@@ -1,0 +1,81 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+A ground-up rebuild of the reference system's capabilities (tasks, actors,
+objects, scheduling, placement groups, collectives, data/train/tune/serve
+libraries) designed for TPU hardware: HBM-resident objects as ``jax.Array``s,
+XLA-compiled task lowering, ICI/DCN collectives via jax.sharding meshes, and
+Pallas kernels for the hot ops.
+"""
+
+from ray_tpu._version import version as __version__
+from ray_tpu.api import (
+    ActorClass,
+    ActorHandle,
+    ActorMethod,
+    RemoteFunction,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_cluster,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RayTaskError,
+    RayTpuError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+__all__ = [
+    "__version__",
+    "ActorClass",
+    "ActorHandle",
+    "ActorMethod",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "get_cluster",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+    # exceptions
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "GetTimeoutError",
+    "ObjectLostError",
+    "RayActorError",
+    "RayTaskError",
+    "RayTpuError",
+    "TaskCancelledError",
+    "WorkerCrashedError",
+]

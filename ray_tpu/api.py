@@ -1,0 +1,412 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors.
+
+Parity with the reference's Python frontend
+(``python/ray/_private/worker.py:1214,2509,2641,2706``,
+``python/ray/remote_function.py:40``, ``python/ray/actor.py:566``): the same
+surface — ``init``, ``@remote`` on functions and classes, ``.remote()`` /
+``.options()`` call styles, ``get``/``put``/``wait``/``kill``/``get_actor`` —
+re-implemented over the in-process TPU-native fabric.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.core.config import Config, get_config, reset_config, set_config
+from ray_tpu.core.ids import ActorID, JobID
+from ray_tpu.core.object_ref import ObjectRef, hooks
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.runtime.cluster import Cluster
+from ray_tpu.runtime.context import RuntimeContext
+from ray_tpu.runtime.worker import CoreWorker, global_worker, set_global_worker
+
+_init_lock = threading.RLock()
+_cluster: Optional[Cluster] = None
+
+
+def is_initialized() -> bool:
+    return _cluster is not None
+
+
+def init(
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[dict] = None,
+    _system_config: Optional[dict] = None,
+    ignore_reinit_error: bool = False,
+    **_compat,
+):
+    """Start the single-host runtime (head node + driver).
+
+    Reference parity: ``ray.init`` (``python/ray/_private/worker.py:1214``) —
+    but instead of exec'ing gcs_server/raylet binaries (``node.py:1371``),
+    the control service, scheduler and object store come up in-process;
+    worker processes spawn lazily.
+    """
+    global _cluster
+    with _init_lock:
+        if _cluster is not None:
+            if ignore_reinit_error:
+                return _cluster
+            raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
+        if _system_config:
+            cfg = Config().apply_env_overrides()
+            cfg.apply_dict(_system_config)
+            set_config(cfg)
+        node_resources = dict(resources or {})
+        node_resources["CPU"] = num_cpus if num_cpus is not None else (os.cpu_count() or 4)
+        if "TPU" not in node_resources:
+            node_resources["TPU"] = num_tpus if num_tpus is not None else _detect_tpus()
+        cluster = Cluster()
+        cluster.add_node(node_resources, labels=labels)
+        job_id = JobID.next()
+        worker = CoreWorker(cluster, job_id)
+        set_global_worker(worker)
+        from ray_tpu.runtime.control import JobInfo
+
+        cluster.control.jobs.add(JobInfo(job_id, entrypoint="driver"))
+        _cluster = cluster
+        return cluster
+
+
+def shutdown() -> None:
+    global _cluster
+    with _init_lock:
+        if _cluster is None:
+            return
+        try:
+            _cluster.shutdown()
+        finally:
+            _cluster = None
+            set_global_worker(None)
+            hooks.ref_counter = None
+            reset_config()
+
+
+def _detect_tpus() -> int:
+    try:
+        import jax
+
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except Exception:
+        return 0
+
+
+def get_cluster() -> Cluster:
+    if _cluster is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return _cluster
+
+
+def _auto_init() -> None:
+    if _cluster is None:
+        init()
+
+
+# --------------------------------------------------------------------------
+# core calls
+# --------------------------------------------------------------------------
+def put(value: Any) -> ObjectRef:
+    _auto_init()
+    return global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    _auto_init()
+    return global_worker().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    _auto_init()
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
+    get_cluster().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Best-effort cancel: queued tasks are dropped at dispatch time (the
+    dispatch path checks the flag and commits TaskCancelledError); already-
+    running tasks are not interrupted (reference parity for non-force)."""
+    for s in get_cluster().task_manager.pending_specs():
+        if ref.id() in s.return_ids:
+            s._cancelled = True
+            return
+
+
+def get_actor(name: str, namespace: str = "default") -> "ActorHandle":
+    info = get_cluster().control.actors.get_by_name(name, namespace)
+    if info is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(info.actor_id, info.class_name, _methods=None)
+
+
+def get_runtime_context() -> RuntimeContext:
+    _auto_init()
+    return RuntimeContext(global_worker())
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for node in get_cluster().nodes.values():
+        if node.dead:
+            continue
+        for k, v in node.pool.total.to_dict().items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    avail: Dict[str, float] = {}
+    for node in get_cluster().nodes.values():
+        if node.dead:
+            continue
+        for k, v in node.pool.available.to_dict().items():
+            avail[k] = avail.get(k, 0) + v
+    return avail
+
+
+def nodes() -> List[dict]:
+    out = []
+    for info in get_cluster().control.nodes.all_nodes():
+        out.append(
+            {
+                "NodeID": info.node_id.hex(),
+                "Alive": info.state.value == "ALIVE",
+                "Resources": info.resources_total,
+                "Labels": info.labels,
+            }
+        )
+    return out
+
+
+def timeline() -> List[dict]:
+    """Chrome-tracing-style task events (ray timeline parity)."""
+    return get_cluster().control.task_events.list_events()
+
+
+# --------------------------------------------------------------------------
+# options normalization
+# --------------------------------------------------------------------------
+_TASK_OPTION_KEYS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "name", "scheduling_strategy",
+    "runtime_env", "execution", "max_calls", "_metadata",
+}
+_ACTOR_OPTION_KEYS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "name", "namespace",
+    "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
+    "scheduling_strategy", "runtime_env", "execution", "max_pending_calls",
+    "_metadata",
+}
+
+
+def _resource_dict(opts: dict, default_cpus: float = 1.0) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    cpus = opts.get("num_cpus")
+    resources["CPU"] = default_cpus if cpus is None else cpus
+    if opts.get("num_tpus"):
+        resources["TPU"] = opts["num_tpus"]
+    if opts.get("num_gpus"):
+        resources["GPU"] = opts["num_gpus"]
+    return {k: v for k, v in resources.items() if v}
+
+
+# --------------------------------------------------------------------------
+# remote functions
+# --------------------------------------------------------------------------
+class RemoteFunction:
+    """Parity: python/ray/remote_function.py:40 (RemoteFunction._remote)."""
+
+    def __init__(self, func, options: Optional[dict] = None):
+        self._function = func
+        self._options = options or {}
+        functools.update_wrapper(self, func)
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        _auto_init()
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        refs = global_worker().submit_task(
+            self._function,
+            args,
+            kwargs,
+            name=opts.get("name") or self._function.__name__,
+            num_returns=num_returns,
+            resources=_resource_dict(opts),
+            max_retries=opts.get("max_retries"),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            execution=opts.get("execution", "auto"),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def options(self, **new_options) -> "RemoteFunction":
+        unknown = set(new_options) - _TASK_OPTION_KEYS
+        if unknown:
+            raise ValueError(f"Unknown task options: {unknown}")
+        merged = {**self._options, **new_options}
+        return RemoteFunction(self._function, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__name__}' cannot be called directly; "
+            f"use '{self._function.__name__}.remote()'."
+        )
+
+
+# --------------------------------------------------------------------------
+# actors
+# --------------------------------------------------------------------------
+class ActorMethod:
+    """Parity: python/ray/actor.py:116."""
+
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        refs = global_worker().submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            name=f"{self._handle._class_name}.{self._method_name}",
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+
+class ActorHandle:
+    """Parity: python/ray/actor.py:1226."""
+
+    def __init__(
+        self,
+        actor_id: ActorID,
+        class_name: str,
+        _methods: Optional[set] = None,
+        _method_num_returns: Optional[Dict[str, int]] = None,
+    ):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._methods = _methods
+        self._method_num_returns = _method_num_returns or {}
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._methods is not None and name not in self._methods:
+            raise AttributeError(f"Actor {self._class_name} has no method {name!r}")
+        return ActorMethod(self, name, self._method_num_returns.get(name, 1))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name, self._methods, self._method_num_returns))
+
+
+class ActorClass:
+    """Parity: python/ray/actor.py:566."""
+
+    def __init__(self, cls, options: Optional[dict] = None):
+        self._cls = cls
+        self._options = options or {}
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        _auto_init()
+        opts = self._options
+        mode = self._pick_mode(opts)
+        actor_id = global_worker().create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            class_name=self._cls.__name__,
+            resources=_resource_dict(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            mode=mode,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+        )
+        methods = {n for n in dir(self._cls) if not n.startswith("_") and callable(getattr(self._cls, n))}
+        num_returns_map = {
+            n: getattr(getattr(self._cls, n), "_rt_num_returns", 1)
+            for n in methods
+            if getattr(getattr(self._cls, n), "_rt_num_returns", 1) != 1
+        }
+        return ActorHandle(actor_id, self._cls.__name__, _methods=methods, _method_num_returns=num_returns_map)
+
+    def _pick_mode(self, opts: dict) -> str:
+        if opts.get("execution") in ("inproc", "thread"):
+            return "inproc"
+        if opts.get("execution") == "process":
+            return "process"
+        # device actors (TPU resources or jax-marked classes) live in-process
+        # next to the device; pure-Python actors get their own process.
+        if opts.get("num_tpus") or (opts.get("resources") or {}).get("TPU"):
+            return "inproc"
+        if getattr(self._cls, "_rt_device", False):
+            return "inproc"
+        return "process"
+
+    def options(self, **new_options) -> "ActorClass":
+        unknown = set(new_options) - _ACTOR_OPTION_KEYS
+        if unknown:
+            raise ValueError(f"Unknown actor options: {unknown}")
+        return ActorClass(self._cls, {**self._options, **new_options})
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor class {self._cls.__name__} cannot be instantiated directly; use .remote().")
+
+
+# --------------------------------------------------------------------------
+# @remote
+# --------------------------------------------------------------------------
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(**options)`` on a function or class."""
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    valid = _TASK_OPTION_KEYS | _ACTOR_OPTION_KEYS
+    unknown = set(kwargs) - valid
+    if unknown:
+        raise ValueError(f"Unknown options to @remote: {unknown}")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+def method(*, num_returns: int = 1):
+    """Parity: @ray.method — per-method num_returns annotation."""
+
+    def decorator(fn):
+        fn._rt_num_returns = num_returns
+        return fn
+
+    return decorator

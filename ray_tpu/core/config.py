@@ -1,0 +1,131 @@
+"""Runtime configuration knobs, every one overridable via environment variable.
+
+Parity with the reference's ``RAY_CONFIG`` macro system
+(``src/ray/common/ray_config_def.h`` — 218 env-overridable knobs): each field
+declared on :class:`Config` can be overridden with ``RAY_TPU_<NAME>`` in the
+environment, or programmatically via the ``_system_config`` dict passed to
+``ray_tpu.init``.  Unlike the reference there is no C++/Python split to keep in
+sync — one dataclass is the single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- object store ----------------------------------------------------
+    # Max bytes of HBM the object table may pin before spilling to host.
+    # 0 = auto (fraction of device memory).
+    object_store_hbm_bytes: int = 0
+    # Fraction of per-device HBM usable by the object store when auto.
+    object_store_hbm_fraction: float = 0.35
+    # Host-RAM tier capacity before spilling to the native shm store / disk.
+    object_store_host_bytes: int = 8 * 1024**3
+    # Inline objects at or below this size directly into task replies
+    # (reference: RayConfig max_direct_call_object_size = 100KB).
+    max_inline_object_size: int = 100 * 1024
+    # Chunk size for inter-host object transfer (reference: 5MiB chunks,
+    # ray_config_def.h:352).
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Directory for disk spill (last tier).
+    spill_dir: str = "/tmp/ray_tpu_spill"
+
+    # ---- scheduler -------------------------------------------------------
+    # Hybrid policy spread threshold (reference hybrid_scheduling_policy.cc:48).
+    scheduler_spread_threshold: float = 0.5
+    # Top-k random choice among best nodes.
+    scheduler_top_k_fraction: float = 0.2
+    # Max tasks dispatched per scheduling iteration.
+    max_tasks_per_dispatch: int = 1000
+
+    # ---- workers ---------------------------------------------------------
+    # Number of CPU-task worker processes to prestart (0 = num_cpus).
+    num_prestart_workers: int = 0
+    # Soft cap on idle workers kept alive per runtime env.
+    idle_worker_cap: int = 8
+    # Seconds before an idle worker process is reaped.
+    idle_worker_timeout_s: float = 60.0
+
+    # ---- tasks / fault tolerance ----------------------------------------
+    # Default max retries for normal tasks (reference default 3).
+    task_max_retries: int = 3
+    # Default max restarts for actors.
+    actor_max_restarts: int = 0
+    # Max bytes of lineage kept per worker (reference max_lineage_bytes).
+    max_lineage_bytes: int = 1024**3
+    # Health-check period / failure threshold (reference
+    # gcs_health_check_manager.h).
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+
+    # ---- compile cache ---------------------------------------------------
+    # Cache compiled executables keyed by (fn, shapes, shardings).
+    executable_cache_size: int = 4096
+    # Automatically lower array-typed remote fns to jax.jit.
+    auto_jit_array_tasks: bool = True
+
+    # ---- events / tracing ------------------------------------------------
+    task_events_enabled: bool = True
+    # Bounded task-event store size (reference GcsTaskManager eviction).
+    task_events_max_entries: int = 100_000
+
+    # ---- distributed -----------------------------------------------------
+    # Port for the control service when serving multi-host.
+    control_port: int = 6380
+    # ray_syncer-equivalent resource broadcast period.
+    resource_sync_period_s: float = 0.1
+
+    def apply_env_overrides(self) -> "Config":
+        for f in dataclasses.fields(self):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                setattr(self, f.name, _coerce(raw, f.type))
+        return self
+
+    def apply_dict(self, overrides: Dict[str, Any]) -> "Config":
+        for key, value in overrides.items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown config key: {key}")
+            setattr(self, key, value)
+        return self
+
+
+def _coerce(raw: str, annot: Any) -> Any:
+    annot = str(annot)
+    if "bool" in annot:
+        return raw.lower() in ("1", "true", "yes")
+    if "int" in annot:
+        return int(raw)
+    if "float" in annot:
+        return float(raw)
+    if "str" in annot:
+        return raw
+    return json.loads(raw)
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env_overrides()
+    return _global_config
+
+
+def set_config(config: Config) -> None:
+    global _global_config
+    _global_config = config
+
+
+def reset_config() -> None:
+    global _global_config
+    _global_config = None

@@ -1,0 +1,199 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes and placement groups.
+
+Design parity with the reference's ID scheme (``src/ray/common/id.h``): every ID is
+a fixed-width byte string; ObjectIDs embed the TaskID that created them plus an
+index, so lineage can be recovered from the ID itself.  TaskIDs embed the ActorID
+(or a nil actor) and the JobID.  Unlike the reference we keep IDs as immutable
+Python objects with interned bytes — there is no C++ struct to mirror because the
+single-host runtime is one process and IDs never cross a language boundary.
+
+Layout (sizes in bytes):
+  JobID:    4
+  ActorID:  12  = 8 unique + JobID
+  TaskID:   16  = 4 unique + ActorID
+  ObjectID: 20  = TaskID + 4 (little-endian object index)
+  NodeID:   16  random
+  PlacementGroupID: 16 = 12 unique + JobID
+  WorkerID: 16  random
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_ID_SIZE = 4
+_ACTOR_UNIQUE_SIZE = 8
+_ACTOR_ID_SIZE = _ACTOR_UNIQUE_SIZE + _JOB_ID_SIZE          # 12
+_TASK_UNIQUE_SIZE = 4
+_TASK_ID_SIZE = _TASK_UNIQUE_SIZE + _ACTOR_ID_SIZE          # 16
+_OBJECT_INDEX_SIZE = 4
+_OBJECT_ID_SIZE = _TASK_ID_SIZE + _OBJECT_INDEX_SIZE        # 20
+_NODE_ID_SIZE = 16
+_PG_UNIQUE_SIZE = 12
+_PG_ID_SIZE = _PG_UNIQUE_SIZE + _JOB_ID_SIZE                # 16
+_WORKER_ID_SIZE = 16
+
+
+class BaseID:
+    """Fixed-width binary identifier. Immutable, hashable, ordered."""
+
+    SIZE = 0
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bytes = binary
+        self._hash = hash((type(self).__name__, binary))
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = _JOB_ID_SIZE
+
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(_JOB_ID_SIZE, "little"))
+
+    @classmethod
+    def next(cls) -> "JobID":
+        with cls._lock:
+            cls._counter += 1
+            return cls.from_int(cls._counter)
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class NodeID(BaseID):
+    SIZE = _NODE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = _WORKER_ID_SIZE
+
+
+class ActorID(BaseID):
+    SIZE = _ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(_ACTOR_UNIQUE_SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[_ACTOR_UNIQUE_SIZE:])
+
+
+class TaskID(BaseID):
+    SIZE = _TASK_ID_SIZE
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID) -> "TaskID":
+        return cls(os.urandom(_TASK_UNIQUE_SIZE) + ActorID.nil().binary()[: _ACTOR_UNIQUE_SIZE] + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(os.urandom(_TASK_UNIQUE_SIZE) + actor_id.binary())
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":
+        # Deterministic: zero unique prefix marks the creation task.
+        return cls(b"\x00" * _TASK_UNIQUE_SIZE + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(b"\x01" * _TASK_UNIQUE_SIZE + ActorID.nil().binary()[: _ACTOR_UNIQUE_SIZE] + job_id.binary())
+
+    def actor_id(self) -> ActorID:
+        embedded = self._bytes[_TASK_UNIQUE_SIZE:]
+        # Normal tasks embed a nil actor-unique prefix (job id still present).
+        if embedded[:_ACTOR_UNIQUE_SIZE] == b"\xff" * _ACTOR_UNIQUE_SIZE:
+            return ActorID.nil()
+        return ActorID(embedded)
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-_JOB_ID_SIZE:])
+
+
+class ObjectID(BaseID):
+    """Embeds the creating TaskID + return/put index → lineage is recoverable."""
+
+    SIZE = _OBJECT_ID_SIZE
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        # index 0 is reserved for puts; returns start at 1 (reference convention).
+        return cls(task_id.binary() + index.to_bytes(_OBJECT_INDEX_SIZE, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # puts use the high bit of the index to avoid collision with returns.
+        idx = put_index | 0x80000000
+        return cls(task_id.binary() + idx.to_bytes(_OBJECT_INDEX_SIZE, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_TASK_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[_TASK_ID_SIZE:], "little")
+
+    def is_put(self) -> bool:
+        return bool(self.index() & 0x80000000)
+
+    def is_return(self) -> bool:
+        return not self.is_put()
+
+
+class PlacementGroupID(BaseID):
+    SIZE = _PG_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "PlacementGroupID":
+        return cls(os.urandom(_PG_UNIQUE_SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[_PG_UNIQUE_SIZE:])

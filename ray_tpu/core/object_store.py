@@ -1,0 +1,323 @@
+"""Tiered object store: HBM-resident jax.Arrays with host/shm/disk spill.
+
+This is the rebuild of the reference's two stores:
+
+  * plasma (``src/ray/object_manager/plasma/store.h``) — node-wide shared
+    immutable objects; here the **native shm tier** (``ray_tpu/native``) plus
+    the host tier play that role.
+  * the in-memory store (``src/ray/core_worker/store_provider/memory_store/
+    memory_store.h:43``) — small/inline objects and errors with blocking Get;
+    here every entry supports blocking get via a per-object future.
+
+TPU-first: the *primary* tier is HBM — a ``jax.Array`` is stored as-is
+(zero-copy; XLA async dispatch means a stored array may still be materializing
+on device, which is invisible to the table).  Spill order under memory
+pressure mirrors plasma's pinned→evictable flow
+(``object_lifecycle_manager.h``): DEVICE → HOST (device_get), HOST → SHM
+(large buffers, zero-copy for workers) or DISK (pickled), with LRU ordering.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from enum import Enum
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+class Tier(Enum):
+    DEVICE = "device"   # jax.Array in HBM
+    HOST = "host"       # any python object in process heap
+    SHM = "shm"         # native shared-memory store (serialized)
+    DISK = "disk"       # pickled file in spill_dir
+
+
+def _is_device_array(value: Any) -> bool:
+    cls = type(value)
+    mod = cls.__module__ or ""
+    if not mod.startswith("jax"):
+        return False
+    try:
+        import jax
+
+        return isinstance(value, jax.Array) and all(
+            d.platform != "cpu" for d in value.devices()
+        )
+    except Exception:
+        return False
+
+
+def _nbytes(value: Any) -> int:
+    if isinstance(value, np.ndarray):
+        return value.nbytes
+    nb = getattr(value, "nbytes", None)
+    if isinstance(nb, int):
+        return nb
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    return 0  # small control-plane object; not accounted
+
+
+class ObjectEntry:
+    __slots__ = ("value", "tier", "size", "is_error", "meta", "disk_path")
+
+    def __init__(self, value: Any, tier: Tier, size: int, is_error: bool = False):
+        self.value = value
+        self.tier = tier
+        self.size = size
+        self.is_error = is_error
+        self.meta: Optional[dict] = None
+        self.disk_path: Optional[str] = None
+
+
+class ObjectStore:
+    """Single-host object table. Thread-safe; blocking gets via futures."""
+
+    def __init__(self, shm_store=None, hbm_budget: Optional[int] = None, host_budget: Optional[int] = None):
+        cfg = get_config()
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
+        self._waiters: Dict[ObjectID, List[Future]] = {}
+        self._shm = shm_store
+        self._hbm_used = 0
+        self._host_used = 0
+        self._hbm_budget = hbm_budget if hbm_budget is not None else cfg.object_store_hbm_bytes or _auto_hbm_budget()
+        self._host_budget = host_budget if host_budget is not None else cfg.object_store_host_bytes
+        self._spill_dir = cfg.spill_dir
+        self.num_puts = 0
+        self.num_gets = 0
+        self.num_spills = 0
+        self.num_restores = 0
+
+    # ------------------------------------------------------------------ put
+    def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
+        if _is_device_array(value):
+            tier, size = Tier.DEVICE, _nbytes(value)
+        else:
+            tier, size = Tier.HOST, _nbytes(value)
+        entry = ObjectEntry(value, tier, size, is_error)
+        with self._lock:
+            self._entries[object_id] = entry
+            self._entries.move_to_end(object_id)
+            if tier is Tier.DEVICE:
+                self._hbm_used += size
+            else:
+                self._host_used += size
+            self.num_puts += 1
+            waiters = self._waiters.pop(object_id, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(value)
+        self._maybe_spill()
+
+    def put_error(self, object_id: ObjectID, error: BaseException) -> None:
+        self.put(object_id, error, is_error=True)
+
+    # ------------------------------------------------------------------ get
+    def get_async(self, object_id: ObjectID) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None:
+                value = self._materialize_locked(object_id, entry)
+                self._entries.move_to_end(object_id)
+                self.num_gets += 1
+                fut.set_result(value)
+                return fut
+            self._waiters.setdefault(object_id, []).append(fut)
+        return fut
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        fut = self.get_async(object_id)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            raise GetTimeoutError(f"Get timed out for {object_id}")
+
+    def get_batch(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        futures = [self.get_async(oid) for oid in object_ids]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for fut in futures:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                out.append(fut.result(remaining))
+            except TimeoutError:
+                raise GetTimeoutError("Get timed out")
+        return out
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def is_ready(self, object_id: ObjectID) -> bool:
+        return self.contains(object_id)
+
+    def entry_info(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            return {"tier": e.tier.value, "size": e.size, "is_error": e.is_error}
+
+    # --------------------------------------------------------------- delete
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is None:
+                return
+            self._account_remove(entry)
+            if entry.tier is Tier.SHM and self._shm is not None:
+                self._shm.delete(object_id.binary())
+            elif entry.tier is Tier.DISK and entry.disk_path:
+                try:
+                    os.unlink(entry.disk_path)
+                except OSError:
+                    pass
+
+    def fail_pending(self, object_id: ObjectID, error: BaseException) -> None:
+        """Wake waiters with an error without storing a value."""
+        with self._lock:
+            waiters = self._waiters.pop(object_id, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_exception(error)
+
+    # ---------------------------------------------------------------- spill
+    def _maybe_spill(self) -> None:
+        with self._lock:
+            if self._hbm_used > self._hbm_budget:
+                self._spill_device_locked(self._hbm_used - self._hbm_budget)
+            if self._host_used > self._host_budget:
+                self._spill_host_locked(self._host_used - self._host_budget)
+
+    def _spill_device_locked(self, need: int) -> None:
+        freed = 0
+        for oid, entry in list(self._entries.items()):
+            if freed >= need:
+                break
+            if entry.tier is Tier.DEVICE:
+                host = np.asarray(entry.value)  # device_get; sync point
+                entry.value = host
+                entry.tier = Tier.HOST
+                self._hbm_used -= entry.size
+                self._host_used += entry.size
+                freed += entry.size
+                self.num_spills += 1
+
+    def _spill_host_locked(self, need: int) -> None:
+        freed = 0
+        for oid, entry in list(self._entries.items()):
+            if freed >= need:
+                break
+            if entry.tier is not Tier.HOST or entry.size == 0:
+                continue
+            if self._try_spill_entry_locked(oid, entry):
+                freed += entry.size
+
+    def _try_spill_entry_locked(self, oid: ObjectID, entry: ObjectEntry) -> bool:
+        value = entry.value
+        if self._shm is not None and isinstance(value, np.ndarray) and value.dtype != object:
+            try:
+                header = pickle.dumps((value.dtype.str, value.shape))
+                data = np.ascontiguousarray(value)
+                payload = header + data.tobytes()
+                self._shm.put(oid.binary(), payload, meta_size=len(header))
+                entry.value = None
+                entry.tier = Tier.SHM
+                self._host_used -= entry.size
+                self.num_spills += 1
+                return True
+            except (MemoryError, FileExistsError):
+                pass
+        # disk fallback
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            pickle.dump(value, f, protocol=5)
+        entry.value = None
+        entry.tier = Tier.DISK
+        entry.disk_path = path
+        self._host_used -= entry.size
+        self.num_spills += 1
+        return True
+
+    def _materialize_locked(self, oid: ObjectID, entry: ObjectEntry) -> Any:
+        if entry.tier in (Tier.DEVICE, Tier.HOST):
+            return entry.value
+        if entry.tier is Tier.SHM:
+            got = self._shm.get(oid.binary())
+            if got is None:
+                raise ObjectLostError(oid)
+            view, meta_size = got
+            try:
+                dtype_str, shape = pickle.loads(view[:meta_size])
+                value = np.frombuffer(view[meta_size:], dtype=np.dtype(dtype_str)).reshape(shape).copy()
+            finally:
+                self._shm.release(oid.binary())
+            entry.value = value
+            entry.tier = Tier.HOST
+            self._host_used += entry.size
+            self._shm.delete(oid.binary())
+            self.num_restores += 1
+            return value
+        if entry.tier is Tier.DISK:
+            with open(entry.disk_path, "rb") as f:
+                value = pickle.load(f)
+            entry.value = value
+            entry.tier = Tier.HOST
+            self._host_used += entry.size
+            try:
+                os.unlink(entry.disk_path)
+            except OSError:
+                pass
+            entry.disk_path = None
+            self.num_restores += 1
+            return value
+        raise ObjectLostError(oid)
+
+    def _account_remove(self, entry: ObjectEntry) -> None:
+        if entry.tier is Tier.DEVICE:
+            self._hbm_used -= entry.size
+        elif entry.tier is Tier.HOST:
+            self._host_used -= entry.size
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "hbm_used": self._hbm_used,
+                "hbm_budget": self._hbm_budget,
+                "host_used": self._host_used,
+                "host_budget": self._host_budget,
+                "puts": self.num_puts,
+                "gets": self.num_gets,
+                "spills": self.num_spills,
+                "restores": self.num_restores,
+            }
+
+
+def _auto_hbm_budget() -> int:
+    cfg = get_config()
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit * cfg.object_store_hbm_fraction)
+    except Exception:
+        pass
+    return 4 * 1024**3

@@ -1,0 +1,134 @@
+"""Serialization for cross-process object transport.
+
+Parity with the reference's ``python/ray/_private/serialization.py``
+(``SerializationContext``): a small metadata envelope plus pickle protocol 5
+out-of-band buffers, so numpy arrays (and host-materialized ``jax.Array``s)
+move between processes without an extra copy.  ObjectRefs pickled inside task
+arguments are recorded by the context so the receiver can be registered as a
+borrower (reference: ``serialization.py:145`` object_ref_reducer →
+``ReferenceCounter`` borrower protocol).
+
+TPU-first deltas from the reference:
+  * In-process tasks (device tasks on the host runtime) never serialize at
+    all — objects pass by reference.  This module is only used at process
+    boundaries (CPU worker pool, multi-host transfer) and for spill tiers.
+  * ``jax.Array`` serializes as (dtype, shape, sharding-less host bytes); on
+    deserialize it becomes numpy, and re-materializes to HBM lazily on first
+    device use.  Device-to-device movement across hosts rides ICI/DCN via the
+    transfer layer, not this path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+_JAX_ARRAY_MARKER = b"__ray_tpu_jax_array__"
+
+
+class SerializedObject:
+    """Envelope: a pickle5 stream plus its out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers")
+
+    def __init__(self, meta: bytes, buffers: List[pickle.PickleBuffer]):
+        self.meta = meta
+        self.buffers = buffers
+
+    def total_bytes(self) -> int:
+        return len(self.meta) + sum(b.raw().nbytes for b in self.buffers)
+
+    def to_flat_parts(self) -> List[bytes]:
+        """Flatten for socket/shm transport: [meta, buf0, buf1, ...]."""
+        return [self.meta] + [bytes(b.raw()) for b in self.buffers]
+
+
+class SerializationContext:
+    """Pickle-5-based serializer with pluggable custom reducers.
+
+    Thread-local hook state lets the object-ref reducer capture which refs are
+    being smuggled inside an object graph (→ borrower registration).
+    """
+
+    def __init__(self):
+        self._reducers: dict[type, Callable] = {}
+        self._local = threading.local()
+
+    def register_reducer(self, cls: type, reducer: Callable) -> None:
+        self._reducers[cls] = reducer
+
+    # -- ref capture hooks -------------------------------------------------
+    def start_capture_refs(self) -> None:
+        self._local.captured_refs = []
+
+    def stop_capture_refs(self) -> list:
+        refs = getattr(self._local, "captured_refs", [])
+        self._local.captured_refs = None
+        return refs
+
+    def note_ref(self, ref) -> None:
+        captured = getattr(self._local, "captured_refs", None)
+        if captured is not None:
+            captured.append(ref)
+
+    # -- serialize/deserialize --------------------------------------------
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: List[pickle.PickleBuffer] = []
+
+        class _Pickler(pickle.Pickler):
+            dispatch_table = {}
+
+            def reducer_override(p_self, obj):  # noqa: N805
+                r = self._reducers.get(type(obj))
+                if r is not None:
+                    return r(obj)
+                if isinstance(obj, np.ndarray) and obj.dtype != object:
+                    return NotImplemented  # numpy handles PickleBuffer itself
+                if _is_jax_array(obj):
+                    host = np.asarray(obj)
+                    return (_rebuild_jax_array, (host,))
+                return NotImplemented
+
+        import io
+
+        stream = io.BytesIO()
+        pickler = _Pickler(stream, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
+        return SerializedObject(stream.getvalue(), buffers)
+
+    def deserialize(self, serialized: SerializedObject) -> Any:
+        return pickle.loads(serialized.meta, buffers=serialized.buffers)
+
+    def deserialize_parts(self, parts: List[bytes]) -> Any:
+        meta, raw_bufs = parts[0], parts[1:]
+        return pickle.loads(meta, buffers=[pickle.PickleBuffer(b) for b in raw_bufs])
+
+
+def _is_jax_array(obj: Any) -> bool:
+    # Avoid importing jax at module load for CPU-only worker processes.
+    cls = type(obj)
+    mod = cls.__module__ or ""
+    return mod.startswith("jax") and cls.__name__ in ("ArrayImpl", "Array")
+
+
+def _rebuild_jax_array(host: np.ndarray):
+    # Deserialized jax arrays come back as numpy; they re-enter HBM lazily on
+    # first device use (jit will device_put them).  This keeps worker-pool
+    # processes free of device state.
+    return host
+
+
+_default_context: SerializationContext | None = None
+_default_lock = threading.Lock()
+
+
+def get_context() -> SerializationContext:
+    global _default_context
+    if _default_context is None:
+        with _default_lock:
+            if _default_context is None:
+                _default_context = SerializationContext()
+    return _default_context

@@ -1,0 +1,114 @@
+"""Exception hierarchy.
+
+Parity with the reference's ``python/ray/exceptions.py``: errors raised inside a
+task are captured, stored as the task's result object, and re-raised at
+``get()`` time wrapped in :class:`RayTaskError` so the full remote traceback is
+visible at the caller.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception; re-raised at the caller on get().
+
+    Mirrors ``python/ray/exceptions.py:RayTaskError`` — carries the remote
+    traceback text and the original cause.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: BaseException | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Task {function_name} failed:\n{traceback_str}")
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException) -> "RayTaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, exc)
+
+    def __reduce__(self):
+        # The cause may not be picklable (it crossed a process boundary as
+        # text); the traceback string carries the information.
+        return (_rebuild_task_error, (self.function_name, self.traceback_str, _maybe_picklable(self.cause)))
+
+
+def _rebuild_task_error(function_name, traceback_str, cause):
+    return RayTaskError(function_name, traceback_str, cause)
+
+
+def _maybe_picklable(obj):
+    import pickle
+
+    if obj is None:
+        return None
+    try:
+        pickle.dumps(obj)
+        return obj
+    except Exception:
+        return None
+
+
+class RayActorError(RayTpuError):
+    """The actor died (creation failure, crash, or intentional kill)."""
+
+    def __init__(self, actor_id=None, message: str = "The actor died unexpectedly."):
+        self.actor_id = actor_id
+        super().__init__(message)
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """Actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object could not be found or reconstructed."""
+
+    def __init__(self, object_id, message: str | None = None):
+        self.object_id = object_id
+        super().__init__(message or f"Object {object_id} was lost and could not be reconstructed.")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    def __init__(self, object_id):
+        super().__init__(object_id, f"The owner of object {object_id} has died.")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get() timed out."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled.")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's pending-call queue is full (max_pending_calls)."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Object store / HBM capacity exhausted."""

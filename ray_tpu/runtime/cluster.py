@@ -1,0 +1,511 @@
+"""The in-process cluster fabric: nodes, object directory, transfer, recovery.
+
+This object stitches together what the reference spreads over processes:
+
+  * object locations + pulls — ``OwnershipBasedObjectDirectory``
+    (``src/ray/object_manager/ownership_based_object_directory.h:37``) and
+    ``PullManager`` (``pull_manager.h:52``): locations are looked up on
+    demand, transfers copy an object's value between node stores (standing in
+    for chunked Push/Pull gRPC; on real multi-host this becomes ICI/DCN
+    device-to-device transfer),
+  * owner-side task completion — ``TaskManager::CompletePendingTask``
+    (``task_manager.h:283``): returns are committed, waiters woken, retries
+    decided here,
+  * actor call routing with per-actor ordered queues
+    (``direct_actor_task_submitter.h:120``) including buffering while the
+    actor is PENDING/RESTARTING,
+  * failure handling — node death drops its store and resubmits its pending
+    tasks; lost objects rebuild via lineage
+    (``object_recovery_manager.h:41``); actors restart per the control
+    service FSM (``gcs_actor_manager.h:513``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.task_manager import TaskManager
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ObjectLostError,
+    RayTaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.runtime.control import ActorState, ControlService, NodeInfo
+from ray_tpu.runtime.node import Node
+from ray_tpu.runtime.scheduler import ClusterScheduler, TaskSpec
+
+
+class ObjectDirectory:
+    """object id -> node locations, with waiters for not-yet-created objects."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._locations: Dict[ObjectID, Set[NodeID]] = {}
+        self._waiters: Dict[ObjectID, List[Callable[[NodeID], None]]] = {}
+
+    def add_location(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._lock:
+            self._locations.setdefault(oid, set()).add(node_id)
+            waiters = self._waiters.pop(oid, [])
+        for cb in waiters:
+            cb(node_id)
+
+    def remove_location(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._lock:
+            locs = self._locations.get(oid)
+            if locs:
+                locs.discard(node_id)
+
+    def locations(self, oid: ObjectID) -> Set[NodeID]:
+        with self._lock:
+            return set(self._locations.get(oid, ()))
+
+    def wait_for(self, oid: ObjectID, callback: Callable[[NodeID], None]) -> None:
+        with self._lock:
+            locs = self._locations.get(oid)
+            if locs:
+                node_id = next(iter(locs))
+            else:
+                self._waiters.setdefault(oid, []).append(callback)
+                return
+        callback(node_id)
+
+    def drop_node(self, node_id: NodeID) -> List[ObjectID]:
+        """Remove all locations on a dead node; return objects now lost."""
+        lost = []
+        with self._lock:
+            for oid, locs in self._locations.items():
+                locs.discard(node_id)
+                if not locs:
+                    lost.append(oid)
+            for oid in lost:
+                del self._locations[oid]
+        return lost
+
+    def forget(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._locations.pop(oid, None)
+            self._waiters.pop(oid, None)
+
+
+class _ActorQueue:
+    """Per-actor ordered send queue (head-of-line blocking on dep pulls)."""
+
+    __slots__ = ("pending", "lock", "alive")
+
+    def __init__(self):
+        self.pending: deque = deque()   # [spec, ready: bool]
+        self.lock = threading.Lock()
+        self.alive = False
+
+
+class Cluster:
+    def __init__(self, session_dir: Optional[str] = None, shm_capacity: int = 0):
+        cfg = get_config()
+        self.session_dir = session_dir or f"/tmp/ray_tpu_session_{os.getpid()}"
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.control = ControlService()
+        self.cluster_scheduler = ClusterScheduler()
+        self.directory = ObjectDirectory()
+        self.task_manager = TaskManager()
+        self.nodes: Dict[NodeID, Node] = {}
+        self.head_node: Optional[Node] = None
+        self._actor_queues: Dict[ActorID, _ActorQueue] = {}
+        self._actor_lock = threading.RLock()
+        self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
+        self._actor_options: Dict[ActorID, dict] = {}
+        self.core_worker = None       # set by worker.init
+        self.shm_store = None
+        if shm_capacity >= 0:
+            try:
+                from ray_tpu.native.shm_store import ShmObjectStore
+
+                self.shm_store = ShmObjectStore(
+                    f"/rt_{os.getpid()}_{id(self) & 0xffff:x}",
+                    shm_capacity or (2 << 30),
+                )
+            except Exception:
+                self.shm_store = None
+        self.transfer_bytes = 0
+        self.transfer_count = 0
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+    def add_node(self, resources: Dict[str, float], labels: Optional[dict] = None) -> Node:
+        node_id = NodeID.from_random()
+        node = Node(node_id, resources, self, shm_store=self.shm_store, labels=labels)
+        self.nodes[node_id] = node
+        self.cluster_scheduler.register_node(node_id, node.pool, labels)
+        self.control.nodes.register(NodeInfo(node_id, f"inproc://{node_id.hex()[:8]}", resources, labels))
+        if self.head_node is None:
+            self.head_node = node
+        # placement groups act on the live node pools
+        self.control.placement_groups.bind_node_pools(
+            {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
+        )
+        return node
+
+    def kill_node(self, node_id: NodeID) -> None:
+        """Chaos hook: simulate node failure (NodeKillerActor parity,
+        python/ray/_private/test_utils.py:1497)."""
+        node = self.nodes.get(node_id)
+        if node is None or node.dead:
+            return
+        node.dead = True
+        self.cluster_scheduler.remove_node(node_id)
+        self.control.nodes.mark_dead(node_id)
+        self.control.placement_groups.on_node_dead(node_id)
+        # objects whose only copy was there are lost
+        lost = self.directory.drop_node(node_id)
+        # resubmit this node's pending tasks (system failure → consumes retry)
+        for spec in self.task_manager.pending_specs():
+            if spec.owner_node == node_id and spec.actor_id is None:
+                if self.task_manager.should_retry(spec, is_system_error=True):
+                    self.submit(spec)
+                else:
+                    self.task_manager.mark_failed(spec)
+                    self._commit_error_everywhere(spec, WorkerCrashedError(f"node {node_id.hex()[:8]} died"))
+        # recover lost objects that someone may still want
+        for oid in lost:
+            self._try_recover(oid)
+        # actors hosted there follow the restart FSM
+        for info in self.control.actors.list_actors():
+            if info.node_id == node_id and info.state in (ActorState.ALIVE, ActorState.PENDING_CREATION):
+                self._handle_actor_failure(info.actor_id, f"node {node_id.hex()[:8]} died")
+        node.shutdown()
+
+    # ------------------------------------------------------------------
+    # task submission (cluster-level)
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        node_id = self.cluster_scheduler.pick_node(spec)
+        if node_id is None:
+            # infeasible now: park until resources free up / nodes join.
+            self._park_infeasible(spec)
+            return
+        self.nodes[node_id].submit(spec)
+
+    def _park_infeasible(self, spec: TaskSpec) -> None:
+        def retry_later():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                time.sleep(0.05)
+                node_id = self.cluster_scheduler.pick_node(spec)
+                if node_id is not None:
+                    self.nodes[node_id].submit(spec)
+                    return
+            self.task_manager.mark_failed(spec)
+            self._commit_error_everywhere(
+                spec,
+                RayTaskError(spec.name, f"Task {spec.name} is infeasible: requires {spec.resources.to_dict()}"),
+            )
+
+        threading.Thread(target=retry_later, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # object pulls / transfer
+    # ------------------------------------------------------------------
+    def pull_object(self, oid: ObjectID, dest_node: Node, callback: Callable[[], None]) -> None:
+        if dest_node.store.contains(oid):
+            callback()
+            return
+
+        def on_located(src_node_id: NodeID) -> None:
+            if src_node_id == dest_node.node_id:
+                callback()
+                return
+            src = self.nodes.get(src_node_id)
+            if src is None or src.dead:
+                # location was stale; retry the wait
+                self.directory.wait_for(oid, on_located)
+                return
+            try:
+                value = src.store.get(oid, timeout=30)
+            except Exception:
+                self.directory.wait_for(oid, on_located)
+                return
+            # chunked-transfer accounting (object_manager 5MiB chunks parity)
+            size = getattr(value, "nbytes", 0) or 0
+            self.transfer_bytes += size
+            self.transfer_count += 1
+            dest_node.store.put(oid, value)
+            self.directory.add_location(oid, dest_node.node_id)
+            callback()
+
+        self.directory.wait_for(oid, on_located)
+        # if nothing will ever produce it, try lineage reconstruction
+        if not self.directory.locations(oid) and not self._is_pending(oid):
+            self._try_recover(oid)
+
+    def _is_pending(self, oid: ObjectID) -> bool:
+        for spec in self.task_manager.pending_specs():
+            if oid in spec.return_ids:
+                return True
+        return False
+
+    def _try_recover(self, oid: ObjectID) -> bool:
+        spec = self.task_manager.lineage_spec(oid)
+        if spec is None:
+            return False
+        spec.retries_left = max(spec.retries_left, 1)
+        spec.attempt += 1
+        self.task_manager.add_pending(spec)
+        self.submit(spec)
+        return True
+
+    # ------------------------------------------------------------------
+    # owner-side completion
+    # ------------------------------------------------------------------
+    def on_task_finished(self, node: Node, spec: TaskSpec, result: Any, error: Optional[BaseException]) -> None:
+        if error is not None:
+            is_system = isinstance(error, (WorkerCrashedError, ActorDiedError))
+            retry_exceptions = getattr(spec, "_retry_exceptions", False)
+            if spec.actor_id is None and self.task_manager.should_retry(spec, is_system, retry_exceptions):
+                self.submit(spec)
+                return
+            self.task_manager.mark_failed(spec)
+            self._commit_error_everywhere(spec, error)
+            self._after_commit(spec)
+            return
+
+        # split returns
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result) if result is not None else [None] * spec.num_returns
+        for oid, value in zip(spec.return_ids, values):
+            node.store.put(oid, value)
+            self.directory.add_location(oid, node.node_id)
+        self.task_manager.mark_completed(spec)
+        self._after_commit(spec)
+        if get_config().task_events_enabled:
+            self.control.task_events.add(
+                {"task_id": spec.task_id.hex(), "name": spec.name, "state": "FINISHED", "node": node.node_id.hex()[:8], "ts": time.time()}
+            )
+
+    def _commit_error_everywhere(self, spec: TaskSpec, error: BaseException) -> None:
+        node = self.nodes.get(spec.owner_node)
+        if node is None or node.dead:
+            node = self.head_node
+        for oid in spec.return_ids:
+            node.store.put(oid, error, is_error=True)
+            self.directory.add_location(oid, node.node_id)
+
+    def _after_commit(self, spec: TaskSpec) -> None:
+        if self.core_worker is not None:
+            self.core_worker.on_task_committed(spec)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int, info, namespace: str = "default") -> None:
+        with self._actor_lock:
+            self._actor_queues[spec.actor_id] = _ActorQueue()
+            self._actor_specs[spec.actor_id] = spec
+            self._actor_options[spec.actor_id] = {"mode": mode, "max_concurrency": max_concurrency}
+        self.control.actors.register(info, namespace=namespace)
+        self._schedule_actor_creation(spec)
+
+    def _schedule_actor_creation(self, spec: TaskSpec) -> None:
+        node_id = self.cluster_scheduler.pick_node(spec)
+        if node_id is None:
+            def retry():
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.05)
+                    nid = self.cluster_scheduler.pick_node(spec)
+                    if nid is not None:
+                        self._start_actor_on(nid, spec)
+                        return
+                self.on_actor_creation_failed(spec, ActorDiedError(spec.actor_id, "actor creation infeasible"))
+
+            threading.Thread(target=retry, daemon=True).start()
+            return
+        self._start_actor_on(node_id, spec)
+
+    def _start_actor_on(self, node_id: NodeID, spec: TaskSpec) -> None:
+        opts = self._actor_options[spec.actor_id]
+        node = self.nodes[node_id]
+        if not node.pool.acquire(spec.resources):
+            # raced; rescheduling
+            self._schedule_actor_creation(spec)
+            return
+        spec.owner_node = node_id
+        deps = [d for d in spec.dependencies if not node.store.contains(d)]
+        if deps:
+            remaining = len(deps)
+            lock = threading.Lock()
+
+            def on_ready(_=None):
+                nonlocal remaining
+                with lock:
+                    remaining -= 1
+                    if remaining:
+                        return
+                node.create_actor(spec, opts["mode"], opts["max_concurrency"])
+
+            for dep in deps:
+                self.pull_object(dep, node, on_ready)
+        else:
+            node.create_actor(spec, opts["mode"], opts["max_concurrency"])
+
+    def on_actor_created(self, node: Node, spec: TaskSpec) -> None:
+        self.control.actors.mark_alive(spec.actor_id, node.node_id)
+        q = self._actor_queues.get(spec.actor_id)
+        if q is not None:
+            with q.lock:
+                q.alive = True
+            self._pump_actor_queue(spec.actor_id)
+
+    def on_actor_creation_failed(self, spec: TaskSpec, error: BaseException) -> None:
+        node = self.nodes.get(spec.owner_node)
+        if node is not None:
+            node.pool.release(spec.resources)
+        state = self.control.actors.on_failure(spec.actor_id, str(error))
+        if state is ActorState.RESTARTING:
+            self._schedule_actor_creation(self._actor_specs[spec.actor_id])
+        else:
+            self._fail_actor_queue(spec.actor_id, error)
+
+    def on_actor_process_died(self, node: Node, actor_id: ActorID) -> None:
+        self._handle_actor_failure(actor_id, "actor process died")
+
+    def _handle_actor_failure(self, actor_id: ActorID, cause: str) -> None:
+        spec = self._actor_specs.get(actor_id)
+        if spec is not None:
+            node = self.nodes.get(spec.owner_node)
+            if node is not None and not node.dead:
+                node.kill_actor(actor_id)
+                node.pool.release(spec.resources)
+        q = self._actor_queues.get(actor_id)
+        if q is not None:
+            with q.lock:
+                q.alive = False
+        state = self.control.actors.on_failure(actor_id, cause)
+        if state is ActorState.RESTARTING and spec is not None:
+            spec.attempt += 1
+            self._schedule_actor_creation(spec)
+        else:
+            self._fail_actor_queue(actor_id, ActorDiedError(actor_id, f"The actor died: {cause}"))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        info = self.control.actors.get(actor_id)
+        if info is None:
+            return
+        if not no_restart:
+            # kill the process/thread but let the restart FSM bring it back
+            # (ray.kill(handle, no_restart=False) parity).
+            self._handle_actor_failure(actor_id, "killed via kill_actor (restartable)")
+            return
+        info.max_restarts = info.num_restarts  # exhaust restarts
+        if info.node_id is not None:
+            node = self.nodes.get(info.node_id)
+            if node is not None:
+                node.kill_actor(actor_id)
+        spec = self._actor_specs.get(actor_id)
+        if spec is not None:
+            node = self.nodes.get(spec.owner_node)
+            if node is not None and not node.dead:
+                node.pool.release(spec.resources)
+        self.control.actors.mark_dead(actor_id, "killed via kill_actor")
+        self._fail_actor_queue(actor_id, ActorDiedError(actor_id, "The actor was killed"))
+
+    # -- ordered per-actor call queue -----------------------------------
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        q = self._actor_queues.get(spec.actor_id)
+        info = self.control.actors.get(spec.actor_id)
+        if q is None or info is None or info.state is ActorState.DEAD:
+            self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))
+            self._after_commit(spec)
+            return
+        entry = [spec, False]
+        with q.lock:
+            q.pending.append(entry)
+        # start dep pulls targeting the actor's node (known once alive)
+        self._prepare_actor_entry(entry)
+
+    def _prepare_actor_entry(self, entry) -> None:
+        spec = entry[0]
+        info = self.control.actors.get(spec.actor_id)
+        if info is None or info.state is not ActorState.ALIVE or info.node_id is None:
+            # deps pulled when the actor lands; mark ready if no deps
+            if not spec.dependencies:
+                entry[1] = True
+            return
+        node = self.nodes[info.node_id]
+        deps = [d for d in spec.dependencies if not node.store.contains(d)]
+        if not deps:
+            entry[1] = True
+            self._pump_actor_queue(spec.actor_id)
+            return
+        remaining = len(deps)
+        lock = threading.Lock()
+
+        def on_ready(_=None):
+            nonlocal remaining
+            with lock:
+                remaining -= 1
+                if remaining:
+                    return
+            entry[1] = True
+            self._pump_actor_queue(spec.actor_id)
+
+        for dep in deps:
+            self.pull_object(dep, node, on_ready)
+
+    def _pump_actor_queue(self, actor_id: ActorID) -> None:
+        q = self._actor_queues.get(actor_id)
+        info = self.control.actors.get(actor_id)
+        if q is None or info is None:
+            return
+        if info.state is not ActorState.ALIVE or info.node_id is None:
+            return
+        node = self.nodes[info.node_id]
+        # Submit under q.lock so concurrent pumps (dep-pull callbacks,
+        # on_actor_created) cannot interleave and reorder the per-actor
+        # stream — submission order IS the execution order guarantee.
+        needs_prep = None
+        with q.lock:
+            while q.alive and q.pending:
+                head = q.pending[0]
+                if not head[1]:
+                    spec = head[0]
+                    if bool(spec.dependencies) and any(
+                        not node.store.contains(d) for d in spec.dependencies
+                    ):
+                        needs_prep = head
+                        break
+                    head[1] = True
+                q.pending.popleft()
+                node.submit_actor_task(head[0])
+        if needs_prep is not None:
+            self._prepare_actor_entry(needs_prep)
+
+    def _fail_actor_queue(self, actor_id: ActorID, error: BaseException) -> None:
+        q = self._actor_queues.get(actor_id)
+        if q is None:
+            return
+        with q.lock:
+            pending = list(q.pending)
+            q.pending.clear()
+        for spec, _ready in pending:
+            self._commit_error_everywhere(spec, error)
+            self._after_commit(spec)
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self.control.shutdown()
+        for node in self.nodes.values():
+            if not node.dead:
+                node.shutdown()
+        if self.shm_store is not None:
+            self.shm_store.close()
+            self.shm_store.unlink()

@@ -1,0 +1,67 @@
+"""Per-thread execution context: which task/node is currently executing.
+
+Parity with the reference's ``python/ray/runtime_context.py`` plus the
+worker's current-task tracking — used so nested submissions and ``put``s are
+attributed to the running task (ObjectIDs embed the creating TaskID).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ray_tpu.core.ids import JobID, NodeID, TaskID
+
+
+class _TaskContext:
+    def __init__(self):
+        self._local = threading.local()
+
+    def push(self, task_id: TaskID, node_id: NodeID):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        stack.append((task_id, node_id))
+        return len(stack) - 1
+
+    def pop(self, token: int) -> None:
+        stack = getattr(self._local, "stack", [])
+        if stack:
+            stack.pop()
+
+    def current(self) -> Optional[Tuple[TaskID, NodeID]]:
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            return stack[-1]
+        return None
+
+
+task_context = _TaskContext()
+
+
+class RuntimeContext:
+    """User-facing runtime context (ray.get_runtime_context() parity)."""
+
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get_job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    def get_node_id(self) -> str:
+        current = task_context.current()
+        if current is not None:
+            return current[1].hex()
+        return self._worker.head_node.node_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        current = task_context.current()
+        return current[0].hex() if current else None
+
+    def get_actor_id(self) -> Optional[str]:
+        current = task_context.current()
+        if current is None:
+            return None
+        actor = current[0].actor_id()
+        return None if actor.is_nil() else actor.hex()

@@ -1,0 +1,371 @@
+"""Control service — the GCS (Global Control Service) equivalent.
+
+Parity with the reference's ``src/ray/gcs/gcs_server/``: the single authority
+for *cluster-level* state only — node membership (``GcsNodeManager``), the
+actor directory + restart FSM (``gcs_actor_manager.h:88,513``), placement
+groups (``gcs_placement_group_manager.h:230``), jobs (``GcsJobManager``),
+internal KV (``gcs_kv_manager.h``), pubsub broadcast, health checks
+(``gcs_health_check_manager.h:39``) and a bounded task-event store
+(``gcs_task_manager.h:85``).  Object/task state stays decentralized in owning
+workers (the ownership invariant, SURVEY §1).
+
+In-process, lock-guarded tables; multi-host access goes through the transport
+layer (``ray_tpu/runtime/rpc.py``) rather than gRPC.  Storage is pluggable the
+way ``store_client`` is: the default is in-memory; a snapshot-to-disk backend
+covers GCS-restart parity.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+
+# --------------------------------------------------------------------------
+# Internal KV (parity: GcsInternalKVManager)
+# --------------------------------------------------------------------------
+class InternalKV:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Dict[bytes, bytes]] = {}
+
+    def put(self, key: bytes, value: bytes, namespace: str = "default", overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._data.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(namespace, {}).get(key)
+
+    def delete(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._data.get(namespace, {}).pop(key, None) is not None
+
+    def exists(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return key in self._data.get(namespace, {})
+
+    def keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._data.get(namespace, {}) if k.startswith(prefix)]
+
+
+# --------------------------------------------------------------------------
+# Pubsub (parity: src/ray/pubsub — but push-based callbacks, no long-poll)
+# --------------------------------------------------------------------------
+class PubSub:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs.setdefault(channel, []).append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs.get(channel, []).remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+# --------------------------------------------------------------------------
+# Nodes (parity: GcsNodeManager + GcsHealthCheckManager)
+# --------------------------------------------------------------------------
+class NodeState(Enum):
+    ALIVE = "ALIVE"
+    DEAD = "DEAD"
+    DRAINING = "DRAINING"
+
+
+class NodeInfo:
+    def __init__(self, node_id: NodeID, address: str, resources: Dict[str, float], labels: Optional[dict] = None):
+        self.node_id = node_id
+        self.address = address
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.state = NodeState.ALIVE
+        self.last_heartbeat = time.monotonic()
+        self.missed_heartbeats = 0
+
+
+class NodeTable:
+    def __init__(self, pubsub: PubSub):
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._pubsub = pubsub
+
+    def register(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._nodes[info.node_id] = info
+        self._pubsub.publish("node", ("ALIVE", info.node_id))
+
+    def heartbeat(self, node_id: NodeID, resources_available: Optional[Dict[str, float]] = None) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.last_heartbeat = time.monotonic()
+            node.missed_heartbeats = 0
+            if resources_available is not None:
+                node.resources_available = dict(resources_available)
+
+    def mark_dead(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or node.state is NodeState.DEAD:
+                return
+            node.state = NodeState.DEAD
+        self._pubsub.publish("node", ("DEAD", node_id))
+
+    def drain(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.state = NodeState.DRAINING
+
+    def get(self, node_id: NodeID) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.state is NodeState.ALIVE]
+
+    def all_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def check_health(self, threshold: int) -> List[NodeID]:
+        """Called periodically; returns newly-dead nodes."""
+        dead = []
+        with self._lock:
+            for node in self._nodes.values():
+                if node.state is not NodeState.ALIVE:
+                    continue
+                node.missed_heartbeats += 1
+                if node.missed_heartbeats >= threshold:
+                    dead.append(node.node_id)
+        for node_id in dead:
+            self.mark_dead(node_id)
+        return dead
+
+
+# --------------------------------------------------------------------------
+# Actors (parity: GcsActorManager — registration, FSM, restarts, names)
+# --------------------------------------------------------------------------
+class ActorState(Enum):
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, name: Optional[str], max_restarts: int, job_id: JobID, class_name: str = ""):
+        self.actor_id = actor_id
+        self.name = name
+        self.class_name = class_name
+        self.max_restarts = max_restarts
+        self.num_restarts = 0
+        self.job_id = job_id
+        self.state = ActorState.PENDING_CREATION
+        self.node_id: Optional[NodeID] = None
+        self.address: Optional[str] = None
+        self.death_cause: Optional[str] = None
+
+
+class ActorDirectory:
+    def __init__(self, pubsub: PubSub):
+        self._lock = threading.RLock()
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._named: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+        self._pubsub = pubsub
+
+    def register(self, info: ActorInfo, namespace: str = "default") -> None:
+        with self._lock:
+            if info.name:
+                key = (namespace, info.name)
+                existing_id = self._named.get(key)
+                if existing_id is not None:
+                    existing = self._actors.get(existing_id)
+                    if existing is not None and existing.state is not ActorState.DEAD:
+                        raise ValueError(f"Actor name {info.name!r} already taken in namespace {namespace!r}")
+                self._named[key] = info.actor_id
+            self._actors[info.actor_id] = info
+
+    def mark_alive(self, actor_id: ActorID, node_id: NodeID, address: str = "") -> None:
+        with self._lock:
+            info = self._actors[actor_id]
+            info.state = ActorState.ALIVE
+            info.node_id = node_id
+            info.address = address
+        self._pubsub.publish("actor", ("ALIVE", actor_id))
+
+    def on_failure(self, actor_id: ActorID, cause: str = "") -> ActorState:
+        """Actor process/thread died: decide restart vs dead (ReconstructActor
+        parity, gcs_actor_manager.h:513)."""
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return ActorState.DEAD
+            if info.max_restarts < 0 or info.num_restarts < info.max_restarts:
+                info.num_restarts += 1
+                info.state = ActorState.RESTARTING
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = cause
+            state = info.state
+        self._pubsub.publish("actor", (state.value, actor_id))
+        return state
+
+    def mark_dead(self, actor_id: ActorID, cause: str = "") -> None:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = ActorState.DEAD
+            info.death_cause = cause
+            if info.name:
+                for key, aid in list(self._named.items()):
+                    if aid == actor_id:
+                        del self._named[key]
+        self._pubsub.publish("actor", ("DEAD", actor_id))
+
+    def get(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_by_name(self, name: str, namespace: str = "default") -> Optional[ActorInfo]:
+        with self._lock:
+            actor_id = self._named.get((namespace, name))
+            return self._actors.get(actor_id) if actor_id else None
+
+    def list_actors(self, job_id: Optional[JobID] = None) -> List[ActorInfo]:
+        with self._lock:
+            actors = list(self._actors.values())
+        if job_id is not None:
+            actors = [a for a in actors if a.job_id == job_id]
+        return actors
+
+
+# --------------------------------------------------------------------------
+# Jobs (parity: GcsJobManager)
+# --------------------------------------------------------------------------
+class JobInfo:
+    def __init__(self, job_id: JobID, entrypoint: str = "", metadata: Optional[dict] = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.metadata = metadata or {}
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.status = "RUNNING"
+
+
+class JobTable:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs: Dict[JobID, JobInfo] = {}
+
+    def add(self, info: JobInfo) -> None:
+        with self._lock:
+            self._jobs[info.job_id] = info
+
+    def finish(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job:
+                job.status = status
+                job.end_time = time.time()
+
+    def get(self, job_id: JobID) -> Optional[JobInfo]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def list_jobs(self) -> List[JobInfo]:
+        with self._lock:
+            return list(self._jobs.values())
+
+
+# --------------------------------------------------------------------------
+# Task events (parity: GcsTaskManager — bounded, evicting store)
+# --------------------------------------------------------------------------
+class TaskEventStore:
+    def __init__(self, max_entries: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._events: deque = deque(maxlen=max_entries or get_config().task_events_max_entries)
+
+    def add(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def list_events(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            items = list(self._events)
+        return items[-limit:]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+
+# --------------------------------------------------------------------------
+# The control service itself
+# --------------------------------------------------------------------------
+class ControlService:
+    def __init__(self):
+        self.kv = InternalKV()
+        self.pubsub = PubSub()
+        self.nodes = NodeTable(self.pubsub)
+        self.actors = ActorDirectory(self.pubsub)
+        self.jobs = JobTable()
+        self.task_events = TaskEventStore()
+        from ray_tpu.runtime.placement import PlacementGroupManager
+
+        self.placement_groups = PlacementGroupManager(self.nodes, self.pubsub)
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # health-check loop (GcsHealthCheckManager parity)
+    def start_health_checks(self, on_node_dead: Callable[[NodeID], None]) -> None:
+        cfg = get_config()
+
+        def loop():
+            while not self._stop.wait(cfg.health_check_period_s):
+                for node_id in self.nodes.check_health(cfg.health_check_failure_threshold):
+                    try:
+                        on_node_dead(node_id)
+                    except Exception:
+                        pass
+
+        self._health_thread = threading.Thread(target=loop, name="control-health", daemon=True)
+        self._health_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2)
+            self._health_thread = None

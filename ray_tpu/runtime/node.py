@@ -1,0 +1,377 @@
+"""Per-node runtime: the raylet equivalent.
+
+Parity with the reference's ``src/ray/raylet/`` ``NodeManager``: owns the
+node's resource pool, local scheduler, worker pool, hosted actors, and the
+local object-store tier; participates in object transfer (object_manager
+Push/Pull parity) through the in-process cluster fabric.
+
+TPU-first deltas (SURVEY §3.2 hot-path note): there is no lease protocol and
+no per-task RPC — dispatch puts the task straight onto an executor:
+
+  * **device/thread tasks** run on an in-process thread pool; jitted array
+    tasks return ``jax.Array`` futures thanks to XLA async dispatch, so the
+    thread is free as soon as dispatch completes (the device command queue IS
+    the queue the raylet used to be),
+  * **process tasks** (pure-Python CPU work) go to the process worker pool,
+    Ray-style, with shm-backed zero-copy args.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.resources import ResourcePool, ResourceSet
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    RayActorError,
+    RayTaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.runtime import protocol
+from ray_tpu.runtime.scheduler import LocalScheduler, TaskSpec
+from ray_tpu.runtime.worker_pool import ProcessWorkerPool, WorkerHandle
+
+
+class ActorInstance:
+    """An actor hosted on this node: either a dedicated worker process or an
+    in-process thread (device actors holding jax state)."""
+
+    def __init__(self, actor_id: ActorID, mode: str, max_concurrency: int = 1):
+        self.actor_id = actor_id
+        self.mode = mode                      # "process" | "inproc"
+        self.max_concurrency = max_concurrency
+        self.worker: Optional[WorkerHandle] = None      # process mode
+        self.instance: Any = None                        # inproc mode
+        self.thread: Optional[threading.Thread] = None
+        self.call_queue: "queue.Queue" = queue.Queue()
+        self.creation_spec = None
+        self.dead = False
+
+
+class Node:
+    def __init__(
+        self,
+        node_id: NodeID,
+        resources: Dict[str, float],
+        cluster,                       # runtime/cluster.Cluster (fabric)
+        shm_store=None,
+        labels: Optional[dict] = None,
+        num_inproc_threads: int = 8,
+    ):
+        cfg = get_config()
+        self.node_id = node_id
+        self.cluster = cluster
+        self.labels = labels or {}
+        self.pool = ResourcePool(resources)
+        self.store = ObjectStore(shm_store=shm_store)
+        self.scheduler = LocalScheduler(self.pool, self.store, self._dispatch)
+        # One pool serves both "thread" CPU-light tasks and device tasks; XLA
+        # dispatch is async so device tasks occupy a thread only briefly.
+        self.executor = ThreadPoolExecutor(max_workers=num_inproc_threads, thread_name_prefix=f"node-{node_id.hex()[:6]}")
+        self.worker_pool = ProcessWorkerPool(
+            shm_name=shm_store.name if shm_store is not None else "",
+            session_dir=cluster.session_dir,
+        )
+        self.worker_pool.set_on_worker_death(self._on_worker_death)
+        self.actors: Dict[ActorID, ActorInstance] = {}
+        self._actor_worker_index: Dict[int, ActorID] = {}  # pid -> actor
+        self.dead = False
+
+    # ------------------------------------------------------------------
+    # submission entry (from cluster fabric after node selection)
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        spec.owner_node = self.node_id
+        # Dependencies may live on other nodes: route waits through the
+        # fabric's pull path instead of the raw local store.
+        deps = [d for d in spec.dependencies if not self.store.contains(d)]
+        if deps:
+            remaining = len(deps)
+            lock = threading.Lock()
+
+            def on_ready(_fut=None):
+                nonlocal remaining
+                with lock:
+                    remaining -= 1
+                    last = remaining == 0
+                if last:
+                    self.scheduler.submit_ready(spec)
+
+            for dep in deps:
+                self.cluster.pull_object(dep, self, on_ready)
+            return
+        self.scheduler.submit_ready(spec)
+
+    # ------------------------------------------------------------------
+    # dispatch (deps local, resources held)
+    # ------------------------------------------------------------------
+    def _dispatch(self, spec: TaskSpec) -> None:
+        if spec._cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            self._commit(spec, None, TaskCancelledError(spec.task_id))
+            return
+        mode = self._execution_mode(spec)
+        if mode == "process":
+            self._dispatch_process(spec)
+        else:
+            self.executor.submit(self._run_inproc, spec)
+
+    def _execution_mode(self, spec: TaskSpec) -> str:
+        if spec.execution != "auto":
+            return spec.execution
+        func = spec.func
+        if getattr(func, "_rt_device", False) or _is_jitted(func):
+            return "thread"
+        # array-typed args execute in-process next to the device
+        try:
+            import jax
+
+            for a in spec.args:
+                if isinstance(a, jax.Array):
+                    return "thread"
+        except Exception:
+            pass
+        return "process"
+
+    def _resolve_args(self, spec: TaskSpec):
+        def resolve(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            value = self.store.get(v.id())
+            info = self.store.entry_info(v.id())
+            if info is not None and info["is_error"] and isinstance(value, BaseException):
+                # Upstream failure propagates to this task's returns
+                # (reference: dependent tasks inherit RayTaskError).
+                raise value
+            return value
+
+        args = tuple(resolve(a) for a in spec.args)
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _run_inproc(self, spec: TaskSpec) -> None:
+        from ray_tpu.runtime.context import task_context
+
+        try:
+            args, kwargs = self._resolve_args(spec)
+            # propagate the executing task id for nested submissions/puts
+            token = task_context.push(spec.task_id, self.node_id)
+            try:
+                result = spec.func(*args, **kwargs)
+            finally:
+                task_context.pop(token)
+            self._commit(spec, result, None)
+        except BaseException as exc:  # noqa: BLE001
+            error = exc if isinstance(exc, RayTaskError) else RayTaskError.from_exception(spec.name, exc)
+            self._commit(spec, None, error)
+
+    def _dispatch_process(self, spec: TaskSpec) -> None:
+        try:
+            args, kwargs = self._resolve_args(spec)
+        except BaseException as exc:  # noqa: BLE001
+            self._commit(spec, None, RayTaskError.from_exception(spec.name, exc))
+            return
+        fn_id, fn_blob = self._function_blob(spec.func)
+        shm = self.store._shm
+        enc_args = tuple(protocol.encode_value(a, shm, _shm_id) for a in args)
+        enc_kwargs = {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()}
+        args_blob = pickle.dumps((enc_args, enc_kwargs), protocol=5)
+
+        def on_result(value, error):
+            if error is not None:
+                self._commit(spec, None, error)
+            else:
+                value = protocol.decode_value(value, shm)
+                self._commit(spec, value, None)
+
+        self.worker_pool.submit(
+            spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
+        )
+
+    def _function_blob(self, func) -> tuple:
+        import cloudpickle
+
+        cached = getattr(func, "_rt_fn_blob", None)
+        if cached is not None:
+            return cached
+        blob = cloudpickle.dumps(func)
+        fn_id = _hash_blob(blob)
+        try:
+            func._rt_fn_blob = (fn_id, blob)
+        except AttributeError:
+            pass
+        return fn_id, blob
+
+    # ------------------------------------------------------------------
+    def _commit(self, spec: TaskSpec, result: Any, error: Optional[BaseException]) -> None:
+        self.scheduler.on_task_done(spec)
+        self.cluster.on_task_finished(self, spec, result, error)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int = 1) -> None:
+        inst = ActorInstance(spec.actor_id, mode, max_concurrency)
+        inst.creation_spec = spec
+        self.actors[spec.actor_id] = inst
+        if mode == "inproc":
+            inst.thread = threading.Thread(
+                target=self._actor_thread_loop, args=(inst,), name=f"actor-{spec.actor_id.hex()[:8]}", daemon=True
+            )
+            inst.thread.start()
+            inst.call_queue.put(("__create__", spec))
+        else:
+            try:
+                worker = self.worker_pool.allocate_actor_worker()
+            except RuntimeError as exc:
+                self.cluster.on_actor_creation_failed(spec, RayActorError(spec.actor_id, f"worker spawn failed: {exc}"))
+                return
+            inst.worker = worker
+            self._actor_worker_index[worker.pid] = spec.actor_id
+            args, kwargs = self._resolve_args(spec)
+            shm = self.store._shm
+            enc = pickle.dumps(
+                (
+                    tuple(protocol.encode_value(a, shm, _shm_id) for a in args),
+                    {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()},
+                ),
+                protocol=5,
+            )
+            fn_id, fn_blob = self._function_blob(spec.func)
+
+            def on_result(value, err):
+                if err is not None:
+                    self.cluster.on_actor_creation_failed(spec, err)
+                else:
+                    self.cluster.on_actor_created(self, spec)
+
+            self.worker_pool.submit_to_worker(
+                worker,
+                "actor_create",
+                spec.task_id.binary(),
+                {"args_blob": enc, "name": spec.name, "max_concurrency": max_concurrency},
+                on_result,
+                fn_blob=fn_blob,
+                fn_id=fn_id,
+            )
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        inst = self.actors.get(spec.actor_id)
+        if inst is None or inst.dead:
+            self._commit_actor_error(spec, ActorDiedError(spec.actor_id))
+            return
+        if inst.mode == "inproc":
+            inst.call_queue.put(("__call__", spec))
+        else:
+            try:
+                args, kwargs = self._resolve_args(spec)
+            except BaseException as exc:  # noqa: BLE001
+                self._commit_actor_error(spec, RayTaskError.from_exception(spec.name, exc))
+                return
+            shm = self.store._shm
+            enc = pickle.dumps(
+                (
+                    tuple(protocol.encode_value(a, shm, _shm_id) for a in args),
+                    {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()},
+                ),
+                protocol=5,
+            )
+
+            def on_result(value, err):
+                if err is not None:
+                    self.cluster.on_task_finished(self, spec, None, err if isinstance(err, (RayTaskError, RayActorError, WorkerCrashedError)) else RayTaskError.from_exception(spec.name, err))
+                else:
+                    value = protocol.decode_value(value, shm)
+                    self.cluster.on_task_finished(self, spec, value, None)
+
+            self.worker_pool.submit_to_worker(
+                inst.worker,
+                "actor_call",
+                spec.task_id.binary(),
+                {"method": spec.actor_method, "args_blob": enc, "name": spec.name},
+                on_result,
+            )
+
+    def _actor_thread_loop(self, inst: ActorInstance) -> None:
+        from ray_tpu.runtime.context import task_context
+
+        while True:
+            kind, spec = inst.call_queue.get()
+            if kind == "__stop__":
+                return
+            try:
+                args, kwargs = self._resolve_args(spec)
+                token = task_context.push(spec.task_id, self.node_id)
+                try:
+                    if kind == "__create__":
+                        inst.instance = spec.func(*args, **kwargs)
+                        self.cluster.on_actor_created(self, spec)
+                        continue
+                    result = getattr(inst.instance, spec.actor_method)(*args, **kwargs)
+                finally:
+                    task_context.pop(token)
+                self.cluster.on_task_finished(self, spec, result, None)
+            except BaseException as exc:  # noqa: BLE001
+                if kind == "__create__":
+                    self.cluster.on_actor_creation_failed(spec, RayTaskError.from_exception(spec.name, exc))
+                else:
+                    self.cluster.on_task_finished(self, spec, None, RayTaskError.from_exception(spec.name, exc))
+
+    def kill_actor(self, actor_id: ActorID, restart: bool = False) -> None:
+        inst = self.actors.pop(actor_id, None)
+        if inst is None:
+            return
+        inst.dead = True
+        if inst.mode == "inproc":
+            inst.call_queue.put(("__stop__", None))
+        elif inst.worker is not None:
+            self._actor_worker_index.pop(inst.worker.pid, None)
+            self.worker_pool.release_actor_worker(inst.worker)
+
+    def _commit_actor_error(self, spec: TaskSpec, error: BaseException) -> None:
+        self.cluster.on_task_finished(self, spec, None, error)
+
+    def _on_worker_death(self, worker: WorkerHandle) -> None:
+        actor_id = self._actor_worker_index.pop(worker.pid, None)
+        if actor_id is not None:
+            inst = self.actors.pop(actor_id, None)
+            if inst is not None:
+                inst.dead = True
+            self.cluster.on_actor_process_died(self, actor_id)
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self.dead = True
+        for actor_id in list(self.actors):
+            self.kill_actor(actor_id)
+        self.executor.shutdown(wait=False)
+        self.worker_pool.shutdown()
+
+
+def _is_jitted(func) -> bool:
+    mod = type(func).__module__ or ""
+    return mod.startswith("jax") and "jit" in type(func).__name__.lower()
+
+
+def _hash_blob(blob: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.blake2b(blob, digest_size=16).digest()
+
+
+_shm_counter = threading.local()
+
+
+def _shm_id() -> bytes:
+    import os
+
+    return os.urandom(20)

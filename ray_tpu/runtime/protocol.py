@@ -1,0 +1,108 @@
+"""Framed pickle protocol shared by the worker pool and worker processes.
+
+A frame is a 4-byte little-endian length followed by a pickle-5 payload of
+``(msg_type: str, payload: dict)``.  Large array values never ride this pipe —
+they go through the native shm store (``ShmRef`` markers), giving workers
+zero-copy reads (parity: plasma client reads over mmap while the unix socket
+carries only control messages — ``src/ray/object_manager/plasma/protocol.h``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+_LEN = struct.Struct("<I")
+
+# Arrays above this many bytes move via shm, not the socket.
+SHM_THRESHOLD = 256 * 1024
+
+
+class ShmRef:
+    """Marker for a value stored out-of-band in the native shm store."""
+
+    __slots__ = ("object_id",)
+
+    def __init__(self, object_id: bytes):
+        self.object_id = object_id
+
+
+def send_msg(sock: socket.socket, msg_type: str, payload: dict) -> None:
+    data = pickle.dumps((msg_type, payload), protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[str, dict]:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    data = _recv_exact(sock, length)
+    return pickle.loads(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError("socket closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def encode_value(value: Any, shm_store, id_factory) -> Any:
+    """Replace large ndarrays with ShmRef markers (recursive over
+    tuple/list/dict one level deep — deep graphs just get pickled)."""
+    import numpy as np
+
+    def enc(v):
+        if isinstance(v, np.ndarray) and v.dtype != object and v.nbytes >= SHM_THRESHOLD and shm_store is not None:
+            oid = id_factory()
+            header = pickle.dumps((v.dtype.str, v.shape))
+            payload = header + np.ascontiguousarray(v).tobytes()
+            try:
+                shm_store.put(oid, payload, meta_size=len(header))
+                return ShmRef(oid)
+            except (MemoryError, FileExistsError):
+                return v
+        return v
+
+    if isinstance(value, tuple):
+        return tuple(enc(v) for v in value)
+    if isinstance(value, list):
+        return [enc(v) for v in value]
+    if isinstance(value, dict):
+        return {k: enc(v) for k, v in value.items()}
+    return enc(value)
+
+
+def decode_value(value: Any, shm_store, release: bool = True) -> Any:
+    import numpy as np
+
+    def dec(v):
+        if isinstance(v, ShmRef):
+            got = shm_store.get(v.object_id)
+            if got is None:
+                raise KeyError(f"shm object {v.object_id.hex()} missing")
+            view, meta_size = got
+            try:
+                dtype_str, shape = pickle.loads(view[:meta_size])
+                arr = np.frombuffer(view[meta_size:], dtype=np.dtype(dtype_str)).reshape(shape)
+                arr = arr.copy()  # detach from the pinned segment
+            finally:
+                shm_store.release(v.object_id)
+            if release:
+                shm_store.delete(v.object_id)
+            return arr
+        return v
+
+    if isinstance(value, tuple):
+        return tuple(dec(v) for v in value)
+    if isinstance(value, list):
+        return [dec(v) for v in value]
+    if isinstance(value, dict):
+        return {k: dec(v) for k, v in value.items()}
+    return dec(value)

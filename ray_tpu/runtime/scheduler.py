@@ -1,0 +1,308 @@
+"""Two-level distributed scheduler.
+
+Parity with the reference (``src/ray/raylet/scheduling/``):
+
+  * :class:`ClusterScheduler` — the cluster-wide decision: pick the best node
+    for a task or spill it over (``cluster_task_manager.h:42``), using the
+    **hybrid** policy (pack until a utilization threshold, then spread;
+    random tie-break among top-k — ``policy/hybrid_scheduling_policy.cc:48-59``),
+    plus spread / node-affinity / placement-group policies.
+  * :class:`LocalScheduler` — per-node dispatch once dependencies are local
+    (``local_task_manager.h:58``): tasks wait first on their argument objects
+    (DependencyManager parity, ``dependency_manager.h:51``), then on
+    resources, then dispatch to an executor.
+
+TPU-first deltas: dispatch hands tasks to in-process executors (device
+command queue / thread pool / process pool) instead of leasing worker
+processes over RPC — the lease round-trip disappears, which is most of the
+reference's per-task latency (SURVEY §3.2).  Gang-scheduling of SPMD programs
+uses placement groups (STRICT_PACK = one ICI domain).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.resources import ResourcePool, ResourceSet
+
+
+# --------------------------------------------------------------------------
+# Scheduling strategies (parity: python/ray/util/scheduling_strategies.py)
+# --------------------------------------------------------------------------
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1, placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: Optional[dict] = None, soft: Optional[dict] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+
+# --------------------------------------------------------------------------
+# Task specification (parity: src/ray/common/task/task_spec.h)
+# --------------------------------------------------------------------------
+class TaskSpec:
+    __slots__ = (
+        "task_id", "name", "func", "args", "kwargs", "dependencies",
+        "num_returns", "return_ids", "resources", "max_retries",
+        "retries_left", "execution", "actor_id", "scheduling_strategy",
+        "runtime_env", "owner_node", "is_actor_creation", "actor_method",
+        "attempt", "submit_time", "_retry_exceptions", "_cancelled",
+    )
+
+    def __init__(
+        self,
+        task_id: TaskID,
+        name: str,
+        func: Any,
+        args: Tuple,
+        kwargs: dict,
+        dependencies: Sequence[ObjectID],
+        num_returns: int,
+        return_ids: List[ObjectID],
+        resources: ResourceSet,
+        max_retries: int = 0,
+        execution: str = "auto",
+        actor_id: Optional[ActorID] = None,
+        scheduling_strategy: Any = None,
+        runtime_env: Optional[dict] = None,
+        owner_node: Optional[NodeID] = None,
+        is_actor_creation: bool = False,
+        actor_method: Optional[str] = None,
+    ):
+        self.task_id = task_id
+        self.name = name
+        self.func = func
+        self.args = args
+        self.kwargs = kwargs
+        self.dependencies = list(dependencies)
+        self.num_returns = num_returns
+        self.return_ids = return_ids
+        self.resources = resources
+        self.max_retries = max_retries
+        self.retries_left = max_retries
+        self.execution = execution
+        self.actor_id = actor_id
+        self.scheduling_strategy = scheduling_strategy
+        self.runtime_env = runtime_env
+        self.owner_node = owner_node
+        self.is_actor_creation = is_actor_creation
+        self.actor_method = actor_method
+        self.attempt = 0
+        self.submit_time = 0.0
+        self._retry_exceptions = False
+        self._cancelled = False
+
+
+# --------------------------------------------------------------------------
+# Cluster-level policies
+# --------------------------------------------------------------------------
+class ClusterScheduler:
+    """Cluster-wide node choice over all nodes' resource pools.
+
+    In-process "ray_syncer": node pools are shared objects, so the resource
+    view is always fresh (the reference syncs views over bidi gRPC streams,
+    ``ray_syncer.h:88``; multi-host mode will do the same over the transport
+    layer).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pools: Dict[NodeID, ResourcePool] = {}
+        self._labels: Dict[NodeID, dict] = {}
+        self._alive: Dict[NodeID, bool] = {}
+
+    def register_node(self, node_id: NodeID, pool: ResourcePool, labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._pools[node_id] = pool
+            self._labels[node_id] = labels or {}
+            self._alive[node_id] = True
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._alive[node_id] = False
+
+    def node_pools(self) -> Dict[NodeID, ResourcePool]:
+        with self._lock:
+            return {nid: p for nid, p in self._pools.items() if self._alive.get(nid)}
+
+    def pick_node(self, spec: TaskSpec) -> Optional[NodeID]:
+        """Returns the chosen node, or None if currently infeasible."""
+        cfg = get_config()
+        strategy = spec.scheduling_strategy
+        with self._lock:
+            alive = [(nid, self._pools[nid]) for nid, ok in self._alive.items() if ok]
+        if not alive:
+            return None
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            target = strategy.node_id
+            for nid, pool in alive:
+                if nid == target:
+                    if spec.resources.fits(pool.available):
+                        return nid
+                    return None if not strategy.soft else self._hybrid(alive, spec, cfg)
+            return self._hybrid(alive, spec, cfg) if strategy.soft else None
+
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            info = pg._info if hasattr(pg, "_info") else pg
+            idx = strategy.placement_group_bundle_index
+            placements = info.bundle_placements
+            if not placements:
+                return None
+            if idx >= 0:
+                return placements.get(idx)
+            # any bundle's node that fits
+            for bundle_idx, nid in placements.items():
+                pool = self._pools.get(nid)
+                if pool and spec.resources.fits(pool.available):
+                    return nid
+            return next(iter(placements.values()))
+
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            feasible = [
+                (nid, pool) for nid, pool in alive
+                if all(self._labels.get(nid, {}).get(k) == v for k, v in strategy.hard.items())
+            ]
+            if not feasible:
+                return None
+            soft = [
+                (nid, pool) for nid, pool in feasible
+                if all(self._labels.get(nid, {}).get(k) == v for k, v in strategy.soft.items())
+            ]
+            return self._hybrid(soft or feasible, spec, cfg)
+
+        if strategy == "SPREAD":
+            feasible = [(nid, p) for nid, p in alive if spec.resources.fits(p.available)]
+            if not feasible:
+                return None
+            return min(feasible, key=lambda kv: kv[1].utilization())[0]
+
+        return self._hybrid(alive, spec, cfg)
+
+    @staticmethod
+    def _hybrid(nodes: List[Tuple[NodeID, ResourcePool]], spec: TaskSpec, cfg) -> Optional[NodeID]:
+        """Hybrid policy (hybrid_scheduling_policy.cc:48): prefer packing
+        nodes under the spread threshold; score = utilization if under
+        threshold else 1+utilization; random choice among top-k."""
+        feasible = [(nid, p) for nid, p in nodes if spec.resources.fits(p.available)]
+        if not feasible:
+            return None
+        thr = cfg.scheduler_spread_threshold
+
+        def score(pool: ResourcePool) -> float:
+            u = pool.utilization()
+            return u if u < thr else 1.0 + u
+
+        ranked = sorted(feasible, key=lambda kv: score(kv[1]))
+        k = max(1, int(len(ranked) * cfg.scheduler_top_k_fraction))
+        return random.choice(ranked[:k])[0]
+
+
+# --------------------------------------------------------------------------
+# Local scheduler
+# --------------------------------------------------------------------------
+class LocalScheduler:
+    """Per-node dispatch: deps → resources → executor.
+
+    ``dispatch_fn(spec)`` is provided by the node runtime and must eventually
+    call :meth:`on_task_done`.
+    """
+
+    def __init__(self, pool: ResourcePool, object_store, dispatch_fn: Callable[[TaskSpec], None]):
+        self._pool = pool
+        self._store = object_store
+        self._dispatch_fn = dispatch_fn
+        self._lock = threading.Lock()
+        self._ready: deque = deque()          # deps satisfied, waiting resources
+        self._infeasible: List[TaskSpec] = []
+        self.num_submitted = 0
+        self.num_dispatched = 0
+
+    # ------------------------------------------------------------------
+    def submit_ready(self, spec: TaskSpec) -> None:
+        """Submit a task whose dependencies are already local."""
+        self.num_submitted += 1
+        self._enqueue_ready(spec)
+
+    def submit(self, spec: TaskSpec) -> None:
+        self.num_submitted += 1
+        deps = spec.dependencies
+        if not deps:
+            self._enqueue_ready(spec)
+            return
+        # Dependency manager: wait on all args, then enqueue.
+        remaining = len(deps)
+        lock = threading.Lock()
+
+        def on_dep_done(_fut):
+            nonlocal remaining
+            with lock:
+                remaining -= 1
+                last = remaining == 0
+            if last:
+                self._enqueue_ready(spec)
+
+        for dep in deps:
+            self._store.get_async(dep).add_done_callback(on_dep_done)
+
+    def _enqueue_ready(self, spec: TaskSpec) -> None:
+        dispatch_now = False
+        with self._lock:
+            if not self._ready and self._pool.acquire(spec.resources):
+                dispatch_now = True
+            else:
+                self._ready.append(spec)
+        if dispatch_now:
+            self._run(spec)
+        else:
+            self._drain()
+
+    def _drain(self) -> None:
+        cfg = get_config()
+        while True:
+            to_run = None
+            with self._lock:
+                if self._ready and self._pool.acquire(self._ready[0].resources):
+                    to_run = self._ready.popleft()
+            if to_run is None:
+                return
+            self._run(to_run)
+
+    def _run(self, spec: TaskSpec) -> None:
+        self.num_dispatched += 1
+        try:
+            self._dispatch_fn(spec)
+        except Exception:
+            self._pool.release(spec.resources)
+            raise
+
+    # ------------------------------------------------------------------
+    def on_task_done(self, spec: TaskSpec) -> None:
+        self._pool.release(spec.resources)
+        self._drain()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self.num_submitted,
+                "dispatched": self.num_dispatched,
+                "queued": len(self._ready),
+                "available": self._pool.available.to_dict(),
+            }

@@ -1,0 +1,288 @@
+"""CoreWorker: the per-driver runtime object.
+
+Parity with the reference's ``CoreWorker``
+(``src/ray/core_worker/core_worker.h:292``): Put/Get/Wait, task and actor
+submission, ownership bookkeeping (every object submitted/created by this
+driver is owned here: refcount, lineage, locations — the NSDI'21 ownership
+invariant), and task-commit callbacks that release argument references.
+
+TPU-first delta: submission is a function call into the in-process fabric,
+not a Cython→C++→gRPC lease round trip (SURVEY §3.2 steps 2-5 collapse into
+``Cluster.submit``), which is where the ~100× task-throughput headroom over
+the reference's 971 tasks/s comes from.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef, hooks
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.serialization import get_context
+from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.runtime.context import task_context
+from ray_tpu.runtime.control import ActorInfo
+from ray_tpu.runtime.scheduler import TaskSpec
+
+
+class CoreWorker:
+    def __init__(self, cluster, job_id: JobID):
+        self.cluster = cluster
+        self.job_id = job_id
+        self.driver_task_id = TaskID.for_driver(job_id)
+        self.ref_counter = ReferenceCounter(self._on_object_out_of_scope)
+        self._put_counter = itertools.count(1)
+        hooks.ref_counter = self.ref_counter
+        hooks.serialization_ctx = get_context()
+        cluster.core_worker = self
+
+    # ------------------------------------------------------------------
+    @property
+    def head_node(self):
+        return self.cluster.head_node
+
+    def _current_task_id(self) -> TaskID:
+        current = task_context.current()
+        return current[0] if current is not None else self.driver_task_id
+
+    # ------------------------------------------------------------------ put
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self._current_task_id(), next(self._put_counter))
+        self.ref_counter.add_owned_object(oid)
+        node = self.head_node
+        node.store.put(oid, value)
+        self.cluster.directory.add_location(oid, node.node_id)
+        return ObjectRef(oid)
+
+    # --------------------------------------------------------------- submit
+    def submit_task(
+        self,
+        func,
+        args: Tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        retry_exceptions: bool = False,
+        execution: str = "auto",
+        scheduling_strategy: Any = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        cfg = get_config()
+        task_id = TaskID.for_normal_task(self.job_id)
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        deps = _collect_deps(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            name=name,
+            func=func,
+            args=args,
+            kwargs=kwargs,
+            dependencies=[r.id() for r in deps],
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=ResourceSet(resources or {"CPU": 1}),
+            max_retries=cfg.task_max_retries if max_retries is None else max_retries,
+            execution=execution,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env,
+        )
+        spec._retry_exceptions = retry_exceptions
+        for oid in return_ids:
+            self.ref_counter.add_owned_object(oid)
+        self.ref_counter.add_submitted_task_references([r.id() for r in deps])
+        spec.submit_time = time.monotonic()
+        self.cluster.task_manager.add_pending(spec)
+        self.cluster.submit(spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    # --------------------------------------------------------------- actors
+    def create_actor(
+        self,
+        cls,
+        args: Tuple,
+        kwargs: dict,
+        *,
+        name: Optional[str] = None,
+        namespace: str = "default",
+        class_name: str = "",
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        mode: str = "process",
+        scheduling_strategy: Any = None,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_creation(actor_id)
+        deps = _collect_deps(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            name=f"{class_name}.__init__",
+            func=cls,
+            args=args,
+            kwargs=kwargs,
+            dependencies=[r.id() for r in deps],
+            num_returns=0,
+            return_ids=[],
+            resources=ResourceSet(resources or {"CPU": 1}),
+            actor_id=actor_id,
+            scheduling_strategy=scheduling_strategy,
+            is_actor_creation=True,
+        )
+        self.ref_counter.add_submitted_task_references([r.id() for r in deps])
+        info = ActorInfo(actor_id, name, max_restarts, self.job_id, class_name)
+        self.cluster.create_actor(spec, mode, max_concurrency, info, namespace=namespace)
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: Tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        name: str = "",
+    ) -> List[ObjectRef]:
+        task_id = TaskID.for_actor_task(actor_id)
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        deps = _collect_deps(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            name=name or method_name,
+            func=None,
+            args=args,
+            kwargs=kwargs,
+            dependencies=[r.id() for r in deps],
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=ResourceSet({}),
+            actor_id=actor_id,
+            actor_method=method_name,
+        )
+        for oid in return_ids:
+            self.ref_counter.add_owned_object(oid)
+        self.ref_counter.add_submitted_task_references([r.id() for r in deps])
+        self.cluster.task_manager.add_pending(spec)
+        self.cluster.submit_actor_task(spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    # ------------------------------------------------------------------ get
+    def get_async(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+        node = self.head_node
+
+        def on_local():
+            try:
+                value = node.store.get(ref.id(), timeout=0.001)
+            except Exception as exc:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(exc)
+                return
+            info = node.store.entry_info(ref.id())
+            if info and info["is_error"] and isinstance(value, BaseException):
+                if not fut.done():
+                    fut.set_exception(value)
+            else:
+                if not fut.done():
+                    fut.set_result(value)
+
+        self.cluster.pull_object(ref.id(), node, on_local)
+        return fut
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        futures = [self.get_async(r) for r in ref_list]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = []
+        for fut in futures:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                values.append(fut.result(remaining))
+            except TimeoutError:
+                raise GetTimeoutError("ray_tpu.get timed out")
+        return values[0] if single else values
+
+    # ----------------------------------------------------------------- wait
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds the number of refs")
+        done_event = threading.Event()
+        done_flags = [False] * len(refs)
+        lock = threading.Lock()
+        count = 0
+
+        def make_cb(i):
+            def cb(_fut):
+                nonlocal count
+                with lock:
+                    done_flags[i] = True
+                    count += 1
+                    if count >= num_returns:
+                        done_event.set()
+
+            return cb
+
+        for i, r in enumerate(refs):
+            fut = self.get_async(r)
+            fut.add_done_callback(make_cb(i))
+        done_event.wait(timeout)
+        # Contract parity: ready never exceeds num_returns even if more
+        # objects completed; the surplus stays in not_ready.
+        ready: List[ObjectRef] = []
+        not_ready: List[ObjectRef] = []
+        with lock:
+            flags = list(done_flags)
+        for r, f in zip(refs, flags):
+            if f and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                not_ready.append(r)
+        return ready, not_ready
+
+    # ------------------------------------------------------------- internal
+    def on_task_committed(self, spec: TaskSpec) -> None:
+        self.ref_counter.remove_submitted_task_references(spec.dependencies)
+
+    def _on_object_out_of_scope(self, oid: ObjectID) -> None:
+        for node_id in self.cluster.directory.locations(oid):
+            node = self.cluster.nodes.get(node_id)
+            if node is not None:
+                node.store.delete(oid)
+        self.cluster.directory.forget(oid)
+
+
+def _collect_deps(args: Tuple, kwargs: dict) -> List[ObjectRef]:
+    deps = [a for a in args if isinstance(a, ObjectRef)]
+    deps.extend(v for v in kwargs.values() if isinstance(v, ObjectRef))
+    return deps
+
+
+# --------------------------------------------------------------------------
+_global_worker: Optional[CoreWorker] = None
+
+
+def global_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu has not been initialized; call ray_tpu.init() first.")
+    return _global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker]) -> None:
+    global _global_worker
+    _global_worker = worker

@@ -1,0 +1,189 @@
+"""Entry point for CPU worker processes.
+
+Parity with the reference's ``python/ray/_private/workers/default_worker.py`` +
+the worker ``main_loop`` (``worker.py:866``): connect back to the node's
+worker pool, then loop executing tasks.  Functions arrive pickled once and are
+cached by function id (FunctionManager parity); large array args/results move
+through the native shm store, zero-copy on the read side.
+
+Workers also host **actors**: an ``actor_create`` message instantiates the
+class; subsequent ``actor_call`` messages run methods in receive order
+(the pool serializes per-actor ordering — ActorSchedulingQueue parity).
+Async actors run methods on an asyncio loop with ``max_concurrency``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import socket
+import sys
+import threading
+import traceback
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--shm", default="")
+    args = parser.parse_args()
+
+    # Workers never touch the TPU — keep jax off the device if imported.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.addr)
+
+    shm_store = None
+    if args.shm:
+        from ray_tpu.native.shm_store import ShmObjectStore
+
+        shm_store = ShmObjectStore(args.shm, create=False)
+
+    Worker(sock, shm_store).run()
+
+
+class Worker:
+    def __init__(self, sock: socket.socket, shm_store):
+        from ray_tpu.runtime import protocol
+
+        self._protocol = protocol
+        self._sock = sock
+        self._shm = shm_store
+        self._fn_cache: dict = {}
+        self._actor = None
+        self._actor_loop: asyncio.AbstractEventLoop | None = None
+        self._send_lock = threading.Lock()
+        self._put_counter = 0
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        p = self._protocol
+        p.send_msg(self._sock, "register", {"pid": os.getpid()})
+        while True:
+            try:
+                msg_type, payload = p.recv_msg(self._sock)
+            except ConnectionError:
+                break
+            if msg_type == "shutdown":
+                break
+            elif msg_type == "exec":
+                self._handle_exec(payload)
+            elif msg_type == "actor_create":
+                self._handle_actor_create(payload)
+            elif msg_type == "actor_call":
+                self._handle_actor_call(payload)
+            elif msg_type == "ping":
+                self._reply("pong", {})
+        if self._shm is not None:
+            self._shm.close()
+
+    def _reply(self, msg_type: str, payload: dict) -> None:
+        with self._send_lock:
+            self._protocol.send_msg(self._sock, msg_type, payload)
+
+    def _next_shm_id(self) -> bytes:
+        self._put_counter += 1
+        return os.urandom(16) + self._put_counter.to_bytes(4, "little")
+
+    # ------------------------------------------------------------------
+    def _get_function(self, payload: dict):
+        fn_id = payload["fn_id"]
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            fn = pickle.loads(payload["fn_blob"])
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    def _decode_args(self, payload: dict):
+        args, kwargs = pickle.loads(payload["args_blob"])
+        p = self._protocol
+        args = tuple(p.decode_value(a, self._shm) for a in args)
+        kwargs = {k: p.decode_value(v, self._shm) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _encode_result(self, value):
+        p = self._protocol
+        encoded = p.encode_value(value, self._shm, self._next_shm_id)
+        return pickle.dumps(encoded, protocol=5)
+
+    def _handle_exec(self, payload: dict) -> None:
+        task_id = payload["task_id"]
+        try:
+            fn = self._get_function(payload)
+            args, kwargs = self._decode_args(payload)
+            result = fn(*args, **kwargs)
+            self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(result)})
+        except BaseException as exc:  # noqa: BLE001 — task errors become objects
+            self._reply(
+                "result",
+                {
+                    "task_id": task_id,
+                    "error_blob": pickle.dumps(_make_task_error(payload.get("name", "task"), exc)),
+                },
+            )
+
+    # ------------------------------------------------------------------
+    def _handle_actor_create(self, payload: dict) -> None:
+        task_id = payload["task_id"]
+        try:
+            cls = self._get_function(payload)
+            args, kwargs = self._decode_args(payload)
+            self._actor = cls(*args, **kwargs)
+            max_concurrency = payload.get("max_concurrency", 1)
+            if _has_async_methods(cls) or max_concurrency > 1:
+                self._start_actor_loop()
+            self._reply("result", {"task_id": task_id, "value_blob": pickle.dumps(None)})
+        except BaseException as exc:  # noqa: BLE001
+            self._reply(
+                "result",
+                {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(payload.get("name", "actor.__init__"), exc))},
+            )
+
+    def _handle_actor_call(self, payload: dict) -> None:
+        task_id = payload["task_id"]
+        method_name = payload["method"]
+        try:
+            method = getattr(self._actor, method_name)
+            args, kwargs = self._decode_args(payload)
+            if asyncio.iscoroutinefunction(method) and self._actor_loop is not None:
+                # async actors: schedule on the loop, reply on completion.
+                fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), self._actor_loop)
+
+                def done(f):
+                    try:
+                        self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(f.result())})
+                    except BaseException as exc:  # noqa: BLE001
+                        self._reply("result", {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})
+
+                fut.add_done_callback(done)
+                return
+            result = method(*args, **kwargs)
+            self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(result)})
+        except BaseException as exc:  # noqa: BLE001
+            self._reply("result", {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})
+
+    def _start_actor_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._actor_loop = loop
+        threading.Thread(target=loop.run_forever, name="actor-asyncio", daemon=True).start()
+
+
+def _has_async_methods(cls) -> bool:
+    return any(asyncio.iscoroutinefunction(getattr(cls, n, None)) for n in dir(cls) if not n.startswith("__"))
+
+
+def _make_task_error(name: str, exc: BaseException):
+    from ray_tpu.exceptions import RayTaskError
+
+    if isinstance(exc, RayTaskError):
+        return exc
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    return RayTaskError(name, tb, exc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,295 @@
+"""Process worker pool.
+
+Parity with the reference's ``WorkerPool`` (``src/ray/raylet/worker_pool.h:159``):
+spawns Python worker processes, prestarts a warm pool, hands idle workers to
+dispatched tasks, reaps idle workers past a cap, and dedicates workers to
+actors.  Transport is a unix socket per worker carrying framed pickle control
+messages; bulk arrays ride the native shm store (zero-copy reads worker-side).
+
+Sync-actor ordering: messages to one worker are written in submission order
+and the worker executes them sequentially off one socket — this IS the
+ActorSchedulingQueue (``transport/actor_scheduling_queue``): ordering falls
+out of the transport instead of sequence numbers, because a single host needs
+no reordering layer.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.core.config import get_config
+from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.runtime import protocol
+
+
+class WorkerHandle:
+    def __init__(self, sock: socket.socket, proc: subprocess.Popen, pid: int):
+        self.sock = sock
+        self.proc = proc
+        self.pid = pid
+        self.known_fns: set = set()
+        self.dedicated = False      # owned by an actor
+        self.alive = True
+        self.last_idle_time = time.monotonic()
+        self.send_lock = threading.Lock()
+
+    def send(self, msg_type: str, payload: dict) -> None:
+        with self.send_lock:
+            protocol.send_msg(self.sock, msg_type, payload)
+
+
+class ProcessWorkerPool:
+    def __init__(self, shm_name: str = "", max_workers: int = 0, session_dir: str = "/tmp"):
+        cfg = get_config()
+        self._shm_name = shm_name
+        self._max_workers = max_workers or (os.cpu_count() or 4)
+        self._idle_cap = cfg.idle_worker_cap
+        self._lock = threading.RLock()
+        self._idle: deque[WorkerHandle] = deque()
+        self._backlog: deque = deque()
+        self._all: Dict[int, WorkerHandle] = {}
+        self._inflight: Dict[bytes, Callable[[Any, Optional[BaseException]], None]] = {}
+        self._inflight_worker: Dict[bytes, WorkerHandle] = {}
+        self._on_worker_death: Optional[Callable[[WorkerHandle], None]] = None
+        self._listen_path = os.path.join(session_dir, f"rt_pool_{os.getpid()}_{id(self):x}.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self._listen_path)
+        self._listener.listen(128)
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    def set_on_worker_death(self, cb: Callable[[WorkerHandle], None]) -> None:
+        self._on_worker_death = cb
+
+    def prestart(self, count: int) -> None:
+        for _ in range(count):
+            self._spawn()
+
+    def _spawn(self, to_idle: bool = True) -> WorkerHandle:
+        # Make the package importable in the child even when the driver found
+        # it via sys.path manipulation rather than an installed dist.
+        import ray_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        pythonpath = os.environ.get("PYTHONPATH", "")
+        if pkg_parent not in pythonpath.split(os.pathsep):
+            pythonpath = pkg_parent + (os.pathsep + pythonpath if pythonpath else "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_main", "--addr", self._listen_path]
+            + (["--shm", self._shm_name] if self._shm_name else []),
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath},
+        )
+        self._listener.settimeout(30.0)
+        try:
+            sock, _ = self._listener.accept()
+        except socket.timeout:
+            proc.kill()
+            raise RuntimeError("worker process failed to register within 30s")
+        finally:
+            self._listener.settimeout(None)
+        msg_type, payload = protocol.recv_msg(sock)
+        assert msg_type == "register", msg_type
+        handle = WorkerHandle(sock, proc, payload["pid"])
+        with self._lock:
+            self._all[handle.pid] = handle
+            if to_idle:
+                self._idle.append(handle)
+        threading.Thread(target=self._reader_loop, args=(handle,), name=f"pool-reader-{handle.pid}", daemon=True).start()
+        return handle
+
+    # ------------------------------------------------------------------
+    def _acquire_worker(self) -> Optional[WorkerHandle]:
+        with self._lock:
+            while self._idle:
+                w = self._idle.popleft()
+                if w.alive:
+                    return w
+            if len(self._all) >= self._max_workers:
+                return None
+        return self._spawn(to_idle=False)
+
+    def _release_worker(self, worker: WorkerHandle) -> None:
+        backlog_item = None
+        with self._lock:
+            if worker.alive and not worker.dedicated:
+                if self._backlog:
+                    backlog_item = self._backlog.popleft()
+                else:
+                    worker.last_idle_time = time.monotonic()
+                    self._idle.append(worker)
+                    self._maybe_reap_locked()
+        if backlog_item is not None:
+            self._send_exec(worker, *backlog_item)
+
+    def _maybe_reap_locked(self) -> None:
+        while len(self._idle) > self._idle_cap:
+            w = self._idle.popleft()
+            self._kill_worker(w)
+
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        task_id: bytes,
+        name: str,
+        fn_id: bytes,
+        fn_blob: bytes,
+        args_blob: bytes,
+        callback: Callable[[Any, Optional[BaseException]], None],
+    ) -> bool:
+        """Run a stateless task on an idle worker; queues when saturated."""
+        worker = self._acquire_worker()
+        if worker is None:
+            with self._lock:
+                self._backlog.append((task_id, name, fn_id, fn_blob, args_blob, callback))
+            return True
+        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback)
+        return True
+
+    def _send_exec(self, worker, task_id, name, fn_id, fn_blob, args_blob, callback) -> None:
+        payload = {"task_id": task_id, "name": name, "fn_id": fn_id, "args_blob": args_blob}
+        if fn_id not in worker.known_fns:
+            payload["fn_blob"] = fn_blob
+            worker.known_fns.add(fn_id)
+        with self._lock:
+            self._inflight[task_id] = callback
+            self._inflight_worker[task_id] = worker
+        try:
+            worker.send("exec", payload)
+        except OSError:
+            self._handle_worker_death(worker)
+
+    # -- actors ---------------------------------------------------------
+    def allocate_actor_worker(self) -> Optional[WorkerHandle]:
+        """Dedicate a worker to an actor; spawns beyond the stateless-task
+        cap if needed (dedicated workers don't count against it — actor
+        concurrency is limited by actor resources, not pool size)."""
+        worker = self._acquire_worker()
+        if worker is None:
+            worker = self._spawn(to_idle=False)
+        worker.dedicated = True
+        return worker
+
+    def submit_to_worker(
+        self,
+        worker: WorkerHandle,
+        msg_type: str,
+        task_id: bytes,
+        payload: dict,
+        callback: Callable[[Any, Optional[BaseException]], None],
+        fn_blob: Optional[bytes] = None,
+        fn_id: Optional[bytes] = None,
+    ) -> None:
+        payload = dict(payload)
+        payload["task_id"] = task_id
+        if fn_id is not None:
+            payload["fn_id"] = fn_id
+            if fn_id not in worker.known_fns and fn_blob is not None:
+                payload["fn_blob"] = fn_blob
+                worker.known_fns.add(fn_id)
+        with self._lock:
+            self._inflight[task_id] = callback
+            self._inflight_worker[task_id] = worker
+        try:
+            worker.send(msg_type, payload)
+        except OSError:
+            self._handle_worker_death(worker)
+
+    def release_actor_worker(self, worker: WorkerHandle) -> None:
+        """Actor died/removed: kill its dedicated process."""
+        self._kill_worker(worker)
+
+    # ------------------------------------------------------------------
+    def _reader_loop(self, worker: WorkerHandle) -> None:
+        while True:
+            try:
+                msg_type, payload = protocol.recv_msg(worker.sock)
+            except (ConnectionError, OSError):
+                self._handle_worker_death(worker)
+                return
+            if msg_type == "result":
+                task_id = payload["task_id"]
+                with self._lock:
+                    callback = self._inflight.pop(task_id, None)
+                    self._inflight_worker.pop(task_id, None)
+                if callback is None:
+                    continue
+                if not worker.dedicated:
+                    self._release_worker(worker)
+                try:
+                    if "error_blob" in payload:
+                        callback(None, pickle.loads(payload["error_blob"]))
+                    else:
+                        callback(pickle.loads(payload["value_blob"]), None)
+                except BaseException as exc:  # noqa: BLE001 — keep the reader alive
+                    try:
+                        callback(None, exc)
+                    except BaseException:
+                        pass
+
+    def _handle_worker_death(self, worker: WorkerHandle) -> None:
+        if not worker.alive:
+            return
+        worker.alive = False
+        dead_tasks = []
+        with self._lock:
+            self._all.pop(worker.pid, None)
+            try:
+                self._idle.remove(worker)
+            except ValueError:
+                pass
+            for task_id, w in list(self._inflight_worker.items()):
+                if w is worker:
+                    dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
+                    del self._inflight_worker[task_id]
+        for task_id, callback in dead_tasks:
+            if callback is not None:
+                callback(None, WorkerCrashedError(f"worker {worker.pid} died"))
+        if self._on_worker_death is not None and not self._shutdown:
+            self._on_worker_death(worker)
+
+    def _kill_worker(self, worker: WorkerHandle) -> None:
+        worker.alive = False
+        with self._lock:
+            self._all.pop(worker.pid, None)
+        try:
+            worker.send("shutdown", {})
+        except OSError:
+            pass
+        try:
+            worker.proc.terminate()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def num_workers(self) -> int:
+        with self._lock:
+            return len(self._all)
+
+    def num_idle(self) -> int:
+        with self._lock:
+            return len(self._idle)
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._lock:
+            workers = list(self._all.values())
+        for w in workers:
+            self._kill_worker(w)
+        for w in workers:
+            try:
+                w.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        self._listener.close()
+        try:
+            os.unlink(self._listen_path)
+        except OSError:
+            pass

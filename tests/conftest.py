@@ -1,0 +1,43 @@
+"""Test fixtures.
+
+Parity with the reference's ``python/ray/tests/conftest.py``: a
+``ray_start_regular``-style fixture for a fresh single-node runtime, and a
+``ray_start_cluster`` fixture that builds multi-node clusters in one process
+(reference: ``python/ray/cluster_utils.py:135`` spawns extra raylets; here
+extra Node objects share one control service).
+
+JAX runs on a virtual 8-device CPU mesh so sharding/collective tests work
+without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+    try:
+        yield rt
+    finally:
+        rt.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node in-process cluster; yields (rt, cluster)."""
+    import ray_tpu as rt
+
+    cluster = rt.init(num_cpus=2)
+    try:
+        yield rt, cluster
+    finally:
+        rt.shutdown()

@@ -1,0 +1,64 @@
+"""ID scheme tests (reference parity: src/ray/common/id.h semantics)."""
+
+import pickle
+
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+def test_sizes():
+    assert len(JobID.next().binary()) == 4
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    assert len(actor.binary()) == 12
+    task = TaskID.for_actor_task(actor)
+    assert len(task.binary()) == 16
+    oid = ObjectID.for_task_return(task, 1)
+    assert len(oid.binary()) == 20
+
+
+def test_lineage_embedding():
+    job = JobID.from_int(3)
+    task = TaskID.for_normal_task(job)
+    oid = ObjectID.for_task_return(task, 2)
+    assert oid.task_id() == task
+    assert oid.job_id() == job
+    assert oid.index() == 2
+    assert oid.is_return() and not oid.is_put()
+
+
+def test_put_vs_return_ids():
+    job = JobID.from_int(1)
+    task = TaskID.for_normal_task(job)
+    put_id = ObjectID.for_put(task, 1)
+    ret_id = ObjectID.for_task_return(task, 1)
+    assert put_id != ret_id
+    assert put_id.is_put()
+
+
+def test_actor_id_embeds_job():
+    job = JobID.from_int(9)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    creation = TaskID.for_actor_creation(actor)
+    assert creation.actor_id() == actor
+
+
+def test_nil_and_equality():
+    nil = ActorID.nil()
+    assert nil.is_nil()
+    job = JobID.from_int(1)
+    t = TaskID.for_normal_task(job)
+    assert t.actor_id().is_nil()
+    assert t == TaskID(t.binary())
+    assert hash(t) == hash(TaskID(t.binary()))
+
+
+def test_pickle_roundtrip():
+    job = JobID.from_int(5)
+    for id_obj in [job, NodeID.from_random(), ActorID.of(job), PlacementGroupID.of(job)]:
+        assert pickle.loads(pickle.dumps(id_obj)) == id_obj
+
+
+def test_hex_roundtrip():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n

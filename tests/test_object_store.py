@@ -1,0 +1,147 @@
+"""Object store + refcount tests (parity: memory_store / reference_count
+test matrices, src/ray/core_worker/test/)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import ObjectStore, Tier
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.exceptions import GetTimeoutError
+
+
+def _oid(i=None):
+    task = TaskID.for_normal_task(JobID.from_int(1))
+    return ObjectID.for_task_return(task, i or 1)
+
+
+def test_put_get():
+    store = ObjectStore()
+    oid = _oid()
+    store.put(oid, {"a": 1})
+    assert store.get(oid) == {"a": 1}
+
+
+def test_blocking_get_wakes_on_put():
+    store = ObjectStore()
+    oid = _oid()
+    result = []
+
+    def getter():
+        result.append(store.get(oid, timeout=5))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    store.put(oid, 42)
+    t.join(timeout=5)
+    assert result == [42]
+
+
+def test_get_timeout():
+    store = ObjectStore()
+    with pytest.raises(GetTimeoutError):
+        store.get(_oid(), timeout=0.05)
+
+
+def test_host_spill_to_disk_and_restore(tmp_path):
+    from ray_tpu.core import config
+
+    cfg = config.Config()
+    cfg.spill_dir = str(tmp_path)
+    config.set_config(cfg)
+    try:
+        store = ObjectStore(host_budget=1024 * 1024)
+        oids = []
+        for i in range(1, 6):
+            oid = _oid(i)
+            store.put(oid, np.ones(200_000, dtype=np.float32))  # 800KB each
+            oids.append(oid)
+        stats = store.stats()
+        assert stats["spills"] > 0
+        # restored values are intact
+        for oid in oids:
+            val = store.get(oid)
+            assert val.shape == (200_000,)
+            assert float(val[0]) == 1.0
+    finally:
+        config.reset_config()
+
+
+def test_delete_accounting():
+    store = ObjectStore(host_budget=10**9)
+    oid = _oid()
+    store.put(oid, np.ones(1000))
+    assert store.stats()["host_used"] > 0
+    store.delete(oid)
+    assert store.stats()["host_used"] == 0
+    assert not store.contains(oid)
+
+
+# -------------------------------------------------------------------------
+# reference counting
+# -------------------------------------------------------------------------
+def test_local_refcount_zero_triggers_delete():
+    deleted = []
+    rc = ReferenceCounter(on_object_out_of_scope=deleted.append)
+    oid = _oid()
+    rc.add_owned_object(oid)
+    rc.add_local_reference(oid)
+    rc.add_local_reference(oid)
+    rc.remove_local_reference(oid)
+    assert not deleted
+    rc.remove_local_reference(oid)
+    assert deleted == [oid]
+
+
+def test_submitted_task_refs_keep_object_alive():
+    deleted = []
+    rc = ReferenceCounter(on_object_out_of_scope=deleted.append)
+    oid = _oid()
+    rc.add_local_reference(oid)
+    rc.add_submitted_task_references([oid])
+    rc.remove_local_reference(oid)
+    assert not deleted  # task still holds it
+    rc.remove_submitted_task_references([oid])
+    assert deleted == [oid]
+
+
+def test_borrowers_keep_object_alive():
+    deleted = []
+    rc = ReferenceCounter(on_object_out_of_scope=deleted.append)
+    oid = _oid()
+    rc.add_local_reference(oid)
+    rc.add_borrower(oid, "worker-2")
+    rc.remove_local_reference(oid)
+    assert not deleted
+    rc.remove_borrower(oid, "worker-2")
+    assert deleted == [oid]
+
+
+def test_pinned_objects_survive_zero_refs():
+    deleted = []
+    rc = ReferenceCounter(on_object_out_of_scope=deleted.append)
+    oid = _oid()
+    rc.add_local_reference(oid)
+    rc.pin(oid)
+    rc.remove_local_reference(oid)
+    assert not deleted
+    rc.unpin(oid)
+    assert deleted == [oid]
+
+
+def test_objectref_lifecycle_integration(ray_start_regular):
+    rt = ray_start_regular
+    worker = __import__("ray_tpu.runtime.worker", fromlist=["global_worker"]).global_worker()
+    ref = rt.put([1, 2, 3])
+    oid = ref.id()
+    assert worker.ref_counter.has_reference(oid)
+    store = rt.get_cluster().head_node.store
+    assert store.contains(oid)
+    del ref
+    import gc
+
+    gc.collect()
+    assert not worker.ref_counter.has_reference(oid)
+    assert not store.contains(oid)

@@ -1,0 +1,279 @@
+"""End-to-end task API tests (parity: python/ray/tests/test_basic*.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+def test_basic_task(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def f(x):
+        return x + 1
+
+    assert rt.get(f.remote(1)) == 2
+
+
+def test_chained_dependencies(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 11
+
+
+def test_multiple_returns(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_kwargs_and_ref_kwargs(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def f(a, b=0):
+        return a + b
+
+    ref = rt.put(5)
+    assert rt.get(f.remote(1, b=ref)) == 6
+
+
+def test_error_propagation_with_traceback(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def boom():
+        raise ZeroDivisionError("oops")
+
+    with pytest.raises(rt.RayTaskError) as info:
+        rt.get(boom.remote())
+    assert "ZeroDivisionError" in info.value.traceback_str
+
+
+def test_error_through_dependency(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def boom():
+        raise ValueError("first")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    # the consumer's arg resolution surfaces the upstream error
+    with pytest.raises(Exception):
+        rt.get(consume.remote(boom.remote()), timeout=15)
+
+
+def test_large_array_through_process_worker(ray_start_regular):
+    rt = ray_start_regular
+    data = np.random.rand(512, 512)
+
+    @rt.remote
+    def stats(x):
+        return float(x.sum()), x.shape
+
+    total, shape = rt.get(stats.remote(data))
+    assert shape == (512, 512)
+    assert abs(total - data.sum()) < 1e-6
+
+
+def test_large_return_from_process_worker(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def make():
+        return np.ones((1024, 1024), dtype=np.float32)
+
+    out = rt.get(make.remote())
+    assert out.nbytes == 4 * 1024 * 1024
+    assert float(out.sum()) == 1024 * 1024
+
+
+def test_process_isolation(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def worker_pid():
+        return os.getpid()
+
+    pids = rt.get([worker_pid.remote() for _ in range(4)])
+    assert os.getpid() not in pids
+
+
+def test_thread_execution_in_process(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(execution="thread")
+    def here():
+        return os.getpid()
+
+    assert rt.get(here.remote()) == os.getpid()
+
+
+def test_nested_tasks(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(execution="thread")
+    def inner(x):
+        return x * 2
+
+    @rt.remote(execution="thread")
+    def outer(x):
+        return rt.get(inner.remote(x)) + 1
+
+    assert rt.get(outer.remote(10)) == 21
+
+
+def test_wait_semantics(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(execution="thread")
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    refs = [slow.remote(), fast.remote()]
+    ready, not_ready = rt.wait(refs, num_returns=1, timeout=10)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert rt.get(ready[0]) == "fast"
+
+
+def test_retries_on_worker_crash(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(max_retries=2)
+    def flaky(path):
+        # crash the worker process on first attempt
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    marker = f"/tmp/rt_flaky_{os.getpid()}_{time.time_ns()}"
+    try:
+        assert rt.get(flaky.remote(marker), timeout=60) == "recovered"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_no_retry_on_app_error_by_default(ray_start_regular):
+    rt = ray_start_regular
+    calls = {"n": 0}
+
+    @rt.remote(execution="thread")
+    def boom():
+        calls["n"] += 1
+        raise RuntimeError("app error")
+
+    with pytest.raises(rt.RayTaskError):
+        rt.get(boom.remote())
+    assert calls["n"] == 1
+
+
+def test_retry_exceptions_opt_in(ray_start_regular):
+    rt = ray_start_regular
+    state = {"n": 0}
+
+    @rt.remote(execution="thread", max_retries=3, retry_exceptions=True)
+    def eventually():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("not yet")
+        return state["n"]
+
+    assert rt.get(eventually.remote(), timeout=30) == 3
+
+
+def test_options_override(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def f():
+        return 1
+
+    g = f.options(name="renamed", num_returns=1)
+    assert rt.get(g.remote()) == 1
+    with pytest.raises(ValueError):
+        f.options(bogus_option=1)
+
+
+def test_direct_call_raises(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_jax_array_task_runs_inprocess(ray_start_regular):
+    rt = ray_start_regular
+    import jax
+    import jax.numpy as jnp
+
+    @rt.remote
+    def matmul(a, b):
+        return a @ b
+
+    a = jnp.ones((64, 64))
+    ref = matmul.remote(a, a)
+    out = rt.get(ref)
+    assert isinstance(out, jax.Array)
+    assert out.shape == (64, 64)
+    assert float(out[0, 0]) == 64.0
+
+
+def test_get_timeout(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(rt.GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.2)
+
+
+def test_cluster_and_available_resources(ray_start_regular):
+    rt = ray_start_regular
+    total = rt.cluster_resources()
+    assert total["CPU"] == 4
+    avail = rt.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_runtime_context(ray_start_regular):
+    rt = ray_start_regular
+    ctx = rt.get_runtime_context()
+    assert ctx.get_job_id()
+    assert ctx.get_node_id()
+
+    @rt.remote(execution="thread")
+    def my_task_id():
+        return rt.get_runtime_context().get_task_id()
+
+    tid = rt.get(my_task_id.remote())
+    assert tid is not None
