@@ -107,6 +107,9 @@ class ObjectStore:
             tier, size = Tier.HOST, _nbytes(value)
         entry = ObjectEntry(value, tier, size, is_error)
         with self._lock:
+            old = self._entries.get(object_id)
+            if old is not None:
+                self._account_remove(old)
             self._entries[object_id] = entry
             self._entries.move_to_end(object_id)
             if tier is Tier.DEVICE:
@@ -178,6 +181,7 @@ class ObjectStore:
                 return
             self._account_remove(entry)
             if entry.tier is Tier.SHM and self._shm is not None:
+                self._shm.unpin(object_id.binary())
                 self._shm.delete(object_id.binary())
             elif entry.tier is Tier.DISK and entry.disk_path:
                 try:
@@ -232,7 +236,8 @@ class ObjectStore:
                 header = pickle.dumps((value.dtype.str, value.shape))
                 data = np.ascontiguousarray(value)
                 payload = header + data.tobytes()
-                self._shm.put(oid.binary(), payload, meta_size=len(header))
+                # pinned: the shm copy is the only copy, LRU must not evict it
+                self._shm.put(oid.binary(), payload, meta_size=len(header), pin=True)
                 entry.value = None
                 entry.tier = Tier.SHM
                 self._host_used -= entry.size
@@ -268,6 +273,7 @@ class ObjectStore:
             entry.value = value
             entry.tier = Tier.HOST
             self._host_used += entry.size
+            self._shm.unpin(oid.binary())  # drop the spill pin, then delete
             self._shm.delete(oid.binary())
             self.num_restores += 1
             return value

@@ -101,10 +101,20 @@ class ShmObjectStore:
         if self._lib.tstore_seal(self._handle, object_id) != 0:
             raise KeyError(f"cannot seal {object_id.hex()}")
 
-    def put(self, object_id: bytes, data, meta_size: int = 0) -> None:
+    def put(self, object_id: bytes, data, meta_size: int = 0, pin: bool = False) -> None:
+        """Store and seal. With pin=True the object holds a reference and is
+        exempt from LRU eviction until unpin() — used by the spill tier,
+        where the shm copy is the only copy."""
         buf = self.create(object_id, len(data), meta_size)
         buf[:] = data
         self.seal(object_id)
+        if pin:
+            size = ctypes.c_uint64()
+            meta = ctypes.c_uint64()
+            self._lib.tstore_get(self._handle, object_id, ctypes.byref(size), ctypes.byref(meta))
+
+    def unpin(self, object_id: bytes) -> None:
+        self.release(object_id)
 
     def get(self, object_id: bytes) -> tuple[memoryview, int] | None:
         """Returns (payload_view, meta_size) pinned against eviction, or None."""

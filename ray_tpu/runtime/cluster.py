@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.sync import when_all
 from ray_tpu.core.task_manager import TaskManager
 from ray_tpu.exceptions import (
     ActorDiedError,
@@ -224,19 +225,24 @@ class Cluster:
                 return
             src = self.nodes.get(src_node_id)
             if src is None or src.dead:
-                # location was stale; retry the wait
+                # Stale location: purge it so the re-registered wait blocks
+                # for a fresh copy instead of looping on the dead node.
+                self.directory.remove_location(oid, src_node_id)
                 self.directory.wait_for(oid, on_located)
+                if not self.directory.locations(oid) and not self._is_pending(oid):
+                    self._try_recover(oid)
                 return
             try:
                 value = src.store.get(oid, timeout=30)
             except Exception:
                 self.directory.wait_for(oid, on_located)
                 return
+            src_info = src.store.entry_info(oid)
             # chunked-transfer accounting (object_manager 5MiB chunks parity)
             size = getattr(value, "nbytes", 0) or 0
             self.transfer_bytes += size
             self.transfer_count += 1
-            dest_node.store.put(oid, value)
+            dest_node.store.put(oid, value, is_error=bool(src_info and src_info["is_error"]))
             self.directory.add_location(oid, dest_node.node_id)
             callback()
 
@@ -252,8 +258,15 @@ class Cluster:
         return False
 
     def _try_recover(self, oid: ObjectID) -> bool:
+        if self.directory.locations(oid) or self._is_pending(oid):
+            return True  # already available or being (re)produced
         spec = self.task_manager.lineage_spec(oid)
         if spec is None:
+            # Unrecoverable: commit ObjectLostError so blocked getters raise
+            # instead of hanging (reference: OwnerDiedError/ObjectLostError
+            # surfaced at get).
+            self.head_node.store.put(oid, ObjectLostError(oid), is_error=True)
+            self.directory.add_location(oid, self.head_node.node_id)
             return False
         spec.retries_left = max(spec.retries_left, 1)
         spec.attempt += 1
@@ -265,6 +278,25 @@ class Cluster:
     # owner-side completion
     # ------------------------------------------------------------------
     def on_task_finished(self, node: Node, spec: TaskSpec, result: Any, error: Optional[BaseException]) -> None:
+        if node.dead:
+            # The node died. Normal tasks were resubmitted by kill_node (the
+            # retry owns the returns), so straggler completions are dropped.
+            # In-flight ACTOR tasks are not resubmitted — their callers must
+            # see an error, not hang.
+            if spec.actor_id is not None:
+                if error is None:
+                    # the call actually completed: salvage the result onto
+                    # the head node's store
+                    values = [result] if spec.num_returns == 1 else list(result or [None] * spec.num_returns)
+                    for oid, value in zip(spec.return_ids, values):
+                        self.head_node.store.put(oid, value)
+                        self.directory.add_location(oid, self.head_node.node_id)
+                    self.task_manager.mark_completed(spec)
+                else:
+                    self.task_manager.mark_failed(spec)
+                    self._commit_error_everywhere(spec, error)
+                self._after_commit(spec)
+            return
         if error is not None:
             is_system = isinstance(error, (WorkerCrashedError, ActorDiedError))
             retry_exceptions = getattr(spec, "_retry_exceptions", False)
@@ -340,22 +372,11 @@ class Cluster:
             return
         spec.owner_node = node_id
         deps = [d for d in spec.dependencies if not node.store.contains(d)]
-        if deps:
-            remaining = len(deps)
-            lock = threading.Lock()
-
-            def on_ready(_=None):
-                nonlocal remaining
-                with lock:
-                    remaining -= 1
-                    if remaining:
-                        return
-                node.create_actor(spec, opts["mode"], opts["max_concurrency"])
-
-            for dep in deps:
-                self.pull_object(dep, node, on_ready)
-        else:
-            node.create_actor(spec, opts["mode"], opts["max_concurrency"])
+        when_all(
+            deps,
+            lambda dep, done: self.pull_object(dep, node, done),
+            lambda: node.create_actor(spec, opts["mode"], opts["max_concurrency"]),
+        )
 
     def on_actor_created(self, node: Node, spec: TaskSpec) -> None:
         self.control.actors.mark_alive(spec.actor_id, node.node_id)
@@ -423,6 +444,7 @@ class Cluster:
         q = self._actor_queues.get(spec.actor_id)
         info = self.control.actors.get(spec.actor_id)
         if q is None or info is None or info.state is ActorState.DEAD:
+            self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))
             self._after_commit(spec)
             return
@@ -442,24 +464,12 @@ class Cluster:
             return
         node = self.nodes[info.node_id]
         deps = [d for d in spec.dependencies if not node.store.contains(d)]
-        if not deps:
-            entry[1] = True
-            self._pump_actor_queue(spec.actor_id)
-            return
-        remaining = len(deps)
-        lock = threading.Lock()
 
-        def on_ready(_=None):
-            nonlocal remaining
-            with lock:
-                remaining -= 1
-                if remaining:
-                    return
+        def ready():
             entry[1] = True
             self._pump_actor_queue(spec.actor_id)
 
-        for dep in deps:
-            self.pull_object(dep, node, on_ready)
+        when_all(deps, lambda dep, done: self.pull_object(dep, node, done), ready)
 
     def _pump_actor_queue(self, actor_id: ActorID) -> None:
         q = self._actor_queues.get(actor_id)
@@ -497,6 +507,7 @@ class Cluster:
             pending = list(q.pending)
             q.pending.clear()
         for spec, _ready in pending:
+            self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, error)
             self._after_commit(spec)
 

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID
 from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.sync import when_all
 from ray_tpu.core.resources import ResourcePool, ResourceSet
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.exceptions import (
@@ -93,22 +94,11 @@ class Node:
         # Dependencies may live on other nodes: route waits through the
         # fabric's pull path instead of the raw local store.
         deps = [d for d in spec.dependencies if not self.store.contains(d)]
-        if deps:
-            remaining = len(deps)
-            lock = threading.Lock()
-
-            def on_ready(_fut=None):
-                nonlocal remaining
-                with lock:
-                    remaining -= 1
-                    last = remaining == 0
-                if last:
-                    self.scheduler.submit_ready(spec)
-
-            for dep in deps:
-                self.cluster.pull_object(dep, self, on_ready)
-            return
-        self.scheduler.submit_ready(spec)
+        when_all(
+            deps,
+            lambda dep, done: self.cluster.pull_object(dep, self, done),
+            lambda: self.scheduler.submit_ready(spec),
+        )
 
     # ------------------------------------------------------------------
     # dispatch (deps local, resources held)

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu.core.resources import ResourcePool, ResourceSet
+from ray_tpu.core.sync import when_all
 
 
 # --------------------------------------------------------------------------
@@ -243,24 +244,12 @@ class LocalScheduler:
 
     def submit(self, spec: TaskSpec) -> None:
         self.num_submitted += 1
-        deps = spec.dependencies
-        if not deps:
-            self._enqueue_ready(spec)
-            return
         # Dependency manager: wait on all args, then enqueue.
-        remaining = len(deps)
-        lock = threading.Lock()
-
-        def on_dep_done(_fut):
-            nonlocal remaining
-            with lock:
-                remaining -= 1
-                last = remaining == 0
-            if last:
-                self._enqueue_ready(spec)
-
-        for dep in deps:
-            self._store.get_async(dep).add_done_callback(on_dep_done)
+        when_all(
+            spec.dependencies,
+            lambda dep, done: self._store.get_async(dep).add_done_callback(done),
+            lambda: self._enqueue_ready(spec),
+        )
 
     def _enqueue_ready(self, spec: TaskSpec) -> None:
         dispatch_now = False

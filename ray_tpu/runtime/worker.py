@@ -90,7 +90,7 @@ class CoreWorker:
             dependencies=[r.id() for r in deps],
             num_returns=num_returns,
             return_ids=return_ids,
-            resources=ResourceSet(resources or {"CPU": 1}),
+            resources=ResourceSet({"CPU": 1} if resources is None else resources),
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             execution=execution,
             scheduling_strategy=scheduling_strategy,
@@ -133,7 +133,7 @@ class CoreWorker:
             dependencies=[r.id() for r in deps],
             num_returns=0,
             return_ids=[],
-            resources=ResourceSet(resources or {"CPU": 1}),
+            resources=ResourceSet({"CPU": 1} if resources is None else resources),
             actor_id=actor_id,
             scheduling_strategy=scheduling_strategy,
             is_actor_creation=True,

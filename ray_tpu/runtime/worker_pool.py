@@ -256,6 +256,21 @@ class ProcessWorkerPool:
             self._on_worker_death(worker)
 
     def _kill_worker(self, worker: WorkerHandle) -> None:
+        # Fail any in-flight tasks first — the reader loop's death handler
+        # will early-return once alive=False, so this is the only chance to
+        # fire their callbacks.
+        dead_tasks = []
+        with self._lock:
+            for task_id, w in list(self._inflight_worker.items()):
+                if w is worker:
+                    dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
+                    del self._inflight_worker[task_id]
+        for task_id, callback in dead_tasks:
+            if callback is not None:
+                try:
+                    callback(None, WorkerCrashedError(f"worker {worker.pid} was killed"))
+                except BaseException:
+                    pass
         worker.alive = False
         with self._lock:
             self._all.pop(worker.pid, None)

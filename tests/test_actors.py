@@ -1,0 +1,308 @@
+"""Actor tests (parity: python/ray/tests/test_actor*.py)."""
+
+import os
+import time
+
+import pytest
+
+
+def test_basic_actor(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.incr.remote()) == 11
+    assert rt.get(c.incr.remote(5)) == 16
+    assert rt.get(c.value.remote()) == 16
+
+
+def test_actor_runs_in_own_process(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class P:
+        def pid(self):
+            return os.getpid()
+
+    a, b = P.remote(), P.remote()
+    pid_a, pid_b = rt.get([a.pid.remote(), b.pid.remote()])
+    assert pid_a != pid_b
+    assert os.getpid() not in (pid_a, pid_b)
+
+
+def test_inproc_actor(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(execution="inproc")
+    class Here:
+        def pid(self):
+            return os.getpid()
+
+    h = Here.remote()
+    assert rt.get(h.pid.remote()) == os.getpid()
+
+
+def test_method_ordering(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get_items(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert rt.get(log.get_items.remote()) == list(range(20))
+
+
+def test_actor_error_propagation(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Fragile:
+        def fail(self):
+            raise KeyError("missing")
+
+        def ok(self):
+            return "fine"
+
+    f = Fragile.remote()
+    with pytest.raises(rt.RayTaskError):
+        rt.get(f.fail.remote())
+    # actor survives application errors
+    assert rt.get(f.ok.remote()) == "fine"
+
+
+def test_creation_failure_surfaces(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot construct")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((rt.RayTaskError, rt.RayActorError)):
+        rt.get(b.m.remote(), timeout=30)
+
+
+def test_named_actor_and_get_actor(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Service:
+        def ping(self):
+            return "pong"
+
+    Service.options(name="svc").remote()
+    time.sleep(0.1)
+    handle = rt.get_actor("svc")
+    assert rt.get(handle.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        rt.get_actor("nonexistent")
+
+
+def test_namespace_isolation(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class S:
+        def which(self):
+            return "found"
+
+    S.options(name="dup", namespace="ns1").remote()
+    S.options(name="dup", namespace="ns2").remote()  # no collision
+    time.sleep(0.1)
+    assert rt.get(rt.get_actor("dup", namespace="ns1").which.remote()) == "found"
+    with pytest.raises(ValueError):
+        rt.get_actor("dup", namespace="ns3")
+
+
+def test_duplicate_name_rejected(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class S:
+        def m(self):
+            return 1
+
+    S.options(name="unique").remote()
+    with pytest.raises(ValueError):
+        S.options(name="unique").remote()
+
+
+def test_kill_actor(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class V:
+        def m(self):
+            return 1
+
+    v = V.remote()
+    assert rt.get(v.m.remote()) == 1
+    rt.kill(v)
+    with pytest.raises(rt.RayActorError):
+        rt.get(v.m.remote(), timeout=30)
+
+
+def test_actor_restart_on_crash(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.state = "reborn"
+
+        def crash(self):
+            os._exit(1)
+
+        def status(self):
+            return self.state
+
+    p = Phoenix.remote()
+    assert rt.get(p.status.remote()) == "reborn"
+    try:
+        rt.get(p.crash.remote(), timeout=30)
+    except (rt.RayActorError, rt.WorkerCrashedError, rt.RayTaskError):
+        pass
+    # restarted actor serves again (state reset by re-running __init__)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert rt.get(p.status.remote(), timeout=10) == "reborn"
+            break
+        except (rt.RayActorError, rt.WorkerCrashedError):
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_no_restart_without_max_restarts(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Mortal:
+        def crash(self):
+            os._exit(1)
+
+        def m(self):
+            return 1
+
+    m = Mortal.remote()
+    assert rt.get(m.m.remote()) == 1
+    try:
+        rt.get(m.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    with pytest.raises(rt.RayActorError):
+        rt.get(m.m.remote(), timeout=30)
+
+
+def test_async_actor_concurrency(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class AsyncWorker:
+        async def slow_echo(self, x, delay):
+            import asyncio
+
+            await asyncio.sleep(delay)
+            return x
+
+    w = AsyncWorker.remote()
+    t0 = time.perf_counter()
+    refs = [w.slow_echo.remote(i, 0.5) for i in range(4)]
+    assert rt.get(refs, timeout=30) == [0, 1, 2, 3]
+    # concurrent: 4 x 0.5s sleeps overlap
+    assert time.perf_counter() - t0 < 1.8
+
+
+def test_actor_handle_passing(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+
+        def get_value(self, k):
+            return self.v.get(k)
+
+    @rt.remote
+    def writer(store, k, v):
+        rt.get(store.set.remote(k, v))
+        return "written"
+
+    s = Store.remote()
+    # handle crosses into an in-process task
+    assert rt.get(writer.options(execution="thread").remote(s, "x", 42), timeout=30) == "written"
+    assert rt.get(s.get_value.remote("x")) == 42
+
+
+def test_method_num_returns(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Splitter:
+        @rt.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    s = Splitter.remote()
+    a, b = s.pair.remote()
+    assert rt.get([a, b]) == [1, 2]
+
+
+def test_actor_with_ref_args(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Adder:
+        def add(self, a, b):
+            return a + b
+
+    x = rt.put(10)
+    a = Adder.remote()
+    assert rt.get(a.add.remote(x, 5)) == 15
+
+
+def test_device_actor_with_jax_state(ray_start_regular):
+    rt = ray_start_regular
+    import jax.numpy as jnp
+
+    @rt.remote(execution="inproc")
+    class Model:
+        def __init__(self, dim):
+            self.w = jnp.eye(dim)
+
+        def apply(self, x):
+            return (self.w @ x).sum()
+
+    m = Model.remote(8)
+    out = rt.get(m.apply.remote(jnp.ones(8)))
+    assert float(out) == 8.0
