@@ -82,6 +82,8 @@ def shutdown() -> None:
         try:
             _cluster.shutdown()
         finally:
+            if _cluster.core_worker is not None:
+                _cluster.core_worker.ref_counter.stop()
             _cluster = None
             set_global_worker(None)
             hooks.ref_counter = None

@@ -86,9 +86,11 @@ class ObjectRef:
         return ObjectRef(self._id, self._owner)
 
     def __del__(self):
+        # __del__ can run at any GC point, including while runtime locks are
+        # held — only a lock-free enqueue is safe here.
         if not self._skip_decref and hooks.ref_counter is not None:
             try:
-                hooks.ref_counter.remove_local_reference(self._id)
+                hooks.ref_counter.enqueue_local_ref_removal(self._id)
             except Exception:
                 pass
 

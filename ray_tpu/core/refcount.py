@@ -61,6 +61,39 @@ class ReferenceCounter:
         self._lock = threading.RLock()
         self._refs: Dict[ObjectID, Reference] = {}
         self._on_out_of_scope = on_object_out_of_scope
+        # Deferred decrement queue: ObjectRef.__del__ may fire from GC while
+        # ANY runtime lock is held, so it must never touch locks itself —
+        # it enqueues here and a drainer thread applies the decrement.
+        from collections import deque
+
+        self._deferred: "deque[ObjectID]" = deque()
+        self._deferred_event = threading.Event()
+        self._drainer_stop = False
+        self._drainer = threading.Thread(target=self._drain_loop, name="refcount-gc", daemon=True)
+        self._drainer.start()
+
+    def enqueue_local_ref_removal(self, object_id: ObjectID) -> None:
+        """GC-safe: called from __del__; lock-free append + event set."""
+        self._deferred.append(object_id)
+        self._deferred_event.set()
+
+    def _drain_loop(self) -> None:
+        while not self._drainer_stop:
+            self._deferred_event.wait(timeout=0.5)
+            self._deferred_event.clear()
+            while True:
+                try:
+                    oid = self._deferred.popleft()
+                except IndexError:
+                    break
+                try:
+                    self.remove_local_reference(oid)
+                except Exception:
+                    pass
+
+    def stop(self) -> None:
+        self._drainer_stop = True
+        self._deferred_event.set()
 
     # -- ownership --------------------------------------------------------
     def add_owned_object(self, object_id: ObjectID, pinned: bool = False) -> None:

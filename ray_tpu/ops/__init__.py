@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops."""
+
+from ray_tpu.ops.attention import flash_attention, mha
+
+__all__ = ["flash_attention", "mha"]

@@ -1,0 +1,149 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the model stack (SURVEY §7 phase 4): blockwise online-softmax
+attention that keeps the [Tq, Tk] score matrix out of HBM — scores live in
+VMEM one (block_q x block_k) tile at a time, feeding the MXU per tile.
+
+Forward is the Pallas kernel; backward recomputes attention under
+``jax.custom_vjp`` (rematerialization trades FLOPs for HBM, the standard TPU
+tradeoff).  On non-TPU backends the kernel runs in interpret mode so tests
+exercise identical code paths on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int):
+    """One q-block vs. the full K/V, blockwise over K.
+
+    q_ref: [block_q, D]; k_ref, v_ref: [Tk, D]; o_ref: [block_q, D].
+    Grid: (batch*heads, num_q_blocks).
+    """
+    block_q, d = q_ref.shape
+    t_k = k_ref.shape[0]
+    q_block_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    num_k_blocks = t_k // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_blk = s.max(axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # skip K blocks strictly above the diagonal
+        last_block = q_block_idx * block_q // block_k + pl.cdiv(block_q, block_k)
+        upper = jnp.minimum(last_block, num_k_blocks)
+    else:
+        upper = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int, interpret: bool):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+
+    grid = (B * H, Tq // bq)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, sm_scale=sm_scale, causal=causal, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D)
+
+
+def _reference_attention(q, k, v, sm_scale: float, causal: bool):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    sm_scale: Optional[float] = None,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Blockwise flash attention. q,k,v: [B, H, T, D]."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k, _use_interpret())
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out = flash_attention(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    # rematerialized backward: differentiate the reference formulation
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference_attention(q_, k_, v_, scale, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def mha(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None):
+    """Plain-XLA reference attention (for tests and small shapes)."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _reference_attention(q, k, v, scale, causal)

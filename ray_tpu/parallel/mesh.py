@@ -1,0 +1,143 @@
+"""Mesh management: device meshes, shardings, SPMD program placement.
+
+This is new, first-class infrastructure in the TPU rebuild (SURVEY §2.5: the
+reference delegates TP/PP/SP to user frameworks and only supplies placement
+groups + env vars).  Here the mesh is a core service: axes are declared once
+(``dp``/``fsdp``/``tp``/``sp``/``pp``/``ep``), arrays carry
+``PartitionSpec``s, and XLA inserts the ICI collectives.
+
+Parity anchor: replaces the role of ``ray.train`` backend configs
+(``python/ray/train/torch/config.py:112`` process-group wiring) and
+``ray.util.collective`` group management for the SPMD data plane.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+# Canonical axis order: dp outermost (slowest ICI), then fsdp/pp, then
+# sp/tp innermost (fastest, most-communicating axes ride the shortest links).
+_CANONICAL_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+class MeshManager:
+    """Named-mesh registry + topology-aware construction."""
+
+    def __init__(self, devices: Optional[Sequence] = None):
+        self._lock = threading.Lock()
+        self._meshes: Dict[str, Mesh] = {}
+        self._devices = list(devices) if devices is not None else None
+
+    def devices(self) -> List:
+        if self._devices is None:
+            self._devices = list(jax.devices())
+        return self._devices
+
+    # ------------------------------------------------------------------
+    def create_mesh(
+        self,
+        axes: Dict[str, int],
+        *,
+        name: Optional[str] = None,
+        devices: Optional[Sequence] = None,
+    ) -> Mesh:
+        """Build a mesh with the given axis sizes.
+
+        Axis sizes must multiply to the device count; a single ``-1`` axis is
+        inferred.  Axes are laid out in canonical order so the
+        highest-traffic axes (tp, sp) map to adjacent devices.
+        """
+        devs = list(devices) if devices is not None else self.devices()
+        axes = dict(axes)
+        known = math.prod(v for v in axes.values() if v != -1)
+        inferred = [k for k, v in axes.items() if v == -1]
+        if len(inferred) > 1:
+            raise ValueError("at most one axis may be -1")
+        if inferred:
+            if len(devs) % known:
+                raise ValueError(f"{len(devs)} devices not divisible by {known}")
+            axes[inferred[0]] = len(devs) // known
+        if math.prod(axes.values()) != len(devs):
+            raise ValueError(f"axis sizes {axes} do not multiply to {len(devs)} devices")
+
+        ordered = sorted(axes.items(), key=_axis_sort_key)
+        names = tuple(k for k, _ in ordered)
+        shape = tuple(v for _, v in ordered)
+        mesh_devices = np.asarray(devs).reshape(shape)
+        mesh = Mesh(mesh_devices, names)
+        if name:
+            with self._lock:
+                self._meshes[name] = mesh
+        return mesh
+
+    def get_mesh(self, name: str) -> Mesh:
+        with self._lock:
+            if name not in self._meshes:
+                raise KeyError(f"no mesh named {name!r}")
+            return self._meshes[name]
+
+    def list_meshes(self) -> Dict[str, Mesh]:
+        with self._lock:
+            return dict(self._meshes)
+
+    # ------------------------------------------------------------------
+    def auto_mesh(self, *, dp: Optional[int] = None, tp: Optional[int] = None, name: Optional[str] = None) -> Mesh:
+        """Sensible default: all devices on one 'dp' axis unless tp given."""
+        n = len(self.devices())
+        if tp is None and dp is None:
+            return self.create_mesh({"dp": n}, name=name)
+        if tp is None:
+            tp = n // dp
+        if dp is None:
+            dp = n // tp
+        return self.create_mesh({"dp": dp, "tp": tp}, name=name)
+
+
+def _axis_sort_key(item: Tuple[str, int]):
+    name, _ = item
+    try:
+        return (_CANONICAL_ORDER.index(name), name)
+    except ValueError:
+        return (len(_CANONICAL_ORDER), name)
+
+
+# --------------------------------------------------------------------------
+# sharding helpers
+# --------------------------------------------------------------------------
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_array(x, mesh: Mesh, *spec):
+    """Place an array onto the mesh with the given partition spec."""
+    return jax.device_put(x, named_sharding(mesh, *spec))
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, named_sharding(mesh))
+
+
+_global_manager: Optional[MeshManager] = None
+_global_lock = threading.Lock()
+
+
+def mesh_manager() -> MeshManager:
+    global _global_manager
+    if _global_manager is None:
+        with _global_lock:
+            if _global_manager is None:
+                _global_manager = MeshManager()
+    return _global_manager
+
+
+def reset_mesh_manager() -> None:
+    global _global_manager
+    _global_manager = None

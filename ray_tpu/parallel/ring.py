@@ -1,0 +1,154 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+New engineering for the TPU rebuild (SURVEY §5.7: the reference has no
+sequence-parallel support — ``ray.util.collective`` stops at tensor
+collectives).  Two strategies over a mesh axis holding sequence shards:
+
+* **Ring attention** (Liu et al.): K/V blocks rotate around the ICI ring via
+  ``ppermute`` while each device accumulates blockwise attention with the
+  online-softmax (log-sum-exp) recurrence, so peak memory stays
+  O(T_local^2-free) and the sequence scales with the ring size.
+* **Ulysses**: ``all_to_all`` swaps the sharding between sequence and heads,
+  runs dense per-head attention locally, and swaps back — cheaper when
+  head_count >= ring size and sequence blocks are small.
+
+Both are pure SPMD functions for use inside ``shard_map``; the ``*_sharded``
+wrappers bind them to a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _to_varying(x, axis_name: str):
+    """Mark an array as device-varying over the axis (shard_map vma typing;
+    no-op on jax versions without pcast)."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return x
+    try:
+        return pcast(x, (axis_name,), to="varying")
+    except TypeError:
+        return pcast(x, (axis_name,))
+
+
+def _block_attention_update(q, k, v, m_prev, l_prev, o_prev, mask, sm_scale):
+    """One online-softmax block update.
+
+    q: [B, H, Tq, D]; k, v: [B, H, Tk, D]
+    m, l: [B, H, Tq]; o: [B, H, Tq, D] (f32 accumulators)
+    mask: [Tq, Tk] True = attend.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m_block = s.max(axis=-1)
+    m_new = jnp.maximum(m_prev, m_block)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(mask[None, None, :, :], p, 0.0)
+    l_new = l_prev * alpha + p.sum(axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, sm_scale: Optional[float] = None):
+    """Blockwise ring attention over sequence shards (call inside shard_map).
+
+    q, k, v: [B, H, T_local, D] — the local sequence shard.
+    Returns [B, H, T_local, D] in q.dtype.
+    """
+    n = lax.axis_size(axis_name)
+    my_block = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    q32 = q.astype(jnp.float32)
+    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    # inside shard_map the loop carry must be marked device-varying
+    m0, l0, o0 = (_to_varying(x, axis_name) for x in (m0, l0, o0))
+
+    q_pos = my_block * Tq + jnp.arange(Tq)
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, o = carry
+        src_block = (my_block - step) % n  # sequence block k_cur holds now
+        if causal:
+            k_pos = src_block * Tk + jnp.arange(Tk)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((Tq, Tk), bool)
+        m, l, o = _block_attention_update(q32, k_cur, v_cur, m, l, o, mask, scale)
+        # rotate K/V to the next rank on the ICI ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, o
+
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    # fully-masked rows (causal, empty prefix) have l == 0
+    l_safe = jnp.where(l == 0, 1.0, l)
+    return (o / l_safe[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True, sm_scale: Optional[float] = None
+):
+    """Bind ring attention onto a mesh: [B, H, T, D] arrays sharded on T."""
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# Ulysses-style all-to-all sequence parallelism
+# --------------------------------------------------------------------------
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True, sm_scale: Optional[float] = None):
+    """Head/sequence all-to-all attention (call inside shard_map).
+
+    q, k, v: [B, H, T_local, D] with H divisible by the axis size.  Swaps to
+    [B, H_local, T_full, D], runs dense attention, swaps back.
+    """
+    def swap_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def swap_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = swap_to_heads(q), swap_to_heads(k), swap_to_heads(v)
+    out = _dense_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return swap_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True, sm_scale=None):
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+def _dense_attention(q, k, v, *, causal: bool, sm_scale: Optional[float]):
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

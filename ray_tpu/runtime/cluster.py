@@ -144,7 +144,7 @@ class Cluster:
         node_id = NodeID.from_random()
         node = Node(node_id, resources, self, shm_store=self.shm_store, labels=labels)
         self.nodes[node_id] = node
-        self.cluster_scheduler.register_node(node_id, node.pool, labels)
+        self.cluster_scheduler.register_node(node_id, node.pool, labels, queue_len=node.scheduler.queue_len)
         self.control.nodes.register(NodeInfo(node_id, f"inproc://{node_id.hex()[:8]}", resources, labels))
         if self.head_node is None:
             self.head_node = node

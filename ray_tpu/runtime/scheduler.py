@@ -127,12 +127,28 @@ class ClusterScheduler:
         self._pools: Dict[NodeID, ResourcePool] = {}
         self._labels: Dict[NodeID, dict] = {}
         self._alive: Dict[NodeID, bool] = {}
+        self._queue_lens: Dict[NodeID, Callable[[], int]] = {}
 
-    def register_node(self, node_id: NodeID, pool: ResourcePool, labels: Optional[dict] = None) -> None:
+    def register_node(
+        self,
+        node_id: NodeID,
+        pool: ResourcePool,
+        labels: Optional[dict] = None,
+        queue_len: Optional[Callable[[], int]] = None,
+    ) -> None:
         with self._lock:
             self._pools[node_id] = pool
             self._labels[node_id] = labels or {}
             self._alive[node_id] = True
+            if queue_len is not None:
+                self._queue_lens[node_id] = queue_len
+
+    def _queued(self, node_id: NodeID) -> int:
+        fn = self._queue_lens.get(node_id)
+        try:
+            return fn() if fn is not None else 0
+        except Exception:
+            return 0
 
     def remove_node(self, node_id: NodeID) -> None:
         with self._lock:
@@ -155,8 +171,8 @@ class ClusterScheduler:
             target = strategy.node_id
             for nid, pool in alive:
                 if nid == target:
-                    if spec.resources.fits(pool.available):
-                        return nid
+                    if spec.resources.fits(pool.total):
+                        return nid  # queues locally if currently busy
                     return None if not strategy.soft else self._hybrid(alive, spec, cfg)
             return self._hybrid(alive, spec, cfg) if strategy.soft else None
 
@@ -192,28 +208,40 @@ class ClusterScheduler:
         if strategy == "SPREAD":
             feasible = [(nid, p) for nid, p in alive if spec.resources.fits(p.available)]
             if not feasible:
+                feasible = [(nid, p) for nid, p in alive if spec.resources.fits(p.total)]
+            if not feasible:
                 return None
-            return min(feasible, key=lambda kv: kv[1].utilization())[0]
+            return min(feasible, key=lambda kv: (self._queued(kv[0]), kv[1].utilization()))[0]
 
         return self._hybrid(alive, spec, cfg)
 
-    @staticmethod
-    def _hybrid(nodes: List[Tuple[NodeID, ResourcePool]], spec: TaskSpec, cfg) -> Optional[NodeID]:
+    def _hybrid(self, nodes: List[Tuple[NodeID, ResourcePool]], spec: TaskSpec, cfg) -> Optional[NodeID]:
         """Hybrid policy (hybrid_scheduling_policy.cc:48): prefer packing
         nodes under the spread threshold; score = utilization if under
-        threshold else 1+utilization; random choice among top-k."""
-        feasible = [(nid, p) for nid, p in nodes if spec.resources.fits(p.available)]
-        if not feasible:
+        threshold else 1+utilization; random choice among top-k.
+
+        A node that is merely BUSY (request fits its total but not its
+        current availability) is still schedulable — the task queues in its
+        LocalScheduler (raylet queueing parity).  None only when no node's
+        total resources could ever satisfy the request."""
+        available_now = [(nid, p) for nid, p in nodes if spec.resources.fits(p.available)]
+        if available_now:
+            thr = cfg.scheduler_spread_threshold
+
+            def score(pool: ResourcePool) -> float:
+                u = pool.utilization()
+                return u if u < thr else 1.0 + u
+
+            ranked = sorted(available_now, key=lambda kv: score(kv[1]))
+            k = max(1, int(len(ranked) * cfg.scheduler_top_k_fraction))
+            return random.choice(ranked[:k])[0]
+        # All nodes busy: queue on the shortest local queue (not plain
+        # utilization — queued tasks don't move `available`, so a
+        # deterministic min() would pile the whole backlog on one node).
+        eventually = [(nid, p) for nid, p in nodes if spec.resources.fits(p.total)]
+        if not eventually:
             return None
-        thr = cfg.scheduler_spread_threshold
-
-        def score(pool: ResourcePool) -> float:
-            u = pool.utilization()
-            return u if u < thr else 1.0 + u
-
-        ranked = sorted(feasible, key=lambda kv: score(kv[1]))
-        k = max(1, int(len(ranked) * cfg.scheduler_top_k_fraction))
-        return random.choice(ranked[:k])[0]
+        return min(eventually, key=lambda kv: (self._queued(kv[0]), kv[1].utilization(), random.random()))[0]
 
 
 # --------------------------------------------------------------------------
@@ -286,6 +314,9 @@ class LocalScheduler:
     def on_task_done(self, spec: TaskSpec) -> None:
         self._pool.release(spec.resources)
         self._drain()
+
+    def queue_len(self) -> int:
+        return len(self._ready)
 
     def stats(self) -> dict:
         with self._lock:

@@ -11,9 +11,9 @@ def test_sizes():
     actor = ActorID.of(job)
     assert len(actor.binary()) == 12
     task = TaskID.for_actor_task(actor)
-    assert len(task.binary()) == 16
+    assert len(task.binary()) == 20
     oid = ObjectID.for_task_return(task, 1)
-    assert len(oid.binary()) == 20
+    assert len(oid.binary()) == 24
 
 
 def test_lineage_embedding():

@@ -141,7 +141,13 @@ def test_objectref_lifecycle_integration(ray_start_regular):
     assert store.contains(oid)
     del ref
     import gc
+    import time
 
     gc.collect()
+    # deletion is deferred to the refcount drainer thread; the store delete
+    # fires after the refcount entry drops, so wait on both
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (worker.ref_counter.has_reference(oid) or store.contains(oid)):
+        time.sleep(0.05)
     assert not worker.ref_counter.has_reference(oid)
     assert not store.contains(oid)

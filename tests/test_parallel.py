@@ -1,0 +1,205 @@
+"""Parallelism layer tests on the virtual 8-device CPU mesh.
+
+Covers mesh construction, SPMD collectives, actor collective groups, ring /
+Ulysses attention numerics vs dense reference, the GPipe pipeline, and the
+Pallas flash-attention kernel (interpret mode on CPU).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import (
+    MeshManager,
+    collective,
+    pipeline_sharded,
+    ring_attention_sharded,
+    shard_array,
+    ulysses_attention_sharded,
+)
+from ray_tpu.ops.attention import flash_attention, mha
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return MeshManager().create_mesh({"dp": 8})
+
+
+@pytest.fixture(scope="module")
+def mesh_sp():
+    return MeshManager().create_mesh({"sp": 8})
+
+
+def test_mesh_construction_and_inference():
+    mm = MeshManager()
+    mesh = mm.create_mesh({"dp": 2, "tp": -1}, name="train")
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    assert mm.get_mesh("train") is mesh
+    with pytest.raises(ValueError):
+        mm.create_mesh({"dp": 3})
+
+
+def test_canonical_axis_order():
+    mm = MeshManager()
+    mesh = mm.create_mesh({"tp": 2, "dp": 2, "sp": 2})
+    assert mesh.axis_names == ("dp", "sp", "tp")
+
+
+def test_spmd_allreduce_allgather(mesh8):
+    x = jnp.arange(8.0)
+    xs = shard_array(x, mesh8, "dp")
+
+    def f(shard):
+        return collective.allreduce(shard.sum(), "dp")
+
+    total = shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=P())(xs)
+    assert float(total) == 28.0
+
+    def g(shard):
+        return collective.allgather(shard, "dp")
+
+    gathered = shard_map(g, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))(xs)
+    assert gathered.shape == (64,)
+
+
+def test_spmd_reducescatter_broadcast(mesh8):
+    x = jnp.ones((8, 4))
+    xs = shard_array(x, mesh8, "dp")
+
+    def rs(shard):
+        return collective.reducescatter(jnp.broadcast_to(shard, (8, 4)), "dp")
+
+    out = shard_map(rs, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))(xs)
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+    def bc(shard):
+        return collective.broadcast(shard, "dp", root=3)
+
+    x2 = shard_array(jnp.arange(8.0), mesh8, "dp")
+    out2 = shard_map(bc, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))(x2)
+    np.testing.assert_allclose(np.asarray(out2), 3.0)
+
+
+def test_send_recv_ring(mesh8):
+    x = shard_array(jnp.arange(8.0), mesh8, "dp")
+
+    def shift(shard):
+        return collective.send_recv(shard, "dp", shift=1)
+
+    out = shard_map(shift, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_actor_collective_group():
+    collective.init_collective_group(world_size=4, rank=0, group_name="g1")
+    results = {}
+
+    def participant(rank):
+        collective.init_collective_group(4, rank, group_name="g1")
+        out = collective.allreduce_tensor(np.full((4,), float(rank + 1)), rank, "g1")
+        results[rank] = np.asarray(out)
+
+    threads = [threading.Thread(target=participant, args=(r,)) for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for r in range(4):
+        np.testing.assert_allclose(results[r], 10.0)  # 1+2+3+4
+    collective.destroy_collective_group("g1")
+
+
+def test_actor_collective_broadcast_and_gather():
+    name = "g2"
+    results = {}
+
+    def participant(rank):
+        collective.init_collective_group(3, rank, group_name=name)
+        results[rank] = (
+            collective.broadcast_tensor(rank * 10, rank, src_rank=1, group_name=name),
+            collective.allgather_tensor(rank, rank, group_name=name),
+        )
+
+    threads = [threading.Thread(target=participant, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for r in range(3):
+        assert results[r][0] == 10
+        assert results[r][1] == [0, 1, 2]
+    collective.destroy_collective_group(name)
+
+
+# ------------------------------------------------------------------ attention
+def _qkv(B=2, H=8, T=128, D=32, dtype=jnp.float32):
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), dtype) for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(mesh_sp, causal):
+    q, k, v = _qkv()
+    ref = mha(q, k, v, causal=causal)
+    spec = (None, None, "sp", None)
+    qs, ks, vs = (shard_array(x, mesh_sp, *spec) for x in (q, k, v))
+    out = ring_attention_sharded(qs, ks, vs, mesh_sp, "sp", causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_matches_dense(mesh_sp):
+    q, k, v = _qkv()
+    ref = mha(q, k, v, causal=True)
+    spec = (None, None, "sp", None)
+    qs, ks, vs = (shard_array(x, mesh_sp, *spec) for x in (q, k, v))
+    out = ulysses_attention_sharded(qs, ks, vs, mesh_sp, "sp", causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_dense(causal):
+    q, k, v = _qkv(T=256, D=64)
+    ref = mha(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, None, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_grads():
+    q, k, v = _qkv(T=128, D=32)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, None, True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (mha(q, k, v, causal=True) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+# ------------------------------------------------------------------ pipeline
+def test_pipeline_matches_sequential(mesh8):
+    mm = MeshManager()
+    mesh = mm.create_mesh({"pp": 4}, devices=mesh8.devices.flatten()[:4])
+    S, M, Bm, F = 4, 8, 4, 16
+    keys = jax.random.split(jax.random.PRNGKey(0), S)
+    ws = jnp.stack([jax.random.normal(k, (F, F)) * 0.3 for k in keys])
+    xs = jax.random.normal(jax.random.PRNGKey(1), (M, Bm, F))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    out = pipeline_sharded(stage_fn, ws, xs, mesh, "pp")
+
+    expected = xs
+    for s in range(S):
+        expected = jnp.tanh(expected @ ws[s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
