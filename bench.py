@@ -1,0 +1,50 @@
+"""Headline benchmark — single-client sync task throughput.
+
+Mirrors the reference's ``single_client_tasks_sync`` microbenchmark
+(``python/ray/_private/ray_perf.py:93``; published 971.3 ± 32.7 tasks/s on a
+64-CPU node, ``release/release_logs/2.22.0/microbenchmark.json``). Prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TASKS_PER_S = 971.3
+
+
+def main() -> None:
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+
+    @rt.remote
+    def noop():
+        return None
+
+    for _ in range(100):
+        rt.get(noop.remote())
+
+    n = 3000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rt.get(noop.remote())
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+
+    value = n / dt
+    print(
+        json.dumps(
+            {
+                "metric": "single_client_tasks_sync",
+                "value": round(value, 1),
+                "unit": "tasks/s",
+                "vs_baseline": round(value / BASELINE_TASKS_PER_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -154,7 +154,8 @@ class TaskID(BaseID):
 
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":
-        # 0xFE prefix keeps clear of the task counter for ~4.2B submissions
+        # 0xFE-filled prefix: the 8-byte little-endian counter reaches it
+        # only after ~1.8e19 submissions
         return cls(b"\xfe" * _TASK_UNIQUE_SIZE + ActorID.nil().binary()[: _ACTOR_UNIQUE_SIZE] + job_id.binary())
 
     def actor_id(self) -> ActorID:

@@ -1,0 +1,32 @@
+"""Flagship model zoo — TPU-first JAX models used by bench/train/serve.
+
+The reference ships no models in core (RLlib has nets; Train wraps user
+models). Here the model layer is first-class because the framework's hot
+path lowers array-typed tasks to XLA: the flagship decoder-only transformer
+exercises every parallelism axis the framework offers (dp/tp/sp/ep via GSPMD
+shardings, pp via ``ray_tpu.parallel.pipeline``).
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_apply
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "param_specs",
+    "shard_params",
+    "MLPConfig",
+    "mlp_init",
+    "mlp_apply",
+]

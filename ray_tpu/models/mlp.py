@@ -1,0 +1,40 @@
+"""Small MLP classifier — the "hello world" model for examples and tests
+(the SURVEY §7 phase-1 milestone model)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: int = 256
+    depth: int = 2
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(cfg: MLPConfig, key: jax.Array) -> List[dict]:
+    dims = [cfg.in_dim] + [cfg.hidden] * cfg.depth + [cfg.out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    return [
+        {
+            "w": (jax.random.normal(k, (a, b), cfg.dtype) / math.sqrt(a)),
+            "b": jnp.zeros((b,), cfg.dtype),
+        }
+        for k, a, b in zip(keys, dims[:-1], dims[1:])
+    ]
+
+
+def mlp_apply(params: List[dict], x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x

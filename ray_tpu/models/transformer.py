@@ -1,0 +1,295 @@
+"""Decoder-only transformer (GPT/Llama-style), TPU-first.
+
+Design notes (not a port — the reference has no model core; RLlib's torch
+nets are the closest analog, ``rllib/core/rl_module/rl_module.py``):
+
+- Pure-pytree params + functional ``forward`` so the whole train step jits
+  to ONE XLA program; sharding is declared with ``PartitionSpec`` and GSPMD
+  propagates collectives (psum over ``tp``, all-gather over ``sp`` for KV).
+- bfloat16 activations, float32 params/optimizer — the MXU-native recipe.
+- RMSNorm + RoPE + SwiGLU; optional top-2 MoE FFN whose expert dimension
+  shards over the ``ep`` mesh axis (expert parallelism).
+- Attention: Pallas flash kernel (``ray_tpu.ops.attention``) on single-chip
+  or dp-only shardings; XLA einsum attention under tp/sp meshes (GSPMD can
+  partition einsums but not custom kernels — ring attention for the optimal
+  sp path lives in ``ray_tpu.parallel.ring``).
+
+Mesh axes: ``dp`` (batch), ``sp`` (sequence), ``tp`` (hidden/heads),
+``ep`` (experts; may be folded into ``dp`` on small meshes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import flash_attention, mha
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    num_experts: int = 0          # 0 => dense FFN
+    expert_top_k: int = 2
+    dtype: Any = jnp.bfloat16     # activation dtype
+    param_dtype: Any = jnp.float32
+    attention: str = "auto"       # auto | flash | dense
+    remat: bool = False           # jax.checkpoint each layer
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    pd = cfg.param_dtype
+    d, h, dh, ff = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+
+    def dense_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+
+    def one_layer(k):
+        ks = jax.random.split(k, 8)
+        layer = {
+            "attn_norm": jnp.ones((d,), pd),
+            "wq": dense_init(ks[0], (d, h, dh), d),
+            "wk": dense_init(ks[1], (d, h, dh), d),
+            "wv": dense_init(ks[2], (d, h, dh), d),
+            "wo": dense_init(ks[3], (h, dh, d), d),
+            "ffn_norm": jnp.ones((d,), pd),
+        }
+        if cfg.num_experts > 0:
+            e = cfg.num_experts
+            layer["router"] = dense_init(ks[7], (d, e), d)
+            layer["we1"] = dense_init(ks[4], (e, d, ff), d)
+            layer["we3"] = dense_init(ks[5], (e, d, ff), d)
+            layer["we2"] = dense_init(ks[6], (e, ff, d), ff)
+        else:
+            layer["w1"] = dense_init(ks[4], (d, ff), d)
+            layer["w3"] = dense_init(ks[5], (d, ff), d)
+            layer["w2"] = dense_init(ks[6], (ff, d), ff)
+        return layer
+
+    # stacked layers: leaves get a leading [n_layers] dim, scanned in forward.
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[one_layer(k) for k in layer_keys])
+    return {
+        "embed": dense_init(k_embed, (cfg.vocab_size, d), d) * math.sqrt(d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), pd),
+    }
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+def param_specs(cfg: TransformerConfig, *, dp: str = "dp", tp: str = "tp", ep: Optional[str] = None) -> Dict[str, Any]:
+    """Megatron-style TP layout as PartitionSpecs (leading axis of stacked
+    layer leaves is the layer dim, unsharded)."""
+    ep = ep or dp
+    layer_specs = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, tp, None),
+        "wk": P(None, None, tp, None),
+        "wv": P(None, None, tp, None),
+        "wo": P(None, tp, None, None),
+        "ffn_norm": P(None, None),
+    }
+    if cfg.num_experts > 0:
+        layer_specs.update(
+            router=P(None, None, None),
+            we1=P(None, ep, None, tp),
+            we3=P(None, ep, None, tp),
+            we2=P(None, ep, tp, None),
+        )
+    else:
+        layer_specs.update(w1=P(None, None, tp), w3=P(None, None, tp), w2=P(None, tp, None))
+    return {"embed": P(tp, None), "layers": layer_specs, "final_norm": P(None)}
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig, **axes):
+    specs = param_specs(cfg, **axes)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    # x: [B, T, H, Dh]
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32) * (math.log(theta) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,T,half]
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(cfg: TransformerConfig, q, k, v, use_flash: bool):
+    # q,k,v: [B, T, H, Dh] -> [B, H, T, Dh]
+    qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+    if use_flash:
+        o = flash_attention(qt, kt, vt, None, True)
+    else:
+        o = mha(qt, kt, vt, causal=True)
+    return jnp.transpose(o, (0, 2, 1, 3))
+
+
+def _moe_ffn(cfg: TransformerConfig, layer, x):
+    """Top-k MoE, dense-dispatch formulation: every expert computes every
+    token and the router mask selects — einsums partition cleanly over
+    ``ep``×``tp`` (a ragged all-to-all dispatch is the next optimization)."""
+    e, k = cfg.num_experts, cfg.expert_top_k
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), layer["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(gates, k)
+    mask = jnp.sum(jax.nn.one_hot(topi, e, dtype=gates.dtype) * topv[..., None], axis=-2)  # [B,T,E]
+    mask = (mask / (jnp.sum(mask, -1, keepdims=True) + 1e-9)).astype(x.dtype)
+    h = jnp.einsum("btd,edf->betf", x, layer["we1"].astype(x.dtype))
+    g = jnp.einsum("btd,edf->betf", x, layer["we3"].astype(x.dtype))
+    h = jax.nn.silu(g) * h
+    out = jnp.einsum("betf,efd->betd", h, layer["we2"].astype(x.dtype))
+    return jnp.einsum("betd,bte->btd", out, mask)
+
+
+def _dense_ffn(layer, x):
+    h = jax.nn.silu(x @ layer["w3"].astype(x.dtype)) * (x @ layer["w1"].astype(x.dtype))
+    return h @ layer["w2"].astype(x.dtype)
+
+
+def forward(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, T] int32
+    *,
+    act_spec: Optional[P] = None,
+) -> jax.Array:
+    """Returns logits [B, T, V]."""
+    use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and jax.default_backend() == "tpu" and act_spec is None)
+    B, T = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+    def layer_fn(x, layer):
+        h = _rms_norm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+        q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+        o = _attention(cfg, q, k, v, use_flash)
+        x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
+        h = _rms_norm(x, layer["ffn_norm"])
+        ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)
+        x = x + ffn
+        if act_spec is not None:
+            x = jax.lax.with_sharding_constraint(x, act_spec)
+        return x, None
+
+    step = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+    x, _ = jax.lax.scan(step, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: TransformerConfig, params, tokens, *, act_spec=None) -> jax.Array:
+    """Next-token cross entropy over tokens[:, :-1] -> tokens[:, 1:]."""
+    logits = forward(cfg, params, tokens[:, :-1], act_spec=act_spec)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# train step
+# ---------------------------------------------------------------------------
+def make_train_step(
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 3e-4,
+    dp: str = "dp",
+    sp: Optional[str] = "sp",
+    tp: str = "tp",
+    ep: Optional[str] = None,
+):
+    """Build (init_state, train_step). Jitted to one XLA program; with a mesh,
+    params/opt shard per ``param_specs`` and batch shards over (dp, sp)."""
+    import optax
+
+    opt = optax.adamw(learning_rate)
+
+    def init_state(key):
+        params = init_params(cfg, key)
+        return {"params": params, "opt": opt.init(params), "step": jnp.zeros((), jnp.int32)}
+
+    act_spec = None
+    if mesh is not None:
+        axis_names = set(mesh.axis_names)
+        sp_ax = sp if (sp and sp in axis_names) else None
+        act_spec = P(dp if dp in axis_names else None, sp_ax, None)
+
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, tokens, act_spec=act_spec))(state["params"])
+        updates, new_opt = opt.update(grads, state["opt"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    if mesh is None:
+        return init_state, jax.jit(train_step, donate_argnums=(0,))
+
+    pspecs = param_specs(cfg, dp=dp, tp=tp, ep=ep)
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P))
+
+    def sharded_init(key):
+        # params placed per the TP layout; the (eagerly-run) optax init then
+        # inherits each leaf's sharding through zeros_like, so opt state is
+        # laid out identically with no explicit spec tree.
+        params = jax.tree.map(lambda x, s: jax.device_put(x, s), init_params(cfg, key), param_sh)
+        return {"params": params, "opt": opt.init(params), "step": jnp.zeros((), jnp.int32)}
+
+    def shard_batch(tokens):
+        return jax.device_put(tokens, NamedSharding(mesh, P(dp, None)))
+
+    class _TrainStep:
+        """Callable train step carrying its batch-placement helper (jit
+        wrappers don't accept attribute assignment)."""
+
+        def __init__(self, fn):
+            self._fn = fn
+            self.shard_batch = shard_batch
+
+        def __call__(self, state, tokens):
+            return self._fn(state, tokens)
+
+    return sharded_init, _TrainStep(jax.jit(train_step, donate_argnums=(0,)))

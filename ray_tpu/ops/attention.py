@@ -4,10 +4,14 @@ The hot op of the model stack (SURVEY §7 phase 4): blockwise online-softmax
 attention that keeps the [Tq, Tk] score matrix out of HBM — scores live in
 VMEM one (block_q x block_k) tile at a time, feeding the MXU per tile.
 
-Forward is the Pallas kernel; backward recomputes attention under
-``jax.custom_vjp`` (rematerialization trades FLOPs for HBM, the standard TPU
-tradeoff).  On non-TPU backends the kernel runs in interpret mode so tests
-exercise identical code paths on the virtual CPU mesh.
+Forward is the Pallas kernel; backward differentiates the dense reference
+formulation under ``jax.custom_vjp``, so backward memory is O(Tq*Tk) per
+head — fine for the seq lengths the framework trains today, while long-
+sequence training routes through ``ray_tpu.parallel.ring`` (blockwise ring
+attention keeps both directions linear in the local shard). A blockwise
+Pallas backward is the planned upgrade. On non-TPU backends the kernel runs
+in interpret mode so tests exercise identical code paths on the virtual CPU
+mesh.
 """
 
 from __future__ import annotations
@@ -23,11 +27,12 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int, kv_len: int):
     """One q-block vs. the full K/V, blockwise over K.
 
-    q_ref: [block_q, D]; k_ref, v_ref: [Tk, D]; o_ref: [block_q, D].
-    Grid: (batch*heads, num_q_blocks).
+    q_ref: [block_q, D]; k_ref, v_ref: [Tk_padded, D]; o_ref: [block_q, D].
+    Grid: (batch*heads, num_q_blocks). kv_len is the unpadded key count —
+    keys at positions >= kv_len are padding and masked out.
     """
     block_q, d = q_ref.shape
     t_k = k_ref.shape[0]
@@ -35,6 +40,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, b
     q = q_ref[:].astype(jnp.float32) * sm_scale
 
     num_k_blocks = t_k // block_k
+    padded = kv_len < t_k
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
@@ -43,16 +49,22 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, b
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        valid = None
         if causal:
             q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            valid = k_pos <= q_pos
+        if padded:
+            in_range = k_pos < kv_len
+            valid = in_range if valid is None else jnp.logical_and(valid, in_range)
+        if valid is not None:
+            s = jnp.where(valid, s, NEG_INF)
         m_blk = s.max(axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)
         alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
         p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         l_new = l_prev * alpha + p.sum(axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -73,29 +85,45 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, b
     o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
 
 
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
 def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int, interpret: bool):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, Tq)
     bk = min(block_k, Tk)
-    qf = q.reshape(B * H, Tq, D)
-    kf = k.reshape(B * H, Tk, D)
-    vf = v.reshape(B * H, Tk, D)
+    # pad ragged tails to block multiples: padded q rows are computed then
+    # sliced off; padded keys are masked in-kernel via kv_len.
+    q = _pad_to(q, 2, bq)
+    k = _pad_to(k, 2, bk)
+    v = _pad_to(v, 2, bk)
+    Tq_p, Tk_p = q.shape[2], k.shape[2]
+    qf = q.reshape(B * H, Tq_p, D)
+    kf = k.reshape(B * H, Tk_p, D)
+    vf = v.reshape(B * H, Tk_p, D)
 
-    grid = (B * H, Tq // bq)
+    grid = (B * H, Tq_p // bq)
     out = pl.pallas_call(
-        functools.partial(_attn_kernel, sm_scale=sm_scale, causal=causal, block_k=bk),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        functools.partial(_attn_kernel, sm_scale=sm_scale, causal=causal, block_k=bk, kv_len=Tk),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, Tk_p, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, Tk_p, D), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, D), lambda bh, i: (bh, i, 0)),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D)
+    return out.reshape(B, H, Tq_p, D)[:, :, :Tq, :]
 
 
 def _reference_attention(q, k, v, sm_scale: float, causal: bool):

@@ -139,7 +139,12 @@ def init_collective_group(world_size: int, rank: int, backend: str = "tpu", grou
     with its rank before using group collectives."""
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
-    _registry.get_or_create(group_name, world_size)
+    group = _registry.get_or_create(group_name, world_size)
+    if group.world_size != world_size:
+        raise ValueError(
+            f"collective group {group_name!r} already exists with world_size "
+            f"{group.world_size}, got {world_size}; destroy it first"
+        )
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

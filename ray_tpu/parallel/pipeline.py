@@ -22,6 +22,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ray_tpu.parallel.ring import _to_varying
+
 
 def pipeline_apply(
     stage_fn: Callable,
@@ -64,21 +66,11 @@ def pipeline_apply(
 
     zeros = jnp.zeros(x_shape, microbatches.dtype)
     outputs0 = jnp.zeros((M,) + x_shape, microbatches.dtype)
-    recv0, outputs0 = (_vary(x, axis_name) for x in (zeros, outputs0))
+    recv0, outputs0 = (_to_varying(x, axis_name) for x in (zeros, outputs0))
     (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(M + n - 1))
     # only the last stage holds real outputs; broadcast to all stages
     outputs = jnp.where(stage == n - 1, outputs, 0.0)
     return lax.psum(outputs, axis_name)
-
-
-def _vary(x, axis_name):
-    pcast = getattr(lax, "pcast", None)
-    if pcast is None:
-        return x
-    try:
-        return pcast(x, (axis_name,), to="varying")
-    except TypeError:
-        return pcast(x, (axis_name,))
 
 
 def pipeline_sharded(

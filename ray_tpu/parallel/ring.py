@@ -131,8 +131,10 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True, sm_scale:
     def swap_to_seq(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
+    from ray_tpu.ops.attention import mha
+
     qh, kh, vh = swap_to_heads(q), swap_to_heads(k), swap_to_heads(v)
-    out = _dense_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    out = mha(qh, kh, vh, causal=causal, sm_scale=sm_scale)
     return swap_to_seq(out)
 
 
@@ -140,15 +142,3 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp", *, cau
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
-
-
-def _dense_attention(q, k, v, *, causal: bool, sm_scale: Optional[float]):
-    D = q.shape[-1]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if causal:
-        Tq, Tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,109 @@
+"""Model-layer tests: forward shapes, training convergence, sharded train
+step over the virtual 8-device mesh (dp/sp/tp + ep), pipeline dryrun."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (
+    MLPConfig,
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    mlp_apply,
+    mlp_init,
+)
+
+TINY = TransformerConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64, attention="dense")
+
+
+def test_forward_shape():
+    params = init_params(TINY, jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = forward(TINY, params, tokens)
+    assert logits.shape == (2, 16, 128)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_loss_decreases():
+    init_state, step = make_train_step(TINY, learning_rate=1e-2)
+    state = init_state(jax.random.key(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 128, (4, 17)), jnp.int32)
+    first = None
+    for _ in range(10):
+        state, loss = step(state, tokens)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+
+def test_moe_forward():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        num_experts=4, expert_top_k=2, attention="dense",
+    )
+    params = init_params(cfg, jax.random.key(1))
+    logits = forward(cfg, params, jnp.zeros((2, 8), jnp.int32))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_train_step():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        num_experts=4, attention="dense",
+    )
+    with mesh:
+        init_state, step = make_train_step(cfg, mesh=mesh, ep="dp")
+        state = init_state(jax.random.key(0))
+        tokens = step.shard_batch(
+            jnp.asarray(np.random.default_rng(0).integers(0, 128, (4, 16)), jnp.int32)
+        )
+        state, loss = step(state, tokens)
+        assert np.isfinite(float(loss))
+        # param shardings actually landed on the tp axis
+        wq = state["params"]["layers"]["wq"]
+        assert "tp" in str(wq.sharding.spec)
+
+
+def test_sharded_matches_single_device():
+    """Same seed/batch: the sharded loss must equal the unsharded loss."""
+    from jax.sharding import Mesh
+
+    cfg = TINY
+    tokens = jnp.asarray(np.random.default_rng(2).integers(0, 128, (4, 16)), jnp.int32)
+    params = init_params(cfg, jax.random.key(3))
+    ref = float(loss_fn(cfg, params, tokens))
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
+    with mesh:
+        from ray_tpu.models.transformer import shard_params
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sp = shard_params(params, mesh, cfg)
+        toks = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+        got = float(jax.jit(lambda p, t: loss_fn(cfg, p, t))(sp, toks))
+    # bf16 matmuls: collective reduction order differs across shardings
+    assert abs(got - ref) / abs(ref) < 1e-3
+
+
+def test_mlp():
+    cfg = MLPConfig(in_dim=8, hidden=16, depth=2, out_dim=4)
+    params = mlp_init(cfg, jax.random.key(0))
+    out = mlp_apply(params, jnp.ones((3, 8)))
+    assert out.shape == (3, 4)
+
+
+def test_graft_entry_hooks():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 2048
+    g.dryrun_multichip(8)

@@ -170,6 +170,16 @@ def test_flash_attention_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("T", [100, 192, 200])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_ragged_lengths(T, causal):
+    """Sequence lengths that are not block multiples (tail-block regression)."""
+    q, k, v = _qkv(T=T, D=32)
+    ref = mha(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, None, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_attention_grads():
     q, k, v = _qkv(T=128, D=32)
 
