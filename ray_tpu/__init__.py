@@ -32,6 +32,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu import dag
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -54,6 +55,7 @@ __all__ = [
     "available_resources",
     "cancel",
     "cluster_resources",
+    "dag",
     "get",
     "get_actor",
     "get_cluster",

@@ -263,6 +263,12 @@ class RemoteFunction:
         merged = {**self._options, **new_options}
         return RemoteFunction(self._function, merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: dag_node.py bind)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{self._function.__name__}' cannot be called directly; "
@@ -294,6 +300,12 @@ class ActorMethod:
 
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: dag_node.py bind)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
 
 
 class ActorHandle:

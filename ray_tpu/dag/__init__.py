@@ -1,0 +1,34 @@
+"""DAG API: lazy task graphs compiled to fast repeat-execution programs.
+
+Reference parity: ``python/ray/dag/`` (``dag_node.py``, ``input_node.py``,
+``compiled_dag_node.py:278``) — ``f.bind()`` builds the graph lazily,
+``experimental_compile`` pre-resolves everything so repeated executions skip
+the per-call scheduling path. The TPU-native twist (SURVEY §7 phase 5):
+"trace once, execute many" is primary — a DAG of jax-pure nodes fuses into
+ONE jitted XLA program, so the per-node dispatch cost disappears entirely
+instead of being replaced by channel writes.
+"""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled import CompiledDAG
+from ray_tpu.dag.channel import Channel, ChannelClosed, DeviceChannel
+
+__all__ = [
+    "DAGNode",
+    "FunctionNode",
+    "ClassMethodNode",
+    "InputNode",
+    "InputAttributeNode",
+    "MultiOutputNode",
+    "CompiledDAG",
+    "Channel",
+    "ChannelClosed",
+    "DeviceChannel",
+]

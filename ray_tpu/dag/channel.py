@@ -1,0 +1,77 @@
+"""Channels: pre-allocated single-slot buffers for compiled-DAG transport.
+
+Reference parity: ``python/ray/experimental/channel/shared_memory_channel.py``
+(mutable plasma channels) and ``torch_tensor_nccl_channel.py`` (NCCL tensor
+channels). Here the host channel is a condition-variable slot (same-process
+runtime — no shared memory needed for the driver-side schedule), and the
+device channel pins a ``jax.Array`` in HBM: handing an array between stages
+is a reference move, and cross-device placement is an ICI copy via
+``jax.device_put`` — the plasma/NCCL split collapses into one type.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Single-slot rendezvous buffer: write blocks while full, read blocks
+    while empty (the mutable-plasma-channel protocol)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._full = False
+        self._value: Any = None
+        self._closed = False
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        with self._cond:
+            if not self._cond.wait_for(lambda: not self._full or self._closed, timeout):
+                raise TimeoutError("channel write timed out")
+            if self._closed:
+                raise ChannelClosed()
+            self._value = value
+            self._full = True
+            self._cond.notify_all()
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._full or self._closed, timeout):
+                raise TimeoutError("channel read timed out")
+            if self._closed and not self._full:
+                raise ChannelClosed()
+            value = self._value
+            self._value = None
+            self._full = False
+            self._cond.notify_all()
+            return value
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class DeviceChannel(Channel):
+    """Channel whose payloads are jax.Arrays pinned to a device.
+
+    Writing moves the array to the channel's device (ICI copy when source
+    and target differ; no-op when already resident) without a host round
+    trip — the NCCL-channel equivalent on the TPU fabric.
+    """
+
+    def __init__(self, device=None):
+        super().__init__()
+        self._device = device
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        if self._device is not None:
+            import jax
+
+            value = jax.device_put(value, self._device)
+        super().write(value, timeout)

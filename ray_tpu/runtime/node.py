@@ -298,6 +298,16 @@ class Node:
             kind, spec = inst.call_queue.get()
             if kind == "__stop__":
                 return
+            if kind == "__direct__":
+                # compiled-DAG fast path: (method, args, kwargs, future) with
+                # no TaskSpec — still serialized through this thread so the
+                # single-threaded actor guarantee holds (dag/compiled.py)
+                method, args, kwargs, fut = spec
+                try:
+                    fut.set_result(getattr(inst.instance, method)(*args, **kwargs))
+                except BaseException as exc:  # noqa: BLE001
+                    fut.set_exception(exc)
+                continue
             try:
                 args, kwargs = self._resolve_args(spec)
                 token = task_context.push(spec.task_id, self.node_id)

@@ -1,0 +1,210 @@
+"""Compiled-DAG tests: interpreted execution, XLA fusion, direct schedule
+with actors, auto fallback, channels."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.dag import Channel, ChannelClosed, DeviceChannel, InputNode, MultiOutputNode
+
+
+def test_interpreted_dag(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def plus(x, y):
+        return x + y
+
+    @rt.remote
+    def times(x, k):
+        return x * k
+
+    with InputNode() as inp:
+        d = times.bind(plus.bind(inp, 10), 2)
+    ref = d.execute(5)
+    assert rt.get(ref) == 30
+
+
+def test_interpreted_multi_output(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def square(x):
+        return x * x
+
+    with InputNode() as inp:
+        d = MultiOutputNode([double.bind(inp), square.bind(inp)])
+    refs = d.execute(3)
+    assert rt.get(refs) == [6, 9]
+
+
+def test_compiled_jit_fusion(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def matmul(x, w):
+        return x @ w
+
+    @rt.remote
+    def act(x):
+        return jax.nn.relu(x)
+
+    with InputNode() as inp:
+        d = act.bind(matmul.bind(inp.x, inp.w))
+    compiled = d.experimental_compile()
+    assert compiled.mode == "jit"
+    x = jnp.ones((4, 8))
+    w = jnp.full((8, 2), -1.0)
+    out = compiled.execute(x=x, w=w)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros((4, 2)))
+    # repeat executions hit the jit cache
+    out2 = compiled.execute(x=x, w=w)
+    assert out2.shape == (4, 2)
+
+
+def test_compiled_auto_fallback(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def shout(s):
+        return s.upper()  # not jax-traceable
+
+    with InputNode() as inp:
+        d = shout.bind(inp)
+    compiled = d.experimental_compile()
+    assert compiled.execute("hi") == "HI"
+    assert compiled.mode == "direct"
+
+
+def test_compiled_actor_direct(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+    counter = Counter.options(execution="inproc").remote()
+    rt.get(counter.add.remote(0))  # wait alive
+
+    with InputNode() as inp:
+        d = counter.add.bind(inp)
+    compiled = d.experimental_compile(fuse="none")
+    assert compiled.mode == "direct"
+    assert compiled.execute(5) == 5
+    assert compiled.execute(7) == 12
+    # repeated executes are much faster than the task path: just check they run
+    t0 = time.perf_counter()
+    for _ in range(100):
+        compiled.execute(1)
+    assert time.perf_counter() - t0 < 1.0
+    assert compiled.execute(0) == 112
+    compiled.teardown()
+
+
+def test_compiled_actor_serializes_with_remote_calls(ray_start_regular):
+    """Direct DAG calls must not race queued .remote() calls (both ride the
+    actor's call queue)."""
+    rt = ray_start_regular
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            n = self.n
+            time.sleep(0)  # widen the read-modify-write window
+            self.n = n + x
+            return self.n
+
+        def total(self):
+            return self.n
+
+    counter = Counter.options(execution="inproc").remote()
+    rt.get(counter.total.remote())
+
+    with InputNode() as inp:
+        d = counter.add.bind(inp)
+    compiled = d.experimental_compile(fuse="none")
+
+    import threading
+
+    refs = []
+
+    def via_remote():
+        for _ in range(200):
+            refs.append(counter.add.remote(1))
+
+    t = threading.Thread(target=via_remote)
+    t.start()
+    for _ in range(200):
+        compiled.execute(1)
+    t.join()
+    rt.get(refs)
+    assert rt.get(counter.total.remote()) == 400
+    compiled.teardown()
+
+
+def test_compiled_fuse_jit_rejects_actors(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    class A:
+        def f(self, x):
+            return x
+
+    a = A.options(execution="inproc").remote()
+    rt.get(a.f.remote(0))
+    with InputNode() as inp:
+        d = a.f.bind(inp)
+    with pytest.raises(ValueError, match="jit"):
+        d.experimental_compile(fuse="jit")
+
+
+def test_execute_async(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        d = inc.bind(inp)
+    compiled = d.experimental_compile(fuse="none")
+    futs = [compiled.execute_async(i) for i in range(10)]
+    assert [f.result() for f in futs] == list(range(1, 11))
+    compiled.teardown()
+
+
+def test_channel_roundtrip():
+    ch = Channel()
+    import threading
+
+    out = []
+    t = threading.Thread(target=lambda: out.append(ch.read()))
+    t.start()
+    ch.write(42)
+    t.join(2)
+    assert out == [42]
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        ch.read()
+
+
+def test_device_channel():
+    ch = DeviceChannel(jax.devices()[0])
+    ch.write(jnp.arange(4))
+    got = ch.read()
+    assert list(np.asarray(got)) == [0, 1, 2, 3]
