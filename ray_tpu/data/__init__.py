@@ -1,0 +1,62 @@
+"""ray_tpu.data: lazy streaming distributed datasets.
+
+TPU-native rebuild of the reference's Ray Data (``python/ray/data/``,
+SURVEY §2.4): columnar-numpy blocks, a logical plan with fusion rules, a
+streaming executor with backpressure over the task fabric, two-stage
+push-style shuffles, and an ``iter_jax_batches`` consumption path that
+stages batches straight into HBM (optionally sharded over a mesh).
+"""
+
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum, Unique
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+
+__all__ = [
+    "AggregateFn",
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "Count",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "MaterializedDataset",
+    "Max",
+    "Mean",
+    "Min",
+    "ReadTask",
+    "Std",
+    "Sum",
+    "Unique",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

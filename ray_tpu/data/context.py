@@ -1,0 +1,33 @@
+"""DataContext: per-process execution knobs.
+
+Parity: ``python/ray/data/context.py`` (``DataContext.get_current()``,
+``target_max_block_size``, shuffle strategy toggle :241, etc.).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    read_parallelism: int = 8
+    max_tasks_in_flight: int = 16
+    max_outqueue_bundles: int = 32
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    use_push_based_shuffle: bool = True
+    enable_progress_bars: bool = False
+    shuffle_seed: Optional[int] = None
+
+    _local = threading.local()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(DataContext._local, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            DataContext._local.ctx = ctx
+        return ctx

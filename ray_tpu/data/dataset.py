@@ -1,0 +1,442 @@
+"""Dataset: the lazy distributed dataset API.
+
+Parity: ``python/ray/data/dataset.py`` (the 5.2k-LoC public class) — lazy
+logical-plan accumulation, streaming execution on consumption, the full
+transform surface (map/map_batches/filter/flat_map/sort/groupby/
+repartition/random_shuffle/union/zip/limit), consumption
+(take/count/show/iter_*), split/streaming_split, and write connectors.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum, Unique
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, concat_blocks
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.executor import RefBundle, StreamingExecutor, plan
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, logical_op: L.LogicalOp):
+        self._logical_op = logical_op
+        self._last_stats = None
+
+    # ------------------------------------------------------------ plumbing
+    def _with(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(op)
+
+    def _execute(self) -> Iterator[RefBundle]:
+        ctx = DataContext.get_current()
+        optimized = L.optimize(_clone_plan(self._logical_op))
+        root = plan(optimized, ctx)
+        executor = StreamingExecutor(root, ctx)
+        try:
+            yield from executor.run()
+        finally:
+            self._last_stats = executor.stats()
+
+    def _collect_bundles(self) -> List[RefBundle]:
+        return list(self._execute())
+
+    # ---------------------------------------------------------- transforms
+    def map(self, fn: Callable, *, num_cpus: float = 1, num_tpus: float = 0, concurrency=None, **kw) -> "Dataset":
+        return self._with(
+            L.AbstractMap(self._logical_op, "map_rows", fn, num_cpus=num_cpus, num_tpus=num_tpus, concurrency=concurrency)
+        )
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute=None,
+        fn_args: tuple = (),
+        fn_kwargs: Optional[dict] = None,
+        fn_constructor_args: tuple = (),
+        num_cpus: float = 1,
+        num_tpus: float = 0,
+        concurrency=None,
+        **kw,
+    ) -> "Dataset":
+        return self._with(
+            L.AbstractMap(
+                self._logical_op,
+                "map_batches",
+                fn,
+                fn_args=fn_args,
+                fn_kwargs=fn_kwargs,
+                batch_size=batch_size,
+                batch_format=batch_format,
+                compute=compute,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                concurrency=concurrency,
+                fn_constructor_args=fn_constructor_args,
+            )
+        )
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with(L.AbstractMap(self._logical_op, "filter", fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with(L.AbstractMap(self._logical_op, "flat_map", fn))
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], np.ndarray]) -> "Dataset":
+        def add(batch):
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        add.__name__ = f"add_column[{name}]"
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        drop.__name__ = f"drop_columns[{cols}]"
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        select.__name__ = f"select_columns[{cols}]"
+        return self.map_batches(select)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        rename.__name__ = "rename_columns"
+        return self.map_batches(rename)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(self._logical_op, n))
+
+    def repartition(self, num_blocks: int, *, shuffle: bool = False) -> "Dataset":
+        return self._with(L.Repartition(self._logical_op, num_blocks, shuffle))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomShuffle(self._logical_op, seed))
+
+    def sort(self, key: Union[str, List[str]], descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(self._logical_op, key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(L.Union([self._logical_op] + [o._logical_op for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(L.Zip(self._logical_op, other._logical_op))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # --------------------------------------------------------- consumption
+    def take(self, limit: int = 20) -> List[Dict[str, Any]]:
+        rows: List[Dict[str, Any]] = []
+        for bundle in self.limit(limit)._execute():
+            for ref in bundle.refs:
+                block = ray_tpu.get(ref)
+                rows.extend(BlockAccessor(block).iter_rows())
+                if len(rows) >= limit:
+                    return rows[:limit]
+        return rows[:limit]
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        rows: List[Dict[str, Any]] = []
+        for bundle in self._execute():
+            for ref in bundle.refs:
+                rows.extend(BlockAccessor(ray_tpu.get(ref)).iter_rows())
+        return rows
+
+    def take_batch(self, batch_size: int = 20, *, batch_format: str = "numpy") -> Any:
+        it = self.iterator().iter_batches(batch_size=batch_size, batch_format=batch_format)
+        try:
+            return next(it)
+        except StopIteration:
+            return {}
+
+    def count(self) -> int:
+        total = 0
+        for bundle in self._execute():
+            total += bundle.num_rows()
+        return total
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def schema(self) -> Optional[Dict[str, Any]]:
+        for bundle in self.limit(1)._execute():
+            for ref, meta in zip(bundle.refs, bundle.metadata):
+                if meta.schema:
+                    return meta.schema
+                block = ray_tpu.get(ref)
+                return BlockAccessor(block).schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s) if s else None
+
+    def unique(self, column: str) -> List[Any]:
+        res = self.groupby(None).aggregate(Unique(column)).take_all()
+        if not res:
+            return []
+        vals = res[0][f"unique({column})"]
+        return [v.item() if isinstance(v, np.generic) else v for v in vals]
+
+    def sum(self, on: str):
+        return self._global_agg(Sum(on))
+
+    def min(self, on: str):
+        return self._global_agg(Min(on))
+
+    def max(self, on: str):
+        return self._global_agg(Max(on))
+
+    def mean(self, on: str):
+        return self._global_agg(Mean(on))
+
+    def std(self, on: str):
+        return self._global_agg(Std(on))
+
+    def _global_agg(self, agg: AggregateFn):
+        rows = self.groupby(None).aggregate(agg).take_all()
+        return rows[0][agg.name] if rows else None
+
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        rows = self.groupby(None).aggregate(*aggs).take_all()
+        return rows[0] if rows else {}
+
+    # ----------------------------------------------------------- iterators
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._execute, owner=self)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_jax_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_jax_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kw)
+
+    # --------------------------------------------------------------- split
+    def split(self, n: int, *, locality_hints=None) -> List["MaterializedDataset"]:
+        """Materialize and split into n even sub-datasets (parity: split())."""
+        mat = self.materialize()
+        refs = mat._refs
+        metas = mat._metadata
+        groups: List[List[Tuple[Any, BlockMetadata]]] = [[] for _ in range(n)]
+        # Greedy row-balanced assignment.
+        loads = [0] * n
+        for ref, meta in sorted(zip(refs, metas), key=lambda rm: -rm[1].num_rows):
+            i = loads.index(min(loads))
+            groups[i].append((ref, meta))
+            loads[i] += meta.num_rows
+        return [MaterializedDataset([r for r, _ in g], [m for _, m in g]) for g in groups]
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> List[DataIterator]:
+        """n coordinated iterators over one execution (parity:
+        ``streaming_split`` + OutputSplitter).  Driver-side implementation:
+        one shared executor thread pushes bundles round-robin into n queues."""
+        import queue as _q
+        import threading
+
+        queues: List[_q.Queue] = [_q.Queue(maxsize=4) for _ in range(n)]
+        SENTINEL = object()
+
+        def producer():
+            i = 0
+            for bundle in self._execute():
+                for ref, meta in zip(bundle.refs, bundle.metadata):
+                    queues[i % n].put(RefBundle([ref], [meta]))
+                    i += 1
+            for q in queues:
+                q.put(SENTINEL)
+
+        threading.Thread(target=producer, daemon=True).start()
+
+        def make_iter(q):
+            def gen():
+                while True:
+                    item = q.get()
+                    if item is SENTINEL:
+                        return
+                    yield item
+
+            return DataIterator(gen)
+
+        return [make_iter(q) for q in queues]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed: Optional[int] = None):
+        ds: Dataset = self.random_shuffle(seed=seed) if shuffle else self
+        mat = ds.materialize()
+        total = mat.count()
+        n_test = int(total * test_size) if isinstance(test_size, float) else test_size
+        rows = mat.take_all()
+        from ray_tpu.data.read_api import from_items
+
+        return from_items(rows[: total - n_test]), from_items(rows[total - n_test :])
+
+    # --------------------------------------------------------- materialize
+    def materialize(self) -> "MaterializedDataset":
+        refs, metas = [], []
+        for bundle in self._execute():
+            refs.extend(bundle.refs)
+            metas.extend(bundle.metadata)
+        return MaterializedDataset(refs, metas)
+
+    def num_blocks(self) -> int:
+        return self.materialize().num_blocks()
+
+    def size_bytes(self) -> int:
+        return sum(m.size_bytes for m in self.materialize()._metadata)
+
+    # -------------------------------------------------------------- writes
+    def write_csv(self, path: str, **kw) -> None:
+        from ray_tpu.data.datasource import CSVDatasource
+
+        self._write(CSVDatasource([]), path, kw)
+
+    def write_json(self, path: str, **kw) -> None:
+        from ray_tpu.data.datasource import JSONDatasource
+
+        self._write(JSONDatasource([]), path, kw)
+
+    def write_numpy(self, path: str, *, column: str = "data", **kw) -> None:
+        from ray_tpu.data.datasource import NumpyDatasource
+
+        kw["column"] = column
+        self._write(NumpyDatasource([]), path, kw)
+
+    def write_parquet(self, path: str, **kw) -> None:
+        from ray_tpu.data.datasource import ParquetDatasource
+
+        self._write(ParquetDatasource([]), path, kw)
+
+    def _write(self, datasource, path: str, kw: dict) -> None:
+        sink = Dataset(L.Write(self._logical_op, datasource, path, kw))
+        for _ in sink._execute():
+            pass
+
+    # --------------------------------------------------------------- misc
+    def to_pandas(self):
+        mat = self.materialize()
+        blocks = [ray_tpu.get(r) for r in mat._refs]
+        merged = concat_blocks([b for b in blocks if b])
+        return BlockAccessor(merged).to_pandas()
+
+    def stats(self) -> str:
+        if self._last_stats is None:
+            return "(dataset not yet executed)"
+        return self._last_stats.summary()
+
+    def __repr__(self) -> str:
+        return f"Dataset(plan=\n{L.plan_to_string(self._logical_op)}\n)"
+
+
+class MaterializedDataset(Dataset):
+    """A Dataset whose blocks are already in the object store
+    (parity: MaterializedDataset)."""
+
+    def __init__(self, refs: List[Any], metadata: List[BlockMetadata]):
+        super().__init__(L.InputData(refs, metadata))
+        self._refs = refs
+        self._metadata = metadata
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def count(self) -> int:
+        return sum(m.num_rows for m in self._metadata)
+
+    def materialize(self) -> "MaterializedDataset":
+        return self
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby`` (parity: grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return Dataset(L.Aggregate(self._ds._logical_op, self._key, list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable[[Block], Any]) -> Dataset:
+        """Apply fn to each group (materializing implementation)."""
+        key = self._key
+        sorted_ds = self._ds.sort(key)
+
+        def apply_groups(batch: Block) -> Block:
+            from ray_tpu.data.block import _sortable, block_from_rows
+
+            acc = BlockAccessor(batch)
+            if not batch or not acc.num_rows():
+                return {}
+            col = _sortable(batch[key])
+            change = np.nonzero(col[1:] != col[:-1])[0] + 1
+            starts = np.concatenate([[0], change])
+            ends = np.concatenate([change, [len(col)]])
+            outs = []
+            for s, e in zip(starts, ends):
+                res = fn(acc.slice(int(s), int(e)))
+                outs.append(normalize_or_rows(res))
+            return concat_blocks(outs)
+
+        apply_groups.__name__ = f"map_groups[{getattr(fn, '__name__', 'fn')}]"
+        return sorted_ds.map_batches(apply_groups, batch_size=None)
+
+
+def normalize_or_rows(res: Any) -> Block:
+    from ray_tpu.data.block import block_from_rows, normalize_block
+
+    if isinstance(res, list):
+        return block_from_rows(res)
+    if isinstance(res, dict) and res and not any(hasattr(v, "__len__") for v in res.values()):
+        return block_from_rows([res])
+    return normalize_block(res)
+
+
+def _clone_plan(op: L.LogicalOp) -> L.LogicalOp:
+    """Shallow-clone the logical DAG so optimization never mutates the
+    user-held plan (Datasets are immutable/reusable)."""
+    import copy
+
+    cloned = copy.copy(op)
+    cloned.inputs = [_clone_plan(i) for i in op.inputs]
+    if isinstance(cloned, L.FusedMap):
+        cloned.stages = list(cloned.stages)
+    return cloned

@@ -1,0 +1,278 @@
+"""Datasources: pluggable read/write connectors producing ReadTasks.
+
+Parity: ``python/ray/data/datasource/`` (Datasource/Reader/ReadTask model —
+each ReadTask is a serializable thunk run as a remote task that yields
+blocks) and ``read_api.py``'s family of ``read_*`` constructors.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json as _json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    ITEM_COLUMN,
+    Block,
+    BlockAccessor,
+    BlockMetadata,
+    block_from_items,
+    block_from_rows,
+)
+
+
+@dataclass
+class ReadTask:
+    """A serializable unit of reading: ``fn()`` yields one or more blocks.
+
+    Parity: ``python/ray/data/datasource/datasource.py`` ReadTask — carries
+    metadata estimates so the planner can size the read stage without
+    executing it.
+    """
+
+    fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+    def __call__(self) -> Iterable[Block]:
+        return self.fn()
+
+
+class Datasource:
+    """Base connector interface (parity: datasource.py Datasource)."""
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# In-memory sources
+# --------------------------------------------------------------------------
+class RangeDatasource(Datasource):
+    """``range``/``range_tensor`` source (parity: read_api.py range())."""
+
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self.n = n
+        self.tensor_shape = tensor_shape
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        per_row = 8 * int(np.prod(self.tensor_shape)) if self.tensor_shape else 8
+        return self.n * per_row
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        tasks = []
+        bounds = [round(i * self.n / parallelism) for i in range(parallelism + 1)]
+        for i in range(parallelism):
+            lo, hi = bounds[i], bounds[i + 1]
+            shape = self.tensor_shape
+
+            def make(lo=lo, hi=hi, shape=shape):
+                if shape:
+                    base = np.arange(lo, hi, dtype=np.int64).reshape((-1,) + (1,) * len(shape))
+                    yield {"data": np.broadcast_to(base, (hi - lo,) + tuple(shape)).copy()}
+                else:
+                    yield {"id": np.arange(lo, hi, dtype=np.int64)}
+
+            per_row = 8 * int(np.prod(shape)) if shape else 8
+            meta = BlockMetadata(num_rows=hi - lo, size_bytes=(hi - lo) * per_row)
+            tasks.append(ReadTask(make, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        bounds = [round(i * n / parallelism) for i in range(parallelism + 1)]
+        tasks = []
+        for i in range(parallelism):
+            chunk = self.items[bounds[i] : bounds[i + 1]]
+
+            def make(chunk=chunk):
+                yield block_from_items(chunk)
+
+            meta = BlockMetadata(num_rows=len(chunk), size_bytes=len(chunk) * 8)
+            tasks.append(ReadTask(make, meta))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Wraps already-materialized blocks (from_numpy/from_pandas/from_arrow)."""
+
+    def __init__(self, blocks: List[Block]):
+        self.blocks = blocks
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self.blocks:
+            acc = BlockAccessor.for_block(b)
+
+            def make(b=acc.to_block()):
+                yield b
+
+            tasks.append(ReadTask(make, acc.get_metadata()))
+        return tasks
+
+
+# --------------------------------------------------------------------------
+# File-based sources
+# --------------------------------------------------------------------------
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "*"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One ReadTask per file group (parity: file_based_datasource.py)."""
+
+    def __init__(self, paths, **read_kwargs):
+        self.paths = _expand_paths(paths)
+        self.read_kwargs = read_kwargs
+
+    def _read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        files = self.paths
+        parallelism = max(1, min(parallelism, len(files) or 1))
+        groups: List[List[str]] = [[] for _ in range(parallelism)]
+        for i, f in enumerate(files):
+            groups[i % parallelism].append(f)
+        tasks = []
+        for group in groups:
+            if not group:
+                continue
+
+            def make(group=group):
+                for path in group:
+                    yield self._read_file(path)
+
+            size = sum(os.path.getsize(f) for f in group if os.path.exists(f))
+            meta = BlockMetadata(num_rows=-1, size_bytes=size, input_files=group)
+            tasks.append(ReadTask(make, meta))
+        return tasks
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        import csv
+
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f, **self.read_kwargs)
+            rows = [dict(r) for r in reader]
+        block = block_from_rows(rows)
+        return {k: _maybe_numeric(v) for k, v in block.items()}
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        import csv
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(blocks):
+            acc = BlockAccessor(block)
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w", newline="") as f:
+                keys = list(block.keys())
+                w = csv.DictWriter(f, fieldnames=keys)
+                w.writeheader()
+                for row in acc.iter_rows():
+                    w.writerow(row)
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSONL files, one object per line (parity: json_datasource.py)."""
+
+    def _read_file(self, path: str) -> Block:
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(_json.loads(line))
+        return block_from_rows(rows)
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(blocks):
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for row in BlockAccessor(block).iter_rows():
+                    f.write(_json.dumps(_jsonable(row)) + "\n")
+
+
+class NumpyDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Block:
+        arr = np.load(path, allow_pickle=False)
+        return {"data": arr}
+
+    def write(self, blocks: List[Block], path: str, *, column: str = "data", **kwargs) -> None:
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(blocks):
+            np.save(os.path.join(path, f"part-{i:05d}.npy"), block[column])
+
+
+class ParquetDatasource(FileBasedDatasource):
+    """Parquet via pyarrow when available (parity: parquet_datasource.py)."""
+
+    def _read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path, **self.read_kwargs)
+        return BlockAccessor.for_block(table).to_block()
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(blocks):
+            table = BlockAccessor(block).to_arrow()
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+def _maybe_numeric(arr: np.ndarray) -> np.ndarray:
+    """CSV reads everything as str; promote to numbers when they parse."""
+    if arr.dtype != object and not np.issubdtype(arr.dtype, np.str_):
+        return arr
+    vals = list(arr)
+    try:
+        return np.asarray([int(v) for v in vals], dtype=np.int64)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return np.asarray([float(v) for v in vals], dtype=np.float64)
+    except (TypeError, ValueError):
+        return arr
+
+
+def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        elif isinstance(v, np.generic):
+            out[k] = v.item()
+        else:
+            out[k] = v
+    return out

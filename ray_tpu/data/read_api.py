@@ -1,0 +1,81 @@
+"""Dataset constructors (parity: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (
+    BlocksDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+)
+
+
+def _parallelism(override: int = -1) -> int:
+    return override if override > 0 else DataContext.get_current().read_parallelism
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return Dataset(L.Read(RangeDatasource(n), _parallelism(parallelism)))
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(RangeDatasource(n, tensor_shape=tuple(shape)), _parallelism(parallelism)))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(ItemsDatasource(list(items)), _parallelism(parallelism)))
+
+
+def from_numpy(arrays: Union[np.ndarray, List[np.ndarray]], *, column: str = "data") -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    blocks: List[Block] = [{column: a} for a in arrays]
+    return Dataset(L.Read(BlocksDatasource(blocks), len(blocks)))
+
+
+def from_blocks(blocks: List[Any]) -> Dataset:
+    return Dataset(L.Read(BlocksDatasource([BlockAccessor.for_block(b).to_block() for b in blocks]), len(blocks)))
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks(dfs)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return from_blocks(tables)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return Dataset(L.Read(CSVDatasource(paths, **kw), _parallelism(parallelism)))
+
+
+def read_json(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return Dataset(L.Read(JSONDatasource(paths, **kw), _parallelism(parallelism)))
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return Dataset(L.Read(NumpyDatasource(paths, **kw), _parallelism(parallelism)))
+
+
+def read_parquet(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return Dataset(L.Read(ParquetDatasource(paths, **kw), _parallelism(parallelism)))
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(datasource, _parallelism(parallelism)))

@@ -1,0 +1,196 @@
+"""All-to-all exchange: sort / hash groupby / random shuffle / repartition.
+
+Parity: ``python/ray/data/_internal/planner/exchange/`` — a two-stage
+map/reduce exchange.  The map stage partitions every input block into N
+partition slices (returned as N separate objects via ``num_returns=N``);
+the reduce stage concatenates slice j from every map task and applies the
+per-partition finalization (sort-merge, aggregate, or plain concat).
+
+This is the push-based-shuffle topology of the Exoshuffle paper
+(``push_based_shuffle_task_scheduler.py:400``) collapsed onto the in-process
+fabric: map outputs are pushed directly into reducer inputs (object refs),
+with no centralized shuffle service.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, _sortable
+
+
+# ---------------------------------------------------------------- map stage
+def _partition_for_sort(block: Block, key, descending: bool, boundaries: List[Any]) -> List[Block]:
+    acc = BlockAccessor(block)
+    n_parts = len(boundaries) + 1
+    if acc.num_rows() == 0:
+        return [{} for _ in range(n_parts)]
+    first_key = key if isinstance(key, str) else key[0]
+    col = _sortable(block[first_key])
+    idx = np.searchsorted(np.asarray(boundaries), col, side="right")
+    if descending:
+        idx = (n_parts - 1) - idx
+    return [acc.take(np.nonzero(idx == p)[0]) for p in range(n_parts)]
+
+
+def _partition_by_hash(block: Block, key: str, n_parts: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return [{} for _ in range(n_parts)]
+    col = block[key]
+    hashes = np.asarray([hash(v.item() if isinstance(v, np.generic) else v) % n_parts for v in col])
+    return [acc.take(np.nonzero(hashes == p)[0]) for p in range(n_parts)]
+
+
+def _partition_random(block: Block, n_parts: int, seed: Optional[int]) -> List[Block]:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return [{} for _ in range(n_parts)]
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_parts, size=n)
+    return [acc.take(np.nonzero(assign == p)[0]) for p in range(n_parts)]
+
+
+def _partition_round_robin(block: Block, n_parts: int, offset: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return [{} for _ in range(n_parts)]
+    assign = (np.arange(n) + offset) % n_parts
+    return [acc.take(np.nonzero(assign == p)[0]) for p in range(n_parts)]
+
+
+# ------------------------------------------------------------- reduce stage
+def _reduce_concat(*parts: Block) -> Block:
+    return concat_blocks(list(parts))
+
+
+def _reduce_sorted(key, descending: bool, *parts: Block) -> Block:
+    merged = concat_blocks(list(parts))
+    if not merged:
+        return merged
+    return BlockAccessor(merged).sort(key, descending)
+
+
+def _reduce_aggregate(key: Optional[str], aggs, *parts: Block) -> Block:
+    from ray_tpu.data.block import block_from_rows
+
+    merged = concat_blocks(list(parts))
+    if not merged:
+        return {}
+    acc = BlockAccessor(merged)
+    if key is None:
+        row = {a.name: a.finalize(a.accumulate_block(a.init(), merged)) for a in aggs}
+        return block_from_rows([row])
+    order = acc.sort_indices(key)
+    sorted_block = acc.take(order)
+    col = sorted_block[key]
+    # group boundaries in the sorted key column
+    keys_sortable = _sortable(col)
+    change = np.nonzero(keys_sortable[1:] != keys_sortable[:-1])[0] + 1
+    starts = np.concatenate([[0], change])
+    ends = np.concatenate([change, [len(col)]])
+    sacc = BlockAccessor(sorted_block)
+    rows = []
+    for s, e in zip(starts, ends):
+        group = sacc.slice(int(s), int(e))
+        row = {key: sorted_block[key][s].item() if isinstance(sorted_block[key][s], np.generic) else sorted_block[key][s]}
+        for a in aggs:
+            row[a.name] = a.finalize(a.accumulate_block(a.init(), group))
+        rows.append(row)
+    return block_from_rows(rows)
+
+
+# --------------------------------------------------------------- boundaries
+def sample_sort_boundaries(blocks: List[Block], key, n_parts: int) -> List[Any]:
+    """Sample input blocks to pick quantile boundaries for a range partition
+    (parity: exchange/sort_task_spec.py sample_boundaries)."""
+    first_key = key if isinstance(key, str) else key[0]
+    samples = []
+    for b in blocks:
+        if b and len(b.get(first_key, ())):
+            col = _sortable(b[first_key])
+            k = min(len(col), 20)
+            samples.append(np.random.default_rng(0).choice(col, size=k, replace=False))
+    if not samples:
+        return []
+    allv = np.sort(np.concatenate(samples))
+    qs = [allv[int(i * len(allv) / n_parts)] for i in range(1, n_parts)]
+    return list(qs)
+
+
+# ---------------------------------------------------------------- the driver
+def run_exchange(
+    input_refs: List[Any],
+    *,
+    kind: str,
+    n_parts: int,
+    key=None,
+    descending: bool = False,
+    aggs=None,
+    seed: Optional[int] = None,
+) -> Tuple[List[Any], List[Any]]:
+    """Run the two-stage exchange; returns (output_refs, output_metadata).
+
+    kind: "sort" | "groupby" | "shuffle" | "repartition"
+    """
+    n_parts = max(1, n_parts)
+
+    if kind == "sort":
+        sampled = ray_tpu.get(input_refs[: min(len(input_refs), 8)])
+        boundaries = sample_sort_boundaries(sampled, key, n_parts)
+        n_parts = len(boundaries) + 1
+        map_fn = lambda b: _partition_for_sort(b, key, descending, boundaries)
+        reduce_fn = lambda *parts: _reduce_sorted(key, descending, *parts)
+    elif kind == "groupby":
+        if key is None:
+            n_parts = 1
+            map_fn = lambda b: [b]
+        else:
+            map_fn = lambda b: _partition_by_hash(b, key, n_parts)
+        reduce_fn = lambda *parts: _reduce_aggregate(key, aggs, *parts)
+        if key is not None:
+            # keep reduced partitions globally sorted by key for determinism
+            pass
+    elif kind == "shuffle":
+        map_fn = lambda b: _partition_random(b, n_parts, seed)
+        reduce_fn = _reduce_concat
+    elif kind == "repartition":
+        map_fn = lambda b: _partition_round_robin(b, n_parts, 0)
+        reduce_fn = _reduce_concat
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    @ray_tpu.remote
+    def exchange_map(block: Block):
+        parts = map_fn(block)
+        if len(parts) == 1:
+            return parts[0]
+        return tuple(parts)
+
+    @ray_tpu.remote
+    def exchange_reduce(*parts: Block):
+        out = reduce_fn(*parts)
+        meta = BlockAccessor(out).get_metadata()
+        return out, meta
+
+    map_out: List[List[Any]] = []
+    for ref in input_refs:
+        refs = exchange_map.options(num_returns=n_parts).remote(ref)
+        if n_parts == 1:
+            refs = [refs]
+        map_out.append(refs)
+
+    out_refs, meta_refs = [], []
+    for p in range(n_parts):
+        block_ref, meta_ref = exchange_reduce.options(num_returns=2).remote(
+            *[map_out[m][p] for m in range(len(input_refs))]
+        )
+        out_refs.append(block_ref)
+        meta_refs.append(meta_ref)
+    metas = ray_tpu.get(meta_refs)
+    return out_refs, metas

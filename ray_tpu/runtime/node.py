@@ -79,6 +79,10 @@ class Node:
         self.executor = ThreadPoolExecutor(max_workers=num_inproc_threads, thread_name_prefix=f"node-{node_id.hex()[:6]}")
         self.worker_pool = ProcessWorkerPool(
             shm_name=shm_store.name if shm_store is not None else "",
+            # Size by the node's declared CPU resource, not the container's
+            # cpu_count — ray_tpu.init(num_cpus=N) must yield N-way task
+            # parallelism even on cgroup-limited hosts.
+            max_workers=int(resources.get("CPU", 0)) or None,
             session_dir=cluster.session_dir,
         )
         self.worker_pool.set_on_worker_death(self._on_worker_death)
@@ -172,9 +176,11 @@ class Node:
             return
         fn_id, fn_blob = self._function_blob(spec.func)
         shm = self.store._shm
-        enc_args = tuple(protocol.encode_value(a, shm, _shm_id) for a in args)
-        enc_kwargs = {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()}
-        args_blob = pickle.dumps((enc_args, enc_kwargs), protocol=5)
+        try:
+            args_blob = self._encode_args(args, kwargs, shm)
+        except BaseException as exc:  # noqa: BLE001
+            self._commit(spec, None, RayTaskError.from_exception(spec.name, exc))
+            return
 
         def on_result(value, error):
             if error is not None:
@@ -186,6 +192,20 @@ class Node:
         self.worker_pool.submit(
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
         )
+
+    @staticmethod
+    def _encode_args(args, kwargs, shm) -> bytes:
+        """Frame task args for a worker process.  Plain pickle first (fast
+        path); cloudpickle for closures/local classes — its stream is still
+        plain-``pickle.loads``-loadable on the worker side."""
+        enc_args = tuple(protocol.encode_value(a, shm, _shm_id) for a in args)
+        enc_kwargs = {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()}
+        try:
+            return pickle.dumps((enc_args, enc_kwargs), protocol=5)
+        except (AttributeError, TypeError, pickle.PicklingError):
+            import cloudpickle
+
+            return cloudpickle.dumps((enc_args, enc_kwargs), protocol=5)
 
     def _function_blob(self, func) -> tuple:
         import cloudpickle
@@ -227,15 +247,12 @@ class Node:
                 return
             inst.worker = worker
             self._actor_worker_index[worker.pid] = spec.actor_id
-            args, kwargs = self._resolve_args(spec)
-            shm = self.store._shm
-            enc = pickle.dumps(
-                (
-                    tuple(protocol.encode_value(a, shm, _shm_id) for a in args),
-                    {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()},
-                ),
-                protocol=5,
-            )
+            try:
+                args, kwargs = self._resolve_args(spec)
+                enc = self._encode_args(args, kwargs, self.store._shm)
+            except BaseException as exc:  # noqa: BLE001
+                self.cluster.on_actor_creation_failed(spec, RayTaskError.from_exception(spec.name, exc))
+                return
             fn_id, fn_blob = self._function_blob(spec.func)
 
             def on_result(value, err):
@@ -262,19 +279,13 @@ class Node:
         if inst.mode == "inproc":
             inst.call_queue.put(("__call__", spec))
         else:
+            shm = self.store._shm
             try:
                 args, kwargs = self._resolve_args(spec)
+                enc = self._encode_args(args, kwargs, shm)
             except BaseException as exc:  # noqa: BLE001
                 self._commit_actor_error(spec, RayTaskError.from_exception(spec.name, exc))
                 return
-            shm = self.store._shm
-            enc = pickle.dumps(
-                (
-                    tuple(protocol.encode_value(a, shm, _shm_id) for a in args),
-                    {k: protocol.encode_value(v, shm, _shm_id) for k, v in kwargs.items()},
-                ),
-                protocol=5,
-            )
 
             def on_result(value, err):
                 if err is not None:

@@ -112,7 +112,10 @@ class ProcessWorkerPool:
                 w = self._idle.popleft()
                 if w.alive:
                     return w
-            if len(self._all) >= self._max_workers:
+            # Dedicated (actor-owned) workers don't count against the
+            # stateless-task cap, or actors would starve normal tasks.
+            shared = sum(1 for w in self._all.values() if w.alive and not w.dedicated)
+            if shared >= self._max_workers:
                 return None
         return self._spawn(to_idle=False)
 

@@ -1,0 +1,228 @@
+"""Tests for ray_tpu.data — mirrors the reference's Data test strategy
+(python/ray/data/tests: plan optimization + streaming semantics + transforms)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum, Unique
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert rows == [{"id": i} for i in range(5)]
+
+
+def test_range_tensor():
+    ds = rd.range_tensor(8, shape=(2, 2))
+    rows = ds.take(2)
+    assert rows[0]["data"].shape == (2, 2)
+    assert (rows[1]["data"] == 1).all()
+
+
+def test_from_items_map_filter():
+    ds = rd.from_items([{"x": i} for i in range(50)])
+    out = ds.map(lambda r: {"y": r["x"] * 2}).filter(lambda r: r["y"] % 4 == 0)
+    vals = sorted(r["y"] for r in out.take_all())
+    assert vals == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(64)
+    out = ds.map_batches(lambda b: {"sq": b["id"] ** 2}, batch_size=16)
+    vals = sorted(r["sq"] for r in out.take_all())
+    assert vals == sorted(i * i for i in range(64))
+
+
+def test_flat_map():
+    ds = rd.from_items([{"x": 1}, {"x": 2}])
+    out = ds.flat_map(lambda r: [{"x": r["x"]}, {"x": -r["x"]}])
+    assert sorted(r["x"] for r in out.take_all()) == [-2, -1, 1, 2]
+
+
+def test_fusion_in_plan():
+    from ray_tpu.data import logical as L
+
+    ds = rd.range(10).map(lambda r: r).map(lambda r: r)
+    optimized = L.optimize(ds._logical_op)
+    assert isinstance(optimized, L.FusedMap)
+    assert len(optimized.stages) == 2
+
+
+def test_limit_streaming():
+    ds = rd.range(1000)
+    assert len(ds.take(7)) == 7
+    assert ds.limit(13).count() == 13
+
+
+def test_sort():
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200)
+    ds = rd.from_items([{"v": int(v)} for v in vals])
+    out = [r["v"] for r in ds.sort("v").take_all()]
+    assert out == sorted(vals.tolist())
+    out_desc = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert out_desc == sorted(vals.tolist(), reverse=True)
+
+
+def test_random_shuffle_preserves_multiset():
+    ds = rd.range(100)
+    out = sorted(r["id"] for r in ds.random_shuffle(seed=42).take_all())
+    assert out == list(range(100))
+
+
+def test_repartition():
+    ds = rd.range(100, parallelism=4)
+    mat = ds.repartition(10).materialize()
+    assert mat.num_blocks() == 10
+    assert mat.count() == 100
+
+
+def test_groupby_aggregate():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    rows = ds.groupby("k").sum("v").take_all()
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    got = {r["k"]: r["sum(v)"] for r in rows}
+    assert got == expect
+
+
+def test_global_aggregates():
+    ds = rd.from_items([{"v": float(i)} for i in range(10)])
+    assert ds.sum("v") == 45.0
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 9.0
+    assert ds.mean("v") == pytest.approx(4.5)
+    assert ds.std("v") == pytest.approx(np.std(np.arange(10.0), ddof=1))
+
+
+def test_unique():
+    ds = rd.from_items([{"v": i % 4} for i in range(20)])
+    assert ds.unique("v") == [0, 1, 2, 3]
+
+
+def test_union_zip():
+    a = rd.from_items([{"x": 1}, {"x": 2}])
+    b = rd.from_items([{"x": 3}])
+    assert sorted(r["x"] for r in a.union(b).take_all()) == [1, 2, 3]
+    c = rd.from_items([{"y": 10}, {"y": 20}])
+    z = a.zip(c).take_all()
+    assert {(r["x"], r["y"]) for r in z} == {(1, 10), (2, 20)}
+
+
+def test_add_drop_select_columns():
+    ds = rd.range(10).add_column("double", lambda b: b["id"] * 2)
+    row = ds.take(1)[0]
+    assert row["double"] == 0
+    assert ds.select_columns(["double"]).take(1)[0] == {"double": 0}
+    assert "id" not in ds.drop_columns(["id"]).take(1)[0]
+
+
+def test_iter_batches():
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert sizes[:3] == [32, 32, 32]
+
+
+def test_iter_batches_local_shuffle():
+    ds = rd.range(100)
+    ids = []
+    for b in ds.iter_batches(batch_size=50, local_shuffle_buffer_size=100, local_shuffle_seed=0):
+        ids.extend(b["id"].tolist())
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_iter_jax_batches():
+    import jax
+
+    ds = rd.range(64)
+    batch = next(ds.iter_jax_batches(batch_size=32))
+    assert isinstance(batch["id"], jax.Array)
+    assert batch["id"].shape == (32,)
+
+
+def test_split_and_streaming_split():
+    ds = rd.range(100, parallelism=4)
+    shards = ds.split(4)
+    assert sum(s.count() for s in shards) == 100
+    its = rd.range(100, parallelism=4).streaming_split(2)
+    total = 0
+    for it in its:
+        for b in it.iter_batches(batch_size=None):
+            total += len(b["id"])
+    assert total == 100
+
+
+def test_actor_pool_map_batches():
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"v": batch["id"] + self.c}
+
+    ds = rd.range(40).map_batches(AddConst, batch_size=10, concurrency=2, fn_constructor_args=(100,))
+    vals = sorted(r["v"] for r in ds.take_all())
+    assert vals == [i + 100 for i in range(40)]
+
+
+def test_csv_json_roundtrip(tmp_path):
+    ds = rd.from_items([{"a": i, "b": float(i) / 2} for i in range(20)])
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back = rd.read_csv(csv_dir)
+    assert back.count() == 20
+    assert sorted(r["a"] for r in back.take_all()) == list(range(20))
+
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    back = rd.read_json(json_dir)
+    assert back.count() == 20
+
+
+def test_numpy_roundtrip(tmp_path):
+    ds = rd.from_numpy(np.arange(12).reshape(12, 1))
+    np_dir = str(tmp_path / "np")
+    ds.write_numpy(np_dir)
+    back = rd.read_numpy(np_dir)
+    assert back.count() == 12
+
+
+def test_map_groups():
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(10)])
+
+    def top1(group):
+        from ray_tpu.data.block import BlockAccessor
+
+        acc = BlockAccessor(group)
+        best = max(acc.iter_rows(), key=lambda r: r["v"])
+        return [best]
+
+    rows = ds.groupby("k").map_groups(top1).take_all()
+    assert {(r["k"], r["v"]) for r in rows} == {(0, 8), (1, 9)}
+
+
+def test_train_test_split():
+    train, test = rd.range(100).train_test_split(0.2)
+    assert train.count() == 80
+    assert test.count() == 20
+
+
+def test_stats_after_execution():
+    ds = rd.range(50)
+    ds.count()
+    assert "tasks" in ds.stats()
