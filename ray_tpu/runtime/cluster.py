@@ -349,26 +349,34 @@ class Cluster:
     def _schedule_actor_creation(self, spec: TaskSpec) -> None:
         node_id = self.cluster_scheduler.pick_node(spec)
         if node_id is None:
-            def retry():
-                deadline = time.monotonic() + 30.0
-                while time.monotonic() < deadline:
-                    time.sleep(0.05)
-                    nid = self.cluster_scheduler.pick_node(spec)
-                    if nid is not None:
-                        self._start_actor_on(nid, spec)
-                        return
-                self.on_actor_creation_failed(spec, ActorDiedError(spec.actor_id, "actor creation infeasible"))
-
-            threading.Thread(target=retry, daemon=True).start()
+            self._retry_actor_creation(spec)
             return
         self._start_actor_on(node_id, spec)
+
+    def _retry_actor_creation(self, spec: TaskSpec) -> None:
+        """Poll for feasibility off-thread (resources may free as actors die
+        or restarts settle); fail the creation after a deadline."""
+
+        def retry():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                time.sleep(0.05)
+                nid = self.cluster_scheduler.pick_node(spec)
+                if nid is not None:
+                    self._start_actor_on(nid, spec)
+                    return
+            self.on_actor_creation_failed(spec, ActorDiedError(spec.actor_id, "actor creation infeasible"))
+
+        threading.Thread(target=retry, daemon=True).start()
 
     def _start_actor_on(self, node_id: NodeID, spec: TaskSpec) -> None:
         opts = self._actor_options[spec.actor_id]
         node = self.nodes[node_id]
         if not node.pool.acquire(spec.resources):
-            # raced; rescheduling
-            self._schedule_actor_creation(spec)
+            # Raced with another placement: the scheduler's view said the
+            # node fit but the pool is now short.  Defer, never recurse —
+            # recursing re-picks the same node and livelocks.
+            self._retry_actor_creation(spec)
             return
         spec.owner_node = node_id
         deps = [d for d in spec.dependencies if not node.store.contains(d)]

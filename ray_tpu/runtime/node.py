@@ -52,7 +52,9 @@ class ActorInstance:
         self.worker: Optional[WorkerHandle] = None      # process mode
         self.instance: Any = None                        # inproc mode
         self.thread: Optional[threading.Thread] = None
+        self.threads: list = []               # inproc, max_concurrency > 1
         self.call_queue: "queue.Queue" = queue.Queue()
+        self.created = threading.Event()      # gates methods behind __init__
         self.creation_spec = None
         self.dead = False
 
@@ -234,10 +236,21 @@ class Node:
         inst.creation_spec = spec
         self.actors[spec.actor_id] = inst
         if mode == "inproc":
-            inst.thread = threading.Thread(
-                target=self._actor_thread_loop, args=(inst,), name=f"actor-{spec.actor_id.hex()[:8]}", daemon=True
-            )
-            inst.thread.start()
+            # max_concurrency > 1: a pool of method threads shares the call
+            # queue (reference: threaded actors / concurrency groups,
+            # transport/concurrency_group_manager).  Ordering is guaranteed
+            # only for max_concurrency == 1, matching the reference.
+            n_threads = max(1, max_concurrency)
+            for i in range(n_threads):
+                t = threading.Thread(
+                    target=self._actor_thread_loop,
+                    args=(inst,),
+                    name=f"actor-{spec.actor_id.hex()[:8]}-{i}",
+                    daemon=True,
+                )
+                inst.threads.append(t)
+                t.start()
+            inst.thread = inst.threads[0]
             inst.call_queue.put(("__create__", spec))
         else:
             try:
@@ -308,7 +321,12 @@ class Node:
         while True:
             kind, spec = inst.call_queue.get()
             if kind == "__stop__":
+                # propagate the sentinel so every pool thread exits
+                inst.call_queue.put(("__stop__", None))
                 return
+            if kind != "__create__" and not inst.created.is_set():
+                # methods must not outrun __init__ on a sibling thread
+                inst.created.wait()
             if kind == "__direct__":
                 # compiled-DAG fast path: (method, args, kwargs, future) with
                 # no TaskSpec — still serialized through this thread so the
@@ -325,6 +343,7 @@ class Node:
                 try:
                     if kind == "__create__":
                         inst.instance = spec.func(*args, **kwargs)
+                        inst.created.set()
                         self.cluster.on_actor_created(self, spec)
                         continue
                     result = getattr(inst.instance, spec.actor_method)(*args, **kwargs)
@@ -333,6 +352,7 @@ class Node:
                 self.cluster.on_task_finished(self, spec, result, None)
             except BaseException as exc:  # noqa: BLE001
                 if kind == "__create__":
+                    inst.created.set()  # unblock method threads; calls will fail fast
                     self.cluster.on_actor_creation_failed(spec, RayTaskError.from_exception(spec.name, exc))
                 else:
                     self.cluster.on_task_finished(self, spec, None, RayTaskError.from_exception(spec.name, exc))

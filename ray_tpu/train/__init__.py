@@ -1,0 +1,44 @@
+"""ray_tpu.train: distributed training orchestration.
+
+TPU-native rebuild of the reference's Ray Train (``python/ray/train/``,
+SURVEY §2.4/§3.5): trainers spawn a gang of device-pinned in-process worker
+actors, ScalingConfig maps to a jax device mesh, ``train.report`` streams
+metrics/checkpoints to the driver, and checkpoints are pytree directories.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataConfig,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TorchTrainer,
+)
+
+__all__ = [
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TorchTrainer",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

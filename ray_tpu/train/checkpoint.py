@@ -1,0 +1,86 @@
+"""Directory-based checkpoints.
+
+Parity: ``python/ray/train/_checkpoint.py`` (``Checkpoint`` — a handle to a
+directory of files; ``from_directory``/``to_directory``/``as_directory``,
+metrics attached by the session).
+
+TPU-first delta: first-class helpers for jax pytrees — ``from_pytree`` /
+``to_pytree`` serialize a params pytree via orbax when available, falling
+back to a pickled host copy (``jax.device_get``) otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # ----------------------------------------------------------- directory
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    # --------------------------------------------------------------- dicts
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], base_dir: Optional[str] = None) -> "Checkpoint":
+        path = os.path.join(base_dir or tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:12]}")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "data.pkl"), "wb") as f:
+            pickle.dump(data, f, protocol=5)
+        return cls(path)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    # ------------------------------------------------------------- pytrees
+    @classmethod
+    def from_pytree(cls, tree: Any, base_dir: Optional[str] = None) -> "Checkpoint":
+        """Save a jax pytree (params/opt state).  Orbax when importable —
+        the TPU-native checkpoint format with async device→host streaming —
+        else pickled ``jax.device_get`` host copies."""
+        path = os.path.join(base_dir or tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:12]}")
+        os.makedirs(path, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.join(path, "pytree"), tree)
+        except Exception:
+            import jax
+
+            with open(os.path.join(path, "pytree.pkl"), "wb") as f:
+                pickle.dump(jax.device_get(tree), f, protocol=5)
+        return cls(path)
+
+    def to_pytree(self) -> Any:
+        orbax_path = os.path.join(self.path, "pytree")
+        if os.path.isdir(orbax_path):
+            import orbax.checkpoint as ocp
+
+            return ocp.PyTreeCheckpointer().restore(orbax_path)
+        with open(os.path.join(self.path, "pytree.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path})"

@@ -1,0 +1,69 @@
+"""Shared Train/Tune configuration objects.
+
+Parity: ``python/ray/air/config.py:103`` (``ScalingConfig``, ``RunConfig``,
+``CheckpointConfig``, ``FailureConfig``) — the AIR-common config surface the
+reference shares between Train and Tune.
+
+TPU-first delta: ``ScalingConfig`` maps directly to a ``jax.sharding.Mesh``
+specification (workers × devices-per-worker over the device grid) instead of
+to placement-group bundles of GPUs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers × what resources each (reference: config.py:103).
+
+    ``num_workers`` data-parallel workers; each holds ``num_devices_per_worker``
+    TPU devices (the mesh's model-parallel submesh when >1).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    num_devices_per_worker: int = 1
+    resources_per_worker: Optional[Dict[str, float]] = None
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1}
+        if self.use_tpu:
+            res["TPU"] = self.num_devices_per_worker
+        return res
+
+    @property
+    def total_devices(self) -> int:
+        return self.num_workers * self.num_devices_per_worker
+
+
+@dataclass
+class FailureConfig:
+    """Worker-group fault tolerance (reference: FailureConfig).
+
+    max_failures: restarts of the whole worker group before giving up;
+    -1 = unlimited.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0

@@ -1,0 +1,217 @@
+"""Trainers: BaseTrainer → DataParallelTrainer → JaxTrainer.
+
+Parity: ``python/ray/train/base_trainer.py`` + ``data_parallel_trainer.py:25``
+(worker-group orchestration, per-framework backends, result/checkpoint
+plumbing, FailureConfig restarts) and the Train↔Data wiring of
+``_internal/data_config.py``.
+
+TPU-first delta: the flagship backend is JAX — ``ScalingConfig`` becomes a
+device mesh, workers are in-process device-pinned actors, and checkpoints
+are pytree directories (orbax when available).  The reference's
+Torch-process-group rendezvous (``train/torch/config.py:112``) is replaced
+by mesh construction; for multi-host, jax.distributed joins hosts into one
+global device grid before the gang starts.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError, WorkerCrashedError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    """What ``Trainer.fit()`` returns (parity: ray.train.Result)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    metrics_dataframe: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[BaseException] = None
+
+    @property
+    def best_checkpoints(self) -> List[Checkpoint]:
+        return [self.checkpoint] if self.checkpoint else []
+
+
+class DataConfig:
+    """How Datasets are sharded across workers (parity: data_config.py).
+
+    Default: every dataset in ``datasets`` is materialized and split into
+    ``num_workers`` row-balanced shards; each worker sees its shard via
+    ``train.get_dataset_shard(name)``.
+    """
+
+    def __init__(self, datasets_to_split: Optional[List[str]] = None):
+        self._datasets_to_split = datasets_to_split
+
+    def configure(self, datasets: Dict[str, Any], num_workers: int) -> List[Dict[str, Any]]:
+        shards: List[Dict[str, Any]] = [{} for _ in range(num_workers)]
+        for name, ds in (datasets or {}).items():
+            split = self._datasets_to_split is None or name in self._datasets_to_split
+            if split and num_workers > 1:
+                parts = ds.split(num_workers)
+                for i in range(num_workers):
+                    shards[i][name] = parts[i]
+            else:
+                for i in range(num_workers):
+                    shards[i][name] = ds
+        return shards
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Adapt this trainer into a Tune trainable (parity: Trainer→Tune).
+
+        Returns a function trainable: Tune merges the search-space config
+        into ``train_loop_config`` and the trainer reports through the Tune
+        session.
+        """
+        trainer = self
+
+        def trainable(config: dict):
+            import copy
+
+            t = copy.copy(trainer)
+            base = dict(getattr(t, "train_loop_config", None) or {})
+            base.update(config)
+            t.train_loop_config = base
+            result = t.fit()
+            # Re-report the terminal metrics into the Tune session if active.
+            from ray_tpu.tune.session import report as tune_report, in_tune_session
+
+            if in_tune_session() and result.metrics:
+                tune_report(result.metrics, checkpoint=result.checkpoint)
+            if result.error is not None:
+                raise result.error
+            return result.metrics
+
+        trainable.__name__ = type(self).__name__
+        return trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs ``train_loop_per_worker`` on a gang of workers
+    (parity: data_parallel_trainer.py:25)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        dataset_config: Optional[DataConfig] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.dataset_config = dataset_config or DataConfig()
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Result:
+        name = self.run_config.name or f"{type(self).__name__}_{int(time.time())}"
+        storage = self.run_config.storage_path or os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        trial_dir = os.path.join(storage, name)
+        os.makedirs(trial_dir, exist_ok=True)
+
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        latest_checkpoint = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+        best_checkpoint = latest_checkpoint
+        error: Optional[BaseException] = None
+
+        while True:
+            group = WorkerGroup(self.scaling_config, name, trial_dir)
+            group.start()
+            shards = self.dataset_config.configure(self.datasets, self.scaling_config.num_workers)
+            futures = group.run_async(
+                self.train_loop_per_worker, self.train_loop_config, shards, latest_checkpoint
+            )
+            try:
+                # Poll for streamed reports until the gang finishes.
+                done_refs: list = []
+                pending = list(futures)
+                while pending:
+                    finished, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.2)
+                    # Surface a rank's failure immediately — sibling ranks
+                    # blocked in a collective on the dead rank never finish,
+                    # so waiting for the full gang would hang fit() forever.
+                    ray_tpu.get(finished)
+                    done_refs.extend(finished)
+                    reports, _ = group.poll_all()
+                    for rank, metrics, ckpt in reports:
+                        if rank == 0:
+                            row = dict(metrics)
+                            history.append(row)
+                            last_metrics = row
+                        if ckpt is not None and rank == 0:
+                            best_checkpoint = ckpt
+                            latest_checkpoint = ckpt
+                # surface worker exceptions
+                ray_tpu.get(done_refs)
+                reports, _ = group.poll_all()
+                for rank, metrics, ckpt in reports:
+                    if rank == 0:
+                        history.append(dict(metrics))
+                        last_metrics = dict(metrics)
+                        if ckpt is not None:
+                            best_checkpoint = ckpt
+                            latest_checkpoint = ckpt
+                error = None
+                break
+            except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
+                attempt += 1
+                error = exc
+                if max_failures != -1 and attempt > max_failures:
+                    break
+                # restart the gang from the latest checkpoint
+            finally:
+                group.shutdown()
+
+        return Result(
+            metrics=last_metrics,
+            checkpoint=best_checkpoint,
+            path=trial_dir,
+            metrics_dataframe=history,
+            error=error,
+        )
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship TPU trainer (replaces the reference's TorchTrainer +
+    Torch-XLA backend, ``train/torch/xla/config.py:20``): the worker gang
+    shares the chip grid, each rank owning a submesh; the user loop builds
+    pjit/shard_map programs over ``train.get_context().get_mesh()``."""
+
+
+class TorchTrainer(DataParallelTrainer):
+    """CPU-torch data-parallel trainer for parity with reference users
+    migrating torch loops; gradient sync via in-process gloo process group
+    when torch.distributed is initialized by the user loop."""

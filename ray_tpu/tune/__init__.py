@@ -1,0 +1,57 @@
+"""ray_tpu.tune: hyperparameter search and trial scheduling.
+
+TPU-native rebuild of the reference's Ray Tune (``python/ray/tune/``,
+SURVEY §2.4): a controller event loop over trial actors, grid/random search,
+ASHA/HyperBand/median-stopping/PBT schedulers, cooperative early stopping,
+and Train-on-Tune layering (a Trainer is a trainable).
+"""
+
+from ray_tpu.tune.controller import Trial, TuneController
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.session import get_checkpoint, get_trial_id, report
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+__all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneController",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_id",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,292 @@
+"""Trial + TuneController: the experiment event loop.
+
+Parity: ``python/ray/tune/execution/tune_controller.py:68`` (controller
+managing ``Trial`` actors, stepping schedulers/searchers on every result)
+and ``python/ray/tune/experiment/trial.py:247`` (trial state machine).
+
+Trials run as **in-process actors** (threads) so nested submissions work —
+a trial that is itself a Trainer spawns its worker gang through the same
+fabric (Train-on-Tune, exactly how the reference layers them).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError, WorkerCrashedError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.session import TrialInterrupt, _TuneSession, init_trial_session, shutdown_trial_session
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, trial_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.trial_dir = trial_dir
+        self.status = PENDING
+        self.last_result: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self.latest_checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[BaseException] = None
+        self.actor = None
+        self.future = None
+        self.num_restarts = 0
+
+    def __repr__(self) -> str:
+        return f"Trial({self.trial_id}, {self.status}, result={self.last_result})"
+
+
+@ray_tpu.remote
+class TrialRunnerActor:
+    """Hosts one trial's function trainable; buffers its reports."""
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+        self._reports: List = []
+        self._lock = threading.Lock()
+        self._session: Optional[_TuneSession] = None
+        self._done = False
+
+    def run(self, fn: Callable, config: dict, latest_checkpoint) -> Optional[dict]:
+        def reporter(metrics, checkpoint):
+            with self._lock:
+                self._reports.append((metrics, checkpoint))
+
+        session = _TuneSession(self.trial_id, reporter, latest_checkpoint)
+        self._session = session
+        init_trial_session(session)
+        try:
+            final = fn(config)
+            if isinstance(final, dict):
+                reporter(final, None)
+            return final if isinstance(final, dict) else None
+        except TrialInterrupt:
+            return None
+        finally:
+            self._done = True
+            shutdown_trial_session()
+
+    def poll(self):
+        with self._lock:
+            out, self._reports = self._reports, []
+        return out, self._done
+
+    def request_stop(self) -> None:
+        if self._session is not None:
+            self._session.stop_requested = True
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        searcher: Searcher,
+        scheduler: Optional[TrialScheduler] = None,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_concurrent_trials: int = 4,
+        experiment_dir: Optional[str] = None,
+        max_failures_per_trial: int = 0,
+    ):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(metric, mode)
+        if getattr(self.scheduler, "metric", None) is None:
+            self.scheduler.metric = metric
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent_trials
+        self.experiment_dir = experiment_dir or os.path.join(tempfile.gettempdir(), f"tune_{uuid.uuid4().hex[:8]}")
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        self.trials: List[Trial] = []
+        self.max_failures_per_trial = max_failures_per_trial
+
+    # ------------------------------------------------------------------
+    def _make_trial(self) -> Optional[Trial]:
+        trial_id = f"trial_{len(self.trials):05d}"
+        config = self.searcher.suggest(trial_id)
+        if config is None:
+            return None
+        trial = Trial(trial_id, config, os.path.join(self.experiment_dir, trial_id))
+        os.makedirs(trial.trial_dir, exist_ok=True)
+        self.trials.append(trial)
+        return trial
+
+    def _start_trial(self, trial: Trial, checkpoint: Optional[Checkpoint] = None) -> None:
+        trial.actor = TrialRunnerActor.options(execution="inproc", max_concurrency=4).remote(trial.trial_id)
+        ray_tpu.get(trial.actor.ping.remote())
+        trial.future = trial.actor.run.remote(self.trainable, trial.config, checkpoint or trial.latest_checkpoint)
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED) -> None:
+        if trial.actor is not None:
+            try:
+                ray_tpu.get(trial.actor.request_stop.remote())
+            except Exception:
+                pass
+        trial.status = status
+
+    def _finalize_trial(self, trial: Trial) -> None:
+        try:
+            ray_tpu.get(trial.future)
+            trial.status = TERMINATED
+        except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
+            if trial.num_restarts < self.max_failures_per_trial:
+                trial.num_restarts += 1
+                if trial.actor is not None:
+                    try:
+                        ray_tpu.kill(trial.actor)
+                    except Exception:
+                        pass
+                self._start_trial(trial)
+                return
+            trial.status = ERROR
+            trial.error = exc
+        finally:
+            if trial.status != RUNNING and trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result, error=trial.status == ERROR)
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self._write_trial_state(trial)
+
+    def _drain_reports(self, trials: List[Trial]) -> None:
+        """Collect buffered reports from every running trial, then feed the
+        scheduler in global iteration order — otherwise whichever trial is
+        drained first reaches every ASHA rung unopposed and async halving
+        never prunes (drain-order bias)."""
+        merged: List[tuple] = []
+        for trial in trials:
+            if trial.actor is None:
+                continue
+            reports, _ = ray_tpu.get(trial.actor.poll.remote())
+            for metrics, ckpt in reports:
+                metrics.setdefault("training_iteration", len(trial.history) + 1)
+                metrics["trial_id"] = trial.trial_id
+                trial.history.append(metrics)
+                merged.append((trial, metrics, ckpt))
+        merged.sort(key=lambda r: r[1].get("training_iteration", 0))
+        for trial, metrics, ckpt in merged:
+            trial.last_result = metrics
+            if ckpt is not None:
+                trial.latest_checkpoint = ckpt
+            self.searcher.on_trial_result(trial.trial_id, metrics)
+            if trial.status != RUNNING:
+                continue
+            decision = self.scheduler.on_trial_result(trial, metrics)
+            if decision == STOP:
+                self._stop_trial(trial)
+            elif isinstance(self.scheduler, PopulationBasedTraining) and self.scheduler.at_perturbation_boundary(metrics):
+                target = self.scheduler.exploit_target(trial)
+                if target is not None:
+                    new_cfg, donor_ckpt = target
+                    self._stop_trial(trial, status=RUNNING)  # request stop; restart below
+                    # Bounded wait: the interrupt lands at the trial's next
+                    # report — never stall the whole controller on a slow one.
+                    done, _ = ray_tpu.wait([trial.future], num_returns=1, timeout=2.0)
+                    if done:
+                        try:
+                            ray_tpu.get(trial.future)
+                        except Exception:
+                            pass
+                    try:
+                        ray_tpu.kill(trial.actor)
+                    except Exception:
+                        pass
+                    trial.config = new_cfg
+                    self._start_trial(trial, checkpoint=donor_ckpt)
+
+    # ------------------------------------------------------------------
+    def run(self) -> List[Trial]:
+        """The experiment loop (parity: TuneController.step cycle)."""
+        while True:
+            running = [t for t in self.trials if t.status == RUNNING]
+            # launch new trials up to the concurrency cap
+            while len(running) < self.max_concurrent:
+                trial = self._make_trial()
+                if trial is None:
+                    break
+                self._start_trial(trial)
+                running.append(trial)
+            if not running:
+                break
+            # poll running trials
+            futures = {t.future: t for t in running if t.future is not None}
+            ready, _ = ray_tpu.wait(list(futures.keys()), num_returns=1, timeout=0.1)
+            self._drain_reports(running)
+            for ref in ready:
+                trial = futures[ref]
+                if trial.future is ref and trial.status == RUNNING:
+                    self._drain_reports([trial])
+                    self._finalize_trial(trial)
+            # Scheduler-stopped trials: reap their (promptly-interrupting)
+            # futures so actors die and completion hooks fire.
+            for t in self.trials:
+                if t.status != RUNNING and t.actor is not None:
+                    done, _ = ray_tpu.wait([t.future], num_returns=1, timeout=0)
+                    if done:
+                        self._cleanup_stopped(t)
+        for t in self.trials:
+            if t.actor is not None:
+                done, _ = ray_tpu.wait([t.future], num_returns=1, timeout=10.0)
+                # A stopped trainable that never reports again can't see the
+                # cooperative interrupt — reap the actor without blocking.
+                self._cleanup_stopped(t, reap_future=bool(done))
+        return self.trials
+
+    def _cleanup_stopped(self, trial: Trial, reap_future: bool = True) -> None:
+        if reap_future:
+            try:
+                ray_tpu.get(trial.future)
+            except Exception:
+                pass
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result, error=trial.status == ERROR)
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self._write_trial_state(trial)
+
+    def _write_trial_state(self, trial: Trial) -> None:
+        """Experiment checkpointing (parity: experiment_state.py) — one JSON
+        per trial so a crashed experiment can be inspected/resumed."""
+        state = {
+            "trial_id": trial.trial_id,
+            "status": trial.status,
+            "config": {k: repr(v) for k, v in trial.config.items()},
+            "last_result": {k: v for k, v in trial.last_result.items() if _jsonable(v)},
+            "checkpoint": trial.latest_checkpoint.path if trial.latest_checkpoint else None,
+            "error": repr(trial.error) if trial.error else None,
+        }
+        with open(os.path.join(trial.trial_dir, "trial_state.json"), "w") as f:
+            json.dump(state, f, indent=2)
+
+
+def _jsonable(v) -> bool:
+    return isinstance(v, (int, float, str, bool, type(None)))

@@ -1,0 +1,198 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, PBT.
+
+Parity: ``python/ray/tune/schedulers/`` — ``async_hyperband.py`` (ASHA),
+``hb.py`` (HyperBand), ``median_stopping_rule.py``, ``pbt.py``.  Decisions
+are made per reported result: CONTINUE or STOP; PBT may also mutate a
+trial's config and restart it from a peer's checkpoint (exploit/explore).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: str, mode: str) -> None:
+        self.metric = metric
+        self.mode = mode
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]) -> None:
+        pass
+
+    def choose_trial_to_run(self, pending: list) -> Optional[Any]:
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (parity: async_hyperband.py:AsyncHyperBandScheduler).
+
+    Rungs at ``grace_period * reduction_factor**k``; at each rung a trial
+    continues only if its metric is in the top ``1/reduction_factor``
+    quantile of results recorded at that rung (asynchronous — no waiting
+    for the full bracket).
+    """
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> recorded metric values; a trial is evaluated at
+        # its FIRST result at-or-after each milestone (reference semantics —
+        # exact equality would disable pruning for any coarser time_attr).
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        self._rung_seen: Dict[int, set] = defaultdict(set)
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(int(t))
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if self.mode == "min":
+            value = -value
+        decision = CONTINUE
+        for milestone in self._milestones:
+            if t >= milestone and trial.trial_id not in self._rung_seen[milestone]:
+                self._rung_seen[milestone].add(trial.trial_id)
+                rung = self._rungs[milestone]
+                rung.append(value)
+                if len(rung) >= self.rf:
+                    cutoff = sorted(rung, reverse=True)[max(0, int(len(rung) / self.rf) - 1)]
+                    if value < cutoff:
+                        decision = STOP
+        if t >= self.max_t:
+            decision = STOP
+        return decision
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by its asynchronous successor —
+    the reference itself recommends ASHA over strict HyperBand for exactly
+    the straggler reasons the async variant fixes."""
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    completed averages at the same step (parity: median_stopping_rule.py)."""
+
+    def __init__(self, *, time_attr: str = "training_iteration", metric: Optional[str] = None,
+                 mode: str = "max", grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if self.mode == "min":
+            value = -value
+        self._history[trial.trial_id].append(value)
+        if t < self.grace_period:
+            return CONTINUE
+        means = [sum(v) / len(v) for k, v in self._history.items() if k != trial.trial_id and v]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        median = sorted(means)[len(means) // 2]
+        my_mean = sum(self._history[trial.trial_id]) / len(self._history[trial.trial_id])
+        return STOP if my_mean < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (parity: pbt.py): every ``perturbation_interval`` steps, a trial
+    in the bottom quantile clones the config+checkpoint of a top-quantile
+    peer and perturbs hyperparameters (exploit + explore)."""
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._latest: Dict[str, tuple] = {}  # trial_id -> (score, config, checkpoint)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        score = -value if self.mode == "min" else value
+        self._latest[trial.trial_id] = (score, dict(trial.config), trial.latest_checkpoint)
+        # Exploit/explore itself is initiated by the controller, which calls
+        # exploit_target() at perturbation boundaries and restarts the trial.
+        return CONTINUE
+
+    def at_perturbation_boundary(self, result: dict) -> bool:
+        t = result.get(self.time_attr, 0)
+        return bool(t) and t % self.interval == 0
+
+    # exploit/explore is driven by the controller calling this:
+    def exploit_target(self, trial) -> Optional[tuple]:
+        """If trial is bottom-quantile, return (new_config, donor_checkpoint)."""
+        if len(self._latest) < 2 or trial.trial_id not in self._latest:
+            return None
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1][0], reverse=True)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom_ids = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id not in bottom_ids:
+            return None
+        donor_id, (score, donor_cfg, donor_ckpt) = ranked[self.rng.randrange(k)]
+        if donor_id == trial.trial_id:
+            return None
+        new_cfg = dict(donor_cfg)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_prob:
+                new_cfg[key] = spec() if callable(spec) else self.rng.choice(list(spec))
+            elif key in new_cfg and isinstance(new_cfg[key], (int, float)):
+                factor = self.rng.choice([0.8, 1.2])
+                new_cfg[key] = type(new_cfg[key])(new_cfg[key] * factor)
+        return new_cfg, donor_ckpt

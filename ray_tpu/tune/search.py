@@ -1,0 +1,168 @@
+"""Search spaces and search algorithms.
+
+Parity: ``python/ray/tune/search/`` — sample-space primitives
+(``tune.choice/uniform/loguniform/randint/grid_search``), the default
+``BasicVariantGenerator`` (grid × random, ``basic_variant.py``), and a
+``Searcher`` interface for smarter algorithms (the reference plugs Optuna/
+HyperOpt/Ax here; we ship the in-tree ones re-implemented).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+# ------------------------------------------------------------ sample spaces
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+
+
+class RandInt(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class QUniform(Domain):
+    def __init__(self, lower, upper, q):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        return round(rng.uniform(self.lower, self.upper) / self.q) * self.q
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower, upper) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower, upper) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower, upper) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def quniform(lower, upper, q) -> QUniform:
+    return QUniform(lower, upper, q)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn: Callable[[dict], Any]):
+    return _SampleFrom(fn)
+
+
+class _SampleFrom(Domain):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved against the partial config later
+        return self.fn
+
+
+# ----------------------------------------------------------------- searcher
+class Searcher:
+    """Interface (parity: search/searcher.py Searcher)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid × random expansion (parity: basic_variant.py).
+
+    Grid dimensions multiply; every grid combination is emitted
+    ``num_samples`` times with random dimensions re-sampled each time.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._configs = list(self._expand())
+        self._next = 0
+
+    def _expand(self):
+        grid_keys = [k for k, v in self.param_space.items() if isinstance(v, GridSearch)]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, _SampleFrom):
+                        cfg[k] = v  # resolve after other keys are fixed
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    else:
+                        cfg[k] = v
+                for k, v in list(cfg.items()):
+                    if isinstance(v, _SampleFrom):
+                        cfg[k] = v.fn(cfg)
+                yield cfg
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._configs)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._next >= len(self._configs):
+            return None
+        cfg = self._configs[self._next]
+        self._next += 1
+        return cfg

@@ -1,0 +1,137 @@
+"""Tuner: the user-facing experiment API.
+
+Parity: ``python/ray/tune/tuner.py`` (``Tuner(trainable, param_space,
+tune_config, run_config).fit() -> ResultGrid``) and ``tune.run``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.trainer import BaseTrainer, Result
+from ray_tpu.tune.controller import ERROR, TERMINATED, Trial, TuneController
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+
+
+class ResultGrid:
+    """Parity: ray.tune.ResultGrid."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._to_result(self._trials[i])
+
+    def _to_result(self, t: Trial) -> Result:
+        return Result(
+            metrics=t.last_result,
+            checkpoint=t.latest_checkpoint,
+            path=t.trial_dir,
+            metrics_dataframe=t.history,
+            error=t.error,
+        )
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [t.error for t in self._trials if t.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric or pass metric=)")
+        candidates = [t for t in self._trials if metric in t.last_result]
+        if not candidates:
+            raise RuntimeError("no trial reported the metric " + metric)
+        best = (max if mode == "max" else min)(candidates, key=lambda t: t.last_result[metric])
+        return self._to_result(best)
+
+    def get_dataframe(self) -> List[Dict[str, Any]]:
+        return [dict(t.last_result, trial_id=t.trial_id, status=t.status) for t in self._trials]
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, BaseTrainer],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        trainable = self.trainable
+        param_space = self.param_space
+        if isinstance(trainable, BaseTrainer):
+            # Train-on-Tune: the search space targets train_loop_config.
+            param_space = dict(param_space.get("train_loop_config", param_space))
+            trainable = self.trainable.as_trainable()
+        searcher = self.tune_config.search_alg or BasicVariantGenerator(
+            param_space, num_samples=self.tune_config.num_samples
+        )
+        exp_dir = None
+        if self.run_config.storage_path:
+            exp_dir = os.path.join(self.run_config.storage_path, self.run_config.name or "tune_experiment")
+        controller = TuneController(
+            trainable,
+            searcher=searcher,
+            scheduler=self.tune_config.scheduler,
+            metric=self.tune_config.metric,
+            mode=self.tune_config.mode,
+            max_concurrent_trials=self.tune_config.max_concurrent_trials,
+            experiment_dir=exp_dir,
+            max_failures_per_trial=self.run_config.failure_config.max_failures,
+        )
+        trials = controller.run()
+        return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
+
+
+def run(
+    trainable: Callable,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "max",
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    max_concurrent_trials: int = 4,
+    **kwargs,
+) -> ResultGrid:
+    """Functional entry point (parity: tune.run)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            scheduler=scheduler,
+            search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+    ).fit()
