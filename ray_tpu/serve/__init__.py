@@ -1,0 +1,41 @@
+"""ray_tpu.serve: online model serving.
+
+TPU-native rebuild of the reference's Ray Serve (``python/ray/serve/``,
+SURVEY §2.4/§3.6): a controller actor reconciles deployment replicas with
+queue-depth autoscaling; handles route with power-of-two-choices; an HTTP
+proxy fronts apps; ``@serve.batch`` shapes concurrent requests into MXU
+batches; ``@serve.multiplexed`` LRU-caches many models per replica.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_deployment_handle,
+    proxy_url,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
+    "proxy_url",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,97 @@
+"""serve public API: run/start/shutdown/status/get_deployment_handle.
+
+Parity: ``python/ray/serve/api.py`` — ``serve.run(app)`` deploys a bound
+application graph and returns the ingress handle; composition materializes
+child Applications as DeploymentHandles passed to parent constructors
+(deployment-graph semantics, SURVEY §3.6).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeControllerActor
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.proxy import HTTPProxy
+from ray_tpu.serve.router import DeploymentHandle
+
+_state_lock = threading.RLock()
+_controller = None
+_proxy: Optional[HTTPProxy] = None
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 0, request_timeout_s: float = 30.0):
+    """Start the Serve instance (controller + HTTP proxy)."""
+    global _controller, _proxy
+    with _state_lock:
+        if _controller is None:
+            _controller = ServeControllerActor.options(execution="inproc", max_concurrency=16).remote()
+            ray_tpu.get(_controller.ping.remote())
+        if _proxy is None:
+            _proxy = HTTPProxy(http_host, http_port, request_timeout_s)
+    return _controller
+
+
+def _require_started():
+    if _controller is None:
+        start()
+    return _controller
+
+
+def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "/") -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle."""
+    controller = _require_started()
+    apps = app.walk()  # dependencies first
+    handles: Dict[int, DeploymentHandle] = {}
+    for sub in apps:
+        init_args = tuple(handles[id(a)] if isinstance(a, Application) else a for a in sub.init_args)
+        init_kwargs = {
+            k: (handles[id(v)] if isinstance(v, Application) else v) for k, v in sub.init_kwargs.items()
+        }
+        ray_tpu.get(controller.deploy.remote(sub.deployment, init_args, init_kwargs))
+        handles[id(sub)] = DeploymentHandle(sub.deployment.name, controller)
+    ingress = handles[id(app)]
+    if route_prefix is not None:
+        ray_tpu.get(controller.set_ingress.remote(route_prefix, app.deployment.name))
+        if _proxy is not None:
+            _proxy.add_route(route_prefix, ingress)
+    return ingress
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    controller = _require_started()
+    return DeploymentHandle(deployment_name, controller)
+
+
+def status() -> Dict[str, Any]:
+    controller = _require_started()
+    return {
+        "deployments": ray_tpu.get(controller.list_deployments.remote()),
+        "proxy_url": _proxy.url if _proxy else None,
+    }
+
+
+def delete(name: str) -> None:
+    controller = _require_started()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def proxy_url() -> Optional[str]:
+    return _proxy.url if _proxy else None
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    with _state_lock:
+        if _proxy is not None:
+            _proxy.shutdown()
+            _proxy = None
+        if _controller is not None:
+            try:
+                ray_tpu.get(_controller.shutdown.remote())
+                ray_tpu.kill(_controller)
+            except Exception:
+                pass
+            _controller = None

@@ -1,0 +1,158 @@
+"""ServeController: the reconciling control loop.
+
+Parity: ``python/ray/serve/_private/controller.py:86`` (singleton controller
+actor reconciling target vs running replicas per deployment,
+``deployment_state.py:1226``) and ``autoscaling_state.py`` (queue-depth
+autoscaling between min/max replicas).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.deployment import AutoscalingConfig, Deployment
+from ray_tpu.serve.replica import ReplicaActor
+
+
+class _DeploymentState:
+    def __init__(self, deployment: Deployment, init_args: tuple, init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.replicas: List[Any] = []
+        self.version = 0
+        if deployment.autoscaling_config is not None:
+            self.target_replicas = deployment.autoscaling_config.min_replicas
+        else:
+            self.target_replicas = int(deployment.num_replicas)
+        self.last_inflight: Dict[int, int] = {}
+        self.last_scale_time = 0.0
+
+
+@ray_tpu.remote
+class ServeControllerActor:
+    """Runs in-process; reconcile loop on a background thread."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._apps: Dict[str, str] = {}  # route_prefix -> ingress deployment
+        self._lock = threading.RLock()
+        self._running = True
+        self._reconcile_thread = threading.Thread(target=self._loop, daemon=True, name="serve-reconcile")
+        self._reconcile_thread.start()
+
+    # ----------------------------------------------------------- deploys
+    def deploy(self, deployment: Deployment, init_args: tuple, init_kwargs: dict) -> None:
+        with self._lock:
+            old = self._deployments.get(deployment.name)
+            state = _DeploymentState(deployment, init_args, init_kwargs)
+            if old is not None:
+                state.version = old.version
+                self._scale_down_locked(old, 0)
+            self._deployments[deployment.name] = state
+            self._reconcile_locked(state)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+            if state is not None:
+                self._scale_down_locked(state, 0)
+
+    def set_ingress(self, route_prefix: str, deployment_name: str) -> None:
+        with self._lock:
+            self._apps[route_prefix] = deployment_name
+
+    def get_ingress_map(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._apps)
+
+    # ----------------------------------------------------------- queries
+    def get_replicas(self, name: str) -> Tuple[int, List[Any]]:
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return (-1, [])
+            return (state.version, list(state.replicas))
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(s.replicas),
+                    "target_replicas": s.target_replicas,
+                    "version": s.deployment.version,
+                }
+                for name, s in self._deployments.items()
+            }
+
+    def record_request_metrics(self, name: str, inflight: Dict[int, int]) -> None:
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is not None:
+                state.last_inflight = dict(inflight)
+
+    # ------------------------------------------------------- reconciling
+    def _reconcile_locked(self, state: _DeploymentState) -> None:
+        d = state.deployment
+        while len(state.replicas) < state.target_replicas:
+            is_function = not isinstance(d.func_or_class, type)
+            replica = ReplicaActor.options(
+                execution="inproc",
+                max_concurrency=max(2, d.max_ongoing_requests),
+                **{k: v for k, v in d.ray_actor_options.items() if k in ("num_cpus", "num_tpus", "resources")},
+            ).remote(d.func_or_class, state.init_args, state.init_kwargs, d.user_config, is_function)
+            state.replicas.append(replica)
+            state.version += 1
+        if len(state.replicas) > state.target_replicas:
+            self._scale_down_locked(state, state.target_replicas)
+
+    def _scale_down_locked(self, state: _DeploymentState, target: int) -> None:
+        while len(state.replicas) > target:
+            replica = state.replicas.pop()
+            try:
+                ray_tpu.kill(replica)
+            except Exception:
+                pass
+            state.version += 1
+
+    def _loop(self) -> None:
+        while self._running:
+            time.sleep(0.2)
+            with self._lock:
+                for state in list(self._deployments.values()):
+                    cfg = state.deployment.autoscaling_config
+                    if cfg is not None:
+                        self._autoscale_locked(state, cfg)
+                    self._reconcile_locked(state)
+
+    def _autoscale_locked(self, state: _DeploymentState, cfg: AutoscalingConfig) -> None:
+        """Queue-depth autoscaling (parity: autoscaling_policy.py
+        _calculate_desired_num_replicas): desired = ceil(total_ongoing /
+        target_ongoing_requests), clamped to [min, max], rate-limited."""
+        now = time.monotonic()
+        total_ongoing = sum(state.last_inflight.values())
+        n = max(1, len(state.replicas))
+        desired = math.ceil(total_ongoing / max(cfg.target_ongoing_requests, 1e-9))
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        if desired > state.target_replicas and now - state.last_scale_time >= cfg.upscale_delay_s:
+            state.target_replicas = desired
+            state.last_scale_time = now
+        elif desired < state.target_replicas and now - state.last_scale_time >= cfg.downscale_delay_s:
+            state.target_replicas = desired
+            state.last_scale_time = now
+
+    # ------------------------------------------------------------- admin
+    def shutdown(self) -> None:
+        with self._lock:
+            self._running = False
+            for state in self._deployments.values():
+                self._scale_down_locked(state, 0)
+            self._deployments.clear()
+            self._apps.clear()
+
+    def ping(self) -> str:
+        return "ok"

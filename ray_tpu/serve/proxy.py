@@ -1,0 +1,102 @@
+"""HTTP proxy: the ingress.
+
+Parity: ``python/ray/serve/_private/proxy.py`` — per-node HTTP ingress
+routing requests by path prefix to the app's ingress deployment handle.
+The reference uses uvicorn/starlette (ASGI); here a stdlib threading HTTP
+server keeps the image dependency-free — each request thread blocks on the
+handle's DeploymentResponse, and replica concurrency does the fan-out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.router import DeploymentHandle
+
+
+class _ServeHTTPHandler(BaseHTTPRequestHandler):
+    proxy: "HTTPProxy" = None  # set by server factory
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _handle(self, body: Optional[bytes]) -> None:
+        from urllib.parse import urlsplit
+
+        path = urlsplit(self.path).path  # strip ?query before matching
+        handle = None
+        for prefix, h in sorted(self.proxy.routes.items(), key=lambda kv: -len(kv[0])):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                handle = h
+                break
+        if handle is None:
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(b'{"error": "no app at this route"}')
+            return
+        try:
+            payload: Any = None
+            if body:
+                try:
+                    payload = json.loads(body)
+                except json.JSONDecodeError:
+                    payload = body.decode("utf-8", "replace")
+            result = handle.remote(payload).result(timeout=self.proxy.request_timeout_s)
+            data = json.dumps(result, default=_jsonify).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(data)
+        except Exception as exc:  # noqa: BLE001
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(json.dumps({"error": str(exc)}).encode())
+
+    def do_GET(self):
+        self._handle(None)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self._handle(self.rfile.read(length) if length else None)
+
+
+def _jsonify(obj):
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return str(obj)
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000, request_timeout_s: float = 30.0):
+        self.host = host
+        self.port = port
+        self.routes: Dict[str, DeploymentHandle] = {}
+        self.request_timeout_s = request_timeout_s
+        handler = type("Handler", (_ServeHTTPHandler,), {"proxy": self})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True, name="serve-proxy")
+        self.thread.start()
+
+    def add_route(self, prefix: str, handle: DeploymentHandle) -> None:
+        self.routes[prefix] = handle
+
+    def remove_route(self, prefix: str) -> None:
+        self.routes.pop(prefix, None)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,59 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Parity: ``python/ray/serve/_private/replica.py`` — wraps the user
+function/class, counts ongoing requests (the router's pow-2 signal),
+applies ``reconfigure`` (user_config), and reports health.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, func_or_class, init_args, init_kwargs, user_config, is_function: bool):
+        self.is_function = is_function
+        if is_function:
+            self.callable = func_or_class
+        else:
+            self.callable = func_or_class(*init_args, **init_kwargs)
+            if user_config is not None and hasattr(self.callable, "reconfigure"):
+                self.callable.reconfigure(user_config)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self.is_function:
+                return self.callable(*args, **kwargs)
+            target = self.callable if method == "__call__" else getattr(self.callable, method)
+            if method == "__call__" and not callable(target):
+                raise TypeError(f"deployment class {type(self.callable)} is not callable")
+            return target(*args, **kwargs) if method != "__call__" else self.callable(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def reconfigure(self, user_config) -> None:
+        if not self.is_function and hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+
+    def get_num_ongoing_requests(self) -> int:
+        return self._ongoing
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def check_health(self) -> str:
+        if not self.is_function and hasattr(self.callable, "check_health"):
+            self.callable.check_health()
+        return "ok"

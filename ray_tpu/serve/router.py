@@ -1,0 +1,152 @@
+"""Router + DeploymentHandle: request routing with power-of-two-choices.
+
+Parity: ``python/ray/serve/_private/router.py:312`` and
+``replica_scheduler/pow_2_scheduler.py:49`` — the handle's router samples
+two replicas and sends to the one with fewer in-flight requests (tracked
+locally, optimistically), giving near-least-loaded balancing without a
+global queue view.  ``DeploymentResponse`` is the future-like result
+(parity: handle.py DeploymentResponse) and can be passed straight into
+another handle call (composition without materializing).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    def __init__(self, ref, router: "Router", replica_idx: int):
+        self._ref = ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+        return value
+
+    def _settle(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router._request_finished(self._replica_idx)
+
+    def _to_object_ref(self):
+        # Handing the ref to a downstream call (composition) transfers
+        # ownership of completion — settle now or the replica's in-flight
+        # count leaks and pow-2/autoscaling skew permanently.
+        self._settle()
+        return self._ref
+
+
+class Router:
+    def __init__(self, deployment_name: str, controller_handle):
+        self.deployment_name = deployment_name
+        self.controller = controller_handle
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._version = -1
+        self._rng = random.Random()
+        self._reqs_since_push = 0
+        self._last_refresh = 0.0
+
+    # ------------------------------------------------------------ updates
+    def _refresh(self, force: bool = False) -> None:
+        # Long-poll-lite: replica membership changes rarely; re-pull at most
+        # every 0.5s (parity: LongPollHost pushes, we poll cheaply).
+        import time
+
+        now = time.monotonic()
+        if not force and self._replicas and now - self._last_refresh < 0.5:
+            return
+        self._last_refresh = now
+        version, replicas = ray_tpu.get(self.controller.get_replicas.remote(self.deployment_name))
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = {i: self._inflight.get(i, 0) for i in range(len(replicas))}
+
+    # ------------------------------------------------------------ routing
+    def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        if not self._replicas:
+            self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
+        with self._lock:
+            n = len(self._replicas)
+            if n == 1:
+                idx = 0
+            else:
+                # power of two choices over locally-tracked in-flight counts
+                a, b = self._rng.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            replica = self._replicas[idx]
+            self._reqs_since_push += 1
+            push = self._reqs_since_push >= 10
+            if push:
+                self._reqs_since_push = 0
+        # Resolve nested DeploymentResponses: pass their refs so the fabric
+        # chains the calls without blocking here (model composition).
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v) for k, v in kwargs.items()}
+        ref = replica.handle_request.remote(method, args, kwargs)
+        if push:
+            try:
+                self.controller.record_request_metrics.remote(
+                    self.deployment_name, dict(self._inflight)
+                )
+            except Exception:
+                pass
+        return DeploymentResponse(ref, self, idx)
+
+    def _request_finished(self, idx: int) -> None:
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    def stale(self) -> bool:
+        return True
+
+
+class DeploymentHandle:
+    """What users (and the proxy) call (parity: serve DeploymentHandle)."""
+
+    def __init__(self, deployment_name: str, controller_handle):
+        self.deployment_name = deployment_name
+        self._router = Router(deployment_name, controller_handle)
+        self._method = "__call__"
+
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h.deployment_name = self.deployment_name
+        h._router = self._router
+        h._method = method_name or self._method
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._router._refresh()
+        return self._router.route(self._method, args, kwargs)
+
+    def __getattr__(self, name: str) -> "_MethodCaller":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._handle._router._refresh()
+        return self._handle._router.route(self._method, args, kwargs)

@@ -1,0 +1,25 @@
+"""ray_tpu.util: utility APIs layered on the core.
+
+Parity: ``python/ray/util/`` (SURVEY §2.4 util misc) — ActorPool, Queue,
+collective ops, scheduling strategies, serializability checking.
+"""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.runtime.scheduler import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+    "Queue",
+    "inspect_serializability",
+]

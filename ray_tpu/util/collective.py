@@ -1,0 +1,154 @@
+"""ray_tpu.util.collective: the public collective-communication API.
+
+Parity: ``python/ray/util/collective/collective.py`` —
+``init_collective_group`` :120, ``create_collective_group`` :151,
+``allreduce`` :258, ``barrier`` :298, ``broadcast`` :373, ``allgather``
+:423, ``reducescatter`` :472, ``send`` :531 / ``recv`` :594 — with the
+backend lowered to the TPU fabric instead of NCCL/Gloo: group ops ride the
+in-process rendezvous (host actors) and, inside jit, the ``ray_tpu.parallel``
+axis collectives (psum/all_gather/ppermute over ICI).
+
+The reference's rendezvous-through-a-named-actor (NCCLUniqueID store)
+disappears: groups are fabric-local state, no unique-id exchange needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.parallel.collective import (
+    _registry,
+    allgather_tensor,
+    allreduce_tensor,
+    broadcast_tensor,
+    destroy_collective_group,
+    init_collective_group,
+    reducescatter_tensor,
+)
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: List[int],
+    backend: str = "tpu",
+    group_name: str = "default",
+) -> None:
+    """Declarative group creation (reference: collective.py:151) — the driver
+    registers the group; actors then call collective ops with their rank."""
+    if len(actors) != len(ranks) or len(ranks) != world_size:
+        raise ValueError("actors/ranks/world_size mismatch")
+    init_collective_group(world_size, ranks[0], backend, group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _registry.get(group_name)
+        return True
+    except KeyError:
+        return False
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _registry.get(group_name).world_size
+
+
+# ------------------------------------------------------------------- ops
+def allreduce(tensor, group_name: str = "default", op: str = "sum", *, rank: Optional[int] = None):
+    return allreduce_tensor(tensor, _need_rank(rank), group_name, op)
+
+
+def allgather(tensor, group_name: str = "default", *, rank: Optional[int] = None) -> List[Any]:
+    return allgather_tensor(tensor, _need_rank(rank), group_name)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default", *, rank: Optional[int] = None):
+    return broadcast_tensor(tensor, _need_rank(rank), src_rank, group_name)
+
+
+def reducescatter(tensor, group_name: str = "default", *, rank: Optional[int] = None):
+    return reducescatter_tensor(tensor, _need_rank(rank), group_name)
+
+
+def barrier(group_name: str = "default", *, rank: Optional[int] = None) -> None:
+    allreduce_tensor(0, _need_rank(rank), group_name)
+
+
+# ---------------------------------------------------------- point-to-point
+class _Mailboxes:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.boxes: Dict[tuple, "_Box"] = {}
+
+    def box(self, group: str, src: int, dst: int) -> "_Box":
+        key = (group, src, dst)
+        with self.lock:
+            if key not in self.boxes:
+                self.boxes[key] = _Box()
+            return self.boxes[key]
+
+
+class _Box:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.items: list = []
+
+
+_mail = _Mailboxes()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[int] = None) -> None:
+    """Reference: collective.py:531 — point-to-point send."""
+    src = _need_rank(rank)
+    box = _mail.box(group_name, src, dst_rank)
+    with box.cond:
+        box.items.append(tensor)
+        box.cond.notify_all()
+
+
+def recv(src_rank: int, group_name: str = "default", *, rank: Optional[int] = None, timeout: float = 120.0):
+    """Reference: collective.py:594 — blocking point-to-point receive."""
+    dst = _need_rank(rank)
+    box = _mail.box(group_name, src_rank, dst)
+    with box.cond:
+        ok = box.cond.wait_for(lambda: bool(box.items), timeout=timeout)
+        if not ok:
+            raise TimeoutError(f"recv from rank {src_rank} timed out")
+        return box.items.pop(0)
+
+
+# ----------------------------------------------------------------- helpers
+_rank_local = threading.local()
+
+
+def set_rank(rank: int) -> None:
+    """Bind this thread's rank (actors call once; the reference infers rank
+    from the actor registered in the group)."""
+    _rank_local.value = rank
+
+
+def _need_rank(rank: Optional[int]) -> int:
+    if rank is not None:
+        return rank
+    r = getattr(_rank_local, "value", None)
+    if r is None:
+        raise ValueError("rank not set: pass rank= or call collective.set_rank(rank) first")
+    return r
+
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "init_collective_group",
+    "is_group_initialized",
+    "recv",
+    "reducescatter",
+    "send",
+    "set_rank",
+]

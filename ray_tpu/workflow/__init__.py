@@ -1,0 +1,34 @@
+"""ray_tpu.workflow: durable DAG execution.
+
+TPU-native rebuild of the reference's Ray Workflows
+(``python/ray/workflow/``, SURVEY §2.4): a DAG of tasks executed with every
+step result checkpointed to storage (``workflow_storage.py:229``), so a
+crashed/resumed workflow replays only incomplete steps — exactly-once-ish
+semantics over the task fabric.
+"""
+
+from ray_tpu.workflow.api import (
+    cancel,
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = [
+    "WorkflowStorage",
+    "cancel",
+    "delete",
+    "get_output",
+    "get_status",
+    "init",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

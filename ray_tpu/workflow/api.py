@@ -1,0 +1,168 @@
+"""Workflow executor + public API.
+
+Parity: ``python/ray/workflow/workflow_executor.py:32`` + ``api.py`` —
+``workflow.run(dag, workflow_id=...)`` executes a ``ray_tpu.dag`` graph with
+every node's result checkpointed; ``resume`` replays the persisted DAG,
+skipping steps whose results are already durable.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag.dag_node import DAGNode
+from ray_tpu.workflow.storage import WorkflowStorage
+
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+_storage: Optional[WorkflowStorage] = None
+_cancel_flags: Dict[str, threading.Event] = {}
+_async_results: Dict[str, Any] = {}
+
+
+def init(storage_dir: Optional[str] = None) -> None:
+    global _storage
+    _storage = WorkflowStorage(storage_dir)
+
+
+def _store() -> WorkflowStorage:
+    global _storage
+    if _storage is None:
+        _storage = WorkflowStorage()
+    return _storage
+
+
+# --------------------------------------------------------------- executor
+def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
+    """Topological replay: durable steps load from storage; the rest are
+    submitted eagerly with upstream REFS as args — independent branches run
+    in parallel and the fabric chains dependents — then results are fetched
+    and checkpointed in topological order (at-least-once replay: a crash
+    between a step finishing and its checkpoint just reruns that step)."""
+    order = dag.topological()
+    cancel_flag = _cancel_flags.setdefault(workflow_id, threading.Event())
+    results: Dict[int, Any] = {}   # node id -> ObjectRef or durable value
+    durable: Dict[int, bool] = {}
+    keys: Dict[int, str] = {}
+    for i, node in enumerate(order):
+        # Step key = topological index → stable across replays of the same
+        # persisted DAG object (DAGNode.topological is deterministic).
+        keys[id(node)] = f"step_{i:04d}"
+
+    for node in order:
+        if cancel_flag.is_set():
+            store.set_status(workflow_id, CANCELED)
+            raise RuntimeError(f"workflow {workflow_id} canceled")
+        key = keys[id(node)]
+        if store.has_step(workflow_id, key):
+            results[id(node)] = store.load_step(workflow_id, key)
+            durable[id(node)] = True
+            continue
+        func = getattr(node, "func", None)
+        if func is None:
+            # Non-task nodes (InputNode etc.) are not supported in durable mode
+            raise TypeError(f"workflow DAGs must be built from task bind()s, got {type(node)}")
+        args = tuple(results[id(a)] if isinstance(a, DAGNode) else a for a in node._bound_args)
+        kwargs = {k: (results[id(v)] if isinstance(v, DAGNode) else v) for k, v in node._bound_kwargs.items()}
+        results[id(node)] = ray_tpu.remote(func).remote(*args, **kwargs)
+        durable[id(node)] = False
+
+    for node in order:
+        if not durable[id(node)]:
+            value = ray_tpu.get(results[id(node)])
+            store.save_step(workflow_id, keys[id(node)], value)
+            results[id(node)] = value
+    return results[id(order[-1])]
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:10]}"
+    store = _store()
+    import cloudpickle
+
+    store.save_dag(workflow_id, cloudpickle.dumps(dag))
+    store.set_status(workflow_id, RUNNING)
+    try:
+        result = _execute_dag(dag, workflow_id, store)
+    except BaseException:
+        if store.get_status(workflow_id) != CANCELED:
+            store.set_status(workflow_id, FAILED)
+        raise
+    store.save_step(workflow_id, "__output__", result)
+    store.set_status(workflow_id, SUCCESSFUL)
+    return result
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None):
+    """Returns an ObjectRef-like future via a background thread."""
+    from concurrent.futures import Future
+
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:10]}"
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(run(dag, workflow_id=workflow_id))
+        except BaseException as exc:  # noqa: BLE001
+            fut.set_exception(exc)
+
+    threading.Thread(target=target, daemon=True, name=f"workflow-{workflow_id}").start()
+    _async_results[workflow_id] = fut
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Replay a persisted workflow; durable steps are skipped."""
+    store = _store()
+    import pickle
+
+    dag = pickle.loads(store.load_dag(workflow_id))
+    # Resuming revokes any prior cancel — otherwise the stale flag aborts
+    # step 0 and resume-after-cancel (a core durability feature) never works.
+    flag = _cancel_flags.get(workflow_id)
+    if flag is not None:
+        flag.clear()
+    store.set_status(workflow_id, RUNNING)
+    try:
+        result = _execute_dag(dag, workflow_id, store)
+    except BaseException:
+        if store.get_status(workflow_id) != CANCELED:
+            store.set_status(workflow_id, FAILED)
+        raise
+    store.save_step(workflow_id, "__output__", result)
+    store.set_status(workflow_id, SUCCESSFUL)
+    return result
+
+
+def get_output(workflow_id: str) -> Any:
+    store = _store()
+    if store.has_step(workflow_id, "__output__"):
+        return store.load_step(workflow_id, "__output__")
+    raise KeyError(f"workflow {workflow_id} has no durable output (status={store.get_status(workflow_id)})")
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _store().get_status(workflow_id)
+
+
+def list_all(status_filter: Optional[str] = None) -> List[Dict[str, Any]]:
+    wfs = _store().list_workflows()
+    if status_filter:
+        wfs = [w for w in wfs if w["status"] == status_filter]
+    return wfs
+
+
+def cancel(workflow_id: str) -> None:
+    _cancel_flags.setdefault(workflow_id, threading.Event()).set()
+    _store().set_status(workflow_id, CANCELED)
+
+
+def delete(workflow_id: str) -> None:
+    _store().delete(workflow_id)
+    _cancel_flags.pop(workflow_id, None)

@@ -1,0 +1,207 @@
+"""Tests for ray_tpu.util (ActorPool, Queue, collective, check_serialize)
+and ray_tpu.workflow (durable DAG execution, resume, replay-skipping)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.util import ActorPool, Queue, inspect_serializability
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- ActorPool
+def test_actor_pool_map():
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [i * 2 for i in range(8)]
+
+
+def test_actor_pool_unordered():
+    @ray_tpu.remote
+    class Sleeper:
+        def go(self, t):
+            time.sleep(t)
+            return t
+
+    pool = ActorPool([Sleeper.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.go.remote(v), [0.2, 0.01]))
+    assert sorted(out) == [0.01, 0.2]
+    assert out[0] == 0.01  # faster task finished first
+
+
+# ----------------------------------------------------------------- Queue
+def test_queue_basic():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    with pytest.raises(Exception):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    q.shutdown()
+
+
+def test_queue_cross_task():
+    q = Queue()
+
+    def producer(qq):
+        for i in range(5):
+            qq.put(i)
+        return True
+
+    # Nested API calls require in-process execution (process workers have no
+    # fabric connection back to the driver — thread tasks do).
+    ray_tpu.get(ray_tpu.remote(producer).options(execution="thread").remote(q))
+    got = [q.get(timeout=5) for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+# ------------------------------------------------------------ collective
+def test_collective_allreduce_threads():
+    col.init_collective_group(world_size=4, rank=0, group_name="g1")
+    results = {}
+
+    def worker(rank):
+        results[rank] = col.allreduce(np.full(4, rank + 1.0), group_name="g1", rank=rank)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in range(4):
+        assert np.allclose(results[r], 10.0)  # 1+2+3+4
+    col.destroy_collective_group("g1")
+
+
+def test_collective_send_recv():
+    out = {}
+
+    def sender():
+        col.send(np.arange(3), dst_rank=1, group_name="p2p", rank=0)
+
+    def receiver():
+        out["v"] = col.recv(src_rank=0, group_name="p2p", rank=1, timeout=10)
+
+    t1, t2 = threading.Thread(target=sender), threading.Thread(target=receiver)
+    t2.start(); t1.start(); t1.join(); t2.join()
+    assert np.array_equal(out["v"], np.arange(3))
+
+
+def test_collective_in_actors():
+    col.init_collective_group(world_size=3, rank=0, group_name="ag")
+
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def gather(self, value):
+            return col.allgather(value, group_name="ag", rank=self.rank)
+
+    members = [Member.options(execution="inproc").remote(r) for r in range(3)]
+    refs = [m.gather.remote(i * 10) for i, m in enumerate(members)]
+    outs = ray_tpu.get(refs)
+    assert all(o == [0, 10, 20] for o in outs)
+    col.destroy_collective_group("ag")
+
+
+# ------------------------------------------------------- check_serialize
+def test_inspect_serializability():
+    ok, problems = inspect_serializability(lambda x: x + 1)
+    assert ok
+    lock = threading.Lock()
+    ok, problems = inspect_serializability(lock)
+    assert not ok
+    assert problems
+
+
+# -------------------------------------------------------------- workflow
+def test_workflow_run_and_output(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    dag = double.bind(add.bind(1, 2))
+    result = workflow.run(dag, workflow_id="wf1")
+    assert result == 6
+    assert workflow.get_status("wf1") == "SUCCESSFUL"
+    assert workflow.get_output("wf1") == 6
+    assert {"workflow_id": "wf1", "status": "SUCCESSFUL"} in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed(tmp_path):
+    workflow.init(str(tmp_path))
+    calls = {"n": 0}
+    marker = tmp_path / "fail_once"
+    marker.write_text("x")
+
+    @ray_tpu.remote
+    def step_a():
+        return 10
+
+    @ray_tpu.remote
+    def step_b(x):
+        import os
+
+        if os.path.exists(str(marker)):
+            os.unlink(str(marker))
+            raise RuntimeError("transient failure")
+        return x + 5
+
+    dag = step_b.bind(step_a.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2")
+    assert workflow.get_status("wf2") == "FAILED"
+    # resume: step_a's durable result is reused, step_b reruns and succeeds
+    assert workflow.resume("wf2") == 15
+    assert workflow.get_status("wf2") == "SUCCESSFUL"
+
+
+def test_workflow_run_async(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.2)
+        return "done"
+
+    fut = workflow.run_async(slow.bind(), workflow_id="wf3")
+    assert fut.result(timeout=30) == "done"
+
+
+def test_workflow_delete(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="wf4")
+    workflow.delete("wf4")
+    assert workflow.get_status("wf4") is None
