@@ -1,0 +1,57 @@
+"""TPU-native RL library — the rebuild of the reference's RLlib (`rllib/`).
+
+Reference architecture (SURVEY §2.4): `Algorithm`/`AlgorithmConfig`
+(`rllib/algorithms/algorithm.py:213`, `algorithm_config.py:117`), the new API
+stack's `RLModule` (`rllib/core/rl_module/rl_module.py`), `Learner`/
+`LearnerGroup` (`rllib/core/learner/learner.py:107`, `learner_group.py:69`),
+and `EnvRunnerGroup` sampling (`rllib/env/env_runner_group.py`).
+
+The TPU redesign: environments are pure JAX functions, so whole rollouts are
+ONE jitted `lax.scan` over a batch of vectorized envs (no per-step Python,
+no gym subprocesses — the torch stack's per-step env loop is the part that
+cannot be translated and had to be rethought). Learners are jitted optax
+updates, data-parallel over a `jax.sharding.Mesh` instead of DDP.
+"""
+
+from ray_tpu.rllib.envs import CartPole, Pendulum, JaxEnv
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import (
+    ActorCriticModule,
+    ContinuousActorCriticModule,
+    QModule,
+    SACModule,
+)
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+
+__all__ = [
+    "JaxEnv",
+    "CartPole",
+    "Pendulum",
+    "SampleBatch",
+    "ReplayBuffer",
+    "ActorCriticModule",
+    "ContinuousActorCriticModule",
+    "QModule",
+    "SACModule",
+    "EnvRunner",
+    "EnvRunnerGroup",
+    "Learner",
+    "LearnerGroup",
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+]

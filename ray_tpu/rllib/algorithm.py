@@ -1,0 +1,175 @@
+"""Algorithm / AlgorithmConfig: the RL training driver.
+
+Parity: `rllib/algorithms/algorithm.py:213` (an `Algorithm` is a Tune
+Trainable whose `train()` runs one iteration and returns a result dict) and
+`rllib/algorithms/algorithm_config.py:117` (fluent builder:
+`.environment().env_runners().training().build()`).
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+from collections import deque
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.envs import JaxEnv
+
+
+class AlgorithmConfig:
+    """Fluent config builder. Subclasses add algorithm-specific `training()`
+    keys; `build()` instantiates the matching Algorithm."""
+
+    algo_class = None  # set by subclasses
+
+    def __init__(self):
+        self.env: Optional[JaxEnv] = None
+        self.seed = 0
+        # env runners
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 8
+        self.rollout_length = 128
+        self.remote_runners = False
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 1024
+        self.max_grad_norm: Optional[float] = 0.5
+        self.hidden = (64, 64)
+
+    def environment(self, env: JaxEnv) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def env_runners(
+        self,
+        *,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_runner: Optional[int] = None,
+        rollout_length: Optional[int] = None,
+        remote: Optional[bool] = None,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        if remote is not None:
+            self.remote_runners = remote
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training key {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        if self.env is None:
+            raise ValueError("call .environment(env) before .build()")
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """Base training driver: iteration loop + metrics + checkpointing.
+
+    Subclasses implement `setup()` (build runners/learner) and
+    `training_step()` (one sample+update cycle returning learner stats).
+    """
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._episode_returns = deque(maxlen=100)
+        self.setup()
+
+    # -- subclass hooks -----------------------------------------------------
+    def setup(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    # -- public API ---------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        stats = self.training_step()
+        self.iteration += 1
+        returns = list(self._episode_returns)
+        result = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_runners": {
+                "episode_return_mean": float(np.mean(returns)) if returns else np.nan,
+                "episode_return_max": float(np.max(returns)) if returns else np.nan,
+                "num_episodes": len(returns),
+            },
+            "learners": stats,
+        }
+        # flat aliases (the reference keeps legacy top-level keys)
+        result["episode_return_mean"] = result["env_runners"]["episode_return_mean"]
+        return result
+
+    def _record_episodes(self, episode_returns, env_steps: int) -> None:
+        self._episode_returns.extend(episode_returns)
+        self._total_env_steps += env_steps
+
+    def stop(self) -> None:
+        runners = getattr(self, "runners", None)
+        if runners is not None:
+            runners.stop()
+
+    # -- checkpointing (parity: Algorithm.save/restore) ---------------------
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "learner": self.learners.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learners.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            self.set_state(pickle.load(f))
+
+    # -- Tune integration ---------------------------------------------------
+    @classmethod
+    def as_trainable(cls, config: AlgorithmConfig, stop_iters: int = 10):
+        """A Tune function-trainable running this algorithm (parity: passing
+        an Algorithm class to Tuner)."""
+
+        def trainable(tune_config: dict):
+            from ray_tpu.tune import session
+
+            cfg = config.copy()
+            for k, v in tune_config.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

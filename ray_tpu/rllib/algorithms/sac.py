@@ -1,0 +1,196 @@
+"""SAC: soft actor-critic for continuous control.
+
+Parity: `rllib/algorithms/sac/` — tanh-gaussian actor, twin Q critics with
+target networks, entropy-regularized targets with a learned temperature
+alpha tuned toward -|A| target entropy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import SACModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1000
+        self.target_update_tau = 0.005
+        self.num_updates_per_iter = 8
+        self.train_batch_size = 128
+        self.initial_alpha = 0.1
+        self.learn_alpha = True
+
+
+class _SACLearner:
+    """SAC needs three interleaved optimizers (critic, actor, alpha), so it
+    owns its update rather than reusing the single-loss Learner."""
+
+    def __init__(self, module: SACModule, cfg: SACConfig):
+        self.module = module
+        self.cfg = cfg
+        key = jax.random.key(cfg.seed)
+        self.params = module.init(key)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.log_alpha = jnp.asarray(jnp.log(cfg.initial_alpha))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_tx = optax.adam(cfg.lr)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+        self.target_entropy = -float(module.action_size)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        m, cfg = self.module, self.cfg
+
+        def update(params, target_params, log_alpha, opt_state, alpha_opt_state, batch, key):
+            alpha = jnp.exp(log_alpha)
+            knext, kpi = jax.random.split(key)
+
+            def critic_loss(p):
+                next_a, next_logp = m.sample_action(
+                    p, batch[SampleBatch.NEXT_OBS], knext
+                )
+                tq1, tq2 = m.q_values(target_params, batch[SampleBatch.NEXT_OBS], next_a)
+                next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+                not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+                target = batch[SampleBatch.REWARDS] + cfg.gamma * not_done * next_v
+                target = jax.lax.stop_gradient(target)
+                q1, q2 = m.q_values(p, batch[SampleBatch.OBS], batch[SampleBatch.ACTIONS])
+                return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+            def actor_loss(p):
+                a, logp = m.sample_action(p, batch[SampleBatch.OBS], kpi)
+                # critic params frozen for the actor step
+                q1, q2 = m.q_values(jax.lax.stop_gradient(p), batch[SampleBatch.OBS], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), jnp.mean(logp)
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            (aloss, mean_logp), agrads = jax.value_and_grad(actor_loss, has_aux=True)(params)
+            # critic step uses q grads, actor step uses pi grads
+            grads = {
+                "pi": agrads["pi"],
+                "q1": cgrads["q1"],
+                "q2": cgrads["q2"],
+            }
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return -jnp.exp(la) * jax.lax.stop_gradient(
+                    mean_logp + self.target_entropy
+                )
+
+            if cfg.learn_alpha:
+                al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+                aupd, alpha_opt_state = self.alpha_tx.update(agrad, alpha_opt_state, log_alpha)
+                log_alpha = optax.apply_updates(log_alpha, aupd)
+            target_params = jax.tree.map(
+                lambda t, o: (1 - cfg.target_update_tau) * t + cfg.target_update_tau * o,
+                target_params,
+                params,
+            )
+            stats = {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+                "alpha": jnp.exp(log_alpha),
+                "mean_logp": mean_logp,
+            }
+            return params, target_params, log_alpha, opt_state, alpha_opt_state, stats
+
+        return update
+
+    def update(self, batch: SampleBatch, key) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (
+            self.params,
+            self.target_params,
+            self.log_alpha,
+            self.opt_state,
+            self.alpha_opt_state,
+            stats,
+        ) = self._update(
+            self.params,
+            self.target_params,
+            self.log_alpha,
+            self.opt_state,
+            self.alpha_opt_state,
+            jbatch,
+            key,
+        )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "log_alpha": self.log_alpha,
+            "opt_state": self.opt_state,
+            "alpha_opt_state": self.alpha_opt_state,
+        }
+
+    def set_state(self, state):
+        for k, v in state.items():
+            setattr(self, k, v)
+
+
+class SAC(Algorithm):
+    def setup(self) -> None:
+        cfg: SACConfig = self.config
+        env = cfg.env
+        assert not env.discrete, "SAC requires a continuous-action env"
+        self.module = SACModule(
+            env.observation_size,
+            env.action_size,
+            env.action_low,
+            env.action_high,
+            cfg.hidden,
+        )
+        self.runners = EnvRunnerGroup(
+            env,
+            self.module,
+            policy="sac",
+            num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            rollout_length=cfg.rollout_length,
+            seed=cfg.seed,
+            remote=cfg.remote_runners,
+        )
+        self.learners = _SACLearner(self.module, cfg)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._key = jax.random.key(cfg.seed + 1)
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: SACConfig = self.config
+        for batch, _, ep_returns in self.runners.sample(self.learners.params):
+            self._record_episodes(ep_returns, len(batch) * batch[SampleBatch.OBS].shape[1])
+            flat = SampleBatch(
+                {
+                    k: jnp.asarray(v).reshape((-1,) + v.shape[2:])
+                    for k, v in batch.items()
+                    if k != SampleBatch.LOGP
+                }
+            )
+            self.buffer.add(flat)
+        stats: Dict[str, float] = {}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            self._key, uk = jax.random.split(self._key)
+            stats = self.learners.update(self.buffer.sample(cfg.train_batch_size), uk)
+        return stats
+
+
+SACConfig.algo_class = SAC

@@ -1,0 +1,151 @@
+"""Pure-functional JAX environments.
+
+Parity: the reference wraps stateful gym envs in `RolloutWorker`s /
+`EnvRunner`s (`rllib/env/env_runner_group.py`, `rllib/evaluation/
+rollout_worker.py`) and steps them from Python. On TPU that per-step
+host loop is the bottleneck, so envs here are pure functions —
+``reset(key) -> (state, obs)`` and ``step(state, action) -> (state, obs,
+reward, done)`` — which lets the sampler `vmap` thousands of envs and
+`lax.scan` whole rollouts inside one XLA program.
+
+CartPole and Pendulum match the classic-control dynamics (the reference's
+default smoke-test envs) so learning curves are comparable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+State = Any
+
+
+class JaxEnv:
+    """Functional env protocol. Subclasses are stateless; all state is in the
+    `state` pytree threaded through `step`."""
+
+    observation_size: int
+    # Discrete envs set num_actions; continuous envs set action_size + bounds.
+    num_actions: int = 0
+    action_size: int = 0
+    action_low: float = -1.0
+    action_high: float = 1.0
+    max_episode_steps: int = 1000
+
+    @property
+    def discrete(self) -> bool:
+        return self.num_actions > 0
+
+    def reset(self, key: jax.Array) -> Tuple[State, jax.Array]:
+        raise NotImplementedError
+
+    def step(
+        self, state: State, action: jax.Array
+    ) -> Tuple[State, jax.Array, jax.Array, jax.Array, jax.Array]:
+        """-> (next_state, obs, reward, terminated, truncated). Terminated is
+        a true environment terminal (no future value); truncated is a time
+        limit — learners must still bootstrap V/Q(next_obs) there (the
+        reference's terminateds/truncateds split). All jax, no Python
+        branching."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class CartPole(JaxEnv):
+    """Classic CartPole-v1 dynamics (Barto-Sutton-Anderson), pure JAX.
+
+    Episode ends when |x| > 2.4, |theta| > 12deg, or after 500 steps.
+    Reward is +1 per step; solved ~= return 475.
+    """
+
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5
+    force_mag: float = 10.0
+    tau: float = 0.02
+    max_episode_steps: int = 500
+
+    observation_size = 4
+    num_actions = 2
+
+    def reset(self, key: jax.Array):
+        pos = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = {"s": pos, "t": jnp.zeros((), jnp.int32)}
+        return state, pos
+
+    def step(self, state, action):
+        x, x_dot, theta, theta_dot = state["s"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costh, sinth = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sinth) / total_mass
+        thetaacc = (self.gravity * sinth - costh * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costh**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costh / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        obs = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+        terminated = (jnp.abs(x) > 2.4) | (jnp.abs(theta) > 12 * jnp.pi / 180)
+        truncated = (t >= self.max_episode_steps) & ~terminated
+        reward = jnp.ones(())
+        return {"s": obs, "t": t}, obs, reward, terminated, truncated
+
+
+@dataclasses.dataclass(frozen=True)
+class Pendulum(JaxEnv):
+    """Pendulum-v1 swing-up, pure JAX. Continuous torque in [-2, 2];
+    obs = (cos th, sin th, thdot); reward = -(th^2 + .1 thdot^2 + .001 u^2)."""
+
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    dt: float = 0.05
+    g: float = 10.0
+    m: float = 1.0
+    length: float = 1.0
+    max_episode_steps: int = 200
+
+    observation_size = 3
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def _obs(self, th, thdot):
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(self, key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        th = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = {"th": th, "thdot": thdot, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(th, thdot)
+
+    def step(self, state, action):
+        th, thdot = state["th"], state["thdot"]
+        u = jnp.clip(action.reshape(()), -self.max_torque, self.max_torque)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3 * self.g / (2 * self.length) * jnp.sin(th)
+            + 3.0 / (self.m * self.length**2) * u
+        ) * self.dt
+        thdot = jnp.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        t = state["t"] + 1
+        # pendulum never terminates; the 200-step cap is pure truncation
+        truncated = t >= self.max_episode_steps
+        return (
+            {"th": th, "thdot": thdot, "t": t},
+            self._obs(th, thdot),
+            -cost,
+            jnp.zeros((), bool),
+            truncated,
+        )

@@ -1,0 +1,52 @@
+"""Replay buffer for off-policy algorithms.
+
+Parity: `rllib/utils/replay_buffers/` (EpisodeReplayBuffer et al.) — a
+bounded FIFO transition store with uniform sampling. Host-side numpy ring
+arrays; sampled minibatches land on device only inside the learner's jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._store: Optional[dict] = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: SampleBatch) -> None:
+        batch = batch.as_numpy()
+        n = len(batch)
+        if self._store is None:
+            self._store = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        for start in range(0, n, self.capacity):
+            chunk = {k: v[start : start + self.capacity] for k, v in batch.items()}
+            m = len(next(iter(chunk.values())))
+            end = self._idx + m
+            for k, v in chunk.items():
+                if end <= self.capacity:
+                    self._store[k][self._idx : end] = v
+                else:
+                    split = self.capacity - self._idx
+                    self._store[k][self._idx :] = v[:split]
+                    self._store[k][: end - self.capacity] = v[split:]
+            self._idx = end % self.capacity
+            self._size = min(self._size + m, self.capacity)
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return SampleBatch({k: v[idx] for k, v in self._store.items()})

@@ -1,0 +1,192 @@
+"""RLModule: the neural-net container of the new API stack.
+
+Parity: `rllib/core/rl_module/rl_module.py` — a framework-agnostic module
+with `forward_exploration` / `forward_inference` / `forward_train` entry
+points owned by both EnvRunners (sampling) and Learners (updates).
+
+TPU design: a module is a frozen config + pure `init`/apply functions over a
+params pytree (same idiom as `ray_tpu.models`), so EnvRunners can close over
+them inside a jitted `lax.scan` and Learners can differentiate them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _mlp_init(key: jax.Array, dims: Sequence[int], out_scale: float = 1.0):
+    keys = jax.random.split(key, len(dims) - 1)
+    layers = []
+    for i, (k, a, b) in enumerate(zip(keys, dims[:-1], dims[1:])):
+        scale = (out_scale if i == len(dims) - 2 else 1.0) * math.sqrt(2.0 / a)
+        layers.append(
+            {"w": jax.random.normal(k, (a, b)) * scale, "b": jnp.zeros((b,))}
+        )
+    return layers
+
+
+def _mlp_apply(layers, x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class ActorCriticModule:
+    """Discrete-action actor-critic (PPO's module): shared-nothing policy and
+    value MLP heads."""
+
+    obs_size: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array):
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": _mlp_init(kp, (self.obs_size, *self.hidden, self.num_actions), 0.01),
+            "vf": _mlp_init(kv, (self.obs_size, *self.hidden, 1)),
+        }
+
+    def logits(self, params, obs: jax.Array) -> jax.Array:
+        return _mlp_apply(params["pi"], obs)
+
+    def value(self, params, obs: jax.Array) -> jax.Array:
+        return _mlp_apply(params["vf"], obs)[..., 0]
+
+    def explore(self, params, obs: jax.Array, key: jax.Array):
+        """-> (action, logp, value). Used inside the rollout scan."""
+        logits = self.logits(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, action[..., None], axis=-1)[..., 0]
+        return action, logp, self.value(params, obs)
+
+    def logp_entropy(self, params, obs: jax.Array, actions: jax.Array):
+        logits = self.logits(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[..., None], axis=-1)[..., 0]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return logp, entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousActorCriticModule:
+    """Continuous-action actor-critic: gaussian policy with state-independent
+    log-std, plus a value head. Actions are squashed by clipping in the env."""
+
+    obs_size: int
+    action_size: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array):
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": _mlp_init(kp, (self.obs_size, *self.hidden, self.action_size), 0.01),
+            "log_std": jnp.zeros((self.action_size,)),
+            "vf": _mlp_init(kv, (self.obs_size, *self.hidden, 1)),
+        }
+
+    def value(self, params, obs):
+        return _mlp_apply(params["vf"], obs)[..., 0]
+
+    def explore(self, params, obs, key):
+        mean = _mlp_apply(params["pi"], obs)
+        std = jnp.exp(params["log_std"])
+        action = mean + std * jax.random.normal(key, mean.shape)
+        logp = self._gauss_logp(mean, params["log_std"], action)
+        return action, logp, self.value(params, obs)
+
+    @staticmethod
+    def _gauss_logp(mean, log_std, action):
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((action - mean) ** 2 / var + 2 * log_std + math.log(2 * math.pi)),
+            axis=-1,
+        )
+
+    def logp_entropy(self, params, obs, actions):
+        mean = _mlp_apply(params["pi"], obs)
+        logp = self._gauss_logp(mean, params["log_std"], actions)
+        entropy = jnp.sum(params["log_std"] + 0.5 * math.log(2 * math.pi * math.e))
+        return logp, jnp.broadcast_to(entropy, logp.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class QModule:
+    """Q-network for DQN: obs -> per-action Q values."""
+
+    obs_size: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array):
+        return {"q": _mlp_init(key, (self.obs_size, *self.hidden, self.num_actions))}
+
+    def q_values(self, params, obs: jax.Array) -> jax.Array:
+        return _mlp_apply(params["q"], obs)
+
+    def explore(self, params, obs: jax.Array, key: jax.Array, epsilon: jax.Array):
+        """Epsilon-greedy action selection (vectorized over leading dims)."""
+        q = self.q_values(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        kr, ku = jax.random.split(key)
+        random_a = jax.random.randint(kr, greedy.shape, 0, self.num_actions)
+        explore = jax.random.uniform(ku, greedy.shape) < epsilon
+        return jnp.where(explore, random_a, greedy)
+
+
+@dataclasses.dataclass(frozen=True)
+class SACModule:
+    """SAC module: tanh-squashed gaussian actor + twin Q critics."""
+
+    obs_size: int
+    action_size: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array):
+        ka, k1, k2 = jax.random.split(key, 3)
+        qdims = (self.obs_size + self.action_size, *self.hidden, 1)
+        return {
+            "pi": _mlp_init(ka, (self.obs_size, *self.hidden, 2 * self.action_size)),
+            "q1": _mlp_init(k1, qdims),
+            "q2": _mlp_init(k2, qdims),
+        }
+
+    def _scale(self, tanh_a):
+        lo, hi = self.action_low, self.action_high
+        return lo + (tanh_a + 1.0) * 0.5 * (hi - lo)
+
+    def sample_action(self, params, obs, key):
+        """-> (env_action, logp) with the tanh-squash logp correction."""
+        out = _mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, -10.0, 2.0)
+        std = jnp.exp(log_std)
+        raw = mean + std * jax.random.normal(key, mean.shape)
+        logp = jnp.sum(
+            -0.5 * ((raw - mean) ** 2 / std**2 + 2 * log_std + math.log(2 * math.pi)),
+            axis=-1,
+        )
+        tanh_a = jnp.tanh(raw)
+        # log det of tanh + affine scaling jacobian
+        logp -= jnp.sum(
+            jnp.log((1 - tanh_a**2) * 0.5 * (self.action_high - self.action_low) + 1e-6),
+            axis=-1,
+        )
+        return self._scale(tanh_a), logp
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return (
+            _mlp_apply(params["q1"], x)[..., 0],
+            _mlp_apply(params["q2"], x)[..., 0],
+        )

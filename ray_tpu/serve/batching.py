@@ -12,8 +12,29 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+import weakref
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
+
+
+def _register_cleanup(instance, key, bq, bq_holder, bq_lock) -> None:
+    """Stop the batch thread and drop the holder entry when the replica
+    instance is gc'd. Guarded by identity: id() reuse after gc must not
+    evict a NEW instance's queue."""
+
+    def cleanup():
+        with bq_lock:
+            if bq_holder.get(key) is bq:
+                del bq_holder[key]
+        bq.stop()
+
+    try:
+        weakref.finalize(instance, cleanup)
+    except TypeError:
+        pass  # non-weakref-able instance: entry lives for the process
+
+
+_STOP = object()
 
 
 class _BatchQueue:
@@ -30,9 +51,17 @@ class _BatchQueue:
         self.queue.put((instance, item, fut))
         return fut
 
+    def stop(self) -> None:
+        """Terminate the loop thread (called when the owning replica is
+        gc'd — without it every retired replica leaks a thread)."""
+        self.queue.put(_STOP)
+
     def _loop(self) -> None:
         while True:
-            instance, item, fut = self.queue.get()
+            got = self.queue.get()
+            if got is _STOP:
+                return
+            instance, item, fut = got
             batch_items = [item]
             futures = [fut]
             deadline = None
@@ -44,11 +73,14 @@ class _BatchQueue:
                 if remaining <= 0:
                     break
                 try:
-                    _, it, f = self.queue.get(timeout=remaining)
+                    nxt = self.queue.get(timeout=remaining)
                 except queue.Empty:
                     break
-                batch_items.append(it)
-                futures.append(f)
+                if nxt is _STOP:
+                    self.queue.put(_STOP)  # re-deliver after this batch
+                    break
+                batch_items.append(nxt[1])
+                futures.append(nxt[2])
             try:
                 if instance is not None:
                     results = self.fn(instance, batch_items)
@@ -101,6 +133,8 @@ def batch(
                 bq = bq_holder.get(key)
                 if bq is None:
                     bq = bq_holder[key] = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    if instance is not None:
+                        _register_cleanup(instance, key, bq, bq_holder, bq_lock)
             return bq.submit(instance, item).result()
 
         method_wrapper._is_serve_batch = True

@@ -19,29 +19,18 @@ import ray_tpu
 
 
 class DeploymentResponse:
-    def __init__(self, ref, router: "Router", replica_idx: int):
+    """Future-like result. The replica's in-flight count is settled by a
+    completion callback the Router attached to the underlying ref, so a
+    `result(timeout=...)` that times out (request still occupying the
+    replica) or an abandoned response cannot skew pow-2 balancing."""
+
+    def __init__(self, ref):
         self._ref = ref
-        self._router = router
-        self._replica_idx = replica_idx
-        self._done = False
 
     def result(self, timeout: Optional[float] = None) -> Any:
-        try:
-            value = ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            self._settle()
-        return value
-
-    def _settle(self) -> None:
-        if not self._done:
-            self._done = True
-            self._router._request_finished(self._replica_idx)
+        return ray_tpu.get(self._ref, timeout=timeout)
 
     def _to_object_ref(self):
-        # Handing the ref to a downstream call (composition) transfers
-        # ownership of completion — settle now or the replica's in-flight
-        # count leaks and pow-2/autoscaling skew permanently.
-        self._settle()
         return self._ref
 
 
@@ -99,19 +88,28 @@ class Router:
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v) for k, v in kwargs.items()}
         ref = replica.handle_request.remote(method, args, kwargs)
+        ref.future().add_done_callback(lambda _f, i=idx: self._request_finished(i))
         if push:
-            try:
-                self.controller.record_request_metrics.remote(
-                    self.deployment_name, dict(self._inflight)
-                )
-            except Exception:
-                pass
-        return DeploymentResponse(ref, self, idx)
+            self._push_metrics()
+        return DeploymentResponse(ref)
+
+    def _push_metrics(self) -> None:
+        try:
+            self.controller.record_request_metrics.remote(
+                self.deployment_name, dict(self._inflight)
+            )
+        except Exception:
+            pass
 
     def _request_finished(self, idx: int) -> None:
         with self._lock:
             if idx in self._inflight and self._inflight[idx] > 0:
                 self._inflight[idx] -= 1
+            drained = not any(self._inflight.values())
+        if drained:
+            # without this push the controller's last snapshot would show
+            # ongoing requests forever and it would never scale down
+            self._push_metrics()
 
     def stale(self) -> bool:
         return True

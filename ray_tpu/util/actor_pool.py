@@ -48,11 +48,13 @@ class ActorPool:
             if not ready:
                 # leave all state intact so the caller can retry
                 raise TimeoutError("timed out waiting for result")
-        result = ray_tpu.get(future)
+        # settle bookkeeping BEFORE get: a raising task must still return its
+        # actor to the pool and advance the return cursor (the reference pops
+        # the future first for the same reason)
         del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         self._return_actor(self._future_to_actor.pop(future))
-        return result
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         if not self.has_next():
@@ -65,9 +67,8 @@ class ActorPool:
             if fut is future:
                 del self._index_to_future[idx]
                 break
-        result = ray_tpu.get(future)
         self._return_actor(self._future_to_actor.pop(future))
-        return result
+        return ray_tpu.get(future)
 
     def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
         for v in values:

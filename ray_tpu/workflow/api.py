@@ -23,7 +23,6 @@ CANCELED = "CANCELED"
 
 _storage: Optional[WorkflowStorage] = None
 _cancel_flags: Dict[str, threading.Event] = {}
-_async_results: Dict[str, Any] = {}
 
 
 def init(storage_dir: Optional[str] = None) -> None:
@@ -74,10 +73,18 @@ def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
         durable[id(node)] = False
 
     for node in order:
+        # The fetch loop is where the wall-clock goes — cancel() must be
+        # honored here, not just at submission.
+        if cancel_flag.is_set():
+            store.set_status(workflow_id, CANCELED)
+            raise RuntimeError(f"workflow {workflow_id} canceled")
         if not durable[id(node)]:
             value = ray_tpu.get(results[id(node)])
             store.save_step(workflow_id, keys[id(node)], value)
             results[id(node)] = value
+    if cancel_flag.is_set():
+        store.set_status(workflow_id, CANCELED)
+        raise RuntimeError(f"workflow {workflow_id} canceled")
     return results[id(order[-1])]
 
 
@@ -113,7 +120,6 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None):
             fut.set_exception(exc)
 
     threading.Thread(target=target, daemon=True, name=f"workflow-{workflow_id}").start()
-    _async_results[workflow_id] = fut
     return fut
 
 
