@@ -173,6 +173,15 @@ class ObjectStore:
                 return None
             return {"tier": e.tier.value, "size": e.size, "is_error": e.is_error}
 
+    def list_entries(self):
+        """[(object_id, entry_info dict)] snapshot — the state API's
+        GetObjectsInfo equivalent (node_manager.proto:426)."""
+        with self._lock:
+            return [
+                (oid, {"tier": e.tier.value, "size": e.size, "is_error": e.is_error})
+                for oid, e in self._entries.items()
+            ]
+
     # --------------------------------------------------------------- delete
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:

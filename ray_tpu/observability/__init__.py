@@ -1,0 +1,31 @@
+"""Observability: metrics, structured events, task timeline.
+
+Parity with the reference's stats/event/tracing stack:
+``src/ray/stats/metric.h:103`` (metric registry), ``src/ray/util/event.h:130``
+(structured event framework), ``src/ray/core_worker/task_event_buffer.h:206``
++ ``python/ray/_private/state.py:434`` (chrome-tracing timeline dump).
+"""
+
+from ray_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    global_registry,
+)
+from ray_tpu.observability.events import Event, EventManager, EventSeverity, global_event_manager
+from ray_tpu.observability.timeline import chrome_trace, dump_timeline
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "global_registry",
+    "Event",
+    "EventManager",
+    "EventSeverity",
+    "global_event_manager",
+    "chrome_trace",
+    "dump_timeline",
+]

@@ -1,0 +1,57 @@
+"""Chrome-tracing timeline from the control service's task-event store.
+
+Parity with ``ray timeline``: the reference buffers per-task events in each
+worker (``src/ray/core_worker/task_event_buffer.h:206``), ships them to
+``GcsTaskManager`` and dumps Chrome tracing JSON from
+``python/ray/_private/state.py:434``.  Here the control service's
+``TaskEventStore`` already holds finished-task records with submit/start/end
+timestamps; this module converts them into the ``chrome://tracing`` /
+Perfetto "X" (complete) event format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def chrome_trace(events: List[dict]) -> List[dict]:
+    """Convert task-event dicts into chrome trace 'X' events.
+
+    Each finished/failed record carries ``ts`` (end, seconds), and optionally
+    ``submit_ts``/``start_ts``; spans prefer start→end (execution) and fall
+    back to submit→end (includes queueing).
+    """
+    out: List[dict] = []
+    for ev in events:
+        end = ev.get("ts")
+        if end is None:
+            continue
+        start = ev.get("start_ts") or ev.get("submit_ts") or end
+        node = ev.get("node", "node")
+        state = ev.get("state", "FINISHED")
+        out.append(
+            {
+                "name": ev.get("name", "task"),
+                "cat": "task",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, (end - start) * 1e6),
+                "pid": f"node:{node}",
+                "tid": ev.get("worker", "worker"),
+                "cname": "thread_state_running" if state == "FINISHED" else "terrible",
+                "args": {"task_id": ev.get("task_id", ""), "state": state, "attempt": ev.get("attempt", 0)},
+            }
+        )
+    return out
+
+
+def dump_timeline(path: str, events: Optional[List[dict]] = None) -> str:
+    """Write a chrome-trace JSON file; returns the path (``ray timeline`` parity)."""
+    if events is None:
+        from ray_tpu.api import get_cluster
+
+        events = get_cluster().control.task_events.list_events(limit=100_000)
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events), f)
+    return path

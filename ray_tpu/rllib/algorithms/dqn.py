@@ -86,6 +86,15 @@ class DQN(Algorithm):
         self.target_params = jax.tree.map(jnp.copy, self.learners.params)
         self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
 
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self.target_params
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+
     def _epsilon(self) -> float:
         cfg: DQNConfig = self.config
         frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))

@@ -306,6 +306,7 @@ class Cluster:
             self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, error)
             self._after_commit(spec)
+            self._record_task_event(spec, node, "FAILED")
             return
 
         # split returns
@@ -318,10 +319,31 @@ class Cluster:
             self.directory.add_location(oid, node.node_id)
         self.task_manager.mark_completed(spec)
         self._after_commit(spec)
-        if get_config().task_events_enabled:
-            self.control.task_events.add(
-                {"task_id": spec.task_id.hex(), "name": spec.name, "state": "FINISHED", "node": node.node_id.hex()[:8], "ts": time.time()}
-            )
+        self._record_task_event(spec, node, "FINISHED")
+
+    def _record_task_event(self, spec: TaskSpec, node: Node, state: str) -> None:
+        """TaskEventBuffer→GcsTaskManager parity (task_event_buffer.h:206):
+        one record per terminal state with submit/start/end timestamps, from
+        which ``rt timeline`` builds chrome-trace spans."""
+        if not get_config().task_events_enabled:
+            return
+        self.control.task_events.add(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "node": node.node_id.hex()[:8],
+                "attempt": spec.attempt,
+                "submit_ts": spec.submit_time or None,
+                "start_ts": spec.start_time or None,
+                "ts": time.time(),
+            }
+        )
+        from ray_tpu.observability.metrics import global_registry
+
+        global_registry().counter(
+            "tasks_terminal_total", "Terminal task states by outcome"
+        ).inc(tags={"state": state})
 
     def _commit_error_everywhere(self, spec: TaskSpec, error: BaseException) -> None:
         node = self.nodes.get(spec.owner_node)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import pickle
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
@@ -110,6 +111,7 @@ class Node:
     # dispatch (deps local, resources held)
     # ------------------------------------------------------------------
     def _dispatch(self, spec: TaskSpec) -> None:
+        spec.start_time = time.time()
         if spec._cancelled:
             from ray_tpu.exceptions import TaskCancelledError
 

@@ -118,6 +118,10 @@ class PlacementGroupManager:
         with self._lock:
             return self._groups.get(pg_id)
 
+    def list_groups(self) -> List[PlacementGroupInfo]:
+        with self._lock:
+            return list(self._groups.values())
+
     def on_node_dead(self, node_id: NodeID) -> List[PlacementGroupID]:
         """Bundles on a dead node put the group into RESCHEDULING."""
         affected = []

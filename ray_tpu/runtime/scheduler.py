@@ -63,7 +63,7 @@ class TaskSpec:
         "num_returns", "return_ids", "resources", "max_retries",
         "retries_left", "execution", "actor_id", "scheduling_strategy",
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
-        "attempt", "submit_time", "_retry_exceptions", "_cancelled",
+        "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
     )
 
     def __init__(
@@ -106,6 +106,7 @@ class TaskSpec:
         self.actor_method = actor_method
         self.attempt = 0
         self.submit_time = 0.0
+        self.start_time = 0.0
         self._retry_exceptions = False
         self._cancelled = False
 

@@ -88,7 +88,14 @@ class Router:
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v) for k, v in kwargs.items()}
         ref = replica.handle_request.remote(method, args, kwargs)
-        ref.future().add_done_callback(lambda _f, i=idx: self._request_finished(i))
+        # Ready-hook, not ref.future(): a future would pull every response
+        # onto the router's node; the directory callback fires when the
+        # result is committed anywhere, without materializing it here.
+        from ray_tpu.api import get_cluster
+
+        get_cluster().directory.wait_for(
+            ref.id(), lambda _node, i=idx: self._request_finished(i)
+        )
         if push:
             self._push_metrics()
         return DeploymentResponse(ref)

@@ -1,0 +1,31 @@
+"""State API — cluster-state listing and summaries.
+
+Parity with ``python/ray/util/state/`` (``api.py:788 list_actors``,
+``:1020 list_tasks``, ``:1382 summarize_tasks``): programmatic and CLI access
+to live nodes, actors, tasks, objects, placement groups and jobs, backed by
+the control service's tables instead of a dashboard aggregator hop.
+"""
+
+from ray_tpu.state.api import (
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    summarize_actors,
+    summarize_objects,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "summarize_actors",
+    "summarize_objects",
+    "summarize_tasks",
+]

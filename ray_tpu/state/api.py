@@ -1,0 +1,208 @@
+"""State API implementation over the control service's live tables.
+
+Parity: ``python/ray/util/state/api.py`` (list_* :788,:1020; summarize_*
+:1382) + the dashboard's ``state_aggregator.py``.  The reference aggregates
+from GCS task events and per-raylet ``GetTasksInfo``/``GetObjectsInfo`` RPCs
+(``node_manager.proto:424-426``); here the same facts live in the in-process
+control service and node object stores, so listing is a table scan.
+
+Every entry is a plain dict (stable keys documented per function) so the
+dashboard REST layer can serialize them unchanged.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+def _cluster():
+    from ray_tpu.api import get_cluster
+
+    return get_cluster()
+
+
+def _limited(rows: List[dict], limit: int, filters: Optional[List[tuple]]) -> List[dict]:
+    if filters:
+        for key, op, value in filters:
+            if op == "=":
+                rows = [r for r in rows if str(r.get(key)) == str(value)]
+            elif op == "!=":
+                rows = [r for r in rows if str(r.get(key)) != str(value)]
+            else:
+                raise ValueError(f"unsupported filter op {op!r} (use '=' or '!=')")
+    return rows[:limit]
+
+
+# ----------------------------------------------------------------------
+def list_nodes(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Keys: node_id, state, address, resources_total, resources_available, labels, is_head."""
+    cluster = _cluster()
+    rows = []
+    head_id = cluster.head_node.node_id if cluster.head_node else None
+    for info in cluster.control.nodes.all_nodes():
+        node = cluster.nodes.get(info.node_id)
+        rows.append(
+            {
+                "node_id": info.node_id.hex(),
+                "state": info.state.name,
+                "address": info.address,
+                "resources_total": dict(info.resources_total),
+                "resources_available": node.pool.available.to_dict() if node and not node.dead else {},
+                "labels": dict(info.labels or {}),
+                "is_head": info.node_id == head_id,
+            }
+        )
+    return _limited(rows, limit, filters)
+
+
+def list_actors(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Keys: actor_id, class_name, name, state, node_id, job_id, restarts, max_restarts, death_cause."""
+    cluster = _cluster()
+    rows = []
+    for info in cluster.control.actors.list_actors():
+        rows.append(
+            {
+                "actor_id": info.actor_id.hex(),
+                "class_name": info.class_name,
+                "name": info.name or "",
+                "state": info.state.name,
+                "node_id": info.node_id.hex() if info.node_id else "",
+                "job_id": info.job_id.hex() if info.job_id else "",
+                "restarts": getattr(info, "num_restarts", 0),
+                "max_restarts": info.max_restarts,
+                "death_cause": getattr(info, "death_cause", "") or "",
+            }
+        )
+    return _limited(rows, limit, filters)
+
+
+def list_tasks(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Pending tasks first (live view), then recent finished task events.
+
+    Keys: task_id, name, state, node_id, attempt, duration_s.
+    """
+    cluster = _cluster()
+    rows = []
+    for spec in cluster.task_manager.pending_specs():
+        rows.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": "PENDING" if spec.actor_id is None else "PENDING_ACTOR_TASK",
+                "node_id": spec.owner_node.hex() if spec.owner_node else "",
+                "attempt": spec.attempt,
+                "duration_s": None,
+            }
+        )
+    for ev in reversed(cluster.control.task_events.list_events(limit=limit)):
+        dur = None
+        if ev.get("ts") and ev.get("start_ts"):
+            dur = round(ev["ts"] - ev["start_ts"], 6)
+        rows.append(
+            {
+                "task_id": ev.get("task_id", ""),
+                "name": ev.get("name", ""),
+                "state": ev.get("state", "FINISHED"),
+                "node_id": ev.get("node", ""),
+                "attempt": ev.get("attempt", 0),
+                "duration_s": dur,
+            }
+        )
+    return _limited(rows, limit, filters)
+
+
+def list_objects(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Keys: object_id, node_id, size_bytes, tier, is_error, ref_count."""
+    cluster = _cluster()
+    rc = cluster.core_worker.ref_counter if cluster.core_worker is not None else None
+    rows = []
+    for node in cluster.nodes.values():
+        if node.dead:
+            continue
+        for oid, info in node.store.list_entries():
+            rows.append(
+                {
+                    "object_id": oid.hex(),
+                    "node_id": node.node_id.hex(),
+                    "size_bytes": info["size"],
+                    "tier": info["tier"],
+                    "is_error": info["is_error"],
+                    "ref_count": rc.reference_counts(oid) if rc is not None else None,
+                }
+            )
+    return _limited(rows, limit, filters)
+
+
+def list_placement_groups(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Keys: placement_group_id, name, state, strategy, bundles."""
+    cluster = _cluster()
+    rows = []
+    for info in cluster.control.placement_groups.list_groups():
+        rows.append(
+            {
+                "placement_group_id": info.pg_id.hex(),
+                "name": info.name,
+                "state": info.state.name,
+                "strategy": info.strategy.name,
+                "bundles": [b.to_dict() for b in info.bundles],
+            }
+        )
+    return _limited(rows, limit, filters)
+
+
+def list_jobs(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Keys: job_id, entrypoint, status, start_time, end_time."""
+    cluster = _cluster()
+    rows = []
+    for info in cluster.control.jobs.list_jobs():
+        rows.append(
+            {
+                "job_id": info.job_id.hex(),
+                "entrypoint": info.entrypoint,
+                "status": getattr(info, "status", "RUNNING"),
+                "start_time": getattr(info, "start_time", None),
+                "end_time": getattr(info, "end_time", None),
+            }
+        )
+    return _limited(rows, limit, filters)
+
+
+# ----------------------------------------------------------------------
+# Summaries (parity: summarize_tasks/actors/objects api.py:1382+)
+# ----------------------------------------------------------------------
+def summarize_tasks() -> Dict[str, Any]:
+    """Group tasks by (name, state) with counts — ``ray summary tasks``."""
+    groups: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for row in list_tasks(limit=100_000):
+        groups[row["name"]][row["state"]] += 1
+    return {
+        "summary": {
+            name: {"state_counts": dict(states), "total": sum(states.values())}
+            for name, states in groups.items()
+        },
+        "total_tasks": sum(sum(s.values()) for s in groups.values()),
+    }
+
+
+def summarize_actors() -> Dict[str, Any]:
+    groups: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for row in list_actors(limit=100_000):
+        groups[row["class_name"] or "<anonymous>"][row["state"]] += 1
+    return {
+        "summary": {
+            cls: {"state_counts": dict(states), "total": sum(states.values())}
+            for cls, states in groups.items()
+        },
+        "total_actors": sum(sum(s.values()) for s in groups.values()),
+    }
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = list_objects(limit=1_000_000)
+    by_tier: Dict[str, Dict[str, float]] = defaultdict(lambda: {"count": 0, "bytes": 0})
+    for r in rows:
+        t = by_tier[r["tier"]]
+        t["count"] += 1
+        t["bytes"] += r["size_bytes"] or 0
+    return {"summary": {k: dict(v) for k, v in by_tier.items()}, "total_objects": len(rows)}

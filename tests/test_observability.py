@@ -1,0 +1,184 @@
+"""Observability: metrics registry, structured events, timeline, state API.
+
+Mirrors the reference's coverage of its stats/event/state surfaces
+(``src/ray/stats/``, ``src/ray/util/event.h``, ``python/ray/util/state``).
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.observability.events import EventManager, EventSeverity
+from ray_tpu.observability.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from ray_tpu.observability.timeline import chrome_trace, dump_timeline
+
+
+# ----------------------------------------------------------------------
+# metrics
+# ----------------------------------------------------------------------
+def test_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    c = reg.counter("tasks", "task count")
+    c.inc()
+    c.inc(2, tags={"state": "FINISHED"})
+    assert c.get() == 1
+    assert c.get({"state": "FINISHED"}) == 2
+
+    g = reg.gauge("mem", "bytes", "By")
+    g.set(123.5)
+    assert g.get() == 123.5
+
+    h = reg.histogram("lat", "latency", "s", boundaries=[0.1, 1, 10])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100)
+    counts, total_sum, total = h.snapshot()
+    assert counts == [1, 1, 0]       # 100 exceeds the largest bound
+    assert total == 3
+    assert total_sum == pytest.approx(100.55)
+
+
+def test_registry_same_name_returns_same_metric_and_type_conflict_raises():
+    reg = MetricsRegistry()
+    a = reg.counter("x")
+    b = reg.counter("x")
+    assert a is b
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_prometheus_rendering():
+    reg = MetricsRegistry()
+    reg.counter("tasks", "help text").inc(3, tags={"state": "FINISHED"})
+    reg.gauge("mem").set(7)
+    reg.histogram("lat", boundaries=[1, 5]).observe(0.5)
+    text = reg.render_prometheus()
+    assert "# TYPE ray_tpu_tasks counter" in text
+    assert 'ray_tpu_tasks{state="FINISHED"} 3' in text
+    assert "ray_tpu_mem 7" in text
+    assert 'ray_tpu_lat_bucket{le="1"} 1' in text
+    assert 'ray_tpu_lat_bucket{le="+Inf"} 1' in text
+    assert "ray_tpu_lat_count 1" in text
+
+
+# ----------------------------------------------------------------------
+# events
+# ----------------------------------------------------------------------
+def test_event_manager_filters_and_file_sink(tmp_path):
+    em = EventManager(log_dir=str(tmp_path))
+    em.info("raylet", "NODE_ADDED", "node up", node_id="abc")
+    em.error("gcs", "NODE_DEAD", "node down")
+    assert len(em.list_events()) == 2
+    assert len(em.list_events(severity=EventSeverity.ERROR)) == 1
+    assert em.list_events(source_type="raylet")[0].custom_fields["node_id"] == "abc"
+    lines = (tmp_path / "events.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["label"] == "NODE_DEAD"
+
+
+# ----------------------------------------------------------------------
+# timeline + state API against a live runtime
+# ----------------------------------------------------------------------
+@pytest.fixture
+def rt_cluster():
+    rt.init(num_cpus=2)
+    yield
+    rt.shutdown()
+
+
+def test_task_events_and_chrome_timeline(rt_cluster, tmp_path):
+    @rt.remote
+    def work(x):
+        return x * 2
+
+    assert rt.get([work.remote(i) for i in range(5)]) == [0, 2, 4, 6, 8]
+    events = rt.timeline()
+    finished = [e for e in events if e["state"] == "FINISHED" and e["name"] == "work"]
+    assert len(finished) == 5
+    ev = finished[0]
+    assert ev["submit_ts"] and ev["start_ts"] and ev["ts"] >= ev["start_ts"] >= ev["submit_ts"]
+
+    trace = chrome_trace(events)
+    assert all(t["ph"] == "X" for t in trace)
+    path = dump_timeline(str(tmp_path / "timeline.json"))
+    data = json.loads(open(path).read())
+    assert len(data) >= 5
+
+
+def test_failed_task_event(rt_cluster):
+    @rt.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(rt.RayTaskError):
+        rt.get(boom.remote())
+    states = {e["state"] for e in rt.timeline() if e["name"] == "boom"}
+    assert "FAILED" in states
+
+
+def test_state_api_lists(rt_cluster):
+    from ray_tpu import state
+
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="state-test").remote()
+    assert rt.get(c.incr.remote()) == 1
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    assert nodes[0]["resources_total"]["CPU"] == 2
+
+    actors = state.list_actors()
+    assert any(a["name"] == "state-test" and a["state"] == "ALIVE" for a in actors)
+
+    # filters
+    assert state.list_actors(filters=[("state", "=", "DEAD")]) == []
+
+    ref = rt.put(list(range(100)))
+    objs = state.list_objects()
+    assert any(o["object_id"] == ref.id().hex() for o in objs)
+
+    jobs = state.list_jobs()
+    assert len(jobs) == 1 and jobs[0]["status"] == "RUNNING"
+    _ = ref
+
+
+def test_state_api_summaries(rt_cluster):
+    from ray_tpu import state
+
+    @rt.remote
+    def stepper():
+        return 1
+
+    rt.get([stepper.remote() for _ in range(4)])
+    summary = state.summarize_tasks()
+    assert summary["summary"]["stepper"]["state_counts"]["FINISHED"] == 4
+
+    actors = state.summarize_actors()
+    assert isinstance(actors["total_actors"], int)
+
+    objs = state.summarize_objects()
+    assert objs["total_objects"] >= 0
+
+
+def test_task_metrics_incremented(rt_cluster):
+    from ray_tpu.observability.metrics import global_registry
+
+    before = global_registry().counter("tasks_terminal_total").get({"state": "FINISHED"})
+
+    @rt.remote
+    def t():
+        return 1
+
+    rt.get([t.remote() for _ in range(3)])
+    after = global_registry().counter("tasks_terminal_total").get({"state": "FINISHED"})
+    assert after - before >= 3
