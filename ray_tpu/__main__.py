@@ -1,0 +1,6 @@
+"""``python -m ray_tpu`` — CLI entry (``ray`` console script parity)."""
+
+from ray_tpu.scripts.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

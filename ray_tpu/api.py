@@ -39,6 +39,8 @@ def init(
     labels: Optional[dict] = None,
     _system_config: Optional[dict] = None,
     ignore_reinit_error: bool = False,
+    include_dashboard: bool = False,
+    dashboard_port: int = 0,
     **_compat,
 ):
     """Start the single-host runtime (head node + driver).
@@ -70,6 +72,10 @@ def init(
         from ray_tpu.runtime.control import JobInfo
 
         cluster.control.jobs.add(JobInfo(job_id, entrypoint="driver"))
+        if include_dashboard:
+            from ray_tpu.dashboard import DashboardHead
+
+            cluster.dashboard = DashboardHead(cluster, port=dashboard_port)
         _cluster = cluster
         return cluster
 

@@ -1,0 +1,13 @@
+"""Dashboard: REST state/metrics API + job submission endpoints.
+
+Parity with the reference's ``dashboard/`` head process (``head.py:81
+DashboardHead``) and its module system (state, jobs, metrics, events):
+a threaded stdlib HTTP server exposing the same JSON surfaces, backed
+directly by the in-process control service (no aggregator hop), plus the
+Prometheus ``/metrics`` endpoint the per-node metrics agent serves in the
+reference (``python/ray/_private/metrics_agent.py``).
+"""
+
+from ray_tpu.dashboard.head import DashboardHead
+
+__all__ = ["DashboardHead"]

@@ -1,0 +1,194 @@
+"""Dashboard head: threaded HTTP server over the state API.
+
+Routes (reference parity: ``dashboard/modules/{node,actor,job,metrics,
+event,healthz}`` REST surfaces + ``python/ray/util/state`` aggregation):
+
+  GET  /api/version                  — framework version + session
+  GET  /api/healthz                  — liveness
+  GET  /api/nodes | /api/actors | /api/tasks | /api/objects
+       /api/placement_groups        — state-API listings
+  GET  /api/cluster_status          — resource totals/availability
+  GET  /api/events                  — structured event log
+  GET  /api/summary/tasks|actors|objects
+  GET  /metrics                     — Prometheus text exposition
+  POST /api/jobs/                   — submit job {entrypoint, ...}
+  GET  /api/jobs/                   — list jobs
+  GET  /api/jobs/<id>               — job detail
+  GET  /api/jobs/<id>/logs          — captured driver logs
+  POST /api/jobs/<id>/stop          — stop a running job
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class DashboardHead:
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster
+        from ray_tpu.job.manager import JobManager
+
+        self.job_manager = JobManager(cluster)
+        head = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # silence the default stderr access log
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, payload, content_type: str = "application/json"):
+                body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    head._handle_get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    self._send(500, {"error": repr(exc)})
+
+            def do_POST(self):
+                try:
+                    head._handle_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    self._send(500, {"error": repr(exc)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, name="dashboard-head", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self.job_manager.shutdown()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _handle_get(self, req) -> None:
+        from ray_tpu import state as state_api
+        from ray_tpu._version import version
+        from ray_tpu.observability.events import global_event_manager
+        from ray_tpu.observability.metrics import global_registry
+
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/")
+        query = parse_qs(parsed.query)
+        limit = int(query.get("limit", ["1000"])[0])
+
+        if path == "/api/version":
+            req._send(200, {"version": version, "session_dir": self.cluster.session_dir})
+        elif path == "/api/healthz":
+            req._send(200, {"status": "ok"})
+        elif path == "/api/nodes":
+            req._send(200, {"nodes": state_api.list_nodes(limit=limit)})
+        elif path == "/api/actors":
+            req._send(200, {"actors": state_api.list_actors(limit=limit)})
+        elif path == "/api/tasks":
+            req._send(200, {"tasks": state_api.list_tasks(limit=limit)})
+        elif path == "/api/objects":
+            req._send(200, {"objects": state_api.list_objects(limit=limit)})
+        elif path == "/api/placement_groups":
+            req._send(200, {"placement_groups": state_api.list_placement_groups(limit=limit)})
+        elif path == "/api/cluster_status":
+            req._send(200, self._cluster_status())
+        elif path == "/api/events":
+            req._send(
+                200,
+                {"events": [e.to_dict() for e in global_event_manager().list_events(limit=limit)]},
+            )
+        elif path.startswith("/api/summary/"):
+            kind = path.rsplit("/", 1)[1]
+            fn = {
+                "tasks": state_api.summarize_tasks,
+                "actors": state_api.summarize_actors,
+                "objects": state_api.summarize_objects,
+            }.get(kind)
+            if fn is None:
+                req._send(404, {"error": f"unknown summary {kind!r}"})
+            else:
+                req._send(200, fn())
+        elif path == "/api/timeline":
+            from ray_tpu.observability.timeline import chrome_trace
+
+            events = self.cluster.control.task_events.list_events(limit=100_000)
+            req._send(200, chrome_trace(events))
+        elif path == "/metrics":
+            req._send(200, global_registry().render_prometheus().encode(), "text/plain; version=0.0.4")
+        elif path == "/api/jobs":
+            req._send(200, {"jobs": self.job_manager.list_jobs()})
+        elif path.startswith("/api/jobs/"):
+            rest = path[len("/api/jobs/"):]
+            if rest.endswith("/logs"):
+                sub_id = rest[: -len("/logs")]
+                logs = self.job_manager.get_logs(sub_id)
+                if logs is None:
+                    req._send(404, {"error": f"job {sub_id!r} not found"})
+                else:
+                    req._send(200, {"logs": logs})
+            else:
+                info = self.job_manager.get_job(rest)
+                if info is None:
+                    req._send(404, {"error": f"job {rest!r} not found"})
+                else:
+                    req._send(200, info)
+        else:
+            req._send(404, {"error": f"no route {path!r}"})
+
+    def _handle_post(self, req) -> None:
+        path = urlparse(req.path).path.rstrip("/")
+        length = int(req.headers.get("Content-Length", 0))
+        body = json.loads(req.rfile.read(length) or b"{}") if length else {}
+
+        if path == "/api/jobs":
+            entrypoint = body.get("entrypoint")
+            if not entrypoint:
+                req._send(400, {"error": "entrypoint required"})
+                return
+            sub_id = self.job_manager.submit_job(
+                entrypoint,
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+                submission_id=body.get("submission_id"),
+            )
+            req._send(200, {"submission_id": sub_id})
+        elif path.startswith("/api/jobs/") and path.endswith("/stop"):
+            sub_id = path[len("/api/jobs/"): -len("/stop")]
+            ok = self.job_manager.stop_job(sub_id)
+            req._send(200 if ok else 404, {"stopped": ok})
+        else:
+            req._send(404, {"error": f"no route {path!r}"})
+
+    # ------------------------------------------------------------------
+    def _cluster_status(self) -> dict:
+        total: dict = {}
+        available: dict = {}
+        for node in self.cluster.nodes.values():
+            if node.dead:
+                continue
+            for k, v in node.pool.total.to_dict().items():
+                total[k] = total.get(k, 0) + v
+            for k, v in node.pool.available.to_dict().items():
+                available[k] = available.get(k, 0) + v
+        return {
+            "resources_total": total,
+            "resources_available": available,
+            "num_nodes": sum(1 for n in self.cluster.nodes.values() if not n.dead),
+            "pending_tasks": self.cluster.task_manager.num_pending(),
+        }

@@ -1,0 +1,12 @@
+"""Job submission: manager, supervisor processes, and the HTTP SDK.
+
+Parity with the reference's ``dashboard/modules/job/``: ``JobManager``
+(``job_manager.py:56``) drives one supervisor per submitted job;
+``JobSubmissionClient`` (``sdk.py:39``) is the REST client; the CLI front
+end is ``rt job submit/status/logs/stop/list``.
+"""
+
+from ray_tpu.job.manager import JobManager, JobStatus
+from ray_tpu.job.sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobSubmissionClient"]

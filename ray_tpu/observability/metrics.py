@@ -151,23 +151,21 @@ class MetricsRegistry:
         for m in self.all_metrics():
             full = f"ray_tpu_{m.name}"
             if m.description:
-                lines.append(f"# HELP {full} {m.description}")
+                help_text = m.description.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {full} {help_text}")
             lines.append(f"# TYPE {full} {m.kind}")
             if isinstance(m, Histogram):
                 for key, counts, total_sum, total in m.histogram_series():
-                    base = _fmt_tags(key)
                     cum = 0
                     for b, c in zip(m.boundaries, counts):
                         cum += c
-                        lines.append(f'{full}_bucket{{{_join(base, ("le", _fnum(b)))}}} {cum}')
-                    lines.append(f'{full}_bucket{{{_join(base, ("le", "+Inf"))}}} {total}')
-                    suffix = "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}" if key else ""
-                    lines.append(f"{full}_sum{suffix} {total_sum}")
-                    lines.append(f"{full}_count{suffix} {total}")
+                        lines.append(f"{full}_bucket{_labels(key, ('le', _fnum(b)))} {cum}")
+                    lines.append(f"{full}_bucket{_labels(key, ('le', '+Inf'))} {total}")
+                    lines.append(f"{full}_sum{_labels(key)} {total_sum}")
+                    lines.append(f"{full}_count{_labels(key)} {total}")
             else:
                 for key, value in m.series():
-                    suffix = "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}" if key else ""
-                    lines.append(f"{full}{suffix} {value}")
+                    lines.append(f"{full}{_labels(key)} {value}")
         return "\n".join(lines) + "\n"
 
 
@@ -175,13 +173,17 @@ def _fnum(x: float) -> str:
     return f"{x:g}"
 
 
-def _fmt_tags(key: TagMap) -> List[Tuple[str, str]]:
-    return list(key)
+def _escape(value: str) -> str:
+    """Label-value escaping per the exposition spec: backslash, quote, newline."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def _join(base: List[Tuple[str, str]], extra: Tuple[str, str]) -> str:
-    items = base + [extra]
-    return ",".join(f'{k}="{v}"' for k, v in items)
+def _labels(key: TagMap, extra: Optional[Tuple[str, str]] = None) -> str:
+    """Render a `{k="v",...}` label suffix ("" when empty) with escaping."""
+    items = list(key) + ([extra] if extra else [])
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(str(v))}"' for k, v in items) + "}"
 
 
 _global = MetricsRegistry()

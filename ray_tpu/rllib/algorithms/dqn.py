@@ -93,7 +93,12 @@ class DQN(Algorithm):
 
     def set_state(self, state) -> None:
         super().set_state(state)
-        self.target_params = state["target_params"]
+        # Older checkpoints predate target_params; fall back to a copy of the
+        # restored online network (their behavior at save time).
+        if "target_params" in state:
+            self.target_params = state["target_params"]
+        else:
+            self.target_params = jax.tree.map(jnp.copy, self.learners.params)
 
     def _epsilon(self) -> float:
         cfg: DQNConfig = self.config

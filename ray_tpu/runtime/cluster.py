@@ -93,7 +93,15 @@ class ObjectDirectory:
     def forget(self, oid: ObjectID) -> None:
         with self._lock:
             self._locations.pop(oid, None)
-            self._waiters.pop(oid, None)
+            waiters = self._waiters.pop(oid, None)
+        # Fire waiters with None (object out of scope) instead of dropping
+        # them: a silently-dropped waiter is a leak for ready-hooks (serve
+        # router in-flight counts) and a hang for pull waiters.
+        for cb in waiters or ():
+            try:
+                cb(None)
+            except Exception:
+                pass
 
 
 class _ActorQueue:
@@ -219,7 +227,20 @@ class Cluster:
             callback()
             return
 
-        def on_located(src_node_id: NodeID) -> None:
+        def on_located(src_node_id: Optional[NodeID]) -> None:
+            if src_node_id is None:
+                # The object went out of scope while we waited. Reconstruct
+                # from lineage if possible; otherwise surface ObjectLostError
+                # to the dependent task instead of hanging it.
+                if self._try_recover(oid):
+                    self.directory.wait_for(oid, on_located)
+                    return
+                from ray_tpu.exceptions import ObjectLostError
+
+                dest_node.store.put(oid, ObjectLostError(oid), is_error=True)
+                self.directory.add_location(oid, dest_node.node_id)
+                callback()
+                return
             if src_node_id == dest_node.node_id:
                 callback()
                 return
@@ -292,9 +313,11 @@ class Cluster:
                         self.head_node.store.put(oid, value)
                         self.directory.add_location(oid, self.head_node.node_id)
                     self.task_manager.mark_completed(spec)
+                    self._record_task_event(spec, node, "FINISHED")
                 else:
                     self.task_manager.mark_failed(spec)
                     self._commit_error_everywhere(spec, error)
+                    self._record_task_event(spec, node, "FAILED")
                 self._after_commit(spec)
             return
         if error is not None:
@@ -543,6 +566,10 @@ class Cluster:
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
+        dashboard = getattr(self, "dashboard", None)
+        if dashboard is not None:
+            dashboard.shutdown()
+            self.dashboard = None
         self.control.shutdown()
         for node in self.nodes.values():
             if not node.dead:

@@ -1,0 +1,26 @@
+"""Runtime environments: per-job/task/actor execution environments.
+
+Parity with ``python/ray/_private/runtime_env/``: a plugin architecture
+(``plugin.py``) where each field of the ``runtime_env`` dict (env_vars,
+working_dir, py_modules, …) is handled by a named plugin that prepares
+resources and mutates the worker/driver process context; URI-addressed
+artifacts are cached with reference counting (``uri_cache.py``).
+"""
+
+from ray_tpu.runtime_env.plugin import (
+    RuntimeEnvPlugin,
+    apply_to_process_env,
+    get_plugin,
+    register_plugin,
+    validate_runtime_env,
+)
+from ray_tpu.runtime_env.uri_cache import URICache
+
+__all__ = [
+    "RuntimeEnvPlugin",
+    "apply_to_process_env",
+    "get_plugin",
+    "register_plugin",
+    "validate_runtime_env",
+    "URICache",
+]

@@ -1,0 +1,187 @@
+"""Runtime-env plugin architecture.
+
+Parity with ``python/ray/_private/runtime_env/plugin.py``: each runtime_env
+field maps to a plugin with validate/create/modify hooks.  Shipped plugins:
+
+  * ``env_vars``    — extra environment variables (validated str→str)
+  * ``working_dir`` — a local directory packaged (copied) into the session's
+    resource dir and used as the process cwd (``working_dir.py`` parity;
+    remote URIs are out of scope with zero egress)
+  * ``py_modules``  — local module dirs/files staged and prepended to
+    PYTHONPATH (``py_modules.py`` parity)
+  * ``pip`` / ``conda`` — declared for API parity; creation raises unless
+    the env already satisfies them, since the image has no network
+
+Creation is cached per-URI through :class:`~ray_tpu.runtime_env.uri_cache.URICache`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.runtime_env.uri_cache import URICache
+
+_RESOURCE_DIR = None
+_cache = URICache()
+
+
+def _resource_dir() -> str:
+    global _RESOURCE_DIR
+    if _RESOURCE_DIR is None:
+        _RESOURCE_DIR = os.path.join("/tmp", f"rt_runtime_env_{os.getpid()}")
+        os.makedirs(_RESOURCE_DIR, exist_ok=True)
+    return _RESOURCE_DIR
+
+
+class RuntimeEnvPlugin:
+    """Base plugin. ``name`` is the runtime_env dict key it owns."""
+
+    name: str = ""
+    priority: int = 10
+
+    def validate(self, value) -> None:
+        pass
+
+    def create(self, value) -> Optional[str]:
+        """Prepare resources; returns a URI for cache bookkeeping (or None)."""
+        return None
+
+    def modify_context(self, value, env: Dict[str, str], cwd: Optional[str]) -> Tuple[Dict[str, str], Optional[str]]:
+        """Mutate the process env/cwd the worker or driver will start with."""
+        return env, cwd
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def validate(self, value) -> None:
+        if not isinstance(value, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in value.items()
+        ):
+            raise TypeError("runtime_env['env_vars'] must be a Dict[str, str]")
+
+    def modify_context(self, value, env, cwd):
+        env.update(value)
+        return env, cwd
+
+
+def _stage_dir(path: str, kind: str) -> str:
+    """Copy a local dir/file into the session resource dir, content-addressed
+    (the reference packages to a zip URI and unpacks into a per-URI dir)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"runtime_env path does not exist: {path}")
+    h = hashlib.sha1(path.encode()).hexdigest()[:16]
+    # Keep the artifact's own basename (it must stay importable for
+    # py_modules); uniqueness comes from the hashed parent dir.
+    dest = os.path.join(_resource_dir(), f"{kind}-{h}", os.path.basename(path))
+    if not os.path.exists(dest):
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        if os.path.isdir(path):
+            shutil.copytree(path, dest)
+        else:
+            shutil.copy2(path, dest)
+    return dest
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 1
+
+    def validate(self, value) -> None:
+        if not isinstance(value, str):
+            raise TypeError("runtime_env['working_dir'] must be a local directory path")
+
+    def create(self, value) -> str:
+        return _stage_dir(value, "working_dir")
+
+    def modify_context(self, value, env, cwd):
+        staged = _cache.get_or_create(f"working_dir://{value}", lambda: self.create(value))
+        return env, staged
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 2
+
+    def validate(self, value) -> None:
+        if not isinstance(value, (list, tuple)) or not all(isinstance(v, str) for v in value):
+            raise TypeError("runtime_env['py_modules'] must be a list of local paths")
+
+    def modify_context(self, value, env, cwd):
+        staged_paths = []
+        for mod in value:
+            staged = _cache.get_or_create(f"py_modules://{mod}", lambda m=mod: _stage_dir(m, "py_modules"))
+            # a staged package dir's *parent* goes on sys.path
+            staged_paths.append(os.path.dirname(staged) if os.path.isdir(staged) else staged)
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(staged_paths + ([existing] if existing else [])))
+        return env, cwd
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Parity with ``pip.py:425``; the zero-egress image cannot install, so
+    creation verifies the requirements are already importable and otherwise
+    raises with a clear message."""
+
+    name = "pip"
+    priority = 3
+
+    def validate(self, value) -> None:
+        if not isinstance(value, (list, dict)):
+            raise TypeError("runtime_env['pip'] must be a list of requirements or a dict")
+
+    def modify_context(self, value, env, cwd):
+        import importlib.util
+
+        reqs = value if isinstance(value, list) else value.get("packages", [])
+        missing = []
+        for req in reqs:
+            base = req.split("==")[0].split(">=")[0].split("<")[0].strip().replace("-", "_")
+            if importlib.util.find_spec(base) is None:
+                missing.append(req)
+        if missing:
+            raise RuntimeError(
+                f"runtime_env pip packages not pre-installed and the environment "
+                f"has no network access: {missing}"
+            )
+        return env, cwd
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    _plugins[plugin.name] = plugin
+
+
+def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
+    return _plugins.get(name)
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin()):
+    register_plugin(_p)
+
+
+def validate_runtime_env(runtime_env: dict) -> None:
+    for key, value in runtime_env.items():
+        plugin = _plugins.get(key)
+        if plugin is None:
+            raise ValueError(f"unknown runtime_env field {key!r}; known: {sorted(_plugins)}")
+        plugin.validate(value)
+
+
+def apply_to_process_env(
+    runtime_env: dict, env: Dict[str, str], cwd: Optional[str] = None
+) -> Tuple[Dict[str, str], Optional[str]]:
+    """Run every relevant plugin's modify_context, in priority order."""
+    validate_runtime_env(runtime_env)
+    for plugin in sorted(
+        (_plugins[k] for k in runtime_env), key=lambda p: p.priority
+    ):
+        env, cwd = plugin.modify_context(runtime_env[plugin.name], env, cwd)
+    return env, cwd

@@ -1,0 +1,82 @@
+"""URI-addressed resource cache with reference counting.
+
+Parity with ``python/ray/_private/runtime_env/uri_cache.py``: created
+runtime-env artifacts (staged working dirs, py_modules) are cached by URI;
+refcounts track live users and size-bounded eviction deletes unreferenced
+artifacts oldest-first.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+
+def _dir_size(path: str) -> int:
+    if os.path.isfile(path):
+        return os.path.getsize(path)
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+class URICache:
+    def __init__(self, max_total_size_bytes: int = 10 * 1024**3):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, str]" = OrderedDict()  # uri -> local path
+        self._refs: Dict[str, int] = {}
+        self._sizes: Dict[str, int] = {}
+        self.max_total_size_bytes = max_total_size_bytes
+
+    def get_or_create(self, uri: str, creator: Callable[[], str]) -> str:
+        with self._lock:
+            path = self._entries.get(uri)
+            if path is not None and os.path.exists(path):
+                self._entries.move_to_end(uri)
+                return path
+        path = creator()
+        with self._lock:
+            self._entries[uri] = path
+            self._sizes[uri] = _dir_size(path)
+            self._evict_locked()
+        return path
+
+    def add_reference(self, uri: str) -> None:
+        with self._lock:
+            self._refs[uri] = self._refs.get(uri, 0) + 1
+
+    def remove_reference(self, uri: str) -> None:
+        with self._lock:
+            n = self._refs.get(uri, 0) - 1
+            if n <= 0:
+                self._refs.pop(uri, None)
+            else:
+                self._refs[uri] = n
+            self._evict_locked()
+
+    def get(self, uri: str) -> Optional[str]:
+        with self._lock:
+            return self._entries.get(uri)
+
+    def total_size(self) -> int:
+        with self._lock:
+            return sum(self._sizes.values())
+
+    def _evict_locked(self) -> None:
+        total = sum(self._sizes.values())
+        for uri in list(self._entries):
+            if total <= self.max_total_size_bytes:
+                break
+            if self._refs.get(uri, 0) > 0:
+                continue
+            path = self._entries.pop(uri)
+            total -= self._sizes.pop(uri, 0)
+            shutil.rmtree(path, ignore_errors=True)

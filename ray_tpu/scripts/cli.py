@@ -1,0 +1,319 @@
+"""Command-line interface.
+
+Parity with ``python/ray/scripts/scripts.py``: ``start`` :568, ``stop``
+:1044, ``status``, ``submit``/job commands :1578, ``timeline``,
+``microbenchmark`` :1862, plus the state-API ``list``/``summary`` CLI from
+``python/ray/util/state``.  Implemented with argparse (no click dependency);
+remote commands talk HTTP to a running head's dashboard.
+
+Run as ``python -m ray_tpu <cmd>`` or ``python -m ray_tpu.scripts.cli <cmd>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import urllib.request
+
+ADDRESS_FILE = "/tmp/ray_tpu/ray_current_head.json"
+
+
+def _write_address_file(info: dict) -> None:
+    os.makedirs(os.path.dirname(ADDRESS_FILE), exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        json.dump(info, f)
+
+
+def _read_address(explicit: str | None) -> str:
+    if explicit:
+        return explicit.rstrip("/")
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env.rstrip("/")
+    try:
+        with open(ADDRESS_FILE) as f:
+            return json.load(f)["dashboard_url"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        raise SystemExit(
+            "No running head found. Pass --address, set RAY_TPU_ADDRESS, or `rt start --head` first."
+        )
+
+
+def _get(address: str, path: str):
+    with urllib.request.urlopen(address + path, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+# ----------------------------------------------------------------------
+def cmd_start(args) -> int:
+    import ray_tpu as rt
+
+    rt.init(
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        include_dashboard=True,
+        dashboard_port=args.dashboard_port,
+    )
+    cluster = rt.get_cluster()
+    info = {
+        "dashboard_url": cluster.dashboard.url,
+        "pid": os.getpid(),
+        "session_dir": cluster.session_dir,
+    }
+    _write_address_file(info)
+    print(f"ray_tpu head started. Dashboard: {cluster.dashboard.url}")
+    print(f"Submit jobs with: python -m ray_tpu job submit --address {cluster.dashboard.url} -- <cmd>")
+
+    # `rt stop` sends SIGTERM (SIGINT is ignored by shells' background jobs).
+    stop_requested = {"flag": False}
+
+    def _on_term(signum, frame):
+        stop_requested["flag"] = True
+
+    signal.signal(signal.SIGTERM, _on_term)
+    try:
+        while not stop_requested["flag"]:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rt.shutdown()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    try:
+        with open(ADDRESS_FILE) as f:
+            info = json.load(f)
+    except OSError:
+        print("no head address file; nothing to stop")
+        return 0
+    pid = info.get("pid")
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"sent SIGTERM to head pid {pid}")
+            for _ in range(40):
+                time.sleep(0.25)
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+            else:
+                os.kill(pid, signal.SIGKILL)
+                print(f"head pid {pid} did not exit; killed")
+        except ProcessLookupError:
+            print(f"head pid {pid} already gone")
+    try:
+        os.unlink(ADDRESS_FILE)
+    except OSError:
+        pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    address = _read_address(args.address)
+    status = _get(address, "/api/cluster_status")
+    nodes = _get(address, "/api/nodes")["nodes"]
+    print(f"Nodes: {status['num_nodes']}  Pending tasks: {status['pending_tasks']}")
+    print("Resources:")
+    for k, total in sorted(status["resources_total"].items()):
+        avail = status["resources_available"].get(k, 0)
+        print(f"  {total - avail:g}/{total:g} {k} used")
+    for n in nodes:
+        head = " (head)" if n["is_head"] else ""
+        print(f"  node {n['node_id'][:12]} {n['state']}{head}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    address = _read_address(args.address)
+    route = {"pgs": "placement_groups"}.get(args.kind, args.kind)
+    data = _get(address, f"/api/{route}?limit={args.limit}")
+    rows = data[route]
+    print(json.dumps(rows, indent=2, default=str) if args.format == "json" else _table(rows))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    address = _read_address(args.address)
+    print(json.dumps(_get(address, f"/api/summary/{args.kind}"), indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    address = _read_address(args.address)
+    trace = _get(address, "/api/timeline")
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output} (open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    address = _read_address(args.address)
+    with urllib.request.urlopen(address + "/metrics", timeout=30) as resp:
+        sys.stdout.write(resp.read().decode())
+    return 0
+
+
+# ----------------------------------------------------------------------
+def cmd_job(args) -> int:
+    from ray_tpu.job.sdk import JobSubmissionClient
+
+    client = JobSubmissionClient(_read_address(args.address))
+    if args.job_cmd == "submit":
+        import shlex
+
+        # re-quote argv words so the shell sees the original tokens
+        entrypoint = shlex.join(args.entrypoint)
+        runtime_env = json.loads(args.runtime_env_json) if args.runtime_env_json else None
+        sub_id = client.submit_job(entrypoint=entrypoint, runtime_env=runtime_env)
+        print(f"submitted: {sub_id}")
+        if not args.no_wait:
+            info = client.wait_until_finished(sub_id, timeout=args.timeout)
+            print(f"status: {info['status']} ({info['message']})")
+            print(client.get_job_logs(sub_id), end="")
+            return 0 if info["status"] == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.submission_id) else "not found")
+    elif args.job_cmd == "list":
+        print(_table(client.list_jobs()))
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    """In-process microbenchmark suite (``ray microbenchmark`` parity,
+    driving the same cases as ``ray_perf.py``)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=args.num_cpus)
+
+    @rt.remote
+    def noop():
+        return None
+
+    @rt.remote
+    class A:
+        def m(self):
+            return None
+
+    def bench(name, fn, n):
+        for _ in range(min(100, n // 10)):
+            fn()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        dt = time.perf_counter() - t0
+        print(f"{name:45s} {n / dt:12.1f} /s")
+
+    bench("single_client_tasks_sync", lambda: rt.get(noop.remote()), 2000)
+    bench("single_client_tasks_async(batch 100)", lambda: rt.get([noop.remote() for _ in range(100)]), 30)
+    a = A.remote()
+    rt.get(a.m.remote())
+    bench("1_1_actor_calls_sync", lambda: rt.get(a.m.remote()), 2000)
+    bench("1_1_actor_calls_async(batch 100)", lambda: rt.get([a.m.remote() for _ in range(100)]), 30)
+    import numpy as np
+
+    arr = np.zeros(1024 * 1024, dtype=np.uint8)
+    bench("put_1MiB", lambda: rt.put(arr), 500)
+    rt.shutdown()
+    return 0
+
+
+# ----------------------------------------------------------------------
+def _table(rows) -> str:
+    if not rows:
+        return "(empty)"
+    cols = [c for c in rows[0] if not isinstance(rows[0][c], (dict, list))]
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    out = ["  ".join(c.ljust(widths[c]) for c in cols)]
+    out.append("  ".join("-" * widths[c] for c in cols))
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+    return "\n".join(out)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu", description="TPU-native distributed compute CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head with dashboard + job server")
+    sp.add_argument("--head", action="store_true", help="start as head (the only mode)")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the running head")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "tasks", "objects", "jobs", "pgs"])
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="state summaries")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome-tracing timeline")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("-o", "--output", default="timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("metrics", help="print Prometheus metrics")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default=None)
+    j.add_argument("--runtime-env-json", default=None)
+    j.add_argument("--no-wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER, help="-- <shell command>")
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("--address", default=None)
+        j.add_argument("submission_id")
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default=None)
+    j.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # strip a leading "--" from REMAINDER entrypoints
+    if getattr(args, "entrypoint", None) and args.entrypoint and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,232 @@
+"""Dashboard REST API, job submission, runtime envs, CLI.
+
+Parity coverage: ``dashboard/modules/job`` REST + SDK tests and the state
+CLI (``python/ray/tests/test_state_api.py`` style, scaled down).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.job.sdk import JobSubmissionClient
+
+
+@pytest.fixture
+def dash_cluster():
+    rt.init(num_cpus=2, include_dashboard=True)
+    cluster = rt.get_cluster()
+    yield cluster
+    rt.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+# ----------------------------------------------------------------------
+def test_dashboard_state_routes(dash_cluster):
+    url = dash_cluster.dashboard.url
+
+    @rt.remote
+    def f():
+        return 1
+
+    rt.get([f.remote() for _ in range(3)])
+
+    assert _get(url + "/api/healthz")["status"] == "ok"
+    assert _get(url + "/api/version")["version"]
+    assert len(_get(url + "/api/nodes")["nodes"]) == 1
+    status = _get(url + "/api/cluster_status")
+    assert status["num_nodes"] == 1 and status["resources_total"]["CPU"] == 2
+    tasks = _get(url + "/api/tasks")["tasks"]
+    assert sum(1 for t in tasks if t["name"] == "f") == 3
+    summary = _get(url + "/api/summary/tasks")
+    assert summary["summary"]["f"]["state_counts"]["FINISHED"] == 3
+    timeline = _get(url + "/api/timeline")
+    assert all(ev["ph"] == "X" for ev in timeline)
+
+
+def test_dashboard_metrics_endpoint(dash_cluster):
+    url = dash_cluster.dashboard.url
+
+    @rt.remote
+    def g():
+        return 1
+
+    rt.get(g.remote())
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "ray_tpu_tasks_terminal_total" in text
+
+
+def test_dashboard_404(dash_cluster):
+    url = dash_cluster.dashboard.url
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(url + "/api/nope")
+    assert exc_info.value.code == 404
+
+
+# ----------------------------------------------------------------------
+# job submission
+# ----------------------------------------------------------------------
+def test_job_submit_success_and_logs(dash_cluster):
+    client = JobSubmissionClient(dash_cluster.dashboard.url)
+    sub_id = client.submit_job(entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+    info = client.wait_until_finished(sub_id, timeout=60)
+    assert info["status"] == "SUCCEEDED"
+    assert "job ran ok" in client.get_job_logs(sub_id)
+    assert any(j["submission_id"] == sub_id for j in client.list_jobs())
+
+
+def test_job_failure_reports_failed(dash_cluster):
+    client = JobSubmissionClient(dash_cluster.dashboard.url)
+    sub_id = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    info = client.wait_until_finished(sub_id, timeout=60)
+    assert info["status"] == "FAILED"
+    assert "exit code 3" in info["message"]
+
+
+def test_job_stop(dash_cluster):
+    client = JobSubmissionClient(dash_cluster.dashboard.url)
+    sub_id = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    assert client.stop_job(sub_id)
+    info = client.wait_until_finished(sub_id, timeout=30)
+    assert info["status"] == "STOPPED"
+
+
+def test_job_runtime_env_env_vars_and_driver_uses_framework(dash_cluster, tmp_path):
+    client = JobSubmissionClient(dash_cluster.dashboard.url)
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import os\n"
+        "import ray_tpu as rt\n"
+        "rt.init(num_cpus=1)\n"
+        "@rt.remote\n"
+        "def f(): return os.environ.get('MY_FLAG')\n"
+        "print('flag=' + str(rt.get(f.remote())))\n"
+        "rt.shutdown()\n"
+    )
+    sub_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": {"MY_FLAG": "hello-env"}},
+    )
+    info = client.wait_until_finished(sub_id, timeout=120)
+    logs = client.get_job_logs(sub_id)
+    assert info["status"] == "SUCCEEDED", logs
+    assert "flag=hello-env" in logs
+
+
+def test_job_runtime_env_working_dir(dash_cluster, tmp_path):
+    workdir = tmp_path / "app"
+    workdir.mkdir()
+    (workdir / "data.txt").write_text("payload42")
+    client = JobSubmissionClient(dash_cluster.dashboard.url)
+    sub_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print(open('data.txt').read())\"",
+        runtime_env={"working_dir": str(workdir)},
+    )
+    info = client.wait_until_finished(sub_id, timeout=60)
+    assert info["status"] == "SUCCEEDED"
+    assert "payload42" in client.get_job_logs(sub_id)
+
+
+# ----------------------------------------------------------------------
+# runtime env plugins (unit)
+# ----------------------------------------------------------------------
+def test_runtime_env_validation():
+    from ray_tpu.runtime_env import validate_runtime_env
+
+    validate_runtime_env({"env_vars": {"A": "1"}})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"env_vars": {"A": 1}})
+    with pytest.raises(ValueError):
+        validate_runtime_env({"bogus_field": 1})
+
+
+def test_runtime_env_py_modules(tmp_path):
+    from ray_tpu.runtime_env.plugin import apply_to_process_env
+
+    pkg = tmp_path / "mypkg_rt_test"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("VALUE = 7\n")
+    env, cwd = apply_to_process_env({"py_modules": [str(pkg)]}, {})
+    assert any("py_modules" in p for p in env["PYTHONPATH"].split(os.pathsep))
+    # import works from the staged path
+    code = "import sys; sys.path[:0]=%r.split(%r); import mypkg_rt_test; print(mypkg_rt_test.VALUE)" % (
+        env["PYTHONPATH"],
+        os.pathsep,
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True)
+    assert out.stdout.strip() == "7"
+
+
+def test_uri_cache_eviction(tmp_path):
+    from ray_tpu.runtime_env.uri_cache import URICache
+
+    cache = URICache(max_total_size_bytes=100)
+
+    def make(name, size):
+        p = tmp_path / name
+        p.write_bytes(b"x" * size)
+        return str(p)
+
+    cache.get_or_create("uri://a", lambda: make("a", 80))
+    cache.add_reference("uri://a")
+    cache.get_or_create("uri://b", lambda: make("b", 80))  # exceeds: b unreferenced but a pinned
+    assert cache.get("uri://a") is not None
+    cache.remove_reference("uri://a")
+    cache.get_or_create("uri://c", lambda: make("c", 80))
+    # total > 100 → oldest unreferenced evicted
+    assert cache.total_size() <= 160
+
+
+# ----------------------------------------------------------------------
+# CLI (against a live dashboard over HTTP)
+# ----------------------------------------------------------------------
+def test_cli_status_list_summary(dash_cluster, capsys):
+    from ray_tpu.scripts.cli import main
+
+    url = dash_cluster.dashboard.url
+
+    @rt.remote
+    def h():
+        return 1
+
+    rt.get(h.remote())
+
+    assert main(["status", "--address", url]) == 0
+    out = capsys.readouterr().out
+    assert "Nodes: 1" in out
+
+    assert main(["list", "nodes", "--address", url]) == 0
+    assert "node_id" in capsys.readouterr().out
+
+    assert main(["summary", "tasks", "--address", url]) == 0
+    assert "h" in capsys.readouterr().out
+
+
+def test_cli_timeline_and_job(dash_cluster, tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    url = dash_cluster.dashboard.url
+
+    @rt.remote
+    def k():
+        return 1
+
+    rt.get(k.remote())
+    out_file = tmp_path / "tl.json"
+    assert main(["timeline", "--address", url, "-o", str(out_file)]) == 0
+    assert json.loads(out_file.read_text())
+
+    rc = main(
+        ["job", "submit", "--address", url, "--", sys.executable, "-c", "print('cli job')"]
+    )
+    assert rc == 0
+    assert "cli job" in capsys.readouterr().out
