@@ -1,0 +1,37 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+TPU-native rebuild of the reference autoscaler
+(``python/ray/autoscaler/_private/autoscaler.py:172`` StandardAutoscaler,
+``resource_demand_scheduler.py`` bin-packing, ``monitor.py`` head-node loop,
+``python/ray/autoscaler/node_provider.py`` provider plugins, and the v2
+declarative rewrite under ``python/ray/autoscaler/v2/``).
+
+Differences by design: node types are TPU-slice-aware (a "node type" can be a
+whole slice, added or removed atomically so an ICI mesh is never fractured),
+and providers materialize in-process nodes against the live ``Cluster``
+fabric (the FakeMultiNodeProvider strategy,
+``python/ray/autoscaler/_private/fake_multi_node/node_provider.py:237``,
+promoted to the primary test path).
+"""
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.demand import NodeTypeConfig, get_nodes_to_launch
+from ray_tpu.autoscaler.monitor import Monitor
+from ray_tpu.autoscaler.node_provider import (
+    InProcessNodeProvider,
+    NodeProvider,
+    TPU_SLICE_TOPOLOGIES,
+    TPUSliceProvider,
+)
+
+__all__ = [
+    "AutoscalerConfig",
+    "StandardAutoscaler",
+    "NodeTypeConfig",
+    "get_nodes_to_launch",
+    "Monitor",
+    "NodeProvider",
+    "InProcessNodeProvider",
+    "TPUSliceProvider",
+    "TPU_SLICE_TOPOLOGIES",
+]

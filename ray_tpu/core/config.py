@@ -64,6 +64,9 @@ class Config:
     # gcs_health_check_manager.h).
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    # How long an unschedulable task waits for capacity (e.g. autoscaler
+    # scale-up) before failing as infeasible.
+    infeasible_task_timeout_s: float = 30.0
 
     # ---- compile cache ---------------------------------------------------
     # Cache compiled executables keyed by (fn, shapes, shardings).

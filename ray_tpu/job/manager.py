@@ -40,6 +40,7 @@ class _JobEntry:
         self.end_time: Optional[float] = None
         self.proc: Optional[subprocess.Popen] = None
         self.log_path: Optional[str] = None
+        self.env_uris: list = []
 
     def to_dict(self) -> dict:
         return {
@@ -84,15 +85,32 @@ class JobManager:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         cwd = None
+        env_uris: list = []
         if runtime_env:
-            from ray_tpu.runtime_env.plugin import apply_to_process_env
+            from ray_tpu.runtime_env.plugin import apply_to_process_env, remove_references
 
-            env, cwd = apply_to_process_env(runtime_env, env)
+            try:
+                # plugins pin each staged artifact (refcount) as they stage it;
+                # released in _watch when the process exits.
+                env, cwd = apply_to_process_env(runtime_env, env, uris_out=env_uris)
+            except Exception as exc:
+                with self._lock:
+                    entry.status = JobStatus.FAILED
+                    entry.message = f"runtime_env setup failed: {exc}"
+                    entry.end_time = time.time()
+                remove_references(env_uris)
+                return sub_id
+        entry.env_uris = env_uris
+
+        with self._lock:
+            if entry.status == JobStatus.STOPPED:  # stop raced env staging
+                self._release_env(entry)
+                return sub_id
 
         entry.log_path = os.path.join(self._log_dir, f"job-{sub_id}.log")
         log_file = open(entry.log_path, "wb")
         try:
-            entry.proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 entrypoint,
                 shell=True,
                 stdout=log_file,
@@ -106,12 +124,29 @@ class JobManager:
             entry.message = f"failed to start: {exc}"
             entry.end_time = time.time()
             log_file.close()
+            self._release_env(entry)
             return sub_id
-        entry.status = JobStatus.RUNNING
+        with self._lock:
+            entry.proc = proc
+            stopped_mid_start = entry.status == JobStatus.STOPPED
+            if not stopped_mid_start:
+                entry.status = JobStatus.RUNNING
+        if stopped_mid_start:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
         threading.Thread(
             target=self._watch, args=(entry, log_file), name=f"job-{sub_id}", daemon=True
         ).start()
         return sub_id
+
+    def _release_env(self, entry: _JobEntry) -> None:
+        if entry.env_uris:
+            from ray_tpu.runtime_env.plugin import remove_references
+
+            remove_references(entry.env_uris)
+            entry.env_uris = []
 
     def _watch(self, entry: _JobEntry, log_file) -> None:
         code = entry.proc.wait()
@@ -121,6 +156,7 @@ class JobManager:
                 entry.status = JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED
                 entry.message = f"exit code {code}"
             entry.end_time = time.time()
+        self._release_env(entry)
 
     # ------------------------------------------------------------------
     def get_job(self, submission_id: str) -> Optional[dict]:
@@ -148,12 +184,18 @@ class JobManager:
             entry = self._jobs.get(submission_id)
             if entry is None:
                 return False
-            if entry.status != JobStatus.RUNNING or entry.proc is None:
+            if entry.status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
                 return True
+            # PENDING (still staging) or RUNNING: record the stop; the
+            # submit path honors it if the process hasn't launched yet.
             entry.status = JobStatus.STOPPED
             entry.message = "stopped by user"
+            proc = entry.proc
+            if proc is None:
+                entry.end_time = time.time()
+                return True
         try:
-            os.killpg(os.getpgid(entry.proc.pid), signal.SIGTERM)
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             pass
         return True

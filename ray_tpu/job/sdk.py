@@ -64,7 +64,12 @@ class JobSubmissionClient:
         return self._request("GET", f"/api/jobs/{submission_id}/logs")["logs"]
 
     def stop_job(self, submission_id: str) -> bool:
-        return self._request("POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+        try:
+            return self._request("POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+        except RuntimeError as exc:
+            if "-> 404" in str(exc):  # unknown submission id
+                return False
+            raise
 
     def list_jobs(self) -> List[dict]:
         return self._request("GET", "/api/jobs/")["jobs"]

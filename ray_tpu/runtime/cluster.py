@@ -144,6 +144,11 @@ class Cluster:
                 self.shm_store = None
         self.transfer_bytes = 0
         self.transfer_count = 0
+        # pending resource demand, read by the autoscaler (parity with the
+        # load the GCS reports to the monitor process,
+        # python/ray/autoscaler/_private/monitor.py): spec id -> resource dict.
+        self._infeasible_demands: Dict[int, Dict[str, float]] = {}
+        self._demand_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # topology
@@ -160,6 +165,7 @@ class Cluster:
         self.control.placement_groups.bind_node_pools(
             {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
         )
+        self.control.placement_groups.retry_pending()
         return node
 
     def kill_node(self, node_id: NodeID) -> None:
@@ -203,21 +209,46 @@ class Cluster:
         self.nodes[node_id].submit(spec)
 
     def _park_infeasible(self, spec: TaskSpec) -> None:
+        key = id(spec)
+        with self._demand_lock:
+            self._infeasible_demands[key] = spec.resources.to_dict()
+
         def retry_later():
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline:
-                time.sleep(0.05)
-                node_id = self.cluster_scheduler.pick_node(spec)
-                if node_id is not None:
-                    self.nodes[node_id].submit(spec)
-                    return
-            self.task_manager.mark_failed(spec)
-            self._commit_error_everywhere(
-                spec,
-                RayTaskError(spec.name, f"Task {spec.name} is infeasible: requires {spec.resources.to_dict()}"),
-            )
+            try:
+                deadline = time.monotonic() + get_config().infeasible_task_timeout_s
+                while time.monotonic() < deadline:
+                    time.sleep(0.05)
+                    node_id = self.cluster_scheduler.pick_node(spec)
+                    if node_id is not None:
+                        # deregister demand BEFORE submit: dispatch can block
+                        # (worker spawn) and the autoscaler must not see both
+                        # the demand and its already-acquired resources.
+                        with self._demand_lock:
+                            self._infeasible_demands.pop(key, None)
+                        self.nodes[node_id].submit(spec)
+                        return
+                self.task_manager.mark_failed(spec)
+                self._commit_error_everywhere(
+                    spec,
+                    RayTaskError(spec.name, f"Task {spec.name} is infeasible: requires {spec.resources.to_dict()}"),
+                )
+            finally:
+                with self._demand_lock:
+                    self._infeasible_demands.pop(key, None)
 
         threading.Thread(target=retry_later, daemon=True).start()
+
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Resource shapes of currently-unschedulable work, for the
+        autoscaler (the load the reference's GCS reports to the monitor)."""
+        with self._demand_lock:
+            demands = list(self._infeasible_demands.values())
+        from ray_tpu.runtime.placement import PlacementGroupState
+
+        for info in self.control.placement_groups.list_groups():
+            if info.state is PlacementGroupState.PENDING:
+                demands.extend(b.to_dict() for b in info.bundles)
+        return demands
 
     # ------------------------------------------------------------------
     # object pulls / transfer
@@ -237,8 +268,10 @@ class Cluster:
                     return
                 from ray_tpu.exceptions import ObjectLostError
 
+                # Local error tombstone so the dependent task fails fast; NOT
+                # registered in the directory — the object is forgotten and
+                # no other node must discover this node as a "location".
                 dest_node.store.put(oid, ObjectLostError(oid), is_error=True)
-                self.directory.add_location(oid, dest_node.node_id)
                 callback()
                 return
             if src_node_id == dest_node.node_id:

@@ -67,9 +67,21 @@ class PlacementGroupManager:
     def bind_node_pools(self, pools) -> None:
         self._node_pools = pools
 
+    def retry_pending(self) -> None:
+        """Re-attempt PENDING groups (called when capacity joins — parity
+        with GcsPlacementGroupManager retrying on node add)."""
+        with self._lock:
+            pending = [g for g in self._groups.values() if g.state is PlacementGroupState.PENDING]
+        for info in pending:
+            self.create(info)
+
     # ------------------------------------------------------------------
     def create(self, info: PlacementGroupInfo) -> bool:
         with self._lock:
+            if info.state is PlacementGroupState.REMOVED:
+                # a retry_pending snapshot racing a concurrent remove() must
+                # not resurrect the group
+                return False
             self._groups[info.pg_id] = info
             placements = self._schedule(info)
             if placements is None:

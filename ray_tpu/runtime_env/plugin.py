@@ -49,8 +49,16 @@ class RuntimeEnvPlugin:
         """Prepare resources; returns a URI for cache bookkeeping (or None)."""
         return None
 
-    def modify_context(self, value, env: Dict[str, str], cwd: Optional[str]) -> Tuple[Dict[str, str], Optional[str]]:
-        """Mutate the process env/cwd the worker or driver will start with."""
+    def modify_context(
+        self,
+        value,
+        env: Dict[str, str],
+        cwd: Optional[str],
+        uris: Optional[list] = None,
+    ) -> Tuple[Dict[str, str], Optional[str]]:
+        """Mutate the process env/cwd the worker or driver will start with.
+        Staging plugins append the cache URIs they used to ``uris`` so the
+        caller can hold references for the process's lifetime."""
         return env, cwd
 
 
@@ -64,27 +72,62 @@ class EnvVarsPlugin(RuntimeEnvPlugin):
         ):
             raise TypeError("runtime_env['env_vars'] must be a Dict[str, str]")
 
-    def modify_context(self, value, env, cwd):
+    def modify_context(self, value, env, cwd, uris=None):
         env.update(value)
         return env, cwd
 
 
-def _stage_dir(path: str, kind: str) -> str:
-    """Copy a local dir/file into the session resource dir, content-addressed
-    (the reference packages to a zip URI and unpacks into a per-URI dir)."""
+def _fingerprint(path: str) -> str:
+    """Cheap content fingerprint: relative names + sizes + mtimes. A changed
+    source dir therefore yields a new URI and gets re-staged (the reference
+    hashes the packaged zip the same way, packaging.py)."""
     path = os.path.abspath(os.path.expanduser(path))
     if not os.path.exists(path):
         raise FileNotFoundError(f"runtime_env path does not exist: {path}")
-    h = hashlib.sha1(path.encode()).hexdigest()[:16]
+    h = hashlib.sha1(path.encode())
+    if os.path.isfile(path):
+        st = os.stat(path)
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+    else:
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for f in sorted(files):
+                fp = os.path.join(root, f)
+                try:
+                    st = os.stat(fp)
+                except OSError:
+                    continue
+                h.update(f"{os.path.relpath(fp, path)}:{st.st_size}:{st.st_mtime_ns};".encode())
+    return h.hexdigest()[:16]
+
+
+def uri_for(path: str, kind: str) -> str:
+    return f"{kind}://{os.path.abspath(os.path.expanduser(path))}@{_fingerprint(path)}"
+
+
+def _stage_dir(path: str, kind: str) -> str:
+    """Copy a local dir/file into the session resource dir, content-addressed;
+    the copy lands in a temp dir and is renamed into place so readers never
+    see a partial stage."""
+    path = os.path.abspath(os.path.expanduser(path))
+    h = _fingerprint(path)
     # Keep the artifact's own basename (it must stay importable for
     # py_modules); uniqueness comes from the hashed parent dir.
-    dest = os.path.join(_resource_dir(), f"{kind}-{h}", os.path.basename(path))
+    parent = os.path.join(_resource_dir(), f"{kind}-{h}")
+    dest = os.path.join(parent, os.path.basename(path))
     if not os.path.exists(dest):
-        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp_parent = parent + ".tmp"
+        shutil.rmtree(tmp_parent, ignore_errors=True)
+        os.makedirs(tmp_parent, exist_ok=True)
+        tmp = os.path.join(tmp_parent, os.path.basename(path))
         if os.path.isdir(path):
-            shutil.copytree(path, dest)
+            shutil.copytree(path, tmp)
         else:
-            shutil.copy2(path, dest)
+            shutil.copy2(path, tmp)
+        try:
+            os.rename(tmp_parent, parent)
+        except OSError:
+            shutil.rmtree(tmp_parent, ignore_errors=True)  # a racer won
     return dest
 
 
@@ -99,8 +142,11 @@ class WorkingDirPlugin(RuntimeEnvPlugin):
     def create(self, value) -> str:
         return _stage_dir(value, "working_dir")
 
-    def modify_context(self, value, env, cwd):
-        staged = _cache.get_or_create(f"working_dir://{value}", lambda: self.create(value))
+    def modify_context(self, value, env, cwd, uris=None):
+        uri = uri_for(value, "working_dir")
+        staged = _cache.get_or_create(uri, lambda: self.create(value), add_ref=uris is not None)
+        if uris is not None:
+            uris.append(uri)
         return env, staged
 
 
@@ -112,10 +158,15 @@ class PyModulesPlugin(RuntimeEnvPlugin):
         if not isinstance(value, (list, tuple)) or not all(isinstance(v, str) for v in value):
             raise TypeError("runtime_env['py_modules'] must be a list of local paths")
 
-    def modify_context(self, value, env, cwd):
+    def modify_context(self, value, env, cwd, uris=None):
         staged_paths = []
         for mod in value:
-            staged = _cache.get_or_create(f"py_modules://{mod}", lambda m=mod: _stage_dir(m, "py_modules"))
+            uri = uri_for(mod, "py_modules")
+            staged = _cache.get_or_create(
+                uri, lambda m=mod: _stage_dir(m, "py_modules"), add_ref=uris is not None
+            )
+            if uris is not None:
+                uris.append(uri)
             # a staged package dir's *parent* goes on sys.path
             staged_paths.append(os.path.dirname(staged) if os.path.isdir(staged) else staged)
         existing = env.get("PYTHONPATH", "")
@@ -135,14 +186,27 @@ class PipPlugin(RuntimeEnvPlugin):
         if not isinstance(value, (list, dict)):
             raise TypeError("runtime_env['pip'] must be a list of requirements or a dict")
 
-    def modify_context(self, value, env, cwd):
+    def modify_context(self, value, env, cwd, uris=None):
+        import importlib.metadata
         import importlib.util
+
+        # distribution name -> importable module(s): "scikit-learn" installs
+        # "sklearn" etc.; packages_distributions() gives module -> [dists].
+        dist_modules: Dict[str, list] = {}
+        try:
+            for module, dists in importlib.metadata.packages_distributions().items():
+                for d in dists:
+                    dist_modules.setdefault(d.lower().replace("_", "-"), []).append(module)
+        except Exception:
+            pass
 
         reqs = value if isinstance(value, list) else value.get("packages", [])
         missing = []
         for req in reqs:
-            base = req.split("==")[0].split(">=")[0].split("<")[0].strip().replace("-", "_")
-            if importlib.util.find_spec(base) is None:
+            base = req.split("==")[0].split(">=")[0].split("<")[0].strip()
+            candidates = dist_modules.get(base.lower().replace("_", "-"), [])
+            candidates.append(base.replace("-", "_"))
+            if not any(importlib.util.find_spec(c) is not None for c in candidates):
                 missing.append(req)
         if missing:
             raise RuntimeError(
@@ -176,12 +240,31 @@ def validate_runtime_env(runtime_env: dict) -> None:
 
 
 def apply_to_process_env(
-    runtime_env: dict, env: Dict[str, str], cwd: Optional[str] = None
+    runtime_env: dict,
+    env: Dict[str, str],
+    cwd: Optional[str] = None,
+    uris_out: Optional[list] = None,
 ) -> Tuple[Dict[str, str], Optional[str]]:
-    """Run every relevant plugin's modify_context, in priority order."""
+    """Run every relevant plugin's modify_context, in priority order.
+
+    Pass ``uris_out`` to collect the cache URIs the env uses; each staged
+    artifact is reference-pinned atomically as it is handed out, so eviction
+    never deletes a directory a live job is running from. Release with
+    :func:`remove_references` when the process exits.
+    """
     validate_runtime_env(runtime_env)
     for plugin in sorted(
         (_plugins[k] for k in runtime_env), key=lambda p: p.priority
     ):
-        env, cwd = plugin.modify_context(runtime_env[plugin.name], env, cwd)
+        env, cwd = plugin.modify_context(runtime_env[plugin.name], env, cwd, uris_out)
     return env, cwd
+
+
+def add_references(uris: list) -> None:
+    for uri in uris:
+        _cache.add_reference(uri)
+
+
+def remove_references(uris: list) -> None:
+    for uri in uris:
+        _cache.remove_reference(uri)

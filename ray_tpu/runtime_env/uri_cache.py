@@ -34,20 +34,36 @@ class URICache:
         self._entries: "OrderedDict[str, str]" = OrderedDict()  # uri -> local path
         self._refs: Dict[str, int] = {}
         self._sizes: Dict[str, int] = {}
+        self._creation_locks: Dict[str, threading.Lock] = {}
         self.max_total_size_bytes = max_total_size_bytes
 
-    def get_or_create(self, uri: str, creator: Callable[[], str]) -> str:
+    def get_or_create(self, uri: str, creator: Callable[[], str], add_ref: bool = False) -> str:
+        """Return the artifact path, creating it if absent.
+
+        ``add_ref=True`` takes a reference atomically with the lookup, so no
+        eviction window exists between handing out the path and the caller
+        pinning it (pair with :meth:`remove_reference`).
+        """
+        # Serialize creation per URI so two concurrent submissions with the
+        # same new artifact don't both run the creator (and race the copy).
         with self._lock:
-            path = self._entries.get(uri)
-            if path is not None and os.path.exists(path):
-                self._entries.move_to_end(uri)
-                return path
-        path = creator()
-        with self._lock:
-            self._entries[uri] = path
-            self._sizes[uri] = _dir_size(path)
-            self._evict_locked()
-        return path
+            creation_lock = self._creation_locks.setdefault(uri, threading.Lock())
+        with creation_lock:
+            with self._lock:
+                path = self._entries.get(uri)
+                if path is not None and os.path.exists(path):
+                    self._entries.move_to_end(uri)
+                    if add_ref:
+                        self._refs[uri] = self._refs.get(uri, 0) + 1
+                    return path
+            path = creator()
+            with self._lock:
+                self._entries[uri] = path
+                self._sizes[uri] = _dir_size(path)
+                if add_ref:
+                    self._refs[uri] = self._refs.get(uri, 0) + 1
+                self._evict_locked()
+            return path
 
     def add_reference(self, uri: str) -> None:
         with self._lock:

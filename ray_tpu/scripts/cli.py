@@ -85,6 +85,24 @@ def cmd_start(args) -> int:
     return 0
 
 
+def _pid_is_head(pid: int) -> bool:
+    """Guard against pid reuse: only signal a process that is actually a
+    ray_tpu head (checked via /proc cmdline; best-effort elsewhere)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\x00", b" ").decode(errors="replace")
+        return "ray_tpu" in cmdline
+    except FileNotFoundError:
+        return False
+    except OSError:
+        # no /proc (non-Linux): fall back to existence only
+        try:
+            os.kill(pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+
 def cmd_stop(args) -> int:
     try:
         with open(ADDRESS_FILE) as f:
@@ -93,7 +111,11 @@ def cmd_stop(args) -> int:
         print("no head address file; nothing to stop")
         return 0
     pid = info.get("pid")
-    if pid:
+    if pid and not _pid_is_head(pid):
+        # stale address file: the pid died and may have been recycled by an
+        # unrelated process — never signal it
+        print(f"head pid {pid} is gone (stale address file)")
+    elif pid:
         try:
             os.kill(pid, signal.SIGTERM)
             print(f"sent SIGTERM to head pid {pid}")
@@ -104,9 +126,10 @@ def cmd_stop(args) -> int:
                 except ProcessLookupError:
                     break
             else:
-                os.kill(pid, signal.SIGKILL)
-                print(f"head pid {pid} did not exit; killed")
-        except ProcessLookupError:
+                if _pid_is_head(pid):
+                    os.kill(pid, signal.SIGKILL)
+                    print(f"head pid {pid} did not exit; killed")
+        except (ProcessLookupError, PermissionError):
             print(f"head pid {pid} already gone")
     try:
         os.unlink(ADDRESS_FILE)
@@ -246,12 +269,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu", description="TPU-native distributed compute CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sp = sub.add_parser("start", help="start a head with dashboard + job server")
-    sp.add_argument("--head", action="store_true", help="start as head (the only mode)")
+    sp = sub.add_parser(
+        "start",
+        help="start a head with dashboard + job server (blocks: the head "
+        "lives in this process; run it in the background to detach)",
+    )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--dashboard-port", type=int, default=8265)
-    sp.add_argument("--block", action="store_true")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the running head")

@@ -1,0 +1,243 @@
+"""Autoscaler: demand bin-packing, scale-up on infeasible load, idle
+scale-down, TPU slice atomicity.
+
+Parity: reference resource_demand_scheduler tests + autoscaler fake-multinode
+e2e (python/ray/tests/test_autoscaler_fake_multinode.py) — the monitor loop
+runs for real against in-process nodes.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    InProcessNodeProvider,
+    Monitor,
+    NodeTypeConfig,
+    TPUSliceProvider,
+    get_nodes_to_launch,
+)
+
+
+# ---------------------------------------------------------------------------
+# demand scheduler (pure unit)
+# ---------------------------------------------------------------------------
+def test_demand_packs_into_existing_capacity():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 4})}
+    out = get_nodes_to_launch(types, {"worker": 1}, [{"CPU": 4.0}], [{"CPU": 2.0}, {"CPU": 2.0}])
+    assert out == {}
+
+
+def test_demand_launches_for_residual():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 4})}
+    out = get_nodes_to_launch(types, {}, [], [{"CPU": 2.0}] * 6)
+    assert out == {"worker": 3}
+
+
+def test_demand_respects_max_workers():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 4}, max_workers=2)}
+    out = get_nodes_to_launch(types, {}, [], [{"CPU": 4.0}] * 5)
+    assert out == {"worker": 2}
+
+
+def test_demand_min_workers_enforced():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 4}, min_workers=2)}
+    out = get_nodes_to_launch(types, {}, [], [])
+    assert out == {"worker": 2}
+
+
+def test_demand_picks_best_fitting_type():
+    types = {
+        "cpu": NodeTypeConfig("cpu", {"CPU": 16}),
+        "tpu": NodeTypeConfig("tpu", {"CPU": 8, "TPU": 8}),
+    }
+    out = get_nodes_to_launch(types, {}, [], [{"TPU": 8.0}])
+    assert out == {"tpu": 1}
+    out = get_nodes_to_launch(types, {}, [], [{"CPU": 16.0}])
+    assert out == {"cpu": 1}
+
+
+def test_demand_infeasible_launches_nothing():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 4})}
+    out = get_nodes_to_launch(types, {}, [], [{"GPU": 1.0}])
+    assert out == {}
+
+
+def test_global_max_workers_cap():
+    types = {"worker": NodeTypeConfig("worker", {"CPU": 1})}
+    out = get_nodes_to_launch(types, {}, [], [{"CPU": 1.0}] * 10, max_total_workers=3)
+    assert out == {"worker": 3}
+
+
+# ---------------------------------------------------------------------------
+# e2e against the live fabric
+# ---------------------------------------------------------------------------
+def test_scale_up_makes_infeasible_task_runnable(ray_start_cluster):
+    rt, cluster = ray_start_cluster  # head has 2 CPU
+    config = AutoscalerConfig(
+        node_types={"big": NodeTypeConfig("big", {"CPU": 8})},
+        idle_timeout_s=3600,
+        update_interval_s=0.1,
+    )
+    monitor = Monitor(cluster, config).start()
+    try:
+
+        @rt.remote(num_cpus=8)
+        def needs_big():
+            return "ran"
+
+        assert rt.get(needs_big.remote(), timeout=20) == "ran"
+        assert monitor.autoscaler.num_launches >= 1
+    finally:
+        monitor.stop()
+
+
+def test_idle_nodes_terminate(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    provider = InProcessNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig("w", {"CPU": 4})},
+        idle_timeout_s=0.3,
+        update_interval_s=0.05,
+    )
+    monitor = Monitor(cluster, config, provider=provider).start()
+    try:
+
+        @rt.remote(num_cpus=4)
+        def f():
+            return 1
+
+        assert rt.get(f.remote(), timeout=20) == 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.1)
+        assert not provider.non_terminated_nodes()
+        assert monitor.autoscaler.num_terminations >= 1
+    finally:
+        monitor.stop()
+
+
+def test_min_workers_never_terminated(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    provider = InProcessNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig("w", {"CPU": 4}, min_workers=1)},
+        idle_timeout_s=0.1,
+        update_interval_s=0.05,
+    )
+    monitor = Monitor(cluster, config, provider=provider).start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not provider.non_terminated_nodes():
+            time.sleep(0.05)
+        assert len(provider.non_terminated_nodes()) == 1
+        time.sleep(0.5)  # well past idle_timeout
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        monitor.stop()
+
+
+def test_pending_placement_group_triggers_scale_up(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    from ray_tpu.core.ids import PlacementGroupID
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu.runtime.placement import (
+        PlacementGroupInfo,
+        PlacementGroupState,
+        PlacementStrategy,
+    )
+
+    info = PlacementGroupInfo(
+        PlacementGroupID.from_random(),
+        [ResourceSet({"CPU": 8.0})],
+        PlacementStrategy.PACK,
+    )
+    cluster.control.placement_groups.create(info)
+    assert info.state is PlacementGroupState.PENDING
+    assert {"CPU": 8.0} in cluster.pending_resource_demands()
+
+    config = AutoscalerConfig(
+        node_types={"big": NodeTypeConfig("big", {"CPU": 8})},
+        idle_timeout_s=3600,
+        update_interval_s=0.1,
+    )
+    monitor = Monitor(cluster, config).start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and info.state is PlacementGroupState.PENDING:
+            time.sleep(0.1)
+        assert info.state is PlacementGroupState.CREATED
+    finally:
+        monitor.stop()
+
+
+# ---------------------------------------------------------------------------
+# TPU slices
+# ---------------------------------------------------------------------------
+def test_tpu_slice_created_atomically(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    provider = TPUSliceProvider(cluster)
+    ntype = TPUSliceProvider.node_type_for("v5e-16")
+    [slice_id] = provider.create_nodes(ntype, 1)
+    members = provider.slice_members(slice_id)
+    assert len(members) == 2  # v5e-16 = 2 hosts x 8 chips
+    per_host = [
+        n.pool.total.to_dict()
+        for n in cluster.nodes.values()
+        if n.node_id.hex() in members
+    ]
+    assert all(r.get("TPU") == 8.0 for r in per_host)
+    assert sum(1 for r in per_host if "TPU-v5e-16-head" in r) == 1
+
+    provider.terminate_node(slice_id)
+    alive = {nid.hex() for nid, n in cluster.nodes.items() if not n.dead}
+    assert not (alive & set(members))  # no partial slice survives
+
+
+def test_multihost_slice_gang_demand_scales_one_slice(ray_start_cluster):
+    """Gang demand targets the slice head token; the autoscaler must launch
+    exactly one slice, not loop on an unsatisfiable aggregate chip count."""
+    rt, cluster = ray_start_cluster
+    provider = TPUSliceProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"v5e-16": TPUSliceProvider.node_type_for("v5e-16", max_workers=4)},
+        idle_timeout_s=3600,
+        update_interval_s=0.1,
+    )
+    monitor = Monitor(cluster, config, provider=provider).start()
+    try:
+
+        @rt.remote(resources={"TPU-v5e-16-head": 1})
+        def gang_leader():
+            return "leader"
+
+        assert rt.get(gang_leader.remote(), timeout=20) == "leader"
+        time.sleep(0.5)  # give the loop a chance to over-launch (it must not)
+        slices = [t for t in provider.non_terminated_nodes().values() if t == "v5e-16"]
+        assert len(slices) == 1
+    finally:
+        monitor.stop()
+
+
+def test_tpu_autoscaler_scales_slice_for_tpu_demand(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    provider = TPUSliceProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"v5e-8": TPUSliceProvider.node_type_for("v5e-8")},
+        idle_timeout_s=3600,
+        update_interval_s=0.1,
+    )
+    monitor = Monitor(cluster, config, provider=provider).start()
+    try:
+
+        @rt.remote(resources={"TPU": 8})
+        def on_tpu():
+            return "tpu"
+
+        assert rt.get(on_tpu.remote(), timeout=20) == "tpu"
+        assert any(t == "v5e-8" for t in provider.non_terminated_nodes().values())
+    finally:
+        monitor.stop()

@@ -230,3 +230,45 @@ def test_cli_timeline_and_job(dash_cluster, tmp_path, capsys):
     )
     assert rc == 0
     assert "cli job" in capsys.readouterr().out
+
+
+def test_job_bad_runtime_env_fails_not_pending(dash_cluster):
+    """A runtime_env failure must yield a FAILED job, not a phantom PENDING."""
+    mgr = dash_cluster.dashboard.job_manager
+    sid = mgr.submit_job("echo hi", runtime_env={"working_dir": "/nonexistent-xyz"})
+    info = mgr.get_job(sid)
+    assert info["status"] == "FAILED"
+    assert "runtime_env" in info["message"]
+
+
+def test_stop_pending_job_prevents_launch(dash_cluster):
+    """stop_job on a not-yet-launched entry must keep it from running."""
+    from ray_tpu.job.manager import JobStatus, _JobEntry
+
+    mgr = dash_cluster.dashboard.job_manager
+    sid = "rtjob_pending_stop"
+    with mgr._lock:
+        mgr._jobs[sid] = _JobEntry(sid, "echo never", None)  # staged, pre-launch
+    assert mgr.stop_job(sid) is True
+    info = mgr.get_job(sid)
+    assert info["status"] == "STOPPED"
+    assert info["end_time"] is not None
+
+
+def test_working_dir_change_restages(dash_cluster, tmp_path):
+    """Content fingerprinting: editing the dir yields a fresh staged copy."""
+    src = tmp_path / "wd"
+    src.mkdir()
+    (src / "f.txt").write_text("one")
+    from ray_tpu.runtime_env.plugin import apply_to_process_env
+
+    _env, cwd1 = apply_to_process_env({"working_dir": str(src)}, {})
+    import os as _os
+    import time as _time
+
+    _time.sleep(0.01)
+    (src / "f.txt").write_text("two-changed")
+    _os.utime(src / "f.txt")
+    _env, cwd2 = apply_to_process_env({"working_dir": str(src)}, {})
+    assert cwd1 != cwd2
+    assert (open(_os.path.join(cwd2, "f.txt")).read()) == "two-changed"
