@@ -53,7 +53,7 @@ class StandardAutoscaler:
         available: List[Dict[str, float]] = []
         busy: Dict[str, bool] = {}
         totals: Dict[str, Dict[str, float]] = {}
-        for node_id, node in self._cluster.nodes.items():
+        for node_id, node in list(self._cluster.nodes.items()):
             if node.dead:
                 continue
             avail = node.pool.available.to_dict()
@@ -117,7 +117,9 @@ class StandardAutoscaler:
                 if hasattr(self._provider, "slice_members")
                 else []
             ) or [pid]
-            if any(busy.get(m, True) for m in members):
+            # a member absent from `busy` is dead — a half-dead slice must be
+            # treated as idle (terminable), not pinned alive forever
+            if any(busy.get(m, False) for m in members):
                 self._idle_since.pop(pid, None)
                 continue
             first_idle = self._idle_since.setdefault(pid, now)

@@ -84,7 +84,7 @@ class InProcessNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> Dict[str, str]:
         with self._lock:
             managed = dict(self._managed)
-        alive = {nid.hex() for nid, n in self._cluster.nodes.items() if not n.dead}
+        alive = {nid.hex() for nid, n in list(self._cluster.nodes.items()) if not n.dead}
         return {pid: t for pid, t in managed.items() if pid in alive}
 
 

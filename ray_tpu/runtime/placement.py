@@ -82,6 +82,9 @@ class PlacementGroupManager:
                 # a retry_pending snapshot racing a concurrent remove() must
                 # not resurrect the group
                 return False
+            if info.state is PlacementGroupState.CREATED:
+                # concurrent retry_pending calls must not double-acquire
+                return True
             self._groups[info.pg_id] = info
             placements = self._schedule(info)
             if placements is None:

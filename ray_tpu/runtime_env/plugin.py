@@ -174,6 +174,28 @@ class PyModulesPlugin(RuntimeEnvPlugin):
         return env, cwd
 
 
+_DIST_MODULES: Optional[Dict[str, list]] = None
+
+
+def _dist_module_map() -> Dict[str, list]:
+    """distribution name -> importable module(s): "scikit-learn" installs
+    "sklearn" etc. Scanning installed-dist metadata is O(100ms); the result
+    only changes on (un)install, so compute once per process."""
+    global _DIST_MODULES
+    if _DIST_MODULES is None:
+        import importlib.metadata
+
+        mapping: Dict[str, list] = {}
+        try:
+            for module, dists in importlib.metadata.packages_distributions().items():
+                for d in dists:
+                    mapping.setdefault(d.lower().replace("_", "-"), []).append(module)
+        except Exception:
+            pass
+        _DIST_MODULES = mapping
+    return _DIST_MODULES
+
+
 class PipPlugin(RuntimeEnvPlugin):
     """Parity with ``pip.py:425``; the zero-egress image cannot install, so
     creation verifies the requirements are already importable and otherwise
@@ -187,24 +209,14 @@ class PipPlugin(RuntimeEnvPlugin):
             raise TypeError("runtime_env['pip'] must be a list of requirements or a dict")
 
     def modify_context(self, value, env, cwd, uris=None):
-        import importlib.metadata
         import importlib.util
 
-        # distribution name -> importable module(s): "scikit-learn" installs
-        # "sklearn" etc.; packages_distributions() gives module -> [dists].
-        dist_modules: Dict[str, list] = {}
-        try:
-            for module, dists in importlib.metadata.packages_distributions().items():
-                for d in dists:
-                    dist_modules.setdefault(d.lower().replace("_", "-"), []).append(module)
-        except Exception:
-            pass
-
+        dist_modules = _dist_module_map()
         reqs = value if isinstance(value, list) else value.get("packages", [])
         missing = []
         for req in reqs:
             base = req.split("==")[0].split(">=")[0].split("<")[0].strip()
-            candidates = dist_modules.get(base.lower().replace("_", "-"), [])
+            candidates = list(dist_modules.get(base.lower().replace("_", "-"), []))
             candidates.append(base.replace("-", "_"))
             if not any(importlib.util.find_spec(c) is not None for c in candidates):
                 missing.append(req)

@@ -56,14 +56,20 @@ class URICache:
                     if add_ref:
                         self._refs[uri] = self._refs.get(uri, 0) + 1
                     return path
-            path = creator()
-            with self._lock:
-                self._entries[uri] = path
-                self._sizes[uri] = _dir_size(path)
-                if add_ref:
-                    self._refs[uri] = self._refs.get(uri, 0) + 1
-                self._evict_locked()
-            return path
+            try:
+                path = creator()
+                with self._lock:
+                    self._entries[uri] = path
+                    self._sizes[uri] = _dir_size(path)
+                    if add_ref:
+                        self._refs[uri] = self._refs.get(uri, 0) + 1
+                    self._evict_locked()
+                return path
+            finally:
+                # prune the per-URI lock: fingerprinted URIs are minted per
+                # content version, so keeping them would grow without bound
+                with self._lock:
+                    self._creation_locks.pop(uri, None)
 
     def add_reference(self, uri: str) -> None:
         with self._lock:

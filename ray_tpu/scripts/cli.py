@@ -39,7 +39,7 @@ def _read_address(explicit: str | None) -> str:
             return json.load(f)["dashboard_url"]
     except (OSError, KeyError, json.JSONDecodeError):
         raise SystemExit(
-            "No running head found. Pass --address, set RAY_TPU_ADDRESS, or `rt start --head` first."
+            "No running head found. Pass --address, set RAY_TPU_ADDRESS, or run `ray_tpu start` first."
         )
 
 

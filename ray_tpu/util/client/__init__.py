@@ -1,0 +1,18 @@
+"""Thin-client mode (``ray://``): a lightweight client driving a remote
+runtime over a socket, parity with ``python/ray/util/client/``."""
+
+from ray_tpu.util.client.server import ClientServer
+from ray_tpu.util.client.worker import (
+    ClientActorHandle,
+    ClientContext,
+    ClientObjectRef,
+    connect,
+)
+
+__all__ = [
+    "ClientServer",
+    "ClientContext",
+    "ClientObjectRef",
+    "ClientActorHandle",
+    "connect",
+]

@@ -1,0 +1,78 @@
+"""Wire protocol for thin-client mode.
+
+Parity with the reference Ray Client protocol
+(``src/ray/protobuf/ray_client.proto``, design in
+``python/ray/util/client/ARCHITECTURE.md:1``): the reference rides gRPC
+streams; here the same request/response shapes ride length-prefixed
+cloudpickle frames over TCP, with request-id multiplexing so many client
+threads can have calls in flight on one connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import cloudpickle
+
+_HEADER = struct.Struct(">Q")
+MAX_FRAME = 1 << 34  # 16 GiB sanity bound
+
+
+def send_msg(sock: socket.socket, msg: Any) -> None:
+    payload = cloudpickle.dumps(msg)
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    return cloudpickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class RefMarker:
+    """Placeholder for a client-held ObjectRef inside pickled args; the
+    server swaps it for the real ref (reference: ClientObjectRef ids in
+    ray_client.proto Args)."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, ref_id: bytes):
+        self.id = ref_id
+
+
+class ActorMarker:
+    """Placeholder for a client-held actor handle inside pickled args."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, actor_id: bytes):
+        self.id = actor_id
+
+
+def translate(obj: Any, ref_fn, actor_fn) -> Any:
+    """Shallow-walk containers swapping client refs/handles via the given
+    translators (the reference also only walks top-level containers)."""
+    if isinstance(obj, RefMarker):
+        return ref_fn(obj)
+    if isinstance(obj, ActorMarker):
+        return actor_fn(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(translate(x, ref_fn, actor_fn) for x in obj)
+    if isinstance(obj, dict):
+        return {k: translate(v, ref_fn, actor_fn) for k, v in obj.items()}
+    return obj
