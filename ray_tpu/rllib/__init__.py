@@ -29,6 +29,10 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib import offline
 
 __all__ = [
     "JaxEnv",
@@ -54,4 +58,13 @@ __all__ = [
     "SACConfig",
     "BC",
     "BCConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "APPO",
+    "APPOConfig",
+    "MARWIL",
+    "MARWILConfig",
+    "CQL",
+    "CQLConfig",
+    "offline",
 ]

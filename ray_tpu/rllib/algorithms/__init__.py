@@ -2,5 +2,8 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig", "BC", "BCConfig"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig", "BC", "BCConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig"]

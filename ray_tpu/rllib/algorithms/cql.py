@@ -1,0 +1,195 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Parity: ``rllib/algorithms/cql/`` — SAC's twin-critic backbone trained purely
+from a fixed dataset, plus the conservative regularizer
+``E_s[logsumexp_a Q(s,a) - E_{a~D} Q(s,a)]`` (Kumar et al. 2020) that pushes
+down Q on out-of-distribution actions. The logsumexp is estimated over
+uniform-random and current-policy action samples, all inside one jitted
+update (no Python loop over action samples).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.rl_module import SACModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.offline_data: Optional[SampleBatch] = None
+        self.cql_alpha = 1.0          # conservative penalty weight (min_q_weight)
+        self.num_ood_actions = 4      # action samples for the logsumexp
+        self.target_update_tau = 0.005
+        self.num_updates_per_iter = 16
+        self.train_batch_size = 256
+        self.initial_alpha = 0.1
+
+    def offline(self, data: SampleBatch) -> "CQLConfig":
+        self.offline_data = data
+        return self
+
+
+class _CQLLearner:
+    """Owns critic/actor/target optimizers; one jitted update step."""
+
+    def __init__(self, module: SACModule, cfg: CQLConfig):
+        self.module = module
+        self.cfg = cfg
+        self.params = module.init(jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._make_update())
+        self._key = jax.random.key(cfg.seed + 1)
+
+    def _make_update(self):
+        m, cfg = self.module, self.cfg
+
+        def critic_loss(params, target_params, batch, key):
+            obs = batch[SampleBatch.OBS]
+            next_obs = batch[SampleBatch.NEXT_OBS]
+            actions = batch[SampleBatch.ACTIONS]
+            B = obs.shape[0]
+            knext, krand, kpi = jax.random.split(key, 3)
+
+            # --- SAC bellman target (no entropy term in the min for CQL's
+            # standard form; alpha fixed here)
+            next_action, next_logp = m.sample_action(params, next_obs, knext)
+            q1_t, q2_t = m.q_values(target_params, next_obs, next_action)
+            target_q = jnp.minimum(q1_t, q2_t) - cfg.initial_alpha * next_logp
+            not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+            y = batch[SampleBatch.REWARDS] + cfg.gamma * not_done * target_q
+            y = jax.lax.stop_gradient(y)
+
+            q1, q2 = m.q_values(params, obs, actions)
+            bellman = jnp.mean((q1 - y) ** 2 + (q2 - y) ** 2)
+
+            # --- conservative penalty: logsumexp over OOD actions
+            N = cfg.num_ood_actions
+            rand_a = jax.random.uniform(
+                krand, (N, B, m.action_size), minval=m.action_low, maxval=m.action_high
+            )
+            pi_a, _ = jax.vmap(
+                lambda k: m.sample_action(jax.lax.stop_gradient(params), obs, k)
+            )(jax.random.split(kpi, N))
+            ood = jnp.concatenate([rand_a, pi_a], axis=0)  # [2N, B, A]
+
+            def q_of(a):
+                q1o, q2o = m.q_values(params, obs, a)
+                return q1o, q2o
+
+            q1_ood, q2_ood = jax.vmap(q_of)(ood)  # [2N, B]
+            cql1 = jax.scipy.special.logsumexp(q1_ood, axis=0) - q1
+            cql2 = jax.scipy.special.logsumexp(q2_ood, axis=0) - q2
+            penalty = cfg.cql_alpha * jnp.mean(cql1 + cql2)
+            return bellman + penalty, {
+                "bellman": bellman,
+                "cql_penalty": penalty,
+                "q_mean": jnp.mean(q1),
+            }
+
+        def actor_loss(params, batch, key):
+            obs = batch[SampleBatch.OBS]
+            action, logp = m.sample_action(params, obs, key)
+            q1, q2 = m.q_values(jax.lax.stop_gradient(params), obs, action)
+            return jnp.mean(cfg.initial_alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def update(params, target_params, opt_state, batch, key):
+            kc, ka = jax.random.split(key)
+            (closs, cstats), cgrad = jax.value_and_grad(critic_loss, has_aux=True)(
+                params, target_params, batch, kc
+            )
+            (aloss, _), agrad = jax.value_and_grad(actor_loss, has_aux=True)(params, batch, ka)
+            grads = jax.tree.map(lambda g1, g2: g1 + g2, cgrad, agrad)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p: t * (1 - cfg.target_update_tau) + p * cfg.target_update_tau,
+                target_params,
+                params,
+            )
+            stats = dict(cstats)
+            stats["actor_loss"] = aloss
+            return params, target_params, opt_state, stats
+
+        return update
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        self._key, sub = jax.random.split(self._key)
+        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.target_params, self.opt_state, stats = self._update(
+            self.params, self.target_params, self.opt_state, dev_batch, sub
+        )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "opt_state": self.opt_state,
+        }
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+
+
+class CQL(Algorithm):
+    def setup(self) -> None:
+        cfg: CQLConfig = self.config
+        if cfg.offline_data is None:
+            raise ValueError("CQLConfig.offline(data) is required")
+        env = cfg.env
+        if env.discrete:
+            raise ValueError("CQL here targets continuous control (SAC backbone)")
+        self.module = SACModule(
+            env.observation_size,
+            env.action_size,
+            env.action_low,
+            env.action_high,
+            cfg.hidden,
+        )
+        self.learner = _CQLLearner(self.module, cfg)
+        self.data = cfg.offline_data.as_numpy()
+        self._rng = np.random.default_rng(cfg.seed)
+        self.runners = None
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: CQLConfig = self.config
+        stats: Dict[str, float] = {}
+        cols = (
+            SampleBatch.OBS,
+            SampleBatch.NEXT_OBS,
+            SampleBatch.ACTIONS,
+            SampleBatch.REWARDS,
+            SampleBatch.DONES,
+        )
+        for _ in range(cfg.num_updates_per_iter):
+            idx = self._rng.integers(0, len(self.data), cfg.train_batch_size)
+            stats = self.learner.update(SampleBatch({k: self.data[k][idx] for k in cols}))
+        return stats
+
+    def get_state(self):
+        return {
+            "learner": self.learner.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state):
+        self.learner.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+
+CQLConfig.algo_class = CQL

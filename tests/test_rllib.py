@@ -193,3 +193,176 @@ def test_remote_env_runners(ray_start_regular):
     result = algo.train()
     assert result["num_env_steps_sampled_lifetime"] == 2 * 4 * 32
     algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# IMPALA / APPO (V-trace)
+# ---------------------------------------------------------------------------
+def test_vtrace_matches_gae_when_on_policy():
+    """With behavior == target policy (rho == 1) and c/rho clips >= 1,
+    V-trace with lambda-free recursion equals the TD(lambda=1)-style
+    corrected returns; sanity: targets are finite and shaped [T, B]."""
+    import jax
+    from ray_tpu.rllib.algorithms.impala import vtrace
+
+    T, B = 16, 4
+    rng = np.random.default_rng(0)
+    logp = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    dones = jnp.zeros((T, B), bool)
+    final_v = jnp.zeros((B,), jnp.float32)
+    vs, pg = vtrace(logp, logp, rewards, values, dones, final_v, 0.99, 1.0, 1.0)
+    assert vs.shape == (T, B) and pg.shape == (T, B)
+    assert bool(jnp.all(jnp.isfinite(vs))) and bool(jnp.all(jnp.isfinite(pg)))
+    # rho==1: vs should equal discounted lambda=1 corrected values
+    np.testing.assert_allclose(
+        np.asarray(vs[-1]), np.asarray(rewards[-1]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_impala_learns_cartpole():
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (
+        IMPALAConfig()
+        .environment(CartPole())
+        .env_runners(num_envs_per_runner=16, rollout_length=128)
+        .training(lr=2e-3, entropy_coeff=0.005, broadcast_interval=2)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    first = None
+    result = None
+    for _ in range(25):
+        result = algo.train()
+        if first is None and not np.isnan(result["episode_return_mean"]):
+            first = result["episode_return_mean"]
+    assert result["episode_return_mean"] > max(60.0, first * 1.5)
+    algo.stop()
+
+
+def test_appo_runs_and_improves():
+    from ray_tpu.rllib import APPOConfig
+
+    config = (
+        APPOConfig()
+        .environment(CartPole())
+        .env_runners(num_envs_per_runner=16, rollout_length=64)
+        .training(lr=5e-4, clip_param=0.3)
+        .debugging(seed=1)
+    )
+    algo = config.build()
+    first = None
+    result = None
+    for _ in range(15):
+        result = algo.train()
+        if first is None and not np.isnan(result["episode_return_mean"]):
+            first = result["episode_return_mean"]
+    assert np.isfinite(result["learners"]["policy_loss"])
+    assert result["episode_return_mean"] > first
+    algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# offline: MARWIL / CQL / offline module
+# ---------------------------------------------------------------------------
+def _expert_cartpole_data(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, 4)).astype(np.float32)
+    actions = (obs[:, 2] > 0).astype(np.int32)  # push toward the lean
+    rewards = np.ones(n, np.float32)
+    returns = rng.uniform(5, 20, size=n).astype(np.float32)
+    return SampleBatch(
+        {
+            SampleBatch.OBS: obs,
+            SampleBatch.ACTIONS: actions,
+            SampleBatch.REWARDS: rewards,
+            SampleBatch.RETURNS: returns,
+        }
+    )
+
+
+def test_marwil_fits_expert():
+    from ray_tpu.rllib import MARWILConfig
+
+    data = _expert_cartpole_data()
+    config = MARWILConfig().environment(CartPole()).offline(data).training(lr=1e-2, beta=1.0)
+    algo = config.build()
+    first = algo.train()["learners"]["policy_loss"]
+    last = None
+    for _ in range(5):
+        last = algo.train()["learners"]["policy_loss"]
+    assert last < first
+
+
+def test_marwil_beta_zero_is_bc():
+    from ray_tpu.rllib import MARWILConfig
+
+    data = _expert_cartpole_data()
+    config = MARWILConfig().environment(CartPole()).offline(data).training(lr=1e-2, beta=0.0)
+    algo = config.build()
+    first = algo.train()["learners"]["policy_loss"]
+    for _ in range(5):
+        last = algo.train()["learners"]["policy_loss"]
+    assert last < first * 0.7
+
+
+def test_cql_offline_pendulum():
+    from ray_tpu.rllib import CQLConfig
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    data = SampleBatch(
+        {
+            SampleBatch.OBS: rng.normal(size=(n, 3)).astype(np.float32),
+            SampleBatch.NEXT_OBS: rng.normal(size=(n, 3)).astype(np.float32),
+            SampleBatch.ACTIONS: rng.uniform(-2, 2, size=(n, 1)).astype(np.float32),
+            SampleBatch.REWARDS: rng.normal(size=n).astype(np.float32),
+            SampleBatch.DONES: np.zeros(n, bool),
+        }
+    )
+    config = (
+        CQLConfig()
+        .environment(Pendulum())
+        .offline(data)
+        .training(num_updates_per_iter=4, cql_alpha=1.0)
+    )
+    algo = config.build()
+    result = None
+    for _ in range(3):
+        result = algo.train()
+    stats = result["learners"]
+    assert np.isfinite(stats["bellman"]) and np.isfinite(stats["cql_penalty"])
+    # conservative penalty must be active (logsumexp > dataset Q on average)
+    assert stats["cql_penalty"] != 0.0
+    # checkpoint roundtrip through the custom learner state
+    import tempfile, os as _os
+
+    with tempfile.TemporaryDirectory() as d:
+        p = algo.save(_os.path.join(d, "ckpt.pkl"))
+        algo2 = config.build()
+        algo2.restore(p)
+        l1 = jax.tree.leaves(algo.learner.params)
+        l2 = jax.tree.leaves(algo2.learner.params)
+        for a, b in zip(l1, l2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_offline_record_save_load_roundtrip(tmp_path):
+    from ray_tpu.rllib import offline
+    from ray_tpu.rllib.rl_module import ActorCriticModule
+
+    env = CartPole()
+    module = ActorCriticModule(env.observation_size, env.num_actions, (32,))
+    params = module.init(jax.random.key(0))
+    data = offline.record_rollouts(
+        env, module, params, num_iterations=2, num_envs=4, rollout_length=32
+    )
+    assert len(data) == 2 * 4 * 32
+    assert SampleBatch.RETURNS in data
+    path = offline.save_batch(data, str(tmp_path / "data.npz"))
+    loaded = offline.load_batch(path)
+    np.testing.assert_array_equal(
+        np.asarray(data[SampleBatch.OBS]), loaded[SampleBatch.OBS]
+    )
