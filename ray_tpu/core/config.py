@@ -67,6 +67,10 @@ class Config:
     # How long an unschedulable task waits for capacity (e.g. autoscaler
     # scale-up) before failing as infeasible.
     infeasible_task_timeout_s: float = 30.0
+    # Host-memory OOM guard (reference memory_monitor_refresh_ms /
+    # memory_usage_threshold, ray_config_def.h). 0 disables the monitor.
+    memory_monitor_refresh_ms: int = 250
+    memory_usage_threshold: float = 0.95
 
     # ---- compile cache ---------------------------------------------------
     # Cache compiled executables keyed by (fn, shapes, shardings).

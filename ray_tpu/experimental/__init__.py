@@ -1,0 +1,5 @@
+"""Experimental APIs (parity with ``python/ray/experimental/``)."""
+
+from ray_tpu.experimental import internal_kv, tqdm_ray
+
+__all__ = ["internal_kv", "tqdm_ray"]

@@ -149,6 +149,24 @@ class Cluster:
         # python/ray/autoscaler/_private/monitor.py): spec id -> resource dict.
         self._infeasible_demands: Dict[int, Dict[str, float]] = {}
         self._demand_lock = threading.Lock()
+        # host-memory OOM guard (memory_monitor.h parity); one monitor for
+        # the in-process fabric, candidates aggregated over all nodes.
+        self.memory_monitor = None
+        if cfg.memory_monitor_refresh_ms > 0:
+            from ray_tpu.runtime.memory_monitor import MemoryMonitor
+
+            def _candidates():
+                out = []
+                for node in list(self.nodes.values()):
+                    if not node.dead:
+                        out.extend(node.kill_candidates())
+                return out
+
+            self.memory_monitor = MemoryMonitor(
+                _candidates,
+                usage_threshold=cfg.memory_usage_threshold,
+                poll_interval_s=cfg.memory_monitor_refresh_ms / 1000.0,
+            ).start()
 
     # ------------------------------------------------------------------
     # topology
@@ -354,7 +372,9 @@ class Cluster:
                 self._after_commit(spec)
             return
         if error is not None:
-            is_system = isinstance(error, (WorkerCrashedError, ActorDiedError))
+            from ray_tpu.exceptions import OutOfMemoryError
+
+            is_system = isinstance(error, (WorkerCrashedError, ActorDiedError, OutOfMemoryError))
             retry_exceptions = getattr(spec, "_retry_exceptions", False)
             if spec.actor_id is None and self.task_manager.should_retry(spec, is_system, retry_exceptions):
                 self.submit(spec)
@@ -599,6 +619,8 @@ class Cluster:
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         dashboard = getattr(self, "dashboard", None)
         if dashboard is not None:
             dashboard.shutdown()

@@ -91,6 +91,7 @@ class Node:
         self.worker_pool.set_on_worker_death(self._on_worker_death)
         self.actors: Dict[ActorID, ActorInstance] = {}
         self._actor_worker_index: Dict[int, ActorID] = {}  # pid -> actor
+        self._proc_specs: Dict[bytes, TaskSpec] = {}  # running in process workers
         self.dead = False
 
     # ------------------------------------------------------------------
@@ -187,15 +188,56 @@ class Node:
             return
 
         def on_result(value, error):
+            self._proc_specs.pop(spec.task_id.binary(), None)
             if error is not None:
+                if spec._oom_killed:
+                    # consume the flag: a later retry of this same spec that
+                    # fails for its own reasons must NOT be relabeled OOM
+                    spec._oom_killed = False
+                    from ray_tpu.exceptions import OutOfMemoryError
+
+                    error = OutOfMemoryError(
+                        f"Task {spec.name} was killed by the memory monitor "
+                        f"under host memory pressure ({error})"
+                    )
                 self._commit(spec, None, error)
             else:
                 value = protocol.decode_value(value, shm)
                 self._commit(spec, value, None)
 
+        self._proc_specs[spec.task_id.binary()] = spec
         self.worker_pool.submit(
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
         )
+
+    def kill_candidates(self):
+        """Killable process tasks for the memory monitor (OOM policies)."""
+        from ray_tpu.runtime.memory_monitor import KillCandidate
+
+        out = []
+        for task_id, _pid, start in self.worker_pool.inflight_tasks():
+            spec = self._proc_specs.get(task_id)
+            if spec is None:
+                continue
+
+            def make_kill(s=spec, tid=task_id):
+                def kill():
+                    s._oom_killed = True
+                    if not self.worker_pool.kill_task_worker(tid):
+                        s._oom_killed = False  # task already finished/moved
+
+                return kill
+
+            out.append(
+                KillCandidate(
+                    task_id=spec.task_id,
+                    owner_id=spec.owner_node,
+                    start_time=start,
+                    retriable=spec.retries_left > 0,
+                    kill_fn=make_kill(),
+                )
+            )
+        return out
 
     @staticmethod
     def _encode_args(args, kwargs, shm) -> bytes:

@@ -64,6 +64,7 @@ class TaskSpec:
         "retries_left", "execution", "actor_id", "scheduling_strategy",
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
+        "_oom_killed",
     )
 
     def __init__(
@@ -109,6 +110,7 @@ class TaskSpec:
         self.start_time = 0.0
         self._retry_exceptions = False
         self._cancelled = False
+        self._oom_killed = False
 
 
 # --------------------------------------------------------------------------

@@ -58,6 +58,7 @@ class ProcessWorkerPool:
         self._all: Dict[int, WorkerHandle] = {}
         self._inflight: Dict[bytes, Callable[[Any, Optional[BaseException]], None]] = {}
         self._inflight_worker: Dict[bytes, WorkerHandle] = {}
+        self._inflight_start: Dict[bytes, float] = {}
         self._on_worker_death: Optional[Callable[[WorkerHandle], None]] = None
         self._listen_path = os.path.join(session_dir, f"rt_pool_{os.getpid()}_{id(self):x}.sock")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -164,6 +165,7 @@ class ProcessWorkerPool:
         with self._lock:
             self._inflight[task_id] = callback
             self._inflight_worker[task_id] = worker
+            self._inflight_start[task_id] = time.time()
         try:
             worker.send("exec", payload)
         except OSError:
@@ -221,6 +223,7 @@ class ProcessWorkerPool:
                 task_id = payload["task_id"]
                 with self._lock:
                     callback = self._inflight.pop(task_id, None)
+                    self._inflight_start.pop(task_id, None)
                     self._inflight_worker.pop(task_id, None)
                 if callback is None:
                     continue
@@ -252,22 +255,32 @@ class ProcessWorkerPool:
                 if w is worker:
                     dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
                     del self._inflight_worker[task_id]
+                    self._inflight_start.pop(task_id, None)
         for task_id, callback in dead_tasks:
             if callback is not None:
                 callback(None, WorkerCrashedError(f"worker {worker.pid} died"))
         if self._on_worker_death is not None and not self._shutdown:
             self._on_worker_death(worker)
 
-    def _kill_worker(self, worker: WorkerHandle) -> None:
+    def _kill_worker(self, worker: WorkerHandle, only_if_running: Optional[bytes] = None) -> bool:
         # Fail any in-flight tasks first — the reader loop's death handler
         # will early-return once alive=False, so this is the only chance to
         # fire their callbacks.
         dead_tasks = []
         with self._lock:
+            if (
+                only_if_running is not None
+                and self._inflight_worker.get(only_if_running) is not worker
+            ):
+                # target task finished and the worker may host someone else
+                # now — do not kill an innocent (checked under the same lock
+                # that reassigns workers)
+                return False
             for task_id, w in list(self._inflight_worker.items()):
                 if w is worker:
                     dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
                     del self._inflight_worker[task_id]
+                    self._inflight_start.pop(task_id, None)
         for task_id, callback in dead_tasks:
             if callback is not None:
                 try:
@@ -285,6 +298,26 @@ class ProcessWorkerPool:
             worker.proc.terminate()
         except OSError:
             pass
+        return True
+
+    # ------------------------------------------------------------------
+    def inflight_tasks(self):
+        """[(task_id, pid, start_time)] of tasks running in process workers
+        (memory-monitor kill candidates)."""
+        with self._lock:
+            return [
+                (tid, w.pid, self._inflight_start.get(tid, 0.0))
+                for tid, w in self._inflight_worker.items()
+                if w.alive
+            ]
+
+    def kill_task_worker(self, task_id: bytes) -> bool:
+        """Kill the worker process hosting task_id (OOM-killer hook)."""
+        with self._lock:
+            worker = self._inflight_worker.get(task_id)
+        if worker is None or not worker.alive:
+            return False
+        return self._kill_worker(worker, only_if_running=task_id)
 
     # ------------------------------------------------------------------
     def num_workers(self) -> int:

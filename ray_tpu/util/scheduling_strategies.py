@@ -1,0 +1,14 @@
+"""Scheduling strategies (public module path parity with
+``python/ray/util/scheduling_strategies.py:15,41,135``)."""
+
+from ray_tpu.runtime.scheduler import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]

@@ -1,0 +1,108 @@
+"""util shims: multiprocessing.Pool, joblib backend, internal_kv, tqdm.
+
+Parity: python/ray/util/multiprocessing + util/joblib tests.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+def test_pool_map(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as pool:
+        out = pool.map(lambda x: x * x, range(20))
+    assert out == [x * x for x in range(20)]
+
+
+def test_pool_starmap_and_apply(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool()
+    assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+    assert pool.apply(lambda a, b: a * b, (3, 4)) == 12
+    res = pool.apply_async(lambda: "async")
+    assert res.get(timeout=30) == "async"
+    assert res.successful()
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
+
+
+def test_pool_imap_ordered_and_unordered(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool()
+    assert list(pool.imap(lambda x: x + 1, range(10), chunksize=3)) == list(range(1, 11))
+    assert sorted(pool.imap_unordered(lambda x: x * 2, range(10), chunksize=2)) == [
+        x * 2 for x in range(10)
+    ]
+
+
+def test_pool_initializer(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init(v):
+        import builtins
+
+        builtins._pool_test_v = v
+
+    def use(x):
+        import builtins
+
+        return x + builtins._pool_test_v
+
+    with Pool(initializer=init, initargs=(100,)) as pool:
+        assert pool.map(use, [1, 2]) == [101, 102]
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(joblib.delayed(np.sqrt)(i**2) for i in range(10))
+    assert out == [float(i) for i in range(10)]
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_initialized()
+    assert kv._internal_kv_put(b"k1", b"v1") is False  # didn't exist
+    assert kv._internal_kv_put(b"k1", b"v2") is True
+    assert kv._internal_kv_get(b"k1") == b"v2"
+    assert kv._internal_kv_exists(b"k1")
+    assert b"k1" in kv._internal_kv_list(b"k")
+    assert kv._internal_kv_del(b"k1") == 1
+    assert kv._internal_kv_get(b"k1") is None
+
+
+def test_tqdm_renders(capsys, monkeypatch):
+    from ray_tpu.experimental import tqdm_ray
+
+    bar = tqdm_ray.tqdm(desc="test", total=10)
+    for _ in range(10):
+        bar.update(1)
+    bar.close()
+    err = capsys.readouterr().err
+    assert "10/10" in err
+    # iterable wrapping
+    assert list(tqdm_ray.tqdm(range(3), desc="it")) == [0, 1, 2]
+    tqdm_ray.safe_print("hello")
+
+
+def test_scheduling_strategies_module():
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    assert NodeAffinitySchedulingStrategy is not None
+    assert NodeLabelSchedulingStrategy is not None
+    assert PlacementGroupSchedulingStrategy is not None
