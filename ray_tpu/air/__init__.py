@@ -1,0 +1,25 @@
+"""AIR common: shared run/scaling configs and the session surface.
+
+Parity: ``python/ray/air/`` (``config.py:103`` ScalingConfig/RunConfig/
+CheckpointConfig/FailureConfig, ``session.py``) — the canonical homes are
+``ray_tpu.train``/``ray_tpu.tune``; this package re-exports them under the
+AIR path and hosts the experiment-tracking integrations.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import Result
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+]

@@ -1,0 +1,1 @@
+"""Experiment-tracking integrations (parity: ``python/ray/air/integrations/``)."""

@@ -21,11 +21,15 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,
     range_tensor,
+    read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
+    read_webdataset,
 )
 
 __all__ = [
@@ -54,9 +58,13 @@ __all__ = [
     "from_pandas",
     "range",
     "range_tensor",
+    "read_binary_files",
     "read_csv",
     "read_datasource",
     "read_json",
     "read_numpy",
+    "read_images",
     "read_parquet",
+    "read_text",
+    "read_webdataset",
 ]

@@ -276,3 +276,105 @@ def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
         else:
             out[k] = v
     return out
+
+
+class TextDatasource(FileBasedDatasource):
+    """One row per line (parity: text_datasource.py)."""
+
+    def _read_file(self, path: str) -> Block:
+        with open(path, encoding=self.read_kwargs.get("encoding", "utf-8")) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if self.read_kwargs.get("drop_empty_lines", True):
+            lines = [ln for ln in lines if ln]
+        return {"text": np.asarray(lines, dtype=object)}
+
+
+class BinaryDatasource(FileBasedDatasource):
+    """Whole files as bytes rows (parity: binary_datasource.py)."""
+
+    def _read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        block = {"bytes": np.asarray([data], dtype=object)}
+        if self.read_kwargs.get("include_paths", False):
+            block["path"] = np.asarray([path], dtype=object)
+        return block
+
+
+class ImageDatasource(FileBasedDatasource):
+    """Images decoded to HWC uint8 arrays via PIL (parity:
+    image_datasource.py). ``size=(h, w)`` resizes; ``mode`` converts."""
+
+    def _read_file(self, path: str) -> Block:
+        from PIL import Image
+
+        img = Image.open(path)
+        mode = self.read_kwargs.get("mode")
+        if mode:
+            img = img.convert(mode)
+        size = self.read_kwargs.get("size")
+        if size:
+            img = img.resize((size[1], size[0]))  # PIL takes (w, h)
+        arr = np.asarray(img)
+        block = {"image": arr[None, ...]}
+        if self.read_kwargs.get("include_paths", False):
+            block["path"] = np.asarray([path], dtype=object)
+        return block
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset-style tar shards: files sharing a basename form one sample,
+    keyed by extension (parity: webdataset_datasource.py). Decodes .json,
+    .txt/.cls, .npy, and common image extensions; other payloads stay bytes."""
+
+    IMAGE_EXTS = {"jpg", "jpeg", "png", "bmp", "gif", "webp"}
+
+    def _read_file(self, path: str) -> Block:
+        import io
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                # webdataset convention: key = member name up to the first
+                # dot AFTER the last '/', so dots in directories don't split
+                dirname, _, filename = member.name.rpartition("/")
+                stem, _, ext = filename.partition(".")
+                base = f"{dirname}/{stem}" if dirname else stem
+                ext = ext.lower()
+                payload = tf.extractfile(member).read()
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                samples[base][ext] = self._decode(ext, payload)
+        rows = [samples[k] for k in order]
+        return block_from_rows(rows)
+
+    def _decode(self, ext: str, payload: bytes):
+        import io
+
+        # a multi-part extension like "seg.png" decodes by its LAST suffix
+        last = ext.rsplit(".", 1)[-1]
+        if last == "json":
+            return _json.loads(payload)
+        if last == "cls":
+            text = payload.decode()
+            try:
+                return int(text)
+            except ValueError:
+                return text
+        if last == "txt":
+            return payload.decode()
+        if last == "npy":
+            return np.load(io.BytesIO(payload), allow_pickle=False)
+        if last in self.IMAGE_EXTS:
+            try:
+                from PIL import Image
+
+                return np.asarray(Image.open(io.BytesIO(payload)))
+            except Exception:
+                return payload
+        return payload

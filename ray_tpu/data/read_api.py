@@ -11,14 +11,18 @@ from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import (
+    BinaryDatasource,
     BlocksDatasource,
     CSVDatasource,
     Datasource,
+    ImageDatasource,
     ItemsDatasource,
     JSONDatasource,
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    TextDatasource,
+    WebDatasetDatasource,
 )
 
 
@@ -79,3 +83,24 @@ def read_parquet(paths, *, parallelism: int = -1, **kw) -> Dataset:
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(datasource, _parallelism(parallelism)))
+
+
+def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return read_datasource(TextDatasource(paths, **kw), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = False, parallelism: int = -1, **kw) -> Dataset:
+    return read_datasource(
+        BinaryDatasource(paths, include_paths=include_paths, **kw), parallelism=parallelism
+    )
+
+
+def read_images(paths, *, size=None, mode=None, include_paths: bool = False, parallelism: int = -1, **kw) -> Dataset:
+    return read_datasource(
+        ImageDatasource(paths, size=size, mode=mode, include_paths=include_paths, **kw),
+        parallelism=parallelism,
+    )
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return read_datasource(WebDatasetDatasource(paths, **kw), parallelism=parallelism)

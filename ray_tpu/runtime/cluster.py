@@ -619,6 +619,13 @@ class Cluster:
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
+        try:
+            from ray_tpu.usage.usage_lib import usage_stats_enabled, write_usage_report
+
+            if usage_stats_enabled():
+                write_usage_report(self.session_dir)
+        except Exception:
+            pass
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         dashboard = getattr(self, "dashboard", None)

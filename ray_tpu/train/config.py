@@ -67,3 +67,5 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # tune experiment callbacks (air/integrations loggers plug in here)
+    callbacks: Optional[list] = None

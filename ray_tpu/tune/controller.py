@@ -106,6 +106,7 @@ class TuneController:
         max_concurrent_trials: int = 4,
         experiment_dir: Optional[str] = None,
         max_failures_per_trial: int = 0,
+        callbacks=None,
     ):
         self.trainable = trainable
         self.searcher = searcher
@@ -120,6 +121,9 @@ class TuneController:
         os.makedirs(self.experiment_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self.max_failures_per_trial = max_failures_per_trial
+        from ray_tpu.tune.callback import CallbackList
+
+        self.callbacks = CallbackList(callbacks)
 
     # ------------------------------------------------------------------
     def _make_trial(self) -> Optional[Trial]:
@@ -137,6 +141,7 @@ class TuneController:
         ray_tpu.get(trial.actor.ping.remote())
         trial.future = trial.actor.run.remote(self.trainable, trial.config, checkpoint or trial.latest_checkpoint)
         trial.status = RUNNING
+        self.callbacks.on_trial_start(trial)
 
     def _stop_trial(self, trial: Trial, status: str = TERMINATED) -> None:
         if trial.actor is not None:
@@ -171,6 +176,10 @@ class TuneController:
                 trial.actor = None
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result, error=trial.status == ERROR)
         self.scheduler.on_trial_complete(trial, trial.last_result)
+        if trial.status == ERROR:
+            self.callbacks.on_trial_error(trial, trial.error)
+        else:
+            self.callbacks.on_trial_complete(trial)
         self._write_trial_state(trial)
 
     def _drain_reports(self, trials: List[Trial]) -> None:
@@ -193,6 +202,8 @@ class TuneController:
             trial.last_result = metrics
             if ckpt is not None:
                 trial.latest_checkpoint = ckpt
+                self.callbacks.on_checkpoint(trial, ckpt)
+            self.callbacks.on_trial_result(trial, metrics)
             self.searcher.on_trial_result(trial.trial_id, metrics)
             if trial.status != RUNNING:
                 continue
@@ -255,6 +266,7 @@ class TuneController:
                 # A stopped trainable that never reports again can't see the
                 # cooperative interrupt — reap the actor without blocking.
                 self._cleanup_stopped(t, reap_future=bool(done))
+        self.callbacks.on_experiment_end(self.trials)
         return self.trials
 
     def _cleanup_stopped(self, trial: Trial, reap_future: bool = True) -> None:
@@ -271,6 +283,10 @@ class TuneController:
             trial.actor = None
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result, error=trial.status == ERROR)
         self.scheduler.on_trial_complete(trial, trial.last_result)
+        if trial.status == ERROR:
+            self.callbacks.on_trial_error(trial, trial.error)
+        else:
+            self.callbacks.on_trial_complete(trial)
         self._write_trial_state(trial)
 
     def _write_trial_state(self, trial: Trial) -> None:

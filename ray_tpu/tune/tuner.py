@@ -105,6 +105,7 @@ class Tuner:
             max_concurrent_trials=self.tune_config.max_concurrent_trials,
             experiment_dir=exp_dir,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
+            callbacks=self.run_config.callbacks,
         )
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)

@@ -1,0 +1,9 @@
+"""Usage stats (parity: ``python/ray/_private/usage/``)."""
+
+from ray_tpu.usage.usage_lib import (
+    record_extra_usage_tag,
+    usage_stats_enabled,
+    usage_report,
+)
+
+__all__ = ["record_extra_usage_tag", "usage_stats_enabled", "usage_report"]

@@ -1,0 +1,124 @@
+"""AIR: tune callbacks, wandb/mlflow logger fallbacks, usage stats.
+
+Parity: python/ray/air/integrations tests + tune callback tests.
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu as rt
+
+
+def _run_small_experiment(tmp_path, callbacks):
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+    from ray_tpu.tune import Tuner
+    from ray_tpu.tune.tuner import TuneConfig
+
+    def trainable(config):
+        from ray_tpu.tune import session
+
+        for i in range(3):
+            session.report({"score": config["x"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="exp", callbacks=callbacks),
+    )
+    return tuner.fit()
+
+
+def test_callback_lifecycle(ray_start_regular, tmp_path):
+    from ray_tpu.tune.callback import Callback
+
+    events = []
+
+    class Recorder(Callback):
+        def on_trial_start(self, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, trial, result):
+            events.append(("result", trial.trial_id, result["score"]))
+
+        def on_trial_complete(self, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials):
+            events.append(("end", len(trials)))
+
+    results = _run_small_experiment(tmp_path, [Recorder()])
+    assert len(results) == 2
+    starts = [e for e in events if e[0] == "start"]
+    completes = [e for e in events if e[0] == "complete"]
+    assert len(starts) == 2 and len(completes) == 2
+    assert events[-1] == ("end", 2)
+    assert any(e[0] == "result" for e in events)
+
+
+def test_broken_callback_does_not_kill_experiment(ray_start_regular, tmp_path):
+    from ray_tpu.tune.callback import Callback
+
+    class Broken(Callback):
+        def on_trial_result(self, trial, result):
+            raise RuntimeError("boom")
+
+    results = _run_small_experiment(tmp_path, [Broken()])
+    assert len(results) == 2
+    assert all(r.metrics.get("score") is not None for r in results)
+
+
+def test_wandb_offline_fallback(ray_start_regular, tmp_path):
+    from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+
+    cb = WandbLoggerCallback(project="proj", dir=str(tmp_path / "wb"))
+    cb._wandb = None  # force the no-package path even if wandb is installed
+    _run_small_experiment(tmp_path, [cb])
+    wb_dir = tmp_path / "wb" / "wandb"
+    assert (wb_dir / "config.json").exists()
+    lines = (wb_dir / "history.jsonl").read_text().strip().splitlines()
+    assert len(lines) >= 3
+    assert "score" in json.loads(lines[0])
+
+
+def test_mlflow_filestore_fallback(ray_start_regular, tmp_path):
+    from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+
+    cb = MLflowLoggerCallback(tracking_uri=f"file:{tmp_path}/ml", experiment_name="e1")
+    cb._mlflow = None
+    _run_small_experiment(tmp_path, [cb])
+    runs = list((tmp_path / "ml" / "mlruns" / "e1").iterdir())
+    assert len(runs) == 2
+    for run in runs:
+        assert (run / "params.json").exists()
+        assert (run / "status").read_text() == "FINISHED"
+        metrics = [json.loads(l) for l in (run / "metrics.jsonl").read_text().splitlines()]
+        assert any("score" in m for m in metrics)
+
+
+def test_usage_stats_report_written(tmp_path):
+    from ray_tpu.usage import record_extra_usage_tag, usage_report
+
+    record_extra_usage_tag("test_feature", "1")
+    report = usage_report()
+    assert report["tags"]["test_feature"] == "1"
+    assert report["source"] == "ray_tpu"
+
+    rt.init(num_cpus=2)
+    cluster = rt.get_cluster()
+    session_dir = cluster.session_dir
+    rt.shutdown()
+    assert os.path.exists(os.path.join(session_dir, "usage_stats.json"))
+
+
+def test_usage_stats_opt_out(monkeypatch):
+    from ray_tpu.usage import usage_lib
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    before = dict(usage_lib._tags)
+    usage_lib.record_extra_usage_tag("should_not_appear", "1")
+    assert "should_not_appear" not in usage_lib._tags
+    assert usage_lib.usage_stats_enabled() is False
