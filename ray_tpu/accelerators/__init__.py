@@ -1,0 +1,23 @@
+"""Accelerator detection (parity: ``python/ray/_private/accelerators/``)."""
+
+from ray_tpu.accelerators.tpu import (
+    get_chips_per_host,
+    get_current_pod_name,
+    get_current_pod_worker_count,
+    get_num_tpu_chips,
+    get_tpu_pod_type,
+    get_visible_chip_ids,
+    tpu_head_resource_name,
+    tpu_pod_resources,
+)
+
+__all__ = [
+    "get_chips_per_host",
+    "get_current_pod_name",
+    "get_current_pod_worker_count",
+    "get_num_tpu_chips",
+    "get_tpu_pod_type",
+    "get_visible_chip_ids",
+    "tpu_head_resource_name",
+    "tpu_pod_resources",
+]

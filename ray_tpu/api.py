@@ -63,7 +63,15 @@ def init(
         node_resources = dict(resources or {})
         node_resources["CPU"] = num_cpus if num_cpus is not None else (os.cpu_count() or 4)
         if "TPU" not in node_resources:
-            node_resources["TPU"] = num_tpus if num_tpus is not None else _detect_tpus()
+            if num_tpus is not None:
+                node_resources["TPU"] = num_tpus
+            else:
+                # auto-detect chips + pod head token (accelerators/tpu.py)
+                from ray_tpu.accelerators import tpu_pod_resources
+
+                detected = tpu_pod_resources()
+                node_resources["TPU"] = detected.pop("TPU", 0)
+                node_resources.update(detected)
         cluster = Cluster()
         cluster.add_node(node_resources, labels=labels)
         job_id = JobID.next()
@@ -94,15 +102,6 @@ def shutdown() -> None:
             set_global_worker(None)
             hooks.ref_counter = None
             reset_config()
-
-
-def _detect_tpus() -> int:
-    try:
-        import jax
-
-        return len([d for d in jax.devices() if d.platform != "cpu"])
-    except Exception:
-        return 0
 
 
 def get_cluster() -> Cluster:

@@ -1,0 +1,159 @@
+"""Torch backend: distributed torch training over a process-actor gang.
+
+Parity: ``python/ray/train/torch/`` — ``TorchTrainer``, ``TorchConfig``
+(``config.py:112``: rank-0 address broadcast + ``dist.init_process_group``),
+``prepare_model`` (DDP wrap, ``train_loop_utils.py:158``) and
+``prepare_data_loader`` (DistributedSampler injection).
+
+Design note: jax gangs run as in-process actors sharing the chip grid, but a
+torch process group is per-OS-process global state, so Torch gangs run as
+PROCESS actors; the trainer picks a free TCP port up front and every rank
+joins a gloo group over it before the user loop starts.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.trainer import DataParallelTrainer
+
+__all__ = [
+    "TorchTrainer",
+    "TorchConfig",
+    "prepare_model",
+    "prepare_data_loader",
+    "get_device",
+]
+
+
+@dataclass
+class TorchConfig:
+    """Process-group settings (reference TorchConfig, train/torch/config.py)."""
+
+    backend: str = "gloo"
+    init_method: str = "tcp"
+    timeout_s: int = 1800
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _with_process_group(fn, backend: str, master_addr: str, master_port: int, timeout_s: int):
+    """Wrap the user loop: join the gloo world before, tear down after.
+    Rank/world come from the train session (the wrapper runs inside the
+    worker after init_session)."""
+
+    def wrapped(config):
+        import datetime
+        import inspect
+
+        import torch.distributed as dist
+
+        from ray_tpu.train import get_context
+
+        ctx = get_context()
+        created_group = False
+        if not dist.is_initialized():  # loops that rendezvous themselves keep working
+            dist.init_process_group(
+                backend=backend,
+                init_method=f"tcp://{master_addr}:{master_port}",
+                rank=ctx.get_world_rank(),
+                world_size=ctx.get_world_size(),
+                timeout=datetime.timedelta(seconds=timeout_s),
+            )
+            created_group = True
+        try:
+            takes_config = bool(inspect.signature(fn).parameters)
+            return fn(config) if takes_config else fn()
+        finally:
+            if created_group:
+                try:
+                    dist.destroy_process_group()
+                except Exception:
+                    pass
+
+    return wrapped
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Distributed torch trainer (reference TorchTrainer): the worker gang
+    runs in separate processes, wired into one ``torch.distributed`` gloo
+    group; ``prepare_model`` adds DDP gradient sync."""
+
+    _worker_execution = "process"
+
+    def __init__(
+        self,
+        train_loop_per_worker,
+        *,
+        torch_config: Optional[TorchConfig] = None,
+        **kwargs,
+    ):
+        self.torch_config = torch_config or TorchConfig()
+        super().__init__(train_loop_per_worker, **kwargs)
+
+    def fit(self):
+        # fresh port per fit: gloo leaves TIME_WAIT sockets behind
+        port = _free_port()
+        raw_loop = self.train_loop_per_worker
+        self.train_loop_per_worker = _with_process_group(
+            raw_loop,
+            self.torch_config.backend,
+            "127.0.0.1",
+            port,
+            self.torch_config.timeout_s,
+        )
+        try:
+            return super().fit()
+        finally:
+            self.train_loop_per_worker = raw_loop
+
+
+def get_device():
+    """The torch device for this worker (reference train.torch.get_device)."""
+    import torch
+
+    return torch.device("cpu")  # TPU compute runs through jax; torch is host-side
+
+
+def prepare_model(model, *, parallel_strategy: str = "ddp"):
+    """Wrap for gradient sync (reference prepare_model): DDP when the
+    process group is up and world_size > 1, identity otherwise."""
+    import torch.distributed as dist
+
+    if parallel_strategy and dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-build a DataLoader with a DistributedSampler so each rank sees its
+    shard (reference prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import SequentialSampler
+
+    shuffle = not isinstance(data_loader.sampler, SequentialSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+        pin_memory=data_loader.pin_memory,
+        worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+    )

@@ -69,6 +69,8 @@ class DataConfig:
 
 
 class BaseTrainer:
+    _worker_execution = "inproc"  # subclass hook (torch gangs need processes)
+
     def __init__(
         self,
         *,
@@ -148,7 +150,9 @@ class DataParallelTrainer(BaseTrainer):
         error: Optional[BaseException] = None
 
         while True:
-            group = WorkerGroup(self.scaling_config, name, trial_dir)
+            group = WorkerGroup(
+                self.scaling_config, name, trial_dir, execution=self._worker_execution
+            )
             group.start()
             shards = self.dataset_config.configure(self.datasets, self.scaling_config.num_workers)
             futures = group.run_async(
@@ -211,7 +215,9 @@ class JaxTrainer(DataParallelTrainer):
     pjit/shard_map programs over ``train.get_context().get_mesh()``."""
 
 
-class TorchTrainer(DataParallelTrainer):
-    """CPU-torch data-parallel trainer for parity with reference users
-    migrating torch loops; gradient sync via in-process gloo process group
-    when torch.distributed is initialized by the user loop."""
+# TorchTrainer lives in ray_tpu.train.torch (full gloo process-group
+# backend over process-actor gangs); imported at the bottom for the
+# historical `ray_tpu.train.trainer.TorchTrainer` path.
+
+
+from ray_tpu.train.torch import TorchTrainer  # noqa: E402

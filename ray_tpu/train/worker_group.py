@@ -28,7 +28,15 @@ from ray_tpu.train.session import TrainContext, _Session, init_session, shutdown
 class TrainWorkerActor:
     """One rank of the training gang (parity: worker_group.py RayTrainWorker)."""
 
-    def __init__(self, rank: int, world_size: int, devices_per_worker: int, experiment_name: str, trial_dir: str):
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        devices_per_worker: int,
+        experiment_name: str,
+        trial_dir: str,
+        pin_devices: bool = True,
+    ):
         self.rank = rank
         self.world_size = world_size
         self.experiment_name = experiment_name
@@ -39,9 +47,15 @@ class TrainWorkerActor:
         self._error: Optional[BaseException] = None
         self._result: Any = None
 
-        import jax
+        # Process-actor gangs (torch) must NOT touch jax here: on a real TPU
+        # host libtpu is single-process-exclusive, and a second rank's
+        # jax.devices() would fail or block waiting for the chip lock.
+        if pin_devices:
+            import jax
 
-        all_devices = jax.devices()
+            all_devices = jax.devices()
+        else:
+            all_devices = []
         n = min(devices_per_worker, len(all_devices))
         lo = (rank * n) % max(len(all_devices), 1)
         # Wrap around so every rank gets exactly n devices even when the
@@ -98,10 +112,18 @@ class TrainWorkerActor:
 
 
 class WorkerGroup:
-    def __init__(self, scaling: ScalingConfig, experiment_name: str, trial_dir: str):
+    def __init__(
+        self,
+        scaling: ScalingConfig,
+        experiment_name: str,
+        trial_dir: str,
+        execution: str = "inproc",
+    ):
         self.scaling = scaling
         self.experiment_name = experiment_name
         self.trial_dir = trial_dir
+        self.execution = execution  # "inproc" shares the jax grid; "process"
+                                    # isolates ranks (torch process groups)
         self.workers: List[Any] = []
 
     def start(self) -> None:
@@ -109,9 +131,16 @@ class WorkerGroup:
         self.workers = [
             TrainWorkerActor.options(
                 resources=self.scaling.worker_resources(),
-                execution="inproc",
+                execution=self.execution,
                 max_concurrency=4,
-            ).remote(rank, n, self.scaling.num_devices_per_worker, self.experiment_name, self.trial_dir)
+            ).remote(
+                rank,
+                n,
+                self.scaling.num_devices_per_worker,
+                self.experiment_name,
+                self.trial_dir,
+                pin_devices=self.execution != "process",
+            )
             for rank in range(n)
         ]
         ray_tpu.get([w.ping.remote() for w in self.workers])

@@ -1,0 +1,71 @@
+"""TPU accelerator detection (parity: accelerators/tpu.py tests)."""
+
+import pytest
+
+from ray_tpu.accelerators import (
+    get_chips_per_host,
+    get_current_pod_worker_count,
+    get_num_tpu_chips,
+    get_tpu_pod_type,
+    get_visible_chip_ids,
+    tpu_head_resource_name,
+    tpu_pod_resources,
+)
+
+
+def test_pod_type_normalization(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    assert get_tpu_pod_type() == "v5e-16"
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    assert get_tpu_pod_type() == "v4-8"
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
+    assert get_tpu_pod_type() is None
+
+
+def test_worker_count_from_host_bounds(monkeypatch):
+    monkeypatch.setenv("TPU_HOST_BOUNDS", "2,2,1")
+    assert get_current_pod_worker_count() == 4
+    monkeypatch.delenv("TPU_HOST_BOUNDS")
+    assert get_current_pod_worker_count() == 1
+
+
+def test_visible_chips_mask(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2")
+    assert get_visible_chip_ids() == [0, 1, 2]
+    assert get_num_tpu_chips() == 3
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS")
+    assert get_visible_chip_ids() is None
+
+
+def test_chips_per_host():
+    assert get_chips_per_host("v5e-16") == 8
+    assert get_chips_per_host("v4-8") == 4
+    assert get_chips_per_host("v6e-8") == 8
+
+
+def test_pod_resources_head_token(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3,4,5,6,7")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = tpu_pod_resources()
+    assert res["TPU"] == 8.0
+    assert res[tpu_head_resource_name("v5e-16")] == 1.0
+    # non-head worker carries no token
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = tpu_pod_resources()
+    assert tpu_head_resource_name("v5e-16") not in res
+
+
+def test_init_picks_up_pod_resources(monkeypatch):
+    import ray_tpu as rt
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3,4,5,6,7")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    rt.init(num_cpus=2)
+    try:
+        res = rt.cluster_resources()
+        assert res["TPU"] == 8.0
+        assert res["TPU-v5e-8-head"] == 1.0
+    finally:
+        rt.shutdown()

@@ -159,3 +159,72 @@ def test_jax_training_end_to_end():
 
     result = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2)).fit()
     assert result.metrics["loss"] < 0.05
+
+
+# ---------------------------------------------------------------------------
+# TorchTrainer: real gloo process groups across process-actor ranks
+# ---------------------------------------------------------------------------
+def test_torch_trainer_allreduce():
+    """Two process ranks join one gloo world and all-reduce a tensor."""
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        t = torch.tensor([float(ctx.get_world_rank() + 1)])
+        dist.all_reduce(t)  # 1 + 2 = 3 across both ranks
+        train.report({"reduced": float(t.item()), "world": dist.get_world_size()})
+
+    trainer = TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.metrics["reduced"] == 3.0
+    assert result.metrics["world"] == 2
+
+
+def test_torch_trainer_ddp_training():
+    """prepare_model wraps DDP; both ranks converge to identical weights."""
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import numpy as np
+        import torch
+
+        from ray_tpu import train
+        from ray_tpu.train.torch import prepare_model
+
+        torch.manual_seed(42)  # same init on every rank
+        model = torch.nn.Linear(4, 1)
+        model = prepare_model(model)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        rank = train.get_context().get_world_rank()
+        rng = np.random.default_rng(rank)  # different data per rank
+        for _ in range(5):
+            x = torch.tensor(rng.normal(size=(8, 4)), dtype=torch.float32)
+            y = x.sum(dim=1, keepdim=True)
+            loss = ((model(x) - y) ** 2).mean()
+            opt.zero_grad()
+            loss.backward()  # DDP all-reduces grads here
+            opt.step()
+        w = model.module.weight if hasattr(model, "module") else model.weight
+        # verify sync ACROSS ranks inside the gang: gather every rank's w0
+        import torch.distributed as dist
+
+        w0 = torch.tensor([w[0, 0].item()])
+        gathered = [torch.zeros(1) for _ in range(dist.get_world_size())]
+        dist.all_gather(gathered, w0)
+        spread = float(max(g.item() for g in gathered) - min(g.item() for g in gathered))
+        train.report(
+            {"w0": float(w[0, 0].item()), "loss": float(loss.item()), "w0_spread": spread}
+        )
+
+    trainer = TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert np.isfinite(result.metrics["loss"])
+    # DDP kept weights identical on every rank (spread gathered in-gang)
+    assert result.metrics["w0_spread"] == 0.0
