@@ -63,30 +63,42 @@ def get_visible_chip_ids() -> Optional[List[int]]:
 
 
 def get_chips_per_host(pod_type: Optional[str] = None) -> int:
+    """Chips each host of the slice carries: the generation's host size,
+    capped by the slice's total chip count (a v5e-4 host has 4, not 8)."""
     pod_type = pod_type or get_tpu_pod_type() or ""
     m = re.match(r"(v\d+[a-z]*|v5litepod|v5e|v5p)", pod_type)
     gen = m.group(1) if m else ""
     gen = {"v5e": "v5litepod"}.get(gen, gen)
-    return _GENERATION_CHIPS_PER_HOST.get(gen, 4)
+    per_host = _GENERATION_CHIPS_PER_HOST.get(gen, 4)
+    suffix = pod_type.rsplit("-", 1)[-1]
+    try:
+        total = int(suffix)
+    except ValueError:
+        return per_host
+    return min(per_host, total) if total > 0 else per_host
 
 
 def get_num_tpu_chips() -> int:
-    """Chips on THIS host: visible-chip mask, else live jax devices, else
-    pod-type arithmetic."""
+    """Chips on THIS host. Priority: explicit visible-chip mask, then live
+    jax enumeration (jax IS the execution engine — if it sees no TPU,
+    advertising chips from env arithmetic would promise capacity tasks can
+    never use, e.g. a CPU-forced test process on a TPU VM), then pod-type
+    arithmetic only when jax itself is unavailable."""
     visible = get_visible_chip_ids()
     if visible is not None:
         return len(visible)
     try:
         import jax
-
-        n = len([d for d in jax.devices() if d.platform != "cpu"])
-        if n:
-            return n
+    except ImportError:
+        if get_tpu_pod_type():
+            return get_chips_per_host()
+        return 0
+    try:
+        return len([d for d in jax.local_devices() if d.platform != "cpu"])
     except Exception:
-        pass
-    if get_tpu_pod_type():
-        return get_chips_per_host()
-    return 0
+        # jax present but backend init failed (device locked, broken
+        # libtpu): those chips are unusable, don't advertise them
+        return 0
 
 
 def tpu_head_resource_name(pod_type: str) -> str:
@@ -101,8 +113,11 @@ def tpu_pod_resources() -> Dict[str, float]:
     this is worker 0 of a multi-host slice."""
     out: Dict[str, float] = {}
     chips = get_num_tpu_chips()
-    if chips:
-        out["TPU"] = float(chips)
+    if not chips:
+        # no usable chips on this host: don't advertise the head token
+        # either, or gang tasks would land somewhere TPU work can't run
+        return out
+    out["TPU"] = float(chips)
     pod_type = get_tpu_pod_type()
     if pod_type and os.environ.get(TPU_WORKER_ID_ENV, "0") == "0":
         out[tpu_head_resource_name(pod_type)] = 1.0

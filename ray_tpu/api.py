@@ -241,25 +241,34 @@ class RemoteFunction:
         self._function = func
         self._options = options or {}
         functools.update_wrapper(self, func)
+        # resolve per-call-invariant options once (hot path: .remote() in a
+        # tight loop must not rebuild these dicts every call)
+        opts = self._options
+        self._num_returns = opts.get("num_returns", 1)
+        self._name = opts.get("name") or getattr(func, "__name__", "anonymous")
+        self._resources = _resource_dict(opts)
+        self._max_retries = opts.get("max_retries")
+        self._retry_exceptions = bool(opts.get("retry_exceptions", False))
+        self._execution = opts.get("execution", "auto")
+        self._scheduling_strategy = opts.get("scheduling_strategy")
+        self._runtime_env = opts.get("runtime_env")
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         _auto_init()
-        opts = self._options
-        num_returns = opts.get("num_returns", 1)
         refs = global_worker().submit_task(
             self._function,
             args,
             kwargs,
-            name=opts.get("name") or self._function.__name__,
-            num_returns=num_returns,
-            resources=_resource_dict(opts),
-            max_retries=opts.get("max_retries"),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            execution=opts.get("execution", "auto"),
-            scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"),
+            name=self._name,
+            num_returns=self._num_returns,
+            resources=self._resources,
+            max_retries=self._max_retries,
+            retry_exceptions=self._retry_exceptions,
+            execution=self._execution,
+            scheduling_strategy=self._scheduling_strategy,
+            runtime_env=self._runtime_env,
         )
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if self._num_returns == 1 else refs
 
     def options(self, **new_options) -> "RemoteFunction":
         unknown = set(new_options) - _TASK_OPTION_KEYS

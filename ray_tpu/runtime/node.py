@@ -173,6 +173,8 @@ class Node:
             error = exc if isinstance(exc, RayTaskError) else RayTaskError.from_exception(spec.name, exc)
             self._commit(spec, None, error)
 
+    _EMPTY_ARGS_BLOB = pickle.dumps(((), {}), protocol=5)
+
     def _dispatch_process(self, spec: TaskSpec) -> None:
         try:
             args, kwargs = self._resolve_args(spec)
@@ -181,11 +183,14 @@ class Node:
             return
         fn_id, fn_blob = self._function_blob(spec.func)
         shm = self.store._shm
-        try:
-            args_blob = self._encode_args(args, kwargs, shm)
-        except BaseException as exc:  # noqa: BLE001
-            self._commit(spec, None, RayTaskError.from_exception(spec.name, exc))
-            return
+        if not args and not kwargs:
+            args_blob = self._EMPTY_ARGS_BLOB
+        else:
+            try:
+                args_blob = self._encode_args(args, kwargs, shm)
+            except BaseException as exc:  # noqa: BLE001
+                self._commit(spec, None, RayTaskError.from_exception(spec.name, exc))
+                return
 
         def on_result(value, error):
             self._proc_specs.pop(spec.task_id.binary(), None)

@@ -169,6 +169,11 @@ class ClusterScheduler:
             alive = [(nid, self._pools[nid]) for nid, ok in self._alive.items() if ok]
         if not alive:
             return None
+        if len(alive) == 1 and strategy is None:
+            # single-node fast path (the common laptop/head-only case):
+            # no scoring — fits-total means run-or-queue here
+            nid, pool = alive[0]
+            return nid if spec.resources.fits(pool.total) else None
 
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             target = strategy.node_id

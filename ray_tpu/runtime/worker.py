@@ -90,7 +90,7 @@ class CoreWorker:
             dependencies=[r.id() for r in deps],
             num_returns=num_returns,
             return_ids=return_ids,
-            resources=ResourceSet({"CPU": 1} if resources is None else resources),
+            resources=_interned_resource_set(resources),
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             execution=execution,
             scheduling_strategy=scheduling_strategy,
@@ -265,6 +265,24 @@ class CoreWorker:
             if node is not None:
                 node.store.delete(oid)
         self.cluster.directory.forget(oid)
+
+
+_RESOURCE_SET_CACHE: dict = {}
+
+
+def _interned_resource_set(resources: Optional[Dict[str, float]]) -> ResourceSet:
+    """ResourceSets are read-only once built; intern the common shapes
+    ({"CPU": 1} etc.) so the hot submit path skips dict->fixed conversion."""
+    if resources is None:
+        resources = {"CPU": 1.0}
+    key = tuple(sorted(resources.items()))
+    cached = _RESOURCE_SET_CACHE.get(key)
+    if cached is None:
+        if len(_RESOURCE_SET_CACHE) > 512:
+            _RESOURCE_SET_CACHE.clear()
+        cached = ResourceSet(resources)
+        _RESOURCE_SET_CACHE[key] = cached
+    return cached
 
 
 def _collect_deps(args: Tuple, kwargs: dict) -> List[ObjectRef]:
