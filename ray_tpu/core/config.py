@@ -46,14 +46,24 @@ class Config:
     max_tasks_per_dispatch: int = 1000
 
     # ---- workers ---------------------------------------------------------
-    # Number of CPU-task worker processes to prestart (0 = num_cpus).
-    num_prestart_workers: int = 0
+    # CPU-task worker processes prestarted (off-thread) at node start; the
+    # pool grows on demand past this, also without blocking submitters.
+    num_prestart_workers: int = 1
     # Soft cap on idle workers kept alive per runtime env.
     idle_worker_cap: int = 8
     # Seconds before an idle worker process is reaped.
     idle_worker_timeout_s: float = 60.0
 
     # ---- tasks / fault tolerance ----------------------------------------
+    # Adaptive tiering: "auto" tasks whose observed mean wall time exceeds
+    # this run in process workers (GIL-free parallelism); faster ones stay
+    # on the zero-IPC in-process executor.
+    inproc_task_threshold_s: float = 0.002
+    # Optional defer before the inproc executor claims a queued task, giving
+    # a sync waiter time to steal it inline. 0 (default): claim immediately
+    # — stealing usually wins the race anyway and the delay throttles
+    # async-burst drains.
+    inproc_claim_delay_s: float = 0.0
     # Default max retries for normal tasks (reference default 3).
     task_max_retries: int = 3
     # Default max restarts for actors.

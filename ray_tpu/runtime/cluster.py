@@ -131,6 +131,7 @@ class Cluster:
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
         self.core_worker = None       # set by worker.init
+        self._terminal_counter = None  # cached tasks_terminal_total metric
         self.shm_store = None
         if shm_capacity >= 0:
             try:
@@ -415,11 +416,14 @@ class Cluster:
                 "ts": time.time(),
             }
         )
-        from ray_tpu.observability.metrics import global_registry
+        counter = self._terminal_counter
+        if counter is None:
+            from ray_tpu.observability.metrics import global_registry
 
-        global_registry().counter(
-            "tasks_terminal_total", "Terminal task states by outcome"
-        ).inc(tags={"state": state})
+            counter = self._terminal_counter = global_registry().counter(
+                "tasks_terminal_total", "Terminal task states by outcome"
+            )
+        counter.inc(tags={"state": state})
 
     def _commit_error_everywhere(self, spec: TaskSpec, error: BaseException) -> None:
         node = self.nodes.get(spec.owner_node)

@@ -22,7 +22,6 @@ import pickle
 import queue
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.core.config import get_config
@@ -40,6 +39,94 @@ from ray_tpu.exceptions import (
 from ray_tpu.runtime import protocol
 from ray_tpu.runtime.scheduler import LocalScheduler, TaskSpec
 from ray_tpu.runtime.worker_pool import ProcessWorkerPool, WorkerHandle
+
+
+class CachedThreadPool:
+    """Demand-grown thread pool with a persistent core and reaped extras.
+
+    The in-process executor runs tasks that may block on child tasks
+    (nested ``rt.get``); a fixed-size pool would deadlock once a dependency
+    chain exceeds its width, so idle-or-grow semantics are load-bearing,
+    not an optimization (reference analogue: the raylet spawns workers on
+    demand past the prestart pool, ``worker_pool.h:169``)."""
+
+    def __init__(self, core: int, max_threads: int = 512, name: str = "inproc"):
+        self._tasks: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._starting = 0   # spawned but not yet in the idle count
+        self._threads = 0
+        self._core = core
+        self._max = max_threads
+        self._name = name
+        self._shutdown = False
+
+    def _maybe_spawn_locked(self) -> None:
+        # _starting gates growth: a just-spawned thread takes a while to
+        # reach its first queue.get, and every submit in that window would
+        # otherwise spawn yet another thread.
+        if (
+            self._idle == 0
+            and self._starting == 0
+            and self._threads < self._max
+            and not self._shutdown
+        ):
+            self._threads += 1
+            self._starting += 1
+            is_extra = self._threads > self._core
+            threading.Thread(
+                target=self._run, args=(is_extra,), name=f"{self._name}-exec", daemon=True
+            ).start()
+
+    def submit(self, fn: Callable, *args) -> None:
+        self._tasks.put((fn, args))
+        with self._lock:
+            self._maybe_spawn_locked()
+
+    def _run(self, is_extra: bool) -> None:
+        first = True
+        while True:
+            with self._lock:
+                self._idle += 1
+                if first:
+                    self._starting -= 1
+                    first = False
+            try:
+                item = self._tasks.get(timeout=30.0) if is_extra else self._tasks.get()
+            except queue.Empty:
+                with self._lock:
+                    self._idle -= 1
+                    # Exit race: a submit may have queued work after the
+                    # timeout fired but before this lock, seeing us idle
+                    # and skipping growth — recheck before standing down.
+                    if not self._tasks.empty():
+                        continue  # loop top re-increments _idle
+                    self._threads -= 1
+                return
+            with self._lock:
+                self._idle -= 1
+                # About to go busy (the task may block indefinitely on
+                # children): if work remains queued and nobody is free to
+                # take it, grow — otherwise a queued task starves behind
+                # this one until it finishes.
+                if not self._tasks.empty():
+                    self._maybe_spawn_locked()
+            if item is None or self._shutdown:
+                with self._lock:
+                    self._threads -= 1
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — executor threads never die
+                pass
+
+    def shutdown(self, wait: bool = False) -> None:
+        self._shutdown = True
+        with self._lock:
+            n = self._threads
+        for _ in range(n):
+            self._tasks.put(None)
 
 
 class ActorInstance:
@@ -79,7 +166,12 @@ class Node:
         self.scheduler = LocalScheduler(self.pool, self.store, self._dispatch)
         # One pool serves both "thread" CPU-light tasks and device tasks; XLA
         # dispatch is async so device tasks occupy a thread only briefly.
-        self.executor = ThreadPoolExecutor(max_workers=num_inproc_threads, thread_name_prefix=f"node-{node_id.hex()[:6]}")
+        # Demand-grown (not fixed-size): nested inproc tasks blocking on
+        # children must never exhaust the pool, or a dependency chain deeper
+        # than the thread count would deadlock.
+        self.executor = CachedThreadPool(
+            core=num_inproc_threads, name=f"node-{node_id.hex()[:6]}"
+        )
         self.worker_pool = ProcessWorkerPool(
             shm_name=shm_store.name if shm_store is not None else "",
             # Size by the node's declared CPU resource, not the container's
@@ -89,9 +181,28 @@ class Node:
             session_dir=cluster.session_dir,
         )
         self.worker_pool.set_on_worker_death(self._on_worker_death)
+        # Prestart a warm worker off-thread (reference: WorkerPool prestart,
+        # worker_pool.h:169-193) so the first task doesn't pay the ~200ms
+        # child-interpreter startup; further growth is demand-driven and
+        # also off the submitting thread (_maybe_grow_async).
+        if cfg.num_prestart_workers > 0:
+            threading.Thread(
+                target=self.worker_pool.prestart,
+                args=(cfg.num_prestart_workers,),
+                name="worker-prestart",
+                daemon=True,
+            ).start()
         self.actors: Dict[ActorID, ActorInstance] = {}
         self._actor_worker_index: Dict[int, ActorID] = {}  # pid -> actor
         self._proc_specs: Dict[bytes, TaskSpec] = {}  # running in process workers
+        # Adaptive tiering state: per-function (count, total_wall_s). Keyed
+        # by id(func) — stable for the life of the decorated function object.
+        self._fn_profile: Dict[int, list] = {}
+        # Queued-but-not-started inproc tasks, stealable by waiters
+        # (work stealing: a blocked rt.get executes the task it waits on
+        # inline — zero thread/process switches on the sync path).
+        self._inproc_pending: Dict[bytes, TaskSpec] = {}
+        self._inproc_lock = threading.Lock()
         self.dead = False
 
     # ------------------------------------------------------------------
@@ -122,7 +233,34 @@ class Node:
         if mode == "process":
             self._dispatch_process(spec)
         else:
-            self.executor.submit(self._run_inproc, spec)
+            with self._inproc_lock:
+                self._inproc_pending[spec.task_id.binary()] = spec
+            self.executor.submit(self._run_inproc_claimed, spec)
+
+    def _claim_inproc(self, task_bin: bytes) -> Optional[TaskSpec]:
+        with self._inproc_lock:
+            return self._inproc_pending.pop(task_bin, None)
+
+    def _run_inproc_claimed(self, spec: TaskSpec) -> None:
+        # Brief defer before claiming: a sync waiter's inline steal is far
+        # cheaper than running here (no thread handoff back to the waiter),
+        # so give it a head start. sleep() parks this thread without
+        # holding the GIL; an async-only caller pays at most the delay.
+        delay = get_config().inproc_claim_delay_s
+        if delay > 0:
+            time.sleep(delay)
+        if self._claim_inproc(spec.task_id.binary()) is None:
+            return  # stolen by a waiter
+        self._run_inproc(spec)
+
+    def steal_task(self, task_bin: bytes) -> bool:
+        """A waiter executes the queued inproc task inline on its own
+        thread. Returns True if the task was run here."""
+        spec = self._claim_inproc(task_bin)
+        if spec is None:
+            return False
+        self._run_inproc(spec)
+        return True
 
     def _execution_mode(self, spec: TaskSpec) -> str:
         if spec.execution != "auto":
@@ -139,7 +277,39 @@ class Node:
                     return "thread"
         except Exception:
             pass
-        return "process"
+        # Adaptive tiering (TPU-first delta; no reference equivalent — Ray
+        # MUST isolate Python workers per-process, our single-process
+        # runtime need not): unknown functions run isolated in process
+        # workers, which report the function body's wall time; once two
+        # samples show the function is fast, it migrates to the zero-IPC
+        # in-process executor (~4x lower latency). Heavy functions stay in
+        # process workers, where the GIL stops mattering. Trial-in-worker
+        # ordering means a function is only ever colocated with the driver
+        # AFTER it has run to completion elsewhere — an os._exit or a
+        # segfault in unknown user code kills a worker, not the driver.
+        # execution="process"/"thread" overrides the policy per task.
+        threshold = get_config().inproc_task_threshold_s
+        if threshold <= 0:
+            return "process"
+        prof = self._fn_profile.get(id(func))
+        if prof is None or prof[2] is not func or prof[0] < 2:
+            return "process"
+        return "process" if prof[1] / prof[0] > threshold else "thread"
+
+    def _profile_task(self, func, dt: float) -> None:
+        # The entry pins func so its id() cannot be recycled by a different
+        # function object inheriting a stale "fast" verdict (which would
+        # colocate untrialed code with the driver).
+        prof = self._fn_profile.get(id(func))
+        if prof is None or prof[2] is not func:
+            if len(self._fn_profile) >= 4096:
+                self._fn_profile.clear()
+            prof = self._fn_profile[id(func)] = [0, 0.0, func]
+        prof[0] += 1
+        prof[1] += dt
+        if prof[0] >= 4096:     # keep the window fresh for drifting tasks
+            prof[0] //= 2
+            prof[1] /= 2.0
 
     def _resolve_args(self, spec: TaskSpec):
         def resolve(v):
@@ -164,10 +334,13 @@ class Node:
             args, kwargs = self._resolve_args(spec)
             # propagate the executing task id for nested submissions/puts
             token = task_context.push(spec.task_id, self.node_id)
+            t0 = time.perf_counter()
             try:
                 result = spec.func(*args, **kwargs)
             finally:
                 task_context.pop(token)
+                if spec.execution == "auto":
+                    self._profile_task(spec.func, time.perf_counter() - t0)
             self._commit(spec, result, None)
         except BaseException as exc:  # noqa: BLE001
             error = exc if isinstance(exc, RayTaskError) else RayTaskError.from_exception(spec.name, exc)
@@ -192,8 +365,12 @@ class Node:
                 self._commit(spec, None, RayTaskError.from_exception(spec.name, exc))
                 return
 
-        def on_result(value, error):
+        def on_result(value, error, exec_s=None):
             self._proc_specs.pop(spec.task_id.binary(), None)
+            if spec.execution == "auto" and exec_s is not None:
+                # worker-reported wall time of the function body alone —
+                # the clean signal for the tiering decision
+                self._profile_task(spec.func, exec_s)
             if error is not None:
                 if spec._oom_killed:
                     # consume the flag: a later retry of this same spec that
@@ -317,7 +494,7 @@ class Node:
                 return
             fn_id, fn_blob = self._function_blob(spec.func)
 
-            def on_result(value, err):
+            def on_result(value, err, exec_s=None):
                 if err is not None:
                     self.cluster.on_actor_creation_failed(spec, err)
                 else:
@@ -349,7 +526,7 @@ class Node:
                 self._commit_actor_error(spec, RayTaskError.from_exception(spec.name, exc))
                 return
 
-            def on_result(value, err):
+            def on_result(value, err, exec_s=None):
                 if err is not None:
                     self.cluster.on_task_finished(self, spec, None, err if isinstance(err, (RayTaskError, RayActorError, WorkerCrashedError)) else RayTaskError.from_exception(spec.name, err))
                 else:

@@ -35,7 +35,10 @@ def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.connect(args.addr)
+    try:
+        sock.connect(args.addr)
+    except OSError:
+        return  # pool already shut down (teardown race): exit quietly
 
     shm_store = None
     if args.shm:
@@ -111,12 +114,19 @@ class Worker:
         return pickle.dumps(encoded, protocol=5)
 
     def _handle_exec(self, payload: dict) -> None:
+        import time
+
         task_id = payload["task_id"]
         try:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
+            t0 = time.perf_counter()
             result = fn(*args, **kwargs)
-            self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(result)})
+            exec_s = time.perf_counter() - t0
+            self._reply(
+                "result",
+                {"task_id": task_id, "value_blob": self._encode_result(result), "exec_s": exec_s},
+            )
         except BaseException as exc:  # noqa: BLE001 — task errors become objects
             self._reply(
                 "result",

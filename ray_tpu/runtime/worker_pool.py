@@ -46,6 +46,35 @@ class WorkerHandle:
             protocol.send_msg(self.sock, msg_type, payload)
 
 
+class _DirectSlot:
+    """Handoff cell for a sync waiter: the reader thread parks the raw
+    result payload here and wakes the waiter, which unpickles and runs the
+    commit chain on its own thread. Halves the reader's GIL-holding window,
+    so the waiter wakes ~30us sooner on the sync round-trip path."""
+
+    __slots__ = ("event", "payload", "callback")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload: Optional[dict] = None
+        self.callback: Optional[Callable] = None
+
+    def run(self) -> None:
+        payload, callback = self.payload, self.callback
+        if payload is None or callback is None:
+            return
+        try:
+            if "error_blob" in payload:
+                callback(None, pickle.loads(payload["error_blob"]), payload.get("exec_s"))
+            else:
+                callback(pickle.loads(payload["value_blob"]), None, payload.get("exec_s"))
+        except BaseException as exc:  # noqa: BLE001
+            try:
+                callback(None, exc, None)
+            except BaseException:
+                pass
+
+
 class ProcessWorkerPool:
     def __init__(self, shm_name: str = "", max_workers: int = 0, session_dir: str = "/tmp"):
         cfg = get_config()
@@ -59,12 +88,15 @@ class ProcessWorkerPool:
         self._inflight: Dict[bytes, Callable[[Any, Optional[BaseException]], None]] = {}
         self._inflight_worker: Dict[bytes, WorkerHandle] = {}
         self._inflight_start: Dict[bytes, float] = {}
+        self._direct: Dict[bytes, _DirectSlot] = {}   # sync waiters by task id
         self._on_worker_death: Optional[Callable[[WorkerHandle], None]] = None
         self._listen_path = os.path.join(session_dir, f"rt_pool_{os.getpid()}_{id(self):x}.sock")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self._listen_path)
         self._listener.listen(128)
         self._shutdown = False
+        self._spawning = 0           # spawns in flight (async growth)
+        self._spawn_lock = threading.Lock()  # serializes listener.accept
 
     # ------------------------------------------------------------------
     def set_on_worker_death(self, cb: Callable[[WorkerHandle], None]) -> None:
@@ -75,27 +107,38 @@ class ProcessWorkerPool:
             self._spawn()
 
     def _spawn(self, to_idle: bool = True) -> WorkerHandle:
-        # Make the package importable in the child even when the driver found
-        # it via sys.path manipulation rather than an installed dist.
+        # Hand the child the driver's full sys.path and start it with -S:
+        # site processing re-runs any sitecustomize, which on TPU hosts can
+        # initialize a jax/PJRT client — seconds of CPU burned per worker
+        # and (on small hosts) stolen from the driver. The explicit path
+        # covers site-packages and the repo, so imports still resolve.
         import ray_tpu
 
         pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-        pythonpath = os.environ.get("PYTHONPATH", "")
-        if pkg_parent not in pythonpath.split(os.pathsep):
-            pythonpath = pkg_parent + (os.pathsep + pythonpath if pythonpath else "")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker_main", "--addr", self._listen_path]
-            + (["--shm", self._shm_name] if self._shm_name else []),
-            env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath},
+        paths = [pkg_parent] + [p for p in sys.path if p]
+        seen: set = set()
+        pythonpath = os.pathsep.join(
+            p for p in paths if not (p in seen or seen.add(p))
         )
-        self._listener.settimeout(30.0)
-        try:
-            sock, _ = self._listener.accept()
-        except socket.timeout:
-            proc.kill()
-            raise RuntimeError("worker process failed to register within 30s")
-        finally:
-            self._listener.settimeout(None)
+        with self._spawn_lock:
+            proc = subprocess.Popen(
+                [sys.executable, "-S", "-m", "ray_tpu.runtime.worker_main", "--addr", self._listen_path]
+                + (["--shm", self._shm_name] if self._shm_name else []),
+                env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath},
+            )
+            self._listener.settimeout(30.0)
+            try:
+                sock, _ = self._listener.accept()
+            except (socket.timeout, OSError):
+                proc.kill()
+                if self._shutdown:
+                    raise RuntimeError("pool shut down during worker spawn")
+                raise RuntimeError("worker process failed to register within 30s")
+            finally:
+                try:
+                    self._listener.settimeout(None)
+                except OSError:
+                    pass
         msg_type, payload = protocol.recv_msg(sock)
         assert msg_type == "register", msg_type
         handle = WorkerHandle(sock, proc, payload["pid"])
@@ -103,20 +146,69 @@ class ProcessWorkerPool:
             self._all[handle.pid] = handle
             if to_idle:
                 self._idle.append(handle)
-        threading.Thread(target=self._reader_loop, args=(handle,), name=f"pool-reader-{handle.pid}", daemon=True).start()
+        self._watch_worker(handle)
         return handle
 
+    def _maybe_grow_async(self) -> None:
+        """Spawn a worker on a background thread when the backlog has work
+        and the pool is under its cap. Submitting threads never block on the
+        ~200ms child-interpreter startup."""
+        with self._lock:
+            if self._shutdown or not self._backlog:
+                return
+            shared = sum(1 for w in self._all.values() if w.alive and not w.dedicated)
+            if shared + self._spawning >= self._max_workers or self._spawning >= len(self._backlog):
+                return
+            self._spawning += 1
+        threading.Thread(target=self._grow_one, name="pool-spawner", daemon=True).start()
+
+    def _grow_one(self) -> None:
+        try:
+            worker = self._spawn(to_idle=False)
+        except Exception as exc:
+            failed = []
+            with self._lock:
+                self._spawning -= 1
+                # If no worker can ever pick the backlog up, fail it now —
+                # swallowing the spawn error would leave getters hanging.
+                alive = any(w.alive and not w.dedicated for w in self._all.values())
+                if not alive and self._spawning == 0 and not self._shutdown:
+                    while self._backlog:
+                        failed.append(self._backlog.popleft())
+            for item in failed:
+                callback = item[-1]
+                try:
+                    callback(None, WorkerCrashedError(f"worker spawn failed: {exc}"), None)
+                except BaseException:
+                    pass
+            return
+        with self._lock:
+            self._spawning -= 1
+        self._release_worker(worker)
+        self._maybe_grow_async()
+
     # ------------------------------------------------------------------
-    def _acquire_worker(self) -> Optional[WorkerHandle]:
+    def _acquire_idle(self) -> Optional[WorkerHandle]:
         with self._lock:
             while self._idle:
-                w = self._idle.popleft()
+                # LIFO: reuse the most recently released worker so a sync
+                # submit loop keeps hitting one hot process (warm caches,
+                # fn already known) instead of rotating through the pool
+                w = self._idle.pop()
                 if w.alive:
                     return w
+        return None
+
+    def _acquire_worker(self) -> Optional[WorkerHandle]:
+        """Idle worker, or a blocking spawn (actor allocation path only)."""
+        worker = self._acquire_idle()
+        if worker is not None:
+            return worker
+        with self._lock:
             # Dedicated (actor-owned) workers don't count against the
             # stateless-task cap, or actors would starve normal tasks.
             shared = sum(1 for w in self._all.values() if w.alive and not w.dedicated)
-            if shared >= self._max_workers:
+            if shared + self._spawning >= self._max_workers:
                 return None
         return self._spawn(to_idle=False)
 
@@ -148,11 +240,13 @@ class ProcessWorkerPool:
         args_blob: bytes,
         callback: Callable[[Any, Optional[BaseException]], None],
     ) -> bool:
-        """Run a stateless task on an idle worker; queues when saturated."""
-        worker = self._acquire_worker()
+        """Run a stateless task on an idle worker; queues when saturated.
+        Never blocks: pool growth happens on a spawner thread."""
+        worker = self._acquire_idle()
         if worker is None:
             with self._lock:
                 self._backlog.append((task_id, name, fn_id, fn_blob, args_blob, callback))
+            self._maybe_grow_async()
             return True
         self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback)
         return True
@@ -212,6 +306,16 @@ class ProcessWorkerPool:
         self._kill_worker(worker)
 
     # ------------------------------------------------------------------
+    # One reader thread per worker socket. (A single selector-based reader
+    # for all sockets was measured strictly worse here — the select+wake
+    # syscalls per message cost more than the GIL handoffs they avoid, and
+    # it serializes the commit chains of concurrent workers.)
+    # ------------------------------------------------------------------
+    def _watch_worker(self, worker: WorkerHandle) -> None:
+        threading.Thread(
+            target=self._reader_loop, args=(worker,), name=f"pool-reader-{worker.pid}", daemon=True
+        ).start()
+
     def _reader_loop(self, worker: WorkerHandle) -> None:
         while True:
             try:
@@ -225,18 +329,26 @@ class ProcessWorkerPool:
                     callback = self._inflight.pop(task_id, None)
                     self._inflight_start.pop(task_id, None)
                     self._inflight_worker.pop(task_id, None)
+                    slot = self._direct.pop(task_id, None)
                 if callback is None:
                     continue
                 if not worker.dedicated:
                     self._release_worker(worker)
+                if slot is not None:
+                    # sync waiter present: hand off the raw payload; the
+                    # waiter's thread unpickles + commits
+                    slot.payload = payload
+                    slot.callback = callback
+                    slot.event.set()
+                    continue
                 try:
                     if "error_blob" in payload:
-                        callback(None, pickle.loads(payload["error_blob"]))
+                        callback(None, pickle.loads(payload["error_blob"]), payload.get("exec_s"))
                     else:
-                        callback(pickle.loads(payload["value_blob"]), None)
+                        callback(pickle.loads(payload["value_blob"]), None, payload.get("exec_s"))
                 except BaseException as exc:  # noqa: BLE001 — keep the reader alive
                     try:
-                        callback(None, exc)
+                        callback(None, exc, None)
                     except BaseException:
                         pass
 
@@ -253,12 +365,16 @@ class ProcessWorkerPool:
                 pass
             for task_id, w in list(self._inflight_worker.items()):
                 if w is worker:
-                    dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
+                    dead_tasks.append(
+                        (task_id, self._inflight.pop(task_id, None), self._direct.pop(task_id, None))
+                    )
                     del self._inflight_worker[task_id]
                     self._inflight_start.pop(task_id, None)
-        for task_id, callback in dead_tasks:
+        for task_id, callback, slot in dead_tasks:
             if callback is not None:
-                callback(None, WorkerCrashedError(f"worker {worker.pid} died"))
+                callback(None, WorkerCrashedError(f"worker {worker.pid} died"), None)
+            if slot is not None:
+                slot.event.set()  # empty slot: waiter falls through to the future
         if self._on_worker_death is not None and not self._shutdown:
             self._on_worker_death(worker)
 
@@ -278,15 +394,19 @@ class ProcessWorkerPool:
                 return False
             for task_id, w in list(self._inflight_worker.items()):
                 if w is worker:
-                    dead_tasks.append((task_id, self._inflight.pop(task_id, None)))
+                    dead_tasks.append(
+                        (task_id, self._inflight.pop(task_id, None), self._direct.pop(task_id, None))
+                    )
                     del self._inflight_worker[task_id]
                     self._inflight_start.pop(task_id, None)
-        for task_id, callback in dead_tasks:
+        for task_id, callback, slot in dead_tasks:
             if callback is not None:
                 try:
-                    callback(None, WorkerCrashedError(f"worker {worker.pid} was killed"))
+                    callback(None, WorkerCrashedError(f"worker {worker.pid} was killed"), None)
                 except BaseException:
                     pass
+            if slot is not None:
+                slot.event.set()  # empty slot: waiter falls through to the future
         worker.alive = False
         with self._lock:
             self._all.pop(worker.pid, None)
@@ -299,6 +419,25 @@ class ProcessWorkerPool:
         except OSError:
             pass
         return True
+
+    # ------------------------------------------------------------------
+    def register_direct_waiter(self, task_id: bytes) -> Optional[_DirectSlot]:
+        """If task_id is inflight here, register a sync-waiter handoff slot.
+        Returns None when the task isn't running in this pool (already done,
+        inproc, backlogged, or elsewhere)."""
+        with self._lock:
+            if task_id not in self._inflight:
+                return None
+            slot = _DirectSlot()
+            self._direct[task_id] = slot
+            return slot
+
+    def cancel_direct_waiter(self, task_id: bytes, slot: _DirectSlot) -> None:
+        """Give up on inline handling. If the reader already delivered into
+        the slot, the caller must still slot.run() (the reader won't)."""
+        with self._lock:
+            if self._direct.get(task_id) is slot:
+                del self._direct[task_id]
 
     # ------------------------------------------------------------------
     def inflight_tasks(self):

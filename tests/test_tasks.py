@@ -106,14 +106,46 @@ def test_large_return_from_process_worker(ray_start_regular):
 
 
 def test_process_isolation(ray_start_regular):
+    """execution="process" guarantees worker-process isolation."""
     rt = ray_start_regular
 
-    @rt.remote
+    @rt.remote(execution="process")
     def worker_pid():
         return os.getpid()
 
     pids = rt.get([worker_pid.remote() for _ in range(4)])
     assert os.getpid() not in pids
+
+
+def test_adaptive_tiering_fast_tasks_run_inproc(ray_start_regular):
+    """Auto-mode tasks with sub-threshold runtimes stay on the zero-IPC
+    in-process executor after the trial phase."""
+    rt = ray_start_regular
+
+    @rt.remote
+    def fast_pid():
+        return os.getpid()
+
+    for _ in range(3):
+        rt.get(fast_pid.remote())
+    assert rt.get(fast_pid.remote()) == os.getpid()
+
+
+def test_adaptive_tiering_heavy_tasks_migrate_to_process(ray_start_regular):
+    """Auto-mode tasks whose observed runtime exceeds the threshold move
+    to process workers (GIL-free parallelism)."""
+    rt = ray_start_regular
+
+    @rt.remote
+    def heavy_pid():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.02:
+            pass
+        return os.getpid()
+
+    for _ in range(3):
+        rt.get(heavy_pid.remote())
+    assert rt.get(heavy_pid.remote()) != os.getpid()
 
 
 def test_thread_execution_in_process(ray_start_regular):
