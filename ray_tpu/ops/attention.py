@@ -274,7 +274,7 @@ def _bwd_dkv_kernel(
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, interpret, g_lse=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, Tq)
@@ -292,6 +292,11 @@ def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, in
     of = op.reshape(B * H, Tq_p, D)
     # delta = rowsum(dO * O): cheap elementwise, plain XLA
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)[:, None, :]
+    if g_lse is not None:
+        # d lse/d s = softmax = P, so the lse cotangent folds into the same
+        # P * (dP - delta) term with delta := delta - g_lse
+        glp = _pad_to(g_lse.astype(jnp.float32).reshape(B * H, Tq), 1, bq)
+        delta = delta - glp[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -364,7 +369,6 @@ def _reference_attention(q, k, v, sm_scale: float, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q,
     k,
@@ -379,25 +383,50 @@ def flash_attention(
     Default blocks measured on v5e at T=32k/D=64: 512x1024 is ~3.7x faster
     than 128x128 (fewer grid steps amortize scratch reads; tiles still fit
     VMEM with margin at D=128).
+
+    Thin wrapper over :func:`flash_attention_with_lse` (an unused lse
+    output costs a zero cotangent, which folds away in the backward).
     """
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k, _use_interpret())
-    return out
+    return flash_attention_with_lse(q, k, v, sm_scale, causal, block_q, block_k)[0]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(
+    q,
+    k,
+    v,
+    sm_scale: Optional[float] = None,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 1024,
+):
+    """Flash attention that also returns the per-row logsumexp.
+
+    Returns (out [B,H,Tq,D], lse [B,H,Tq] f32). The lse output is what
+    makes partial-attention results combinable — ring attention merges
+    per-step outputs with lse-softmax weights (``parallel/ring.py``)."""
+    out, lse = _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k)[0]
+    return out, lse
+
+
+def _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k):
+    B, H, Tq, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, _use_interpret())
-    return out, (q, k, v, out, lse)
+    lse_trim = lse[:, 0, :Tq].reshape(B, H, Tq)
+    return (out, lse_trim), (q, k, v, out, lse)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, residuals, g):
+def _bwd_lse(sm_scale, causal, block_q, block_k, residuals, g):
     q, k, v, out, lse = residuals
+    g_out, g_lse = g
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k, _use_interpret())
+    return _flash_backward(
+        q, k, v, out, lse, g_out, scale, causal, block_q, block_k, _use_interpret(), g_lse=g_lse
+    )
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention_with_lse.defvjp(_fwd_lse, _bwd_lse)
 
 
 def _use_interpret() -> bool:

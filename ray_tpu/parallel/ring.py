@@ -43,77 +43,86 @@ def _to_varying(x, axis_name: str):
         return pcast(x, (axis_name,))
 
 
-def _block_attention_update(q, k, v, m_prev, l_prev, o_prev, mask, sm_scale):
-    """One online-softmax block update.
-
-    q: [B, H, Tq, D]; k, v: [B, H, Tk, D]
-    m, l: [B, H, Tq]; o: [B, H, Tq, D] (f32 accumulators)
-    mask: [Tq, Tk] True = attend.
-    """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
-    s = jnp.where(mask[None, None, :, :], s, NEG_INF)
-    m_block = s.max(axis=-1)
-    m_new = jnp.maximum(m_prev, m_block)
-    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
-    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
-    p = jnp.exp(s - m_new[..., None])
-    p = jnp.where(mask[None, None, :, :], p, 0.0)
-    l_new = l_prev * alpha + p.sum(axis=-1)
-    o_new = o_prev * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32), preferred_element_type=jnp.float32
-    )
-    return m_new, l_new, o_new
-
-
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, sm_scale: Optional[float] = None):
+def ring_attention(
+    q, k, v, axis_name: str, *, causal: bool = True, sm_scale: Optional[float] = None,
+    block_q: int = 512, block_k: int = 1024,
+):
     """Blockwise ring attention over sequence shards (call inside shard_map).
 
     q, k, v: [B, H, T_local, D] — the local sequence shard.
     Returns [B, H, T_local, D] in q.dtype.
+
+    Each ring step runs the Pallas flash kernel on the local Q against the
+    currently-held K/V shard (``flash_attention_with_lse``) and merges the
+    normalized partial outputs with lse-softmax weights — so per-step
+    compute rides the MXU kernel and per-device memory stays linear in the
+    shard length. For a causal mask the shard either attends fully
+    (earlier shard), causally (the diagonal shard), or not at all (later
+    shard) — picked per step with ``lax.switch``.
     """
     n = lax.axis_size(axis_name)
     my_block = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
-    q32 = q.astype(jnp.float32)
-    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Tq), jnp.float32)
-    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
-    # inside shard_map the loop carry must be marked device-varying
-    m0, l0, o0 = (_to_varying(x, axis_name) for x in (m0, l0, o0))
+    from ray_tpu.ops.attention import flash_attention_with_lse
 
-    q_pos = my_block * Tq + jnp.arange(Tq)
+    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)   # unnormalized accumulator
+    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)  # running max of lse_i
+    w0 = jnp.zeros((B, H, Tq), jnp.float32)      # sum of exp(lse_i - m)
+    o0, m0, w0 = (_to_varying(x, axis_name) for x in (o0, m0, w0))
+
+    def local_full(k_cur, v_cur):
+        out, lse = flash_attention_with_lse(q, k_cur, v_cur, scale, False, block_q, block_k)
+        return out.astype(jnp.float32), lse
+
+    def local_diag(k_cur, v_cur):
+        out, lse = flash_attention_with_lse(q, k_cur, v_cur, scale, True, block_q, block_k)
+        return out.astype(jnp.float32), lse
+
+    def local_empty(k_cur, v_cur):
+        return jnp.zeros((B, H, Tq, D), jnp.float32), jnp.full((B, H, Tq), NEG_INF, jnp.float32)
 
     def body(step, carry):
-        k_cur, v_cur, m, l, o = carry
+        k_cur, v_cur, o_acc, m_run, w_sum = carry
         src_block = (my_block - step) % n  # sequence block k_cur holds now
         if causal:
-            k_pos = src_block * Tk + jnp.arange(Tk)
-            mask = k_pos[None, :] <= q_pos[:, None]
+            # 0: src < my (full), 1: src == my (diagonal), 2: src > my (skip)
+            idx = jnp.where(src_block == my_block, 1, jnp.where(src_block < my_block, 0, 2))
+            o_i, lse_i = lax.switch(idx, (local_full, local_diag, local_empty), k_cur, v_cur)
         else:
-            mask = jnp.ones((Tq, Tk), bool)
-        m, l, o = _block_attention_update(q32, k_cur, v_cur, m, l, o, mask, scale)
+            o_i, lse_i = local_full(k_cur, v_cur)
+        # accumulate UNNORMALIZED against the running max: one divide after
+        # the loop replaces a full-tensor renormalize per step
+        m_new = jnp.maximum(m_run, lse_i)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(lse_i - m_new)
+        o_acc = o_acc * alpha[..., None] + o_i * beta[..., None]
+        w_sum = w_sum * alpha + beta
         # rotate K/V to the next rank on the ICI ring
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, o
+        return k_nxt, v_nxt, o_acc, m_new, w_sum
 
-    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
-    # fully-masked rows (causal, empty prefix) have l == 0
-    l_safe = jnp.where(l == 0, 1.0, l)
-    return (o / l_safe[..., None]).astype(q.dtype)
+    _, _, o, _m, w = lax.fori_loop(0, n, body, (k, v, o0, m0, w0))
+    w_safe = jnp.where(w == 0, 1.0, w)
+    return (o / w_safe[..., None]).astype(q.dtype)
 
 
 def ring_attention_sharded(
-    q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True, sm_scale: Optional[float] = None
+    q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True,
+    sm_scale: Optional[float] = None, block_q: int = 512, block_k: int = 1024,
 ):
     """Bind ring attention onto a mesh: [B, H, T, D] arrays sharded on T."""
     spec = P(None, None, axis_name, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k,
+    )
+    # check_vma=False: pallas_call out_shapes carry no vma annotation, and
+    # the kernel outputs are trivially device-varying over the shard axis
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(q, k, v)
 
 
 # --------------------------------------------------------------------------
@@ -131,14 +140,14 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True, sm_scale:
     def swap_to_seq(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    from ray_tpu.ops.attention import mha
+    from ray_tpu.ops.attention import flash_attention
 
     qh, kh, vh = swap_to_heads(q), swap_to_heads(k), swap_to_heads(v)
-    out = mha(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    out = flash_attention(qh, kh, vh, sm_scale, causal)
     return swap_to_seq(out)
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True, sm_scale=None):
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(q, k, v)
