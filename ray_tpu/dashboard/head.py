@@ -131,6 +131,19 @@ class DashboardHead:
             req._send(200, chrome_trace(events))
         elif path == "/metrics":
             req._send(200, global_registry().render_prometheus().encode(), "text/plain; version=0.0.4")
+        elif path == "/api/serve/applications":
+            from ray_tpu.serve import api as serve_api
+
+            if serve_api._controller is None:
+                # read-only endpoint: report not-started, don't boot serve
+                req._send(200, {"deployments": {}, "proxy_url": None, "started": False})
+            else:
+                try:
+                    from ray_tpu import serve
+
+                    req._send(200, serve.status())
+                except Exception as exc:
+                    req._send(500, {"error": str(exc)})
         elif path == "/api/jobs":
             req._send(200, {"jobs": self.job_manager.list_jobs()})
         elif path.startswith("/api/jobs/"):
@@ -172,6 +185,15 @@ class DashboardHead:
             sub_id = path[len("/api/jobs/"): -len("/stop")]
             ok = self.job_manager.stop_job(sub_id)
             req._send(200 if ok else 404, {"stopped": ok})
+        elif path == "/api/serve/applications":
+            # declarative deploy (parity: serve REST API PUT /applications)
+            try:
+                from ray_tpu import serve
+
+                deployed = serve.run_config(body)
+                req._send(200, {"deployed": deployed})
+            except Exception as exc:
+                req._send(400, {"error": str(exc)})
         else:
             req._send(404, {"error": f"no route {path!r}"})
 

@@ -28,6 +28,7 @@ from ray_tpu.data.read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_webdataset,
 )
@@ -65,6 +66,7 @@ __all__ = [
     "read_numpy",
     "read_images",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_webdataset",
 ]

@@ -327,6 +327,14 @@ class Dataset:
 
         self._write(ParquetDatasource([]), path, kw)
 
+    def write_sql(self, table: str, connection_factory, *, paramstyle: str = "qmark") -> None:
+        """Insert all rows into a DB table via DB-API (parity: write_sql)."""
+        from ray_tpu.data.datasource import SQLDatasource
+
+        self._write(
+            SQLDatasource("", connection_factory), table, {"paramstyle": paramstyle}
+        )
+
     def _write(self, datasource, path: str, kw: dict) -> None:
         sink = Dataset(L.Write(self._logical_op, datasource, path, kw))
         for _ in sink._execute():

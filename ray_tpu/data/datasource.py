@@ -378,3 +378,69 @@ class WebDatasetDatasource(FileBasedDatasource):
             except Exception:
                 return payload
         return payload
+
+
+class SQLDatasource(Datasource):
+    """DB-API 2.0 query reads (parity: sql_datasource.py — ``read_sql``
+    takes a query + zero-arg connection factory; rows become columnar
+    blocks). Parallelism is 1 unless the caller provides shard queries —
+    DB-API cursors can't be split safely in general."""
+
+    def __init__(self, sql: str, connection_factory, shard_queries=None):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.shard_queries = list(shard_queries) if shard_queries else [sql]
+
+    def get_name(self) -> str:
+        return "SQL"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        factory = self.connection_factory
+        tasks = []
+        for query in self.shard_queries:
+            def make(query=query):
+                conn = factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(query)
+                    cols = [d[0] for d in cur.description]
+                    rows = cur.fetchall()
+                finally:
+                    conn.close()
+                if not rows:
+                    return []
+                block = {
+                    c: np.asarray([r[i] for r in rows])
+                    for i, c in enumerate(cols)
+                }
+                return [block]
+
+            tasks.append(ReadTask(make, BlockMetadata(num_rows=-1, size_bytes=-1)))
+        return tasks
+
+    def write(self, blocks: List[Block], table: str, **kwargs) -> None:
+        """Insert blocks into ``table`` (backs ``Dataset.write_sql``).
+
+        ``paramstyle`` kwarg picks the DB-API placeholder: "qmark" (sqlite)
+        or "format" (postgres/mysql drivers). The table name must be a
+        plain identifier — it is interpolated into the statement.
+        """
+        if not table.replace("_", "").isalnum():
+            raise ValueError(f"table name must be a plain identifier, got {table!r}")
+        placeholder = {"qmark": "?", "format": "%s"}[kwargs.get("paramstyle", "qmark")]
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            for block in blocks:
+                acc = BlockAccessor.for_block(block)
+                data = acc.to_dict()
+                cols = list(data.keys())
+                placeholders = ", ".join(placeholder for _ in cols)
+                stmt = f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({placeholders})"
+                n = acc.num_rows()
+                cur.executemany(
+                    stmt, [tuple(data[c][i] for c in cols) for i in range(n)]
+                )
+            conn.commit()
+        finally:
+            conn.close()

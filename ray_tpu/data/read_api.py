@@ -21,6 +21,7 @@ from ray_tpu.data.datasource import (
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    SQLDatasource,
     TextDatasource,
     WebDatasetDatasource,
 )
@@ -104,3 +105,17 @@ def read_images(paths, *, size=None, mode=None, include_paths: bool = False, par
 
 def read_webdataset(paths, *, parallelism: int = -1, **kw) -> Dataset:
     return read_datasource(WebDatasetDatasource(paths, **kw), parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, shard_queries=None, parallelism: int = -1) -> Dataset:
+    """Rows of a DB-API query as a Dataset (parity: read_api.read_sql).
+
+    ``connection_factory`` is a zero-arg callable returning a DB-API
+    connection (e.g. ``lambda: sqlite3.connect(path)``). Pass
+    ``shard_queries`` (a list of non-overlapping queries) to read in
+    parallel; a single query reads serially.
+    """
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_queries=shard_queries),
+        parallelism=parallelism,
+    )

@@ -104,7 +104,12 @@ class ProcessWorkerPool:
 
     def prestart(self, count: int) -> None:
         for _ in range(count):
-            self._spawn()
+            try:
+                self._spawn()
+            except (RuntimeError, OSError):
+                if self._shutdown:
+                    return  # pool torn down mid-prestart: stand down quietly
+                raise
 
     def _spawn(self, to_idle: bool = True) -> WorkerHandle:
         # Hand the child the driver's full sys.path and start it with -S:
@@ -126,8 +131,8 @@ class ProcessWorkerPool:
                 + (["--shm", self._shm_name] if self._shm_name else []),
                 env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath},
             )
-            self._listener.settimeout(30.0)
             try:
+                self._listener.settimeout(30.0)
                 sock, _ = self._listener.accept()
             except (socket.timeout, OSError):
                 proc.kill()

@@ -213,6 +213,36 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """``rt serve deploy|run|status|shutdown`` (parity: the serve CLI,
+    serve/scripts.py — config-file deploys against a running runtime)."""
+    import json as _json
+
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu import serve
+
+    if args.serve_cmd in ("deploy", "run"):
+        deployed = serve.run_config(args.config)
+        print(_json.dumps({"deployed": deployed}, indent=2))
+        if args.serve_cmd == "run":
+            import time as _time
+
+            try:
+                while True:
+                    _time.sleep(1)
+            except KeyboardInterrupt:
+                serve.shutdown()
+        return 0
+    if args.serve_cmd == "status":
+        print(_json.dumps(serve.status(), indent=2, default=str))
+        return 0
+    serve.shutdown()
+    print("serve shut down")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     """In-process microbenchmark suite (``ray microbenchmark`` parity,
     driving the same cases as ``ray_perf.py``)."""
@@ -324,6 +354,18 @@ def build_parser() -> argparse.ArgumentParser:
     j = jsub.add_parser("list")
     j.add_argument("--address", default=None)
     j.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy", help="deploy applications from a YAML config")
+    s.add_argument("config", help="path to a serve config YAML")
+    s.set_defaults(fn=cmd_serve)
+    s = ssub.add_parser("run", help="deploy and block until interrupted")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        s = ssub.add_parser(name)
+        s.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
     sp.add_argument("--num-cpus", type=int, default=None)

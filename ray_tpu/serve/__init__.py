@@ -12,6 +12,7 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     proxy_url,
     run,
+    run_config,
     shutdown,
     start,
     status,
@@ -35,6 +36,7 @@ __all__ = [
     "multiplexed",
     "proxy_url",
     "run",
+    "run_config",
     "shutdown",
     "start",
     "status",

@@ -27,7 +27,7 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 0, request_timeout_s
     global _controller, _proxy
     with _state_lock:
         if _controller is None:
-            _controller = ServeControllerActor.options(execution="inproc", max_concurrency=16).remote()
+            _controller = ServeControllerActor.options(execution="inproc", max_concurrency=64).remote()
             ray_tpu.get(_controller.ping.remote())
         if _proxy is None:
             _proxy = HTTPProxy(http_host, http_port, request_timeout_s)
@@ -58,6 +58,16 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         if _proxy is not None:
             _proxy.add_route(route_prefix, ingress)
     return ingress
+
+
+def run_config(config) -> Dict[str, Any]:
+    """Deploy from a declarative config: a dict, or a path to a YAML file
+    (parity: ``serve deploy`` / ``serve run`` config path, serve/schema.py)."""
+    from ray_tpu.serve import schema
+
+    if isinstance(config, str):
+        config = schema.load_config_file(config)
+    return schema.deploy_config(config)
 
 
 def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
@@ -95,3 +105,6 @@ def shutdown() -> None:
             except Exception:
                 pass
             _controller = None
+        from ray_tpu.serve.router import clear_router_cache
+
+        clear_router_cache()

@@ -41,6 +41,7 @@ class ServeControllerActor:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._apps: Dict[str, str] = {}  # route_prefix -> ingress deployment
         self._lock = threading.RLock()
+        self._changed = threading.Condition(self._lock)  # long-poll wakeups
         self._running = True
         self._reconcile_thread = threading.Thread(target=self._loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
@@ -55,12 +56,14 @@ class ServeControllerActor:
                 self._scale_down_locked(old, 0)
             self._deployments[deployment.name] = state
             self._reconcile_locked(state)
+            self._changed.notify_all()
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             state = self._deployments.pop(name, None)
             if state is not None:
                 self._scale_down_locked(state, 0)
+            self._changed.notify_all()
 
     def set_ingress(self, route_prefix: str, deployment_name: str) -> None:
         with self._lock:
@@ -73,6 +76,26 @@ class ServeControllerActor:
     # ----------------------------------------------------------- queries
     def get_replicas(self, name: str) -> Tuple[int, List[Any]]:
         with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return (-1, [])
+            return (state.version, list(state.replicas))
+
+    def poll_replicas(self, name: str, known_version: int, timeout_s: float = 10.0) -> Tuple[int, List[Any]]:
+        """Long-poll (parity: LongPollHost, serve/_private/long_poll.py):
+        blocks until the replica set's version moves past known_version or
+        the timeout lapses, then returns the current snapshot. Routers keep
+        one of these outstanding instead of re-pulling on a timer."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while self._running:
+                state = self._deployments.get(name)
+                current = state.version if state is not None else -1
+                if current != known_version:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._changed.wait(remaining):
+                    break
             state = self._deployments.get(name)
             if state is None:
                 return (-1, [])
@@ -97,6 +120,15 @@ class ServeControllerActor:
 
     # ------------------------------------------------------- reconciling
     def _reconcile_locked(self, state: _DeploymentState) -> None:
+        before = state.version
+        self._reconcile_inner_locked(state)
+        if state.version != before:
+            # wake long-pollers only on real membership change — an
+            # unconditional notify would turn the 0.2s reconcile tick into
+            # a busy-poll for every watcher
+            self._changed.notify_all()
+
+    def _reconcile_inner_locked(self, state: _DeploymentState) -> None:
         d = state.deployment
         while len(state.replicas) < state.target_replicas:
             is_function = not isinstance(d.func_or_class, type)
@@ -153,6 +185,7 @@ class ServeControllerActor:
                 self._scale_down_locked(state, 0)
             self._deployments.clear()
             self._apps.clear()
+            self._changed.notify_all()
 
     def ping(self) -> str:
         return "ok"

@@ -44,24 +44,50 @@ class Router:
         self._version = -1
         self._rng = random.Random()
         self._reqs_since_push = 0
-        self._last_refresh = 0.0
+        self._watching = False
 
     # ------------------------------------------------------------ updates
-    def _refresh(self, force: bool = False) -> None:
-        # Long-poll-lite: replica membership changes rarely; re-pull at most
-        # every 0.5s (parity: LongPollHost pushes, we poll cheaply).
-        import time
-
-        now = time.monotonic()
-        if not force and self._replicas and now - self._last_refresh < 0.5:
-            return
-        self._last_refresh = now
-        version, replicas = ray_tpu.get(self.controller.get_replicas.remote(self.deployment_name))
+    def _apply_snapshot(self, version: int, replicas: List[Any]) -> None:
         with self._lock:
             if version != self._version:
                 self._version = version
                 self._replicas = replicas
                 self._inflight = {i: self._inflight.get(i, 0) for i in range(len(replicas))}
+
+    def _refresh(self, force: bool = False) -> None:
+        # Membership updates arrive via a long-poll watcher (parity:
+        # LongPollHost, serve/_private/long_poll.py); the synchronous pull
+        # only runs before the first snapshot lands.
+        if not self._watching:
+            with self._lock:
+                if self._watching:
+                    return
+                self._watching = True
+            threading.Thread(
+                target=self._watch_loop, daemon=True, name=f"serve-watch-{self.deployment_name}"
+            ).start()
+        if force or not self._replicas:
+            version, replicas = ray_tpu.get(self.controller.get_replicas.remote(self.deployment_name))
+            self._apply_snapshot(version, replicas)
+
+    def _watch_loop(self) -> None:
+        import time
+
+        failures = 0
+        while failures < 3:
+            try:
+                version, replicas = ray_tpu.get(
+                    self.controller.poll_replicas.remote(self.deployment_name, self._version, 5.0),
+                    timeout=30,
+                )
+                failures = 0
+                self._apply_snapshot(version, replicas)
+            except Exception:
+                failures += 1
+                time.sleep(0.5)
+        # controller unreachable: stand down; the next route() restarts us
+        with self._lock:
+            self._watching = False
 
     # ------------------------------------------------------------ routing
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
@@ -122,12 +148,35 @@ class Router:
         return True
 
 
+# One Router (and thus one long-poll watcher thread) per deployment per
+# controller — handles are created freely (serve.run makes one per
+# sub-deployment per call) and must not each spawn a watcher.
+_router_cache: Dict[tuple, "Router"] = {}
+_router_cache_lock = threading.Lock()
+
+
+def _shared_router(deployment_name: str, controller_handle) -> "Router":
+    key = (id(controller_handle), deployment_name)
+    with _router_cache_lock:
+        router = _router_cache.get(key)
+        if router is None:
+            router = _router_cache[key] = Router(deployment_name, controller_handle)
+        return router
+
+
+def clear_router_cache() -> None:
+    """Called on serve.shutdown so stale watchers drain and a new serve
+    instance gets fresh routers."""
+    with _router_cache_lock:
+        _router_cache.clear()
+
+
 class DeploymentHandle:
     """What users (and the proxy) call (parity: serve DeploymentHandle)."""
 
     def __init__(self, deployment_name: str, controller_handle):
         self.deployment_name = deployment_name
-        self._router = Router(deployment_name, controller_handle)
+        self._router = _shared_router(deployment_name, controller_handle)
         self._method = "__call__"
 
     def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":

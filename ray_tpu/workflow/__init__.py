@@ -18,11 +18,23 @@ from ray_tpu.workflow.api import (
     run,
     run_async,
 )
+from ray_tpu.workflow.events import (
+    EventListener,
+    QueueEventListener,
+    TimerListener,
+    deliver_event,
+    wait_for_event,
+)
 from ray_tpu.workflow.storage import WorkflowStorage
 
 __all__ = [
+    "EventListener",
+    "QueueEventListener",
+    "TimerListener",
     "WorkflowStorage",
     "cancel",
+    "deliver_event",
+    "wait_for_event",
     "delete",
     "get_output",
     "get_status",

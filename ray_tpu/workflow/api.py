@@ -69,7 +69,10 @@ def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
             raise TypeError(f"workflow DAGs must be built from task bind()s, got {type(node)}")
         args = tuple(results[id(a)] if isinstance(a, DAGNode) else a for a in node._bound_args)
         kwargs = {k: (results[id(v)] if isinstance(v, DAGNode) else v) for k, v in node._bound_kwargs.items()}
-        results[id(node)] = ray_tpu.remote(func).remote(*args, **kwargs)
+        # submit through the node's own RemoteFunction so bind-time options
+        # (execution mode, resources, retries) survive the replay
+        remote_fn = getattr(node, "_remote_function", None) or ray_tpu.remote(func)
+        results[id(node)] = remote_fn.remote(*args, **kwargs)
         durable[id(node)] = False
 
     for node in order:

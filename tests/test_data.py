@@ -332,3 +332,36 @@ def test_write_read_parquet_roundtrip(tmp_path):
     rows = sorted(back.take_all(), key=lambda r: r["a"])
     assert len(rows) == 100
     assert rows[10]["b"] == 5.0
+
+
+def test_read_sql_sqlite(tmp_path):
+    """DB-API reads (parity: read_api.read_sql over sql_datasource.py)."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT, score REAL)")
+    conn.executemany(
+        "INSERT INTO users VALUES (?, ?, ?)",
+        [(i, f"u{i}", i * 1.5) for i in range(10)],
+    )
+    conn.commit()
+    conn.close()
+
+    import ray_tpu.data as data
+
+    ds = data.read_sql("SELECT * FROM users ORDER BY id", lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert rows[3]["name"] == "u3" and rows[3]["score"] == 4.5
+
+    # sharded parallel read
+    ds2 = data.read_sql(
+        "SELECT * FROM users",
+        lambda: sqlite3.connect(db),
+        shard_queries=[
+            "SELECT * FROM users WHERE id < 5",
+            "SELECT * FROM users WHERE id >= 5",
+        ],
+    )
+    assert sorted(r["id"] for r in ds2.take_all()) == list(range(10))

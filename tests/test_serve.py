@@ -228,3 +228,107 @@ def test_deploy_upgrade_replaces():
     handle = serve.run(v2.bind(), route_prefix=None)
     time.sleep(0.3)
     assert handle.remote(None).result() == "v2"
+
+
+# --------------------------------------------------------------------------
+# Declarative config (schema.py; parity: serve deploy config path)
+# --------------------------------------------------------------------------
+# module-level bound app the config import_path can resolve
+@serve.deployment
+def _config_app_fn(x):
+    return {"doubled": x * 2}
+
+
+config_app = _config_app_fn.bind()
+
+
+def test_run_config_deploys_and_overrides():
+    cfg = {
+        "applications": [
+            {
+                "name": "cfg_app",
+                "route_prefix": "/cfg",
+                "import_path": "tests.test_serve:config_app",
+                "deployments": [
+                    {"name": "_config_app_fn", "num_replicas": 2, "max_ongoing_requests": 7}
+                ],
+            }
+        ]
+    }
+    deployed = serve.run_config(cfg)
+    assert deployed["cfg_app"]["ingress"] == "_config_app_fn"
+    handle = serve.get_deployment_handle("_config_app_fn")
+    assert handle.remote(21).result() == {"doubled": 42}
+    st = serve.status()["deployments"]["_config_app_fn"]
+    assert st["target_replicas"] == 2
+
+
+def test_config_validation_rejects_bad_configs():
+    from ray_tpu.serve.schema import ServeConfigError, validate_config
+
+    with pytest.raises(ServeConfigError):
+        validate_config({})
+    with pytest.raises(ServeConfigError):
+        validate_config({"applications": [{"name": "x"}]})  # no import_path
+    with pytest.raises(ServeConfigError):
+        validate_config(
+            {
+                "applications": [
+                    {"name": "a", "import_path": "m:a", "route_prefix": "nope"}
+                ]
+            }
+        )
+    with pytest.raises(ServeConfigError):
+        validate_config(
+            {
+                "applications": [
+                    {"name": "a", "import_path": "m:a"},
+                    {"name": "a", "import_path": "m:b"},
+                ]
+            }
+        )
+
+
+def test_run_config_from_yaml_file(tmp_path):
+    import yaml
+
+    path = tmp_path / "serve.yaml"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "applications": [
+                    {
+                        "name": "yaml_app",
+                        "route_prefix": "/yaml",
+                        "import_path": "tests.test_serve:config_app",
+                    }
+                ]
+            }
+        )
+    )
+    deployed = serve.run_config(str(path))
+    assert "yaml_app" in deployed
+    handle = serve.get_deployment_handle("_config_app_fn")
+    assert handle.remote(3).result() == {"doubled": 6}
+
+
+def test_long_poll_pushes_membership():
+    """The router's long-poll watcher must pick up scale-ups without a
+    request-driven refresh."""
+
+    @serve.deployment(num_replicas=1)
+    class Scaled:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Scaled.bind(), route_prefix=None)
+    assert handle.remote(1).result() == 1
+    router = handle._router
+    v0 = router._version
+    # scale up via redeploy and wait for the watcher to observe it
+    serve.run(Scaled.options(num_replicas=3).bind(), route_prefix=None)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(router._replicas) < 3:
+        time.sleep(0.05)
+    assert len(router._replicas) == 3
+    assert router._version != v0

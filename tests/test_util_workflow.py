@@ -205,3 +205,39 @@ def test_workflow_delete(tmp_path):
     workflow.run(one.bind(), workflow_id="wf4")
     workflow.delete("wf4")
     assert workflow.get_status("wf4") is None
+
+
+# --------------------------------------------------------------------------
+# Workflow events (parity: event_listener.py / wait_for_event)
+# --------------------------------------------------------------------------
+def test_workflow_wait_for_event_and_replay(tmp_path):
+    from ray_tpu import workflow
+    import ray_tpu
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def combine(evt, base):
+        return {"event": evt, "base": base}
+
+    # deliver before waiting so the poll returns immediately
+    workflow.deliver_event("approval", {"approved": True})
+    evt_node = workflow.wait_for_event(workflow.QueueEventListener, "approval", 10.0)
+    dag = combine.bind(evt_node, 7)
+    out = workflow.run(dag, workflow_id="wf_events")
+    assert out == {"event": {"approved": True}, "base": 7}
+
+    # resume must REPLAY the checkpointed event, not wait again (no second
+    # deliver_event happens; a re-poll would block and time out)
+    out2 = workflow.resume("wf_events")
+    assert out2 == {"event": {"approved": True}, "base": 7}
+
+
+def test_timer_listener_fires():
+    from ray_tpu.workflow.events import TimerListener
+    import time as _t
+
+    t0 = _t.monotonic()
+    val = TimerListener().poll_for_event(0.05)
+    assert _t.monotonic() - t0 >= 0.05
+    assert isinstance(val, float)
