@@ -25,8 +25,8 @@ def send_msg(sock: socket.socket, msg: Any) -> None:
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
-def recv_msg(sock: socket.socket) -> Any:
-    header = _recv_exact(sock, _HEADER.size)
+def recv_msg(sock: socket.socket, preread_header: bytes | None = None) -> Any:
+    header = preread_header if preread_header is not None else _recv_exact(sock, _HEADER.size)
     (length,) = _HEADER.unpack(header)
     if length > MAX_FRAME:
         raise ConnectionError(f"frame too large: {length}")

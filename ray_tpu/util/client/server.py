@@ -20,6 +20,7 @@ import threading
 import uuid
 from typing import Any, Dict
 
+from ray_tpu.util.client.binary import BINARY_MAGIC, recv_exact as _recv_exact_raw, serve_binary
 from ray_tpu.util.client.common import ActorMarker, RefMarker, recv_msg, send_msg, translate
 
 logger = logging.getLogger(__name__)
@@ -73,8 +74,17 @@ class ClientServer:
         session = _Session()
         send_lock = threading.Lock()
         try:
+            # Mode sniff: C++/native clients open with the 8-byte magic
+            # "RTCPBIN1" (cross-language frontend, reference cpp/ parity);
+            # Python clients open with a frame-length header (first byte 0
+            # for any sane frame size).
+            first8 = _recv_exact_raw(conn, 8)
+            if first8 == BINARY_MAGIC:
+                self._serve_binary(conn, session)
+                return
             while not self._stop.is_set():
-                msg = recv_msg(conn)
+                msg = recv_msg(conn, preread_header=first8)
+                first8 = None
                 # each request handled on its own thread so a blocking get
                 # doesn't starve concurrent calls (gRPC-stream parity)
                 threading.Thread(
@@ -90,6 +100,9 @@ class ClientServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_binary(self, conn: socket.socket, session: _Session) -> None:
+        serve_binary(self._rt, session, conn, stop_event=self._stop)
 
     def _handle(self, conn, send_lock, session: _Session, msg: dict) -> None:
         rid = msg.get("rid")

@@ -1,0 +1,10 @@
+"""Python functions invoked by name from the C++ client test
+(cross-language call targets; see tests/cpp_client_main.cpp)."""
+
+
+def format_sum(a: int, b: int, label: str) -> str:
+    return f"{label}={a + b}"
+
+
+def reverse_bytes(data: bytes) -> bytes:
+    return bytes(reversed(data))
