@@ -35,3 +35,11 @@ __all__ = [
     "TPUSliceProvider",
     "TPU_SLICE_TOPOLOGIES",
 ]
+from ray_tpu.autoscaler.v2 import (
+    AutoscalerV2,
+    AutoscalerV2Config,
+    Instance,
+    InstanceManager,
+)
+
+__all__ += ["AutoscalerV2", "AutoscalerV2Config", "Instance", "InstanceManager"]

@@ -1,0 +1,120 @@
+"""Connector pipelines: composable transforms between envs and modules.
+
+Parity: the reference's new-API-stack connectors (``rllib/connectors/`` —
+``ConnectorV2`` pieces chained into env-to-module and module-to-env
+pipelines that own observation preprocessing, frame stacking, action
+clipping/unsquashing etc., so RLModules stay pure).
+
+TPU-first shape: connectors here are PURE functions over pytrees so a
+pipeline can run inside the jitted rollout (``EnvRunner._build_rollout``)
+— XLA fuses the whole preprocessing chain into the scan. Stateless by
+construction: stateful pieces (frame stacking) would need a slot in the
+rollout carry, which the runner does not thread yet, so none ship.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Connector:
+    """One transform. Subclasses implement __call__(data) -> data (pure,
+    jit-safe)."""
+
+    def __call__(self, data):
+        raise NotImplementedError
+
+
+class ConnectorPipeline(Connector):
+    """Composition (parity: ConnectorPipelineV2). Applies pieces in order."""
+
+    def __init__(self, connectors: Sequence[Connector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, data):
+        for c in self.connectors:
+            data = c(data)
+        return data
+
+    def prepend(self, connector: Connector) -> "ConnectorPipeline":
+        return ConnectorPipeline([connector] + self.connectors)
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        return ConnectorPipeline(self.connectors + [connector])
+
+
+# ----------------------------------------------------------- env-to-module
+class NormalizeObs(Connector):
+    """Running-stats-free normalization: (obs - mean) / std with fixed
+    stats (computed offline or from env specs). For jit purity the stats
+    are constants, not running estimates."""
+
+    def __init__(self, mean, std):
+        self.mean = jnp.asarray(mean)
+        self.std = jnp.asarray(std)
+
+    def __call__(self, obs):
+        return (obs - self.mean) / jnp.maximum(self.std, 1e-6)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float, high: float):
+        self.low = low
+        self.high = high
+
+    def __call__(self, obs):
+        return jnp.clip(obs, self.low, self.high)
+
+
+class FlattenObs(Connector):
+    """Flatten trailing observation dims to a vector (keeps batch dims)."""
+
+    def __init__(self, batch_dims: int = 1):
+        self.batch_dims = batch_dims
+
+    def __call__(self, obs):
+        lead = obs.shape[: self.batch_dims]
+        return obs.reshape(*lead, -1)
+
+
+class CastObs(Connector):
+    def __init__(self, dtype=jnp.float32):
+        self.dtype = dtype
+
+    def __call__(self, obs):
+        return obs.astype(self.dtype)
+
+
+# ----------------------------------------------------------- module-to-env
+class ClipActions(Connector):
+    """Clip continuous actions to bounds (parity: clip_actions piece)."""
+
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low)
+        self.high = jnp.asarray(high)
+
+    def __call__(self, action):
+        return jnp.clip(action, self.low, self.high)
+
+
+class UnsquashActions(Connector):
+    """Map tanh-squashed [-1, 1] module outputs into env bounds (parity:
+    unsquash_actions piece)."""
+
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low)
+        self.high = jnp.asarray(high)
+
+    def __call__(self, action):
+        return self.low + (jnp.tanh(action) + 1.0) * 0.5 * (self.high - self.low)
+
+
+def env_to_module(*connectors: Connector) -> ConnectorPipeline:
+    return ConnectorPipeline(list(connectors))
+
+
+def module_to_env(*connectors: Connector) -> ConnectorPipeline:
+    return ConnectorPipeline(list(connectors))

@@ -51,10 +51,16 @@ class EnvRunner:
         num_envs: int = 8,
         rollout_length: int = 128,
         seed: int = 0,
+        env_to_module=None,
+        module_to_env=None,
     ):
         self.env = env
         self.module = module
         self.policy = policy
+        # connector pipelines (rllib/connectors parity): pure transforms
+        # that run INSIDE the jitted scan, fused by XLA
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
         self.num_envs = num_envs
         self.rollout_length = rollout_length
         self._key = jax.random.key(seed)
@@ -95,9 +101,20 @@ class EnvRunner:
             def step(carry, _):
                 env_state, obs, ep_ret, key = carry
                 key, ak, rk = jax.random.split(key, 3)
-                action, aux = self._action_fn(params, obs, ak, extra)
+                # env_to_module runs HERE, once, and the TRANSFORMED obs is
+                # what gets recorded — the learner must see the same inputs
+                # the policy acted on, or importance ratios/value targets
+                # compare different observation spaces
+                obs_mod = self.env_to_module(obs) if self.env_to_module is not None else obs
+                action, aux = self._action_fn(params, obs_mod, ak, extra)
+                env_action = (
+                    self.module_to_env(action) if self.module_to_env is not None else action
+                )
                 env_state2, next_obs, reward, terminated, truncated = self._step_v(
-                    env_state, action
+                    env_state, env_action
+                )
+                next_obs_mod = (
+                    self.env_to_module(next_obs) if self.env_to_module is not None else next_obs
                 )
                 done = terminated | truncated
                 ep_ret2 = ep_ret + reward
@@ -108,12 +125,12 @@ class EnvRunner:
                 env_state3 = _tree_where(done, reset_state, env_state2)
                 obs_after = _tree_where(done, reset_obs, next_obs)
                 record = {
-                    SampleBatch.OBS: obs,
+                    SampleBatch.OBS: obs_mod,
                     SampleBatch.ACTIONS: action,
                     SampleBatch.REWARDS: reward,
                     SampleBatch.DONES: terminated,
                     SampleBatch.TRUNCATEDS: truncated,
-                    SampleBatch.NEXT_OBS: next_obs,
+                    SampleBatch.NEXT_OBS: next_obs_mod,
                     "_completed_return": completed,
                     **aux,
                 }
@@ -148,7 +165,11 @@ class EnvRunner:
             "episodes_this_iter": len(episode_returns),
             "env_steps_this_iter": self.rollout_length * self.num_envs,
         }
-        return SampleBatch(traj), np.asarray(self._obs), episode_returns
+        final_obs = self._obs
+        if self.env_to_module is not None:
+            # bootstrap values are computed on the module's view of obs
+            final_obs = self.env_to_module(final_obs)
+        return SampleBatch(traj), np.asarray(final_obs), episode_returns
 
     def stop(self) -> None:
         pass

@@ -16,8 +16,15 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    AxSearch,
     BasicVariantGenerator,
+    BayesOptSearch,
+    ConcurrencyLimiter,
+    HyperOptSearch,
+    OptunaSearch,
+    Repeater,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -31,7 +38,14 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 
 __all__ = [
     "AsyncHyperBandScheduler",
+    "AxSearch",
     "BasicVariantGenerator",
+    "BayesOptSearch",
+    "ConcurrencyLimiter",
+    "HyperOptSearch",
+    "OptunaSearch",
+    "Repeater",
+    "TPESearcher",
     "FIFOScheduler",
     "HyperBandScheduler",
     "MedianStoppingRule",

@@ -107,6 +107,7 @@ class TuneController:
         experiment_dir: Optional[str] = None,
         max_failures_per_trial: int = 0,
         callbacks=None,
+        num_samples: Optional[int] = None,
     ):
         self.trainable = trainable
         self.searcher = searcher
@@ -121,12 +122,18 @@ class TuneController:
         os.makedirs(self.experiment_dir, exist_ok=True)
         self.trials: List[Trial] = []
         self.max_failures_per_trial = max_failures_per_trial
+        # Trial budget for suggesting searchers (a TPE-style searcher never
+        # exhausts on its own; BasicVariantGenerator self-limits, so the
+        # tuner passes None for it).
+        self.num_samples = num_samples
         from ray_tpu.tune.callback import CallbackList
 
         self.callbacks = CallbackList(callbacks)
 
     # ------------------------------------------------------------------
     def _make_trial(self) -> Optional[Trial]:
+        if self.num_samples is not None and len(self.trials) >= self.num_samples:
+            return None
         trial_id = f"trial_{len(self.trials):05d}"
         config = self.searcher.suggest(trial_id)
         if config is None:

@@ -103,6 +103,25 @@ class _SampleFrom(Domain):
 
 
 # ----------------------------------------------------------------- searcher
+def _sample_config(param_space: Dict[str, Any], rng: random.Random) -> dict:
+    """One random draw from a param space (shared by BasicVariantGenerator
+    and TPESearcher; grid dims collapse to a uniform choice here)."""
+    cfg = {}
+    for k, v in param_space.items():
+        if isinstance(v, GridSearch):
+            cfg[k] = rng.choice(v.values)
+        elif isinstance(v, _SampleFrom):
+            cfg[k] = v  # resolve after other keys are fixed
+        elif isinstance(v, Domain):
+            cfg[k] = v.sample(rng)
+        else:
+            cfg[k] = v
+    for k, v in list(cfg.items()):
+        if isinstance(v, _SampleFrom):
+            cfg[k] = v.fn(cfg)
+    return cfg
+
+
 class Searcher:
     """Interface (parity: search/searcher.py Searcher)."""
 
@@ -166,3 +185,202 @@ class BasicVariantGenerator(Searcher):
         cfg = self._configs[self._next]
         self._next += 1
         return cfg
+
+
+# --------------------------------------------------------------------------
+# Model-based search: native TPE (what the reference delegates to
+# Optuna/HyperOpt — search/optuna/, search/hyperopt/). Tree-structured
+# Parzen Estimator: split observed trials into good/bad by quantile gamma,
+# sample candidates from the good distribution, rank by the density ratio
+# l(x)/g(x). Supports Uniform/LogUniform/RandInt/QUniform/Categorical.
+# --------------------------------------------------------------------------
+class TPESearcher(Searcher):
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: Optional[str] = None,
+        mode: str = "max",
+        n_startup_trials: int = 8,
+        n_candidates: int = 24,
+        gamma: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric=metric, mode=mode)
+        self.param_space = param_space
+        self.n_startup_trials = n_startup_trials
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.rng = random.Random(seed)
+        self._live: Dict[str, dict] = {}
+        self._observed: List[Tuple[dict, float]] = []
+
+    # -- observation -------------------------------------------------------
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._observed.append((cfg, score))
+
+    # -- sampling ----------------------------------------------------------
+    def _random_config(self) -> dict:
+        return _sample_config(self.param_space, self.rng)
+
+    def _to_unit(self, key: str, value) -> Optional[float]:
+        """Map a sampled value into [0,1] for kernel density work."""
+        dom = self.param_space.get(key)
+        if isinstance(dom, (Uniform, QUniform)):
+            lo, hi = dom.lower, dom.upper
+            return (float(value) - lo) / (hi - lo) if hi > lo else 0.5
+        if isinstance(dom, LogUniform):
+            import math as _m
+
+            lo, hi = _m.log(dom.lower), _m.log(dom.upper)
+            return (_m.log(float(value)) - lo) / (hi - lo) if hi > lo else 0.5
+        if isinstance(dom, RandInt):
+            lo, hi = dom.lower, dom.upper
+            return (float(value) - lo) / max(hi - 1 - lo, 1)
+        return None  # categorical / fixed handled separately
+
+    def _density(self, group: List[dict], cfg: dict) -> float:
+        """Parzen estimate of cfg's log-density under a trial group."""
+        if not group:
+            return 0.0
+        import math as _m
+
+        bw = max(0.08, 1.0 / max(len(group), 1) ** 0.5)
+        logp = 0.0
+        for key in self.param_space:
+            dom = self.param_space.get(key)
+            if isinstance(dom, (Categorical, GridSearch)):
+                values = dom.categories if isinstance(dom, Categorical) else dom.values
+                # smoothed categorical frequency
+                counts = sum(1 for g in group if g.get(key) == cfg.get(key))
+                logp += _m.log((counts + 1.0) / (len(group) + len(values)))
+                continue
+            u = self._to_unit(key, cfg.get(key))
+            if u is None:
+                continue
+            dens = 0.0
+            for g in group:
+                gu = self._to_unit(key, g.get(key))
+                if gu is None:
+                    continue
+                dens += _m.exp(-0.5 * ((u - gu) / bw) ** 2)
+            dens = dens / (len(group) * bw * _m.sqrt(2 * _m.pi)) + 1e-12
+            logp += _m.log(dens)
+        return logp
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._observed) < self.n_startup_trials:
+            cfg = self._random_config()
+        else:
+            ranked = sorted(self._observed, key=lambda t: t[1], reverse=True)
+            n_good = max(1, int(len(ranked) * self.gamma))
+            good = [c for c, _ in ranked[:n_good]]
+            bad = [c for c, _ in ranked[n_good:]] or good
+            candidates = [self._random_config() for _ in range(self.n_candidates)]
+            cfg = max(candidates, key=lambda c: self._density(good, c) - self._density(bad, c))
+        self._live[trial_id] = cfg
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (parity: search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None  # controller retries later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class Repeater(Searcher):
+    """Repeats each suggestion N times and reports the averaged metric to
+    the wrapped searcher (parity: search/repeater.py — noise-robust
+    evaluation)."""
+
+    def __init__(self, searcher: Searcher, repeat: int):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        self.searcher = searcher
+        self.repeat = repeat
+        self._groups: Dict[str, dict] = {}      # group key -> config
+        self._results: Dict[str, List[dict]] = {}
+        self._trial_group: Dict[str, str] = {}
+        self._counter = 0
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        group, idx = divmod(self._counter, self.repeat)
+        key = f"group_{group}"
+        if idx == 0:
+            cfg = self.searcher.suggest(key)
+            if cfg is None:
+                return None
+            self._groups[key] = cfg
+            self._results[key] = []
+        cfg = self._groups.get(key)
+        if cfg is None:
+            return None
+        self._counter += 1
+        self._trial_group[trial_id] = key
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
+        key = self._trial_group.pop(trial_id, None)
+        if key is None:
+            return
+        bucket = self._results.setdefault(key, [])
+        # errored repeats count toward group completion but contribute no
+        # observation — otherwise one failed repeat stalls the group (and
+        # the wrapped searcher's live-trial accounting) forever
+        bucket.append(result if (result and not error) else None)
+        if len(bucket) >= self.repeat:
+            rs = [r for r in self._results.pop(key) if r is not None]
+            if not rs:
+                self.searcher.on_trial_complete(key, None, error=True)
+                return
+            metric = self.metric or self.searcher.metric
+            vals = [r[metric] for r in rs if metric in r]
+            avg = dict(rs[-1])
+            if vals:
+                avg[metric] = sum(vals) / len(vals)
+            self.searcher.on_trial_complete(key, avg, error=False)
+
+
+def _external_searcher_stub(name: str, dist: str):
+    class _Missing(Searcher):
+        def __init__(self, *a, **kw):
+            raise ImportError(
+                f"{name} wraps the external '{dist}' package, which is not "
+                f"installed in this environment. Use TPESearcher (native "
+                f"model-based search) or BasicVariantGenerator instead."
+            )
+
+    _Missing.__name__ = name
+    return _Missing
+
+
+# Parity markers for the reference's external-library searchers (gated:
+# the libraries are not vendored; the native TPESearcher covers the
+# model-based-search role).
+OptunaSearch = _external_searcher_stub("OptunaSearch", "optuna")
+HyperOptSearch = _external_searcher_stub("HyperOptSearch", "hyperopt")
+AxSearch = _external_searcher_stub("AxSearch", "ax-platform")
+BayesOptSearch = _external_searcher_stub("BayesOptSearch", "bayesian-optimization")

@@ -90,9 +90,16 @@ class Tuner:
             # Train-on-Tune: the search space targets train_loop_config.
             param_space = dict(param_space.get("train_loop_config", param_space))
             trainable = self.trainable.as_trainable()
+        custom_searcher = self.tune_config.search_alg is not None
         searcher = self.tune_config.search_alg or BasicVariantGenerator(
             param_space, num_samples=self.tune_config.num_samples
         )
+        # TuneConfig.metric/mode flow into a custom searcher that wasn't
+        # given its own — a model-based searcher with metric=None would
+        # silently degrade to random search
+        if custom_searcher and searcher.metric is None:
+            searcher.metric = self.tune_config.metric
+            searcher.mode = self.tune_config.mode
         exp_dir = None
         if self.run_config.storage_path:
             exp_dir = os.path.join(self.run_config.storage_path, self.run_config.name or "tune_experiment")
@@ -106,6 +113,7 @@ class Tuner:
             experiment_dir=exp_dir,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
             callbacks=self.run_config.callbacks,
+            num_samples=self.tune_config.num_samples if custom_searcher else None,
         )
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)

@@ -366,3 +366,62 @@ def test_offline_record_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(data[SampleBatch.OBS]), loaded[SampleBatch.OBS]
     )
+
+
+# --------------------------------------------------------------------------
+# Connectors (parity: rllib/connectors env-to-module / module-to-env)
+# --------------------------------------------------------------------------
+def test_connector_pipeline_composition():
+    import jax.numpy as jnp
+    from ray_tpu.rllib.connectors import (
+        CastObs,
+        ClipActions,
+        ClipObs,
+        ConnectorPipeline,
+        FlattenObs,
+        NormalizeObs,
+        UnsquashActions,
+        env_to_module,
+    )
+
+    pipe = env_to_module(NormalizeObs(mean=1.0, std=2.0), ClipObs(-1.0, 1.0))
+    out = pipe(jnp.asarray([1.0, 5.0, -9.0]))
+    assert out.tolist() == [0.0, 1.0, -1.0]
+
+    flat = FlattenObs(batch_dims=1)(jnp.ones((4, 2, 3)))
+    assert flat.shape == (4, 6)
+
+    clip = ClipActions(-0.5, 0.5)(jnp.asarray([-2.0, 0.1, 3.0]))
+    assert clip.tolist() == pytest.approx([-0.5, 0.1, 0.5])
+
+    unsq = UnsquashActions(0.0, 10.0)(jnp.asarray([-100.0, 0.0, 100.0]))
+    assert float(unsq[0]) == pytest.approx(0.0, abs=1e-3)
+    assert float(unsq[1]) == pytest.approx(5.0)
+    assert float(unsq[2]) == pytest.approx(10.0, abs=1e-3)
+
+    # pipelines compose and extend
+    p2 = ConnectorPipeline([CastObs(jnp.float32)]).append(NormalizeObs(0.0, 1.0))
+    assert p2(jnp.asarray([1, 2], jnp.int32)).dtype == jnp.float32
+
+
+def test_env_runner_with_connectors():
+    """An observation-normalizing connector inside the jitted rollout must
+    still produce learnable PPO batches."""
+    import jax.numpy as jnp
+    from ray_tpu.rllib.connectors import NormalizeObs, env_to_module
+    from ray_tpu.rllib.env_runner import EnvRunner
+    from ray_tpu.rllib.envs import CartPole
+    from ray_tpu.rllib.rl_module import ActorCriticModule
+
+    env = CartPole()
+    module = ActorCriticModule(env.observation_size, env.num_actions, hidden=(32,))
+    runner = EnvRunner(
+        env,
+        module,
+        num_envs=4,
+        rollout_length=16,
+        env_to_module=env_to_module(NormalizeObs(mean=0.0, std=1.0)),
+    )
+    params = module.init(jax.random.key(0))
+    batch, final_obs, returns = runner.sample(params)
+    assert batch["obs"].shape[:2] == (16, 4)

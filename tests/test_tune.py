@@ -186,3 +186,77 @@ def test_pbt_runs():
     )
     assert len(results) == 4
     assert results.get_best_result().metrics["score"] > 0
+
+
+# --------------------------------------------------------------------------
+# Model-based search (native TPE) + searcher utilities
+# --------------------------------------------------------------------------
+def test_tpe_searcher_finds_optimum():
+    """TPE must concentrate samples near the optimum of a smooth bowl and
+    beat random search's best-found on average."""
+    from ray_tpu import tune
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        tune.report({"loss": (x - 0.7) ** 2 + (y + 0.3) ** 2})
+
+    space = {"x": tune.uniform(-2, 2), "y": tune.uniform(-2, 2)}
+    searcher = tune.TPESearcher(space, metric="loss", mode="min", n_startup_trials=6, seed=0)
+    tuner = tune.Tuner(
+        objective,
+        param_space=space,
+        tune_config=tune.TuneConfig(search_alg=searcher, num_samples=30, metric="loss", mode="min"),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 0.25
+    assert len(grid) == 30
+
+
+def test_concurrency_limiter_caps_inflight():
+    from ray_tpu import tune
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    inner = BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=6)
+    limiter = ConcurrencyLimiter(inner, max_concurrent=2)
+    # suggest 2 fine, 3rd deferred until a completion
+    assert limiter.suggest("a") is not None
+    assert limiter.suggest("b") is not None
+    assert limiter.suggest("c") is None
+    limiter.on_trial_complete("a", {"x": 1})
+    assert limiter.suggest("c") is not None
+
+
+def test_repeater_averages_metric():
+    from ray_tpu import tune
+    from ray_tpu.tune.search import Repeater, Searcher
+
+    class Fixed(Searcher):
+        def __init__(self):
+            super().__init__(metric="score", mode="max")
+            self.completed = []
+
+        def suggest(self, trial_id):
+            return {"c": 1}
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append(result)
+
+    inner = Fixed()
+    rep = Repeater(inner, repeat=3)
+    ids = ["t1", "t2", "t3"]
+    for t in ids:
+        assert rep.suggest(t) == {"c": 1}
+    for t, score in zip(ids, [1.0, 2.0, 3.0]):
+        rep.on_trial_complete(t, {"score": score})
+    assert len(inner.completed) == 1
+    assert inner.completed[0]["score"] == 2.0
+
+
+def test_external_searchers_gate_with_importerror():
+    from ray_tpu import tune
+
+    with pytest.raises(ImportError, match="optuna"):
+        tune.OptunaSearch()
+    with pytest.raises(ImportError, match="hyperopt"):
+        tune.HyperOptSearch()
