@@ -1,0 +1,89 @@
+"""HuggingFace integrations: Accelerate and Transformers trainers.
+
+Parity: the reference's ``train/huggingface/`` + the Accelerate/DeepSpeed
+examples (``train/examples/deepspeed/deepspeed_torch_trainer.py``,
+``train/tests/test_torch_accelerate.py``) — a worker gang where each rank
+runs under an ``accelerate.Accelerator`` (or a ``transformers.Trainer``),
+with the process group and Accelerate's env contract wired by the
+framework instead of `accelerate launch`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+class AccelerateTrainer(TorchTrainer):
+    """Runs the user loop under HF Accelerate (parity: AccelerateTrainer).
+
+    The gang's torch process group comes up first (gloo); each worker then
+    sets Accelerate's launcher env so ``accelerate.Accelerator()`` adopts
+    the existing group instead of spawning its own.
+    """
+
+    def __init__(self, train_loop_per_worker, **kwargs):
+        def loop(config):
+            # rank/world/master env comes from the torch process-group
+            # wrapper (_with_process_group); Accelerator() then adopts the
+            # already-initialized gloo group — no launcher flag needed.
+            os.environ.setdefault("ACCELERATE_USE_CPU", "true")
+            return train_loop_per_worker(config)
+
+        super().__init__(loop, **kwargs)
+
+
+_report_callback_cls = None
+
+
+def _get_report_callback_cls():
+    """Build the TrainerCallback subclass once (lazy: transformers import
+    stays off the module-import path). A single cached class keeps
+    add_callback/remove_callback(RayTrainReportCallback-style) type
+    comparisons working."""
+    global _report_callback_cls
+    if _report_callback_cls is None:
+        from transformers import TrainerCallback
+
+        class RayTrainReportCallbackImpl(TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                from ray_tpu import train
+
+                if logs:
+                    metrics = {k: v for k, v in logs.items() if isinstance(v, (int, float))}
+                    metrics["step"] = state.global_step
+                    metrics["epoch"] = float(state.epoch or 0)
+                    train.report(metrics)
+
+        _report_callback_cls = RayTrainReportCallbackImpl
+    return _report_callback_cls
+
+
+def RayTrainReportCallback():
+    """transformers.TrainerCallback bridging HF logs to train.report
+    (parity: ray.train.huggingface.transformers.RayTrainReportCallback)."""
+    return _get_report_callback_cls()()
+
+
+def prepare_trainer(trainer):
+    """Attach the report bridge to a transformers.Trainer (parity:
+    transformers.prepare_trainer)."""
+    trainer.add_callback(RayTrainReportCallback())
+    return trainer
+
+
+class TransformersTrainer(TorchTrainer):
+    """Gang-runs a user-built ``transformers.Trainer`` per worker (parity:
+    the legacy TransformersTrainer): ``trainer_init_per_worker(config)``
+    returns a Trainer; the framework wires the process group, attaches the
+    report callback, and calls ``.train()``."""
+
+    def __init__(self, trainer_init_per_worker: Callable, **kwargs):
+        def loop(config):
+            hf_trainer = trainer_init_per_worker(config)
+            prepare_trainer(hf_trainer)
+            hf_trainer.train()
+
+        super().__init__(loop, **kwargs)

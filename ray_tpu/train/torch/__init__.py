@@ -57,6 +57,18 @@ def _with_process_group(fn, backend: str, master_addr: str, master_port: int, ti
         from ray_tpu.train import get_context
 
         ctx = get_context()
+        # Torch-launcher env contract (reference TorchConfig sets the same):
+        # libraries that re-derive the rendezvous from env (HF Accelerate,
+        # lightning) find it without their own launcher.
+        import os
+
+        os.environ["MASTER_ADDR"] = master_addr
+        os.environ["MASTER_PORT"] = str(master_port)
+        os.environ["RANK"] = str(ctx.get_world_rank())
+        os.environ["LOCAL_RANK"] = str(ctx.get_local_rank())
+        os.environ["WORLD_SIZE"] = str(ctx.get_world_size())
+        os.environ["LOCAL_WORLD_SIZE"] = str(ctx.get_local_world_size())
+        os.environ["NODE_RANK"] = str(ctx.get_node_rank())
         created_group = False
         if not dist.is_initialized():  # loops that rendezvous themselves keep working
             dist.init_process_group(

@@ -1,0 +1,86 @@
+"""HuggingFace integration tests: Accelerate gangs and a real
+transformers.Trainer over the worker-group fabric (reference parity:
+train/tests/test_torch_accelerate.py + transformers integration tests —
+models built from config, no hub access)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import ScalingConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_accelerate_trainer_gang():
+    from ray_tpu.train.huggingface import AccelerateTrainer
+
+    def loop(config):
+        import torch
+        from accelerate import Accelerator
+
+        acc = Accelerator(cpu=True)
+        model = torch.nn.Linear(4, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        x = torch.randn(16, 4)
+        y = x.sum(dim=1, keepdim=True)
+        for _ in range(3):
+            loss = ((model(x) - y) ** 2).mean()
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+        train.report(
+            {
+                "loss": float(loss.detach()),
+                "world": acc.num_processes,
+                "rank": acc.process_index,
+            }
+        )
+
+    trainer = AccelerateTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.metrics["world"] == 2
+
+
+def test_transformers_trainer_tiny_model(tmp_path):
+    from ray_tpu.train.huggingface import TransformersTrainer
+
+    def trainer_init(config):
+        import torch
+        from transformers import GPT2Config, GPT2LMHeadModel, Trainer, TrainingArguments
+
+        model = GPT2LMHeadModel(
+            GPT2Config(vocab_size=64, n_positions=16, n_embd=16, n_layer=1, n_head=2)
+        )
+
+        class Toks(torch.utils.data.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                ids = torch.randint(0, 64, (8,))
+                return {"input_ids": ids, "labels": ids.clone()}
+
+        args = TrainingArguments(
+            output_dir=str(tmp_path / "hf_out"),
+            per_device_train_batch_size=4,
+            max_steps=2,
+            logging_steps=1,
+            report_to=[],
+            use_cpu=True,
+            save_strategy="no",
+        )
+        return Trainer(model=model, args=args, train_dataset=Toks())
+
+    trainer = TransformersTrainer(
+        trainer_init, scaling_config=ScalingConfig(num_workers=1)
+    )
+    result = trainer.fit()
+    assert "loss" in result.metrics or "train_loss" in result.metrics
