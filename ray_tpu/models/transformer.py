@@ -10,9 +10,10 @@ nets are the closest analog, ``rllib/core/rl_module/rl_module.py``):
 - RMSNorm + RoPE + SwiGLU; optional top-2 MoE FFN whose expert dimension
   shards over the ``ep`` mesh axis (expert parallelism).
 - Attention: Pallas flash kernel (``ray_tpu.ops.attention``) on single-chip
-  or dp-only shardings; XLA einsum attention under tp/sp meshes (GSPMD can
-  partition einsums but not custom kernels — ring attention for the optimal
-  sp path lives in ``ray_tpu.parallel.ring``).
+  or dp-only shardings; XLA einsum attention under tp/sp meshes; or
+  ``attention="ring"`` — sequence-parallel ring attention
+  (``ray_tpu.parallel.ring``: ppermute K/V rotation + per-step flash
+  kernel) sharded over (dp, tp, sp), the long-context mode.
 
 Mesh axes: ``dp`` (batch), ``sp`` (sequence), ``tp`` (hidden/heads),
 ``ep`` (experts; may be folded into ``dp`` on small meshes).
@@ -45,7 +46,7 @@ class TransformerConfig:
     expert_top_k: int = 2
     dtype: Any = jnp.bfloat16     # activation dtype
     param_dtype: Any = jnp.float32
-    attention: str = "auto"       # auto | flash | dense
+    attention: str = "auto"       # auto | flash | dense | ring (sp-sharded)
     remat: bool = False           # jax.checkpoint each layer
 
     @property
@@ -154,10 +155,32 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _attention(cfg: TransformerConfig, q, k, v, use_flash: bool):
+def _attention(cfg: TransformerConfig, q, k, v, use_flash: bool, mesh=None, sp_axis=None):
     # q,k,v: [B, T, H, Dh] -> [B, H, T, Dh]
     qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
-    if use_flash:
+    if cfg.attention == "ring" and mesh is not None and sp_axis is not None:
+        # sequence-parallel ring attention: K/V shards rotate over the sp
+        # ICI axis; each step runs the Pallas flash kernel locally
+        # (parallel/ring.py). GSPMD would instead all-gather K/V.
+        from ray_tpu.parallel.ring import ring_attention_sharded
+
+        T = qt.shape[2]
+        n_sp = mesh.shape[sp_axis]
+        pad = (-T) % n_sp
+        if pad:
+            # tail-pad to an even sp split; causal masking keeps padded
+            # KEYS invisible to real queries, padded QUERY rows are sliced
+            widths = ((0, 0), (0, 0), (0, pad), (0, 0))
+            qt, kt, vt = (jnp.pad(x, widths) for x in (qt, kt, vt))
+        axes = set(mesh.axis_names)
+        o = ring_attention_sharded(
+            qt, kt, vt, mesh, sp_axis, causal=True,
+            batch_axis="dp" if "dp" in axes else None,
+            head_axis="tp" if "tp" in axes else None,
+        )
+        if pad:
+            o = o[:, :, :T]
+    elif use_flash:
         o = flash_attention(qt, kt, vt, None, True)
     else:
         o = mha(qt, kt, vt, causal=True)
@@ -192,6 +215,8 @@ def forward(
     tokens: jax.Array,  # [B, T] int32
     *,
     act_spec: Optional[P] = None,
+    mesh: Optional[Mesh] = None,
+    sp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Returns logits [B, T, V]."""
     use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and jax.default_backend() == "tpu" and act_spec is None)
@@ -205,7 +230,7 @@ def forward(
         k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
         v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
         q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
-        o = _attention(cfg, q, k, v, use_flash)
+        o = _attention(cfg, q, k, v, use_flash, mesh=mesh, sp_axis=sp_axis)
         x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
         h = _rms_norm(x, layer["ffn_norm"])
         ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)
@@ -221,9 +246,9 @@ def forward(
     return logits.astype(jnp.float32)
 
 
-def loss_fn(cfg: TransformerConfig, params, tokens, *, act_spec=None) -> jax.Array:
+def loss_fn(cfg: TransformerConfig, params, tokens, *, act_spec=None, mesh=None, sp_axis=None) -> jax.Array:
     """Next-token cross entropy over tokens[:, :-1] -> tokens[:, 1:]."""
-    logits = forward(cfg, params, tokens[:, :-1], act_spec=act_spec)
+    logits = forward(cfg, params, tokens[:, :-1], act_spec=act_spec, mesh=mesh, sp_axis=sp_axis)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -254,13 +279,26 @@ def make_train_step(
         return {"params": params, "opt": opt.init(params), "step": jnp.zeros((), jnp.int32)}
 
     act_spec = None
+    ring_mesh = None
+    sp_ax = None
     if mesh is not None:
         axis_names = set(mesh.axis_names)
         sp_ax = sp if (sp and sp in axis_names) else None
         act_spec = P(dp if dp in axis_names else None, sp_ax, None)
+        if cfg.attention == "ring":
+            if sp_ax is None:
+                raise ValueError(
+                    'attention="ring" needs a sequence-parallel mesh axis '
+                    f"(sp={sp!r} not in mesh axes {sorted(axis_names)}); "
+                    "silently falling back to dense would lose the memory "
+                    "scaling the mode promises"
+                )
+            ring_mesh = mesh
 
     def train_step(state, tokens):
-        loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, tokens, act_spec=act_spec))(state["params"])
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, act_spec=act_spec, mesh=ring_mesh, sp_axis=sp_ax)
+        )(state["params"])
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss

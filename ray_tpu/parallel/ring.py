@@ -113,9 +113,14 @@ def ring_attention(
 def ring_attention_sharded(
     q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True,
     sm_scale: Optional[float] = None, block_q: int = 512, block_k: int = 1024,
+    batch_axis: Optional[str] = None, head_axis: Optional[str] = None,
 ):
-    """Bind ring attention onto a mesh: [B, H, T, D] arrays sharded on T."""
-    spec = P(None, None, axis_name, None)
+    """Bind ring attention onto a mesh: [B, H, T, D] arrays sharded on T.
+
+    ``batch_axis``/``head_axis`` shard B and H through the shard_map too —
+    without them a dp/tp-sharded caller pays an all-gather into the
+    shard_map and redundant per-replica attention compute."""
+    spec = P(batch_axis, head_axis, axis_name, None)
     fn = functools.partial(
         ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k,

@@ -107,3 +107,40 @@ def test_graft_entry_hooks():
     out = jax.jit(fn)(*args)
     assert out.shape[-1] == 2048
     g.dryrun_multichip(8)
+
+
+def test_ring_attention_mode_matches_dense():
+    """attention="ring" (sp-sharded ring attention in the model) must agree
+    with the dense einsum path on loss and gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.transformer import TransformerConfig, make_train_step
+
+    devices = np.array(jax.devices()[:4]).reshape(2, 2, 1)
+    mesh = Mesh(devices, ("dp", "sp", "tp"))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (4, 32)), jnp.int32
+    )
+
+    losses = {}
+    params_after = {}
+    for mode in ("dense", "ring"):
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=64, attention=mode, remat=False,
+        )
+        with mesh:
+            init_state, step = make_train_step(cfg, mesh=mesh)
+            state = init_state(jax.random.key(0))
+            state, loss = step(state, step.shard_batch(tokens))
+            losses[mode] = float(loss)
+            params_after[mode] = jax.tree.map(np.asarray, state["params"])
+    assert losses["ring"] == pytest.approx(losses["dense"], rel=1e-3)
+    # the backward pass must agree too, not just the forward loss
+    flat_d = jax.tree.leaves(params_after["dense"])
+    flat_r = jax.tree.leaves(params_after["ring"])
+    for a, b in zip(flat_d, flat_r):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-3)
