@@ -228,6 +228,40 @@ class PipPlugin(RuntimeEnvPlugin):
         return env, cwd
 
 
+class CondaPlugin(RuntimeEnvPlugin):
+    """Parity with ``conda.py:259``; like pip, the zero-egress image cannot
+    solve/install environments, so the plugin validates shape and verifies
+    any pip-style dependency list is already importable."""
+
+    name = "conda"
+    priority = 3
+
+    def validate(self, value) -> None:
+        if not isinstance(value, (str, dict)):
+            raise TypeError(
+                "runtime_env['conda'] must be an env name or an environment.yml dict"
+            )
+
+    def modify_context(self, value, env, cwd, uris=None):
+        if isinstance(value, str):
+            raise RuntimeError(
+                f"runtime_env conda env {value!r}: no conda installation is "
+                "available in this environment"
+            )
+        deps = value.get("dependencies", [])
+        reqs = []
+        for d in deps:
+            if isinstance(d, dict) and "pip" in d:
+                reqs.extend(d["pip"])
+            elif isinstance(d, str) and d.split("=")[0] not in ("python", "pip"):
+                # conda-native packages verify the same way: importable or
+                # fail fast with the clear not-pre-installed error
+                reqs.append(d.split("=")[0])
+        if reqs:
+            return PipPlugin().modify_context(reqs, env, cwd, uris)
+        return env, cwd
+
+
 _plugins: Dict[str, RuntimeEnvPlugin] = {}
 
 
@@ -239,7 +273,7 @@ def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
     return _plugins.get(name)
 
 
-for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin()):
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin(), CondaPlugin()):
     register_plugin(_p)
 
 

@@ -212,3 +212,21 @@ def test_pg_scheduling_strategy_targets_bundle_node(ray_start_cluster):
 
     strategy = PlacementGroupSchedulingStrategy(info, placement_group_bundle_index=0)
     assert rt.get(where.options(scheduling_strategy=strategy).remote()) == target.hex()
+
+
+def test_runtime_env_conda_gates_and_pip_passthrough():
+    from ray_tpu.runtime_env.plugin import apply_to_process_env
+
+    # pip deps already installed pass through conda's pip section
+    env, cwd = apply_to_process_env(
+        {"conda": {"dependencies": ["python", {"pip": ["numpy"]}]}}, {}, None
+    )
+    # a named conda env cannot exist here
+    with pytest.raises(RuntimeError, match="conda"):
+        apply_to_process_env({"conda": "my-env"}, {}, None)
+    with pytest.raises(RuntimeError, match="not pre-installed"):
+        apply_to_process_env(
+            {"conda": {"dependencies": [{"pip": ["definitely-not-a-real-pkg-xyz"]}]}},
+            {},
+            None,
+        )
