@@ -26,6 +26,11 @@ from ray_tpu.parallel.collective import (
     reducescatter,
     send_recv,
 )
+from ray_tpu.parallel.distributed import (
+    initialize as distributed_initialize,
+    multihost_mesh,
+    rendezvous_via_cluster,
+)
 from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_sharded
 from ray_tpu.parallel.ring import (
     ring_attention,
@@ -38,6 +43,7 @@ __all__ = [
     "MeshManager", "P", "mesh_manager", "named_sharding", "replicate",
     "shard_array", "collective", "allgather", "allreduce", "allreduce_mean",
     "all_to_all", "barrier", "broadcast", "init_collective_group",
+    "distributed_initialize", "multihost_mesh", "rendezvous_via_cluster",
     "ppermute", "reducescatter", "send_recv", "pipeline_apply",
     "pipeline_sharded", "ring_attention", "ring_attention_sharded",
     "ulysses_attention", "ulysses_attention_sharded",

@@ -1,0 +1,168 @@
+"""Multi-host distributed runtime: jax.distributed over DCN.
+
+Parity target: the reference's multi-node data plane (NCCL/MPI process
+groups rendezvoused through a named actor — ``ray.util.collective``
+``collective_group/nccl_collective_group.py``; Train's rank-0 address
+broadcast, ``train/torch/config.py:112``). The TPU-native equivalent is
+``jax.distributed``: one controller process per host joins a coordination
+service, after which ``jax.devices()`` spans every host's chips and a
+``Mesh`` laid out with hosts on the OUTER axes makes XLA route those axes'
+collectives over DCN while inner axes ride ICI (the scaling-book recipe).
+
+This module owns that bootstrap:
+
+* :func:`initialize` — join/start the coordination service (idempotent),
+  env-driven on TPU pods (the runtime sets MEGASCALE/COORDINATOR vars) or
+  explicit for CPU/GPU fleets.
+* :func:`multihost_mesh` — build a Mesh whose leading axis is the host
+  (slice) dimension: ``devices.reshape(num_hosts, ...)`` ordered so each
+  host's local chips are contiguous — DCN-crossing collectives only on
+  the leading axis.
+* :func:`rendezvous_via_cluster` — the in-fabric analog of the NCCL-id
+  actor: rank 0 publishes the coordinator address in the control-plane KV
+  and every other host blocks on it, so a worker gang started by Train
+  can bootstrap jax.distributed with no out-of-band channel.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    timeout_s: float = 120.0,
+) -> bool:
+    """Join the jax.distributed coordination service (idempotent).
+
+    With no arguments on a TPU pod, jax discovers everything from the
+    runtime env (TPU_WORKER_HOSTNAMES et al.). Returns True if this call
+    initialized the runtime, False if it already was.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return False
+    try:  # private probe: tolerate jax moving this namespace
+        if jax._src.distributed.global_state.client is not None:
+            _initialized = True
+            return False
+    except AttributeError:
+        pass
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(
+        **kwargs,
+        initialization_timeout=int(timeout_s),
+    )
+    _initialized = True
+    return True
+
+
+def multihost_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    *,
+    dcn_axis: str = "dp",
+):
+    """Mesh over ALL hosts' devices with the DCN-crossing axis outermost.
+
+    ``axis_sizes`` may use -1 once (inferred). The ``dcn_axis`` gets the
+    host dimension: each host's local devices stay contiguous on the inner
+    axes so only ``dcn_axis`` collectives cross hosts.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    names = list(axis_names)
+    sizes = list(axis_sizes)
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    if dcn_axis in names:
+        # order: dcn axis first so the reshape assigns whole contiguous
+        # host blocks to it; axis j of the reshaped array is the axis
+        # NAMED names[order[j]], so it must move to position order[j]
+        order = [names.index(dcn_axis)] + [i for i in range(len(names)) if names[i] != dcn_axis]
+        arr = np.array(devices).reshape([sizes[i] for i in order])
+        arr = np.moveaxis(arr, range(len(order)), order)
+    else:
+        arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def _routable_ip() -> str:
+    """A non-loopback interface IP (UDP-connect trick — no packet is sent;
+    gethostbyname(hostname) commonly resolves to 127.0.1.1 on Debian-family
+    images, which other hosts cannot reach)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def rendezvous_via_cluster(
+    rank: int,
+    world_size: int,
+    *,
+    group_name: str = "default",
+    port: int = 0,
+    timeout_s: float = 120.0,
+) -> Tuple[str, int, int]:
+    """Agree on a coordinator via the control-plane KV (NCCL-id-actor
+    parity): rank 0 picks ``host:port`` and publishes it; other ranks poll.
+    ``group_name`` scopes the key per gang — a retry or a second job must
+    not read a dead gang's address. Returns (coordinator_address,
+    world_size, rank) ready for :func:`initialize`.
+    """
+    import socket
+
+    from ray_tpu.api import get_cluster
+
+    kv = get_cluster().control.kv
+    key = f"jax_distributed_coordinator/{group_name}".encode()
+    if rank == 0:
+        host = _routable_ip()
+        if port == 0:
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+        address = f"{host}:{port}"
+        kv.put(key, address.encode())
+    else:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            raw = kv.get(key)
+            if raw:
+                address = raw.decode()
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("rank 0 never published the jax coordinator address")
+            time.sleep(0.05)
+    return address, world_size, rank

@@ -5,5 +5,6 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig", "BC", "BCConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig", "BC", "BCConfig", "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "DreamerV3", "DreamerV3Config"]

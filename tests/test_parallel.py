@@ -264,3 +264,44 @@ def test_pipeline_gradients_flow():
     g_ref = jax.grad(loss_ref)(stacked)
     np.testing.assert_allclose(np.asarray(g_pipe["w"]), np.asarray(g_ref["w"]), rtol=1e-4, atol=1e-5)
     assert float(jnp.abs(g_pipe["w"]).sum()) > 0
+
+
+# ------------------------------------------------------------- multi-host
+def test_multihost_mesh_layout():
+    """The DCN axis must own whole host blocks: device order within each
+    dcn slice stays contiguous (inner axes ride ICI)."""
+    from ray_tpu.parallel.distributed import multihost_mesh
+
+    mesh = multihost_mesh(("dp", "tp"), (2, -1), dcn_axis="dp")
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    devs = mesh.devices
+    ids = np.vectorize(lambda d: d.id)(devs)
+    # row 0 = first host's 4 devices, row 1 = second host's
+    assert sorted(ids[0].tolist()) == [0, 1, 2, 3]
+    assert sorted(ids[1].tolist()) == [4, 5, 6, 7]
+
+
+def test_rendezvous_via_cluster_kv():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    from ray_tpu.parallel.distributed import rendezvous_via_cluster
+
+    addr0, ws, r0 = rendezvous_via_cluster(0, 2)
+    addr1, _, r1 = rendezvous_via_cluster(1, 2)
+    assert addr0 == addr1 and ":" in addr0
+    assert (r0, r1) == (0, 1)
+
+
+def test_multihost_mesh_three_axes_dcn_not_first():
+    """3-axis layout with the DCN axis in the middle: shape must be right
+    AND the dcn axis must own contiguous host blocks (moveaxis regression)."""
+    from ray_tpu.parallel.distributed import multihost_mesh
+
+    mesh = multihost_mesh(("a", "dp", "b"), (2, 2, 2), dcn_axis="dp")
+    assert dict(mesh.shape) == {"a": 2, "dp": 2, "b": 2}
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    # fixing a,b and varying dp must jump by a whole host block (4 devices)
+    for a in range(2):
+        for b in range(2):
+            assert abs(int(ids[a, 1, b]) - int(ids[a, 0, b])) == 4

@@ -425,3 +425,65 @@ def test_env_runner_with_connectors():
     params = module.init(jax.random.key(0))
     batch, final_obs, returns = runner.sample(params)
     assert batch["obs"].shape[:2] == (16, 4)
+
+
+# --------------------------------------------------------------------------
+# DreamerV3 (parity: rllib/algorithms/dreamerv3 — model-based RL)
+# --------------------------------------------------------------------------
+def _tiny_dreamer():
+    from ray_tpu.rllib.algorithms import DreamerV3Config
+    from ray_tpu.rllib.envs import CartPole
+
+    cfg = DreamerV3Config().environment(CartPole()).debugging(seed=0)
+    cfg.num_envs = 4
+    cfg.seq_len = 8
+    cfg.batch_size_seqs = 4
+    cfg.deter_size = 64
+    cfg.units = 64
+    cfg.latent_cats = 8
+    cfg.latent_classes = 8
+    cfg.horizon = 8
+    cfg.updates_per_iter = 1
+    return cfg
+
+
+def test_dreamerv3_world_model_learns():
+    """The world-model loss on a FIXED probe batch must drop with training
+    (same data before and after isolates learning from replay drift)."""
+    algo = _tiny_dreamer().build()
+    algo.train()  # fill replay; compile
+    probe = {k: jnp.asarray(v) for k, v in algo._replay[0].items()}
+    key = jax.random.key(123)
+    before = float(algo._observe_loss(algo.state["wm"], key, probe))
+    last = {}
+    for _ in range(8):
+        last = algo.train()["learners"]
+    after = float(algo._observe_loss(algo.state["wm"], key, probe))
+    assert np.isfinite(list(last.values())).all()
+    assert after < before
+    algo.stop()
+
+
+def test_dreamerv3_checkpoint_roundtrip(tmp_path):
+    algo = _tiny_dreamer().build()
+    algo.train()
+    state = algo.get_state()
+    algo2 = _tiny_dreamer().build()
+    algo2.set_state(state)
+    a = jax.tree.leaves(algo.state["wm"])[0]
+    b = jax.tree.leaves(algo2.state["wm"])[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    algo2.train()  # resumed instance keeps training
+    algo.stop()
+    algo2.stop()
+
+
+def test_dreamerv3_symlog_twohot_roundtrip():
+    from ray_tpu.rllib.algorithms.dreamerv3 import _BINS, symexp, symlog, twohot, twohot_mean
+
+    x = jnp.asarray([-50.0, -1.0, 0.0, 0.5, 7.0, 300.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x), rtol=1e-5)
+    # twohot encoding is exact for in-range scalars: decode via bin atoms
+    enc = twohot(symlog(x))
+    dec = symexp(jnp.sum(enc * _BINS, -1))
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(x), rtol=1e-4, atol=1e-4)
