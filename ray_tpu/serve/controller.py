@@ -31,6 +31,7 @@ class _DeploymentState:
             self.target_replicas = int(deployment.num_replicas)
         self.last_inflight: Dict[int, int] = {}
         self.last_scale_time = 0.0
+        self.health: Dict[int, dict] = {}    # id(replica) -> {fails, born}
 
 
 @ray_tpu.remote
@@ -151,15 +152,90 @@ class ServeControllerActor:
                 pass
             state.version += 1
 
+    HEALTH_CHECK_TIMEOUT_S = 5.0
+    HEALTH_CHECK_FAILS = 3       # consecutive failures before replacement
+    HEALTH_GRACE_S = 15.0        # startup grace before failures count
+
     def _loop(self) -> None:
+        ticks = 0
         while self._running:
             time.sleep(0.2)
+            ticks += 1
+            if ticks % 5 == 0:  # ~1s health-check cadence, outside the lock
+                self._health_check()
             with self._lock:
                 for state in list(self._deployments.values()):
                     cfg = state.deployment.autoscaling_config
                     if cfg is not None:
                         self._autoscale_locked(state, cfg)
                     self._reconcile_locked(state)
+
+    def _health_check(self) -> None:
+        """Replace replicas that fail HEALTH_CHECK_FAILS consecutive probes
+        (parity: DeploymentState replica health checks). Probes run OUTSIDE
+        the controller lock — a hung replica must not stall deploys or
+        long-pollers — and a startup grace period keeps slow __init__s
+        (method calls queue behind them) from being killed mid-load."""
+        with self._lock:
+            snapshot = {name: list(st.replicas) for name, st in self._deployments.items()}
+        refs = {}
+        for name, reps in snapshot.items():
+            for r in reps:
+                try:
+                    refs[(name, id(r))] = r.check_health.remote()
+                except Exception:
+                    refs[(name, id(r))] = None
+        from ray_tpu.exceptions import GetTimeoutError
+
+        deadline = time.monotonic() + self.HEALTH_CHECK_TIMEOUT_S
+        # "ok" / "slow" (probe timed out: maybe busy or initializing) /
+        # "dead" (actor gone: no threshold needed, it can never recover)
+        verdicts: Dict[tuple, str] = {}
+        for key, ref in refs.items():
+            if ref is None:
+                verdicts[key] = "dead"
+                continue
+            try:
+                ray_tpu.get(ref, timeout=max(0.1, deadline - time.monotonic()))
+                verdicts[key] = "ok"
+            except GetTimeoutError:
+                verdicts[key] = "slow"
+            except Exception:
+                verdicts[key] = "dead"
+        now = time.monotonic()
+        with self._lock:
+            for name, reps in snapshot.items():
+                state = self._deployments.get(name)
+                if state is None:
+                    continue
+                changed = False
+                for r in reps:
+                    verdict = verdicts.get((name, id(r)), "ok")
+                    rec = state.health.setdefault(
+                        id(r), {"fails": 0, "born": now, "ready": False}
+                    )
+                    if verdict == "ok":
+                        rec["fails"] = 0
+                        rec["ready"] = True
+                        continue
+                    rec["fails"] += 1
+                    # startup grace ends once the replica has EVER passed a
+                    # probe; a dead actor skips the threshold entirely
+                    in_grace = not rec["ready"] and now - rec["born"] < self.HEALTH_GRACE_S
+                    should_remove = verdict == "dead" or (
+                        rec["fails"] >= self.HEALTH_CHECK_FAILS and not in_grace
+                    )
+                    if should_remove and r in state.replicas:
+                        state.replicas.remove(r)
+                        state.health.pop(id(r), None)
+                        state.version += 1
+                        changed = True
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                if changed:
+                    self._changed.notify_all()  # routers drop dead replicas now
 
     def _autoscale_locked(self, state: _DeploymentState, cfg: AutoscalingConfig) -> None:
         """Queue-depth autoscaling (parity: autoscaling_policy.py

@@ -1,0 +1,20 @@
+"""User-facing metrics API (parity: ``ray.util.metrics`` — Counter/Gauge/
+Histogram that application code defines and the runtime exports through the
+same Prometheus endpoint as the system metrics)."""
+
+from ray_tpu.observability.metrics import global_registry
+
+
+def Counter(name: str, description: str = "", tag_keys=None):
+    return global_registry().counter(name, description)
+
+
+def Gauge(name: str, description: str = "", tag_keys=None):
+    return global_registry().gauge(name, description)
+
+
+def Histogram(name: str, description: str = "", boundaries=None, tag_keys=None):
+    return global_registry().histogram(name, description, boundaries=tuple(boundaries or ()))
+
+
+__all__ = ["Counter", "Gauge", "Histogram"]

@@ -182,3 +182,22 @@ def test_task_metrics_incremented(rt_cluster):
     rt.get([t.remote() for _ in range(3)])
     after = global_registry().counter("tasks_terminal_total").get({"state": "FINISHED"})
     assert after - before >= 3
+
+
+def test_util_metrics_user_api():
+    """Parity: ray.util.metrics — user-defined metrics export through the
+    same Prometheus endpoint as system metrics."""
+    from ray_tpu.observability.metrics import global_registry
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("app_requests_total", "requests")
+    c.inc()
+    c.inc(2)
+    g = Gauge("app_queue_depth")
+    g.set(7)
+    h = Histogram("app_latency_s", boundaries=[0.01, 0.1, 1.0])
+    h.observe(0.05)
+    out = global_registry().render_prometheus()
+    assert "app_requests_total" in out
+    assert "app_queue_depth 7" in out
+    assert "app_latency_s" in out

@@ -285,12 +285,15 @@ def test_rendezvous_via_cluster_kv():
     import ray_tpu
 
     ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
-    from ray_tpu.parallel.distributed import rendezvous_via_cluster
+    try:
+        from ray_tpu.parallel.distributed import rendezvous_via_cluster
 
-    addr0, ws, r0 = rendezvous_via_cluster(0, 2)
-    addr1, _, r1 = rendezvous_via_cluster(1, 2)
-    assert addr0 == addr1 and ":" in addr0
-    assert (r0, r1) == (0, 1)
+        addr0, ws, r0 = rendezvous_via_cluster(0, 2)
+        addr1, _, r1 = rendezvous_via_cluster(1, 2)
+        assert addr0 == addr1 and ":" in addr0
+        assert (r0, r1) == (0, 1)
+    finally:
+        ray_tpu.shutdown()
 
 
 def test_multihost_mesh_three_axes_dcn_not_first():

@@ -332,3 +332,34 @@ def test_long_poll_pushes_membership():
         time.sleep(0.05)
     assert len(router._replicas) == 3
     assert router._version != v0
+
+
+def test_dead_replica_replaced_by_health_check():
+    """A replica whose actor dies must be pruned by the controller's health
+    check and respawned by reconcile; requests keep succeeding."""
+
+    @serve.deployment(num_replicas=2)
+    class Svc:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Svc.bind(), route_prefix=None)
+    assert handle.remote(1).result() == 2
+    # kill one replica actor out from under the controller
+    from ray_tpu.serve import api as serve_api
+
+    _v, replicas = ray_tpu.get(
+        serve_api._controller.get_replicas.remote("Svc")
+    )
+    ray_tpu.kill(replicas[0])
+    deadline = time.monotonic() + 15
+    healed = False
+    while time.monotonic() < deadline:
+        _v2, reps = ray_tpu.get(serve_api._controller.get_replicas.remote("Svc"))
+        if len(reps) == 2 and replicas[0] not in reps:
+            healed = True
+            break
+        time.sleep(0.1)
+    assert healed, "controller never replaced the killed replica"
+    for i in range(6):
+        assert handle.remote(i).result() == i + 1
