@@ -129,8 +129,24 @@ class ProcessWorkerPool:
             proc = subprocess.Popen(
                 [sys.executable, "-S", "-m", "ray_tpu.runtime.worker_main", "--addr", self._listen_path]
                 + (["--shm", self._shm_name] if self._shm_name else []),
-                env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath},
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": pythonpath,
+                    # pipes are block-buffered; prints must reach the driver live
+                    "PYTHONUNBUFFERED": "1",
+                },
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                errors="replace",
             )
+            # Stream worker output to the driver with a pid prefix (parity:
+            # log_monitor.py tailing worker logs into the driver, the
+            # "(pid=...)" lines) — user prints inside tasks stay visible.
+            threading.Thread(
+                target=self._pump_logs, args=(proc,), name=f"worker-logs-{proc.pid}", daemon=True
+            ).start()
             try:
                 self._listener.settimeout(30.0)
                 sock, _ = self._listener.accept()
@@ -153,6 +169,19 @@ class ProcessWorkerPool:
                 self._idle.append(handle)
         self._watch_worker(handle)
         return handle
+
+    @staticmethod
+    def _pump_logs(proc: subprocess.Popen) -> None:
+        # merged worker stdout+stderr goes to the DRIVER'S STDERR (reference
+        # log_monitor behavior): parsed driver stdout stays clean, and the
+        # pump must never die early or the 64KB pipe fills and blocks the
+        # worker mid-task (decode errors are already 'replace'd).
+        try:
+            for line in proc.stdout:
+                sys.stderr.write(f"(worker pid={proc.pid}) {line}")
+                sys.stderr.flush()
+        except (ValueError, OSError):
+            pass  # stream closed at shutdown
 
     def _maybe_grow_async(self) -> None:
         """Spawn a worker on a background thread when the backlog has work

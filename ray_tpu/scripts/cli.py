@@ -213,6 +213,19 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """``rt memory`` (parity: ray memory): `rt list objects` plus a totals
+    footer — delegates to the shared list path."""
+    args.kind = "objects"
+    args.format = "table"
+    cmd_list(args)
+    data = _get(_read_address(args.address), f"/api/objects?limit={args.limit}")
+    rows = data["objects"]
+    total = sum(r.get("size_bytes") or 0 for r in rows)
+    print(f"{len(rows)} objects, {total / 1e6:.2f} MB total")
+    return 0
+
+
 def cmd_serve(args) -> int:
     """``rt serve deploy|run|status|shutdown`` (parity: the serve CLI,
     serve/scripts.py — config-file deploys against a running runtime)."""
@@ -393,6 +406,11 @@ def build_parser() -> argparse.ArgumentParser:
     j = jsub.add_parser("list")
     j.add_argument("--address", default=None)
     j.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)
