@@ -1,5 +1,15 @@
 """Pallas TPU kernels for the hot ops."""
 
-from ray_tpu.ops.attention import flash_attention, mha
+from ray_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_with_lse,
+    mha,
+    sliding_window_attention,
+)
 
-__all__ = ["flash_attention", "mha"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "mha",
+    "sliding_window_attention",
+]

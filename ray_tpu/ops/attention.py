@@ -36,13 +36,20 @@ NEG_INF = -1e30
 _LANES = 128  # m/l scratch is lane-replicated to keep stores 2-D tileable
 
 
-def _block_mask(q_start, k_start, block_q, block_k, causal, q_len, kv_len):
-    """[block_q, block_k] validity mask (None when nothing is masked)."""
+def _block_mask(q_start, k_start, block_q, block_k, causal, q_len, kv_len, window=None):
+    """[block_q, block_k] validity mask (None when nothing is masked).
+
+    ``window``: sliding-window (local) attention — key j is visible to
+    query i iff i - window < j (combined with causal: j <= i), the
+    Mistral-style local mask."""
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     valid = None
     if causal:
         valid = k_pos <= q_pos
+    if window is not None:
+        in_w = k_pos > q_pos - window
+        valid = in_w if valid is None else jnp.logical_and(valid, in_w)
     if q_len is not None:
         in_q = q_pos < q_len
         valid = in_q if valid is None else jnp.logical_and(valid, in_q)
@@ -55,6 +62,7 @@ def _block_mask(q_start, k_start, block_q, block_k, causal, q_len, kv_len):
 def _attn_fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, sm_scale: float, causal: bool, block_q: int, block_k: int, kv_len: int, tk_padded: int,
+    window=None,
 ):
     """Grid (bh, q_block, k_block); k innermost streams K/V through VMEM.
 
@@ -75,8 +83,11 @@ def _attn_fwd_kernel(
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # Causal: blocks entirely above the diagonal contribute nothing.
+    # Skip blocks with no visible (q, k) pair: above the causal diagonal,
+    # or entirely left of the sliding window.
     run = jnp.asarray(True) if not causal else (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = jnp.logical_and(run, k_start + block_k - 1 > q_start - window)
 
     @pl.when(run)
     def _step():
@@ -86,7 +97,7 @@ def _attn_fwd_kernel(
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         valid = _block_mask(
             q_start, k_start, block_q, block_k, causal,
-            None, kv_len if kv_len < tk_padded else None,
+            None, kv_len if kv_len < tk_padded else None, window=window,
         )
         if valid is not None:
             s = jnp.where(valid, s, NEG_INF)
@@ -125,7 +136,7 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int, interpret: bool):
+def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int, interpret: bool, window=None):
     """Returns (out [B,H,Tq,D], lse [B*H, 1, Tq_padded])."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -145,7 +156,7 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k
     out, lse = pl.pallas_call(
         functools.partial(
             _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk, kv_len=Tk, tk_padded=Tk_p,
+            block_q=bq, block_k=bk, kv_len=Tk, tk_padded=Tk_p, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
@@ -176,7 +187,7 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, sm_scale, causal, block_q, block_k, kv_len, tk_padded,
+    *, sm_scale, causal, block_q, block_k, kv_len, tk_padded, window=None,
 ):
     """Grid (bh, q_block, k_block); streams K/V. dq accumulates in scratch.
 
@@ -193,6 +204,8 @@ def _bwd_dq_kernel(
     q_start = qi * block_q
     k_start = ki * block_k
     run = jnp.asarray(True) if not causal else (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = jnp.logical_and(run, k_start + block_k - 1 > q_start - window)
 
     @pl.when(run)
     def _step():
@@ -206,7 +219,7 @@ def _bwd_dq_kernel(
         p = jnp.exp(s - lse[:, None])
         valid = _block_mask(
             q_start, k_start, block_q, block_k, causal,
-            None, kv_len if kv_len < tk_padded else None,
+            None, kv_len if kv_len < tk_padded else None, window=window,
         )
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
@@ -223,7 +236,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len, tq_padded, tk_padded,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, tq_padded, tk_padded, window=None,
 ):
     """Grid (bh, k_block, q_block); streams Q/dO. dk/dv accumulate in scratch.
 
@@ -241,6 +254,11 @@ def _bwd_dkv_kernel(
     q_start = qi * block_q
     k_start = ki * block_k
     run = jnp.asarray(True) if not causal else (q_start + block_q - 1 >= k_start)
+    if window is not None:
+        # any-visible-pair condition: the EARLIEST query (i = q_start) has
+        # the loosest window bound j > i - window, so the pair is live iff
+        # the latest key clears it (same guard as the dq kernel)
+        run = jnp.logical_and(run, k_start + block_k - 1 > q_start - window)
 
     @pl.when(run)
     def _step():
@@ -255,7 +273,7 @@ def _bwd_dkv_kernel(
         valid = _block_mask(
             q_start, k_start, block_q, block_k, causal,
             q_len if q_len < tq_padded else None,
-            kv_len if kv_len < tk_padded else None,
+            kv_len if kv_len < tk_padded else None, window=window,
         )
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
@@ -274,7 +292,7 @@ def _bwd_dkv_kernel(
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, interpret, g_lse=None):
+def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, interpret, g_lse=None, window=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, Tq)
@@ -301,7 +319,7 @@ def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, in
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk, kv_len=Tk, tk_padded=Tk_p,
+            block_q=bq, block_k=bk, kv_len=Tk, tk_padded=Tk_p, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
         grid=(B * H, Tq_p // bq, Tk_p // bk),
@@ -325,6 +343,7 @@ def _flash_backward(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k, in
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=bq, block_k=bk, q_len=Tq, kv_len=Tk, tq_padded=Tq_p, tk_padded=Tk_p,
+            window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tk_p, D), k.dtype),
@@ -390,7 +409,24 @@ def flash_attention(
     return flash_attention_with_lse(q, k, v, sm_scale, causal, block_q, block_k)[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def sliding_window_attention(
+    q, k, v, window: int, *, sm_scale: Optional[float] = None, causal: bool = True,
+    block_q: int = 512, block_k: int = 1024,
+):
+    """Local (sliding-window) flash attention.
+
+    With ``causal=True`` (the Mistral-style long-context mask) query i sees
+    keys in (i - window, i]; off-window blocks are skipped entirely, so
+    compute is O(T * window). With ``causal=False`` the window bounds only
+    the PAST — keys j > i - window, including all future positions — and
+    compute stays O(T^2) on the future side.
+    """
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window} (0 would mask every key)")
+    return flash_attention_with_lse(q, k, v, sm_scale, causal, block_q, block_k, window)[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(
     q,
     k,
@@ -399,30 +435,32 @@ def flash_attention_with_lse(
     causal: bool = True,
     block_q: int = 512,
     block_k: int = 1024,
+    window: Optional[int] = None,
 ):
     """Flash attention that also returns the per-row logsumexp.
 
     Returns (out [B,H,Tq,D], lse [B,H,Tq] f32). The lse output is what
     makes partial-attention results combinable — ring attention merges
     per-step outputs with lse-softmax weights (``parallel/ring.py``)."""
-    out, lse = _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k)[0]
+    out, lse = _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k, window)[0]
     return out, lse
 
 
-def _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k):
+def _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k, window=None):
     B, H, Tq, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
-    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, _use_interpret())
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, _use_interpret(), window=window)
     lse_trim = lse[:, 0, :Tq].reshape(B, H, Tq)
     return (out, lse_trim), (q, k, v, out, lse)
 
 
-def _bwd_lse(sm_scale, causal, block_q, block_k, residuals, g):
+def _bwd_lse(sm_scale, causal, block_q, block_k, window, residuals, g):
     q, k, v, out, lse = residuals
     g_out, g_lse = g
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     return _flash_backward(
-        q, k, v, out, lse, g_out, scale, causal, block_q, block_k, _use_interpret(), g_lse=g_lse
+        q, k, v, out, lse, g_out, scale, causal, block_q, block_k, _use_interpret(),
+        g_lse=g_lse, window=window,
     )
 
 
