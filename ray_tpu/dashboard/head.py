@@ -92,7 +92,11 @@ class DashboardHead:
         query = parse_qs(parsed.query)
         limit = int(query.get("limit", ["1000"])[0])
 
-        if path == "/api/version":
+        if not path:
+            from ray_tpu.dashboard.ui import INDEX_HTML
+
+            req._send(200, INDEX_HTML.encode(), "text/html; charset=utf-8")
+        elif path == "/api/version":
             req._send(200, {"version": version, "session_dir": self.cluster.session_dir})
         elif path == "/api/healthz":
             req._send(200, {"status": "ok"})

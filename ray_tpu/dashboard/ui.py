@@ -1,0 +1,107 @@
+"""Dashboard web UI: one self-contained page over the REST API.
+
+Parity role: the reference's React dashboard (``dashboard/client/src``,
+21.9k LoC TS) — cluster/resource overview, node list, job list, serve
+applications, task/actor summaries, recent events. Here it is a single
+dependency-free HTML document (no build step, no npm, works air-gapped)
+that polls the same REST endpoints the CLI uses.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 13px/1.5 system-ui, sans-serif; margin: 0; background: #0f1419; color: #d6dbe1; }
+  header { padding: 14px 22px; background: #161c24; border-bottom: 1px solid #2a323d;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 16px; margin: 0; color: #7fd1b9; }
+  header span { color: #8a94a0; font-size: 12px; }
+  main { padding: 18px 22px; display: grid; gap: 18px;
+         grid-template-columns: repeat(auto-fit, minmax(340px, 1fr)); }
+  section { background: #161c24; border: 1px solid #2a323d; border-radius: 8px; padding: 14px 16px; }
+  h2 { font-size: 13px; margin: 0 0 10px; color: #9fb3c8; text-transform: uppercase;
+       letter-spacing: .06em; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0; font-variant-numeric: tabular-nums; }
+  th { color: #8a94a0; font-weight: 500; border-bottom: 1px solid #2a323d; }
+  .bar { height: 8px; background: #2a323d; border-radius: 4px; overflow: hidden; min-width: 90px; }
+  .bar i { display: block; height: 100%; background: #7fd1b9; }
+  .num { color: #e8c268; }
+  .ok { color: #7fd1b9; } .bad { color: #e07a5f; }
+  pre { margin: 0; white-space: pre-wrap; word-break: break-all; font-size: 11px; color: #8a94a0; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span id="version"></span>
+  <span id="updated"></span>
+</header>
+<main>
+  <section><h2>Resources</h2><table id="resources"></table></section>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Work</h2><table id="work"></table></section>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Serve</h2><table id="serve"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre></section>
+</main>
+<script>
+const $ = id => document.getElementById(id);
+const get = p => fetch(p).then(r => r.json()).catch(() => null);
+const esc = v => String(v).replace(/[&<>"']/g,
+  c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+function rows(el, header, data) {
+  el.innerHTML = "<tr>" + header.map(h => `<th>${h}</th>`).join("") + "</tr>" +
+    data.map(r => "<tr>" + r.map(c => `<td>${c}</td>`).join("") + "</tr>").join("");
+}
+function bar(used, total) {
+  const pct = total ? Math.min(100, 100 * used / total) : 0;
+  return `<div class="bar"><i style="width:${pct}%"></i></div>`;
+}
+async function refresh() {
+  const [ver, status, nodes, jobs, serve, events, tasks, actors, objects] = await Promise.all([
+    get("/api/version"), get("/api/cluster_status"), get("/api/nodes"), get("/api/jobs"),
+    get("/api/serve/applications"), get("/api/events?limit=12"),
+    get("/api/summary/tasks"), get("/api/summary/actors"), get("/api/objects?limit=1"),
+  ]);
+  if (ver) $("version").textContent = "v" + ver.version + " · " + ver.session_dir;
+  $("updated").textContent = "updated " + new Date().toLocaleTimeString();
+  if (status) {
+    const data = Object.keys(status.resources_total || {}).sort().map(k => {
+      const total = status.resources_total[k], avail = (status.resources_available || {})[k] ?? 0;
+      const used = total - avail;
+      return [esc(k), `<span class="num">${used.toFixed(1)} / ${total.toFixed(1)}</span>`, bar(used, total)];
+    });
+    rows($("resources"), ["resource", "used", ""], data);
+  }
+  if (nodes) rows($("nodes"), ["node", "state", "head"],
+    nodes.nodes.map(n => [esc(n.node_id.slice(0, 12)),
+      `<span class="${n.state === 'ALIVE' ? 'ok' : 'bad'}">${esc(n.state)}</span>`, n.is_head ? "★" : ""]));
+  const work = [];
+  if (status) work.push(["pending tasks", `<span class="num">${status.pending_tasks}</span>`]);
+  if (tasks) work.push(["tasks total", `<span class="num">${tasks.total_tasks ?? 0}</span>`]);
+  if (tasks) for (const [name, info] of Object.entries(tasks.summary || {}))
+    work.push(["task " + esc(name), esc(JSON.stringify(info.state_counts))]);
+  if (actors) work.push(["actors total", `<span class="num">${actors.total_actors ?? 0}</span>`]);
+  if (actors) for (const [name, info] of Object.entries(actors.summary || {}))
+    work.push(["actor " + esc(name), esc(JSON.stringify(info.state_counts ?? info))]);
+  rows($("work"), ["metric", "count"], work.slice(0, 14));
+  if (jobs) rows($("jobs"), ["job", "status", "entrypoint"],
+    (jobs.jobs || []).slice(-8).reverse().map(j => [esc(j.submission_id?.slice(0, 14) ?? "-"),
+      `<span class="${j.status === 'SUCCEEDED' ? 'ok' : j.status === 'FAILED' ? 'bad' : ''}">${esc(j.status)}</span>`,
+      esc((j.entrypoint || "").slice(0, 42))]));
+  if (serve) rows($("serve"), ["deployment", "replicas", "target"],
+    Object.entries(serve.deployments || {}).map(([name, d]) =>
+      [esc(name), esc(d.num_replicas), esc(d.target_replicas)]));
+  if (events) $("events").textContent =
+    (events.events || []).map(e => `${e.timestamp ?? ""} [${e.severity ?? e.level ?? ""}] ${e.label ?? ""} ${e.message ?? ""}`).join("\\n") || "(none)";
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -272,3 +272,13 @@ def test_working_dir_change_restages(dash_cluster, tmp_path):
     _env, cwd2 = apply_to_process_env({"working_dir": str(src)}, {})
     assert cwd1 != cwd2
     assert (open(_os.path.join(cwd2, "f.txt")).read()) == "two-changed"
+
+
+def test_dashboard_serves_ui_index(dash_cluster):
+    import urllib.request
+
+    with urllib.request.urlopen(dash_cluster.dashboard.url + "/", timeout=30) as resp:
+        body = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+    assert "text/html" in ctype
+    assert "ray_tpu" in body and "/api/cluster_status" in body
