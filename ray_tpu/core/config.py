@@ -54,6 +54,12 @@ class Config:
     # Seconds before an idle worker process is reaped.
     idle_worker_timeout_s: float = 60.0
 
+    # ---- control-plane persistence (GCS-with-Redis parity) --------------
+    # When set, durable control state (KV, jobs, task events) snapshots to
+    # this file periodically and reloads on the next init.
+    control_snapshot_path: str = ""
+    control_snapshot_interval_s: float = 10.0
+
     # ---- tasks / fault tolerance ----------------------------------------
     # Adaptive tiering: "auto" tasks whose observed mean wall time exceeds
     # this run in process workers (GIL-free parallelism); faster ones stay

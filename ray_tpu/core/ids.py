@@ -103,6 +103,13 @@ class JobID(BaseID):
             cls._counter += 1
             return cls.from_int(cls._counter)
 
+    @classmethod
+    def ensure_above(cls, value: int) -> None:
+        """Advance the counter past ids restored from a previous process,
+        so new jobs can't collide with persisted history."""
+        with cls._lock:
+            cls._counter = max(cls._counter, value)
+
     def int_value(self) -> int:
         return int.from_bytes(self._bytes, "little")
 

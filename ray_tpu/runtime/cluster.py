@@ -121,6 +121,19 @@ class Cluster:
         self.session_dir = session_dir or f"/tmp/ray_tpu_session_{os.getpid()}"
         os.makedirs(self.session_dir, exist_ok=True)
         self.control = ControlService()
+        self._snapshot_stop = threading.Event()
+        self._snapshot_thread = None
+        if cfg.control_snapshot_path:
+            # GCS-restart parity: durable cluster state reloads from the
+            # last snapshot; a background writer keeps it fresh
+            self.control.restore_snapshot(cfg.control_snapshot_path)
+            self._snapshot_thread = threading.Thread(
+                target=self._snapshot_loop,
+                args=(cfg.control_snapshot_path, cfg.control_snapshot_interval_s),
+                name="control-snapshot",
+                daemon=True,
+            )
+            self._snapshot_thread.start()
         self.cluster_scheduler = ClusterScheduler()
         self.directory = ObjectDirectory()
         self.task_manager = TaskManager()
@@ -622,7 +635,30 @@ class Cluster:
             self._after_commit(spec)
 
     # ------------------------------------------------------------------
+    def _snapshot_loop(self, path: str, interval_s: float) -> None:
+        while not self._snapshot_stop.wait(interval_s):
+            try:
+                self.control.save_snapshot(path)
+            except Exception:  # noqa: BLE001 — persistence must not kill the fabric
+                pass
+
     def shutdown(self) -> None:
+        self._snapshot_stop.set()
+        if self._snapshot_thread is not None:
+            self._snapshot_thread.join(timeout=10)
+        cfg = get_config()
+        if cfg.control_snapshot_path:
+            # a cleanly-shut-down driver job is SUCCEEDED, not a phantom
+            # RUNNING that the next restore would rewrite to FAILED
+            if self.core_worker is not None:
+                try:
+                    self.control.jobs.finish(self.core_worker.job_id, "SUCCEEDED")
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                self.control.save_snapshot(cfg.control_snapshot_path)
+            except Exception:  # noqa: BLE001
+                pass
         try:
             from ray_tpu.usage.usage_lib import usage_stats_enabled, write_usage_report
 

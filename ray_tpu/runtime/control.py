@@ -35,6 +35,15 @@ class InternalKV:
         self._lock = threading.RLock()
         self._data: Dict[str, Dict[bytes, bytes]] = {}
 
+    def snapshot(self) -> Dict[str, Dict[bytes, bytes]]:
+        with self._lock:
+            return {ns: dict(entries) for ns, entries in self._data.items()}
+
+    def restore(self, data: Dict[str, Dict[bytes, bytes]]) -> None:
+        with self._lock:
+            for ns, entries in data.items():
+                self._data.setdefault(ns, {}).update(entries)
+
     def put(self, key: bytes, value: bytes, namespace: str = "default", overwrite: bool = True) -> bool:
         with self._lock:
             ns = self._data.setdefault(namespace, {})
@@ -348,6 +357,80 @@ class ControlService:
         self.placement_groups = PlacementGroupManager(self.nodes, self.pubsub)
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    # ---------------------------------------------------------- persistence
+    # Parity: GCS fault tolerance (RedisStoreClient-backed GcsTableStorage,
+    # gcs_table_storage.h:238): the durable cluster-level state — internal
+    # KV, job history, task events — snapshots to disk and reloads on the
+    # next runtime start. Node/actor liveness is process state and is
+    # rebuilt live, exactly as raylets re-register with a restarted GCS.
+    def snapshot_state(self) -> dict:
+        kv_data = self.kv.snapshot()
+        jobs = [
+            {
+                "job_id": info.job_id.binary(),
+                "entrypoint": info.entrypoint,
+                "metadata": info.metadata,
+                "start_time": info.start_time,
+                "end_time": info.end_time,
+                "status": info.status,
+            }
+            for info in self.jobs.list_jobs()
+        ]
+        return {
+            "version": 1,
+            "kv": kv_data,
+            "jobs": jobs,
+            "task_events": self.task_events.list_events(limit=len(self.task_events)),
+        }
+
+    _snapshot_write_lock = threading.Lock()
+
+    def save_snapshot(self, path: str) -> None:
+        import os
+        import pickle
+
+        # serialized: the periodic writer and the shutdown save share the
+        # tmp path; concurrent writes would publish a torn snapshot
+        with self._snapshot_write_lock:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(self.snapshot_state(), f)
+            os.replace(tmp, path)   # atomic: readers never see a torn file
+
+    def restore_snapshot(self, path: str) -> bool:
+        import logging
+        import os
+        import pickle
+
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:  # noqa: BLE001 — same rule as save: persistence
+            # must not brick init(); a torn snapshot starts empty
+            logging.getLogger(__name__).exception(
+                "control snapshot %s unreadable; starting with empty state", path
+            )
+            return False
+        self.kv.restore(state.get("kv", {}))
+        max_job = 0
+        for row in state.get("jobs", []):
+            job_id = JobID(row["job_id"])
+            max_job = max(max_job, job_id.int_value())
+            info = JobInfo(job_id, row["entrypoint"], row["metadata"])
+            info.start_time = row["start_time"]
+            info.end_time = row["end_time"]
+            # RUNNING jobs from a dead runtime did not survive it
+            info.status = "FAILED" if row["status"] == "RUNNING" else row["status"]
+            self.jobs.add(info)
+        # a fresh process restarts the JobID counter at 0 — new driver jobs
+        # must not overwrite restored history
+        JobID.ensure_above(max_job)
+        for event in state.get("task_events", []):
+            self.task_events.add(event)
+        return True
 
     # health-check loop (GcsHealthCheckManager parity)
     def start_health_checks(self, on_node_dead: Callable[[NodeID], None]) -> None:
