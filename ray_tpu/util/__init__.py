@@ -7,6 +7,12 @@ collective ops, scheduling strategies, serializability checking.
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util.placement import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
 from ray_tpu.runtime.scheduler import (
     NodeAffinitySchedulingStrategy,
     NodeLabelSchedulingStrategy,
@@ -15,6 +21,10 @@ from ray_tpu.runtime.scheduler import (
 
 __all__ = [
     "ActorPool",
+    "PlacementGroup",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
     "Empty",
     "Full",
     "NodeAffinitySchedulingStrategy",

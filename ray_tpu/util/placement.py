@@ -1,0 +1,129 @@
+"""User-facing placement-group API.
+
+Parity: ``ray.util.placement_group`` / ``remove_placement_group`` /
+``placement_group_table`` (``python/ray/util/placement_group.py``) — the
+convenience layer over the control service's PG manager, returning a
+handle usable with ``PlacementGroupSchedulingStrategy``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.runtime.placement import PlacementGroupInfo, PlacementStrategy
+
+
+class PlacementGroup:
+    """Handle over a created group (parity: util PlacementGroup)."""
+
+    def __init__(self, info: PlacementGroupInfo):
+        self._info = info
+
+    @property
+    def id(self) -> PlacementGroupID:
+        return self._info.pg_id
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return [b.to_dict() for b in self._info.bundles]
+
+    def ready(self):
+        """ObjectRef resolving True once the group is scheduled — a PENDING
+        group (awaiting capacity) blocks until the manager's retry places
+        it (parity: PlacementGroup.ready())."""
+        import ray_tpu
+
+        info = self._info
+
+        def _ready() -> bool:
+            import time
+
+            from ray_tpu.runtime.placement import PlacementGroupState
+
+            while info.state is not PlacementGroupState.CREATED:
+                if info.state is PlacementGroupState.REMOVED:
+                    raise RuntimeError("placement group was removed before it was placed")
+                time.sleep(0.05)
+            return True
+
+        return ray_tpu.remote(_ready).options(execution="thread").remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        """Block up to timeout_seconds for the group to be scheduled."""
+        import time
+
+        from ray_tpu.runtime.placement import PlacementGroupState
+
+        deadline = time.monotonic() + timeout_seconds
+        while time.monotonic() < deadline:
+            if self._info.state is PlacementGroupState.CREATED:
+                return True
+            if self._info.state is PlacementGroupState.REMOVED:
+                return False
+            time.sleep(0.02)
+        return self._info.state is PlacementGroupState.CREATED
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    """Create (and synchronously schedule) a placement group."""
+    import ray_tpu
+    from ray_tpu.runtime.worker import global_worker
+
+    if not bundles:
+        raise ValueError("placement group bundles cannot be empty")
+    try:
+        PlacementStrategy[strategy]
+    except KeyError:
+        valid = [s.name for s in PlacementStrategy]
+        raise ValueError(f"invalid placement strategy {strategy!r}; valid: {valid}")
+    if lifetime not in (None, "detached"):
+        raise ValueError(f"lifetime must be None or 'detached', got {lifetime!r}")
+    # lifetime="detached" is accepted for API parity; in-process groups are
+    # process-scoped either way (no cross-driver registry to detach into)
+
+    worker = global_worker()
+    info = PlacementGroupInfo(
+        PlacementGroupID.of(worker.job_id),
+        [ResourceSet(b) for b in bundles],
+        PlacementStrategy[strategy],
+        name=name,
+    )
+    cluster = ray_tpu.get_cluster()
+    # create() registers the group either way; an infeasible one stays
+    # PENDING and is retried when capacity joins (autoscaler parity)
+    cluster.control.placement_groups.create(info)
+    return PlacementGroup(info)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    import ray_tpu
+
+    ray_tpu.get_cluster().control.placement_groups.remove(pg.id)
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> Dict:
+    import ray_tpu
+
+    mgr = ray_tpu.get_cluster().control.placement_groups
+    rows = {}
+    for info in mgr.list_groups():
+        with mgr._lock:   # remove() clears bundle_placements under this lock
+            placements = dict(info.bundle_placements)
+            state = info.state.name
+        rows[info.pg_id.hex()] = {
+            "name": info.name,
+            "strategy": info.strategy.name,
+            "state": state,
+            "bundles": [b.to_dict() for b in info.bundles],
+            "bundle_placements": {i: nid.hex() for i, nid in placements.items()},
+        }
+    if pg is not None:
+        return rows.get(pg.id.hex(), {})
+    return rows

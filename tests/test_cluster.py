@@ -265,3 +265,38 @@ def test_control_state_snapshot_restore(tmp_path):
         assert len(cluster.control.task_events) > 0
     finally:
         ray_tpu.shutdown()
+
+
+def test_util_placement_group_api(ray_start_cluster):
+    """ray.util.placement_group parity: create, table, strategy use, remove."""
+    rt, cluster = ray_start_cluster
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        placement_group_table,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="mygang")
+    assert pg.wait(5)
+    assert rt.get(pg.ready()) is True
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED" and table["name"] == "mygang"
+
+    @rt.remote(num_cpus=1)
+    def inside():
+        return "in-pg"
+
+    strat = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    assert rt.get(inside.options(scheduling_strategy=strat).remote(), timeout=30) == "in-pg"
+    remove_placement_group(pg)
+    assert placement_group_table(pg)["state"] == "REMOVED"
+
+    # validation errors
+    import pytest as _p
+    with _p.raises(ValueError, match="empty"):
+        placement_group([])
+    with _p.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="NOT_A_STRATEGY")
+    with _p.raises(ValueError, match="lifetime"):
+        placement_group([{"CPU": 1}], lifetime="bogus")
