@@ -189,6 +189,23 @@ class DashboardHead:
             sub_id = path[len("/api/jobs/"): -len("/stop")]
             ok = self.job_manager.stop_job(sub_id)
             req._send(200 if ok else 404, {"stopped": ok})
+        elif path.startswith("/api/workflows/events"):
+            # HTTP workflow trigger (parity: HTTPEventProvider — an external
+            # system resumes a waiting workflow by POSTing the event payload)
+            from urllib.parse import unquote
+
+            from ray_tpu.workflow.events import deliver_event, has_waiters
+
+            name = unquote(path[len("/api/workflows/events"):].lstrip("/"))
+            if not name:
+                req._send(400, {"error": "event name required"})
+            elif not has_waiters(name):
+                # dropping unmatched events keeps the head unbounded-growth
+                # safe and tells the caller the trigger reached nobody
+                req._send(404, {"error": f"no workflow is waiting on {name!r}"})
+            else:
+                deliver_event(name, body)
+                req._send(200, {"delivered": name})
         elif path == "/api/serve/applications":
             # declarative deploy (parity: serve REST API PUT /applications)
             try:

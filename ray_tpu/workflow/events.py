@@ -36,6 +36,7 @@ class TimerListener(EventListener):
 
 
 _event_queues: Dict[str, "queue.Queue[Any]"] = {}
+_event_waiters: Dict[str, int] = {}
 _event_lock = threading.Lock()
 
 
@@ -47,9 +48,18 @@ def _queue_for(name: str) -> "queue.Queue[Any]":
         return q
 
 
+def has_waiters(name: str) -> bool:
+    """True when at least one workflow is blocked on the channel (lets the
+    HTTP trigger reject events nobody will consume instead of queueing
+    them forever)."""
+    with _event_lock:
+        return _event_waiters.get(name, 0) > 0
+
+
 def deliver_event(name: str, payload: Any) -> None:
-    """Push an event to every workflow blocked on ``name`` (HTTP-trigger
-    style: an external system calls this — e.g. via the dashboard REST)."""
+    """Push an event to ONE workflow blocked on ``name`` (HTTP-trigger
+    style: an external system calls this — e.g. via the dashboard REST).
+    Each delivered payload resumes a single waiter."""
     _queue_for(name).put(payload)
 
 
@@ -58,7 +68,17 @@ class QueueEventListener(EventListener):
     :func:`deliver_event`."""
 
     def poll_for_event(self, name: str, timeout: Optional[float] = None) -> Any:
-        return _queue_for(name).get(timeout=timeout)
+        with _event_lock:
+            _event_waiters[name] = _event_waiters.get(name, 0) + 1
+        try:
+            return _queue_for(name).get(timeout=timeout)
+        finally:
+            with _event_lock:
+                _event_waiters[name] = max(0, _event_waiters.get(name, 1) - 1)
+                if _event_waiters[name] == 0 and _event_queues.get(name) is not None:
+                    if _event_queues[name].empty():
+                        del _event_queues[name]
+                    _event_waiters.pop(name, None)
 
 
 def wait_for_event(listener_or_cls, *args, **kwargs):

@@ -282,3 +282,53 @@ def test_dashboard_serves_ui_index(dash_cluster):
         ctype = resp.headers.get("Content-Type", "")
     assert "text/html" in ctype
     assert "ray_tpu" in body and "/api/cluster_status" in body
+
+
+def test_workflow_http_event_trigger(dash_cluster, tmp_path):
+    """POST /api/workflows/events/<name> resumes a workflow blocked on
+    wait_for_event (HTTPEventProvider parity)."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def unwrap(evt):
+        return evt["decision"]
+
+    dag = unwrap.bind(workflow.wait_for_event(workflow.QueueEventListener, "release", 30.0))
+    result = {}
+
+    def run():
+        result["value"] = workflow.run(dag, workflow_id="wf_http")
+
+    t = threading.Thread(target=run)
+    t.start()
+    # the trigger 404s until the workflow is actually blocked on the event
+    # (unmatched events are rejected, not queued) — retry until it lands
+    import time as _time
+
+    deadline = _time.monotonic() + 30
+    delivered = False
+    while _time.monotonic() < deadline and not delivered:
+        req = urllib.request.Request(
+            dash_cluster.dashboard.url + "/api/workflows/events/release",
+            data=json.dumps({"decision": "approved"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["delivered"] == "release"
+                delivered = True
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            _time.sleep(0.05)
+    assert delivered
+    t.join(timeout=60)
+    assert result.get("value") == "approved"
