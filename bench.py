@@ -23,17 +23,21 @@ def main() -> None:
     def noop():
         return None
 
-    for _ in range(100):
+    for _ in range(200):
         rt.get(noop.remote())
 
+    # median of 3 rounds: robust to the box's shared-infrastructure noise
+    # without the upward bias of max() against the reference's mean baseline
     n = 3000
-    t0 = time.perf_counter()
-    for _ in range(n):
-        rt.get(noop.remote())
-    dt = time.perf_counter() - t0
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rt.get(noop.remote())
+        rates.append(n / (time.perf_counter() - t0))
     rt.shutdown()
 
-    value = n / dt
+    value = sorted(rates)[1]
     print(
         json.dumps(
             {
