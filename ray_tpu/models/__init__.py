@@ -7,6 +7,15 @@ exercises every parallelism axis the framework offers (dp/tp/sp/ep via GSPMD
 shardings, pp via ``ray_tpu.parallel.pipeline``).
 """
 
+from ray_tpu.models.vit import (
+    ViTConfig,
+    init_vit_params,
+    make_vit_train_step,
+    patchify,
+    vit_forward,
+    vit_loss_fn,
+    vit_param_specs,
+)
 from ray_tpu.models.transformer import (
     TransformerConfig,
     init_params,
@@ -19,6 +28,13 @@ from ray_tpu.models.transformer import (
 from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_apply
 
 __all__ = [
+    "ViTConfig",
+    "init_vit_params",
+    "make_vit_train_step",
+    "patchify",
+    "vit_forward",
+    "vit_loss_fn",
+    "vit_param_specs",
     "TransformerConfig",
     "init_params",
     "forward",
