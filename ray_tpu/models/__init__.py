@@ -26,6 +26,13 @@ from ray_tpu.models.transformer import (
     shard_params,
 )
 from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_apply
+from ray_tpu.models.generation import (
+    decode_step,
+    generate,
+    init_cache,
+    prefill,
+    sample_logits,
+)
 
 __all__ = [
     "ViTConfig",
@@ -45,4 +52,9 @@ __all__ = [
     "MLPConfig",
     "mlp_init",
     "mlp_apply",
+    "decode_step",
+    "generate",
+    "init_cache",
+    "prefill",
+    "sample_logits",
 ]

@@ -1,0 +1,16 @@
+"""Shared model-layer helpers."""
+
+from __future__ import annotations
+
+
+class JittedStep:
+    """Callable train step carrying its batch-placement helper (jit wrappers
+    don't accept attribute assignment). Shared by the decoder and ViT train
+    steps so sharding/donation fixes land in one place."""
+
+    def __init__(self, fn, shard_batch):
+        self._fn = fn
+        self.shard_batch = shard_batch
+
+    def __call__(self, *args):
+        return self._fn(*args)

@@ -1,0 +1,228 @@
+"""KV-cache autoregressive decoding for the flagship transformer.
+
+The reference has no inference engine in core (Serve wraps user callables;
+its LLM examples delegate to vLLM). Here decoding is first-class and
+TPU-first:
+
+- **Static shapes everywhere**: the cache is a preallocated ring of
+  ``[n_layers, B, kv_heads, max_len, head_dim]`` buffers; prefill and every
+  decode step are fixed-shape XLA programs, so the whole generate loop jits
+  to one compiled executable (``lax.scan`` over steps — no per-token Python).
+- **Ragged batches without ragged shapes**: per-sequence write offsets go
+  through a vmapped ``dynamic_update_slice`` (lowers to an in-place scatter)
+  and visibility is a ``key_pos <= query_pos`` mask — the padded tail of a
+  short prompt is simply never visible and is overwritten as decoding
+  proceeds.
+- GQA (``n_kv_heads < n_heads``) shrinks the cache by the group factor —
+  decode is HBM-bandwidth-bound, so cache bytes are the speed of light here.
+
+Used by ``ray_tpu.serve.llm`` (continuous batching) and directly via
+``generate()``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _dense_ffn,
+    _moe_ffn,
+    _rms_norm,
+    _rope,
+)
+
+KVCache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    """Preallocated KV cache: {"k","v"}: [L, B, Hkv, max_len, Dh]."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _write_kv(cache_layer: jax.Array, new: jax.Array, starts: jax.Array) -> jax.Array:
+    """cache_layer [B,Hkv,S,Dh] <- new [B,T,Hkv,Dh] at per-row offset starts[B]."""
+    upd = jnp.transpose(new, (0, 2, 1, 3))  # [B, Hkv, T, Dh]
+    return jax.vmap(
+        lambda c, u, s: jax.lax.dynamic_update_slice(c, u.astype(c.dtype), (0, s, 0))
+    )(cache_layer, upd, starts)
+
+
+def forward_with_cache(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    cache: KVCache,
+    tokens: jax.Array,     # [B, T] int32 (T = prompt len for prefill, 1 for decode)
+    positions: jax.Array,  # [B, T] int32 absolute positions (contiguous per row)
+) -> Tuple[jax.Array, KVCache]:
+    """One cached forward pass. Writes this call's K/V into the cache at
+    ``positions`` and attends over everything up to them. Returns
+    (logits [B, T, V] f32, updated cache)."""
+    B, T = tokens.shape
+    S = cache["k"].shape[3]
+    h_heads, hkv = cfg.n_heads, cfg.kv_heads
+    n_rep = h_heads // hkv
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    starts = positions[:, 0]
+    kv_pos = jnp.arange(S)
+    # key s visible to query t iff s <= position(t): causal over the cache
+    vis = kv_pos[None, None, None, :] <= positions[:, None, :, None]  # [B,1,T,S]
+
+    def layer_fn(x, layer_kc_vc):
+        layer, kc, vc = layer_kc_vc
+        h = _rms_norm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+        q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+        kc = _write_kv(kc, k, starts)
+        vc = _write_kv(vc, v, starts)
+        # grouped-query attention against the whole cache
+        qg = q.reshape(B, T, hkv, n_rep, cfg.head_dim)
+        s_ = jnp.einsum(
+            "btgrk,bgsk->bgrts", qg.astype(jnp.float32), kc.astype(jnp.float32)
+        ) * scale  # [B, Hkv, n_rep, T, S]
+        s_ = jnp.where(vis[:, :, None], s_, -1e30)
+        p = jax.nn.softmax(s_, axis=-1)
+        o = jnp.einsum("bgrts,bgsk->btgrk", p, vc.astype(jnp.float32))
+        o = o.reshape(B, T, h_heads, cfg.head_dim).astype(x.dtype)
+        x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
+        h = _rms_norm(x, layer["ffn_norm"])
+        ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)
+        return x + ffn, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
+    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+
+def prefill(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    cache: KVCache,
+    tokens: jax.Array,          # [B, Tp] right-padded prompts
+    lengths: jax.Array,         # [B] true prompt lengths (>= 1)
+) -> Tuple[jax.Array, KVCache]:
+    """Fill the cache from position 0 and return the last real token's
+    logits per row: (logits [B, V], cache)."""
+    B, Tp = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(Tp)[None, :], (B, Tp))
+    logits, cache = forward_with_cache(cfg, params, cache, tokens, positions)
+    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
+def decode_step(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    cache: KVCache,
+    tokens: jax.Array,     # [B] the previously sampled token per row
+    positions: jax.Array,  # [B] the absolute position to write it at
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step: (logits [B, V], cache)."""
+    logits, cache = forward_with_cache(cfg, params, cache, tokens[:, None], positions[:, None])
+    return logits[:, 0], cache
+
+
+def sample_logits(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Greedy (temperature == 0) or temperature/top-k/top-p sampling. The
+    knobs are Python statics, so each configuration is its own jit cache
+    entry — the decode loop stays branch-free."""
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (always >= 1 token)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    prompt: jax.Array,                       # [B, Tp] right-padded
+    prompt_lengths: Optional[jax.Array] = None,  # [B]; defaults to full rows
+    *,
+    max_new_tokens: int,
+    key: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched autoregressive generation; jit-compatible end to end.
+
+    Returns (tokens [B, Tp + max_new_tokens] with each row = prompt followed
+    by its generated continuation, lengths [B] = prompt + generated counts).
+    Rows that hit ``eos_id`` stop counting (the eos itself is included) and
+    pad with ``eos_id`` thereafter.
+    """
+    B, Tp = prompt.shape
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((B,), Tp, jnp.int32)
+    if key is None:
+        key = jax.random.key(0)
+    total = Tp + max_new_tokens
+    cache = init_cache(cfg, B, total)
+    last_logits, cache = prefill(cfg, params, cache, prompt, prompt_lengths)
+    pad_tok = eos_id if eos_id is not None else 0
+    keys = jax.random.split(key, max_new_tokens)
+
+    def _sample(logits, k, done):
+        tok = sample_logits(logits, k, temperature=temperature, top_k=top_k, top_p=top_p)
+        tok = jnp.where(done, pad_tok, tok)
+        new_done = done | (tok == eos_id) if eos_id is not None else done
+        return tok, new_done
+
+    # first token comes straight from the prefill logits; each scan step then
+    # decodes exactly one forward per sampled token (no trailing wasted step)
+    tok0, done0 = _sample(last_logits, keys[0], jnp.zeros((B,), bool))
+
+    def body(carry, step_key):
+        cache, tok, pos, done = carry
+        logits, cache = decode_step(cfg, params, cache, tok, pos)
+        nxt, new_done = _sample(logits, step_key, done)
+        return (cache, nxt, pos + 1, new_done), (nxt, done)
+
+    init = (cache, tok0, prompt_lengths, done0)
+    if max_new_tokens > 1:
+        (_, _, _, _), (rest, rest_was_done) = jax.lax.scan(body, init, keys[1:])
+        toks = jnp.concatenate([tok0[None], rest], axis=0).T          # [B, max_new]
+        was_done = jnp.concatenate(
+            [jnp.zeros((1, B), bool), rest_was_done], axis=0
+        ).T
+    else:
+        toks = tok0[:, None]
+        was_done = jnp.zeros((B, 1), bool)
+    gen_counts = jnp.sum(~was_done, axis=1).astype(jnp.int32)
+
+    out = jnp.zeros((B, total), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
+    # place each row's continuation right after its true prompt
+    out = jax.vmap(lambda o, t, s: jax.lax.dynamic_update_slice(o, t, (s,)))(
+        out, toks, prompt_lengths
+    )
+    return out, prompt_lengths + gen_counts

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.ops.attention import flash_attention, mha
+from ray_tpu.ops.attention import NEG_INF, flash_attention, mha
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +39,7 @@ class TransformerConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None => MHA; < n_heads => GQA (Llama-2/3 style)
     d_ff: int = 2048
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
@@ -49,9 +50,22 @@ class TransformerConfig:
     attention: str = "auto"       # auto | flash | dense | ring (sp-sharded)
     remat: bool = False           # jax.checkpoint each layer
 
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} not divisible by n_heads {self.n_heads}")
+        kv = self.n_kv_heads
+        if kv is not None and (kv < 1 or kv > self.n_heads or self.n_heads % kv):
+            raise ValueError(
+                f"n_kv_heads {kv} must be a positive divisor of n_heads {self.n_heads}"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 # ---------------------------------------------------------------------------
@@ -60,7 +74,7 @@ class TransformerConfig:
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     k_embed, k_layers, k_out = jax.random.split(key, 3)
     pd = cfg.param_dtype
-    d, h, dh, ff = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    d, h, hkv, dh, ff = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_ff
 
     def dense_init(k, shape, fan_in):
         return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
@@ -72,8 +86,8 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         layer = {
             "attn_norm": jnp.ones((d,), pd),
             "wq": dense_init(ks[0], (d, h, dh), d),
-            "wk": dense_init(ks[1], (d, h, dh), d),
-            "wv": dense_init(ks[2], (d, h, dh), d),
+            "wk": dense_init(ks[1], (d, hkv, dh), d),
+            "wv": dense_init(ks[2], (d, hkv, dh), d),
             "wo": dense_init(ks[3], (h, dh, d), d),
             "ffn_norm": jnp.ones((d,), pd),
         }
@@ -101,15 +115,27 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 # sharding rules
 # ---------------------------------------------------------------------------
-def param_specs(cfg: TransformerConfig, *, dp: str = "dp", tp: str = "tp", ep: Optional[str] = None) -> Dict[str, Any]:
+def param_specs(
+    cfg: TransformerConfig,
+    *,
+    dp: str = "dp",
+    tp: str = "tp",
+    ep: Optional[str] = None,
+    kv_tp: bool = True,
+) -> Dict[str, Any]:
     """Megatron-style TP layout as PartitionSpecs (leading axis of stacked
-    layer leaves is the layer dim, unsharded)."""
+    layer leaves is the layer dim, unsharded).
+
+    ``kv_tp=False`` replicates wk/wv across tp — required under GQA when
+    ``kv_heads`` isn't divisible by the tp axis size (callers with a mesh,
+    e.g. :func:`make_train_step`, decide automatically)."""
     ep = ep or dp
+    kv = tp if kv_tp else None
     layer_specs = {
         "attn_norm": P(None, None),
         "wq": P(None, None, tp, None),
-        "wk": P(None, None, tp, None),
-        "wv": P(None, None, tp, None),
+        "wk": P(None, None, kv, None),
+        "wv": P(None, None, kv, None),
         "wo": P(None, tp, None, None),
         "ffn_norm": P(None, None),
     }
@@ -125,7 +151,15 @@ def param_specs(cfg: TransformerConfig, *, dp: str = "dp", tp: str = "tp", ep: O
     return {"embed": P(tp, None), "layers": layer_specs, "final_norm": P(None)}
 
 
+def _kv_tp_ok(cfg: TransformerConfig, mesh: Mesh, tp: str) -> bool:
+    """Whether the kv-head axis can shard over tp (GQA may make it too small)."""
+    n = mesh.shape.get(tp, 1)
+    return cfg.kv_heads % n == 0
+
+
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig, **axes):
+    if "kv_tp" not in axes:
+        axes["kv_tp"] = _kv_tp_ok(cfg, mesh, axes.get("tp", "tp"))
     specs = param_specs(cfg, **axes)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
@@ -155,9 +189,46 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def _repeat_kv(x, n_rep: int):
+    """[B, T, Hkv, Dh] -> [B, T, Hkv*n_rep, Dh] (GQA group broadcast)."""
+    if n_rep == 1:
+        return x
+    B, T, Hkv, Dh = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (B, T, Hkv, n_rep, Dh)).reshape(B, T, Hkv * n_rep, Dh)
+
+
+def _gqa_mha(qt, k, v, *, causal: bool, sm_scale: float):
+    """Grouped-query attention, K/V kept at kv-head width (no materialized
+    repeat — decode/train HBM traffic stays 1/n_rep of the MHA layout).
+
+    qt: [B, H, T, Dh]; k, v: [B, T, Hkv, Dh]."""
+    B, H, T, Dh = qt.shape
+    Hkv = k.shape[2]
+    n_rep = H // Hkv
+    qg = qt.reshape(B, Hkv, n_rep, T, Dh)
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, Hkv, S, Dh]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    s = jnp.einsum("bgrtd,bgsd->bgrts", qg, kt, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.arange(S)[None, :] <= jnp.arange(T)[:, None]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrts,bgsd->bgrtd", p, vt.astype(jnp.float32))
+    return o.reshape(B, H, T, Dh).astype(qt.dtype)
+
+
 def _attention(cfg: TransformerConfig, q, k, v, use_flash: bool, mesh=None, sp_axis=None):
-    # q,k,v: [B, T, H, Dh] -> [B, H, T, Dh]
-    qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+    # q: [B, T, H, Dh]; k, v: [B, T, Hkv, Dh] (unrepeated under GQA)
+    n_rep = cfg.n_heads // cfg.kv_heads
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    if not use_flash and cfg.attention != "ring":
+        # grouped einsum path: K/V never widen to n_heads
+        o = _gqa_mha(qt, k, v, causal=True, sm_scale=1.0 / math.sqrt(cfg.head_dim))
+        return jnp.transpose(o, (0, 2, 1, 3))
+    # the Pallas flash / ring kernels take [B, H, T, Dh] with full heads
+    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+    kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (k, v))
     if cfg.attention == "ring" and mesh is not None and sp_axis is not None:
         # sequence-parallel ring attention: K/V shards rotate over the sp
         # ICI axis; each step runs the Pallas flash kernel locally
@@ -306,7 +377,7 @@ def make_train_step(
     if mesh is None:
         return init_state, jax.jit(train_step, donate_argnums=(0,))
 
-    pspecs = param_specs(cfg, dp=dp, tp=tp, ep=ep)
+    pspecs = param_specs(cfg, dp=dp, tp=tp, ep=ep, kv_tp=_kv_tp_ok(cfg, mesh, tp))
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs, is_leaf=lambda x: isinstance(x, P))
 
     def sharded_init(key):
@@ -319,15 +390,6 @@ def make_train_step(
     def shard_batch(tokens):
         return jax.device_put(tokens, NamedSharding(mesh, P(dp, None)))
 
-    class _TrainStep:
-        """Callable train step carrying its batch-placement helper (jit
-        wrappers don't accept attribute assignment)."""
+    from ray_tpu.models.common import JittedStep
 
-        def __init__(self, fn):
-            self._fn = fn
-            self.shard_batch = shard_batch
-
-        def __call__(self, state, tokens):
-            return self._fn(state, tokens)
-
-    return sharded_init, _TrainStep(jax.jit(train_step, donate_argnums=(0,)))
+    return sharded_init, JittedStep(jax.jit(train_step, donate_argnums=(0,)), shard_batch)

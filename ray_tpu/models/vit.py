@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.models.transformer import _rms_norm
+from ray_tpu.models.common import JittedStep
+from ray_tpu.models.transformer import _dense_ffn, _rms_norm
 from ray_tpu.ops.attention import flash_attention, mha
 
 
@@ -43,6 +44,14 @@ class ViTConfig:
     attention: str = "auto"       # auto | flash | dense
     remat: bool = False
 
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by patch_size {self.patch_size}"
+            )
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} not divisible by n_heads {self.n_heads}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
@@ -59,10 +68,10 @@ class ViTConfig:
 def init_vit_params(cfg: ViTConfig, key: jax.Array) -> Dict[str, Any]:
     pd = cfg.param_dtype
     d, h, dh, ff = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
-    ks = jax.random.split(key, 6)
+    ks = jax.random.split(key, 4)
 
-    def dense(k, shape, fan_in, scale=1.0):
-        return (jax.random.normal(k, shape, pd) * scale / math.sqrt(fan_in)).astype(pd)
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
 
     def one_layer(k):
         lk = jax.random.split(k, 7)
@@ -151,8 +160,7 @@ def vit_forward(
         o = jnp.transpose(o, (0, 2, 1, 3))
         x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
         h = _rms_norm(x, layer["ffn_norm"])
-        ffn = jax.nn.silu(h @ layer["w3"].astype(h.dtype)) * (h @ layer["w1"].astype(h.dtype))
-        x = x + ffn @ layer["w2"].astype(h.dtype)
+        x = x + _dense_ffn(layer, h)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
         return x, None
@@ -184,8 +192,10 @@ def make_vit_train_step(
     opt = optax.adamw(learning_rate)
 
     act_spec = None
+    dp_ax = None
     if mesh is not None:
-        act_spec = P(dp if dp in mesh.axis_names else None, None, None)
+        dp_ax = dp if dp in mesh.axis_names else None
+        act_spec = P(dp_ax, None, None)
 
     def train_step(state, images, labels):
         loss, grads = jax.value_and_grad(
@@ -213,19 +223,13 @@ def make_vit_train_step(
         )
         return {"params": params, "opt": opt.init(params), "step": jnp.zeros((), jnp.int32)}
 
-    batch_sharding = NamedSharding(mesh, P(dp, None, None, None))
-    label_sharding = NamedSharding(mesh, P(dp))
-    jitted = jax.jit(train_step, donate_argnums=(0,))
+    batch_sharding = NamedSharding(mesh, P(dp_ax, None, None, None))
+    label_sharding = NamedSharding(mesh, P(dp_ax))
 
-    class _Step:
-        def __call__(self, state, images, labels):
-            return jitted(state, images, labels)
+    def shard_batch(images, labels):
+        return (
+            jax.device_put(images, batch_sharding),
+            jax.device_put(labels, label_sharding),
+        )
 
-        @staticmethod
-        def shard_batch(images, labels):
-            return (
-                jax.device_put(images, batch_sharding),
-                jax.device_put(labels, label_sharding),
-            )
-
-    return sharded_init, _Step()
+    return sharded_init, JittedStep(jax.jit(train_step, donate_argnums=(0,)), shard_batch)

@@ -1,0 +1,152 @@
+"""KV-cache decoding tests: cached forward == full forward, ragged batches,
+GQA, sampling knobs, eos early-stop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (
+    TransformerConfig,
+    decode_step,
+    forward,
+    generate,
+    init_cache,
+    init_params,
+    prefill,
+    sample_logits,
+)
+
+CFG = TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    attention="dense", dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0))
+
+
+def test_prefill_matches_forward(params):
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 97, (3, 9)), jnp.int32)
+    full = forward(CFG, params, tokens)  # [B, T, V]
+    cache = init_cache(CFG, 3, 16)
+    last, _ = prefill(CFG, params, cache, tokens, jnp.full((3,), 9, jnp.int32))
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1]), rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_forward_stepwise(params):
+    """Greedy decode via the cache equals rerunning the full forward."""
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)), jnp.int32)
+    n_new = 4
+    cache = init_cache(CFG, 2, 5 + n_new)
+    logits, cache = prefill(CFG, params, cache, prompt, jnp.full((2,), 5, jnp.int32))
+    seq = prompt
+    pos = jnp.full((2,), 5, jnp.int32)
+    for _ in range(n_new):
+        ref_logits = forward(CFG, params, seq)[:, -1]
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+        logits, cache = decode_step(CFG, params, cache, tok, pos)
+        pos = pos + 1
+
+
+def test_generate_greedy_ragged(params):
+    """Each ragged row generates exactly what its solo run generates."""
+    rng = np.random.default_rng(2)
+    r0 = jnp.asarray(rng.integers(0, 97, (1, 3)), jnp.int32)
+    r1 = jnp.asarray(rng.integers(0, 97, (1, 7)), jnp.int32)
+    batch = jnp.zeros((2, 7), jnp.int32)
+    batch = batch.at[0, :3].set(r0[0]).at[1].set(r1[0])
+    lengths = jnp.asarray([3, 7], jnp.int32)
+
+    out, out_len = generate(
+        CFG, params, batch, lengths, max_new_tokens=5, temperature=0
+    )
+    solo0, _ = generate(CFG, params, r0, max_new_tokens=5, temperature=0)
+    solo1, _ = generate(CFG, params, r1, max_new_tokens=5, temperature=0)
+    assert np.array_equal(np.asarray(out[0, :8]), np.asarray(solo0[0]))
+    assert np.array_equal(np.asarray(out[1, :12]), np.asarray(solo1[0]))
+    assert np.asarray(out_len).tolist() == [8, 12]
+
+
+def test_generate_jits(params):
+    import functools
+
+    gen = jax.jit(
+        functools.partial(generate, CFG, max_new_tokens=3, temperature=0)
+    )
+    prompt = jnp.ones((2, 4), jnp.int32)
+    out, lens = gen(params, prompt)
+    assert out.shape == (2, 7)
+    assert np.asarray(lens).tolist() == [7, 7]
+
+
+def test_eos_early_stop(params):
+    prompt = jnp.ones((1, 4), jnp.int32)
+    first, _ = generate(CFG, params, prompt, max_new_tokens=1, temperature=0)
+    eos = int(first[0, 4])
+    out, lens = generate(CFG, params, prompt, max_new_tokens=6, temperature=0, eos_id=eos)
+    assert int(lens[0]) == 5  # prompt 4 + the eos token itself
+    assert np.asarray(out[0, 5:]).tolist() == [eos] * 5  # padded with eos
+
+
+def test_gqa_matches_mha_shapes():
+    cfg = TransformerConfig(
+        vocab_size=31, d_model=16, n_layers=1, n_heads=4, n_kv_heads=1, d_ff=32,
+        attention="dense", dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.key(3))
+    assert params["layers"]["wk"].shape == (1, 16, 1, 4)
+    logits = forward(cfg, params, jnp.zeros((2, 6), jnp.int32))
+    assert logits.shape == (2, 6, 31)
+    assert np.isfinite(np.asarray(logits)).all()
+    # cached path agrees with the uncached one under GQA too
+    cache = init_cache(cfg, 2, 6)
+    last, _ = prefill(cfg, params, cache, jnp.zeros((2, 6), jnp.int32), jnp.full((2,), 6, jnp.int32))
+    np.testing.assert_allclose(np.asarray(last), np.asarray(logits[:, -1]), rtol=2e-4, atol=2e-4)
+
+
+def test_sampling_knobs():
+    logits = jnp.asarray([[0.0, 10.0, 0.0, 0.0]])
+    key = jax.random.key(0)
+    assert int(sample_logits(logits, key, temperature=0)[0]) == 1
+    assert int(sample_logits(logits, key, temperature=1.0, top_k=1)[0]) == 1
+    assert int(sample_logits(logits, key, temperature=1.0, top_p=0.5)[0]) == 1
+    # high temperature + full support still returns a valid token id
+    tok = sample_logits(jnp.zeros((3, 8)), key, temperature=5.0, top_k=4, top_p=0.9)
+    assert tok.shape == (3,)
+    assert ((np.asarray(tok) >= 0) & (np.asarray(tok) < 8)).all()
+
+
+def test_gqa_kv_replicated_under_tp():
+    """kv_heads smaller than the tp axis: wk/wv fall back to replicated."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=8, n_kv_heads=2, d_ff=64,
+        attention="dense",
+    )
+    from ray_tpu.models import make_train_step
+
+    with mesh:
+        init_state, step = make_train_step(cfg, mesh=mesh, sp=None)
+        state = init_state(jax.random.key(0))
+        tokens = step.shard_batch(
+            jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 12)), jnp.int32)
+        )
+        state, loss = step(state, tokens)
+        assert np.isfinite(float(loss))
+
+
+def test_invalid_gqa_config_raises():
+    with pytest.raises(ValueError):
+        TransformerConfig(n_heads=8, n_kv_heads=3)
+    with pytest.raises(ValueError):
+        TransformerConfig(n_heads=8, n_kv_heads=16)
