@@ -132,6 +132,26 @@ def decode_step(
     return logits[:, 0], cache
 
 
+def filter_top_k_top_p(
+    logits: jax.Array, top_k: Optional[int] = None, top_p: Optional[float] = None
+) -> jax.Array:
+    """Mask logits outside the top-k set / top-p nucleus to -inf. Shared by
+    :func:`sample_logits` and the serving engine so the two sampling paths
+    can't drift."""
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (always >= 1 token)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return logits
+
+
 def sample_logits(
     logits: jax.Array,  # [B, V] f32
     key: jax.Array,
@@ -145,18 +165,7 @@ def sample_logits(
     entry — the decode loop stays branch-free."""
     if temperature == 0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None and top_k < logits.shape[-1]:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p is not None and top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest prefix with cumulative mass >= top_p (always >= 1 token)
-        keep = cum - probs < top_p
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
-        logits = jnp.where(logits < cutoff, -1e30, logits)
+    logits = filter_top_k_top_p(logits / temperature, top_k, top_p)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 

@@ -19,11 +19,14 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
+from ray_tpu.serve.llm import LLMEngine, LLMServer
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 __all__ = [
     "Application",
+    "LLMEngine",
+    "LLMServer",
     "AutoscalingConfig",
     "Deployment",
     "DeploymentHandle",

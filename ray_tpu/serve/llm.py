@@ -1,0 +1,321 @@
+"""LLM serving: continuous batching over a shared KV cache.
+
+The reference's Serve ships no inference engine (its LLM guides delegate to
+vLLM on GPU). On TPU the engine IS the framework's job, and the design is
+dictated by XLA's static-shape compilation model:
+
+- **Fixed decode slots.** One preallocated cache of ``[L, B, Hkv, S, Dh]``
+  where B = ``max_batch_size`` slots. A request occupies a slot from
+  admission to completion; every decode step is ONE jitted program over all
+  B slots (inactive slots compute masked garbage — the static-shape price,
+  paid in exchange for zero recompiles at any admission pattern).
+- **Bucketed prefill.** Prompts pad to power-of-2 buckets so prefill
+  compiles once per bucket, not once per length. Prefill runs batch-1 and
+  the resulting cache row is scattered into the slot (`dynamic_update_slice`
+  on the batch axis) — admission never stalls running decodes for longer
+  than one prefill.
+- **Continuous batching.** New requests join between decode steps
+  (vLLM-style iteration-level scheduling); finished ones free their slot
+  immediately. Per-request ``max_tokens`` and ``temperature`` ride as
+  device arrays, so mixed sampling configs share one compiled step.
+
+``LLMServer`` is the Serve-facing wrapper: a deployment class whose
+replicas each own an engine; requests arrive via handle/HTTP and block on a
+per-request Future.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.generation import (
+    decode_step,
+    filter_top_k_top_p,
+    forward_with_cache,
+    init_cache,
+)
+from ray_tpu.models.transformer import TransformerConfig
+
+
+@dataclass
+class GenRequest:
+    prompt: List[int]
+    max_tokens: int = 32
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    future: Future = field(default_factory=Future)
+    # filled by the engine
+    slot: int = -1
+    generated: List[int] = field(default_factory=list)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class LLMEngine:
+    """Continuous-batching decode engine for one model on one device/mesh.
+
+    Thread model: callers enqueue via :meth:`submit` (thread-safe); one
+    background loop admits requests and steps the batch. All jitted callables
+    are built once in __init__ so the loop never traces.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Any,
+        *,
+        max_batch_size: int = 8,
+        max_seq_len: int = 512,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.B = max_batch_size
+        self.S = max_seq_len
+        self.top_k = top_k
+        self.top_p = top_p
+
+        self._queue: List[GenRequest] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+
+        # slot state (host-side mirrors of the device arrays)
+        self._slots: List[Optional[GenRequest]] = [None] * self.B
+        self._last_tok = np.zeros(self.B, np.int32)
+        self._pos = np.zeros(self.B, np.int32)
+        self._temps = np.zeros(self.B, np.float32)
+        self._active = np.zeros(self.B, bool)
+
+        self._cache = init_cache(cfg, self.B, self.S)
+        self._key = jax.random.key(np.random.randint(0, 2**31 - 1))
+
+        cfg_ = cfg
+
+        # the cache is donated through decode/insert: the engine holds the
+        # only reference and reassigns, so XLA updates the [L,B,Hkv,S,Dh]
+        # buffers in place instead of copying them every token
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode(params, cache, toks, pos):
+            return decode_step(cfg_, params, cache, toks, pos)
+
+        @jax.jit
+        def _prefill_one(params, tokens, length):
+            """tokens [1, Tb] (bucket-padded); length is traced so all
+            prompts in a bucket share ONE compile. Returns (logits [V],
+            cache row)."""
+            row = init_cache(cfg_, 1, self.S)
+            positions = jnp.arange(tokens.shape[1])[None, :]
+            logits, row = forward_with_cache(cfg_, params, row, tokens, positions)
+            return jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0, keepdims=False), row
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _insert(cache, row, slot):
+            out = {}
+            for kk in ("k", "v"):
+                out[kk] = jax.vmap(
+                    lambda c, r: jax.lax.dynamic_update_slice(c, r, (slot, 0, 0, 0))
+                )(cache[kk], row[kk])
+            return out
+
+        @jax.jit
+        def _sample(key, logits, temps):
+            """Per-slot temperature; temp <= 0 means greedy."""
+            greedy = temps <= 0.0
+            t = jnp.where(greedy, 1.0, temps)
+            scaled = filter_top_k_top_p(logits / t[:, None], self.top_k, self.top_p)
+            keys = jax.random.split(key, logits.shape[0])
+            sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+            return jnp.where(greedy, jnp.argmax(logits, -1), sampled).astype(jnp.int32)
+
+        self._decode = _decode
+        self._prefill_one = _prefill_one
+        self._insert = _insert
+        self._sample = _sample
+
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
+        self._thread.start()
+
+    # -- public API ---------------------------------------------------------
+    def submit(
+        self,
+        prompt: List[int],
+        *,
+        max_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+    ) -> Future:
+        """Enqueue one request; resolves to the generated token-id list."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_tokens > self.S:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) exceeds "
+                f"engine max_seq_len {self.S}"
+            )
+        req = GenRequest(list(prompt), max_tokens, temperature, eos_id)
+        with self._lock:
+            self._queue.append(req)
+        self._wake.set()
+        return req.future
+
+    def generate(self, prompt: List[int], **kw) -> List[int]:
+        return self.submit(prompt, **kw).result()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_slots": int(self._active.sum()),
+                "max_batch_size": self.B,
+                "queued": len(self._queue),
+            }
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+        with self._lock:
+            pending = [r for r in self._queue if not r.future.done()]
+            pending += [r for r in self._slots if r is not None and not r.future.done()]
+            self._queue.clear()
+        for r in pending:
+            r.future.set_exception(RuntimeError("LLMEngine shut down"))
+
+    # -- engine loop --------------------------------------------------------
+    def _admit(self) -> None:
+        while True:
+            with self._lock:
+                free = [i for i in range(self.B) if not self._active[i]]
+                if not free or not self._queue:
+                    return
+                req = self._queue.pop(0)
+                slot = free[0]
+            tp = len(req.prompt)
+            bucket = min(_bucket(tp), self.S)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :tp] = req.prompt
+            logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+            self._cache = self._insert(self._cache, row, slot)
+            # first output token comes straight from the prefill logits
+            self._key, sub = jax.random.split(self._key)
+            tok0 = int(
+                self._sample(
+                    sub, logits[None, :], jnp.asarray([req.temperature], jnp.float32)
+                )[0]
+            )
+            req.slot = slot
+            req.generated = [tok0]
+            with self._lock:
+                self._slots[slot] = req
+                self._active[slot] = True
+                self._last_tok[slot] = tok0
+                self._pos[slot] = tp
+                self._temps[slot] = req.temperature
+            if self._maybe_finish(req, tok0):
+                continue
+
+    def _maybe_finish(self, req: GenRequest, tok: int) -> bool:
+        done = len(req.generated) >= req.max_tokens or (
+            req.eos_id is not None and tok == req.eos_id
+        )
+        if done:
+            with self._lock:
+                self._active[req.slot] = False
+                self._slots[req.slot] = None
+            req.future.set_result(req.generated)
+        return done
+
+    def _step(self) -> None:
+        toks = jnp.asarray(self._last_tok)
+        pos = jnp.asarray(self._pos)
+        logits, self._cache = self._decode(self.params, self._cache, toks, pos)
+        self._key, sub = jax.random.split(self._key)
+        sampled = np.asarray(self._sample(sub, logits, jnp.asarray(self._temps)))
+        for i in range(self.B):
+            req = self._slots[i]
+            if req is None:
+                continue
+            tok = int(sampled[i])
+            req.generated.append(tok)
+            self._pos[i] += 1
+            self._last_tok[i] = tok
+            self._maybe_finish(req, tok)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._admit()
+            if self._active.any():
+                self._step()
+            else:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+
+class LLMServer:
+    """Serve deployment wrapper: each replica owns an engine.
+
+    ``model_factory`` -> (cfg, params); called once per replica so weights
+    live replica-local (HBM). Deploy with::
+
+        app = serve.deployment(LLMServer).bind(model_factory, max_batch_size=8)
+        handle = serve.run(app)
+        handle.remote({"prompt": [1,2,3], "max_tokens": 16}).result()
+    """
+
+    def __init__(
+        self,
+        model_factory: Callable[[], Any],
+        *,
+        max_batch_size: int = 8,
+        max_seq_len: int = 512,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        cfg, params = model_factory()
+        self.engine = LLMEngine(
+            cfg,
+            params,
+            max_batch_size=max_batch_size,
+            max_seq_len=max_seq_len,
+            top_k=top_k,
+            top_p=top_p,
+        )
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = request["prompt"]
+        t0 = time.perf_counter()
+        out = self.engine.generate(
+            prompt,
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"),
+        )
+        return {
+            "tokens": out,
+            "num_generated": len(out),
+            "latency_s": round(time.perf_counter() - t0, 4),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass

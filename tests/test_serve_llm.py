@@ -1,0 +1,118 @@
+"""LLM serving engine tests: greedy engine output == one-shot generate(),
+continuous admission (mid-flight joins), slot reuse, eos/max_tokens stops,
+and the Serve deployment wrapper."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import TransformerConfig, generate, init_params
+from ray_tpu.serve.llm import LLMEngine, LLMServer, _bucket
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    attention="dense", dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(11))
+
+
+@pytest.fixture()
+def engine(params):
+    eng = LLMEngine(CFG, params, max_batch_size=4, max_seq_len=64)
+    yield eng
+    eng.shutdown()
+
+
+def _reference(params, prompt, n):
+    """Greedy reference continuation via the one-shot generate()."""
+    p = jnp.asarray([prompt], jnp.int32)
+    out, lens = generate(CFG, params, p, max_new_tokens=n, temperature=0)
+    return np.asarray(out[0, len(prompt): int(lens[0])]).tolist()
+
+
+def test_single_request_matches_generate(engine, params):
+    prompt = [3, 14, 15, 9, 2]
+    got = engine.generate(prompt, max_tokens=6)
+    assert got == _reference(params, prompt, 6)
+
+
+def test_concurrent_ragged_requests_match(engine, params):
+    prompts = [[5, 6], [7, 8, 9, 10, 11], [1] * 17, [42]]
+    futs = [engine.submit(p, max_tokens=5) for p in prompts]
+    outs = [f.result(timeout=120) for f in futs]
+    for p, o in zip(prompts, outs):
+        assert o == _reference(params, p, 5)
+
+
+def test_continuous_admission_mid_flight(engine, params):
+    """A request submitted while another decodes must join its batch and
+    still produce exactly the solo-run tokens."""
+    first = engine.submit([2, 3, 4], max_tokens=24)
+    time.sleep(0.2)  # let decoding start
+    second = engine.submit([9, 8, 7, 6], max_tokens=4)
+    assert second.result(timeout=120) == _reference(params, [9, 8, 7, 6], 4)
+    assert first.result(timeout=120) == _reference(params, [2, 3, 4], 24)
+
+
+def test_slot_reuse_more_requests_than_slots(engine, params):
+    prompts = [[i + 1, i + 2] for i in range(9)]  # 9 requests, 4 slots
+    futs = [engine.submit(p, max_tokens=3) for p in prompts]
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=120) == _reference(params, p, 3)
+
+
+def test_eos_stops_generation(engine, params):
+    prompt = [4, 5, 6]
+    ref = _reference(params, prompt, 8)
+    eos = ref[2]
+    got = engine.generate(prompt, max_tokens=8, eos_id=eos)
+    # stops at (and includes) the FIRST occurrence of the eos token
+    assert got == ref[: ref.index(eos) + 1]
+
+
+def test_prompt_too_long_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.submit(list(range(60)), max_tokens=10)
+
+
+def test_sampled_temperature_valid_tokens(engine):
+    out = engine.generate([1, 2, 3], max_tokens=12, temperature=1.3)
+    assert len(out) == 12
+    assert all(0 <= t < CFG.vocab_size for t in out)
+
+
+def test_bucket():
+    assert _bucket(1) == 16
+    assert _bucket(16) == 16
+    assert _bucket(17) == 32
+    assert _bucket(100) == 128
+
+
+def test_llm_server_deployment(params):
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer).bind(
+            lambda: (CFG, params), max_batch_size=4, max_seq_len=64
+        )
+        handle = serve.run(app, route_prefix=None)
+        reqs = [{"prompt": [3, 1, 4], "max_tokens": 5}, {"prompt": [2, 7], "max_tokens": 3}]
+        resps = [handle.remote(r) for r in reqs]
+        r0, r1 = (r.result() for r in resps)
+        assert r0["tokens"] == _reference(params, [3, 1, 4], 5)
+        assert r1["tokens"] == _reference(params, [2, 7], 3)
+        assert r0["num_generated"] == 5
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
