@@ -60,10 +60,16 @@ def forward_with_cache(
     cache: KVCache,
     tokens: jax.Array,     # [B, T] int32 (T = prompt len for prefill, 1 for decode)
     positions: jax.Array,  # [B, T] int32 absolute positions (contiguous per row)
+    *,
+    use_decode_kernel: Optional[bool] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """One cached forward pass. Writes this call's K/V into the cache at
     ``positions`` and attends over everything up to them. Returns
-    (logits [B, T, V] f32, updated cache)."""
+    (logits [B, T, V] f32, updated cache).
+
+    ``use_decode_kernel``: route single-token steps through the Pallas
+    decode-attention kernel (``ray_tpu.ops.decode_attention``); default
+    auto — on for TPU, off elsewhere (the plain-XLA grouped einsum)."""
     B, T = tokens.shape
     S = cache["k"].shape[3]
     h_heads, hkv = cfg.n_heads, cfg.kv_heads
@@ -74,6 +80,9 @@ def forward_with_cache(
     kv_pos = jnp.arange(S)
     # key s visible to query t iff s <= position(t): causal over the cache
     vis = kv_pos[None, None, None, :] <= positions[:, None, :, None]  # [B,1,T,S]
+    if use_decode_kernel is None:
+        use_decode_kernel = jax.default_backend() == "tpu"
+    decode_kernel = use_decode_kernel and T == 1
 
     def layer_fn(x, layer_kc_vc):
         layer, kc, vc = layer_kc_vc
@@ -84,15 +93,21 @@ def forward_with_cache(
         q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
         kc = _write_kv(kc, k, starts)
         vc = _write_kv(vc, v, starts)
-        # grouped-query attention against the whole cache
-        qg = q.reshape(B, T, hkv, n_rep, cfg.head_dim)
-        s_ = jnp.einsum(
-            "btgrk,bgsk->bgrts", qg.astype(jnp.float32), kc.astype(jnp.float32)
-        ) * scale  # [B, Hkv, n_rep, T, S]
-        s_ = jnp.where(vis[:, :, None], s_, -1e30)
-        p = jax.nn.softmax(s_, axis=-1)
-        o = jnp.einsum("bgrts,bgsk->btgrk", p, vc.astype(jnp.float32))
-        o = o.reshape(B, T, h_heads, cfg.head_dim).astype(x.dtype)
+        if decode_kernel:
+            from ray_tpu.ops.decode_attention import decode_attention
+
+            o = decode_attention(q[:, 0], kc, vc, starts + 1, sm_scale=scale)[:, None]
+            o = o.astype(x.dtype)
+        else:
+            # grouped-query attention against the whole cache
+            qg = q.reshape(B, T, hkv, n_rep, cfg.head_dim)
+            s_ = jnp.einsum(
+                "btgrk,bgsk->bgrts", qg.astype(jnp.float32), kc.astype(jnp.float32)
+            ) * scale  # [B, Hkv, n_rep, T, S]
+            s_ = jnp.where(vis[:, :, None], s_, -1e30)
+            p = jax.nn.softmax(s_, axis=-1)
+            o = jnp.einsum("bgrts,bgsk->btgrk", p, vc.astype(jnp.float32))
+            o = o.reshape(B, T, h_heads, cfg.head_dim).astype(x.dtype)
         x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
         h = _rms_norm(x, layer["ffn_norm"])
         ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)

@@ -1,0 +1,140 @@
+"""Single-token (decode) attention over a KV cache as a Pallas TPU kernel.
+
+Decode attention is the per-token hot op of serving: one query row per
+sequence attends over the whole cache. It is purely HBM-bandwidth-bound —
+the FLOPs are trivial; what matters is streaming K/V exactly once at full
+bandwidth. The kernel:
+
+- grids over (batch, kv_head, cache blocks) and streams K/V blocks through
+  VMEM with online-softmax state in scratch (same revisited-output pattern
+  as the training flash kernel in ``ray_tpu.ops.attention``);
+- exploits GQA natively: the ``n_rep`` query heads of a KV group ride in
+  the sublane dimension of ONE block, so K/V bytes are read once per
+  GROUP, not once per query head — an n_rep-fold bandwidth saving, which
+  is the whole reason GQA exists;
+- masks per-sequence cache validity with an additive bias row
+  (``0 / -inf``), so ragged slot positions in the serving engine's shared
+  cache need no recompilation.
+
+No backward pass: decode is inference-only. Non-TPU backends run in
+interpret mode (tests exercise the same code path on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.attention import NEG_INF, _LANES, _use_interpret
+
+_MIN_REP = 8  # sublane multiple: pad the n_rep query rows up to one tile
+
+
+def _decode_kernel(
+    q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale: float, block_s: int,
+):
+    """Grid (B, Hkv, S_blocks); S innermost streams the cache through VMEM.
+
+    q_ref: [rep_p, D] (the group's query heads, sublane-padded);
+    k_ref/v_ref: [block_s, D]; bias_ref: [1, block_s] (0 valid / -inf not);
+    o_ref: [rep_p, D]; scratch m/l [rep_p, LANES], acc [rep_p, D].
+    """
+    si = pl.program_id(2)
+    num_s = pl.num_programs(2)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    s = s + bias_ref[0, :][None, :]  # [rep_p, block_s]
+
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == num_s - 1)
+    def _final():
+        l = l_scr[:, :1]
+        o_ref[...] = (acc_scr[...] / jnp.where(l == 0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,         # [B, H, D] one query row per sequence
+    k_cache: jax.Array,   # [B, Hkv, S, D]
+    v_cache: jax.Array,   # [B, Hkv, S, D]
+    lengths: jax.Array,   # [B] int32: valid cache entries per sequence
+    *,
+    sm_scale: Optional[float] = None,
+    block_s: int = 512,
+) -> jax.Array:
+    """Returns [B, H, D]. H must be a multiple of Hkv (GQA groups)."""
+    import math
+
+    B, H, D = q.shape
+    _, Hkv, S, _ = k_cache.shape
+    n_rep = H // Hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    rep_p = -(-n_rep // _MIN_REP) * _MIN_REP  # round UP to a sublane multiple
+
+    qg = q.reshape(B, Hkv, n_rep, D)
+    if rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - n_rep), (0, 0)))
+
+    # Prefer shrinking the block to a divisor of S over padding: padding
+    # copies the ENTIRE cache (the op's whole byte budget) just to round the
+    # last block. Only fall back to a padded copy when every divisor is tiny.
+    bs = min(block_s, S)
+    if S % bs:
+        d = next((d for d in range(bs, 0, -1) if S % d == 0), 1)
+        if d >= 128:
+            bs = d
+    pad_s = (-S) % bs
+    if pad_s:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+    Sp = S + pad_s
+    bias = jnp.where(jnp.arange(Sp)[None, :] < lengths[:, None], 0.0, NEG_INF).astype(jnp.float32)
+
+    grid = (B, Hkv, Sp // bs)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=scale, block_s=bs),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep_p, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, rep_p, D), lambda b, g, s: (b, g, 0, 0)),
+            pl.BlockSpec((None, None, bs, D), lambda b, g, s: (b, g, s, 0)),
+            pl.BlockSpec((None, None, bs, D), lambda b, g, s: (b, g, s, 0)),
+            pl.BlockSpec((None, 1, bs), lambda b, g, s: (b, 0, s)),
+        ],
+        out_specs=pl.BlockSpec((None, None, rep_p, D), lambda b, g, s: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep_p, _LANES), jnp.float32),
+            pltpu.VMEM((rep_p, _LANES), jnp.float32),
+            pltpu.VMEM((rep_p, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_use_interpret(),
+    )(qg, k_cache, v_cache, bias[:, None, :])
+    return out[:, :, :n_rep, :].reshape(B, H, D)

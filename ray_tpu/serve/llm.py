@@ -161,8 +161,12 @@ class LLMEngine:
         eos_id: Optional[int] = None,
     ) -> Future:
         """Enqueue one request; resolves to the generated token-id list."""
+        if self._stop:
+            raise RuntimeError("LLMEngine is shut down")
         if not prompt:
             raise ValueError("empty prompt")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         if len(prompt) + max_tokens > self.S:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) exceeds "

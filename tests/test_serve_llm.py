@@ -116,3 +116,15 @@ def test_llm_server_deployment(params):
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_submit_after_shutdown_raises(params):
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=32)
+    eng.shutdown()
+    with pytest.raises(RuntimeError):
+        eng.submit([1, 2], max_tokens=2)
+
+
+def test_zero_max_tokens_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_tokens=0)
