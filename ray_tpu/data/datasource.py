@@ -148,14 +148,27 @@ def _expand_paths(paths) -> List[str]:
 
 
 class FileBasedDatasource(Datasource):
-    """One ReadTask per file group (parity: file_based_datasource.py)."""
+    """One ReadTask per file group (parity: file_based_datasource.py).
+
+    Subclasses that can decode from raw bytes implement ``_decode_bytes``;
+    their read tasks then batch-read each group through the native IO pool
+    (``ray_tpu.native.io_pool``, C++ pthread pread — GIL-free), decoding in
+    Python while the remaining files stream in the background."""
 
     def __init__(self, paths, **read_kwargs):
         self.paths = _expand_paths(paths)
         self.read_kwargs = read_kwargs
 
     def _read_file(self, path: str) -> Block:
+        # default: read bytes then decode (subclasses override either hook)
+        with open(path, "rb") as f:
+            return self._decode_bytes(path, f.read())
+
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
         raise NotImplementedError
+
+    def _supports_bytes(self) -> bool:
+        return type(self)._decode_bytes is not FileBasedDatasource._decode_bytes
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         files = self.paths
@@ -169,8 +182,21 @@ class FileBasedDatasource(Datasource):
                 continue
 
             def make(group=group):
-                for path in group:
-                    yield self._read_file(path)
+                pool = None
+                if len(group) > 1 and self._supports_bytes():
+                    from ray_tpu.native.io_pool import default_pool, file_size
+
+                    pool = default_pool()
+                if pool is not None:
+                    # all reads submitted up front; each file decodes as its
+                    # read lands, overlapping IO with decode — memory stays
+                    # ~one group of in-flight buffers, yielded one at a time
+                    ranges = [(p, 0, file_size(p)) for p in group]
+                    for path, data in zip(group, pool.iter_reads(ranges)):
+                        yield self._decode_bytes(path, data)
+                else:
+                    for path in group:
+                        yield self._read_file(path)
 
             size = sum(os.path.getsize(f) for f in group if os.path.exists(f))
             meta = BlockMetadata(num_rows=-1, size_bytes=size, input_files=group)
@@ -179,12 +205,12 @@ class FileBasedDatasource(Datasource):
 
 
 class CSVDatasource(FileBasedDatasource):
-    def _read_file(self, path: str) -> Block:
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
         import csv
+        import io
 
-        with open(path, newline="") as f:
-            reader = csv.DictReader(f, **self.read_kwargs)
-            rows = [dict(r) for r in reader]
+        reader = csv.DictReader(io.StringIO(data.decode(), newline=""), **self.read_kwargs)
+        rows = [dict(r) for r in reader]
         block = block_from_rows(rows)
         return {k: _maybe_numeric(v) for k, v in block.items()}
 
@@ -205,13 +231,12 @@ class CSVDatasource(FileBasedDatasource):
 class JSONDatasource(FileBasedDatasource):
     """JSONL files, one object per line (parity: json_datasource.py)."""
 
-    def _read_file(self, path: str) -> Block:
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
         rows = []
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    rows.append(_json.loads(line))
+        for line in data.decode().splitlines():
+            line = line.strip()
+            if line:
+                rows.append(_json.loads(line))
         return block_from_rows(rows)
 
     def write(self, blocks: List[Block], path: str, **kwargs) -> None:
@@ -223,8 +248,10 @@ class JSONDatasource(FileBasedDatasource):
 
 
 class NumpyDatasource(FileBasedDatasource):
-    def _read_file(self, path: str) -> Block:
-        arr = np.load(path, allow_pickle=False)
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
+        import io
+
+        arr = np.load(io.BytesIO(data), allow_pickle=False)
         return {"data": arr}
 
     def write(self, blocks: List[Block], path: str, *, column: str = "data", **kwargs) -> None:
@@ -281,9 +308,13 @@ def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
 class TextDatasource(FileBasedDatasource):
     """One row per line (parity: text_datasource.py)."""
 
-    def _read_file(self, path: str) -> Block:
-        with open(path, encoding=self.read_kwargs.get("encoding", "utf-8")) as f:
-            lines = [ln.rstrip("\n") for ln in f]
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
+        text = data.decode(self.read_kwargs.get("encoding", "utf-8"))
+        # split on \n ONLY (file-iteration semantics): splitlines() would
+        # also break rows at \x0c, \x85,  ... inside a line
+        lines = text.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()  # trailing newline is a terminator, not an empty row
         if self.read_kwargs.get("drop_empty_lines", True):
             lines = [ln for ln in lines if ln]
         return {"text": np.asarray(lines, dtype=object)}
@@ -292,10 +323,8 @@ class TextDatasource(FileBasedDatasource):
 class BinaryDatasource(FileBasedDatasource):
     """Whole files as bytes rows (parity: binary_datasource.py)."""
 
-    def _read_file(self, path: str) -> Block:
-        with open(path, "rb") as f:
-            data = f.read()
-        block = {"bytes": np.asarray([data], dtype=object)}
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
+        block = {"bytes": np.asarray([bytes(data)], dtype=object)}
         if self.read_kwargs.get("include_paths", False):
             block["path"] = np.asarray([path], dtype=object)
         return block
@@ -305,10 +334,11 @@ class ImageDatasource(FileBasedDatasource):
     """Images decoded to HWC uint8 arrays via PIL (parity:
     image_datasource.py). ``size=(h, w)`` resizes; ``mode`` converts."""
 
-    def _read_file(self, path: str) -> Block:
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
+        import io
         from PIL import Image
 
-        img = Image.open(path)
+        img = Image.open(io.BytesIO(data))
         mode = self.read_kwargs.get("mode")
         if mode:
             img = img.convert(mode)
@@ -329,13 +359,13 @@ class WebDatasetDatasource(FileBasedDatasource):
 
     IMAGE_EXTS = {"jpg", "jpeg", "png", "bmp", "gif", "webp"}
 
-    def _read_file(self, path: str) -> Block:
+    def _decode_bytes(self, path: str, data: bytes) -> Block:
         import io
         import tarfile
 
         samples: Dict[str, Dict[str, Any]] = {}
         order: List[str] = []
-        with tarfile.open(path) as tf:
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
             for member in tf:
                 if not member.isfile():
                     continue

@@ -75,7 +75,13 @@ def _decode_kernel(
     @pl.when(si == num_s - 1)
     def _final():
         l = l_scr[:, :1]
-        o_ref[...] = (acc_scr[...] / jnp.where(l == 0, 1.0, l)).astype(o_ref.dtype)
+        # A fully-masked row (lengths[b] == 0) never sees a finite score, so
+        # its running max stays at the bias floor: m <= NEG_INF/2 detects it
+        # (l is useless here — additive -1e30 bias absorbs in f32 and every
+        # masked slot contributes p == 1). Emit zeros, not garbage-V means.
+        empty = m_scr[:, :1] <= NEG_INF * 0.5
+        out = jnp.where(empty, 0.0, acc_scr[...] / jnp.where(l == 0, 1.0, l))
+        o_ref[...] = out.astype(o_ref.dtype)
 
 
 def decode_attention(

@@ -133,3 +133,17 @@ def test_block_shrinks_to_divisor_instead_of_padding():
     out = decode_attention(q, kc, vc, lengths, block_s=512)
     ref = _reference(q, kc, vc, lengths)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_zero_length_rows_yield_zeros():
+    """An empty slot (lengths == 0) must emit zeros, not garbage-V means."""
+    rng = np.random.default_rng(7)
+    B, Hkv, S, D = 3, 2, 64, 16
+    q = jnp.asarray(rng.standard_normal((B, 4, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    lengths = jnp.asarray([0, 5, 0], jnp.int32)
+    out = np.asarray(decode_attention(q, kc, vc, lengths))
+    assert (out[0] == 0).all() and (out[2] == 0).all()
+    ref = _reference(q, kc, vc, lengths)
+    np.testing.assert_allclose(out[1], np.asarray(ref[1]), rtol=2e-5, atol=2e-5)

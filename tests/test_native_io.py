@@ -1,0 +1,166 @@
+"""Native parallel IO pool tests: reads/writes/ranges, error paths,
+concurrency, and the Data datasource fast path riding it."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.native.io_pool import IOPool, default_pool, file_size
+
+
+@pytest.fixture(scope="module")
+def pool():
+    p = IOPool(num_threads=4)
+    yield p
+    p.close()
+
+
+def test_read_files_in_order(tmp_path, pool):
+    paths = []
+    for i in range(10):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(bytes([i]) * (1000 + i))
+        paths.append(str(p))
+    out = pool.read_files(paths)
+    for i, data in enumerate(out):
+        assert data == bytes([i]) * (1000 + i)
+
+
+def test_read_ranges(tmp_path, pool):
+    p = tmp_path / "r.bin"
+    p.write_bytes(bytes(range(256)))
+    out = pool.read_ranges([(str(p), 0, 16), (str(p), 100, 28), (str(p), 250, 20)])
+    assert out[0] == bytes(range(16))
+    assert out[1] == bytes(range(100, 128))
+    assert out[2] == bytes(range(250, 256))  # short read at EOF truncates
+
+
+def test_write_then_read(tmp_path, pool):
+    p = str(tmp_path / "w.bin")
+    payload = os.urandom(65536)
+    assert pool.write_file(p, payload) == len(payload)
+    assert pool.read_files([p])[0] == payload
+
+
+def test_write_files_concurrent(tmp_path, pool):
+    items = [(str(tmp_path / f"w{i}.bin"), os.urandom(1024)) for i in range(8)]
+    ns = pool.write_files(items)
+    assert ns == [1024] * 8
+    for path, data in items:
+        with open(path, "rb") as f:
+            assert f.read() == data
+
+
+def test_missing_file_raises(pool, tmp_path):
+    jid = pool.submit_read(str(tmp_path / "nope.bin"), bytearray(10))
+    with pytest.raises(OSError):
+        pool.wait(jid)
+    with pytest.raises(OSError):
+        file_size(str(tmp_path / "nope.bin"))
+
+
+def test_file_size(tmp_path, pool):
+    p = tmp_path / "s.bin"
+    p.write_bytes(b"x" * 12345)
+    assert file_size(str(p)) == 12345
+
+
+def test_many_concurrent_jobs(tmp_path, pool):
+    """More in-flight jobs than threads: queue drains correctly."""
+    p = tmp_path / "big.bin"
+    blob = os.urandom(1 << 20)
+    p.write_bytes(blob)
+    ranges = [(str(p), i * 4096, 4096) for i in range(256)]
+    out = pool.read_ranges(ranges)
+    for i, chunk in enumerate(out):
+        assert chunk == blob[i * 4096 : (i + 1) * 4096]
+
+
+def test_default_pool_singleton():
+    a, b = default_pool(), default_pool()
+    assert a is b and a is not None
+
+
+def test_datasource_rides_native_pool(tmp_path, monkeypatch):
+    """A grouped read task goes through IOPool.read_files (checked in-process
+    — the cluster path runs tasks in worker processes where a monkeypatch
+    can't observe them)."""
+    from ray_tpu.data.datasource import BinaryDatasource
+    from ray_tpu.native import io_pool
+
+    for i in range(6):
+        (tmp_path / f"b{i}.bin").write_bytes(bytes([i]) * 64)
+
+    calls = []
+    orig = io_pool.IOPool.iter_reads
+
+    def spy(self, ranges):
+        calls.append(list(ranges))
+        return orig(self, ranges)
+
+    monkeypatch.setattr(io_pool.IOPool, "iter_reads", spy)
+    tasks = BinaryDatasource(str(tmp_path)).get_read_tasks(2)
+    blocks = [b for t in tasks for b in t.fn()]
+    assert sorted(bytes(b["bytes"][0])[:1] for b in blocks) == [bytes([i]) for i in range(6)]
+    assert calls and all(len(c) == 3 for c in calls), calls
+
+
+def test_datasource_end_to_end_through_cluster(tmp_path):
+    """Full cluster path still returns correct rows (workers use the pool
+    or the fallback, whichever their process supports)."""
+    import ray_tpu
+    import ray_tpu.data as data
+
+    for i in range(6):
+        (tmp_path / f"b{i}.bin").write_bytes(bytes([i]) * 64)
+    ray_tpu.init(num_cpus=2)
+    try:
+        ds = data.read_binary_files(str(tmp_path), parallelism=2)
+        rows = sorted(bytes(r["bytes"])[:1] for r in ds.take_all())
+        assert rows == [bytes([i]) for i in range(6)]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_read_files_missing_second_file_safe(tmp_path, pool):
+    """A stat failure mid-batch must not leave native threads writing into
+    freed buffers (submit happens only after all sizes are known)."""
+    ok = tmp_path / "ok.bin"
+    ok.write_bytes(b"y" * 4096)
+    with pytest.raises(OSError):
+        pool.read_files([str(ok), str(tmp_path / "gone.bin")])
+    # pool still healthy afterwards
+    assert bytes(pool.read_files([str(ok)])[0]) == b"y" * 4096
+
+
+def test_iter_reads_early_close_drains(tmp_path, pool):
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"it{i}.bin"
+        p.write_bytes(bytes([i]) * 8192)
+        paths.append(str(p))
+    it = pool.iter_reads([(p, 0, 8192) for p in paths])
+    first = next(it)
+    assert bytes(first) == bytes([0]) * 8192
+    it.close()  # outstanding jobs must be drained, not abandoned
+    out = pool.read_files(paths)  # pool still consistent
+    assert all(bytes(b) == bytes([i]) * 8192 for i, b in enumerate(out))
+
+
+def test_default_pool_failure_cached(monkeypatch):
+    from ray_tpu.native import io_pool as mod
+
+    monkeypatch.setattr(mod, "_default_pool", None)
+    attempts = []
+
+    class Boom:
+        def __init__(self, *a, **k):
+            attempts.append(1)
+            raise OSError("no toolchain")
+
+    monkeypatch.setattr(mod, "IOPool", Boom)
+    assert mod.default_pool() is None
+    assert mod.default_pool() is None
+    assert len(attempts) == 1  # second call hits the cached failure
