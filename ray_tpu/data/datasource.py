@@ -312,6 +312,7 @@ class TextDatasource(FileBasedDatasource):
         text = data.decode(self.read_kwargs.get("encoding", "utf-8"))
         # split on \n ONLY (file-iteration semantics): splitlines() would
         # also break rows at \x0c, \x85,  ... inside a line
+        text = text.replace("\r\n", "\n").replace("\r", "\n")  # universal newlines
         lines = text.split("\n")
         if lines and lines[-1] == "":
             lines.pop()  # trailing newline is a terminator, not an empty row

@@ -25,6 +25,21 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _locked_build(make_dir: str, lib_path: str) -> None:
+    """Build under an flock so concurrent worker processes don't race
+    ``make`` — without it one process can dlopen a half-linked .so and
+    cache the failure for its whole lifetime."""
+    import fcntl
+
+    with open(os.path.join(make_dir, ".build.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(lib_path):  # a peer may have built it
+                subprocess.run(["make", "-s", "-C", make_dir], check=True, capture_output=True)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
 def _load_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -33,7 +48,7 @@ def _load_lib() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH):
-            subprocess.run(["make", "-s", "-C", _DIR], check=True, capture_output=True)
+            _locked_build(_DIR, _LIB_PATH)
         lib = ctypes.CDLL(_LIB_PATH)
         lib.tio_pool_create.restype = ctypes.c_void_p
         lib.tio_pool_create.argtypes = [ctypes.c_int]
@@ -118,37 +133,39 @@ class IOPool:
             except Exception:
                 pass
 
-    def _submit_reads(self, ranges):
-        """(sizes staged first so nothing is in flight if a stat raises)"""
-        bufs = [bytearray(ln) for _, _, ln in ranges]
-        jobs = []
-        try:
-            for (path, off, ln), buf in zip(ranges, bufs):
-                jobs.append(self.submit_read(path, buf, offset=off, length=ln))
-        except BaseException:
-            self._drain(jobs)
-            raise
-        return bufs, jobs
-
-    def iter_reads(self, ranges: Sequence[tuple]):
-        """Generator over [(path, offset, length), ...]: submits everything
-        up front, then yields each payload as its read completes — IO for
-        later files overlaps the caller's processing of earlier ones, and
-        peak memory is bounded by in-flight buffers, not the whole batch.
+    def iter_reads(self, ranges: Sequence[tuple], *, window: Optional[int] = None):
+        """Generator over [(path, offset, length), ...]: keeps up to
+        ``window`` reads in flight (default: pool threads + a small
+        lookahead) and yields each payload in order as it completes — IO
+        for later files overlaps the caller's processing of earlier ones,
+        and peak memory is bounded by the window, not the whole batch.
 
         Exception-safe: on any error (or early generator close) every
-        outstanding job is drained before buffers go out of scope."""
-        bufs, jobs = self._submit_reads(ranges)
-        done = 0
+        outstanding job is drained before its buffer can be freed."""
+        ranges = list(ranges)
+        w = window or (self.num_threads + 4)
+        inflight: List = []  # [(buf, job_id or None)]
+        idx = 0
         try:
-            for i, (buf, jid) in enumerate(zip(bufs, jobs)):
-                done = i + 1
-                n = self.wait(jid)
-                if n != len(buf):
-                    del buf[n:]  # short read at EOF / file shrank
+            while idx < len(ranges) or inflight:
+                while idx < len(ranges) and len(inflight) < w:
+                    path, off, ln = ranges[idx]
+                    idx += 1
+                    if ln == 0:
+                        # ctypes can't take the address of an empty buffer;
+                        # an empty file is just an empty payload
+                        inflight.append((bytearray(0), None))
+                        continue
+                    buf = bytearray(ln)
+                    inflight.append((buf, self.submit_read(path, buf, offset=off, length=ln)))
+                buf, jid = inflight.pop(0)
+                if jid is not None:
+                    n = self.wait(jid)
+                    if n != len(buf):
+                        del buf[n:]  # short read at EOF / file shrank
                 yield buf
         finally:
-            self._drain(jobs[done:])
+            self._drain(j for _, j in inflight if j is not None)
 
     def read_files(self, paths: Sequence[str]) -> List[bytearray]:
         """Read whole files concurrently; returns payloads (bytes-like) in
@@ -164,9 +181,20 @@ class IOPool:
         return self.wait(self.submit_write(path, data))
 
     def write_files(self, items: Sequence[tuple]) -> List[int]:
-        """items: [(path, data), ...] written concurrently."""
+        """items: [(path, data), ...] written concurrently. On a failed
+        write the remaining jobs are still reaped (no leaked buffers)."""
         jobs = [self.submit_write(p, d) for p, d in items]
-        return [self.wait(j) for j in jobs]
+        out, done = [], 0
+        try:
+            for jid in jobs:
+                done += 1
+                out.append(self.wait(jid))
+        finally:
+            rest = jobs[done:]
+            self._drain(rest)
+            for jid in rest:
+                self._pending_bufs.pop(jid, None)
+        return out
 
     # -- teardown -----------------------------------------------------------
     def close(self) -> None:

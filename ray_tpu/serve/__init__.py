@@ -10,6 +10,7 @@ batches; ``@serve.multiplexed`` LRU-caches many models per replica.
 from ray_tpu.serve.api import (
     delete,
     get_deployment_handle,
+    grpc_address,
     proxy_url,
     run,
     run_config,
@@ -36,6 +37,7 @@ __all__ = [
     "deployment",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "grpc_address",
     "multiplexed",
     "proxy_url",
     "run",

@@ -20,17 +20,30 @@ from ray_tpu.serve.router import DeploymentHandle
 _state_lock = threading.RLock()
 _controller = None
 _proxy: Optional[HTTPProxy] = None
+_grpc_proxy = None
 
 
-def start(*, http_host: str = "127.0.0.1", http_port: int = 0, request_timeout_s: float = 30.0):
-    """Start the Serve instance (controller + HTTP proxy)."""
-    global _controller, _proxy
+def start(
+    *,
+    http_host: str = "127.0.0.1",
+    http_port: int = 0,
+    request_timeout_s: float = 30.0,
+    grpc_port: Optional[int] = None,
+):
+    """Start the Serve instance (controller + HTTP proxy; pass ``grpc_port``
+    — 0 for an ephemeral port — to also open the gRPC ingress, parity with
+    the reference's gRPCOptions)."""
+    global _controller, _proxy, _grpc_proxy
     with _state_lock:
         if _controller is None:
             _controller = ServeControllerActor.options(execution="inproc", max_concurrency=64).remote()
             ray_tpu.get(_controller.ping.remote())
         if _proxy is None:
             _proxy = HTTPProxy(http_host, http_port, request_timeout_s)
+        if _grpc_proxy is None and grpc_port is not None:
+            from ray_tpu.serve.grpc_proxy import GRPCProxy
+
+            _grpc_proxy = GRPCProxy(http_host, grpc_port, request_timeout_s)
     return _controller
 
 
@@ -57,6 +70,8 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         ray_tpu.get(controller.set_ingress.remote(route_prefix, app.deployment.name))
         if _proxy is not None:
             _proxy.add_route(route_prefix, ingress)
+    if _grpc_proxy is not None:
+        _grpc_proxy.add_app(name, ingress)
     return ingress
 
 
@@ -80,21 +95,40 @@ def status() -> Dict[str, Any]:
     return {
         "deployments": ray_tpu.get(controller.list_deployments.remote()),
         "proxy_url": _proxy.url if _proxy else None,
+        "grpc_address": _grpc_proxy.address if _grpc_proxy else None,
     }
 
 
 def delete(name: str) -> None:
     controller = _require_started()
     ray_tpu.get(controller.delete_deployment.remote(name))
+    # drop proxy routes whose ingress was this deployment — a stale handle
+    # would surface as ActorDiedError on the next request
+    if _grpc_proxy is not None:
+        for app, handle in list(_grpc_proxy.apps.items()):
+            if getattr(handle, "deployment_name", None) == name:
+                _grpc_proxy.remove_app(app)
+    if _proxy is not None:
+        for prefix, handle in list(_proxy.routes.items()):
+            if getattr(handle, "deployment_name", None) == name:
+                _proxy.remove_route(prefix)
 
 
 def proxy_url() -> Optional[str]:
     return _proxy.url if _proxy else None
 
 
+def grpc_address() -> Optional[str]:
+    """host:port of the gRPC ingress, or None when not started."""
+    return _grpc_proxy.address if _grpc_proxy else None
+
+
 def shutdown() -> None:
-    global _controller, _proxy
+    global _controller, _proxy, _grpc_proxy
     with _state_lock:
+        if _grpc_proxy is not None:
+            _grpc_proxy.shutdown()
+            _grpc_proxy = None
         if _proxy is not None:
             _proxy.shutdown()
             _proxy = None

@@ -1,0 +1,95 @@
+"""gRPC ingress for Serve.
+
+Parity: the reference's per-node ``gRPCProxy`` (``serve/_private/proxy.py:534``,
+service schema ``src/ray/protobuf/serve.proto:317`` — ``ListApplications``/
+``Healthz`` plus user-defined method handlers routed by the ``application``
+request metadata). Here the service is a generic-bytes contract (no protoc
+codegen, so user payload schemas stay open):
+
+  /ray_tpu.serve.Serve/Predict           unary-unary, bytes -> bytes
+  /ray_tpu.serve.Serve/ListApplications  '' -> JSON list of app names
+  /ray_tpu.serve.Serve/Healthz           '' -> b"success"
+
+Routing: request metadata ``application`` picks the app (default:
+``default``); ``payload-codec`` metadata selects the codec —
+``json`` (default) or ``pickle`` for arbitrary Python/numpy values on both
+legs (``content-type`` is reserved by gRPC itself and cannot be user-set).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from concurrent import futures
+from typing import Dict, Optional
+
+from ray_tpu.serve.router import DeploymentHandle
+
+_SERVICE = "ray_tpu.serve.Serve"
+
+
+class GRPCProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, request_timeout_s: float = 30.0):
+        import grpc
+
+        self._grpc = grpc
+        self.host = host
+        self.request_timeout_s = request_timeout_s
+        self.apps: Dict[str, DeploymentHandle] = {}
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                "Predict": grpc.unary_unary_rpc_method_handler(self._predict),
+                "ListApplications": grpc.unary_unary_rpc_method_handler(self._list_apps),
+                "Healthz": grpc.unary_unary_rpc_method_handler(self._healthz),
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    # -- handlers (bytes in / bytes out) ------------------------------------
+    def _predict(self, request: bytes, context) -> bytes:
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        app = md.get("application", "default")
+        handle = self.apps.get(app)
+        if handle is None:
+            context.abort(
+                self._grpc.StatusCode.NOT_FOUND,
+                f"no application {app!r} (have: {sorted(self.apps)})",
+            )
+        codec = md.get("payload-codec", "json")
+        try:
+            if codec == "pickle":
+                payload = pickle.loads(request) if request else None
+            else:
+                payload = json.loads(request) if request else None
+            result = handle.remote(payload).result(timeout=self.request_timeout_s)
+            if codec == "pickle":
+                return pickle.dumps(result)
+            from ray_tpu.serve.proxy import _jsonify
+
+            return json.dumps(result, default=_jsonify).encode()
+        except Exception as exc:  # noqa: BLE001
+            context.abort(self._grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+
+    def _list_apps(self, request: bytes, context) -> bytes:
+        return json.dumps(sorted(self.apps)).encode()
+
+    def _healthz(self, request: bytes, context) -> bytes:
+        return b"success"
+
+    # -- proxy surface (mirrors HTTPProxy) ----------------------------------
+    def add_app(self, name: str, handle: DeploymentHandle) -> None:
+        self.apps[name] = handle
+
+    def remove_app(self, name: str) -> None:
+        self.apps.pop(name, None)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.stop(grace=0.5)

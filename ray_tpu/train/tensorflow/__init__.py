@@ -1,0 +1,104 @@
+"""TensorFlow backend: TF_CONFIG-rendezvous'd worker gangs.
+
+Parity: ``python/ray/train/tensorflow/`` — ``TensorflowTrainer`` +
+``TensorflowConfig`` (reference ``train/tensorflow/config.py``:
+``_setup_tensorflow_environment`` builds the ``TF_CONFIG`` cluster spec from
+the worker gang's addresses so ``tf.distribute.MultiWorkerMirroredStrategy``
+rendezvouses without its own launcher).
+
+Workers run as PROCESS actors (TF runtime state is per-OS-process, same
+reasoning as the torch backend). The trainer allocates one port per rank up
+front, builds the shared cluster spec, and each worker exports TF_CONFIG
+before the user loop starts — any TF_CONFIG-aware library finds it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.trainer import DataParallelTrainer
+
+__all__ = ["TensorflowTrainer", "TensorflowConfig", "prepare_dataset_shard"]
+
+
+@dataclass
+class TensorflowConfig:
+    """Cluster-spec settings (reference TensorflowConfig)."""
+
+    host: str = "127.0.0.1"
+
+
+def _free_ports(n: int, host: str):
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()  # freed just before workers bind; races are unlikely
+    return ports
+
+
+def _with_tf_config(fn, cluster_spec: dict):
+    """Export TF_CONFIG (cluster + this rank's task) around the user loop."""
+
+    def wrapped(config):
+        import inspect
+        import os
+
+        from ray_tpu.train import get_context
+
+        rank = get_context().get_world_rank()
+        os.environ["TF_CONFIG"] = json.dumps(
+            {"cluster": cluster_spec, "task": {"type": "worker", "index": rank}}
+        )
+        try:
+            takes_config = bool(inspect.signature(fn).parameters)
+            return fn(config) if takes_config else fn()
+        finally:
+            os.environ.pop("TF_CONFIG", None)
+
+    return wrapped
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """Distributed TF trainer (reference TensorflowTrainer): process-actor
+    gang with a shared TF_CONFIG cluster spec; the user loop builds its
+    ``MultiWorkerMirroredStrategy`` under that spec."""
+
+    _worker_execution = "process"
+
+    def __init__(
+        self,
+        train_loop_per_worker,
+        *,
+        tensorflow_config: Optional[TensorflowConfig] = None,
+        **kwargs,
+    ):
+        self.tensorflow_config = tensorflow_config or TensorflowConfig()
+        super().__init__(train_loop_per_worker, **kwargs)
+
+    def fit(self):
+        host = self.tensorflow_config.host
+        n = self.scaling_config.num_workers if self.scaling_config else 1
+        ports = _free_ports(n, host)
+        cluster = {"worker": [f"{host}:{p}" for p in ports]}
+        raw_loop = self.train_loop_per_worker
+        self.train_loop_per_worker = _with_tf_config(raw_loop, cluster)
+        try:
+            return super().fit()
+        finally:
+            self.train_loop_per_worker = raw_loop
+
+
+def prepare_dataset_shard(dataset_shard):
+    """Passthrough hook (reference prepare_dataset_shard disables TF
+    auto-sharding on an already-sharded dataset; our shards arrive
+    pre-split from DataConfig)."""
+    return dataset_shard

@@ -365,3 +365,10 @@ def test_read_sql_sqlite(tmp_path):
         ],
     )
     assert sorted(r["id"] for r in ds2.take_all()) == list(range(10))
+
+
+def test_read_text_crlf_newlines(tmp_path):
+    p = tmp_path / "crlf.txt"
+    p.write_bytes(b"alpha\r\nbeta\rgamma\n")
+    rows = [r["text"] for r in rd.read_text(str(p)).take_all()]
+    assert rows == ["alpha", "beta", "gamma"]

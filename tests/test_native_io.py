@@ -164,3 +164,32 @@ def test_default_pool_failure_cached(monkeypatch):
     assert mod.default_pool() is None
     assert mod.default_pool() is None
     assert len(attempts) == 1  # second call hits the cached failure
+
+
+def test_zero_byte_files(tmp_path, pool):
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    full = tmp_path / "full.bin"
+    full.write_bytes(b"z" * 128)
+    out = pool.read_files([str(empty), str(full), str(empty)])
+    assert [bytes(b) for b in out] == [b"", b"z" * 128, b""]
+
+
+def test_iter_reads_window_bounds_inflight(tmp_path, pool):
+    paths = []
+    for i in range(12):
+        p = tmp_path / f"w{i}.bin"
+        p.write_bytes(bytes([i]) * 256)
+        paths.append((str(p), 0, 256))
+    out = list(pool.iter_reads(paths, window=2))
+    assert [bytes(b)[:1] for b in out] == [bytes([i]) for i in range(12)]
+
+
+def test_write_files_partial_failure_drains(tmp_path, pool):
+    ok = str(tmp_path / "ok.bin")
+    bad = str(tmp_path / "nodir" / "x.bin")  # parent missing -> ENOENT
+    with pytest.raises(OSError):
+        pool.write_files([(bad, b"a" * 64), (ok, b"b" * 64)])
+    # pool healthy and no leaked pending buffers
+    assert pool.write_file(ok, b"c" * 64) == 64
+    assert not pool._pending_bufs

@@ -1,0 +1,98 @@
+"""gRPC ingress tests: Predict routing by application metadata, JSON and
+pickle codecs, Healthz/ListApplications, and error statuses (mirrors the
+reference's serve gRPC proxy tests, which drive a real channel)."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _serve():
+    ray_tpu.init(num_cpus=8)
+    serve.start(http_port=0, grpc_port=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_apps():
+    yield
+    for name in serve.status()["deployments"]:
+        serve.delete(name)
+
+
+@pytest.fixture(scope="module")
+def channel():
+    ch = grpc.insecure_channel(serve.grpc_address())
+    yield ch
+    ch.close()
+
+
+def _method(channel, name):
+    return channel.unary_unary(f"/ray_tpu.serve.Serve/{name}")
+
+
+def test_healthz_and_list_apps(channel):
+    assert _method(channel, "Healthz")(b"") == b"success"
+
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    serve.run(echo.bind(), name="echo_app", route_prefix=None)
+    apps = json.loads(_method(channel, "ListApplications")(b""))
+    assert "echo_app" in apps
+
+
+def test_predict_json(channel):
+    @serve.deployment
+    def double(x):
+        return {"doubled": [v * 2 for v in x["values"]]}
+
+    serve.run(double.bind(), name="double", route_prefix=None)
+    resp = _method(channel, "Predict")(
+        json.dumps({"values": [1, 2, 3]}).encode(),
+        metadata=(("application", "double"),),
+    )
+    assert json.loads(resp) == {"doubled": [2, 4, 6]}
+
+
+def test_predict_pickle_numpy(channel):
+    @serve.deployment
+    def matsum(arr):
+        return np.asarray(arr).sum(axis=0)
+
+    serve.run(matsum.bind(), name="matsum", route_prefix=None)
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    resp = _method(channel, "Predict")(
+        pickle.dumps(arr),
+        metadata=(("application", "matsum"), ("payload-codec", "pickle")),
+    )
+    np.testing.assert_allclose(pickle.loads(resp), arr.sum(axis=0))
+
+
+def test_unknown_application_not_found(channel):
+    with pytest.raises(grpc.RpcError) as exc:
+        _method(channel, "Predict")(b"{}", metadata=(("application", "nope"),))
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_replica_error_propagates_as_internal(channel):
+    @serve.deployment
+    def boom(x):
+        raise RuntimeError("kaboom")
+
+    serve.run(boom.bind(), name="boom", route_prefix=None)
+    with pytest.raises(grpc.RpcError) as exc:
+        _method(channel, "Predict")(b"{}", metadata=(("application", "boom"),))
+    assert exc.value.code() == grpc.StatusCode.INTERNAL
+    assert "kaboom" in exc.value.details()
