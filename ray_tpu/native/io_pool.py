@@ -181,11 +181,14 @@ class IOPool:
         return self.wait(self.submit_write(path, data))
 
     def write_files(self, items: Sequence[tuple]) -> List[int]:
-        """items: [(path, data), ...] written concurrently. On a failed
-        write the remaining jobs are still reaped (no leaked buffers)."""
-        jobs = [self.submit_write(p, d) for p, d in items]
+        """items: [(path, data), ...] written concurrently. On any failure
+        (a bad submit OR a failed write) every in-flight job is still
+        reaped — no leaked buffers or native job slots."""
+        jobs: List[int] = []
         out, done = [], 0
         try:
+            for p, d in items:
+                jobs.append(self.submit_write(p, d))
             for jid in jobs:
                 done += 1
                 out.append(self.wait(jid))

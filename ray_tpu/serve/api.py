@@ -21,6 +21,7 @@ _state_lock = threading.RLock()
 _controller = None
 _proxy: Optional[HTTPProxy] = None
 _grpc_proxy = None
+_apps: Dict[str, DeploymentHandle] = {}  # app name -> ingress handle
 
 
 def start(
@@ -29,10 +30,12 @@ def start(
     http_port: int = 0,
     request_timeout_s: float = 30.0,
     grpc_port: Optional[int] = None,
+    grpc_allow_pickle: bool = False,
 ):
     """Start the Serve instance (controller + HTTP proxy; pass ``grpc_port``
     — 0 for an ephemeral port — to also open the gRPC ingress, parity with
-    the reference's gRPCOptions)."""
+    the reference's gRPCOptions). ``grpc_allow_pickle`` enables the pickle
+    payload codec — trusted networks only (pickle executes client bytes)."""
     global _controller, _proxy, _grpc_proxy
     with _state_lock:
         if _controller is None:
@@ -43,7 +46,11 @@ def start(
         if _grpc_proxy is None and grpc_port is not None:
             from ray_tpu.serve.grpc_proxy import GRPCProxy
 
-            _grpc_proxy = GRPCProxy(http_host, grpc_port, request_timeout_s)
+            _grpc_proxy = GRPCProxy(
+                http_host, grpc_port, request_timeout_s, allow_pickle=grpc_allow_pickle
+            )
+            for app_name, handle in _apps.items():  # apps deployed pre-start
+                _grpc_proxy.add_app(app_name, handle)
     return _controller
 
 
@@ -70,6 +77,7 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         ray_tpu.get(controller.set_ingress.remote(route_prefix, app.deployment.name))
         if _proxy is not None:
             _proxy.add_route(route_prefix, ingress)
+    _apps[name] = ingress
     if _grpc_proxy is not None:
         _grpc_proxy.add_app(name, ingress)
     return ingress
@@ -102,8 +110,11 @@ def status() -> Dict[str, Any]:
 def delete(name: str) -> None:
     controller = _require_started()
     ray_tpu.get(controller.delete_deployment.remote(name))
-    # drop proxy routes whose ingress was this deployment — a stale handle
-    # would surface as ActorDiedError on the next request
+    # drop app registrations / proxy routes whose ingress was this
+    # deployment — a stale handle would surface as ActorDiedError next call
+    for app, handle in list(_apps.items()):
+        if getattr(handle, "deployment_name", None) == name:
+            del _apps[app]
     if _grpc_proxy is not None:
         for app, handle in list(_grpc_proxy.apps.items()):
             if getattr(handle, "deployment_name", None) == name:
@@ -126,6 +137,7 @@ def grpc_address() -> Optional[str]:
 def shutdown() -> None:
     global _controller, _proxy, _grpc_proxy
     with _state_lock:
+        _apps.clear()
         if _grpc_proxy is not None:
             _grpc_proxy.shutdown()
             _grpc_proxy = None

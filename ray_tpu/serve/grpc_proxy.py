@@ -29,12 +29,23 @@ _SERVICE = "ray_tpu.serve.Serve"
 
 
 class GRPCProxy:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, request_timeout_s: float = 30.0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 30.0,
+        *,
+        allow_pickle: bool = False,
+    ):
         import grpc
 
         self._grpc = grpc
         self.host = host
         self.request_timeout_s = request_timeout_s
+        # pickle deserializes CLIENT-CONTROLLED bytes => arbitrary code
+        # execution; only enable on a trusted network (opt-in, like the
+        # runtime's own worker channel which assumes a trusted cluster)
+        self.allow_pickle = allow_pickle
         self.apps: Dict[str, DeploymentHandle] = {}
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         handler = grpc.method_handlers_generic_handler(
@@ -52,6 +63,15 @@ class GRPCProxy:
     # -- handlers (bytes in / bytes out) ------------------------------------
     def _predict(self, request: bytes, context) -> bytes:
         md = {k: v for k, v in (context.invocation_metadata() or ())}
+        codec = md.get("payload-codec", "json")
+        if codec == "pickle" and not self.allow_pickle:
+            # security gate FIRST: never unpickle client bytes un-opted-in,
+            # regardless of whether the target app exists
+            context.abort(
+                self._grpc.StatusCode.INVALID_ARGUMENT,
+                "pickle codec disabled; start the proxy with allow_pickle=True "
+                "(serve.start(grpc_allow_pickle=True)) on trusted networks only",
+            )
         app = md.get("application", "default")
         handle = self.apps.get(app)
         if handle is None:
@@ -59,7 +79,6 @@ class GRPCProxy:
                 self._grpc.StatusCode.NOT_FOUND,
                 f"no application {app!r} (have: {sorted(self.apps)})",
             )
-        codec = md.get("payload-codec", "json")
         try:
             if codec == "pickle":
                 payload = pickle.loads(request) if request else None

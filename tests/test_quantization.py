@@ -1,0 +1,83 @@
+"""Quantization op tests: absmax round-trip accuracy, the Pallas int8
+matmul vs f32 reference (interpret mode), ragged shapes, pytree helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.quantization import (
+    NO_SCALE,
+    dequantize_int8,
+    dequantize_tree,
+    int8_matmul,
+    quantize_int8,
+    quantize_tree,
+)
+
+
+def test_quantize_roundtrip_accuracy():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((128, 64)), jnp.float32)
+    w_q, scales = quantize_int8(w, axis=0)
+    assert w_q.dtype == jnp.int8
+    assert scales.shape == (1, 64)
+    w_back = dequantize_int8(w_q, scales)
+    # absmax int8: error bounded by scale/2 per element
+    err = np.abs(np.asarray(w - w_back))
+    bound = np.asarray(scales)[0] / 2 + 1e-7
+    assert (err <= bound[None, :]).all()
+
+
+def test_zero_column_safe():
+    w = jnp.zeros((16, 4), jnp.float32)
+    w_q, scales = quantize_int8(w, axis=0)
+    assert np.isfinite(np.asarray(scales)).all()
+    assert (np.asarray(dequantize_int8(w_q, scales)) == 0).all()
+
+
+@pytest.mark.parametrize("shape", [(64, 128, 96), (100, 300, 50)])  # ragged too
+def test_int8_matmul_matches_reference(shape):
+    M, K, N = shape
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+    w_q, scales = quantize_int8(w, axis=0)
+    out = int8_matmul(x, w_q, scales, block_m=32, block_n=64, block_k=32)
+    ref = x @ dequantize_int8(w_q, scales)  # same quantized weights
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-3)
+    # and close to the UNQUANTIZED result within quantization error
+    full = np.asarray(x @ w)
+    rel = np.abs(np.asarray(out) - full) / (np.abs(full) + 1.0)
+    assert np.median(rel) < 0.02
+
+
+def test_bf16_activations():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((32, 64)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((64, 48)), jnp.float32)
+    w_q, scales = quantize_int8(w, axis=0)
+    out = int8_matmul(x, w_q, scales)
+    assert out.dtype == jnp.bfloat16
+    ref = x.astype(jnp.float32) @ dequantize_int8(w_q, scales)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-1
+    )
+
+
+def test_quantize_tree_roundtrip():
+    rng = np.random.default_rng(3)
+    params = {
+        "big": jnp.asarray(rng.standard_normal((128, 64)), jnp.float32),
+        "small": jnp.asarray(rng.standard_normal((4,)), jnp.float32),  # kept
+        "nested": {"w": jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)},
+    }
+    wq, sc = quantize_tree(params, min_size=1024)
+    assert wq["big"].dtype == jnp.int8
+    assert wq["nested"]["w"].dtype == jnp.int8
+    assert wq["small"].dtype == jnp.float32  # too small: untouched
+    assert sc["small"] is NO_SCALE
+    back = dequantize_tree(wq, sc)
+    assert (np.asarray(back["small"]) == np.asarray(params["small"])).all()
+    err = np.abs(np.asarray(back["big"] - params["big"]))
+    assert err.max() < np.abs(np.asarray(params["big"])).max() / 100

@@ -17,7 +17,7 @@ grpc = pytest.importorskip("grpc")
 @pytest.fixture(scope="module", autouse=True)
 def _serve():
     ray_tpu.init(num_cpus=8)
-    serve.start(http_port=0, grpc_port=0)
+    serve.start(http_port=0, grpc_port=0, grpc_allow_pickle=True)
     yield
     serve.shutdown()
     ray_tpu.shutdown()
@@ -96,3 +96,44 @@ def test_replica_error_propagates_as_internal(channel):
         _method(channel, "Predict")(b"{}", metadata=(("application", "boom"),))
     assert exc.value.code() == grpc.StatusCode.INTERNAL
     assert "kaboom" in exc.value.details()
+
+
+def test_pickle_codec_requires_opt_in():
+    """A proxy started WITHOUT allow_pickle rejects pickle payloads."""
+    from ray_tpu.serve.grpc_proxy import GRPCProxy
+    from ray_tpu.serve.router import DeploymentHandle
+
+    proxy = GRPCProxy(port=0)  # default: pickle off
+    try:
+        ch = grpc.insecure_channel(proxy.address)
+        with pytest.raises(grpc.RpcError) as exc:
+            ch.unary_unary("/ray_tpu.serve.Serve/Predict")(
+                pickle.dumps({"x": 1}),
+                metadata=(("application", "a"), ("payload-codec", "pickle")),
+            )
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        ch.close()
+    finally:
+        proxy.shutdown()
+
+
+def test_apps_deployed_before_grpc_start_are_served():
+    """run() before the gRPC proxy exists, then a late start(grpc_port=...)
+    must backfill the app registry."""
+    import ray_tpu as rt2
+
+    serve.shutdown()
+    serve.start(http_port=0)  # no gRPC yet
+
+    @serve.deployment
+    def early(x):
+        return {"ok": x}
+
+    serve.run(early.bind(), name="early_app", route_prefix=None)
+    serve.start(http_port=0, grpc_port=0)  # late gRPC start
+    ch = grpc.insecure_channel(serve.grpc_address())
+    resp = ch.unary_unary("/ray_tpu.serve.Serve/Predict")(
+        json.dumps(5).encode(), metadata=(("application", "early_app"),)
+    )
+    assert json.loads(resp) == {"ok": 5}
+    ch.close()
