@@ -62,6 +62,7 @@ def forward_with_cache(
     positions: jax.Array,  # [B, T] int32 absolute positions (contiguous per row)
     *,
     use_decode_kernel: Optional[bool] = None,
+    layer_scales: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """One cached forward pass. Writes this call's K/V into the cache at
     ``positions`` and attends over everything up to them. Returns
@@ -69,7 +70,13 @@ def forward_with_cache(
 
     ``use_decode_kernel``: route single-token steps through the Pallas
     decode-attention kernel (``ray_tpu.ops.decode_attention``); default
-    auto — on for TPU, off elsewhere (the plain-XLA grouped einsum)."""
+    auto — on for TPU, off elsewhere (the plain-XLA grouped einsum).
+
+    ``layer_scales``: dequantization scales matching ``params['layers']``
+    (int8 weight-only serving). They ride the layer scan as xs, so each
+    layer dequantizes IN the scan body — only one layer's weights ever
+    exist at full precision, instead of a whole-tree f32 copy per step.
+    Unquantized leaves carry broadcast-ones scales."""
     B, T = tokens.shape
     S = cache["k"].shape[3]
     h_heads, hkv = cfg.n_heads, cfg.kv_heads
@@ -85,7 +92,14 @@ def forward_with_cache(
     decode_kernel = use_decode_kernel and T == 1
 
     def layer_fn(x, layer_kc_vc):
-        layer, kc, vc = layer_kc_vc
+        if layer_scales is not None:
+            layer_q, lsc, kc, vc = layer_kc_vc
+            layer = {
+                k: (layer_q[k].astype(jnp.float32) * lsc[k]).astype(cfg.param_dtype)
+                for k in layer_q
+            }
+        else:
+            layer, kc, vc = layer_kc_vc
         h = _rms_norm(x, layer["attn_norm"])
         q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
         k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
@@ -113,7 +127,11 @@ def forward_with_cache(
         ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)
         return x + ffn, (kc, vc)
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    if layer_scales is not None:
+        xs = (params["layers"], layer_scales, cache["k"], cache["v"])
+    else:
+        xs = (params["layers"], cache["k"], cache["v"])
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, xs)
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
     return logits.astype(jnp.float32), {"k": ks, "v": vs}
@@ -125,12 +143,13 @@ def prefill(
     cache: KVCache,
     tokens: jax.Array,          # [B, Tp] right-padded prompts
     lengths: jax.Array,         # [B] true prompt lengths (>= 1)
+    **fw_kwargs,
 ) -> Tuple[jax.Array, KVCache]:
     """Fill the cache from position 0 and return the last real token's
     logits per row: (logits [B, V], cache)."""
     B, Tp = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(Tp)[None, :], (B, Tp))
-    logits, cache = forward_with_cache(cfg, params, cache, tokens, positions)
+    logits, cache = forward_with_cache(cfg, params, cache, tokens, positions, **fw_kwargs)
     last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
 
@@ -141,9 +160,12 @@ def decode_step(
     cache: KVCache,
     tokens: jax.Array,     # [B] the previously sampled token per row
     positions: jax.Array,  # [B] the absolute position to write it at
+    **fw_kwargs,
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step: (logits [B, V], cache)."""
-    logits, cache = forward_with_cache(cfg, params, cache, tokens[:, None], positions[:, None])
+    logits, cache = forward_with_cache(
+        cfg, params, cache, tokens[:, None], positions[:, None], **fw_kwargs
+    )
     return logits[:, 0], cache
 
 

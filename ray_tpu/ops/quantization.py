@@ -188,3 +188,34 @@ def dequantize_tree(wq: Any, scales: Any, dtype=jnp.float32) -> Any:
         return dequantize_int8(w, s, dtype)
 
     return jax.tree.map(dq, wq, scales)
+
+
+# keys of the transformer's stacked-layer LINEAR weights (ray_tpu.models.
+# transformer.init_params layout) — the bandwidth bulk worth quantizing;
+# norm gains stay exact and the embedding keeps output quality
+TRANSFORMER_LINEAR_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w1", "w2", "w3", "we1", "we2", "we3", "router"}
+)
+
+
+def quantize_layers(
+    layers: Dict[str, jax.Array],
+    *,
+    keys=TRANSFORMER_LINEAR_KEYS,
+    min_size: int = 4096,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Quantize a stacked-layer dict ([L, ...] leaves) for in-scan dequant.
+
+    Returns (layers with int8 leaves where quantized, DENSE scales dict —
+    broadcast-ones where unquantized — shaped to ride a lax.scan as xs:
+    every scale has leading dim L). Quantization axis is 1 (the first
+    per-layer axis); scales varying along a contraction axis are fine
+    because the consumer dequantizes elementwise before its matmul."""
+    q, sc = {}, {}
+    for k, w in layers.items():
+        if k in keys and w.size >= min_size and jnp.issubdtype(w.dtype, jnp.floating):
+            q[k], sc[k] = quantize_int8(w, axis=1)
+        else:
+            q[k] = w
+            sc[k] = jnp.ones((w.shape[0],) + (1,) * (w.ndim - 1), jnp.float32)
+    return q, sc

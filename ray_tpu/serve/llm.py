@@ -82,13 +82,29 @@ class LLMEngine:
         max_seq_len: int = 512,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        quantize: bool = False,
+        quantize_min_size: int = 4096,
     ):
         self.cfg = cfg
-        self.params = params
         self.B = max_batch_size
         self.S = max_seq_len
         self.top_k = top_k
         self.top_p = top_p
+        self.quantized = quantize
+        if quantize:
+            # weight-only int8 on the stacked layer LINEAR weights (norm
+            # gains and the embedding stay full precision). Scales ride the
+            # layer scan as xs, so dequant happens per layer IN the scan
+            # body — only one layer is ever wide, never a whole-tree copy.
+            from ray_tpu.ops.quantization import quantize_layers
+
+            q_layers, self._layer_scales = quantize_layers(
+                params["layers"], min_size=quantize_min_size
+            )
+            self.params = {**params, "layers": q_layers}
+        else:
+            self._layer_scales = None
+            self.params = params
 
         self._queue: List[GenRequest] = []
         self._lock = threading.Lock()
@@ -106,13 +122,14 @@ class LLMEngine:
         self._key = jax.random.key(np.random.randint(0, 2**31 - 1))
 
         cfg_ = cfg
+        layer_scales = self._layer_scales
 
         # the cache is donated through decode/insert: the engine holds the
         # only reference and reassigns, so XLA updates the [L,B,Hkv,S,Dh]
         # buffers in place instead of copying them every token
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode(params, cache, toks, pos):
-            return decode_step(cfg_, params, cache, toks, pos)
+            return decode_step(cfg_, params, cache, toks, pos, layer_scales=layer_scales)
 
         @jax.jit
         def _prefill_one(params, tokens, length):
@@ -121,7 +138,9 @@ class LLMEngine:
             cache row)."""
             row = init_cache(cfg_, 1, self.S)
             positions = jnp.arange(tokens.shape[1])[None, :]
-            logits, row = forward_with_cache(cfg_, params, row, tokens, positions)
+            logits, row = forward_with_cache(
+                cfg_, params, row, tokens, positions, layer_scales=layer_scales
+            )
             return jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0, keepdims=False), row
 
         @functools.partial(jax.jit, donate_argnums=(0,))
@@ -289,6 +308,7 @@ class LLMServer:
         max_seq_len: int = 512,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        quantize: bool = False,
     ):
         cfg, params = model_factory()
         self.engine = LLMEngine(
@@ -298,6 +318,7 @@ class LLMServer:
             max_seq_len=max_seq_len,
             top_k=top_k,
             top_p=top_p,
+            quantize=quantize,
         )
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
