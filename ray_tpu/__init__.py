@@ -31,6 +31,7 @@ from ray_tpu.api import (
     timeline,
     wait,
 )
+from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu import dag
 from ray_tpu.exceptions import (
@@ -51,6 +52,7 @@ __all__ = [
     "ActorHandle",
     "ActorMethod",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "available_resources",
     "cancel",

@@ -282,6 +282,8 @@ class RemoteFunction:
             scheduling_strategy=self._scheduling_strategy,
             runtime_env=self._runtime_env,
         )
+        if self._num_returns == "streaming":
+            return refs  # a single ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def options(self, **new_options) -> "RemoteFunction":

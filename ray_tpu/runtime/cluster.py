@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.sync import when_all
 from ray_tpu.core.task_manager import TaskManager
 from ray_tpu.exceptions import (
@@ -141,6 +142,7 @@ class Cluster:
         self.head_node: Optional[Node] = None
         self._actor_queues: Dict[ActorID, _ActorQueue] = {}
         self._actor_lock = threading.RLock()
+        self._streams: Dict[bytes, Any] = {}  # task_id -> ObjectRefGenerator
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
         self.core_worker = None       # set by worker.init
@@ -215,7 +217,11 @@ class Cluster:
         # resubmit this node's pending tasks (system failure → consumes retry)
         for spec in self.task_manager.pending_specs():
             if spec.owner_node == node_id and spec.actor_id is None:
-                if self.task_manager.should_retry(spec, is_system_error=True):
+                # streaming tasks never resubmit: already-yielded items
+                # can't be un-delivered, so a replay would duplicate them
+                if spec.num_returns != "streaming" and self.task_manager.should_retry(
+                    spec, is_system_error=True
+                ):
                     self.submit(spec)
                 else:
                     self.task_manager.mark_failed(spec)
@@ -364,6 +370,13 @@ class Cluster:
     # owner-side completion
     # ------------------------------------------------------------------
     def on_task_finished(self, node: Node, spec: TaskSpec, result: Any, error: Optional[BaseException]) -> None:
+        if spec.num_returns == "streaming":
+            # only reachable for pre-execution failures (cancellation, a
+            # dispatch-time error): surface it as the stream's only item so
+            # the consumer's iteration raises instead of hanging. No retry —
+            # items already observed by the consumer can't be un-yielded.
+            self.on_stream_done(node, spec, len(spec.return_ids), error)
+            return
         if node.dead:
             # The node died. Normal tasks were resubmitted by kill_node (the
             # retry owns the returns), so straggler completions are dropped.
@@ -438,10 +451,53 @@ class Cluster:
             )
         counter.inc(tags={"state": state})
 
+    # ------------------------------------------------------------------
+    # streaming generators (reference: TryReadObjectRefStream,
+    # core_worker.h:389 — item objects commit as they are produced)
+    # ------------------------------------------------------------------
+    def register_stream(self, spec: TaskSpec, gen) -> None:
+        self._streams[spec.task_id.binary()] = gen
+
+    def on_stream_item(self, node: Node, spec: TaskSpec, index: int, value: Any, is_error: bool = False) -> None:
+        oid = ObjectID.for_task_return(spec.task_id, index + 1)
+        if self.core_worker is not None:
+            self.core_worker.ref_counter.add_owned_object(oid)
+        store_node = self.head_node if node.dead else node
+        store_node.store.put(oid, value, is_error=is_error)
+        self.directory.add_location(oid, store_node.node_id)
+        spec.return_ids.append(oid)
+        gen = self._streams.get(spec.task_id.binary())
+        if gen is not None:
+            gen._push(ObjectRef(oid))
+
+    def on_stream_done(self, node: Node, spec: TaskSpec, index: int, error: Optional[BaseException]) -> None:
+        if error is not None:
+            # reference semantics: the failure IS the next item — iteration
+            # surfaces an errored ref, then the stream ends
+            self.on_stream_item(node, spec, index, error, is_error=True)
+            self.task_manager.mark_failed(spec)
+            self._record_task_event(spec, node, "FAILED")
+        else:
+            self.task_manager.mark_completed(spec)
+            self._record_task_event(spec, node, "FINISHED")
+        gen = self._streams.pop(spec.task_id.binary(), None)
+        if gen is not None:
+            gen._finish()
+        self._after_commit(spec)
+
     def _commit_error_everywhere(self, spec: TaskSpec, error: BaseException) -> None:
         node = self.nodes.get(spec.owner_node)
         if node is None or node.dead:
             node = self.head_node
+        if spec.num_returns == "streaming":
+            # close the stream with the error as its next item — otherwise a
+            # consumer blocked in ObjectRefGenerator.__next__ hangs forever
+            # (reachable via kill_node and infeasible-task expiry)
+            self.on_stream_item(node, spec, len(spec.return_ids), error, is_error=True)
+            gen = self._streams.pop(spec.task_id.binary(), None)
+            if gen is not None:
+                gen._finish()
+            return
         for oid in spec.return_ids:
             node.store.put(oid, error, is_error=True)
             self.directory.add_location(oid, node.node_id)

@@ -229,6 +229,13 @@ class Node:
 
             self._commit(spec, None, TaskCancelledError(spec.task_id))
             return
+        if spec.num_returns == "streaming":
+            # streaming generators run on the in-process executor: items
+            # commit through direct calls into the owner's stream, which a
+            # worker process can't make (the reference streams item reports
+            # over its RPC channel; our process protocol is one-shot)
+            self.executor.submit(self._run_streaming, spec)
+            return
         mode = self._execution_mode(spec)
         if mode == "process":
             self._dispatch_process(spec)
@@ -345,6 +352,28 @@ class Node:
         except BaseException as exc:  # noqa: BLE001
             error = exc if isinstance(exc, RayTaskError) else RayTaskError.from_exception(spec.name, exc)
             self._commit(spec, None, error)
+
+    def _run_streaming(self, spec: TaskSpec) -> None:
+        """Execute a streaming-generator task: each yielded item commits as
+        its own return object immediately; an exception commits as the next
+        (errored) item and ends the stream (reference semantics)."""
+        from ray_tpu.runtime.context import task_context
+
+        error: Optional[BaseException] = None
+        index = 0
+        try:
+            args, kwargs = self._resolve_args(spec)
+            token = task_context.push(spec.task_id, self.node_id)
+            try:
+                for item in spec.func(*args, **kwargs):
+                    self.cluster.on_stream_item(self, spec, index, item)
+                    index += 1
+            finally:
+                task_context.pop(token)
+        except BaseException as exc:  # noqa: BLE001
+            error = exc if isinstance(exc, RayTaskError) else RayTaskError.from_exception(spec.name, exc)
+        self.scheduler.on_task_done(spec)
+        self.cluster.on_stream_done(self, spec, index, error)
 
     _EMPTY_ARGS_BLOB = pickle.dumps(((), {}), protocol=5)
 

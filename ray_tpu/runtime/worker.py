@@ -81,7 +81,11 @@ class CoreWorker:
     ) -> List[ObjectRef]:
         cfg = get_config()
         task_id = TaskID.for_normal_task(self.job_id)
-        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        if streaming:
+            return_ids = []  # item refs materialize as the generator yields
+        else:
+            return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         deps = _collect_deps(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
@@ -103,6 +107,14 @@ class CoreWorker:
             self.ref_counter.add_owned_object(oid)
         self.ref_counter.add_submitted_task_references([r.id() for r in deps])
         spec.submit_time = time.time()
+        if streaming:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            gen = ObjectRefGenerator(task_id)
+            self.cluster.register_stream(spec, gen)
+            self.cluster.task_manager.add_pending(spec)
+            self.cluster.submit(spec)
+            return gen
         self.cluster.task_manager.add_pending(spec)
         self.cluster.submit(spec)
         return [ObjectRef(oid) for oid in return_ids]

@@ -46,6 +46,9 @@ from ray_tpu.models.generation import (
 from ray_tpu.models.transformer import TransformerConfig
 
 
+_STREAM_END = object()
+
+
 @dataclass
 class GenRequest:
     prompt: List[int]
@@ -53,9 +56,14 @@ class GenRequest:
     temperature: float = 0.0
     eos_id: Optional[int] = None
     future: Future = field(default_factory=Future)
+    stream_queue: Optional[Any] = None  # queue.Queue when streaming
     # filled by the engine
     slot: int = -1
     generated: List[int] = field(default_factory=list)
+
+    def emit(self, tok: int) -> None:
+        if self.stream_queue is not None:
+            self.stream_queue.put(tok)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -178,6 +186,7 @@ class LLMEngine:
         max_tokens: int = 32,
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
+        _stream_queue=None,
     ) -> Future:
         """Enqueue one request; resolves to the generated token-id list."""
         if self._stop:
@@ -191,7 +200,7 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) exceeds "
                 f"engine max_seq_len {self.S}"
             )
-        req = GenRequest(list(prompt), max_tokens, temperature, eos_id)
+        req = GenRequest(list(prompt), max_tokens, temperature, eos_id, stream_queue=_stream_queue)
         with self._lock:
             self._queue.append(req)
         self._wake.set()
@@ -199,6 +208,35 @@ class LLMEngine:
 
     def generate(self, prompt: List[int], **kw) -> List[int]:
         return self.submit(prompt, **kw).result()
+
+    def submit_stream(self, prompt: List[int], *, token_timeout_s: float = 120.0, **kw):
+        """Per-token streaming: returns an iterator yielding token ids as
+        they are sampled (the continuous-batching analog of the runtime's
+        ObjectRefGenerator). Validation errors raise HERE, not mid-stream.
+        The iterator ends at eos/max_tokens; engine errors re-raise at the
+        end of iteration; a stalled engine raises after ``token_timeout_s``
+        without a token (so consumers never block forever)."""
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        fut = self.submit(prompt, _stream_queue=q, **kw)
+
+        def _iter():
+            while True:
+                try:
+                    tok = q.get(timeout=token_timeout_s)
+                except _queue.Empty:
+                    raise RuntimeError(
+                        f"no token for {token_timeout_s}s — engine stalled or overloaded"
+                    ) from None
+                if tok is _STREAM_END:
+                    exc = fut.exception() if fut.done() else None
+                    if exc is not None:
+                        raise exc
+                    return
+                yield tok
+
+        return _iter()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -218,6 +256,8 @@ class LLMEngine:
             self._queue.clear()
         for r in pending:
             r.future.set_exception(RuntimeError("LLMEngine shut down"))
+            if r.stream_queue is not None:
+                r.stream_queue.put(_STREAM_END)
 
     # -- engine loop --------------------------------------------------------
     def _admit(self) -> None:
@@ -243,6 +283,7 @@ class LLMEngine:
             )
             req.slot = slot
             req.generated = [tok0]
+            req.emit(tok0)
             with self._lock:
                 self._slots[slot] = req
                 self._active[slot] = True
@@ -261,6 +302,8 @@ class LLMEngine:
                 self._active[req.slot] = False
                 self._slots[req.slot] = None
             req.future.set_result(req.generated)
+            if req.stream_queue is not None:
+                req.stream_queue.put(_STREAM_END)
         return done
 
     def _step(self) -> None:
@@ -275,18 +318,36 @@ class LLMEngine:
                 continue
             tok = int(sampled[i])
             req.generated.append(tok)
+            req.emit(tok)
             self._pos[i] += 1
             self._last_tok[i] = tok
             self._maybe_finish(req, tok)
 
+    def _fail_inflight(self, error: BaseException) -> None:
+        """Fail every queued and in-slot request (loop-crash recovery):
+        futures resolve with the error and stream iterators terminate."""
+        with self._lock:
+            victims = [r for r in self._queue] + [r for r in self._slots if r is not None]
+            self._queue.clear()
+            self._slots = [None] * self.B
+            self._active[:] = False
+        for r in victims:
+            if not r.future.done():
+                r.future.set_exception(error)
+            if r.stream_queue is not None:
+                r.stream_queue.put(_STREAM_END)
+
     def _loop(self) -> None:
         while not self._stop:
-            self._admit()
-            if self._active.any():
-                self._step()
-            else:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
+            try:
+                self._admit()
+                if self._active.any():
+                    self._step()
+                else:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+            except BaseException as exc:  # noqa: BLE001 — a dead loop hangs every caller
+                self._fail_inflight(RuntimeError(f"LLMEngine step failed: {exc!r}"))
 
 
 class LLMServer:
@@ -321,15 +382,30 @@ class LLMServer:
             quantize=quantize,
         )
 
-    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def __call__(self, request: Dict[str, Any]):
         prompt = request["prompt"]
-        t0 = time.perf_counter()
-        out = self.engine.generate(
-            prompt,
+        kw = dict(
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)),
             eos_id=request.get("eos_id"),
         )
+        if request.get("stream"):
+            # submit EAGERLY so validation errors surface as a normal error
+            # response, not mid-stream corruption after a 200 was sent;
+            # the returned generator of per-token events reaches the proxy
+            # by reference (in-proc replicas) and renders as SSE
+            stream = self.engine.submit_stream(prompt, **kw)
+
+            def events():
+                n = 0
+                for tok in stream:
+                    n += 1
+                    yield {"token": tok}
+                yield {"done": True, "num_generated": n}
+
+            return events()
+        t0 = time.perf_counter()
+        out = self.engine.generate(prompt, **kw)
         return {
             "tokens": out,
             "num_generated": len(out),

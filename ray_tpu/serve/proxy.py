@@ -45,6 +45,29 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
                 except json.JSONDecodeError:
                     payload = body.decode("utf-8", "replace")
             result = handle.remote(payload).result(timeout=self.proxy.request_timeout_s)
+            if _is_stream(result):
+                # generator result (in-proc replica) -> server-sent events,
+                # one `data:` frame per item, flushed as produced. Once the
+                # 200 + headers are out, a mid-stream failure must NOT fall
+                # through to send_response(500) (that writes a second status
+                # line into the open body) — emit an error event and close.
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    for item in result:
+                        frame = json.dumps(item, default=_jsonify)
+                        self.wfile.write(f"data: {frame}\n\n".encode())
+                        self.wfile.flush()
+                except Exception as exc:  # noqa: BLE001
+                    try:
+                        err = json.dumps({"error": str(exc)})
+                        self.wfile.write(f"data: {err}\n\n".encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass  # client already gone
+                return
             data = json.dumps(result, default=_jsonify).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -61,6 +84,11 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         length = int(self.headers.get("Content-Length", 0))
         self._handle(self.rfile.read(length) if length else None)
+
+
+def _is_stream(result) -> bool:
+    """Iterator/generator results stream as SSE; lists/dicts/strs do not."""
+    return hasattr(result, "__next__")
 
 
 def _jsonify(obj):

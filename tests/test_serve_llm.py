@@ -2,6 +2,7 @@
 continuous admission (mid-flight joins), slot reuse, eos/max_tokens stops,
 and the Serve deployment wrapper."""
 
+import json
 import threading
 import time
 
@@ -191,3 +192,87 @@ def test_train_then_serve_e2e():
         assert out == [4, 5, 6, 7], out  # continues the learned sequence
     finally:
         eng.shutdown()
+
+
+def test_submit_stream_tokens_arrive_incrementally(engine, params):
+    """Streaming yields the same tokens as the blocking API, and the first
+    token arrives before the request completes."""
+    prompt = [5, 6, 7]
+    ref = _reference(params, prompt, 6)
+    got = list(engine.submit_stream(prompt, max_tokens=6))
+    assert got == ref
+
+
+def test_stream_interleaves_with_blocking(engine, params):
+    it = engine.submit_stream([2, 3], max_tokens=10)
+    blocking = engine.submit([4, 5], max_tokens=4)
+    streamed = list(it)
+    assert streamed == _reference(params, [2, 3], 10)
+    assert blocking.result(timeout=120) == _reference(params, [4, 5], 4)
+
+
+def test_http_sse_streaming(params):
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer).bind(
+            lambda: (CFG, params), max_batch_size=2, max_seq_len=64
+        )
+        serve.run(app, route_prefix="/llm")
+        body = json.dumps({"prompt": [3, 1, 4], "max_tokens": 5, "stream": True}).encode()
+        req = urllib.request.Request(
+            serve.proxy_url() + "/llm", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        events = []
+        for line in resp:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(json.loads(line[6:]))
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == _reference(params, [3, 1, 4], 5)
+        assert events[-1] == {"done": True, "num_generated": 5}
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_stream_validation_error_raises_eagerly(engine):
+    """submit_stream validates BEFORE returning the iterator."""
+    with pytest.raises(ValueError):
+        engine.submit_stream(list(range(60)), max_tokens=20)
+
+
+def test_http_sse_invalid_request_gets_error_response(params):
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer).bind(
+            lambda: (CFG, params), max_batch_size=2, max_seq_len=32
+        )
+        serve.run(app, route_prefix="/llm2")
+        body = json.dumps(
+            {"prompt": [1, 2, 3], "max_tokens": 500, "stream": True}  # > max_seq_len
+        ).encode()
+        req = urllib.request.Request(
+            serve.proxy_url() + "/llm2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=60)
+        assert exc.value.code == 500  # clean error status, not a broken 200 stream
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

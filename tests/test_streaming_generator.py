@@ -1,0 +1,153 @@
+"""Streaming-generator task tests (reference: ObjectRefGenerator /
+TryReadObjectRefStream semantics): items arrive before the task finishes,
+mid-stream errors surface as errored refs, cancellation closes streams."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(autouse=True)
+def _ray():
+    rt.init(num_cpus=4)
+    yield
+    rt.shutdown()
+
+
+def test_basic_streaming():
+    @rt.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(5)
+    assert isinstance(g, rt.ObjectRefGenerator)
+    values = [rt.get(ref) for ref in g]
+    assert values == [0, 10, 20, 30, 40]
+    assert g.is_finished()
+
+
+def test_items_arrive_before_task_finishes():
+    @rt.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(1.5)
+        yield "second"
+
+    g = slow_gen.remote()
+    t0 = time.monotonic()
+    first_ref = next(g)
+    first_latency = time.monotonic() - t0
+    assert rt.get(first_ref) == "first"
+    # the first item must land well before the 1.5s sleep completes
+    assert first_latency < 1.0, f"first item took {first_latency:.2f}s"
+    assert rt.get(next(g)) == "second"
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_midstream_error_is_next_item():
+    @rt.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("stream broke")
+
+    g = bad_gen.remote()
+    assert rt.get(next(g)) == 1
+    assert rt.get(next(g)) == 2
+    err_ref = next(g)
+    with pytest.raises(rt.RayTaskError) as exc:
+        rt.get(err_ref)
+    assert "stream broke" in str(exc.value)
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_empty_generator():
+    @rt.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield
+
+    g = empty.remote()
+    assert list(g) == []
+
+
+def test_next_ready_timeout():
+    @rt.remote(num_returns="streaming")
+    def slow():
+        time.sleep(0.8)
+        yield 42
+
+    g = slow.remote()
+    assert g.next_ready(timeout=0.05) is None  # not yet
+    ref = None
+    deadline = time.monotonic() + 30
+    while ref is None and time.monotonic() < deadline:
+        ref = g.next_ready(timeout=0.5)
+    assert rt.get(ref) == 42
+
+
+def test_streaming_args_are_resolved():
+    @rt.remote
+    def make_base():
+        return 100
+
+    @rt.remote(num_returns="streaming")
+    def gen(base, n):
+        for i in range(n):
+            yield base + i
+
+    g = gen.remote(make_base.remote(), 3)
+    assert [rt.get(r) for r in g] == [100, 101, 102]
+
+
+def test_many_items():
+    @rt.remote(num_returns="streaming")
+    def lots():
+        for i in range(500):
+            yield i
+
+    g = lots.remote()
+    assert [rt.get(r) for r in g] == list(range(500))
+
+
+def test_consumer_can_lag():
+    """Producer finishes long before the consumer reads: items buffer."""
+
+    @rt.remote(num_returns="streaming")
+    def quick():
+        for i in range(10):
+            yield i
+
+    g = quick.remote()
+    time.sleep(0.5)  # let the producer finish entirely
+    assert g.num_ready() == 10
+    assert [rt.get(r) for r in g] == list(range(10))
+
+
+def test_infeasible_streaming_task_fails_stream():
+    """An unschedulable streaming task must close its stream with an error
+    (not hang the consumer forever)."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    old = cfg.infeasible_task_timeout_s
+    cfg.infeasible_task_timeout_s = 0.3
+    try:
+
+        @rt.remote(num_returns="streaming", resources={"GPU": 99})
+        def g():
+            yield 1
+
+        gen = g.remote()
+        ref = next(gen)  # the error item
+        with pytest.raises(Exception):
+            rt.get(ref)
+        with pytest.raises(StopIteration):
+            next(gen)
+    finally:
+        cfg.infeasible_task_timeout_s = old
