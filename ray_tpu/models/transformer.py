@@ -157,12 +157,37 @@ def _kv_tp_ok(cfg: TransformerConfig, mesh: Mesh, tp: str) -> bool:
     return cfg.kv_heads % n == 0
 
 
+def fit_spec(shape, spec: P, mesh: Mesh) -> P:
+    """Make a PartitionSpec legal for this array/mesh: drop mesh axes on
+    dimensions they don't divide (e.g. an odd vocab size under tp), axes
+    the mesh doesn't have, and repeated axes (a spec may name each mesh
+    axis once — e.g. MoE specs with ep folded into tp keep only the first
+    occurrence). A replicated dim beats a crash."""
+    parts = []
+    used = set()
+    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if ax is None:
+            parts.append(None)
+            continue
+        axes = tuple(a for a in ((ax,) if isinstance(ax, str) else tuple(ax))
+                     if a in mesh.shape and a not in used)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        if not axes or dim % size != 0:
+            parts.append(None)
+            continue
+        used.update(axes)
+        parts.append(axes[0] if len(axes) == 1 else axes)
+    return P(*parts)
+
+
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig, **axes):
     if "kv_tp" not in axes:
         axes["kv_tp"] = _kv_tp_ok(cfg, mesh, axes.get("tp", "tp"))
     specs = param_specs(cfg, **axes)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, fit_spec(x.shape, s, mesh))),
         params,
         specs,
         is_leaf=lambda x: isinstance(x, P),

@@ -459,6 +459,11 @@ class Cluster:
         self._streams[spec.task_id.binary()] = gen
 
     def on_stream_item(self, node: Node, spec: TaskSpec, index: int, value: Any, is_error: bool = False) -> None:
+        if spec._stream_closed:
+            # stream force-closed (node death / infeasibility) while the
+            # producer thread was still running: late items must not
+            # overwrite the committed error object or reopen the stream
+            return
         oid = ObjectID.for_task_return(spec.task_id, index + 1)
         if self.core_worker is not None:
             self.core_worker.ref_counter.add_owned_object(oid)
@@ -471,6 +476,8 @@ class Cluster:
             gen._push(ObjectRef(oid))
 
     def on_stream_done(self, node: Node, spec: TaskSpec, index: int, error: Optional[BaseException]) -> None:
+        if spec._stream_closed:
+            return  # already force-closed and marked failed
         if error is not None:
             # reference semantics: the failure IS the next item — iteration
             # surfaces an errored ref, then the stream ends
@@ -492,8 +499,10 @@ class Cluster:
         if spec.num_returns == "streaming":
             # close the stream with the error as its next item — otherwise a
             # consumer blocked in ObjectRefGenerator.__next__ hangs forever
-            # (reachable via kill_node and infeasible-task expiry)
+            # (reachable via kill_node and infeasible-task expiry). The flag
+            # makes any still-running producer's late commits no-ops.
             self.on_stream_item(node, spec, len(spec.return_ids), error, is_error=True)
+            spec._stream_closed = True
             gen = self._streams.pop(spec.task_id.binary(), None)
             if gen is not None:
                 gen._finish()

@@ -64,7 +64,7 @@ class TaskSpec:
         "retries_left", "execution", "actor_id", "scheduling_strategy",
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
-        "_oom_killed",
+        "_oom_killed", "_stream_closed",
     )
 
     def __init__(
@@ -111,6 +111,7 @@ class TaskSpec:
         self._retry_exceptions = False
         self._cancelled = False
         self._oom_killed = False
+        self._stream_closed = False
 
 
 # --------------------------------------------------------------------------

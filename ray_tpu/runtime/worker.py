@@ -167,6 +167,11 @@ class CoreWorker:
         num_returns: int = 1,
         name: str = "",
     ) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            raise ValueError(
+                "num_returns='streaming' is not supported for actor tasks "
+                "(supported for @remote functions only)"
+            )
         task_id = TaskID.for_actor_task(actor_id)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         deps = _collect_deps(args, kwargs)

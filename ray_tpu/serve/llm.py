@@ -92,6 +92,8 @@ class LLMEngine:
         top_p: Optional[float] = None,
         quantize: bool = False,
         quantize_min_size: int = 4096,
+        mesh: Optional[Any] = None,
+        tp: str = "tp",
     ):
         self.cfg = cfg
         self.B = max_batch_size
@@ -99,6 +101,25 @@ class LLMEngine:
         self.top_k = top_k
         self.top_p = top_p
         self.quantized = quantize
+        self.mesh = mesh
+        self._kv_spec = None
+        if mesh is not None:
+            # tensor-parallel serving: params shard per the Megatron layout
+            # (ray_tpu.models.transformer.param_specs), the KV cache's head
+            # axis over tp when divisible; GSPMD partitions the einsum
+            # attention, so decode collectives ride ICI. The Pallas decode
+            # kernel is bypassed (it would need a shard_map wrapper).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.models.transformer import _kv_tp_ok, shard_params
+
+            if quantize:
+                raise ValueError("quantize=True with mesh is not supported yet")
+            if tp not in mesh.axis_names:
+                raise ValueError(f"mesh has no {tp!r} axis: {mesh.axis_names}")
+            params = shard_params(params, mesh, cfg, tp=tp, ep=tp)
+            kv_ax = tp if _kv_tp_ok(cfg, mesh, tp) else None
+            self._kv_spec = NamedSharding(mesh, P(None, None, kv_ax, None, None))
         if quantize:
             # weight-only int8 on the stacked layer LINEAR weights (norm
             # gains and the embedding stay full precision). Scales ride the
@@ -126,18 +147,25 @@ class LLMEngine:
         self._temps = np.zeros(self.B, np.float32)
         self._active = np.zeros(self.B, bool)
 
-        self._cache = init_cache(cfg, self.B, self.S)
+        self._reset_cache()
         self._key = jax.random.key(np.random.randint(0, 2**31 - 1))
 
         cfg_ = cfg
         layer_scales = self._layer_scales
+        kv_spec = self._kv_spec
+        # under a mesh the einsum path partitions via GSPMD; the Pallas
+        # kernel path stays for the single-device engine
+        use_kernel = None if mesh is None else False
 
         # the cache is donated through decode/insert: the engine holds the
         # only reference and reassigns, so XLA updates the [L,B,Hkv,S,Dh]
         # buffers in place instead of copying them every token
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode(params, cache, toks, pos):
-            return decode_step(cfg_, params, cache, toks, pos, layer_scales=layer_scales)
+            return decode_step(
+                cfg_, params, cache, toks, pos,
+                layer_scales=layer_scales, use_decode_kernel=use_kernel,
+            )
 
         @jax.jit
         def _prefill_one(params, tokens, length):
@@ -145,9 +173,12 @@ class LLMEngine:
             prompts in a bucket share ONE compile. Returns (logits [V],
             cache row)."""
             row = init_cache(cfg_, 1, self.S)
+            if kv_spec is not None:
+                row = {k: jax.lax.with_sharding_constraint(v, kv_spec) for k, v in row.items()}
             positions = jnp.arange(tokens.shape[1])[None, :]
             logits, row = forward_with_cache(
-                cfg_, params, row, tokens, positions, layer_scales=layer_scales
+                cfg_, params, row, tokens, positions,
+                layer_scales=layer_scales, use_decode_kernel=use_kernel,
             )
             return jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0, keepdims=False), row
 
@@ -268,19 +299,33 @@ class LLMEngine:
                     return
                 req = self._queue.pop(0)
                 slot = free[0]
-            tp = len(req.prompt)
-            bucket = min(_bucket(tp), self.S)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :tp] = req.prompt
-            logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
-            self._cache = self._insert(self._cache, row, slot)
-            # first output token comes straight from the prefill logits
-            self._key, sub = jax.random.split(self._key)
-            tok0 = int(
-                self._sample(
-                    sub, logits[None, :], jnp.asarray([req.temperature], jnp.float32)
-                )[0]
-            )
+            try:
+                tp = len(req.prompt)
+                bucket = min(_bucket(tp), self.S)
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :tp] = req.prompt
+                logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+                self._cache = self._insert(self._cache, row, slot)
+                # first output token comes straight from the prefill logits
+                self._key, sub = jax.random.split(self._key)
+                tok0 = int(
+                    self._sample(
+                        sub, logits[None, :], jnp.asarray([req.temperature], jnp.float32)
+                    )[0]
+                )
+            except BaseException as exc:  # noqa: BLE001
+                # the popped request is in neither queue nor slots — fail it
+                # HERE or its caller hangs forever
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError(f"prefill failed: {exc!r}"))
+                if req.stream_queue is not None:
+                    req.stream_queue.put(_STREAM_END)
+                if self._cache["k"].is_deleted():
+                    # _insert consumed its donation then failed: the shared
+                    # cache is gone, taking every in-flight slot with it
+                    self._fail_inflight(RuntimeError(f"cache lost in failed insert: {exc!r}"))
+                    self._reset_cache()
+                continue
             req.slot = slot
             req.generated = [tok0]
             req.emit(tok0)
@@ -323,6 +368,14 @@ class LLMEngine:
             self._last_tok[i] = tok
             self._maybe_finish(req, tok)
 
+    def _reset_cache(self) -> None:
+        """(Re)allocate the decode cache — also the recovery path after a
+        failed donated step leaves the old buffers deleted."""
+        cache = init_cache(self.cfg, self.B, self.S)
+        if self._kv_spec is not None:
+            cache = {k: jax.device_put(v, self._kv_spec) for k, v in cache.items()}
+        self._cache = cache
+
     def _fail_inflight(self, error: BaseException) -> None:
         """Fail every queued and in-slot request (loop-crash recovery):
         futures resolve with the error and stream iterators terminate."""
@@ -348,6 +401,9 @@ class LLMEngine:
                     self._wake.clear()
             except BaseException as exc:  # noqa: BLE001 — a dead loop hangs every caller
                 self._fail_inflight(RuntimeError(f"LLMEngine step failed: {exc!r}"))
+                # a failed donated step leaves self._cache pointing at
+                # deleted buffers; reallocate so the engine keeps serving
+                self._reset_cache()
 
 
 class LLMServer:

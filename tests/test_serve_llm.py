@@ -276,3 +276,71 @@ def test_http_sse_invalid_request_gets_error_response(params):
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_mesh_sharded_engine(params):
+    """Tensor-parallel engine over the virtual device mesh: params shard
+    per the Megatron layout, cache heads over tp, outputs match the
+    single-device engine."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual devices")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))  # kv_heads=2 -> tp=2 shards kv
+    eng_m = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64, mesh=mesh)
+    eng_s = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64)
+    try:
+        # params really sharded over tp
+        wq_sh = eng_m.params["layers"]["wq"].sharding
+        assert wq_sh.spec[2] == "tp"
+        prompts = [[3, 14, 15], [7, 8]]
+        m_out = [eng_m.generate(p, max_tokens=6) for p in prompts]
+        s_out = [eng_s.generate(p, max_tokens=6) for p in prompts]
+        assert m_out == s_out
+    finally:
+        eng_m.shutdown()
+        eng_s.shutdown()
+
+
+def test_mesh_engine_kv_replicated_when_indivisible(params):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual devices")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))  # kv_heads=2, tp=4 -> replicate kv
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=48, mesh=mesh)
+    try:
+        out = eng.generate([5, 6, 7], max_tokens=4)
+        assert len(out) == 4
+    finally:
+        eng.shutdown()
+
+
+def test_mesh_quantize_rejected(params):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    with pytest.raises(ValueError):
+        LLMEngine(CFG, params, mesh=mesh, quantize=True)
+
+
+def test_mesh_moe_engine(params):
+    """MoE + mesh: expert specs fold ep into tp without duplicate-axis
+    crashes (fit_spec keeps the first occurrence)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual devices")
+    from jax.sharding import Mesh
+
+    from ray_tpu.models import init_params as ip
+
+    moe_cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        num_experts=2, expert_top_k=1, attention="dense", dtype=jnp.float32,
+    )
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    eng = LLMEngine(moe_cfg, ip(moe_cfg, jax.random.key(5)), max_batch_size=2, max_seq_len=32, mesh=mesh)
+    try:
+        out = eng.generate([1, 2, 3], max_tokens=3)
+        assert len(out) == 3
+    finally:
+        eng.shutdown()

@@ -151,3 +151,14 @@ def test_infeasible_streaming_task_fails_stream():
             next(gen)
     finally:
         cfg.infeasible_task_timeout_s = old
+
+
+def test_actor_streaming_rejected():
+    @rt.remote
+    class A:
+        def gen(self):
+            yield 1
+
+    a = A.remote()
+    with pytest.raises(ValueError):
+        a.gen.options(num_returns="streaming").remote()
