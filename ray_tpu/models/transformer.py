@@ -159,18 +159,26 @@ def _kv_tp_ok(cfg: TransformerConfig, mesh: Mesh, tp: str) -> bool:
 
 def fit_spec(shape, spec: P, mesh: Mesh) -> P:
     """Make a PartitionSpec legal for this array/mesh: drop mesh axes on
-    dimensions they don't divide (e.g. an odd vocab size under tp), axes
-    the mesh doesn't have, and repeated axes (a spec may name each mesh
-    axis once — e.g. MoE specs with ep folded into tp keep only the first
-    occurrence). A replicated dim beats a crash."""
+    dimensions they don't divide (e.g. an odd vocab size under tp) and
+    repeated axes (a spec may name each mesh axis once — e.g. MoE specs
+    with ep folded into tp keep only the first occurrence). A replicated
+    dim beats a crash — but an axis the mesh doesn't HAVE is a typo and
+    raises, not a silent full replication."""
     parts = []
     used = set()
     for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
         if ax is None:
             parts.append(None)
             continue
-        axes = tuple(a for a in ((ax,) if isinstance(ax, str) else tuple(ax))
-                     if a in mesh.shape and a not in used)
+        named = (ax,) if isinstance(ax, str) else tuple(ax)
+        unknown = [a for a in named if a not in mesh.shape]
+        if unknown:
+            raise ValueError(
+                f"PartitionSpec axis {unknown[0]!r} is not a mesh axis "
+                f"(mesh has {sorted(mesh.shape)}): likely a typo in the "
+                f"dp/tp/ep axis names passed to shard_params/param_specs"
+            )
+        axes = tuple(a for a in named if a not in used)
         size = 1
         for a in axes:
             size *= mesh.shape[a]

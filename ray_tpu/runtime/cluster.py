@@ -143,6 +143,7 @@ class Cluster:
         self._actor_queues: Dict[ActorID, _ActorQueue] = {}
         self._actor_lock = threading.RLock()
         self._streams: Dict[bytes, Any] = {}  # task_id -> ObjectRefGenerator
+        self._stream_lock = threading.Lock()  # serializes item commits vs force-close
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
         self.core_worker = None       # set by worker.init
@@ -458,22 +459,29 @@ class Cluster:
     def register_stream(self, spec: TaskSpec, gen) -> None:
         self._streams[spec.task_id.binary()] = gen
 
-    def on_stream_item(self, node: Node, spec: TaskSpec, index: int, value: Any, is_error: bool = False) -> None:
-        if spec._stream_closed:
-            # stream force-closed (node death / infeasibility) while the
-            # producer thread was still running: late items must not
-            # overwrite the committed error object or reopen the stream
-            return
-        oid = ObjectID.for_task_return(spec.task_id, index + 1)
-        if self.core_worker is not None:
-            self.core_worker.ref_counter.add_owned_object(oid)
-        store_node = self.head_node if node.dead else node
-        store_node.store.put(oid, value, is_error=is_error)
-        self.directory.add_location(oid, store_node.node_id)
-        spec.return_ids.append(oid)
-        gen = self._streams.get(spec.task_id.binary())
-        if gen is not None:
-            gen._push(ObjectRef(oid))
+    def on_stream_item(
+        self, node: Node, spec: TaskSpec, index: int, value: Any,
+        is_error: bool = False, _force: bool = False,
+    ) -> None:
+        # the lock makes check-flag -> commit atomic against force-close:
+        # without it a producer that passed the flag check could overwrite
+        # the force-committed error object (same ObjectID index)
+        with self._stream_lock:
+            if spec._stream_closed and not _force:
+                # stream force-closed (node death / infeasibility) while the
+                # producer thread was still running: late items must not
+                # overwrite the committed error object or reopen the stream
+                return
+            oid = ObjectID.for_task_return(spec.task_id, index + 1)
+            if self.core_worker is not None:
+                self.core_worker.ref_counter.add_owned_object(oid)
+            store_node = self.head_node if node.dead else node
+            store_node.store.put(oid, value, is_error=is_error)
+            self.directory.add_location(oid, store_node.node_id)
+            spec.return_ids.append(oid)
+            gen = self._streams.get(spec.task_id.binary())
+            if gen is not None:
+                gen._push(ObjectRef(oid))
 
     def on_stream_done(self, node: Node, spec: TaskSpec, index: int, error: Optional[BaseException]) -> None:
         if spec._stream_closed:
@@ -499,10 +507,13 @@ class Cluster:
         if spec.num_returns == "streaming":
             # close the stream with the error as its next item — otherwise a
             # consumer blocked in ObjectRefGenerator.__next__ hangs forever
-            # (reachable via kill_node and infeasible-task expiry). The flag
-            # makes any still-running producer's late commits no-ops.
-            self.on_stream_item(node, spec, len(spec.return_ids), error, is_error=True)
-            spec._stream_closed = True
+            # (reachable via kill_node and infeasible-task expiry). Flag set
+            # FIRST (under the stream lock via _force commit) so a racing
+            # producer's late commits are no-ops, never overwrites.
+            with self._stream_lock:
+                spec._stream_closed = True
+                idx = len(spec.return_ids)
+            self.on_stream_item(node, spec, idx, error, is_error=True, _force=True)
             gen = self._streams.pop(spec.task_id.binary(), None)
             if gen is not None:
                 gen._finish()

@@ -409,12 +409,16 @@ class LLMEngine:
 class LLMServer:
     """Serve deployment wrapper: each replica owns an engine.
 
-    ``model_factory`` -> (cfg, params); called once per replica so weights
-    live replica-local (HBM). Deploy with::
+    ``model_factory`` -> (cfg, params) or (cfg, params, tokenizer); called
+    once per replica so weights live replica-local (HBM). With a tokenizer
+    (anything exposing ``encode(str) -> ids`` / ``decode(ids) -> str``, e.g.
+    a HuggingFace tokenizer), requests may pass ``text`` instead of
+    ``prompt`` and responses carry decoded ``text``. Deploy with::
 
         app = serve.deployment(LLMServer).bind(model_factory, max_batch_size=8)
         handle = serve.run(app)
         handle.remote({"prompt": [1,2,3], "max_tokens": 16}).result()
+        handle.remote({"text": "once upon", "max_tokens": 16}).result()
     """
 
     def __init__(
@@ -426,8 +430,12 @@ class LLMServer:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         quantize: bool = False,
+        mesh: Optional[Any] = None,
+        tp: str = "tp",
     ):
-        cfg, params = model_factory()
+        made = model_factory()
+        cfg, params = made[0], made[1]
+        self.tokenizer = made[2] if len(made) > 2 else None
         self.engine = LLMEngine(
             cfg,
             params,
@@ -436,10 +444,21 @@ class LLMServer:
             top_k=top_k,
             top_p=top_p,
             quantize=quantize,
+            mesh=mesh,
+            tp=tp,
         )
 
+    def _encode(self, request: Dict[str, Any]) -> List[int]:
+        if "prompt" in request:
+            return request["prompt"]
+        if "text" in request:
+            if self.tokenizer is None:
+                raise ValueError("this deployment has no tokenizer; send 'prompt' token ids")
+            return list(self.tokenizer.encode(request["text"]))
+        raise ValueError("request needs 'prompt' (token ids) or 'text'")
+
     def __call__(self, request: Dict[str, Any]):
-        prompt = request["prompt"]
+        prompt = self._encode(request)
         kw = dict(
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)),
@@ -462,11 +481,14 @@ class LLMServer:
             return events()
         t0 = time.perf_counter()
         out = self.engine.generate(prompt, **kw)
-        return {
+        resp = {
             "tokens": out,
             "num_generated": len(out),
             "latency_s": round(time.perf_counter() - t0, 4),
         }
+        if self.tokenizer is not None:
+            resp["text"] = self.tokenizer.decode(out)
+        return resp
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

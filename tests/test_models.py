@@ -210,3 +210,15 @@ def test_vit_sharded_train_step():
         )
         state, loss = step(state, images, labels)
         assert np.isfinite(float(loss))
+
+
+def test_shard_params_typo_axis_raises():
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.transformer import shard_params
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64)
+    params = init_params(cfg, jax.random.key(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        shard_params(params, mesh, cfg, tp="model")  # typo'd axis name

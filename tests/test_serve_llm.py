@@ -344,3 +344,77 @@ def test_mesh_moe_engine(params):
         assert len(out) == 3
     finally:
         eng.shutdown()
+
+
+def test_text_requests_with_tokenizer(params):
+    """model_factory may return (cfg, params, tokenizer): requests send
+    'text', responses carry decoded text."""
+
+    class ByteTok:
+        def encode(self, s):
+            return [b % CFG.vocab_size for b in s.encode()]
+
+        def decode(self, ids):
+            return "".join(chr(97 + (i % 26)) for i in ids)
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer, name="txt").bind(
+            lambda: (CFG, params, ByteTok()), max_batch_size=2, max_seq_len=64
+        )
+        handle = serve.run(app, route_prefix=None)
+        r = handle.remote({"text": "hi", "max_tokens": 4}).result()
+        assert r["tokens"] == _reference(params, ByteTok().encode("hi"), 4)
+        assert r["text"] == ByteTok().decode(r["tokens"])
+        # prompt ids still work on the same deployment
+        r2 = handle.remote({"prompt": [1, 2], "max_tokens": 3}).result()
+        assert len(r2["tokens"]) == 3
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_text_without_tokenizer_rejected(params):
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer, name="notok").bind(
+            lambda: (CFG, params), max_batch_size=2, max_seq_len=32
+        )
+        handle = serve.run(app, route_prefix=None)
+        with pytest.raises(Exception, match="tokenizer"):
+            handle.remote({"text": "hi", "max_tokens": 2}).result()
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_llm_server_mesh_passthrough(params):
+    """serve deployments reach the tensor-parallel engine path."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual devices")
+    from jax.sharding import Mesh
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer, name="tp_llm").bind(
+            lambda: (CFG, params), max_batch_size=2, max_seq_len=48, mesh=mesh
+        )
+        handle = serve.run(app, route_prefix=None)
+        r = handle.remote({"prompt": [3, 14, 15], "max_tokens": 4}).result()
+        assert r["tokens"] == _reference(params, [3, 14, 15], 4)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
