@@ -26,6 +26,14 @@ from ray_tpu.models.transformer import (
     shard_params,
 )
 from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_apply
+from ray_tpu.models.dit import (
+    DiTConfig,
+    ddim_sample,
+    dit_forward,
+    dit_loss_fn,
+    init_dit_params,
+    make_dit_train_step,
+)
 from ray_tpu.models.generation import (
     decode_step,
     generate,
@@ -35,6 +43,12 @@ from ray_tpu.models.generation import (
 )
 
 __all__ = [
+    "DiTConfig",
+    "ddim_sample",
+    "dit_forward",
+    "dit_loss_fn",
+    "init_dit_params",
+    "make_dit_train_step",
     "ViTConfig",
     "init_vit_params",
     "make_vit_train_step",

@@ -509,8 +509,12 @@ class Cluster:
             # consumer blocked in ObjectRefGenerator.__next__ hangs forever
             # (reachable via kill_node and infeasible-task expiry). Flag set
             # FIRST (under the stream lock via _force commit) so a racing
-            # producer's late commits are no-ops, never overwrites.
+            # producer's late commits are no-ops, never overwrites; a second
+            # force-close is itself a no-op (idempotent — a killed node's
+            # producer may also surface its crash through this path).
             with self._stream_lock:
+                if spec._stream_closed:
+                    return
                 spec._stream_closed = True
                 idx = len(spec.return_ids)
             self.on_stream_item(node, spec, idx, error, is_error=True, _force=True)

@@ -222,3 +222,19 @@ def test_shard_params_typo_axis_raises():
     mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
     with pytest.raises(ValueError, match="not a mesh axis"):
         shard_params(params, mesh, cfg, tp="model")  # typo'd axis name
+
+
+def test_shard_params_moe_on_dp_less_mesh():
+    """Implicit ep->dp default must not raise on a tp-only mesh."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.transformer import shard_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        num_experts=2, expert_top_k=1,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    sharded = shard_params(params, mesh, cfg, tp="tp")
+    assert sharded["layers"]["we1"].shape == params["layers"]["we1"].shape
