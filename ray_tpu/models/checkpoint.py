@@ -1,0 +1,89 @@
+"""Model checkpoint helpers: (config, params) round-trips via orbax.
+
+Parity context: the reference's checkpointing lives in its libraries
+(``python/ray/train/_checkpoint.py`` directory checkpoints); here the model
+layer adds typed helpers so a serving ``model_factory`` is one line:
+
+    save_model(path, cfg, params)
+    app = serve.deployment(LLMServer).bind(lambda: load_model(path))
+
+Configs serialize as JSON next to the orbax tree (dataclass fields only;
+dtypes stored by name).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Tuple, Type
+
+import jax.numpy as jnp
+
+_CONFIG_FILE = "model_config.json"
+_PARAMS_DIR = "params"
+
+# registry of known config classes (extensible via register_config)
+_CONFIG_TYPES: dict = {}
+
+
+def register_config(cls: Type) -> Type:
+    _CONFIG_TYPES[cls.__name__] = cls
+    return cls
+
+
+def _encode_field(v: Any) -> Any:
+    if isinstance(v, (type, jnp.dtype)):  # dtype fields (cfg.dtype etc.)
+        return {"__dtype__": jnp.dtype(v).name}
+    return v
+
+
+def _decode_field(v: Any) -> Any:
+    if isinstance(v, dict) and "__dtype__" in v:
+        return jnp.dtype(v["__dtype__"]).type
+    return v
+
+
+def save_model(path: str, cfg: Any, params: Any) -> None:
+    """Write cfg (dataclass) + params (pytree) under ``path``."""
+    import orbax.checkpoint as ocp
+
+    os.makedirs(path, exist_ok=True)
+    fields = {
+        f.name: _encode_field(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)
+    }
+    with open(os.path.join(path, _CONFIG_FILE), "w") as f:
+        json.dump({"type": type(cfg).__name__, "fields": fields}, f, indent=1)
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.join(os.path.abspath(path), _PARAMS_DIR), params, force=True)
+    ckpt.wait_until_finished()
+
+
+def load_model(path: str) -> Tuple[Any, Any]:
+    """Returns (cfg, params) saved by :func:`save_model`."""
+    import orbax.checkpoint as ocp
+
+    with open(os.path.join(path, _CONFIG_FILE)) as f:
+        meta = json.load(f)
+    cls = _CONFIG_TYPES.get(meta["type"])
+    if cls is None:
+        raise ValueError(
+            f"unknown model config type {meta['type']!r}; register it with "
+            "ray_tpu.models.checkpoint.register_config"
+        )
+    cfg = cls(**{k: _decode_field(v) for k, v in meta["fields"].items()})
+    ckpt = ocp.StandardCheckpointer()
+    params = ckpt.restore(os.path.join(os.path.abspath(path), _PARAMS_DIR))
+    return cfg, params
+
+
+def _register_builtin():
+    from ray_tpu.models.dit import DiTConfig
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.models.vit import ViTConfig
+
+    for c in (TransformerConfig, ViTConfig, DiTConfig):
+        register_config(c)
+
+
+_register_builtin()

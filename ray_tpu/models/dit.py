@@ -21,7 +21,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.common import JittedStep
+from ray_tpu.models.common import JittedStep, dense_init
+from ray_tpu.models.common import patchify as _patchify, unpatchify as _unpatchify
+from ray_tpu.ops.attention import mha
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,7 +82,7 @@ def init_dit_params(cfg: DiTConfig, key: jax.Array) -> Dict[str, Any]:
     ks = jax.random.split(key, 6)
 
     def dense(k, shape, fan_in):
-        return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
+        return dense_init(k, shape, fan_in, pd)
 
     def one_layer(k):
         lk = jax.random.split(k, 7)
@@ -138,19 +140,12 @@ def _modulated_ln(x, shift, scale, eps=1e-6):
 
 def patchify(cfg: DiTConfig, images: jax.Array) -> jax.Array:
     """[B, H, W, C] -> [B, N, patch_dim]."""
-    B, H, W, C = images.shape
-    p = cfg.patch_size
-    x = images.reshape(B, H // p, p, W // p, p, C)
-    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(B, cfg.num_patches, cfg.patch_dim)
+    return _patchify(images, cfg.patch_size)
 
 
 def unpatchify(cfg: DiTConfig, patches: jax.Array) -> jax.Array:
     """[B, N, patch_dim] -> [B, H, W, C]."""
-    B = patches.shape[0]
-    p = cfg.patch_size
-    g = cfg.image_size // p
-    x = patches.reshape(B, g, g, p, p, cfg.channels)
-    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(B, cfg.image_size, cfg.image_size, cfg.channels)
+    return _unpatchify(patches, cfg.image_size, cfg.patch_size, cfg.channels)
 
 
 def dit_forward(
@@ -171,8 +166,6 @@ def dit_forward(
         cond = cond + params["label_embed"].astype(jnp.float32)[labels]
     cond = jax.nn.silu(cond).astype(cfg.dtype)  # [B, d]
 
-    scale = 1.0 / math.sqrt(cfg.head_dim)
-
     def layer_fn(x, layer):
         mods = cond @ layer["ada"].astype(cond.dtype) + layer["ada_b"].astype(cond.dtype)
         sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mods, 6, axis=-1)
@@ -180,9 +173,14 @@ def dit_forward(
         q = jnp.einsum("bnd,dhk->bnhk", h, layer["wq"].astype(h.dtype))
         k = jnp.einsum("bnd,dhk->bnhk", h, layer["wk"].astype(h.dtype))
         v = jnp.einsum("bnd,dhk->bnhk", h, layer["wv"].astype(h.dtype))
-        s = jnp.einsum("bnhk,bmhk->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhnm,bmhk->bnhk", p, v.astype(jnp.float32)).astype(h.dtype)
+        # shared reference attention (bidirectional), [B, H, N, Dh] layout
+        o = mha(
+            jnp.transpose(q, (0, 2, 1, 3)),
+            jnp.transpose(k, (0, 2, 1, 3)),
+            jnp.transpose(v, (0, 2, 1, 3)),
+            causal=False,
+        )
+        o = jnp.transpose(o, (0, 2, 1, 3))
         att = jnp.einsum("bnhk,hkd->bnd", o, layer["wo"].astype(o.dtype))
         x = x + g1[:, None, :] * att
         h = _modulated_ln(x, sh2, sc2)
@@ -284,7 +282,7 @@ def ddim_sample(
     null = jnp.full((num,), cfg.num_classes, jnp.int32) if cfg.num_classes else None
 
     def eps_fn(x, t_b):
-        if guidance_scale > 0 and labels is not None:
+        if guidance_scale > 0 and labels is not None and cfg.num_classes:
             # one batched forward over [cond; uncond] (the standard CFG
             # trick) instead of two sequential passes per step
             both = dit_forward(

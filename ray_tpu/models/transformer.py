@@ -193,10 +193,11 @@ def fit_spec(shape, spec: P, mesh: Mesh) -> P:
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig, **axes):
     if "kv_tp" not in axes:
         axes["kv_tp"] = _kv_tp_ok(cfg, mesh, axes.get("tp", "tp"))
-    if "ep" not in axes and axes.get("dp", "dp") not in mesh.shape:
+    if "ep" not in axes and "dp" not in axes and "dp" not in mesh.shape:
         # param_specs defaults ep to dp; on a dp-less mesh (tp-only
         # inference) fold experts into tp instead of raising on the
-        # implicit 'dp' (fit_spec's typo check is for EXPLICIT axes)
+        # IMPLICIT 'dp' default. An explicitly-passed dp still goes
+        # through fit_spec's typo check untouched.
         axes["ep"] = axes.get("tp", "tp")
     specs = param_specs(cfg, **axes)
     return jax.tree.map(

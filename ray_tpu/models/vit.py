@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.models.common import JittedStep
+from ray_tpu.models.common import JittedStep, dense_init
+from ray_tpu.models.common import patchify as _patchify
 from ray_tpu.models.transformer import _dense_ffn, _rms_norm
 from ray_tpu.ops.attention import flash_attention, mha
 
@@ -71,7 +72,7 @@ def init_vit_params(cfg: ViTConfig, key: jax.Array) -> Dict[str, Any]:
     ks = jax.random.split(key, 4)
 
     def dense(k, shape, fan_in):
-        return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
+        return dense_init(k, shape, fan_in, pd)
 
     def one_layer(k):
         lk = jax.random.split(k, 7)
@@ -123,11 +124,7 @@ def vit_param_specs(cfg: ViTConfig, *, tp: str = "tp") -> Dict[str, Any]:
 
 def patchify(cfg: ViTConfig, images: jax.Array) -> jax.Array:
     """[B, H, W, C] -> [B, num_patches, patch_dim] via strided reshape."""
-    B, H, W, C = images.shape
-    p = cfg.patch_size
-    x = images.reshape(B, H // p, p, W // p, p, C)
-    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
-    return x.reshape(B, (H // p) * (W // p), p * p * C)
+    return _patchify(images, cfg.patch_size)
 
 
 def vit_forward(

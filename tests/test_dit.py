@@ -121,3 +121,36 @@ def test_null_label_gets_trained():
     )(params)
     null_grad = np.abs(np.asarray(grads["label_embed"][TINY.num_classes]))
     assert null_grad.max() > 0, "null label embedding never received a gradient"
+
+
+def test_model_checkpoint_roundtrip(tmp_path, params):
+    from ray_tpu.models.checkpoint import load_model, save_model
+
+    save_model(str(tmp_path / "m"), TINY, params)
+    cfg2, params2 = load_model(str(tmp_path / "m"))
+    assert cfg2 == TINY
+    flat1 = jax.tree.leaves(params)
+    flat2 = jax.tree.leaves(params2)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_into_llm_server(tmp_path):
+    """save_model -> load_model as a serving model_factory."""
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.checkpoint import load_model, save_model
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = TransformerConfig(
+        vocab_size=41, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        attention="dense", dtype=jnp.float32,
+    )
+    save_model(str(tmp_path / "lm"), cfg, init_params(cfg, jax.random.key(0)))
+    cfg2, params2 = load_model(str(tmp_path / "lm"))
+    eng = LLMEngine(cfg2, params2, max_batch_size=1, max_seq_len=16)
+    try:
+        out = eng.generate([1, 2], max_tokens=3)
+        assert len(out) == 3
+    finally:
+        eng.shutdown()
