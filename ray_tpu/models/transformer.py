@@ -45,6 +45,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     num_experts: int = 0          # 0 => dense FFN
     expert_top_k: int = 2
+    # 0 => dense dispatch (every expert computes every token — exact, the
+    # small-scale default); > 0 => GShard/Switch capacity dispatch: expert
+    # slots = ceil(top_k * T * factor / E), FLOPs per token drop from E
+    # expert-FFNs to top_k, overflow tokens fall through the residual
+    moe_capacity_factor: float = 0.0
     dtype: Any = jnp.bfloat16     # activation dtype
     param_dtype: Any = jnp.float32
     attention: str = "auto"       # auto | flash | dense | ring (sp-sharded)
@@ -76,8 +81,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     pd = cfg.param_dtype
     d, h, hkv, dh, ff = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_ff
 
+    from ray_tpu.models.common import dense_init as _dinit
+
     def dense_init(k, shape, fan_in):
-        return (jax.random.normal(k, shape, pd) / math.sqrt(fan_in)).astype(pd)
+        return _dinit(k, shape, fan_in, pd)
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
 
@@ -298,9 +305,12 @@ def _attention(cfg: TransformerConfig, q, k, v, use_flash: bool, mesh=None, sp_a
 
 
 def _moe_ffn(cfg: TransformerConfig, layer, x):
-    """Top-k MoE, dense-dispatch formulation: every expert computes every
-    token and the router mask selects — einsums partition cleanly over
-    ``ep``×``tp`` (a ragged all-to-all dispatch is the next optimization)."""
+    """Top-k MoE dispatcher. ``moe_capacity_factor > 0`` routes through the
+    capacity formulation (:func:`_moe_ffn_capacity` — top_k FFNs per
+    token); otherwise dense dispatch: every expert computes every token and
+    the router mask selects — exact, and fine when E is small."""
+    if cfg.moe_capacity_factor > 0:
+        return _moe_ffn_capacity(cfg, layer, x)
     e, k = cfg.num_experts, cfg.expert_top_k
     logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), layer["router"].astype(jnp.float32))
     gates = jax.nn.softmax(logits, axis=-1)
@@ -312,6 +322,40 @@ def _moe_ffn(cfg: TransformerConfig, layer, x):
     h = jax.nn.silu(g) * h
     out = jnp.einsum("betf,efd->betd", h, layer["we2"].astype(x.dtype))
     return jnp.einsum("betd,bte->btd", out, mask)
+
+
+def _moe_ffn_capacity(cfg: TransformerConfig, layer, x):
+    """Capacity-based top-k MoE (GShard/Switch): tokens route to at most
+    ``C = ceil(top_k * T * factor / E)`` slots per expert via one-hot
+    dispatch/combine einsums — compute per token is top_k expert-FFNs
+    instead of all E. Overflow tokens are dropped (standard; they pass
+    through the residual). The dispatch einsums partition over ep×tp the
+    same way the dense formulation does — [B, E, C, d] expert blocks are
+    the all-to-all payload under expert parallelism."""
+    B, T, d = x.shape
+    E, k = cfg.num_experts, cfg.expert_top_k
+    C = max(1, math.ceil(k * T * cfg.moe_capacity_factor / E))
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), layer["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)                      # [B,T,E]
+    topv, topi = jax.lax.top_k(gates, k)                         # [B,T,k]
+    topv = topv / (jnp.sum(topv, -1, keepdims=True) + 1e-9)
+    sel = jax.nn.one_hot(topi, E, dtype=jnp.float32)             # [B,T,k,E]
+    # slot index per (token, choice): how many earlier assignments this
+    # expert already has (cumsum over the flattened (T, k) order)
+    flat = sel.reshape(B, T * k, E)
+    pos = jnp.cumsum(flat, axis=1) - flat                        # [B,T*k,E]
+    slot = jnp.sum(pos.reshape(B, T, k, E) * sel, axis=-1)       # [B,T,k]
+    keep = (slot < C).astype(jnp.float32)                       # fits capacity
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[..., None]
+    # dispatch [B,T,E,C]: 1 where token t goes to expert e slot c
+    dispatch = jnp.einsum("btke,btkc->btec", sel, slot_oh)
+    combine = jnp.einsum("btk,btke,btkc->btec", topv.astype(jnp.float32), sel, slot_oh)
+    xin = jnp.einsum("btec,btd->becd", dispatch.astype(x.dtype), x)   # [B,E,C,d]
+    h = jnp.einsum("becd,edf->becf", xin, layer["we1"].astype(x.dtype))
+    g = jnp.einsum("becd,edf->becf", xin, layer["we3"].astype(x.dtype))
+    h = jax.nn.silu(g) * h
+    out = jnp.einsum("becf,efd->becd", h, layer["we2"].astype(x.dtype))
+    return jnp.einsum("btec,becd->btd", combine.astype(x.dtype), out)
 
 
 def _dense_ffn(layer, x):

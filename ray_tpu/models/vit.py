@@ -17,7 +17,6 @@ rounds out the model stack next to the decoder transformer):
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Any, Dict, Optional
 
 import jax
