@@ -32,9 +32,11 @@ from ray_tpu.data.read_api import (
     read_text,
     read_webdataset,
 )
+from ray_tpu.data.llm_inference import LLMPredictor
 
 __all__ = [
     "AggregateFn",
+    "LLMPredictor",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

@@ -61,6 +61,7 @@ class Dataset:
         fn_args: tuple = (),
         fn_kwargs: Optional[dict] = None,
         fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
         num_cpus: float = 1,
         num_tpus: float = 0,
         concurrency=None,
@@ -80,6 +81,7 @@ class Dataset:
                 num_tpus=num_tpus,
                 concurrency=concurrency,
                 fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs,
             )
         )
 

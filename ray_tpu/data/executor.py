@@ -242,7 +242,10 @@ class ActorPoolMapOperator(PhysicalOperator):
         class _MapWorker:
             def __init__(self):
                 self._udfs = [
-                    s.fn(*s.fn_constructor_args) if isinstance(s.fn, type) else s.fn for s in stages_ser
+                    s.fn(*s.fn_constructor_args, **s.fn_constructor_kwargs)
+                    if isinstance(s.fn, type)
+                    else s.fn
+                    for s in stages_ser
                 ]
 
             def run(self, block: Block):

@@ -67,6 +67,7 @@ class AbstractMap(LogicalOp):
         num_tpus: float = 0,
         concurrency: Optional[Union[int, Tuple[int, int]]] = None,
         fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
     ):
         super().__init__([input_op])
         self.kind = kind
@@ -80,6 +81,7 @@ class AbstractMap(LogicalOp):
         self.num_tpus = num_tpus
         self.concurrency = concurrency
         self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs or {}
 
     @property
     def name(self) -> str:  # type: ignore[override]

@@ -418,3 +418,49 @@ def test_llm_server_mesh_passthrough(params):
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_data_batch_inference(params):
+    """Dataset map_batches with LLMPredictor: offline batch generation
+    rides the continuous-batching engine; outputs match solo runs."""
+    import ray_tpu
+    import ray_tpu.data as rd
+    from ray_tpu.data import LLMPredictor
+
+    prompts = [[3, 1], [4, 1, 5], [9, 2], [6, 5, 3, 5]]
+    ray_tpu.init(num_cpus=4)
+    try:
+        ds = rd.from_items([{"prompt": p} for p in prompts])
+        factory = lambda: (CFG, params)  # noqa: E731
+        out = ds.map_batches(
+            LLMPredictor,
+            fn_constructor_args=(factory,),
+            fn_constructor_kwargs={
+                "max_tokens": 4, "max_batch_size": 4, "max_seq_len": 32,
+            },
+            batch_size=4,
+        ).take_all()
+        by_prompt = {tuple(r["prompt"]): list(r["generated"]) for r in out}
+        for p in prompts:
+            assert by_prompt[tuple(p)] == _reference(params, p, 4)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_llm_predictor_cache_respects_kwargs(params):
+    """Different engine kwargs must not share a cached engine; same
+    factory+kwargs must reuse one."""
+    from ray_tpu.data.llm_inference import LLMPredictor, _engine_cache
+
+    factory = lambda: (CFG, params)  # noqa: E731
+    a = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
+    b = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
+    c = LLMPredictor(factory, max_batch_size=2, max_seq_len=48)
+    try:
+        assert a.engine is b.engine
+        assert a.engine is not c.engine
+        assert c.engine.S == 48
+    finally:
+        for e in {id(a.engine): a.engine, id(c.engine): c.engine}.values():
+            e.shutdown()
+        _engine_cache.clear()
