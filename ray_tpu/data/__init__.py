@@ -32,11 +32,12 @@ from ray_tpu.data.read_api import (
     read_text,
     read_webdataset,
 )
-from ray_tpu.data.llm_inference import LLMPredictor
+from ray_tpu.data.llm_inference import LLMPredictor, clear_engine_cache
 
 __all__ = [
     "AggregateFn",
     "LLMPredictor",
+    "clear_engine_cache",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

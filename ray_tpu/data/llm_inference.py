@@ -29,6 +29,20 @@ _engine_cache: Dict[Any, Any] = {}
 _cache_lock = threading.Lock()
 
 
+def clear_engine_cache() -> None:
+    """Shut down and release every cached engine (daemon threads + device
+    KV caches + pinned params). Call between unrelated batch-inference
+    jobs in a long-lived process; worker processes exit anyway."""
+    with _cache_lock:
+        entries = list(_engine_cache.values())
+        _engine_cache.clear()
+    for _factory, engine, _tok in entries:
+        try:
+            engine.shutdown()
+        except Exception:
+            pass
+
+
 class LLMPredictor:
     """``map_batches``-compatible callable: token-id prompts in, generated
     token ids (and text, when the factory supplies a tokenizer) out."""
@@ -51,24 +65,26 @@ class LLMPredictor:
         self.output_column = output_column
         # Cache key: factory identity AND the engine kwargs — different
         # kwargs must not silently share an engine. The cached tuple keeps a
-        # STRONG reference to the factory so its id() can't be recycled onto
-        # a different function after GC; the identity check validates a hit.
+        # STRONG reference to the factory, so a cached id() always refers to
+        # that still-alive object (no post-GC id recycling).
         key = (id(model_factory), tuple(sorted((k, repr(v)) for k, v in engine_kwargs.items())))
         with _cache_lock:
             entry = _engine_cache.get(key)
-            if entry is not None and entry[0] is model_factory:
-                self.engine, self.tokenizer = entry[1], entry[2]
-                return
-            # build INSIDE the lock: a racing constructor would otherwise
-            # leak a fully-built engine (daemon thread + device params)
-            from ray_tpu.serve.llm import LLMEngine
+            if entry is None:
+                # build INSIDE the lock: a racing constructor would
+                # otherwise leak a fully-built engine (daemon thread +
+                # device params)
+                from ray_tpu.serve.llm import LLMEngine
 
-            made = model_factory()
-            cfg, params = made[0], made[1]
-            tokenizer = made[2] if len(made) > 2 else None
-            engine = LLMEngine(cfg, params, **engine_kwargs)
-            _engine_cache[key] = (model_factory, engine, tokenizer)
-        self.engine, self.tokenizer = engine, tokenizer
+                made = model_factory()
+                cfg, params = made[0], made[1]
+                tokenizer = made[2] if len(made) > 2 else None
+                entry = _engine_cache[key] = (
+                    model_factory,
+                    LLMEngine(cfg, params, **engine_kwargs),
+                    tokenizer,
+                )
+        self.engine, self.tokenizer = entry[1], entry[2]
 
     def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         prompts = batch[self.prompt_column]

@@ -62,6 +62,7 @@ def forward_with_cache(
     positions: jax.Array,  # [B, T] int32 absolute positions (contiguous per row)
     *,
     use_decode_kernel: Optional[bool] = None,
+    use_prefill_kernel: Optional[bool] = None,
     layer_scales: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """One cached forward pass. Writes this call's K/V into the cache at
@@ -71,6 +72,14 @@ def forward_with_cache(
     ``use_decode_kernel``: route single-token steps through the Pallas
     decode-attention kernel (``ray_tpu.ops.decode_attention``); default
     auto — on for TPU, off elsewhere (the plain-XLA grouped einsum).
+
+    ``use_prefill_kernel``: ONLY valid when every row's positions start at
+    0 (the :func:`prefill` contract) — then attention sees just this
+    call's own K/V, which is exactly causal flash attention over T tokens,
+    and the Pallas kernel skips the [T, S] masked einsum against the whole
+    cache (quadratic in cache size). Default OFF here (a T>1 call at
+    nonzero positions, e.g. speculative verification, would be wrong);
+    :func:`prefill` turns it on automatically on TPU.
 
     ``layer_scales``: dequantization scales matching ``params['layers']``
     (int8 weight-only serving). They ride the layer scan as xs, so each
@@ -87,9 +96,11 @@ def forward_with_cache(
     kv_pos = jnp.arange(S)
     # key s visible to query t iff s <= position(t): causal over the cache
     vis = kv_pos[None, None, None, :] <= positions[:, None, :, None]  # [B,1,T,S]
+    on_tpu = jax.default_backend() == "tpu"
     if use_decode_kernel is None:
-        use_decode_kernel = jax.default_backend() == "tpu"
+        use_decode_kernel = on_tpu
     decode_kernel = use_decode_kernel and T == 1
+    prefill_kernel = bool(use_prefill_kernel) and T > 1
 
     def layer_fn(x, layer_kc_vc):
         if layer_scales is not None:
@@ -112,6 +123,22 @@ def forward_with_cache(
 
             o = decode_attention(q[:, 0], kc, vc, starts + 1, sm_scale=scale)[:, None]
             o = o.astype(x.dtype)
+        elif prefill_kernel:
+            # positions start at 0 for every row (prefill contract): the
+            # visible keys are exactly this call's own K/V — causal flash
+            # over T tokens, no [T, S] cache-wide mask
+            from ray_tpu.ops.attention import flash_attention
+
+            kr = jnp.repeat(k, n_rep, axis=2) if n_rep > 1 else k
+            vr = jnp.repeat(v, n_rep, axis=2) if n_rep > 1 else v
+            o = flash_attention(
+                jnp.transpose(q, (0, 2, 1, 3)),
+                jnp.transpose(kr, (0, 2, 1, 3)),
+                jnp.transpose(vr, (0, 2, 1, 3)),
+                scale,
+                True,
+            )
+            o = jnp.transpose(o, (0, 2, 1, 3)).astype(x.dtype)
         else:
             # grouped-query attention against the whole cache
             qg = q.reshape(B, T, hkv, n_rep, cfg.head_dim)
@@ -137,6 +164,20 @@ def forward_with_cache(
     return logits.astype(jnp.float32), {"k": ks, "v": vs}
 
 
+def _single_device_params(params) -> bool:
+    """True iff on TPU and the embed param is a CONCRETE single-device
+    array (tracers and multi-device shardings return False)."""
+    if jax.default_backend() != "tpu":
+        return False
+    emb = params.get("embed") if isinstance(params, dict) else None
+    if not isinstance(emb, jax.Array) or isinstance(emb, jax.core.Tracer):
+        return False
+    try:
+        return len(emb.sharding.device_set) == 1
+    except Exception:
+        return False
+
+
 def prefill(
     cfg: TransformerConfig,
     params: Dict[str, Any],
@@ -149,6 +190,12 @@ def prefill(
     logits per row: (logits [B, V], cache)."""
     B, Tp = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(Tp)[None, :], (B, Tp))
+    if "use_prefill_kernel" not in fw_kwargs:
+        # positions provably start at 0 here, so the flash path is safe —
+        # but ONLY auto-enable when params are concretely single-device
+        # (a pallas_call can't lower against GSPMD-sharded operands; under
+        # jit tracing or multi-device shardings, stay on the einsum path)
+        fw_kwargs["use_prefill_kernel"] = _single_device_params(params)
     logits, cache = forward_with_cache(cfg, params, cache, tokens, positions, **fw_kwargs)
     last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return last, cache

@@ -154,8 +154,9 @@ class LLMEngine:
         layer_scales = self._layer_scales
         kv_spec = self._kv_spec
         # under a mesh the einsum path partitions via GSPMD; the Pallas
-        # kernel path stays for the single-device engine
+        # kernel paths stay for the single-device engine
         use_kernel = None if mesh is None else False
+        prefill_kernel = mesh is None and jax.default_backend() == "tpu"
 
         # the cache is donated through decode/insert: the engine holds the
         # only reference and reassigns, so XLA updates the [L,B,Hkv,S,Dh]
@@ -179,6 +180,7 @@ class LLMEngine:
             logits, row = forward_with_cache(
                 cfg_, params, row, tokens, positions,
                 layer_scales=layer_scales, use_decode_kernel=use_kernel,
+                use_prefill_kernel=prefill_kernel,  # positions start at 0 here
             )
             return jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0, keepdims=False), row
 

@@ -450,17 +450,15 @@ def test_data_batch_inference(params):
 def test_llm_predictor_cache_respects_kwargs(params):
     """Different engine kwargs must not share a cached engine; same
     factory+kwargs must reuse one."""
-    from ray_tpu.data.llm_inference import LLMPredictor, _engine_cache
+    from ray_tpu.data.llm_inference import LLMPredictor, clear_engine_cache
 
     factory = lambda: (CFG, params)  # noqa: E731
-    a = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
-    b = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
-    c = LLMPredictor(factory, max_batch_size=2, max_seq_len=48)
     try:
+        a = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
+        b = LLMPredictor(factory, max_batch_size=2, max_seq_len=32)
+        c = LLMPredictor(factory, max_batch_size=2, max_seq_len=48)
         assert a.engine is b.engine
         assert a.engine is not c.engine
         assert c.engine.S == 48
     finally:
-        for e in {id(a.engine): a.engine, id(c.engine): c.engine}.values():
-            e.shutdown()
-        _engine_cache.clear()
+        clear_engine_cache()  # the supported release API
