@@ -43,6 +43,7 @@ def init(
     ignore_reinit_error: bool = False,
     include_dashboard: bool = False,
     dashboard_port: int = 0,
+    head_port: Optional[int] = None,
     **_compat,
 ):
     """Start the single-host runtime (head node + driver).
@@ -86,6 +87,10 @@ def init(
             from ray_tpu.dashboard import DashboardHead
 
             cluster.dashboard = DashboardHead(cluster, port=dashboard_port)
+        if head_port is not None:
+            # open the multi-host control plane; agents join with
+            # ``rt start --address=<this address>``
+            cluster.start_head_service(host="0.0.0.0", port=head_port)
         _cluster = cluster
         # The default 5ms GIL switch interval lets a busy driver thread
         # starve the pool reader threads for whole scheduling quanta,
@@ -158,13 +163,20 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    """Best-effort cancel: queued tasks are dropped at dispatch time (the
-    dispatch path checks the flag and commits TaskCancelledError); already-
-    running tasks are not interrupted (reference parity for non-force)."""
-    for s in get_cluster().task_manager.pending_specs():
-        if ref.id() in s.return_ids:
-            s._cancelled = True
-            return
+    """Cancel the task that produces ``ref``.
+
+    Non-force: queued tasks are dropped at dispatch time (the dispatch path
+    checks the flag and commits TaskCancelledError); running tasks finish.
+    ``force=True`` additionally kills the worker process hosting an
+    already-running task (reference ``CancelTask`` force_kill,
+    src/ray/protobuf/core_worker.proto:441-502). O(1): the spec is found via
+    the TaskID embedded in the ObjectID, not a pending scan."""
+    cluster = get_cluster()
+    spec = cluster.task_manager.get_pending(ref.id().task_id())
+    if spec is None:
+        return
+    spec._cancelled = True
+    cluster.cancel_task(spec, force=force)
 
 
 def get_actor(name: str, namespace: str = "default") -> "ActorHandle":
@@ -387,6 +399,7 @@ class ActorClass:
             class_name=self._cls.__name__,
             resources=_resource_dict(opts),
             max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             mode=mode,
             scheduling_strategy=opts.get("scheduling_strategy"),

@@ -113,6 +113,15 @@ class ResourcePool:
                 total_k = self.total.fixed().get(k, 0)
                 self._available[k] = min(self._available.get(k, 0) + v, total_k) if total_k else self._available.get(k, 0) + v
 
+    def force_acquire(self, request: ResourceSet) -> None:
+        """Deduct unconditionally (may go transiently negative).  Used when
+        applying a head-authorized acquire on an agent's authoritative pool:
+        the placement decision was already made against the head's view, so
+        the agent must reflect it even mid-reconciliation."""
+        with self._lock:
+            for k, v in request.fixed().items():
+                self._available[k] = self._available.get(k, 0) - v
+
     def add_capacity(self, extra: ResourceSet) -> None:
         """Grow the pool (used by placement-group bundle commit/return)."""
         with self._lock:

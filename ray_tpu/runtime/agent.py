@@ -1,0 +1,340 @@
+"""Node agent: joins a head over TCP and runs a full local node runtime.
+
+``python -m ray_tpu.runtime.agent --address=<head_host:port>`` (or
+``rt start --address=...``) is the multi-host analogue of the reference's
+``ray start --address`` raylet bring-up (``python/ray/scripts/scripts.py:568``
+exec'ing ``src/ray/raylet/main.cc:123``): this process hosts a real
+:class:`~ray_tpu.runtime.node.Node` — local scheduler, process worker pool,
+object-store tier, actor instances — and speaks to the head through one
+duplex RPC connection.
+
+The :class:`AgentFabric` implements the slice of the ``Cluster`` interface a
+``Node`` calls (object pulls, task/stream/actor completion callbacks),
+forwarding each across the wire; ordering holds because the transport
+dispatches inbound messages on a single thread per connection.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.runtime import rpc
+
+
+class AgentFabric:
+    """The Node's view of "the cluster" inside an agent process."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.conn: Optional[rpc.RpcConnection] = None
+        self.node = None          # set after registration
+        self._specs: Dict[bytes, Any] = {}   # task_id -> agent-side spec
+        self._specs_lock = threading.Lock()
+
+    # -- object movement ----------------------------------------------------
+    def pull_object(self, oid: ObjectID, node, callback) -> None:
+        if node.store.contains(oid):
+            callback()
+            return
+
+        def on_reply(reply, error):
+            if error is not None:
+                # Head gone: the process is about to exit via on_disconnect;
+                # leave the waiter — nothing can complete it.
+                return
+            value, is_error = rpc.decode_value(reply)
+            node.store.put(oid, value, is_error=is_error)
+            callback()
+
+        self.conn.request_async("pull_object", {"oid": oid.binary()}, on_reply)
+
+    # -- completion callbacks (forwarded to the owner on the head) ----------
+    def on_task_finished(self, node, spec, result, error) -> None:
+        self._forget(spec)
+        if error is not None:
+            self.conn.send(
+                "task_finished",
+                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None},
+            )
+            return
+        # Store returns locally first: this node IS a valid object location
+        # (the head's directory will record it), so same-node consumers read
+        # without a wire round trip.
+        if spec.num_returns == 1:
+            values = [result]
+        elif spec.num_returns == 0:
+            values = []
+        else:
+            values = list(result) if result is not None else [None] * spec.num_returns
+        for oid, value in zip(spec.return_ids, values):
+            node.store.put(oid, value)
+        self.conn.send(
+            "task_finished",
+            {"task_id": spec.task_id.binary(), "value": rpc.encode_value(result), "error": None},
+        )
+
+    def on_stream_item(self, node, spec, index: int, value, is_error: bool = False) -> None:
+        self.conn.send(
+            "stream_item",
+            {"task_id": spec.task_id.binary(), "index": index, "value": rpc.encode_value(value, is_error)},
+        )
+
+    def on_stream_done(self, node, spec, index: int, error) -> None:
+        self._forget(spec)
+        self.conn.send(
+            "stream_done",
+            {
+                "task_id": spec.task_id.binary(),
+                "index": index,
+                "error": rpc.encode_value(error) if error is not None else None,
+            },
+        )
+
+    # -- actor lifecycle ----------------------------------------------------
+    def on_actor_created(self, node, spec) -> None:
+        self._forget(spec)
+        self.conn.send("actor_created", {"task_id": spec.task_id.binary()})
+
+    def on_actor_creation_failed(self, spec, error) -> None:
+        self._forget(spec)
+        self.conn.send(
+            "actor_creation_failed",
+            {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error)},
+        )
+
+    def on_actor_process_died(self, node, actor_id: ActorID) -> None:
+        self.conn.send("actor_died", {"actor_id": actor_id.binary()})
+
+    # -- spec registry (cancellation) ---------------------------------------
+    def _remember(self, spec) -> None:
+        with self._specs_lock:
+            self._specs[spec.task_id.binary()] = spec
+
+    def _forget(self, spec) -> None:
+        with self._specs_lock:
+            self._specs.pop(spec.task_id.binary(), None)
+
+    def lookup_spec(self, task_bin: bytes):
+        with self._specs_lock:
+            return self._specs.get(task_bin)
+
+
+class NodeAgent:
+    """Process-level wiring: connect, register, serve until disconnect."""
+
+    def __init__(
+        self,
+        address: str,
+        resources: Dict[str, float],
+        labels: Optional[dict] = None,
+        session_dir: Optional[str] = None,
+    ):
+        self.head_address = address
+        self.resources = resources
+        self.labels = labels or {}
+        self.session_dir = session_dir or f"/tmp/ray_tpu_agent_{os.getpid()}"
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.fabric = AgentFabric(self.session_dir)
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._stop = threading.Event()
+        self.node = None
+        self.node_id: Optional[NodeID] = None
+        self.conn: Optional[rpc.RpcConnection] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        from ray_tpu.core.config import Config, set_config
+        from ray_tpu.runtime.node import Node
+
+        self.conn = rpc.connect(
+            self.head_address,
+            handlers=self._handlers(),
+            on_disconnect=self._on_disconnect,
+            name="agent",
+        )
+        self.fabric.conn = self.conn
+        # Node id is generated HERE and the Node is fully constructed before
+        # registration: the head may dispatch the instant it learns about
+        # this node, so registration must be the last step.
+        self.node_id = NodeID.from_random()
+        reply = self.conn.request("register_node_config", {})
+        # Adopt the head's config so thresholds/timeouts agree cluster-wide
+        # (reference: non-head nodes fetch the serialized RayConfig from the
+        # GCS, python/ray/_private/node.py:1377-1392).
+        cfg = Config()
+        cfg.apply_dict({k: v for k, v in reply["config"].items() if hasattr(cfg, k)})
+        set_config(cfg)
+        self.node = Node(self.node_id, self.resources, self.fabric, shm_store=None, labels=self.labels)
+        self.fabric.node = self.node
+        # collectives / gang rendezvous in this process reach the cluster KV
+        # over the head connection
+        from ray_tpu.runtime.kv_client import register_agent_kv
+
+        register_agent_kv(self.conn)
+        self.conn.request(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "resources": self.resources,
+                "labels": self.labels,
+                "address": _self_address(),
+            },
+        )
+        threading.Thread(target=self._report_loop, name="agent-report", daemon=True).start()
+
+    def wait(self) -> None:
+        self._stop.wait()
+
+    # ------------------------------------------------------------------
+    def _handlers(self) -> dict:
+        return {
+            "submit_task": self._h_submit_task,
+            "submit_actor_task": self._h_submit_actor_task,
+            "create_actor": self._h_create_actor,
+            "kill_actor": self._h_kill_actor,
+            "cancel_task": self._h_cancel_task,
+            "pool_update": self._h_pool_update,
+            "push_object": self._h_push_object,
+            "fetch_object": self._h_fetch_object,
+            "delete_object": self._h_delete_object,
+            "shutdown": self._h_shutdown,
+            "ping": lambda c, p, rid=None: {},
+        }
+
+    def _decode(self, payload: dict):
+        spec = rpc.decode_spec(payload["spec"], self._fn_cache)
+        self.fabric._remember(spec)
+        return spec
+
+    def _h_submit_task(self, conn, payload) -> None:
+        self.node.submit(self._decode(payload))
+
+    def _h_submit_actor_task(self, conn, payload) -> None:
+        self.node.submit_actor_task(self._decode(payload))
+
+    def _h_create_actor(self, conn, payload) -> None:
+        spec = self._decode(payload)
+        self.node.create_actor(spec, payload["mode"], payload["max_concurrency"])
+
+    def _h_kill_actor(self, conn, payload) -> None:
+        self.node.kill_actor(ActorID(payload["actor_id"]))
+
+    def _h_cancel_task(self, conn, payload) -> None:
+        spec = self.fabric.lookup_spec(payload["task_id"])
+        if spec is not None:
+            spec._cancelled = True
+            self.node.cancel_task(spec, force=payload.get("force", False))
+
+    def _h_pool_update(self, conn, payload) -> None:
+        rset = ResourceSet.from_fixed_dict(payload["resources"])
+        op = payload["op"]
+        pool = self.node.pool
+        if op == "acquire":
+            pool.force_acquire(rset)
+        elif op == "release":
+            pool.release(rset)
+        elif op == "add_capacity":
+            pool.add_capacity(rset)
+        elif op == "remove_capacity":
+            pool.remove_capacity(rset)
+
+    def _h_push_object(self, conn, payload) -> None:
+        value, is_error = rpc.decode_value(payload)
+        self.node.store.put(ObjectID(payload["oid"]), value, is_error=is_error)
+
+    def _h_fetch_object(self, conn, payload, rid) -> dict:
+        oid = ObjectID(payload["oid"])
+        value = self.node.store.get(oid, timeout=30)
+        info = self.node.store.entry_info(oid)
+        return rpc.encode_value(value, bool(info and info["is_error"]))
+
+    def _h_delete_object(self, conn, payload) -> None:
+        self.node.store.delete(ObjectID(payload["oid"]))
+
+    def _h_shutdown(self, conn, payload) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------------
+    def _report_loop(self) -> None:
+        from ray_tpu.core.config import get_config
+
+        period = max(0.02, get_config().resource_sync_period_s)
+        while not self._stop.is_set() and not self.conn.closed:
+            try:
+                pool = self.node.pool
+                self.conn.send(
+                    "resource_report",
+                    {
+                        "total": pool.total.fixed(),
+                        "available": pool.available.fixed(),
+                        "queue_len": self.node.scheduler.queue_len(),
+                        "stats": self.node.scheduler.stats(),
+                    },
+                )
+            except rpc.RpcError:
+                return
+            self._stop.wait(period)
+
+    def _on_disconnect(self, conn) -> None:
+        # The head is the control plane; without it this node has no work
+        # source and no owner to report to — exit (raylet dies when the GCS
+        # is unreachable past the reconnect budget, same policy).
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.node is not None:
+            self.node.shutdown()
+        if self.conn is not None:
+            self.conn.close()
+
+
+def _self_address() -> str:
+    import socket
+
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "?"
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="ray_tpu node agent")
+    parser.add_argument("--address", required=True, help="head host:port")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}", help="JSON extra resources")
+    parser.add_argument("--labels", default="{}", help="JSON node labels")
+    args = parser.parse_args(argv)
+
+    resources = dict(json.loads(args.resources))
+    resources["CPU"] = args.num_cpus if args.num_cpus is not None else (os.cpu_count() or 4)
+    if args.num_tpus is not None:
+        resources["TPU"] = args.num_tpus
+
+    agent = NodeAgent(args.address, resources, labels=json.loads(args.labels))
+    try:
+        agent.start()
+    except (OSError, rpc.RpcError) as exc:
+        print(f"ray_tpu agent: cannot join {args.address}: {exc}", file=sys.stderr)
+        return 1
+    print(f"ray_tpu agent joined {args.address} as node {agent.node_id.hex()[:8]}", file=sys.stderr)
+    try:
+        agent.wait()
+    except KeyboardInterrupt:
+        pass
+    agent.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,11 +161,21 @@ class Cluster:
                 self.shm_store = None
         self.transfer_bytes = 0
         self.transfer_count = 0
+        self.head_service = None  # multi-host TCP service (start_head_service)
         # pending resource demand, read by the autoscaler (parity with the
         # load the GCS reports to the monitor process,
         # python/ray/autoscaler/_private/monitor.py): spec id -> resource dict.
         self._infeasible_demands: Dict[int, Dict[str, float]] = {}
         self._demand_lock = threading.Lock()
+        # ONE demand queue + ONE drainer thread for all currently-infeasible
+        # work (tasks and actor creations).  The reference keeps these in
+        # scheduler queues drained on resource events
+        # (cluster_task_manager.h:42 infeasible_tasks_); a thread per parked
+        # task would turn a 10k-task burst into 10k threads.
+        self._demand_cv = threading.Condition()
+        self._demand_entries: List[list] = []   # [spec, kind, deadline]
+        self._demand_thread: Optional[threading.Thread] = None
+        self._demand_stop = False
         # host-memory OOM guard (memory_monitor.h parity); one monitor for
         # the in-process fabric, candidates aggregated over all nodes.
         self.memory_monitor = None
@@ -201,7 +211,35 @@ class Cluster:
             {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
         )
         self.control.placement_groups.retry_pending()
+        self.notify_resources_changed()
         return node
+
+    def start_head_service(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Open the TCP control plane so node agents on other machines (or
+        other processes) can join (``rt start --address=<returned addr>``).
+        Returns the listen address. Idempotent."""
+        if self.head_service is None:
+            from ray_tpu.runtime.remote_node import HeadService
+
+            self.head_service = HeadService(self, host, port)
+        return self.head_service.address
+
+    def register_remote_node(self, handle) -> None:
+        """A node agent registered over the transport: wire its proxy into
+        the scheduler, control service and placement machinery exactly like
+        an in-process node (add_node parity)."""
+        self.nodes[handle.node_id] = handle
+        self.cluster_scheduler.register_node(
+            handle.node_id, handle.pool, handle.labels, queue_len=handle.scheduler.queue_len
+        )
+        self.control.nodes.register(
+            NodeInfo(handle.node_id, f"tcp://{handle.address}", handle.pool.total.to_dict(), handle.labels)
+        )
+        self.control.placement_groups.bind_node_pools(
+            {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
+        )
+        self.control.placement_groups.retry_pending()
+        self.notify_resources_changed()
 
     def kill_node(self, node_id: NodeID) -> None:
         """Chaos hook: simulate node failure (NodeKillerActor parity,
@@ -245,37 +283,104 @@ class Cluster:
             # infeasible now: park until resources free up / nodes join.
             self._park_infeasible(spec)
             return
-        self.nodes[node_id].submit(spec)
+        try:
+            self.nodes[node_id].submit(spec)
+        except ConnectionError:
+            # remote node died between pick and dispatch: its disconnect
+            # handler will run kill_node; this task just re-routes
+            self._park_infeasible(spec)
 
-    def _park_infeasible(self, spec: TaskSpec) -> None:
-        key = id(spec)
+    def _park_infeasible(self, spec: TaskSpec, kind: str = "task") -> None:
+        """Queue currently-unschedulable work on the shared demand queue.
+
+        Zero threads per entry: one drainer (started lazily, parked while
+        the queue is empty) retries placement on resource events / a short
+        tick and fails entries past their deadline."""
         with self._demand_lock:
-            self._infeasible_demands[key] = spec.resources.to_dict()
-
-        def retry_later():
-            try:
-                deadline = time.monotonic() + get_config().infeasible_task_timeout_s
-                while time.monotonic() < deadline:
-                    time.sleep(0.05)
-                    node_id = self.cluster_scheduler.pick_node(spec)
-                    if node_id is not None:
-                        # deregister demand BEFORE submit: dispatch can block
-                        # (worker spawn) and the autoscaler must not see both
-                        # the demand and its already-acquired resources.
-                        with self._demand_lock:
-                            self._infeasible_demands.pop(key, None)
-                        self.nodes[node_id].submit(spec)
-                        return
-                self.task_manager.mark_failed(spec)
-                self._commit_error_everywhere(
-                    spec,
-                    RayTaskError(spec.name, f"Task {spec.name} is infeasible: requires {spec.resources.to_dict()}"),
+            self._infeasible_demands[id(spec)] = spec.resources.to_dict()
+        timeout = (
+            get_config().infeasible_task_timeout_s if kind == "task" else 30.0
+        )
+        with self._demand_cv:
+            self._demand_entries.append([spec, kind, time.monotonic() + timeout])
+            if self._demand_thread is None or not self._demand_thread.is_alive():
+                self._demand_thread = threading.Thread(
+                    target=self._demand_drain_loop, name="demand-drain", daemon=True
                 )
-            finally:
-                with self._demand_lock:
-                    self._infeasible_demands.pop(key, None)
+                self._demand_thread.start()
+            self._demand_cv.notify_all()
 
-        threading.Thread(target=retry_later, daemon=True).start()
+    def notify_resources_changed(self) -> None:
+        """Wake the demand drainer (node join, capacity growth)."""
+        with self._demand_cv:
+            self._demand_cv.notify_all()
+
+    def _demand_drain_loop(self) -> None:
+        while not self._demand_stop:
+            with self._demand_cv:
+                while not self._demand_entries and not self._demand_stop:
+                    self._demand_cv.wait()   # park: empty queue costs nothing
+                if self._demand_stop:
+                    return
+                entries = list(self._demand_entries)
+            now = time.monotonic()
+            placed_or_failed = []
+            for entry in entries:
+                spec, kind, deadline = entry
+                node_id = self.cluster_scheduler.pick_node(spec)
+                if node_id is not None:
+                    # deregister demand BEFORE submit: dispatch can block
+                    # (worker spawn) and the autoscaler must not see both the
+                    # demand and its already-acquired resources.
+                    with self._demand_lock:
+                        self._infeasible_demands.pop(id(spec), None)
+                    placed_or_failed.append(entry)
+                    try:
+                        if kind == "task":
+                            self.nodes[node_id].submit(spec)
+                        else:
+                            self._start_actor_on(node_id, spec)
+                    except Exception:  # noqa: BLE001 — one bad entry must not stall the queue
+                        # dispatch raced a node death: re-park (fresh
+                        # deadline) rather than silently losing the task
+                        self._park_infeasible(spec, kind=kind)
+                elif now >= deadline:
+                    with self._demand_lock:
+                        self._infeasible_demands.pop(id(spec), None)
+                    placed_or_failed.append(entry)
+                    if kind == "task":
+                        self.task_manager.mark_failed(spec)
+                        self._commit_error_everywhere(
+                            spec,
+                            RayTaskError(
+                                spec.name,
+                                f"Task {spec.name} is infeasible: requires {spec.resources.to_dict()}",
+                            ),
+                        )
+                    else:
+                        self.on_actor_creation_failed(
+                            spec, ActorDiedError(spec.actor_id, "actor creation infeasible")
+                        )
+            with self._demand_cv:
+                for entry in placed_or_failed:
+                    try:
+                        self._demand_entries.remove(entry)
+                    except ValueError:
+                        pass
+                if self._demand_entries:
+                    self._demand_cv.wait(timeout=0.05)  # tick while backlogged
+
+    def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
+        """Propagate a cancellation to wherever the task is queued/running.
+
+        The ``_cancelled`` flag (set by the caller) covers the
+        pre-dispatch window; this routes the running-task half: with
+        ``force`` the hosting worker process is killed (CancelTask
+        force_kill parity)."""
+        node = self.nodes.get(spec.owner_node)
+        if node is None or node.dead:
+            return
+        node.cancel_task(spec, force=force)
 
     def pending_resource_demands(self) -> List[Dict[str, float]]:
         """Resource shapes of currently-unschedulable work, for the
@@ -393,6 +498,8 @@ class Cluster:
                         self.directory.add_location(oid, self.head_node.node_id)
                     self.task_manager.mark_completed(spec)
                     self._record_task_event(spec, node, "FINISHED")
+                elif self._maybe_retry_actor_task(spec):
+                    return
                 else:
                     self.task_manager.mark_failed(spec)
                     self._commit_error_everywhere(spec, error)
@@ -400,13 +507,29 @@ class Cluster:
                 self._after_commit(spec)
             return
         if error is not None:
-            from ray_tpu.exceptions import OutOfMemoryError
+            from ray_tpu.exceptions import OutOfMemoryError, TaskCancelledError
 
+            if spec._cancelled and not isinstance(error, TaskCancelledError):
+                # a force-cancel kills the hosting worker: the death must
+                # surface as cancellation, not WorkerCrashedError, and must
+                # never retry
+                error = TaskCancelledError(spec.task_id)
             is_system = isinstance(error, (WorkerCrashedError, ActorDiedError, OutOfMemoryError))
             retry_exceptions = getattr(spec, "_retry_exceptions", False)
-            if spec.actor_id is None and self.task_manager.should_retry(spec, is_system, retry_exceptions):
+            if spec._cancelled:
+                pass  # cancelled tasks never retry
+            elif spec.actor_id is None and self.task_manager.should_retry(spec, is_system, retry_exceptions):
                 self.submit(spec)
                 return
+            elif spec.actor_id is not None and is_system and self._maybe_retry_actor_task(spec):
+                # max_task_retries: the actor is restarting (or alive again);
+                # transparently resubmit the in-flight call
+                # (task_manager.h:208 — owners resubmit in-flight methods)
+                return
+            if spec.actor_id is not None and isinstance(error, WorkerCrashedError):
+                # an actor call that died with its worker surfaces as an
+                # actor error, not a bare worker crash (RayActorError parity)
+                error = ActorDiedError(spec.actor_id, str(error))
             self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, error)
             self._after_commit(spec)
@@ -533,11 +656,18 @@ class Cluster:
     # ------------------------------------------------------------------
     # actors
     # ------------------------------------------------------------------
-    def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int, info, namespace: str = "default") -> None:
+    def create_actor(
+        self, spec: TaskSpec, mode: str, max_concurrency: int, info,
+        namespace: str = "default", max_task_retries: int = 0,
+    ) -> None:
         with self._actor_lock:
             self._actor_queues[spec.actor_id] = _ActorQueue()
             self._actor_specs[spec.actor_id] = spec
-            self._actor_options[spec.actor_id] = {"mode": mode, "max_concurrency": max_concurrency}
+            self._actor_options[spec.actor_id] = {
+                "mode": mode,
+                "max_concurrency": max_concurrency,
+                "max_task_retries": max_task_retries,
+            }
         self.control.actors.register(info, namespace=namespace)
         self._schedule_actor_creation(spec)
 
@@ -549,20 +679,10 @@ class Cluster:
         self._start_actor_on(node_id, spec)
 
     def _retry_actor_creation(self, spec: TaskSpec) -> None:
-        """Poll for feasibility off-thread (resources may free as actors die
-        or restarts settle); fail the creation after a deadline."""
-
-        def retry():
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline:
-                time.sleep(0.05)
-                nid = self.cluster_scheduler.pick_node(spec)
-                if nid is not None:
-                    self._start_actor_on(nid, spec)
-                    return
-            self.on_actor_creation_failed(spec, ActorDiedError(spec.actor_id, "actor creation infeasible"))
-
-        threading.Thread(target=retry, daemon=True).start()
+        """Actor creation is currently infeasible (resources may free as
+        actors die or restarts settle): park it on the shared demand queue;
+        the drainer fails it after the deadline."""
+        self._park_infeasible(spec, kind="actor")
 
     def _start_actor_on(self, node_id: NodeID, spec: TaskSpec) -> None:
         opts = self._actor_options[spec.actor_id]
@@ -642,8 +762,29 @@ class Cluster:
         self.control.actors.mark_dead(actor_id, "killed via kill_actor")
         self._fail_actor_queue(actor_id, ActorDiedError(actor_id, "The actor was killed"))
 
+    def _maybe_retry_actor_task(self, spec: TaskSpec) -> bool:
+        """max_task_retries: resubmit an in-flight actor call whose actor
+        died but is restarting (reference: owners resubmit in-flight methods
+        when max_task_retries is set — task_manager.h:208, SURVEY §3.3
+        step 5). Returns True if the retry was queued."""
+        info = self.control.actors.get(spec.actor_id)
+        if info is None or info.state is ActorState.DEAD:
+            return False
+        if not self.task_manager.should_retry(spec, is_system_error=True):
+            return False
+        self.submit_actor_task(spec, _is_retry=True)
+        return True
+
     # -- ordered per-actor call queue -----------------------------------
-    def submit_actor_task(self, spec: TaskSpec) -> None:
+    def submit_actor_task(self, spec: TaskSpec, _is_retry: bool = False) -> None:
+        if not _is_retry:
+            opts = self._actor_options.get(spec.actor_id)
+            if opts:
+                retries = opts.get("max_task_retries", 0)
+                if retries:
+                    # -1 = retry until the actor is permanently dead
+                    spec.max_retries = (1 << 30) if retries < 0 else retries
+                    spec.retries_left = spec.max_retries
         q = self._actor_queues.get(spec.actor_id)
         info = self.control.actors.get(spec.actor_id)
         if q is None or info is None or info.state is ActorState.DEAD:
@@ -723,6 +864,9 @@ class Cluster:
                 pass
 
     def shutdown(self) -> None:
+        with self._demand_cv:
+            self._demand_stop = True
+            self._demand_cv.notify_all()
         self._snapshot_stop.set()
         if self._snapshot_thread is not None:
             self._snapshot_thread.join(timeout=10)
@@ -753,9 +897,15 @@ class Cluster:
             dashboard.shutdown()
             self.dashboard = None
         self.control.shutdown()
+        # Remote handles first: proxy.shutdown marks them dead BEFORE the
+        # socket drops, so the disconnect callback doesn't run the
+        # node-failure path (resubmission) during teardown.
         for node in self.nodes.values():
             if not node.dead:
                 node.shutdown()
+        if self.head_service is not None:
+            self.head_service.close()
+            self.head_service = None
         if self.shm_store is not None:
             self.shm_store.close()
             self.shm_store.unlink()

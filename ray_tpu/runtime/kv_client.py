@@ -1,0 +1,110 @@
+"""Process-local handle to the cluster's internal KV store.
+
+The control service owns one InternalKV (``runtime/control.py``; reference:
+``GcsInternalKVManager``, ``src/ray/gcs/gcs_server/gcs_kv_manager.h``).  This
+module answers "how do I reach it from THIS process":
+
+  * driver process — direct in-process access to ``cluster.control.kv``;
+  * node-agent process — the ``kv_put``/``kv_get``/``kv_del`` RPCs on the
+    agent's head connection (``runtime/remote_node.py`` handlers).
+
+Gang rendezvous (jax.distributed coordinator exchange) and the cross-process
+collective rendezvous ride this; the reference uses a named NCCL-unique-id
+store actor for the same role
+(``python/ray/util/collective/collective.py`` rendezvous).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class KVClient:
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+
+class _ControlKV(KVClient):
+    """Driver-side: the control service lives in this process."""
+
+    def __init__(self, kv):
+        self._kv = kv
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._kv.put(key, value)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._kv.get(key)
+
+    def delete(self, key: bytes) -> None:
+        self._kv.delete(key)
+
+
+class _RpcKV(KVClient):
+    """Agent-side: KV ops over the head connection."""
+
+    def __init__(self, conn):
+        self._conn = conn
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._conn.request("kv_put", {"key": key, "value": value})
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._conn.request("kv_get", {"key": key}).get("value")
+
+    def delete(self, key: bytes) -> None:
+        self._conn.request("kv_del", {"key": key})
+
+
+_lock = threading.Lock()
+_agent_conn = None
+
+
+def register_agent_kv(conn) -> None:
+    """Called by the node agent at startup: this process reaches the KV over
+    the head connection."""
+    global _agent_conn
+    with _lock:
+        _agent_conn = conn
+
+
+def get_kv() -> Optional[KVClient]:
+    with _lock:
+        if _agent_conn is not None and not _agent_conn.closed:
+            return _RpcKV(_agent_conn)
+    try:
+        from ray_tpu import api
+
+        if api.is_initialized():
+            return _ControlKV(api.get_cluster().control.kv)
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def is_multiprocess() -> bool:
+    """True when collective/rendezvous state must go through the shared KV
+    (this process is an agent, or the cluster has remote nodes) rather than
+    process-local memory."""
+    with _lock:
+        if _agent_conn is not None and not _agent_conn.closed:
+            return True
+    try:
+        from ray_tpu import api
+
+        if api.is_initialized():
+            from ray_tpu.runtime.remote_node import RemoteNodeHandle
+
+            return any(
+                isinstance(n, RemoteNodeHandle) for n in api.get_cluster().nodes.values()
+            )
+    except Exception:  # noqa: BLE001
+        pass
+    return False

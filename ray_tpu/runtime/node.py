@@ -260,6 +260,21 @@ class Node:
             return  # stolen by a waiter
         self._run_inproc(spec)
 
+    def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
+        """Running-task cancellation.  A queued inproc task is claimed and
+        committed cancelled immediately; with ``force`` a task running in a
+        process worker has its worker killed (the commit path maps the death
+        to TaskCancelledError via spec._cancelled)."""
+        task_bin = spec.task_id.binary()
+        claimed = self._claim_inproc(task_bin)
+        if claimed is not None:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            self._commit(claimed, None, TaskCancelledError(claimed.task_id))
+            return
+        if force and task_bin in self._proc_specs:
+            self.worker_pool.kill_task_worker(task_bin)
+
     def steal_task(self, task_bin: bytes) -> bool:
         """A waiter executes the queued inproc task inline on its own
         thread. Returns True if the task was run here."""

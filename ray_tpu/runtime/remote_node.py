@@ -1,0 +1,451 @@
+"""Head-side half of the multi-host fabric.
+
+A node agent (``ray_tpu.runtime.agent``) connecting over the transport
+(``runtime/rpc.py``) materializes here as a :class:`RemoteNodeHandle` — an
+object implementing the same surface as :class:`ray_tpu.runtime.node.Node`,
+so the cluster fabric (scheduler, actor FSM, object directory, chaos hooks)
+treats in-process and remote nodes identically.
+
+Role parity with the reference's head-side view of a raylet: the GCS node
+table + the ``NodeManagerService`` client stubs
+(``src/ray/gcs/gcs_server/gcs_server.h:78``,
+``src/ray/protobuf/node_manager.proto:371-433``) and the ray_syncer resource
+view (``src/ray/common/ray_syncer/ray_syncer.h:88``) — here one duplex
+connection carries leases (task dispatch), actor lifecycle, object movement
+and resource reports.
+
+Resource accounting: the head schedules against a :class:`MirrorPool` — the
+head's view of the agent's real pool.  Every head-initiated acquire/release
+(actor placement, placement-group 2PC) is applied locally AND echoed to the
+agent, so the agent's authoritative pool sees the same deltas its own local
+scheduler does; periodic ``resource_report`` messages reconcile any drift
+(the ray_syncer role).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.resources import ResourcePool, ResourceSet
+from ray_tpu.runtime import rpc
+from ray_tpu.runtime.scheduler import TaskSpec
+
+
+class MirrorPool(ResourcePool):
+    """Head-side mirror of a remote node's resource pool.
+
+    Head-initiated mutations forward to the agent (one-way; the agent
+    force-applies them), keeping the remote authoritative pool consistent
+    with placement decisions made here."""
+
+    def __init__(self, total, send: Callable[[str, dict], None]):
+        super().__init__(total)
+        self._send = send
+
+    def _forward(self, op: str, rset: ResourceSet) -> None:
+        try:
+            self._send("pool_update", {"op": op, "resources": rset.fixed()})
+        except rpc.RpcError:
+            pass  # node death is handled by the disconnect path
+
+    def acquire(self, request: ResourceSet) -> bool:
+        ok = super().acquire(request)
+        if ok:
+            self._forward("acquire", request)
+        return ok
+
+    def release(self, request: ResourceSet) -> None:
+        super().release(request)
+        self._forward("release", request)
+
+    def add_capacity(self, extra: ResourceSet) -> None:
+        super().add_capacity(extra)
+        self._forward("add_capacity", extra)
+
+    def remove_capacity(self, extra: ResourceSet) -> None:
+        super().remove_capacity(extra)
+        self._forward("remove_capacity", extra)
+
+    # -- reconciliation (resource_report) ---------------------------------
+    def reconcile(self, total_fixed: Dict[str, int], available_fixed: Dict[str, int]) -> None:
+        with self._lock:
+            self.total = ResourceSet.from_fixed_dict(total_fixed)
+            self._available = dict(available_fixed)
+
+
+class RemoteStore(ObjectStore):
+    """The head's cache of a remote node's objects.
+
+    ``put`` pushes the value to the agent as well (object-manager ``Push``
+    parity) so dependencies staged here before an actor/task dispatch are
+    readable by the remote executor; values that ORIGINATED on the agent
+    (task results it already stored locally) are marked via
+    :meth:`skip_push_once` so they don't echo back across the wire.
+    ``get`` falls back to fetching from the agent when the head cache
+    doesn't hold the bytes (``Pull`` parity)."""
+
+    def __init__(self, handle: "RemoteNodeHandle"):
+        super().__init__(shm_store=None)
+        self._handle = handle
+        self._skip_push: set = set()
+        self._skip_lock = threading.Lock()
+
+    def skip_push_once(self, oid: ObjectID) -> None:
+        with self._skip_lock:
+            self._skip_push.add(oid)
+
+    def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
+        super().put(object_id, value, is_error=is_error)
+        with self._skip_lock:
+            if object_id in self._skip_push:
+                self._skip_push.discard(object_id)
+                return
+        if not self._handle.dead:
+            try:
+                self._handle.conn.send(
+                    "push_object",
+                    {"oid": object_id.binary(), **rpc.encode_value(value, is_error)},
+                )
+            except rpc.RpcError:
+                pass
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        if self.contains(object_id):
+            return super().get(object_id, timeout=timeout)
+        if self._handle.dead:
+            return super().get(object_id, timeout=timeout)
+        # fetch from the agent (its local store is a valid location)
+        reply = self._handle.conn.request(
+            "fetch_object", {"oid": object_id.binary()}, timeout=timeout or 30.0
+        )
+        value, is_error = rpc.decode_value(reply)
+        self.skip_push_once(object_id)
+        super().put(object_id, value, is_error=is_error)
+        return value
+
+    def delete(self, object_id: ObjectID) -> None:
+        super().delete(object_id)
+        if not self._handle.dead:
+            try:
+                self._handle.conn.send("delete_object", {"oid": object_id.binary()})
+            except rpc.RpcError:
+                pass
+
+
+class _RemoteSchedulerView:
+    """queue_len/stats view fed by resource reports."""
+
+    def __init__(self):
+        self._queue_len = 0
+        self._stats: dict = {}
+
+    def queue_len(self) -> int:
+        return self._queue_len
+
+    def stats(self) -> dict:
+        return dict(self._stats)
+
+
+class _NullWorkerPool:
+    """Head-side stub: direct-slot handoff / inflight inspection are local
+    optimizations that don't exist across the wire."""
+
+    def register_direct_waiter(self, task_bin: bytes):
+        return None
+
+    def cancel_direct_waiter(self, task_bin: bytes, slot) -> None:
+        pass
+
+    def inflight_tasks(self):
+        return []
+
+
+class RemoteNodeHandle:
+    """Node-surface proxy for an agent process (see module docstring)."""
+
+    def __init__(self, cluster, conn: rpc.RpcConnection, node_id: NodeID,
+                 resources: Dict[str, float], labels: Optional[dict], address: str):
+        self.cluster = cluster
+        self.conn = conn
+        self.node_id = node_id
+        self.labels = labels or {}
+        self.address = address
+        self.dead = False
+        self.pool = MirrorPool(resources, self._send)
+        self.store = RemoteStore(self)
+        self.scheduler = _RemoteSchedulerView()
+        self.worker_pool = _NullWorkerPool()
+        self._inflight: Dict[bytes, TaskSpec] = {}   # task_id -> head-side spec
+        self._inflight_lock = threading.Lock()
+        self._sent_fns: set = set()
+        self.last_report = time.monotonic()
+
+    # ------------------------------------------------------------------
+    def _send(self, msg_type: str, payload: dict) -> None:
+        self.conn.send(msg_type, payload)
+
+    def _encode(self, spec: TaskSpec) -> dict:
+        return rpc.encode_spec(spec, self._function_blob, self._sent_fns)
+
+    def _function_blob(self, func):  # reuse Node's cached cloudpickle path
+        from ray_tpu.runtime.node import Node
+
+        return Node._function_blob(self, func)
+
+    def _track(self, spec: TaskSpec) -> None:
+        with self._inflight_lock:
+            self._inflight[spec.task_id.binary()] = spec
+
+    def _untrack(self, task_bin: bytes) -> Optional[TaskSpec]:
+        with self._inflight_lock:
+            return self._inflight.pop(task_bin, None)
+
+    def _lookup(self, task_bin: bytes) -> Optional[TaskSpec]:
+        with self._inflight_lock:
+            return self._inflight.get(task_bin)
+
+    # ------------------------------------------------------------------
+    # Node surface (what the cluster fabric calls)
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> None:
+        spec.owner_node = self.node_id
+        self._track(spec)
+        try:
+            self._send("submit_task", {"spec": self._encode(spec)})
+        except rpc.RpcError:
+            self._untrack(spec.task_id.binary())
+            raise
+
+    def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int = 1) -> None:
+        self._track(spec)
+        try:
+            self._send(
+                "create_actor",
+                {"spec": self._encode(spec), "mode": mode, "max_concurrency": max_concurrency},
+            )
+        except rpc.RpcError:
+            self._untrack(spec.task_id.binary())
+            raise
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        spec.owner_node = self.node_id
+        self._track(spec)
+        try:
+            self._send("submit_actor_task", {"spec": self._encode(spec)})
+        except rpc.RpcError:
+            self._untrack(spec.task_id.binary())
+            raise
+
+    def kill_actor(self, actor_id: ActorID, restart: bool = False) -> None:
+        if self.dead:
+            return
+        try:
+            self._send("kill_actor", {"actor_id": actor_id.binary()})
+        except rpc.RpcError:
+            pass
+
+    def steal_task(self, task_bin: bytes) -> bool:
+        return False  # inline stealing is a same-process optimization
+
+    def kill_candidates(self):
+        return []  # the agent runs its own memory monitor
+
+    def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
+        if self.dead:
+            return
+        try:
+            self._send("cancel_task", {"task_id": spec.task_id.binary(), "force": force})
+        except rpc.RpcError:
+            pass
+
+    def shutdown(self) -> None:
+        self.dead = True
+        try:
+            self.conn.send("shutdown", {})
+        except rpc.RpcError:
+            pass
+        self.conn.close()
+
+    # ------------------------------------------------------------------
+    # agent -> head message handling (called by HeadService)
+    # ------------------------------------------------------------------
+    def on_task_finished_msg(self, payload: dict) -> None:
+        spec = self._untrack(payload["task_id"])
+        if spec is None:
+            return  # already resolved (e.g. node-death resubmission raced)
+        error = None
+        result = None
+        if payload.get("error") is not None:
+            error, _ = rpc.decode_value(payload["error"])
+        else:
+            result, _ = rpc.decode_value(payload["value"])
+            # the agent stored the returns locally before reporting: mark
+            # them so the head-cache put doesn't echo the bytes back
+            for oid in spec.return_ids:
+                self.store.skip_push_once(oid)
+        self.cluster.on_task_finished(self, spec, result, error)
+
+    def on_stream_item_msg(self, payload: dict) -> None:
+        spec = self._lookup(payload["task_id"])
+        if spec is None:
+            return
+        value, is_error = rpc.decode_value(payload["value"])
+        self.cluster.on_stream_item(self, spec, payload["index"], value, is_error=is_error)
+
+    def on_stream_done_msg(self, payload: dict) -> None:
+        spec = self._untrack(payload["task_id"])
+        if spec is None:
+            return
+        error = None
+        if payload.get("error") is not None:
+            error, _ = rpc.decode_value(payload["error"])
+        self.cluster.on_stream_done(self, spec, payload["index"], error)
+
+    def on_actor_created_msg(self, payload: dict) -> None:
+        spec = self._untrack(payload["task_id"])
+        if spec is not None:
+            self.cluster.on_actor_created(self, spec)
+
+    def on_actor_creation_failed_msg(self, payload: dict) -> None:
+        spec = self._untrack(payload["task_id"])
+        if spec is None:
+            return
+        error, _ = rpc.decode_value(payload["error"])
+        self.cluster.on_actor_creation_failed(spec, error)
+
+    def on_actor_died_msg(self, payload: dict) -> None:
+        self.cluster.on_actor_process_died(self, ActorID(payload["actor_id"]))
+
+    def on_resource_report(self, payload: dict) -> None:
+        self.pool.reconcile(payload["total"], payload["available"])
+        self.scheduler._queue_len = payload.get("queue_len", 0)
+        self.scheduler._stats = payload.get("stats", {})
+        self.last_report = time.monotonic()
+        self.cluster.control.nodes.heartbeat(
+            self.node_id,
+            ResourceSet.from_fixed_dict(payload["available"]).to_dict(),
+        )
+
+
+class HeadService:
+    """The head's TCP control-plane service: accepts node agents, binds each
+    to a :class:`RemoteNodeHandle`, and serves the cluster-side APIs they
+    need (object pulls, the internal KV for gang rendezvous).
+
+    Role parity: the GCS server process (``gcs_server.h:78``) plus the head
+    raylet's object-manager endpoints."""
+
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster
+        self.server = rpc.RpcServer(
+            host=host, port=port,
+            handler_factory=self._handlers_for,
+            on_disconnect=self._on_disconnect,
+            name="head",
+        )
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def close(self) -> None:
+        self.server.close()
+
+    # ------------------------------------------------------------------
+    def _handlers_for(self, conn: rpc.RpcConnection) -> dict:
+        return {
+            "register_node_config": self._h_register_config,
+            "register_node": self._h_register,
+            "task_finished": lambda c, p: c.peer.on_task_finished_msg(p),
+            "stream_item": lambda c, p: c.peer.on_stream_item_msg(p),
+            "stream_done": lambda c, p: c.peer.on_stream_done_msg(p),
+            "actor_created": lambda c, p: c.peer.on_actor_created_msg(p),
+            "actor_creation_failed": lambda c, p: c.peer.on_actor_creation_failed_msg(p),
+            "actor_died": lambda c, p: c.peer.on_actor_died_msg(p),
+            "resource_report": lambda c, p: c.peer.on_resource_report(p),
+            "pull_object": self._h_pull_object,
+            "kv_put": self._h_kv_put,
+            "kv_get": self._h_kv_get,
+            "kv_del": self._h_kv_del,
+            "log_batch": self._h_log_batch,
+            "ping": lambda c, p, rid=None: {},
+        }
+
+    def _h_register_config(self, conn: rpc.RpcConnection, payload: dict, rid: int) -> dict:
+        import dataclasses
+
+        from ray_tpu.core.config import get_config
+
+        return {"config": dataclasses.asdict(get_config())}
+
+    def _h_register(self, conn: rpc.RpcConnection, payload: dict, rid: int) -> dict:
+        handle = RemoteNodeHandle(
+            self.cluster, conn, NodeID(payload["node_id"]),
+            resources=payload["resources"],
+            labels=payload.get("labels"),
+            address=payload.get("address", "?"),
+        )
+        conn.peer = handle
+        self.cluster.register_remote_node(handle)
+        return {}
+
+    def _h_pull_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
+        """An agent needs an object for a task dependency.  Resolve through
+        the owner directory (pull into the head-side cache of that node),
+        then ship the bytes."""
+        handle: RemoteNodeHandle = conn.peer
+        oid = ObjectID(payload["oid"])
+
+        def on_local():
+            try:
+                # the value landed in handle.store (the pull's destination);
+                # read it WITHOUT the remote-fetch fallback — it's local now
+                value = ObjectStore.get(handle.store, oid, timeout=30)
+                info = handle.store.entry_info(oid)
+                conn.send_reply(rid, rpc.encode_value(value, bool(info and info["is_error"])))
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                conn.send_reply(rid, {"_exc": traceback.format_exc()})
+
+        # Destination = the requesting node's head-side cache. skip_push:
+        # the reply itself carries the bytes; pushing would double-send.
+        handle.store.skip_push_once(oid)
+        self.cluster.pull_object(oid, handle, on_local)
+        return rpc.DEFER
+
+    def _h_kv_put(self, conn, payload, rid=None):
+        self.cluster.control.kv.put(
+            payload["key"], payload["value"], overwrite=payload.get("overwrite", True)
+        )
+        return {}
+
+    def _h_kv_get(self, conn, payload, rid=None):
+        return {"value": self.cluster.control.kv.get(payload["key"])}
+
+    def _h_kv_del(self, conn, payload, rid=None):
+        self.cluster.control.kv.delete(payload["key"])
+        return {}
+
+    def _h_log_batch(self, conn, payload) -> None:
+        import sys
+
+        node = conn.peer.node_id.hex()[:8] if conn.peer else "?"
+        for line in payload.get("lines", ()):
+            print(f"(node={node}) {line}", file=sys.stderr)
+
+    # ------------------------------------------------------------------
+    def _on_disconnect(self, conn: rpc.RpcConnection) -> None:
+        handle: Optional[RemoteNodeHandle] = conn.peer
+        if handle is None or handle.dead:
+            return
+        # Socket death IS the failure detector (the reference health-checks
+        # over gRPC, gcs_health_check_manager.h:39; a dead TCP session is
+        # the same signal with no polling). kill_node runs the full
+        # node-failure path: resubmit pending, recover objects, restart
+        # actors.
+        self.cluster.kill_node(handle.node_id)

@@ -1,0 +1,421 @@
+"""TCP control-plane transport: the wire between the head and node agents.
+
+This is the transport layer the rest of the runtime rides when a node is a
+separate OS process on (possibly) a separate machine.  Role parity with the
+reference's gRPC plumbing (``src/ray/rpc/grpc_server.h``,
+``src/ray/rpc/client_call.h``) and the raylet<->GCS session it carries
+(``src/ray/protobuf/node_manager.proto:371-433``,
+``src/ray/gcs/gcs_server/gcs_server.h:78``) — re-designed small: one duplex
+TCP connection per node carries requests in BOTH directions (the head pushes
+dispatch; the agent pushes results, pulls, resource reports), instead of the
+reference's 2N unary channels.
+
+Framing reuses the worker-pool protocol (``runtime/protocol.py``): 4-byte
+length + pickle-5 ``(msg_type, payload)``.  Three message shapes:
+
+  * one-way:      ``send(type, payload)`` — no reply expected,
+  * request:      ``request(type, payload)`` — payload carries ``_rid``; the
+                  peer replies with ``("__reply__", {"_rid": rid, ...})``,
+  * deferred:     a handler returns :data:`DEFER` and later calls
+                  ``conn.send_reply(rid, payload)`` (used by object pulls,
+                  which resolve asynchronously through the object directory).
+
+Ordering: inbound messages dispatch on ONE thread per connection, in arrival
+order — per-actor call ordering and stream-item ordering therefore hold
+end-to-end without sequence numbers (the reference needs them because its
+calls fan out over concurrent gRPC streams).  Replies are matched and run on
+the reader thread so a blocked dispatch thread can still receive its answer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import queue
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+
+#: Sentinel a handler returns to take ownership of replying later.
+DEFER = object()
+
+
+class RpcError(ConnectionError):
+    """Transport-level failure (peer died, handler raised)."""
+
+
+class RemoteHandlerError(RpcError):
+    """The peer's handler raised; carries the remote traceback."""
+
+
+class RpcConnection:
+    """One duplex framed-pickle connection; thread-safe sends."""
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        handlers: Dict[str, Callable],
+        on_disconnect: Optional[Callable[["RpcConnection"], None]] = None,
+        name: str = "rpc",
+        defer_dispatch: bool = False,
+    ):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._handlers = handlers
+        self._on_disconnect = on_disconnect
+        self._name = name
+        self._send_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: Dict[int, Callable] = {}  # rid -> callback(payload, error)
+        self._pending_lock = threading.Lock()
+        self._inbox: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._closed = threading.Event()
+        self.peer: Any = None  # slot for the owner to hang state on
+        self._reader = threading.Thread(target=self._read_loop, name=f"{name}-reader", daemon=True)
+        self._dispatcher = threading.Thread(target=self._dispatch_loop, name=f"{name}-dispatch", daemon=True)
+        self._reader.start()
+        if not defer_dispatch:
+            self._dispatcher.start()
+
+    def start_dispatch(self) -> None:
+        """Start inbound dispatch after the owner finished installing
+        handlers (messages received meanwhile queue in arrival order)."""
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------
+    # sending
+    # ------------------------------------------------------------------
+    def _send_frame(self, msg_type: str, payload: dict) -> None:
+        data = pickle.dumps((msg_type, payload), protocol=5)
+        with self._send_lock:
+            self._sock.sendall(_LEN.pack(len(data)) + data)
+
+    def send(self, msg_type: str, payload: dict) -> None:
+        """One-way notification."""
+        try:
+            self._send_frame(msg_type, payload)
+        except OSError as exc:
+            self._teardown()
+            raise RpcError(f"connection lost during send: {exc}") from exc
+
+    def request(self, msg_type: str, payload: dict, timeout: Optional[float] = 30.0) -> dict:
+        """Blocking request/response."""
+        result: list = [None, None]
+        done = threading.Event()
+
+        def cb(reply, error):
+            result[0], result[1] = reply, error
+            done.set()
+
+        self.request_async(msg_type, payload, cb)
+        if not done.wait(timeout):
+            raise RpcError(f"rpc {msg_type} timed out after {timeout}s")
+        if result[1] is not None:
+            raise result[1]
+        return result[0]
+
+    def request_async(self, msg_type: str, payload: dict, callback: Callable) -> None:
+        """Fire a request; ``callback(reply, error)`` runs on the reader
+        thread when the response lands (or on teardown with an RpcError)."""
+        rid = next(self._rid)
+        with self._pending_lock:
+            if self._closed.is_set():
+                callback(None, RpcError("connection closed"))
+                return
+            self._pending[rid] = callback
+        payload = dict(payload)
+        payload["_rid"] = rid
+        try:
+            self._send_frame(msg_type, payload)
+        except OSError as exc:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            self._teardown()
+            callback(None, RpcError(f"connection lost: {exc}"))
+
+    def send_reply(self, rid: int, payload: dict) -> None:
+        payload = dict(payload)
+        payload["_rid"] = rid
+        try:
+            self._send_frame("__reply__", payload)
+        except OSError:
+            self._teardown()
+
+    # ------------------------------------------------------------------
+    # receiving
+    # ------------------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                header = self._recv_exact(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                data = self._recv_exact(length)
+                msg_type, payload = pickle.loads(data)
+                if msg_type == "__reply__":
+                    rid = payload.pop("_rid", None)
+                    with self._pending_lock:
+                        cb = self._pending.pop(rid, None)
+                    if cb is not None:
+                        exc_text = payload.get("_exc")
+                        if exc_text is not None:
+                            cb(None, RemoteHandlerError(exc_text))
+                        else:
+                            cb(payload, None)
+                else:
+                    self._inbox.put((msg_type, payload))
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            self._teardown()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                return
+            msg_type, payload = item
+            rid = payload.pop("_rid", None)
+            handler = self._handlers.get(msg_type)
+            try:
+                if handler is None:
+                    raise KeyError(f"no handler for rpc message {msg_type!r}")
+                result = handler(self, payload) if rid is None else handler(self, payload, rid)
+                if rid is not None and result is not DEFER:
+                    self.send_reply(rid, result if isinstance(result, dict) else {})
+            except Exception:  # noqa: BLE001 — a bad message must not kill the link
+                if rid is not None:
+                    self.send_reply(rid, {"_exc": traceback.format_exc()})
+                else:
+                    import sys
+
+                    print(
+                        f"[{self._name}] handler for {msg_type!r} failed:\n{traceback.format_exc()}",
+                        file=sys.stderr,
+                    )
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = self._sock.recv(min(n - got, 1 << 20))
+            if not chunk:
+                raise ConnectionError("socket closed")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    # ------------------------------------------------------------------
+    def _teardown(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._inbox.put(None)
+        with self._pending_lock:
+            pending = list(self._pending.items())
+            self._pending.clear()
+        for _rid, cb in pending:
+            try:
+                cb(None, RpcError("connection closed"))
+            except Exception:  # noqa: BLE001
+                pass
+        cb = self._on_disconnect
+        self._on_disconnect = None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class RpcServer:
+    """Accept loop creating an :class:`RpcConnection` per client.
+
+    ``handler_factory(conn)`` returns the handler dict for that connection
+    (letting the owner bind per-connection state before any message lands).
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        handler_factory: Callable[[RpcConnection], Dict[str, Callable]] = None,
+        on_disconnect: Optional[Callable[[RpcConnection], None]] = None,
+        name: str = "rpc-server",
+    ):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._factory = handler_factory
+        self._on_disconnect = on_disconnect
+        self._name = name
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._closed = False
+        threading.Thread(target=self._accept_loop, name=f"{name}-accept", daemon=True).start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            handlers: Dict[str, Callable] = {}
+            conn = RpcConnection(
+                sock, handlers, on_disconnect=self._on_disconnect,
+                name=self._name, defer_dispatch=True,
+            )
+            handlers.update(self._factory(conn))
+            conn.start_dispatch()
+            with self._lock:
+                self._conns.append(conn)
+
+    def connections(self) -> list:
+        with self._lock:
+            return [c for c in self._conns if not c.closed]
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            conn.close()
+
+
+def connect(
+    address: str,
+    handlers: Dict[str, Callable],
+    on_disconnect: Optional[Callable] = None,
+    timeout: float = 10.0,
+    name: str = "rpc-client",
+) -> RpcConnection:
+    host, _, port = address.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
+    sock.settimeout(None)
+    return RpcConnection(sock, handlers, on_disconnect=on_disconnect, name=name)
+
+
+# ==========================================================================
+# TaskSpec wire codec
+# ==========================================================================
+# The reference serializes TaskSpecs as protobuf (src/ray/protobuf/common.proto:408
+# ``TaskSpec``); here the spec's control fields ride as a plain dict and the
+# function/args ride as pickle-5 blobs.  Function bodies are content-addressed
+# (blake2b of the cloudpickle blob) and sent at most once per connection —
+# FunctionManager-over-GCS-KV parity (python/ray/_private/function_manager.py)
+# without the extra KV round trip.
+
+def encode_spec(spec, fn_blob_fn, sent_fns: set) -> dict:
+    """Encode a TaskSpec for the wire.  ``fn_blob_fn(func) -> (fn_id, blob)``
+    is Node._function_blob-compatible; ``sent_fns`` tracks fn_ids this
+    connection has already shipped."""
+    try:
+        args_blob = pickle.dumps((spec.args, spec.kwargs), protocol=5)
+    except (AttributeError, TypeError, pickle.PicklingError):
+        import cloudpickle
+
+        args_blob = cloudpickle.dumps((spec.args, spec.kwargs), protocol=5)
+    d = {
+        "task_id": spec.task_id.binary(),
+        "name": spec.name,
+        "args_blob": args_blob,
+        "deps": [dep.binary() for dep in spec.dependencies],
+        "num_returns": spec.num_returns,
+        "return_ids": [oid.binary() for oid in spec.return_ids],
+        "resources": spec.resources.fixed(),
+        "max_retries": spec.max_retries,
+        "retries_left": spec.retries_left,
+        "execution": spec.execution,
+        "attempt": spec.attempt,
+        "actor_id": spec.actor_id.binary() if spec.actor_id is not None else None,
+        "actor_method": spec.actor_method,
+        "is_actor_creation": spec.is_actor_creation,
+        "runtime_env": spec.runtime_env,
+    }
+    if spec.func is not None:
+        fn_id, blob = fn_blob_fn(spec.func)
+        d["fn_id"] = fn_id
+        if fn_id not in sent_fns:
+            d["fn_blob"] = blob
+            sent_fns.add(fn_id)
+    return d
+
+
+def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
+    """Rebuild a TaskSpec on the agent.  ``fn_cache`` maps fn_id -> callable
+    and is fed by the ``fn_blob`` field when present."""
+    from ray_tpu.core.ids import ActorID, ObjectID, TaskID
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu.runtime.scheduler import TaskSpec
+
+    func = None
+    fn_id = d.get("fn_id")
+    if fn_id is not None:
+        blob = d.get("fn_blob")
+        if blob is not None and fn_id not in fn_cache:
+            fn_cache[fn_id] = pickle.loads(blob)
+        func = fn_cache[fn_id]
+    args, kwargs = pickle.loads(d["args_blob"])
+    spec = TaskSpec(
+        task_id=TaskID(d["task_id"]),
+        name=d["name"],
+        func=func,
+        args=args,
+        kwargs=kwargs,
+        dependencies=[ObjectID(b) for b in d["deps"]],
+        num_returns=d["num_returns"],
+        return_ids=[ObjectID(b) for b in d["return_ids"]],
+        resources=ResourceSet.from_fixed_dict(d["resources"]),
+        max_retries=d["max_retries"],
+        execution=d["execution"],
+        actor_id=ActorID(d["actor_id"]) if d["actor_id"] is not None else None,
+        actor_method=d["actor_method"],
+        is_actor_creation=d["is_actor_creation"],
+        runtime_env=d["runtime_env"],
+    )
+    spec.retries_left = d["retries_left"]
+    spec.attempt = d["attempt"]
+    return spec
+
+
+def encode_value(value: Any, is_error: bool = False) -> dict:
+    """Encode a task result / object value for the wire."""
+    try:
+        blob = pickle.dumps(value, protocol=5)
+    except (AttributeError, TypeError, pickle.PicklingError):
+        import cloudpickle
+
+        blob = cloudpickle.dumps(value, protocol=5)
+    return {"value_blob": blob, "is_error": is_error}
+
+
+def decode_value(d: dict) -> Tuple[Any, bool]:
+    return pickle.loads(d["value_blob"]), d.get("is_error", False)

@@ -131,6 +131,7 @@ class CoreWorker:
         class_name: str = "",
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
+        max_task_retries: int = 0,
         max_concurrency: int = 1,
         mode: str = "process",
         scheduling_strategy: Any = None,
@@ -154,7 +155,10 @@ class CoreWorker:
         )
         self.ref_counter.add_submitted_task_references([r.id() for r in deps])
         info = ActorInfo(actor_id, name, max_restarts, self.job_id, class_name)
-        self.cluster.create_actor(spec, mode, max_concurrency, info, namespace=namespace)
+        self.cluster.create_actor(
+            spec, mode, max_concurrency, info,
+            namespace=namespace, max_task_retries=max_task_retries,
+        )
         return actor_id
 
     def submit_actor_task(

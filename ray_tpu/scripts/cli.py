@@ -52,6 +52,18 @@ def _get(address: str, path: str):
 def cmd_start(args) -> int:
     import ray_tpu as rt
 
+    if getattr(args, "address", None):
+        # agent mode: join an existing head as a worker node
+        # (``ray start --address`` parity, python/ray/scripts/scripts.py:568)
+        from ray_tpu.runtime.agent import main as agent_main
+
+        agent_args = ["--address", args.address, "--resources", args.resources, "--labels", args.labels]
+        if args.num_cpus is not None:
+            agent_args += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            agent_args += ["--num-tpus", str(args.num_tpus)]
+        return agent_main(agent_args)
+
     rt.init(
         num_cpus=args.num_cpus,
         num_tpus=args.num_tpus,
@@ -64,8 +76,17 @@ def cmd_start(args) -> int:
         "pid": os.getpid(),
         "session_dir": cluster.session_dir,
     }
+    if getattr(args, "head", False):
+        bound = cluster.start_head_service(host="0.0.0.0", port=args.port)
+        # advertise a routable IP, not the 0.0.0.0 bind address (copying the
+        # printed join command to another machine must just work)
+        from ray_tpu.parallel.distributed import _routable_ip
+
+        info["node_address"] = f"{_routable_ip()}:{bound.rsplit(':', 1)[1]}"
     _write_address_file(info)
     print(f"ray_tpu head started. Dashboard: {cluster.dashboard.url}")
+    if "node_address" in info:
+        print(f"Join more nodes with: ray_tpu start --address {info['node_address']}")
     print(f"Submit jobs with: python -m ray_tpu job submit --address {cluster.dashboard.url} -- <cmd>")
 
     # `rt stop` sends SIGTERM (SIGINT is ignored by shells' background jobs).
@@ -257,79 +278,20 @@ def cmd_serve(args) -> int:
 
 
 def cmd_microbenchmark(args) -> int:
-    """In-process microbenchmark suite (``ray microbenchmark`` parity,
-    driving the same cases as ``ray_perf.py``)."""
+    """Microbenchmark suite (``ray microbenchmark`` parity: the ray_perf.py
+    metric set, plus the TPU-native shm / host<->HBM bandwidth axes)."""
     import ray_tpu as rt
+    from ray_tpu.scripts.microbench import BASELINES, run_suite
 
     rt.init(num_cpus=args.num_cpus)
 
-    @rt.remote
-    def noop():
-        return None
+    def progress(name, value, unit):
+        base = BASELINES.get(name)
+        vs = f"{value / base[0]:7.2f}x vs ref" if base else ""
+        print(f"{name:42s} {value:14.1f} {unit:>8s} {vs}")
 
-    @rt.remote
-    class A:
-        def m(self):
-            return None
-
-    def bench(name, fn, n):
-        for _ in range(min(100, n // 10)):
-            fn()
-        t0 = time.perf_counter()
-        for _ in range(n):
-            fn()
-        dt = time.perf_counter() - t0
-        print(f"{name:45s} {n / dt:12.1f} /s")
-
-    bench("single_client_tasks_sync", lambda: rt.get(noop.remote()), 2000)
-    bench("single_client_tasks_async(batch 100)", lambda: rt.get([noop.remote() for _ in range(100)]), 30)
-    a = A.remote()
-    rt.get(a.m.remote())
-    bench("1_1_actor_calls_sync", lambda: rt.get(a.m.remote()), 2000)
-    bench("1_1_actor_calls_async(batch 100)", lambda: rt.get([a.m.remote() for _ in range(100)]), 30)
-    import numpy as np
-
-    arr = np.zeros(1024 * 1024, dtype=np.uint8)
-    bench("put_1MiB", lambda: rt.put(arr), 500)
-
-    # Put/Get GB/s on 1 GiB objects (reference single_client_put_gigabytes:
-    # 20.1 GB/s via plasma). The driver-side store holds objects by
-    # reference (zero copy); the shm path measures the worker-visible tier.
-    big = np.zeros(1 << 30, dtype=np.uint8)
-
-    def put_get_gb():
-        r = rt.put(big)
-        out = rt.get(r)
-        assert out.nbytes == big.nbytes
-
-    t0 = time.perf_counter()
-    for _ in range(4):
-        put_get_gb()
-    dt = time.perf_counter() - t0
-    # by-reference store: no bytes move — report op rate, not a fake GB/s
-    print(f"{'put+get_1GiB (driver store, zero-copy)':45s} {4 / dt:12.1f} ops/s")
-
-    shm = rt.get_cluster().shm_store
-    if shm is not None:
-        half = np.zeros(1 << 29, dtype=np.uint8)  # fit comfortably in the arena
-        oid_counter = [0]
-
-        def shm_roundtrip():
-            oid_counter[0] += 1
-            oid = oid_counter[0].to_bytes(20, "little")
-            shm.put(oid, memoryview(half), meta_size=0)
-            view, _meta = shm.get(oid)
-            assert len(view) == half.nbytes
-            shm.release(oid)
-            shm.delete(oid)
-
-        t0 = time.perf_counter()
-        for _ in range(4):
-            shm_roundtrip()
-        dt = time.perf_counter() - t0
-        # one 512 MiB copy per iteration (put memcpy; get is a zero-copy view)
-        copied_gb = 4 * half.nbytes / 1e9
-        print(f"{'put_512MiB copy bw (native shm tier)':45s} {copied_gb / dt:12.1f} GB/s")
+    select = args.only.split(",") if args.only else None
+    run_suite(rt, select=select, quick=args.quick, progress=progress)
     rt.shutdown()
     return 0
 
@@ -359,6 +321,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument(
+        "--head", action="store_true",
+        help="also open the TCP control plane so node agents can join",
+    )
+    sp.add_argument("--port", type=int, default=0, help="control-plane port with --head (0 = auto)")
+    sp.add_argument(
+        "--address", default=None,
+        help="join an existing head as a node agent (host:port) instead of starting one",
+    )
+    sp.add_argument("--resources", default="{}", help="JSON extra resources (agent mode)")
+    sp.add_argument("--labels", default="{}", help="JSON node labels (agent mode)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the running head")
@@ -426,6 +399,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
     sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--only", default=None, help="comma-separated metric names")
+    sp.add_argument("--quick", action="store_true", help="shrunk iteration counts")
     sp.set_defaults(fn=cmd_microbenchmark)
 
     return p
