@@ -151,6 +151,77 @@ def destroy_collective_group(group_name: str = "default") -> None:
     _registry.destroy(group_name)
 
 
+def _rendezvous_kv(
+    group_name: str, group: _Group, rank: int, value: Any, reduce_fn, timeout: float
+):
+    """Cross-process rendezvous through the cluster KV (the transport-backed
+    path when ranks live in different OS processes — driver + node agents).
+    Generation counters advance in lockstep per process because collective
+    calls are, by contract, issued in the same order by every rank."""
+    import pickle
+    import time as _time
+
+    from ray_tpu.runtime.kv_client import get_kv
+
+    kv = get_kv()
+    # Per-RANK generation counters: two ranks of one group may share this
+    # process (inproc actors), and the in-memory shared counter would hand
+    # them different generations for the SAME round, desyncing the keys.
+    with group.condition:
+        if not hasattr(group, "kv_gen"):
+            group.kv_gen = {}
+        gen = group.kv_gen.get(rank, 0)
+        group.kv_gen[rank] = gen + 1
+    world = group.world_size
+
+    def key(r: int, g: int) -> bytes:
+        return f"rt_coll/{group_name}/{g}/{r}".encode()
+
+    kv.put(key(rank, gen), pickle.dumps(_host_value(value), protocol=5))
+    values: List[Any] = [None] * world
+    remaining = set(range(world))
+    deadline = _time.monotonic() + timeout
+    while remaining:
+        for r in list(remaining):
+            raw = kv.get(key(r, gen))
+            if raw is not None:
+                values[r] = pickle.loads(raw)
+                remaining.discard(r)
+        if not remaining:
+            break
+        if _time.monotonic() > deadline:
+            raise TimeoutError(f"collective rendezvous timed out (rank {rank}, gen {gen})")
+        _time.sleep(0.002)
+    result = reduce_fn(values)
+    if rank == 0 and gen >= 2:
+        # everyone who could still read gen-2 has advanced past it (they
+        # contributed to gen-1 at the latest): safe to garbage-collect
+        for r in range(world):
+            kv.delete(key(r, gen - 2))
+    return result
+
+
+def _host_value(value: Any) -> Any:
+    """jax arrays cross the process boundary as numpy (device buffers don't
+    pickle portably)."""
+    if hasattr(value, "device") and hasattr(value, "__array__"):
+        return np.asarray(value)
+    return value
+
+
+def _run_rendezvous(
+    group_name: str, group: _Group, rank: int, value: Any, reduce_fn, timeout: float = 120.0
+):
+    """Route one collective round: in-memory condition-variable rendezvous
+    when all ranks share this process; KV-over-transport when the cluster
+    spans OS processes (multi-host fabric)."""
+    from ray_tpu.runtime.kv_client import is_multiprocess
+
+    if is_multiprocess():
+        return _rendezvous_kv(group_name, group, rank, value, reduce_fn, timeout)
+    return _rendezvous(group, rank, value, reduce_fn, timeout)
+
+
 def _rendezvous(group: _Group, rank: int, value: Any, reduce_fn, timeout: float = 120.0):
     """All-contribute-then-all-collect with generation counting so groups are
     reusable across rounds."""
@@ -190,17 +261,17 @@ def allreduce_tensor(tensor, rank: int, group_name: str = "default", op: str = "
             acc = jnp.stack([jnp.asarray(v) for v in values]).max(0) if hasattr(values[0], "shape") else max(values)
         return acc
 
-    return _rendezvous(group, rank, tensor, reduce_fn)
+    return _run_rendezvous(group_name, group, rank, tensor, reduce_fn)
 
 
 def allgather_tensor(tensor, rank: int, group_name: str = "default"):
     group = _registry.get(group_name)
-    return _rendezvous(group, rank, tensor, lambda values: list(values))
+    return _run_rendezvous(group_name, group, rank, tensor, lambda values: list(values))
 
 
 def broadcast_tensor(tensor, rank: int, src_rank: int = 0, group_name: str = "default"):
     group = _registry.get(group_name)
-    return _rendezvous(group, rank, tensor, lambda values: values[src_rank])
+    return _run_rendezvous(group_name, group, rank, tensor, lambda values: values[src_rank])
 
 
 def reducescatter_tensor(tensor, rank: int, group_name: str = "default"):
@@ -212,10 +283,10 @@ def reducescatter_tensor(tensor, rank: int, group_name: str = "default"):
             acc = acc + v
         return np.array_split(np.asarray(acc), group.world_size, axis=0)
 
-    chunks = _rendezvous(group, rank, tensor, reduce_fn)
+    chunks = _run_rendezvous(group_name, group, rank, tensor, reduce_fn)
     return chunks[rank]
 
 
 def barrier_group(rank: int, group_name: str = "default") -> None:
     group = _registry.get(group_name)
-    _rendezvous(group, rank, None, lambda values: None)
+    _run_rendezvous(group_name, group, rank, None, lambda values: None)

@@ -143,9 +143,14 @@ def rendezvous_via_cluster(
     """
     import socket
 
-    from ray_tpu.api import get_cluster
+    from ray_tpu.runtime.kv_client import get_kv
 
-    kv = get_cluster().control.kv
+    # resolves to the in-process control KV on the driver, or the
+    # transport-backed KV inside a node agent — gangs can rendezvous from
+    # any host in the cluster
+    kv = get_kv()
+    if kv is None:
+        raise RuntimeError("no cluster KV reachable from this process (init ray_tpu first)")
     key = f"jax_distributed_coordinator/{group_name}".encode()
     if rank == 0:
         host = _routable_ip()

@@ -98,9 +98,35 @@ class _Box:
 _mail = _Mailboxes()
 
 
+# per-process sequence counters for the cross-process (KV) channel: each
+# (group, src, dst) pair is a FIFO stream; the sender numbers messages and
+# the receiver consumes them in order
+_p2p_send_seq: Dict[tuple, int] = {}
+_p2p_recv_seq: Dict[tuple, int] = {}
+_p2p_lock = threading.Lock()
+
+
 def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[int] = None) -> None:
-    """Reference: collective.py:531 — point-to-point send."""
+    """Reference: collective.py:531 — point-to-point send.
+
+    Same-process ranks use in-memory mailboxes; across OS processes
+    (multi-host fabric) the message rides the cluster KV over the transport."""
     src = _need_rank(rank)
+    from ray_tpu.runtime.kv_client import get_kv, is_multiprocess
+
+    if is_multiprocess():
+        import pickle
+
+        from ray_tpu.parallel.collective import _host_value
+
+        with _p2p_lock:
+            seq = _p2p_send_seq.get((group_name, src, dst_rank), 0)
+            _p2p_send_seq[(group_name, src, dst_rank)] = seq + 1
+        get_kv().put(
+            f"rt_p2p/{group_name}/{src}/{dst_rank}/{seq}".encode(),
+            pickle.dumps(_host_value(tensor), protocol=5),
+        )
+        return
     box = _mail.box(group_name, src, dst_rank)
     with box.cond:
         box.items.append(tensor)
@@ -110,6 +136,29 @@ def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[i
 def recv(src_rank: int, group_name: str = "default", *, rank: Optional[int] = None, timeout: float = 120.0):
     """Reference: collective.py:594 — blocking point-to-point receive."""
     dst = _need_rank(rank)
+    from ray_tpu.runtime.kv_client import get_kv, is_multiprocess
+
+    if is_multiprocess():
+        import pickle
+        import time as _time
+
+        with _p2p_lock:
+            seq = _p2p_recv_seq.get((group_name, src_rank, dst), 0)
+        kv = get_kv()
+        key = f"rt_p2p/{group_name}/{src_rank}/{dst}/{seq}".encode()
+        deadline = _time.monotonic() + timeout
+        while True:
+            raw = kv.get(key)
+            if raw is not None:
+                kv.delete(key)
+                # consume the sequence number only on success — a timed-out
+                # recv must retry the SAME slot, or the FIFO desyncs
+                with _p2p_lock:
+                    _p2p_recv_seq[(group_name, src_rank, dst)] = seq + 1
+                return pickle.loads(raw)
+            if _time.monotonic() > deadline:
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+            _time.sleep(0.002)
     box = _mail.box(group_name, src_rank, dst)
     with box.cond:
         ok = box.cond.wait_for(lambda: bool(box.items), timeout=timeout)
