@@ -69,6 +69,13 @@ class TaskManager:
         with self._lock:
             return self._lineage.get(object_id)
 
+    def get_pending(self, task_id: TaskID):
+        """O(1) pending-spec lookup (ObjectIDs embed their creating TaskID,
+        so ``ref -> spec`` needs no scan — reference: task id index in
+        ``task_manager.h``)."""
+        with self._lock:
+            return self._pending.get(task_id)
+
     def num_pending(self) -> int:
         with self._lock:
             return len(self._pending)

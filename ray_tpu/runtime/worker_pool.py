@@ -320,6 +320,15 @@ class ProcessWorkerPool:
         fn_blob: Optional[bytes] = None,
         fn_id: Optional[bytes] = None,
     ) -> None:
+        if not worker.alive:
+            # The worker died and its death was already handled: a late
+            # submission must fail fast, not register a callback nobody will
+            # ever drain (reachable when an actor call races the worker's
+            # death notification).  Deferred to a fresh thread: the caller
+            # may hold the per-actor queue lock, and the error path re-enters
+            # the queue pump (synchronous delivery self-deadlocks).
+            _defer_error(callback, WorkerCrashedError(f"worker {worker.pid} is dead"))
+            return
         payload = dict(payload)
         payload["task_id"] = task_id
         if fn_id is not None:
@@ -333,7 +342,16 @@ class ProcessWorkerPool:
         try:
             worker.send(msg_type, payload)
         except OSError:
+            # Deregister OUR callback first: if the death handler already ran
+            # (alive flipped by the reader thread), it would early-return and
+            # orphan it.
+            with self._lock:
+                cb = self._inflight.pop(task_id, None)
+                self._inflight_worker.pop(task_id, None)
+                self._inflight_start.pop(task_id, None)
             self._handle_worker_death(worker)
+            if cb is not None:
+                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
 
     def release_actor_worker(self, worker: WorkerHandle) -> None:
         """Actor died/removed: kill its dedicated process."""
@@ -404,13 +422,18 @@ class ProcessWorkerPool:
                     )
                     del self._inflight_worker[task_id]
                     self._inflight_start.pop(task_id, None)
+        # Death notification FIRST (marks a hosted actor RESTARTING/DEAD and
+        # closes its queue), THEN the per-call error callbacks: a retry fired
+        # from a callback must see the post-death actor state and buffer for
+        # the restart — the reverse order burns max_task_retries against the
+        # corpse.
+        if self._on_worker_death is not None and not self._shutdown:
+            self._on_worker_death(worker)
         for task_id, callback, slot in dead_tasks:
             if callback is not None:
                 callback(None, WorkerCrashedError(f"worker {worker.pid} died"), None)
             if slot is not None:
                 slot.event.set()  # empty slot: waiter falls through to the future
-        if self._on_worker_death is not None and not self._shutdown:
-            self._on_worker_death(worker)
 
     def _kill_worker(self, worker: WorkerHandle, only_if_running: Optional[bytes] = None) -> bool:
         # Fail any in-flight tasks first — the reader loop's death handler
@@ -517,3 +540,12 @@ class ProcessWorkerPool:
             os.unlink(self._listen_path)
         except OSError:
             pass
+
+
+def _defer_error(callback, error) -> None:
+    """Deliver an error callback on its own thread (rare failure path).
+    Synchronous delivery can self-deadlock: submit paths run under the
+    per-actor queue lock and error handling re-enters the queue pump."""
+    threading.Thread(
+        target=lambda: callback(None, error, None), name="deferred-error", daemon=True
+    ).start()
