@@ -1,15 +1,18 @@
 """All-to-all exchange: sort / hash groupby / random shuffle / repartition.
 
-Parity: ``python/ray/data/_internal/planner/exchange/`` — a two-stage
-map/reduce exchange.  The map stage partitions every input block into N
-partition slices (returned as N separate objects via ``num_returns=N``);
-the reduce stage concatenates slice j from every map task and applies the
-per-partition finalization (sort-merge, aggregate, or plain concat).
+Parity: ``python/ray/data/_internal/planner/exchange/``.  Two strategies,
+toggled by ``DataContext.use_push_based_shuffle`` (reference toggle:
+``python/ray/data/context.py:241``):
 
-This is the push-based-shuffle topology of the Exoshuffle paper
-(``push_based_shuffle_task_scheduler.py:400``) collapsed onto the in-process
-fabric: map outputs are pushed directly into reducer inputs (object refs),
-with no centralized shuffle service.
+  * **push-based (default)** — the Exoshuffle scheduler
+    (``push_based_shuffle_task_scheduler.py:400``): map tasks run in rounds
+    whose outputs push into a bounded set of merge tasks that pre-combine
+    partition slices while later rounds still map; the final reduce combines
+    one merged partial per round.  See :func:`_run_push_exchange`.
+  * **pull-based fallback** — the simple two-stage exchange: every map task
+    partitions its block into N slices (``num_returns=N``); each reduce task
+    pulls slice j from every map task and finalizes (sort-merge, aggregate,
+    or concat).
 """
 
 from __future__ import annotations
@@ -123,6 +126,136 @@ def sample_sort_boundaries(blocks: List[Block], key, n_parts: int) -> List[Any]:
     return list(qs)
 
 
+# ----------------------------------------------------- push-based scheduling
+class PushShuffleSchedule:
+    """The round/merge structure of one push-based shuffle run (parity:
+    ``_PushBasedShuffleStage`` in
+    ``push_based_shuffle_task_scheduler.py:400``)."""
+
+    def __init__(self, num_inputs: int, n_parts: int, maps_per_round: int, num_mergers: int):
+        self.num_inputs = num_inputs
+        self.n_parts = n_parts
+        self.maps_per_round = maps_per_round
+        self.num_rounds = -(-num_inputs // maps_per_round)
+        self.num_mergers = num_mergers
+        # contiguous partition ranges, one per merger
+        base, extra = divmod(n_parts, num_mergers)
+        self.merger_ranges: List[Tuple[int, int]] = []
+        start = 0
+        for j in range(num_mergers):
+            size = base + (1 if j < extra else 0)
+            self.merger_ranges.append((start, start + size))
+            start += size
+
+    def __repr__(self):
+        return (
+            f"PushShuffleSchedule(inputs={self.num_inputs}, parts={self.n_parts}, "
+            f"rounds={self.num_rounds}x{self.maps_per_round} maps, "
+            f"mergers={self.num_mergers})"
+        )
+
+
+#: Schedule of the most recent push-based exchange (test/diagnostic hook).
+last_push_schedule: Optional[PushShuffleSchedule] = None
+
+
+def _run_push_exchange(
+    input_refs: List[Any],
+    map_fn: Callable[[Block], List[Block]],
+    reduce_fn: Callable[..., Block],
+    n_parts: int,
+) -> Tuple[List[Any], List[Any]]:
+    """Pipelined push-based (Exoshuffle) exchange: map -> merge -> reduce.
+
+    Parity with the reference's large-scale shuffle
+    (``push_based_shuffle_task_scheduler.py:400``; Exoshuffle,
+    ``README.rst:99``): map tasks run in rounds; each round's outputs are
+    immediately pushed into a BOUNDED set of merge tasks (one per contiguous
+    partition range) that pre-combine partials while later map rounds are
+    still running; the final reduce per partition combines one merged
+    partial per round instead of one slice per map task.  This caps the
+    live-object count at O(rounds x parts + round_size x parts) instead of
+    O(maps x parts) and overlaps map/merge — the property that makes 100
+    GB-class sorts feasible (BASELINE.md target #3).
+
+    Submission here is async end-to-end: because the fabric resolves
+    dependencies through object refs, round r's merges run while round r+1's
+    maps execute — the pipelining falls out of ref-based dataflow with no
+    bespoke scheduler loop."""
+    global last_push_schedule
+    import ray_tpu
+
+    M = len(input_refs)
+    ctx = _data_context()
+    try:
+        cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 2)))
+    except Exception:  # noqa: BLE001
+        cpus = 2
+    maps_per_round = max(2, min(ctx.max_tasks_in_flight, cpus * 2))
+    num_mergers = max(1, min(n_parts, cpus))
+    sched = PushShuffleSchedule(M, n_parts, maps_per_round, num_mergers)
+    last_push_schedule = sched
+
+    @ray_tpu.remote
+    def push_map(block: Block):
+        parts = map_fn(block)
+        return parts[0] if len(parts) == 1 else tuple(parts)
+
+    @ray_tpu.remote
+    def push_merge(n_slices: int, *parts: Block):
+        """Pre-combine this merger's partition slices across one round's
+        maps. parts layout: [map0_slice0..map0_sliceK, map1_slice0..]."""
+        merged = []
+        for s in range(n_slices):
+            merged.append(concat_blocks([parts[m * n_slices + s] for m in range(len(parts) // n_slices)]))
+        return merged[0] if n_slices == 1 else tuple(merged)
+
+    @ray_tpu.remote
+    def push_reduce(*parts: Block):
+        out = reduce_fn(*parts)
+        meta = BlockAccessor(out).get_metadata()
+        return out, meta
+
+    # merge_out[r][j] -> list of per-slice refs for merger j in round r
+    merge_out: List[List[List[Any]]] = []
+    for r in range(sched.num_rounds):
+        round_inputs = input_refs[r * maps_per_round : (r + 1) * maps_per_round]
+        round_maps = []
+        for ref in round_inputs:
+            refs = push_map.options(num_returns=n_parts).remote(ref)
+            if n_parts == 1:
+                refs = [refs]
+            round_maps.append(refs)
+        round_merges: List[List[Any]] = []
+        for j, (lo, hi) in enumerate(sched.merger_ranges):
+            n_slices = hi - lo
+            if n_slices == 0:
+                round_merges.append([])
+                continue
+            args = [m[p] for m in round_maps for p in range(lo, hi)]
+            out = push_merge.options(num_returns=n_slices).remote(n_slices, *args)
+            if n_slices == 1:
+                out = [out]
+            round_merges.append(list(out))
+        merge_out.append(round_merges)
+
+    out_refs, meta_refs = [], []
+    for j, (lo, hi) in enumerate(sched.merger_ranges):
+        for o in range(hi - lo):
+            parts = [merge_out[r][j][o] for r in range(sched.num_rounds)]
+            block_ref, meta_ref = push_reduce.options(num_returns=2).remote(*parts)
+            out_refs.append(block_ref)
+            meta_refs.append(meta_ref)
+    metas = ray_tpu.get(meta_refs)
+    return out_refs, metas
+
+
+def _data_context():
+    from ray_tpu.data.context import DataContext
+
+    return DataContext.get_current()
+
+
 # ---------------------------------------------------------------- the driver
 def run_exchange(
     input_refs: List[Any],
@@ -164,6 +297,9 @@ def run_exchange(
         reduce_fn = _reduce_concat
     else:  # pragma: no cover
         raise ValueError(kind)
+
+    if _data_context().use_push_based_shuffle and len(input_refs) > 1:
+        return _run_push_exchange(input_refs, map_fn, reduce_fn, n_parts)
 
     @ray_tpu.remote
     def exchange_map(block: Block):

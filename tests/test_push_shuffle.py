@@ -1,0 +1,108 @@
+"""Push-based (Exoshuffle) shuffle: round/merge structure + correctness.
+
+Round-2 VERDICT item 5. Reference:
+python/ray/data/_internal/planner/exchange/push_based_shuffle_task_scheduler.py:400.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.data import shuffle as shuffle_mod
+from ray_tpu.data.context import DataContext
+
+
+@pytest.fixture
+def runtime():
+    rt.init(num_cpus=4)
+    try:
+        yield rt
+    finally:
+        rt.shutdown()
+
+
+def _blocks(n_blocks, rows_per_block, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"k": rng.integers(0, 1000, rows_per_block), "v": rng.random(rows_per_block)}
+        for _ in range(n_blocks)
+    ]
+
+
+def test_push_shuffle_schedule_structure(runtime):
+    blocks = _blocks(12, 100)
+    refs = [rt.put(b) for b in blocks]
+    DataContext.get_current().use_push_based_shuffle = True
+    out_refs, metas = shuffle_mod.run_exchange(refs, kind="sort", n_parts=6, key="k")
+    sched = shuffle_mod.last_push_schedule
+    assert sched is not None
+    # bounded mergers: never more than one per partition nor per CPU
+    assert 1 <= sched.num_mergers <= min(sched.n_parts, 4)
+    # rounds cover all inputs with the configured round width
+    assert sched.num_rounds * sched.maps_per_round >= sched.num_inputs
+    assert sched.num_rounds == -(-12 // sched.maps_per_round)
+    # merger ranges tile [0, n_parts)
+    covered = [p for lo, hi in sched.merger_ranges for p in range(lo, hi)]
+    assert covered == list(range(sched.n_parts))
+    # sorted output equals the dense sort of all input rows
+    out = rt.get(out_refs)
+    got = np.concatenate([b["k"] for b in out if len(b.get("k", ()))])
+    want = np.sort(np.concatenate([b["k"] for b in blocks]))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_push_vs_pull_same_result(runtime):
+    blocks = _blocks(8, 64, seed=3)
+    ctx = DataContext.get_current()
+
+    results = {}
+    for mode in (True, False):
+        ctx.use_push_based_shuffle = mode
+        refs = [rt.put(b) for b in blocks]
+        out_refs, _ = shuffle_mod.run_exchange(refs, kind="sort", n_parts=4, key="k")
+        out = rt.get(out_refs)
+        results[mode] = np.concatenate([b["k"] for b in out if len(b.get("k", ()))])
+    np.testing.assert_array_equal(results[True], results[False])
+    ctx.use_push_based_shuffle = True
+
+
+def test_dataset_sort_and_groupby_ride_push_shuffle(runtime):
+    import ray_tpu.data as data
+
+    DataContext.get_current().use_push_based_shuffle = True
+    shuffle_mod.last_push_schedule = None
+    ds = data.from_items([{"k": int(i % 5), "v": float(i)} for i in range(1000)]).repartition(8)
+    sorted_rows = ds.sort("k").take_all()
+    assert [r["k"] for r in sorted_rows] == sorted(int(i % 5) for i in range(1000))
+    assert shuffle_mod.last_push_schedule is not None  # went through push path
+
+    agg = ds.groupby("k").sum("v").take_all()
+    want = {}
+    for i in range(1000):
+        want[int(i % 5)] = want.get(int(i % 5), 0.0) + float(i)
+    got = {int(r["k"]): r["sum(v)"] for r in agg}
+    assert got == pytest.approx(want)
+
+
+def test_push_shuffle_bench_smoke(runtime):
+    """Push >= functional on ~64 MiB of blocks; perf table lives in PERF.md
+    (the GB-scale bench runs via `rt microbenchmark`/bench.py on real HW)."""
+    import time
+
+    rng = np.random.default_rng(0)
+    blocks = [
+        {"k": rng.integers(0, 1 << 30, 1 << 17), "v": rng.random(1 << 17)}  # ~1.5MiB
+        for _ in range(16)
+    ]
+    ctx = DataContext.get_current()
+    timings = {}
+    for mode in (True, False):
+        ctx.use_push_based_shuffle = mode
+        refs = [rt.put(b) for b in blocks]
+        t0 = time.perf_counter()
+        out_refs, _ = shuffle_mod.run_exchange(refs, kind="sort", n_parts=8, key="k")
+        rt.get(out_refs)
+        timings[mode] = time.perf_counter() - t0
+    ctx.use_push_based_shuffle = True
+    # both complete; no perf assertion (1-core CI box)
+    assert timings[True] > 0 and timings[False] > 0
