@@ -237,34 +237,74 @@ class Dataset:
 
     # --------------------------------------------------------------- split
     def split(self, n: int, *, locality_hints=None) -> List["MaterializedDataset"]:
-        """Materialize and split into n even sub-datasets (parity: split())."""
+        """Materialize and split into n even sub-datasets (parity: split()).
+
+        ``locality_hints`` — one per split: actor handles (the consumer
+        actors) or NodeIDs.  Blocks are assigned preferentially to the split
+        whose hint node already stores them (parity:
+        ``output_splitter.py`` locality_hints /
+        ``split.py _split_at_indices`` locality), falling back to greedy
+        row-balancing."""
         mat = self.materialize()
         refs = mat._refs
         metas = mat._metadata
         groups: List[List[Tuple[Any, BlockMetadata]]] = [[] for _ in range(n)]
-        # Greedy row-balanced assignment.
         loads = [0] * n
+        hint_nodes = _resolve_locality_hints(locality_hints, n)
+        total_rows = sum(max(0, m.num_rows) for m in metas) or 1
+        fair_share = 1.3 * total_rows / n
         for ref, meta in sorted(zip(refs, metas), key=lambda rm: -rm[1].num_rows):
-            i = loads.index(min(loads))
+            i = None
+            if hint_nodes is not None:
+                block_nodes = _block_locations(ref)
+                # prefer a co-located, not-overloaded split
+                candidates = [
+                    j for j in range(n)
+                    if hint_nodes[j] is not None and hint_nodes[j] in block_nodes
+                    and loads[j] + meta.num_rows <= fair_share
+                ]
+                if candidates:
+                    i = min(candidates, key=loads.__getitem__)
+            if i is None:
+                i = loads.index(min(loads))
             groups[i].append((ref, meta))
             loads[i] += meta.num_rows
         return [MaterializedDataset([r for r, _ in g], [m for _, m in g]) for g in groups]
 
-    def streaming_split(self, n: int, *, equal: bool = True) -> List[DataIterator]:
+    def streaming_split(
+        self, n: int, *, equal: bool = True, locality_hints=None
+    ) -> List[DataIterator]:
         """n coordinated iterators over one execution (parity:
-        ``streaming_split`` + OutputSplitter).  Driver-side implementation:
-        one shared executor thread pushes bundles round-robin into n queues."""
+        ``streaming_split`` + OutputSplitter,
+        ``_internal/execution/operators/output_splitter.py:1``).  Driver-side
+        implementation: one shared executor thread pushes bundles into n
+        queues — round-robin when ``equal``; with ``locality_hints`` (one
+        actor handle / NodeID per consumer, requires ``equal=False``) each
+        bundle prefers the consumer whose node already stores it."""
         import queue as _q
         import threading
 
         queues: List[_q.Queue] = [_q.Queue(maxsize=4) for _ in range(n)]
         SENTINEL = object()
+        hint_nodes = None if equal else _resolve_locality_hints(locality_hints, n)
+
+        def pick_queue(ref, i: int) -> int:
+            if hint_nodes is not None:
+                block_nodes = _block_locations(ref)
+                candidates = [
+                    j for j in range(n)
+                    if hint_nodes[j] is not None and hint_nodes[j] in block_nodes
+                ]
+                if candidates:
+                    # least-backlogged co-located consumer
+                    return min(candidates, key=lambda j: queues[j].qsize())
+            return i % n
 
         def producer():
             i = 0
             for bundle in self._execute():
                 for ref, meta in zip(bundle.refs, bundle.metadata):
-                    queues[i % n].put(RefBundle([ref], [meta]))
+                    queues[pick_queue(ref, i)].put(RefBundle([ref], [meta]))
                     i += 1
             for q in queues:
                 q.put(SENTINEL)
@@ -450,3 +490,35 @@ def _clone_plan(op: L.LogicalOp) -> L.LogicalOp:
     if isinstance(cloned, L.FusedMap):
         cloned.stages = list(cloned.stages)
     return cloned
+
+
+def _resolve_locality_hints(hints, n: int):
+    """Resolve split locality hints (actor handles or NodeIDs) to NodeIDs.
+    Returns None when no usable hints (plain balanced split)."""
+    if not hints:
+        return None
+    if len(hints) != n:
+        raise ValueError(f"locality_hints must have length {n}, got {len(hints)}")
+    from ray_tpu.core.ids import NodeID
+
+    cluster = ray_tpu.get_cluster()
+    nodes = []
+    for h in hints:
+        node_id = None
+        if isinstance(h, NodeID):
+            node_id = h
+        else:
+            actor_id = getattr(h, "_actor_id", None)
+            if actor_id is not None:
+                info = cluster.control.actors.get(actor_id)
+                if info is not None:
+                    node_id = info.node_id
+        nodes.append(node_id)
+    return nodes if any(x is not None for x in nodes) else None
+
+
+def _block_locations(ref) -> set:
+    try:
+        return ray_tpu.get_cluster().directory.locations(ref.id())
+    except Exception:  # noqa: BLE001
+        return set()

@@ -1,0 +1,144 @@
+"""Parquet column/predicate pushdown + locality-aware split (VERDICT item 8).
+
+Reference anchors: python/ray/data/datasource/parquet_datasource.py
+(columns/filter pushdown through pyarrow) and
+python/ray/data/_internal/execution/operators/output_splitter.py:1
+(locality hints).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+import ray_tpu as rt  # noqa: E402
+from ray_tpu.data.datasource import ParquetDatasource  # noqa: E402
+
+
+@pytest.fixture
+def runtime():
+    rt.init(num_cpus=4)
+    try:
+        yield rt
+    finally:
+        rt.shutdown()
+
+
+@pytest.fixture
+def parquet_dir(tmp_path):
+    """One file, 10 row groups of 100 rows each; `k` ascending so row-group
+    min/max statistics give clean pruning boundaries."""
+    path = str(tmp_path / "data.parquet")
+    table = pa.table(
+        {
+            "k": np.arange(1000, dtype=np.int64),
+            "v": np.random.default_rng(0).random(1000),
+            "label": np.array([f"row{i}" for i in range(1000)]),
+        }
+    )
+    pq.write_table(table, path, row_group_size=100)
+    return str(tmp_path)
+
+
+def test_column_pruning(runtime, parquet_dir):
+    import ray_tpu.data as data
+
+    ds = data.read_parquet(parquet_dir, columns=["k"])
+    rows = ds.take(5)
+    assert set(rows[0].keys()) == {"k"}
+
+
+def test_predicate_pushdown_skips_row_groups(runtime, parquet_dir):
+    import ray_tpu.data as data
+
+    # e2e: exact rows survive the filter
+    ds = data.read_parquet(parquet_dir, filters=[("k", ">=", 850)])
+    rows = ds.take_all()
+    assert sorted(r["k"] for r in rows) == list(range(850, 1000))
+
+    # pushdown proof (driver-side read: tasks run in worker processes, so
+    # stats are asserted on a direct datasource read): min/max statistics
+    # prune row groups BEFORE any IO on them
+    src = ParquetDatasource(parquet_dir, filters=[("k", ">=", 850)])
+    ParquetDatasource.reset_read_stats()
+    path = os.path.join(parquet_dir, "data.parquet")
+    block = src._read_file(path)
+    stats = ParquetDatasource.read_stats
+    assert stats["row_groups_total"] == 10
+    assert stats["row_groups_read"] <= 2
+    assert sorted(block["k"].tolist()) == list(range(850, 1000))
+
+
+def test_pushdown_combined_with_columns(runtime, parquet_dir):
+    import ray_tpu.data as data
+
+    ds = data.read_parquet(parquet_dir, columns=["v"], filters=[("k", "<", 100)])
+    rows = ds.take_all()
+    assert len(rows) == 100
+    assert set(rows[0].keys()) == {"v"}
+
+    src = ParquetDatasource(parquet_dir, columns=["v"], filters=[("k", "<", 100)])
+    ParquetDatasource.reset_read_stats()
+    block = src._read_file(os.path.join(parquet_dir, "data.parquet"))
+    assert ParquetDatasource.read_stats["row_groups_read"] <= 1
+    assert set(block.keys()) == {"v"}
+
+
+def test_filter_no_match_returns_empty(runtime, parquet_dir):
+    import ray_tpu.data as data
+
+    ds = data.read_parquet(parquet_dir, filters=[("k", ">", 10_000)])
+    assert ds.take_all() == []
+
+
+# ----------------------------------------------------------- locality split
+def test_split_respects_locality_hints(runtime):
+    import ray_tpu.data as data
+    from ray_tpu.core.ids import NodeID
+
+    cluster = rt.get_cluster()
+    node_b = cluster.add_node({"CPU": 2})
+    head_id = cluster.head_node.node_id
+
+    ds = data.from_items([{"x": i} for i in range(100)], parallelism=4)
+    mat = ds.materialize()
+    # move two blocks' objects to node_b (exclusive location) so hints have
+    # something to match
+    for ref in mat._refs[:2]:
+        value = rt.get(ref)
+        node_b.store.put(ref.id(), value)
+        cluster.directory.add_location(ref.id(), node_b.node_id)
+        cluster.directory.remove_location(ref.id(), head_id)
+
+    splits = mat.split(2, locality_hints=[head_id, node_b.node_id])
+    # node_b's split got the blocks that live there
+    b_refs = set(r.id() for r in splits[1]._refs)
+    for ref in mat._refs[:2]:
+        assert ref.id() in b_refs
+    # balanced overall
+    assert sum(len(s._refs) for s in splits) == len(mat._refs)
+
+
+def test_split_hint_length_mismatch_raises(runtime):
+    import ray_tpu.data as data
+
+    ds = data.from_items([{"x": i} for i in range(10)])
+    with pytest.raises(ValueError):
+        ds.split(2, locality_hints=[None])
+
+
+def test_streaming_split_locality(runtime):
+    import ray_tpu.data as data
+
+    cluster = rt.get_cluster()
+    node_b = cluster.add_node({"CPU": 2})
+    ds = data.from_items([{"x": i} for i in range(40)], parallelism=4)
+    its = ds.streaming_split(2, equal=False, locality_hints=[cluster.head_node.node_id, node_b.node_id])
+    rows = []
+    for it in its:
+        for batch in it.iter_batches(batch_size=10):
+            rows.extend(np.asarray(batch["x"]).tolist())
+    assert sorted(rows) == list(range(40))
