@@ -1,0 +1,94 @@
+"""Stress/sanitizer tier (round-2 VERDICT item 10).
+
+* ``test_asan_native_stress`` builds the C++ stress harness under
+  AddressSanitizer and runs it (reference role: ``.bazelrc:104-127``
+  ``--config=asan``) — allocator/refcount/eviction races in the shm store
+  and IO pool are exercised from 10 threads.
+* ``test_fabric_stress`` hammers the Python fabric: concurrent put/get,
+  task submission and object transfer racing ``add_node``/``kill_node``
+  chaos, asserting the runtime neither deadlocks nor corrupts results.
+"""
+
+import os
+import shutil
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ray_tpu", "native"
+)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++ toolchain")
+def test_asan_native_stress():
+    res = subprocess.run(
+        ["make", "asan"], cwd=NATIVE_DIR, capture_output=True, text=True, timeout=600
+    )
+    assert res.returncode == 0, f"ASAN stress failed:\n{res.stdout}\n{res.stderr}"
+    assert "stress: OK" in res.stdout
+
+
+def test_fabric_stress():
+    rt.init(num_cpus=4)
+    try:
+        cluster = rt.get_cluster()
+        stop = threading.Event()
+        errors = []
+
+        @rt.remote
+        def double(a):
+            return a * 2
+
+        def put_get_loop(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                while not stop.is_set():
+                    arr = rng.random(int(rng.integers(100, 20_000)))
+                    ref = rt.put(arr)
+                    out = rt.get(ref)
+                    if out.shape != arr.shape:
+                        errors.append("shape mismatch")
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"put_get: {exc!r}")
+
+        def task_loop(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                while not stop.is_set():
+                    x = float(rng.random())
+                    if abs(rt.get(double.remote(x), timeout=60) - 2 * x) > 1e-9:
+                        errors.append("bad task result")
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"task: {exc!r}")
+
+        def chaos_loop():
+            try:
+                while not stop.is_set():
+                    node = cluster.add_node({"CPU": 1, "chaos": 1})
+                    time.sleep(0.3)
+                    cluster.kill_node(node.node_id)
+                    time.sleep(0.1)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"chaos: {exc!r}")
+
+        threads = (
+            [threading.Thread(target=put_get_loop, args=(i,)) for i in range(3)]
+            + [threading.Thread(target=task_loop, args=(10 + i,)) for i in range(3)]
+            + [threading.Thread(target=chaos_loop)]
+        )
+        for t in threads:
+            t.start()
+        time.sleep(6.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=90)
+            assert not t.is_alive(), "stress thread hung (deadlock)"
+        assert not errors, errors[:5]
+    finally:
+        rt.shutdown()
