@@ -93,6 +93,11 @@ class JobManager:
                 # plugins pin each staged artifact (refcount) as they stage it;
                 # released in _watch when the process exits.
                 env, cwd = apply_to_process_env(runtime_env, env, uris_out=env_uris)
+                # command-wrapping plugins (mpi -> mpirun, container ->
+                # podman/docker run) rewrite the entrypoint itself
+                from ray_tpu.runtime_env.plugin import wrap_entrypoint
+
+                entrypoint = wrap_entrypoint(runtime_env, entrypoint, env, cwd)
             except Exception as exc:
                 with self._lock:
                     entry.status = JobStatus.FAILED

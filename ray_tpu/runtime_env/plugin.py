@@ -262,6 +262,104 @@ class CondaPlugin(RuntimeEnvPlugin):
         return env, cwd
 
 
+class ContainerPlugin(RuntimeEnvPlugin):
+    """Run the job entrypoint inside a container (reference:
+    ``python/ray/_private/runtime_env/container.py`` — podman-wrapped worker
+    commands).  Value shape::
+
+        {"image": "img:tag", "run_options": ["--net=host", ...]}
+
+    The container engine is resolved at validate time (podman preferred,
+    docker fallback); the repo/working dir is bind-mounted so staged
+    runtime-env artifacts stay visible."""
+
+    name = "container"
+    priority = 90  # wraps last: sees the final env/cwd
+
+    def _engine(self) -> Optional[str]:
+        import shutil as _shutil
+
+        for exe in ("podman", "docker"):
+            if _shutil.which(exe):
+                return exe
+        return None
+
+    def validate(self, value) -> None:
+        if not isinstance(value, dict) or "image" not in value:
+            raise ValueError("runtime_env['container'] must be {'image': ..., ...}")
+        if self._engine() is None:
+            raise ValueError(
+                "runtime_env['container'] requires podman or docker on PATH"
+            )
+
+    def wrap_entrypoint(
+        self, value, entrypoint: str, env: Dict[str, str], cwd: Optional[str],
+        runtime_env: Optional[dict] = None,
+    ) -> str:
+        import shlex
+
+        engine = self._engine()
+        opts = " ".join(shlex.quote(o) for o in value.get("run_options", ()))
+        workdir = cwd or os.getcwd()
+        # forward exactly the user's env_vars (host PYTHONPATH etc. would be
+        # dangling paths inside the image — the image must ship its own
+        # Python environment, reference container.py behavior)
+        user_env = (runtime_env or {}).get("env_vars", {})
+        env_flags = " ".join(
+            f"-e {shlex.quote(f'{k}={v}')}" for k, v in user_env.items()
+        )
+        return (
+            f"{engine} run --rm {opts} -v {shlex.quote(workdir)}:/work -w /work "
+            f"{env_flags} {shlex.quote(value['image'])} /bin/sh -c {shlex.quote(entrypoint)}"
+        ).replace("  ", " ")
+
+
+class MPIPlugin(RuntimeEnvPlugin):
+    """Wrap the entrypoint in ``mpirun`` (reference:
+    ``python/ray/_private/runtime_env/mpi.py:41`` ``MPIPlugin`` wrapping
+    worker exec in mpirun :104).  Value shape::
+
+        {"worker_entry": ..., "args": ["-n", "4"]}  # or {"processes": 4}
+    """
+
+    name = "mpi"
+    priority = 80
+
+    def validate(self, value) -> None:
+        if not isinstance(value, dict):
+            raise ValueError("runtime_env['mpi'] must be a dict")
+        import shutil as _shutil
+
+        if _shutil.which("mpirun") is None:
+            raise ValueError("runtime_env['mpi'] requires mpirun on PATH")
+
+    def wrap_entrypoint(
+        self, value, entrypoint: str, env: Dict[str, str], cwd: Optional[str],
+        runtime_env: Optional[dict] = None,
+    ) -> str:
+        import shlex
+
+        if "args" in value:
+            args = " ".join(shlex.quote(a) for a in value["args"])
+        else:
+            args = f"-n {int(value.get('processes', 1))}"
+        return f"mpirun {args} /bin/sh -c {shlex.quote(entrypoint)}"
+
+
+def wrap_entrypoint(
+    runtime_env: dict, entrypoint: str, env: Dict[str, str], cwd: Optional[str]
+) -> str:
+    """Apply every command-wrapping plugin (mpi, container) to a job
+    entrypoint, in priority order."""
+    for key in sorted(runtime_env, key=lambda k: getattr(_plugins.get(k), "priority", 10)):
+        plugin = _plugins.get(key)
+        if plugin is not None and hasattr(plugin, "wrap_entrypoint"):
+            entrypoint = plugin.wrap_entrypoint(
+                runtime_env[key], entrypoint, env, cwd, runtime_env=runtime_env
+            )
+    return entrypoint
+
+
 _plugins: Dict[str, RuntimeEnvPlugin] = {}
 
 
@@ -273,7 +371,10 @@ def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
     return _plugins.get(name)
 
 
-for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin(), CondaPlugin()):
+for _p in (
+    EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin(),
+    CondaPlugin(), ContainerPlugin(), MPIPlugin(),
+):
     register_plugin(_p)
 
 

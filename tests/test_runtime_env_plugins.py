@@ -1,0 +1,97 @@
+"""Container / MPI runtime-env plugins + client proxy mode (missing-list
+items 8 from round-1 VERDICT).
+
+Reference anchors: python/ray/_private/runtime_env/container.py,
+python/ray/_private/runtime_env/mpi.py:41,
+python/ray/util/client/server/proxier.py.
+"""
+
+import shutil
+
+import pytest
+
+from ray_tpu.runtime_env.plugin import (
+    ContainerPlugin,
+    MPIPlugin,
+    validate_runtime_env,
+    wrap_entrypoint,
+)
+
+
+def test_container_wrap(monkeypatch):
+    monkeypatch.setattr(shutil, "which", lambda exe: "/usr/bin/podman" if exe == "podman" else None)
+    value = {"image": "python:3.12", "run_options": ["--net=host"]}
+    ContainerPlugin().validate(value)
+    cmd = wrap_entrypoint(
+        {"container": value, "env_vars": {"WANDB_API_KEY": "k"}},
+        "python train.py", {"PYTHONPATH": "/repo"}, "/work",
+    )
+    assert cmd.startswith("podman run --rm --net=host")
+    assert "python:3.12" in cmd
+    assert "'python train.py'" in cmd
+    # user env_vars are forwarded into the container; host paths are not
+    assert "-e WANDB_API_KEY=k" in cmd
+    assert "PYTHONPATH" not in cmd
+
+
+def test_container_requires_engine(monkeypatch):
+    monkeypatch.setattr(shutil, "which", lambda exe: None)
+    with pytest.raises(ValueError, match="podman or docker"):
+        validate_runtime_env({"container": {"image": "x"}})
+
+
+def test_mpi_wrap(monkeypatch):
+    monkeypatch.setattr(shutil, "which", lambda exe: "/usr/bin/mpirun" if exe == "mpirun" else None)
+    value = {"processes": 4}
+    MPIPlugin().validate(value)
+    cmd = wrap_entrypoint({"mpi": value}, "python step.py", {}, None)
+    assert cmd.startswith("mpirun -n 4")
+    assert "'python step.py'" in cmd
+
+
+def test_mpi_then_container_order(monkeypatch):
+    monkeypatch.setattr(
+        shutil, "which",
+        lambda exe: f"/usr/bin/{exe}" if exe in ("mpirun", "podman") else None,
+    )
+    cmd = wrap_entrypoint(
+        {"mpi": {"processes": 2}, "container": {"image": "img"}},
+        "python x.py", {}, "/w",
+    )
+    # mpi wraps first (priority 80), container wraps the mpirun line (90)
+    assert cmd.startswith("podman run")
+    assert "mpirun -n 2" in cmd
+
+
+def test_unknown_runtime_env_key_rejected():
+    with pytest.raises(ValueError, match="unknown runtime_env"):
+        validate_runtime_env({"not_a_plugin": 1})
+
+
+# ----------------------------------------------------------- proxy mode
+def test_client_proxy_isolates_tenants():
+    """Two clients through one proxy endpoint get separate driver runtimes."""
+    from ray_tpu.util.client.proxier import ProxyServer
+    from ray_tpu.util.client.worker import connect
+
+    proxy = ProxyServer(port=0, num_cpus_per_backend=1, warm_backends=1).start()
+    try:
+        ctx1 = connect(proxy.address)
+        ctx2 = connect(proxy.address)
+        try:
+            def whoami():
+                import os
+
+                return os.getpid()
+
+            f1 = ctx1.remote(whoami)
+            f2 = ctx2.remote(whoami)
+            pid1 = ctx1.get(f1.remote(), timeout=120)
+            pid2 = ctx2.get(f2.remote(), timeout=120)
+            # separate backend driver processes per tenant
+            assert pid1 != pid2
+        finally:
+            ctx1.disconnect()
+            ctx2.disconnect()
+    finally:
+        proxy.stop()
