@@ -346,7 +346,7 @@ def _moe_ffn_capacity(cfg: TransformerConfig, layer, x):
     pos = jnp.cumsum(flat, axis=1) - flat                        # [B,T*k,E]
     slot = jnp.sum(pos.reshape(B, T, k, E) * sel, axis=-1)       # [B,T,k]
     keep = (slot < C).astype(jnp.float32)                       # fits capacity
-    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[..., None]
+    slot_oh = jax.nn.one_hot(slot.astype(jnp.int32), C, dtype=jnp.float32) * keep[..., None]
     # dispatch [B,T,E,C]: 1 where token t goes to expert e slot c
     dispatch = jnp.einsum("btke,btkc->btec", sel, slot_oh)
     combine = jnp.einsum("btk,btke,btkc->btec", topv.astype(jnp.float32), sel, slot_oh)

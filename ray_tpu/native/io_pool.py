@@ -95,8 +95,15 @@ class IOPool:
         self._pending_bufs: dict = {}
 
     # -- low-level ----------------------------------------------------------
+    def _check_open(self) -> None:
+        # use-after-close would hand the freed native pool handle to the C
+        # library — a crash, not an exception; fail in Python instead
+        if self._closed:
+            raise RuntimeError("IOPool is closed")
+
     def submit_read(self, path: str, buf, offset: int = 0, length: Optional[int] = None) -> int:
         """Read [offset, offset+length) of path into buf (writable buffer)."""
+        self._check_open()
         addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
         n = length if length is not None else len(buf)
         return self._lib.tio_submit_read(
@@ -104,6 +111,7 @@ class IOPool:
         )
 
     def submit_write(self, path: str, data, offset: int = 0, trunc: bool = True) -> int:
+        self._check_open()
         # copy into a ctypes buffer so arbitrary (possibly readonly) bytes
         # stay alive until the worker thread finishes
         buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
@@ -116,6 +124,7 @@ class IOPool:
         return jid
 
     def wait(self, job_id: int) -> int:
+        self._check_open()
         r = self._lib.tio_wait(self._handle, job_id)
         self._pending_bufs.pop(job_id, None)
         if r < 0:

@@ -75,11 +75,15 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
     ingress = handles[id(app)]
     if route_prefix is not None:
         ray_tpu.get(controller.set_ingress.remote(route_prefix, app.deployment.name))
-        if _proxy is not None:
+    # registration into the proxies/app map under the state lock: a
+    # concurrent shutdown()/start() must not see a half-registered app or
+    # register into a proxy being torn down
+    with _state_lock:
+        if route_prefix is not None and _proxy is not None:
             _proxy.add_route(route_prefix, ingress)
-    _apps[name] = ingress
-    if _grpc_proxy is not None:
-        _grpc_proxy.add_app(name, ingress)
+        _apps[name] = ingress
+        if _grpc_proxy is not None:
+            _grpc_proxy.add_app(name, ingress)
     return ingress
 
 
@@ -111,18 +115,21 @@ def delete(name: str) -> None:
     controller = _require_started()
     ray_tpu.get(controller.delete_deployment.remote(name))
     # drop app registrations / proxy routes whose ingress was this
-    # deployment — a stale handle would surface as ActorDiedError next call
-    for app, handle in list(_apps.items()):
-        if getattr(handle, "deployment_name", None) == name:
-            del _apps[app]
-    if _grpc_proxy is not None:
-        for app, handle in list(_grpc_proxy.apps.items()):
+    # deployment — a stale handle would surface as ActorDiedError next call.
+    # Under the state lock so a concurrent shutdown()/start() can't race the
+    # proxy map mutations.
+    with _state_lock:
+        for app, handle in list(_apps.items()):
             if getattr(handle, "deployment_name", None) == name:
-                _grpc_proxy.remove_app(app)
-    if _proxy is not None:
-        for prefix, handle in list(_proxy.routes.items()):
-            if getattr(handle, "deployment_name", None) == name:
-                _proxy.remove_route(prefix)
+                del _apps[app]
+        if _grpc_proxy is not None:
+            for app, handle in list(_grpc_proxy.apps.items()):
+                if getattr(handle, "deployment_name", None) == name:
+                    _grpc_proxy.remove_app(app)
+        if _proxy is not None:
+            for prefix, handle in list(_proxy.routes.items()):
+                if getattr(handle, "deployment_name", None) == name:
+                    _proxy.remove_route(prefix)
 
 
 def proxy_url() -> Optional[str]:

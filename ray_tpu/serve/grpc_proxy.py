@@ -85,6 +85,15 @@ class GRPCProxy:
             else:
                 payload = json.loads(request) if request else None
             result = handle.remote(payload).result(timeout=self.request_timeout_s)
+            if hasattr(result, "__next__"):
+                # streaming deployments (stream=True generators) have no
+                # unary-gRPC representation; the HTTP proxy serves them as
+                # SSE — tell the client instead of dying in json.dumps
+                context.abort(
+                    self._grpc.StatusCode.UNIMPLEMENTED,
+                    "deployment returned a stream; streaming is not supported "
+                    "over gRPC Predict — use the HTTP proxy (SSE)",
+                )
             if codec == "pickle":
                 return pickle.dumps(result)
             from ray_tpu.serve.proxy import _jsonify
