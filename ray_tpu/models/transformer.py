@@ -373,7 +373,11 @@ def forward(
     sp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Returns logits [B, T, V]."""
-    use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and jax.default_backend() == "tpu" and act_spec is None)
+    # tunneled TPU platforms (axon) report their own backend name; keep the
+    # auto-detect a WHITELIST so unknown backends (metal, interpreter,
+    # future plugins) fall back to dense instead of a TPU-only Pallas kernel
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and on_tpu and act_spec is None)
     B, T = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))

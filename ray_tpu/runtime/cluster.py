@@ -176,6 +176,10 @@ class Cluster:
         self._demand_entries: List[list] = []   # [spec, kind, deadline]
         self._demand_thread: Optional[threading.Thread] = None
         self._demand_stop = False
+        # first-park deadlines by spec identity: a re-park (placement race,
+        # acquire failure) must NOT reset the clock, or work that never
+        # becomes feasible loops forever instead of timing out
+        self._park_deadlines: Dict[int, float] = {}
         # host-memory OOM guard (memory_monitor.h parity); one monitor for
         # the in-process fabric, candidates aggregated over all nodes.
         self.memory_monitor = None
@@ -302,7 +306,11 @@ class Cluster:
             get_config().infeasible_task_timeout_s if kind == "task" else 30.0
         )
         with self._demand_cv:
-            self._demand_entries.append([spec, kind, time.monotonic() + timeout])
+            deadline = self._park_deadlines.get(id(spec))
+            if deadline is None:
+                deadline = time.monotonic() + timeout
+                self._park_deadlines[id(spec)] = deadline
+            self._demand_entries.append([spec, kind, deadline])
             if self._demand_thread is None or not self._demand_thread.is_alive():
                 self._demand_thread = threading.Thread(
                     target=self._demand_drain_loop, name="demand-drain", daemon=True
@@ -335,18 +343,26 @@ class Cluster:
                     with self._demand_lock:
                         self._infeasible_demands.pop(id(spec), None)
                     placed_or_failed.append(entry)
+                    if kind == "task":
+                        with self._demand_cv:
+                            self._park_deadlines.pop(id(spec), None)
                     try:
                         if kind == "task":
                             self.nodes[node_id].submit(spec)
                         else:
+                            # success clears the deadline inside
+                            # _start_actor_on; an acquire race re-parks on
+                            # the ORIGINAL clock so it can still time out
                             self._start_actor_on(node_id, spec)
                     except Exception:  # noqa: BLE001 — one bad entry must not stall the queue
-                        # dispatch raced a node death: re-park (fresh
-                        # deadline) rather than silently losing the task
+                        # dispatch raced a node death: re-park rather than
+                        # silently losing the task
                         self._park_infeasible(spec, kind=kind)
                 elif now >= deadline:
                     with self._demand_lock:
                         self._infeasible_demands.pop(id(spec), None)
+                    with self._demand_cv:
+                        self._park_deadlines.pop(id(spec), None)
                     placed_or_failed.append(entry)
                     if kind == "task":
                         self.task_manager.mark_failed(spec)
@@ -688,11 +704,14 @@ class Cluster:
         opts = self._actor_options[spec.actor_id]
         node = self.nodes[node_id]
         if not node.pool.acquire(spec.resources):
-            # Raced with another placement: the scheduler's view said the
-            # node fit but the pool is now short.  Defer, never recurse —
-            # recursing re-picks the same node and livelocks.
+            # Raced with another placement (or the node merely fits by
+            # TOTAL while its resources are held): back on the demand
+            # queue — the first-park deadline is preserved there, so a
+            # never-feasible creation still times out.
             self._retry_actor_creation(spec)
             return
+        with self._demand_cv:
+            self._park_deadlines.pop(id(spec), None)
         spec.owner_node = node_id
         deps = [d for d in spec.dependencies if not node.store.contains(d)]
         when_all(

@@ -398,7 +398,7 @@ def build_parser() -> argparse.ArgumentParser:
         s.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
-    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-cpus", type=int, default=4)
     sp.add_argument("--only", default=None, help="comma-separated metric names")
     sp.add_argument("--quick", action="store_true", help="shrunk iteration counts")
     sp.set_defaults(fn=cmd_microbenchmark)

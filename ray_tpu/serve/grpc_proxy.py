@@ -85,17 +85,22 @@ class GRPCProxy:
             else:
                 payload = json.loads(request) if request else None
             result = handle.remote(payload).result(timeout=self.request_timeout_s)
-            if hasattr(result, "__next__"):
-                # streaming deployments (stream=True generators) have no
-                # unary-gRPC representation; the HTTP proxy serves them as
-                # SSE — tell the client instead of dying in json.dumps
-                context.abort(
-                    self._grpc.StatusCode.UNIMPLEMENTED,
-                    "deployment returned a stream; streaming is not supported "
-                    "over gRPC Predict — use the HTTP proxy (SSE)",
-                )
-            if codec == "pickle":
+            if codec == "pickle" and not hasattr(result, "__next__"):
                 return pickle.dumps(result)
+        except Exception as exc:  # noqa: BLE001
+            context.abort(self._grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+        if hasattr(result, "__next__"):
+            # streaming deployments (stream=True generators) have no
+            # unary-gRPC representation; the HTTP proxy serves them as SSE —
+            # tell the client instead of dying in json.dumps. OUTSIDE the
+            # try: context.abort raises, and the catch-all would rewrite the
+            # status to INTERNAL.
+            context.abort(
+                self._grpc.StatusCode.UNIMPLEMENTED,
+                "deployment returned a stream; streaming is not supported "
+                "over gRPC Predict — use the HTTP proxy (SSE)",
+            )
+        try:
             from ray_tpu.serve.proxy import _jsonify
 
             return json.dumps(result, default=_jsonify).encode()
