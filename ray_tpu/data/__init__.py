@@ -16,9 +16,12 @@ from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
     from_arrow,
     from_blocks,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_tf,
+    from_torch,
     range,
     range_tensor,
     read_binary_files,
@@ -27,9 +30,12 @@ from ray_tpu.data.read_api import (
     read_images,
     read_json,
     read_numpy,
+    read_bigquery,
+    read_mongo,
     read_parquet,
     read_sql,
     read_text,
+    read_tfrecords,
     read_webdataset,
 )
 from ray_tpu.data.llm_inference import LLMPredictor, clear_engine_cache
@@ -56,6 +62,9 @@ __all__ = [
     "Sum",
     "Unique",
     "from_arrow",
+    "from_huggingface",
+    "from_tf",
+    "from_torch",
     "from_blocks",
     "from_items",
     "from_numpy",
@@ -69,7 +78,10 @@ __all__ = [
     "read_numpy",
     "read_images",
     "read_parquet",
+    "read_bigquery",
+    "read_mongo",
     "read_sql",
     "read_text",
+    "read_tfrecords",
     "read_webdataset",
 ]

@@ -369,6 +369,11 @@ class Dataset:
 
         self._write(ParquetDatasource([]), path, kw)
 
+    def write_tfrecords(self, path: str, **kw) -> None:
+        from ray_tpu.data.datasource import TFRecordDatasource
+
+        self._write(TFRecordDatasource([]), path, kw)
+
     def write_sql(self, table: str, connection_factory, *, paramstyle: str = "qmark") -> None:
         """Insert all rows into a DB table via DB-API (parity: write_sql)."""
         from ray_tpu.data.datasource import SQLDatasource

@@ -119,3 +119,80 @@ def read_sql(sql: str, connection_factory, *, shard_queries=None, parallelism: i
         SQLDatasource(sql, connection_factory, shard_queries=shard_queries),
         parallelism=parallelism,
     )
+
+
+def read_tfrecords(paths, *, decode_examples: bool = True, parallelism: int = -1, **kw) -> Dataset:
+    """TFRecord files (parity: read_api read_tfrecords); payloads decode as
+    tf.train.Example feature dicts (requires tensorflow) or raw bytes."""
+    from ray_tpu.data.datasource import TFRecordDatasource
+
+    return Dataset(
+        L.Read(TFRecordDatasource(paths, decode_examples=decode_examples, **kw), _parallelism(parallelism))
+    )
+
+
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
+    """MongoDB collection (parity: read_mongo; requires pymongo)."""
+    from ray_tpu.data.datasource import MongoDatasource
+
+    return Dataset(
+        L.Read(MongoDatasource(uri, database, collection, pipeline), _parallelism(parallelism))
+    )
+
+
+def read_bigquery(project_id: str, *, query=None, dataset=None, parallelism: int = -1) -> Dataset:
+    """BigQuery query/table (parity: read_bigquery; requires google-cloud-bigquery)."""
+    from ray_tpu.data.datasource import BigQueryDatasource
+
+    return Dataset(
+        L.Read(BigQueryDatasource(project_id, query=query, dataset=dataset), _parallelism(parallelism))
+    )
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """Materialize a torch.utils.data.Dataset (map-style) or iterable
+    (parity: from_torch)."""
+    import builtins
+
+    if hasattr(torch_dataset, "__getitem__") and hasattr(torch_dataset, "__len__"):
+        rows = [torch_dataset[i] for i in builtins.range(len(torch_dataset))]
+    else:
+        rows = list(torch_dataset)
+    items = []
+    for row in rows:
+        if isinstance(row, tuple) and len(row) == 2:
+            items.append({"item": _to_numpy(row[0]), "label": _to_numpy(row[1])})
+        else:
+            items.append(_to_numpy(row))
+    return from_items(items, parallelism=parallelism)
+
+
+def from_tf(tf_dataset, *, parallelism: int = -1) -> Dataset:
+    """Materialize a tf.data.Dataset (parity: from_tf)."""
+    items = []
+    for elem in tf_dataset.as_numpy_iterator():
+        if isinstance(elem, dict):
+            items.append(elem)
+        elif isinstance(elem, tuple) and len(elem) == 2:
+            items.append({"item": elem[0], "label": elem[1]})
+        else:
+            items.append(elem)
+    return from_items(items, parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """A Hugging Face datasets.Dataset rides in as Arrow (parity:
+    from_huggingface).  Materialized through ``with_format("arrow")`` — NOT
+    the raw ``.data`` table — so select/filter/shuffle views (which live in
+    the dataset's ``_indices``) are honored."""
+    table = hf_dataset.with_format("arrow")[:]
+    return from_arrow(table)
+
+
+def _to_numpy(x):
+    if hasattr(x, "numpy"):
+        try:
+            return x.numpy()
+        except Exception:  # noqa: BLE001
+            return x
+    return x

@@ -23,6 +23,11 @@ from ray_tpu.rllib.rl_module import (
     SACModule,
 )
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.external_env import (
+    GymEnvRunner,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+)
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig

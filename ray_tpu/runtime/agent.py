@@ -177,6 +177,28 @@ class NodeAgent:
         from ray_tpu.runtime.kv_client import register_agent_kv
 
         register_agent_kv(self.conn)
+        # worker prints on this node surface on the DRIVER's stderr
+        # (log_monitor parity; head side: HeadService._h_log_batch).
+        # Batched: chatty workers must not serialize one RPC frame per line
+        # against task traffic on the shared connection.
+        self._log_buf: list = []
+        self._log_lock = threading.Lock()
+        self._log_last_flush = time.monotonic()
+
+        def log_sink(line: str) -> None:
+            flush = None
+            with self._log_lock:
+                self._log_buf.append(line)
+                now = time.monotonic()
+                if len(self._log_buf) >= 50 or now - self._log_last_flush > 0.2:
+                    flush, self._log_buf = self._log_buf, []
+                    self._log_last_flush = now
+            if flush:
+                self.conn.send("log_batch", {"lines": flush})
+
+        self.node.worker_pool.log_sink = log_sink
+        # stragglers below the batch threshold drain on the report tick
+        # (_report_loop calls _flush_logs)
         self.conn.request(
             "register_node",
             {
@@ -187,6 +209,16 @@ class NodeAgent:
             },
         )
         threading.Thread(target=self._report_loop, name="agent-report", daemon=True).start()
+
+    def _flush_logs(self) -> None:
+        with self._log_lock:
+            flush, self._log_buf = self._log_buf, []
+            self._log_last_flush = time.monotonic()
+        if flush:
+            try:
+                self.conn.send("log_batch", {"lines": flush})
+            except rpc.RpcError:
+                pass
 
     def wait(self) -> None:
         self._stop.wait()
@@ -279,6 +311,7 @@ class NodeAgent:
                 )
             except rpc.RpcError:
                 return
+            self._flush_logs()
             self._stop.wait(period)
 
     def _on_disconnect(self, conn) -> None:

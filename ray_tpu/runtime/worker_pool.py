@@ -170,14 +170,25 @@ class ProcessWorkerPool:
         self._watch_worker(handle)
         return handle
 
-    @staticmethod
-    def _pump_logs(proc: subprocess.Popen) -> None:
+    #: optional redirect for worker log lines (fn(line_with_prefix)); node
+    #: agents point this at the head connection so task prints land on the
+    #: DRIVER's stderr across hosts (log_monitor-to-driver parity)
+    log_sink: Optional[Callable[[str], None]] = None
+
+    def _pump_logs(self, proc: subprocess.Popen) -> None:
         # merged worker stdout+stderr goes to the DRIVER'S STDERR (reference
         # log_monitor behavior): parsed driver stdout stays clean, and the
         # pump must never die early or the 64KB pipe fills and blocks the
         # worker mid-task (decode errors are already 'replace'd).
         try:
             for line in proc.stdout:
+                sink = self.log_sink
+                if sink is not None:
+                    try:
+                        sink(f"(worker pid={proc.pid}) {line.rstrip()}")
+                        continue
+                    except Exception:  # noqa: BLE001 — fall back to local stderr
+                        pass
                 sys.stderr.write(f"(worker pid={proc.pid}) {line}")
                 sys.stderr.flush()
         except (ValueError, OSError):

@@ -302,3 +302,25 @@ def test_collective_group_across_processes(two_process_cluster):
     got = r0.recv_from.remote(1)
     assert rt.get(sent, timeout=90) is True
     assert rt.get(got, timeout=90) == {"x": 42}
+
+
+def test_worker_prints_forward_to_driver(two_process_cluster, capsys):
+    """Task prints on an agent's workers surface on the driver's stderr
+    (log_monitor-to-driver parity across hosts)."""
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def chatty():
+        print("hello-from-agent-worker")
+        return 1
+
+    assert rt.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 30
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capsys.readouterr().err
+        if "hello-from-agent-worker" in seen:
+            break
+        time.sleep(0.2)
+    assert "hello-from-agent-worker" in seen
+    assert "(node=" in seen  # head prefixes the source node

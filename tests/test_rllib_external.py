@@ -1,0 +1,105 @@
+"""External (gym-API) and multi-agent env runners (round-1 VERDICT: RLlib
+was JAX-native-envs only — no gym/multi-agent support).
+
+Reference anchors: rllib/evaluation/rollout_worker.py (host-loop sampling),
+rllib/env/multi_agent_env.py.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import GymEnvRunner, MultiAgentEnv, MultiAgentEnvRunner, SampleBatch
+import jax
+
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+class TinyGymEnv:
+    """Gymnasium-API env without the gymnasium dependency: 1D position
+    walk; +1 reward for action 1, episode ends after 10 steps."""
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self.t = 0
+        return np.zeros(4, np.float32), {}
+
+    def step(self, action):
+        self.t += 1
+        obs = np.full(4, self.t / 10.0, np.float32)
+        reward = float(action == 1)
+        terminated = self.t >= 10
+        return obs, reward, terminated, False, {}
+
+
+def _module():
+    return ActorCriticModule(obs_size=4, num_actions=2, hidden=(16,))
+
+
+def test_gym_runner_samples_batches():
+    module = _module()
+    params = module.init(jax.random.key(0))
+    runner = GymEnvRunner(
+        [TinyGymEnv for _ in range(3)], module,
+        rollout_length=25, num_actions=2,
+    )
+    batch, final_obs, returns = runner.sample(params)
+    assert batch[SampleBatch.OBS].shape == (25, 3, 4)
+    assert batch[SampleBatch.ACTIONS].shape == (25, 3)
+    assert batch[SampleBatch.LOGP].shape == (25, 3)
+    assert final_obs.shape == (3, 4)
+    # 25 steps x 3 envs with 10-step episodes -> at least 6 completions
+    assert len(returns) >= 6
+    # terminals recorded at episode boundaries
+    assert batch[SampleBatch.DONES].sum() >= 6
+    runner.stop()
+
+
+def test_gym_runner_classic_4tuple_api():
+    class OldGym(TinyGymEnv):
+        def step(self, action):  # classic gym: no truncated field
+            obs, r, term, trunc, info = super().step(action)
+            return obs, r, term, info
+
+    module = _module()
+    runner = GymEnvRunner([OldGym], module, rollout_length=12, num_actions=2)
+    batch, _obs, returns = runner.sample(module.init(jax.random.key(0)))
+    assert batch[SampleBatch.REWARDS].shape == (12, 1)
+    assert len(returns) >= 1
+
+
+class TwoAgentTag(MultiAgentEnv):
+    """Two agents on a line; each gets its own reward; episode ends for
+    all after 8 steps."""
+
+    agents = ["a0", "a1"]
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self):
+        self.t = 0
+        return {a: np.zeros(4, np.float32) for a in self.agents}, {}
+
+    def step(self, action_dict):
+        self.t += 1
+        obs = {a: np.full(4, self.t / 8.0, np.float32) for a in self.agents}
+        rewards = {a: float(act) for a, act in action_dict.items()}
+        done = self.t >= 8
+        terms = {a: done for a in self.agents}
+        terms["__all__"] = done
+        truncs = {"__all__": False}
+        return obs, rewards, terms, truncs, {}
+
+
+def test_multi_agent_runner_shared_policy():
+    module = _module()
+    params = module.init(jax.random.key(0))
+    runner = MultiAgentEnvRunner(TwoAgentTag(), module, rollout_length=20)
+    batch, final, returns = runner.sample(params)
+    # [T, n_agents, obs]: both agents batched through one policy forward
+    assert batch[SampleBatch.OBS].shape == (20, 2, 4)
+    assert batch[SampleBatch.ACTIONS].shape == (20, 2)
+    assert len(returns) >= 2  # 20 steps / 8-step episodes
+    assert final.shape == (2, 4)
