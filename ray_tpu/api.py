@@ -125,13 +125,29 @@ def shutdown() -> None:
 
 def get_cluster() -> Cluster:
     if _cluster is None:
+        from ray_tpu.runtime.worker import _global_worker
+
+        if _global_worker is not None:
+            # inside a worker process: the cluster object lives in the
+            # driver — this operation has no worker-side routing (yet)
+            raise RuntimeError(
+                "this operation is not supported from inside worker "
+                "processes (get/put/wait/@remote tasks and actors are; "
+                "run cluster-introspection calls on the driver)"
+            )
         raise RuntimeError("ray_tpu is not initialized")
     return _cluster
 
 
 def _auto_init() -> None:
     if _cluster is None:
-        init()
+        # inside a worker process a WorkerApiClient is installed as the
+        # global worker: API calls route to the owning driver — starting a
+        # second runtime here would be wrong, not just wasteful
+        from ray_tpu.runtime.worker import _global_worker
+
+        if _global_worker is None:
+            init()
 
 
 # --------------------------------------------------------------------------

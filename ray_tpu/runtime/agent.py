@@ -111,6 +111,13 @@ class AgentFabric:
     def on_actor_process_died(self, node, actor_id: ActorID) -> None:
         self.conn.send("actor_died", {"actor_id": actor_id.binary()})
 
+    def handle_worker_api(self, blob: bytes) -> bytes:
+        """A worker on this agent made a nested API call: the owner (the
+        driver's CoreWorker) lives across the transport — relay and wait.
+        Long timeout: a nested get legitimately waits on real work."""
+        reply = self.conn.request("worker_api", {"blob": blob}, timeout=24 * 3600.0)
+        return reply["blob"]
+
     # -- spec registry (cancellation) ---------------------------------------
     def _remember(self, spec) -> None:
         with self._specs_lock:

@@ -386,6 +386,15 @@ class Cluster:
                 if self._demand_entries:
                     self._demand_cv.wait(timeout=0.05)  # tick while backlogged
 
+    def handle_worker_api(self, blob: bytes) -> bytes:
+        """Nested runtime API call from a worker process on this host: runs
+        against the driver's CoreWorker (the single owner)."""
+        from ray_tpu.runtime import worker_api
+
+        if self.core_worker is None:
+            raise RuntimeError("no core worker attached to this cluster")
+        return worker_api.execute(self.core_worker, blob)
+
     def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
         """Propagate a cancellation to wherever the task is queued/running.
 

@@ -181,6 +181,7 @@ class Node:
             session_dir=cluster.session_dir,
         )
         self.worker_pool.set_on_worker_death(self._on_worker_death)
+        self.worker_pool.api_handler = self._handle_worker_api
         # Prestart a warm worker off-thread (reference: WorkerPool prestart,
         # worker_pool.h:169-193) so the first task doesn't pay the ~200ms
         # child-interpreter startup; further growth is demand-driven and
@@ -435,6 +436,31 @@ class Node:
         self.worker_pool.submit(
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
         )
+
+    def _handle_worker_api(self, task_bin, blob: bytes, op: str = "") -> bytes:
+        """A worker process made a nested runtime API call (worker_api.py).
+
+        Blocking ops release the calling task's resources for the duration
+        (reference: a worker blocked in ray.get releases its CPU via the
+        raylet, NotifyUnblocked) so nested children can schedule; the
+        resources are force-reacquired on wake (transient oversubscription
+        instead of a deadlock)."""
+        from ray_tpu.runtime import worker_api
+
+        spec = self._proc_specs.get(task_bin) if task_bin else None
+        op = op or worker_api.peek_op(blob)
+        blocking = spec is not None and op in worker_api.BLOCKING_OPS
+        if blocking:
+            self.scheduler.release_blocked(spec)
+        try:
+            return self.cluster.handle_worker_api(blob)
+        finally:
+            if blocking and task_bin in self._proc_specs:
+                # reacquire ONLY if the task is still in flight: its worker
+                # may have died/been cancelled while we waited, in which
+                # case the death path already settled the accounting and a
+                # forced reacquire would leak capacity forever
+                self.scheduler.reacquire_blocked(spec)
 
     def kill_candidates(self):
         """Killable process tasks for the memory monitor (OOM policies)."""

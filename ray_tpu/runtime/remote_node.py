@@ -368,6 +368,7 @@ class HeadService:
             "actor_died": lambda c, p: c.peer.on_actor_died_msg(p),
             "resource_report": lambda c, p: c.peer.on_resource_report(p),
             "pull_object": self._h_pull_object,
+            "worker_api": self._h_worker_api,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
             "kv_del": self._h_kv_del,
@@ -416,6 +417,27 @@ class HeadService:
         # the reply itself carries the bytes; pushing would double-send.
         handle.store.skip_push_once(oid)
         self.cluster.pull_object(oid, handle, on_local)
+        return rpc.DEFER
+
+    def _h_worker_api(self, conn: rpc.RpcConnection, payload: dict, rid: int):
+        """Nested API call relayed from an agent's worker.  Served OFF the
+        connection's dispatch thread: a blocking nested get must not stall
+        the agent's task_finished messages — the very messages that resolve
+        it (deadlock otherwise)."""
+        from ray_tpu.runtime import worker_api
+
+        def run():
+            try:
+                blob = worker_api.execute(self.cluster.core_worker, payload["blob"])
+                conn.send_reply(rid, {"blob": blob})
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                conn.send_reply(rid, {"_exc": traceback.format_exc()})
+
+        import threading
+
+        threading.Thread(target=run, name="head-worker-api", daemon=True).start()
         return rpc.DEFER
 
     def _h_kv_put(self, conn, payload, rid=None):

@@ -324,6 +324,19 @@ class LocalScheduler:
         self._pool.release(spec.resources)
         self._drain()
 
+    def release_blocked(self, spec: TaskSpec) -> None:
+        """The task's worker blocked in a nested get/wait: return its
+        resources so children can dispatch (blocked-worker CPU release,
+        reference raylet NotifyUnblocked role)."""
+        self._pool.release(spec.resources)
+        self._drain()
+
+    def reacquire_blocked(self, spec: TaskSpec) -> None:
+        """Wake from a nested block: take the resources back.  Forced —
+        refusing would deadlock the parent; the oversubscription lasts only
+        until currently-running tasks finish."""
+        self._pool.force_acquire(spec.resources)
+
     def queue_len(self) -> int:
         return len(self._ready)
 

@@ -1,0 +1,214 @@
+"""Nested runtime API from inside worker processes.
+
+The reference embeds a full CoreWorker in every worker process, so task code
+can call ``ray.get``/``.remote``/``ray.put`` anywhere (SURVEY §1 layer 4).
+Here workers stay thin: a :class:`WorkerApiClient` forwards API calls as
+``api_request`` frames over the existing pool socket; the node routes them
+to the DRIVER's CoreWorker (directly on the head, over the node transport
+from agents), which owns every object and task exactly as before — the
+ownership invariant keeps a single owner per object instead of
+per-submitter ownership.
+
+Blocking semantics match the reference's "blocked worker releases its CPU"
+rule (``raylet NotifyUnblocked``): while a worker waits in a nested
+``get``/``wait``, its task's resources are returned to the local scheduler
+so child tasks can run — otherwise a fan-out of nested parents deadlocks
+the pool — and re-acquired (forced: transient oversubscription, bounded by
+pool width) when the wait resolves.
+
+Not supported from workers (clear errors, not hangs):
+``num_returns="streaming"`` and detached lifetime actors.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+#: ops whose handler may block awaiting other tasks -> release resources
+BLOCKING_OPS = ("get", "wait")
+
+
+def _dumps(obj) -> bytes:
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except (AttributeError, TypeError, pickle.PicklingError):
+        import cloudpickle
+
+        return cloudpickle.dumps(obj, protocol=5)
+
+
+def peek_op(blob: bytes) -> str:
+    """Cheap op sniff without a full unpickle: the tuple's first element.
+    Falls back to a full load on any surprise."""
+    try:
+        return pickle.loads(blob)[0]
+    except Exception:  # noqa: BLE001
+        return "?"
+
+
+# ---------------------------------------------------------------------------
+# server side (runs in the DRIVER process against its CoreWorker)
+# ---------------------------------------------------------------------------
+def execute(core_worker, blob: bytes) -> bytes:
+    """Run one worker API call; returns pickled ("ok", result) / ("err", exc)."""
+    try:
+        op, kw = pickle.loads(blob)
+        if op == "put":
+            result = core_worker.put(kw["value"])
+        elif op == "get":
+            result = core_worker.get(kw["refs"], timeout=kw.get("timeout"))
+        elif op == "wait":
+            result = core_worker.wait(
+                kw["refs"], num_returns=kw.get("num_returns", 1), timeout=kw.get("timeout")
+            )
+        elif op == "submit_task":
+            if kw.get("num_returns") == "streaming":
+                raise NotImplementedError(
+                    "num_returns='streaming' is not supported from inside "
+                    "worker processes (call it from the driver)"
+                )
+            result = core_worker.submit_task(
+                kw["func"], kw["args"], kw["kwargs"],
+                name=kw["name"], num_returns=kw.get("num_returns", 1),
+                resources=kw.get("resources"),
+                max_retries=kw.get("max_retries"),
+                retry_exceptions=kw.get("retry_exceptions", False),
+                execution=kw.get("execution", "auto"),
+                scheduling_strategy=kw.get("scheduling_strategy"),
+                runtime_env=kw.get("runtime_env"),
+            )
+        elif op == "create_actor":
+            result = core_worker.create_actor(
+                kw["cls"], kw["args"], kw["kwargs"],
+                name=kw.get("name"), namespace=kw.get("namespace", "default"),
+                class_name=kw.get("class_name", ""),
+                resources=kw.get("resources"),
+                max_restarts=kw.get("max_restarts", 0),
+                max_task_retries=kw.get("max_task_retries", 0),
+                max_concurrency=kw.get("max_concurrency", 1),
+                mode=kw.get("mode", "process"),
+                scheduling_strategy=kw.get("scheduling_strategy"),
+            )
+        elif op == "submit_actor_task":
+            result = core_worker.submit_actor_task(
+                kw["actor_id"], kw["method_name"], kw["args"], kw["kwargs"],
+                num_returns=kw.get("num_returns", 1), name=kw.get("name", ""),
+            )
+        else:
+            raise ValueError(f"unknown worker api op {op!r}")
+        _pin_refs(core_worker, result)
+        return _dumps(("ok", result))
+    except BaseException as exc:  # noqa: BLE001 — errors cross the socket
+        try:
+            return _dumps(("err", exc))
+        except BaseException:
+            return _dumps(("err", RuntimeError(f"{type(exc).__name__}: {exc}")))
+
+
+def _pin_refs(core_worker, result) -> None:
+    """Refs returned to a worker must outlive this function: the worker
+    holds them, but its process has no reference counter, so the driver
+    pins a copy for the job's lifetime (otherwise the server-side ObjectRef
+    drops to zero the moment the reply is sent and the object is freed
+    before the worker ever gets it)."""
+    pins = getattr(core_worker, "_worker_api_pins", None)
+    if pins is None:
+        pins = core_worker._worker_api_pins = {}
+
+    def pin(ref) -> None:
+        pins.setdefault(ref.id(), ref)
+
+    from ray_tpu.core.object_ref import ObjectRef
+
+    if isinstance(result, ObjectRef):
+        pin(result)
+    elif isinstance(result, (list, tuple)):
+        for r in result:
+            if isinstance(r, ObjectRef):
+                pin(r)
+
+
+# ---------------------------------------------------------------------------
+# client side (runs in the worker process)
+# ---------------------------------------------------------------------------
+class WorkerApiClient:
+    """CoreWorker-surface shim: every method is one round trip to the owner.
+
+    Installed as the worker process's global worker, so
+    ``rt.get/put/wait/@remote`` work unchanged inside tasks and actors."""
+
+    def __init__(self, send_request, current_task_fn):
+        # send_request(rid, blob): write an api_request frame (thread-safe)
+        self._send = send_request
+        self._current_task = current_task_fn
+        self._rid = itertools.count(1)
+        self._pending: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+
+    # -- plumbing ----------------------------------------------------------
+    def _call(self, op: str, **kw) -> Any:
+        rid = next(self._rid)
+        fut: Future = Future()
+        with self._lock:
+            self._pending[rid] = fut
+        # op rides beside the blob so the node's blocking-op check never
+        # needs to deserialize the (possibly huge) payload
+        self._send(rid, _dumps((op, kw)), self._current_task(), op)
+        status, result = pickle.loads(fut.result())
+        if status == "err":
+            raise result
+        return result
+
+    def on_reply(self, rid: int, blob: bytes) -> None:
+        with self._lock:
+            fut = self._pending.pop(rid, None)
+        if fut is not None:
+            fut.set_result(blob)
+
+    def fail_all(self, error: BaseException) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            try:
+                fut.set_result(_dumps(("err", error)))
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- CoreWorker surface (what ray_tpu/api.py calls) --------------------
+    def put(self, value):
+        return self._call("put", value=value)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        return self._call("get", refs=refs, timeout=timeout)
+
+    def wait(self, refs, num_returns: int = 1, timeout: Optional[float] = None):
+        return self._call("wait", refs=list(refs), num_returns=num_returns, timeout=timeout)
+
+    def submit_task(self, func, args, kwargs, **opts):
+        return self._call("submit_task", func=func, args=args, kwargs=kwargs, **opts)
+
+    def create_actor(self, cls, args, kwargs, **opts):
+        return self._call("create_actor", cls=cls, args=args, kwargs=kwargs, **opts)
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, **opts):
+        return self._call(
+            "submit_actor_task",
+            actor_id=actor_id, method_name=method_name, args=args, kwargs=kwargs, **opts,
+        )
+
+    def get_async(self, ref):
+        """Future-producing get (ObjectRef.future / await support)."""
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as exc:  # noqa: BLE001
+                fut.set_exception(exc)
+
+        threading.Thread(target=run, name="worker-api-get", daemon=True).start()
+        return fut

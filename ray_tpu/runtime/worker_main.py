@@ -51,6 +51,8 @@ def main() -> None:
 
 class Worker:
     def __init__(self, sock: socket.socket, shm_store):
+        import queue as _q
+
         from ray_tpu.runtime import protocol
 
         self._protocol = protocol
@@ -61,11 +63,40 @@ class Worker:
         self._actor_loop: asyncio.AbstractEventLoop | None = None
         self._send_lock = threading.Lock()
         self._put_counter = 0
+        self._exec_queue: "_q.SimpleQueue" = _q.SimpleQueue()
+        # per-THREAD current task: an async actor's loop thread must not
+        # observe (and release resources for) the exec thread's task
+        self._current = threading.local()
+        self._api = None  # WorkerApiClient, installed lazily on first use
 
     # ------------------------------------------------------------------
+    def _install_api(self) -> None:
+        """Make rt.get/put/wait/@remote work inside this worker: a
+        WorkerApiClient (one round trip per call to the owner over the pool
+        socket) becomes the process's global worker."""
+        from ray_tpu.runtime.worker import set_global_worker
+        from ray_tpu.runtime.worker_api import WorkerApiClient
+
+        def send_request(rid: int, blob: bytes, task_id, op: str) -> None:
+            self._reply(
+                "api_request", {"rid": rid, "blob": blob, "task_id": task_id, "op": op}
+            )
+
+        self._api = WorkerApiClient(
+            send_request, lambda: getattr(self._current, "task", None)
+        )
+        set_global_worker(self._api)
+
     def run(self) -> None:
         p = self._protocol
         p.send_msg(self._sock, "register", {"pid": os.getpid()})
+        self._install_api()
+        # Execution runs on its own thread so the socket reader stays free
+        # to deliver api_reply frames while a task blocks in a nested
+        # rt.get (single exec thread: one task at a time, actor-call order
+        # preserved — ActorSchedulingQueue parity as before).
+        exec_thread = threading.Thread(target=self._exec_loop, name="worker-exec", daemon=True)
+        exec_thread.start()
         while True:
             try:
                 msg_type, payload = p.recv_msg(self._sock)
@@ -73,7 +104,23 @@ class Worker:
                 break
             if msg_type == "shutdown":
                 break
-            elif msg_type == "exec":
+            if msg_type == "api_reply":
+                self._api.on_reply(payload["rid"], payload["blob"])
+            else:
+                self._exec_queue.put((msg_type, payload))
+        self._exec_queue.put(None)
+        if self._api is not None:
+            self._api.fail_all(ConnectionError("worker pool connection closed"))
+        if self._shm is not None:
+            self._shm.close()
+
+    def _exec_loop(self) -> None:
+        while True:
+            item = self._exec_queue.get()
+            if item is None:
+                return
+            msg_type, payload = item
+            if msg_type == "exec":
                 self._handle_exec(payload)
             elif msg_type == "actor_create":
                 self._handle_actor_create(payload)
@@ -81,8 +128,6 @@ class Worker:
                 self._handle_actor_call(payload)
             elif msg_type == "ping":
                 self._reply("pong", {})
-        if self._shm is not None:
-            self._shm.close()
 
     def _reply(self, msg_type: str, payload: dict) -> None:
         with self._send_lock:
@@ -117,6 +162,7 @@ class Worker:
         import time
 
         task_id = payload["task_id"]
+        self._current.task = task_id
         try:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
@@ -135,6 +181,8 @@ class Worker:
                     "error_blob": pickle.dumps(_make_task_error(payload.get("name", "task"), exc)),
                 },
             )
+        finally:
+            self._current.task = None
 
     # ------------------------------------------------------------------
     def _handle_actor_create(self, payload: dict) -> None:
@@ -171,7 +219,11 @@ class Worker:
 
                 fut.add_done_callback(done)
                 return
-            result = method(*args, **kwargs)
+            self._current.task = task_id
+            try:
+                result = method(*args, **kwargs)
+            finally:
+                self._current.task = None
             self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(result)})
         except BaseException as exc:  # noqa: BLE001
             self._reply("result", {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})

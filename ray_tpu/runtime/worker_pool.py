@@ -379,6 +379,31 @@ class ProcessWorkerPool:
             target=self._reader_loop, args=(worker,), name=f"pool-reader-{worker.pid}", daemon=True
         ).start()
 
+    #: nested-API dispatcher set by the owning Node:
+    #: fn(task_bin, blob) -> reply_blob (may block awaiting other tasks)
+    api_handler: Optional[Callable[[Optional[bytes], bytes], bytes]] = None
+
+    def _serve_api_request(self, worker: WorkerHandle, payload: dict) -> None:
+        """Run one worker API call on its own thread (it may block in a
+        nested get) and push the reply frame back."""
+        handler = self.api_handler
+
+        def run():
+            try:
+                if handler is None:
+                    raise RuntimeError("nested runtime API is not available on this node")
+                blob = handler(payload.get("task_id"), payload["blob"], payload.get("op", ""))
+            except BaseException as exc:  # noqa: BLE001
+                import pickle as _p
+
+                blob = _p.dumps(("err", RuntimeError(f"worker api failed: {exc}")))
+            try:
+                worker.send("api_reply", {"rid": payload["rid"], "blob": blob})
+            except OSError:
+                pass  # worker died while we worked; its death path handles it
+
+        threading.Thread(target=run, name=f"worker-api-{worker.pid}", daemon=True).start()
+
     def _reader_loop(self, worker: WorkerHandle) -> None:
         while True:
             try:
@@ -386,6 +411,9 @@ class ProcessWorkerPool:
             except (ConnectionError, OSError):
                 self._handle_worker_death(worker)
                 return
+            if msg_type == "api_request":
+                self._serve_api_request(worker, payload)
+                continue
             if msg_type == "result":
                 task_id = payload["task_id"]
                 with self._lock:

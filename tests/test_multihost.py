@@ -324,3 +324,24 @@ def test_worker_prints_forward_to_driver(two_process_cluster, capsys):
         time.sleep(0.2)
     assert "hello-from-agent-worker" in seen
     assert "(node=" in seen  # head prefixes the source node
+
+
+def test_nested_api_from_agent_worker(two_process_cluster):
+    """A worker process ON THE AGENT makes nested rt calls; they relay
+    agent -> head over the transport to the owning driver."""
+    cluster, proc = two_process_cluster
+
+    @rt.remote
+    def child(x):
+        return x * 3
+
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def parent(x):
+        import numpy as np
+
+        ref = rt.put(np.arange(10))
+        nested = rt.get(child.remote(x))
+        return nested + int(rt.get(ref).sum())
+
+    # child may run anywhere; parent runs in an agent worker process
+    assert rt.get(parent.remote(2), timeout=120) == 6 + 45

@@ -1,0 +1,134 @@
+"""Nested runtime API from inside worker processes.
+
+The reference embeds a CoreWorker in every worker, so ray.get/.remote/ray.put
+work anywhere (SURVEY §1 layer 4). Here workers route API calls back to the
+owning driver over the pool socket (runtime/worker_api.py); blocked parents
+release their CPU so children can run (raylet NotifyUnblocked parity).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def runtime():
+    rt.init(num_cpus=2)
+    try:
+        yield rt
+    finally:
+        rt.shutdown()
+
+
+def test_nested_task_from_worker(runtime):
+    @rt.remote(execution="process")
+    def child(x):
+        return x * 2
+
+    @rt.remote(execution="process")
+    def parent(x):
+        # full submit + get round trip from inside a worker process
+        return rt.get(child.remote(x)) + 1
+
+    assert rt.get(parent.remote(10), timeout=120) == 21
+
+
+def test_nested_put_get_from_worker(runtime):
+    import numpy as np
+
+    @rt.remote(execution="process")
+    def roundtrip():
+        arr = np.arange(1000, dtype=np.float64)
+        ref = rt.put(arr)
+        back = rt.get(ref)
+        return float(back.sum())
+
+    assert rt.get(roundtrip.remote(), timeout=120) == pytest.approx(999 * 1000 / 2)
+
+
+def test_nested_fanout_does_not_deadlock(runtime):
+    """Two blocked parents on a 2-CPU node: children can only run because
+    blocked workers release their resources."""
+
+    @rt.remote(execution="process")
+    def leaf(x):
+        return x + 1
+
+    @rt.remote(execution="process")
+    def parent(x):
+        return sum(rt.get([leaf.remote(x), leaf.remote(x + 10)]))
+
+    refs = [parent.remote(0), parent.remote(100)]
+    assert rt.get(refs, timeout=180) == [12, 212]
+
+
+def test_nested_actor_from_worker(runtime):
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    @rt.remote(execution="process")
+    def drive():
+        c = Counter.remote()
+        rt.get(c.add.remote(5))
+        return rt.get(c.add.remote(7))
+
+    assert rt.get(drive.remote(), timeout=120) == 12
+
+
+def test_nested_wait_from_worker(runtime):
+    @rt.remote(execution="process")
+    def slow(x):
+        time.sleep(0.2)
+        return x
+
+    @rt.remote(execution="process")
+    def parent():
+        refs = [slow.remote(i) for i in range(4)]
+        ready, not_ready = rt.wait(refs, num_returns=2, timeout=60)
+        return len(ready), len(not_ready)
+
+    r, nr = rt.get(parent.remote(), timeout=120)
+    assert r == 2 and nr == 2
+
+
+def test_nested_error_propagates(runtime):
+    from ray_tpu.exceptions import RayTaskError
+
+    @rt.remote(execution="process")
+    def boom():
+        raise ValueError("inner")
+
+    @rt.remote(execution="process")
+    def parent():
+        try:
+            rt.get(boom.remote())
+        except RayTaskError:
+            return "caught"
+        return "missed"
+
+    assert rt.get(parent.remote(), timeout=120) == "caught"
+
+
+def test_streaming_from_worker_rejected(runtime):
+    @rt.remote(execution="process")
+    def parent():
+        @rt.remote(num_returns="streaming")
+        def gen():
+            yield 1
+
+        try:
+            gen.remote()
+        except NotImplementedError as exc:
+            return str(exc)
+        return "no error"
+
+    msg = rt.get(parent.remote(), timeout=120)
+    assert "streaming" in msg
