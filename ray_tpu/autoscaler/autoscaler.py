@@ -64,6 +64,12 @@ class StandardAutoscaler:
                 abs(avail.get(k, 0.0) - v) < 1e-9 for k, v in total.items()
             ) and node.scheduler.queue_len() == 0
             busy[node_id.hex()] = not is_idle
+            # subprocess/SSH-provisioned nodes are known to the provider by
+            # their rt_provider_id label, not their cluster node id
+            provider_id = (getattr(node, "labels", None) or {}).get("rt_provider_id")
+            if provider_id:
+                busy[provider_id] = not is_idle
+                totals[provider_id] = total
         return demands, available, busy, totals
 
     def update(self) -> None:

@@ -175,3 +175,163 @@ class TPUSliceProvider(InProcessNodeProvider):
     def slice_members(self, slice_id: str) -> List[str]:
         with self._lock:
             return list(self._slices.get(slice_id, []))
+
+
+class SubprocessNodeProvider(NodeProvider):
+    """Materializes nodes as REAL node-agent OS processes joining the head
+    over the transport (``python -m ray_tpu.runtime.agent``).
+
+    This is the provisioning path `rt up` uses for provider type "local":
+    elastic scale-up spawns a process, scale-down/terminate kills it and the
+    head's disconnect handling runs the node-failure path. Role parity with
+    the reference's local node provider + command runner
+    (``python/ray/autoscaler/_private/local/node_provider.py``,
+    ``command_runner.py``) with exec replacing SSH on one machine."""
+
+    def __init__(self, head_address: str, python: Optional[str] = None):
+        import sys as _sys
+
+        self.head_address = head_address
+        self._python = python or _sys.executable
+        self._lock = threading.Lock()
+        self._procs: Dict[str, object] = {}       # provider id -> Popen
+        self._types: Dict[str, str] = {}
+
+    def create_nodes(self, node_type: NodeTypeConfig, count: int) -> List[str]:
+        import json as _json
+        import os as _os
+        import subprocess as _sp
+
+        created = []
+        for _ in range(count):
+            resources = dict(node_type.resources)
+            cpus = resources.pop("CPU", 1)
+            env = dict(_os.environ)
+            import uuid as _uuid
+
+            pid = f"proc-{_uuid.uuid4().hex[:12]}"
+            # the provider id rides as a node label so the autoscaler can
+            # match its managed ids to live cluster nodes (busy/idle view)
+            labels = {**node_type.labels, "rt_provider_id": pid}
+            proc = _sp.Popen(
+                [
+                    self._python, "-m", "ray_tpu.runtime.agent",
+                    "--address", self.head_address,
+                    "--num-cpus", str(cpus),
+                    "--resources", _json.dumps(resources),
+                    "--labels", _json.dumps(labels),
+                ],
+                env=env,
+                stdout=_sp.DEVNULL,
+                stderr=_sp.DEVNULL,
+            )
+            with self._lock:
+                self._procs[pid] = proc
+                self._types[pid] = node_type.name
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(provider_node_id, None)
+            self._types.pop(provider_node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                pid: t for pid, t in self._types.items()
+                if self._procs[pid].poll() is None
+            }
+
+
+class SSHNodeProvider(NodeProvider):
+    """Starts node agents on remote machines over SSH (``ray up`` role:
+    ``python/ray/autoscaler/_private/command_runner.py`` SSHCommandRunner).
+
+    Config: a list of hosts, an ssh user/key, and the remote python +
+    working dir. Each created node runs ``python -m ray_tpu.runtime.agent``
+    detached (nohup) on the next free host; terminate pkills it there."""
+
+    def __init__(
+        self,
+        head_address: str,
+        hosts: List[str],
+        *,
+        ssh_user: str = "",
+        ssh_key: str = "",
+        remote_python: str = "python3",
+        remote_dir: str = "~",
+    ):
+        self.head_address = head_address
+        self.hosts = list(hosts)
+        self.ssh_user = ssh_user
+        self.ssh_key = ssh_key
+        self.remote_python = remote_python
+        self.remote_dir = remote_dir
+        self._lock = threading.Lock()
+        self._in_use: Dict[str, str] = {}   # host -> node type
+        self._remote_pids: Dict[str, int] = {}  # host -> remote agent PID
+
+    def _ssh_base(self, host: str) -> List[str]:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "ConnectTimeout=10"]
+        if self.ssh_key:
+            cmd += ["-i", self.ssh_key]
+        target = f"{self.ssh_user}@{host}" if self.ssh_user else host
+        return cmd + [target]
+
+    def create_nodes(self, node_type: NodeTypeConfig, count: int) -> List[str]:
+        import json as _json
+        import shlex as _shlex
+        import subprocess as _sp
+
+        created = []
+        with self._lock:
+            free = [h for h in self.hosts if h not in self._in_use]
+        for host in free[:count]:
+            resources = dict(node_type.resources)
+            cpus = resources.pop("CPU", 1)
+            labels = _json.dumps({**node_type.labels, "rt_provider_id": host})
+            agent = (
+                f"cd {self.remote_dir} && nohup {self.remote_python} -m "
+                f"ray_tpu.runtime.agent --address {_shlex.quote(self.head_address)} "
+                f"--num-cpus {cpus} --resources {_shlex.quote(_json.dumps(resources))} "
+                f"--labels {_shlex.quote(labels)} "
+                f">> ray_tpu_agent.log 2>&1 & echo $!"
+            )
+            res = _sp.run(self._ssh_base(host) + [agent], capture_output=True, text=True, timeout=60)
+            if res.returncode == 0:
+                with self._lock:
+                    self._in_use[host] = node_type.name
+                    # remember the remote PID: termination must kill OUR
+                    # agent, not every ray_tpu agent on a shared host
+                    try:
+                        self._remote_pids[host] = int(res.stdout.strip().splitlines()[-1])
+                    except (ValueError, IndexError):
+                        self._remote_pids[host] = 0
+                created.append(host)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        import subprocess as _sp
+
+        with self._lock:
+            self._in_use.pop(provider_node_id, None)
+            pid = self._remote_pids.pop(provider_node_id, 0)
+        kill_cmd = (
+            f"kill {pid} || true" if pid
+            else "pkill -f ray_tpu.runtime.agent || true"  # PID capture failed
+        )
+        _sp.run(
+            self._ssh_base(provider_node_id) + [kill_cmd],
+            capture_output=True, timeout=60,
+        )
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._in_use)

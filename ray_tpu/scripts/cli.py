@@ -106,6 +106,34 @@ def cmd_start(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """`ray up` parity: head + provisioned workers from a YAML config."""
+    import ray_tpu as rt
+    from ray_tpu.autoscaler.launcher import up
+
+    launcher = up(args.config, wait_for_min_workers=not args.no_wait)
+    cluster = rt.get_cluster()
+    live = sum(1 for n in cluster.nodes.values() if not n.dead)
+    print(f"cluster up: control plane at {launcher.address}, {live} nodes")
+    print(f"Join more nodes with: ray_tpu start --address {launcher.address}")
+
+    stop_requested = {"flag": False}
+
+    def _on_term(signum, frame):
+        stop_requested["flag"] = True
+
+    signal.signal(signal.SIGTERM, _on_term)
+    try:
+        while not stop_requested["flag"]:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        launcher.down()
+        rt.shutdown()
+    return 0
+
+
 def _pid_is_head(pid: int) -> bool:
     """Guard against pid reuse: only signal a process that is actually a
     ray_tpu head (checked via /proc cmdline; best-effort elsewhere)."""
@@ -336,6 +364,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the running head")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "up",
+        help="launch a cluster from a YAML config (head here + provisioned "
+        "workers; blocks, Ctrl-C/SIGTERM tears the cluster down)",
+    )
+    sp.add_argument("config", help="cluster YAML (see ray_tpu/autoscaler/launcher.py)")
+    sp.add_argument("--no-wait", action="store_true", help="don't wait for min_workers")
+    sp.set_defaults(fn=cmd_up)
 
     sp = sub.add_parser("status", help="cluster resource status")
     sp.add_argument("--address", default=None)

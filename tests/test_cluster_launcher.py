@@ -1,0 +1,96 @@
+"""Cluster lifecycle provisioning: `rt up`-style launch with real agent
+processes (round-1 VERDICT missing item 6 — ray up / providers / command
+runner).
+
+Reference anchors: python/ray/scripts/scripts.py:1279 (ray up),
+python/ray/autoscaler/_private/command_runner.py,
+_private/local/node_provider.py.
+"""
+
+import time
+
+import pytest
+import yaml
+
+import ray_tpu as rt
+from ray_tpu.autoscaler.launcher import ClusterLauncher, load_cluster_config, up
+from ray_tpu.autoscaler.node_provider import SSHNodeProvider, SubprocessNodeProvider
+from ray_tpu.autoscaler.demand import NodeTypeConfig
+
+
+@pytest.fixture
+def cluster_yaml(tmp_path):
+    cfg = {
+        "cluster_name": "test",
+        "provider": {"type": "local"},
+        "head": {"num_cpus": 2},
+        "available_node_types": {
+            "cpu_worker": {
+                "resources": {"CPU": 1, "pool": 1},
+                "min_workers": 2,
+                "max_workers": 4,
+            }
+        },
+        "max_workers": 4,
+        "idle_timeout_s": 300,
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_up_provisions_min_workers_and_down_terminates(cluster_yaml):
+    launcher = up(cluster_yaml, timeout_s=120)
+    try:
+        cluster = rt.get_cluster()
+        live = [n for n in cluster.nodes.values() if not n.dead]
+        assert len(live) == 3  # head + 2 provisioned agent processes
+
+        # work actually lands on provisioned workers (their exclusive
+        # 'pool' resource)
+        @rt.remote(resources={"pool": 1})
+        def where():
+            import os
+
+            return os.getpid()
+
+        import os
+
+        pids = set(rt.get([where.remote() for _ in range(2)], timeout=60))
+        assert os.getpid() not in pids
+
+        # provisioned agents carry the provider-id label so the autoscaler
+        # can track their busy/idle state
+        labeled = [
+            n for n in live
+            if (getattr(n, "labels", None) or {}).get("rt_provider_id")
+        ]
+        assert len(labeled) == 2
+    finally:
+        launcher.down()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(1 for n in rt.get_cluster().nodes.values() if not n.dead) == 1:
+                break
+            time.sleep(0.2)
+        assert sum(1 for n in rt.get_cluster().nodes.values() if not n.dead) == 1
+        rt.shutdown()
+
+
+def test_config_validation(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\n")
+    with pytest.raises(ValueError, match="available_node_types"):
+        load_cluster_config(str(bad))
+
+
+def test_ssh_provider_command_shape():
+    """The SSH command runner builds the right remote invocation (no real
+    SSH here; command construction is the testable contract)."""
+    p = SSHNodeProvider(
+        "10.0.0.1:6380", ["worker1"], ssh_user="ubuntu", ssh_key="/k",
+        remote_python="python3.11", remote_dir="/opt/app",
+    )
+    base = p._ssh_base("worker1")
+    assert base[0] == "ssh" and "-i" in base and "ubuntu@worker1" == base[-1]
+    assert p.non_terminated_nodes() == {}
