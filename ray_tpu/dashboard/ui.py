@@ -42,11 +42,15 @@ INDEX_HTML = """<!doctype html>
 </header>
 <main>
   <section><h2>Resources</h2><table id="resources"></table></section>
-  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Nodes</h2><table id="nodes"></table></section>
   <section><h2>Work</h2><table id="work"></table></section>
   <section><h2>Jobs</h2><table id="jobs"></table></section>
   <section><h2>Serve</h2><table id="serve"></table></section>
-  <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre></section>
+  <section style="grid-column: 1 / -1"><h2>Actors</h2><table id="actors"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Recent tasks</h2><table id="tasks"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre>
+    <p style="margin:8px 0 0"><a style="color:#7fd1b9" href="/api/timeline" download="timeline.json">download chrome timeline</a></p>
+  </section>
 </main>
 <script>
 const $ = id => document.getElementById(id);
@@ -62,10 +66,11 @@ function bar(used, total) {
   return `<div class="bar"><i style="width:${pct}%"></i></div>`;
 }
 async function refresh() {
-  const [ver, status, nodes, jobs, serve, events, tasks, actors, objects] = await Promise.all([
+  const [ver, status, nodes, jobs, serve, events, tasks, actors, objects, taskList, actorList] = await Promise.all([
     get("/api/version"), get("/api/cluster_status"), get("/api/nodes"), get("/api/jobs"),
     get("/api/serve/applications"), get("/api/events?limit=12"),
     get("/api/summary/tasks"), get("/api/summary/actors"), get("/api/objects?limit=1"),
+    get("/api/tasks?limit=12"), get("/api/actors?limit=12"),
   ]);
   if (ver) $("version").textContent = "v" + ver.version + " · " + ver.session_dir;
   $("updated").textContent = "updated " + new Date().toLocaleTimeString();
@@ -77,9 +82,29 @@ async function refresh() {
     });
     rows($("resources"), ["resource", "used", ""], data);
   }
-  if (nodes) rows($("nodes"), ["node", "state", "head"],
-    nodes.nodes.map(n => [esc(n.node_id.slice(0, 12)),
-      `<span class="${n.state === 'ALIVE' ? 'ok' : 'bad'}">${esc(n.state)}</span>`, n.is_head ? "★" : ""]));
+  if (nodes) rows($("nodes"), ["node", "state", "address", "cpu", "", "labels", "head"],
+    nodes.nodes.map(n => {
+      const tot = (n.resources_total || {})["CPU"] ?? 0;
+      const avail = (n.resources_available || {})["CPU"] ?? 0;
+      const used = tot - avail;
+      return [esc(n.node_id.slice(0, 12)),
+        `<span class="${n.state === 'ALIVE' ? 'ok' : 'bad'}">${esc(n.state)}</span>`,
+        esc(n.address || ""),
+        `<span class="num">${used.toFixed(1)}/${tot.toFixed(1)}</span>`, bar(used, tot),
+        esc(Object.entries(n.labels || {}).map(([k, v]) => k + "=" + v).join(" ").slice(0, 40)),
+        n.is_head ? "★" : ""];
+    }));
+  if (actorList) rows($("actors"), ["actor", "class", "name", "state", "node", "restarts"],
+    (actorList.actors || []).slice(0, 12).map(a => [esc(a.actor_id.slice(0, 12)),
+      esc(a.class_name), esc(a.name),
+      `<span class="${a.state === 'ALIVE' ? 'ok' : a.state === 'DEAD' ? 'bad' : ''}">${esc(a.state)}</span>`,
+      esc((a.node_id || "").slice(0, 12)), esc(a.restarts + "/" + a.max_restarts)]));
+  if (taskList) rows($("tasks"), ["task", "name", "state", "node", "attempt", "duration"],
+    (taskList.tasks || []).slice(-12).reverse().map(t => [esc((t.task_id || "").slice(0, 12)),
+      esc(t.name || ""),
+      `<span class="${t.state === 'FINISHED' ? 'ok' : t.state === 'FAILED' ? 'bad' : ''}">${esc(t.state || "")}</span>`,
+      esc((t.node_id || "").slice(0, 12)), esc(t.attempt ?? 0),
+      t.duration_s == null ? "" : `<span class="num">${(+t.duration_s).toFixed(3)}s</span>`]));
   const work = [];
   if (status) work.push(["pending tasks", `<span class="num">${status.pending_tasks}</span>`]);
   if (tasks) work.push(["tasks total", `<span class="num">${tasks.total_tasks ?? 0}</span>`]);

@@ -347,13 +347,46 @@ class HeadService:
             on_disconnect=self._on_disconnect,
             name="head",
         )
+        self._stop = threading.Event()
+        # Active failure detector (GcsHealthCheckManager parity,
+        # gcs_health_check_manager.h:39,97): socket death catches clean
+        # exits and kill -9 on one host; PINGS catch half-open connections
+        # (network partition, frozen peer) that TCP alone won't surface for
+        # minutes. A node whose resource reports go stale past the failure
+        # threshold gets one ping; no answer => node failure path.
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="head-health", daemon=True
+        )
+        self._health_thread.start()
 
     @property
     def address(self) -> str:
         return self.server.address
 
     def close(self) -> None:
+        self._stop.set()
         self.server.close()
+
+    def _health_loop(self) -> None:
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        period = max(0.2, cfg.health_check_period_s)
+        stale_after = period * max(2, cfg.health_check_failure_threshold)
+        while not self._stop.wait(period):
+            for conn in self.server.connections():
+                handle = conn.peer
+                if handle is None or handle.dead:
+                    continue
+                if time.monotonic() - handle.last_report < stale_after:
+                    continue
+                try:
+                    conn.request("ping", {}, timeout=period * 2)
+                    handle.last_report = time.monotonic()
+                except Exception:  # noqa: BLE001 — unresponsive: declare dead
+                    if not handle.dead:
+                        self.cluster.kill_node(handle.node_id)
+                    conn.close()
 
     # ------------------------------------------------------------------
     def _handlers_for(self, conn: rpc.RpcConnection) -> dict:
