@@ -388,20 +388,36 @@ def _reference_attention(q, k, v, sm_scale: float, causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def default_blocks(head_dim: int) -> tuple:
+    """Measured on a real v5e (scan-amortized, ray_tpu/scripts/kernel_bench.py):
+
+    ==========  =========  =========  =========
+    shape       128x128    256x512    512x1024
+    ==========  =========  =========  =========
+    32k, D=64   1201 ms    1166 ms    **820 ms**
+    8k,  D=64    316 ms     279 ms    **245 ms**
+    8k,  D=128  **103 ms**  211 ms     264 ms
+    ==========  =========  =========  =========
+
+    Large tiles win while they fit VMEM (D<128); at D>=128 the 512x1024
+    K/V + accumulator working set spills and small tiles are ~2.6x faster.
+    """
+    return (512, 1024) if head_dim < 128 else (128, 128)
+
+
 def flash_attention(
     q,
     k,
     v,
     sm_scale: Optional[float] = None,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
     """Blockwise flash attention. q,k,v: [B, H, T, D].
 
-    Default blocks measured on v5e at T=32k/D=64: 512x1024 is ~3.7x faster
-    than 128x128 (fewer grid steps amortize scratch reads; tiles still fit
-    VMEM with margin at D=128).
+    Block sizes default per head_dim from the measured table in
+    :func:`default_blocks`.
 
     Thin wrapper over :func:`flash_attention_with_lse` (an unused lse
     output costs a zero cotangent, which folds away in the backward).
@@ -411,7 +427,7 @@ def flash_attention(
 
 def sliding_window_attention(
     q, k, v, window: int, *, sm_scale: Optional[float] = None, causal: bool = True,
-    block_q: int = 512, block_k: int = 1024,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
 ):
     """Local (sliding-window) flash attention.
 
@@ -426,22 +442,34 @@ def sliding_window_attention(
     return flash_attention_with_lse(q, k, v, sm_scale, causal, block_q, block_k, window)[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(
     q,
     k,
     v,
     sm_scale: Optional[float] = None,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     window: Optional[int] = None,
 ):
     """Flash attention that also returns the per-row logsumexp.
 
     Returns (out [B,H,Tq,D], lse [B,H,Tq] f32). The lse output is what
     makes partial-attention results combinable — ring attention merges
-    per-step outputs with lse-softmax weights (``parallel/ring.py``)."""
+    per-step outputs with lse-softmax weights (``parallel/ring.py``).
+
+    Block defaults resolve HERE, outside the custom_vjp: its fwd/bwd are
+    invoked with the wrapper's original nondiff args, so a None default
+    resolved inside the primal body would leak into the grad path."""
+    if block_q is None or block_k is None:
+        dq, dk = default_blocks(q.shape[-1])
+        block_q = block_q or dq
+        block_k = block_k or dk
+    return _flash_with_lse_cv(q, k, v, sm_scale, causal, block_q, block_k, window)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_with_lse_cv(q, k, v, sm_scale, causal, block_q: int, block_k: int, window):
     out, lse = _fwd_lse(q, k, v, sm_scale, causal, block_q, block_k, window)[0]
     return out, lse
 
@@ -464,7 +492,7 @@ def _bwd_lse(sm_scale, causal, block_q, block_k, window, residuals, g):
     )
 
 
-flash_attention_with_lse.defvjp(_fwd_lse, _bwd_lse)
+_flash_with_lse_cv.defvjp(_fwd_lse, _bwd_lse)
 
 
 def _use_interpret() -> bool:

@@ -45,7 +45,7 @@ def _to_varying(x, axis_name: str):
 
 def ring_attention(
     q, k, v, axis_name: str, *, causal: bool = True, sm_scale: Optional[float] = None,
-    block_q: int = 512, block_k: int = 1024,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
 ):
     """Blockwise ring attention over sequence shards (call inside shard_map).
 
@@ -112,7 +112,7 @@ def ring_attention(
 
 def ring_attention_sharded(
     q, k, v, mesh: Mesh, axis_name: str = "sp", *, causal: bool = True,
-    sm_scale: Optional[float] = None, block_q: int = 512, block_k: int = 1024,
+    sm_scale: Optional[float] = None, block_q: Optional[int] = None, block_k: Optional[int] = None,
     batch_axis: Optional[str] = None, head_axis: Optional[str] = None,
 ):
     """Bind ring attention onto a mesh: [B, H, T, D] arrays sharded on T.
