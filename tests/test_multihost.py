@@ -213,7 +213,7 @@ def test_kill9_agent_fails_actors_and_recovers_node_table(two_process_cluster):
     proc.send_signal(signal.SIGKILL)
     proc.wait(timeout=10)
     with pytest.raises((ActorDiedError, RayActorError)):
-        rt.get(h.poke.remote(), timeout=30)
+        rt.get(h.poke.remote(), timeout=90)
     # node table marks the agent dead
     _wait_for_nodes(cluster, 1)
     dead = [n for n in cluster.nodes.values() if n.dead]
