@@ -232,6 +232,12 @@ class Dataset:
     def iter_jax_batches(self, **kw) -> Iterator[Dict[str, Any]]:
         return self.iterator().iter_jax_batches(**kw)
 
+    def iter_tf_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_tf_batches(**kw)
+
+    def to_tf(self, feature_columns, label_columns, **kw):
+        return self.iterator().to_tf(feature_columns, label_columns, **kw)
+
     def iter_torch_batches(self, **kw) -> Iterator[Dict[str, Any]]:
         return self.iterator().iter_torch_batches(**kw)
 

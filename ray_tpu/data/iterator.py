@@ -118,6 +118,59 @@ class DataIterator:
                 for k, v in batch.items()
             }
 
+    def iter_tf_batches(self, *, batch_size: int = 256, drop_last: bool = False, **kw) -> Iterator[Dict[str, Any]]:
+        """Batches as tf tensors (parity: DataIterator.iter_tf_batches)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", drop_last=drop_last, **kw):
+            yield {
+                k: tf.convert_to_tensor(v) if v.dtype != object else v
+                for k, v in batch.items()
+            }
+
+    def to_tf(self, feature_columns, label_columns, *, batch_size: int = 256):
+        """A tf.data.Dataset over this iterator (parity: Dataset.to_tf):
+        yields (features, labels) tuples (single column -> tensor, several
+        -> dict of tensors)."""
+        import tensorflow as tf
+
+        feats = [feature_columns] if isinstance(feature_columns, str) else list(feature_columns)
+        labels = [label_columns] if isinstance(label_columns, str) else list(label_columns)
+
+        def pick(batch, cols):
+            if len(cols) == 1:
+                return batch[cols[0]]
+            return {c: batch[c] for c in cols}
+
+        def fresh():
+            for batch in self.iter_tf_batches(batch_size=batch_size):
+                yield pick(batch, feats), pick(batch, labels)
+
+        # Probe one batch to build output specs, then hand the SAME
+        # iterator (probe batch first) to the first epoch — a single-pass
+        # source must not lose its first batch to the spec probe.
+        probe_iter = fresh()
+        first = next(probe_iter)
+        state = {"probe": (probe_iter, first)}
+
+        def gen():
+            probe = state.pop("probe", None)
+            if probe is not None:
+                it, head = probe
+                yield head
+                yield from it
+            else:
+                yield from fresh()
+
+        def spec_of(x):
+            if isinstance(x, dict):
+                return {k: tf.TensorSpec(shape=(None,) + tuple(v.shape[1:]), dtype=v.dtype) for k, v in x.items()}
+            return tf.TensorSpec(shape=(None,) + tuple(x.shape[1:]), dtype=x.dtype)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(spec_of(first[0]), spec_of(first[1]))
+        )
+
     def materialize(self):
         if self._owner is not None:
             return self._owner.materialize()

@@ -95,3 +95,15 @@ def test_mongo_bigquery_gated(runtime):
         data.read_bigquery("proj", dataset="d.t")  # lazy: no IO yet
     except ImportError as exc:
         assert "bigquery" in str(exc)
+
+
+def test_iter_tf_batches_and_to_tf(runtime):
+    tf = pytest.importorskip("tensorflow")
+    ds = data.from_items([{"x": float(i), "y": i % 2} for i in range(64)])
+    batches = list(ds.iter_tf_batches(batch_size=16))
+    assert len(batches) == 4
+    assert batches[0]["x"].shape == (16,)
+    tfds = ds.to_tf("x", "y", batch_size=32)
+    feats, labels = next(iter(tfds))
+    assert int(feats.shape[0]) == 32
+    assert labels.dtype in (tf.int64, tf.int32)
