@@ -105,6 +105,10 @@ class _Group:
         self.result: Any = None
         self.generation = 0
         self.arrived = 0
+        # Latched routing ("kv" | "inproc"): decided once on the group's first
+        # collective so a node registering (or an agent dropping) mid-round
+        # can't split ranks across the two rendezvous mechanisms.
+        self.routing: Optional[str] = None
 
 
 class _GroupRegistry:
@@ -214,12 +218,27 @@ def _run_rendezvous(
 ):
     """Route one collective round: in-memory condition-variable rendezvous
     when all ranks share this process; KV-over-transport when the cluster
-    spans OS processes (multi-host fabric)."""
+    spans OS processes (multi-host fabric).  The decision is latched per
+    group on its first round — re-reading live cluster state every call
+    could split ranks of one round across the two mechanisms."""
     from ray_tpu.runtime.kv_client import is_multiprocess
 
-    if is_multiprocess():
-        return _rendezvous_kv(group_name, group, rank, value, reduce_fn, timeout)
-    return _rendezvous(group, rank, value, reduce_fn, timeout)
+    with group.condition:
+        if group.routing is None:
+            group.routing = "kv" if is_multiprocess() else "inproc"
+        routing = group.routing
+    try:
+        if routing == "kv":
+            return _rendezvous_kv(group_name, group, rank, value, reduce_fn, timeout)
+        return _rendezvous(group, rank, value, reduce_fn, timeout)
+    except TimeoutError:
+        # A timed-out round may mean the latch chose wrong (e.g. the group's
+        # first collective ran before the remote ranks' node registered):
+        # clear it so the next attempt re-evaluates instead of being stuck
+        # split forever.
+        with group.condition:
+            group.routing = None
+        raise
 
 
 def _rendezvous(group: _Group, rank: int, value: Any, reduce_fn, timeout: float = 120.0):

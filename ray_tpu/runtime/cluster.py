@@ -108,12 +108,13 @@ class ObjectDirectory:
 class _ActorQueue:
     """Per-actor ordered send queue (head-of-line blocking on dep pulls)."""
 
-    __slots__ = ("pending", "lock", "alive")
+    __slots__ = ("pending", "lock", "alive", "next_seq")
 
     def __init__(self):
         self.pending: deque = deque()   # [spec, ready: bool]
         self.lock = threading.Lock()
         self.alive = False
+        self.next_seq = 0               # per-actor submission order stamp
 
 
 class Cluster:
@@ -276,7 +277,34 @@ class Cluster:
         for info in self.control.actors.list_actors():
             if info.node_id == node_id and info.state in (ActorState.ALIVE, ActorState.PENDING_CREATION):
                 self._handle_actor_failure(info.actor_id, f"node {node_id.hex()[:8]} died")
+        # IN-FLIGHT actor calls (already popped from the per-actor queue and
+        # pushed to the node) are invisible to _fail_actor_queue — without
+        # this sweep their callers hang forever.  Runs AFTER the FSM updates
+        # above so _maybe_retry_actor_task sees the post-death actor state
+        # (reference: direct_actor_task_submitter.h:120 fails pending calls
+        # atomically with the death notice).
+        for spec in self.task_manager.pending_specs():
+            if spec.owner_node == node_id and spec.actor_id is not None:
+                if self._spec_is_queued(spec):
+                    # owner_node is stale: the call was requeued (earlier
+                    # retry) and sits in the per-actor queue, not in flight
+                    # on this node — the queue machinery owns it.
+                    continue
+                if self._maybe_retry_actor_task(spec):
+                    continue
+                self.task_manager.mark_failed(spec)
+                self._commit_error_everywhere(
+                    spec, ActorDiedError(spec.actor_id, f"node {node_id.hex()[:8]} died")
+                )
+                self._after_commit(spec)
         node.shutdown()
+
+    def _spec_is_queued(self, spec: TaskSpec) -> bool:
+        q = self._actor_queues.get(spec.actor_id)
+        if q is None:
+            return False
+        with q.lock:
+            return any(e[0] is spec for e in q.pending)
 
     # ------------------------------------------------------------------
     # task submission (cluster-level)
@@ -343,12 +371,16 @@ class Cluster:
                     with self._demand_lock:
                         self._infeasible_demands.pop(id(spec), None)
                     placed_or_failed.append(entry)
-                    if kind == "task":
-                        with self._demand_cv:
-                            self._park_deadlines.pop(id(spec), None)
                     try:
                         if kind == "task":
                             self.nodes[node_id].submit(spec)
+                            # Deadline cleared only AFTER submit succeeds: a
+                            # dispatch-race re-park must keep the ORIGINAL
+                            # infeasibility clock (same invariant as the
+                            # actor kind) so a flapping node can't keep a
+                            # never-feasible task parked forever.
+                            with self._demand_cv:
+                                self._park_deadlines.pop(id(spec), None)
                         else:
                             # success clears the deadline inside
                             # _start_actor_on; an acquire race re-parks on
@@ -500,7 +532,13 @@ class Cluster:
     # ------------------------------------------------------------------
     # owner-side completion
     # ------------------------------------------------------------------
-    def on_task_finished(self, node: Node, spec: TaskSpec, result: Any, error: Optional[BaseException]) -> None:
+    def on_task_finished(
+        self, node: Node, spec: TaskSpec, result: Any,
+        error: Optional[BaseException], lazy: bool = False,
+    ) -> None:
+        """``lazy=True``: a remote node completed the task and kept the bulk
+        result in its local store — commit locations + completion only; the
+        bytes move peer-to-peer on the data plane when someone reads them."""
         if spec.num_returns == "streaming":
             # only reachable for pre-execution failures (cancellation, a
             # dispatch-time error): surface it as the stream's only item so
@@ -514,6 +552,12 @@ class Cluster:
             # In-flight ACTOR tasks are not resubmitted — their callers must
             # see an error, not hang.
             if spec.actor_id is not None:
+                if lazy and error is None:
+                    # the result's only copy died with the node: surface as a
+                    # worker crash so retry/ActorDiedError policy applies
+                    error = WorkerCrashedError(
+                        f"node {node.node_id.hex()[:8]} died before the result transferred"
+                    )
                 if error is None:
                     # the call actually completed: salvage the result onto
                     # the head node's store
@@ -562,6 +606,14 @@ class Cluster:
             return
 
         # split returns
+        if lazy:
+            # values live in the remote node's store; record locations only
+            for oid in spec.return_ids:
+                self.directory.add_location(oid, node.node_id)
+            self.task_manager.mark_completed(spec)
+            self._after_commit(spec)
+            self._record_task_event(spec, node, "FINISHED")
+            return
         if spec.num_returns == 1:
             values = [result]
         else:
@@ -822,7 +874,46 @@ class Cluster:
             return
         entry = [spec, False]
         with q.lock:
-            q.pending.append(entry)
+            seq = getattr(spec, "_actor_seq", None)
+            if seq is None:
+                # first submission: stamp and append (stamps are monotonic,
+                # so plain appends keep the queue sorted)
+                spec._actor_seq = q.next_seq
+                q.next_seq += 1
+                q.pending.append(entry)
+            else:
+                # a RETRIED in-flight call (actor restart, node death):
+                # reinsert by its original stamp so it runs BEFORE calls
+                # submitted after it — per-actor submission order is the
+                # execution-order guarantee (_pump_actor_queue docstring;
+                # reference: seq-no ordered ActorSchedulingQueue).
+                idx = len(q.pending)
+                for i, e in enumerate(q.pending):
+                    if getattr(e[0], "_actor_seq", float("inf")) > seq:
+                        idx = i
+                        break
+                q.pending.insert(idx, entry)
+        # Post-append DEAD re-check: the death sweep (_handle_actor_failure →
+        # _fail_actor_queue) may have flipped the state and drained the queue
+        # BETWEEN the check above and the append — in that window the entry
+        # would never be failed and the caller would hang forever (reference:
+        # per-actor queues fail pending calls atomically with the death
+        # notice, direct_actor_task_submitter.h:120).  Only fail it ourselves
+        # if WE removed it — if the sweep ran after the append it already did.
+        info = self.control.actors.get(spec.actor_id)
+        if info is None or info.state is ActorState.DEAD:
+            removed = False
+            with q.lock:
+                try:
+                    q.pending.remove(entry)
+                    removed = True
+                except ValueError:
+                    pass
+            if removed:
+                self.task_manager.mark_failed(spec)
+                self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))
+                self._after_commit(spec)
+            return
         # start dep pulls targeting the actor's node (known once alive)
         self._prepare_actor_entry(entry)
 
@@ -867,7 +958,15 @@ class Cluster:
                         break
                     head[1] = True
                 q.pending.popleft()
-                node.submit_actor_task(head[0])
+                try:
+                    node.submit_actor_task(head[0])
+                except ConnectionError:
+                    # The node died under us: requeue at the front (order
+                    # preserved) and let the death sweep fail/retry the
+                    # whole queue.  Raising here would surface a transport
+                    # error at the caller's .remote() site.
+                    q.pending.appendleft(head)
+                    break
         if needs_prep is not None:
             self._prepare_actor_entry(needs_prep)
 

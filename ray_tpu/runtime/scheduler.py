@@ -64,7 +64,7 @@ class TaskSpec:
         "retries_left", "execution", "actor_id", "scheduling_strategy",
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
-        "_oom_killed", "_stream_closed",
+        "_oom_killed", "_stream_closed", "_actor_seq",
     )
 
     def __init__(
@@ -112,6 +112,9 @@ class TaskSpec:
         self._cancelled = False
         self._oom_killed = False
         self._stream_closed = False
+        # per-actor submission-order stamp, assigned on first enqueue;
+        # retries reinsert by it (see Cluster.submit_actor_task)
+        self._actor_seq = None
 
 
 # --------------------------------------------------------------------------

@@ -299,19 +299,20 @@ class ContainerPlugin(RuntimeEnvPlugin):
         import shlex
 
         engine = self._engine()
-        opts = " ".join(shlex.quote(o) for o in value.get("run_options", ()))
         workdir = cwd or os.getcwd()
         # forward exactly the user's env_vars (host PYTHONPATH etc. would be
         # dangling paths inside the image — the image must ship its own
         # Python environment, reference container.py behavior)
         user_env = (runtime_env or {}).get("env_vars", {})
-        env_flags = " ".join(
-            f"-e {shlex.quote(f'{k}={v}')}" for k, v in user_env.items()
-        )
-        return (
-            f"{engine} run --rm {opts} -v {shlex.quote(workdir)}:/work -w /work "
-            f"{env_flags} {shlex.quote(value['image'])} /bin/sh -c {shlex.quote(entrypoint)}"
-        ).replace("  ", " ")
+        parts = [engine, "run", "--rm"]
+        parts.extend(shlex.quote(o) for o in value.get("run_options", ()))
+        parts.extend(["-v", f"{shlex.quote(workdir)}:/work", "-w", "/work"])
+        for k, v in user_env.items():
+            parts.extend(["-e", shlex.quote(f"{k}={v}")])
+        parts.extend([shlex.quote(value["image"]), "/bin/sh", "-c", shlex.quote(entrypoint)])
+        # join non-empty parts with single spaces: a post-hoc
+        # .replace("  ", " ") would corrupt double spaces INSIDE quoted values
+        return " ".join(p for p in parts if p)
 
 
 class MPIPlugin(RuntimeEnvPlugin):

@@ -212,8 +212,11 @@ def test_kill9_agent_fails_actors_and_recovers_node_table(two_process_cluster):
     assert rt.get(h.poke.remote()) == "ok"
     proc.send_signal(signal.SIGKILL)
     proc.wait(timeout=10)
+    # Tight timeout on purpose: the death sweep must fail the pending call
+    # promptly (the former 90 s value masked a submit/death-sweep TOCTOU
+    # race where the call was never failed at all).
     with pytest.raises((ActorDiedError, RayActorError)):
-        rt.get(h.poke.remote(), timeout=90)
+        rt.get(h.poke.remote(), timeout=15)
     # node table marks the agent dead
     _wait_for_nodes(cluster, 1)
     dead = [n for n in cluster.nodes.values() if n.dead]
