@@ -104,6 +104,13 @@ class Config:
     control_port: int = 6380
     # ray_syncer-equivalent resource broadcast period.
     resource_sync_period_s: float = 0.1
+    # Values at or below this size ride the (ordered, low-latency) control
+    # connection; larger ones move peer-to-peer on the chunked data plane so
+    # bulk bytes never head-of-line-block heartbeats or dispatch.
+    data_plane_inline_bytes: int = 64 * 1024
+    # Admission control: concurrent bulk transfers served/issued per process
+    # (reference: PullManager admission, pull_manager.h:52).
+    max_concurrent_object_transfers: int = 4
 
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):

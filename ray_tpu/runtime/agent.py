@@ -34,25 +34,100 @@ class AgentFabric:
         self.session_dir = session_dir
         self.conn: Optional[rpc.RpcConnection] = None
         self.node = None          # set after registration
+        self.data_client = None   # peer-to-peer bulk transfer (data_plane)
+        self._pull_pool = None    # lazily-built transfer thread pool
         self._specs: Dict[bytes, Any] = {}   # task_id -> agent-side spec
         self._specs_lock = threading.Lock()
 
+    def _transfer_pool(self):
+        if self._pull_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ray_tpu.core.config import get_config
+
+            self._pull_pool = ThreadPoolExecutor(
+                max_workers=max(1, get_config().max_concurrent_object_transfers),
+                thread_name_prefix="agent-pull",
+            )
+        return self._pull_pool
+
     # -- object movement ----------------------------------------------------
     def pull_object(self, oid: ObjectID, node, callback) -> None:
+        """Dependency pull.  The head is consulted for *metadata only*
+        (``locate_object`` resolves the ObjectID to a peer's data address);
+        the bytes then move peer-to-peer on the chunked data plane — never
+        relayed through the head (reference: node-to-node Push/Pull,
+        object_manager.h:117).  Falls back to the head-relay path when the
+        data plane can't serve (peer died mid-transfer, no data address)."""
         if node.store.contains(oid):
             callback()
             return
 
-        def on_reply(reply, error):
-            if error is not None:
-                # Head gone: the process is about to exit via on_disconnect;
-                # leave the waiter — nothing can complete it.
-                return
-            value, is_error = rpc.decode_value(reply)
-            node.store.put(oid, value, is_error=is_error)
-            callback()
+        def relay():
+            # head-relay fallback: correct under every failure mode (the
+            # head re-resolves, recovers via lineage, tombstones lost
+            # objects), at the cost of shipping bytes through the head.
+            def on_reply(reply, error):
+                if error is not None:
+                    # Head gone: the process is about to exit via
+                    # on_disconnect; leave the waiter.
+                    return
+                value, is_error = rpc.decode_value(reply)
+                node.store.put(oid, value, is_error=is_error)
+                callback()
 
-        self.conn.request_async("pull_object", {"oid": oid.binary()}, on_reply)
+            self.conn.request_async("pull_object", {"oid": oid.binary()}, on_reply)
+
+        if self.data_client is None:
+            relay()
+            return
+
+        def on_locate(reply, error):
+            if isinstance(error, rpc.RemoteHandlerError):
+                # live head, failing handler (e.g. version skew): the relay
+                # path can still serve — only connection loss strands us
+                relay()
+                return
+            if error is not None:
+                return  # head gone; process exiting
+            addr = reply.get("addr")
+            if addr == "self":
+                # a push to this node is already in flight — wait for it
+                self._transfer_pool().submit(self._wait_local, oid, node, callback, relay)
+            elif addr:
+                self._transfer_pool().submit(
+                    self._direct_pull, addr, oid, node, callback, relay
+                )
+            else:
+                relay()
+
+        self.conn.request_async("locate_object", {"oid": oid.binary()}, on_locate)
+
+    def _wait_local(self, oid: ObjectID, node, callback, fallback) -> None:
+        try:
+            node.store.get(oid, timeout=30)
+            callback()
+        except Exception:  # noqa: BLE001
+            fallback()
+
+    def _direct_pull(self, addr: str, oid: ObjectID, node, callback, fallback) -> None:
+        from ray_tpu.runtime import data_plane
+
+        try:
+            blob, is_error = self.data_client.pull(addr, oid.binary(), timeout=30.0)
+            value = data_plane.from_blob(blob)
+        except Exception:  # noqa: BLE001 — peer died / stale location
+            fallback()
+            return
+        node.store.put(oid, value, is_error=is_error)
+        # metadata-only notice: the head's directory records this node as a
+        # location so future consumers can pull from here and recovery knows
+        # this copy exists
+        try:
+            self.conn.send("object_location", {"oid": oid.binary()})
+        except rpc.RpcError:
+            pass
+        callback()
 
     # -- completion callbacks (forwarded to the owner on the head) ----------
     def on_task_finished(self, node, spec, result, error) -> None:
@@ -74,9 +149,35 @@ class AgentFabric:
             values = list(result) if result is not None else [None] * spec.num_returns
         for oid, value in zip(spec.return_ids, values):
             node.store.put(oid, value)
+        from ray_tpu.core.config import get_config
+
+        threshold = get_config().data_plane_inline_bytes
+
+        def lazy_commit() -> None:
+            # LAZY commit: bulk results stay here; the completion notice is
+            # metadata-only and consumers pull the bytes peer-to-peer on
+            # demand.  The control connection never carries bulk frames.
+            self.conn.send(
+                "task_finished",
+                {"task_id": spec.task_id.binary(), "value": None, "error": None, "lazy": True},
+            )
+
+        if self.data_client is not None:
+            # cheap size probe first (ndarray/bytes cover the bulk cases) so
+            # a multi-GB result isn't pickled just to be thrown away
+            approx = getattr(result, "nbytes", None)
+            if approx is None and isinstance(result, (bytes, bytearray)):
+                approx = len(result)
+            if approx is not None and approx > threshold:
+                lazy_commit()
+                return
+        enc = rpc.encode_value(result)
+        if self.data_client is not None and len(enc["value_blob"]) > threshold:
+            lazy_commit()
+            return
         self.conn.send(
             "task_finished",
-            {"task_id": spec.task_id.binary(), "value": rpc.encode_value(result), "error": None},
+            {"task_id": spec.task_id.binary(), "value": enc, "error": None},
         )
 
     def on_stream_item(self, node, spec, index: int, value, is_error: bool = False) -> None:
@@ -179,6 +280,20 @@ class NodeAgent:
         set_config(cfg)
         self.node = Node(self.node_id, self.resources, self.fabric, shm_store=None, labels=self.labels)
         self.fabric.node = self.node
+        # Bulk data plane: this node serves its local store to peers and
+        # pulls dependencies directly from whichever peer holds them (the
+        # head is only the address book — see data_plane.py docstring).
+        from ray_tpu.runtime import data_plane
+
+        # Bind all interfaces; advertise the IP this host is reachable at
+        # from the head's side of the control connection (loopback would be
+        # undialable for peers on other machines).
+        self.data_server = data_plane.store_server(self.node.store, host="0.0.0.0")
+        self.data_address = f"{self.conn.local_ip}:{self.data_server.port}"
+        self.fabric.data_client = data_plane.DataClient(
+            chunk_bytes=cfg.object_transfer_chunk_bytes,
+            max_concurrent=cfg.max_concurrent_object_transfers,
+        )
         # collectives / gang rendezvous in this process reach the cluster KV
         # over the head connection
         from ray_tpu.runtime.kv_client import register_agent_kv
@@ -213,6 +328,7 @@ class NodeAgent:
                 "resources": self.resources,
                 "labels": self.labels,
                 "address": _self_address(),
+                "data_address": self.data_address,
             },
         )
         threading.Thread(target=self._report_loop, name="agent-report", daemon=True).start()
@@ -287,11 +403,39 @@ class NodeAgent:
         value, is_error = rpc.decode_value(payload)
         self.node.store.put(ObjectID(payload["oid"]), value, is_error=is_error)
 
-    def _h_fetch_object(self, conn, payload, rid) -> dict:
+    def _h_fetch_object(self, conn, payload, rid):
+        # Resolve asynchronously: a blocking store.get here would park the
+        # connection's single dispatch thread, so the very push_object frame
+        # that could satisfy it (or any submit/cancel behind it) would queue
+        # forever — the head-side RemoteStore.get would only unblock at its
+        # own timeout.  DEFER keeps the dispatch thread free.
         oid = ObjectID(payload["oid"])
-        value = self.node.store.get(oid, timeout=30)
-        info = self.node.store.entry_info(oid)
-        return rpc.encode_value(value, bool(info and info["is_error"]))
+        fut = self.node.store.get_async(oid)
+        replied = threading.Event()
+
+        def reply_once(payload_dict: dict) -> None:
+            if not replied.is_set():
+                replied.set()
+                conn.send_reply(rid, payload_dict)
+
+        def on_done(f):
+            try:
+                value = f.result()
+            except Exception as exc:  # noqa: BLE001 — relay, don't kill dispatch
+                reply_once({"_exc": repr(exc)})
+                return
+            info = self.node.store.entry_info(oid)
+            reply_once(rpc.encode_value(value, bool(info and info["is_error"])))
+
+        # bound the deferral: without it an object that never materializes
+        # keeps the rid + connection captured forever (the head-side request
+        # already timed out and popped the rid anyway)
+        timer = threading.Timer(30.0, reply_once, args=({"_exc": "fetch_object timed out"},))
+        timer.daemon = True
+        timer.start()
+        fut.add_done_callback(on_done)
+        fut.add_done_callback(lambda f: timer.cancel())
+        return rpc.DEFER
 
     def _h_delete_object(self, conn, payload) -> None:
         self.node.store.delete(ObjectID(payload["oid"]))
@@ -331,6 +475,10 @@ class NodeAgent:
         self._stop.set()
         if self.node is not None:
             self.node.shutdown()
+        if getattr(self, "data_server", None) is not None:
+            self.data_server.close()
+        if self.fabric.data_client is not None:
+            self.fabric.data_client.close()
         if self.conn is not None:
             self.conn.close()
 

