@@ -294,6 +294,11 @@ class NodeAgent:
             chunk_bytes=cfg.object_transfer_chunk_bytes,
             max_concurrent=cfg.max_concurrent_object_transfers,
         )
+        # collectives in this process send/recv store-to-store on the data
+        # plane (runtime/p2p.py) instead of polling values through the KV
+        from ray_tpu.runtime import p2p
+
+        p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
         # collectives / gang rendezvous in this process reach the cluster KV
         # over the head connection
         from ray_tpu.runtime.kv_client import register_agent_kv
@@ -475,6 +480,9 @@ class NodeAgent:
         self._stop.set()
         if self.node is not None:
             self.node.shutdown()
+        from ray_tpu.runtime import p2p
+
+        p2p.clear_endpoint()
         if getattr(self, "data_server", None) is not None:
             self.data_server.close()
         if self.fabric.data_client is not None:

@@ -224,9 +224,19 @@ class Cluster:
         other processes) can join (``rt start --address=<returned addr>``).
         Returns the listen address. Idempotent."""
         if self.head_service is None:
+            from ray_tpu.runtime import p2p
             from ray_tpu.runtime.remote_node import HeadService
 
             self.head_service = HeadService(self, host, port)
+            # driver-resident collective ranks ride the data plane too;
+            # on_consume drops the directory entry the head data server
+            # records per inbound blob (mailbox ids must not accumulate)
+            p2p.register_endpoint(
+                self.head_node.store,
+                self.head_service.data_client,
+                self.head_service.data_server.address,
+                on_consume=self.directory.forget,
+            )
         return self.head_service.address
 
     def register_remote_node(self, handle) -> None:
@@ -991,6 +1001,9 @@ class Cluster:
                 pass
 
     def shutdown(self) -> None:
+        from ray_tpu.runtime import p2p
+
+        p2p.clear_endpoint()
         with self._demand_cv:
             self._demand_stop = True
             self._demand_cv.notify_all()

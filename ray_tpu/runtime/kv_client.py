@@ -89,6 +89,15 @@ def get_kv() -> Optional[KVClient]:
     return None
 
 
+def head_peer_ip() -> Optional[str]:
+    """The head's IP as seen from this process (agents only) — used to
+    rewrite wildcard-bound data addresses to something dialable."""
+    with _lock:
+        if _agent_conn is not None and not _agent_conn.closed:
+            return _agent_conn.peer_ip
+    return None
+
+
 def is_multiprocess() -> bool:
     """True when collective/rendezvous state must go through the shared KV
     (this process is an agent, or the cluster has remote nodes) rather than

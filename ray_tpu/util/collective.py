@@ -35,11 +35,125 @@ def create_collective_group(
     backend: str = "tpu",
     group_name: str = "default",
 ) -> None:
-    """Declarative group creation (reference: collective.py:151) — the driver
-    registers the group; actors then call collective ops with their rank."""
+    """Declarative group creation (reference: collective.py:151): binds each
+    actor to its rank so collective ops called from actor code resolve their
+    rank automatically (no manual ``set_rank``), and pre-registers every
+    rank's data-plane address so cross-process sends never wait on lazy
+    registration."""
     if len(actors) != len(ranks) or len(ranks) != world_size:
         raise ValueError("actors/ranks/world_size mismatch")
-    init_collective_group(world_size, ranks[0], backend, group_name)
+    for group_rank in ranks:
+        if not 0 <= group_rank < world_size:
+            raise ValueError(f"rank {group_rank} out of range for world_size {world_size}")
+    if len(set(ranks)) != world_size:
+        raise ValueError("ranks must be unique")
+    # Create the registry entry directly — init_collective_group would also
+    # publish THIS (driver) process's address as ranks[0]'s endpoint, which
+    # is wrong when that rank's actor lives elsewhere.
+    _registry.destroy(group_name)
+    _registry.get_or_create(group_name, world_size)
+
+    # actor -> rank binding, readable from any process via the cluster KV
+    binding = {}
+    for actor, group_rank in zip(actors, ranks):
+        actor_id = getattr(actor, "_actor_id", None)
+        if actor_id is None:
+            raise ValueError("create_collective_group expects actor handles")
+        binding[actor_id.hex()] = group_rank
+    _bind_group(group_name, world_size, binding)
+
+    # resolve each actor's hosting node to its data-plane address and
+    # publish rank->address upfront (the actors may never call set_rank)
+    try:
+        from ray_tpu import api
+        from ray_tpu.runtime import p2p
+
+        cluster = api.get_cluster()
+        for actor, group_rank in zip(actors, ranks):
+            info = _wait_actor_placed(cluster, actor._actor_id)
+            if info is None or info.node_id is None:
+                continue
+            node = cluster.nodes.get(info.node_id)
+            addr = getattr(node, "data_address", None)
+            if not addr and cluster.head_service is not None:
+                addr = cluster.head_service.data_server.address
+            if addr:
+                p2p.register_rank(group_name, group_rank, addr)
+    except Exception:  # noqa: BLE001 — in-proc clusters have no data plane
+        pass
+
+
+def _wait_actor_placed(cluster, actor_id, timeout: float = 30.0):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        info = cluster.control.actors.get(actor_id)
+        if info is not None and info.node_id is not None:
+            return info
+        _time.sleep(0.01)
+    return cluster.control.actors.get(actor_id)
+
+
+# group-name -> {actor_id_hex: rank}; mirrored in the KV for other processes
+_group_bindings: Dict[str, Dict[str, int]] = {}
+_bindings_lock = threading.Lock()
+
+
+def _bind_group(group_name: str, world_size: int, binding: Dict[str, int]) -> None:
+    import os
+    import pickle
+
+    with _bindings_lock:
+        _group_bindings[group_name] = dict(binding)
+    from ray_tpu.runtime.kv_client import get_kv
+
+    kv = get_kv()
+    if kv is not None:
+        # epoch: unique per creation, so participant processes holding state
+        # from an earlier same-named group reset instead of desyncing
+        kv.put(
+            f"rt_coll_grp/{group_name}".encode(),
+            pickle.dumps(
+                {
+                    "world_size": world_size,
+                    "binding": binding,
+                    "epoch": os.urandom(8).hex(),
+                },
+                protocol=5,
+            ),
+        )
+
+
+def _rank_from_actor_context(group_name: str) -> Optional[int]:
+    """Declarative-binding fallback for _need_rank: the currently-executing
+    actor's rank in the group, if bound via create_collective_group."""
+    from ray_tpu.runtime.context import task_context
+
+    current = task_context.current()
+    if current is None:
+        return None
+    actor = current[0].actor_id()
+    if actor.is_nil():
+        return None
+    aid = actor.hex()
+    with _bindings_lock:
+        binding = _group_bindings.get(group_name)
+    if binding is None:
+        import pickle
+
+        from ray_tpu.runtime.kv_client import get_kv
+
+        kv = get_kv()
+        if kv is None:
+            return None
+        raw = kv.get(f"rt_coll_grp/{group_name}".encode())
+        if raw is None:
+            return None
+        binding = pickle.loads(raw)["binding"]
+        with _bindings_lock:
+            _group_bindings[group_name] = binding
+    return binding.get(aid)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -54,25 +168,65 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _registry.get(group_name).world_size
 
 
+def _ensure_group(group_name: str) -> None:
+    """Materialize a declaratively-created group in THIS process: an actor
+    bound via create_collective_group never called init_collective_group
+    here, so pull world_size from the group record in the KV.  The record's
+    epoch detects a re-created group: stale local state (generation
+    counters, cached bindings) resets instead of desyncing mailbox ids."""
+    import pickle
+
+    from ray_tpu.runtime import p2p
+    from ray_tpu.runtime.kv_client import get_kv
+
+    existing = None
+    try:
+        existing = _registry.get(group_name)
+    except KeyError:
+        pass
+    kv = get_kv()
+    if kv is None:
+        return
+    raw = kv.get(f"rt_coll_grp/{group_name}".encode())
+    if raw is None:
+        return
+    record = pickle.loads(raw)
+    epoch = record.get("epoch")
+    if existing is not None and getattr(existing, "epoch", None) == epoch:
+        return
+    if existing is not None:
+        _registry.destroy(group_name)
+        p2p.forget_group(group_name)
+        with _bindings_lock:
+            _group_bindings.pop(group_name, None)
+    group = _registry.get_or_create(group_name, record["world_size"])
+    group.epoch = epoch
+
+
 # ------------------------------------------------------------------- ops
 def allreduce(tensor, group_name: str = "default", op: str = "sum", *, rank: Optional[int] = None):
-    return allreduce_tensor(tensor, _need_rank(rank), group_name, op)
+    _ensure_group(group_name)
+    return allreduce_tensor(tensor, _need_rank(rank, group_name), group_name, op)
 
 
 def allgather(tensor, group_name: str = "default", *, rank: Optional[int] = None) -> List[Any]:
-    return allgather_tensor(tensor, _need_rank(rank), group_name)
+    _ensure_group(group_name)
+    return allgather_tensor(tensor, _need_rank(rank, group_name), group_name)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default", *, rank: Optional[int] = None):
-    return broadcast_tensor(tensor, _need_rank(rank), src_rank, group_name)
+    _ensure_group(group_name)
+    return broadcast_tensor(tensor, _need_rank(rank, group_name), src_rank, group_name)
 
 
 def reducescatter(tensor, group_name: str = "default", *, rank: Optional[int] = None):
-    return reducescatter_tensor(tensor, _need_rank(rank), group_name)
+    _ensure_group(group_name)
+    return reducescatter_tensor(tensor, _need_rank(rank, group_name), group_name)
 
 
 def barrier(group_name: str = "default", *, rank: Optional[int] = None) -> None:
-    allreduce_tensor(0, _need_rank(rank), group_name)
+    _ensure_group(group_name)
+    allreduce_tensor(0, _need_rank(rank, group_name), group_name)
 
 
 # ---------------------------------------------------------- point-to-point
@@ -109,23 +263,25 @@ _p2p_lock = threading.Lock()
 def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[int] = None) -> None:
     """Reference: collective.py:531 — point-to-point send.
 
-    Same-process ranks use in-memory mailboxes; across OS processes
-    (multi-host fabric) the message rides the cluster KV over the transport."""
-    src = _need_rank(rank)
-    from ray_tpu.runtime.kv_client import get_kv, is_multiprocess
+    Transport-native: across OS processes the message moves store-to-store
+    on the chunked data plane (``runtime/p2p.py``) — a direct push into the
+    destination process, never a value through the head KV.  Same-process
+    ranks (no fabric endpoint) use in-memory mailboxes."""
+    src = _need_rank(rank, group_name)
+    from ray_tpu.runtime import p2p
+    from ray_tpu.runtime.kv_client import is_multiprocess
 
-    if is_multiprocess():
-        import pickle
-
+    ep = p2p.get_endpoint()
+    if ep is not None and is_multiprocess():
         from ray_tpu.parallel.collective import _host_value
 
         with _p2p_lock:
             seq = _p2p_send_seq.get((group_name, src, dst_rank), 0)
             _p2p_send_seq[(group_name, src, dst_rank)] = seq + 1
-        get_kv().put(
-            f"rt_p2p/{group_name}/{src}/{dst_rank}/{seq}".encode(),
-            pickle.dumps(_host_value(tensor), protocol=5),
-        )
+        # make sure the counterpart can answer/see us before first contact
+        p2p.register_rank(group_name, src)
+        oid = p2p.mailbox_oid("p2p", group_name, src, dst_rank, seq)
+        p2p.post_to_rank(group_name, dst_rank, oid, _host_value(tensor))
         return
     box = _mail.box(group_name, src, dst_rank)
     with box.cond:
@@ -134,31 +290,30 @@ def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[i
 
 
 def recv(src_rank: int, group_name: str = "default", *, rank: Optional[int] = None, timeout: float = 120.0):
-    """Reference: collective.py:594 — blocking point-to-point receive."""
-    dst = _need_rank(rank)
-    from ray_tpu.runtime.kv_client import get_kv, is_multiprocess
+    """Reference: collective.py:594 — blocking point-to-point receive.
 
-    if is_multiprocess():
-        import pickle
-        import time as _time
+    Waits on the LOCAL store's condition variable (the inbound data-plane
+    push wakes it) — no polling anywhere."""
+    dst = _need_rank(rank, group_name)
+    from ray_tpu.runtime import p2p
+    from ray_tpu.runtime.kv_client import is_multiprocess
 
+    ep = p2p.get_endpoint()
+    if ep is not None and is_multiprocess():
+        # publish where this rank lives so senders can reach us
+        p2p.register_rank(group_name, dst)
         with _p2p_lock:
             seq = _p2p_recv_seq.get((group_name, src_rank, dst), 0)
-        kv = get_kv()
-        key = f"rt_p2p/{group_name}/{src_rank}/{dst}/{seq}".encode()
-        deadline = _time.monotonic() + timeout
-        while True:
-            raw = kv.get(key)
-            if raw is not None:
-                kv.delete(key)
-                # consume the sequence number only on success — a timed-out
-                # recv must retry the SAME slot, or the FIFO desyncs
-                with _p2p_lock:
-                    _p2p_recv_seq[(group_name, src_rank, dst)] = seq + 1
-                return pickle.loads(raw)
-            if _time.monotonic() > deadline:
-                raise TimeoutError(f"recv from rank {src_rank} timed out")
-            _time.sleep(0.002)
+        oid = p2p.mailbox_oid("p2p", group_name, src_rank, dst, seq)
+        try:
+            value = p2p.take(oid, timeout=timeout)
+        except Exception as exc:  # noqa: BLE001 — GetTimeoutError etc.
+            raise TimeoutError(f"recv from rank {src_rank} timed out") from exc
+        # consume the sequence number only on success — a timed-out recv
+        # must retry the SAME slot, or the FIFO desyncs
+        with _p2p_lock:
+            _p2p_recv_seq[(group_name, src_rank, dst)] = seq + 1
+        return value
     box = _mail.box(group_name, src_rank, dst)
     with box.cond:
         ok = box.cond.wait_for(lambda: bool(box.items), timeout=timeout)
@@ -177,12 +332,21 @@ def set_rank(rank: int) -> None:
     _rank_local.value = rank
 
 
-def _need_rank(rank: Optional[int]) -> int:
+def _need_rank(rank: Optional[int], group_name: str = "default") -> int:
     if rank is not None:
         return rank
     r = getattr(_rank_local, "value", None)
+    if r is not None:
+        return r
+    # declarative binding: the executing actor's rank from
+    # create_collective_group (reference: collective.py:151 infers rank
+    # from the registered actor)
+    r = _rank_from_actor_context(group_name)
     if r is None:
-        raise ValueError("rank not set: pass rank= or call collective.set_rank(rank) first")
+        raise ValueError(
+            "rank not set: pass rank=, call collective.set_rank(rank), or bind "
+            "this actor via create_collective_group"
+        )
     return r
 
 

@@ -1,0 +1,187 @@
+"""Transport-native collectives (round-3 VERDICT item 5).
+
+Cross-process ``send``/``recv`` and group rendezvous move store-to-store on
+the chunked data plane; the head KV carries only tiny rank→address
+registrations — never message payloads (the round-2 path polled pickled
+values through ``rt_p2p/`` KV keys at 2 ms).  Declarative
+``create_collective_group`` binds actors to ranks so collective ops need no
+manual ``set_rank``.
+
+Reference parity anchors: ``python/ray/util/collective/collective.py``
+:151 (create), :531/:594 (send/recv);
+``collective_group/nccl_collective_group.py`` (the transport-bound backend
+role NCCL plays for GPUs).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.runtime.scheduler import NodeAffinitySchedulingStrategy
+
+from test_multihost import _spawn_agent, _wait_for_nodes, two_process_cluster  # noqa: F401
+
+
+class _KVRecorder:
+    """Wraps the head's InternalKV put to record every key written."""
+
+    def __init__(self, kv):
+        self._kv = kv
+        self._orig_put = kv.put
+        self.keys = []
+
+    def __enter__(self):
+        def recording_put(key, value, *a, **kw):
+            self.keys.append(bytes(key))
+            return self._orig_put(key, value, *a, **kw)
+
+        self._kv.put = recording_put
+        return self
+
+    def __exit__(self, *exc):
+        self._kv.put = self._orig_put
+        return False
+
+
+def test_no_payload_keys_hit_head_kv(two_process_cluster):
+    """THE acceptance assertion: collective payloads never ride the head KV —
+    no rt_p2p/ (old payload prefix) and no rt_coll/ (old rendezvous payload
+    prefix) keys are ever written during cross-process collectives."""
+    cluster, proc = two_process_cluster
+    head_id = cluster.head_node.node_id
+
+    @rt.remote(execution="thread")
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(world, rank, group_name="nokv")
+            self.rank = rank
+
+        def roundtrip(self, x):
+            from ray_tpu.util import collective
+
+            out = collective.allreduce(
+                np.array([x], np.float32), group_name="nokv", rank=self.rank
+            )
+            return np.asarray(out).tolist()
+
+        def send_to(self, value, dst):
+            from ray_tpu.util import collective
+
+            collective.send(value, dst, group_name="nokv", rank=self.rank)
+            return True
+
+        def recv_from(self, src):
+            from ray_tpu.util import collective
+
+            return collective.recv(src, group_name="nokv", rank=self.rank, timeout=60)
+
+    with _KVRecorder(cluster.control.kv) as rec:
+        r0 = Rank.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(head_id)
+        ).remote(0, 2)
+        r1 = Rank.options(resources={"remote": 1}).remote(1, 2)
+        a = r0.roundtrip.remote(1.0)
+        b = r1.roundtrip.remote(2.0)
+        assert rt.get(a, timeout=90) == [3.0]
+        assert rt.get(b, timeout=90) == [3.0]
+        sent = r0.send_to.remote(np.arange(10), 1)
+        got = r1.recv_from.remote(0)
+        assert rt.get(sent, timeout=90) is True
+        np.testing.assert_array_equal(rt.get(got, timeout=90), np.arange(10))
+
+    payload_keys = [
+        k for k in rec.keys if k.startswith(b"rt_p2p/") or k.startswith(b"rt_coll/")
+    ]
+    assert payload_keys == [], payload_keys
+    # only tiny metadata (rank->address) may appear
+    for k in rec.keys:
+        if k.startswith(b"rt_coll_addr/"):
+            break
+    else:
+        pytest.fail("expected rank-address registrations in the KV")
+
+
+def test_send_recv_throughput_above_100mbps(two_process_cluster):
+    """Loopback cross-process send/recv sustains >100 MB/s (acceptance bar;
+    the 2ms-KV-polling path measured far below it)."""
+    cluster, proc = two_process_cluster
+    head_id = cluster.head_node.node_id
+    nbytes = 100 * 1024 * 1024
+
+    @rt.remote(execution="thread")
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(world, rank, group_name="tput")
+            self.rank = rank
+
+        def send_big(self, dst):
+            from ray_tpu.util import collective
+
+            collective.send(
+                np.ones(nbytes, np.uint8), dst, group_name="tput", rank=self.rank
+            )
+            return True
+
+        def recv_big(self, src):
+            from ray_tpu.util import collective
+
+            t0 = time.monotonic()
+            out = collective.recv(src, group_name="tput", rank=self.rank, timeout=120)
+            return out.nbytes, time.monotonic() - t0
+
+    r0 = Rank.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(head_id)
+    ).remote(0, 2)
+    r1 = Rank.options(resources={"remote": 1}).remote(1, 2)
+
+    # warm the path (address resolution, connection setup)
+    assert rt.get(r0.send_big.remote(1), timeout=120) is True
+    got, _ = rt.get(r1.recv_big.remote(0), timeout=120)
+    assert got == nbytes
+
+    t0 = time.monotonic()
+    sent = r0.send_big.remote(1)
+    got, _recv_wait = rt.get(r1.recv_big.remote(0), timeout=120)
+    assert rt.get(sent, timeout=120) is True
+    elapsed = time.monotonic() - t0
+    assert got == nbytes
+    mbps = nbytes / (1024 * 1024) / elapsed
+    assert mbps > 100, f"send/recv sustained only {mbps:.1f} MB/s"
+
+
+def test_declarative_group_binds_ranks(two_process_cluster):
+    """create_collective_group(actors, world, ranks) alone suffices: actors
+    call collective ops with NO rank argument and NO set_rank."""
+    from ray_tpu.util import collective
+
+    cluster, proc = two_process_cluster
+    head_id = cluster.head_node.node_id
+
+    @rt.remote(execution="thread")
+    class Worker:
+        def contribute(self, x):
+            out = collective.allreduce(np.array([x], np.float32), group_name="decl")
+            return np.asarray(out).tolist()
+
+        def whoami(self):
+            return "alive"
+
+    w0 = Worker.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(head_id)
+    ).remote()
+    w1 = Worker.options(resources={"remote": 1}).remote()
+    # make sure both actors exist before binding ranks to their nodes
+    assert rt.get([w0.whoami.remote(), w1.whoami.remote()], timeout=60) == ["alive", "alive"]
+
+    collective.create_collective_group([w0, w1], 2, [0, 1], group_name="decl")
+    a = w0.contribute.remote(10.0)
+    b = w1.contribute.remote(32.0)
+    assert rt.get(a, timeout=90) == [42.0]
+    assert rt.get(b, timeout=90) == [42.0]
+    collective.destroy_collective_group("decl")
