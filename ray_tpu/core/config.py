@@ -111,6 +111,11 @@ class Config:
     # Admission control: concurrent bulk transfers served/issued per process
     # (reference: PullManager admission, pull_manager.h:52).
     max_concurrent_object_transfers: int = 4
+    # Head fault tolerance: how long a node agent keeps retrying the head
+    # after a disconnect before giving up and exiting (reference: raylets
+    # reconnect to a restarted GCS — core_worker.proto:443
+    # RayletNotifyGCSRestart). 0 restores the round-2 exit-on-disconnect.
+    agent_reconnect_timeout_s: float = 60.0
 
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):

@@ -259,30 +259,34 @@ def _rendezvous_transport(
         gen = group.kv_gen.get(rank, 0)
         group.kv_gen[rank] = gen + 1
     world = group.world_size
+    # mailbox ids carry the group EPOCH so a re-created same-named group
+    # can never consume a stale contribution left by a timed-out round of
+    # its predecessor
+    epoch = getattr(group, "epoch", "")
     p2p.register_rank(group_name, rank)
     if rank == 0:
         p2p.post(
             p2p.get_endpoint().address,
-            p2p.mailbox_oid("rdv", group_name, gen, "c", 0),
+            p2p.mailbox_oid("rdv", group_name, epoch, gen, "c", 0),
             _host_value(value),
         )
         values: List[Any] = [
-            p2p.take(p2p.mailbox_oid("rdv", group_name, gen, "c", r), timeout)
+            p2p.take(p2p.mailbox_oid("rdv", group_name, epoch, gen, "c", r), timeout)
             for r in range(world)
         ]
         result = reduce_fn(values)
         host_result = _host_value(result)
         for r in range(1, world):
             p2p.post_to_rank(
-                group_name, r, p2p.mailbox_oid("rdv", group_name, gen, "r", r),
+                group_name, r, p2p.mailbox_oid("rdv", group_name, epoch, gen, "r", r),
                 host_result, timeout=timeout,
             )
         return result
     p2p.post_to_rank(
-        group_name, 0, p2p.mailbox_oid("rdv", group_name, gen, "c", rank),
+        group_name, 0, p2p.mailbox_oid("rdv", group_name, epoch, gen, "c", rank),
         _host_value(value), timeout=timeout,
     )
-    return p2p.take(p2p.mailbox_oid("rdv", group_name, gen, "r", rank), timeout)
+    return p2p.take(p2p.mailbox_oid("rdv", group_name, epoch, gen, "r", rank), timeout)
 
 
 def _run_rendezvous(

@@ -111,11 +111,8 @@ class AgentFabric:
             fallback()
 
     def _direct_pull(self, addr: str, oid: ObjectID, node, callback, fallback) -> None:
-        from ray_tpu.runtime import data_plane
-
         try:
-            blob, is_error = self.data_client.pull(addr, oid.binary(), timeout=30.0)
-            value = data_plane.from_blob(blob)
+            value, is_error = self.data_client.pull(addr, oid.binary(), timeout=30.0)
         except Exception:  # noqa: BLE001 — peer died / stale location
             fallback()
             return
@@ -163,12 +160,11 @@ class AgentFabric:
             )
 
         if self.data_client is not None:
-            # cheap size probe first (ndarray/bytes cover the bulk cases) so
-            # a multi-GB result isn't pickled just to be thrown away
-            approx = getattr(result, "nbytes", None)
-            if approx is None and isinstance(result, (bytes, bytearray)):
-                approx = len(result)
-            if approx is not None and approx > threshold:
+            # out-of-band size probe (no GIL-held in-band pickle of bulk
+            # values, even nested in containers)
+            from ray_tpu.runtime.remote_node import _bulk_size
+
+            if _bulk_size(result) > threshold:
                 lazy_commit()
                 return
         enc = rpc.encode_value(result)
@@ -251,13 +247,14 @@ class NodeAgent:
         self.fabric = AgentFabric(self.session_dir)
         self._fn_cache: Dict[bytes, Any] = {}
         self._stop = threading.Event()
+        self._reconnect_lock = threading.Lock()
+        self._reconnecting = False
         self.node = None
         self.node_id: Optional[NodeID] = None
         self.conn: Optional[rpc.RpcConnection] = None
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        from ray_tpu.core.config import Config, set_config
         from ray_tpu.runtime.node import Node
 
         self.conn = rpc.connect(
@@ -272,12 +269,10 @@ class NodeAgent:
         # this node, so registration must be the last step.
         self.node_id = NodeID.from_random()
         reply = self.conn.request("register_node_config", {})
-        # Adopt the head's config so thresholds/timeouts agree cluster-wide
-        # (reference: non-head nodes fetch the serialized RayConfig from the
-        # GCS, python/ray/_private/node.py:1377-1392).
-        cfg = Config()
-        cfg.apply_dict({k: v for k, v in reply["config"].items() if hasattr(cfg, k)})
-        set_config(cfg)
+        self._adopt_config(reply)
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
         self.node = Node(self.node_id, self.resources, self.fabric, shm_store=None, labels=self.labels)
         self.fabric.node = self.node
         # Bulk data plane: this node serves its local store to peers and
@@ -326,17 +321,103 @@ class NodeAgent:
         self.node.worker_pool.log_sink = log_sink
         # stragglers below the batch threshold drain on the report tick
         # (_report_loop calls _flush_logs)
-        self.conn.request(
-            "register_node",
-            {
-                "node_id": self.node_id.binary(),
-                "resources": self.resources,
-                "labels": self.labels,
-                "address": _self_address(),
-                "data_address": self.data_address,
-            },
+        self._register(rejoin=False)
+        threading.Thread(
+            target=self._report_loop, args=(self.conn,), name="agent-report", daemon=True
+        ).start()
+
+    def _register(self, rejoin: bool, conn: Optional[rpc.RpcConnection] = None) -> None:
+        payload = {
+            "node_id": self.node_id.binary(),
+            "resources": self.resources,
+            "labels": self.labels,
+            "address": _self_address(),
+            "data_address": self.data_address,
+        }
+        if rejoin:
+            payload["rejoin"] = True
+            # reconciliation: the actor instances still alive in THIS
+            # process, so the (possibly restarted) head can rebuild its
+            # routing state for them
+            payload["actors"] = [aid.binary() for aid in list(self.node.actors.keys())]
+        (conn or self.conn).request("register_node", payload)
+
+    # -- head fault tolerance -------------------------------------------
+    def _reconnect_loop(self) -> None:
+        """The head went away: keep the node alive and retry with backoff
+        (reference: raylets reconnect to a restarted GCS —
+        ``core_worker.proto:443 RayletNotifyGCSRestart``).  Gives up and
+        exits after ``agent_reconnect_timeout_s`` (0 disables rejoin)."""
+        from ray_tpu.core.config import get_config
+
+        window = get_config().agent_reconnect_timeout_s
+        if window <= 0:
+            self._stop.set()
+            return
+        try:
+            deadline = time.monotonic() + window
+            backoff = 0.5
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                try:
+                    self._rejoin()
+                    print(
+                        f"ray_tpu agent: rejoined head at {self.head_address}",
+                        file=sys.stderr,
+                    )
+                    return
+                except (OSError, rpc.RpcError):
+                    self._stop.wait(backoff)
+                    backoff = min(backoff * 2, 5.0)
+            self._stop.set()
+        finally:
+            with self._reconnect_lock:
+                self._reconnecting = False
+
+    def _rejoin(self) -> None:
+        conn = rpc.connect(
+            self.head_address,
+            handlers=self._handlers(),
+            # no disconnect hook while joining: a failed attempt must not
+            # spawn a second reconnect loop; installed only on success below
+            on_disconnect=None,
+            name="agent",
         )
-        threading.Thread(target=self._report_loop, name="agent-report", daemon=True).start()
+        try:
+            reply = conn.request("register_node_config", {})
+            self._adopt_config(reply)
+            # the data server survived; the reachable IP may differ on a new
+            # connection (multi-NIC), recompute the advertisement
+            self.data_address = f"{conn.local_ip}:{self.data_server.port}"
+            from ray_tpu.runtime import p2p
+            from ray_tpu.runtime.kv_client import register_agent_kv
+
+            self._register(rejoin=True, conn=conn)
+            # registration done: publish the new epoch to the rest of the
+            # process, then arm the disconnect hook
+            self.conn = conn
+            self.fabric.conn = conn
+            register_agent_kv(conn)
+            p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
+            conn._on_disconnect = self._on_disconnect
+            if conn.closed:
+                # it died between registration and arming the hook: run the
+                # hook ourselves so the next reconnect round fires
+                raise rpc.RpcError("connection lost during rejoin")
+        except BaseException:
+            conn.close()
+            raise
+        threading.Thread(
+            target=self._report_loop, args=(conn,), name="agent-report", daemon=True
+        ).start()
+
+    def _adopt_config(self, reply: dict) -> None:
+        """Adopt the (possibly restarted) head's config so thresholds and
+        timeouts agree cluster-wide (node.py:1377-1392 parity)."""
+        from ray_tpu.core.config import Config, set_config
+
+        cfg = Config()
+        cfg.apply_dict({k: v for k, v in reply.get("config", {}).items() if hasattr(cfg, k)})
+        set_config(cfg)
 
     def _flush_logs(self) -> None:
         with self._log_lock:
@@ -449,14 +530,16 @@ class NodeAgent:
         self._stop.set()
 
     # ------------------------------------------------------------------
-    def _report_loop(self) -> None:
+    def _report_loop(self, conn: rpc.RpcConnection) -> None:
+        """One report loop per connection epoch; exits when ITS connection
+        dies (the rejoin path starts a fresh one)."""
         from ray_tpu.core.config import get_config
 
         period = max(0.02, get_config().resource_sync_period_s)
-        while not self._stop.is_set() and not self.conn.closed:
+        while not self._stop.is_set() and not conn.closed:
             try:
                 pool = self.node.pool
-                self.conn.send(
+                conn.send(
                     "resource_report",
                     {
                         "total": pool.total.fixed(),
@@ -471,10 +554,18 @@ class NodeAgent:
             self._stop.wait(period)
 
     def _on_disconnect(self, conn) -> None:
-        # The head is the control plane; without it this node has no work
-        # source and no owner to report to — exit (raylet dies when the GCS
-        # is unreachable past the reconnect budget, same policy).
-        self._stop.set()
+        # The head went away. Unlike round 2 (exit immediately), keep this
+        # node and its state alive and try to rejoin a restarted head; only
+        # exit once the reconnect window expires.
+        if self._stop.is_set() or conn is not self.conn:
+            return  # deliberate shutdown, or an old epoch's connection
+        with self._reconnect_lock:
+            if self._reconnecting:
+                return  # one reconnect loop at a time
+            self._reconnecting = True
+        threading.Thread(
+            target=self._reconnect_loop, name="agent-reconnect", daemon=True
+        ).start()
 
     def shutdown(self) -> None:
         self._stop.set()

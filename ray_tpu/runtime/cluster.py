@@ -129,6 +129,17 @@ class Cluster:
             # GCS-restart parity: durable cluster state reloads from the
             # last snapshot; a background writer keeps it fresh
             self.control.restore_snapshot(cfg.control_snapshot_path)
+            if self.control.restored_restarting:
+                # reconciliation deadline: restored-RESTARTING actors whose
+                # host never rejoins must fail their buffered calls, not
+                # hang them forever
+                timer = threading.Timer(
+                    cfg.agent_reconnect_timeout_s + 15.0,
+                    self._expire_unreconciled_actors,
+                    args=(list(self.control.restored_restarting),),
+                )
+                timer.daemon = True
+                timer.start()
             self._snapshot_thread = threading.Thread(
                 target=self._snapshot_loop,
                 args=(cfg.control_snapshot_path, cfg.control_snapshot_interval_s),
@@ -256,11 +267,49 @@ class Cluster:
         self.control.placement_groups.retry_pending()
         self.notify_resources_changed()
 
-    def kill_node(self, node_id: NodeID) -> None:
+    def _expire_unreconciled_actors(self, actor_ids: List[ActorID]) -> None:
+        for actor_id in actor_ids:
+            info = self.control.actors.get(actor_id)
+            if info is None or info.state is not ActorState.RESTARTING or info.node_id is not None:
+                continue  # reconciled (or restarting live elsewhere)
+            self.control.actors.mark_dead(
+                actor_id, "hosting node never rejoined after head restart"
+            )
+            self._fail_actor_queue(
+                actor_id,
+                ActorDiedError(actor_id, "The actor's node never rejoined the restarted head"),
+            )
+
+    def reconcile_rejoined_actors(self, handle, actor_ids: List[ActorID]) -> None:
+        """An agent rejoined (head restart or transient disconnect) still
+        hosting live actor instances: rebuild the head-side routing state —
+        actor FSM back to ALIVE on that node, per-actor call queue pumping —
+        for every actor the control service still tracks as non-DEAD.
+        Reference role: raylets re-registering with a restarted GCS
+        (core_worker.proto:443 RayletNotifyGCSRestart)."""
+        for actor_id in actor_ids:
+            info = self.control.actors.get(actor_id)
+            if info is None or info.state is ActorState.DEAD:
+                continue
+            with self._actor_lock:
+                q = self._actor_queues.get(actor_id)
+                if q is None:
+                    q = self._actor_queues[actor_id] = _ActorQueue()
+            self.control.actors.mark_alive(actor_id, handle.node_id)
+            with q.lock:
+                q.alive = True
+            self._pump_actor_queue(actor_id)
+
+    def kill_node(self, node_id: NodeID, expected=None) -> None:
         """Chaos hook: simulate node failure (NodeKillerActor parity,
-        python/ray/_private/test_utils.py:1497)."""
+        python/ray/_private/test_utils.py:1497).  ``expected`` guards the
+        async disconnect path: if the agent already REJOINED (same node_id,
+        fresh handle) by the time this runs, the stale death must not kill
+        the new registration."""
         node = self.nodes.get(node_id)
         if node is None or node.dead:
+            return
+        if expected is not None and node is not expected:
             return
         node.dead = True
         self.cluster_scheduler.remove_node(node_id)
@@ -877,6 +926,12 @@ class Cluster:
                     spec.retries_left = spec.max_retries
         q = self._actor_queues.get(spec.actor_id)
         info = self.control.actors.get(spec.actor_id)
+        if q is None and info is not None and info.state is not ActorState.DEAD:
+            # snapshot-restored actor: its record survived the head restart
+            # but no queue exists yet — create one; calls buffer until the
+            # hosting agent rejoins and reconcile marks it alive
+            with self._actor_lock:
+                q = self._actor_queues.setdefault(spec.actor_id, _ActorQueue())
         if q is None or info is None or info.state is ActorState.DEAD:
             self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))

@@ -357,6 +357,10 @@ class ControlService:
         self.placement_groups = PlacementGroupManager(self.nodes, self.pubsub)
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # actor ids restored as RESTARTING by restore_snapshot — the fabric
+        # arms a reconciliation deadline for them (never-rejoining hosts
+        # must surface ActorDiedError, not hang callers forever)
+        self.restored_restarting: List[ActorID] = []
 
     # ---------------------------------------------------------- persistence
     # Parity: GCS fault tolerance (RedisStoreClient-backed GcsTableStorage,
@@ -377,10 +381,33 @@ class ControlService:
             }
             for info in self.jobs.list_jobs()
         ]
+        # Actor RECORDS (identity, names, restart budget) persist so a
+        # restarted head can reconcile rejoining agents' live instances and
+        # resolve get_actor(name); liveness itself is rebuilt from those
+        # rejoin reports (reference: GcsActorManager records in
+        # gcs_table_storage.h:238; raylet rejoin core_worker.proto:443).
+        actors = []
+        with self.actors._lock:
+            named = {aid: key for key, aid in self.actors._named.items()}
+            for info in self.actors._actors.values():
+                actors.append(
+                    {
+                        "actor_id": info.actor_id.binary(),
+                        "name": info.name,
+                        "namespace": named.get(info.actor_id, (None, None))[0],
+                        "class_name": info.class_name,
+                        "max_restarts": info.max_restarts,
+                        "num_restarts": info.num_restarts,
+                        "job_id": info.job_id.binary(),
+                        "dead": info.state is ActorState.DEAD,
+                        "death_cause": info.death_cause,
+                    }
+                )
         return {
             "version": 1,
             "kv": kv_data,
             "jobs": jobs,
+            "actors": actors,
             "task_events": self.task_events.list_events(limit=len(self.task_events)),
         }
 
@@ -428,6 +455,29 @@ class ControlService:
         # a fresh process restarts the JobID counter at 0 — new driver jobs
         # must not overwrite restored history
         JobID.ensure_above(max_job)
+        for row in state.get("actors", []):
+            # dead actors keep their record (death_cause introspection) but
+            # release their name — mark_dead would have freed it live
+            info = ActorInfo(
+                ActorID(row["actor_id"]),
+                None if row.get("dead") else row["name"],
+                row["max_restarts"],
+                JobID(row["job_id"]), class_name=row.get("class_name", ""),
+            )
+            info.num_restarts = row.get("num_restarts", 0)
+            if row.get("dead"):
+                info.state = ActorState.DEAD
+                info.death_cause = row.get("death_cause")
+            else:
+                # not dead, but its node binding did not survive the old
+                # head: RESTARTING until the hosting agent rejoins and
+                # reports the instance alive (reconcile_rejoined_actors)
+                info.state = ActorState.RESTARTING
+                self.restored_restarting.append(info.actor_id)
+            try:
+                self.actors.register(info, namespace=row.get("namespace") or "default")
+            except ValueError:
+                pass  # name collision with a live record wins
         for event in state.get("task_events", []):
             self.task_events.add(event)
         return True

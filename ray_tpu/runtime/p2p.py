@@ -169,9 +169,7 @@ def post(dst_addr: str, oid: ObjectID, value) -> None:
     if dst_addr == ep.address:
         ep.store.put(oid, value)
         return
-    from ray_tpu.runtime import data_plane
-
-    ep.data_client.push(dst_addr, oid.binary(), data_plane.to_blob(value))
+    ep.data_client.push(dst_addr, oid.binary(), value)
 
 
 def post_to_rank(group: str, rank: int, oid: ObjectID, value, timeout: float = 30.0) -> None:

@@ -77,6 +77,25 @@ class MirrorPool(ResourcePool):
             self._available = dict(available_fixed)
 
 
+def _bulk_size(value: Any) -> int:
+    """Out-of-band size probe WITHOUT a GIL-held in-band pickle: pickle-5
+    frames the value with buffer_callback, so ndarrays — including ones
+    nested in dicts/tuples — contribute buffer views, never copies.  Returns
+    the total frame size (meta + buffers)."""
+    from ray_tpu.runtime import data_plane
+
+    nb = getattr(value, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    try:
+        meta, buffers = data_plane.to_frames(value)
+    except Exception:  # noqa: BLE001 — unpicklable probes as "small"
+        return 0
+    return len(meta) + sum(memoryview(b).cast("B").nbytes for b in buffers)
+
+
 class RemoteStore(ObjectStore):
     """The head's cache of a remote node's objects.
 
@@ -113,15 +132,15 @@ class RemoteStore(ObjectStore):
         if handle.dead:
             return
         from ray_tpu.core.config import get_config
-        from ray_tpu.runtime import data_plane
 
-        blob = data_plane.to_blob(value)
-        if (
-            handle.data_address
-            and handle.data_client is not None
-            and len(blob) > get_config().data_plane_inline_bytes
-        ):
-            handle.push_blob_async(object_id, blob, is_error)
+        threshold = get_config().data_plane_inline_bytes
+        bulk_capable = handle.data_address and handle.data_client is not None
+        if bulk_capable and _bulk_size(value) > threshold:
+            handle.push_value_async(object_id, value, is_error)
+            return
+        blob = rpc.dumps_value(value)
+        if bulk_capable and len(blob) > threshold:
+            handle.push_value_async(object_id, value, is_error)
             return
         try:
             handle.conn.send(
@@ -143,10 +162,9 @@ class RemoteStore(ObjectStore):
             from ray_tpu.runtime import data_plane
 
             try:
-                blob, is_error = handle.data_client.pull(
+                value, is_error = handle.data_client.pull(
                     handle.data_address, object_id.binary(), timeout=timeout or 30.0
                 )
-                value = data_plane.from_blob(blob)
                 self.skip_push_once(object_id)
                 super().put(object_id, value, is_error=is_error)
                 return value
@@ -222,7 +240,7 @@ class RemoteNodeHandle:
         self._sent_fns: set = set()
         self.last_report = time.monotonic()
 
-    def push_blob_async(self, oid: ObjectID, blob: bytes, is_error: bool) -> None:
+    def push_value_async(self, oid: ObjectID, value, is_error: bool) -> None:
         """Ship a value to the agent on the data plane, off-thread: callers
         (directory callbacks, dispatch paths) must not block on bulk bytes.
         Consumers that race ahead of the push self-heal — the agent's pull
@@ -230,7 +248,7 @@ class RemoteNodeHandle:
 
         def run():
             try:
-                self.data_client.push(self.data_address, oid.binary(), blob, is_error)
+                self.data_client.push(self.data_address, oid.binary(), value, is_error)
             except Exception:  # noqa: BLE001 — transient data-plane failure
                 # Control-plane fallback: the consuming task was already
                 # dispatched assuming the dependency would land; silently
@@ -238,7 +256,8 @@ class RemoteNodeHandle:
                 try:
                     self.conn.send(
                         "push_object",
-                        {"oid": oid.binary(), "value_blob": blob, "is_error": is_error},
+                        {"oid": oid.binary(), "value_blob": rpc.dumps_value(value),
+                         "is_error": is_error},
                     )
                 except rpc.RpcError:
                     pass  # connection death runs the node-failure path
@@ -425,7 +444,7 @@ class HeadService:
         # Bulk endpoint for objects living in THIS process (head node + the
         # head-side caches); agents learn its address at config fetch.
         self.data_server = data_plane.DataServer(
-            self._head_get_blob, self._head_put_blob, host=host,
+            self._head_get_frames, self._head_put_frames, host=host,
             chunk_bytes=cfg.object_transfer_chunk_bytes,
             max_concurrent=cfg.max_concurrent_object_transfers,
         )
@@ -461,7 +480,7 @@ class HeadService:
         self._transfer_pool.shutdown(wait=False)
 
     # -- data-plane store resolvers ------------------------------------
-    def _head_get_blob(self, oid_bytes: bytes, timeout: float):
+    def _head_get_frames(self, oid_bytes: bytes, timeout: float):
         """Serve a pull against this process's stores: the head node's own
         store first, then the head-side caches of every node (a value staged
         for / reported by any node is a valid copy)."""
@@ -477,18 +496,22 @@ class HeadService:
             if store is not None and store.contains(oid):
                 value = ObjectStore.get(store, oid, timeout=1.0)
                 info = store.entry_info(oid)
-                return data_plane.to_blob(value), bool(info and info["is_error"])
+                meta, buffers = data_plane.to_frames(value)
+                return meta, buffers, bool(info and info["is_error"])
         # not local yet: a push/commit may be in flight — wait on the head
         # store (blocking is fine on a data-plane serve thread)
         value = ObjectStore.get(cluster.head_node.store, oid, timeout=timeout)
         info = cluster.head_node.store.entry_info(oid)
-        return data_plane.to_blob(value), bool(info and info["is_error"])
+        meta, buffers = data_plane.to_frames(value)
+        return meta, buffers, bool(info and info["is_error"])
 
-    def _head_put_blob(self, oid_bytes: bytes, blob: bytes, is_error: bool) -> None:
+    def _head_put_frames(self, oid_bytes: bytes, meta: bytes, buffers, is_error: bool) -> None:
         from ray_tpu.runtime import data_plane
 
         oid = ObjectID(oid_bytes)
-        self.cluster.head_node.store.put(oid, data_plane.from_blob(blob), is_error=is_error)
+        self.cluster.head_node.store.put(
+            oid, data_plane.from_frames(meta, buffers), is_error=is_error
+        )
         self.cluster.directory.add_location(oid, self.cluster.head_node.node_id)
 
     def _health_loop(self) -> None:
@@ -509,7 +532,7 @@ class HeadService:
                     handle.last_report = time.monotonic()
                 except Exception:  # noqa: BLE001 — unresponsive: declare dead
                     if not handle.dead:
-                        self.cluster.kill_node(handle.node_id)
+                        self.cluster.kill_node(handle.node_id, handle)
                     conn.close()
 
     # ------------------------------------------------------------------
@@ -559,6 +582,15 @@ class HeadService:
         )
         conn.peer = handle
         self.cluster.register_remote_node(handle)
+        if payload.get("rejoin"):
+            # Head-restart reconciliation: the agent kept its actors alive
+            # across our outage — rebuild routing state for the ones the
+            # control service still tracks as live (a DEAD record stays
+            # dead; an unknown actor belongs to a dead driver and is left
+            # orphaned for the agent to reap).
+            self.cluster.reconcile_rejoined_actors(
+                handle, [ActorID(b) for b in payload.get("actors", ())]
+            )
         return {}
 
     def _h_locate_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
@@ -690,6 +722,6 @@ class HeadService:
         # queue lock inside _pump_actor_queue) — kill_node re-acquiring them
         # synchronously would self-deadlock.
         threading.Thread(
-            target=self.cluster.kill_node, args=(handle.node_id,),
+            target=self.cluster.kill_node, args=(handle.node_id, handle),
             name="head-node-death", daemon=True,
         ).start()

@@ -432,15 +432,25 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
     return spec
 
 
-def dumps_value(value: Any) -> bytes:
+def dumps_value(value: Any, buffer_callback=None) -> bytes:
     """THE value-serialization policy (pickle-5, cloudpickle fallback) —
-    shared by the control plane and the bulk data plane."""
+    shared by the control plane and the bulk data plane.  With
+    ``buffer_callback`` the big buffers go out-of-band (data-plane frames);
+    without it they inline into the returned stream (control frames).  The
+    callback fires only for the attempt that SUCCEEDS (a half-failed pickle
+    pass must not leak its buffers into the fallback's)."""
+    collected: list = []
+    cb = None if buffer_callback is None else collected.append
     try:
-        return pickle.dumps(value, protocol=5)
+        out = pickle.dumps(value, protocol=5, buffer_callback=cb)
     except (AttributeError, TypeError, pickle.PicklingError):
         import cloudpickle
 
-        return cloudpickle.dumps(value, protocol=5)
+        collected.clear()
+        out = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
+    for b in collected:
+        buffer_callback(b)
+    return out
 
 
 def encode_value(value: Any, is_error: bool = False) -> dict:

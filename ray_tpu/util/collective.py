@@ -199,8 +199,21 @@ def _ensure_group(group_name: str) -> None:
         p2p.forget_group(group_name)
         with _bindings_lock:
             _group_bindings.pop(group_name, None)
+        # p2p FIFO counters belong to the dead incarnation
+        with _p2p_lock:
+            for key in [k for k in _p2p_send_seq if k[0] == group_name]:
+                del _p2p_send_seq[key]
+            for key in [k for k in _p2p_recv_seq if k[0] == group_name]:
+                del _p2p_recv_seq[key]
     group = _registry.get_or_create(group_name, record["world_size"])
     group.epoch = epoch
+
+
+def _group_epoch(group_name: str) -> str:
+    try:
+        return getattr(_registry.get(group_name), "epoch", "") or ""
+    except KeyError:
+        return ""
 
 
 # ------------------------------------------------------------------- ops
@@ -275,12 +288,13 @@ def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[i
     if ep is not None and is_multiprocess():
         from ray_tpu.parallel.collective import _host_value
 
+        _ensure_group(group_name)
         with _p2p_lock:
             seq = _p2p_send_seq.get((group_name, src, dst_rank), 0)
             _p2p_send_seq[(group_name, src, dst_rank)] = seq + 1
         # make sure the counterpart can answer/see us before first contact
         p2p.register_rank(group_name, src)
-        oid = p2p.mailbox_oid("p2p", group_name, src, dst_rank, seq)
+        oid = p2p.mailbox_oid("p2p", group_name, _group_epoch(group_name), src, dst_rank, seq)
         p2p.post_to_rank(group_name, dst_rank, oid, _host_value(tensor))
         return
     box = _mail.box(group_name, src, dst_rank)
@@ -300,14 +314,19 @@ def recv(src_rank: int, group_name: str = "default", *, rank: Optional[int] = No
 
     ep = p2p.get_endpoint()
     if ep is not None and is_multiprocess():
+        from ray_tpu.exceptions import GetTimeoutError
+
+        _ensure_group(group_name)
         # publish where this rank lives so senders can reach us
         p2p.register_rank(group_name, dst)
         with _p2p_lock:
             seq = _p2p_recv_seq.get((group_name, src_rank, dst), 0)
-        oid = p2p.mailbox_oid("p2p", group_name, src_rank, dst, seq)
+        oid = p2p.mailbox_oid("p2p", group_name, _group_epoch(group_name), src_rank, dst, seq)
         try:
             value = p2p.take(oid, timeout=timeout)
-        except Exception as exc:  # noqa: BLE001 — GetTimeoutError etc.
+        except GetTimeoutError as exc:
+            # only a genuine wait expiry maps to TimeoutError — endpoint /
+            # store failures propagate with their real cause
             raise TimeoutError(f"recv from rank {src_rank} timed out") from exc
         # consume the sequence number only on success — a timed-out recv
         # must retry the SAME slot, or the FIFO desyncs

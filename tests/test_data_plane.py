@@ -42,9 +42,9 @@ def test_pull_roundtrip(server_store):
     store.put(oid, value)
 
     client = data_plane.DataClient(chunk_bytes=1 << 20)
-    blob, is_error = client.pull(server.address, oid.binary())
+    got, is_error = client.pull(server.address, oid.binary())
     assert not is_error
-    np.testing.assert_array_equal(data_plane.from_blob(blob), value)
+    np.testing.assert_array_equal(got, value)
     client.close()
 
 
@@ -55,10 +55,9 @@ def test_pull_chunked_large_object(server_store):
     store.put(oid, value)
 
     client = data_plane.DataClient(chunk_bytes=1 << 20)
-    blob, _ = client.pull(server.address, oid.binary())
-    got = data_plane.from_blob(blob)
+    got, _ = client.pull(server.address, oid.binary())
     np.testing.assert_array_equal(got, value)
-    # the transfer must have moved in multiple chunks, not one frame
+    # out-of-band frames: the array bytes moved raw, not as one pickle frame
     assert server.stats.snapshot()["bytes_sent"] >= value.nbytes
     client.close()
 
@@ -68,7 +67,7 @@ def test_push_roundtrip(server_store):
     oid = ObjectID.from_random()
     value = {"weights": np.ones((256, 256), np.float32), "step": 7}
     client = data_plane.DataClient(chunk_bytes=1 << 20)
-    client.push(server.address, oid.binary(), data_plane.to_blob(value))
+    client.push(server.address, oid.binary(), value)
     got = store.get(oid, timeout=5)
     assert got["step"] == 7
     np.testing.assert_array_equal(got["weights"], value["weights"])
@@ -96,8 +95,8 @@ def test_pull_waits_for_inflight_materialization(server_store):
 
     threading.Thread(target=late_put, daemon=True).start()
     client = data_plane.DataClient()
-    blob, _ = client.pull(server.address, oid.binary(), timeout=10)
-    assert data_plane.from_blob(blob) == b"late-bytes"
+    got, _ = client.pull(server.address, oid.binary(), timeout=10)
+    assert got == b"late-bytes"
     client.close()
 
 
@@ -106,9 +105,9 @@ def test_error_objects_carry_flag(server_store):
     oid = ObjectID.from_random()
     store.put(oid, ValueError("boom"), is_error=True)
     client = data_plane.DataClient()
-    blob, is_error = client.pull(server.address, oid.binary())
+    got, is_error = client.pull(server.address, oid.binary())
     assert is_error
-    assert isinstance(data_plane.from_blob(blob), ValueError)
+    assert isinstance(got, ValueError)
     client.close()
 
 
@@ -125,8 +124,7 @@ def test_concurrent_pulls(server_store):
     results = [None] * len(oids)
 
     def pull(i):
-        blob, _ = client.pull(server.address, oids[i].binary())
-        results[i] = data_plane.from_blob(blob)
+        results[i], _ = client.pull(server.address, oids[i].binary())
 
     threads = [threading.Thread(target=pull, args=(i,)) for i in range(len(oids))]
     for t in threads:
