@@ -119,9 +119,14 @@ class AgentFabric:
         node.store.put(oid, value, is_error=is_error)
         # metadata-only notice: the head's directory records this node as a
         # location so future consumers can pull from here and recovery knows
-        # this copy exists
+        # this copy exists (device flag keeps HBM-residency tracking honest)
+        from ray_tpu.runtime.device_plane import is_device_array
+
         try:
-            self.conn.send("object_location", {"oid": oid.binary()})
+            self.conn.send(
+                "object_location",
+                {"oid": oid.binary(), "device": is_device_array(value)},
+            )
         except rpc.RpcError:
             pass
         callback()
@@ -154,9 +159,17 @@ class AgentFabric:
             # LAZY commit: bulk results stay here; the completion notice is
             # metadata-only and consumers pull the bytes peer-to-peer on
             # demand.  The control connection never carries bulk frames.
+            # Device placement of each return rides along so the head's
+            # directory records HBM residency (SURVEY §5.8).
+            from ray_tpu.runtime.device_plane import is_device_array
+
             self.conn.send(
                 "task_finished",
-                {"task_id": spec.task_id.binary(), "value": None, "error": None, "lazy": True},
+                {
+                    "task_id": spec.task_id.binary(), "value": None, "error": None,
+                    "lazy": True,
+                    "device_returns": [is_device_array(v) for v in values],
+                },
             )
 
         if self.data_client is not None:
@@ -354,24 +367,26 @@ class NodeAgent:
         if window <= 0:
             self._stop.set()
             return
-        try:
-            deadline = time.monotonic() + window
-            backoff = 0.5
-            while not self._stop.is_set() and time.monotonic() < deadline:
-                try:
-                    self._rejoin()
-                    print(
-                        f"ray_tpu agent: rejoined head at {self.head_address}",
-                        file=sys.stderr,
-                    )
-                    return
-                except (OSError, rpc.RpcError):
-                    self._stop.wait(backoff)
-                    backoff = min(backoff * 2, 5.0)
-            self._stop.set()
-        finally:
-            with self._reconnect_lock:
-                self._reconnecting = False
+        deadline = time.monotonic() + window
+        backoff = 0.5
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                # on success _rejoin clears _reconnecting ITSELF (before
+                # arming the disconnect hook) so an immediate second outage
+                # can spawn the next loop — a finally here would stomp that
+                # new loop's flag
+                self._rejoin()
+                print(
+                    f"ray_tpu agent: rejoined head at {self.head_address}",
+                    file=sys.stderr,
+                )
+                return
+            except (OSError, rpc.RpcError):
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+        self._stop.set()
+        with self._reconnect_lock:
+            self._reconnecting = False
 
     def _rejoin(self) -> None:
         conn = rpc.connect(
@@ -398,14 +413,20 @@ class NodeAgent:
             self.fabric.conn = conn
             register_agent_kv(conn)
             p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
-            conn._on_disconnect = self._on_disconnect
-            if conn.closed:
-                # it died between registration and arming the hook: run the
-                # hook ourselves so the next reconnect round fires
-                raise rpc.RpcError("connection lost during rejoin")
         except BaseException:
             conn.close()
             raise
+        # clear the single-flight flag BEFORE arming the hook: a disconnect
+        # that lands immediately after arming must be able to start the next
+        # reconnect loop (otherwise it sees _reconnecting=True, returns, and
+        # the agent zombies — alive, headless, never retrying)
+        with self._reconnect_lock:
+            self._reconnecting = False
+        conn._on_disconnect = self._on_disconnect
+        if conn.closed:
+            # teardown ran before the hook was armed: fire it ourselves
+            self._on_disconnect(conn)
+            return
         threading.Thread(
             target=self._report_loop, args=(conn,), name="agent-report", daemon=True
         ).start()

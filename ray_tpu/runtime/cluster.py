@@ -51,6 +51,17 @@ class ObjectDirectory:
         self._lock = threading.Lock()
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
         self._waiters: Dict[ObjectID, List[Callable[[NodeID], None]]] = {}
+        # oids whose primary copy is DEVICE-resident (HBM) at its location —
+        # SURVEY §5.8: device placement recorded in the object directory
+        self._device: Set[ObjectID] = set()
+
+    def mark_device(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._device.add(oid)
+
+    def is_device(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._device
 
     def add_location(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._lock:
@@ -94,6 +105,7 @@ class ObjectDirectory:
     def forget(self, oid: ObjectID) -> None:
         with self._lock:
             self._locations.pop(oid, None)
+            self._device.discard(oid)
             waiters = self._waiters.pop(oid, None)
         # Fire waiters with None (object out of scope) instead of dropping
         # them: a silently-dropped waiter is a leak for ready-hooks (serve
@@ -173,6 +185,9 @@ class Cluster:
                 self.shm_store = None
         self.transfer_bytes = 0
         self.transfer_count = 0
+        # serializes node (re)registration against node-death sweeps: a
+        # rejoin landing mid-kill must not have its fresh state clobbered
+        self._node_lifecycle_lock = threading.RLock()
         self.head_service = None  # multi-host TCP service (start_head_service)
         # pending resource demand, read by the autoscaler (parity with the
         # load the GCS reports to the monitor process,
@@ -254,6 +269,10 @@ class Cluster:
         """A node agent registered over the transport: wire its proxy into
         the scheduler, control service and placement machinery exactly like
         an in-process node (add_node parity)."""
+        with self._node_lifecycle_lock:
+            self._register_remote_node_locked(handle)
+
+    def _register_remote_node_locked(self, handle) -> None:
         self.nodes[handle.node_id] = handle
         self.cluster_scheduler.register_node(
             handle.node_id, handle.pool, handle.labels, queue_len=handle.scheduler.queue_len
@@ -305,12 +324,17 @@ class Cluster:
         python/ray/_private/test_utils.py:1497).  ``expected`` guards the
         async disconnect path: if the agent already REJOINED (same node_id,
         fresh handle) by the time this runs, the stale death must not kill
-        the new registration."""
-        node = self.nodes.get(node_id)
-        if node is None or node.dead:
-            return
-        if expected is not None and node is not expected:
-            return
+        the new registration.  The lifecycle lock makes guard+teardown
+        atomic against a concurrent re-registration."""
+        with self._node_lifecycle_lock:
+            node = self.nodes.get(node_id)
+            if node is None or node.dead:
+                return
+            if expected is not None and node is not expected:
+                return
+            self._kill_node_locked(node_id, node)
+
+    def _kill_node_locked(self, node_id: NodeID, node) -> None:
         node.dead = True
         self.cluster_scheduler.remove_node(node_id)
         self.control.nodes.mark_dead(node_id)

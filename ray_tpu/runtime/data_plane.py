@@ -68,10 +68,10 @@ def to_frames(value: Any) -> Tuple[bytes, List[memoryview]]:
     memcpy — without this, serializing bulk objects starves the agent's
     heartbeat threads and the head's health checker false-kills the node
     (the failure mode VERDICT weak #4 warned about)."""
-    from ray_tpu.runtime.rpc import dumps_value
+    from ray_tpu.runtime.device_plane import dumps_with_device_envelope
 
     buffers: List[pickle.PickleBuffer] = []
-    meta = dumps_value(value, buffer_callback=buffers.append)
+    meta = dumps_with_device_envelope(value, buffer_callback=buffers.append)
     return meta, [b.raw() for b in buffers]
 
 
@@ -181,9 +181,11 @@ class DataServer:
         port: int = 0,
         chunk_bytes: int = 8 * 1024 * 1024,
         max_concurrent: int = 4,
+        get_device_offer: Optional[Callable[[bytes], Optional[dict]]] = None,
     ):
         self._get_frames = get_frames
         self._put_frames = put_frames
+        self._get_device_offer = get_device_offer
         self.chunk_bytes = chunk_bytes
         self.stats = TransferStats()
         self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
@@ -240,6 +242,15 @@ class DataServer:
     def _serve_pull(self, sock: socket.socket, req: dict) -> None:
         oid = req["oid"]
         timeout = float(req.get("timeout", 30.0))
+        if req.get("device_capable") and self._get_device_offer is not None:
+            # ICI/DCN: both endpoints run jax transfer servers — hand the
+            # consumer a device-to-device pull ticket; the host envelope
+            # (and its device->host export) is skipped entirely
+            offer = self._get_device_offer(oid)
+            if offer is not None:
+                _send_header(sock, {"found": True, "device_xfer": offer})
+                self.stats.add("pulls_served")
+                return
         try:
             meta, buffers, is_error = self._get_frames(oid, timeout)
         except Exception:  # noqa: BLE001 — not found / timed out
@@ -314,6 +325,53 @@ class DataClient:
         """Fetch an object from a peer; returns ``(value, is_error)``.
         Raises :class:`ObjectNotFound` if the peer doesn't materialize it
         within ``timeout``."""
+        from ray_tpu.runtime import device_plane
+
+        device_capable = device_plane.transfer_address() is not None
+        with self._admission:
+            sock = self._checkout(addr)
+            try:
+                sock.settimeout(timeout + 30.0)
+                _send_header(
+                    sock,
+                    {"op": "pull", "oid": oid, "timeout": timeout,
+                     "device_capable": device_capable},
+                )
+                header = _recv_header(sock)
+                if not header.get("found"):
+                    self._checkin(addr, sock)
+                    raise ObjectNotFound(f"peer {addr} does not hold the object")
+                if "device_xfer" not in header:
+                    meta = _recv_exact(sock, header["meta_size"])
+                    buffers = [
+                        _recv_into_buffer(sock, size) for size in header["buffer_sizes"]
+                    ]
+                sock.settimeout(None)
+            except ObjectNotFound:
+                raise  # connection already checked back in above
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                self._discard(sock)
+                raise DataPlaneError(f"pull from {addr} failed: {exc}") from exc
+            else:
+                self._checkin(addr, sock)
+        offer = header.get("device_xfer")
+        if offer is not None:
+            # device-to-device through the jax transfer server
+            import jax
+
+            template = jax.ShapeDtypeStruct(tuple(offer["shape"]), offer["dtype"])
+            arr = device_plane.device_pull(offer["addr"], offer["uuid"], template)
+            if arr is not None:
+                self.stats.add("pulls_issued")
+                return arr, False
+            # local backend refused mid-flight: retry as a host-envelope pull
+            return self.pull_host(addr, oid, timeout)
+        self.stats.add("pulls_issued")
+        self.stats.add("bytes_received", len(meta) + sum(header["buffer_sizes"]))
+        return from_frames(meta, buffers), header.get("is_error", False)
+
+    def pull_host(self, addr: str, oid: bytes, timeout: float = 30.0) -> Tuple[Any, bool]:
+        """Envelope-only pull (no device-transfer negotiation)."""
         with self._admission:
             sock = self._checkout(addr)
             try:
@@ -327,7 +385,7 @@ class DataClient:
                 buffers = [_recv_into_buffer(sock, size) for size in header["buffer_sizes"]]
                 sock.settimeout(None)
             except ObjectNotFound:
-                raise  # connection already checked back in above
+                raise
             except (OSError, EOFError, pickle.UnpicklingError) as exc:
                 self._discard(sock)
                 raise DataPlaneError(f"pull from {addr} failed: {exc}") from exc
@@ -401,8 +459,32 @@ def store_server(store, host: str = "127.0.0.1", port: int = 0,
     def put_frames(oid_bytes: bytes, meta: bytes, buffers, is_error: bool) -> None:
         store.put(ObjectID(oid_bytes), from_frames(meta, buffers), is_error=is_error)
 
+    def get_device_offer(oid_bytes: bytes):
+        from ray_tpu.runtime import device_plane
+
+        try:
+            addr = device_plane.transfer_address()
+            if addr is None:
+                return None
+            oid = ObjectID(oid_bytes)
+            if not store.contains(oid):
+                return None
+            value = store.get(oid, timeout=0.01)
+            if not device_plane.is_device_array(value):
+                return None
+            uuid = device_plane.uuid_for_object(oid_bytes)
+            if not device_plane.offer_device_pull(uuid, value):
+                return None
+            return {
+                "addr": addr, "uuid": uuid,
+                "shape": tuple(value.shape), "dtype": str(value.dtype),
+            }
+        except Exception:  # noqa: BLE001 — eviction race etc.: no offer,
+            return None    # the pull falls through to the host envelope
+
     return DataServer(
         get_frames, put_frames, host=host, port=port,
         chunk_bytes=chunk_bytes or cfg.object_transfer_chunk_bytes,
         max_concurrent=max_concurrent or cfg.max_concurrent_object_transfers,
+        get_device_offer=get_device_offer,
     )

@@ -77,18 +77,47 @@ class MirrorPool(ResourcePool):
             self._available = dict(available_fixed)
 
 
-def _bulk_size(value: Any) -> int:
-    """Out-of-band size probe WITHOUT a GIL-held in-band pickle: pickle-5
-    frames the value with buffer_callback, so ndarrays — including ones
-    nested in dicts/tuples — contribute buffer views, never copies.  Returns
-    the total frame size (meta + buffers)."""
-    from ray_tpu.runtime import data_plane
-
+def _probe_nbytes(value: Any, depth: int = 0) -> Tuple[int, bool]:
+    """(known_bytes, fully_known): sums nbytes over arrays/bytes including
+    ones nested in common containers — no serialization, no device->host
+    export (jax.Array.nbytes is metadata)."""
     nb = getattr(value, "nbytes", None)
     if nb is not None:
-        return int(nb)
+        return int(nb), True
     if isinstance(value, (bytes, bytearray)):
-        return len(value)
+        return len(value), True
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return 64, True
+    if depth < 4:
+        if isinstance(value, dict):
+            items = value.values()
+        elif isinstance(value, (list, tuple)):
+            items = value
+        else:
+            return 0, False
+        total, known = 0, True
+        for item in items:
+            n, k = _probe_nbytes(item, depth + 1)
+            total += n
+            known = known and k
+        return total, known
+    return 0, False
+
+
+def _bulk_size(value: Any) -> int:
+    """Size probe for inline-vs-bulk routing WITHOUT a GIL-held in-band
+    pickle and WITHOUT device->host exports: arrays (incl. nested in
+    containers) are summed via nbytes metadata; only odd types fall back to
+    pickle-5 framing (whose reducer exports device buffers)."""
+    from ray_tpu.runtime import data_plane
+
+    known, fully = _probe_nbytes(value)
+    if fully:
+        return known
+    from ray_tpu.core.config import get_config
+
+    if known > get_config().data_plane_inline_bytes:
+        return known  # already over the line; no need to serialize to prove it
     try:
         meta, buffers = data_plane.to_frames(value)
     except Exception:  # noqa: BLE001 — unpicklable probes as "small"
@@ -366,7 +395,11 @@ class RemoteNodeHandle:
             error, _ = rpc.decode_value(payload["error"])
         elif payload.get("lazy"):
             # bulk result: bytes stayed on the agent; commit location-only
-            # and let consumers pull peer-to-peer on demand
+            # and let consumers pull peer-to-peer on demand.  HBM-resident
+            # returns are flagged in the directory (SURVEY §5.8).
+            for oid, on_device in zip(spec.return_ids, payload.get("device_returns", ())):
+                if on_device:
+                    self.cluster.directory.mark_device(oid)
             self.cluster.on_task_finished(self, spec, None, None, lazy=True)
             return
         else:
@@ -640,7 +673,10 @@ class HeadService:
         handle: RemoteNodeHandle = conn.peer
         if handle is None or handle.dead:
             return
-        self.cluster.directory.add_location(ObjectID(payload["oid"]), handle.node_id)
+        oid = ObjectID(payload["oid"])
+        if payload.get("device"):
+            self.cluster.directory.mark_device(oid)
+        self.cluster.directory.add_location(oid, handle.node_id)
 
     def _h_pull_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """An agent needs an object for a task dependency.  Resolve through

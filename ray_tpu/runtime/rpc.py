@@ -432,25 +432,18 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
     return spec
 
 
-def dumps_value(value: Any, buffer_callback=None) -> bytes:
-    """THE value-serialization policy (pickle-5, cloudpickle fallback) —
-    shared by the control plane and the bulk data plane.  With
-    ``buffer_callback`` the big buffers go out-of-band (data-plane frames);
-    without it they inline into the returned stream (control frames).  The
-    callback fires only for the attempt that SUCCEEDS (a half-failed pickle
-    pass must not leak its buffers into the fallback's)."""
-    collected: list = []
-    cb = None if buffer_callback is None else collected.append
+def dumps_value(value: Any) -> bytes:
+    """The CONTROL-plane value-serialization policy (in-band pickle-5,
+    cloudpickle fallback).  The bulk data plane shares the same policy plus
+    out-of-band buffers and the device-array envelope —
+    ``device_plane.dumps_with_device_envelope`` (one place, one fallback
+    dance; this function stays the small-value fast path)."""
     try:
-        out = pickle.dumps(value, protocol=5, buffer_callback=cb)
+        return pickle.dumps(value, protocol=5)
     except (AttributeError, TypeError, pickle.PicklingError):
         import cloudpickle
 
-        collected.clear()
-        out = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
-    for b in collected:
-        buffer_callback(b)
-    return out
+        return cloudpickle.dumps(value, protocol=5)
 
 
 def encode_value(value: Any, is_error: bool = False) -> dict:

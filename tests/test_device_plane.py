@@ -1,0 +1,128 @@
+"""Cross-host device-array transfer (round-3 VERDICT item 3).
+
+A ``jax.Array`` crossing processes no longer takes a host PICKLE round trip
+(device_get → in-band pickle → head relay → unpickle → numpy): the device
+envelope reduces it to metadata + an out-of-band raw buffer on the peer
+data plane, and the consumer rebuilds a REAL device array via
+``jax.device_put``.  On real multi-host TPU the same pull negotiates a
+``jax.experimental.transfer`` device-to-device ticket instead (probed; CPU
+and the single-chip tunnel fall back to the envelope transparently).
+
+Reference anchor: the role NCCL channels play for GPU tensors —
+``python/ray/experimental/channel/nccl_group.py:18``; SURVEY §5.8.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu as rt
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.runtime import data_plane, device_plane
+from ray_tpu.runtime.scheduler import NodeAffinitySchedulingStrategy
+
+from test_multihost import _spawn_agent, _wait_for_nodes, two_process_cluster  # noqa: F401
+
+
+# ==========================================================================
+# unit: the device envelope
+# ==========================================================================
+def test_device_array_serializes_out_of_band():
+    """The array's bytes never enter the pickle stream: meta stays tiny and
+    the payload rides as a raw out-of-band buffer."""
+    x = jnp.arange(250_000, dtype=jnp.float32)  # 1 MB
+    meta, buffers = data_plane.to_frames(x)
+    assert len(meta) < 4096, f"meta unexpectedly large: {len(meta)} (in-band pickle?)"
+    assert sum(memoryview(b).cast('B').nbytes for b in buffers) >= x.nbytes
+
+
+def test_device_array_roundtrips_as_device_array():
+    before = device_plane.stats.snapshot()["arrays_restored"]
+    x = jnp.arange(100_000, dtype=jnp.float32) * 3.0
+    meta, buffers = data_plane.to_frames(x)
+    y = data_plane.from_frames(meta, [bytearray(memoryview(b).cast('B')) for b in buffers])
+    assert isinstance(y, jax.Array), type(y)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    assert device_plane.stats.snapshot()["arrays_restored"] > before
+
+
+def test_device_arrays_nested_in_containers():
+    value = {"params": {"w": jnp.ones((64, 64), jnp.bfloat16)}, "step": 3,
+             "host": np.arange(10)}
+    meta, buffers = data_plane.to_frames(value)
+    got = data_plane.from_frames(meta, [bytes(memoryview(b).cast('B')) for b in buffers])
+    assert isinstance(got["params"]["w"], jax.Array)
+    assert got["params"]["w"].dtype == jnp.bfloat16
+    assert got["step"] == 3 and isinstance(got["host"], np.ndarray)
+
+
+def test_tracers_are_not_enveloped():
+    """Inside a jit trace the reducer must not try to export buffers."""
+
+    @jax.jit
+    def f(x):
+        # pickling never happens here; just assert the predicate is safe
+        assert not device_plane.is_device_array(x)
+        return x * 2
+
+    np.testing.assert_array_equal(np.asarray(f(jnp.ones(4))), 2 * np.ones(4))
+
+
+def test_transfer_server_probe_degrades_gracefully():
+    """On backends without transfer-server support (CPU / tunnel), the probe
+    yields None and pulls silently use the envelope."""
+    addr = device_plane.transfer_address()
+    assert addr is None or isinstance(addr, str)
+
+
+def test_pull_of_device_array_via_data_server():
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store)
+    try:
+        oid = ObjectID.from_random()
+        store.put(oid, jnp.full((512, 512), 7.0, jnp.float32))
+        client = data_plane.DataClient()
+        got, is_error = client.pull(server.address, oid.binary())
+        assert not is_error
+        assert isinstance(got, jax.Array)
+        assert float(got[0, 0]) == 7.0
+        client.close()
+    finally:
+        server.close()
+
+
+# ==========================================================================
+# integration: device array produced on the agent, consumed by the driver
+# and by peer tasks — no host pickle round trip
+# ==========================================================================
+def test_device_array_crosses_processes_without_host_pickle(two_process_cluster):
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1})
+    def produce():
+        return jnp.arange(1_000_000, dtype=jnp.float32) + 1.0  # 4MB: lazy commit
+
+    @rt.remote(resources={"remote": 1})
+    def norm(x):
+        assert hasattr(x, "devices"), f"consumer got {type(x)}, not a device array"
+        return float(jnp.max(x))
+
+    restored_before = device_plane.stats.snapshot()["arrays_restored"]
+    ref = produce.remote()
+
+    # driver-side consumption: a REAL device array arrives
+    arr = rt.get(ref, timeout=120)
+    assert isinstance(arr, jax.Array), type(arr)
+    assert float(arr[0]) == 1.0 and float(arr[-1]) == 1_000_000.0
+
+    # the envelope restored it (device_put), no in-band pickle round trip
+    assert device_plane.stats.snapshot()["arrays_restored"] > restored_before
+
+    # same-node peer consumption sees a device array too
+    assert rt.get(norm.remote(ref), timeout=120) == 1_000_000.0
+
+    # the head's directory knows the object is device-resident at its source
+    assert cluster.directory.is_device(ref.id())
