@@ -119,10 +119,17 @@ def main() -> None:
 
     extra = {}
     for name, (value, unit) in results.items():
-        row = {"value": round(value, 2), "unit": unit}
+        # small bandwidth rows keep enough precision that a slow-but-alive
+        # path can never print as 0.0 (a shipped zero reads as broken)
+        row = {"value": round(value, 2) if value >= 1 else round(value, 5), "unit": unit}
         base = BASELINES.get(name)
         if base is not None:
             row["vs_baseline"] = round(value / base[0], 2)
+        if name == "hbm_get_gigabytes" and value < 0.5:
+            row["note"] = (
+                "tunnel-limited: every device->host read crosses the CI "
+                "tunnel network; on-host TPU d2h runs at PCIe/DMA rates"
+            )
         extra[name] = row
 
     try:

@@ -286,7 +286,27 @@ class NodeAgent:
         from ray_tpu.core.config import get_config
 
         cfg = get_config()
-        self.node = Node(self.node_id, self.resources, self.fabric, shm_store=None, labels=self.labels)
+        # Native shm arena (plasma role) for THIS node's process workers:
+        # without it every bulk worker result pays an in-band pickle over
+        # the worker socket before it can even reach the data plane.
+        self.shm_store = None
+        try:
+            from ray_tpu.native.shm_store import ShmObjectStore
+
+            # a kill -9'd agent can't unlink its segment: reap predecessors
+            # whose embedded pid is dead before creating ours
+            _gc_stale_shm_segments()
+            # random suffix: pid reuse must not reopen a crashed agent's
+            # stale segment; unlinked in shutdown()
+            self.shm_store = ShmObjectStore(
+                f"/rt_a{os.getpid():x}_{os.urandom(3).hex()}", 2 << 30
+            )
+        except Exception:  # noqa: BLE001 — no /dev/shm: plain pipes still work
+            self.shm_store = None
+        self.node = Node(
+            self.node_id, self.resources, self.fabric,
+            shm_store=self.shm_store, labels=self.labels,
+        )
         self.fabric.node = self.node
         # Bulk data plane: this node serves its local store to peers and
         # pulls dependencies directly from whichever peer holds them (the
@@ -595,12 +615,43 @@ class NodeAgent:
         from ray_tpu.runtime import p2p
 
         p2p.clear_endpoint()
+        if getattr(self, "shm_store", None) is not None:
+            try:
+                self.shm_store.close()
+                self.shm_store.unlink()
+            except Exception:  # noqa: BLE001
+                pass
         if getattr(self, "data_server", None) is not None:
             self.data_server.close()
         if self.fabric.data_client is not None:
             self.fabric.data_client.close()
         if self.conn is not None:
             self.conn.close()
+
+
+def _gc_stale_shm_segments() -> None:
+    """Unlink /dev/shm/rt_a<pid>_* segments whose owning process is gone
+    (SIGKILL leaves them behind; they are RAM until someone reaps them)."""
+    import re
+
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        m = re.match(r"rt_a([0-9a-f]+)_[0-9a-f]+$", name)
+        if not m:
+            continue
+        try:
+            pid = int(m.group(1), 16)
+            os.kill(pid, 0)  # raises if the owner is dead
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
+        except (OSError, ValueError):
+            pass  # alive or unparsable: leave it
 
 
 def _self_address() -> str:
@@ -630,6 +681,10 @@ def main(argv=None) -> int:
         resources["TPU"] = args.num_tpus
 
     agent = NodeAgent(args.address, resources, labels=json.loads(args.labels))
+    # graceful SIGTERM: unlink the shm arena and leave the cluster cleanly
+    import signal as _signal
+
+    _signal.signal(_signal.SIGTERM, lambda *_a: agent.shutdown())
     try:
         agent.start()
     except (OSError, rpc.RpcError) as exc:

@@ -1034,27 +1034,47 @@ class Cluster:
         # Submit under q.lock so concurrent pumps (dep-pull callbacks,
         # on_actor_created) cannot interleave and reorder the per-actor
         # stream — submission order IS the execution order guarantee.
+        # Contiguous ready calls drain as ONE batch (one IPC frame for
+        # process-worker actors — the per-call submit cost dominated the
+        # async actor path).
         needs_prep = None
+        batch_submit = getattr(node, "submit_actor_task_batch", None)
         with q.lock:
             while q.alive and q.pending:
-                head = q.pending[0]
-                if not head[1]:
-                    spec = head[0]
-                    if bool(spec.dependencies) and any(
-                        not node.store.contains(d) for d in spec.dependencies
-                    ):
-                        needs_prep = head
-                        break
-                    head[1] = True
-                q.pending.popleft()
-                try:
-                    node.submit_actor_task(head[0])
-                except ConnectionError:
-                    # The node died under us: requeue at the front (order
-                    # preserved) and let the death sweep fail/retry the
-                    # whole queue.  Raising here would surface a transport
-                    # error at the caller's .remote() site.
-                    q.pending.appendleft(head)
+                batch = []
+                # bounded batches: a deep backlog must not become one giant
+                # encode + IPC frame built under the queue lock
+                while q.alive and q.pending and len(batch) < 100:
+                    head = q.pending[0]
+                    if not head[1]:
+                        spec = head[0]
+                        if bool(spec.dependencies) and any(
+                            not node.store.contains(d) for d in spec.dependencies
+                        ):
+                            needs_prep = head
+                            break
+                        head[1] = True
+                    q.pending.popleft()
+                    batch.append(head)
+                if not batch:
+                    break
+                failed = False
+                if batch_submit is not None and len(batch) > 1:
+                    batch_submit([e[0] for e in batch])  # local: never raises
+                else:
+                    for i, entry in enumerate(batch):
+                        try:
+                            node.submit_actor_task(entry[0])
+                        except ConnectionError:
+                            # The node died under us: requeue the UNSENT
+                            # tail at the front (order preserved) and let
+                            # the death sweep fail/retry the whole queue.
+                            # Raising would surface a transport error at
+                            # the caller's .remote() site.
+                            q.pending.extendleft(reversed(batch[i:]))
+                            failed = True
+                            break
+                if failed or needs_prep is not None:
                     break
         if needs_prep is not None:
             self._prepare_actor_entry(needs_prep)

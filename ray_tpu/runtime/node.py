@@ -632,6 +632,55 @@ class Node:
                 on_result,
             )
 
+    def submit_actor_task_batch(self, specs) -> None:
+        """Contiguous ready calls for ONE actor, submitted as a single IPC
+        frame when the actor lives in a process worker (order preserved —
+        one frame, executed in sequence by the worker's exec loop)."""
+        if len(specs) == 1:
+            self.submit_actor_task(specs[0])
+            return
+        inst = self.actors.get(specs[0].actor_id)
+        if inst is None or inst.dead or inst.mode == "inproc" or inst.worker is None:
+            for spec in specs:
+                self.submit_actor_task(spec)
+            return
+        shm = self.store._shm
+        calls, cbs = [], []
+        for spec in specs:
+            try:
+                args, kwargs = self._resolve_args(spec)
+                enc = self._encode_args(args, kwargs, shm)
+            except BaseException as exc:  # noqa: BLE001
+                self._commit_actor_error(spec, RayTaskError.from_exception(spec.name, exc))
+                continue
+
+            def make_on_result(spec=spec):
+                def on_result(value, err, exec_s=None):
+                    if err is not None:
+                        self.cluster.on_task_finished(
+                            self, spec, None,
+                            err if isinstance(err, (RayTaskError, RayActorError, WorkerCrashedError))
+                            else RayTaskError.from_exception(spec.name, err),
+                        )
+                    else:
+                        self.cluster.on_task_finished(
+                            self, spec, protocol.decode_value(value, shm), None
+                        )
+
+                return on_result
+
+            calls.append(
+                {
+                    "task_id": spec.task_id.binary(),
+                    "method": spec.actor_method,
+                    "args_blob": enc,
+                    "name": spec.name,
+                }
+            )
+            cbs.append((spec.task_id.binary(), make_on_result()))
+        if calls:
+            self.worker_pool.submit_batch_to_worker(inst.worker, calls, cbs)
+
     def _actor_thread_loop(self, inst: ActorInstance) -> None:
         from ray_tpu.runtime.context import task_context
 

@@ -21,6 +21,7 @@ import socket
 import sys
 import threading
 import traceback
+from typing import Optional
 
 
 def main() -> None:
@@ -68,6 +69,8 @@ class Worker:
         # observe (and release resources for) the exec thread's task
         self._current = threading.local()
         self._api = None  # WorkerApiClient, installed lazily on first use
+        self._flush_cv = None  # result flusher, started on first batch call
+        self._flush_buf: list = []
 
     # ------------------------------------------------------------------
     def _install_api(self) -> None:
@@ -126,6 +129,15 @@ class Worker:
                 self._handle_actor_create(payload)
             elif msg_type == "actor_call":
                 self._handle_actor_call(payload)
+            elif msg_type == "actor_call_batch":
+                # k calls in ONE IPC frame; each result is handed to the
+                # flusher thread which sends AS SOON AS IT CAN, naturally
+                # coalescing into result_batch frames while the exec thread
+                # keeps running.  Results are never withheld — a call whose
+                # completion the driver must observe before a later call can
+                # proceed (external coordination) still flows immediately.
+                for call in payload["calls"]:
+                    self._handle_actor_call(call, collect=self._emit_result)
             elif msg_type == "ping":
                 self._reply("pong", {})
 
@@ -201,14 +213,47 @@ class Worker:
                 {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(payload.get("name", "actor.__init__"), exc))},
             )
 
-    def _handle_actor_call(self, payload: dict) -> None:
+    def _emit_result(self, result_payload: dict) -> None:
+        """Queue a result for the flusher thread: it drains whatever has
+        accumulated into ONE result_batch frame per send — syscall
+        amortization under burst with zero added latency when idle."""
+        if self._flush_cv is None:
+            import threading as _t
+
+            self._flush_cv = _t.Condition()
+            self._flush_buf = []
+            _t.Thread(target=self._flush_loop, name="result-flush", daemon=True).start()
+        with self._flush_cv:
+            self._flush_buf.append(result_payload)
+            self._flush_cv.notify()
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._flush_cv:
+                while not self._flush_buf:
+                    self._flush_cv.wait()
+                batch, self._flush_buf = self._flush_buf, []
+            if len(batch) == 1:
+                self._reply("result", batch[0])
+            else:
+                self._reply("result_batch", {"results": batch})
+
+    def _handle_actor_call(self, payload: dict, collect=None) -> None:
         task_id = payload["task_id"]
         method_name = payload["method"]
+
+        def emit(result_payload: dict) -> None:
+            if collect is not None:
+                collect(result_payload)
+            else:
+                self._reply("result", result_payload)
+
         try:
             method = getattr(self._actor, method_name)
             args, kwargs = self._decode_args(payload)
             if asyncio.iscoroutinefunction(method) and self._actor_loop is not None:
-                # async actors: schedule on the loop, reply on completion.
+                # async actors: schedule on the loop, reply on completion
+                # (never coalesced — completion order is the loop's).
                 fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), self._actor_loop)
 
                 def done(f):
@@ -224,9 +269,9 @@ class Worker:
                 result = method(*args, **kwargs)
             finally:
                 self._current.task = None
-            self._reply("result", {"task_id": task_id, "value_blob": self._encode_result(result)})
+            emit({"task_id": task_id, "value_blob": self._encode_result(result)})
         except BaseException as exc:  # noqa: BLE001
-            self._reply("result", {"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})
+            emit({"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})
 
     def _start_actor_loop(self) -> None:
         loop = asyncio.new_event_loop()

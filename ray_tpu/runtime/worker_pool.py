@@ -364,6 +364,33 @@ class ProcessWorkerPool:
             if cb is not None:
                 _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
 
+    def submit_batch_to_worker(self, worker: WorkerHandle, calls: list, cbs: list) -> None:
+        """k actor calls in one IPC frame (``calls`` carry their task_ids;
+        ``cbs`` is [(task_id, callback)]).  Collapses the per-call
+        pickle+syscall submit cost that dominates the async actor path."""
+        if not worker.alive:
+            for _tid, cb in cbs:
+                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} is dead"))
+            return
+        with self._lock:
+            for tid, cb in cbs:
+                self._inflight[tid] = cb
+                self._inflight_worker[tid] = worker
+        try:
+            worker.send("actor_call_batch", {"calls": calls})
+        except OSError:
+            with self._lock:
+                pending = [
+                    (tid, self._inflight.pop(tid, None)) for tid, _cb in cbs
+                ]
+                for tid, _cb in cbs:
+                    self._inflight_worker.pop(tid, None)
+                    self._inflight_start.pop(tid, None)
+            self._handle_worker_death(worker)
+            for _tid, cb in pending:
+                if cb is not None:
+                    _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
+
     def release_actor_worker(self, worker: WorkerHandle) -> None:
         """Actor died/removed: kill its dedicated process."""
         self._kill_worker(worker)
@@ -414,34 +441,44 @@ class ProcessWorkerPool:
             if msg_type == "api_request":
                 self._serve_api_request(worker, payload)
                 continue
+            if msg_type == "result_batch":
+                # coalesced replies from an actor_call_batch: one frame, k
+                # results (the per-result recv+unpickle syscall tax was the
+                # other half of the async actor path's cost)
+                for result_payload in payload["results"]:
+                    self._deliver_result(worker, result_payload)
+                continue
             if msg_type == "result":
-                task_id = payload["task_id"]
-                with self._lock:
-                    callback = self._inflight.pop(task_id, None)
-                    self._inflight_start.pop(task_id, None)
-                    self._inflight_worker.pop(task_id, None)
-                    slot = self._direct.pop(task_id, None)
-                if callback is None:
-                    continue
-                if not worker.dedicated:
-                    self._release_worker(worker)
-                if slot is not None:
-                    # sync waiter present: hand off the raw payload; the
-                    # waiter's thread unpickles + commits
-                    slot.payload = payload
-                    slot.callback = callback
-                    slot.event.set()
-                    continue
-                try:
-                    if "error_blob" in payload:
-                        callback(None, pickle.loads(payload["error_blob"]), payload.get("exec_s"))
-                    else:
-                        callback(pickle.loads(payload["value_blob"]), None, payload.get("exec_s"))
-                except BaseException as exc:  # noqa: BLE001 — keep the reader alive
-                    try:
-                        callback(None, exc, None)
-                    except BaseException:
-                        pass
+                self._deliver_result(worker, payload)
+
+    def _deliver_result(self, worker: WorkerHandle, payload: dict) -> None:
+        task_id = payload["task_id"]
+        with self._lock:
+            callback = self._inflight.pop(task_id, None)
+            self._inflight_start.pop(task_id, None)
+            self._inflight_worker.pop(task_id, None)
+            slot = self._direct.pop(task_id, None)
+        if callback is None:
+            return
+        if not worker.dedicated:
+            self._release_worker(worker)
+        if slot is not None:
+            # sync waiter present: hand off the raw payload; the
+            # waiter's thread unpickles + commits
+            slot.payload = payload
+            slot.callback = callback
+            slot.event.set()
+            return
+        try:
+            if "error_blob" in payload:
+                callback(None, pickle.loads(payload["error_blob"]), payload.get("exec_s"))
+            else:
+                callback(pickle.loads(payload["value_blob"]), None, payload.get("exec_s"))
+        except BaseException as exc:  # noqa: BLE001 — keep the reader alive
+            try:
+                callback(None, exc, None)
+            except BaseException:
+                pass
 
     def _handle_worker_death(self, worker: WorkerHandle) -> None:
         if not worker.alive:
