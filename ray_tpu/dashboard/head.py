@@ -70,12 +70,26 @@ class DashboardHead:
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, name="dashboard-head", daemon=True)
         self._thread.start()
+        # the head node's own utilization samples (agents piggyback theirs
+        # on resource_report; the head has no agent, so sample locally)
+        self._stop_sampler = threading.Event()
+        threading.Thread(target=self._self_sample_loop, name="dashboard-sampler", daemon=True).start()
+
+    def _self_sample_loop(self) -> None:
+        from ray_tpu.dashboard.reporter import SystemSampler
+
+        sampler = SystemSampler()
+        head_node = self.cluster.head_node
+        while not self._stop_sampler.wait(2.0):
+            if head_node is not None:
+                self.cluster.metrics_history.add(head_node.node_id.hex(), sampler.sample())
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
     def shutdown(self) -> None:
+        self._stop_sampler.set()
         self.job_manager.shutdown()
         self._server.shutdown()
         self._server.server_close()
@@ -112,6 +126,17 @@ class DashboardHead:
             req._send(200, {"placement_groups": state_api.list_placement_groups(limit=limit)})
         elif path == "/api/cluster_status":
             req._send(200, self._cluster_status())
+        elif path == "/api/metrics_history":
+            minutes = float(query.get("minutes", ["15"])[0])
+            req._send(200, {"nodes": self.cluster.metrics_history.all_series(minutes)})
+        elif path.startswith("/api/nodes/") and path.endswith("/metrics"):
+            node_hex = self._resolve_node_hex(path[len("/api/nodes/"): -len("/metrics")])
+            minutes = float(query.get("minutes", ["15"])[0])
+            req._send(200, {"node": node_hex, "series": self.cluster.metrics_history.series(node_hex, minutes)})
+        elif path.startswith("/api/nodes/") and path.endswith("/logs"):
+            node_hex = self._resolve_node_hex(path[len("/api/nodes/"): -len("/logs")])
+            lines = int(query.get("lines", ["200"])[0])
+            req._send(200, {"node": node_hex, "lines": self.cluster.node_logs.tail(node_hex, lines)})
         elif path == "/api/events":
             req._send(
                 200,
@@ -217,6 +242,14 @@ class DashboardHead:
                 req._send(400, {"error": str(exc)})
         else:
             req._send(404, {"error": f"no route {path!r}"})
+
+    def _resolve_node_hex(self, prefix: str) -> str:
+        """Accept full or prefix node ids in URLs."""
+        for nid in list(self.cluster.nodes):
+            h = nid.hex()
+            if h.startswith(prefix):
+                return h
+        return prefix
 
     # ------------------------------------------------------------------
     def _cluster_status(self) -> dict:

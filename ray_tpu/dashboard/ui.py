@@ -48,6 +48,11 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Serve</h2><table id="serve"></table></section>
   <section style="grid-column: 1 / -1"><h2>Actors</h2><table id="actors"></table></section>
   <section style="grid-column: 1 / -1"><h2>Recent tasks</h2><table id="tasks"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Node utilization</h2><div id="util"></div></section>
+  <section style="grid-column: 1 / -1"><h2>Node logs</h2>
+    <div style="margin-bottom:8px">node: <select id="lognode" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d"></select></div>
+    <pre id="nodelogs" style="max-height:260px;overflow:auto"></pre>
+  </section>
   <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre>
     <p style="margin:8px 0 0"><a style="color:#7fd1b9" href="/api/timeline" download="timeline.json">download chrome timeline</a></p>
   </section>
@@ -123,6 +128,47 @@ async function refresh() {
       [esc(name), esc(d.num_replicas), esc(d.target_replicas)]));
   if (events) $("events").textContent =
     (events.events || []).map(e => `${e.timestamp ?? ""} [${e.severity ?? e.level ?? ""}] ${e.label ?? ""} ${e.message ?? ""}`).join("\\n") || "(none)";
+  await refreshUtil();
+  await refreshLogs();
+}
+function spark(points, key, color) {
+  const w = 260, h = 36;
+  const vals = points.map(p => p[key]).filter(v => v != null);
+  if (!vals.length) return "<span style='color:#555'>no data</span>";
+  const max = Math.max(100, ...vals);
+  const step = vals.length > 1 ? w / (vals.length - 1) : w;
+  const pts = vals.map((v, i) => `${(i * step).toFixed(1)},${(h - h * v / max).toFixed(1)}`).join(" ");
+  const last = vals[vals.length - 1];
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">
+    <polyline points="${pts}" fill="none" stroke="${color}" stroke-width="1.5"/></svg>
+    <span class="num" style="margin-left:6px">${last.toFixed(1)}%</span>`;
+}
+async function refreshUtil() {
+  const hist = await get("/api/metrics_history?minutes=15");
+  if (!hist) return;
+  const rowsHtml = Object.entries(hist.nodes || {}).map(([node, pts]) => {
+    const tpu = pts.some(p => p.tpu_mem_percent != null)
+      ? `<td>tpu mem ${spark(pts, "tpu_mem_percent", "#e8c268")}</td>` : "";
+    return `<tr><td>${esc(node.slice(0, 12))}</td>
+      <td>cpu ${spark(pts, "cpu_percent", "#7fd1b9")}</td>
+      <td>mem ${spark(pts, "mem_percent", "#9fb3c8")}</td>${tpu}</tr>`;
+  }).join("");
+  $("util").innerHTML = rowsHtml ? `<table>${rowsHtml}</table>` : "(no samples yet)";
+}
+async function refreshLogs() {
+  const sel = $("lognode");
+  const nodes = await get("/api/nodes");
+  if (nodes) {
+    const current = sel.value;
+    const opts = nodes.nodes.filter(n => !n.is_head).map(n => n.node_id);
+    if (opts.join() !== [...sel.options].map(o => o.value).join()) {
+      sel.innerHTML = opts.map(v => `<option value="${esc(v)}">${esc(v.slice(0, 12))}</option>`).join("");
+      if (opts.includes(current)) sel.value = current;
+    }
+  }
+  if (!sel.value) { $("nodelogs").textContent = "(no remote nodes)"; return; }
+  const logs = await get(`/api/nodes/${sel.value}/logs?lines=100`);
+  if (logs) $("nodelogs").textContent = (logs.lines || []).join("\\n") || "(no worker logs yet)";
 }
 refresh();
 setInterval(refresh, 2000);

@@ -575,20 +575,29 @@ class NodeAgent:
         """One report loop per connection epoch; exits when ITS connection
         dies (the rejoin path starts a fresh one)."""
         from ray_tpu.core.config import get_config
+        from ray_tpu.dashboard.reporter import SystemSampler
 
+        sampler = SystemSampler()
         period = max(0.02, get_config().resource_sync_period_s)
+        last_sample = 0.0
         while not self._stop.is_set() and not conn.closed:
             try:
                 pool = self.node.pool
-                conn.send(
-                    "resource_report",
-                    {
-                        "total": pool.total.fixed(),
-                        "available": pool.available.fixed(),
-                        "queue_len": self.node.scheduler.queue_len(),
-                        "stats": self.node.scheduler.stats(),
-                    },
-                )
+                report = {
+                    "total": pool.total.fixed(),
+                    "available": pool.available.fixed(),
+                    "queue_len": self.node.scheduler.queue_len(),
+                    "stats": self.node.scheduler.stats(),
+                }
+                # reporter piggyback: CPU/mem/TPU utilization, sampled at
+                # the HISTORY's cadence (2s), not the hot report tick — the
+                # head ring-buffers at 2s anyway, so faster sampling is
+                # /proc+jax I/O thrown away
+                now = time.monotonic()
+                if now - last_sample >= 2.0:
+                    last_sample = now
+                    report["metrics"] = sampler.sample()
+                conn.send("resource_report", report)
             except rpc.RpcError:
                 return
             self._flush_logs()

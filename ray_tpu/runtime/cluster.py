@@ -188,6 +188,12 @@ class Cluster:
         # serializes node (re)registration against node-death sweeps: a
         # rejoin landing mid-kill must not have its fresh state clobbered
         self._node_lifecycle_lock = threading.RLock()
+        # dashboard reporter stores (per-node utilization time series +
+        # worker log tails; reference: dashboard/modules/reporter/ + log)
+        from ray_tpu.dashboard.reporter import MetricsHistory, NodeLogStore
+
+        self.metrics_history = MetricsHistory()
+        self.node_logs = NodeLogStore()
         self.head_service = None  # multi-host TCP service (start_head_service)
         # pending resource demand, read by the autoscaler (parity with the
         # load the GCS reports to the monitor process,
@@ -356,6 +362,8 @@ class Cluster:
         # recover lost objects that someone may still want
         for oid in lost:
             self._try_recover(oid)
+        # dashboard stores: a dead node must not linger in the UI
+        self.metrics_history.drop_node(node_id.hex())
         # actors hosted there follow the restart FSM
         for info in self.control.actors.list_actors():
             if info.node_id == node_id and info.state in (ActorState.ALIVE, ActorState.PENDING_CREATION):

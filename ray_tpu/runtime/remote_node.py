@@ -445,6 +445,7 @@ class RemoteNodeHandle:
         self.pool.reconcile(payload["total"], payload["available"])
         self.scheduler._queue_len = payload.get("queue_len", 0)
         self.scheduler._stats = payload.get("stats", {})
+        self.cluster.metrics_history.add(self.node_id.hex(), payload.get("metrics"))
         self.last_report = time.monotonic()
         self.cluster.control.nodes.heartbeat(
             self.node_id,
@@ -740,8 +741,12 @@ class HeadService:
     def _h_log_batch(self, conn, payload) -> None:
         import sys
 
+        lines = payload.get("lines", ())
         node = conn.peer.node_id.hex()[:8] if conn.peer else "?"
-        for line in payload.get("lines", ()):
+        if conn.peer is not None:
+            # dashboard log viewer: per-node ring buffer on the head
+            self.cluster.node_logs.append(conn.peer.node_id.hex(), lines)
+        for line in lines:
             print(f"(node={node}) {line}", file=sys.stderr)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,126 @@
+"""Dashboard per-node reporter: utilization time series + remote log viewer
+(round-3 VERDICT item 7).
+
+Each agent piggybacks CPU/mem/TPU samples on its resource reports; the head
+ring-buffers per-node series and per-node worker-log tails and serves both
+over REST (and graphs them in the UI).  Reference parity:
+``dashboard/agent.py:28`` + ``dashboard/modules/reporter/`` + the log
+module.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dashboard.reporter import MetricsHistory, NodeLogStore, SystemSampler
+
+from test_multihost import _spawn_agent, _wait_for_nodes
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------- unit
+def test_system_sampler_reports_cpu_and_memory():
+    s = SystemSampler()
+    s.sample()          # first call primes the /proc/stat delta
+    time.sleep(0.15)
+    out = s.sample()
+    assert 0.0 <= out["cpu_percent"] <= 100.0
+    assert out["mem_total"] > 0 and 0 < out["mem_used"] <= out["mem_total"]
+    assert "ts" in out
+
+
+def test_metrics_history_ring_and_throttle():
+    h = MetricsHistory(maxlen=5, min_interval_s=0.0)
+    for i in range(9):
+        h.add("node1", {"ts": time.time(), "cpu_percent": float(i)})
+    series = h.series("node1", minutes=5)
+    assert len(series) == 5 and series[-1]["cpu_percent"] == 8.0
+
+    throttled = MetricsHistory(min_interval_s=60.0)
+    throttled.add("n", {"ts": time.time(), "cpu_percent": 1.0})
+    throttled.add("n", {"ts": time.time(), "cpu_percent": 2.0})  # inside window
+    assert len(throttled.series("n", minutes=5)) == 1
+
+
+def test_node_log_store_tail():
+    s = NodeLogStore(maxlen=10)
+    s.append("n", [f"line{i}" for i in range(25)])
+    assert s.tail("n", 3) == ["line22", "line23", "line24"]
+    assert s.tail("unknown") == []
+
+
+# ------------------------------------------------------- integration
+@pytest.fixture
+def dash_multihost():
+    rt.init(num_cpus=2, include_dashboard=True)
+    cluster = rt.get_cluster()
+    address = cluster.start_head_service()
+    proc = _spawn_agent(address)
+    try:
+        _wait_for_nodes(cluster, 2)
+        yield cluster, proc
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        rt.shutdown()
+
+
+def test_both_nodes_report_series_and_remote_logs_visible(dash_multihost):
+    """The acceptance bar: a two-process cluster surfaces BOTH nodes' live
+    utilization series and the remote node's worker logs through the
+    dashboard REST API the UI graphs."""
+    cluster, proc = dash_multihost
+    url = cluster.dashboard.url
+
+    # generate remote worker logs
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def chatty(i):
+        print(f"reporter-test-line-{i}")
+        return i
+
+    assert rt.get([chatty.remote(i) for i in range(3)], timeout=60) == [0, 1, 2]
+
+    # both nodes produce utilization samples (head sampler ~2s period;
+    # agent piggybacks on resource reports)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        hist = _get(url + "/api/metrics_history?minutes=5")["nodes"]
+        live = [
+            n for n, pts in hist.items()
+            if pts and pts[-1].get("cpu_percent") is not None
+        ]
+        if len(live) >= 2:
+            break
+        time.sleep(0.5)
+    assert len(live) >= 2, f"expected 2 nodes with samples, got {hist.keys()}"
+
+    # per-node series route (prefix form)
+    some_node = live[0]
+    series = _get(url + f"/api/nodes/{some_node[:12]}/metrics?minutes=5")["series"]
+    assert series and series[-1]["mem_total"] > 0
+
+    # the remote node's worker logs are viewable per node
+    remote_hex = next(
+        nid.hex() for nid, n in cluster.nodes.items() if nid != cluster.head_node.node_id
+    )
+    deadline = time.monotonic() + 30
+    lines = []
+    while time.monotonic() < deadline:
+        lines = _get(url + f"/api/nodes/{remote_hex}/logs?lines=50")["lines"]
+        if any("reporter-test-line-" in ln for ln in lines):
+            break
+        time.sleep(0.5)
+    assert any("reporter-test-line-" in ln for ln in lines), lines
+
+    # the UI page embeds the utilization + log panels
+    with urllib.request.urlopen(url + "/", timeout=10) as r:
+        html = r.read().decode()
+    assert "Node utilization" in html and "Node logs" in html
