@@ -68,7 +68,9 @@ class StandardAutoscaler:
             # their rt_provider_id label, not their cluster node id
             provider_id = (getattr(node, "labels", None) or {}).get("rt_provider_id")
             if provider_id:
-                busy[provider_id] = not is_idle
+                # multiple hosts may share one provider id (a TPU slice):
+                # the slice is busy if ANY host is
+                busy[provider_id] = busy.get(provider_id, False) or not is_idle
                 totals[provider_id] = total
         return demands, available, busy, totals
 

@@ -67,7 +67,7 @@ def _node_types(cfg: Dict[str, Any]) -> Dict[str, NodeTypeConfig]:
     return out
 
 
-def make_provider(cfg: Dict[str, Any], head_address: str) -> NodeProvider:
+def make_provider(cfg: Dict[str, Any], head_address: str, cluster=None) -> NodeProvider:
     provider_cfg = cfg.get("provider") or {"type": "local"}
     kind = provider_cfg.get("type", "local")
     if kind == "local":
@@ -81,7 +81,33 @@ def make_provider(cfg: Dict[str, Any], head_address: str) -> NodeProvider:
             remote_python=provider_cfg.get("remote_python", "python3"),
             remote_dir=provider_cfg.get("remote_dir", "~"),
         )
-    raise ValueError(f"unknown provider type {kind!r} (supported: local, ssh)")
+    if kind == "gcp-tpu":
+        # Cloud TPU-VM slices as gang-provisioned nodes (reference:
+        # autoscaler/_private/gcp/node_provider.py + accelerators/tpu.py)
+        from ray_tpu.autoscaler.gcp import (
+            FakeGcloudTpuAPI,
+            GcpTpuNodeProvider,
+            live_slice_hosts_fn,
+        )
+
+        if not provider_cfg.get("fake") and not provider_cfg.get("project"):
+            raise ValueError("gcp-tpu provider requires 'project' in the provider config")
+        if not provider_cfg.get("zone"):
+            raise ValueError("gcp-tpu provider requires 'zone' in the provider config")
+        return GcpTpuNodeProvider(
+            head_address,
+            # fake: true = exercise the full lifecycle against the in-tree
+            # fake API (slice hosts become real local agent processes)
+            api=FakeGcloudTpuAPI() if provider_cfg.get("fake") else None,
+            zone=provider_cfg.get("zone", ""),
+            project=provider_cfg.get("project", ""),
+            runtime_version=provider_cfg.get("runtime_version", "tpu-ubuntu2204-base"),
+            name_prefix=provider_cfg.get("name_prefix", cfg.get("cluster_name", "rt")),
+            remote_python=provider_cfg.get("remote_python", "python3"),
+            gang_join_timeout_s=float(provider_cfg.get("gang_join_timeout_s", 600.0)),
+            live_slice_hosts=live_slice_hosts_fn(cluster) if cluster is not None else None,
+        )
+    raise ValueError(f"unknown provider type {kind!r} (supported: local, ssh, gcp-tpu)")
 
 
 class ClusterLauncher:
@@ -103,7 +129,7 @@ class ClusterLauncher:
         self.address = cluster.start_head_service(
             host="0.0.0.0", port=int(head.get("port", 0))
         )
-        self.provider = make_provider(self.config, self.address)
+        self.provider = make_provider(self.config, self.address, cluster=cluster)
         node_types = _node_types(self.config)
         as_config = AutoscalerConfig(
             node_types=node_types,

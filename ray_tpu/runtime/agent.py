@@ -689,7 +689,14 @@ def main(argv=None) -> int:
     if args.num_tpus is not None:
         resources["TPU"] = args.num_tpus
 
-    agent = NodeAgent(args.address, resources, labels=json.loads(args.labels))
+    labels = dict(json.loads(args.labels))
+    # Cloud TPU sets TPU_WORKER_ID per slice host: record it so gang
+    # placement and the dashboard see each host's index in its slice
+    # (reference: accelerators/tpu.py worker-id detection).
+    if "TPU_WORKER_ID" in os.environ and "ray_tpu.io/worker-index" not in labels:
+        labels["ray_tpu.io/worker-index"] = os.environ["TPU_WORKER_ID"]
+
+    agent = NodeAgent(args.address, resources, labels=labels)
     # graceful SIGTERM: unlink the shm arena and leave the cluster cleanly
     import signal as _signal
 

@@ -39,11 +39,22 @@ class PlacementGroupState(Enum):
 
 
 class PlacementGroupInfo:
-    def __init__(self, pg_id: PlacementGroupID, bundles: List[ResourceSet], strategy: PlacementStrategy, name: str = ""):
+    def __init__(
+        self, pg_id: PlacementGroupID, bundles: List[ResourceSet],
+        strategy: PlacementStrategy, name: str = "",
+        labels: Optional[Dict[str, str]] = None,
+        pack_by_label: Optional[str] = None,
+    ):
         self.pg_id = pg_id
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
+        # node-label selector: only nodes carrying every (k, v) qualify
+        self.labels = dict(labels or {})
+        # gang-at-slice-granularity: all bundles must land on nodes sharing
+        # ONE value of this label (e.g. "ray_tpu.io/slice-id" places a
+        # STRICT_SPREAD gang across the hosts of a single TPU slice)
+        self.pack_by_label = pack_by_label
         self.state = PlacementGroupState.PENDING
         # bundle index -> node id
         self.bundle_placements: Dict[int, NodeID] = {}
@@ -165,6 +176,27 @@ class PlacementGroupManager:
         nodes = self._nodes.alive_nodes()
         if not nodes or self._node_pools is None:
             return None
+        if info.labels:
+            nodes = [
+                n for n in nodes
+                if all((n.labels or {}).get(k) == v for k, v in info.labels.items())
+            ]
+        if info.pack_by_label:
+            # candidate groups = nodes sharing one value of the label; the
+            # whole gang must fit inside a single group (a TPU slice)
+            by_value: Dict[str, list] = {}
+            for n in nodes:
+                value = (n.labels or {}).get(info.pack_by_label)
+                if value is not None:
+                    by_value.setdefault(value, []).append(n)
+            for _value, group_nodes in sorted(by_value.items()):
+                placements = self._schedule_on(info, group_nodes)
+                if placements is not None:
+                    return placements
+            return None
+        return self._schedule_on(info, nodes)
+
+    def _schedule_on(self, info: PlacementGroupInfo, nodes) -> Optional[Dict[int, NodeID]]:
         pools = {n.node_id: self._node_pools.get(n.node_id) for n in nodes}
         pools = {nid: p for nid, p in pools.items() if p is not None}
         if not pools:

@@ -71,8 +71,17 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    pack_by_label: Optional[str] = None,
 ) -> PlacementGroup:
-    """Create (and synchronously schedule) a placement group."""
+    """Create (and synchronously schedule) a placement group.
+
+    ``labels`` restricts candidate nodes to those carrying every (k, v);
+    ``pack_by_label`` places the whole gang on nodes sharing ONE value of
+    that label — e.g. ``pack_by_label="ray_tpu.io/slice-id"`` with
+    ``strategy="STRICT_SPREAD"`` gang-places one bundle per host of a
+    single TPU slice (reference: TPU pod affinity via the
+    ``TPU-<pod>-head`` resource, accelerators/tpu.py:13-33)."""
     import ray_tpu
     from ray_tpu.runtime.worker import global_worker
 
@@ -94,6 +103,8 @@ def placement_group(
         [ResourceSet(b) for b in bundles],
         PlacementStrategy[strategy],
         name=name,
+        labels=labels,
+        pack_by_label=pack_by_label,
     )
     cluster = ray_tpu.get_cluster()
     # create() registers the group either way; an infeasible one stays
