@@ -31,6 +31,9 @@ from ray_tpu.data.read_api import (
     read_json,
     read_numpy,
     read_bigquery,
+    read_delta,
+    read_iceberg,
+    read_lance,
     read_mongo,
     read_parquet,
     read_sql,
@@ -79,6 +82,9 @@ __all__ = [
     "read_images",
     "read_parquet",
     "read_bigquery",
+    "read_delta",
+    "read_iceberg",
+    "read_lance",
     "read_mongo",
     "read_sql",
     "read_text",

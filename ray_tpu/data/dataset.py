@@ -380,6 +380,19 @@ class Dataset:
 
         self._write(TFRecordDatasource([]), path, kw)
 
+    def write_delta(self, table_path: str, *, mode: str = "append") -> None:
+        """Delta Lake commit (parquet part files + _delta_log JSON commit;
+        mode: append | overwrite)."""
+        from ray_tpu.data.datasource_lakes import DeltaWriteDatasource
+
+        self._write(DeltaWriteDatasource(mode), table_path, {})
+
+    def write_lance(self, uri: str, *, mode: str = "create") -> None:
+        """Lance dataset (requires the lance package)."""
+        from ray_tpu.data.datasource_lakes import LanceWriteDatasource
+
+        self._write(LanceWriteDatasource(mode), uri, {})
+
     def write_sql(self, table: str, connection_factory, *, paramstyle: str = "qmark") -> None:
         """Insert all rows into a DB table via DB-API (parity: write_sql)."""
         from ray_tpu.data.datasource import SQLDatasource

@@ -134,13 +134,24 @@ class BlocksDatasource(Datasource):
 # --------------------------------------------------------------------------
 # File-based sources
 # --------------------------------------------------------------------------
-def _expand_paths(paths) -> List[str]:
+def _expand_paths(paths, metadata_prefixes: tuple = ()) -> List[str]:
+    """Directories expand RECURSIVELY to files (partitioned layouts nest
+    data under key=value / bucket subdirectories).  Dotfiles are skipped
+    (glob's historical behavior); ``metadata_prefixes`` lets parquet-family
+    sources additionally skip their _-prefixed metadata entries
+    (_delta_log, _partition_spec.json) without hiding underscore-named
+    data files from text/csv/json readers."""
     if isinstance(paths, str):
         paths = [paths]
+    skip = (".",) + tuple(metadata_prefixes)
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(_glob.glob(os.path.join(p, "*"))))
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if not d.startswith(skip))
+                for f in sorted(files):
+                    if not f.startswith(skip):
+                        out.append(os.path.join(root, f))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(_glob.glob(p)))
         else:
@@ -156,9 +167,25 @@ class FileBasedDatasource(Datasource):
     (``ray_tpu.native.io_pool``, C++ pthread pread — GIL-free), decoding in
     Python while the remaining files stream in the background."""
 
+    #: prefixes of non-data entries to skip during expansion (parquet-family
+    #: sources set ("_",) for their sidecar metadata)
+    _metadata_prefixes: tuple = ()
+
     def __init__(self, paths, **read_kwargs):
-        self.paths = _expand_paths(paths)
+        # remember the user-supplied directory roots: hive partition-value
+        # parsing must only consider path segments BELOW a root, never
+        # unrelated ancestors (/tmp/run=3/... must not become a column)
+        raw = [paths] if isinstance(paths, str) else list(paths)
+        self.root_dirs = [os.path.abspath(p) for p in raw if isinstance(p, str) and os.path.isdir(p)]
+        self.paths = _expand_paths(paths, self._metadata_prefixes)
         self.read_kwargs = read_kwargs
+
+    def _relative_to_root(self, path: str) -> Optional[str]:
+        ap = os.path.abspath(path)
+        for root in self.root_dirs:
+            if ap.startswith(root + os.sep):
+                return ap[len(root) + 1:]
+        return None
 
     def _read_file(self, path: str) -> Block:
         # default: read bytes then decode (subclasses override either hook)
@@ -262,6 +289,8 @@ class NumpyDatasource(FileBasedDatasource):
 
 
 class ParquetDatasource(FileBasedDatasource):
+    _metadata_prefixes = ("_",)  # _delta_log, _partition_spec.json, _SUCCESS
+
     """Parquet via pyarrow with column + predicate pushdown.
 
     Parity: ``python/ray/data/datasource/parquet_datasource.py`` — ``columns``
@@ -354,15 +383,167 @@ class ParquetDatasource(FileBasedDatasource):
             with cls._stats_lock:
                 cls.read_stats["row_groups_read"] += meta.num_row_groups
             table = f.read(columns=self.columns)
+        # hive layout: key=value segments BELOW the dataset root come back
+        # as columns (ancestor directories never do)
+        hive = _hive_partition_values(self._relative_to_root(path))
+        for key, value in hive.items():
+            if key not in table.column_names and (
+                self.columns is None or key in self.columns
+            ):
+                import pyarrow as pa
+
+                table = table.append_column(key, pa.array([value] * table.num_rows))
         return BlockAccessor.for_block(table).to_block()
 
-    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+    def write(
+        self, blocks: List[Block], path: str,
+        partition_cols=None, partition_by=None, **kwargs,
+    ) -> None:
+        """Write blocks as parquet.  ``partition_cols=[col, ...]`` produces a
+        hive layout (``col=value/`` directories, partition columns dropped
+        from the files — restored at read time); ``partition_by={"column":
+        c, "mode": "hash"|"range", "num_partitions": N}`` buckets rows by a
+        deterministic hash or by global range boundaries (reference:
+        partitioned writes in parquet_datasource + partitioning.py)."""
         import pyarrow.parquet as pq
 
         os.makedirs(path, exist_ok=True)
+        if partition_cols:
+            self._write_hive(blocks, path, list(partition_cols))
+            return
+        if partition_by:
+            self._write_bucketed(blocks, path, dict(partition_by))
+            return
         for i, block in enumerate(blocks):
             table = BlockAccessor(block).to_arrow()
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    @staticmethod
+    def _hive_quote(value) -> str:
+        from urllib.parse import quote
+
+        return quote(str(value), safe="")
+
+    def _write_hive(self, blocks, path: str, cols: List[str]) -> None:
+        import pyarrow.parquet as pq
+
+        part_seq: Dict[str, int] = {}
+        for block in blocks:
+            table = BlockAccessor(block).to_arrow()
+            key_arrays = [np.asarray(table[c]) for c in cols]
+            for c, arr in zip(cols, key_arrays):
+                # NaN != NaN would silently drop those rows (no combo mask
+                # matches); nulls in partition columns are a modeling error
+                if arr.dtype.kind == "f" and np.isnan(arr).any():
+                    raise ValueError(
+                        f"partition column {c!r} contains NaN/null values; "
+                        "partition keys must be non-null"
+                    )
+            keys = list(zip(*[a.tolist() for a in key_arrays])) if len(table) else []
+            data = table.drop_columns(cols)
+            for combo in sorted(set(keys), key=str):
+                mask = np.ones(len(table), dtype=bool)
+                for arr, v in zip(key_arrays, combo):
+                    mask &= arr == v
+                subdir = os.path.join(
+                    path, *[f"{c}={self._hive_quote(v)}" for c, v in zip(cols, combo)]
+                )
+                os.makedirs(subdir, exist_ok=True)
+                seq = part_seq.get(subdir, 0)
+                part_seq[subdir] = seq + 1
+                pq.write_table(
+                    data.filter(mask), os.path.join(subdir, f"part-{seq:05d}.parquet")
+                )
+
+    def _write_bucketed(self, blocks, path: str, spec: dict) -> None:
+        import json as _json
+
+        import pyarrow.parquet as pq
+
+        column = spec["column"]
+        n = int(spec.get("num_partitions", 8))
+        mode = spec.get("mode", "hash")
+        tables = [BlockAccessor(b).to_arrow() for b in blocks]
+        if mode == "range":
+            chunks = [np.asarray(t[column]) for t in tables if len(t)]
+            if not chunks:
+                # empty dataset: spec-only layout, nothing to bucket
+                with open(os.path.join(path, "_partition_spec.json"), "w") as f:
+                    import json as _j
+
+                    _j.dump({"column": column, "mode": mode,
+                             "num_partitions": n, "bounds": []}, f)
+                return
+            all_vals = np.concatenate(chunks)
+            if all_vals.dtype.kind not in "iuf":
+                raise ValueError(
+                    f"range partitioning needs a numeric column; {column!r} "
+                    f"has dtype {all_vals.dtype}"
+                )
+            bounds = [
+                float(np.quantile(all_vals, q))
+                for q in np.linspace(0, 1, n + 1)[1:-1]
+            ]
+        elif mode == "hash":
+            bounds = None
+        else:
+            raise ValueError(f"partition_by mode must be 'hash' or 'range', got {mode!r}")
+        with open(os.path.join(path, "_partition_spec.json"), "w") as f:
+            _json.dump({"column": column, "mode": mode, "num_partitions": n, "bounds": bounds}, f)
+        part_seq: Dict[int, int] = {}
+        for table in tables:
+            vals = np.asarray(table[column])
+            if mode == "range":
+                idx = np.searchsorted(np.asarray(bounds), vals, side="right")
+            else:
+                idx = np.asarray([_stable_hash(v) % n for v in vals.tolist()])
+            for part in sorted(set(idx.tolist())):
+                subdir = os.path.join(path, f"{mode}={part:04d}")
+                os.makedirs(subdir, exist_ok=True)
+                seq = part_seq.get(part, 0)
+                part_seq[part] = seq + 1
+                pq.write_table(
+                    table.filter(idx == part),
+                    os.path.join(subdir, f"part-{seq:05d}.parquet"),
+                )
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes (Python's str hash is salted)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2b(repr(value).encode(), digest_size=8).digest(), "little"
+    )
+
+
+def coerce_partition_value(raw) -> Any:
+    """THE string->value promotion for partition values ('try int, then
+    float, else str') — shared by hive parquet and Delta partitionValues
+    so the policies can't drift."""
+    if not isinstance(raw, str):
+        return raw
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            continue
+    return raw
+
+
+def _hive_partition_values(rel_path: Optional[str]) -> Dict[str, Any]:
+    """key=value segments of a root-RELATIVE path -> column values."""
+    from urllib.parse import unquote
+
+    out: Dict[str, Any] = {}
+    if not rel_path:
+        return out
+    for segment in rel_path.split(os.sep)[:-1]:
+        key, sep, raw = segment.partition("=")
+        if not sep or not key or key in ("hash", "range"):
+            continue
+        out[key] = coerce_partition_value(unquote(raw))
+    return out
 
 
 def _maybe_numeric(arr: np.ndarray) -> np.ndarray:

@@ -1,0 +1,348 @@
+"""Lakehouse-format datasources: Delta Lake, Lance, Iceberg.
+
+Parity targets: ``python/ray/data/datasource/delta_sharing_datasource.py``
+/ ``lance_datasource.py`` / ``iceberg_datasource.py``.
+
+Delta is implemented NATIVELY (no ``deltalake`` dependency): the table's
+``_delta_log/NNN.json`` commits are replayed to the set of live data files
+(add/remove actions, latest ``metaData`` for partition columns), which then
+read through the parquet machinery; ``write_delta`` emits the same commit
+protocol, so the round trip is byte-compatible with real Delta readers for
+unpartitioned/hive-partitioned JSON-commit tables (parquet checkpoints are
+folded in when present).  Lance and Iceberg bind to their native libraries
+when installed and fail with an actionable ImportError otherwise (the
+image gates optional deps — SURVEY env rules).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.datasource import Datasource, ReadTask
+
+
+# ==========================================================================
+# Delta Lake (native log replay)
+# ==========================================================================
+def _delta_live_files(table_path: str) -> List[dict]:
+    """Replay _delta_log into the live ``add`` actions (path,
+    partitionValues).  Checkpoint parquet files are folded in when present
+    (their rows carry the same add/remove structure)."""
+    log_dir = os.path.join(table_path, "_delta_log")
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"{table_path!r} is not a Delta table (no _delta_log)")
+    entries = sorted(os.listdir(log_dir))
+    checkpoint_version = -1
+    adds: Dict[str, dict] = {}
+    # multi-part / v2 checkpoints are not replayed here: reading a SUBSET
+    # of the log silently loses data, so refuse loudly instead
+    unsupported = [e for e in entries if ".checkpoint." in e and not e.endswith(".checkpoint.parquet")]
+    if unsupported:
+        raise NotImplementedError(
+            f"unsupported Delta checkpoint format in {log_dir}: {unsupported[0]!r} "
+            "(multi-part/v2 checkpoints are not supported by the native reader)"
+        )
+    # newest checkpoint seeds the state; later JSON commits replay on top
+    checkpoints = [e for e in entries if e.endswith(".checkpoint.parquet")]
+    if checkpoints:
+        import pyarrow.parquet as pq
+
+        latest = checkpoints[-1]
+        checkpoint_version = int(latest.split(".")[0])
+        table = pq.read_table(os.path.join(log_dir, latest))
+        for row in table.to_pylist():
+            add = row.get("add")
+            if add and add.get("path"):
+                adds[add["path"]] = add
+            remove = row.get("remove")
+            if remove and remove.get("path"):
+                adds.pop(remove["path"], None)
+    for entry in entries:
+        if not entry.endswith(".json"):
+            continue
+        try:
+            version = int(entry.split(".")[0])
+        except ValueError:
+            continue
+        if version <= checkpoint_version:
+            continue
+        with open(os.path.join(log_dir, entry)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                action = json.loads(line)
+                add = action.get("add")
+                if add and add.get("path"):
+                    adds[add["path"]] = add
+                remove = action.get("remove")
+                if remove and remove.get("path"):
+                    adds.pop(remove["path"], None)
+    return list(adds.values())
+
+
+class DeltaDatasource(Datasource):
+    """Read a Delta table by replaying its transaction log (module
+    docstring); each live file becomes a parquet read task with its
+    partitionValues restored as constant columns."""
+
+    def __init__(self, table_path: str, columns: Optional[List[str]] = None):
+        self.table_path = table_path
+        self.columns = list(columns) if columns else None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        adds = _delta_live_files(self.table_path)
+        tasks: List[ReadTask] = []
+        for add in adds:
+            file_path = os.path.join(self.table_path, add["path"])
+            partition_values = add.get("partitionValues") or {}
+            columns = self.columns
+
+            def make(file_path=file_path, partition_values=partition_values, columns=columns):
+                from ray_tpu.data.datasource import coerce_partition_value
+
+                table = pq.read_table(
+                    file_path,
+                    columns=[c for c in columns if c not in partition_values] if columns else None,
+                )
+                for key, raw in partition_values.items():
+                    if columns is not None and key not in columns:
+                        continue
+                    value = coerce_partition_value(raw)
+                    table = table.append_column(key, pa.array([value] * table.num_rows))
+                yield BlockAccessor.for_block(table).to_block()
+
+            size = os.path.getsize(file_path) if os.path.exists(file_path) else add.get("size", 0)
+            tasks.append(ReadTask(make, BlockMetadata(num_rows=-1, size_bytes=size, input_files=[file_path])))
+        if not tasks:
+            def empty():
+                yield {}
+
+            tasks.append(ReadTask(empty, BlockMetadata(num_rows=0, size_bytes=0)))
+        return tasks
+
+
+def _spark_schema(blocks: List[Block]) -> dict:
+    """Arrow schema of the first block -> Spark struct-schema JSON."""
+    import pyarrow as pa
+
+    fields = []
+    if blocks:
+        schema = BlockAccessor(blocks[0]).to_arrow().schema
+        for field in schema:
+            t = field.type
+            if pa.types.is_int64(t):
+                name = "long"
+            elif pa.types.is_integer(t):
+                name = "integer"
+            elif pa.types.is_float64(t):
+                name = "double"
+            elif pa.types.is_floating(t):
+                name = "float"
+            elif pa.types.is_boolean(t):
+                name = "boolean"
+            elif pa.types.is_binary(t) or pa.types.is_large_binary(t):
+                name = "binary"
+            elif pa.types.is_timestamp(t):
+                name = "timestamp"
+            elif pa.types.is_date(t):
+                name = "date"
+            else:
+                name = "string"
+            fields.append(
+                {"name": field.name, "type": name, "nullable": bool(field.nullable), "metadata": {}}
+            )
+    return {"type": "struct", "fields": fields}
+
+
+def write_delta_blocks(blocks: List[Block], table_path: str, mode: str = "append") -> None:
+    """Emit parquet part files + a Delta JSON commit (protocol/metaData on
+    the first commit).  ``mode``: append | overwrite (overwrite removes the
+    previously-live files in the same commit)."""
+    import pyarrow.parquet as pq
+
+    log_dir = os.path.join(table_path, "_delta_log")
+    os.makedirs(log_dir, exist_ok=True)
+    existing = sorted(e for e in os.listdir(log_dir) if e.endswith(".json"))
+    version = int(existing[-1].split(".")[0]) + 1 if existing else 0
+
+    actions: List[dict] = []
+    now_ms = int(time.time() * 1000)
+    if version == 0:
+        actions.append({"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}})
+        actions.append(
+            {
+                "metaData": {
+                    "id": str(uuid.uuid4()),
+                    "format": {"provider": "parquet", "options": {}},
+                    # a REAL Spark-schema document (deltalake/Spark readers
+                    # parse this; "{}" would fail them)
+                    "schemaString": json.dumps(_spark_schema(blocks)),
+                    "partitionColumns": [],
+                    "configuration": {},
+                    "createdTime": now_ms,
+                }
+            }
+        )
+    if mode == "overwrite" and version > 0:
+        for add in _delta_live_files(table_path):
+            actions.append(
+                {"remove": {"path": add["path"], "deletionTimestamp": now_ms, "dataChange": True}}
+            )
+    for block in blocks:
+        table = BlockAccessor(block).to_arrow()
+        name = f"part-{version:05d}-{uuid.uuid4().hex[:12]}.parquet"
+        pq.write_table(table, os.path.join(table_path, name))
+        actions.append(
+            {
+                "add": {
+                    "path": name,
+                    "partitionValues": {},
+                    "size": os.path.getsize(os.path.join(table_path, name)),
+                    "modificationTime": now_ms,
+                    "dataChange": True,
+                }
+            }
+        )
+    commit = os.path.join(log_dir, f"{version:020d}.json")
+    tmp = commit + ".tmp"
+    with open(tmp, "w") as f:
+        for action in actions:
+            f.write(json.dumps(action) + "\n")
+    os.replace(tmp, commit)
+
+
+class DeltaWriteDatasource(Datasource):
+    """Write side used by ``Dataset.write_delta``."""
+
+    def __init__(self, mode: str = "append"):
+        self.mode = mode
+
+    def write(self, blocks: List[Block], path: str, *, mode: Optional[str] = None, **kw) -> None:
+        write_delta_blocks(blocks, path, mode=mode or self.mode)
+
+
+# ==========================================================================
+# Lance (native library, gated)
+# ==========================================================================
+def _require(module: str, feature: str):
+    try:
+        return __import__(module)
+    except ImportError as exc:
+        raise ImportError(
+            f"{feature} requires the {module!r} package, which is not installed "
+            f"in this environment (pip install {module})"
+        ) from exc
+
+
+class LanceDatasource(Datasource):
+    """Read a Lance dataset fragment-parallel (parity:
+    ``lance_datasource.py``)."""
+
+    def __init__(self, uri: str, columns: Optional[List[str]] = None, filter: Optional[str] = None):
+        self.uri = uri
+        self.columns = columns
+        self.filter = filter
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        lance = _require("lance", "read_lance")
+        ds = lance.dataset(self.uri)
+        tasks: List[ReadTask] = []
+        for fragment in ds.get_fragments():
+            columns, filt = self.columns, self.filter
+
+            def make(fragment=fragment, columns=columns, filt=filt):
+                table = fragment.to_table(columns=columns, filter=filt)
+                yield BlockAccessor.for_block(table).to_block()
+
+            tasks.append(
+                ReadTask(make, BlockMetadata(num_rows=fragment.count_rows(), size_bytes=-1))
+            )
+        return tasks or [ReadTask(lambda: iter(({},)), BlockMetadata(num_rows=0, size_bytes=0))]
+
+
+def write_lance_blocks(blocks: List[Block], uri: str, mode: str = "create") -> None:
+    lance = _require("lance", "write_lance")
+    import pyarrow as pa
+
+    tables = [BlockAccessor(b).to_arrow() for b in blocks]
+    combined = pa.concat_tables(tables) if tables else pa.table({})
+    lance.write_dataset(combined, uri, mode=mode)
+
+
+class LanceWriteDatasource(Datasource):
+    def __init__(self, mode: str = "create"):
+        self.mode = mode
+
+    def write(self, blocks: List[Block], path: str, *, mode: Optional[str] = None, **kw) -> None:
+        write_lance_blocks(blocks, path, mode=mode or self.mode)
+
+
+# ==========================================================================
+# Iceberg (pyiceberg, gated)
+# ==========================================================================
+class IcebergDatasource(Datasource):
+    """Read an Iceberg table via pyiceberg's scan planning (parity:
+    ``iceberg_datasource.py`` — one read task per plan file)."""
+
+    def __init__(
+        self, table_identifier: str, *, catalog_kwargs: Optional[dict] = None,
+        row_filter: Optional[str] = None, selected_fields: Optional[List[str]] = None,
+    ):
+        self.table_identifier = table_identifier
+        self.catalog_kwargs = dict(catalog_kwargs or {})
+        self.row_filter = row_filter
+        self.selected_fields = selected_fields
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        _require("pyiceberg", "read_iceberg")
+        from pyiceberg.catalog import load_catalog
+
+        catalog = load_catalog(**self.catalog_kwargs)
+        table = catalog.load_table(self.table_identifier)
+        scan_kwargs: Dict[str, Any] = {}
+        if self.row_filter is not None:
+            scan_kwargs["row_filter"] = self.row_filter
+        if self.selected_fields is not None:
+            scan_kwargs["selected_fields"] = tuple(self.selected_fields)
+        scan = table.scan(**scan_kwargs)
+        plan_files = list(scan.plan_files())
+        has_deletes = any(getattr(pf, "delete_files", None) for pf in plan_files)
+        if self.row_filter is not None or has_deletes:
+            # residual row filters and positional/equality deletes need
+            # Iceberg's own evaluation — one task through scan.to_arrow()
+            # is CORRECT, per-file raw parquet reads would not be
+            def make_scan(scan=scan):
+                yield BlockAccessor.for_block(scan.to_arrow()).to_block()
+
+            return [ReadTask(make_scan, BlockMetadata(num_rows=-1, size_bytes=-1))]
+        tasks: List[ReadTask] = []
+        selected = self.selected_fields
+        for plan_file in plan_files:
+            def make(plan_file=plan_file, selected=selected):
+                import pyarrow.parquet as pq
+
+                table = pq.read_table(
+                    plan_file.file.file_path.replace("file://", ""),
+                    columns=list(selected) if selected else None,
+                )
+                yield BlockAccessor.for_block(table).to_block()
+
+            tasks.append(
+                ReadTask(
+                    make,
+                    BlockMetadata(
+                        num_rows=plan_file.file.record_count,
+                        size_bytes=plan_file.file.file_size_in_bytes,
+                    ),
+                )
+            )
+        return tasks or [ReadTask(lambda: iter(({},)), BlockMetadata(num_rows=0, size_bytes=0))]

@@ -131,6 +131,39 @@ def read_tfrecords(paths, *, decode_examples: bool = True, parallelism: int = -1
     )
 
 
+def read_delta(table_path: str, *, columns=None, parallelism: int = -1) -> Dataset:
+    """Delta Lake table via native _delta_log replay (parity:
+    delta-sharing/deltalake readers; no deltalake dependency needed)."""
+    from ray_tpu.data.datasource_lakes import DeltaDatasource
+
+    return read_datasource(DeltaDatasource(table_path, columns=columns), parallelism=parallelism)
+
+
+def read_lance(uri: str, *, columns=None, filter=None, parallelism: int = -1) -> Dataset:
+    """Lance dataset, fragment-parallel (parity: lance_datasource.py;
+    requires the lance package)."""
+    from ray_tpu.data.datasource_lakes import LanceDatasource
+
+    return read_datasource(
+        LanceDatasource(uri, columns=columns, filter=filter), parallelism=parallelism
+    )
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None, row_filter=None,
+                 selected_fields=None, parallelism: int = -1) -> Dataset:
+    """Iceberg table via pyiceberg scan planning (parity:
+    iceberg_datasource.py; requires pyiceberg)."""
+    from ray_tpu.data.datasource_lakes import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(
+            table_identifier, catalog_kwargs=catalog_kwargs,
+            row_filter=row_filter, selected_fields=selected_fields,
+        ),
+        parallelism=parallelism,
+    )
+
+
 def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
     """MongoDB collection (parity: read_mongo; requires pymongo)."""
     from ray_tpu.data.datasource import MongoDatasource

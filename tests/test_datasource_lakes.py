@@ -1,0 +1,159 @@
+"""Lakehouse datasources + partitioned parquet writes (round-3 VERDICT 9).
+
+Delta round-trips natively (log replay, no deltalake dependency); Lance and
+Iceberg gate on their libraries (skipped when absent, with the ImportError
+message asserted).  Partitioned parquet writes cover hive / hash / range.
+
+Parity anchors: python/ray/data/datasource/{delta_sharing,lance,iceberg}
+_datasource.py and parquet partitioning.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.data import read_api
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def _make_ds(n=100):
+    from ray_tpu.data import read_api as ra
+
+    return ra.range(n).map(lambda row: {"id": row["id"], "bucket": int(row["id"] % 4)})
+
+
+# ---------------------------------------------------------------- delta
+def test_delta_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "delta_table")
+    ds = _make_ds(50)
+    ds.write_delta(path)
+    # the on-disk table is a real Delta layout
+    assert os.path.isdir(os.path.join(path, "_delta_log"))
+    commits = os.listdir(os.path.join(path, "_delta_log"))
+    assert any(c.endswith(".json") for c in commits)
+
+    back = read_api.read_delta(path)
+    rows = sorted(r["id"] for r in back.take_all())
+    assert rows == list(range(50))
+
+
+def test_delta_append_and_overwrite(tmp_path):
+    path = str(tmp_path / "delta_table")
+    _make_ds(10).write_delta(path)
+    _make_ds(10).write_delta(path, mode="append")
+    assert len(read_api.read_delta(path).take_all()) == 20
+
+    _make_ds(5).write_delta(path, mode="overwrite")
+    rows = read_api.read_delta(path).take_all()
+    assert sorted(r["id"] for r in rows) == list(range(5))
+    # overwritten files are tombstoned in the log, not deleted from disk
+    log = os.path.join(path, "_delta_log")
+    removes = []
+    for commit in sorted(os.listdir(log)):
+        if commit.endswith(".json"):
+            with open(os.path.join(log, commit)) as f:
+                removes += [json.loads(l) for l in f if '"remove"' in l]
+    assert removes, "overwrite must emit remove actions"
+
+
+def test_delta_column_projection(tmp_path):
+    path = str(tmp_path / "delta_table")
+    _make_ds(20).write_delta(path)
+    rows = read_api.read_delta(path, columns=["bucket"]).take_all()
+    assert set(rows[0].keys()) == {"bucket"}
+
+
+def test_delta_rejects_non_table(tmp_path):
+    with pytest.raises(Exception):
+        read_api.read_delta(str(tmp_path / "nope")).take_all()
+
+
+# ---------------------------------------------------------------- lance / iceberg gating
+def test_lance_gated_or_roundtrip(tmp_path):
+    try:
+        import lance  # noqa: F401
+
+        have = True
+    except ImportError:
+        have = False
+    if not have:
+        with pytest.raises(ImportError, match="lance"):
+            read_api.read_lance(str(tmp_path / "t.lance")).take_all()
+        with pytest.raises(ImportError, match="lance"):
+            _make_ds(5).write_lance(str(tmp_path / "t.lance"))
+        return
+    path = str(tmp_path / "t.lance")
+    _make_ds(30).write_lance(path)
+    rows = sorted(r["id"] for r in read_api.read_lance(path).take_all())
+    assert rows == list(range(30))
+
+
+def test_iceberg_gated():
+    try:
+        import pyiceberg  # noqa: F401
+
+        pytest.skip("pyiceberg installed; gating path not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="pyiceberg"):
+        read_api.read_iceberg("db.table").take_all()
+
+
+# ------------------------------------------------- partitioned parquet
+def test_hive_partitioned_parquet_roundtrip(tmp_path):
+    path = str(tmp_path / "hive")
+    _make_ds(40).write_parquet(path, partition_cols=["bucket"])
+    # hive layout on disk
+    subdirs = sorted(d for d in os.listdir(path) if d.startswith("bucket="))
+    assert subdirs == ["bucket=0", "bucket=1", "bucket=2", "bucket=3"]
+    # partition values come back as columns
+    rows = read_api.read_parquet(path).take_all()
+    assert len(rows) == 40
+    assert all(r["bucket"] == r["id"] % 4 for r in rows)
+
+
+def test_hash_partitioned_parquet_write(tmp_path):
+    path = str(tmp_path / "hashed")
+    _make_ds(64).write_parquet(
+        path, partition_by={"column": "id", "mode": "hash", "num_partitions": 4}
+    )
+    spec = json.load(open(os.path.join(path, "_partition_spec.json")))
+    assert spec["mode"] == "hash" and spec["num_partitions"] == 4
+    parts = sorted(d for d in os.listdir(path) if d.startswith("hash="))
+    assert 1 < len(parts) <= 4
+    rows = read_api.read_parquet(path).take_all()
+    assert sorted(r["id"] for r in rows) == list(range(64))
+
+
+def test_range_partitioned_parquet_write_is_ordered(tmp_path):
+    path = str(tmp_path / "ranged")
+    _make_ds(100).write_parquet(
+        path, partition_by={"column": "id", "mode": "range", "num_partitions": 4}
+    )
+    spec = json.load(open(os.path.join(path, "_partition_spec.json")))
+    assert spec["mode"] == "range" and len(spec["bounds"]) == 3
+    import pyarrow.parquet as pq
+
+    parts = sorted(d for d in os.listdir(path) if d.startswith("range="))
+    assert len(parts) == 4
+    maxes = []
+    for part in parts:
+        vals = []
+        for f in os.listdir(os.path.join(path, part)):
+            vals += pq.read_table(os.path.join(path, part, f))["id"].to_pylist()
+        assert vals, part
+        maxes.append((min(vals), max(vals)))
+    # ranges are disjoint and ordered
+    for (lo1, hi1), (lo2, hi2) in zip(maxes, maxes[1:]):
+        assert hi1 <= lo2
+    rows = read_api.read_parquet(path).take_all()
+    assert sorted(r["id"] for r in rows) == list(range(100))
