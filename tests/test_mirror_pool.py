@@ -1,0 +1,114 @@
+"""MirrorPool consistency under concurrent head placements + agent-local
+dispatch (round-2 VERDICT weak #6).
+
+The head schedules against a MirrorPool (its view of the agent's pool,
+echoed one-way) while the agent's local scheduler acquires concurrently;
+periodic resource reports reconcile drift.  These tests drive both sides
+at once and assert the invariant that matters: the agent's REAL capacity
+is never oversubscribed (measured by actual task-execution overlap), and
+the views converge after quiescence (ray_syncer's versioned-view role,
+reference ray_syncer.h:88).
+"""
+
+import os
+import threading
+import time
+
+import ray_tpu as rt
+
+from test_multihost import _spawn_agent, _wait_for_nodes, two_process_cluster  # noqa: F401
+
+
+def test_no_oversubscription_under_concurrent_placement(two_process_cluster, tmp_path):
+    cluster, proc = two_process_cluster  # agent: CPU=2, remote=4
+    log_path = str(tmp_path / "overlap.log")
+    open(log_path, "w").close()
+
+    @rt.remote(resources={"remote": 1})
+    def work(i, log_path):
+        import os
+        import time as _t
+
+        # O_APPEND single-write records are atomic at this size
+        with open(log_path, "a") as f:
+            f.write(f"s {_t.time():.6f}\n")
+            f.flush()
+        _t.sleep(0.05)
+        with open(log_path, "a") as f:
+            f.write(f"e {_t.time():.6f}\n")
+            f.flush()
+        return i
+
+    results = []
+    errors = []
+
+    def submit_tasks():
+        try:
+            for wave in range(5):
+                refs = [work.remote(wave * 8 + i, log_path) for i in range(8)]
+                results.extend(rt.get(refs, timeout=120))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def churn_placement_groups():
+        from ray_tpu.util.placement import placement_group, remove_placement_group
+
+        try:
+            for _ in range(15):
+                pg = placement_group([{"remote": 1.0}], strategy="PACK")
+                pg.wait(timeout_seconds=30)
+                remove_placement_group(pg)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=submit_tasks),
+        threading.Thread(target=churn_placement_groups),
+        threading.Thread(target=churn_placement_groups),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    assert sorted(results) == list(range(40))
+
+    # actual concurrency on the agent never exceeded its remote capacity
+    events = []
+    with open(log_path) as f:
+        for line in f:
+            kind, ts = line.split()
+            events.append((float(ts), 1 if kind == "s" else -1))
+    events.sort()
+    load = max_load = 0
+    for _ts, delta in events:
+        load += delta
+        max_load = max(max_load, load)
+    assert 1 <= max_load <= 4, f"oversubscribed: {max_load} concurrent > capacity 4"
+
+
+def test_views_reconcile_after_quiescence(two_process_cluster):
+    """After the churn stops, the head's mirror converges to the agent's
+    authoritative pool (periodic resource_report reconcile)."""
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1})
+    def touch():
+        return 1
+
+    assert sum(rt.get([touch.remote() for _ in range(12)], timeout=120)) == 12
+
+    handle = next(
+        n for nid, n in cluster.nodes.items()
+        if nid != cluster.head_node.node_id and not n.dead
+    )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        avail = handle.pool.available.to_dict()
+        total = handle.pool.total.to_dict()
+        if avail == total:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"mirror never reconciled to full availability: {avail} != {total}"
+    )
