@@ -190,9 +190,43 @@ class AgentFabric:
         )
 
     def on_stream_item(self, node, spec, index: int, value, is_error: bool = False) -> None:
+        enc = None
+        if not is_error and self.data_client is not None:
+            from ray_tpu.core.config import get_config
+            from ray_tpu.core.ids import ObjectID as _OID
+            from ray_tpu.runtime.remote_node import _probe_nbytes
+
+            threshold = get_config().data_plane_inline_bytes
+            # cheap metadata probe; unknown types encode ONCE and route on
+            # the encoded size (this is a per-item hot path — never pickle
+            # twice)
+            approx, fully_known = _probe_nbytes(value)
+            bulk = approx > threshold
+            if not fully_known and not bulk:
+                enc = rpc.encode_value(value, is_error)
+                bulk = len(enc["value_blob"]) > threshold
+            if bulk:
+                # bulk stream item (shuffle blocks, batches): store locally
+                # under its deterministic item oid and send metadata only —
+                # consumers pull peer-to-peer on demand
+                from ray_tpu.runtime.device_plane import is_device_array
+
+                oid = _OID.for_task_return(spec.task_id, index + 1)
+                node.store.put(oid, value)
+                self.conn.send(
+                    "stream_item",
+                    {
+                        "task_id": spec.task_id.binary(), "index": index,
+                        "lazy": True, "device": is_device_array(value),
+                    },
+                )
+                return
         self.conn.send(
             "stream_item",
-            {"task_id": spec.task_id.binary(), "index": index, "value": rpc.encode_value(value, is_error)},
+            {
+                "task_id": spec.task_id.binary(), "index": index,
+                "value": enc if enc is not None else rpc.encode_value(value, is_error),
+            },
         )
 
     def on_stream_done(self, node, spec, index: int, error) -> None:
@@ -282,6 +316,7 @@ class NodeAgent:
         # this node, so registration must be the last step.
         self.node_id = NodeID.from_random()
         reply = self.conn.request("register_node_config", {})
+        self._check_protocol(reply)
         self._adopt_config(reply)
         from ray_tpu.core.config import get_config
 
@@ -401,6 +436,12 @@ class NodeAgent:
                     file=sys.stderr,
                 )
                 return
+            except rpc.ProtocolMismatchError as exc:
+                # PERMANENT: a restarted head with a different wire version
+                # will never accept us — fail fast with the diagnostic
+                # instead of hammering it for the whole window
+                print(f"ray_tpu agent: {exc}", file=sys.stderr)
+                break
             except (OSError, rpc.RpcError):
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 5.0)
@@ -419,6 +460,7 @@ class NodeAgent:
         )
         try:
             reply = conn.request("register_node_config", {})
+            self._check_protocol(reply)
             self._adopt_config(reply)
             # the data server survived; the reachable IP may differ on a new
             # connection (multi-NIC), recompute the advertisement
@@ -451,6 +493,18 @@ class NodeAgent:
             target=self._report_loop, args=(conn,), name="agent-report", daemon=True
         ).start()
 
+    def _check_protocol(self, reply: dict) -> None:
+        """Same-version-everywhere is the pickle-frame contract — verify it
+        EXPLICITLY instead of corrupting silently (reference: versioned
+        protobuf schemas play this role)."""
+        head_version = reply.get("protocol_version")
+        if head_version is not None and head_version != rpc.PROTOCOL_VERSION:
+            raise rpc.ProtocolMismatchError(
+                f"protocol version mismatch: head speaks v{head_version}, "
+                f"this agent speaks v{rpc.PROTOCOL_VERSION} — upgrade the "
+                "older side; mixed-version clusters are not supported"
+            )
+
     def _adopt_config(self, reply: dict) -> None:
         """Adopt the (possibly restarted) head's config so thresholds and
         timeouts agree cluster-wide (node.py:1377-1392 parity)."""
@@ -478,6 +532,7 @@ class NodeAgent:
         return {
             "submit_task": self._h_submit_task,
             "submit_actor_task": self._h_submit_actor_task,
+            "submit_actor_task_batch": self._h_submit_actor_task_batch,
             "create_actor": self._h_create_actor,
             "kill_actor": self._h_kill_actor,
             "cancel_task": self._h_cancel_task,
@@ -499,6 +554,11 @@ class NodeAgent:
 
     def _h_submit_actor_task(self, conn, payload) -> None:
         self.node.submit_actor_task(self._decode(payload))
+
+    def _h_submit_actor_task_batch(self, conn, payload) -> None:
+        specs = [self._decode({"spec": enc}) for enc in payload["specs"]]
+        # same-actor batches cascade into one worker IPC frame downstream
+        self.node.submit_actor_task_batch(specs)
 
     def _h_create_actor(self, conn, payload) -> None:
         spec = self._decode(payload)

@@ -752,8 +752,10 @@ class Cluster:
 
     def on_stream_item(
         self, node: Node, spec: TaskSpec, index: int, value: Any,
-        is_error: bool = False, _force: bool = False,
-    ) -> None:
+        is_error: bool = False, _force: bool = False, lazy: bool = False,
+    ) -> Optional[bool]:
+        """Returns False when the commit was DROPPED (force-closed stream) —
+        remote callers use it to free a lazily-staged copy on the agent."""
         # the lock makes check-flag -> commit atomic against force-close:
         # without it a producer that passed the flag check could overwrite
         # the force-committed error object (same ObjectID index)
@@ -762,13 +764,22 @@ class Cluster:
                 # stream force-closed (node death / infeasibility) while the
                 # producer thread was still running: late items must not
                 # overwrite the committed error object or reopen the stream
-                return
+                return False
             oid = ObjectID.for_task_return(spec.task_id, index + 1)
             if self.core_worker is not None:
                 self.core_worker.ref_counter.add_owned_object(oid)
-            store_node = self.head_node if node.dead else node
-            store_node.store.put(oid, value, is_error=is_error)
-            self.directory.add_location(oid, store_node.node_id)
+            if lazy:
+                # bulk item: the bytes stayed in the producing node's store;
+                # commit the location only (consumers pull peer-to-peer)
+                if node.dead:
+                    self.head_node.store.put(oid, ObjectLostError(oid), is_error=True)
+                    self.directory.add_location(oid, self.head_node.node_id)
+                else:
+                    self.directory.add_location(oid, node.node_id)
+            else:
+                store_node = self.head_node if node.dead else node
+                store_node.store.put(oid, value, is_error=is_error)
+                self.directory.add_location(oid, store_node.node_id)
             spec.return_ids.append(oid)
             gen = self._streams.get(spec.task_id.binary())
             if gen is not None:
@@ -1068,7 +1079,13 @@ class Cluster:
                     break
                 failed = False
                 if batch_submit is not None and len(batch) > 1:
-                    batch_submit([e[0] for e in batch])  # local: never raises
+                    try:
+                        # one frame, all-or-nothing (remote handles raise
+                        # BEFORE anything is sent)
+                        batch_submit([e[0] for e in batch])
+                    except ConnectionError:
+                        q.pending.extendleft(reversed(batch))
+                        failed = True
                 else:
                     for i, entry in enumerate(batch):
                         try:

@@ -352,6 +352,23 @@ class RemoteNodeHandle:
             self._untrack(spec.task_id.binary())
             raise
 
+    def submit_actor_task_batch(self, specs) -> None:
+        """k queued calls in ONE control frame (atomic: the frame either
+        sends whole or not at all — a ConnectionError means none reached
+        the agent and the caller requeues everything)."""
+        for spec in specs:
+            spec.owner_node = self.node_id
+            self._track(spec)
+        try:
+            self._send(
+                "submit_actor_task_batch",
+                {"specs": [self._encode(spec) for spec in specs]},
+            )
+        except rpc.RpcError:
+            for spec in specs:
+                self._untrack(spec.task_id.binary())
+            raise
+
     def kill_actor(self, actor_id: ActorID, restart: bool = False) -> None:
         if self.dead:
             return
@@ -411,8 +428,40 @@ class RemoteNodeHandle:
         self.cluster.on_task_finished(self, spec, result, error)
 
     def on_stream_item_msg(self, payload: dict) -> None:
+        from ray_tpu.core.ids import TaskID
+
         spec = self._lookup(payload["task_id"])
         if spec is None:
+            if payload.get("lazy"):
+                # the task already resolved head-side: the agent staged the
+                # bulk item for nothing — free it or it pins store memory
+                # for the agent's lifetime
+                oid = ObjectID.for_task_return(
+                    TaskID(payload["task_id"]), payload["index"] + 1
+                )
+                try:
+                    self._send("delete_object", {"oid": oid.binary()})
+                except rpc.RpcError:
+                    pass
+            return
+        if payload.get("lazy"):
+            # bulk item stayed on the agent: location-only commit
+            if payload.get("device"):
+                self.cluster.directory.mark_device(
+                    ObjectID.for_task_return(TaskID(payload["task_id"]), payload["index"] + 1)
+                )
+            committed = self.cluster.on_stream_item(
+                self, spec, payload["index"], None, lazy=True
+            )
+            if committed is False:
+                # force-closed stream dropped the commit: free the staged copy
+                oid = ObjectID.for_task_return(
+                    TaskID(payload["task_id"]), payload["index"] + 1
+                )
+                try:
+                    self._send("delete_object", {"oid": oid.binary()})
+                except rpc.RpcError:
+                    pass
             return
         value, is_error = rpc.decode_value(payload["value"])
         self.cluster.on_stream_item(self, spec, payload["index"], value, is_error=is_error)
@@ -599,6 +648,7 @@ class HeadService:
 
         return {
             "config": dataclasses.asdict(get_config()),
+            "protocol_version": rpc.PROTOCOL_VERSION,
             # composed per-connection: the head's data endpoint at the IP
             # THIS agent reached the head on (never a bind-side 0.0.0.0)
             "data_address": f"{conn.local_ip}:{self.data_server.port}",

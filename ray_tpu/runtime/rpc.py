@@ -40,6 +40,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("<I")
 
+#: Wire-protocol version: bumped on any incompatible change to message
+#: shapes (the reference versions its protobuf schemas; pickle frames
+#: assume same-version-everywhere, so the version is checked EXPLICITLY at
+#: node registration instead of silently corrupting).
+PROTOCOL_VERSION = 3
+
 #: Sentinel a handler returns to take ownership of replying later.
 DEFER = object()
 
@@ -50,6 +56,11 @@ class RpcError(ConnectionError):
 
 class RemoteHandlerError(RpcError):
     """The peer's handler raised; carries the remote traceback."""
+
+
+class ProtocolMismatchError(RpcError):
+    """Peer speaks a different wire-protocol version — permanent, never
+    retried (reconnect loops fail fast with the diagnostic)."""
 
 
 class RpcConnection:
