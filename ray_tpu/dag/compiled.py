@@ -109,7 +109,10 @@ class CompiledDAG:
             raylet = cluster.nodes.get(info.node_id)
             if raylet is None:
                 continue
-            inst = raylet.actors.get(actor_id)
+            # a REMOTE node (agent process) has no in-proc actor instances:
+            # those calls take the normal submit path — which still rides
+            # one batched control frame + the peer data plane for bulk args
+            inst = getattr(raylet, "actors", {}).get(actor_id)
             if inst is not None and inst.mode == "inproc":
                 self._direct_actors[id(node)] = inst
             # else: process actor — node falls back to the queued call path

@@ -70,6 +70,41 @@ def test_batched_actor_dispatch_preserves_order(two_process_cluster):
     assert rt.get(s.get_log.remote(), timeout=60) == list(range(60))
 
 
+def test_compiled_dag_with_remote_actor(two_process_cluster):
+    """Compiled DAGs span OS processes: a stage actor living in the agent
+    executes through the compiled schedule (bulk intermediates ride the
+    data plane via the normal call path)."""
+    from ray_tpu.dag import InputNode
+
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1}, execution="thread")
+    class Scale:
+        def apply(self, x):
+            return x * 2.0
+
+    @rt.remote
+    class Bias:
+        def apply(self, x):
+            return x + 1.0
+
+    remote_actor = Scale.remote()
+    local_actor = Bias.remote()
+    rt.get([remote_actor.apply.remote(np.float64(0)), local_actor.apply.remote(np.float64(0))], timeout=60)
+
+    with InputNode() as inp:
+        mid = remote_actor.apply.bind(inp)     # executes in the agent process
+        out = local_actor.apply.bind(mid)      # executes in the driver
+    dag = out.experimental_compile()
+    try:
+        for i in range(3):
+            x = np.full(400_000, float(i))     # 3.2MB: crosses via data plane
+            result = dag.execute(x)
+            assert float(result[0]) == i * 2.0 + 1.0
+    finally:
+        dag.teardown()
+
+
 def test_protocol_version_mismatch_rejected():
     from ray_tpu.runtime.agent import NodeAgent
 
