@@ -207,7 +207,26 @@ class ObjectStore:
                 fut.set_exception(error)
 
     # ---------------------------------------------------------------- spill
+    #: optional memory-pressure hook (wired by the runtime to the reference
+    #: counter's synchronous drain): dead refs awaiting the GC drainer
+    #: thread must FREE, not SPILL — plasma's evict-after-refcount ordering
+    pressure_callback = None
+
     def _maybe_spill(self) -> None:
+        with self._lock:
+            over = (
+                self._hbm_used > self._hbm_budget
+                or self._host_used > self._host_budget
+            )
+        if over and self.pressure_callback is not None:
+            try:
+                # apply pending out-of-scope deletions before copying
+                # anything out: a tight put loop outruns the deferred-decref
+                # drainer on small hosts, and spilling already-dead objects
+                # costs GB-scale memcpys for nothing
+                self.pressure_callback()
+            except Exception:  # noqa: BLE001 — pressure relief is best-effort
+                pass
         with self._lock:
             if self._hbm_used > self._hbm_budget:
                 self._spill_device_locked(self._hbm_used - self._hbm_budget)

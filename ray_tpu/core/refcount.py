@@ -77,23 +77,49 @@ class ReferenceCounter:
         self._deferred.append(object_id)
         self._deferred_event.set()
 
+    def _apply_pending(self) -> int:
+        """Apply every queued __del__ decrement; returns how many."""
+        n = 0
+        while True:
+            try:
+                oid = self._deferred.popleft()
+            except IndexError:
+                return n
+            try:
+                self.remove_local_reference(oid)
+                n += 1
+            except Exception:  # noqa: BLE001
+                pass
+
     def _drain_loop(self) -> None:
         while not self._drainer_stop:
             self._deferred_event.wait(timeout=0.5)
             self._deferred_event.clear()
-            while True:
-                try:
-                    oid = self._deferred.popleft()
-                except IndexError:
-                    break
-                try:
-                    self.remove_local_reference(oid)
-                except Exception:
-                    pass
+            self._apply_pending()
 
     def stop(self) -> None:
         self._drainer_stop = True
         self._deferred_event.set()
+
+    def drain_deferred(self) -> int:
+        """Synchronously apply queued __del__ decrements (memory-pressure
+        path: the store calls this before spilling so dead objects FREE
+        instead of paying a spill copy).  A full gc.collect only runs when
+        the queue was empty (cycles may still hold refs) and at most once
+        per second — a legitimately-over-budget workload must not pay a
+        stop-the-world GC per put."""
+        n = self._apply_pending()
+        if n == 0:
+            import gc
+            import time as _time
+
+            now = _time.monotonic()
+            if now - getattr(self, "_last_pressure_gc", 0.0) < 1.0:
+                return 0
+            self._last_pressure_gc = now
+            gc.collect()
+            n = self._apply_pending()
+        return n
 
     # -- ownership --------------------------------------------------------
     def add_owned_object(self, object_id: ObjectID, pinned: bool = False) -> None:

@@ -238,6 +238,10 @@ class Cluster:
     def add_node(self, resources: Dict[str, float], labels: Optional[dict] = None) -> Node:
         node_id = NodeID.from_random()
         node = Node(node_id, resources, self, shm_store=self.shm_store, labels=labels)
+        if self.core_worker is not None:
+            # dead refs free (not spill) under memory pressure — same hook
+            # CoreWorker wires onto init-time stores
+            node.store.pressure_callback = self.core_worker.ref_counter.drain_deferred
         self.nodes[node_id] = node
         self.cluster_scheduler.register_node(node_id, node.pool, labels, queue_len=node.scheduler.queue_len)
         self.control.nodes.register(NodeInfo(node_id, f"inproc://{node_id.hex()[:8]}", resources, labels))

@@ -44,6 +44,13 @@ class CoreWorker:
         hooks.ref_counter = self.ref_counter
         hooks.serialization_ctx = get_context()
         cluster.core_worker = self
+        # memory pressure frees dead objects before anything spills (a tight
+        # put loop outruns the deferred-decref drainer thread); every
+        # in-process store gets the hook, and add_node wires later joiners
+        for node in list(cluster.nodes.values()):
+            store = getattr(node, "store", None)
+            if store is not None:
+                store.pressure_callback = self.ref_counter.drain_deferred
 
     # ------------------------------------------------------------------
     @property
