@@ -255,12 +255,78 @@ class AgentFabric:
     def on_actor_process_died(self, node, actor_id: ActorID) -> None:
         self.conn.send("actor_died", {"actor_id": actor_id.binary()})
 
-    def handle_worker_api(self, blob: bytes) -> bytes:
+    def handle_worker_api(self, blob: bytes, op: str = "") -> bytes:
         """A worker on this agent made a nested API call: the owner (the
         driver's CoreWorker) lives across the transport — relay and wait.
-        Long timeout: a nested get legitimately waits on real work."""
+        Long timeout: a nested get legitimately waits on real work.
+
+        Fast path: a nested ``get`` whose objects already sit in THIS
+        node's store (same-node task results, lazily-committed bulk) is
+        answered locally — without it every byte round-trips the head's
+        control connection twice (worker→agent→head→agent→worker).
+        ``op`` rides beside the blob so non-get payloads (a 1 GB put!) are
+        never deserialized here."""
+        if op == "get":
+            try:
+                local = self._local_get(blob)
+            except Exception:  # noqa: BLE001 — any surprise: authoritative path
+                local = None
+            if local is not None:
+                return local
         reply = self.conn.request("worker_api", {"blob": blob}, timeout=24 * 3600.0)
         return reply["blob"]
+
+    def _local_get(self, blob: bytes) -> Optional[bytes]:
+        """Serve a nested get from the local store, or None to fall back.
+        Only values free of nested ObjectRefs qualify (ref-bearing results
+        need the driver's borrower/pinning bookkeeping)."""
+        import pickle
+
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.runtime import worker_api
+
+        import numpy as _np
+
+        def ref_free(v, depth=0) -> bool:
+            """WHITELIST: only value shapes that provably hold no ObjectRef
+            qualify (an arbitrary object could hide a ref needing the
+            driver's borrower/pinning bookkeeping — those fall back)."""
+            if v is None or isinstance(v, (bool, int, float, str, bytes, bytearray, _np.generic)):
+                return True
+            if isinstance(v, _np.ndarray):
+                return v.dtype != object  # object arrays can hide ObjectRefs
+            from ray_tpu.runtime.device_plane import is_device_array
+
+            if is_device_array(v):
+                return True
+            if depth >= 3 or isinstance(v, ObjectRef):
+                return False
+            if isinstance(v, dict):
+                return all(ref_free(x, depth + 1) for kv in v.items() for x in kv)
+            if isinstance(v, (list, tuple)):
+                return all(ref_free(x, depth + 1) for x in v)
+            return False
+
+        _op, kw = pickle.loads(blob)
+        refs = kw["refs"]
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        store = self.node.store
+        values = []
+        for r in ref_list:
+            oid = r.id()
+            if not store.contains(oid):
+                return None
+            # short timeout: a concurrent free between contains() and get()
+            # leaves an unwoken waiter — time out and take the head path
+            value = store.get(oid, timeout=1.0)
+            info = store.entry_info(oid)
+            if info and info["is_error"] and isinstance(value, BaseException):
+                return worker_api._dumps(("err", value))
+            if not ref_free(value):
+                return None
+            values.append(value)
+        return worker_api._dumps(("ok", values[0] if single else values))
 
     # -- spec registry (cancellation) ---------------------------------------
     def _remember(self, spec) -> None:

