@@ -273,8 +273,39 @@ class AgentFabric:
                 local = None
             if local is not None:
                 return local
+        elif op == "put":
+            try:
+                local = self._local_put(blob)
+            except Exception:  # noqa: BLE001
+                local = None
+            if local is not None:
+                return local
         reply = self.conn.request("worker_api", {"blob": blob}, timeout=24 * 3600.0)
         return reply["blob"]
+
+    def _local_put(self, blob: bytes) -> Optional[bytes]:
+        """Nested put: the BYTES stay in this node's store; the head only
+        mints the ObjectID and records ownership + location (metadata).
+        Without this a worker's rt.put shipped the whole value over two
+        control hops to live in the head's store."""
+        import pickle
+
+        from ray_tpu.core.ids import ObjectID as _OID
+        from ray_tpu.runtime import worker_api
+
+        _op, kw = pickle.loads(blob)
+        value = kw["value"]
+        reply = self.conn.request("mint_put_oid", {}, timeout=30.0)
+        oid = _OID(reply["oid"])
+        self.node.store.put(oid, value)
+        from ray_tpu.runtime.device_plane import is_device_array
+
+        self.conn.send(
+            "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
+        )
+        from ray_tpu.core.object_ref import ObjectRef
+
+        return worker_api._dumps(("ok", ObjectRef(oid, _add_ref=False)))
 
     def _local_get(self, blob: bytes) -> Optional[bytes]:
         """Serve a nested get from the local store, or None to fall back.

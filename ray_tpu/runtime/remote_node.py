@@ -633,6 +633,7 @@ class HeadService:
             "pull_object": self._h_pull_object,
             "locate_object": self._h_locate_object,
             "object_location": self._h_object_location,
+            "mint_put_oid": self._h_mint_put_oid,
             "worker_api": self._h_worker_api,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
@@ -753,6 +754,26 @@ class HeadService:
         handle.store.skip_push_once(oid)
         self.cluster.pull_object(oid, handle, on_local)
         return rpc.DEFER
+
+    def _h_mint_put_oid(self, conn: rpc.RpcConnection, payload: dict, rid: int) -> dict:
+        """Metadata half of an agent-local nested put: mint the ObjectID,
+        register ownership and pin it for the job's lifetime (the worker
+        holds the ref but has no reference counter — same contract as
+        worker_api._pin_refs on the relay path).  The BYTES stay on the
+        agent; its object_location notice records where."""
+        from ray_tpu.core.ids import ObjectID as _OID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        cw = self.cluster.core_worker
+        if cw is None:
+            raise RuntimeError("no core worker attached to this cluster")
+        oid = _OID.for_put(cw.driver_task_id, next(cw._put_counter))
+        cw.ref_counter.add_owned_object(oid)
+        pins = getattr(cw, "_worker_api_pins", None)
+        if pins is None:
+            pins = cw._worker_api_pins = {}
+        pins.setdefault(oid, ObjectRef(oid))
+        return {"oid": oid.binary()}
 
     def _h_worker_api(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """Nested API call relayed from an agent's worker.  Served OFF the

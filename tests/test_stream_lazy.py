@@ -96,6 +96,27 @@ def test_nested_get_served_from_agent_store(two_process_cluster):
     assert pulls_after == pulls_before
 
 
+def test_nested_put_keeps_bytes_on_agent(two_process_cluster):
+    """A worker's nested rt.put stores the bytes in its own node's store
+    (head mints the id + metadata only); the driver can still get it."""
+    cluster, proc = two_process_cluster
+
+    @rt.remote(resources={"remote": 1}, execution="process")
+    def put_and_return_ref():
+        data = np.full(1_000_000, 9, np.int32)  # 4MB
+        return [rt.put(data)]  # nested-in-list: survives as a ref
+
+    [ref] = rt.get(put_and_return_ref.remote(), timeout=120)
+    # the value is directory-located on the AGENT node, not the head
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not cluster.directory.locations(ref.id()):
+        time.sleep(0.1)
+    locs = cluster.directory.locations(ref.id())
+    assert locs and cluster.head_node.node_id not in locs, locs
+    out = rt.get(ref, timeout=60)
+    assert int(out[5]) == 9 and out.shape == (1_000_000,)
+
+
 def test_compiled_dag_with_remote_actor(two_process_cluster):
     """Compiled DAGs span OS processes: a stage actor living in the agent
     executes through the compiled schedule (bulk intermediates ride the
