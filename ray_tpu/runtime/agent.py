@@ -264,8 +264,9 @@ class AgentFabric:
         node's store (same-node task results, lazily-committed bulk) is
         answered locally — without it every byte round-trips the head's
         control connection twice (worker→agent→head→agent→worker).
-        ``op`` rides beside the blob so non-get payloads (a 1 GB put!) are
-        never deserialized here."""
+        ``op`` rides beside the blob so only the ops with a local fast path
+        (get/put) are ever deserialized here; everything else relays as an
+        opaque blob."""
         if op == "get":
             try:
                 local = self._local_get(blob)
@@ -287,7 +288,9 @@ class AgentFabric:
         """Nested put: the BYTES stay in this node's store; the head only
         mints the ObjectID and records ownership + location (metadata).
         Without this a worker's rt.put shipped the whole value over two
-        control hops to live in the head's store."""
+        control hops to live in the head's store.  Values that may carry
+        nested ObjectRefs fall back (the relay path rebuilds them in the
+        driver where the reference counter lives)."""
         import pickle
 
         from ray_tpu.core.ids import ObjectID as _OID
@@ -295,14 +298,26 @@ class AgentFabric:
 
         _op, kw = pickle.loads(blob)
         value = kw["value"]
+        if not _ref_free(value):
+            return None
         reply = self.conn.request("mint_put_oid", {}, timeout=30.0)
         oid = _OID(reply["oid"])
-        self.node.store.put(oid, value)
-        from ray_tpu.runtime.device_plane import is_device_array
+        try:
+            self.node.store.put(oid, value)
+            from ray_tpu.runtime.device_plane import is_device_array
 
-        self.conn.send(
-            "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
-        )
+            self.conn.send(
+                "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
+            )
+        except BaseException:
+            # minted but not committed: unpin on the head and drop the local
+            # copy, else the oid stays owned forever with a stranded value
+            self.node.store.delete(oid)
+            try:
+                self.conn.send("release_put_oid", {"oid": oid.binary()})
+            except Exception:  # noqa: BLE001 — conn death: head cleanup owns it
+                pass
+            raise
         from ray_tpu.core.object_ref import ObjectRef
 
         return worker_api._dumps(("ok", ObjectRef(oid, _add_ref=False)))
@@ -315,28 +330,6 @@ class AgentFabric:
 
         from ray_tpu.core.object_ref import ObjectRef
         from ray_tpu.runtime import worker_api
-
-        import numpy as _np
-
-        def ref_free(v, depth=0) -> bool:
-            """WHITELIST: only value shapes that provably hold no ObjectRef
-            qualify (an arbitrary object could hide a ref needing the
-            driver's borrower/pinning bookkeeping — those fall back)."""
-            if v is None or isinstance(v, (bool, int, float, str, bytes, bytearray, _np.generic)):
-                return True
-            if isinstance(v, _np.ndarray):
-                return v.dtype != object  # object arrays can hide ObjectRefs
-            from ray_tpu.runtime.device_plane import is_device_array
-
-            if is_device_array(v):
-                return True
-            if depth >= 3 or isinstance(v, ObjectRef):
-                return False
-            if isinstance(v, dict):
-                return all(ref_free(x, depth + 1) for kv in v.items() for x in kv)
-            if isinstance(v, (list, tuple)):
-                return all(ref_free(x, depth + 1) for x in v)
-            return False
 
         _op, kw = pickle.loads(blob)
         refs = kw["refs"]
@@ -354,7 +347,7 @@ class AgentFabric:
             info = store.entry_info(oid)
             if info and info["is_error"] and isinstance(value, BaseException):
                 return worker_api._dumps(("err", value))
-            if not ref_free(value):
+            if not _ref_free(value):
                 return None
             values.append(value)
         return worker_api._dumps(("ok", values[0] if single else values))
@@ -793,6 +786,31 @@ class NodeAgent:
             self.fabric.data_client.close()
         if self.conn is not None:
             self.conn.close()
+
+
+def _ref_free(v, depth: int = 0) -> bool:
+    """WHITELIST: only value shapes that provably hold no ObjectRef qualify
+    for agent-local fast paths (an arbitrary object could hide a ref
+    needing the driver's borrower/pinning bookkeeping — those fall back)."""
+    import numpy as _np
+
+    from ray_tpu.core.object_ref import ObjectRef
+
+    if v is None or isinstance(v, (bool, int, float, str, bytes, bytearray, _np.generic)):
+        return True
+    if isinstance(v, _np.ndarray):
+        return v.dtype != object  # object arrays can hide ObjectRefs
+    from ray_tpu.runtime.device_plane import is_device_array
+
+    if is_device_array(v):
+        return True
+    if depth >= 3 or isinstance(v, ObjectRef):
+        return False
+    if isinstance(v, dict):
+        return all(_ref_free(x, depth + 1) for kv in v.items() for x in kv)
+    if isinstance(v, (list, tuple)):
+        return all(_ref_free(x, depth + 1) for x in v)
+    return False
 
 
 def _gc_stale_shm_segments() -> None:

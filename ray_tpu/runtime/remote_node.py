@@ -634,6 +634,7 @@ class HeadService:
             "locate_object": self._h_locate_object,
             "object_location": self._h_object_location,
             "mint_put_oid": self._h_mint_put_oid,
+            "release_put_oid": self._h_release_put_oid,
             "worker_api": self._h_worker_api,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
@@ -761,19 +762,27 @@ class HeadService:
         holds the ref but has no reference counter — same contract as
         worker_api._pin_refs on the relay path).  The BYTES stay on the
         agent; its object_location notice records where."""
-        from ray_tpu.core.ids import ObjectID as _OID
         from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.runtime.worker_api import _pin_refs
 
         cw = self.cluster.core_worker
         if cw is None:
             raise RuntimeError("no core worker attached to this cluster")
-        oid = _OID.for_put(cw.driver_task_id, next(cw._put_counter))
-        cw.ref_counter.add_owned_object(oid)
-        pins = getattr(cw, "_worker_api_pins", None)
-        if pins is None:
-            pins = cw._worker_api_pins = {}
-        pins.setdefault(oid, ObjectRef(oid))
+        oid = cw.mint_put_oid()
+        _pin_refs(cw, ObjectRef(oid))
         return {"oid": oid.binary()}
+
+    def _h_release_put_oid(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """Agent-local put aborted after minting: drop the pin so the oid
+        doesn't stay owned forever."""
+        cw = self.cluster.core_worker
+        if cw is None:
+            return
+        pins = getattr(cw, "_worker_api_pins", None)
+        if pins is not None:
+            from ray_tpu.core.ids import ObjectID as _OID
+
+            pins.pop(_OID(payload["oid"]), None)
 
     def _h_worker_api(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """Nested API call relayed from an agent's worker.  Served OFF the

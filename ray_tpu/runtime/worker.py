@@ -62,9 +62,15 @@ class CoreWorker:
         return current[0] if current is not None else self.driver_task_id
 
     # ------------------------------------------------------------------ put
-    def put(self, value: Any) -> ObjectRef:
+    def mint_put_oid(self) -> ObjectID:
+        """Mint + register ownership for a put object whose BYTES live
+        elsewhere (agent-local nested puts); the caller records location."""
         oid = ObjectID.for_put(self._current_task_id(), next(self._put_counter))
         self.ref_counter.add_owned_object(oid)
+        return oid
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self.mint_put_oid()
         node = self.head_node
         node.store.put(oid, value)
         self.cluster.directory.add_location(oid, node.node_id)
