@@ -113,7 +113,12 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     # stacked layers: leaves get a leading [n_layers] dim, scanned in forward.
     layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[one_layer(k) for k in layer_keys])
     return {
-        "embed": dense_init(k_embed, (cfg.vocab_size, d), d) * math.sqrt(d),
+        # tied embedding/unembed: init at 1/sqrt(d) std (unembed wants unit
+        # row norms so init logits are O(1) — std-1 rows made the model a
+        # confident token-COPIER at init: diag logit ~= |E_t|^2 ~= d); the
+        # input path multiplies by sqrt(d) in forward() to keep the residual
+        # stream at its usual scale (Gemma-style tied-embedding recipe)
+        "embed": dense_init(k_embed, (cfg.vocab_size, d), d),
         "layers": layers,
         "final_norm": jnp.ones((d,), pd),
     }
@@ -379,7 +384,10 @@ def forward(
     on_tpu = jax.default_backend() in ("tpu", "axon")
     use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and on_tpu and act_spec is None)
     B, T = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # sqrt(d) input scale pairs with the 1/sqrt(d)-std tied embedding (see
+    # init_params): residual stream keeps its usual magnitude, unembed rows
+    # stay ~unit-norm so init logits are O(1), not a copy of the input
+    x = params["embed"].astype(cfg.dtype)[tokens] * math.sqrt(cfg.d_model)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
     def layer_fn(x, layer):
