@@ -91,9 +91,9 @@ def forward_with_cache(
     h_heads, hkv = cfg.n_heads, cfg.kv_heads
     n_rep = h_heads // hkv
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    # sqrt(d) input scale: MUST match forward()'s tied-embedding recipe
-    # (transformer.py) or prefill/decode diverge from training logits
-    x = params["embed"].astype(cfg.dtype)[tokens] * math.sqrt(cfg.d_model)
+    from ray_tpu.models.transformer import embed_tokens
+
+    x = embed_tokens(cfg, params, tokens)
     starts = positions[:, 0]
     kv_pos = jnp.arange(S)
     # key s visible to query t iff s <= position(t): causal over the cache

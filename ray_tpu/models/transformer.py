@@ -77,7 +77,8 @@ class TransformerConfig:
 # init
 # ---------------------------------------------------------------------------
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
-    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    # third split kept (not dropped) so existing seeds reproduce their init
+    k_embed, k_layers, _k_unused = jax.random.split(key, 3)
     pd = cfg.param_dtype
     d, h, hkv, dh, ff = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_ff
 
@@ -384,10 +385,7 @@ def forward(
     on_tpu = jax.default_backend() in ("tpu", "axon")
     use_flash = cfg.attention == "flash" or (cfg.attention == "auto" and on_tpu and act_spec is None)
     B, T = tokens.shape
-    # sqrt(d) input scale pairs with the 1/sqrt(d)-std tied embedding (see
-    # init_params): residual stream keeps its usual magnitude, unembed rows
-    # stay ~unit-norm so init logits are O(1), not a copy of the input
-    x = params["embed"].astype(cfg.dtype)[tokens] * math.sqrt(cfg.d_model)
+    x = embed_tokens(cfg, params, tokens)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
     def layer_fn(x, layer):
@@ -410,6 +408,16 @@ def forward(
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
     return logits.astype(jnp.float32)
+
+
+def embed_tokens(cfg: TransformerConfig, params, tokens) -> jax.Array:
+    """THE tied-embedding input path (training forward AND cached decode
+    import this — a drifted copy would make serving logits diverge from
+    training by the scale factor): sqrt(d) input scale pairs with the
+    1/sqrt(d)-std embedding init so the residual stream keeps its usual
+    magnitude while unembed rows stay ~unit-norm (init logits O(1), never
+    an input-copier)."""
+    return params["embed"].astype(cfg.dtype)[tokens] * math.sqrt(cfg.d_model)
 
 
 def loss_fn(cfg: TransformerConfig, params, tokens, *, act_spec=None, mesh=None, sp_axis=None) -> jax.Array:
