@@ -111,6 +111,11 @@ class Config:
     # Admission control: concurrent bulk transfers served/issued per process
     # (reference: PullManager admission, pull_manager.h:52).
     max_concurrent_object_transfers: int = 4
+    # Default timeout for one actor-collective round (rendezvous + reduce).
+    # Callers waiting on a collective result (rt.get) should budget MORE
+    # than this so the collective's own timeout fires first with the
+    # precise error, not the outer get's generic one.
+    collective_timeout_s: float = 120.0
     # Head fault tolerance: how long a node agent keeps retrying the head
     # after a disconnect before giving up and exiting (reference: raylets
     # reconnect to a restarted GCS — core_worker.proto:443

@@ -134,8 +134,24 @@ class _GroupRegistry:
         with self._lock:
             self._groups.pop(name, None)
 
+    def clear(self) -> None:
+        with self._lock:
+            self._groups.clear()
+
 
 _registry = _GroupRegistry()
+
+
+def reset_module_state() -> None:
+    """Fresh-runtime reset, called from cluster shutdown.  Collective groups
+    belong to a runtime incarnation: a group surviving ``rt.shutdown()``
+    carries stale generation counters and a stale routing latch, and the
+    next ``rt.init()`` in this process would desync against peers that
+    start at generation 0 (the round-4 dryrun-loop failure mode)."""
+    _registry.clear()
+    from ray_tpu.util.collective import _reset_binding_state
+
+    _reset_binding_state()
 
 
 def init_collective_group(world_size: int, rank: int, backend: str = "tpu", group_name: str = "default") -> None:
@@ -290,7 +306,8 @@ def _rendezvous_transport(
 
 
 def _run_rendezvous(
-    group_name: str, group: _Group, rank: int, value: Any, reduce_fn, timeout: float = 120.0
+    group_name: str, group: _Group, rank: int, value: Any, reduce_fn,
+    timeout: Optional[float] = None,
 ):
     """Route one collective round: in-memory condition-variable rendezvous
     when all ranks share this process; store-to-store transport rendezvous
@@ -299,8 +316,11 @@ def _run_rendezvous(
     latched per group on its first round — re-reading live cluster state
     every call could split ranks of one round across mechanisms."""
     from ray_tpu.runtime import p2p
+    from ray_tpu.core.config import get_config
     from ray_tpu.runtime.kv_client import is_multiprocess
 
+    if timeout is None:
+        timeout = get_config().collective_timeout_s
     with group.condition:
         if group.routing is None:
             if is_multiprocess():
@@ -324,7 +344,7 @@ def _run_rendezvous(
         raise
 
 
-def _rendezvous(group: _Group, rank: int, value: Any, reduce_fn, timeout: float = 120.0):
+def _rendezvous(group: _Group, rank: int, value: Any, reduce_fn, timeout: float):
     """All-contribute-then-all-collect with generation counting so groups are
     reusable across rounds."""
     with group.condition:

@@ -565,6 +565,12 @@ class NodeAgent:
             self.fabric.conn = conn
             register_agent_kv(conn)
             p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
+            # collective groups/counters index the PREVIOUS head incarnation:
+            # a rank here holding generation N would desync against restarted
+            # driver-side ranks that are born at generation 0
+            from ray_tpu.parallel.collective import reset_module_state
+
+            reset_module_state()
         except BaseException:
             conn.close()
             raise
@@ -771,9 +777,11 @@ class NodeAgent:
         self._stop.set()
         if self.node is not None:
             self.node.shutdown()
+        from ray_tpu.parallel.collective import reset_module_state
         from ray_tpu.runtime import p2p
 
         p2p.clear_endpoint()
+        reset_module_state()
         if getattr(self, "shm_store", None) is not None:
             try:
                 self.shm_store.close()

@@ -1129,9 +1129,13 @@ class Cluster:
                 pass
 
     def shutdown(self) -> None:
+        from ray_tpu.parallel.collective import reset_module_state
         from ray_tpu.runtime import p2p
 
         p2p.clear_endpoint()
+        # collective groups/counters index this runtime incarnation; a
+        # survivor would desync the next init against fresh-born peers
+        reset_module_state()
         with self._demand_cv:
             self._demand_stop = True
             self._demand_cv.notify_all()

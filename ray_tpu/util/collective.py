@@ -273,6 +273,18 @@ _p2p_recv_seq: Dict[tuple, int] = {}
 _p2p_lock = threading.Lock()
 
 
+def _reset_binding_state() -> None:
+    """Runtime-shutdown reset (see parallel.collective.reset_module_state):
+    bindings, mailboxes and FIFO counters all index a dead incarnation."""
+    with _bindings_lock:
+        _group_bindings.clear()
+    with _p2p_lock:
+        _p2p_send_seq.clear()
+        _p2p_recv_seq.clear()
+    with _mail.lock:
+        _mail.boxes.clear()
+
+
 def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[int] = None) -> None:
     """Reference: collective.py:531 — point-to-point send.
 
