@@ -112,3 +112,16 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class OutOfMemoryError(RayTpuError):
     """Object store / HBM capacity exhausted."""
+
+
+class CollectiveGroupDeadError(RayTpuError):
+    """A rank of an open collective group died: surviving ranks' waits fail
+    immediately instead of running out the full rendezvous timeout
+    (reference: pending actor calls fail atomically with the death notice,
+    ``src/ray/core_worker/transport/direct_actor_task_submitter.h:120``)."""
+
+    def __init__(self, group_name: str, reason: str = ""):
+        self.group_name = group_name
+        super().__init__(
+            f"collective group {group_name!r} lost a participant: {reason or 'rank died'}"
+        )

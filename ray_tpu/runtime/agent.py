@@ -275,6 +275,14 @@ class AgentFabric:
             if local is not None:
                 return local
         elif op == "put":
+            shm = getattr(self.node, "store", None) if self.node is not None else None
+            shm = getattr(shm, "_shm", None)
+            if shm is not None:
+                # resolve shm markers HERE: the arena is this host's — the
+                # driver across the relay could never read them
+                from ray_tpu.runtime import protocol as _protocol
+
+                blob = _protocol.decode_put_blob(blob, shm)
             try:
                 local = self._local_put(blob)
             except Exception:  # noqa: BLE001
@@ -431,6 +439,11 @@ class NodeAgent:
         self.node = Node(
             self.node_id, self.resources, self.fabric,
             shm_store=self.shm_store, labels=self.labels,
+            # workers spawned on this node advertise dialable hosts for
+            # their lazy p2p endpoints (worker_pool spawn env;
+            # p2p.ensure_endpoint) — passed through the constructor so even
+            # the prestarted worker gets them
+            data_ip=self.conn.local_ip, head_ip=self.conn.peer_ip,
         )
         self.fabric.node = self.node
         # Bulk data plane: this node serves its local store to peers and
@@ -452,6 +465,7 @@ class NodeAgent:
         from ray_tpu.runtime import p2p
 
         p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
+        p2p.set_local_node(self.node_id.hex())
         # collectives / gang rendezvous in this process reach the cluster KV
         # over the head connection
         from ray_tpu.runtime.kv_client import register_agent_kv
@@ -637,8 +651,20 @@ class NodeAgent:
             "fetch_object": self._h_fetch_object,
             "delete_object": self._h_delete_object,
             "shutdown": self._h_shutdown,
+            "coll_fail": self._h_coll_fail,
             "ping": lambda c, p, rid=None: {},
         }
+
+    def _h_coll_fail(self, conn, payload) -> None:
+        """Cluster-wide collective death notice: fail open waits in THIS
+        process and relay to this node's pool workers."""
+        from ray_tpu.runtime import p2p
+
+        groups, reason = payload["groups"], payload["reason"]
+        for g in groups:
+            p2p.fail_group(g, reason)
+        if self.node is not None:
+            self.node.worker_pool.broadcast_fail_group(groups, reason)
 
     def _decode(self, payload: dict):
         spec = rpc.decode_spec(payload["spec"], self._fn_cache)
@@ -753,6 +779,18 @@ class NodeAgent:
                 if now - last_sample >= 2.0:
                     last_sample = now
                     report["metrics"] = sampler.sample()
+                    # data/device-plane counters ride the same piggyback so
+                    # the dashboard can show live per-node transfer stats
+                    try:
+                        from ray_tpu.runtime import device_plane
+
+                        report["transfers"] = {
+                            "data_server": self.data_server.stats.snapshot(),
+                            "data_client": self.fabric.data_client.stats.snapshot(),
+                            "device": device_plane.stats.snapshot(),
+                        }
+                    except Exception:  # noqa: BLE001 — stats must not kill reports
+                        pass
                 conn.send("resource_report", report)
             except rpc.RpcError:
                 return

@@ -273,6 +273,7 @@ class Cluster:
                 self.head_service.data_server.address,
                 on_consume=self.directory.forget,
             )
+            p2p.set_local_node(self.head_node.node_id.hex())
         return self.head_service.address
 
     def register_remote_node(self, handle) -> None:
@@ -368,6 +369,10 @@ class Cluster:
             self._try_recover(oid)
         # dashboard stores: a dead node must not linger in the UI
         self.metrics_history.drop_node(node_id.hex())
+        # open collective waits involving ranks on this node fail NOW, not
+        # at the rendezvous timeout (direct_actor_task_submitter.h:120: the
+        # reference fails pending calls atomically with the death notice)
+        self._fail_collective_groups_for_node(node_id)
         # actors hosted there follow the restart FSM
         for info in self.control.actors.list_actors():
             if info.node_id == node_id and info.state in (ActorState.ALIVE, ActorState.PENDING_CREATION):
@@ -393,6 +398,71 @@ class Cluster:
                 )
                 self._after_commit(spec)
         node.shutdown()
+
+    # ------------------------------------------------------------------
+    # collective death notices (VERDICT r4 item 5)
+    # ------------------------------------------------------------------
+    def _fail_collective_groups_for_node(self, node_id: NodeID) -> None:
+        """Groups with a rank registered from the dead node (the rank's
+        process published its hosting node beside its address —
+        ``p2p.node_key``) get a cluster-wide death notice."""
+        node_hex = node_id.hex().encode()
+        groups = set()
+        try:
+            for key in self.control.kv.keys(b"rt_coll_node/"):
+                if self.control.kv.get(key) == node_hex:
+                    parts = key.decode().split("/")
+                    if len(parts) == 3:
+                        groups.add(parts[1])
+        except Exception:  # noqa: BLE001 — notice is best-effort
+            return
+        if groups:
+            self._broadcast_collective_failure(
+                groups, f"node {node_id.hex()[:8]} died"
+            )
+
+    def _fail_collective_groups_for_actor(self, actor_id: ActorID, cause: str) -> None:
+        """Groups the actor was declaratively bound to
+        (``create_collective_group`` records actor->rank in the KV)."""
+        import pickle as _pickle
+
+        aid = actor_id.hex()
+        groups = set()
+        try:
+            for key in self.control.kv.keys(b"rt_coll_grp/"):
+                raw = self.control.kv.get(key)
+                if raw is None:
+                    continue
+                record = _pickle.loads(raw)
+                if aid in record.get("binding", {}):
+                    groups.add(key.decode().split("/", 1)[1])
+        except Exception:  # noqa: BLE001
+            return
+        if groups:
+            self._broadcast_collective_failure(groups, f"actor {aid[:8]} died: {cause}")
+
+    def _broadcast_collective_failure(self, groups, reason: str) -> None:
+        """Fan the death notice to every fabric process: this (driver)
+        process, every live agent (which relays to its pool workers), and
+        this host's own pool workers."""
+        from ray_tpu.runtime import p2p
+        from ray_tpu.runtime.remote_node import RemoteNodeHandle
+
+        group_list = sorted(groups)
+        for g in group_list:
+            p2p.fail_group(g, reason)
+        for node in list(self.nodes.values()):
+            if node.dead:
+                continue
+            if isinstance(node, RemoteNodeHandle):
+                try:
+                    node.conn.send("coll_fail", {"groups": group_list, "reason": reason})
+                except Exception:  # noqa: BLE001 — that node is dying too
+                    pass
+            else:
+                pool = getattr(node, "worker_pool", None)
+                if pool is not None:
+                    pool.broadcast_fail_group(group_list, reason)
 
     def _spec_is_queued(self, spec: TaskSpec) -> bool:
         q = self._actor_queues.get(spec.actor_id)
@@ -516,10 +586,13 @@ class Cluster:
     def handle_worker_api(self, blob: bytes, op: str = "") -> bytes:
         """Nested runtime API call from a worker process on this host: runs
         against the driver's CoreWorker (the single owner)."""
-        from ray_tpu.runtime import worker_api
+        from ray_tpu.runtime import protocol, worker_api
 
         if self.core_worker is None:
             raise RuntimeError("no core worker attached to this cluster")
+        if op == "put" and self.shm_store is not None:
+            # bulk put payloads arrive as shm markers, not in-band pickle
+            blob = protocol.decode_put_blob(blob, self.shm_store)
         return worker_api.execute(self.core_worker, blob)
 
     def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
@@ -919,6 +992,9 @@ class Cluster:
         if q is not None:
             with q.lock:
                 q.alive = False
+        # declaratively-bound collective groups the actor belongs to fail
+        # open waits immediately (direct_actor_task_submitter.h:120 parity)
+        self._fail_collective_groups_for_actor(actor_id, cause)
         state = self.control.actors.on_failure(actor_id, cause)
         if state is ActorState.RESTARTING and spec is not None:
             spec.attempt += 1

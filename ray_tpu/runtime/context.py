@@ -7,34 +7,33 @@ attributed to the running task (ObjectIDs embed the creating TaskID).
 
 from __future__ import annotations
 
-import threading
+import contextvars
 from typing import Optional, Tuple
 
 from ray_tpu.core.ids import JobID, NodeID, TaskID
 
+# contextvars, not threading.local: per-thread for sync execution (same
+# semantics as before), but ALSO copied into every asyncio Task — so async
+# actor methods interleaving on one event-loop thread each see their own
+# task context instead of whichever one pushed last.
+_stack: "contextvars.ContextVar[tuple]" = contextvars.ContextVar("rt_task_stack", default=())
+
 
 class _TaskContext:
-    def __init__(self):
-        self._local = threading.local()
-
     def push(self, task_id: TaskID, node_id: NodeID):
-        stack = getattr(self._local, "stack", None)
-        if stack is None:
-            stack = []
-            self._local.stack = stack
-        stack.append((task_id, node_id))
-        return len(stack) - 1
+        return _stack.set(_stack.get() + ((task_id, node_id),))
 
-    def pop(self, token: int) -> None:
-        stack = getattr(self._local, "stack", [])
-        if stack:
-            stack.pop()
+    def pop(self, token) -> None:
+        try:
+            _stack.reset(token)
+        except ValueError:
+            # token from another Context copy (async hand-off): nothing to
+            # unwind here — that copy dies with its Task
+            pass
 
     def current(self) -> Optional[Tuple[TaskID, NodeID]]:
-        stack = getattr(self._local, "stack", None)
-        if stack:
-            return stack[-1]
-        return None
+        stack = _stack.get()
+        return stack[-1] if stack else None
 
 
 task_context = _TaskContext()

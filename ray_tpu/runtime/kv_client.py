@@ -63,6 +63,37 @@ class _RpcKV(KVClient):
         self._conn.request("kv_del", {"key": key})
 
 
+class _WorkerKV(KVClient):
+    """Worker-process side: KV ops as worker-api frames over the pool
+    socket (worker -> node -> driver's control KV).  Metadata only — the
+    collective rank-address book, never payloads."""
+
+    def __init__(self, api_client):
+        self._api = api_client
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._api.kv_put(key, value)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._api.kv_get(key)
+
+    def delete(self, key: bytes) -> None:
+        self._api.kv_del(key)
+
+
+def worker_api_client():
+    """The WorkerApiClient when THIS process is a spawned pool worker,
+    else None (shared by get_kv / is_multiprocess / p2p.ensure_endpoint)."""
+    try:
+        from ray_tpu.runtime import worker as _worker_mod
+        from ray_tpu.runtime.worker_api import WorkerApiClient
+
+        w = getattr(_worker_mod, "_global_worker", None)
+        return w if isinstance(w, WorkerApiClient) else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
 _lock = threading.Lock()
 _agent_conn = None
 
@@ -79,6 +110,9 @@ def get_kv() -> Optional[KVClient]:
     with _lock:
         if _agent_conn is not None and not _agent_conn.closed:
             return _RpcKV(_agent_conn)
+    w = worker_api_client()
+    if w is not None:
+        return _WorkerKV(w)
     try:
         from ray_tpu import api
 
@@ -100,20 +134,29 @@ def head_peer_ip() -> Optional[str]:
 
 def is_multiprocess() -> bool:
     """True when collective/rendezvous state must go through the shared KV
-    (this process is an agent, or the cluster has remote nodes) rather than
-    process-local memory."""
+    (this process is an agent or a spawned pool worker, or the cluster has
+    remote nodes) rather than process-local memory."""
     with _lock:
         if _agent_conn is not None and not _agent_conn.closed:
             return True
+    if worker_api_client() is not None:
+        return True
     try:
         from ray_tpu import api
 
         if api.is_initialized():
             from ray_tpu.runtime.remote_node import RemoteNodeHandle
 
-            return any(
-                isinstance(n, RemoteNodeHandle) for n in api.get_cluster().nodes.values()
-            )
+            cluster = api.get_cluster()
+            for n in cluster.nodes.values():
+                if isinstance(n, RemoteNodeHandle):
+                    return True
+                # process-execution actors/tasks on a local node live in
+                # spawned worker processes — a collective group touching
+                # them must ride the transport even with no remote nodes
+                pool = getattr(n, "worker_pool", None)
+                if pool is not None and pool.has_process_participants():
+                    return True
     except Exception:  # noqa: BLE001
         pass
     return False

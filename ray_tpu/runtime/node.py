@@ -177,6 +177,8 @@ class Node:
         shm_store=None,
         labels: Optional[dict] = None,
         num_inproc_threads: int = 8,
+        data_ip: str = "",
+        head_ip: str = "",
     ):
         cfg = get_config()
         self.node_id = node_id
@@ -201,6 +203,10 @@ class Node:
             max_workers=int(resources.get("CPU", 0)) or None,
             session_dir=cluster.session_dir,
         )
+        # before prestart: spawned workers read these from env at spawn time
+        self.worker_pool.data_ip = data_ip
+        self.worker_pool.head_ip = head_ip
+        self.worker_pool.node_hex = node_id.hex()
         self.worker_pool.set_on_worker_death(self._on_worker_death)
         self.worker_pool.api_handler = self._handle_worker_api
         # Prestart a warm worker off-thread (reference: WorkerPool prestart,

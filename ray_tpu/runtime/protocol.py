@@ -62,9 +62,19 @@ def encode_value(value: Any, shm_store, id_factory) -> Any:
         if isinstance(v, np.ndarray) and v.dtype != object and v.nbytes >= SHM_THRESHOLD and shm_store is not None:
             oid = id_factory()
             header = pickle.dumps((v.dtype.str, v.shape))
-            payload = header + np.ascontiguousarray(v).tobytes()
             try:
-                shm_store.put(oid, payload, meta_size=len(header))
+                if hasattr(shm_store, "create"):
+                    # write STRAIGHT into the arena: one memcpy, no
+                    # header+bytes concat staging copy
+                    view = shm_store.create(oid, len(header) + v.nbytes, meta_size=len(header))
+                    view[: len(header)] = header
+                    src = v if v.flags.c_contiguous else np.ascontiguousarray(v)
+                    view[len(header):] = memoryview(src).cast("B")
+                    shm_store.seal(oid)
+                else:
+                    shm_store.put(
+                        oid, header + np.ascontiguousarray(v).tobytes(), meta_size=len(header)
+                    )
                 return ShmRef(oid)
             except (MemoryError, FileExistsError):
                 return v
@@ -77,6 +87,31 @@ def encode_value(value: Any, shm_store, id_factory) -> Any:
     if isinstance(value, dict):
         return {k: enc(v) for k, v in value.items()}
     return enc(value)
+
+
+def decode_put_blob(blob: bytes, shm_store) -> bytes:
+    """Resolve ShmRef markers inside a worker-api ``put`` frame at the FIRST
+    hop that shares the worker's shm arena.  Worker ``rt.put`` of a bulk
+    ndarray moves one shm memcpy + a tiny pickled marker over the pool
+    socket instead of in-band pickled gigabytes (same policy as task
+    args/results; reference: plasma puts from workers never ride the GCS).
+    No-op (returns the original blob) when no marker is present."""
+    op, kw = pickle.loads(blob)
+    value = kw.get("value")
+
+    def has_ref(v) -> bool:
+        if isinstance(v, ShmRef):
+            return True
+        if isinstance(v, (tuple, list)):
+            return any(isinstance(x, ShmRef) for x in v)
+        if isinstance(v, dict):
+            return any(isinstance(x, ShmRef) for x in v.values())
+        return False
+
+    if shm_store is None or not has_ref(value):
+        return blob
+    kw["value"] = decode_value(value, shm_store)
+    return pickle.dumps((op, kw), protocol=5)
 
 
 def decode_value(value: Any, shm_store, release: bool = True) -> Any:

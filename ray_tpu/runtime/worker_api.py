@@ -98,6 +98,14 @@ def execute(core_worker, blob: bytes) -> bytes:
                 kw["actor_id"], kw["method_name"], kw["args"], kw["kwargs"],
                 num_returns=kw.get("num_returns", 1), name=kw.get("name", ""),
             )
+        elif op == "kv_put":
+            _control_kv().put(kw["key"], kw["value"])
+            result = None
+        elif op == "kv_get":
+            result = _control_kv().get(kw["key"])
+        elif op == "kv_del":
+            _control_kv().delete(kw["key"])
+            result = None
         else:
             raise ValueError(f"unknown worker api op {op!r}")
         _pin_refs(core_worker, result)
@@ -107,6 +115,15 @@ def execute(core_worker, blob: bytes) -> bytes:
             return _dumps(("err", exc))
         except BaseException:
             return _dumps(("err", RuntimeError(f"{type(exc).__name__}: {exc}")))
+
+
+def _control_kv():
+    """The cluster KV, reached from the process executing worker API calls
+    (the driver).  Workers use it for collective rank-address registration
+    and group records — tiny metadata, never payloads."""
+    from ray_tpu import api
+
+    return api.get_cluster().control.kv
 
 
 def _pin_refs(core_worker, result) -> None:
@@ -141,13 +158,16 @@ class WorkerApiClient:
     Installed as the worker process's global worker, so
     ``rt.get/put/wait/@remote`` work unchanged inside tasks and actors."""
 
-    def __init__(self, send_request, current_task_fn):
+    def __init__(self, send_request, current_task_fn, shm_store=None, shm_id_factory=None):
         # send_request(rid, blob): write an api_request frame (thread-safe)
         self._send = send_request
         self._current_task = current_task_fn
         self._rid = itertools.count(1)
         self._pending: Dict[int, Future] = {}
         self._lock = threading.Lock()
+        # bulk put payloads ride the node's shm arena, not in-band pickle
+        self._shm = shm_store
+        self._shm_id = shm_id_factory
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, op: str, **kw) -> Any:
@@ -180,6 +200,10 @@ class WorkerApiClient:
 
     # -- CoreWorker surface (what ray_tpu/api.py calls) --------------------
     def put(self, value):
+        if self._shm is not None and self._shm_id is not None:
+            from ray_tpu.runtime import protocol
+
+            value = protocol.encode_value(value, self._shm, self._shm_id)
         return self._call("put", value=value)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -199,6 +223,16 @@ class WorkerApiClient:
             "submit_actor_task",
             actor_id=actor_id, method_name=method_name, args=args, kwargs=kwargs, **opts,
         )
+
+    # -- cluster KV (collective rank registration from worker processes) ---
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._call("kv_put", key=key, value=value)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._call("kv_get", key=key)
+
+    def kv_del(self, key: bytes) -> None:
+        self._call("kv_del", key=key)
 
     def get_async(self, ref):
         """Future-producing get (ObjectRef.future / await support)."""

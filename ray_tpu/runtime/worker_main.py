@@ -86,7 +86,8 @@ class Worker:
             )
 
         self._api = WorkerApiClient(
-            send_request, lambda: getattr(self._current, "task", None)
+            send_request, lambda: getattr(self._current, "task", None),
+            shm_store=self._shm, shm_id_factory=self._next_shm_id,
         )
         set_global_worker(self._api)
 
@@ -109,6 +110,13 @@ class Worker:
                 break
             if msg_type == "api_reply":
                 self._api.on_reply(payload["rid"], payload["blob"])
+            elif msg_type == "fail_group":
+                # handled on the READER thread: the exec thread may be the
+                # one blocked inside the collective wait being failed
+                from ray_tpu.runtime import p2p
+
+                for g in payload["groups"]:
+                    p2p.fail_group(g, payload["reason"])
             else:
                 self._exec_queue.put((msg_type, payload))
         self._exec_queue.put(None)
@@ -170,11 +178,26 @@ class Worker:
         encoded = p.encode_value(value, self._shm, self._next_shm_id)
         return pickle.dumps(encoded, protocol=5)
 
+    def _push_task_context(self, task_id: bytes):
+        """Worker-side task context: TaskIDs are lineage-embedded (actor
+        tasks carry their ActorID), so pushing the id here makes
+        ``get_runtime_context()`` and the declarative collective-rank
+        inference (util/collective._rank_from_actor_context) work inside
+        process workers exactly as they do in-process."""
+        from ray_tpu.core.ids import NodeID, TaskID
+        from ray_tpu.runtime.context import task_context
+
+        try:
+            return task_context, task_context.push(TaskID(task_id), NodeID.nil())
+        except Exception:  # noqa: BLE001 — opaque ids: context stays unset
+            return task_context, None
+
     def _handle_exec(self, payload: dict) -> None:
         import time
 
         task_id = payload["task_id"]
         self._current.task = task_id
+        ctx, token = self._push_task_context(task_id)
         try:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
@@ -195,6 +218,8 @@ class Worker:
             )
         finally:
             self._current.task = None
+            if token is not None:
+                ctx.pop(token)
 
     # ------------------------------------------------------------------
     def _handle_actor_create(self, payload: dict) -> None:
@@ -254,7 +279,18 @@ class Worker:
             if asyncio.iscoroutinefunction(method) and self._actor_loop is not None:
                 # async actors: schedule on the loop, reply on completion
                 # (never coalesced — completion order is the loop's).
-                fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), self._actor_loop)
+                # The task context is pushed INSIDE the coroutine: each
+                # asyncio Task runs in its own contextvars copy, so
+                # interleaved methods keep their own task ids.
+                async def _run_with_context():
+                    ctx, token = self._push_task_context(task_id)
+                    try:
+                        return await method(*args, **kwargs)
+                    finally:
+                        if token is not None:
+                            ctx.pop(token)
+
+                fut = asyncio.run_coroutine_threadsafe(_run_with_context(), self._actor_loop)
 
                 def done(f):
                     try:
@@ -265,10 +301,13 @@ class Worker:
                 fut.add_done_callback(done)
                 return
             self._current.task = task_id
+            ctx, token = self._push_task_context(task_id)
             try:
                 result = method(*args, **kwargs)
             finally:
                 self._current.task = None
+                if token is not None:
+                    ctx.pop(token)
             emit({"task_id": task_id, "value_blob": self._encode_result(result)})
         except BaseException as exc:  # noqa: BLE001
             emit({"task_id": task_id, "error_blob": pickle.dumps(_make_task_error(method_name, exc))})

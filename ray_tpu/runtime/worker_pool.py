@@ -97,6 +97,17 @@ class ProcessWorkerPool:
         self._shutdown = False
         self._spawning = 0           # spawns in flight (async growth)
         self._spawn_lock = threading.Lock()  # serializes listener.accept
+        # Advertised to workers at spawn so their lazy p2p endpoints carry a
+        # dialable host: data_ip = this node's reachable IP (agents set it
+        # from the head connection), head_ip = the head's IP as seen from
+        # this node (wildcard-address rewrites in processes with no head
+        # connection of their own).  Empty on head-host pools: loopback /
+        # peer-side rewrite is correct there.
+        self.data_ip: str = ""
+        self.head_ip: str = ""
+        # hosting node id (hex) — workers publish it beside collective rank
+        # registrations so node-death notices can find their groups
+        self.node_hex: str = ""
 
     # ------------------------------------------------------------------
     def set_on_worker_death(self, cb: Callable[[WorkerHandle], None]) -> None:
@@ -135,6 +146,9 @@ class ProcessWorkerPool:
                     "PYTHONPATH": pythonpath,
                     # pipes are block-buffered; prints must reach the driver live
                     "PYTHONUNBUFFERED": "1",
+                    **({"RT_DATA_IP": self.data_ip} if self.data_ip else {}),
+                    **({"RT_HEAD_IP": self.head_ip} if self.head_ip else {}),
+                    **({"RT_NODE_ID": self.node_hex} if self.node_hex else {}),
                 },
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
@@ -592,6 +606,29 @@ class ProcessWorkerPool:
         return self._kill_worker(worker, only_if_running=task_id)
 
     # ------------------------------------------------------------------
+    def broadcast_fail_group(self, groups, reason: str) -> None:
+        """Relay a collective death notice to every live worker (their
+        reader threads invoke p2p.fail_group locally — a worker blocked in
+        a collective wait can't be reached through the exec queue)."""
+        with self._lock:
+            workers = [w for w in self._all.values() if w.alive]
+        for w in workers:
+            try:
+                w.send("fail_group", {"groups": list(groups), "reason": reason})
+            except Exception:  # noqa: BLE001 — dying worker: its waits die with it
+                pass
+
+    def has_process_participants(self) -> bool:
+        """True when code that could join a collective is running in a
+        spawned worker right now: an actor-dedicated worker exists, or a
+        process task is in flight.  Idle/prestarted workers don't count —
+        they host nobody (used by kv_client.is_multiprocess to route
+        driver-side collectives)."""
+        with self._lock:
+            if self._inflight_worker:
+                return True
+            return any(w.alive and w.dedicated for w in self._all.values())
+
     def num_workers(self) -> int:
         with self._lock:
             return len(self._all)

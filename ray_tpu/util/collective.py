@@ -62,37 +62,11 @@ def create_collective_group(
         binding[actor_id.hex()] = group_rank
     _bind_group(group_name, world_size, binding)
 
-    # resolve each actor's hosting node to its data-plane address and
-    # publish rank->address upfront (the actors may never call set_rank)
-    try:
-        from ray_tpu import api
-        from ray_tpu.runtime import p2p
-
-        cluster = api.get_cluster()
-        for actor, group_rank in zip(actors, ranks):
-            info = _wait_actor_placed(cluster, actor._actor_id)
-            if info is None or info.node_id is None:
-                continue
-            node = cluster.nodes.get(info.node_id)
-            addr = getattr(node, "data_address", None)
-            if not addr and cluster.head_service is not None:
-                addr = cluster.head_service.data_server.address
-            if addr:
-                p2p.register_rank(group_name, group_rank, addr)
-    except Exception:  # noqa: BLE001 — in-proc clusters have no data plane
-        pass
-
-
-def _wait_actor_placed(cluster, actor_id, timeout: float = 30.0):
-    import time as _time
-
-    deadline = _time.monotonic() + timeout
-    while _time.monotonic() < deadline:
-        info = cluster.control.actors.get(actor_id)
-        if info is not None and info.node_id is not None:
-            return info
-        _time.sleep(0.01)
-    return cluster.control.actors.get(actor_id)
+    # Rank addresses are NOT pre-published here: each rank's process
+    # registers its OWN endpoint at round start (_rendezvous_transport /
+    # recv), which is the only address that's always right — a
+    # process-worker actor's endpoint is the worker's own data server, not
+    # its hosting node's, and the driver can't know which from here.
 
 
 # group-name -> {actor_id_hex: rank}; mirrored in the KV for other processes
@@ -293,21 +267,30 @@ def send(tensor, dst_rank: int, group_name: str = "default", *, rank: Optional[i
     destination process, never a value through the head KV.  Same-process
     ranks (no fabric endpoint) use in-memory mailboxes."""
     src = _need_rank(rank, group_name)
+    from ray_tpu.parallel.collective import use_transport
     from ray_tpu.runtime import p2p
-    from ray_tpu.runtime.kv_client import is_multiprocess
 
-    ep = p2p.get_endpoint()
-    if ep is not None and is_multiprocess():
+    _ensure_group(group_name)
+    if use_transport(group_name):
         from ray_tpu.parallel.collective import _host_value
 
-        _ensure_group(group_name)
         with _p2p_lock:
             seq = _p2p_send_seq.get((group_name, src, dst_rank), 0)
             _p2p_send_seq[(group_name, src, dst_rank)] = seq + 1
         # make sure the counterpart can answer/see us before first contact
         p2p.register_rank(group_name, src)
         oid = p2p.mailbox_oid("p2p", group_name, _group_epoch(group_name), src, dst_rank, seq)
-        p2p.post_to_rank(group_name, dst_rank, oid, _host_value(tensor))
+        # budget: the destination registers its address on ITS first op
+        # (addresses are not pre-published — the binding process can't know
+        # a worker-hosted rank's endpoint), so a sender may legitimately
+        # wait for a receiver that is still loading; give it the collective
+        # timeout, not resolve_rank's 30 s metadata default
+        from ray_tpu.core.config import get_config
+
+        p2p.post_to_rank(
+            group_name, dst_rank, oid, _host_value(tensor),
+            timeout=get_config().collective_timeout_s,
+        )
         return
     box = _mail.box(group_name, src, dst_rank)
     with box.cond:
@@ -319,38 +302,57 @@ def recv(src_rank: int, group_name: str = "default", *, rank: Optional[int] = No
     """Reference: collective.py:594 — blocking point-to-point receive.
 
     Waits on the LOCAL store's condition variable (the inbound data-plane
-    push wakes it) — no polling anywhere."""
+    push wakes it) — no polling anywhere.  A mailbox wait that routed
+    "inproc" WITHOUT proof (no multiprocess evidence yet) re-checks the
+    routing every 250 ms and switches to the transport mid-wait — the same
+    self-heal the rendezvous path gets from its _ReRoute escape."""
+    import time as _time
+
     dst = _need_rank(rank, group_name)
+    from ray_tpu.parallel.collective import use_transport
     from ray_tpu.runtime import p2p
-    from ray_tpu.runtime.kv_client import is_multiprocess
 
-    ep = p2p.get_endpoint()
-    if ep is not None and is_multiprocess():
-        from ray_tpu.exceptions import GetTimeoutError
-
-        _ensure_group(group_name)
-        # publish where this rank lives so senders can reach us
-        p2p.register_rank(group_name, dst)
-        with _p2p_lock:
-            seq = _p2p_recv_seq.get((group_name, src_rank, dst), 0)
-        oid = p2p.mailbox_oid("p2p", group_name, _group_epoch(group_name), src_rank, dst, seq)
-        try:
-            value = p2p.take(oid, timeout=timeout)
-        except GetTimeoutError as exc:
-            # only a genuine wait expiry maps to TimeoutError — endpoint /
-            # store failures propagate with their real cause
-            raise TimeoutError(f"recv from rank {src_rank} timed out") from exc
-        # consume the sequence number only on success — a timed-out recv
-        # must retry the SAME slot, or the FIFO desyncs
-        with _p2p_lock:
-            _p2p_recv_seq[(group_name, src_rank, dst)] = seq + 1
-        return value
+    _ensure_group(group_name)
+    deadline = _time.monotonic() + timeout
+    if use_transport(group_name):
+        return _recv_transport(src_rank, dst, group_name, timeout)
     box = _mail.box(group_name, src_rank, dst)
     with box.cond:
-        ok = box.cond.wait_for(lambda: bool(box.items), timeout=timeout)
-        if not ok:
-            raise TimeoutError(f"recv from rank {src_rank} timed out")
-        return box.items.pop(0)
+        while not box.items:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+            box.cond.wait(min(0.25, remaining))
+            if not box.items and use_transport(group_name):
+                break
+        else:
+            return box.items.pop(0)
+    # routing evidence appeared mid-wait: finish the receive on the transport
+    return _recv_transport(
+        src_rank, dst, group_name, max(0.0, deadline - _time.monotonic())
+    )
+
+
+def _recv_transport(src_rank: int, dst: int, group_name: str, timeout: float):
+    from ray_tpu.exceptions import GetTimeoutError
+    from ray_tpu.runtime import p2p
+
+    # publish where this rank lives so senders can reach us
+    p2p.register_rank(group_name, dst)
+    with _p2p_lock:
+        seq = _p2p_recv_seq.get((group_name, src_rank, dst), 0)
+    oid = p2p.mailbox_oid("p2p", group_name, _group_epoch(group_name), src_rank, dst, seq)
+    try:
+        value = p2p.take_group(group_name, oid, timeout)
+    except GetTimeoutError as exc:
+        # only a genuine wait expiry maps to TimeoutError — endpoint /
+        # store failures propagate with their real cause
+        raise TimeoutError(f"recv from rank {src_rank} timed out") from exc
+    # consume the sequence number only on success — a timed-out recv
+    # must retry the SAME slot, or the FIFO desyncs
+    with _p2p_lock:
+        _p2p_recv_seq[(group_name, src_rank, dst)] = seq + 1
+    return value
 
 
 # ----------------------------------------------------------------- helpers
