@@ -156,18 +156,40 @@ _xfer_server = None
 _xfer_probed = False
 
 
+def install_transfer_server(server: Optional[Any]) -> None:
+    """Inject a transfer server (tests / the fake): subsequent
+    ``transfer_server()`` calls return it without probing the platform.
+    Pass None to reset to the unprobed state."""
+    global _xfer_server, _xfer_probed
+    with _xfer_lock:
+        _xfer_server = server
+        _xfer_probed = server is not None
+
+
 def transfer_server() -> Optional[Any]:
     """This process's jax transfer server, enabled ONLY on real multi-host
     TPU backends.  The gate is a platform check, not a construction probe:
     the CPU backend happily constructs a server and then hard-CRASHES the
     process (fatal ``Check failed`` in streaming.cc) on first pull — an
-    unservable backend must never advertise device transfer."""
+    unservable backend must never advertise device transfer.
+
+    ``RAY_TPU_FAKE_DEVICE_TRANSFER=1`` substitutes the host-memory-backed
+    fake (``runtime/fake_transfer.py``) so the negotiation protocol runs
+    end-to-end on any backend — the dryrun and tests prove the offer →
+    ticket → pull → release path itself, not just the probe."""
     global _xfer_server, _xfer_probed
     with _xfer_lock:
         if _xfer_probed:
             return _xfer_server
         _xfer_probed = True
         _xfer_server = None
+        import os
+
+        if os.environ.get("RAY_TPU_FAKE_DEVICE_TRANSFER"):
+            from ray_tpu.runtime.fake_transfer import FakeTransferServer
+
+            _xfer_server = FakeTransferServer()
+            return _xfer_server
         try:
             import jax
 
