@@ -48,6 +48,9 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Serve</h2><table id="serve"></table></section>
   <section style="grid-column: 1 / -1"><h2>Actors</h2><table id="actors"></table></section>
   <section style="grid-column: 1 / -1"><h2>Recent tasks</h2><table id="tasks"></table></section>
+  <section style="grid-column: 1 / -1; display:none" id="detailsec"><h2 id="detailtitle">Detail</h2>
+    <table id="detailkv"></table><table id="detailevents" style="margin-top:8px"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Data-plane transfers</h2><table id="transfers"></table></section>
   <section style="grid-column: 1 / -1"><h2>Node utilization</h2><div id="util"></div></section>
   <section style="grid-column: 1 / -1"><h2>Node logs</h2>
     <div style="margin-bottom:8px">node: <select id="lognode" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d"></select></div>
@@ -100,12 +103,14 @@ async function refresh() {
         n.is_head ? "★" : ""];
     }));
   if (actorList) rows($("actors"), ["actor", "class", "name", "state", "node", "restarts"],
-    (actorList.actors || []).slice(0, 12).map(a => [esc(a.actor_id.slice(0, 12)),
+    (actorList.actors || []).slice(0, 12).map(a => [
+      `<a style="color:#7fd1b9;cursor:pointer" onclick="showDetail('actors','${esc(a.actor_id)}')">${esc(a.actor_id.slice(0, 12))}</a>`,
       esc(a.class_name), esc(a.name),
       `<span class="${a.state === 'ALIVE' ? 'ok' : a.state === 'DEAD' ? 'bad' : ''}">${esc(a.state)}</span>`,
       esc((a.node_id || "").slice(0, 12)), esc(a.restarts + "/" + a.max_restarts)]));
   if (taskList) rows($("tasks"), ["task", "name", "state", "node", "attempt", "duration"],
-    (taskList.tasks || []).slice(-12).reverse().map(t => [esc((t.task_id || "").slice(0, 12)),
+    (taskList.tasks || []).slice(-12).reverse().map(t => [
+      `<a style="color:#7fd1b9;cursor:pointer" onclick="showDetail('tasks','${esc(t.task_id || "")}')">${esc((t.task_id || "").slice(0, 12))}</a>`,
       esc(t.name || ""),
       `<span class="${t.state === 'FINISHED' ? 'ok' : t.state === 'FAILED' ? 'bad' : ''}">${esc(t.state || "")}</span>`,
       esc((t.node_id || "").slice(0, 12)), esc(t.attempt ?? 0),
@@ -130,6 +135,46 @@ async function refresh() {
     (events.events || []).map(e => `${e.timestamp ?? ""} [${e.severity ?? e.level ?? ""}] ${e.label ?? ""} ${e.message ?? ""}`).join("\\n") || "(none)";
   await refreshUtil();
   await refreshLogs();
+  await refreshTransfers();
+}
+function fmtBytes(n) {
+  if (n == null) return "";
+  if (n >= 1e9) return (n / 1e9).toFixed(2) + " GB";
+  if (n >= 1e6) return (n / 1e6).toFixed(1) + " MB";
+  if (n >= 1e3) return (n / 1e3).toFixed(1) + " KB";
+  return n + " B";
+}
+async function refreshTransfers() {
+  const t = await get("/api/transfers");
+  if (!t) return;
+  const data = Object.entries(t.nodes || {}).map(([node, s]) => {
+    const srv = s.data_server || {}, cli = s.data_client || {}, dev = s.device || {};
+    return [esc(node.slice(0, 12)),
+      `<span class="num">${(srv.pulls_served ?? 0)}/${(cli.pulls_issued ?? 0)}</span>`,
+      `<span class="num">${(srv.pushes_received ?? 0)}/${(cli.pushes_sent ?? 0)}</span>`,
+      `<span class="num">${fmtBytes((srv.bytes_sent ?? 0) + (cli.bytes_sent ?? 0))}</span>`,
+      `<span class="num">${fmtBytes((srv.bytes_received ?? 0) + (cli.bytes_received ?? 0))}</span>`,
+      `<span class="num">${dev.arrays_packed ?? 0}/${dev.arrays_restored ?? 0}</span>`,
+      `<span class="num">${dev.ici_pulls ?? 0}</span>`];
+  });
+  rows($("transfers"),
+    ["node", "pulls srv/iss", "pushes in/out", "bytes out", "bytes in", "dev pack/restore", "ici pulls"],
+    data.length ? data : [["(no transfer activity yet)", "", "", "", "", "", ""]]);
+}
+async function showDetail(kind, id) {
+  const d = await get(`/api/${kind}/${id}`);
+  if (!d) return;
+  $("detailsec").style.display = "";
+  $("detailtitle").textContent = (kind === "actors" ? "Actor " : "Task ") + id.slice(0, 16);
+  const kv = Object.entries(d).filter(([k]) => k !== "events")
+    .map(([k, v]) => [esc(k), `<span class="num">${esc(JSON.stringify(v))}</span>`]);
+  rows($("detailkv"), ["field", "value"], kv);
+  const evs = (d.events || []).slice(-30).reverse().map(e => [
+    esc((e.task_id || "").slice(0, 12)), esc(e.name || ""), esc(e.state || ""),
+    esc(e.node || ""), esc(e.attempt ?? 0),
+    e.start_ts && e.ts ? `<span class="num">${(e.ts - e.start_ts).toFixed(3)}s</span>` : ""]);
+  rows($("detailevents"), ["task", "name", "state", "node", "attempt", "duration"], evs);
+  $("detailsec").scrollIntoView({behavior: "smooth"});
 }
 function spark(points, key, color) {
   const w = 260, h = 36;

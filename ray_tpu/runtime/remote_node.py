@@ -495,6 +495,8 @@ class RemoteNodeHandle:
         self.scheduler._queue_len = payload.get("queue_len", 0)
         self.scheduler._stats = payload.get("stats", {})
         self.cluster.metrics_history.add(self.node_id.hex(), payload.get("metrics"))
+        if "transfers" in payload:
+            self.transfer_stats = payload["transfers"]
         self.last_report = time.monotonic()
         self.cluster.control.nodes.heartbeat(
             self.node_id,

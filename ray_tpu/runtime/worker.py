@@ -208,6 +208,7 @@ class CoreWorker:
         for oid in return_ids:
             self.ref_counter.add_owned_object(oid)
         self.ref_counter.add_submitted_task_references([r.id() for r in deps])
+        spec.submit_time = time.time()
         self.cluster.task_manager.add_pending(spec)
         self.cluster.submit_actor_task(spec)
         return [ObjectRef(oid) for oid in return_ids]

@@ -124,3 +124,73 @@ def test_both_nodes_report_series_and_remote_logs_visible(dash_multihost):
     with urllib.request.urlopen(url + "/", timeout=10) as r:
         html = r.read().decode()
     assert "Node utilization" in html and "Node logs" in html
+
+
+def test_drilldowns_and_transfer_counters(dash_multihost):
+    """Round-4 VERDICT item 6 acceptance: a two-process cluster surfaces
+    per-task timing, per-actor state + its call history, and LIVE data-plane
+    byte counters through the dashboard REST API."""
+    import numpy as np
+
+    cluster, proc = dash_multihost
+    url = cluster.dashboard.url
+
+    @rt.remote(resources={"remote": 1}, execution="thread")
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, arr):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    # 1 MB by-REFERENCE arg: the agent resolves the dependency with a real
+    # data-plane pull (inline args ride the control spec and wouldn't count)
+    big_ref = rt.put(np.zeros(1 << 20, dtype=np.uint8))
+    for _ in range(3):
+        rt.get(c.bump.remote(big_ref), timeout=60)
+
+    # per-actor drill-down: state + its method-call task events
+    actors = _get(url + "/api/actors")["actors"]
+    aid = next(a["actor_id"] for a in actors if a["class_name"] == "Counter")
+    detail = _get(url + f"/api/actors/{aid[:16]}")
+    assert detail["state"] == "ALIVE", detail
+    assert detail["class_name"] == "Counter"
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        detail = _get(url + f"/api/actors/{aid[:16]}")
+        if len(detail.get("events", [])) >= 3:
+            break
+        time.sleep(0.5)
+    assert len(detail["events"]) >= 3, detail.get("events")
+    assert all(e["state"] == "FINISHED" for e in detail["events"][-3:])
+
+    # per-task drill-down: duration + event history for one of those calls
+    tid = detail["events"][-1]["task_id"]
+    task = _get(url + f"/api/tasks/{tid[:16]}")
+    assert task["task_id"] == tid and task["state"] == "FINISHED"
+    assert task.get("duration_s") is not None or task.get("total_s") is not None
+    assert task["events"], task
+
+    # live transfer counters: the agent moved >=3 MB of args; its piggyback
+    # snapshot must show nonzero data-plane bytes within a report cycle
+    deadline = time.monotonic() + 30
+    seen = {}
+    while time.monotonic() < deadline:
+        seen = _get(url + "/api/transfers")["nodes"]
+        moved = sum(
+            s.get(side, {}).get(counter, 0)
+            for s in seen.values()
+            for side in ("data_server", "data_client")
+            for counter in ("bytes_received", "bytes_sent")
+        )
+        if len(seen) >= 1 and moved > 0:
+            break
+        time.sleep(0.5)
+    assert seen and moved > 0, seen
+
+    # the UI page embeds the transfers panel + drill-down plumbing
+    with urllib.request.urlopen(url + "/", timeout=10) as r:
+        html = r.read().decode()
+    assert "Data-plane transfers" in html and "showDetail" in html
