@@ -31,7 +31,11 @@ from ray_tpu.data.read_api import (
     read_json,
     read_numpy,
     read_bigquery,
+    read_clickhouse,
+    read_databricks_tables,
     read_delta,
+    read_delta_sharing_tables,
+    read_hudi,
     read_iceberg,
     read_lance,
     read_mongo,
@@ -82,7 +86,11 @@ __all__ = [
     "read_images",
     "read_parquet",
     "read_bigquery",
+    "read_clickhouse",
+    "read_databricks_tables",
     "read_delta",
+    "read_delta_sharing_tables",
+    "read_hudi",
     "read_iceberg",
     "read_lance",
     "read_mongo",

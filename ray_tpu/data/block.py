@@ -40,6 +40,7 @@ class BlockMetadata:
     schema: Optional[Dict[str, Any]] = None
     input_files: List[str] = field(default_factory=list)
     exec_time_s: float = 0.0
+    cpu_time_s: float = 0.0
 
 
 def _as_array(values: Any) -> np.ndarray:
@@ -110,13 +111,19 @@ class BlockAccessor:
             return None
         return {k: (v.dtype, v.shape[1:]) for k, v in self._block.items()}
 
-    def get_metadata(self, input_files: Optional[List[str]] = None, exec_time_s: float = 0.0) -> BlockMetadata:
+    def get_metadata(
+        self,
+        input_files: Optional[List[str]] = None,
+        exec_time_s: float = 0.0,
+        cpu_time_s: float = 0.0,
+    ) -> BlockMetadata:
         return BlockMetadata(
             num_rows=self.num_rows(),
             size_bytes=self.size_bytes(),
             schema=self.schema(),
             input_files=input_files or [],
             exec_time_s=exec_time_s,
+            cpu_time_s=cpu_time_s,
         )
 
     # ------------------------------------------------------------- slicing

