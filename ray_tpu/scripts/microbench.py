@@ -20,16 +20,24 @@ from typing import Callable, Dict, List, Optional, Tuple
 BASELINES: Dict[str, Tuple[float, str]] = {
     "single_client_tasks_sync": (971.3, "tasks/s"),
     "single_client_tasks_async": (8194.0, "tasks/s"),
+    "single_client_tasks_and_get_batch": (8.14, "batches/s"),
     "multi_client_tasks_async": (21744.0, "tasks/s"),
     "1_1_actor_calls_sync": (2096.0, "calls/s"),
     "1_1_actor_calls_async": (9063.0, "calls/s"),
+    "1_1_actor_calls_concurrent": (5480.0, "calls/s"),
+    "1_n_actor_calls_async": (8606.0, "calls/s"),
+    "n_n_actor_calls_async": (27688.0, "calls/s"),
+    "n_n_actor_calls_with_arg_async": (2714.0, "calls/s"),
     "1_1_async_actor_calls_sync": (1326.0, "calls/s"),
     "1_1_async_actor_calls_async": (3314.0, "calls/s"),
-    "n_n_actor_calls_async": (27688.0, "calls/s"),
+    "n_n_async_actor_calls_async": (23093.0, "calls/s"),
     "single_client_put_calls": (5196.0, "puts/s"),
     "single_client_get_calls": (10270.0, "gets/s"),
+    "multi_client_put_calls": (12873.0, "puts/s"),
     "single_client_put_gigabytes": (20.1, "GB/s"),
+    "multi_client_put_gigabytes": (35.9, "GB/s"),
     "single_client_wait_1k_refs": (5.01, "waits/s"),
+    "single_client_get_object_containing_10k_refs": (13.3, "gets/s"),
     "placement_group_create_removal": (838.5, "ops/s"),
     # shm_put_gigabytes / hbm_put_gigabytes / hbm_get_gigabytes have NO
     # reference analogue (TPU-native axes) and carry no baseline: their
@@ -87,6 +95,9 @@ def run_suite(
         def m(self):
             return None
 
+        def m_arg(self, x):
+            return None
+
     class AsyncA:
         async def m(self):
             return None
@@ -103,6 +114,20 @@ def run_suite(
             "single_client_tasks_async",
             _rate(lambda: rt.get([noop.remote() for _ in range(batch)]), 10, warmup=2) * batch,
             "tasks/s",
+        )
+
+    if wanted("single_client_tasks_and_get_batch"):
+        # reference: ray_perf.py:131 — submit a 1k-task batch, get it; the
+        # rate is BATCHES per second (baseline 8.14)
+        batch = N(1000)
+
+        def tasks_and_get_batch():
+            rt.get([noop.remote() for _ in range(batch)])
+
+        record(
+            "single_client_tasks_and_get_batch",
+            _rate(tasks_and_get_batch, 8, warmup=2) * batch / 1000.0,
+            "batches/s",
         )
 
     if wanted("multi_client_tasks_async"):
@@ -157,6 +182,50 @@ def run_suite(
             )
         rt.kill(aa)
 
+    if wanted("1_1_actor_calls_concurrent"):
+        # reference: ray_perf.py:205 — one actor, max_concurrency=16
+        ca = A.options(max_concurrency=16).remote()
+        rt.get(ca.m.remote())
+        batch = N(500)
+        record(
+            "1_1_actor_calls_concurrent",
+            _rate(lambda: rt.get([ca.m.remote() for _ in range(batch)]), 8, warmup=2) * batch,
+            "calls/s",
+        )
+        rt.kill(ca)
+
+    if wanted("1_n_actor_calls_async"):
+        # reference: ray_perf.py:214-220 — ONE client actor fanning a batch
+        # across n server actors (nested submission from inside an actor)
+        n_servers = max(2, min(4, int(rt.cluster_resources().get("CPU", 2))))
+        servers = [A.remote() for _ in range(n_servers)]
+        rt.get([s.m.remote() for s in servers])
+
+        # num_cpus=0, like the reference's Client (ray_perf.py:38): with n
+        # servers already holding every CPU, a 1-CPU client would never
+        # schedule and the row would deadlock
+        @rt.remote(num_cpus=0)
+        class Client:
+            def __init__(self, servers):
+                self.servers = servers
+
+            def batch(self, per):
+                refs = []
+                for s in self.servers:
+                    refs.extend([s.m.remote() for _ in range(per)])
+                rt.get(refs)
+
+        client = Client.remote(servers)
+        per = N(250)
+        record(
+            "1_n_actor_calls_async",
+            _rate(lambda: rt.get(client.batch.remote(per)), 6, warmup=1) * per * n_servers,
+            "calls/s",
+        )
+        rt.kill(client)
+        for s in servers:
+            rt.kill(s)
+
     if wanted("n_n_actor_calls_async"):
         n = max(2, min(4, int(rt.cluster_resources().get("CPU", 2))))
         actors = [A.remote() for _ in range(n)]
@@ -179,6 +248,62 @@ def run_suite(
         for actor in actors:
             rt.kill(actor)
 
+    if wanted("n_n_actor_calls_with_arg_async"):
+        # reference: ray_perf.py:234-243 — n client actors, each fanning
+        # calls WITH a put-ref argument to its own server actor
+        n = max(2, min(4, int(rt.cluster_resources().get("CPU", 2))))
+        servers = [A.remote() for _ in range(n)]
+        rt.get([s.m.remote() for s in servers])
+
+        @rt.remote(num_cpus=0)
+        class ArgClient:
+            def __init__(self, server):
+                self.server = server
+
+            def batch_arg(self, per):
+                x = rt.put(0)
+                rt.get([self.server.m_arg.remote(x) for _ in range(per)])
+
+        clients = [ArgClient.remote(s) for s in servers]
+        per = N(200)
+
+        def round_():
+            rt.get([c.batch_arg.remote(per) for c in clients])
+
+        record(
+            "n_n_actor_calls_with_arg_async",
+            _rate(round_, 4, warmup=1) * per * n,
+            "calls/s",
+        )
+        for c in clients:
+            rt.kill(c)
+        for s in servers:
+            rt.kill(s)
+
+    if wanted("n_n_async_actor_calls_async"):
+        # reference: ray_perf.py:276-288 — n concurrent submitters against
+        # n ASYNC actors
+        n = max(2, min(4, int(rt.cluster_resources().get("CPU", 2))))
+        actors = [AsyncA.options(max_concurrency=8).remote() for _ in range(n)]
+        rt.get([a.m.remote() for a in actors])
+        per = N(500)
+
+        def caller(i):
+            rt.get([actors[(i + j) % n].m.remote() for j in range(per)])
+
+        rates = []
+        for _ in range(3):
+            threads = [threading.Thread(target=caller, args=(i,)) for i in range(n)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rates.append(n * per / (time.perf_counter() - t0))
+        record("n_n_async_actor_calls_async", sorted(rates)[1], "calls/s")
+        for actor in actors:
+            rt.kill(actor)
+
     # ---- put/get call rates ---------------------------------------------
     if wanted("single_client_put_calls"):
         small = np.zeros(1024, dtype=np.uint8)
@@ -187,6 +312,42 @@ def run_suite(
     if wanted("single_client_get_calls"):
         ref = rt.put(np.zeros(1024, dtype=np.uint8))
         record("single_client_get_calls", _rate(lambda: rt.get(ref), N(5000)), "gets/s")
+
+    if wanted("multi_client_put_calls"):
+        # reference: ray_perf.py:110-124 — 10 concurrent tasks each doing
+        # 100 nested puts (the put rate under multi-submitter contention)
+        @rt.remote
+        def do_put_small():
+            for _ in range(100):
+                rt.put(0)
+
+        def put_multi_small():
+            rt.get([do_put_small.remote() for _ in range(10)])
+
+        record(
+            "multi_client_put_calls",
+            _rate(put_multi_small, max(2, N(6)), warmup=1) * 1000,
+            "puts/s",
+        )
+
+    if wanted("single_client_get_object_containing_10k_refs"):
+        # reference: ray_perf.py:71-76,148-155 — a remote task creates an
+        # object holding 10k ObjectRefs; the client gets that object
+        n_refs = N(10_000)
+
+        @rt.remote
+        def create_object_containing_ref():
+            return [rt.put(1) for _ in range(n_refs)]
+
+        obj = create_object_containing_ref.remote()
+        got = rt.get(obj)
+        assert len(got) == n_refs
+        # normalize to the reference's 10k-ref object rate
+        record(
+            "single_client_get_object_containing_10k_refs",
+            _rate(lambda: rt.get(obj), N(60), warmup=5) * n_refs / 10_000.0,
+            "gets/s",
+        )
 
     if wanted("single_client_wait_1k_refs"):
         refs = [noop.remote() for _ in range(1000)]
@@ -230,6 +391,26 @@ def run_suite(
         rate = _rate(put_get_pair, pairs_per_round, warmup=1)
         record("single_client_put_gigabytes", rate * big.nbytes / 1e9, "GB/s")
         del big
+
+    if wanted("multi_client_put_gigabytes"):
+        # reference: ray_perf.py:138-146 — 10 concurrent tasks each doing
+        # 10 nested 80 MB puts; scaled to the box (N) with the same shape:
+        # concurrent submitters, bulk ndarray payloads
+        put_mb = 40
+        puts_per_task = 4
+        n_tasks = max(2, N(8))
+
+        @rt.remote
+        def do_put_big():
+            for _ in range(puts_per_task):
+                rt.put(np.zeros(put_mb * 1024 * 1024, dtype=np.uint8))
+
+        def put_multi_big():
+            rt.get([do_put_big.remote() for _ in range(n_tasks)])
+
+        bytes_per_round = n_tasks * puts_per_task * put_mb * 1024 * 1024
+        rate = _rate(put_multi_big, 3, warmup=1, rounds=3)
+        record("multi_client_put_gigabytes", rate * bytes_per_round / 1e9, "GB/s")
 
     if wanted("shm_put_gigabytes"):
         # The copy path a process boundary pays (plasma-role C++ shm arena):
