@@ -27,6 +27,7 @@ from ray_tpu.runtime.worker import CoreWorker, global_worker, set_global_worker
 _init_lock = threading.RLock()
 _cluster: Optional[Cluster] = None
 _prev_switch_interval: Optional[float] = None
+_prev_gc_threshold: Optional[tuple] = None
 
 
 def is_initialized() -> bool:
@@ -101,11 +102,28 @@ def init(
         if _prev_switch_interval is None:
             _prev_switch_interval = sys.getswitchinterval()
         sys.setswitchinterval(0.002)
+        # GC collections triggered every 700 allocations stall the submit
+        # path for whole batches (measured: periodic 3x throughput
+        # collapses on the async rows).  Raising the thresholds amortizes
+        # collections over bursts — cycles are still collected, just less
+        # often.  Measured equal to gc.freeze()-based tuning WITHOUT
+        # freeze's side effect of permanently exempting the embedding
+        # application's pre-init objects from cycle collection.  Restored
+        # at shutdown; opt out with gc_tune_on_init=False.  (The
+        # reference's drivers avoid this by keeping the hot path in C++,
+        # outside the Python GC entirely.)
+        if get_config().gc_tune_on_init:
+            import gc
+
+            global _prev_gc_threshold
+            if _prev_gc_threshold is None:
+                _prev_gc_threshold = gc.get_threshold()
+            gc.set_threshold(10_000, 20, 20)
         return cluster
 
 
 def shutdown() -> None:
-    global _cluster, _prev_switch_interval
+    global _cluster, _prev_switch_interval, _prev_gc_threshold
     with _init_lock:
         if _cluster is None:
             return
@@ -121,6 +139,11 @@ def shutdown() -> None:
             if _prev_switch_interval is not None:
                 sys.setswitchinterval(_prev_switch_interval)
                 _prev_switch_interval = None
+            if _prev_gc_threshold is not None:
+                import gc
+
+                gc.set_threshold(*_prev_gc_threshold)
+                _prev_gc_threshold = None
 
 
 def get_cluster() -> Cluster:

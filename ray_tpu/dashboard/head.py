@@ -128,6 +128,10 @@ class DashboardHead:
             req._send(200, self._cluster_status())
         elif path == "/api/transfers":
             req._send(200, self._transfer_stats())
+        elif path == "/api/data/datasets":
+            from ray_tpu.data.executor import recent_executions
+
+            req._send(200, {"executions": recent_executions()})
         elif path.startswith("/api/actors/"):
             req._send(200, self._actor_detail(path[len("/api/actors/"):]))
         elif path.startswith("/api/tasks/"):

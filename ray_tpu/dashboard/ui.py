@@ -51,6 +51,7 @@ INDEX_HTML = """<!doctype html>
   <section style="grid-column: 1 / -1; display:none" id="detailsec"><h2 id="detailtitle">Detail</h2>
     <table id="detailkv"></table><table id="detailevents" style="margin-top:8px"></table></section>
   <section style="grid-column: 1 / -1"><h2>Data-plane transfers</h2><table id="transfers"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Dataset executions</h2><table id="datasets"></table></section>
   <section style="grid-column: 1 / -1"><h2>Node utilization</h2><div id="util"></div></section>
   <section style="grid-column: 1 / -1"><h2>Node logs</h2>
     <div style="margin-bottom:8px">node: <select id="lognode" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d"></select></div>
@@ -160,6 +161,15 @@ async function refreshTransfers() {
   rows($("transfers"),
     ["node", "pulls srv/iss", "pushes in/out", "bytes out", "bytes in", "dev pack/restore", "ici pulls"],
     data.length ? data : [["(no transfer activity yet)", "", "", "", "", "", ""]]);
+  const dsets = await get("/api/data/datasets");
+  if (dsets) rows($("datasets"), ["pipeline", "when", "wall", "ops", "rows", "bytes"],
+    (dsets.executions || []).slice(-8).reverse().map(e => {
+      const last = e.ops[e.ops.length - 1] || {};
+      return [esc(e.name.slice(0, 48)), new Date(e.ts * 1000).toLocaleTimeString(),
+        `<span class="num">${e.wall_s.toFixed(2)}s</span>`, esc(e.ops.length),
+        `<span class="num">${last.rows_out ?? 0}</span>`,
+        `<span class="num">${fmtBytes(last.bytes_out)}</span>`];
+    }));
 }
 async function showDetail(kind, id) {
   const d = await get(`/api/${kind}/${id}`);

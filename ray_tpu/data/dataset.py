@@ -41,6 +41,11 @@ class Dataset:
             yield from executor.run()
         finally:
             self._last_stats = executor.stats()
+            # observable beyond this handle: the dashboard's Data panel
+            # lists recent executions (reference: Data dashboard module)
+            from ray_tpu.data.executor import record_execution
+
+            record_execution(L.plan_to_string(optimized).split("\n")[0], self._last_stats)
 
     def _collect_bundles(self) -> List[RefBundle]:
         return list(self._execute())

@@ -638,6 +638,41 @@ class StreamingExecutor:
         )
 
 
+# Recent dataset executions (name, wall-clock, per-op stats) for the
+# dashboard's Data panel — bounded ring, newest last.
+_recent_executions: deque = deque(maxlen=50)
+_recent_lock = threading.Lock()
+
+
+def record_execution(name: str, stats: "ExecutorStats") -> None:
+    with _recent_lock:
+        _recent_executions.append({"name": name, "ts": time.time(), "stats": stats})
+
+
+def recent_executions() -> List[dict]:
+    with _recent_lock:
+        items = list(_recent_executions)
+    return [
+        {
+            "name": it["name"],
+            "ts": it["ts"],
+            "wall_s": round(it["stats"].wall_s, 4),
+            "ops": [
+                {
+                    "name": op.name,
+                    "num_tasks": op.num_tasks,
+                    "rows_out": op.rows_out,
+                    "bytes_out": op.bytes_out,
+                    "task_time_s": round(op.task_time_s, 4),
+                    "cpu_time_s": round(op.cpu_time_s, 4),
+                }
+                for op in it["stats"].ops
+            ],
+        }
+        for it in items
+    ]
+
+
 def _mmmt(samples, fmt) -> str:
     """min/max/mean/total line in the reference's stats format."""
     if not samples:

@@ -175,6 +175,11 @@ def init_collective_group(world_size: int, rank: int, backend: str = "tpu", grou
         )
     with group.condition:
         group.local_ranks.add(rank)
+    # NOTE: stale death notices from a previous runtime incarnation are
+    # prevented at the source (cluster.shutdown marks the incarnation dead
+    # before async death handlers can write into fresh p2p state); clearing
+    # here would also erase GENUINE notices for a live group whose last
+    # rank inits after a peer died.
     # publish this rank's data-plane address immediately: senders must be
     # able to reach a rank that has not yet issued any collective call.
     # ensure_endpoint: process workers and the driver build their transport

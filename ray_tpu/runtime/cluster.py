@@ -448,6 +448,13 @@ class Cluster:
         from ray_tpu.runtime import p2p
         from ray_tpu.runtime.remote_node import RemoteNodeHandle
 
+        if self._snapshot_stop.is_set():
+            # this cluster is shutting down (or already gone): an async
+            # disconnect handler firing now must NOT write failure records
+            # into process-global p2p state — a NEXT runtime in this
+            # process may already own same-named groups
+            return
+
         group_list = sorted(groups)
         for g in group_list:
             p2p.fail_group(g, reason)
@@ -1208,6 +1215,10 @@ class Cluster:
         from ray_tpu.parallel.collective import reset_module_state
         from ray_tpu.runtime import p2p
 
+        # FIRST: mark this incarnation dead, so async handlers (node
+        # disconnects racing the teardown) stop writing into process-global
+        # p2p state the moment we start clearing it
+        self._snapshot_stop.set()
         p2p.clear_endpoint()
         # collective groups/counters index this runtime incarnation; a
         # survivor would desync the next init against fresh-born peers
@@ -1215,7 +1226,6 @@ class Cluster:
         with self._demand_cv:
             self._demand_stop = True
             self._demand_cv.notify_all()
-        self._snapshot_stop.set()
         if self._snapshot_thread is not None:
             self._snapshot_thread.join(timeout=10)
         cfg = get_config()

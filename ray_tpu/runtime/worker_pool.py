@@ -40,6 +40,13 @@ class WorkerHandle:
         self.alive = True
         self.last_idle_time = time.monotonic()
         self.send_lock = threading.Lock()
+        # outbound coalescing (see ProcessWorkerPool._sender_loop): a tight
+        # async submit loop naturally accumulates frames while the sender
+        # writes, so runs of actor calls collapse into actor_call_batch
+        # frames — the submit-side mirror of the worker's result flusher
+        self.send_cv = threading.Condition()
+        self.sendq: deque = deque()
+        self.sender_started = False
 
     def send(self, msg_type: str, payload: dict) -> None:
         with self.send_lock:
@@ -364,19 +371,72 @@ class ProcessWorkerPool:
         with self._lock:
             self._inflight[task_id] = callback
             self._inflight_worker[task_id] = worker
-        try:
-            worker.send(msg_type, payload)
-        except OSError:
-            # Deregister OUR callback first: if the death handler already ran
-            # (alive flipped by the reader thread), it would early-return and
-            # orphan it.
+        # async, order-preserving enqueue: a send failure surfaces through
+        # the sender loop's death handling, which fails every inflight
+        # callback (same path a mid-flight worker crash already takes)
+        self._send_async(worker, msg_type, payload)
+        if not worker.alive:
+            # death handler may have drained _inflight BEFORE we registered
+            # (check-register race): our callback would be orphaned and the
+            # caller would hang forever — fail it ourselves. pop returns
+            # None when the handler DID see it, so exactly one side fires.
             with self._lock:
                 cb = self._inflight.pop(task_id, None)
                 self._inflight_worker.pop(task_id, None)
                 self._inflight_start.pop(task_id, None)
-            self._handle_worker_death(worker)
             if cb is not None:
                 _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
+
+    def _send_async(self, worker: WorkerHandle, msg_type: str, payload: dict) -> None:
+        with worker.send_cv:
+            worker.sendq.append((msg_type, payload))
+            if not worker.sender_started:
+                worker.sender_started = True
+                threading.Thread(
+                    target=self._sender_loop, args=(worker,),
+                    name=f"worker-send-{worker.pid}", daemon=True,
+                ).start()
+            worker.send_cv.notify()
+
+    def _sender_loop(self, worker: WorkerHandle) -> None:
+        """Per-worker outbound writer.  Drains whatever accumulated since
+        the last write in ONE pass and collapses runs of consecutive
+        actor_call frames into actor_call_batch — tight async submitters
+        pay ~one pickle+syscall per BURST instead of per call, with zero
+        added latency when idle (lone frames flush immediately).  Total
+        frame order is preserved: everything rides this queue."""
+        while worker.alive:
+            with worker.send_cv:
+                while not worker.sendq:
+                    worker.send_cv.wait(timeout=1.0)
+                    if not worker.alive:
+                        return
+                batch = list(worker.sendq)
+                worker.sendq.clear()
+            try:
+                run: list = []
+                for msg_type, payload in batch:
+                    if msg_type == "actor_call":
+                        run.append(payload)
+                        continue
+                    self._flush_call_run(worker, run)
+                    run = []
+                    worker.send(msg_type, payload)
+                self._flush_call_run(worker, run)
+            except Exception:  # noqa: BLE001 — not just OSError: ANY send
+                # failure (pickling error mid-frame included) may have left
+                # the stream half-written; the connection is unusable and a
+                # silently-dead sender would hang every future call
+                self._handle_worker_death(worker)
+                return
+
+    def _flush_call_run(self, worker: WorkerHandle, run: list) -> None:
+        if not run:
+            return
+        if len(run) == 1:
+            worker.send("actor_call", run[0])
+        else:
+            worker.send("actor_call_batch", {"calls": run})
 
     def submit_batch_to_worker(self, worker: WorkerHandle, calls: list, cbs: list) -> None:
         """k actor calls in one IPC frame (``calls`` carry their task_ids;
@@ -390,18 +450,17 @@ class ProcessWorkerPool:
             for tid, cb in cbs:
                 self._inflight[tid] = cb
                 self._inflight_worker[tid] = worker
-        try:
-            worker.send("actor_call_batch", {"calls": calls})
-        except OSError:
+        # same ordered queue as single calls — a direct write here could
+        # overtake queued singles for the same actor and invert call order
+        self._send_async(worker, "actor_call_batch", {"calls": calls})
+        if not worker.alive:
+            # same check-register race as submit_to_worker
             with self._lock:
-                pending = [
-                    (tid, self._inflight.pop(tid, None)) for tid, _cb in cbs
-                ]
+                orphans = [(tid, self._inflight.pop(tid, None)) for tid, _cb in cbs]
                 for tid, _cb in cbs:
                     self._inflight_worker.pop(tid, None)
                     self._inflight_start.pop(tid, None)
-            self._handle_worker_death(worker)
-            for _tid, cb in pending:
+            for _tid, cb in orphans:
                 if cb is not None:
                     _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
 
@@ -498,6 +557,9 @@ class ProcessWorkerPool:
         if not worker.alive:
             return
         worker.alive = False
+        with worker.send_cv:
+            worker.sendq.clear()
+            worker.send_cv.notify_all()  # release the sender loop
         dead_tasks = []
         with self._lock:
             self._all.pop(worker.pid, None)

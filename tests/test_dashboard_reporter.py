@@ -194,3 +194,24 @@ def test_drilldowns_and_transfer_counters(dash_multihost):
     with urllib.request.urlopen(url + "/", timeout=10) as r:
         html = r.read().decode()
     assert "Data-plane transfers" in html and "showDetail" in html
+
+
+def test_data_panel_lists_recent_executions(dash_multihost):
+    """Dataset executions show up in the dashboard's Data panel with
+    per-op rows/bytes/timings (reference: the Data dashboard module)."""
+    from ray_tpu import data
+
+    cluster, proc = dash_multihost
+    url = cluster.dashboard.url
+
+    ds = data.range(100, parallelism=2).map_batches(lambda b: {"x": b["id"] + 1})
+    ds.materialize()
+
+    execs = _get(url + "/api/data/datasets")["executions"]
+    assert execs, "no executions recorded"
+    last = execs[-1]
+    assert last["wall_s"] >= 0 and last["ops"], last
+    total_rows = max(op["rows_out"] for op in last["ops"])
+    assert total_rows == 100, last
+    with urllib.request.urlopen(url + "/", timeout=10) as r:
+        assert "Dataset executions" in r.read().decode()
