@@ -78,7 +78,7 @@ class InProcessNodeProvider(NodeProvider):
                 # graceful: drain, then remove (reference DrainRaylet,
                 # node_manager.proto:391)
                 self._cluster.control.nodes.drain(node_id)
-                self._cluster.kill_node(node_id)
+                self._cluster.kill_node(node_id, reason="autoscaler terminated node")
                 return
 
     def non_terminated_nodes(self) -> Dict[str, str]:

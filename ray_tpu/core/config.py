@@ -76,10 +76,17 @@ class Config:
     actor_max_restarts: int = 0
     # Max bytes of lineage kept per worker (reference max_lineage_bytes).
     max_lineage_bytes: int = 1024**3
-    # Health-check period / failure threshold (reference
-    # gcs_health_check_manager.h).
-    health_check_period_s: float = 1.0
+    # Health-check period / failure threshold.  Tolerance matches the
+    # reference's GCS defaults (~25 s before a silent raylet is declared
+    # dead: period 3 s x threshold 5 + 10 s ping timeout,
+    # ray_config_def.h health_check_*_ms): period * threshold of report
+    # silence, then one ping with a health_check_ping_timeout_s budget.
+    # The old 1 s x 5 + 2 s ping (~7 s) false-positived on saturated
+    # 1-core hosts: a node mid-1 GiB-transfer can starve its report
+    # thread past 7 s and get killed while perfectly healthy.
+    health_check_period_s: float = 3.0
     health_check_failure_threshold: int = 5
+    health_check_ping_timeout_s: float = 10.0
     # How long an unschedulable task waits for capacity (e.g. autoscaler
     # scale-up) before failing as infeasible.
     infeasible_task_timeout_s: float = 30.0

@@ -217,3 +217,26 @@ class PlacementGroupID(BaseID):
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[_PG_UNIQUE_SIZE:])
+
+
+# --------------------------------------------------------------------------
+# Native tier: the C extension re-implements these types with C-speed
+# tp_hash/tp_richcompare (ids are the dict keys on every submit/result
+# path).  Semantics are identical — tests/test_native_ids.py asserts parity
+# class by class, and RAY_TPU_PURE_PY_IDS=1 keeps the Python classes (used
+# by the parity tests themselves, and as the fallback wherever the
+# toolchain can't build the extension).  All-or-nothing per process: mixing
+# C and Python id instances in one dict would break equality.
+if os.environ.get("RAY_TPU_PURE_PY_IDS") != "1":
+    try:
+        from ray_tpu.native import hotpath as _hotpath
+
+        JobID = _hotpath.JobID  # noqa: F811
+        NodeID = _hotpath.NodeID  # noqa: F811
+        WorkerID = _hotpath.WorkerID  # noqa: F811
+        ActorID = _hotpath.ActorID  # noqa: F811
+        TaskID = _hotpath.TaskID  # noqa: F811
+        ObjectID = _hotpath.ObjectID  # noqa: F811
+        PlacementGroupID = _hotpath.PlacementGroupID  # noqa: F811
+    except Exception:  # noqa: BLE001 — no compiler / load failure: Python tier
+        pass

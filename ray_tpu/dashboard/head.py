@@ -128,6 +128,8 @@ class DashboardHead:
             req._send(200, self._cluster_status())
         elif path == "/api/transfers":
             req._send(200, self._transfer_stats())
+        elif path == "/api/memory":
+            req._send(200, self._memory_summary())
         elif path == "/api/data/datasets":
             from ray_tpu.data.executor import recent_executions
 
@@ -285,6 +287,50 @@ class DashboardHead:
                     "device": device_plane.stats.snapshot(),
                 }
         return {"nodes": nodes}
+
+    def _memory_summary(self) -> dict:
+        """`ray memory` role for the browser: per-node object totals broken
+        down by storage tier, the largest live objects, and native shm-arena
+        occupancy where a node has one."""
+        from ray_tpu import state as state_api
+
+        objects = state_api.list_objects(limit=100_000)
+        nodes: dict = {}
+        for o in objects:
+            n = nodes.setdefault(
+                o["node_id"], {"count": 0, "bytes": 0, "tiers": {}}
+            )
+            n["count"] += 1
+            n["bytes"] += o["size_bytes"] or 0
+            tier = o["tier"] or "?"
+            t = n["tiers"].setdefault(tier, {"count": 0, "bytes": 0})
+            t["count"] += 1
+            t["bytes"] += o["size_bytes"] or 0
+        # polled every 2 s by the UI: top-k, not a full sort of 100k objects
+        import heapq
+
+        top = heapq.nlargest(15, objects, key=lambda o: o["size_bytes"] or 0)
+        arenas = {}
+        # snapshot: agents register concurrently with this request path
+        for nid, node in list(self.cluster.nodes.items()):
+            # remote agents piggyback their arena occupancy on resource
+            # reports (the arena lives in the agent process); in-proc nodes
+            # share the cluster's own arena (Cluster.shm_store -> ObjectStore._shm)
+            stats = getattr(node, "arena_stats", None)
+            if stats is None:
+                shm = getattr(getattr(node, "store", None), "_shm", None)
+                if shm is not None:
+                    try:
+                        stats = {
+                            "used": shm.used_bytes,
+                            "capacity": shm.capacity,
+                            "objects": shm.num_objects,
+                        }
+                    except OSError:
+                        stats = None
+            if stats is not None:
+                arenas[nid.hex()] = stats
+        return {"nodes": nodes, "top_objects": top, "arenas": arenas}
 
     def _actor_detail(self, prefix: str) -> dict:
         """Per-actor drill-down: FSM state + every task event of its method

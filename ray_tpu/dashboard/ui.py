@@ -50,6 +50,9 @@ INDEX_HTML = """<!doctype html>
   <section style="grid-column: 1 / -1"><h2>Recent tasks</h2><table id="tasks"></table></section>
   <section style="grid-column: 1 / -1; display:none" id="detailsec"><h2 id="detailtitle">Detail</h2>
     <table id="detailkv"></table><table id="detailevents" style="margin-top:8px"></table></section>
+  <section><h2>Placement groups</h2><table id="pgs"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Object memory</h2>
+    <table id="memnodes"></table><table id="memtop" style="margin-top:8px"></table></section>
   <section style="grid-column: 1 / -1"><h2>Data-plane transfers</h2><table id="transfers"></table></section>
   <section style="grid-column: 1 / -1"><h2>Dataset executions</h2><table id="datasets"></table></section>
   <section style="grid-column: 1 / -1"><h2>Node utilization</h2><div id="util"></div></section>
@@ -137,6 +140,30 @@ async function refresh() {
   await refreshUtil();
   await refreshLogs();
   await refreshTransfers();
+  await refreshMemory();
+}
+async function refreshMemory() {
+  const pgs = await get("/api/placement_groups");
+  if (pgs) rows($("pgs"), ["pg", "name", "state", "strategy", "bundles"],
+    (pgs.placement_groups || []).slice(0, 10).map(p => [
+      esc((p.placement_group_id || "").slice(0, 12)), esc(p.name || ""),
+      `<span class="${p.state === 'CREATED' ? 'ok' : ''}">${esc(p.state || "")}</span>`,
+      esc(p.strategy || ""), esc((p.bundles || []).length)]));
+  const mem = await get("/api/memory");
+  if (!mem) return;
+  rows($("memnodes"), ["node", "objects", "bytes", "by tier", "shm arena"],
+    Object.entries(mem.nodes || {}).map(([node, n]) => {
+      const arena = (mem.arenas || {})[node];
+      return [esc(node.slice(0, 12)), `<span class="num">${n.count}</span>`,
+        `<span class="num">${fmtBytes(n.bytes)}</span>`,
+        esc(Object.entries(n.tiers || {}).map(([t, v]) => `${t}:${v.count}`).join(" ")),
+        arena ? `<span class="num">${fmtBytes(arena.used)}</span> / ${fmtBytes(arena.capacity)} ${bar(arena.used, arena.capacity)}` : ""];
+    }));
+  rows($("memtop"), ["largest objects", "node", "tier", "size", "refs"],
+    (mem.top_objects || []).slice(0, 10).map(o => [
+      esc((o.object_id || "").slice(0, 16)), esc((o.node_id || "").slice(0, 12)),
+      esc(o.tier || ""), `<span class="num">${fmtBytes(o.size_bytes)}</span>`,
+      esc(o.ref_count == null ? "" : JSON.stringify(o.ref_count))]));
 }
 function fmtBytes(n) {
   if (n == null) return "";

@@ -1,0 +1,83 @@
+"""Loader for the native hot-path extension (``_hotpath.so``).
+
+The extension provides C implementations of the identifier types
+(``ray_tpu/core/ids.py`` aliases them when available) and the socket frame
+codec.  Role parity: the reference's Cython bridge (``python/ray/_raylet.pyx``
+wrapping ``src/ray/common/id.h``) keeps the same objects native.
+
+Builds on first use (``make -s -C ray_tpu/native _hotpath.so``) under a file
+lock — worker processes importing concurrently must not race the compiler.
+The Makefile writes to a temp name and renames atomically, so a reader can
+never dlopen a half-written library.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "_hotpath.so")
+
+
+_SRC_PATH = os.path.join(_DIR, "src", "hotpath.c")
+
+
+def _stale() -> bool:
+    """True when the binary is missing or older than its source — the same
+    staleness make would compute, for two stats instead of a fork/exec on
+    every process's import path (workers import this at spawn)."""
+    try:
+        return os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC_PATH)
+    except OSError:
+        return True
+
+
+def _build() -> None:
+    import fcntl
+    import sys
+
+    lock_path = os.path.join(_DIR, ".hotpath.build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if _stale():  # re-check under the lock: another process built it
+                # PYTHON= pins the headers to THIS interpreter's ABI
+                subprocess.run(
+                    ["make", "-s", "-C", _DIR, f"PYTHON={sys.executable}", "_hotpath.so"],
+                    check=True,
+                    capture_output=True,
+                )
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _load():
+    if _stale():
+        try:
+            _build()
+        except Exception:
+            # no toolchain: fall back to an existing binary if one is present
+            if not os.path.exists(_LIB_PATH):
+                raise
+    loader = importlib.machinery.ExtensionFileLoader("_hotpath", _LIB_PATH)
+    spec = importlib.util.spec_from_file_location("_hotpath", _LIB_PATH, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+_mod = _load()
+
+BaseID = _mod.BaseID
+JobID = _mod.JobID
+NodeID = _mod.NodeID
+WorkerID = _mod.WorkerID
+ActorID = _mod.ActorID
+TaskID = _mod.TaskID
+ObjectID = _mod.ObjectID
+PlacementGroupID = _mod.PlacementGroupID
+FrameDecoder = _mod.FrameDecoder
+send_frame = _mod.send_frame

@@ -190,22 +190,47 @@ class Guard {
 extern "C" {
 
 // Open (or create) the named segment.  Returns opaque handle or null.
+//
+// Exactly ONE process ever initializes a segment: creation races through
+// O_EXCL, and every other opener (a losing creator, or a worker mapping the
+// creator's arena) WAITS for the initializer's magic instead of checking it.
+// The old "init if magic missing" fallback was a real corruption: a worker
+// opening in the window between the creator's ftruncate and its magic store
+// would memset the header — including the process-shared mutex the creator
+// might already hold — and glibc later aborts on the trampled robust mutex
+// (observed as pthread_mutex_lock assertion failures under load, where the
+// creator can sit descheduled in that window for hundreds of ms).
 void* tstore_open(const char* name, uint64_t capacity, int create) {
   // The segment must hold the header (index) plus a useful arena.
   const uint64_t min_capacity = align_up(sizeof(Header), kAlign) + (1ULL << 20);
   if (create && capacity < min_capacity) capacity = min_capacity;
 
-  int flags = create ? (O_RDWR | O_CREAT) : O_RDWR;
-  int fd = shm_open(name, flags, 0600);
-  if (fd < 0) return nullptr;
+  bool initializer = false;
+  int fd = -1;
+  if (create) {
+    fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd >= 0) {
+      initializer = true;
+    } else if (errno != EEXIST) {
+      return nullptr;
+    }
+  }
+  if (fd < 0) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+  }
 
-  struct stat st;
-  fstat(fd, &st);
-  bool init = false;
-  if (create && static_cast<uint64_t>(st.st_size) < capacity) {
-    if (ftruncate(fd, capacity) != 0) { close(fd); return nullptr; }
-    init = (st.st_size == 0);
+  if (initializer) {
+    if (ftruncate(fd, capacity) != 0) { close(fd); shm_unlink(name); return nullptr; }
   } else {
+    // wait (bounded) for the initializer to size the segment
+    struct stat st;
+    for (int spin = 0; ; spin++) {
+      if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+      if (st.st_size > 0) break;
+      if (spin > 5000) { close(fd); return nullptr; }  // ~5s
+      usleep(1000);
+    }
     capacity = st.st_size;
   }
 
@@ -219,7 +244,7 @@ void* tstore_open(const char* name, uint64_t capacity, int create) {
   s->map_size = capacity;
   snprintf(s->name, sizeof(s->name), "%s", name);
 
-  if (init || s->hdr->magic != kMagic) {
+  if (initializer) {
     memset(s->hdr, 0, sizeof(Header));
     s->hdr->capacity = capacity;
     s->hdr->arena_offset = align_up(sizeof(Header), kAlign);
@@ -234,6 +259,13 @@ void* tstore_open(const char* name, uint64_t capacity, int create) {
     first->free = 1;
     __sync_synchronize();
     s->hdr->magic = kMagic;
+  } else {
+    // never initialize a segment someone else created: wait for its magic
+    for (int spin = 0; s->hdr->magic != kMagic; spin++) {
+      if (spin > 5000) { munmap(mem, capacity); delete s; return nullptr; }
+      usleep(1000);
+      __sync_synchronize();
+    }
   }
   return s;
 }

@@ -494,9 +494,10 @@ class NodeAgent:
         # stragglers below the batch threshold drain on the report tick
         # (_report_loop calls _flush_logs)
         self._register(rejoin=False)
-        threading.Thread(
+        self._report_thread = threading.Thread(
             target=self._report_loop, args=(self.conn,), name="agent-report", daemon=True
-        ).start()
+        )
+        self._report_thread.start()
 
     def _register(self, rejoin: bool, conn: Optional[rpc.RpcConnection] = None) -> None:
         payload = {
@@ -599,9 +600,10 @@ class NodeAgent:
             # teardown ran before the hook was armed: fire it ourselves
             self._on_disconnect(conn)
             return
-        threading.Thread(
+        self._report_thread = threading.Thread(
             target=self._report_loop, args=(conn,), name="agent-report", daemon=True
-        ).start()
+        )
+        self._report_thread.start()
 
     def _check_protocol(self, reply: dict) -> None:
         """Same-version-everywhere is the pickle-frame contract — verify it
@@ -791,6 +793,17 @@ class NodeAgent:
                         }
                     except Exception:  # noqa: BLE001 — stats must not kill reports
                         pass
+                    # shm-arena occupancy: the arena lives in THIS process,
+                    # so the head's /api/memory can only see it by piggyback
+                    if self.shm_store is not None:
+                        try:
+                            report["arena"] = {
+                                "used": self.shm_store.used_bytes,
+                                "capacity": self.shm_store.capacity,
+                                "objects": self.shm_store.num_objects,
+                            }
+                        except OSError:
+                            pass
                 conn.send("resource_report", report)
             except rpc.RpcError:
                 return
@@ -813,6 +826,13 @@ class NodeAgent:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # The report loop reads the shm arena header through ctypes; closing
+        # the store (munmap) under it is a use-after-free no except-clause
+        # can catch.  Join it (bounded — it wakes from its wait on _stop)
+        # before the arena goes away.
+        t = getattr(self, "_report_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
         if self.node is not None:
             self.node.shutdown()
         from ray_tpu.parallel.collective import reset_module_state

@@ -330,23 +330,35 @@ class Cluster:
                 q.alive = True
             self._pump_actor_queue(actor_id)
 
-    def kill_node(self, node_id: NodeID, expected=None) -> None:
+    def kill_node(self, node_id: NodeID, expected=None, reason: str = "") -> None:
         """Chaos hook: simulate node failure (NodeKillerActor parity,
         python/ray/_private/test_utils.py:1497).  ``expected`` guards the
         async disconnect path: if the agent already REJOINED (same node_id,
         fresh handle) by the time this runs, the stale death must not kill
         the new registration.  The lifecycle lock makes guard+teardown
-        atomic against a concurrent re-registration."""
+        atomic against a concurrent re-registration.  ``reason`` lands on
+        the handle and in the event log — "node died" without why is
+        undebuggable after the fact."""
         with self._node_lifecycle_lock:
             node = self.nodes.get(node_id)
             if node is None or node.dead:
                 return
             if expected is not None and node is not expected:
                 return
-            self._kill_node_locked(node_id, node)
+            self._kill_node_locked(node_id, node, reason=reason)
 
-    def _kill_node_locked(self, node_id: NodeID, node) -> None:
+    def _kill_node_locked(self, node_id: NodeID, node, reason: str = "") -> None:
         node.dead = True
+        node.death_reason = reason or "killed"
+        try:
+            from ray_tpu.observability.events import global_event_manager
+
+            global_event_manager().warning(
+                "NODE", "node_died",
+                f"node {node_id.hex()[:8]} died: {node.death_reason}",
+            )
+        except Exception:  # noqa: BLE001 — diagnostics must not block teardown
+            pass
         self.cluster_scheduler.remove_node(node_id)
         self.control.nodes.mark_dead(node_id)
         self.control.placement_groups.on_node_dead(node_id)

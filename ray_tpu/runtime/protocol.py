@@ -9,12 +9,24 @@ carries only control messages — ``src/ray/object_manager/plasma/protocol.h``).
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 from typing import Any, Tuple
 
 _LEN = struct.Struct("<I")
+
+# Native frame codec (ray_tpu/native/src/hotpath.c): C-buffered reads pull
+# many frames per recv syscall and sends skip the header+payload concat.
+# Same wire format either way — a native peer and a pure-Python peer
+# interoperate frame-for-frame.
+_native = None
+if os.environ.get("RAY_TPU_PURE_PY_FRAMES") != "1":
+    try:
+        from ray_tpu.native import hotpath as _native
+    except Exception:  # noqa: BLE001 — no compiler: pure-Python framing
+        _native = None
 
 # Arrays above this many bytes move via shm, not the socket.
 SHM_THRESHOLD = 256 * 1024
@@ -31,7 +43,13 @@ class ShmRef:
 
 def send_msg(sock: socket.socket, msg_type: str, payload: dict) -> None:
     data = pickle.dumps((msg_type, payload), protocol=5)
-    sock.sendall(_LEN.pack(len(data)) + data)
+    if _native is not None:
+        fd = sock.fileno()
+        if fd < 0:
+            raise ConnectionError("socket closed")
+        _native.send_frame(fd, data)
+    else:
+        sock.sendall(_LEN.pack(len(data)) + data)
 
 
 def recv_msg(sock: socket.socket) -> Tuple[str, dict]:
@@ -39,6 +57,32 @@ def recv_msg(sock: socket.socket) -> Tuple[str, dict]:
     (length,) = _LEN.unpack(header)
     data = _recv_exact(sock, length)
     return pickle.loads(data)
+
+
+class FrameReader:
+    """Per-connection buffered frame reader for a dedicated reader thread.
+
+    With the native codec, one recv syscall drains every frame the kernel
+    has buffered (a burst of coalesced results parses with no further
+    syscalls); without it, behaves exactly like ``recv_msg``.  Not
+    thread-safe — each socket's single reader loop owns one instance.
+    """
+
+    __slots__ = ("_sock", "_dec")
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._dec = _native.FrameDecoder() if _native is not None else None
+
+    def recv(self) -> Tuple[str, dict]:
+        if self._dec is None:
+            return recv_msg(self._sock)
+        # fileno() re-read per call: after close() it returns -1, so a
+        # reader racing a teardown can't recv on a recycled fd number
+        fd = self._sock.fileno()
+        if fd < 0:
+            raise ConnectionError("socket closed")
+        return pickle.loads(self._dec.read_frame(fd))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -497,6 +497,8 @@ class RemoteNodeHandle:
         self.cluster.metrics_history.add(self.node_id.hex(), payload.get("metrics"))
         if "transfers" in payload:
             self.transfer_stats = payload["transfers"]
+        if "arena" in payload:
+            self.arena_stats = payload["arena"]
         self.last_report = time.monotonic()
         self.cluster.control.nodes.heartbeat(
             self.node_id,
@@ -605,19 +607,28 @@ class HeadService:
         cfg = get_config()
         period = max(0.2, cfg.health_check_period_s)
         stale_after = period * max(2, cfg.health_check_failure_threshold)
+        ping_timeout = max(period, cfg.health_check_ping_timeout_s)
         while not self._stop.wait(period):
             for conn in self.server.connections():
                 handle = conn.peer
                 if handle is None or handle.dead:
                     continue
-                if time.monotonic() - handle.last_report < stale_after:
+                silent_s = time.monotonic() - handle.last_report
+                if silent_s < stale_after:
                     continue
                 try:
-                    conn.request("ping", {}, timeout=period * 2)
+                    conn.request("ping", {}, timeout=ping_timeout)
                     handle.last_report = time.monotonic()
                 except Exception:  # noqa: BLE001 — unresponsive: declare dead
                     if not handle.dead:
-                        self.cluster.kill_node(handle.node_id, handle)
+                        self.cluster.kill_node(
+                            handle.node_id,
+                            handle,
+                            reason=(
+                                f"health check failed: no report for {silent_s:.1f}s "
+                                f"and ping timed out after {ping_timeout:.0f}s"
+                            ),
+                        )
                     conn.close()
 
     # ------------------------------------------------------------------
@@ -846,5 +857,6 @@ class HeadService:
         # synchronously would self-deadlock.
         threading.Thread(
             target=self.cluster.kill_node, args=(handle.node_id, handle),
+            kwargs={"reason": "control connection to the node closed"},
             name="head-node-death", daemon=True,
         ).start()

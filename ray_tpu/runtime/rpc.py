@@ -33,12 +33,11 @@ import itertools
 import pickle
 import queue
 import socket
-import struct
 import threading
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
-_LEN = struct.Struct("<I")
+from ray_tpu.runtime.protocol import FrameReader, send_msg as _send_msg
 
 #: Wire-protocol version: bumped on any incompatible change to message
 #: shapes (the reference versions its protobuf schemas; pickle frames
@@ -101,9 +100,8 @@ class RpcConnection:
     # sending
     # ------------------------------------------------------------------
     def _send_frame(self, msg_type: str, payload: dict) -> None:
-        data = pickle.dumps((msg_type, payload), protocol=5)
         with self._send_lock:
-            self._sock.sendall(_LEN.pack(len(data)) + data)
+            _send_msg(self._sock, msg_type, payload)
 
     def send(self, msg_type: str, payload: dict) -> None:
         """One-way notification."""
@@ -169,12 +167,10 @@ class RpcConnection:
     # receiving
     # ------------------------------------------------------------------
     def _read_loop(self) -> None:
+        reader = FrameReader(self._sock)
         try:
             while not self._closed.is_set():
-                header = self._recv_exact(_LEN.size)
-                (length,) = _LEN.unpack(header)
-                data = self._recv_exact(length)
-                msg_type, payload = pickle.loads(data)
+                msg_type, payload = reader.recv()
                 if msg_type == "__reply__":
                     rid = payload.pop("_rid", None)
                     with self._pending_lock:
@@ -216,17 +212,6 @@ class RpcConnection:
                         f"[{self._name}] handler for {msg_type!r} failed:\n{traceback.format_exc()}",
                         file=sys.stderr,
                     )
-
-    def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        got = 0
-        while got < n:
-            chunk = self._sock.recv(min(n - got, 1 << 20))
-            if not chunk:
-                raise ConnectionError("socket closed")
-            chunks.append(chunk)
-            got += len(chunk)
-        return b"".join(chunks)
 
     # ------------------------------------------------------------------
     def _teardown(self) -> None:

@@ -101,9 +101,10 @@ class Worker:
         # preserved — ActorSchedulingQueue parity as before).
         exec_thread = threading.Thread(target=self._exec_loop, name="worker-exec", daemon=True)
         exec_thread.start()
+        reader = p.FrameReader(self._sock)
         while True:
             try:
-                msg_type, payload = p.recv_msg(self._sock)
+                msg_type, payload = reader.recv()
             except ConnectionError:
                 break
             if msg_type == "shutdown":

@@ -38,6 +38,9 @@ class WorkerHandle:
         self.known_fns: set = set()
         self.dedicated = False      # owned by an actor
         self.alive = True
+        # set once the death handler has finished notifying (actor FSM
+        # updated); orphaned-callback paths sequence behind it
+        self.death_done = threading.Event()
         self.last_idle_time = time.monotonic()
         self.send_lock = threading.Lock()
         # outbound coalescing (see ProcessWorkerPool._sender_loop): a tight
@@ -359,7 +362,7 @@ class ProcessWorkerPool:
             # death notification).  Deferred to a fresh thread: the caller
             # may hold the per-actor queue lock, and the error path re-enters
             # the queue pump (synchronous delivery self-deadlocks).
-            _defer_error(callback, WorkerCrashedError(f"worker {worker.pid} is dead"))
+            _defer_error(callback, WorkerCrashedError(f"worker {worker.pid} is dead"), after=worker.death_done)
             return
         payload = dict(payload)
         payload["task_id"] = task_id
@@ -385,7 +388,7 @@ class ProcessWorkerPool:
                 self._inflight_worker.pop(task_id, None)
                 self._inflight_start.pop(task_id, None)
             if cb is not None:
-                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
+                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"), after=worker.death_done)
 
     def _send_async(self, worker: WorkerHandle, msg_type: str, payload: dict) -> None:
         with worker.send_cv:
@@ -444,7 +447,7 @@ class ProcessWorkerPool:
         pickle+syscall submit cost that dominates the async actor path."""
         if not worker.alive:
             for _tid, cb in cbs:
-                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} is dead"))
+                _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} is dead"), after=worker.death_done)
             return
         with self._lock:
             for tid, cb in cbs:
@@ -462,7 +465,7 @@ class ProcessWorkerPool:
                     self._inflight_start.pop(tid, None)
             for _tid, cb in orphans:
                 if cb is not None:
-                    _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"))
+                    _defer_error(cb, WorkerCrashedError(f"worker {worker.pid} died"), after=worker.death_done)
 
     def release_actor_worker(self, worker: WorkerHandle) -> None:
         """Actor died/removed: kill its dedicated process."""
@@ -505,9 +508,10 @@ class ProcessWorkerPool:
         threading.Thread(target=run, name=f"worker-api-{worker.pid}", daemon=True).start()
 
     def _reader_loop(self, worker: WorkerHandle) -> None:
+        reader = protocol.FrameReader(worker.sock)
         while True:
             try:
-                msg_type, payload = protocol.recv_msg(worker.sock)
+                msg_type, payload = reader.recv()
             except (ConnectionError, OSError):
                 self._handle_worker_death(worker)
                 return
@@ -581,6 +585,9 @@ class ProcessWorkerPool:
         # corpse.
         if self._on_worker_death is not None and not self._shutdown:
             self._on_worker_death(worker)
+        # unblock orphaned-callback paths (check-register races) that
+        # sequence behind the notification above
+        worker.death_done.set()
         for task_id, callback, slot in dead_tasks:
             if callback is not None:
                 callback(None, WorkerCrashedError(f"worker {worker.pid} died"), None)
@@ -617,6 +624,8 @@ class ProcessWorkerPool:
             if slot is not None:
                 slot.event.set()  # empty slot: waiter falls through to the future
         worker.alive = False
+        # deliberate kill: there is no death notification to wait for
+        worker.death_done.set()
         with self._lock:
             self._all.pop(worker.pid, None)
         try:
@@ -717,10 +726,20 @@ class ProcessWorkerPool:
             pass
 
 
-def _defer_error(callback, error) -> None:
+def _defer_error(callback, error, after=None) -> None:
     """Deliver an error callback on its own thread (rare failure path).
     Synchronous delivery can self-deadlock: submit paths run under the
-    per-actor queue lock and error handling re-enters the queue pump."""
-    threading.Thread(
-        target=lambda: callback(None, error, None), name="deferred-error", daemon=True
-    ).start()
+    per-actor queue lock and error handling re-enters the queue pump.
+
+    ``after`` (an Event) sequences the callback behind the worker's death
+    notification: a retry fired from the callback must observe the
+    post-death actor state (RESTARTING + closed queue), or it burns
+    max_task_retries against the corpse.  Bounded wait — a stuck death
+    handler must not orphan the error forever."""
+
+    def run():
+        if after is not None:
+            after.wait(timeout=10.0)
+        callback(None, error, None)
+
+    threading.Thread(target=run, name="deferred-error", daemon=True).start()

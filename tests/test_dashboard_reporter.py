@@ -215,3 +215,53 @@ def test_data_panel_lists_recent_executions(dash_multihost):
     assert total_rows == 100, last
     with urllib.request.urlopen(url + "/", timeout=10) as r:
         assert "Dataset executions" in r.read().decode()
+
+
+def test_memory_and_placement_group_panels(dash_multihost):
+    """`ray memory` role in the browser: /api/memory aggregates per-node
+    object totals by tier, names the largest objects, and reports shm-arena
+    occupancy for nodes that have one; placement groups list alongside."""
+    import numpy as np
+
+    cluster, _proc = dash_multihost
+    base = cluster.dashboard.url
+
+    big = rt.put(np.arange(1 << 17, dtype=np.float64))  # 1 MiB, driver store
+
+    @rt.remote(resources={"remote": 1})
+    def produce():
+        return np.ones(1 << 16, np.float64)  # agent-side object
+
+    remote_ref = produce.remote()
+    rt.get(remote_ref)
+    pg = rt.util.placement_group([{"remote": 1}], strategy="PACK")
+    rt.get(pg.ready(), timeout=30)
+
+    mem = _get(base + "/api/memory")
+    total_objects = sum(n["count"] for n in mem["nodes"].values())
+    assert total_objects >= 2
+    assert any(
+        o["size_bytes"] >= (1 << 17) * 8 for o in mem["top_objects"]
+    ), mem["top_objects"][:3]
+    for n in mem["nodes"].values():
+        assert n["bytes"] == sum(t["bytes"] for t in n["tiers"].values())
+    # the agent node runs a native shm arena in ITS process; the occupancy
+    # piggybacks on resource reports, so give one report cycle to land
+    agent_hex = next(
+        nid.hex() for nid, n in cluster.nodes.items() if n is not cluster.head_node
+    )
+    deadline = time.monotonic() + 15
+    arena = None
+    while time.monotonic() < deadline:
+        arena = _get(base + "/api/memory")["arenas"].get(agent_hex)
+        if arena is not None:
+            break
+        time.sleep(0.3)
+    assert arena is not None, "agent arena occupancy never reached the head"
+    assert arena["capacity"] > 0 and arena["used"] >= 0
+
+    pgs = _get(base + "/api/placement_groups")["placement_groups"]
+    assert any(p["strategy"] == "PACK" for p in pgs)
+
+    del big, remote_ref
+    rt.util.remove_placement_group(pg)

@@ -28,18 +28,28 @@ def _spawn_agent(address, num_cpus=2, extra_resources='{"remote": 4}'):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.Popen(
-        [
-            sys.executable, "-m", "ray_tpu.runtime.agent",
-            "--address", address,
-            "--num-cpus", str(num_cpus),
-            "--resources", extra_resources,
-            "--labels", '{"zone": "agent-zone"}',
-        ],
-        env=env,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.PIPE,
-    )
+    # stderr goes to a per-pid file, not an unread PIPE: when a test fails
+    # because an agent silently died, the traceback (or its absence — clean
+    # exit vs crash) is the difference between a diagnosis and a shrug
+    log_dir = "/tmp/rt_agent_logs"
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, f"agent_{os.getpid()}_{time.monotonic_ns()}.log"), "w")
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.runtime.agent",
+                "--address", address,
+                "--num-cpus", str(num_cpus),
+                "--resources", extra_resources,
+                "--labels", '{"zone": "agent-zone"}',
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=log,
+        )
+    finally:
+        log.close()  # Popen duped the fd; keeping ours leaks one per agent
+    return proc
 
 
 def _wait_for_nodes(cluster, n, timeout=90.0):

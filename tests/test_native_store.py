@@ -88,3 +88,70 @@ print("child-ok")
 """
     out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo")
     assert "child-ok" in out.stdout, out.stderr
+
+
+def test_concurrent_open_single_initializer():
+    """Open-race regression: N processes race shm_open on the SAME name
+    (one passing create=True a moment before the rest pile in with
+    create=False reads).  Before the O_EXCL + wait-for-magic fix, a late
+    opener that saw the segment mid-initialization would re-memset the
+    header — including the live process-shared mutex — and the creator
+    process later died on the corrupted robust mutex.  Every opener must
+    see one consistently-initialized arena and read back the value."""
+    name = f"/rt_race_{os.getpid()}_{os.urandom(4).hex()}"
+    creator_code = f"""
+import sys
+from ray_tpu.native.shm_store import ShmObjectStore
+s = ShmObjectStore({name!r}, 16 << 20)
+s.put(b"k" * 20, b"race-proof")
+print("created")
+sys.stdout.flush()
+import time
+time.sleep(3)  # keep the segment alive while readers attach
+"""
+    reader_code = f"""
+from ray_tpu.native.shm_store import ShmObjectStore
+import time
+s = None
+for _ in range(500):  # segment may not exist yet: retry open (test-only —
+    try:               # real workers are handed an arena that already exists)
+        s = ShmObjectStore({name!r}, create=False)
+        break
+    except OSError:
+        time.sleep(0.01)
+assert s is not None, "segment never appeared"
+got = None
+for _ in range(200):
+    got = s.get(b"k" * 20)
+    if got is not None:
+        break
+    time.sleep(0.01)
+view, _ = got
+assert bytes(view) == b"race-proof", bytes(view)
+s.release(b"k" * 20)
+print("reader-ok")
+"""
+    creator = subprocess.Popen(
+        [sys.executable, "-c", creator_code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd="/root/repo",
+    )
+    # readers start IMMEDIATELY — before the creator has finished (or even
+    # begun) initializing; with the old magic-check fallback this is the
+    # corruption window
+    readers = [
+        subprocess.Popen(
+            [sys.executable, "-c", reader_code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd="/root/repo",
+        )
+        for _ in range(3)
+    ]
+    try:
+        for r in readers:
+            out, err = r.communicate(timeout=60)
+            assert "reader-ok" in out, err
+        creator.kill()
+    finally:
+        for p in readers + [creator]:
+            if p.poll() is None:
+                p.kill()
+        ShmObjectStore(name, 1 << 20).unlink()
