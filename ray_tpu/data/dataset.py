@@ -418,6 +418,297 @@ class Dataset:
         merged = concat_blocks([b for b in blocks if b])
         return BlockAccessor(merged).to_pandas()
 
+    # ------------------------------------------------- metadata (parity:
+    # dataset.py context/copy/names/types/input_files)
+    def context(self) -> DataContext:
+        return DataContext.get_current()
+
+    def copy(self) -> "Dataset":
+        return Dataset(_clone_plan(self._logical_op))
+
+    def names(self) -> Optional[List[str]]:
+        return self.columns()
+
+    def types(self) -> Optional[List[Any]]:
+        s = self.schema()
+        return list(s.values()) if s else None
+
+    def input_files(self) -> List[str]:
+        """Every file path feeding the plan's Read leaves."""
+        files: List[str] = []
+
+        def walk(op):
+            for i in op.inputs:
+                walk(i)
+            if isinstance(op, L.Read):
+                files.extend(getattr(op.datasource, "paths", []) or [])
+
+        walk(self._logical_op)
+        return files
+
+    # ---------------------------------------------- sampling / block order
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        """Keep each row independently with probability ``fraction``.  With a
+        seed, the mask is derived from (seed, block contents) so re-running
+        the plan reproduces the sample without coordinating block indices
+        across tasks."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def sample(batch):
+            import zlib
+
+            n = len(next(iter(batch.values()))) if batch else 0
+            if n == 0:
+                return batch
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                # crc32, not hash(): the fn runs in worker processes, where
+                # Python's salted hash() differs per process and would break
+                # the seeded-reproducibility contract on retries/re-runs
+                first = np.asarray(next(iter(batch.values())))
+                digest = zlib.crc32(first.tobytes()[:64], seed ^ n) & 0x7FFFFFFF
+                rng = np.random.default_rng(digest)
+            mask = rng.random(n) < fraction
+            return {k: np.asarray(v)[mask] for k, v in batch.items()}
+
+        sample.__name__ = f"random_sample[{fraction}]"
+        return self.map_batches(sample)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle WHOLE blocks (cheap coarse shuffle — no row movement).
+        Executes the plan; the result is a materialized dataset with its
+        block list permuted (parity: randomize_block_order)."""
+        mat = self.materialize()
+        order = np.random.default_rng(seed).permutation(len(mat._refs))
+        return MaterializedDataset(
+            [mat._refs[i] for i in order], [mat._metadata[i] for i in order]
+        )
+
+    # ------------------------------------------------------ indexed splits
+    def split_at_indices(self, indices: List[int]) -> List["MaterializedDataset"]:
+        """Split into len(indices)+1 datasets at the given GLOBAL row
+        offsets (parity: split_at_indices; boundary blocks are sliced by a
+        remote task, interior blocks move by reference)."""
+        if any(i < 0 for i in indices) or list(indices) != sorted(indices):
+            raise ValueError("indices must be non-negative and sorted")
+        mat = self.materialize()
+
+        @ray_tpu.remote
+        def slice_block(block, start: int, end: int):
+            return BlockAccessor(block).slice(start, end)
+
+        bounds = list(indices) + [None]  # None = rest
+        out: List[MaterializedDataset] = []
+        blocks = list(zip(mat._refs, mat._metadata))
+        bi = 0            # current block index
+        row_in_block = 0  # rows of blocks[bi] already consumed
+        global_row = 0
+        for bound in bounds:
+            refs: List[Any] = []
+            metas: List[BlockMetadata] = []
+            while bi < len(blocks):
+                ref, meta = blocks[bi]
+                n = meta.num_rows
+                remaining = n - row_in_block
+                if bound is None or global_row + remaining <= bound:
+                    # whole (rest of) block belongs to this split
+                    if row_in_block == 0:
+                        refs.append(ref)
+                        metas.append(meta)
+                    elif remaining > 0:
+                        sliced = slice_block.remote(ref, row_in_block, n)
+                        refs.append(sliced)
+                        metas.append(BlockMetadata(num_rows=remaining, size_bytes=0, schema=meta.schema))
+                    global_row += remaining
+                    bi += 1
+                    row_in_block = 0
+                    if bound is not None and global_row == bound:
+                        break
+                else:
+                    take = bound - global_row
+                    if take > 0:
+                        sliced = slice_block.remote(ref, row_in_block, row_in_block + take)
+                        refs.append(sliced)
+                        metas.append(BlockMetadata(num_rows=take, size_bytes=0, schema=meta.schema))
+                        row_in_block += take
+                        global_row = bound
+                    break
+            out.append(MaterializedDataset(refs, metas))
+        return out
+
+    def split_proportionately(self, proportions: List[float]) -> List["MaterializedDataset"]:
+        """Split by fractions; a final split receives the remainder
+        (parity: split_proportionately)."""
+        if not proportions or any(p <= 0 for p in proportions) or sum(proportions) >= 1.0:
+            raise ValueError("proportions must be positive and sum to < 1")
+        # materialize ONCE: count and the split must see the same execution
+        # (a second run would double the cost and can disagree on the total
+        # when an upstream op is nondeterministic)
+        mat = self.materialize()
+        total = mat.count()
+        indices = []
+        acc = 0
+        for p in proportions:
+            acc += int(total * p)
+            indices.append(acc)
+        return mat.split_at_indices(indices)
+
+    # -------------------------------------------- refs-based consumption
+    def get_internal_block_refs(self) -> List[Any]:
+        return self.materialize()._refs
+
+    def to_numpy_refs(self, *, column: Optional[str] = None) -> List[Any]:
+        """One ref per block: dict of numpy arrays (or one array when
+        ``column`` is given)."""
+
+        @ray_tpu.remote
+        def to_np(block):
+            return BlockAccessor(block).to_numpy(column)
+
+        return [to_np.remote(r) for r in self.materialize()._refs]
+
+    def to_pandas_refs(self) -> List[Any]:
+        @ray_tpu.remote
+        def to_pd(block):
+            return BlockAccessor(block).to_pandas()
+
+        return [to_pd.remote(r) for r in self.materialize()._refs]
+
+    def to_arrow_refs(self) -> List[Any]:
+        @ray_tpu.remote
+        def to_arrow(block):
+            return BlockAccessor(block).to_arrow()
+
+        return [to_arrow.remote(r) for r in self.materialize()._refs]
+
+    def to_torch(
+        self,
+        *,
+        label_column: Optional[str] = None,
+        feature_columns: Optional[List[str]] = None,
+        batch_size: int = 1,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+    ):
+        """A ``torch.utils.data.IterableDataset`` yielding
+        ``(features, label)`` tensor pairs (label None when no
+        ``label_column``) — parity: Dataset.to_torch."""
+        import torch
+
+        outer = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                it = outer.iter_torch_batches(
+                    batch_size=batch_size,
+                    drop_last=drop_last,
+                    local_shuffle_buffer_size=local_shuffle_buffer_size,
+                )
+                for batch in it:
+                    label = batch.pop(label_column) if label_column else None
+                    cols = feature_columns or list(batch)
+                    # consistent (B, num_cols) float contract regardless of
+                    # column count — a model must not change shape because
+                    # the feature list grew by one
+                    feats = torch.stack([batch[c].float() for c in cols], dim=1)
+                    yield feats, label
+
+        return _TorchIterable()
+
+    def to_random_access_dataset(self, key: str, *, num_workers: int = 4):
+        """Serve this dataset for random key lookups from a pool of actors
+        (parity: random_access_dataset.py)."""
+        from ray_tpu.data.random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
+    # ------------------------------------------------------------ lineage
+    def has_serializable_lineage(self) -> bool:
+        """True when the plan can be pickled and re-executed elsewhere —
+        i.e. every leaf is a Read (InputData holds process-local refs)."""
+
+        def ok(op) -> bool:
+            if isinstance(op, L.InputData):
+                return False
+            return all(ok(i) for i in op.inputs)
+
+        return ok(self._logical_op)
+
+    def serialize_lineage(self) -> bytes:
+        if not self.has_serializable_lineage():
+            raise ValueError(
+                "dataset lineage is not serializable: the plan contains "
+                "materialized InputData blocks (only Read-rooted plans can "
+                "be re-executed elsewhere)"
+            )
+        import cloudpickle
+
+        return cloudpickle.dumps(_clone_plan(self._logical_op))
+
+    @staticmethod
+    def deserialize_lineage(blob: bytes) -> "Dataset":
+        import pickle
+
+        return Dataset(pickle.loads(blob))
+
+    # ------------------------------------------------------ write tail
+    def write_images(self, path: str, column: str = "image", *, file_format: str = "png", **kw) -> None:
+        from ray_tpu.data.datasource import ImageWriteDatasource
+
+        kw.update({"column": column, "file_format": file_format})
+        self._write(ImageWriteDatasource([]), path, kw)
+
+    def write_webdataset(self, path: str, **kw) -> None:
+        from ray_tpu.data.datasource import WebDatasetWriteDatasource
+
+        self._write(WebDatasetWriteDatasource([]), path, kw)
+
+    def write_datasource(self, datasource, *, path: str = "", **write_args) -> None:
+        """Write through any Datasource with a ``write_block`` /
+        ``write`` surface (parity: write_datasource)."""
+        self._write(datasource, path, write_args)
+
+    # reference 2.9 renamed Datasource->Datasink on the write path; both
+    # spellings accept the same object here
+    write_datasink = write_datasource
+
+    def write_mongo(self, uri: str, database: str, collection: str, **kw) -> None:
+        raise ImportError(
+            "write_mongo requires the pymongo package, which is not "
+            "installed in this environment; write_json + a mongoimport "
+            "step, or write_sql against a DB-API driver, are the native "
+            "alternatives"
+        )
+
+    def write_bigquery(self, project_id: str, dataset: str, **kw) -> None:
+        raise ImportError(
+            "write_bigquery requires google-cloud-bigquery, which is not "
+            "installed in this environment; write_parquet to GCS + a "
+            "BigQuery load job is the native alternative"
+        )
+
+    # --------------------------------------- external-frame interop (gated)
+    def to_dask(self):
+        raise ImportError(
+            "to_dask requires the dask package, which is not installed; "
+            "to_pandas()/to_pandas_refs() or iter_batches() are the native "
+            "consumption paths"
+        )
+
+    def to_mars(self):
+        raise ImportError("to_mars requires the mars package, which is not installed")
+
+    def to_modin(self):
+        raise ImportError("to_modin requires the modin package, which is not installed")
+
+    def to_spark(self, spark=None):
+        raise ImportError(
+            "to_spark requires pyspark, which is not installed; "
+            "write_parquet + spark.read.parquet is the native alternative"
+        )
+
     def stats(self) -> str:
         if self._last_stats is None:
             return "(dataset not yet executed)"

@@ -66,6 +66,97 @@ def from_arrow(tables) -> Dataset:
     return from_blocks(tables)
 
 
+def _from_refs(refs: List[Any]) -> Dataset:
+    """Build a MaterializedDataset from already-stored block-convertible
+    refs: each is normalized to a Block by a remote task.  num_returns=2
+    keeps the normalized blocks in the store — the driver fetches only the
+    metadata (the *_refs APIs exist precisely so payloads never transit
+    the driver)."""
+    import ray_tpu
+
+    from ray_tpu.data.dataset import MaterializedDataset
+
+    @ray_tpu.remote
+    def normalize(obj):
+        block = BlockAccessor.for_block(obj).to_block()
+        return block, BlockAccessor(block).get_metadata()
+
+    task = normalize.options(num_returns=2)
+    block_refs, meta_refs = [], []
+    for r in refs:
+        b, m = task.remote(r)
+        block_refs.append(b)
+        meta_refs.append(m)
+    return MaterializedDataset(block_refs, ray_tpu.get(meta_refs))
+
+
+def from_numpy_refs(refs, *, column: str = "data") -> Dataset:
+    """Refs to ndarrays (or dicts of ndarrays) -> Dataset
+    (parity: from_numpy_refs)."""
+    if not isinstance(refs, list):
+        refs = [refs]
+
+    import ray_tpu
+
+    from ray_tpu.data.dataset import MaterializedDataset
+
+    @ray_tpu.remote
+    def normalize(obj):
+        block = {column: obj} if isinstance(obj, np.ndarray) else BlockAccessor.for_block(obj).to_block()
+        return block, BlockAccessor(block).get_metadata()
+
+    task = normalize.options(num_returns=2)
+    block_refs, meta_refs = [], []
+    for r in refs:
+        b, m = task.remote(r)
+        block_refs.append(b)
+        meta_refs.append(m)
+    return MaterializedDataset(block_refs, ray_tpu.get(meta_refs))
+
+
+def from_pandas_refs(refs) -> Dataset:
+    """Refs to pandas DataFrames -> Dataset (parity: from_pandas_refs)."""
+    return _from_refs(refs if isinstance(refs, list) else [refs])
+
+
+def from_arrow_refs(refs) -> Dataset:
+    """Refs to pyarrow Tables -> Dataset (parity: from_arrow_refs)."""
+    return _from_refs(refs if isinstance(refs, list) else [refs])
+
+
+def from_dask(df) -> Dataset:
+    raise ImportError(
+        "from_dask requires the dask package, which is not installed in "
+        "this environment; from_pandas(df.compute()) is the native path"
+    )
+
+
+def from_mars(df) -> Dataset:
+    raise ImportError("from_mars requires the mars package, which is not installed")
+
+
+def from_modin(df) -> Dataset:
+    raise ImportError(
+        "from_modin requires the modin package, which is not installed; "
+        "from_pandas(df._to_pandas()) is the native path"
+    )
+
+
+def from_spark(df, *, parallelism: int = -1) -> Dataset:
+    raise ImportError(
+        "from_spark requires pyspark, which is not installed; "
+        "df.write.parquet + read_parquet is the native path"
+    )
+
+
+def read_avro(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    raise ImportError(
+        "read_avro requires the fastavro package, which is not installed "
+        "in this environment; convert with fastavro to parquet/jsonl and "
+        "use read_parquet/read_json"
+    )
+
+
 def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
     return Dataset(L.Read(CSVDatasource(paths, **kw), _parallelism(parallelism)))
 
@@ -80,6 +171,16 @@ def read_numpy(paths, *, parallelism: int = -1, **kw) -> Dataset:
 
 def read_parquet(paths, *, parallelism: int = -1, **kw) -> Dataset:
     return Dataset(L.Read(ParquetDatasource(paths, **kw), _parallelism(parallelism)))
+
+
+def read_parquet_bulk(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    """Many small parquet files, one task per file, no directory expansion
+    or footer prefetch on the driver (parity: read_parquet_bulk)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    return Dataset(
+        L.Read(ParquetDatasource(list(paths), **kw), max(len(paths), _parallelism(parallelism)))
+    )
 
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:

@@ -125,8 +125,9 @@ def test_pure_python_fallback_parity():
 import os, pickle, sys
 assert os.environ["RAY_TPU_PURE_PY_IDS"] == "1"
 from ray_tpu.core import ids
-# must actually be the Python tier
-assert ids.TaskID.__module__ == "ray_tpu.core.ids" and not hasattr(ids.TaskID, "__base__") or True
+# must actually be the Python tier: pure-Python classes are heap types
+# (Py_TPFLAGS_HEAPTYPE, bit 9); the C extension's are static types
+assert ids.TaskID.__flags__ & (1 << 9), "expected the pure-Python id tier"
 import ray_tpu.native
 o = pickle.loads(sys.stdin.buffer.read())
 assert type(o) is ids.ObjectID
