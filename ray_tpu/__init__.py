@@ -32,8 +32,24 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.core.generator import ObjectRefGenerator
+from ray_tpu.core.ids import (
+    ActorClassID,
+    ActorID,
+    FunctionID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu import dag
+
+#: Streaming-generator return type under its reference alias
+#: (python/ray/_raylet.pyx DynamicObjectRefGenerator).
+DynamicObjectRefGenerator = ObjectRefGenerator
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -82,4 +98,127 @@ __all__ = [
     "RayTpuError",
     "TaskCancelledError",
     "WorkerCrashedError",
+    # ids
+    "ActorClassID",
+    "ActorID",
+    "DynamicObjectRefGenerator",
+    "FunctionID",
+    "JobID",
+    "NodeID",
+    "ObjectID",
+    "PlacementGroupID",
+    "TaskID",
+    "UniqueID",
+    "WorkerID",
+    # modes / misc
+    "LOCAL_MODE",
+    "SCRIPT_MODE",
+    "WORKER_MODE",
+    "Language",
+    "ClientBuilder",
+    "client",
+    "get_gpu_ids",
+    "show_in_dashboard",
+    "cpp_function",
+    "java_function",
+    "java_actor_class",
 ]
+
+# ------------------------------------------------------------------ misc
+# Driver-connection modes (reference python/ray/_private/worker.py:120 —
+# informational constants; the runtime infers its own mode).
+SCRIPT_MODE = 0
+WORKER_MODE = 1
+LOCAL_MODE = 2
+
+
+class Language:
+    """Cross-language markers (reference python/ray/cross_language.py).
+    PYTHON and CPP are live frontends here; JAVA is a declared non-goal
+    (README "Deliberate non-goals")."""
+
+    PYTHON = "PYTHON"
+    JAVA = "JAVA"
+    CPP = "CPP"
+
+
+def get_gpu_ids() -> list:
+    """Reference-parity accelerator accessor.  On TPU runtimes there are no
+    CUDA devices: returns the visible TPU chip indices instead, mirroring
+    how the reference returns assigned GPU ids inside a task
+    (python/ray/_private/worker.py get_gpu_ids)."""
+    from ray_tpu.accelerators import tpu
+
+    try:
+        return list(range(tpu.get_num_tpu_chips()))
+    except Exception:  # noqa: BLE001 — no accelerator visible
+        return []
+
+
+def show_in_dashboard(message: str, key: str = "") -> None:
+    """Publish a free-form driver message the dashboard surfaces
+    (reference worker.show_in_dashboard)."""
+    from ray_tpu.observability.events import global_event_manager
+
+    global_event_manager().info("DRIVER", key or "show_in_dashboard", str(message))
+
+
+class ClientBuilder:
+    """``ray_tpu.client("ray://host:port").connect()`` — builder parity
+    with the reference's ClientBuilder (python/ray/client_builder.py);
+    the connection itself is the thin client in util/client."""
+
+    def __init__(self, address: str):
+        self._address = address
+        self._kwargs: dict = {}
+
+    def connect(self):
+        from ray_tpu.util.client import connect as _connect
+
+        return _connect(self._address, **self._kwargs)
+
+
+def client(address: str) -> ClientBuilder:
+    """Reference-parity entry: ``ray_tpu.client(address)``."""
+    return ClientBuilder(address)
+
+
+def cpp_function(name: str):
+    """Handle to a C++-registered function by import name, callable with
+    .remote() through the C++ client protocol (reference
+    ray.cpp_function; see native/src/client.cpp + tests/test_cpp_client.py
+    for the live C++ frontend)."""
+    raise NotImplementedError(
+        "cross-language calls INTO C++ are issued from the C++ client "
+        "(native/src/client.cpp); Python-side cpp_function handles are not "
+        "implemented — expose the C++ logic as a task via the client "
+        "protocol instead"
+    )
+
+
+def java_function(class_name: str, function_name: str):
+    """Reference API surface; the JVM frontend is a declared non-goal
+    (README 'Deliberate non-goals')."""
+    raise NotImplementedError("the Java frontend is a declared non-goal; see README")
+
+
+def java_actor_class(class_name: str):
+    raise NotImplementedError("the Java frontend is a declared non-goal; see README")
+
+
+_LAZY_SUBMODULES = (
+    "accelerators", "air", "autoscaler", "data", "experimental", "job",
+    "models", "ops", "parallel", "rllib", "serve", "state", "train", "tune",
+    "util", "workflow",
+)
+
+
+def __getattr__(name: str):
+    # `import ray_tpu; ray_tpu.data.range(...)` works without paying every
+    # library's import cost at package import (the reference imports these
+    # eagerly; lazy attrs keep init() fast on 1-core hosts)
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

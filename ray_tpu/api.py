@@ -338,11 +338,21 @@ class RemoteFunction:
         return refs[0] if self._num_returns == 1 else refs
 
     def options(self, **new_options) -> "RemoteFunction":
+        # `_metadata` carries layer-specific options (the reference threads
+        # workflow options through it: `f.options(**workflow.options(...))`)
+        # — kept off the task-option surface and re-attached to the clone.
+        metadata = new_options.pop("_metadata", None)
         unknown = set(new_options) - _TASK_OPTION_KEYS
         if unknown:
             raise ValueError(f"Unknown task options: {unknown}")
         merged = {**self._options, **new_options}
-        return RemoteFunction(self._function, merged)
+        clone = RemoteFunction(self._function, merged)
+        if metadata is not None:
+            clone._metadata = dict(getattr(self, "_metadata", {}) or {})
+            clone._metadata.update(metadata)
+        elif getattr(self, "_metadata", None):
+            clone._metadata = dict(self._metadata)
+        return clone
 
     def bind(self, *args, **kwargs):
         """Lazy DAG construction (reference: dag_node.py bind)."""

@@ -219,6 +219,23 @@ class PlacementGroupID(BaseID):
         return JobID(self._bytes[_PG_UNIQUE_SIZE:])
 
 
+class UniqueID(BaseID):
+    """General-purpose 28-byte id (reference ``kUniqueIDSize=28``,
+    src/ray/common/id.h) — the base width of ids that don't embed lineage."""
+
+    SIZE = 28
+
+
+class FunctionID(UniqueID):
+    """Identifies a registered function (content hash width parity:
+    ``FunctionID``, src/ray/common/id.h)."""
+
+
+class ActorClassID(UniqueID):
+    """Identifies an exported actor class (``ActorClassID``,
+    src/ray/common/id.h)."""
+
+
 # --------------------------------------------------------------------------
 # Native tier: the C extension re-implements these types with C-speed
 # tp_hash/tp_richcompare (ids are the dict keys on every submit/result

@@ -466,10 +466,19 @@ class Dataset:
             else:
                 # crc32, not hash(): the fn runs in worker processes, where
                 # Python's salted hash() differs per process and would break
-                # the seeded-reproducibility contract on retries/re-runs
-                first = np.asarray(next(iter(batch.values())))
-                digest = zlib.crc32(first.tobytes()[:64], seed ^ n) & 0x7FFFFFFF
-                rng = np.random.default_rng(digest)
+                # the seeded-reproducibility contract on retries/re-runs.
+                # The digest covers EVERY column's full bytes — a prefix of
+                # the first column would give equal-size blocks sharing a
+                # constant lead column the identical keep-mask (correlated,
+                # non-uniform sampling)
+                digest = seed ^ n
+                for key in sorted(batch):
+                    arr = np.ascontiguousarray(np.asarray(batch[key]))
+                    if arr.dtype != object:
+                        digest = zlib.crc32(arr.tobytes(), digest)
+                    else:
+                        digest = zlib.crc32(repr(arr.tolist()).encode(), digest)
+                rng = np.random.default_rng(digest & 0x7FFFFFFF)
             mask = rng.random(n) < fraction
             return {k: np.asarray(v)[mask] for k, v in batch.items()}
 

@@ -39,7 +39,20 @@ class AlgorithmConfig:
         self.max_grad_norm: Optional[float] = 0.5
         self.hidden = (64, 64)
 
-    def environment(self, env: JaxEnv) -> "AlgorithmConfig":
+    def environment(self, env) -> "AlgorithmConfig":
+        # a string resolves through the shared tune registry
+        # (tune.register_env — the reference routes RLlib env names the
+        # same way, tune/registry.py)
+        if isinstance(env, str):
+            from ray_tpu.tune.experiment import get_env_creator
+
+            creator = get_env_creator(env)
+            if creator is None:
+                raise ValueError(
+                    f"unknown env name {env!r}: call "
+                    f"tune.register_env({env!r}, creator) first"
+                )
+            env = creator({})
         self.env = env
         return self
 

@@ -177,7 +177,14 @@ class Worker:
     def _encode_result(self, value):
         p = self._protocol
         encoded = p.encode_value(value, self._shm, self._next_shm_id)
-        return pickle.dumps(encoded, protocol=5)
+        try:
+            return pickle.dumps(encoded, protocol=5)
+        except (AttributeError, TypeError, pickle.PicklingError):
+            # results can carry closures (e.g. a workflow continuation DAG
+            # returned from a step) — same fallback policy as dumps_value
+            import cloudpickle
+
+            return cloudpickle.dumps(encoded, protocol=5)
 
     def _push_task_context(self, task_id: bytes):
         """Worker-side task context: TaskIDs are lineage-embedded (actor

@@ -8,8 +8,12 @@ batches; ``@serve.multiplexed`` LRU-caches many models per replica.
 """
 
 from ray_tpu.serve.api import (
+    HTTPOptions,
+    _run,
     delete,
+    get_app_handle,
     get_deployment_handle,
+    ingress,
     grpc_address,
     proxy_url,
     run,
@@ -22,6 +26,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 from ray_tpu.serve.llm import LLMEngine, LLMServer
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.replica import ReplicaContext, get_replica_context
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 __all__ = [
@@ -32,10 +37,15 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "HTTPOptions",
+    "ReplicaContext",
     "batch",
     "delete",
     "deployment",
+    "get_app_handle",
     "get_deployment_handle",
+    "get_replica_context",
+    "ingress",
     "get_multiplexed_model_id",
     "grpc_address",
     "multiplexed",

@@ -9,6 +9,7 @@ child Applications as DeploymentHandles passed to parent constructors
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import ray_tpu
@@ -24,6 +25,16 @@ _grpc_proxy = None
 _apps: Dict[str, DeploymentHandle] = {}  # app name -> ingress handle
 
 
+@dataclass
+class HTTPOptions:
+    """HTTP ingress options (parity: serve.config.HTTPOptions — the
+    subset the proxy honors; pass to ``serve.start(http_options=...)``)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    request_timeout_s: float = 30.0
+
+
 def start(
     *,
     http_host: str = "127.0.0.1",
@@ -31,11 +42,16 @@ def start(
     request_timeout_s: float = 30.0,
     grpc_port: Optional[int] = None,
     grpc_allow_pickle: bool = False,
+    http_options: Optional[HTTPOptions] = None,
 ):
     """Start the Serve instance (controller + HTTP proxy; pass ``grpc_port``
     — 0 for an ephemeral port — to also open the gRPC ingress, parity with
     the reference's gRPCOptions). ``grpc_allow_pickle`` enables the pickle
     payload codec — trusted networks only (pickle executes client bytes)."""
+    if http_options is not None:
+        http_host = http_options.host
+        http_port = http_options.port
+        request_timeout_s = http_options.request_timeout_s
     global _controller, _proxy, _grpc_proxy
     with _state_lock:
         if _controller is None:
@@ -100,6 +116,37 @@ def run_config(config) -> Dict[str, Any]:
 def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
     controller = _require_started()
     return DeploymentHandle(deployment_name, controller)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    """Handle to a running application's ingress deployment (parity:
+    serve.get_app_handle)."""
+    with _state_lock:
+        handle = _apps.get(name)
+    if handle is None:
+        raise KeyError(
+            f"no running Serve application named {name!r}; deployed apps: "
+            f"{sorted(_apps)}"
+        )
+    return handle
+
+
+def _run(app, *, name: str = "default", route_prefix: Optional[str] = "/", **_ignored) -> DeploymentHandle:
+    """Internal non-blocking deploy variant (reference serve._run — same
+    behavior here because run() already returns without blocking)."""
+    return run(app, name=name, route_prefix=route_prefix)
+
+
+def ingress(app):
+    """FastAPI ingress decorator (parity: serve.ingress).  The fastapi
+    package is not installed in this environment; plain deployments with
+    __call__ handlers and the HTTP proxy's route dispatch cover the
+    native ingress path."""
+    raise ImportError(
+        "serve.ingress requires the fastapi package, which is not installed "
+        "in this environment; define a deployment class with a __call__ "
+        "(request) handler and serve.run(app, route_prefix=...) instead"
+    )
 
 
 def status() -> Dict[str, Any]:

@@ -137,7 +137,11 @@ class ServeControllerActor:
                 execution="inproc",
                 max_concurrency=max(2, d.max_ongoing_requests),
                 **{k: v for k, v in d.ray_actor_options.items() if k in ("num_cpus", "num_tpus", "resources")},
-            ).remote(d.func_or_class, state.init_args, state.init_kwargs, d.user_config, is_function)
+            ).remote(
+                d.func_or_class, state.init_args, state.init_kwargs, d.user_config, is_function,
+                deployment=d.name,
+                replica_tag=f"{d.name}#{state.version}",
+            )
             state.replicas.append(replica)
             state.version += 1
         if len(state.replicas) > state.target_replicas:

@@ -7,23 +7,62 @@ applies ``reconfigure`` (user_config), and reports health.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 
 
+@dataclass(frozen=True)
+class ReplicaContext:
+    """What a replica knows about itself (parity: serve.context
+    ReplicaContext / serve.get_replica_context)."""
+
+    deployment: str
+    replica_tag: str
+    app_name: str = "default"
+    servable_object: Any = field(default=None, compare=False)
+
+
+_replica_context: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_serve_replica_context", default=None
+)
+
+
+def get_replica_context() -> ReplicaContext:
+    """Inside a replica (constructor or request), the replica's identity.
+    Contextvar-scoped: replicas can share a process (inproc execution) and
+    requests run on pool threads, so a module global would cross-talk."""
+    ctx = _replica_context.get()
+    if ctx is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called from within a Serve "
+            "replica (a deployment's constructor or request handler)"
+        )
+    return ctx
+
+
 @ray_tpu.remote
 class ReplicaActor:
-    def __init__(self, func_or_class, init_args, init_kwargs, user_config, is_function: bool):
+    def __init__(self, func_or_class, init_args, init_kwargs, user_config, is_function: bool,
+                 deployment: str = "", replica_tag: str = ""):
         self.is_function = is_function
-        if is_function:
-            self.callable = func_or_class
-        else:
-            self.callable = func_or_class(*init_args, **init_kwargs)
-            if user_config is not None and hasattr(self.callable, "reconfigure"):
-                self.callable.reconfigure(user_config)
+        self._context = ReplicaContext(deployment=deployment, replica_tag=replica_tag)
+        token = _replica_context.set(self._context)
+        try:
+            if is_function:
+                self.callable = func_or_class
+            else:
+                self.callable = func_or_class(*init_args, **init_kwargs)
+                if user_config is not None and hasattr(self.callable, "reconfigure"):
+                    self.callable.reconfigure(user_config)
+        finally:
+            _replica_context.reset(token)
+        if not is_function:
+            object.__setattr__(self._context, "servable_object", self.callable)
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
@@ -32,6 +71,7 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _replica_context.set(self._context)
         try:
             if self.is_function:
                 return self.callable(*args, **kwargs)
@@ -40,6 +80,7 @@ class ReplicaActor:
                 raise TypeError(f"deployment class {type(self.callable)} is not callable")
             return target(*args, **kwargs) if method != "__call__" else self.callable(*args, **kwargs)
         finally:
+            _replica_context.reset(token)
             with self._lock:
                 self._ongoing -= 1
 

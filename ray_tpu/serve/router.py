@@ -27,8 +27,9 @@ class DeploymentResponse:
     def __init__(self, ref):
         self._ref = ref
 
-    def result(self, timeout: Optional[float] = None) -> Any:
-        return ray_tpu.get(self._ref, timeout=timeout)
+    def result(self, timeout: Optional[float] = None, *, timeout_s: Optional[float] = None) -> Any:
+        # timeout_s: the reference's spelling (serve.handle.DeploymentResponse)
+        return ray_tpu.get(self._ref, timeout=timeout_s if timeout_s is not None else timeout)
 
     def _to_object_ref(self):
         return self._ref

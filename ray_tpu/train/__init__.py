@@ -7,7 +7,15 @@ metrics/checkpoints to the driver, and checkpoints are pytree directories.
 """
 
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.config import (
+    TRAIN_DATASET_KEY,
+    BackendConfig,
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+    SyncConfig,
+)
 from ray_tpu.train.session import (
     TrainContext,
     get_checkpoint,
@@ -22,10 +30,15 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
     Result,
     TorchTrainer,
+    TrainingIterator,
 )
 
 __all__ = [
+    "TRAIN_DATASET_KEY",
+    "BackendConfig",
     "BaseTrainer",
+    "SyncConfig",
+    "TrainingIterator",
     "Checkpoint",
     "CheckpointConfig",
     "DataConfig",

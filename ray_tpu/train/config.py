@@ -15,6 +15,35 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 
+#: Key under which a trainer's primary dataset is passed/looked up
+#: (reference: air/constants.py TRAIN_DATASET_KEY).
+TRAIN_DATASET_KEY = "train"
+
+
+@dataclass
+class SyncConfig:
+    """Driver<->storage sync knobs (reference: _internal/syncer.py
+    SyncConfig).  On this runtime, checkpoints/artifacts write straight to
+    ``RunConfig.storage_path`` (orbax/posix IO) — there is no separate
+    sync daemon — so these fields gate only whether trial artifacts are
+    mirrored at all."""
+
+    sync_period: float = 300.0
+    sync_timeout: float = 1800.0
+    sync_artifacts: bool = False
+
+
+class BackendConfig:
+    """Parent class for training-backend configurations (reference:
+    train/backend.py).  Concrete backends: the torch(gloo)/tf/jax trainer
+    setups in ``ray_tpu/train/trainer.py`` — subclass and override
+    ``backend_name`` for custom setups."""
+
+    @property
+    def backend_name(self) -> str:
+        return type(self).__name__.replace("Config", "").lower() or "custom"
+
+
 @dataclass
 class ScalingConfig:
     """How many workers × what resources each (reference: config.py:103).
@@ -69,3 +98,7 @@ class RunConfig:
     verbose: int = 0
     # tune experiment callbacks (air/integrations loggers plug in here)
     callbacks: Optional[list] = None
+    # stop criteria for Tune trials: {"metric": threshold} dict or a
+    # tune.Stopper (reference puts stop on air.RunConfig the same way)
+    stop: Optional[Any] = None
+    sync_config: Optional["SyncConfig"] = None

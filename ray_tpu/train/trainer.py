@@ -134,6 +134,11 @@ class DataParallelTrainer(BaseTrainer):
         self.train_loop_config = train_loop_config or {}
         self.dataset_config = dataset_config or DataConfig()
 
+    def training_iterator(self) -> "TrainingIterator":
+        """Stream rank-0 reports while the gang trains (one attempt,
+        caller-owned loop); ``fit()`` remains the retrying path."""
+        return TrainingIterator(self)
+
     # ------------------------------------------------------------------
     def fit(self) -> Result:
         name = self.run_config.name or f"{type(self).__name__}_{int(time.time())}"
@@ -206,6 +211,78 @@ class DataParallelTrainer(BaseTrainer):
             metrics_dataframe=history,
             error=error,
         )
+
+
+class TrainingIterator:
+    """Streamed per-report iteration over ONE training-gang run
+    (reference: train/trainer.py TrainingIterator — the internal iterator
+    fit() drains).  Yields rank-0 report rows as they arrive; ``result()``
+    afterwards returns the terminal :class:`Result`.  Unlike ``fit()`` it
+    does not retry on failure — the caller owns the loop."""
+
+    def __init__(self, trainer: "DataParallelTrainer"):
+        self._trainer = trainer
+        self._result: Optional[Result] = None
+
+    def __iter__(self):
+        t = self._trainer
+        name = t.run_config.name or f"{type(t).__name__}_{int(time.time())}"
+        storage = t.run_config.storage_path or os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        trial_dir = os.path.join(storage, name)
+        os.makedirs(trial_dir, exist_ok=True)
+        history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+        best_checkpoint = t.resume_from_checkpoint
+        error: Optional[BaseException] = None
+        group = WorkerGroup(t.scaling_config, name, trial_dir, execution=t._worker_execution)
+        group.start()
+
+        def drain_rank0():
+            # one drain of the group's buffered reports -> rank-0 rows
+            reports, _ = group.poll_all()
+            for rank, metrics, ckpt in reports:
+                if rank != 0:
+                    continue
+                row = dict(metrics)
+                history.append(row)
+                nonlocal last_metrics, best_checkpoint
+                last_metrics = row
+                if ckpt is not None:
+                    best_checkpoint = ckpt
+                yield row
+
+        try:
+            shards = t.dataset_config.configure(t.datasets, t.scaling_config.num_workers)
+            futures = group.run_async(
+                t.train_loop_per_worker, t.train_loop_config, shards, best_checkpoint
+            )
+            pending = list(futures)
+            done_refs: list = []
+            while pending:
+                finished, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.2)
+                ray_tpu.get(finished)
+                done_refs.extend(finished)
+                yield from drain_rank0()
+            ray_tpu.get(done_refs)
+            yield from drain_rank0()
+        except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
+            error = exc
+        finally:
+            group.shutdown()
+            self._result = Result(
+                metrics=last_metrics,
+                checkpoint=best_checkpoint,
+                path=trial_dir,
+                metrics_dataframe=history,
+                error=error,
+            )
+        if error is not None:
+            raise error
+
+    def result(self) -> Result:
+        if self._result is None:
+            raise RuntimeError("iterate the TrainingIterator to completion first")
+        return self._result
 
 
 class JaxTrainer(DataParallelTrainer):

@@ -108,7 +108,11 @@ class TuneController:
         max_failures_per_trial: int = 0,
         callbacks=None,
         num_samples: Optional[int] = None,
+        stop=None,
     ):
+        # stop criteria: {"metric": threshold} dict or a tune.Stopper
+        # (checked per result, before the scheduler's own decision)
+        self.stop = stop
         self.trainable = trainable
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
@@ -144,7 +148,17 @@ class TuneController:
         return trial
 
     def _start_trial(self, trial: Trial, checkpoint: Optional[Checkpoint] = None) -> None:
-        trial.actor = TrialRunnerActor.options(execution="inproc", max_concurrency=4).remote(trial.trial_id)
+        # with_resources() attaches per-trial requirements to the trainable
+        # (parity: tune.with_resources -> PlacementGroupFactory head bundle)
+        res = dict(getattr(self.trainable, "_tune_resources", None) or {})
+        opts: dict = {"execution": "inproc", "max_concurrency": 4}
+        if res:
+            opts["num_cpus"] = res.pop("CPU", 1)
+            if "TPU" in res:
+                opts["num_tpus"] = res.pop("TPU")
+            if res:
+                opts["resources"] = res
+        trial.actor = TrialRunnerActor.options(**opts).remote(trial.trial_id)
         ray_tpu.get(trial.actor.ping.remote())
         trial.future = trial.actor.run.remote(self.trainable, trial.config, checkpoint or trial.latest_checkpoint)
         trial.status = RUNNING
@@ -189,6 +203,19 @@ class TuneController:
             self.callbacks.on_trial_complete(trial)
         self._write_trial_state(trial)
 
+    def _stop_criteria_met(self, trial: Trial, metrics: dict) -> bool:
+        if self.stop is None:
+            return False
+        if isinstance(self.stop, dict):
+            return any(k in metrics and metrics[k] >= v for k, v in self.stop.items())
+        if callable(self.stop):  # tune.Stopper (or bare callable)
+            if bool(getattr(self.stop, "stop_all", lambda: False)()):
+                # experiment-wide stop: every trial, not just the reporter
+                self._stop_all = True
+                return True
+            return self.stop(trial.trial_id, metrics)
+        return False
+
     def _drain_reports(self, trials: List[Trial]) -> None:
         """Collect buffered reports from every running trial, then feed the
         scheduler in global iteration order — otherwise whichever trial is
@@ -213,6 +240,9 @@ class TuneController:
             self.callbacks.on_trial_result(trial, metrics)
             self.searcher.on_trial_result(trial.trial_id, metrics)
             if trial.status != RUNNING:
+                continue
+            if self._stop_criteria_met(trial, metrics):
+                self._stop_trial(trial)
                 continue
             decision = self.scheduler.on_trial_result(trial, metrics)
             if decision == STOP:
@@ -240,8 +270,15 @@ class TuneController:
     # ------------------------------------------------------------------
     def run(self) -> List[Trial]:
         """The experiment loop (parity: TuneController.step cycle)."""
+        self._stop_all = False
         while True:
             running = [t for t in self.trials if t.status == RUNNING]
+            if self._stop_all:
+                # a Stopper.stop_all() fired: stop every running trial and
+                # start nothing further — pending trials never launch
+                for t in running:
+                    self._stop_trial(t)
+                break
             # launch new trials up to the concurrency cap
             while len(running) < self.max_concurrent:
                 trial = self._make_trial()

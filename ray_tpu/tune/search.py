@@ -61,6 +61,62 @@ class QUniform(Domain):
         return round(rng.uniform(self.lower, self.upper) / self.q) * self.q
 
 
+class Normal(Domain):
+    def __init__(self, mean, sd):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class QNormal(Normal):
+    def __init__(self, mean, sd, q):
+        super().__init__(mean, sd)
+        self.q = q
+
+    def sample(self, rng):
+        return round(super().sample(rng) / self.q) * self.q
+
+
+class QLogUniform(LogUniform):
+    def __init__(self, lower, upper, q):
+        super().__init__(lower, upper)
+        self.q = q
+
+    def sample(self, rng):
+        # quantization clips BOTH ends: rounding up past `upper` would hand
+        # trials values outside the declared space (reference clips too)
+        return min(self.upper, max(self.lower, round(super().sample(rng) / self.q) * self.q))
+
+
+class LogRandInt(Domain):
+    def __init__(self, lower, upper, base=10):
+        self.lower, self.upper, self.base = lower, upper, base
+
+    def sample(self, rng):
+        lo = math.log(self.lower, self.base)
+        hi = math.log(self.upper, self.base)
+        return min(self.upper - 1, int(self.base ** rng.uniform(lo, hi)))
+
+
+class QLogRandInt(LogRandInt):
+    def __init__(self, lower, upper, q, base=10):
+        super().__init__(lower, upper, base)
+        self.q = q
+
+    def sample(self, rng):
+        return min(self.upper, max(self.lower, int(round(super().sample(rng) / self.q) * self.q)))
+
+
+class QRandInt(RandInt):
+    def __init__(self, lower, upper, q):
+        super().__init__(lower, upper)
+        self.q = q
+
+    def sample(self, rng):
+        return min(self.upper, max(self.lower, int(round(super().sample(rng) / self.q) * self.q)))
+
+
 class GridSearch:
     def __init__(self, values):
         self.values = list(values)
@@ -88,6 +144,30 @@ def quniform(lower, upper, q) -> QUniform:
 
 def grid_search(values) -> GridSearch:
     return GridSearch(values)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def qrandn(mean: float, sd: float, q: float) -> QNormal:
+    return QNormal(mean, sd, q)
+
+
+def qrandint(lower: int, upper: int, q: int) -> QRandInt:
+    return QRandInt(lower, upper, q)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> QLogUniform:
+    return QLogUniform(lower, upper, q)
+
+
+def lograndint(lower: int, upper: int, base: float = 10) -> LogRandInt:
+    return LogRandInt(lower, upper, base)
+
+
+def qlograndint(lower: int, upper: int, q: int, base: float = 10) -> QLogRandInt:
+    return QLogRandInt(lower, upper, q, base)
 
 
 def sample_from(fn: Callable[[dict], Any]):

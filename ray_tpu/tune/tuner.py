@@ -86,6 +86,16 @@ class Tuner:
     def fit(self) -> ResultGrid:
         trainable = self.trainable
         param_space = self.param_space
+        if isinstance(trainable, str):
+            # registry name (tune.register_trainable)
+            from ray_tpu.tune.experiment import get_trainable
+
+            trainable = get_trainable(trainable)
+        from ray_tpu.tune.experiment import Trainable as _ClassTrainable
+
+        if isinstance(trainable, type) and issubclass(trainable, _ClassTrainable):
+            stop = self.run_config.stop if isinstance(self.run_config.stop, dict) else None
+            trainable = trainable.as_function_trainable(stop=stop)
         if isinstance(trainable, BaseTrainer):
             # Train-on-Tune: the search space targets train_loop_config.
             param_space = dict(param_space.get("train_loop_config", param_space))
@@ -114,6 +124,7 @@ class Tuner:
             max_failures_per_trial=self.run_config.failure_config.max_failures,
             callbacks=self.run_config.callbacks,
             num_samples=self.tune_config.num_samples if custom_searcher else None,
+            stop=self.run_config.stop,
         )
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
@@ -129,6 +140,7 @@ def run(
     scheduler: Optional[TrialScheduler] = None,
     search_alg: Optional[Searcher] = None,
     max_concurrent_trials: int = 4,
+    stop=None,
     **kwargs,
 ) -> ResultGrid:
     """Functional entry point (parity: tune.run)."""
@@ -143,4 +155,5 @@ def run(
             search_alg=search_alg,
             max_concurrent_trials=max_concurrent_trials,
         ),
+        run_config=RunConfig(stop=stop) if stop is not None else None,
     ).fit()

@@ -9,14 +9,26 @@ semantics over the task fabric.
 
 from ray_tpu.workflow.api import (
     cancel,
+    continuation,
     delete,
+    get_metadata,
     get_output,
+    get_output_async,
     get_status,
     init,
     list_all,
+    options,
     resume,
+    resume_all,
+    resume_async,
     run,
     run_async,
+    sleep,
+)
+from ray_tpu.workflow.exceptions import (
+    WorkflowCancellationError,
+    WorkflowError,
+    WorkflowExecutionError,
 )
 from ray_tpu.workflow.events import (
     EventListener,
@@ -29,6 +41,16 @@ from ray_tpu.workflow.storage import WorkflowStorage
 
 __all__ = [
     "EventListener",
+    "WorkflowCancellationError",
+    "WorkflowError",
+    "WorkflowExecutionError",
+    "continuation",
+    "get_metadata",
+    "get_output_async",
+    "options",
+    "resume_all",
+    "resume_async",
+    "sleep",
     "QueueEventListener",
     "TimerListener",
     "WorkflowStorage",

@@ -9,11 +9,17 @@ skipping steps whose results are already durable.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.dag.dag_node import DAGNode
+from ray_tpu.workflow.exceptions import (
+    WorkflowCancellationError,
+    WorkflowError,
+    WorkflowExecutionError,
+)
 from ray_tpu.workflow.storage import WorkflowStorage
 
 RUNNING = "RUNNING"
@@ -23,6 +29,11 @@ CANCELED = "CANCELED"
 
 _storage: Optional[WorkflowStorage] = None
 _cancel_flags: Dict[str, threading.Event] = {}
+# workflow ids executing in THIS process right now — resume_all must not
+# start a second concurrent execution of one of them (the store says
+# RUNNING for both a crashed driver's orphan and a live in-flight run)
+_active_workflows: set = set()
+_active_lock = threading.Lock()
 
 
 def init(storage_dir: Optional[str] = None) -> None:
@@ -38,26 +49,31 @@ def _store() -> WorkflowStorage:
 
 
 # --------------------------------------------------------------- executor
-def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
+def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage, prefix: str = "") -> Any:
     """Topological replay: durable steps load from storage; the rest are
     submitted eagerly with upstream REFS as args — independent branches run
     in parallel and the fabric chains dependents — then results are fetched
     and checkpointed in topological order (at-least-once replay: a crash
-    between a step finishing and its checkpoint just reruns that step)."""
+    between a step finishing and its checkpoint just reruns that step).
+
+    ``prefix`` namespaces step keys for continuations: a step returning a
+    DAGNode (``workflow.continuation``) tail-calls into a fresh sub-plan
+    whose steps checkpoint under ``<parent-step>/``."""
     order = dag.topological()
     cancel_flag = _cancel_flags.setdefault(workflow_id, threading.Event())
     results: Dict[int, Any] = {}   # node id -> ObjectRef or durable value
     durable: Dict[int, bool] = {}
+    wf_options: Dict[int, dict] = {}  # per-step workflow.options
     keys: Dict[int, str] = {}
     for i, node in enumerate(order):
         # Step key = topological index → stable across replays of the same
         # persisted DAG object (DAGNode.topological is deterministic).
-        keys[id(node)] = f"step_{i:04d}"
+        keys[id(node)] = f"{prefix}step_{i:04d}"
 
     for node in order:
         if cancel_flag.is_set():
             store.set_status(workflow_id, CANCELED)
-            raise RuntimeError(f"workflow {workflow_id} canceled")
+            raise WorkflowCancellationError(f"workflow {workflow_id} canceled")
         key = keys[id(node)]
         if store.has_step(workflow_id, key):
             results[id(node)] = store.load_step(workflow_id, key)
@@ -72,6 +88,9 @@ def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
         # submit through the node's own RemoteFunction so bind-time options
         # (execution mode, resources, retries) survive the replay
         remote_fn = getattr(node, "_remote_function", None) or ray_tpu.remote(func)
+        wf_options[id(node)] = (getattr(remote_fn, "_metadata", None) or {}).get(
+            "workflow.io/options", {}
+        )
         results[id(node)] = remote_fn.remote(*args, **kwargs)
         durable[id(node)] = False
 
@@ -80,14 +99,38 @@ def _execute_dag(dag: DAGNode, workflow_id: str, store: WorkflowStorage) -> Any:
         # honored here, not just at submission.
         if cancel_flag.is_set():
             store.set_status(workflow_id, CANCELED)
-            raise RuntimeError(f"workflow {workflow_id} canceled")
+            raise WorkflowCancellationError(f"workflow {workflow_id} canceled")
         if not durable[id(node)]:
-            value = ray_tpu.get(results[id(node)])
-            store.save_step(workflow_id, keys[id(node)], value)
+            opts = wf_options.get(id(node), {})
+
+            def fetch_and_continue(ref, key=keys[id(node)]):
+                value = ray_tpu.get(ref)
+                if isinstance(value, DAGNode):
+                    # continuation: the step's durable value is the
+                    # sub-plan's final result; its steps checkpoint under
+                    # this step's key
+                    value = _execute_dag(value, workflow_id, store, prefix=f"{key}/")
+                return value
+
+            if opts.get("catch_exceptions"):
+                # durable value becomes (result, exception) — the step's
+                # failure is data, not a workflow failure; a continuation's
+                # failure is the step's failure too, so it runs inside the
+                # catch
+                try:
+                    value = (fetch_and_continue(results[id(node)]), None)
+                except WorkflowCancellationError:
+                    raise  # cancellation is never "data"
+                except Exception as exc:  # noqa: BLE001
+                    value = (None, exc)
+            else:
+                value = fetch_and_continue(results[id(node)])
+            if opts.get("checkpoint", True):
+                store.save_step(workflow_id, keys[id(node)], value)
             results[id(node)] = value
     if cancel_flag.is_set():
         store.set_status(workflow_id, CANCELED)
-        raise RuntimeError(f"workflow {workflow_id} canceled")
+        raise WorkflowCancellationError(f"workflow {workflow_id} canceled")
     return results[id(order[-1])]
 
 
@@ -98,12 +141,17 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
 
     store.save_dag(workflow_id, cloudpickle.dumps(dag))
     store.set_status(workflow_id, RUNNING)
+    with _active_lock:
+        _active_workflows.add(workflow_id)
     try:
         result = _execute_dag(dag, workflow_id, store)
     except BaseException:
         if store.get_status(workflow_id) != CANCELED:
             store.set_status(workflow_id, FAILED)
         raise
+    finally:
+        with _active_lock:
+            _active_workflows.discard(workflow_id)
     store.save_step(workflow_id, "__output__", result)
     store.set_status(workflow_id, SUCCESSFUL)
     return result
@@ -138,12 +186,17 @@ def resume(workflow_id: str) -> Any:
     if flag is not None:
         flag.clear()
     store.set_status(workflow_id, RUNNING)
+    with _active_lock:
+        _active_workflows.add(workflow_id)
     try:
         result = _execute_dag(dag, workflow_id, store)
     except BaseException:
         if store.get_status(workflow_id) != CANCELED:
             store.set_status(workflow_id, FAILED)
         raise
+    finally:
+        with _active_lock:
+            _active_workflows.discard(workflow_id)
     store.save_step(workflow_id, "__output__", result)
     store.set_status(workflow_id, SUCCESSFUL)
     return result
@@ -175,3 +228,145 @@ def cancel(workflow_id: str) -> None:
 def delete(workflow_id: str) -> None:
     _store().delete(workflow_id)
     _cancel_flags.pop(workflow_id, None)
+
+
+def resume_async(workflow_id: str):
+    """resume() on a background thread; returns a Future
+    (parity: workflow.resume_async)."""
+    from concurrent.futures import Future
+
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(resume(workflow_id))
+        except BaseException as exc:  # noqa: BLE001
+            fut.set_exception(exc)
+
+    threading.Thread(target=target, daemon=True, name=f"workflow-resume-{workflow_id}").start()
+    return fut
+
+
+def resume_all() -> List[tuple]:
+    """Resume every workflow persisted in RESUMABLE/FAILED/RUNNING state
+    (parity: workflow.resume_all — RUNNING covers a crashed driver whose
+    workflows never reached a terminal status).  Returns
+    ``[(workflow_id, future), ...]``."""
+    with _active_lock:
+        active = set(_active_workflows)
+    out = []
+    for wf in list_all():
+        if wf["workflow_id"] in active:
+            continue  # executing in this process right now — not an orphan
+        if wf["status"] in (RUNNING, FAILED, "RESUMABLE"):
+            out.append((wf["workflow_id"], resume_async(wf["workflow_id"])))
+    return out
+
+
+def get_output_async(workflow_id: str):
+    """Future for a workflow's durable output, waiting for completion if
+    it is still running (parity: workflow.get_output_async)."""
+    from concurrent.futures import Future
+
+    fut: Future = Future()
+    if _store().get_status(workflow_id) is None:
+        fut.set_exception(KeyError(f"no workflow {workflow_id!r}"))
+        return fut
+
+    def target():
+        try:
+            deadline = time.monotonic() + 3600.0
+            while time.monotonic() < deadline:
+                status = get_status(workflow_id)
+                if status == SUCCESSFUL:
+                    fut.set_result(get_output(workflow_id))
+                    return
+                if status in (FAILED, CANCELED):
+                    fut.set_exception(
+                        WorkflowExecutionError(f"workflow {workflow_id} ended {status}")
+                    )
+                    return
+                time.sleep(0.05)
+            fut.set_exception(TimeoutError(f"workflow {workflow_id} never completed"))
+        except BaseException as exc:  # noqa: BLE001
+            fut.set_exception(exc)
+
+    threading.Thread(target=target, daemon=True, name=f"workflow-output-{workflow_id}").start()
+    return fut
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    """Status + per-step durable-record summary
+    (parity: workflow.get_metadata)."""
+    store = _store()
+    status = store.get_status(workflow_id)
+    if status is None:
+        raise KeyError(f"no workflow {workflow_id!r}")
+    steps = store.list_steps(workflow_id) if hasattr(store, "list_steps") else []
+    return {
+        "workflow_id": workflow_id,
+        "status": status,
+        "stats": {"steps_recorded": len(steps)},
+        "step_names": steps,
+    }
+
+
+def sleep(duration_s: float):
+    """A durable sleep step: delays once, replays instantly
+    (parity: workflow.sleep — the wake time persists with the step, so a
+    resumed workflow doesn't re-wait)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _sleep(wake_at_monotonic_anchor: float, duration: float) -> float:
+        remaining = duration - (time.time() - wake_at_monotonic_anchor)
+        if remaining > 0:
+            time.sleep(remaining)
+        return duration
+
+    return _sleep.bind(time.time(), duration_s)
+
+
+def continuation(dag_node):
+    """Mark a DAG returned from a step as the workflow's continuation
+    (parity: workflow.continuation).  The executor tail-calls any DAGNode a
+    step returns — sub-steps checkpoint under the parent step's key — so
+    this is the explicit spelling of that contract."""
+    return dag_node
+
+
+_WORKFLOW_OPTION_KEYS = {"task_id", "metadata", "catch_exceptions", "checkpoint"}
+
+
+class options:
+    """Per-step workflow options, usable as a decorator or via
+    ``f.options(**workflow.options(...))`` (parity: workflow.api.options).
+
+    Honored by the executor: ``checkpoint=False`` skips the step's durable
+    record (it recomputes on replay); ``catch_exceptions=True`` makes the
+    step's durable value a ``(result, exception)`` pair instead of failing
+    the workflow.  ``task_id``/``metadata`` are recorded for bookkeeping.
+    """
+
+    def __init__(self, **workflow_options: Any):
+        invalid = set(workflow_options) - _WORKFLOW_OPTION_KEYS
+        if invalid:
+            raise ValueError(
+                f"Invalid workflow option keywords {invalid}; valid ones are "
+                f"{_WORKFLOW_OPTION_KEYS}"
+            )
+        self.options = {"_metadata": {"workflow.io/options": dict(workflow_options)}}
+
+    # mapping protocol: `f.options(**workflow.options(...))`
+    def keys(self):
+        return ("_metadata",)
+
+    def __getitem__(self, key):
+        return self.options[key]
+
+    def __call__(self, f):
+        from ray_tpu.api import RemoteFunction
+
+        if not isinstance(f, RemoteFunction):
+            raise ValueError("workflow.options applies to remote functions")
+        return f.options(**self)

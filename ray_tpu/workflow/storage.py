@@ -46,6 +46,19 @@ class WorkflowStorage:
         with open(self._step_path(workflow_id, step_key), "rb") as f:
             return pickle.load(f)
 
+    def list_steps(self, workflow_id: str) -> list:
+        """Durably-recorded step keys (continuation sub-steps included —
+        their keys carry the parent-step prefix path)."""
+        root = os.path.join(self._wf_dir(workflow_id), "steps")
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            rel = os.path.relpath(dirpath, root)
+            for f in files:
+                if f.endswith(".pkl"):
+                    key = f[: -len(".pkl")]
+                    out.append(key if rel == "." else f"{rel}/{key}")
+        return sorted(out)
+
     # ------------------------------------------------------------- status
     def set_status(self, workflow_id: str, status: str, extra: Optional[dict] = None) -> None:
         os.makedirs(self._wf_dir(workflow_id), exist_ok=True)

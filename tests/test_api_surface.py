@@ -1,0 +1,68 @@
+"""Top-level public-API surface parity (reference python/ray/__init__.py
+__all__): id types, connection-mode constants, Language markers, the
+ClientBuilder entry, accelerator accessors, and the cross-language stubs
+whose absence is a declared decision, not an accident.
+"""
+
+import pytest
+
+import ray_tpu as rt
+
+
+def test_id_types_exported():
+    assert rt.TaskID is not None and rt.ObjectID is not None
+    assert rt.UniqueID.SIZE == 28
+    assert issubclass(rt.FunctionID, rt.UniqueID)
+    assert issubclass(rt.ActorClassID, rt.UniqueID)
+    u = rt.UniqueID.from_random()
+    assert len(u.binary()) == 28 and not u.is_nil()
+    # the hot ids are the native tier; these cold ones are pure-Python —
+    # both live under the same import path
+    from ray_tpu.core import ids
+
+    assert rt.TaskID is ids.TaskID
+
+
+def test_modes_language_and_generator_alias():
+    assert (rt.SCRIPT_MODE, rt.WORKER_MODE, rt.LOCAL_MODE) == (0, 1, 2)
+    assert rt.Language.PYTHON == "PYTHON" and rt.Language.CPP == "CPP"
+    assert rt.DynamicObjectRefGenerator is rt.ObjectRefGenerator
+
+
+def test_client_builder():
+    b = rt.client("ray://127.0.0.1:1")
+    assert isinstance(b, rt.ClientBuilder)
+    # no server there: connect must fail cleanly, not hang
+    with pytest.raises(OSError):
+        b.connect()
+
+
+def test_get_gpu_ids_returns_list():
+    ids = rt.get_gpu_ids()
+    assert isinstance(ids, list)
+
+
+def test_cross_language_stubs_refuse():
+    with pytest.raises(NotImplementedError):
+        rt.java_function("com.example.C", "f")
+    with pytest.raises(NotImplementedError):
+        rt.java_actor_class("com.example.C")
+    with pytest.raises(NotImplementedError):
+        rt.cpp_function("f")
+
+
+def test_lazy_submodules_resolve():
+    import importlib
+
+    for name in ("data", "serve", "train", "tune", "workflow", "util", "state"):
+        assert getattr(rt, name) is importlib.import_module(f"ray_tpu.{name}")
+    with pytest.raises(AttributeError):
+        rt.not_a_module  # noqa: B018
+
+
+def test_show_in_dashboard_lands_in_events():
+    rt.show_in_dashboard("hello from the driver", key="greeting")
+    from ray_tpu.observability.events import global_event_manager
+
+    evs = global_event_manager().list_events(limit=50)
+    assert any(e.label == "greeting" for e in evs)

@@ -1,0 +1,181 @@
+"""Serve/Train/Workflow public-surface tail (reference __init__ __all__
+parity): replica context, app handles, HTTPOptions; TrainingIterator,
+SyncConfig/BackendConfig/TRAIN_DATASET_KEY; workflow continuations, typed
+errors, durable sleep, options, resume_all/get_output_async/get_metadata.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve, train, workflow
+
+
+@pytest.fixture(scope="module", autouse=True)
+def runtime():
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    rt.shutdown()
+
+
+# ------------------------------------------------------------------ serve
+def test_replica_context_and_app_handle():
+    seen = {}
+
+    @serve.deployment
+    class Echo:
+        def __init__(self):
+            ctx = serve.get_replica_context()
+            seen["init"] = (ctx.deployment, ctx.replica_tag)
+
+        def __call__(self, x):
+            return serve.get_replica_context().deployment, x
+
+    handle = serve.run(Echo.bind(), name="ctx_app")
+    dep, x = handle.remote("v").result(timeout_s=30)
+    assert dep == "Echo" and x == "v"
+    assert seen["init"][0] == "Echo" and seen["init"][1].startswith("Echo#")
+
+    same = serve.get_app_handle("ctx_app")
+    assert same.remote("w").result(timeout_s=30)[1] == "w"
+    with pytest.raises(KeyError):
+        serve.get_app_handle("nope")
+    # outside a replica: clean error
+    with pytest.raises(RuntimeError):
+        serve.get_replica_context()
+
+
+def test_http_options_and_ingress_gate():
+    assert serve.HTTPOptions().host == "127.0.0.1"
+    with pytest.raises(ImportError):
+        serve.ingress(object())
+
+
+# ------------------------------------------------------------------ train
+def test_training_iterator_streams_reports():
+    def loop(config):
+        for i in range(3):
+            train.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    trainer = train.DataParallelTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=2)
+    )
+    it = trainer.training_iterator()
+    rows = list(it)
+    assert [r["step"] for r in rows] == [0, 1, 2]  # rank-0 stream, in order
+    result = it.result()
+    assert result.metrics["step"] == 2 and result.error is None
+    with pytest.raises(RuntimeError):
+        train.DataParallelTrainer(loop).training_iterator().result()
+
+
+def test_train_config_surface():
+    assert train.TRAIN_DATASET_KEY == "train"
+    assert train.SyncConfig().sync_period == 300.0
+    assert train.BackendConfig().backend_name == "backend"
+
+    class JaxBackendConfig(train.BackendConfig):
+        pass
+
+    assert JaxBackendConfig().backend_name == "jaxbackend"
+
+
+# --------------------------------------------------------------- workflow
+def test_workflow_continuation_and_sleep(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @rt.remote
+    def tail(x):
+        return x * 10
+
+    @rt.remote
+    def head(x):
+        # a returned DAG is the workflow's continuation (tail call)
+        return workflow.continuation(tail.bind(x + 1))
+
+    out = workflow.run(head.bind(4), workflow_id="wf_cont")
+    assert out == 50
+    # sub-steps checkpoint under the parent step's key
+    meta = workflow.get_metadata("wf_cont")
+    assert meta["status"] == "SUCCESSFUL"
+    assert any("/" in k for k in meta["step_names"]), meta["step_names"]
+
+    t0 = time.monotonic()
+    assert workflow.run(workflow.sleep(0.3), workflow_id="wf_sleep") == 0.3
+    assert time.monotonic() - t0 >= 0.25
+    # replay: the sleep is durable, so resume returns instantly
+    t0 = time.monotonic()
+    workflow.resume("wf_sleep")
+    assert time.monotonic() - t0 < 0.25
+
+
+def test_workflow_options_and_errors(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @workflow.options(catch_exceptions=True)
+    @rt.remote
+    def flaky():
+        raise ValueError("expected")
+
+    result, err = workflow.run(flaky.bind(), workflow_id="wf_catch")
+    assert result is None and "expected" in str(err)
+
+    with pytest.raises(ValueError):
+        workflow.options(bogus_key=1)
+    assert issubclass(workflow.WorkflowCancellationError, RuntimeError)
+    assert issubclass(workflow.WorkflowExecutionError, workflow.WorkflowError)
+
+
+def test_workflow_async_and_resume_all(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    fut = workflow.run_async(add.bind(1, 2), workflow_id="wf_async")
+    assert fut.result(timeout=60) == 3
+    out = workflow.get_output_async("wf_async")
+    assert out.result(timeout=60) == 3
+
+    resumed = workflow.resume_all()
+    assert isinstance(resumed, list)
+
+
+def test_workflow_catch_exceptions_with_continuation(tmp_path):
+    # review regression: a continuation under catch_exceptions must
+    # tail-call (and absorb the sub-plan's failure as data)
+    workflow.init(str(tmp_path))
+
+    @rt.remote
+    def ok_tail(x):
+        return x + 100
+
+    @workflow.options(catch_exceptions=True)
+    @rt.remote
+    def outer(x):
+        return workflow.continuation(ok_tail.bind(x))
+
+    result, err = workflow.run(outer.bind(1), workflow_id="wf_cc")
+    assert result == 101 and err is None
+
+    @rt.remote
+    def boom_tail(x):
+        raise RuntimeError("sub-plan boom")
+
+    @workflow.options(catch_exceptions=True)
+    @rt.remote
+    def outer2(x):
+        return workflow.continuation(boom_tail.bind(x))
+
+    result, err = workflow.run(outer2.bind(1), workflow_id="wf_cc2")
+    assert result is None and "boom" in str(err)
+
+
+def test_get_output_async_unknown_id_fails_fast(tmp_path):
+    workflow.init(str(tmp_path))
+    fut = workflow.get_output_async("never_existed")
+    with pytest.raises(KeyError):
+        fut.result(timeout=5)

@@ -1,0 +1,210 @@
+"""Tune public-surface tail (parity: python/ray/tune/__init__.py __all__):
+the Trainable class API, Experiment/run_experiments/ExperimentAnalysis,
+Stopper-driven termination, registries, with_parameters/with_resources,
+sampling distributions, and the string factories.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module", autouse=True)
+def runtime():
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_sampling_distributions():
+    rng = random.Random(0)
+    assert -10 < tune.randn(0, 2).sample(rng) < 10
+    q = tune.qrandn(5, 1, 0.5).sample(rng)
+    assert abs(q / 0.5 - round(q / 0.5)) < 1e-9
+    for _ in range(50):
+        v = tune.lograndint(1, 1000).sample(rng)
+        assert 1 <= v < 1000 and isinstance(v, int)
+        v = tune.qrandint(10, 100, 10).sample(rng)
+        assert v % 10 == 0 and v >= 10
+        v = tune.qloguniform(0.001, 1.0, 0.001).sample(rng)
+        assert v >= 0.001
+        v = tune.qlograndint(1, 100, 5)._q_check() if False else tune.qlograndint(1, 100, 5).sample(rng)
+        assert isinstance(v, int) and v >= 1
+
+
+def test_class_trainable_with_stop_criteria():
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.base = config.get("base", 0.0)
+
+        def step(self):
+            return {"score": self.base + self.iteration}
+
+    grid = tune.run(
+        MyTrainable,
+        config={"base": tune.grid_search([0.0, 10.0])},
+        metric="score",
+        mode="max",
+        stop={"training_iteration": 3},
+    )
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 13.0  # base 10 + final iteration 3
+    assert all(r.metrics["training_iteration"] <= 3 for r in [grid[i] for i in range(len(grid))])
+
+
+def test_stopper_object():
+    calls = []
+
+    class ScoreStopper(tune.Stopper):
+        def __call__(self, trial_id, result):
+            calls.append(trial_id)
+            return result.get("score", 0) >= 2
+
+    def trainable(config):
+        import time
+
+        for i in range(100):
+            # reports buffer in the trial actor and the controller drains
+            # periodically — a sleep gives the stop decision a window to
+            # land (an instant 100-report burst outruns any async stopper)
+            tune.report({"score": i})
+            time.sleep(0.05)
+
+    grid = tune.run(trainable, config={}, metric="score", mode="max", stop=ScoreStopper())
+    assert calls
+    assert grid[0].metrics["score"] < 99  # interrupted well before the end
+
+
+def test_experiment_and_run_experiments():
+    def trainable(config):
+        tune.report({"val": config["x"] * 2})
+
+    exps = [
+        tune.Experiment(name="exp_a", run=trainable, config={"x": tune.grid_search([1, 2])},
+                        metric="val", mode="max"),
+        tune.Experiment(name="exp_b", run=trainable, config={"x": 5}, metric="val", mode="max"),
+    ]
+    out = tune.run_experiments(exps)
+    assert set(out) == {"exp_a", "exp_b"}
+    assert out["exp_a"].get_best_result().metrics["val"] == 4
+    analysis = tune.ExperimentAnalysis(out["exp_b"], metric="val", mode="max")
+    assert analysis.best_result.metrics["val"] == 10
+    assert len(analysis.dataframe()) == 1
+
+
+def test_register_trainable_by_name():
+    def trainable(config):
+        tune.report({"out": config["k"] + 1})
+
+    tune.register_trainable("my_trainable", trainable)
+    grid = tune.run("my_trainable", config={"k": 41}, metric="out", mode="max")
+    assert grid.get_best_result().metrics["out"] == 42
+    with pytest.raises(tune.TuneError):
+        tune.run("never_registered", config={})
+
+
+def test_with_parameters_injects_large_objects():
+    big = np.arange(100_000)
+
+    def trainable(config, data=None):
+        tune.report({"total": float(data.sum()) + config["off"]})
+
+    wrapped = tune.with_parameters(trainable, data=big)
+    grid = tune.run(wrapped, config={"off": 1.0}, metric="total", mode="max")
+    assert grid.get_best_result().metrics["total"] == float(big.sum()) + 1.0
+
+
+def test_with_resources_and_pgf():
+    pgf = tune.PlacementGroupFactory([{"CPU": 1}, {"CPU": 1}])
+    assert pgf.required_resources() == {"CPU": 2}
+
+    def trainable(config):
+        tune.report({"ok": 1})
+
+    wrapped = tune.with_resources(trainable, pgf)
+    assert wrapped._tune_resources == {"CPU": 1}
+    grid = tune.run(wrapped, config={}, metric="ok", mode="max")
+    assert grid.get_best_result().metrics["ok"] == 1
+
+
+def test_factories_and_misc():
+    s = tune.create_scheduler("asha")
+    from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+
+    assert isinstance(s, AsyncHyperBandScheduler)
+    g = tune.create_searcher("random", param_space={"x": tune.uniform(0, 1)})
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    assert isinstance(g, BasicVariantGenerator)
+    with pytest.raises(tune.TuneError):
+        tune.create_scheduler("nope")
+    assert isinstance(tune.ResumeConfig(), tune.ResumeConfig)
+    stopper = tune.MaximumIterationStopper(2)
+    assert stopper("t", {"training_iteration": 2})
+    assert not stopper("t", {"training_iteration": 1})
+
+
+def test_cli_reporter_throttles(capsys):
+    rep = tune.CLIReporter(max_report_frequency=0.0)
+
+    class FakeTrial:
+        trial_id = "t1"
+        status = "RUNNING"
+
+    rep.on_trial_result(FakeTrial(), {"loss": 0.5})
+    out = capsys.readouterr().out
+    assert "Tune progress" in out and "t1" in out
+
+
+def test_register_env_reaches_rllib():
+    from ray_tpu.rllib.algorithm import AlgorithmConfig
+
+    class DummyEnv:
+        pass
+
+    tune.register_env("my_env", lambda cfg: DummyEnv())
+    config = AlgorithmConfig().environment("my_env")
+    assert isinstance(config.env, DummyEnv)
+    with pytest.raises(ValueError):
+        AlgorithmConfig().environment("unregistered_env")
+
+
+def test_q_samplers_clip_to_bounds():
+    # review regression: rounding must never exceed the declared upper bound
+    rng = random.Random(1)
+    for _ in range(300):
+        assert 1 <= tune.qloguniform(1, 130, 50).sample(rng) <= 130
+        assert 1 <= tune.qlograndint(1, 130, 50).sample(rng) <= 130
+        assert 10 <= tune.qrandint(10, 95, 10).sample(rng) <= 95
+
+
+def test_stop_all_halts_whole_experiment():
+    import time as _time
+
+    class AfterFirstResult(tune.Stopper):
+        fired = False
+
+        def stop_all(self):
+            return AfterFirstResult.fired
+
+        def __call__(self, trial_id, result):
+            AfterFirstResult.fired = True
+            return False
+
+    def trainable(config):
+        for i in range(200):
+            tune.report({"i": i})
+            _time.sleep(0.03)
+
+    grid = tune.run(
+        trainable, config={"x": tune.grid_search([1, 2, 3, 4, 5, 6])},
+        metric="i", mode="max", max_concurrent_trials=2, stop=AfterFirstResult(),
+    )
+    # experiment-wide stop: pending trials never launched, nothing ran long
+    started = [grid[i] for i in range(len(grid)) if grid[i].metrics]
+    assert len(started) <= 3, [r.metrics for r in started]
+    assert all(r.metrics.get("i", 0) < 199 for r in started)
