@@ -477,7 +477,10 @@ class Dataset:
                     if arr.dtype != object:
                         digest = zlib.crc32(arr.tobytes(), digest)
                     else:
-                        digest = zlib.crc32(repr(arr.tolist()).encode(), digest)
+                        # per-element: no monolithic repr of the whole
+                        # column just to feed the checksum
+                        for item in arr.flat:
+                            digest = zlib.crc32(str(item).encode(), digest)
                 rng = np.random.default_rng(digest & 0x7FFFFFFF)
             mask = rng.random(n) < fraction
             return {k: np.asarray(v)[mask] for k, v in batch.items()}

@@ -23,6 +23,14 @@ class TuneError(RayTpuError):
     """Tune-layer failure (parity: tune.error.TuneError)."""
 
 
+def dict_stop_met(stop: Optional[dict], result: dict) -> bool:
+    """THE dict-stop policy ({"metric": threshold}, >= semantics) — one
+    definition shared by the class-trainable adapter (exact, in-loop) and
+    the controller (async, for function trainables) so the two can't
+    drift."""
+    return bool(stop) and any(k in result and result[k] >= v for k, v in stop.items())
+
+
 # --------------------------------------------------------------------------
 # Trainable: the class API
 # --------------------------------------------------------------------------
@@ -87,9 +95,7 @@ class Trainable:
                     # requested a stop — the cooperative interrupt point
                     result = t.train()
                     report(result)
-                    if stop and any(
-                        k in result and result[k] >= v for k, v in stop.items()
-                    ):
+                    if dict_stop_met(stop, result):
                         break
             finally:
                 t.stop()
@@ -184,15 +190,13 @@ def run_experiments(experiments: Union[Experiment, List[Experiment]]) -> Dict[st
         experiments = [experiments]
     out = {}
     for exp in experiments:
-        trainable = exp.run
-        if isinstance(trainable, type) and issubclass(trainable, Trainable):
-            trainable = trainable.as_function_trainable(stop=exp.stop)
         out[exp.name] = tune_run(
-            trainable,
+            exp.run,
             config=exp.config,
             num_samples=exp.num_samples,
             metric=exp.metric,
             mode=exp.mode,
+            stop=exp.stop,
         )
     return out
 
@@ -312,11 +316,20 @@ def with_parameters(trainable: Callable, **params) -> Callable:
 
 def with_resources(trainable: Callable, resources: Union[dict, "PlacementGroupFactory"]) -> Callable:
     """Attach per-trial resource requirements (parity: tune.with_resources);
-    the controller submits each trial's session actor with them."""
+    the controller submits each trial's session actor with them.  Wraps —
+    never mutates — so the caller's function stays resource-free and two
+    with_resources() calls on one trainable can't leak into each other."""
+    import functools
+
     if isinstance(resources, PlacementGroupFactory):
         resources = resources.head_bundle()
-    trainable._tune_resources = dict(resources)  # type: ignore[attr-defined]
-    return trainable
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        return trainable(config)
+
+    wrapped._tune_resources = dict(resources)  # type: ignore[attr-defined]
+    return wrapped
 
 
 class PlacementGroupFactory:

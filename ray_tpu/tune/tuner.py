@@ -42,8 +42,12 @@ class ResultGrid:
         return self._to_result(self._trials[i])
 
     def _to_result(self, t: Trial) -> Result:
+        metrics = dict(t.last_result)
+        # the trial's config rides with its metrics so analysis surfaces
+        # (ExperimentAnalysis.best_config) can answer "which config won"
+        metrics.setdefault("config", t.config)
         return Result(
-            metrics=t.last_result,
+            metrics=metrics,
             checkpoint=t.latest_checkpoint,
             path=t.trial_dir,
             metrics_dataframe=t.history,
@@ -94,6 +98,10 @@ class Tuner:
         from ray_tpu.tune.experiment import Trainable as _ClassTrainable
 
         if isinstance(trainable, type) and issubclass(trainable, _ClassTrainable):
+            # dict stops are ALSO checked inside the adapter loop: the
+            # push-model report buffer means the controller's async check
+            # alone lets a fast trial overshoot the exact iteration bound
+            # (both sides share dict_stop_met, so the policy can't drift)
             stop = self.run_config.stop if isinstance(self.run_config.stop, dict) else None
             trainable = trainable.as_function_trainable(stop=stop)
         if isinstance(trainable, BaseTrainer):

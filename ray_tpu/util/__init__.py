@@ -7,8 +7,21 @@ collective ops, scheduling strategies, serializability checking.
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util import collective, iter, pdb  # noqa: A004 — reference name
+from ray_tpu.util.client.worker import connect
+from ray_tpu.util.misc import (
+    deregister_serializer,
+    disable_log_once_globally,
+    enable_periodic_logging,
+    get_node_ip_address,
+    list_named_actors,
+    log_once,
+    register_serializer,
+)
 from ray_tpu.util.placement import (
     PlacementGroup,
+    get_current_placement_group,
+    get_placement_group,
     placement_group,
     placement_group_table,
     remove_placement_group,
@@ -18,10 +31,27 @@ from ray_tpu.runtime.scheduler import (
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu import accelerators
+
+
+def disconnect() -> None:
+    """Close the thin-client session opened by util.connect (parity:
+    ray.util.disconnect — connect() returns the context; keeping a module
+    handle on the last one mirrors the reference's global stub)."""
+    ctx = getattr(connect, "_last_context", None)
+    if ctx is not None:
+        ctx.disconnect()
+
 
 __all__ = [
     "ActorPool",
     "PlacementGroup",
+    "accelerators",
+    "collective",
+    "connect",
+    "disconnect",
+    "get_current_placement_group",
+    "get_placement_group",
     "placement_group",
     "placement_group_table",
     "remove_placement_group",
@@ -31,5 +61,14 @@ __all__ = [
     "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
     "Queue",
+    "deregister_serializer",
+    "disable_log_once_globally",
+    "enable_periodic_logging",
+    "get_node_ip_address",
     "inspect_serializability",
+    "iter",
+    "list_named_actors",
+    "log_once",
+    "pdb",
+    "register_serializer",
 ]

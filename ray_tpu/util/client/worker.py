@@ -256,4 +256,6 @@ def connect(address: str, **kw) -> ClientContext:
     ``"ray://host:port"``)."""
     if address.startswith("ray://"):
         address = address[len("ray://"):]
-    return ClientContext(address, **kw)
+    ctx = ClientContext(address, **kw)
+    connect._last_context = ctx  # ray.util.disconnect() closes the latest
+    return ctx

@@ -66,6 +66,53 @@ class PlacementGroup:
         return self._info.state is PlacementGroupState.CREATED
 
 
+def get_placement_group(name: str) -> PlacementGroup:
+    """Look up a live placement group by name (parity:
+    util.get_placement_group)."""
+    from ray_tpu.api import get_cluster
+
+    cluster = get_cluster()
+    for info in cluster.control.placement_groups.list_groups():
+        if info.name == name and info.state.name != "REMOVED":
+            return PlacementGroup(info)
+    raise ValueError(f"no placement group named {name!r}")
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The placement group the CURRENT actor was scheduled under, or None
+    (parity: util.get_current_placement_group).  Resolved from the actor's
+    creation spec — available for in-process/thread execution, where the
+    cluster state is reachable; process workers see None."""
+    from ray_tpu.api import get_cluster
+    from ray_tpu.runtime.context import task_context
+    from ray_tpu.runtime.scheduler import PlacementGroupSchedulingStrategy
+
+    current = task_context.current()
+    if current is None:
+        return None
+    task_id, _node = current
+    try:
+        cluster = get_cluster()
+    except Exception:  # noqa: BLE001 — no in-proc cluster (process worker)
+        return None
+    # actor tasks embed their ActorID: the creation spec carries the
+    # scheduling strategy the actor was placed with
+    actor_id = task_id.actor_id()
+    if actor_id.is_nil():
+        return None
+    spec = getattr(cluster, "_actor_specs", {}).get(actor_id)
+    strategy = getattr(spec, "scheduling_strategy", None)
+    if strategy is None:
+        return None
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        if isinstance(pg, PlacementGroup):
+            return pg
+        info = cluster.control.placement_groups.get(getattr(pg, "id", pg))
+        return PlacementGroup(info) if info is not None else None
+    return None
+
+
 def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",

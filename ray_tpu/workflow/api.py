@@ -145,6 +145,11 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
         _active_workflows.add(workflow_id)
     try:
         result = _execute_dag(dag, workflow_id, store)
+        # terminal status writes happen BEFORE the active-set discard: a
+        # resume_all() racing this window must see either "active" or a
+        # terminal status, never RUNNING+inactive (double execution)
+        store.save_step(workflow_id, "__output__", result)
+        store.set_status(workflow_id, SUCCESSFUL)
     except BaseException:
         if store.get_status(workflow_id) != CANCELED:
             store.set_status(workflow_id, FAILED)
@@ -152,8 +157,6 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
     finally:
         with _active_lock:
             _active_workflows.discard(workflow_id)
-    store.save_step(workflow_id, "__output__", result)
-    store.set_status(workflow_id, SUCCESSFUL)
     return result
 
 
@@ -190,6 +193,11 @@ def resume(workflow_id: str) -> Any:
         _active_workflows.add(workflow_id)
     try:
         result = _execute_dag(dag, workflow_id, store)
+        # terminal status writes happen BEFORE the active-set discard: a
+        # resume_all() racing this window must see either "active" or a
+        # terminal status, never RUNNING+inactive (double execution)
+        store.save_step(workflow_id, "__output__", result)
+        store.set_status(workflow_id, SUCCESSFUL)
     except BaseException:
         if store.get_status(workflow_id) != CANCELED:
             store.set_status(workflow_id, FAILED)
@@ -197,8 +205,6 @@ def resume(workflow_id: str) -> Any:
     finally:
         with _active_lock:
             _active_workflows.discard(workflow_id)
-    store.save_step(workflow_id, "__output__", result)
-    store.set_status(workflow_id, SUCCESSFUL)
     return result
 
 
@@ -275,8 +281,9 @@ def get_output_async(workflow_id: str):
 
     def target():
         try:
-            deadline = time.monotonic() + 3600.0
-            while time.monotonic() < deadline:
+            # no deadline of our own: a workflow may legitimately run for
+            # hours — the caller's fut.result(timeout=...) owns the budget
+            while True:
                 status = get_status(workflow_id)
                 if status == SUCCESSFUL:
                     fut.set_result(get_output(workflow_id))
@@ -286,8 +293,7 @@ def get_output_async(workflow_id: str):
                         WorkflowExecutionError(f"workflow {workflow_id} ended {status}")
                     )
                     return
-                time.sleep(0.05)
-            fut.set_exception(TimeoutError(f"workflow {workflow_id} never completed"))
+                time.sleep(0.2)
         except BaseException as exc:  # noqa: BLE001
             fut.set_exception(exc)
 

@@ -1,0 +1,13 @@
+"""Module-level helper types for util tests (custom-serializer targets
+must be importable by module+qualname for the deserializer lookup)."""
+
+
+class Opaque:
+    """Unpicklable by default — only a registered custom serializer can
+    move it."""
+
+    def __init__(self, v):
+        self.v = v
+
+    def __reduce__(self):
+        raise TypeError("Opaque is not directly picklable")
