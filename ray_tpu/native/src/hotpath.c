@@ -18,6 +18,7 @@
 
 #include <errno.h>
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/random.h>
 #include <sys/socket.h>
@@ -687,6 +688,15 @@ typedef struct {
 
 #define DECODER_INITIAL_CAP (256 * 1024)
 #define DECODER_SHRINK_CAP (4 * 1024 * 1024)
+/* Largest frame we will ever reserve for.  Legit frames are pickled RPC
+ * messages (bulk objects ride the shm arena / chunked data plane, not one
+ * frame), so 1 GiB is far above real traffic while keeping a corrupted
+ * 4-byte length header from demanding a ~4 GiB allocation.  Overridable via
+ * RAY_TPU_MAX_FRAME_BYTES (read once at module init; the pure-Python codec
+ * in runtime/protocol.py honors the same env so the two tiers interop). */
+#define DECODER_MAX_FRAME_DEFAULT ((Py_ssize_t)1 << 30)
+static Py_ssize_t g_max_frame = DECODER_MAX_FRAME_DEFAULT;
+#define DECODER_MAX_FRAME g_max_frame
 #define DECODER_MIN_SPARE (64 * 1024)
 
 static PyObject *
@@ -732,8 +742,10 @@ decoder_reserve(DecoderObject *self, Py_ssize_t need)
     }
     Py_ssize_t newcap = self->cap;
     while (newcap - used < need) {
-        if (newcap > PY_SSIZE_T_MAX / 2)
+        if (newcap > PY_SSIZE_T_MAX / 2) {
+            PyErr_NoMemory();
             return -1;
+        }
         newcap *= 2;
     }
     char *nb = PyMem_Realloc(self->buf, (size_t)newcap);
@@ -798,6 +810,14 @@ decoder_read_frame(DecoderObject *self, PyObject *arg)
         Py_ssize_t need = DECODER_MIN_SPARE;
         if (have >= 4) {
             Py_ssize_t len = (Py_ssize_t)read_le32(self->buf + self->start);
+            /* sanity-cap BEFORE reserving: a corrupted length header must
+             * not demand a multi-GiB allocation */
+            if (len > DECODER_MAX_FRAME) {
+                PyErr_Format(PyExc_ValueError,
+                             "frame length %zd exceeds max %zd (corrupt header?)",
+                             len, (Py_ssize_t)DECODER_MAX_FRAME);
+                return NULL;
+            }
             need = 4 + len - have;
         }
         if (decoder_reserve(self, need < DECODER_MIN_SPARE ? DECODER_MIN_SPARE : need) < 0)
@@ -857,9 +877,14 @@ hotpath_send_frame(PyObject *Py_UNUSED(mod), PyObject *args)
     Py_buffer view;
     if (!PyArg_ParseTuple(args, "iy*", &fd, &view))
         return NULL;
-    if (view.len > 0xffffffffL) {
+    /* same ceiling the receiving FrameDecoder enforces — a larger frame
+     * would be accepted here and then deterministically wedge the peer's
+     * connection (the poisoned header stays buffered) */
+    if (view.len > DECODER_MAX_FRAME) {
         PyBuffer_Release(&view);
-        PyErr_SetString(PyExc_OverflowError, "frame exceeds 4 GiB length prefix");
+        PyErr_Format(PyExc_OverflowError,
+                     "frame length %zd exceeds max %zd",
+                     view.len, (Py_ssize_t)DECODER_MAX_FRAME);
         return NULL;
     }
     char hdr[4];
@@ -945,6 +970,16 @@ PyInit__hotpath(void)
 {
     if (PyType_Ready(&BaseID_Type) < 0 || PyType_Ready(&FrameDecoder_Type) < 0)
         return NULL;
+    {
+        const char *env = getenv("RAY_TPU_MAX_FRAME_BYTES");
+        if (env != NULL && env[0] != '\0') {
+            char *endp = NULL;
+            long long v = strtoll(env, &endp, 10);
+            /* uint32 length prefix bounds the wire format at 4 GiB - 1 */
+            if (endp != env && *endp == '\0' && v > 0 && v <= 0xffffffffLL)
+                g_max_frame = (Py_ssize_t)v;
+        }
+    }
     PyObject *mod = PyModule_Create(&hotpath_module);
     if (mod == NULL)
         return NULL;

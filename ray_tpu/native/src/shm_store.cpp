@@ -200,74 +200,127 @@ extern "C" {
 // might already hold — and glibc later aborts on the trampled robust mutex
 // (observed as pthread_mutex_lock assertion failures under load, where the
 // creator can sit descheduled in that window for hundreds of ms).
+// Unlink `name` only if it still refers to the same inode we timed out on.
+// Two creators timing out on one carcass would otherwise double-unlink: the
+// first retries and builds a healthy segment under the name, and the second's
+// bare shm_unlink(name) would then remove the HEALTHY one, splitting the
+// cluster into disjoint stores.  (A window between our fstat and the unlink
+// remains — POSIX has no funlinkat for shm — but it is microseconds against
+// the 5-second staleness bar that gates entry to this path.)
+static void unlink_if_same_inode(const char* name, dev_t dev, ino_t ino) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return;  // already gone
+  struct stat st;
+  if (fstat(fd, &st) == 0 && st.st_dev == dev && st.st_ino == ino) {
+    shm_unlink(name);
+  }
+  close(fd);
+}
+
 void* tstore_open(const char* name, uint64_t capacity, int create) {
   // The segment must hold the header (index) plus a useful arena.
   const uint64_t min_capacity = align_up(sizeof(Header), kAlign) + (1ULL << 20);
-  if (create && capacity < min_capacity) capacity = min_capacity;
+  const uint64_t want_capacity = capacity;
 
-  bool initializer = false;
-  int fd = -1;
-  if (create) {
-    fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
-    if (fd >= 0) {
-      initializer = true;
-    } else if (errno != EEXIST) {
-      return nullptr;
+  // attempt 0: normal open.  attempt 1 (create=1 only): the segment existed
+  // but its initializer died between shm_open and storing magic/ftruncate,
+  // leaving it permanently half-built — unlink the carcass and take over as
+  // the O_EXCL winner ourselves.  One retry only: a second timeout means a
+  // live-but-wedged initializer, which we must not yank out from under.
+  for (int attempt = 0; attempt < 2; attempt++) {
+    capacity = create && want_capacity < min_capacity ? min_capacity : want_capacity;
+    bool initializer = false;
+    int fd = -1;
+    if (create) {
+      fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+      if (fd >= 0) {
+        initializer = true;
+      } else if (errno != EEXIST) {
+        return nullptr;
+      }
     }
-  }
-  if (fd < 0) {
-    fd = shm_open(name, O_RDWR, 0600);
-    if (fd < 0) return nullptr;
-  }
-
-  if (initializer) {
-    if (ftruncate(fd, capacity) != 0) { close(fd); shm_unlink(name); return nullptr; }
-  } else {
-    // wait (bounded) for the initializer to size the segment
-    struct stat st;
-    for (int spin = 0; ; spin++) {
-      if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
-      if (st.st_size > 0) break;
-      if (spin > 5000) { close(fd); return nullptr; }  // ~5s
-      usleep(1000);
+    if (fd < 0) {
+      fd = shm_open(name, O_RDWR, 0600);
+      if (fd < 0) {
+        if (create && errno == ENOENT) continue;  // unlinked under us — recreate
+        return nullptr;
+      }
     }
-    capacity = st.st_size;
-  }
 
-  void* mem = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-  close(fd);
-  if (mem == MAP_FAILED) return nullptr;
+    // Identity of the segment we actually opened — needed for a safe
+    // stale-carcass unlink later (by then the name may point elsewhere).
+    struct stat self_st;
+    if (fstat(fd, &self_st) != 0) { close(fd); return nullptr; }
 
-  Store* s = new Store();
-  s->hdr = reinterpret_cast<Header*>(mem);
-  s->base = reinterpret_cast<uint8_t*>(mem);
-  s->map_size = capacity;
-  snprintf(s->name, sizeof(s->name), "%s", name);
+    if (initializer) {
+      if (ftruncate(fd, capacity) != 0) { close(fd); shm_unlink(name); return nullptr; }
+    } else {
+      // wait (bounded) for the initializer to size the segment
+      struct stat st;
+      bool stale = false;
+      for (int spin = 0; ; spin++) {
+        if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+        if (st.st_size > 0) break;
+        if (spin > 5000) { stale = true; break; }  // ~5s
+        usleep(1000);
+      }
+      if (stale) {
+        close(fd);
+        if (create && attempt == 0) {
+          unlink_if_same_inode(name, self_st.st_dev, self_st.st_ino);
+          continue;
+        }
+        return nullptr;
+      }
+      capacity = st.st_size;
+    }
 
-  if (initializer) {
-    memset(s->hdr, 0, sizeof(Header));
-    s->hdr->capacity = capacity;
-    s->hdr->arena_offset = align_up(sizeof(Header), kAlign);
-    s->hdr->arena_size = capacity - s->hdr->arena_offset;
-    pthread_mutexattr_t attr;
-    pthread_mutexattr_init(&attr);
-    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
-    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
-    pthread_mutex_init(&s->hdr->mutex, &attr);
-    BlockHeader* first = block_at(s, s->hdr->arena_offset);
-    first->size = s->hdr->arena_size - sizeof(BlockHeader);
-    first->free = 1;
-    __sync_synchronize();
-    s->hdr->magic = kMagic;
-  } else {
-    // never initialize a segment someone else created: wait for its magic
-    for (int spin = 0; s->hdr->magic != kMagic; spin++) {
-      if (spin > 5000) { munmap(mem, capacity); delete s; return nullptr; }
-      usleep(1000);
+    void* mem = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) return nullptr;
+
+    Store* s = new Store();
+    s->hdr = reinterpret_cast<Header*>(mem);
+    s->base = reinterpret_cast<uint8_t*>(mem);
+    s->map_size = capacity;
+    snprintf(s->name, sizeof(s->name), "%s", name);
+
+    if (initializer) {
+      memset(s->hdr, 0, sizeof(Header));
+      s->hdr->capacity = capacity;
+      s->hdr->arena_offset = align_up(sizeof(Header), kAlign);
+      s->hdr->arena_size = capacity - s->hdr->arena_offset;
+      pthread_mutexattr_t attr;
+      pthread_mutexattr_init(&attr);
+      pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+      pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+      pthread_mutex_init(&s->hdr->mutex, &attr);
+      BlockHeader* first = block_at(s, s->hdr->arena_offset);
+      first->size = s->hdr->arena_size - sizeof(BlockHeader);
+      first->free = 1;
       __sync_synchronize();
+      s->hdr->magic = kMagic;
+    } else {
+      // never initialize a segment someone else created: wait for its magic
+      bool stale = false;
+      for (int spin = 0; s->hdr->magic != kMagic; spin++) {
+        if (spin > 5000) { stale = true; break; }
+        usleep(1000);
+        __sync_synchronize();
+      }
+      if (stale) {
+        munmap(mem, capacity);
+        delete s;
+        if (create && attempt == 0) {
+          unlink_if_same_inode(name, self_st.st_dev, self_st.st_ino);
+          continue;
+        }
+        return nullptr;
+      }
     }
+    return s;
   }
-  return s;
+  return nullptr;
 }
 
 void tstore_close(void* h) {

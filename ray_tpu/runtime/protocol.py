@@ -31,6 +31,21 @@ if os.environ.get("RAY_TPU_PURE_PY_FRAMES") != "1":
 # Arrays above this many bytes move via shm, not the socket.
 SHM_THRESHOLD = 256 * 1024
 
+# Hard ceiling on one frame, mirrored by the native codec (hotpath.c reads
+# the same env at module init).  Caps what a corrupted 4-byte length header
+# can demand on the receive side, and makes an over-limit send fail FAST on
+# the sender instead of wedging the peer's decoder.  Bulk payloads ride the
+# shm arena / chunked data plane, never one control frame.
+MAX_FRAME_BYTES = 1 << 30
+_env_max = os.environ.get("RAY_TPU_MAX_FRAME_BYTES")
+if _env_max:
+    try:
+        _v = int(_env_max)
+        if 0 < _v <= 0xFFFFFFFF:
+            MAX_FRAME_BYTES = _v
+    except ValueError:
+        pass
+
 
 class ShmRef:
     """Marker for a value stored out-of-band in the native shm store."""
@@ -43,6 +58,12 @@ class ShmRef:
 
 def send_msg(sock: socket.socket, msg_type: str, payload: dict) -> None:
     data = pickle.dumps((msg_type, payload), protocol=5)
+    if len(data) > MAX_FRAME_BYTES:
+        raise OverflowError(
+            f"frame length {len(data)} exceeds max {MAX_FRAME_BYTES} "
+            "(move bulk data through put()/the object store, or raise "
+            "RAY_TPU_MAX_FRAME_BYTES on every process)"
+        )
     if _native is not None:
         fd = sock.fileno()
         if fd < 0:
@@ -55,6 +76,10 @@ def send_msg(sock: socket.socket, msg_type: str, payload: dict) -> None:
 def recv_msg(sock: socket.socket) -> Tuple[str, dict]:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"frame length {length} exceeds max {MAX_FRAME_BYTES} (corrupt header?)"
+        )
     data = _recv_exact(sock, length)
     return pickle.loads(data)
 

@@ -107,6 +107,9 @@ class RpcConnection:
         """One-way notification."""
         try:
             self._send_frame(msg_type, payload)
+        except OverflowError as exc:
+            # nothing reached the wire — the connection stays usable
+            raise RpcError(f"message too large: {exc}") from exc
         except OSError as exc:
             self._teardown()
             raise RpcError(f"connection lost during send: {exc}") from exc
@@ -149,6 +152,12 @@ class RpcConnection:
         payload["_rid"] = rid
         try:
             self._send_frame(msg_type, payload)
+        except OverflowError as exc:
+            # Frame over the codec cap: nothing reached the wire, so the
+            # connection stays healthy — fail just this request.
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            callback(None, RpcError(f"request too large: {exc}"))
         except OSError as exc:
             with self._pending_lock:
                 self._pending.pop(rid, None)
@@ -183,7 +192,8 @@ class RpcConnection:
                             cb(payload, None)
                 else:
                     self._inbox.put((msg_type, payload))
-        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+        except (ConnectionError, OSError, EOFError, ValueError, pickle.UnpicklingError):
+            # ValueError = corrupt frame header; stream unrecoverable
             pass
         finally:
             self._teardown()

@@ -105,7 +105,8 @@ class Worker:
         while True:
             try:
                 msg_type, payload = reader.recv()
-            except ConnectionError:
+            except (ConnectionError, ValueError):
+                # ValueError = corrupt frame header; treat as a lost pool
                 break
             if msg_type == "shutdown":
                 break

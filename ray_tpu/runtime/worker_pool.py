@@ -512,7 +512,9 @@ class ProcessWorkerPool:
         while True:
             try:
                 msg_type, payload = reader.recv()
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, ValueError):
+                # ValueError = corrupt frame header (over the codec cap):
+                # the stream is unrecoverable, same as a death
                 self._handle_worker_death(worker)
                 return
             if msg_type == "api_request":

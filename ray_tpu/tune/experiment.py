@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Union
 
 from ray_tpu.exceptions import RayTpuError
+from ray_tpu.tune.callback import Callback
 
 
 class TuneError(RayTpuError):
@@ -101,6 +102,11 @@ class Trainable:
                 t.stop()
 
         fn.__name__ = cls.__name__
+        # with_resources() on a class trainable stores the bundle on the
+        # subclass; the adapter function must carry it to the controller.
+        res = getattr(cls, "_tune_resources", None)
+        if res:
+            fn._tune_resources = dict(res)  # type: ignore[attr-defined]
         return fn
 
 
@@ -204,7 +210,7 @@ def run_experiments(experiments: Union[Experiment, List[Experiment]]) -> Dict[st
 # --------------------------------------------------------------------------
 # Progress reporters
 # --------------------------------------------------------------------------
-class ProgressReporter:
+class ProgressReporter(Callback):
     """Periodic experiment-progress output (parity:
     tune/progress_reporter.py).  Wired as a Tune Callback: the controller
     invokes ``on_trial_result``; ``should_report`` throttles."""
@@ -323,6 +329,15 @@ def with_resources(trainable: Callable, resources: Union[dict, "PlacementGroupFa
 
     if isinstance(resources, PlacementGroupFactory):
         resources = resources.head_bundle()
+
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        # A plain-function wrapper would hide the class from Tuner.fit's
+        # issubclass adapter check, so the trial would construct the class
+        # once (running only setup) and finish with zero steps.  Subclass
+        # instead so the class-trainable path still fires.
+        sub = type(trainable.__name__, (trainable,), {})
+        sub._tune_resources = dict(resources)  # type: ignore[attr-defined]
+        return sub
 
     @functools.wraps(trainable)
     def wrapped(config):

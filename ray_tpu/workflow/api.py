@@ -139,11 +139,34 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
     store = _store()
     import cloudpickle
 
-    store.save_dag(workflow_id, cloudpickle.dumps(dag))
-    store.set_status(workflow_id, RUNNING)
+    # Atomic check-and-add BEFORE any durable write: anyone who reads
+    # RUNNING is guaranteed to find the id in _active_workflows (or find a
+    # terminal status later) — the invariant resume()/resume_all() lean on.
+    # The check also refuses two run() calls racing on one explicit id,
+    # which would replay steps concurrently, race the step-file writes, and
+    # (were save_dag hoisted above this check) clobber the running
+    # workflow's durable DAG with the refused caller's.
     with _active_lock:
+        if workflow_id in _active_workflows:
+            raise WorkflowExecutionError(
+                f"workflow {workflow_id!r} is already executing in this process"
+            )
         _active_workflows.add(workflow_id)
+        # A fresh run revokes any cancel left over from a prior execution of
+        # this id — the stale flag would abort step 0 (same rule as resume).
+        flag = _cancel_flags.get(workflow_id)
+        if flag is not None:
+            flag.clear()
     try:
+        # durable writes live INSIDE the try: a storage error must not leak
+        # the id in the active set (the finally below owns the discard).
+        # run() is a FRESH execution — prior checkpoints under this id
+        # belong to whatever DAG ran before (step keys are topological
+        # indices, so a different DAG's steps would collide); resume() is
+        # the replay path.
+        store.clear_steps(workflow_id)
+        store.save_dag(workflow_id, cloudpickle.dumps(dag))
+        store.set_status(workflow_id, RUNNING)
         result = _execute_dag(dag, workflow_id, store)
         # terminal status writes happen BEFORE the active-set discard: a
         # resume_all() racing this window must see either "active" or a
@@ -182,16 +205,28 @@ def resume(workflow_id: str) -> Any:
     store = _store()
     import pickle
 
-    dag = pickle.loads(store.load_dag(workflow_id))
-    # Resuming revokes any prior cancel — otherwise the stale flag aborts
-    # step 0 and resume-after-cancel (a core durability feature) never works.
-    flag = _cancel_flags.get(workflow_id)
-    if flag is not None:
-        flag.clear()
-    store.set_status(workflow_id, RUNNING)
+    # Atomic check-and-add BEFORE touching the durable DAG: a resume racing
+    # a concurrent run()/resume() of the same id must hit this clean
+    # refusal, not whatever state the other execution is mid-writing.  The
+    # cancel-flag clear lives INSIDE the lock, after the check — clearing
+    # before the refusal would silently un-cancel a running workflow.
     with _active_lock:
+        if workflow_id in _active_workflows:
+            raise WorkflowExecutionError(
+                f"workflow {workflow_id!r} is already executing in this process"
+            )
         _active_workflows.add(workflow_id)
+        # Resuming revokes any prior cancel — otherwise the stale flag
+        # aborts step 0 and resume-after-cancel (a core durability
+        # feature) never works.
+        flag = _cancel_flags.get(workflow_id)
+        if flag is not None:
+            flag.clear()
     try:
+        dag = pickle.loads(store.load_dag(workflow_id))
+        # set_status lives INSIDE the try: a storage error must not leak the
+        # id in the active set (the finally below owns the discard).
+        store.set_status(workflow_id, RUNNING)
         result = _execute_dag(dag, workflow_id, store)
         # terminal status writes happen BEFORE the active-set discard: a
         # resume_all() racing this window must see either "active" or a
@@ -199,7 +234,9 @@ def resume(workflow_id: str) -> Any:
         store.save_step(workflow_id, "__output__", result)
         store.set_status(workflow_id, SUCCESSFUL)
     except BaseException:
-        if store.get_status(workflow_id) != CANCELED:
+        # don't mint a FAILED status for an id that was never persisted
+        # (load_dag on an unknown workflow raises before anything ran)
+        if store.get_status(workflow_id) not in (None, CANCELED):
             store.set_status(workflow_id, FAILED)
         raise
     finally:
@@ -258,10 +295,18 @@ def resume_all() -> List[tuple]:
     (parity: workflow.resume_all — RUNNING covers a crashed driver whose
     workflows never reached a terminal status).  Returns
     ``[(workflow_id, future), ...]``."""
+    # Snapshot the active set BEFORE listing: a workflow that *finishes*
+    # between the reads writes its terminal status before the active-set
+    # discard, so the list either shows it terminal (skipped by status) or
+    # RUNNING while still in the snapshot (skipped as active).  The inverse
+    # race — one that *starts* between the reads — is caught by resume()'s
+    # atomic refusal, since run()/resume() add to the active set before
+    # writing RUNNING.
     with _active_lock:
         active = set(_active_workflows)
+    listed = list_all()
     out = []
-    for wf in list_all():
+    for wf in listed:
         if wf["workflow_id"] in active:
             continue  # executing in this process right now — not an orphan
         if wf["status"] in (RUNNING, FAILED, "RESUMABLE"):

@@ -59,6 +59,13 @@ class WorkflowStorage:
                     out.append(key if rel == "." else f"{rel}/{key}")
         return sorted(out)
 
+    def clear_steps(self, workflow_id: str) -> None:
+        """Drop every durable step for a fresh run() of a reused id —
+        replaying another DAG's checkpoints (step keys are topological
+        indices) would silently serve its results as this run's."""
+        root = os.path.join(self._wf_dir(workflow_id), "steps")
+        shutil.rmtree(root, ignore_errors=True)
+
     # ------------------------------------------------------------- status
     def set_status(self, workflow_id: str, status: str, extra: Optional[dict] = None) -> None:
         os.makedirs(self._wf_dir(workflow_id), exist_ok=True)
@@ -74,8 +81,11 @@ class WorkflowStorage:
 
     def save_dag(self, workflow_id: str, dag_blob: bytes) -> None:
         os.makedirs(self._wf_dir(workflow_id), exist_ok=True)
-        with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"), "wb") as f:
+        path = os.path.join(self._wf_dir(workflow_id), "dag.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(dag_blob)
+        os.replace(tmp, path)  # atomic — a concurrent load_dag never sees a half-write
 
     def load_dag(self, workflow_id: str) -> bytes:
         with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"), "rb") as f:

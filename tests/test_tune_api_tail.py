@@ -131,6 +131,30 @@ def test_with_resources_and_pgf():
     assert grid.get_best_result().metrics["ok"] == 1
 
 
+def test_with_resources_class_trainable_still_trains():
+    # Regression: wrapping a class Trainable in a plain function hid it from
+    # Tuner.fit's issubclass adapter, so the trial ran setup() once and
+    # reported nothing.
+    class Step(tune.Trainable):
+        def setup(self, config):
+            self.k = config["k"]
+            self.i = 0
+
+        def train(self):
+            self.i += 1
+            return {"score": self.k + self.i, "training_iteration": self.i}
+
+    wrapped = tune.with_resources(Step, {"CPU": 1})
+    assert isinstance(wrapped, type) and issubclass(wrapped, tune.Trainable)
+    assert wrapped._tune_resources == {"CPU": 1}
+    grid = tune.run(
+        wrapped, config={"k": 10}, metric="score", mode="max",
+        stop={"training_iteration": 3},
+    )
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 13  # trained 3 steps, not zero
+
+
 def test_factories_and_misc():
     s = tune.create_scheduler("asha")
     from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
