@@ -124,6 +124,16 @@ class Config:
     # Admission control: concurrent bulk transfers served/issued per process
     # (reference: PullManager admission, pull_manager.h:52).
     max_concurrent_object_transfers: int = 4
+    # Worker results/args decoded from the shm arena stay as READ-ONLY
+    # zero-copy views pinned until garbage-collected (plasma Get semantics,
+    # plasma/client.h:62) instead of being copied out. Disable for owned,
+    # writable arrays at one extra memcpy per bulk value.
+    zero_copy_shm_values: bool = True
+    # Same-host peers hand bulk objects through the native shm arena
+    # (one memcpy, zero socket bytes) instead of loopback TCP — plasma's
+    # zero-copy local sharing role (reference: plasma/store.h:55, fd
+    # passing fling.cc). Disable to force every transfer onto sockets.
+    same_host_shm_transfer: bool = True
     # Default timeout for one actor-collective round (rendezvous + reduce).
     # Callers waiting on a collective result (rt.get) should budget MORE
     # than this so the collective's own timeout fires first with the

@@ -56,6 +56,13 @@ def _load_lib() -> ctypes.CDLL:
         lib.tstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.tstore_contains.restype = ctypes.c_int
         lib.tstore_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tstore_pin_range.restype = ctypes.c_int
+        lib.tstore_pin_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.tstore_prefault.restype = ctypes.c_int
+        lib.tstore_prefault.argtypes = [ctypes.c_void_p]
         lib.tstore_used.restype = ctypes.c_uint64
         lib.tstore_used.argtypes = [ctypes.c_void_p]
         lib.tstore_capacity.restype = ctypes.c_uint64
@@ -86,6 +93,46 @@ class ShmObjectStore:
             os.close(fd)
         self._view = memoryview(self._map)
         self._closed = False
+        # base address of the mapping, for buffer-containment checks
+        self._base_addr = ctypes.addressof(ctypes.c_char.from_buffer(self._map))
+        self._size = len(self._map)
+        # Populate THIS mapping's page tables off the hot path: the first
+        # bulk memcpy through an unpopulated VMA pays a write-fault per 4K
+        # page (~1.6-2.8 GB/s measured) vs ~8 GB/s once populated.  PTEs are
+        # per-mapping, so every opener — creator and workers alike — warms
+        # its own.  MADV_POPULATE_WRITE allocates the tmpfs pages without
+        # altering contents; unsupported kernels just skip the warmup.
+        import threading
+
+        threading.Thread(
+            target=self._prefault, name="shm-prefault", daemon=True
+        ).start()
+
+    _MADV_POPULATE_WRITE = 23  # Linux 5.14+
+
+    def _prefault(self) -> None:
+        # Populating commits the segment's FULL capacity in tmpfs up front.
+        # Gate on free memory (skip when the arena would eat >25% of
+        # MemAvailable) so small hosts keep lazy per-object allocation;
+        # RAY_TPU_SHM_PREFAULT=0/1 forces either way.
+        forced = os.environ.get("RAY_TPU_SHM_PREFAULT")
+        if forced == "0":
+            return
+        if forced != "1":
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        if line.startswith("MemAvailable:"):
+                            avail_kb = int(line.split()[1])
+                            if self._size > avail_kb * 1024 // 4:
+                                return
+                            break
+            except (OSError, ValueError):
+                return
+        try:
+            self._map.madvise(self._MADV_POPULATE_WRITE)
+        except (OSError, ValueError):
+            pass
 
     # -- plasma-style lifecycle -------------------------------------------
     def create(self, object_id: bytes, size: int, meta_size: int = 0) -> memoryview:
@@ -133,6 +180,29 @@ class ShmObjectStore:
 
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.tstore_contains(self._handle, object_id))
+
+    def pin_buffer(self, addr: int, nbytes: int):
+        """If [addr, addr+nbytes) lies inside one SEALED entry's payload,
+        pin that entry and return ``(entry_id, offset_within_payload)``;
+        else None.  Pairs with release(entry_id).  This is the zero-copy
+        passthrough: a buffer already living in the arena is served by
+        reference, never re-staged."""
+        if not (self._base_addr <= addr and addr + nbytes <= self._base_addr + self._size):
+            return None
+        seg_off = addr - self._base_addr
+        id_out = ctypes.create_string_buffer(28)
+        pay_off = ctypes.c_uint64()
+        pay_size = ctypes.c_uint64()
+        rc = self._lib.tstore_pin_range(
+            self._handle, seg_off, id_out, ctypes.byref(pay_off), ctypes.byref(pay_size)
+        )
+        if rc != 0:
+            return None
+        rel = seg_off - pay_off.value
+        if rel + nbytes > pay_size.value:  # straddles entries: not servable
+            self.release(id_out.raw)
+            return None
+        return id_out.raw, rel
 
     def evict(self, num_bytes: int) -> int:
         return self._lib.tstore_evict(self._handle, num_bytes)

@@ -255,7 +255,15 @@ class AgentFabric:
     def on_actor_process_died(self, node, actor_id: ActorID) -> None:
         self.conn.send("actor_died", {"actor_id": actor_id.binary()})
 
-    def handle_worker_api(self, blob: bytes, op: str = "") -> bytes:
+    def on_worker_process_died(self, pid) -> None:
+        """Relay to the head, which keys this agent's worker pins by
+        (agent node id, pid) — see remote_node._h_worker_api."""
+        try:
+            self.conn.send("worker_died", {"pid": pid})
+        except Exception:  # noqa: BLE001 — head gone: its death sweep cleans up
+            pass
+
+    def handle_worker_api(self, blob: bytes, op: str = "", worker_key=None) -> bytes:
         """A worker on this agent made a nested API call: the owner (the
         driver's CoreWorker) lives across the transport — relay and wait.
         Long timeout: a nested get legitimately waits on real work.
@@ -277,22 +285,32 @@ class AgentFabric:
         elif op == "put":
             shm = getattr(self.node, "store", None) if self.node is not None else None
             shm = getattr(shm, "_shm", None)
+            decoded = None
             if shm is not None:
                 # resolve shm markers HERE: the arena is this host's — the
-                # driver across the relay could never read them
+                # driver across the relay could never read them.  Keep the
+                # DECODED frame: re-pickling the resolved bulk value just to
+                # load it again would copy it twice.
                 from ray_tpu.runtime import protocol as _protocol
 
-                blob = _protocol.decode_put_blob(blob, shm)
+                decoded = _protocol.decode_put_frame(blob, shm)
             try:
-                local = self._local_put(blob)
+                local = self._local_put(blob, decoded=decoded)
             except Exception:  # noqa: BLE001
                 local = None
             if local is not None:
                 return local
-        reply = self.conn.request("worker_api", {"blob": blob}, timeout=24 * 3600.0)
+            if decoded is not None:
+                # relay fallback needs an in-band blob the driver can read
+                import pickle as _pickle
+
+                blob = _pickle.dumps(decoded, protocol=5)
+        reply = self.conn.request(
+            "worker_api", {"blob": blob, "worker_key": worker_key}, timeout=24 * 3600.0
+        )
         return reply["blob"]
 
-    def _local_put(self, blob: bytes) -> Optional[bytes]:
+    def _local_put(self, blob: bytes, decoded=None) -> Optional[bytes]:
         """Nested put: the BYTES stay in this node's store; the head only
         mints the ObjectID and records ownership + location (metadata).
         Without this a worker's rt.put shipped the whole value over two
@@ -304,7 +322,7 @@ class AgentFabric:
         from ray_tpu.core.ids import ObjectID as _OID
         from ray_tpu.runtime import worker_api
 
-        _op, kw = pickle.loads(blob)
+        _op, kw = pickle.loads(blob) if decoded is None else decoded
         value = kw["value"]
         if not _ref_free(value):
             return None
@@ -454,7 +472,9 @@ class NodeAgent:
         # Bind all interfaces; advertise the IP this host is reachable at
         # from the head's side of the control connection (loopback would be
         # undialable for peers on other machines).
-        self.data_server = data_plane.store_server(self.node.store, host="0.0.0.0")
+        self.data_server = data_plane.store_server(
+            self.node.store, host="0.0.0.0", shm_store=self.shm_store
+        )
         self.data_address = f"{self.conn.local_ip}:{self.data_server.port}"
         self.fabric.data_client = data_plane.DataClient(
             chunk_bytes=cfg.object_transfer_chunk_bytes,

@@ -602,17 +602,30 @@ class Cluster:
                 if self._demand_entries:
                     self._demand_cv.wait(timeout=0.05)  # tick while backlogged
 
-    def handle_worker_api(self, blob: bytes, op: str = "") -> bytes:
+    def on_worker_process_died(self, pid) -> None:
+        """A pool worker on the head host died: its borrower ledger can
+        never report again, so drop every ref pin it held."""
+        if self.core_worker is not None:
+            from ray_tpu.runtime.worker_api import release_worker_pins
+
+            release_worker_pins(self.core_worker, pid)
+
+    def handle_worker_api(self, blob: bytes, op: str = "", worker_key=None) -> bytes:
         """Nested runtime API call from a worker process on this host: runs
         against the driver's CoreWorker (the single owner)."""
         from ray_tpu.runtime import protocol, worker_api
 
         if self.core_worker is None:
             raise RuntimeError("no core worker attached to this cluster")
+        decoded = None
         if op == "put" and self.shm_store is not None:
-            # bulk put payloads arrive as shm markers, not in-band pickle
-            blob = protocol.decode_put_blob(blob, self.shm_store)
-        return worker_api.execute(self.core_worker, blob)
+            # bulk put payloads arrive as shm markers, not in-band pickle;
+            # hand execute() the decoded frame — a re-pickle round trip
+            # would copy the bulk value twice
+            decoded = protocol.decode_put_frame(blob, self.shm_store)
+        return worker_api.execute(
+            self.core_worker, blob, decoded=decoded, worker_key=worker_key
+        )
 
     def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
         """Propagate a cancellation to wherever the task is queued/running.

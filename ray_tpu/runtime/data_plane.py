@@ -35,6 +35,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
@@ -126,6 +127,134 @@ def _send_buffers(sock: socket.socket, buffers, chunk_bytes: int) -> int:
     return total
 
 
+# --------------------------------------------------------------------------
+# Same-host shm handoff (plasma zero-copy local sharing role: store.h:55)
+# --------------------------------------------------------------------------
+# Two processes can hand an object through the native shm arena instead of
+# loopback TCP iff they share /dev/shm.  The proof is a shared random token
+# file: same namespace <=> both read the same bytes.  (Hostname comparison
+# would lie across containers; this cannot.)
+_HOST_TOKEN_PATH = "/dev/shm/ray_tpu_host_token"
+_host_token_cache: Optional[bytes] = None
+
+
+def host_token() -> Optional[bytes]:
+    global _host_token_cache
+    if _host_token_cache is not None:
+        return _host_token_cache or None  # b"" caches "unavailable"
+
+    def _fail() -> None:
+        global _host_token_cache
+        _host_token_cache = b""  # never re-pay the probe on this process
+
+    import os
+
+    try:
+        for attempt in range(2):
+            try:
+                fd = os.open(_HOST_TOKEN_PATH, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            except FileExistsError:
+                fd = -1
+            if fd >= 0:
+                try:
+                    os.write(fd, os.urandom(16).hex().encode())
+                finally:
+                    os.close(fd)
+            # read back (covers the creator and the raced loser; a reader
+            # racing the creator's write may see a short file — retry
+            # briefly)
+            for _ in range(50):
+                try:
+                    with open(_HOST_TOKEN_PATH, "rb") as f:
+                        tok = f.read()
+                except FileNotFoundError:
+                    break  # repaired/unlinked under us: recreate
+                if len(tok) >= 32:
+                    _host_token_cache = tok
+                    return tok
+                time.sleep(0.01)
+            if attempt == 0:
+                # a creator SIGKILLed between open and write leaves a
+                # permanent zero-byte file: unlink the carcass and retry
+                # once as the new creator
+                try:
+                    os.unlink(_HOST_TOKEN_PATH)
+                except OSError:
+                    pass
+        _fail()
+        return None
+    except OSError:
+        _fail()
+        return None
+
+
+# Staged-entry payload layout (self-contained; the arena entry's own
+# meta_size field is unused):
+#   u32 n_buffers | u64 meta_off | u64 meta_len | n * (u64 off, u64 len)
+#   ... meta bytes ... | 64B-aligned buffer payloads ...
+_STAGE_HDR = struct.Struct("<IQQ")
+_STAGE_BUF = struct.Struct("<QQ")
+_STAGE_ALIGN = 64
+
+
+def _staging_id(oid: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha224(b"xfer:" + oid).digest()[:28]
+
+
+def stage_frames(shm, sid: bytes, meta: bytes, buffers: List[Any]) -> None:
+    """Write pickle-5 frames as ONE sealed arena entry under ``sid``.
+    Raises FileExistsError if another stager won, MemoryError if the arena
+    cannot fit it even after eviction."""
+    views = [memoryview(b).cast("B") for b in buffers]
+    table_len = _STAGE_HDR.size + _STAGE_BUF.size * len(views)
+    meta_off = table_len
+    cursor = meta_off + len(meta)
+    offsets = []
+    for v in views:
+        cursor = (cursor + _STAGE_ALIGN - 1) // _STAGE_ALIGN * _STAGE_ALIGN
+        offsets.append(cursor)
+        cursor += v.nbytes
+    dest = shm.create(sid, cursor)
+    try:
+        _STAGE_HDR.pack_into(dest, 0, len(views), meta_off, len(meta))
+        pos = _STAGE_HDR.size
+        for off, v in zip(offsets, views):
+            _STAGE_BUF.pack_into(dest, pos, off, v.nbytes)
+            pos += _STAGE_BUF.size
+        dest[meta_off : meta_off + len(meta)] = meta
+        for off, v in zip(offsets, views):
+            dest[off : off + v.nbytes] = v
+    finally:
+        dest.release()
+    shm.seal(sid)
+
+
+def _release_pins(store, pins) -> None:
+    if getattr(store, "_closed", False):
+        return
+    for eid in pins:
+        try:
+            store.release(eid)
+        except Exception:  # noqa: BLE001 — arena torn down mid-exit
+            pass
+
+
+def read_staged(view: memoryview) -> Tuple[memoryview, List[memoryview]]:
+    """Parse a staged entry into (meta, buffer views) — zero-copy slices of
+    the pinned arena view."""
+    n, meta_off, meta_len = _STAGE_HDR.unpack_from(view, 0)
+    meta = view[meta_off : meta_off + meta_len]
+    bufs = []
+    pos = _STAGE_HDR.size
+    for _ in range(n):
+        off, size = _STAGE_BUF.unpack_from(view, pos)
+        pos += _STAGE_BUF.size
+        bufs.append(view[off : off + size])
+    return meta, bufs
+
+
 def _recv_frame(sock: socket.socket) -> bytes:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return _recv_exact(sock, length)
@@ -146,6 +275,7 @@ class TransferStats:
         self.pulls_issued = 0
         self.pushes_sent = 0
         self.pushes_received = 0
+        self.shm_handoffs = 0
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -156,6 +286,7 @@ class TransferStats:
                 "pulls_issued": self.pulls_issued,
                 "pushes_sent": self.pushes_sent,
                 "pushes_received": self.pushes_received,
+                "shm_handoffs": self.shm_handoffs,
             }
 
     def add(self, field: str, n: int = 1) -> None:
@@ -182,10 +313,13 @@ class DataServer:
         chunk_bytes: int = 8 * 1024 * 1024,
         max_concurrent: int = 4,
         get_device_offer: Optional[Callable[[bytes], Optional[dict]]] = None,
+        shm_store=None,
     ):
         self._get_frames = get_frames
         self._put_frames = put_frames
         self._get_device_offer = get_device_offer
+        self._shm_store = shm_store
+        self._stage_lock = threading.Lock()
         self.chunk_bytes = chunk_bytes
         self.stats = TransferStats()
         self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
@@ -256,6 +390,23 @@ class DataServer:
         except Exception:  # noqa: BLE001 — not found / timed out
             _send_header(sock, {"found": False})
             return
+        # Same-host requester: hand off through the shm arena — one memcpy
+        # into the segment, zero object bytes on this socket.
+        tok = req.get("shm_token")
+        if (
+            tok is not None
+            and self._shm_store is not None
+            and tok == host_token()
+        ):
+            offer = self._stage_offer(oid, meta, buffers)
+            if offer is not None:
+                _send_header(
+                    sock,
+                    {"found": True, "is_error": is_error, "shm": offer},
+                )
+                self.stats.add("pulls_served")
+                self.stats.add("shm_handoffs")
+                return
         sizes = [memoryview(b).cast("B").nbytes for b in buffers]
         with self._admission:
             _send_header(
@@ -267,6 +418,63 @@ class DataServer:
             sent = _send_buffers(sock, buffers, self.chunk_bytes)
         self.stats.add("pulls_served")
         self.stats.add("bytes_sent", len(meta) + sent)
+
+    def _stage_offer(self, oid: bytes, meta: bytes, buffers: List[Any]) -> Optional[dict]:
+        """Build a same-host handoff offer.
+
+        Passthrough first: when every buffer ALREADY lives inside the arena
+        (a worker-produced result decoded zero-copy), pin those entries and
+        reference them — no bytes move at all.  Otherwise stage one copy
+        into the arena under a derived id; staged entries are LRU-reclaimed
+        once the consumer releases its pin, so repeat pulls of one object
+        reuse a single staging."""
+        shm = self._shm_store
+        try:
+            entries = self._passthrough_entries(shm, buffers)
+            if entries is not None:
+                return {
+                    "segment": shm.name, "kind": "entries",
+                    "meta": bytes(meta), "bufs": entries,
+                }
+            sid = _staging_id(oid)
+            with self._stage_lock:
+                if not shm.contains(sid):
+                    try:
+                        stage_frames(shm, sid, meta, buffers)
+                    except FileExistsError:
+                        # created-but-unsealed by a crashed/other path; let
+                        # the socket path carry this pull
+                        return None
+            return {"segment": shm.name, "kind": "staged", "sid": sid}
+        except MemoryError:
+            return None
+        except Exception:  # noqa: BLE001 — arena closed mid-shutdown etc.
+            return None
+
+    @staticmethod
+    def _passthrough_entries(shm, buffers: List[Any]) -> Optional[list]:
+        """Resolve each buffer to its containing arena entry; returns
+        [(entry_id, rel_off, nbytes), ...] or None if any buffer lives
+        off-arena.  No pin is retained here: the entries are kept alive by
+        the store's own zero-copy value (which pins them for its lifetime);
+        if the object is dropped before the consumer pins, its get fails
+        and the pull falls back to the socket path."""
+        if not buffers or not hasattr(shm, "pin_buffer"):
+            return None
+        import numpy as np
+
+        out = []
+        for b in buffers:
+            view = memoryview(b).cast("B")
+            if view.nbytes == 0:
+                return None
+            addr = np.frombuffer(view, dtype=np.uint8).__array_interface__["data"][0]
+            hit = shm.pin_buffer(addr, view.nbytes)
+            if hit is None:
+                return None
+            shm.release(hit[0])  # lookup only — the store value holds the pin
+            out.append((hit[0], hit[1], view.nbytes))
+        return out
 
     def _serve_push(self, sock: socket.socket, req: dict) -> None:
         # same admission gate as pulls: inbound bulk buffering is bounded too
@@ -290,6 +498,88 @@ class DataClient:
         self._admission = threading.BoundedSemaphore(max(1, max_concurrent))
         self._idle: Dict[str, List[socket.socket]] = {}
         self._lock = threading.Lock()
+        # same-host handoff: cached read-side opens of peers' arenas
+        self._peer_segments: Dict[str, Any] = {}
+        self._seg_lock = threading.Lock()
+
+    # -- same-host shm handoff ------------------------------------------
+    def _peer_segment(self, name: str):
+        with self._seg_lock:
+            store = self._peer_segments.get(name)
+        if store is not None:
+            return store
+        from ray_tpu.native.shm_store import ShmObjectStore
+
+        store = ShmObjectStore(name, create=False)
+        with self._seg_lock:
+            return self._peer_segments.setdefault(name, store)
+
+    def _consume_shm_offer(self, offer: dict, is_error: bool) -> Tuple[Any, bool]:
+        """Reconstruct the value from a peer's arena.
+
+        ``entries`` offers reference the producer's ORIGINAL entries (zero
+        server-side copy); ``staged`` offers reference one freshly staged
+        entry.  Either way: zero-copy when the value can carry a finalizer
+        (ndarray — the dominant bulk case), buffers viewing the mapped
+        segment pinned until the value is garbage-collected; otherwise the
+        buffers are copied out (one memcpy at arena rates) and the pins
+        drop immediately."""
+        import weakref
+
+        store = self._peer_segment(offer["segment"])
+        if offer.get("kind") == "entries":
+            meta = offer["meta"]
+            pins: List[bytes] = []
+            bufs = []
+            try:
+                for eid, rel, nbytes in offer["bufs"]:
+                    got = store.get(eid)
+                    if got is None:
+                        raise DataPlaneError(f"entry {eid.hex()} vanished")
+                    pins.append(eid)
+                    view, _ = got
+                    bufs.append(view[rel : rel + nbytes].toreadonly())
+            except BaseException:
+                for eid in pins:
+                    store.release(eid)
+                raise
+        else:
+            sid = offer["sid"]
+            got = store.get(sid)
+            if got is None:
+                raise DataPlaneError(f"staged entry {sid.hex()} vanished")
+            view, _meta = got
+            pins = [sid]
+            meta, bufs = read_staged(view)
+            # read-only views: a consumer mutating its array must not
+            # corrupt the shared bytes other pullers may map (plasma
+            # returns read-only buffers for the same reason)
+            bufs = [b.toreadonly() for b in bufs]
+        pinned = True
+        try:
+            value = from_frames(meta, bufs)
+            import numpy as np
+
+            if isinstance(value, np.ndarray):
+                # zero-copy: finalize the data OWNER — sub-views collapse
+                # .base to the bottom array, so only it is guaranteed to
+                # outlive every surviving slice (else: use-after-free)
+                from ray_tpu.runtime.protocol import nd_owner
+
+                weakref.finalize(nd_owner(value), _release_pins, store, tuple(pins))
+                pinned = False  # finalizer owns the releases now
+            else:
+                # containers/custom objects: an inner array a caller
+                # extracts could outlive any finalizer anchor we can see —
+                # re-load with copies so nothing references the arena once
+                # we release (one memcpy at arena rates)
+                copied = [bytes(b) for b in bufs]
+                value = from_frames(bytes(meta), copied)
+            return value, is_error
+        finally:
+            if pinned:
+                for eid in pins:
+                    store.release(eid)
 
     # -- connection pool -------------------------------------------------
     def _checkout(self, addr: str) -> socket.socket:
@@ -325,9 +615,11 @@ class DataClient:
         """Fetch an object from a peer; returns ``(value, is_error)``.
         Raises :class:`ObjectNotFound` if the peer doesn't materialize it
         within ``timeout``."""
+        from ray_tpu.core.config import get_config
         from ray_tpu.runtime import device_plane
 
         device_capable = device_plane.transfer_address() is not None
+        tok = host_token() if get_config().same_host_shm_transfer else None
         with self._admission:
             sock = self._checkout(addr)
             try:
@@ -335,13 +627,13 @@ class DataClient:
                 _send_header(
                     sock,
                     {"op": "pull", "oid": oid, "timeout": timeout,
-                     "device_capable": device_capable},
+                     "device_capable": device_capable, "shm_token": tok},
                 )
                 header = _recv_header(sock)
                 if not header.get("found"):
                     self._checkin(addr, sock)
                     raise ObjectNotFound(f"peer {addr} does not hold the object")
-                if "device_xfer" not in header:
+                if "device_xfer" not in header and "shm" not in header:
                     meta = _recv_exact(sock, header["meta_size"])
                     buffers = [
                         _recv_into_buffer(sock, size) for size in header["buffer_sizes"]
@@ -354,6 +646,17 @@ class DataClient:
                 raise DataPlaneError(f"pull from {addr} failed: {exc}") from exc
             else:
                 self._checkin(addr, sock)
+        shm_offer = header.get("shm")
+        if shm_offer is not None:
+            try:
+                value, is_error = self._consume_shm_offer(
+                    shm_offer, header.get("is_error", False)
+                )
+                self.stats.add("pulls_issued")
+                self.stats.add("shm_handoffs")
+                return value, is_error
+            except Exception:  # noqa: BLE001 — segment gone/arena churned:
+                return self.pull_host(addr, oid, timeout)  # stream instead
         offer = header.get("device_xfer")
         if offer is not None:
             # device-to-device through the jax transfer server
@@ -424,7 +727,8 @@ class DataClient:
 
 def store_server(store, host: str = "127.0.0.1", port: int = 0,
                  chunk_bytes: Optional[int] = None,
-                 max_concurrent: Optional[int] = None) -> DataServer:
+                 max_concurrent: Optional[int] = None,
+                 shm_store=None) -> DataServer:
     """A :class:`DataServer` backed by one local ObjectStore (agent side)."""
     from collections import OrderedDict
 
@@ -487,4 +791,5 @@ def store_server(store, host: str = "127.0.0.1", port: int = 0,
         chunk_bytes=chunk_bytes or cfg.object_transfer_chunk_bytes,
         max_concurrent=max_concurrent or cfg.max_concurrent_object_transfers,
         get_device_offer=get_device_offer,
+        shm_store=shm_store,
     )

@@ -464,7 +464,7 @@ class Node:
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
         )
 
-    def _handle_worker_api(self, task_bin, blob: bytes, op: str = "") -> bytes:
+    def _handle_worker_api(self, task_bin, blob: bytes, op: str = "", worker_key=None) -> bytes:
         """A worker process made a nested runtime API call (worker_api.py).
 
         Blocking ops release the calling task's resources for the duration
@@ -480,7 +480,7 @@ class Node:
         if blocking:
             self.scheduler.release_blocked(spec)
         try:
-            return self.cluster.handle_worker_api(blob, op=op)
+            return self.cluster.handle_worker_api(blob, op=op, worker_key=worker_key)
         finally:
             if blocking and task_bin in self._proc_specs:
                 # reacquire ONLY if the task is still in flight: its worker
@@ -750,6 +750,12 @@ class Node:
             if inst is not None:
                 inst.dead = True
             self.cluster.on_actor_process_died(self, actor_id)
+        # a dead worker's borrower ledger can never report again — drop its
+        # per-worker ref pins (head pools release directly; agent fabrics
+        # relay a worker_died notice to the head, which owns the ledger)
+        on_died = getattr(self.cluster, "on_worker_process_died", None)
+        if on_died is not None:
+            on_died(worker.pid)
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:

@@ -13,7 +13,7 @@ import os
 import pickle
 import socket
 import struct
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct("<I")
 
@@ -158,13 +158,14 @@ def encode_value(value: Any, shm_store, id_factory) -> Any:
     return enc(value)
 
 
-def decode_put_blob(blob: bytes, shm_store) -> bytes:
+def decode_put_frame(blob: bytes, shm_store):
     """Resolve ShmRef markers inside a worker-api ``put`` frame at the FIRST
     hop that shares the worker's shm arena.  Worker ``rt.put`` of a bulk
     ndarray moves one shm memcpy + a tiny pickled marker over the pool
     socket instead of in-band pickled gigabytes (same policy as task
     args/results; reference: plasma puts from workers never ride the GCS).
-    No-op (returns the original blob) when no marker is present."""
+    Returns the DECODED ``(op, kw)`` tuple — never a re-pickled blob; the
+    round trip through pickle would copy the bulk value twice."""
     op, kw = pickle.loads(blob)
     value = kw.get("value")
 
@@ -177,14 +178,53 @@ def decode_put_blob(blob: bytes, shm_store) -> bytes:
             return any(isinstance(x, ShmRef) for x in v.values())
         return False
 
-    if shm_store is None or not has_ref(value):
-        return blob
-    kw["value"] = decode_value(value, shm_store)
-    return pickle.dumps((op, kw), protocol=5)
+    if shm_store is not None and has_ref(value):
+        kw["value"] = decode_value(value, shm_store)
+    return op, kw
 
 
-def decode_value(value: Any, shm_store, release: bool = True) -> Any:
+def nd_owner(arr):
+    """The data-owning ndarray at the bottom of a view chain.  NumPy
+    collapses ``.base`` through views, so a slice of a reshaped frombuffer
+    array keeps only the BOTTOM array alive — a finalizer must ride there,
+    or a surviving sub-view outlives the pin and reads reused memory."""
     import numpy as np
+
+    a = arr
+    while isinstance(a.base, np.ndarray):
+        a = a.base
+    return a
+
+
+def _release_entry(shm_store, oid: bytes, delete: bool) -> None:
+    """Finalizer for zero-copy views: drop the pin (and the entry, when we
+    were its consumer-of-record) once the array is garbage-collected."""
+    if getattr(shm_store, "_closed", False):
+        return
+    try:
+        shm_store.release(oid)
+        if delete:
+            shm_store.delete(oid)  # refuses (-2) if someone else still pins
+    except Exception:  # noqa: BLE001 — arena torn down mid-exit
+        pass
+
+
+def decode_value(value: Any, shm_store, release: bool = True,
+                 zero_copy: Optional[bool] = None) -> Any:
+    """Resolve ShmRef markers back into ndarrays.
+
+    ``zero_copy=True`` (the default, via config) returns READ-ONLY arrays
+    that view the arena directly — the plasma semantic: no copy-out, the
+    entry stays pinned until the array is garbage-collected (plasma client
+    Get maps the object read-only for exactly this reason,
+    ``plasma/client.h:62``).  ``zero_copy=False`` restores owned, writable
+    copies."""
+    import numpy as np
+
+    if zero_copy is None:
+        from ray_tpu.core.config import get_config
+
+        zero_copy = get_config().zero_copy_shm_values
 
     def dec(v):
         if isinstance(v, ShmRef):
@@ -192,6 +232,23 @@ def decode_value(value: Any, shm_store, release: bool = True) -> Any:
             if got is None:
                 raise KeyError(f"shm object {v.object_id.hex()} missing")
             view, meta_size = got
+            if zero_copy:
+                import weakref
+
+                try:
+                    dtype_str, shape = pickle.loads(view[:meta_size])
+                    flat = np.frombuffer(
+                        view[meta_size:].toreadonly(), dtype=np.dtype(dtype_str)
+                    )
+                    arr = flat.reshape(shape)
+                except BaseException:
+                    shm_store.release(v.object_id)
+                    raise
+                # finalize the data OWNER (flat), not the reshaped view:
+                # sub-views collapse .base to the owner, so only it is
+                # guaranteed to outlive every surviving slice
+                weakref.finalize(nd_owner(arr), _release_entry, shm_store, v.object_id, release)
+                return arr
             try:
                 dtype_str, shape = pickle.loads(view[:meta_size])
                 arr = np.frombuffer(view[meta_size:], dtype=np.dtype(dtype_str)).reshape(shape)

@@ -534,6 +534,7 @@ class HeadService:
             self._head_get_frames, self._head_put_frames, host=host,
             chunk_bytes=cfg.object_transfer_chunk_bytes,
             max_concurrent=cfg.max_concurrent_object_transfers,
+            shm_store=getattr(cluster, "shm_store", None),
         )
         self.data_client = data_plane.DataClient(
             chunk_bytes=cfg.object_transfer_chunk_bytes,
@@ -649,6 +650,7 @@ class HeadService:
             "mint_put_oid": self._h_mint_put_oid,
             "release_put_oid": self._h_release_put_oid,
             "worker_api": self._h_worker_api,
+            "worker_died": self._h_worker_died,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
             "kv_del": self._h_kv_del,
@@ -797,6 +799,17 @@ class HeadService:
 
             pins.pop(_OID(payload["oid"]), None)
 
+    def _h_worker_died(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """A worker process on an agent died: drop its ref pins (keyed the
+        same way _h_worker_api pins them)."""
+        from ray_tpu.runtime import worker_api
+
+        peer = getattr(conn, "peer", None)
+        worker_api.release_worker_pins(
+            self.cluster.core_worker,
+            (getattr(peer, "node_id", None), payload.get("pid")),
+        )
+
     def _h_worker_api(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """Nested API call relayed from an agent's worker.  Served OFF the
         connection's dispatch thread: a blocking nested get must not stall
@@ -804,9 +817,17 @@ class HeadService:
         it (deadlock otherwise)."""
         from ray_tpu.runtime import worker_api
 
+        # pin accounting key: (agent node, worker pid) — unique per worker
+        # process cluster-wide, so one worker's release can't drop a pin a
+        # different worker on another node still needs
+        peer = getattr(conn, "peer", None)
+        wkey = (getattr(peer, "node_id", None), payload.get("worker_key"))
+
         def run():
             try:
-                blob = worker_api.execute(self.cluster.core_worker, payload["blob"])
+                blob = worker_api.execute(
+                    self.cluster.core_worker, payload["blob"], worker_key=wkey
+                )
                 conn.send_reply(rid, {"blob": blob})
             except Exception:  # noqa: BLE001
                 import traceback

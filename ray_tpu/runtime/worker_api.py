@@ -53,10 +53,19 @@ def peek_op(blob: bytes) -> str:
 # ---------------------------------------------------------------------------
 # server side (runs in the DRIVER process against its CoreWorker)
 # ---------------------------------------------------------------------------
-def execute(core_worker, blob: bytes) -> bytes:
-    """Run one worker API call; returns pickled ("ok", result) / ("err", exc)."""
+def execute(core_worker, blob: bytes, decoded=None, worker_key=None) -> bytes:
+    """Run one worker API call; returns pickled ("ok", result) / ("err", exc).
+
+    ``decoded`` short-circuits the unpickle when the caller already loaded
+    the frame (the shm-marker put path: re-pickling a resolved bulk array
+    just to re-load it here would cost two full copies per put).
+    ``worker_key`` identifies the calling worker process for pin
+    accounting (see _pin_refs / release_refs)."""
     try:
-        op, kw = pickle.loads(blob)
+        op, kw = pickle.loads(blob) if decoded is None else decoded
+        if op == "release_refs":
+            _drop_pins(core_worker, worker_key, kw["released"])
+            return _dumps(("ok", None))
         if op == "put":
             result = core_worker.put(kw["value"])
         elif op == "get":
@@ -108,7 +117,21 @@ def execute(core_worker, blob: bytes) -> bytes:
             result = None
         else:
             raise ValueError(f"unknown worker api op {op!r}")
-        _pin_refs(core_worker, result)
+        # Serialize with ref capture: every ObjectRef occurrence pickled
+        # into the reply (at ANY depth — __reduce__ fires per occurrence)
+        # gets a counted pin matching the construction the worker's
+        # unpickle will perform.
+        from ray_tpu.core.object_ref import hooks as _hooks
+
+        ctx = _hooks.serialization_ctx
+        if ctx is not None and hasattr(ctx, "start_capture_refs"):
+            ctx.start_capture_refs()
+            try:
+                blob = _dumps(("ok", result))
+            finally:
+                captured = ctx.stop_capture_refs()
+            _pin_captured(core_worker, worker_key, captured)
+            return blob
         return _dumps(("ok", result))
     except BaseException as exc:  # noqa: BLE001 — errors cross the socket
         try:
@@ -126,27 +149,63 @@ def _control_kv():
     return api.get_cluster().control.kv
 
 
-def _pin_refs(core_worker, result) -> None:
-    """Refs returned to a worker must outlive this function: the worker
-    holds them, but its process has no reference counter, so the driver
-    pins a copy for the job's lifetime (otherwise the server-side ObjectRef
-    drops to zero the moment the reply is sent and the object is freed
-    before the worker ever gets it)."""
+def _pins_of(core_worker) -> dict:
     pins = getattr(core_worker, "_worker_api_pins", None)
     if pins is None:
         pins = core_worker._worker_api_pins = {}
+    return pins
 
-    def pin(ref) -> None:
-        pins.setdefault(ref.id(), ref)
 
-    from ray_tpu.core.object_ref import ObjectRef
+def _pin_captured(core_worker, worker_key, refs) -> None:
+    """Refs serialized into a worker-bound reply must outlive the send: the
+    worker holds them, so the driver pins them keyed (worker, oid) with a
+    DELIVERY COUNT, until the worker's reference ledger reports the last
+    local ref dead (release_refs) or the worker dies (release_worker_pins).
 
-    if isinstance(result, ObjectRef):
-        pin(result)
-    elif isinstance(result, (list, tuple)):
-        for r in result:
-            if isinstance(r, ObjectRef):
-                pin(r)
+    The count makes the protocol race-free: each pickled ref occurrence
+    becomes exactly one ObjectRef construction on the worker's unpickle
+    (pickler memoization on both sides), the worker's release reports how
+    many deliveries that holding-epoch consumed, and the pin drops only
+    when every delivery is accounted — so a release racing a reply that
+    re-delivers the same oid can never strand the worker's live ref."""
+    pins = _pins_of(core_worker)
+    for ref in refs:
+        key = (worker_key, ref.id())
+        entry = pins.get(key)
+        if entry is None:
+            pins[key] = [ref, 1]
+        else:
+            entry[1] += 1
+
+
+def _drop_pins(core_worker, worker_key, released) -> None:
+    """``released``: [(oid_binary, delivered_count), ...] from the worker's
+    ledger.  Decrement by the reported deliveries; pop at zero."""
+    from ray_tpu.core.ids import ObjectID
+
+    pins = _pins_of(core_worker)
+    for b, k in released:
+        if k <= 0:
+            continue  # arg-only ref: never pinned here
+        key = (worker_key, ObjectID(b))
+        entry = pins.get(key)
+        if entry is None:
+            continue
+        entry[1] -= k
+        if entry[1] <= 0:
+            pins.pop(key, None)
+
+
+def release_worker_pins(core_worker, worker_key) -> None:
+    """A worker process died: every pin it held dies with it (its borrower
+    ledger can no longer report)."""
+    if core_worker is None:
+        return
+    pins = getattr(core_worker, "_worker_api_pins", None)
+    if not pins:
+        return
+    for key in [k for k in pins if k[0] == worker_key]:
+        pins.pop(key, None)
 
 
 # ---------------------------------------------------------------------------
@@ -178,7 +237,17 @@ class WorkerApiClient:
         # op rides beside the blob so the node's blocking-op check never
         # needs to deserialize the (possibly huge) payload
         self._send(rid, _dumps((op, kw)), self._current_task(), op)
-        status, result = pickle.loads(fut.result())
+        blob = fut.result()
+        # unpickle under reply capture: ObjectRef constructions here are
+        # owner-pinned deliveries the release protocol must account for
+        from ray_tpu.core.object_ref import hooks as _hooks
+
+        ctr = _hooks.ref_counter
+        if ctr is not None and hasattr(ctr, "reply_capture"):
+            with ctr.reply_capture():
+                status, result = pickle.loads(blob)
+        else:
+            status, result = pickle.loads(blob)
         if status == "err":
             raise result
         return result
@@ -223,6 +292,14 @@ class WorkerApiClient:
             "submit_actor_task",
             actor_id=actor_id, method_name=method_name, args=args, kwargs=kwargs, **opts,
         )
+
+    def release_refs(self, released: list) -> None:
+        """Fire-and-forget: tell the owner the last local refs for these
+        oids died — ``released`` is [(oid_binary, delivered_count), ...].
+        No future is registered; the reply (if any) is discarded by
+        on_reply."""
+        rid = next(self._rid)
+        self._send(rid, _dumps(("release_refs", {"released": released})), None, "release_refs")
 
     # -- cluster KV (collective rank registration from worker processes) ---
     def kv_put(self, key: bytes, value: bytes) -> None:

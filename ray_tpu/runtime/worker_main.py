@@ -50,6 +50,81 @@ def main() -> None:
     Worker(sock, shm_store).run()
 
 
+class _WorkerRefCounter:
+    """Minimal per-process reference ledger for worker processes.
+
+    Tracks live ObjectRef instances by oid, and separately how many of them
+    were DELIVERED in api replies (counted during the reply unpickle via
+    ``reply_capture``).  When an oid's instance count hits zero, the ledger
+    queues ``(oid, delivered)`` and a daemon flusher sends a
+    fire-and-forget ``release_refs`` frame to the owner, which decrements
+    this worker's counted pin by exactly those deliveries
+    (worker_api._pin_captured / _drop_pins) — so a release racing a reply
+    that re-delivers the same oid can never strand a live ref.  Role
+    parity: the reference's borrower protocol — a borrower reports to the
+    owner when its local refs are gone (reference_count.h
+    WaitForRefRemoved)."""
+
+    _FLUSH_EVERY_S = 0.2
+    _FLUSH_AT = 128
+
+    def __init__(self, api_client):
+        self._api = api_client
+        self._counts: dict = {}
+        self._delivered: dict = {}
+        self._pending: list = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._capturing = threading.local()
+        threading.Thread(target=self._flush_loop, name="worker-ref-flush", daemon=True).start()
+
+    def reply_capture(self):
+        """Context manager marking this thread's ObjectRef constructions as
+        reply deliveries (owner-pinned)."""
+        counter = self
+
+        class _Cap:
+            def __enter__(self):
+                counter._capturing.active = True
+
+            def __exit__(self, *exc):
+                counter._capturing.active = False
+
+        return _Cap()
+
+    def add_local_reference(self, oid) -> None:
+        with self._lock:
+            self._counts[oid] = self._counts.get(oid, 0) + 1
+            if getattr(self._capturing, "active", False):
+                self._delivered[oid] = self._delivered.get(oid, 0) + 1
+
+    def enqueue_local_ref_removal(self, oid) -> None:
+        # called from __del__ — must stay allocation-light and never raise
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n > 0:
+                self._counts[oid] = n
+                return
+            self._counts.pop(oid, None)
+            delivered = self._delivered.pop(oid, 0)
+            self._pending.append((oid.binary(), delivered))
+            if len(self._pending) >= self._FLUSH_AT:
+                self._wake.set()
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._wake.wait(self._FLUSH_EVERY_S)
+            self._wake.clear()
+            with self._lock:
+                batch, self._pending = self._pending, []
+            if not batch:
+                continue
+            try:
+                self._api.release_refs(batch)
+            except Exception:  # noqa: BLE001 — pool gone: exit quietly
+                return
+
+
 class Worker:
     def __init__(self, sock: socket.socket, shm_store):
         import queue as _q
@@ -90,17 +165,35 @@ class Worker:
             shm_store=self._shm, shm_id_factory=self._next_shm_id,
         )
         set_global_worker(self._api)
+        # Worker-side reference counting: when the last local ObjectRef for
+        # an oid dies, tell the owner so it can drop this worker's pin
+        # (without this, every ref a worker ever held stays pinned for the
+        # job's lifetime and bulk put churn fills the arena forever).
+        from ray_tpu.core.object_ref import hooks
+
+        hooks.ref_counter = _WorkerRefCounter(self._api)
 
     def run(self) -> None:
         p = self._protocol
         p.send_msg(self._sock, "register", {"pid": os.getpid()})
         self._install_api()
-        # Execution runs on its own thread so the socket reader stays free
-        # to deliver api_reply frames while a task blocks in a nested
-        # rt.get (single exec thread: one task at a time, actor-call order
-        # preserved — ActorSchedulingQueue parity as before).
-        exec_thread = threading.Thread(target=self._exec_loop, name="worker-exec", daemon=True)
-        exec_thread.start()
+        # Execution runs on the MAIN thread; the socket reader gets its own
+        # thread so api_reply frames still arrive while a task blocks in a
+        # nested rt.get (single exec thread: one task at a time, actor-call
+        # order preserved — ActorSchedulingQueue parity as before).
+        # Main-thread exec matters for throughput: glibc serves a non-main
+        # thread's >64 MB allocations by mmap/munmap regardless of
+        # MALLOC_MMAP_THRESHOLD_ (per-thread heaps cap at HEAP_MAX_SIZE), so
+        # a task allocating a bulk array every call would page-fault the
+        # whole buffer in each time; the main arena reuses its top chunk.
+        reader_thread = threading.Thread(
+            target=self._reader_loop, name="worker-reader", daemon=True
+        )
+        reader_thread.start()
+        self._exec_loop()
+
+    def _reader_loop(self) -> None:
+        p = self._protocol
         reader = p.FrameReader(self._sock)
         while True:
             try:

@@ -156,6 +156,17 @@ class ProcessWorkerPool:
                     "PYTHONPATH": pythonpath,
                     # pipes are block-buffered; prints must reach the driver live
                     "PYTHONUNBUFFERED": "1",
+                    # Keep glibc from mmap'ing (and on free, munmap'ing)
+                    # bulk allocations: a task allocating a few-hundred-MB
+                    # array every call would otherwise page-fault the full
+                    # buffer in each time (~5x slower than reused hot
+                    # pages). Users can override either knob.
+                    "MALLOC_MMAP_THRESHOLD_": os.environ.get(
+                        "MALLOC_MMAP_THRESHOLD_", str(512 * 1024 * 1024)
+                    ),
+                    "MALLOC_TRIM_THRESHOLD_": os.environ.get(
+                        "MALLOC_TRIM_THRESHOLD_", str(512 * 1024 * 1024)
+                    ),
                     **({"RT_DATA_IP": self.data_ip} if self.data_ip else {}),
                     **({"RT_HEAD_IP": self.head_ip} if self.head_ip else {}),
                     **({"RT_NODE_ID": self.node_hex} if self.node_hex else {}),
@@ -495,7 +506,10 @@ class ProcessWorkerPool:
             try:
                 if handler is None:
                     raise RuntimeError("nested runtime API is not available on this node")
-                blob = handler(payload.get("task_id"), payload["blob"], payload.get("op", ""))
+                blob = handler(
+                    payload.get("task_id"), payload["blob"], payload.get("op", ""),
+                    worker.pid,
+                )
             except BaseException as exc:  # noqa: BLE001
                 import pickle as _p
 

@@ -309,3 +309,117 @@ def test_small_values_stay_on_control_plane(two_agent_cluster):
     assert rt.get(tiny.remote(), timeout=60) == 42
     after = cluster.head_service.data_client.stats.snapshot()["pulls_issued"]
     assert after == before
+
+
+# ==========================================================================
+# same-host shm handoff (plasma zero-copy local sharing role: store.h:55)
+# ==========================================================================
+@pytest.fixture
+def shm_server_store():
+    from ray_tpu.native.shm_store import ShmObjectStore
+
+    shm = ShmObjectStore(f"/rt_test_dp_{os.getpid():x}_{os.urandom(2).hex()}", 1 << 28)
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store, chunk_bytes=1 << 20, shm_store=shm)
+    yield store, server, shm
+    server.close()
+    shm.close()
+    shm.unlink()
+
+
+def test_same_host_pull_moves_zero_socket_bytes(shm_server_store):
+    """A same-host pull hands the payload through the shm arena: the data
+    socket carries only the offer header — zero object bytes."""
+    store, server, shm = shm_server_store
+    oid = ObjectID.from_random()
+    value = np.arange(500_000, dtype=np.int64)  # 4 MB, well over inline
+    store.put(oid, value)
+
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    got, is_error = client.pull(server.address, oid.binary())
+    assert not is_error
+    np.testing.assert_array_equal(got, value)
+    stats = server.stats.snapshot()
+    assert stats["shm_handoffs"] == 1
+    assert stats["bytes_sent"] == 0  # ZERO object bytes on the socket
+    assert client.stats.snapshot()["shm_handoffs"] == 1
+    # handoff values are read-only views (plasma Get semantics)
+    assert isinstance(got, np.ndarray) and not got.flags.writeable
+    client.close()
+
+
+def test_same_host_pull_disabled_by_config(shm_server_store, monkeypatch):
+    store, server, shm = shm_server_store
+    monkeypatch.setenv("RAY_TPU_SAME_HOST_SHM_TRANSFER", "0")
+    from ray_tpu.core import config as config_mod
+
+    config_mod.reload_config() if hasattr(config_mod, "reload_config") else None
+    oid = ObjectID.from_random()
+    value = np.arange(200_000, dtype=np.int64)
+    store.put(oid, value)
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    try:
+        from ray_tpu.core.config import get_config
+
+        if get_config().same_host_shm_transfer:
+            pytest.skip("config not env-reloadable in-process")
+        got, _ = client.pull(server.address, oid.binary())
+        np.testing.assert_array_equal(got, value)
+        assert server.stats.snapshot()["shm_handoffs"] == 0
+    finally:
+        client.close()
+
+
+def test_shm_offer_fallback_when_entry_vanishes(shm_server_store):
+    """If the staged/passthrough entry disappears between offer and consume,
+    the client falls back to the socket path and still succeeds."""
+    store, server, shm = shm_server_store
+    oid = ObjectID.from_random()
+    value = np.arange(300_000, dtype=np.int64)
+    store.put(oid, value)
+
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    real_consume = client._consume_shm_offer
+    calls = {"n": 0}
+
+    def broken_consume(offer, is_error):
+        calls["n"] += 1
+        raise data_plane.DataPlaneError("simulated vanished entry")
+
+    client._consume_shm_offer = broken_consume
+    got, _ = client.pull(server.address, oid.binary())
+    np.testing.assert_array_equal(got, value)
+    assert calls["n"] == 1  # the shm path was attempted, then fell back
+    client._consume_shm_offer = real_consume
+    client.close()
+
+
+def test_worker_put_refs_release_arena(tmp_path):
+    """Worker-side borrower ledger: dropping the last worker-held ref for a
+    bulk put drains the head's shm arena (regression: pins used to live for
+    the job's lifetime, so put churn filled the arena forever)."""
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        if cluster.shm_store is None:
+            pytest.skip("no shm arena on this host")
+
+        @rt.remote
+        def churn():
+            for _ in range(3):
+                r = rt.put(np.zeros(2 * 1024 * 1024, dtype=np.uint8))
+                del r
+            return None
+
+        rt.get(churn.remote(), timeout=60)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if cluster.shm_store.num_objects == 0:
+                break
+            time.sleep(0.2)
+        assert cluster.shm_store.num_objects == 0, (
+            f"arena still holds {cluster.shm_store.num_objects} objects "
+            f"({cluster.shm_store.used_bytes >> 20} MB) after refs died"
+        )
+    finally:
+        rt.shutdown()
