@@ -57,6 +57,10 @@ def model_mfu(steps: int = 8):
     on_cpu = dev.platform == "cpu"
     # sized to fit one 16G-HBM chip WITH adam state + f32 masters: ~0.6B
     # params; flash attention + per-layer remat keep activation memory flat
+    # remat="dots" (save matmul outputs, recompute elementwise) +
+    # unrolled layers (scan stacks remat saves through dynamic-update-slice
+    # — measured ~25% of the step) + full-T masked loss (odd T-1 forced
+    # pad/slice on every (8,128)-tiled tensor): 52.5% -> 63% MFU on v5e.
     cfg = TransformerConfig(
         vocab_size=32_000,
         d_model=256 if on_cpu else 2048,
@@ -66,9 +70,10 @@ def model_mfu(steps: int = 8):
         max_seq_len=256 if on_cpu else 2048,
         dtype=jnp.bfloat16,
         attention="dense" if on_cpu else "flash",
-        remat=not on_cpu,
+        remat=False if on_cpu else "dots",
+        scan_layers=on_cpu,
     )
-    batch = 1 if on_cpu else 4
+    batch = 1 if on_cpu else 6
     seq = cfg.max_seq_len
     init_state, train_step = make_train_step(cfg)
     state = init_state(jax.random.key(0))
@@ -78,7 +83,24 @@ def model_mfu(steps: int = 8):
     # compile + warm; float() forces a device->host read — on tunneled
     # platforms block_until_ready can return at enqueue, which would time
     # the Python dispatch loop instead of the chip
-    state, loss = train_step(state, tokens)
+    try:
+        state, loss = train_step(state, tokens)
+    except Exception as exc:
+        # batch 6 rides close to the 16G HBM line beside adam state; an
+        # OOM at compile falls back to the always-fits batch.  Anything
+        # that isn't memory-shaped re-raises — masking a real bug behind a
+        # batch-4 retry would point the report at the wrong failure.
+        msg = str(exc)
+        if not any(s in msg for s in ("RESOURCE_EXHAUSTED", "ResourceExhausted",
+                                      "Out of memory", "OOM", "remote_compile")):
+            raise
+        batch = 4
+        tokens = tokens[:batch]
+        # drop the undonated first state BEFORE re-initializing: two ~7 GB
+        # adamw states never coexist on a 16 GB chip
+        state = loss = None
+        state = init_state(jax.random.key(0))
+        state, loss = train_step(state, tokens)
     assert np.isfinite(float(loss))
     t0 = time.perf_counter()
     for _ in range(steps):
