@@ -134,13 +134,17 @@ class ActorID(BaseID):
 
 
 # Hot path: task ids are minted at submission rate; a process-wide atomic
-# 64-bit counter is collision-free for the life of any driver and ~50x
-# cheaper than urandom.
-_task_counter = itertools.count(2)
+# 64-bit counter is ~50x cheaper than urandom.  The counter starts at a
+# RANDOM 62-bit offset: worker processes mint task ids locally
+# (fire-and-forget nested submission), and two processes counting from a
+# fixed base would collide on their early ids — observed as one task's
+# return object satisfying another task's get.
+_task_counter = itertools.count(int.from_bytes(os.urandom(8), "little") >> 2)
+_UNIQUE_MASK = (1 << (8 * _TASK_UNIQUE_SIZE)) - 1
 
 
 def _next_unique() -> bytes:
-    return next(_task_counter).to_bytes(_TASK_UNIQUE_SIZE, "little")
+    return (next(_task_counter) & _UNIQUE_MASK).to_bytes(_TASK_UNIQUE_SIZE, "little")
 
 
 class TaskID(BaseID):

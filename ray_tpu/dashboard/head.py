@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -76,13 +77,48 @@ class DashboardHead:
         threading.Thread(target=self._self_sample_loop, name="dashboard-sampler", daemon=True).start()
 
     def _self_sample_loop(self) -> None:
+        from collections import deque
+
         from ray_tpu.dashboard.reporter import SystemSampler
 
         sampler = SystemSampler()
         head_node = self.cluster.head_node
+        # cluster-wide rate series (tasks/s, transfer B/s): sampled from the
+        # counters the runtime already keeps, ~15 min of 2s points
+        self.cluster_history: deque = deque(maxlen=450)
+        prev_tasks = prev_bytes = None
+        prev_t = time.monotonic()
         while not self._stop_sampler.wait(2.0):
             if head_node is not None:
                 self.cluster.metrics_history.add(head_node.node_id.hex(), sampler.sample())
+            now = time.monotonic()
+            dt = max(1e-6, now - prev_t)
+            tasks = self._terminal_task_count()
+            xfer = self.cluster.transfer_bytes + self._peer_bytes_received()
+            point = {"ts": time.time()}
+            if prev_tasks is not None:
+                point["tasks_per_s"] = max(0.0, (tasks - prev_tasks) / dt)
+                point["transfer_bytes_per_s"] = max(0.0, (xfer - prev_bytes) / dt)
+            prev_tasks, prev_bytes, prev_t = tasks, xfer, now
+            self.cluster_history.append(point)
+
+    def _terminal_task_count(self) -> float:
+        from ray_tpu.observability.metrics import global_registry
+
+        try:
+            m = global_registry().counter("tasks_terminal_total")
+            return float(sum(v for _tags, v in m.series()))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _peer_bytes_received(self) -> float:
+        try:
+            hs = self.cluster.head_service
+            if hs is None:
+                return 0.0
+            return float(hs.data_client.stats.snapshot()["bytes_received"])
+        except Exception:  # noqa: BLE001
+            return 0.0
 
     @property
     def url(self) -> str:
@@ -126,6 +162,18 @@ class DashboardHead:
             req._send(200, {"placement_groups": state_api.list_placement_groups(limit=limit)})
         elif path == "/api/cluster_status":
             req._send(200, self._cluster_status())
+        elif path == "/api/logs/search":
+            pattern = query.get("q", [""])[0]
+            node_q = query.get("node", [None])[0]
+            req._send(
+                200,
+                {"matches": self.cluster.node_logs.search(
+                    pattern, limit=limit, node_hex=node_q
+                )},
+            )
+        elif path == "/api/stack":
+            timeout = float(query.get("timeout", ["5"])[0])
+            req._send(200, self.cluster.dump_cluster_stacks(timeout=timeout))
         elif path == "/api/transfers":
             req._send(200, self._transfer_stats())
         elif path == "/api/memory":
@@ -141,6 +189,10 @@ class DashboardHead:
         elif path == "/api/metrics_history":
             minutes = float(query.get("minutes", ["15"])[0])
             req._send(200, {"nodes": self.cluster.metrics_history.all_series(minutes)})
+        elif path == "/api/metrics/cluster_history":
+            cutoff = time.time() - float(query.get("minutes", ["15"])[0]) * 60
+            pts = [p for p in getattr(self, "cluster_history", ()) if p["ts"] >= cutoff]
+            req._send(200, {"points": pts})
         elif path.startswith("/api/nodes/") and path.endswith("/metrics"):
             node_hex = self._resolve_node_hex(path[len("/api/nodes/"): -len("/metrics")])
             minutes = float(query.get("minutes", ["15"])[0])

@@ -170,3 +170,29 @@ class NodeLogStore:
     def nodes(self):
         with self._lock:
             return list(self._logs.keys())
+
+    def search(self, pattern: str, limit: int = 500, node_hex: str | None = None):
+        """Cross-node log grep (regex; falls back to substring on a bad
+        pattern).  Returns [{"node", "line"}] newest-last, capped at
+        ``limit`` (reference: the dashboard log module's search box)."""
+        import re
+
+        try:
+            rx = re.compile(pattern)
+            match = rx.search
+        except re.error:
+            match = lambda line: pattern in line  # noqa: E731
+        # snapshot under the lock, match OUTSIDE it: a pathological regex
+        # (catastrophic backtracking) must not stall log ingestion
+        with self._lock:
+            items = (
+                [(node_hex, list(self._logs.get(node_hex, ())))]
+                if node_hex is not None
+                else [(n, list(buf)) for n, buf in self._logs.items()]
+            )
+        out = []
+        for node, buf in items:
+            for line in buf:
+                if match(line):
+                    out.append({"node": node, "line": line})
+        return out[-limit:]

@@ -55,9 +55,13 @@ INDEX_HTML = """<!doctype html>
     <table id="memnodes"></table><table id="memtop" style="margin-top:8px"></table></section>
   <section style="grid-column: 1 / -1"><h2>Data-plane transfers</h2><table id="transfers"></table></section>
   <section style="grid-column: 1 / -1"><h2>Dataset executions</h2><table id="datasets"></table></section>
+  <section style="grid-column: 1 / -1"><h2>Cluster throughput</h2><div id="clusterrates"></div></section>
   <section style="grid-column: 1 / -1"><h2>Node utilization</h2><div id="util"></div></section>
   <section style="grid-column: 1 / -1"><h2>Node logs</h2>
-    <div style="margin-bottom:8px">node: <select id="lognode" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d"></select></div>
+    <div style="margin-bottom:8px">node: <select id="lognode" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d"></select>
+      &nbsp; search all nodes: <input id="logq" placeholder="regex" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d;width:220px">
+      <button onclick="searchLogs()" style="background:#2a323d;color:#d6dbe1;border:0;padding:2px 10px;cursor:pointer">grep</button></div>
+    <pre id="logsearch" style="max-height:200px;overflow:auto"></pre>
     <pre id="nodelogs" style="max-height:260px;overflow:auto"></pre>
   </section>
   <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre>
@@ -138,6 +142,7 @@ async function refresh() {
   if (events) $("events").textContent =
     (events.events || []).map(e => `${e.timestamp ?? ""} [${e.severity ?? e.level ?? ""}] ${e.label ?? ""} ${e.message ?? ""}`).join("\\n") || "(none)";
   await refreshUtil();
+  await refreshClusterRates();
   await refreshLogs();
   await refreshTransfers();
   await refreshMemory();
@@ -224,6 +229,39 @@ function spark(points, key, color) {
   return `<svg width="${w}" height="${h}" style="vertical-align:middle">
     <polyline points="${pts}" fill="none" stroke="${color}" stroke-width="1.5"/></svg>
     <span class="num" style="margin-left:6px">${last.toFixed(1)}%</span>`;
+}
+function fmtRate(v, unit) {
+  if (unit === "B/s") {
+    if (v >= 1e9) return (v / 1e9).toFixed(2) + " GB/s";
+    if (v >= 1e6) return (v / 1e6).toFixed(1) + " MB/s";
+    if (v >= 1e3) return (v / 1e3).toFixed(1) + " KB/s";
+  }
+  return v >= 1000 ? (v / 1000).toFixed(1) + "k" + unit.replace("B/s", "/s") : v.toFixed(1) + " " + unit;
+}
+function sparkRate(points, key, color, unit) {
+  const w = 260, h = 36;
+  const vals = points.map(p => p[key]).filter(v => v != null);
+  if (!vals.length) return "<span style='color:#555'>no data</span>";
+  const max = Math.max(1e-9, ...vals);
+  const step = vals.length > 1 ? w / (vals.length - 1) : w;
+  const pts = vals.map((v, i) => `${(i * step).toFixed(1)},${(h - h * v / max).toFixed(1)}`).join(" ");
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">
+    <polyline points="${pts}" fill="none" stroke="${color}" stroke-width="1.5"/></svg>
+    <span class="num" style="margin-left:6px">${fmtRate(vals[vals.length - 1], unit)}</span>`;
+}
+async function refreshClusterRates() {
+  const hist = await get("/api/metrics/cluster_history?minutes=15");
+  if (!hist || !(hist.points || []).length) { $("clusterrates").innerHTML = "(no samples yet)"; return; }
+  $("clusterrates").innerHTML = `<table><tr>
+    <td>tasks/s ${sparkRate(hist.points, "tasks_per_s", "#7fd1b9", "/s")}</td>
+    <td>transfer ${sparkRate(hist.points, "transfer_bytes_per_s", "#e8c268", "B/s")}</td></tr></table>`;
+}
+async function searchLogs() {
+  const q = $("logq").value;
+  if (!q) { $("logsearch").textContent = ""; return; }
+  const res = await get(`/api/logs/search?q=${encodeURIComponent(q)}&limit=200`);
+  $("logsearch").textContent = (res && res.matches || [])
+    .map(m => `[${m.node.slice(0, 12)}] ${m.line}`).join("\\n") || "(no matches)";
 }
 async function refreshUtil() {
   const hist = await get("/api/metrics_history?minutes=15");

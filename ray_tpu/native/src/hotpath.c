@@ -326,7 +326,11 @@ static IDType BaseID_TypeSpec = {
 
 /* ---- mint counters (GIL-protected) -------------------------------- */
 
-static uint64_t task_counter = 2; /* parity: ids.py itertools.count(2) */
+/* Starts at a RANDOM 62-bit offset (parity: ids.py _task_counter):
+ * worker processes mint task ids locally (fire-and-forget nested
+ * submission), and two processes counting from a fixed base collide on
+ * their early ids.  Seeded in PyInit. */
+static uint64_t task_counter = 2;
 static uint64_t job_counter = 0;
 
 static inline void
@@ -970,6 +974,11 @@ PyInit__hotpath(void)
 {
     if (PyType_Ready(&BaseID_Type) < 0 || PyType_Ready(&FrameDecoder_Type) < 0)
         return NULL;
+    {
+        uint64_t seed = 0;
+        if (getrandom(&seed, sizeof(seed), 0) == (ssize_t)sizeof(seed))
+            task_counter = seed >> 2;
+    }
     {
         const char *env = getenv("RAY_TPU_MAX_FRAME_BYTES");
         if (env != NULL && env[0] != '\0') {

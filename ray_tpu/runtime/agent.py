@@ -275,6 +275,35 @@ class AgentFabric:
         ``op`` rides beside the blob so only the ops with a local fast path
         (get/put) are ever deserialized here; everything else relays as an
         opaque blob."""
+        from ray_tpu.runtime.worker_api import ASYNC_OPS
+
+        if op in ASYNC_OPS:
+            if op == "put_async":
+                # keep the BYTES in this node's store; the head records
+                # only ownership + the worker pin (register_put_async) and
+                # learns placement from object_location
+                try:
+                    if self._local_put_async(blob, worker_key):
+                        return b""
+                except Exception:  # noqa: BLE001 — fall through to full relay
+                    pass
+                # relay fallback must resolve shm markers HERE — the head
+                # cannot read this host's arena
+                shm = getattr(getattr(self.node, "store", None), "_shm", None)
+                if shm is not None:
+                    import pickle as _pickle
+
+                    from ray_tpu.runtime import protocol as _protocol
+
+                    blob = _pickle.dumps(
+                        _protocol.decode_put_frame(blob, shm), protocol=5
+                    )
+            # fire-and-forget: relay as a notification — the control
+            # connection preserves order, the head processes inline
+            self.conn.send(
+                "worker_api_async", {"blob": blob, "op": op, "worker_key": worker_key}
+            )
+            return b""
         if op == "get":
             try:
                 local = self._local_get(blob)
@@ -309,6 +338,41 @@ class AgentFabric:
             "worker_api", {"blob": blob, "worker_key": worker_key}, timeout=24 * 3600.0
         )
         return reply["blob"]
+
+    def _local_put_async(self, blob: bytes, worker_key) -> bool:
+        """Worker-minted fire-and-forget put: bytes stay in this node's
+        store; the head gets a tiny ownership+pin notice.  Returns False
+        when the value must rebuild in the driver (nested refs)."""
+        import pickle
+
+        from ray_tpu.core.ids import ObjectID as _OID
+        from ray_tpu.runtime import worker_api
+        from ray_tpu.runtime import protocol as _protocol
+
+        shm = getattr(getattr(self.node, "store", None), "_shm", None)
+        if shm is not None:
+            _op, kw = _protocol.decode_put_frame(blob, shm)
+        else:
+            _op, kw = pickle.loads(blob)
+        value = kw["value"]
+        if not _ref_free(value):
+            return False
+        oid = _OID(kw["oid"])
+        self.node.store.put(oid, value)
+        from ray_tpu.runtime.device_plane import is_device_array
+
+        self.conn.send(
+            "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
+        )
+        self.conn.send(
+            "worker_api_async",
+            {
+                "blob": worker_api._dumps(("register_put_async", {"oid": kw["oid"]})),
+                "op": "register_put_async",
+                "worker_key": worker_key,
+            },
+        )
+        return True
 
     def _local_put(self, blob: bytes, decoded=None) -> Optional[bytes]:
         """Nested put: the BYTES stay in this node's store; the head only
@@ -674,8 +738,28 @@ class NodeAgent:
             "delete_object": self._h_delete_object,
             "shutdown": self._h_shutdown,
             "coll_fail": self._h_coll_fail,
+            "dump_stacks": self._h_dump_stacks,
             "ping": lambda c, p, rid=None: {},
         }
+
+    def _h_dump_stacks(self, conn, payload: dict, rid: int):
+        """`rt stack`: this agent's threads + its pool workers'.  Collected
+        OFF the dispatch thread — worker replies need the connection live."""
+        import threading as _t
+
+        from ray_tpu.runtime import stack as _stack
+
+        def run():
+            try:
+                out = _stack.node_stacks(self.node, timeout=float(payload.get("timeout", 5.0)))
+                conn.send_reply(rid, out)
+            except Exception:  # noqa: BLE001
+                import traceback as _tb
+
+                conn.send_reply(rid, {"_exc": _tb.format_exc()})
+
+        _t.Thread(target=run, name="stack-dump", daemon=True).start()
+        return rpc.DEFER
 
     def _h_coll_fail(self, conn, payload) -> None:
         """Cluster-wide collective death notice: fail open waits in THIS

@@ -602,6 +602,43 @@ class Cluster:
                 if self._demand_entries:
                     self._demand_cv.wait(timeout=0.05)  # tick while backlogged
 
+    def dump_cluster_stacks(self, timeout: float = 5.0) -> dict:
+        """Live thread stacks from the driver, every local node's pool
+        workers, and every remote agent (`rt stack`; reference:
+        scripts.py:1830 `ray stack`, node-local py-spy)."""
+        import threading as _t
+
+        from ray_tpu.runtime import stack as _stack
+
+        out = {"driver": _stack.format_thread_stacks(), "nodes": {}}
+        threads = []
+        for nid, node in list(self.nodes.items()):
+            if node.dead:
+                continue
+            if hasattr(node, "conn"):  # remote agent: ask it — in PARALLEL,
+                # so N wedged agents cost one timeout, not N (a stuck
+                # cluster is exactly when this command runs)
+                def ask(nid=nid, node=node):
+                    try:
+                        entry = node.conn.request(
+                            "dump_stacks", {"timeout": timeout}, timeout=timeout + 10
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        entry = {"error": f"<agent unreachable: {exc}>"}
+                    out["nodes"][nid.hex()] = entry
+
+                th = _t.Thread(target=ask, name="stack-fanout", daemon=True)
+                th.start()
+                threads.append(th)
+            else:  # in-process node: its pool workers answer directly
+                entry = _stack.node_stacks(node, timeout=timeout)
+                entry.pop("process", None)  # same process as the driver
+                out["nodes"][nid.hex()] = entry
+        deadline = time.monotonic() + timeout + 12
+        for th in threads:
+            th.join(max(0.0, deadline - time.monotonic()))
+        return out
+
     def on_worker_process_died(self, pid) -> None:
         """A pool worker on the head host died: its borrower ledger can
         never report again, so drop every ref pin it held."""
@@ -618,7 +655,7 @@ class Cluster:
         if self.core_worker is None:
             raise RuntimeError("no core worker attached to this cluster")
         decoded = None
-        if op == "put" and self.shm_store is not None:
+        if op in ("put", "put_async") and self.shm_store is not None:
             # bulk put payloads arrive as shm markers, not in-band pickle;
             # hand execute() the decoded frame — a re-pickle round trip
             # would copy the bulk value twice

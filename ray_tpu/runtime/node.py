@@ -209,6 +209,7 @@ class Node:
         self.worker_pool.node_hex = node_id.hex()
         self.worker_pool.set_on_worker_death(self._on_worker_death)
         self.worker_pool.api_handler = self._handle_worker_api
+        self.worker_pool.serve_inline_sync = hasattr(self.cluster, "core_worker")
         # Prestart a warm worker off-thread (reference: WorkerPool prestart,
         # worker_pool.h:169-193) so the first task doesn't pay the ~200ms
         # child-interpreter startup; further growth is demand-driven and

@@ -650,6 +650,7 @@ class HeadService:
             "mint_put_oid": self._h_mint_put_oid,
             "release_put_oid": self._h_release_put_oid,
             "worker_api": self._h_worker_api,
+            "worker_api_async": self._h_worker_api_async,
             "worker_died": self._h_worker_died,
             "kv_put": self._h_kv_put,
             "kv_get": self._h_kv_get,
@@ -808,6 +809,18 @@ class HeadService:
         worker_api.release_worker_pins(
             self.cluster.core_worker,
             (getattr(peer, "node_id", None), payload.get("pid")),
+        )
+
+    def _h_worker_api_async(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """Fire-and-forget worker API op relayed from an agent (async
+        submits, ref releases): processed inline — cheap, never blocking —
+        so the control connection's frame order carries through."""
+        from ray_tpu.runtime import worker_api
+
+        peer = getattr(conn, "peer", None)
+        worker_api.execute(
+            self.cluster.core_worker, payload["blob"],
+            worker_key=(getattr(peer, "node_id", None), payload.get("worker_key")),
         )
 
     def _h_worker_api(self, conn: rpc.RpcConnection, payload: dict, rid: int):

@@ -91,9 +91,12 @@ class CoreWorker:
         execution: str = "auto",
         scheduling_strategy: Any = None,
         runtime_env: Optional[dict] = None,
+        _task_id: Optional[bytes] = None,
     ) -> List[ObjectRef]:
         cfg = get_config()
-        task_id = TaskID.for_normal_task(self.job_id)
+        # _task_id: a worker minted the id locally (fire-and-forget nested
+        # submission) — use it so its locally-built refs resolve here
+        task_id = TaskID(_task_id) if _task_id is not None else TaskID.for_normal_task(self.job_id)
         streaming = num_returns == "streaming"
         if streaming:
             return_ids = []  # item refs materialize as the generator yields
@@ -183,13 +186,14 @@ class CoreWorker:
         *,
         num_returns: int = 1,
         name: str = "",
+        _task_id: Optional[bytes] = None,
     ) -> List[ObjectRef]:
         if num_returns == "streaming":
             raise ValueError(
                 "num_returns='streaming' is not supported for actor tasks "
                 "(supported for @remote functions only)"
             )
-        task_id = TaskID.for_actor_task(actor_id)
+        task_id = TaskID(_task_id) if _task_id is not None else TaskID.for_actor_task(actor_id)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         deps = _collect_deps(args, kwargs)
         spec = TaskSpec(

@@ -50,6 +50,12 @@ def main() -> None:
     Worker(sock, shm_store).run()
 
 
+def _format_stacks() -> str:
+    from ray_tpu.runtime.stack import format_thread_stacks
+
+    return format_thread_stacks()
+
+
 class _WorkerRefCounter:
     """Minimal per-process reference ledger for worker processes.
 
@@ -205,6 +211,13 @@ class Worker:
                 break
             if msg_type == "api_reply":
                 self._api.on_reply(payload["rid"], payload["blob"])
+            elif msg_type == "dump_stacks":
+                # READER thread: must answer even when the exec thread is
+                # wedged — that is the whole point of `rt stack`
+                self._reply(
+                    "stacks_reply",
+                    {"token": payload.get("token"), "stacks": _format_stacks()},
+                )
             elif msg_type == "fail_group":
                 # handled on the READER thread: the exec thread may be the
                 # one blocked inside the collective wait being failed

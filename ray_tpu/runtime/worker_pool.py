@@ -99,6 +99,7 @@ class ProcessWorkerPool:
         self._inflight_worker: Dict[bytes, WorkerHandle] = {}
         self._inflight_start: Dict[bytes, float] = {}
         self._direct: Dict[bytes, _DirectSlot] = {}   # sync waiters by task id
+        self._stack_waiters: Dict[str, dict] = {}     # dump_stacks tokens
         self._on_worker_death: Optional[Callable[[WorkerHandle], None]] = None
         self._listen_path = os.path.join(session_dir, f"rt_pool_{os.getpid()}_{id(self):x}.sock")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -496,11 +497,47 @@ class ProcessWorkerPool:
     #: nested-API dispatcher set by the owning Node:
     #: fn(task_bin, blob) -> reply_blob (may block awaiting other tasks)
     api_handler: Optional[Callable[[Optional[bytes], bytes], bytes]] = None
+    #: True when the api handler resolves LOCALLY (head-host pools):
+    #: cheap sync ops then run inline on the reader thread.  Agent pools
+    #: relay to the head — a blocking relay must never hold the reader.
+    serve_inline_sync: bool = False
 
     def _serve_api_request(self, worker: WorkerHandle, payload: dict) -> None:
         """Run one worker API call on its own thread (it may block in a
-        nested get) and push the reply frame back."""
+        nested get) and push the reply frame back.  Fire-and-forget ops
+        (async submits, ref releases) run INLINE on the reader thread:
+        they are cheap and non-blocking, and inline processing preserves
+        per-worker frame order — actor-call ordering and the
+        submit-before-release invariant for worker-minted refs depend on
+        it."""
         handler = self.api_handler
+        from ray_tpu.runtime.worker_api import ASYNC_OPS, INLINE_SYNC_OPS
+
+        op = payload.get("op")
+        if op in ASYNC_OPS:
+            try:
+                if handler is not None:
+                    handler(
+                        payload.get("task_id"), payload["blob"],
+                        op, worker.pid,
+                    )
+            except Exception:  # noqa: BLE001 — notification: nothing to reply to
+                pass
+            return
+        if op in INLINE_SYNC_OPS and handler is not None and self.serve_inline_sync:
+            # cheap non-blocking request: serve on the reader thread — a
+            # thread spawn per call costs more than the handler
+            try:
+                blob = handler(payload.get("task_id"), payload["blob"], op, worker.pid)
+            except BaseException as exc:  # noqa: BLE001
+                import pickle as _p
+
+                blob = _p.dumps(("err", RuntimeError(f"worker api failed: {exc}")))
+            try:
+                worker.send("api_reply", {"rid": payload["rid"], "blob": blob})
+            except OSError:
+                pass
+            return
 
         def run():
             try:
@@ -541,8 +578,51 @@ class ProcessWorkerPool:
                 for result_payload in payload["results"]:
                     self._deliver_result(worker, result_payload)
                 continue
+            if msg_type == "stacks_reply":
+                waiter = self._stack_waiters.pop(payload.get("token"), None)
+                if waiter is not None:
+                    waiter["stacks"] = payload.get("stacks", "")
+                    waiter["event"].set()
+                continue
             if msg_type == "result":
                 self._deliver_result(worker, payload)
+
+    # ------------------------------------------------------------------
+    def dump_worker_stacks(self, timeout: float = 5.0) -> Dict[int, str]:
+        """Live thread stacks from every pool worker (reference: `ray
+        stack`'s py-spy dump of workers, scripts.py:1830).  Served on each
+        worker's reader thread, so a wedged exec thread still answers —
+        which is exactly when this is needed."""
+        import os as _os
+
+        waiters = []
+        with self._lock:
+            workers = [w for w in self._all.values() if w.alive]
+        seen = set()
+        for w in workers:
+            if w.pid in seen:
+                continue
+            seen.add(w.pid)
+            token = _os.urandom(8).hex()
+            waiter = {"event": threading.Event(), "stacks": None, "pid": w.pid, "token": token}
+            self._stack_waiters[token] = waiter
+            try:
+                w.send("dump_stacks", {"token": token})
+                waiters.append(waiter)
+            except OSError:
+                self._stack_waiters.pop(token, None)
+        deadline = time.monotonic() + timeout
+        out: Dict[int, str] = {}
+        for waiter in waiters:
+            waiter["event"].wait(max(0.0, deadline - time.monotonic()))
+            if waiter["stacks"] is not None:
+                out[waiter["pid"]] = waiter["stacks"]
+            else:
+                out[waiter["pid"]] = "<no response within timeout — process wedged or dead>"
+                # reap the token, or every dump against a wedged worker
+                # leaks one waiter entry forever
+                self._stack_waiters.pop(waiter["token"], None)
+        return out
 
     def _deliver_result(self, worker: WorkerHandle, payload: dict) -> None:
         task_id = payload["task_id"]

@@ -262,6 +262,27 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Cluster-wide live stack dump (reference: `ray stack`,
+    scripts.py:1830 — py-spy per worker; here every process answers over
+    its control channel, so a wedged exec thread still reports)."""
+    address = _read_address(args.address)
+    data = _get(address, f"/api/stack?timeout={args.timeout}")
+    print("===== driver =====")
+    print(data.get("driver", ""))
+    for node_hex, entry in sorted(data.get("nodes", {}).items()):
+        if entry.get("error"):
+            print(f"===== node {node_hex[:12]} =====\n{entry['error']}")
+            continue
+        if entry.get("process"):
+            print(f"===== node {node_hex[:12]} agent =====")
+            print(entry["process"])
+        for pid, stacks in sorted(entry.get("workers", {}).items()):
+            print(f"===== node {node_hex[:12]} worker pid {pid} =====")
+            print(stacks)
+    return 0
+
+
 def cmd_memory(args) -> int:
     """``rt memory`` (parity: ray memory): `rt list objects` plus a totals
     footer — delegates to the shared list path."""
@@ -416,6 +437,11 @@ def build_parser() -> argparse.ArgumentParser:
     j = jsub.add_parser("list")
     j.add_argument("--address", default=None)
     j.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("stack", help="live thread stacks from driver, agents, and workers (ray stack parity)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--timeout", type=float, default=5.0)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

@@ -457,10 +457,113 @@ def _external_searcher_stub(name: str, dist: str):
     return _Missing
 
 
+class _OptunaSearch(Searcher):
+    """Ask/tell wrapper over an optuna Study (parity:
+    python/ray/tune/search/optuna/optuna_search.py — the one external
+    searcher users actually reach for).  Domain classes translate onto
+    optuna's suggest surface; quantized domains round the suggestion back
+    onto their grid (optuna has no q-variants)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 sampler=None, seed: Optional[int] = None, study=None,
+                 param_space: Optional[Dict[str, Any]] = None, **kw):
+        super().__init__(metric=metric, mode=mode)
+        import optuna
+
+        self._optuna = optuna
+        self.param_space = space if space is not None else (param_space or {})
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        # Study creation is LAZY (first suggest): the Tuner back-fills
+        # metric/mode onto a custom searcher AFTER construction
+        # (tuner.py), so an eager study would bake the wrong direction.
+        self._study = study
+        self._sampler = sampler
+        self._seed = seed
+        self._live: Dict[str, Any] = {}  # trial_id -> optuna trial
+
+    @property
+    def study(self):
+        if self._study is None:
+            self._study = self._optuna.create_study(
+                direction="maximize" if self.mode == "max" else "minimize",
+                sampler=self._sampler or self._optuna.samplers.TPESampler(seed=self._seed),
+            )
+        return self._study
+
+    def _suggest_param(self, ot, name: str, dom) -> Any:
+        if isinstance(dom, GridSearch):
+            return ot.suggest_categorical(name, list(dom.values))
+        if isinstance(dom, Categorical):
+            return ot.suggest_categorical(name, list(dom.categories))
+        if isinstance(dom, (QLogUniform,)):
+            v = ot.suggest_float(name, dom.lower, dom.upper, log=True)
+            return min(dom.upper, max(dom.lower, round(v / dom.q) * dom.q))
+        if isinstance(dom, LogUniform):
+            return ot.suggest_float(name, dom.lower, dom.upper, log=True)
+        if isinstance(dom, QUniform):
+            return ot.suggest_float(name, dom.lower, dom.upper, step=dom.q)
+        if isinstance(dom, (QNormal, Normal)):
+            # optuna has no unbounded normal: sample ±4sd bounded
+            v = ot.suggest_float(name, dom.mean - 4 * dom.sd, dom.mean + 4 * dom.sd)
+            if isinstance(dom, QNormal):
+                v = round(v / dom.q) * dom.q
+            return v
+        if isinstance(dom, Uniform):
+            return ot.suggest_float(name, dom.lower, dom.upper)
+        if isinstance(dom, (QLogRandInt, LogRandInt)):
+            v = ot.suggest_int(name, dom.lower, max(dom.lower, dom.upper - 1), log=True)
+            if isinstance(dom, QLogRandInt):
+                v = min(dom.upper, max(dom.lower, int(round(v / dom.q) * dom.q)))
+            return v
+        if isinstance(dom, QRandInt):
+            return ot.suggest_int(name, dom.lower, dom.upper, step=dom.q)
+        if isinstance(dom, RandInt):
+            # our randint upper bound is EXCLUSIVE; optuna's is inclusive
+            return ot.suggest_int(name, dom.lower, dom.upper - 1)
+        if isinstance(dom, _SampleFrom):
+            raise ValueError(
+                "tune.sample_from is not translatable to optuna's ask/tell "
+                "surface; use explicit Domain classes with OptunaSearch"
+            )
+        return dom  # constant
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        ot = self.study.ask()
+        self._live[trial_id] = ot
+        cfg = {}
+        for name, dom in self.param_space.items():
+            cfg[name] = self._suggest_param(ot, name, dom)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
+        ot = self._live.pop(trial_id, None)
+        if ot is None:
+            return
+        state = self._optuna.trial.TrialState.COMPLETE
+        value = None
+        if error or not result or self.metric not in result:
+            state = self._optuna.trial.TrialState.FAIL
+        else:
+            value = result[self.metric]
+        self.study.tell(ot, value, state=state)
+
+
+def _make_optuna_search():
+    try:
+        import optuna  # noqa: F401
+
+        return _OptunaSearch
+    except ImportError:
+        return _external_searcher_stub("OptunaSearch", "optuna")
+
+
 # Parity markers for the reference's external-library searchers (gated:
 # the libraries are not vendored; the native TPESearcher covers the
-# model-based-search role).
-OptunaSearch = _external_searcher_stub("OptunaSearch", "optuna")
+# model-based-search role).  OptunaSearch is REAL when optuna is
+# importable — ask/tell translation above — and an actionable stub when
+# not.
+OptunaSearch = _make_optuna_search()
 HyperOptSearch = _external_searcher_stub("HyperOptSearch", "hyperopt")
 AxSearch = _external_searcher_stub("AxSearch", "ax-platform")
 BayesOptSearch = _external_searcher_stub("BayesOptSearch", "bayesian-optimization")

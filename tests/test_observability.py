@@ -201,3 +201,53 @@ def test_util_metrics_user_api():
     assert "app_requests_total" in out
     assert "app_queue_depth 7" in out
     assert "app_latency_s" in out
+
+
+# --------------------------------------------------------------------------
+# rt stack: cluster-wide live stack dump (reference: `ray stack`,
+# scripts.py:1830)
+# --------------------------------------------------------------------------
+def test_cluster_stack_dump_two_process():
+    import time as _time
+
+    import ray_tpu as rt
+    from test_multihost import _spawn_agent, _wait_for_nodes
+
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        address = cluster.start_head_service()
+        proc = _spawn_agent(address)
+        try:
+            _wait_for_nodes(cluster, 2)
+
+            # something long-running in a remote pool worker so its stack
+            # shows a real user frame
+            @rt.remote(resources={"remote": 1}, execution="process")
+            def parked():
+                _time.sleep(8)
+                return 1
+
+            ref = parked.remote()
+            deadline = _time.monotonic() + 30
+            # wait until the worker is actually executing
+            while _time.monotonic() < deadline:
+                dump = cluster.dump_cluster_stacks(timeout=5.0)
+                agents = [e for e in dump["nodes"].values() if "process" in e]
+                if agents and any("parked" in s for e in agents for s in e.get("workers", {}).values()):
+                    break
+                _time.sleep(0.5)
+            else:
+                raise AssertionError(f"worker stack never showed the parked task: {dump}")
+
+            # driver stacks present and name this very test
+            assert "test_cluster_stack_dump_two_process" in dump["driver"]
+            # the agent's own process stacks came across the wire
+            assert any("Thread" in e.get("process", "") for e in agents)
+            assert rt.get(ref, timeout=60) == 1
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+    finally:
+        rt.shutdown()
