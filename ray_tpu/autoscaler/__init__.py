@@ -17,6 +17,7 @@ promoted to the primary test path).
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
 from ray_tpu.autoscaler.demand import NodeTypeConfig, get_nodes_to_launch
 from ray_tpu.autoscaler.monitor import Monitor
+from ray_tpu.autoscaler.kuberay import KubernetesNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     InProcessNodeProvider,
     NodeProvider,
@@ -32,6 +33,7 @@ __all__ = [
     "Monitor",
     "NodeProvider",
     "InProcessNodeProvider",
+    "KubernetesNodeProvider",
     "TPUSliceProvider",
     "TPU_SLICE_TOPOLOGIES",
 ]

@@ -19,7 +19,10 @@ import os
 import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_DIR, "_hotpath.so")
+# RAY_TPU_HOTPATH_LIB selects an alternate build of the extension — the
+# sanitizer leg loads _hotpath_asan.so (built by `make _hotpath_asan.so`)
+# with the asan runtime LD_PRELOADed.
+_LIB_PATH = os.path.join(_DIR, os.environ.get("RAY_TPU_HOTPATH_LIB", "_hotpath.so"))
 
 
 _SRC_PATH = os.path.join(_DIR, "src", "hotpath.c")
@@ -46,7 +49,8 @@ def _build() -> None:
             if _stale():  # re-check under the lock: another process built it
                 # PYTHON= pins the headers to THIS interpreter's ABI
                 subprocess.run(
-                    ["make", "-s", "-C", _DIR, f"PYTHON={sys.executable}", "_hotpath.so"],
+                    ["make", "-s", "-C", _DIR, f"PYTHON={sys.executable}",
+                     os.path.basename(_LIB_PATH)],
                     check=True,
                     capture_output=True,
                 )

@@ -39,6 +39,7 @@ def cluster_yaml(tmp_path):
     return str(path)
 
 
+@pytest.mark.full
 def test_up_provisions_min_workers_and_down_terminates(cluster_yaml):
     launcher = up(cluster_yaml, timeout_s=120)
     try:

@@ -21,6 +21,7 @@ def runtime():
         rt.shutdown()
 
 
+@pytest.mark.full
 def test_tfrecords_roundtrip(runtime, tmp_path):
     tf = pytest.importorskip("tensorflow")  # noqa: F841
     out = str(tmp_path / "tfr")

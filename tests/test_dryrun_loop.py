@@ -9,6 +9,9 @@ GPipe, tp serving, and the cross-process collective + device-envelope leg —
 five times back to back: the flake rate the gate can tolerate is zero.
 """
 
+import pytest
+
+pytestmark = pytest.mark.full  # soak: the full dryrun 5x back-to-back
 def test_dryrun_multichip_5x_loop():
     import __graft_entry__ as graft
 

@@ -107,6 +107,7 @@ def test_external_deletion_reflected_in_non_terminated():
 
 
 # ------------------------------------------------------- integration
+@pytest.mark.full
 def test_rt_up_gcp_tpu_fake_full_lifecycle(tmp_path):
     """`rt up` with provider: gcp-tpu drives the fake through
     create→join→drain→delete; slice hosts are real agent processes carrying

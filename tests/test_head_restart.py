@@ -10,6 +10,7 @@ Reference parity anchors: GCS restart against Redis
 (core_worker.proto:443 RayletNotifyGCSRestart).
 """
 
+import pytest
 import os
 import signal
 import socket
@@ -231,6 +232,7 @@ time.sleep(600)
 """
 
 
+@pytest.mark.full
 def test_head_restart_under_load_5x():
     """Round-4 VERDICT item 7: kill -9 the head while 2 agents run a
     50-task in-flight stream and hold an open collective group; the

@@ -1,0 +1,152 @@
+"""Kubernetes node provider (KubeRay role) against a fake pod API
+(reference: python/ray/autoscaler/_private/kuberay/node_provider.py).
+Exercises the full create -> list -> head-restart adoption -> terminate
+lifecycle, plus manifest shape for CPU and GKE TPU node types.
+"""
+
+import json
+
+from ray_tpu.autoscaler import KubernetesNodeProvider, NodeTypeConfig
+from ray_tpu.autoscaler.kuberay import CLUSTER_LABEL, TYPE_LABEL, KubernetesAPI
+
+
+class FakePodAPI(KubernetesAPI):
+    def __init__(self):
+        self.pods = {}
+        self.deleted = []
+
+    def create_pod(self, manifest):
+        name = manifest["metadata"]["name"]
+        assert name not in self.pods, "name collision"
+        self.pods[name] = {
+            "name": name,
+            "phase": "Running",
+            "labels": manifest["metadata"]["labels"],
+            "manifest": manifest,
+        }
+
+    def delete_pod(self, name):
+        self.deleted.append(name)
+        self.pods.pop(name, None)
+
+    def list_pods(self, label_selector):
+        key, _, val = label_selector.partition("=")
+        return [
+            {"name": p["name"], "phase": p["phase"], "labels": p["labels"]}
+            for p in self.pods.values()
+            if p["labels"].get(key) == val
+        ]
+
+
+CPU_TYPE = NodeTypeConfig(name="cpu-worker", resources={"CPU": 4})
+TPU_TYPE = NodeTypeConfig(name="v5e-8", resources={"CPU": 8, "TPU": 8})
+
+
+def test_create_list_terminate_lifecycle():
+    api = FakePodAPI()
+    p = KubernetesNodeProvider("head:6380", api=api, cluster_name="rtk")
+    created = p.create_nodes(CPU_TYPE, 2)
+    assert len(created) == 2 and all(n.startswith("rtk-cpu-worker-") for n in created)
+    assert p.non_terminated_nodes() == {created[0]: "cpu-worker", created[1]: "cpu-worker"}
+
+    p.terminate_node(created[0])
+    assert api.deleted == [created[0]]
+    assert p.non_terminated_nodes() == {created[1]: "cpu-worker"}
+
+
+def test_manifest_runs_agent_with_resources_and_labels():
+    api = FakePodAPI()
+    p = KubernetesNodeProvider("10.0.0.1:6380", api=api, cluster_name="rtk",
+                               image="my/img:1", service_account="rt-sa")
+    (name,) = p.create_nodes(CPU_TYPE, 1)
+    m = api.pods[name]["manifest"]
+    assert m["metadata"]["labels"][CLUSTER_LABEL] == "rtk"
+    assert m["metadata"]["labels"][TYPE_LABEL] == "cpu-worker"
+    spec = m["spec"]
+    assert spec["serviceAccountName"] == "rt-sa"
+    assert spec["restartPolicy"] == "Never"
+    (ctr,) = spec["containers"]
+    assert ctr["image"] == "my/img:1"
+    cmd = ctr["command"][-1]
+    assert "ray_tpu.runtime.agent" in cmd and "10.0.0.1:6380" in cmd
+    assert json.loads(cmd.split("--resources ")[1].split(" --labels")[0].strip("'")) == {"CPU": 4}
+    assert ctr["resources"]["limits"]["cpu"] == "4"
+
+
+def test_gke_tpu_node_type_requests_tpu_resource():
+    api = FakePodAPI()
+    p = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    (name,) = p.create_nodes(TPU_TYPE, 1)
+    m = api.pods[name]["manifest"]
+    limits = m["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "8"
+    cmd = m["spec"]["containers"][0]["command"][-1]
+    labels = json.loads(cmd.split("--labels ")[1].strip("'"))
+    assert labels["ray_tpu.io/pod-type"] == "v5e-8"
+
+
+def test_head_restart_adopts_pods_and_advances_sequence():
+    api = FakePodAPI()
+    p1 = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    created = p1.create_nodes(CPU_TYPE, 3)
+
+    # fresh provider (restarted head) sees the fleet and never collides
+    p2 = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    assert set(p2.non_terminated_nodes()) == set(created)
+    more = p2.create_nodes(CPU_TYPE, 1)
+    assert more[0] not in created
+    assert int(more[0].rsplit("-", 1)[1]) > max(int(c.rsplit("-", 1)[1]) for c in created)
+
+
+def test_finished_pods_drop_out():
+    api = FakePodAPI()
+    p = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    created = p.create_nodes(CPU_TYPE, 2)
+    api.pods[created[0]]["phase"] = "Failed"
+    live = p.non_terminated_nodes()
+    assert created[0] not in live and created[1] in live
+
+
+def test_other_clusters_pods_invisible():
+    api = FakePodAPI()
+    a = KubernetesNodeProvider("h:1", api=api, cluster_name="aaa")
+    b = KubernetesNodeProvider("h:1", api=api, cluster_name="bbb")
+    a.create_nodes(CPU_TYPE, 1)
+    assert b.non_terminated_nodes() == {}
+
+
+def test_provider_id_label_and_fractional_cpu():
+    api = FakePodAPI()
+    p = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    (name,) = p.create_nodes(NodeTypeConfig(name="frac", resources={"CPU": 0.5}), 1)
+    m = api.pods[name]["manifest"]
+    # busy/idle mapping key reaches the agent labels
+    cmd = m["spec"]["containers"][0]["command"][-1]
+    labels = json.loads(cmd.split("--labels ")[1].strip("'"))
+    assert labels["rt_provider_id"] == name
+    # fractional CPUs become millicores, never a zero quota
+    assert m["spec"]["containers"][0]["resources"]["limits"]["cpu"] == "500m"
+
+
+def test_reconcile_retries_after_api_outage():
+    class FlakyAPI(FakePodAPI):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = True
+
+        def list_pods(self, sel):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("API down")
+            return super().list_pods(sel)
+
+    api = FlakyAPI()
+    seed = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    api.fail_next = False
+    existing = seed.create_nodes(CPU_TYPE, 2)
+
+    api.fail_next = True
+    p = KubernetesNodeProvider("h:1", api=api, cluster_name="rtk")
+    assert p.non_terminated_nodes()  # first call failed reconcile, retried
+    created = p.create_nodes(CPU_TYPE, 1)
+    assert created[0] not in existing  # sequence advanced past survivors

@@ -122,6 +122,7 @@ def test_mlp():
     assert out.shape == (3, 4)
 
 
+@pytest.mark.full
 def test_graft_entry_hooks():
     import __graft_entry__ as g
 
@@ -131,6 +132,7 @@ def test_graft_entry_hooks():
     g.dryrun_multichip(8)
 
 
+@pytest.mark.full
 def test_ring_attention_mode_matches_dense():
     """attention="ring" (sp-sharded ring attention in the model) must agree
     with the dense einsum path on loss and gradients."""
@@ -314,6 +316,7 @@ def test_moe_capacity_sharded_train_step():
         assert np.isfinite(float(loss))
 
 
+@pytest.mark.full
 def test_unrolled_and_dots_remat_match_scan():
     """The headline TPU bench runs remat="dots" + scan_layers=False; this
     CPU parity check pins that exact configuration to the default scan

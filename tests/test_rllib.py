@@ -116,6 +116,7 @@ def test_dqn_runs_and_improves():
     algo.stop()
 
 
+@pytest.mark.full
 def test_sac_runs_on_pendulum():
     config = (
         SACConfig()
@@ -181,6 +182,7 @@ def test_algorithm_as_tune_trainable(ray_start_regular):
     assert len(results) == 2
 
 
+@pytest.mark.full
 def test_remote_env_runners(ray_start_regular):
     config = (
         PPOConfig()
@@ -447,6 +449,7 @@ def _tiny_dreamer():
     return cfg
 
 
+@pytest.mark.full
 def test_dreamerv3_world_model_learns():
     """The world-model loss on a FIXED probe batch must drop with training
     (same data before and after isolates learning from replay drift)."""
@@ -464,6 +467,7 @@ def test_dreamerv3_world_model_learns():
     algo.stop()
 
 
+@pytest.mark.full
 def test_dreamerv3_checkpoint_roundtrip(tmp_path):
     algo = _tiny_dreamer().build()
     algo.train()

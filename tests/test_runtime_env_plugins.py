@@ -69,6 +69,7 @@ def test_unknown_runtime_env_key_rejected():
 
 
 # ----------------------------------------------------------- proxy mode
+@pytest.mark.full
 def test_client_proxy_isolates_tenants():
     """Two clients through one proxy endpoint get separate driver runtimes."""
     from ray_tpu.util.client.proxier import ProxyServer

@@ -45,6 +45,7 @@ def test_single_request_matches_generate(engine, params):
     assert got == _reference(params, prompt, 6)
 
 
+@pytest.mark.full
 def test_concurrent_ragged_requests_match(engine, params):
     prompts = [[5, 6], [7, 8, 9, 10, 11], [1] * 17, [42]]
     futs = [engine.submit(p, max_tokens=5) for p in prompts]
@@ -53,6 +54,7 @@ def test_concurrent_ragged_requests_match(engine, params):
         assert o == _reference(params, p, 5)
 
 
+@pytest.mark.full
 def test_continuous_admission_mid_flight(engine, params):
     """A request submitted while another decodes must join its batch and
     still produce exactly the solo-run tokens."""
@@ -63,6 +65,7 @@ def test_continuous_admission_mid_flight(engine, params):
     assert first.result(timeout=120) == _reference(params, [2, 3, 4], 24)
 
 
+@pytest.mark.full
 def test_slot_reuse_more_requests_than_slots(engine, params):
     prompts = [[i + 1, i + 2] for i in range(9)]  # 9 requests, 4 slots
     futs = [engine.submit(p, max_tokens=3) for p in prompts]
@@ -131,6 +134,7 @@ def test_zero_max_tokens_rejected(engine):
         engine.submit([1, 2], max_tokens=0)
 
 
+@pytest.mark.full
 def test_quantized_engine_generates(params):
     """Weight-only int8 engine: layer linears stored int8 (norm gains stay
     fp), greedy output EXACTLY matches generate() on the dequantized
@@ -194,6 +198,7 @@ def test_train_then_serve_e2e():
         eng.shutdown()
 
 
+@pytest.mark.full
 def test_submit_stream_tokens_arrive_incrementally(engine, params):
     """Streaming yields the same tokens as the blocking API, and the first
     token arrives before the request completes."""
@@ -211,6 +216,7 @@ def test_stream_interleaves_with_blocking(engine, params):
     assert blocking.result(timeout=120) == _reference(params, [4, 5], 4)
 
 
+@pytest.mark.full
 def test_http_sse_streaming(params):
     import urllib.request
 
@@ -346,6 +352,7 @@ def test_mesh_moe_engine(params):
         eng.shutdown()
 
 
+@pytest.mark.full
 def test_text_requests_with_tokenizer(params):
     """model_factory may return (cfg, params, tokenizer): requests send
     'text', responses carry decoded text."""
@@ -420,6 +427,7 @@ def test_llm_server_mesh_passthrough(params):
         ray_tpu.shutdown()
 
 
+@pytest.mark.full
 def test_data_batch_inference(params):
     """Dataset map_batches with LLMPredictor: offline batch generation
     rides the continuous-batching engine; outputs match solo runs."""

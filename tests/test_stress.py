@@ -20,6 +20,8 @@ import pytest
 
 import ray_tpu as rt
 
+pytestmark = pytest.mark.full  # stress + sanitizer legs; always run before capturing artifacts
+
 NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ray_tpu", "native"
 )
@@ -92,3 +94,54 @@ def test_fabric_stress():
         assert not errors, errors[:5]
     finally:
         rt.shutdown()
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++ toolchain")
+def test_tsan_native_stress():
+    """ThreadSanitizer leg over the shm store + io pool stress harness
+    (reference role: .bazelrc:104-127 --config=tsan) — the r04 shm
+    open-race (robust-mutex trample under concurrency) is exactly the bug
+    class this catches."""
+    res = subprocess.run(
+        ["make", "tsan"], cwd=NATIVE_DIR, capture_output=True, text=True, timeout=600
+    )
+    assert res.returncode == 0, f"TSAN stress failed:\n{res.stdout}\n{res.stderr}"
+    assert "stress: OK" in res.stdout
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no toolchain")
+def test_asan_hotpath_extension():
+    """The C id types + FrameDecoder run their FULL parity suites under
+    AddressSanitizer: an ASAN-instrumented build of the extension is
+    selected via RAY_TPU_HOTPATH_LIB and loaded into a pytest subprocess
+    with the asan runtime LD_PRELOADed."""
+    import glob
+    import sys
+
+    build = subprocess.run(
+        ["make", "-s", f"PYTHON={sys.executable}", "_hotpath_asan.so"],
+        cwd=NATIVE_DIR, capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr
+    libasan = sorted(glob.glob("/usr/lib/gcc/*/*/libasan.so")) or sorted(
+        glob.glob("/usr/lib/*/libasan.so*")
+    )
+    if not libasan:
+        pytest.skip("no libasan runtime found")
+    env = dict(os.environ)
+    env.update(
+        LD_PRELOAD=libasan[-1],
+        RAY_TPU_HOTPATH_LIB="_hotpath_asan.so",
+        # CPython leaks by design at interpreter exit; we want memory
+        # ERRORS (overflow/UAF in the extension), not leak reports
+        ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+        JAX_PLATFORMS="cpu",
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         "tests/test_native_ids.py", "tests/test_native_frames.py"],
+        cwd=os.path.dirname(os.path.dirname(NATIVE_DIR)),
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert res.returncode == 0, f"ASAN hotpath run failed:\n{res.stdout[-3000:]}\n{res.stderr[-3000:]}"
+    assert "passed" in res.stdout
