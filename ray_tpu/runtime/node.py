@@ -316,6 +316,11 @@ class Node:
     def _execution_mode(self, spec: TaskSpec) -> str:
         if spec.execution != "auto":
             return spec.execution
+        if spec.runtime_env:
+            # body-scoped runtime_env (env_vars/profiling) is applied by
+            # PROCESS workers; auto-tier migration in-process would
+            # silently drop it mid-stream
+            return "process"
         func = spec.func
         if getattr(func, "_rt_device", False) or _is_jitted(func):
             return "thread"
@@ -462,7 +467,8 @@ class Node:
 
         self._proc_specs[spec.task_id.binary()] = spec
         self.worker_pool.submit(
-            spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result
+            spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result,
+            runtime_env=spec.runtime_env,
         )
 
     def _handle_worker_api(self, task_bin, blob: bytes, op: str = "", worker_key=None) -> bytes:

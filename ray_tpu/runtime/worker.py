@@ -94,6 +94,12 @@ class CoreWorker:
         _task_id: Optional[bytes] = None,
     ) -> List[ObjectRef]:
         cfg = get_config()
+        if runtime_env:
+            # fail malformed envs HERE with the plugin's own error, not as
+            # an opaque RayTaskError from inside a worker
+            from ray_tpu.runtime_env.plugin import validate_runtime_env
+
+            validate_runtime_env(runtime_env)
         # _task_id: a worker minted the id locally (fire-and-forget nested
         # submission) — use it so its locally-built refs resolve here
         task_id = TaskID(_task_id) if _task_id is not None else TaskID.for_normal_task(self.job_id)

@@ -50,6 +50,50 @@ def main() -> None:
     Worker(sock, shm_store).run()
 
 
+class _TaskEnv:
+    """Apply a per-TASK runtime_env (env_vars + profiling — the
+    body-scoped plugins) around one execution and restore after.  The
+    exec loop is single-threaded, so mutate-and-restore is race-free."""
+
+    def __init__(self, runtime_env):
+        self._env = runtime_env or {}
+        self._saved: dict = {}
+
+    def __enter__(self):
+        changes = dict(self._env.get("env_vars") or {})
+        prof = self._env.get("profiling")
+        if prof:
+            import tempfile
+
+            out_dir = prof.get("dir") if isinstance(prof, dict) else None
+            out_dir = out_dir or os.path.join(tempfile.gettempdir(), "rt_task_profiles")
+            os.makedirs(out_dir, exist_ok=True)
+            changes["RAY_TPU_TASK_PROFILING"] = out_dir
+        for k, v in changes.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
+def _maybe_profile(name, task_id_bin, fn, args, kwargs, runtime_env=None):
+    """cProfile wrapper for ProfilingPlugin; one getenv when off."""
+    with _TaskEnv(runtime_env):
+        if not os.environ.get("RAY_TPU_TASK_PROFILING"):
+            return fn(*args, **kwargs)
+        from ray_tpu.runtime_env.plugin import maybe_profile
+
+        hexid = task_id_bin.hex() if isinstance(task_id_bin, bytes) else str(task_id_bin)
+        return maybe_profile(name, hexid, fn, args, kwargs)
+
+
 def _format_stacks() -> str:
     from ray_tpu.runtime.stack import format_thread_stacks
 
@@ -317,7 +361,10 @@ class Worker:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
             t0 = time.perf_counter()
-            result = fn(*args, **kwargs)
+            result = _maybe_profile(
+                payload.get("name", "task"), task_id, fn, args, kwargs,
+                runtime_env=payload.get("runtime_env"),
+            )
             exec_s = time.perf_counter() - t0
             self._reply(
                 "result",
@@ -418,7 +465,7 @@ class Worker:
             self._current.task = task_id
             ctx, token = self._push_task_context(task_id)
             try:
-                result = method(*args, **kwargs)
+                result = _maybe_profile(method_name, task_id, method, args, kwargs)
             finally:
                 self._current.task = None
                 if token is not None:

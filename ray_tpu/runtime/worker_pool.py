@@ -257,7 +257,7 @@ class ProcessWorkerPool:
                     while self._backlog:
                         failed.append(self._backlog.popleft())
             for item in failed:
-                callback = item[-1]
+                callback = item[5]  # (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
                 try:
                     callback(None, WorkerCrashedError(f"worker spawn failed: {exc}"), None)
                 except BaseException:
@@ -320,20 +320,31 @@ class ProcessWorkerPool:
         fn_blob: bytes,
         args_blob: bytes,
         callback: Callable[[Any, Optional[BaseException]], None],
+        runtime_env: Optional[dict] = None,
     ) -> bool:
         """Run a stateless task on an idle worker; queues when saturated.
         Never blocks: pool growth happens on a spawner thread."""
         worker = self._acquire_idle()
         if worker is None:
             with self._lock:
-                self._backlog.append((task_id, name, fn_id, fn_blob, args_blob, callback))
+                self._backlog.append(
+                    (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
+                )
             self._maybe_grow_async()
             return True
-        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback)
+        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
         return True
 
-    def _send_exec(self, worker, task_id, name, fn_id, fn_blob, args_blob, callback) -> None:
+    def _send_exec(self, worker, task_id, name, fn_id, fn_blob, args_blob, callback,
+                   runtime_env: Optional[dict] = None) -> None:
         payload = {"task_id": task_id, "name": name, "fn_id": fn_id, "args_blob": args_blob}
+        if runtime_env:
+            # per-TASK runtime env: only the body-scoped keys travel —
+            # process-level plugins (pip, conda, container, working_dir)
+            # need a job/worker scope and stay job-level
+            body_env = {k: runtime_env[k] for k in ("env_vars", "profiling") if k in runtime_env}
+            if body_env:
+                payload["runtime_env"] = body_env
         if fn_id not in worker.known_fns:
             payload["fn_blob"] = fn_blob
             worker.known_fns.add(fn_id)

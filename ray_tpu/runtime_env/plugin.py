@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 import os
 import shutil
+import tempfile
 from typing import Dict, Optional, Tuple
 
 from ray_tpu.runtime_env.uri_cache import URICache
@@ -347,6 +348,66 @@ class MPIPlugin(RuntimeEnvPlugin):
         return f"mpirun {args} /bin/sh -c {shlex.quote(entrypoint)}"
 
 
+class ProfilingPlugin(RuntimeEnvPlugin):
+    """Per-task cProfile capture (reference role: the profiling runtime-env
+    plugins — ``_private/runtime_env/nsight.py`` shape, py-spy dashboard
+    integration — rebuilt CPU-native: TPU work is profiled by
+    ``jax.profiler``, what needs a runtime-env switch is the PYTHON side of
+    a task).  Value shape::
+
+        {"profiling": True}                      # profiles to the session dir
+        {"profiling": {"dir": "/tmp/profs"}}     # explicit output dir
+
+    Workers honor ``RAY_TPU_TASK_PROFILING``: every task/actor-call body
+    runs under cProfile and dumps ``<name>_<task_id>.prof`` (pstats
+    loadable) into the directory.  Zero overhead when unset."""
+
+    name = "profiling"
+    priority = 5
+
+    def validate(self, value) -> None:
+        if not (value is True or isinstance(value, dict)):
+            raise ValueError("runtime_env['profiling'] must be True or {'dir': path}")
+        if isinstance(value, dict) and set(value) - {"dir"}:
+            raise ValueError(f"unknown profiling keys {set(value) - {'dir'}}")
+
+    def modify_context(self, value, env, cwd, uris=None):
+        out_dir = value.get("dir") if isinstance(value, dict) else None
+        if not out_dir:
+            out_dir = os.path.join(tempfile.gettempdir(), "rt_task_profiles")
+        os.makedirs(out_dir, exist_ok=True)
+        env["RAY_TPU_TASK_PROFILING"] = out_dir
+        return env, cwd
+
+
+def maybe_profile(name: str, task_id_hex: str, fn, args, kwargs):
+    """Worker-side hook for ProfilingPlugin: run a task body under cProfile
+    when RAY_TPU_TASK_PROFILING is set, dumping a pstats-loadable file per
+    task.  One getenv when profiling is off."""
+    out_dir = os.environ.get("RAY_TPU_TASK_PROFILING")
+    if not out_dir:
+        return fn(*args, **kwargs)
+    import cProfile
+    import re
+
+    prof = cProfile.Profile()
+    try:
+        return prof.runcall(fn, *args, **kwargs)
+    finally:
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", name or "task")[:60]
+        try:
+            os.makedirs(out_dir, exist_ok=True)  # this process may not be the creator
+            prof.dump_stats(os.path.join(out_dir, f"{safe}_{task_id_hex[:12]}.prof"))
+        except OSError as exc:
+            # profiling must never fail the task — but silence here means
+            # "profiling on, zero profiles, no clue"; say why once
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "profiling dump to %s failed: %s", out_dir, exc
+            )
+
+
 def wrap_entrypoint(
     runtime_env: dict, entrypoint: str, env: Dict[str, str], cwd: Optional[str]
 ) -> str:
@@ -374,7 +435,7 @@ def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
 
 for _p in (
     EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(), PipPlugin(),
-    CondaPlugin(), ContainerPlugin(), MPIPlugin(),
+    CondaPlugin(), ContainerPlugin(), MPIPlugin(), ProfilingPlugin(),
 ):
     register_plugin(_p)
 

@@ -96,3 +96,86 @@ def test_client_proxy_isolates_tenants():
             ctx2.disconnect()
     finally:
         proxy.stop()
+
+
+def test_profiling_plugin_dumps_pstats(tmp_path):
+    """runtime_env={'profiling': {'dir': ...}}: every task body runs under
+    cProfile and leaves a pstats-loadable dump named after the task."""
+    import pstats
+
+    import ray_tpu as rt
+
+    rt.init(num_cpus=2)
+    try:
+        out = str(tmp_path / "profs")
+
+        @rt.remote(execution="process", runtime_env={"profiling": {"dir": out}})
+        def crunch(n):
+            total = 0
+            for i in range(n):
+                total += i * i
+            return total
+
+        assert rt.get(crunch.remote(50_000), timeout=120) == sum(i * i for i in range(50_000))
+        profs = list((tmp_path / "profs").glob("crunch_*.prof"))
+        assert profs, list((tmp_path / "profs").iterdir())
+        stats = pstats.Stats(str(profs[0]))
+        assert stats.total_calls > 0
+    finally:
+        rt.shutdown()
+
+
+def test_profiling_plugin_validation():
+    from ray_tpu.runtime_env.plugin import validate_runtime_env
+
+    validate_runtime_env({"profiling": True})
+    validate_runtime_env({"profiling": {"dir": "/tmp/x"}})
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        validate_runtime_env({"profiling": {"nope": 1}})
+    with _pytest.raises(ValueError):
+        validate_runtime_env({"profiling": "yes"})
+
+
+def test_task_level_env_vars_apply_and_restore():
+    """Per-task env_vars reach the worker's task body and do not leak into
+    the next task on the SAME worker (the restore path, pinned by pid)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=2)
+    try:
+        import os as _os
+        import time as _time
+
+        @rt.remote(execution="process", runtime_env={"env_vars": {"MY_TASK_FLAG": "on"}})
+        def with_env():
+            return _os.getpid(), _os.environ.get("MY_TASK_FLAG")
+
+        @rt.remote(execution="process")
+        def without_env():
+            return _os.getpid(), _os.environ.get("MY_TASK_FLAG")
+
+        pid, flag = rt.get(with_env.remote(), timeout=120)
+        assert flag == "on"
+        # keep calling until the plain task lands on the SAME worker — only
+        # then does "unset" prove the restore, not just a fresh process
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            pid2, flag2 = rt.get(without_env.remote(), timeout=120)
+            if pid2 == pid:
+                assert flag2 is None, "env var leaked into the next task on the same worker"
+                break
+        else:
+            raise AssertionError("plain task never reused the env task's worker")
+        # malformed env fails at the DRIVER with the plugin's error
+        import pytest as _pytest
+
+        @rt.remote(execution="process", runtime_env={"env_vars": {"N": 1}})
+        def bad():
+            return None
+
+        with _pytest.raises((TypeError, ValueError)):
+            bad.remote()
+    finally:
+        rt.shutdown()
