@@ -18,18 +18,72 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 
+def _is_system_failure(exc: BaseException) -> bool:
+    """System-level failures the router may fail over; application
+    exceptions propagate untouched (parity: the reference router only
+    retries system errors)."""
+    from ray_tpu.exceptions import (
+        ObjectLostError,
+        RayActorError,
+        WorkerCrashedError,
+    )
+
+    return isinstance(exc, (RayActorError, WorkerCrashedError, ObjectLostError))
+
+
 class DeploymentResponse:
     """Future-like result. The replica's in-flight count is settled by a
     completion callback the Router attached to the underlying ref, so a
     `result(timeout=...)` that times out (request still occupying the
-    replica) or an abandoned response cannot skew pow-2 balancing."""
+    replica) or an abandoned response cannot skew pow-2 balancing.
 
-    def __init__(self, ref):
+    Replica-death failover: a request that raced a dying replica (the
+    window between the kill and the controller's health-check replacement)
+    reports the dead replica to the router (local prune — the controller's
+    snapshot may still list it for ~a health-check period), waits for
+    usable membership within the caller's deadline, and re-routes.  The
+    retry replays the ORIGINAL request (nested DeploymentResponses
+    included, so a lost upstream result can itself fail over)."""
+
+    def __init__(self, ref, router=None, request=None, replica=None):
         self._ref = ref
+        self._router = router
+        self._request = request  # (method, args, kwargs) PRE-resolution
+        self._replica = replica  # the actor handle this attempt targets
 
     def result(self, timeout: Optional[float] = None, *, timeout_s: Optional[float] = None) -> Any:
         # timeout_s: the reference's spelling (serve.handle.DeploymentResponse)
-        return ray_tpu.get(self._ref, timeout=timeout_s if timeout_s is not None else timeout)
+        import time as _time
+
+        budget = timeout_s if timeout_s is not None else timeout
+        deadline = None if budget is None else _time.monotonic() + budget
+        while True:
+            try:
+                remaining = None if deadline is None else max(0.01, deadline - _time.monotonic())
+                value = ray_tpu.get(self._ref, timeout=remaining)
+                # retries are pointless after success: drop the replay
+                # payload so the response doesn't pin args/router forever
+                self._router = self._request = self._replica = None
+                return value
+            except Exception as exc:  # noqa: BLE001 — filtered below
+                if (
+                    self._router is None
+                    or self._request is None
+                    or not _is_system_failure(exc)
+                    or (deadline is not None and _time.monotonic() >= deadline)
+                ):
+                    raise
+                if self._replica is not None:
+                    self._router.report_dead(self._replica)
+                    self._replica = None
+                method, args, kwargs = self._request
+                retry = self._router.route_within(
+                    method, args, kwargs,
+                    deadline=deadline if deadline is not None else _time.monotonic() + 30.0,
+                )
+                if retry is None:
+                    raise  # no usable membership before the deadline
+                self._ref, self._replica = retry._ref, retry._replica
 
     def _to_object_ref(self):
         return self._ref
@@ -91,11 +145,35 @@ class Router:
             self._watching = False
 
     # ------------------------------------------------------------ routing
+    def report_dead(self, replica) -> None:
+        """A caller observed this replica fail: prune it locally NOW — the
+        controller's snapshot keeps listing it for up to a health-check
+        period, and re-routing onto it just burns the retry."""
+        with self._lock:
+            if replica in self._replicas:
+                self._replicas = [r for r in self._replicas if r is not replica]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def route_within(self, method: str, args: tuple, kwargs: dict, *, deadline: float):
+        """route(), but wait for usable membership (a live replica) up to
+        ``deadline`` instead of failing fast; None if none appeared."""
+        import time as _time
+
+        while True:
+            try:
+                return self.route(method, args, kwargs)
+            except RuntimeError:
+                if _time.monotonic() >= deadline:
+                    return None
+                _time.sleep(0.1)
+                self._refresh(force=True)
+
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
         if not self._replicas:
             self._refresh()
         if not self._replicas:
             raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
+        original_request = (method, args, kwargs)  # PRE-resolution, for replay
         with self._lock:
             n = len(self._replicas)
             if n == 1:
@@ -125,7 +203,7 @@ class Router:
         )
         if push:
             self._push_metrics()
-        return DeploymentResponse(ref)
+        return DeploymentResponse(ref, router=self, request=original_request, replica=replica)
 
     def _push_metrics(self) -> None:
         try:

@@ -363,3 +363,44 @@ def test_dead_replica_replaced_by_health_check():
     assert healed, "controller never replaced the killed replica"
     for i in range(6):
         assert handle.remote(i).result() == i + 1
+
+
+def test_requests_failover_while_replica_dies():
+    """The full-suite flake made real: requests racing a replica's death
+    window (killed, not yet replaced) must fail over through the router —
+    the dead replica is pruned locally and the retry waits for usable
+    membership — never surfacing ActorDiedError to the caller."""
+
+    @serve.deployment(num_replicas=2)
+    class Svc:
+        def __call__(self, x):
+            return x * 10
+
+    handle = serve.run(Svc.bind(), route_prefix=None)
+    assert handle.remote(1).result() == 10
+    from ray_tpu.serve import api as serve_api
+
+    _v, replicas = ray_tpu.get(serve_api._controller.get_replicas.remote("Svc"))
+    # kill and IMMEDIATELY hammer — no wait for the health check
+    ray_tpu.kill(replicas[0])
+    results = [handle.remote(i).result(timeout=60) for i in range(12)]
+    assert results == [i * 10 for i in range(12)]
+
+
+def test_single_replica_failover_waits_for_replacement():
+    """num_replicas=1 is the deterministic worst case: every request picks
+    the (only) dead replica, so failover must WAIT for the controller's
+    replacement, not burn retries against the stale snapshot."""
+
+    @serve.deployment(num_replicas=1)
+    class Solo:
+        def __call__(self, x):
+            return x + 100
+
+    handle = serve.run(Solo.bind(), route_prefix=None)
+    assert handle.remote(1).result() == 101
+    from ray_tpu.serve import api as serve_api
+
+    _v, replicas = ray_tpu.get(serve_api._controller.get_replicas.remote("Solo"))
+    ray_tpu.kill(replicas[0])
+    assert handle.remote(7).result(timeout=60) == 107
