@@ -45,7 +45,8 @@ INDEX_HTML = """<!doctype html>
   <section style="grid-column: 1 / -1"><h2>Nodes</h2><table id="nodes"></table></section>
   <section><h2>Work</h2><table id="work"></table></section>
   <section><h2>Jobs</h2><table id="jobs"></table></section>
-  <section><h2>Serve</h2><table id="serve"></table></section>
+  <section><h2>Serve</h2><table id="serve"></table>
+    <table id="servetopo" style="margin-top:8px"></table></section>
   <section style="grid-column: 1 / -1"><h2>Actors</h2><table id="actors"></table></section>
   <section style="grid-column: 1 / -1"><h2>Recent tasks</h2><table id="tasks"></table></section>
   <section style="grid-column: 1 / -1; display:none" id="detailsec"><h2 id="detailtitle">Detail</h2>
@@ -136,6 +137,21 @@ async function refresh() {
     (jobs.jobs || []).slice(-8).reverse().map(j => [esc(j.submission_id?.slice(0, 14) ?? "-"),
       `<span class="${j.status === 'SUCCEEDED' ? 'ok' : j.status === 'FAILED' ? 'bad' : ''}">${esc(j.status)}</span>`,
       esc((j.entrypoint || "").slice(0, 42))]));
+  if (serve) {
+    // application topology: deployment DAG per app, ingress marked,
+    // upstream dependencies as arrows; re-rendered every refresh so a
+    // shutdown app leaves the screen
+    const topo = Object.entries(serve.applications || {}).map(([app, t]) =>
+      (t.deployments || []).map(d => {
+        const up = (d.depends_on || []).length ? ` ← ${d.depends_on.map(esc).join(", ")}` : "";
+        const ing = d.name === t.ingress ? " ★" : "";
+        return `<tr><td>${esc(app)}</td><td>${esc(d.name)}${ing}</td><td>${esc(d.num_replicas)}</td><td>${up}</td></tr>`;
+      }).join("")
+    ).join("");
+    $("servetopo").innerHTML = topo
+      ? "<tr><th>app</th><th>deployment (★ ingress)</th><th>replicas</th><th>depends on</th></tr>" + topo
+      : "";
+  }
   if (serve) rows($("serve"), ["deployment", "replicas", "target"],
     Object.entries(serve.deployments || {}).map(([name, d]) =>
       [esc(name), esc(d.num_replicas), esc(d.target_replicas)]));

@@ -23,6 +23,7 @@ _controller = None
 _proxy: Optional[HTTPProxy] = None
 _grpc_proxy = None
 _apps: Dict[str, DeploymentHandle] = {}  # app name -> ingress handle
+_topology: Dict[str, dict] = {}  # app name -> deployment DAG (dashboard view)
 
 
 @dataclass
@@ -80,6 +81,28 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
     """Deploy an application graph; returns the ingress handle."""
     controller = _require_started()
     apps = app.walk()  # dependencies first
+    # the DAG shape for the dashboard's topology view (reference: the
+    # serve dashboard's application graph) — registered under the state
+    # lock AFTER every deploy succeeds, beside _apps, so status() never
+    # shows an app that failed to deploy or raced a shutdown
+    topology = {
+        "ingress": app.deployment.name,
+        "route_prefix": route_prefix,
+        "deployments": [
+            {
+                "name": sub.deployment.name,
+                "num_replicas": sub.deployment.num_replicas,
+                "depends_on": sorted(
+                    {
+                        a.deployment.name
+                        for a in list(sub.init_args) + list(sub.init_kwargs.values())
+                        if isinstance(a, Application)
+                    }
+                ),
+            }
+            for sub in apps
+        ],
+    }
     handles: Dict[int, DeploymentHandle] = {}
     for sub in apps:
         init_args = tuple(handles[id(a)] if isinstance(a, Application) else a for a in sub.init_args)
@@ -98,6 +121,7 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         if route_prefix is not None and _proxy is not None:
             _proxy.add_route(route_prefix, ingress)
         _apps[name] = ingress
+        _topology[name] = topology
         if _grpc_proxy is not None:
             _grpc_proxy.add_app(name, ingress)
     return ingress
@@ -155,6 +179,7 @@ def status() -> Dict[str, Any]:
         "deployments": ray_tpu.get(controller.list_deployments.remote()),
         "proxy_url": _proxy.url if _proxy else None,
         "grpc_address": _grpc_proxy.address if _grpc_proxy else None,
+        "applications": dict(_topology),
     }
 
 
@@ -169,6 +194,11 @@ def delete(name: str) -> None:
         for app, handle in list(_apps.items()):
             if getattr(handle, "deployment_name", None) == name:
                 del _apps[app]
+                _topology.pop(app, None)
+        # deleting a non-ingress member invalidates its app's DAG too
+        for app, topo in list(_topology.items()):
+            if any(d["name"] == name for d in topo.get("deployments", ())):
+                _topology.pop(app, None)
         if _grpc_proxy is not None:
             for app, handle in list(_grpc_proxy.apps.items()):
                 if getattr(handle, "deployment_name", None) == name:
@@ -192,6 +222,7 @@ def shutdown() -> None:
     global _controller, _proxy, _grpc_proxy
     with _state_lock:
         _apps.clear()
+        _topology.clear()
         if _grpc_proxy is not None:
             _grpc_proxy.shutdown()
             _grpc_proxy = None

@@ -404,3 +404,31 @@ def test_single_replica_failover_waits_for_replacement():
     _v, replicas = ray_tpu.get(serve_api._controller.get_replicas.remote("Solo"))
     ray_tpu.kill(replicas[0])
     assert handle.remote(7).result(timeout=60) == 107
+
+
+def test_application_topology_in_status():
+    """serve.status() exposes the deployment DAG (the dashboard's
+    application topology view): ingress marked, dependencies-first edges."""
+
+    @serve.deployment
+    class Embed:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Rank:
+        def __init__(self, embed):
+            self.embed = embed
+
+        def __call__(self, x):
+            return self.embed.remote(x).result() + 1
+
+    handle = serve.run(Rank.bind(Embed.bind()), name="pipeline", route_prefix=None)
+    assert handle.remote(3).result() == 7
+    from ray_tpu import serve as serve_mod
+
+    topo = serve_mod.status()["applications"]["pipeline"]
+    assert topo["ingress"] == "Rank"
+    by_name = {d["name"]: d for d in topo["deployments"]}
+    assert by_name["Rank"]["depends_on"] == ["Embed"]
+    assert by_name["Embed"]["depends_on"] == []
