@@ -37,6 +37,35 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.pg import (
+    A2C,
+    A2CConfig,
+    A3C,
+    A3CConfig,
+    PG,
+    PGConfig,
+)
+from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
+from ray_tpu.rllib.algorithms.simple_q import (
+    ApexDQN,
+    ApexDQNConfig,
+    SimpleQ,
+    SimpleQConfig,
+)
+from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.algorithms.bandit import (
+    LinearBanditEnv,
+    LinTS,
+    LinTSConfig,
+    LinUCB,
+    LinUCBConfig,
+)
+from ray_tpu.rllib.algorithms.registry import (
+    get_algorithm_class,
+    get_algorithm_config,
+    list_algorithms,
+)
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
 from ray_tpu.rllib import offline
 
 __all__ = [
@@ -71,5 +100,32 @@ __all__ = [
     "MARWILConfig",
     "CQL",
     "CQLConfig",
+    "PG",
+    "PGConfig",
+    "A2C",
+    "A2CConfig",
+    "A3C",
+    "A3CConfig",
+    "DDPG",
+    "DDPGConfig",
+    "TD3",
+    "TD3Config",
+    "SimpleQ",
+    "SimpleQConfig",
+    "ApexDQN",
+    "ApexDQNConfig",
+    "ES",
+    "ESConfig",
+    "ARS",
+    "ARSConfig",
+    "LinUCB",
+    "LinUCBConfig",
+    "LinTS",
+    "LinTSConfig",
+    "LinearBanditEnv",
+    "PrioritizedReplayBuffer",
+    "get_algorithm_class",
+    "get_algorithm_config",
+    "list_algorithms",
     "offline",
 ]

@@ -1,0 +1,181 @@
+"""Contextual linear bandits: LinUCB and Linear Thompson Sampling.
+
+Parity: `rllib_contrib/bandit` (BanditLinUCB / BanditLinTS — per-arm linear
+models with closed-form posterior updates; no gradient descent, no replay).
+
+TPU design: each arm keeps the sufficient statistics (A = lambda*I + sum
+x x^T, b = sum r*x) as device arrays stacked [num_arms, D, D]; action
+selection and the rank-1 update are one jitted function each, with
+`jnp.linalg.solve` on the stacked statistics instead of per-arm Python.
+Contexts come from a `BanditEnv` protocol (obs IS the context; reward
+arrives for the pulled arm only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.envs import JaxEnv
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearBanditEnv(JaxEnv):
+    """Synthetic contextual bandit: true per-arm weights are drawn at reset
+    from the given seed; reward = <w_arm, context> + noise. One step per
+    "episode" (bandits have horizon 1)."""
+
+    num_arms: int = 5
+    context_dim: int = 8
+    noise: float = 0.1
+    env_seed: int = 0
+    max_episode_steps: int = 1
+
+    @property
+    def observation_size(self):  # type: ignore[override]
+        return self.context_dim
+
+    @property
+    def num_actions(self):  # type: ignore[override]
+        return self.num_arms
+
+    def _weights(self):
+        return jax.random.normal(
+            jax.random.key(self.env_seed), (self.num_arms, self.context_dim)
+        )
+
+    def reset(self, key: jax.Array):
+        ctx = jax.random.normal(key, (self.context_dim,))
+        return {"ctx": ctx, "key": key}, ctx
+
+    def step(self, state, action):
+        w = self._weights()
+        kn, knext = jax.random.split(jax.random.fold_in(state["key"], 1))
+        reward = w[action] @ state["ctx"] + self.noise * jax.random.normal(kn)
+        new_ctx = jax.random.normal(knext, (self.context_dim,))
+        done = jnp.ones((), bool)  # horizon-1: every pull ends the episode
+        return {"ctx": new_ctx, "key": knext}, new_ctx, reward, done, jnp.zeros((), bool)
+
+    def best_expected_reward(self, ctx: jax.Array) -> jax.Array:
+        return jnp.max(self._weights() @ ctx)
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.reg_lambda = 1.0
+        self.ucb_alpha = 1.0  # LinUCB exploration bonus scale
+        self.ts_scale = 1.0  # LinTS posterior sample scale
+        self.steps_per_iter = 64
+        self.exploration = "ucb"  # "ucb" | "ts"
+
+
+class LinUCBConfig(BanditConfig):
+    pass
+
+
+class LinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.exploration = "ts"
+
+
+class LinUCB(Algorithm):
+    """Closed-form contextual bandit. Stats update is exact (rank-1), so
+    there is no learner/optimizer — `training_step` pulls arms, observes
+    rewards, and refreshes the posterior."""
+
+    def setup(self) -> None:
+        cfg: BanditConfig = self.config
+        env = cfg.env
+        assert env.discrete and env.max_episode_steps == 1, (
+            "bandit algorithms need a horizon-1 discrete env"
+        )
+        d = env.observation_size
+        self.A = jnp.eye(d)[None].repeat(env.num_actions, 0) * cfg.reg_lambda
+        self.b = jnp.zeros((env.num_actions, d))
+        self._key = jax.random.key(cfg.seed)
+        self._select = jax.jit(self._make_select())
+        self._update = jax.jit(self._make_update())
+        self._regret_sum = 0.0
+
+    def _make_select(self):
+        cfg: BanditConfig = self.config
+
+        def select(A, b, ctx, key):
+            theta = jnp.linalg.solve(A, b[..., None])[..., 0]  # [arms, D]
+            mean = theta @ ctx
+            if cfg.exploration == "ts":
+                # sample from each arm's posterior N(theta, scale^2 * A^-1)
+                cov_ctx = jnp.linalg.solve(A, jnp.broadcast_to(ctx, b.shape)[..., None])[..., 0]
+                var = jnp.einsum("ad,d->a", cov_ctx, ctx)
+                noise = jax.random.normal(key, mean.shape)
+                score = mean + cfg.ts_scale * jnp.sqrt(jnp.maximum(var, 0.0)) * noise
+            else:
+                cov_ctx = jnp.linalg.solve(A, jnp.broadcast_to(ctx, b.shape)[..., None])[..., 0]
+                bonus = jnp.sqrt(jnp.maximum(jnp.einsum("ad,d->a", cov_ctx, ctx), 0.0))
+                score = mean + cfg.ucb_alpha * bonus
+            return jnp.argmax(score)
+
+        return select
+
+    def _make_update(self):
+        def update(A, b, arm, ctx, reward):
+            A = A.at[arm].add(jnp.outer(ctx, ctx))
+            b = b.at[arm].add(reward * ctx)
+            return A, b
+
+        return update
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: BanditConfig = self.config
+        env = cfg.env
+        rewards = []
+        regret = 0.0
+        for _ in range(cfg.steps_per_iter):
+            self._key, kr, ks = jax.random.split(self._key, 3)
+            state, ctx = env.reset(kr)
+            arm = self._select(self.A, self.b, ctx, ks)
+            state, _, reward, _, _ = env.step(state, arm)
+            self.A, self.b = self._update(self.A, self.b, arm, ctx, reward)
+            rewards.append(float(reward))
+            if hasattr(env, "best_expected_reward"):
+                regret += float(env.best_expected_reward(ctx)) - float(reward)
+        self._regret_sum += regret
+        self._record_episodes(rewards, cfg.steps_per_iter)
+        return {
+            "reward_mean": float(jnp.mean(jnp.asarray(rewards))),
+            "regret_this_iter": regret,
+            "cumulative_regret": self._regret_sum,
+        }
+
+    def get_state(self):
+        return {
+            "A": self.A,
+            "b": self.b,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state):
+        self.A = state["A"]
+        self.b = state["b"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+LinUCBConfig.algo_class = LinUCB
+
+
+class LinTS(LinUCB):
+    pass
+
+
+LinTSConfig.algo_class = LinTS

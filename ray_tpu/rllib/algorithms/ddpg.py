@@ -1,0 +1,231 @@
+"""DDPG / TD3: deterministic-policy-gradient continuous control.
+
+Parity: `rllib_contrib/ddpg` (deterministic actor + Q critic with target
+networks and exploration noise) and `rllib_contrib/td3` (the three TD3
+fixes: twin critics with min-target, delayed policy updates, target-policy
+smoothing noise). TD3 here IS DDPG with those three knobs on — one learner
+covers both, the config chooses.
+
+TPU design: actor and critic updates are a single jitted step (critic TD
+regression on targets from the target nets, actor ascent through the frozen
+critic). The delayed policy update is a static jit argument — XLA compiles
+exactly two variants (critic-only / critic+actor) instead of tracing a
+dynamic branch every step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import DDPGModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1000
+        self.target_update_tau = 0.005
+        self.num_updates_per_iter = 8
+        self.train_batch_size = 128
+        self.exploration_noise = 0.1
+        # TD3 knobs (off => plain DDPG)
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.5
+
+
+class TD3Config(DDPGConfig):
+    def __init__(self):
+        super().__init__()
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+
+
+class _DDPGLearner:
+    """Separate actor/critic optimizers over one params tree; one jitted
+    update covering both DDPG and TD3 semantics."""
+
+    def __init__(self, module: DDPGModule, cfg: DDPGConfig):
+        self.module = module
+        self.cfg = cfg
+        self.params = module.init(jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.critic_lr)
+        self.actor_opt_state = self.actor_tx.init(self.params)
+        self.critic_opt_state = self.critic_tx.init(self.params)
+        self._step = 0
+        self._update = jax.jit(self._make_update(), static_argnames=("do_policy_update",))
+
+    def _make_update(self):
+        m, cfg = self.module, self.cfg
+
+        def update(
+            params,
+            target_params,
+            actor_opt_state,
+            critic_opt_state,
+            batch,
+            key,
+            do_policy_update: bool,
+        ):
+            next_a = m.action(target_params, batch[SampleBatch.NEXT_OBS])
+            if cfg.target_noise > 0.0:
+                # target-policy smoothing (TD3): noise on the TARGET action,
+                # clipped, so the critic can't exploit sharp Q ridges
+                span = 0.5 * (m.action_high - m.action_low)
+                noise = jnp.clip(
+                    cfg.target_noise * span * jax.random.normal(key, next_a.shape),
+                    -cfg.target_noise_clip * span,
+                    cfg.target_noise_clip * span,
+                )
+                next_a = jnp.clip(next_a + noise, m.action_low, m.action_high)
+            tq1, tq2 = m.q_values(target_params, batch[SampleBatch.NEXT_OBS], next_a)
+            next_q = jnp.minimum(tq1, tq2) if cfg.twin_q else tq1
+            not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SampleBatch.REWARDS] + cfg.gamma * not_done * next_q
+            )
+
+            def critic_loss(p):
+                q1, q2 = m.q_values(p, batch[SampleBatch.OBS], batch[SampleBatch.ACTIONS])
+                loss = jnp.mean((q1 - target) ** 2)
+                if cfg.twin_q:
+                    loss = loss + jnp.mean((q2 - target) ** 2)
+                return loss, jnp.mean(q1)
+
+            (closs, q_mean), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
+            cgrads = {**cgrads, "pi": jax.tree.map(jnp.zeros_like, cgrads["pi"])}
+            cupd, critic_opt_state = self.critic_tx.update(cgrads, critic_opt_state, params)
+            params = optax.apply_updates(params, cupd)
+
+            def actor_loss(p):
+                a = m.action(p, batch[SampleBatch.OBS])
+                q1, _ = m.q_values(jax.lax.stop_gradient(p), batch[SampleBatch.OBS], a)
+                return -jnp.mean(q1)
+
+            aloss = jnp.zeros(())
+            if do_policy_update:
+                aloss, agrads = jax.value_and_grad(actor_loss)(params)
+                agrads = {
+                    "pi": agrads["pi"],
+                    "q1": jax.tree.map(jnp.zeros_like, agrads["q1"]),
+                    "q2": jax.tree.map(jnp.zeros_like, agrads["q2"]),
+                }
+                aupd, actor_opt_state = self.actor_tx.update(agrads, actor_opt_state, params)
+                params = optax.apply_updates(params, aupd)
+                target_params = jax.tree.map(
+                    lambda t, o: (1 - cfg.target_update_tau) * t + cfg.target_update_tau * o,
+                    target_params,
+                    params,
+                )
+            stats = {"critic_loss": closs, "actor_loss": aloss, "q_mean": q_mean}
+            return params, target_params, actor_opt_state, critic_opt_state, stats
+
+        return update
+
+    def update(self, batch: SampleBatch, key) -> Dict[str, float]:
+        self._step += 1
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (
+            self.params,
+            self.target_params,
+            self.actor_opt_state,
+            self.critic_opt_state,
+            stats,
+        ) = self._update(
+            self.params,
+            self.target_params,
+            self.actor_opt_state,
+            self.critic_opt_state,
+            jbatch,
+            key,
+            do_policy_update=(self._step % self.cfg.policy_delay == 0),
+        )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "actor_opt_state": self.actor_opt_state,
+            "critic_opt_state": self.critic_opt_state,
+            "step": self._step,
+        }
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.actor_opt_state = state["actor_opt_state"]
+        self.critic_opt_state = state["critic_opt_state"]
+        self._step = state["step"]
+
+
+class DDPG(Algorithm):
+    def setup(self) -> None:
+        cfg: DDPGConfig = self.config
+        env = cfg.env
+        assert not env.discrete, "DDPG/TD3 require a continuous-action env"
+        self.module = DDPGModule(
+            env.observation_size,
+            env.action_size,
+            env.action_low,
+            env.action_high,
+            cfg.hidden,
+        )
+        self.runners = EnvRunnerGroup(
+            env,
+            self.module,
+            policy="ddpg",
+            num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            rollout_length=cfg.rollout_length,
+            seed=cfg.seed,
+            remote=cfg.remote_runners,
+        )
+        self.learners = _DDPGLearner(self.module, cfg)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._key = jax.random.key(cfg.seed + 1)
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: DDPGConfig = self.config
+        extra = {"noise_scale": jnp.asarray(cfg.exploration_noise)}
+        for batch, _, ep_returns in self.runners.sample(self.learners.params, extra):
+            self._record_episodes(ep_returns, len(batch) * batch[SampleBatch.OBS].shape[1])
+            flat = SampleBatch(
+                {
+                    k: jnp.asarray(v).reshape((-1,) + v.shape[2:])
+                    for k, v in batch.items()
+                }
+            )
+            self.buffer.add(flat)
+        stats: Dict[str, float] = {}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            self._key, uk = jax.random.split(self._key)
+            stats = self.learners.update(self.buffer.sample(cfg.train_batch_size), uk)
+        return stats
+
+
+DDPGConfig.algo_class = DDPG
+
+
+class TD3(DDPG):
+    pass
+
+
+TD3Config.algo_class = TD3

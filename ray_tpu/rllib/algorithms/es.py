@@ -1,0 +1,323 @@
+"""ES / ARS: black-box evolution strategies.
+
+Parity: `rllib_contrib/es` (OpenAI-ES: antithetic gaussian perturbations,
+centered-rank fitness shaping, Adam on the estimated gradient) and
+`rllib_contrib/ars` (Augmented Random Search V2: top-k direction selection,
+reward-std scaling, online observation normalization, linear policy by
+default).
+
+TPU design: the reference fans perturbations out as one worker per rollout
+over gRPC with a shared noise table. Here the ENTIRE population evaluates as
+one XLA program — perturbed parameter trees carry a leading population axis
+and `jax.vmap` maps episode rollouts (a `lax.scan` with alive-masking past
+terminals) over it. No noise table, no workers, no serialization: the noise
+is regenerated from the jit key and the MXU batches every policy forward
+across the population.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rllib.envs import JaxEnv
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 0.02
+        self.population_size = 64  # perturbation PAIRS are pop/2
+        self.noise_std = 0.05
+        self.weight_decay = 0.005
+        self.eval_length = 0  # 0 -> env.max_episode_steps
+
+
+class ARSConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 0.02
+        self.population_size = 32  # directions = pop/2
+        self.noise_std = 0.05
+        self.top_directions = 8
+        self.eval_length = 0
+        self.hidden = ()  # ARS default: linear policy
+
+
+class _DeterministicPolicy:
+    """Policy-only MLP: argmax logits for discrete envs, scaled tanh for
+    continuous. Optional observation normalization (ARS V2)."""
+
+    def __init__(self, env: JaxEnv, hidden: Tuple[int, ...]):
+        self.env = env
+        out = env.num_actions if env.discrete else env.action_size
+        self.dims = (env.observation_size, *hidden, out)
+
+    def init(self, key: jax.Array):
+        return _mlp_init(key, self.dims)
+
+    def action(self, params, obs: jax.Array) -> jax.Array:
+        out = _mlp_apply(params, obs)
+        if self.env.discrete:
+            return jnp.argmax(out, axis=-1)
+        lo, hi = self.env.action_low, self.env.action_high
+        return lo + (jnp.tanh(out) + 1.0) * 0.5 * (hi - lo)
+
+
+def _make_eval(env: JaxEnv, policy: _DeterministicPolicy, length: int):
+    """-> jitted (params_pop, keys[P]) -> (returns[P], steps[P],
+    obs_sum[P,D], obs_sqsum[P,D]). One vmapped scan evaluates every
+    population member's episode; alive-masking freezes reward/obs
+    accumulation after the episode ends."""
+
+    def one(params, key):
+        state, obs = env.reset(key)
+
+        def step(carry, _):
+            state, obs, ret, alive, osum, osq = carry
+            a = policy.action(params, obs)
+            state2, obs2, r, term, trunc = env.step(state, a)
+            done = (term | trunc).astype(jnp.float32)
+            ret = ret + r * alive
+            osum = osum + obs * alive
+            osq = osq + obs * obs * alive
+            alive2 = alive * (1.0 - done)
+            return (state2, obs2, ret, alive2, osum, osq), alive
+
+        zeros = jnp.zeros((env.observation_size,))
+        (state, obs, ret, alive, osum, osq), alive_tr = jax.lax.scan(
+            step,
+            (state, obs, jnp.zeros(()), jnp.ones(()), zeros, zeros),
+            None,
+            length=length,
+        )
+        return ret, jnp.sum(alive_tr), osum, osq
+
+    return jax.jit(jax.vmap(one))
+
+
+class _ObsNormalizer:
+    """Running mean/std over observations (ARS V2). Updates from the masked
+    sums the eval scan already accumulates."""
+
+    def __init__(self, dim: int):
+        self.count = 1e-4
+        self.mean = jnp.zeros((dim,))
+        # sum of squared deviations; primed so std starts at 1 (not 1/sqrt(count))
+        self.m2 = jnp.full((dim,), self.count)
+
+    def update(self, obs_sum, obs_sqsum, n: float) -> None:
+        if n <= 0:
+            return
+        batch_mean = obs_sum / n
+        batch_var = jnp.maximum(obs_sqsum / n - batch_mean**2, 0.0)
+        delta = batch_mean - self.mean
+        tot = self.count + n
+        self.mean = self.mean + delta * n / tot
+        self.m2 = self.m2 + batch_var * n + delta**2 * self.count * n / tot
+        self.count = tot
+
+    @property
+    def std(self):
+        return jnp.sqrt(jnp.maximum(self.m2 / self.count, 1e-8))
+
+
+class ES(Algorithm):
+    def setup(self) -> None:
+        cfg: ESConfig = self.config
+        env = cfg.env
+        assert cfg.population_size % 2 == 0, "population_size must be even (antithetic)"
+        self.policy = _DeterministicPolicy(env, cfg.hidden)
+        self.theta = self.policy.init(jax.random.key(cfg.seed))
+        self._length = cfg.eval_length or env.max_episode_steps
+        self._eval = _make_eval(env, self.policy, self._length)
+        self._key = jax.random.key(cfg.seed + 1)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.theta)
+        self._es_step = jax.jit(self._make_step())
+
+    def _make_step(self):
+        cfg: ESConfig = self.config
+        half = cfg.population_size // 2
+
+        def es_step(theta, opt_state, key):
+            knoise, keval = jax.random.split(key)
+            leaves, treedef = jax.tree.flatten(theta)
+            nkeys = jax.random.split(knoise, len(leaves))
+            eps = [
+                jax.random.normal(k, (half,) + leaf.shape)
+                for k, leaf in zip(nkeys, leaves)
+            ]
+            # antithetic pairs: theta +/- std*eps, stacked [P = 2*half]
+            pop_leaves = [
+                jnp.concatenate(
+                    [leaf[None] + cfg.noise_std * e, leaf[None] - cfg.noise_std * e]
+                )
+                for leaf, e in zip(leaves, eps)
+            ]
+            pop = jax.tree.unflatten(treedef, pop_leaves)
+            keys = jax.random.split(keval, cfg.population_size)
+            returns, steps, _, _ = self._eval(pop, keys)
+            # centered-rank shaping in [-0.5, 0.5]
+            ranks = jnp.argsort(jnp.argsort(returns)).astype(jnp.float32)
+            shaped = ranks / (cfg.population_size - 1) - 0.5
+            w = shaped[:half] - shaped[half:]  # antithetic difference weights
+            grads = jax.tree.unflatten(
+                treedef,
+                [
+                    -jnp.tensordot(w, e, axes=1) / (cfg.population_size * cfg.noise_std)
+                    + cfg.weight_decay * leaf
+                    for leaf, e in zip(leaves, eps)
+                ],
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, theta)
+            theta = optax.apply_updates(theta, updates)
+            return theta, opt_state, returns, steps
+
+        return es_step
+
+    def training_step(self) -> Dict[str, float]:
+        self._key, k = jax.random.split(self._key)
+        self.theta, self.opt_state, returns, steps = self._es_step(
+            self.theta, self.opt_state, k
+        )
+        self._record_episodes([float(r) for r in returns], int(jnp.sum(steps)))
+        return {
+            "fitness_mean": float(jnp.mean(returns)),
+            "fitness_max": float(jnp.max(returns)),
+        }
+
+    def get_state(self):
+        return {
+            "theta": self.theta,
+            "opt_state": self.opt_state,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state):
+        self.theta = state["theta"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+ESConfig.algo_class = ES
+
+
+class ARS(Algorithm):
+    """ARS V2: evaluate +/- each direction on normalized observations, keep
+    the top-k directions by best-of-pair return, step by the reward-std-scaled
+    average of their return differences."""
+
+    def setup(self) -> None:
+        cfg: ARSConfig = self.config
+        env = cfg.env
+        assert cfg.population_size % 2 == 0
+        self.policy = _DeterministicPolicy(env, cfg.hidden)
+        base_action = self.policy.action
+        self.normalizer = _ObsNormalizer(env.observation_size)
+        # normalization is applied inside the policy so the SAME jitted eval
+        # serves both algorithms; mean/std ride in as extra params
+        policy = _DeterministicPolicy(env, cfg.hidden)
+
+        def norm_action(params, obs):
+            obs = (obs - params["_norm_mean"]) / params["_norm_std"]
+            return base_action(params["w"], obs)
+
+        policy.action = norm_action
+        self.theta = self.policy.init(jax.random.key(cfg.seed))
+        self._length = cfg.eval_length or env.max_episode_steps
+        self._eval = _make_eval(env, policy, self._length)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._ars_step = jax.jit(self._make_step())
+
+    def _make_step(self):
+        cfg: ARSConfig = self.config
+        half = cfg.population_size // 2
+        k_top = min(cfg.top_directions, half)
+
+        def ars_step(theta, norm_mean, norm_std, key):
+            knoise, keval = jax.random.split(key)
+            leaves, treedef = jax.tree.flatten(theta)
+            nkeys = jax.random.split(knoise, len(leaves))
+            eps = [
+                jax.random.normal(k, (half,) + leaf.shape)
+                for k, leaf in zip(nkeys, leaves)
+            ]
+            pop_leaves = [
+                jnp.concatenate(
+                    [leaf[None] + cfg.noise_std * e, leaf[None] - cfg.noise_std * e]
+                )
+                for leaf, e in zip(leaves, eps)
+            ]
+            pop = {
+                "w": jax.tree.unflatten(treedef, pop_leaves),
+                "_norm_mean": jnp.broadcast_to(
+                    norm_mean, (cfg.population_size,) + norm_mean.shape
+                ),
+                "_norm_std": jnp.broadcast_to(
+                    norm_std, (cfg.population_size,) + norm_std.shape
+                ),
+            }
+            keys = jax.random.split(keval, cfg.population_size)
+            returns, steps, osum, osq = self._eval(pop, keys)
+            r_plus, r_minus = returns[:half], returns[half:]
+            # top-k directions by the better of the pair
+            score = jnp.maximum(r_plus, r_minus)
+            top = jnp.argsort(-score)[:k_top]
+            diffs = r_plus[top] - r_minus[top]
+            sigma_r = jnp.std(jnp.concatenate([r_plus[top], r_minus[top]])) + 1e-8
+            scale = cfg.lr / (k_top * sigma_r)
+            theta = jax.tree.unflatten(
+                treedef,
+                [
+                    leaf + scale * jnp.tensordot(diffs, e[top], axes=1)
+                    for leaf, e in zip(leaves, eps)
+                ],
+            )
+            return theta, returns, steps, jnp.sum(osum, 0), jnp.sum(osq, 0)
+
+        return ars_step
+
+    def training_step(self) -> Dict[str, float]:
+        self._key, k = jax.random.split(self._key)
+        self.theta, returns, steps, osum, osq = self._ars_step(
+            self.theta, self.normalizer.mean, self.normalizer.std, k
+        )
+        n = float(jnp.sum(steps))
+        self.normalizer.update(osum, osq, n)
+        self._record_episodes([float(r) for r in returns], int(n))
+        return {
+            "fitness_mean": float(jnp.mean(returns)),
+            "fitness_max": float(jnp.max(returns)),
+            "obs_count": float(self.normalizer.count),
+        }
+
+    def get_state(self):
+        return {
+            "theta": self.theta,
+            "norm": (self.normalizer.count, self.normalizer.mean, self.normalizer.m2),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state):
+        self.theta = state["theta"]
+        self.normalizer.count, self.normalizer.mean, self.normalizer.m2 = state["norm"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+ARSConfig.algo_class = ARS

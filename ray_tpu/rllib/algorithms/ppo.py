@@ -49,6 +49,34 @@ def _gae(rewards, values, dones, final_value, gamma, lam):
     return advs, advs + values
 
 
+def attach_gae_and_flatten(batch, final_obs, value_fn, params, gamma, lam) -> SampleBatch:
+    """Attach GAE advantages/returns to one runner's [T, B] rollout and
+    flatten it to [T*B] rows. Truncated (time-limit) cuts still have future
+    value: fold gamma*V(next_obs) into the reward, then break the GAE chain
+    at BOTH kinds of episode end (reference: terminateds/truncateds).
+    Shared by PPO and the PG family."""
+    final_value = value_fn(params, jnp.asarray(final_obs))
+    truncated = jnp.asarray(batch[SampleBatch.TRUNCATEDS])
+    next_values = value_fn(params, jnp.asarray(batch[SampleBatch.NEXT_OBS]))
+    rewards = (
+        jnp.asarray(batch[SampleBatch.REWARDS])
+        + gamma * truncated.astype(jnp.float32) * next_values
+    )
+    advs, returns = _gae(
+        rewards,
+        jnp.asarray(batch[SampleBatch.VALUES]),
+        jnp.asarray(batch[SampleBatch.DONES]) | truncated,
+        final_value,
+        gamma,
+        lam,
+    )
+    batch[SampleBatch.ADVANTAGES] = np.asarray(advs)
+    batch[SampleBatch.RETURNS] = np.asarray(returns)
+    return SampleBatch(
+        {k: np.asarray(v).reshape((-1,) + np.shape(v)[2:]) for k, v in batch.items()}
+    )
+
+
 def _ppo_loss(module, clip_param, entropy_coeff, vf_loss_coeff):
     def loss_fn(params, batch):
         logp, entropy = module.logp_entropy(
@@ -107,35 +135,14 @@ class PPO(Algorithm):
         flat_batches = []
         for batch, final_obs, ep_returns in self.runners.sample(self.learners.params):
             self._record_episodes(ep_returns, len(batch) * batch[SampleBatch.OBS].shape[1])
-            final_value = self._value_fn(self.learners.params, jnp.asarray(final_obs))
-            # Truncated (time-limit) cuts still have future value: fold
-            # gamma*V(next_obs) into the reward, then break the GAE chain at
-            # BOTH kinds of episode end (reference: terminateds/truncateds).
-            truncated = jnp.asarray(batch[SampleBatch.TRUNCATEDS])
-            next_values = self._value_fn(
-                self.learners.params, jnp.asarray(batch[SampleBatch.NEXT_OBS])
-            )
-            rewards = (
-                jnp.asarray(batch[SampleBatch.REWARDS])
-                + cfg.gamma * truncated.astype(jnp.float32) * next_values
-            )
-            advs, returns = _gae(
-                rewards,
-                jnp.asarray(batch[SampleBatch.VALUES]),
-                jnp.asarray(batch[SampleBatch.DONES]) | truncated,
-                final_value,
-                cfg.gamma,
-                cfg.gae_lambda,
-            )
-            batch[SampleBatch.ADVANTAGES] = np.asarray(advs)
-            batch[SampleBatch.RETURNS] = np.asarray(returns)
-            # flatten [T, B, ...] -> [T*B, ...]
             flat_batches.append(
-                SampleBatch(
-                    {
-                        k: np.asarray(v).reshape((-1,) + np.shape(v)[2:])
-                        for k, v in batch.items()
-                    }
+                attach_gae_and_flatten(
+                    batch,
+                    final_obs,
+                    self._value_fn,
+                    self.learners.params,
+                    cfg.gamma,
+                    cfg.gae_lambda,
                 )
             )
         train_batch = SampleBatch.concat_samples(flat_batches)

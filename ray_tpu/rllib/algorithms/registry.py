@@ -1,0 +1,89 @@
+"""Algorithm registry: name -> (Algorithm class, default config factory).
+
+Parity: `rllib/algorithms/registry.py` (POLICIES/ALGORITHMS name maps used
+by `rllib train --run=PPO` and Tune's string-run resolution).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple, Type
+
+
+def _load() -> Dict[str, Tuple[type, Callable]]:
+    from ray_tpu.rllib.algorithms.bandit import (
+        LinTS,
+        LinTSConfig,
+        LinUCB,
+        LinUCBConfig,
+    )
+    from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+    from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+    from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
+    from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+    from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+    from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
+    from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+    from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, A3C, A3CConfig, PG, PGConfig
+    from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+    from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+    from ray_tpu.rllib.algorithms.simple_q import (
+        ApexDQN,
+        ApexDQNConfig,
+        SimpleQ,
+        SimpleQConfig,
+    )
+
+    return {
+        "PPO": (PPO, PPOConfig),
+        "APPO": (APPO, APPOConfig),
+        "IMPALA": (IMPALA, IMPALAConfig),
+        "DQN": (DQN, DQNConfig),
+        "SAC": (SAC, SACConfig),
+        "BC": (BC, BCConfig),
+        "MARWIL": (MARWIL, MARWILConfig),
+        "CQL": (CQL, CQLConfig),
+        "DreamerV3": (DreamerV3, DreamerV3Config),
+        "PG": (PG, PGConfig),
+        "A2C": (A2C, A2CConfig),
+        "A3C": (A3C, A3CConfig),
+        "DDPG": (DDPG, DDPGConfig),
+        "TD3": (TD3, TD3Config),
+        "SimpleQ": (SimpleQ, SimpleQConfig),
+        "APEX": (ApexDQN, ApexDQNConfig),
+        "ES": (ES, ESConfig),
+        "ARS": (ARS, ARSConfig),
+        "BanditLinUCB": (LinUCB, LinUCBConfig),
+        "BanditLinTS": (LinTS, LinTSConfig),
+    }
+
+
+_REGISTRY: Dict[str, Tuple[type, Callable]] = {}
+
+
+def _registry() -> Dict[str, Tuple[type, Callable]]:
+    if not _REGISTRY:
+        _REGISTRY.update(_load())
+    return _REGISTRY
+
+
+def get_algorithm_class(name: str) -> Type:
+    """Resolve an algorithm by its registry name (case-insensitive)."""
+    reg = _registry()
+    for k, (cls, _) in reg.items():
+        if k.lower() == name.lower():
+            return cls
+    raise ValueError(f"unknown algorithm {name!r}; known: {sorted(reg)}")
+
+
+def get_algorithm_config(name: str):
+    """A fresh default config for the named algorithm."""
+    reg = _registry()
+    for k, (_, cfg_cls) in reg.items():
+        if k.lower() == name.lower():
+            return cfg_cls()
+    raise ValueError(f"unknown algorithm {name!r}; known: {sorted(reg)}")
+
+
+def list_algorithms():
+    return sorted(_registry())

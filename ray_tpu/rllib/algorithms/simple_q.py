@@ -1,0 +1,249 @@
+"""SimpleQ / Ape-X DQN: the plain and the distributed ends of Q-learning.
+
+Parity: `rllib_contrib/simple_q` (vanilla TD(0) Q-learning — no double-Q,
+no dueling, hard periodic target sync; kept as the readable reference
+implementation) and `rllib_contrib/apex_dqn` (Horgan et al.'s distributed
+DQN: many actors with per-actor exploration epsilons feeding one learner
+through prioritized replay with importance-weighted updates).
+
+TPU design: Ape-X's contribution is the SCHEDULE, not the kernels — here
+the per-actor epsilon ladder rides the existing vectorized runner (each
+runner gets its own epsilon, fanned out as `ray_tpu` actors when
+`remote=True`), and the prioritized buffer returns sampled indices so the
+jitted weighted-Huber update can write |TD| priorities straight back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import _soft_update
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.rl_module import QModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class SimpleQConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 32  # hard sync every N updates
+        self.epsilon = 0.1
+        self.num_updates_per_iter = 8
+        self.train_batch_size = 128
+
+
+def _simple_q_loss(module: QModule, gamma: float):
+    def loss_fn(params, batch, target_params):
+        q = module.q_values(params, batch[SampleBatch.OBS])
+        q_taken = jnp.take_along_axis(
+            q, batch[SampleBatch.ACTIONS][..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        # vanilla TD(0): target net both picks and evaluates the max
+        next_q = jnp.max(
+            module.q_values(target_params, batch[SampleBatch.NEXT_OBS]), axis=-1
+        )
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+        target = batch[SampleBatch.REWARDS] + gamma * not_done * jax.lax.stop_gradient(next_q)
+        loss = jnp.mean((q_taken - target) ** 2)
+        return loss, {"q_mean": jnp.mean(q_taken)}
+
+    return loss_fn
+
+
+class SimpleQ(Algorithm):
+    def setup(self) -> None:
+        cfg: SimpleQConfig = self.config
+        env = cfg.env
+        assert env.discrete, "SimpleQ requires a discrete-action env"
+        self.module = QModule(env.observation_size, env.num_actions, cfg.hidden)
+        self.runners = EnvRunnerGroup(
+            env,
+            self.module,
+            policy="q",
+            num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            rollout_length=cfg.rollout_length,
+            seed=cfg.seed,
+            remote=cfg.remote_runners,
+        )
+        self.learners = LearnerGroup(
+            Learner(
+                self.module,
+                _simple_q_loss(self.module, cfg.gamma),
+                lr=cfg.lr,
+                max_grad_norm=cfg.max_grad_norm,
+                seed=cfg.seed,
+            )
+        )
+        self.target_params = jax.tree.map(jnp.copy, self.learners.params)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._updates = 0
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self.target_params
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+        self._updates = state["updates"]
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: SimpleQConfig = self.config
+        eps = jnp.asarray(cfg.epsilon)
+        for batch, _, ep_returns in self.runners.sample(self.learners.params, {"epsilon": eps}):
+            self._record_episodes(ep_returns, len(batch) * batch[SampleBatch.OBS].shape[1])
+            self.buffer.add(
+                SampleBatch(
+                    {k: jnp.asarray(v).reshape((-1,) + v.shape[2:]) for k, v in batch.items()}
+                )
+            )
+        stats: Dict[str, float] = {}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            stats = self.learners.update(
+                self.buffer.sample(cfg.train_batch_size), target_params=self.target_params
+            )
+            self._updates += 1
+            if self._updates % cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.learners.params)
+        return stats
+
+
+SimpleQConfig.algo_class = SimpleQ
+
+
+class ApexDQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.num_env_runners = 4
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1000
+        self.target_update_tau = 0.01
+        self.num_updates_per_iter = 16
+        self.train_batch_size = 128
+        # Ape-X epsilon ladder: runner i explores at eps_base^(1 + i/(N-1)*alpha)
+        self.epsilon_base = 0.4
+        self.epsilon_alpha = 7.0
+        self.prioritized_alpha = 0.6
+        self.prioritized_beta = 0.4
+
+
+def _apex_loss(module: QModule, gamma: float):
+    def loss_fn(params, batch, target_params):
+        q = module.q_values(params, batch[SampleBatch.OBS])
+        q_taken = jnp.take_along_axis(
+            q, batch[SampleBatch.ACTIONS][..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        # double-DQN targets (Ape-X uses the full Rainbow-lite learner)
+        next_a = jnp.argmax(module.q_values(params, batch[SampleBatch.NEXT_OBS]), axis=-1)
+        next_q = jnp.take_along_axis(
+            module.q_values(target_params, batch[SampleBatch.NEXT_OBS]),
+            next_a[..., None],
+            axis=-1,
+        )[..., 0]
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+        target = batch[SampleBatch.REWARDS] + gamma * not_done * jax.lax.stop_gradient(next_q)
+        td = q_taken - target
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+        loss = jnp.mean(batch["weights"] * huber)
+        return loss, {"td_abs": jnp.abs(td), "q_mean": jnp.mean(q_taken)}
+
+    return loss_fn
+
+
+class ApexDQN(Algorithm):
+    """Distributed prioritized-replay DQN. Each runner samples at its own
+    rung of the epsilon ladder; the learner consumes IS-weighted prioritized
+    minibatches and writes fresh |TD| priorities back after every update."""
+
+    def setup(self) -> None:
+        cfg: ApexDQNConfig = self.config
+        env = cfg.env
+        assert env.discrete, "ApexDQN requires a discrete-action env"
+        self.module = QModule(env.observation_size, env.num_actions, cfg.hidden)
+        self.runners = EnvRunnerGroup(
+            env,
+            self.module,
+            policy="q",
+            num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            rollout_length=cfg.rollout_length,
+            seed=cfg.seed,
+            remote=cfg.remote_runners,
+        )
+        n = max(1, cfg.num_env_runners)
+        self._epsilons = [
+            cfg.epsilon_base ** (1 + (i / max(1, n - 1)) * cfg.epsilon_alpha)
+            for i in range(n)
+        ]
+        self.learners = LearnerGroup(
+            Learner(
+                self.module,
+                _apex_loss(self.module, cfg.gamma),
+                lr=cfg.lr,
+                max_grad_norm=cfg.max_grad_norm,
+                seed=cfg.seed,
+            )
+        )
+        self.target_params = jax.tree.map(jnp.copy, self.learners.params)
+        self.buffer = PrioritizedReplayBuffer(
+            cfg.buffer_capacity,
+            seed=cfg.seed,
+            alpha=cfg.prioritized_alpha,
+            beta=cfg.prioritized_beta,
+        )
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self.target_params
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: ApexDQNConfig = self.config
+        # per-runner epsilons: each runner samples at its ladder rung
+        results = self.runners.sample_each(
+            self.learners.params,
+            [{"epsilon": jnp.asarray(e)} for e in self._epsilons],
+        )
+        for batch, _, ep_returns in results:
+            self._record_episodes(ep_returns, len(batch) * batch[SampleBatch.OBS].shape[1])
+            self.buffer.add(
+                SampleBatch(
+                    {k: jnp.asarray(v).reshape((-1,) + v.shape[2:]) for k, v in batch.items()}
+                )
+            )
+        stats: Dict[str, float] = {}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            sample = self.buffer.sample(cfg.train_batch_size)
+            idx = sample.sampled_indices
+            raw = self.learners.learner.update_raw(sample, target_params=self.target_params)
+            self.buffer.update_priorities(idx, np.asarray(raw.pop("td_abs")))
+            stats = {k: float(v) for k, v in raw.items()}
+            self.target_params = _soft_update(
+                self.target_params, self.learners.params, cfg.target_update_tau
+            )
+        return stats
+
+
+ApexDQNConfig.algo_class = ApexDQN

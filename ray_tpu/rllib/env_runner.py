@@ -39,6 +39,8 @@ class EnvRunner:
       - "actor_critic": module.explore -> (action, logp, value) recorded.
       - "q": epsilon-greedy on module.q_values; `extra` carries epsilon.
       - "sac": module.sample_action; logp recorded.
+      - "ddpg": deterministic module.explore + gaussian noise; `extra`
+        carries noise_scale.
       - "random": uniform actions (warmup for off-policy algos).
     """
 
@@ -84,6 +86,8 @@ class EnvRunner:
         if self.policy == "sac":
             action, logp = m.sample_action(params, obs, key)
             return action, {SampleBatch.LOGP: logp}
+        if self.policy == "ddpg":
+            return m.explore(params, obs, key, extra["noise_scale"]), {}
         if self.policy == "random":
             if self.env.discrete:
                 return jax.random.randint(key, obs.shape[:1], 0, self.env.num_actions), {}
@@ -212,12 +216,28 @@ class EnvRunnerGroup:
 
     def sample(self, params, extra: Optional[Dict[str, Any]] = None):
         """-> list of (batch, final_obs, episode_returns) per runner."""
+        return self.sample_each(params, [extra] * len(self._runners))
+
+    def sample_each(self, params, extras: List[Optional[Dict[str, Any]]]):
+        """Sample with a PER-RUNNER extra dict (e.g. Ape-X's epsilon ladder).
+        Remote runners overlap; inline runners go sequentially."""
         if self.remote:
             import ray_tpu
 
-            refs = [r.sample.remote(params, extra) for r in self._runners]
+            refs = [
+                r.sample.remote(params, e) for r, e in zip(self._runners, extras)
+            ]
             return ray_tpu.get(refs)
-        return [r.sample(params, extra) for r in self._runners]
+        return [r.sample(params, e) for r, e in zip(self._runners, extras)]
+
+    def sample_one(self, index: int, params, extra: Optional[Dict[str, Any]] = None):
+        """Sample a single runner (A3C's interleaved schedule)."""
+        runner = self._runners[index]
+        if self.remote:
+            import ray_tpu
+
+            return ray_tpu.get(runner.sample.remote(params, extra))
+        return runner.sample(params, extra)
 
     def stop(self) -> None:
         if self.remote:

@@ -58,12 +58,17 @@ class Learner:
 
         return update
 
-    def update(self, batch: SampleBatch, **aux) -> Dict[str, float]:
+    def update_raw(self, batch: SampleBatch, **aux) -> Dict[str, jax.Array]:
+        """One update returning stats as device arrays (losses may be
+        per-row vectors — e.g. |TD| for prioritized-replay write-back)."""
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, stats = self._update(
             self.params, self.opt_state, jbatch, aux
         )
-        return {k: float(v) for k, v in stats.items()}
+        return stats
+
+    def update(self, batch: SampleBatch, **aux) -> Dict[str, float]:
+        return {k: float(v) for k, v in self.update_raw(batch, **aux).items()}
 
     def get_state(self) -> Dict[str, Any]:
         return {"params": self.params, "opt_state": self.opt_state}

@@ -143,6 +143,50 @@ class QModule:
 
 
 @dataclasses.dataclass(frozen=True)
+class DDPGModule:
+    """Deterministic-policy module for DDPG/TD3: tanh actor scaled to the
+    action bounds plus twin Q critics (DDPG trains only q1; TD3 both)."""
+
+    obs_size: int
+    action_size: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: Tuple[int, ...] = (64, 64)
+
+    def init(self, key: jax.Array):
+        ka, k1, k2 = jax.random.split(key, 3)
+        qdims = (self.obs_size + self.action_size, *self.hidden, 1)
+        return {
+            "pi": _mlp_init(ka, (self.obs_size, *self.hidden, self.action_size)),
+            "q1": _mlp_init(k1, qdims),
+            "q2": _mlp_init(k2, qdims),
+        }
+
+    def _scale(self, tanh_a):
+        lo, hi = self.action_low, self.action_high
+        return lo + (tanh_a + 1.0) * 0.5 * (hi - lo)
+
+    def action(self, params, obs: jax.Array) -> jax.Array:
+        """Deterministic policy output, already in env action space."""
+        return self._scale(jnp.tanh(_mlp_apply(params["pi"], obs)))
+
+    def explore(self, params, obs: jax.Array, key: jax.Array, noise_scale: jax.Array):
+        """Gaussian exploration noise (scaled to the action range) clipped
+        back into bounds — the reference's OU noise converged to this."""
+        a = self.action(params, obs)
+        span = 0.5 * (self.action_high - self.action_low)
+        noise = noise_scale * span * jax.random.normal(key, a.shape)
+        return jnp.clip(a + noise, self.action_low, self.action_high)
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return (
+            _mlp_apply(params["q1"], x)[..., 0],
+            _mlp_apply(params["q2"], x)[..., 0],
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class SACModule:
     """SAC module: tanh-squashed gaussian actor + twin Q critics."""
 

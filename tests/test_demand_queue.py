@@ -18,7 +18,10 @@ from ray_tpu.exceptions import RayTaskError
 
 @pytest.fixture
 def runtime():
-    rt.init(num_cpus=2, _system_config={"infeasible_task_timeout_s": 2.0})
+    # 10s deadline: long enough that a contended box finishes submitting the
+    # 10k burst before entries start expiring (2s flaked under load), short
+    # enough that the expiry assertion stays inside its rt.get timeout
+    rt.init(num_cpus=2, _system_config={"infeasible_task_timeout_s": 10.0})
     try:
         yield rt
     finally:
@@ -41,7 +44,7 @@ def test_infeasible_burst_flat_thread_count(runtime):
     assert len(cluster.pending_resource_demands()) >= 10_000
     # entries fail with the infeasibility error after the deadline
     with pytest.raises(RayTaskError):
-        rt.get(refs[0], timeout=30)
+        rt.get(refs[0], timeout=60)
 
 
 def test_parked_task_runs_when_node_joins(runtime):
