@@ -1,0 +1,63 @@
+"""Core API tour: tasks, objects, actors, waiting, failure semantics."""
+
+import ray_tpu as rt
+
+
+def main():
+    rt.init(num_cpus=4)
+
+    # -- tasks ---------------------------------------------------------
+    @rt.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(8)]
+    assert rt.get(refs) == [i * i for i in range(8)]
+
+    # objects: put once, pass by reference into many tasks
+    big = rt.put(list(range(10_000)))
+
+    @rt.remote
+    def total(xs):
+        return sum(xs)
+
+    assert rt.get(total.remote(big)) == sum(range(10_000))
+
+    # wait: consume results as they finish
+    pending = [square.remote(i) for i in range(6)]
+    done = []
+    while pending:
+        ready, pending = rt.wait(pending, num_returns=1)
+        done.extend(rt.get(ready))
+    assert sorted(done) == [i * i for i in range(6)]
+
+    # -- actors --------------------------------------------------------
+    @rt.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert rt.get([c.incr.remote() for _ in range(5)]) == [1, 2, 3, 4, 5]
+
+    # -- errors propagate with tracebacks ------------------------------
+    @rt.remote
+    def boom():
+        raise ValueError("expected failure")
+
+    try:
+        rt.get(boom.remote())
+        raise AssertionError("should have raised")
+    except rt.RayTaskError as err:
+        assert "expected failure" in str(err)
+
+    print("tasks/actors tour OK")
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

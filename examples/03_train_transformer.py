@@ -1,0 +1,53 @@
+"""The flagship model: a decoder transformer train step as ONE XLA program.
+
+Single device: plain jit. More than one device (a TPU slice, or
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` on CPU): the same
+step shards over a (dp, tp) mesh — params on tp, batch on dp — and XLA
+inserts the collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.transformer import TransformerConfig, make_train_step
+
+
+def main():
+    cfg = TransformerConfig(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        d_ff=256,
+        max_seq_len=128,
+        remat="dots",
+    )
+    devices = jax.devices()
+    mesh = None
+    if len(devices) > 1:
+        dp = 2 if len(devices) % 2 == 0 else 1
+        mesh = jax.sharding.Mesh(
+            np.array(devices).reshape(dp, len(devices) // dp), ("dp", "tp")
+        )
+        print(f"training over mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    init_state, step = make_train_step(cfg, mesh=mesh, learning_rate=1e-3)
+    state = init_state(jax.random.key(0))
+
+    key = jax.random.key(1)
+    tokens = jax.random.randint(key, (4, 128), 0, cfg.vocab_size)
+    if mesh is not None:
+        tokens = step.shard_batch(tokens)
+
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    print("losses:", [round(l, 3) for l in losses])
+    assert losses[-1] < losses[0], "loss should fall on a repeated batch"
+    print("train tour OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Tune tour: search a toy objective with ASHA early stopping."""
+
+import ray_tpu as rt
+from ray_tpu import tune
+from ray_tpu.tune import AsyncHyperBandScheduler, TuneConfig, Tuner
+
+
+def objective(config):
+    # a quadratic bowl: best at lr=0.1, width=16
+    for step in range(10):
+        loss = (config["lr"] - 0.1) ** 2 + (config["width"] - 16) ** 2 / 256 + 1 / (step + 1)
+        tune.session.report({"loss": loss, "training_iteration": step + 1})
+
+
+def main():
+    rt.init(num_cpus=4)
+    tuner = Tuner(
+        objective,
+        param_space={
+            "lr": tune.loguniform(1e-3, 1.0),
+            "width": tune.choice([4, 8, 16, 32]),
+        },
+        tune_config=TuneConfig(
+            metric="loss",
+            mode="min",
+            num_samples=12,
+            scheduler=AsyncHyperBandScheduler(max_t=10, grace_period=2),
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best config:", best.config, "loss:", round(best.metrics["loss"], 4))
+    assert best.metrics["loss"] < 1.0
+    print("tune tour OK")
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

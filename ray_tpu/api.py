@@ -264,9 +264,21 @@ def nodes() -> List[dict]:
     return out
 
 
-def timeline() -> List[dict]:
-    """Chrome-tracing-style task events (ray timeline parity)."""
-    return get_cluster().control.task_events.list_events()
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Task events for tracing (``ray.timeline`` parity). With ``filename``,
+    writes chrome://tracing JSON there and returns the converted events;
+    without, returns the raw task-event records."""
+    events = get_cluster().control.task_events.list_events()
+    if filename is not None:
+        from ray_tpu.observability.timeline import chrome_trace
+
+        trace = chrome_trace(events)
+        import json as _json
+
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+        return trace
+    return events
 
 
 # --------------------------------------------------------------------------

@@ -220,8 +220,19 @@ class DashboardHead:
         elif path == "/api/timeline":
             from ray_tpu.observability.timeline import chrome_trace
 
-            events = self.cluster.control.task_events.list_events(limit=100_000)
-            req._send(200, chrome_trace(events))
+            # ?limit= caps the event count (downloads default high); ?since_s=
+            # keeps only spans ending in the trailing window — the inline
+            # Gantt polls with since_s=120&limit=400 so refreshes stay cheap
+            trace = chrome_trace(self.cluster.control.task_events.list_events(limit=100_000))
+            since_s = query.get("since_s")
+            if since_s:
+                cutoff = (time.time() - float(since_s[0])) * 1e6
+                trace = [e for e in trace if e["ts"] + e["dur"] >= cutoff]
+            if "limit" in query:
+                # newest-N of the WINDOW (limit-before-window would silently
+                # blank the older part of a busy Gantt)
+                trace = trace[-limit:]
+            req._send(200, trace)
         elif path == "/metrics":
             req._send(200, global_registry().render_prometheus().encode(), "text/plain; version=0.0.4")
         elif path == "/api/serve/applications":

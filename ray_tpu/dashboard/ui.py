@@ -65,6 +65,13 @@ INDEX_HTML = """<!doctype html>
     <pre id="logsearch" style="max-height:200px;overflow:auto"></pre>
     <pre id="nodelogs" style="max-height:260px;overflow:auto"></pre>
   </section>
+  <section style="grid-column: 1 / -1"><h2>Task timeline</h2>
+    <div style="margin-bottom:6px">window:
+      <select id="tlwin" style="background:#0f1419;color:#d6dbe1;border:1px solid #2a323d">
+        <option value="30">30s</option><option value="120" selected>2m</option>
+        <option value="600">10m</option></select></div>
+    <div id="timeline" style="overflow-x:auto"></div>
+  </section>
   <section style="grid-column: 1 / -1"><h2>Recent events</h2><pre id="events"></pre>
     <p style="margin:8px 0 0"><a style="color:#7fd1b9" href="/api/timeline" download="timeline.json">download chrome timeline</a></p>
   </section>
@@ -162,6 +169,7 @@ async function refresh() {
   await refreshLogs();
   await refreshTransfers();
   await refreshMemory();
+  await refreshTimeline();
 }
 async function refreshMemory() {
   const pgs = await get("/api/placement_groups");
@@ -271,6 +279,47 @@ async function refreshClusterRates() {
   $("clusterrates").innerHTML = `<table><tr>
     <td>tasks/s ${sparkRate(hist.points, "tasks_per_s", "#7fd1b9", "/s")}</td>
     <td>transfer ${sparkRate(hist.points, "transfer_bytes_per_s", "#e8c268", "B/s")}</td></tr></table>`;
+}
+async function refreshTimeline() {
+  // inline Gantt over the chrome-trace events: lanes = node/worker pairs
+  // (busiest first), bars = task spans colored by final state
+  const win = +$("tlwin").value;
+  const trace = await get(`/api/timeline?since_s=${win}&limit=400`);
+  if (!trace || !trace.length) { $("timeline").innerHTML = "(no finished tasks in window)"; return; }
+  // anchor the axis to the wall clock (same host as the server), matching
+  // the server-side window — anchoring to the newest span would mislabel
+  // the axis after idle periods
+  const end = Date.now() * 1e3;
+  const start = end - win * 1e6;
+  const lanes = new Map();
+  for (const e of trace) {
+    const key = `${e.pid} ${e.tid}`;
+    if (!lanes.has(key)) lanes.set(key, []);
+    lanes.get(key).push(e);
+  }
+  const ordered = [...lanes.entries()].sort((a, b) => b[1].length - a[1].length).slice(0, 14);
+  const W = 920, LABEL = 190, ROW = 18;
+  const sx = t => LABEL + (W - LABEL) * Math.max(0, t - start) / (end - start || 1);
+  let svg = "";
+  // time gridlines every quarter-window
+  for (let i = 0; i <= 4; i++) {
+    const t = start + (end - start) * i / 4;
+    svg += `<line x1="${sx(t).toFixed(1)}" y1="0" x2="${sx(t).toFixed(1)}" y2="${ordered.length * ROW}" stroke="#2a323d"/>
+      <text x="${sx(t).toFixed(1)}" y="${ordered.length * ROW + 12}" fill="#8a94a0" font-size="10">-${((end - t) / 1e6).toFixed(0)}s</text>`;
+  }
+  ordered.forEach(([key, evs], i) => {
+    const y = i * ROW;
+    svg += `<text x="0" y="${y + 13}" fill="#9fb3c8" font-size="10">${esc(key.replace("node:", "").slice(0, 28))}</text>`;
+    for (const e of evs) {
+      const x0 = sx(e.ts), x1 = sx(e.ts + e.dur);
+      const color = (e.args || {}).state === "FAILED" ? "#e07a5f"
+        : (e.args || {}).state === "FINISHED" ? "#7fd1b9" : "#e8c268";
+      svg += `<rect x="${x0.toFixed(1)}" y="${y + 3}" width="${Math.max(1.5, x1 - x0).toFixed(1)}" height="${ROW - 6}"
+        fill="${color}" opacity="0.85"><title>${esc(e.name)} ${(e.dur / 1e3).toFixed(1)}ms ${esc((e.args || {}).state || "")}</title></rect>`;
+    }
+  });
+  $("timeline").innerHTML =
+    `<svg width="${W}" height="${ordered.length * ROW + 16}">${svg}</svg>`;
 }
 async function searchLogs() {
   const q = $("logq").value;

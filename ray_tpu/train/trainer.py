@@ -37,6 +37,9 @@ class Result:
     path: str
     metrics_dataframe: List[Dict[str, Any]] = field(default_factory=list)
     error: Optional[BaseException] = None
+    # the hyperparameter config that produced this result (parity:
+    # ray.air.Result.config — Tune fills it; bare Trainer.fit leaves None)
+    config: Optional[Dict[str, Any]] = None
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:

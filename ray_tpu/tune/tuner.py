@@ -52,6 +52,7 @@ class ResultGrid:
             path=t.trial_dir,
             metrics_dataframe=t.history,
             error=t.error,
+            config=t.config,
         )
 
     @property

@@ -305,3 +305,44 @@ def test_cluster_rate_panels_and_log_search(dash_multihost):
     node = matches[0]["node"]
     only = _get(url + f"/api/logs/search?q=needle&node={node}")["matches"]
     assert only and all(m["node"] == node for m in only)
+
+
+def test_timeline_window_and_inline_gantt_source(dash_multihost, tmp_path):
+    """The inline Gantt polls /api/timeline?since_s=&limit=: spans carry
+    chrome-trace fields, the trailing window drops stale spans, and limit
+    caps the event count."""
+    cluster, proc = dash_multihost
+    url = cluster.dashboard.url
+
+    @rt.remote
+    def quick(i):
+        return i
+
+    assert rt.get([quick.remote(i) for i in range(6)], timeout=60) == list(range(6))
+    # a synthetic span that ended hours ago must fall outside the window
+    cluster.control.task_events.add(
+        {"task_id": "stale", "name": "stale_task", "ts": time.time() - 7200,
+         "start_ts": time.time() - 7201, "state": "FINISHED", "node": "n", "worker": "w"}
+    )
+    deadline = time.monotonic() + 30
+    windowed = []
+    while time.monotonic() < deadline:
+        windowed = _get(url + "/api/timeline?since_s=120&limit=400")
+        if len(windowed) >= 6:
+            break
+        time.sleep(0.5)
+    assert len(windowed) >= 6, windowed
+    span = windowed[0]
+    assert span["ph"] == "X" and span["dur"] >= 0 and span["pid"].startswith("node:")
+    names = {e["name"] for e in windowed}
+    assert "stale_task" not in names
+    # no window: the stale span IS served (download path unchanged)
+    full = _get(url + "/api/timeline")
+    assert any(e["name"] == "stale_task" for e in full)
+    # limit caps (applied AFTER the window filter: newest-N of the window)
+    assert len(_get(url + "/api/timeline?since_s=120&limit=2")) <= 2
+    # rt.timeline(file) writes chrome-trace JSON (ray.timeline parity)
+    out = tmp_path / "trace.json"
+    trace = rt.timeline(str(out))
+    assert out.exists() and json.loads(out.read_text()) == trace
+    assert any(e.get("ph") == "X" for e in trace)
