@@ -16,6 +16,7 @@ promoted to the primary test path).
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
 from ray_tpu.autoscaler.demand import NodeTypeConfig, get_nodes_to_launch
+from ray_tpu.autoscaler import sdk
 from ray_tpu.autoscaler.monitor import Monitor
 from ray_tpu.autoscaler.kuberay import KubernetesNodeProvider
 from ray_tpu.autoscaler.node_provider import (
@@ -36,6 +37,7 @@ __all__ = [
     "KubernetesNodeProvider",
     "TPUSliceProvider",
     "TPU_SLICE_TOPOLOGIES",
+    "sdk",
 ]
 from ray_tpu.autoscaler.v2 import (
     AutoscalerV2,

@@ -81,6 +81,24 @@ class StandardAutoscaler:
     def _update_locked(self) -> None:
         demands, available, busy, totals = self._load_snapshot()
         managed = self._provider.non_terminated_nodes()
+        # the request_resources floor launches only its UNMET residual
+        # (vs TOTAL capacity — a busy cluster that already holds the floor
+        # must not over-provision); scale-down has its own floor check.
+        # Credit managed-but-unregistered (booting) nodes or every tick of
+        # a slow provider re-launches for the same residual (the credit v2
+        # gets from its QUEUED/REQUESTED/ALLOCATED instance states).
+        registered = {
+            (getattr(node, "labels", None) or {}).get("rt_provider_id")
+            for node in list(self._cluster.nodes.values())
+            if not node.dead
+        }
+        booting = []
+        for pid, tname in managed.items():
+            if pid not in registered and pid not in totals:
+                tcfg = self.config.node_types.get(tname)
+                if tcfg is not None:
+                    booting.append(dict(tcfg.resources))
+        demands = demands + self._cluster.unmet_resource_requests(booting)
         existing_by_type: Dict[str, int] = {}
         for tname in managed.values():
             existing_by_type[tname] = existing_by_type.get(tname, 0) + 1
@@ -118,6 +136,10 @@ class StandardAutoscaler:
         counts_by_type: Dict[str, int] = {}
         for tname in managed.values():
             counts_by_type[tname] = counts_by_type.get(tname, 0) + 1
+        # nodes terminated earlier in THIS sweep: async-death providers
+        # haven't marked them dead in cluster.nodes yet, so the floor check
+        # must exclude them explicitly or one sweep can drop below the floor
+        removed_this_sweep: set = set()
         for pid, tname in list(managed.items()):
             # a slice is busy if any member host is busy
             members = (
@@ -144,12 +166,31 @@ class StandardAutoscaler:
                 now - first_idle >= self.config.idle_timeout_s
                 and counts_by_type.get(tname, 0) > min_workers
                 and not could_serve
+                and self._floor_allows_removal(set(members) | removed_this_sweep)
             ):
+                removed_this_sweep.update(members)
                 self._provider.terminate_node(pid)
                 self._idle_since.pop(pid, None)
                 counts_by_type[tname] -= 1
                 self.num_terminations += 1
                 logger.info("autoscaler: terminated idle node %s (%s)", pid[:8], tname)
+
+    def _floor_allows_removal(self, members) -> bool:
+        """False if terminating this node/slice would drop TOTAL capacity
+        below the request_resources floor (reference: commands.py keeps
+        nodes needed to satisfy resource_requests)."""
+        if not self._cluster.resource_requests():
+            return True
+        members = set(members)
+        remaining = []
+        for node_id, node in list(self._cluster.nodes.items()):
+            if node.dead:
+                continue
+            provider_id = (getattr(node, "labels", None) or {}).get("rt_provider_id")
+            if node_id.hex() in members or (provider_id and provider_id in members):
+                continue
+            remaining.append(node.pool.total.to_dict())
+        return self._cluster.requests_fit(remaining)
 
     # ------------------------------------------------------------------
     def summary(self) -> dict:

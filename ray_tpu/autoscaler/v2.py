@@ -172,7 +172,12 @@ class AutoscalerV2:
                 self.im.transition(inst.instance_id, TERMINATED)
 
     def _scale_up(self) -> None:
-        demands = self._cluster.pending_resource_demands()
+        # floor residual vs TOTAL capacity, like v1 (scale-down re-checks
+        # the floor itself before terminating)
+        demands = (
+            self._cluster.pending_resource_demands()
+            + self._cluster.unmet_resource_requests()
+        )
         available = [
             node.pool.available.to_dict()
             for node in self._cluster.nodes.values()
@@ -237,6 +242,7 @@ class AutoscalerV2:
         live = self.im.instances({RUNNING})
         counts = self._counts_by_type(live)
         node_by_hex = {nid.hex(): node for nid, node in self._cluster.nodes.items()}
+        removed_this_sweep: set = set()
         for inst in live:
             node = node_by_hex.get(inst.provider_node_id or "")
             busy = False
@@ -255,7 +261,9 @@ class AutoscalerV2:
             if (
                 now - first_idle >= self.config.idle_timeout_s
                 and counts.get(inst.node_type, 0) > min_workers
+                and self._floor_allows_removal(inst, removed_this_sweep)
             ):
+                removed_this_sweep.add(inst.provider_node_id or "")
                 self.im.transition(inst.instance_id, STOPPING)
                 try:
                     self._provider.terminate_node(inst.provider_node_id)
@@ -264,6 +272,24 @@ class AutoscalerV2:
                 self.im.transition(inst.instance_id, TERMINATED)
                 self._idle_since.pop(inst.instance_id, None)
                 counts[inst.node_type] -= 1
+
+    def _floor_allows_removal(self, inst, removed_this_sweep: set = frozenset()) -> bool:
+        """False if terminating this instance would drop TOTAL capacity
+        below the request_resources floor. ``removed_this_sweep`` excludes
+        nodes already terminated in this reconcile that an async-death
+        provider hasn't marked dead yet."""
+        if not self._cluster.resource_requests():
+            return True
+        excluded = set(removed_this_sweep) | {inst.provider_node_id or ""}
+        remaining = []
+        for node_id, node in list(self._cluster.nodes.items()):
+            if node.dead or node_id.hex() in excluded:
+                continue
+            provider_id = (getattr(node, "labels", None) or {}).get("rt_provider_id")
+            if provider_id and provider_id in excluded:
+                continue
+            remaining.append(node.pool.total.to_dict())
+        return self._cluster.requests_fit(remaining)
 
     # -- introspection ------------------------------------------------------
     def cluster_status(self) -> dict:

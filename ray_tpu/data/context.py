@@ -21,6 +21,9 @@ class DataContext:
     use_push_based_shuffle: bool = True
     enable_progress_bars: bool = False
     shuffle_seed: Optional[int] = None
+    # release map outputs in dispatch order instead of completion order
+    # (parity: ExecutionOptions.preserve_order; costs head-of-line blocking)
+    preserve_order: bool = False
 
     _local = threading.local()
 

@@ -138,6 +138,37 @@ class PhysicalOperator:
         self.cpu_samples: List[float] = []
         self.row_samples: List[int] = []
         self.byte_samples: List[int] = []
+        # DataContext.preserve_order (reference ExecutionOptions.preserve_order):
+        # parallel map tasks finish out of order; when set, operators release
+        # outputs in DISPATCH order through _emit instead of completion order
+        from ray_tpu.data.context import DataContext
+
+        self._preserve_order = DataContext.get_current().preserve_order
+        self._seq_counter = 0
+        self._next_seq_out = 0
+        self._pending_ordered: Dict[int, RefBundle] = {}
+
+    def _next_seq(self) -> int:
+        seq = self._seq_counter
+        self._seq_counter += 1
+        return seq
+
+    def queued_output_count(self) -> int:
+        """Finished-but-unconsumed bundles: visible outqueue plus any
+        preserve_order hold-back (both are materialized memory)."""
+        return len(self.outqueue) + len(self._pending_ordered)
+
+    def _emit(self, seq: int, bundle: RefBundle) -> None:
+        """Release one task's output, reordering to dispatch order when
+        preserve_order is set (a missing seq can only be a still-active
+        task, so the hold-back always drains)."""
+        if not self._preserve_order:
+            self.outqueue.append(bundle)
+            return
+        self._pending_ordered[seq] = bundle
+        while self._next_seq_out in self._pending_ordered:
+            self.outqueue.append(self._pending_ordered.pop(self._next_seq_out))
+            self._next_seq_out += 1
 
     def record_task_meta(self, meta) -> None:
         """One finished task's BlockMetadata -> stats samples."""
@@ -236,16 +267,16 @@ class TaskPoolMapOperator(PhysicalOperator):
         waits = []
         for ref in bundle.refs:
             block_ref, meta_ref = self._map_task.remote(ref)
-            self._active[meta_ref] = block_ref
+            self._active[meta_ref] = (block_ref, self._next_seq())
             waits.append(meta_ref)
             self.num_tasks += 1
         return waits
 
     def on_task_done(self, meta_ref: Any) -> None:
-        block_ref = self._active.pop(meta_ref)
+        block_ref, seq = self._active.pop(meta_ref)
         meta = ray_tpu.get(meta_ref)
         self.record_task_meta(meta)
-        self.outqueue.append(RefBundle([block_ref], [meta]))
+        self._emit(seq, RefBundle([block_ref], [meta]))
 
 
 class ActorPoolMapOperator(PhysicalOperator):
@@ -289,17 +320,17 @@ class ActorPoolMapOperator(PhysicalOperator):
             idx = min(self._load, key=self._load.get)
             self._load[idx] += 1
             block_ref, meta_ref = self._actors[idx].run.options(num_returns=2).remote(ref)
-            self._active[meta_ref] = (block_ref, idx)
+            self._active[meta_ref] = (block_ref, idx, self._next_seq())
             waits.append(meta_ref)
             self.num_tasks += 1
         return waits
 
     def on_task_done(self, meta_ref: Any) -> None:
-        block_ref, idx = self._active.pop(meta_ref)
+        block_ref, idx, seq = self._active.pop(meta_ref)
         self._load[idx] -= 1
         meta = ray_tpu.get(meta_ref)
         self.record_task_meta(meta)
-        self.outqueue.append(RefBundle([block_ref], [meta]))
+        self._emit(seq, RefBundle([block_ref], [meta]))
 
     def shutdown(self) -> None:
         for a in self._actors:
@@ -472,18 +503,23 @@ class ReadOperator(PhysicalOperator):
     def dispatch(self) -> List[Any]:
         task = self._pending.popleft()
         block_ref, meta_ref = self._do_read.remote(task)
-        self._active[meta_ref] = block_ref
+        self._active[meta_ref] = (block_ref, self._next_seq())
         self.num_tasks += 1
         return [meta_ref]
 
     def on_task_done(self, meta_ref: Any) -> None:
-        block_ref = self._active.pop(meta_ref)
+        block_ref, seq = self._active.pop(meta_ref)
         meta = ray_tpu.get(meta_ref)
         self.record_task_meta(meta)
-        self.outqueue.append(RefBundle([block_ref], [meta]))
+        self._emit(seq, RefBundle([block_ref], [meta]))
 
     def completed(self) -> bool:
-        return not self._pending and not self._active and not self.outqueue
+        return (
+            not self._pending
+            and not self._active
+            and not self.outqueue
+            and not self._pending_ordered
+        )
 
 
 class WriteOperator(PhysicalOperator):
@@ -592,9 +628,12 @@ class StreamingExecutor:
             return False
         # Prefer the op with the least queued output (backpressure), with
         # downstream position as tie-break so data drains toward the sink.
-        op = min(runnable, key=lambda o: (len(o.outqueue), -self.topology.index(o)))
+        op = min(runnable, key=lambda o: (o.queued_output_count(), -self.topology.index(o)))
         # Output backpressure: don't let any op run far ahead of its consumer.
-        if len(op.outqueue) > self.ctx.max_outqueue_bundles and op is not self.root:
+        # queued_output_count includes the preserve_order hold-back buffer —
+        # blocks parked behind a slow head-of-line task are finished memory
+        # and must throttle dispatch exactly like visible outqueue bundles.
+        if op.queued_output_count() > self.ctx.max_outqueue_bundles and op is not self.root:
             return False
         for ref in op.dispatch():
             self._waits[ref] = op

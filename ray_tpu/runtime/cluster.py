@@ -199,6 +199,7 @@ class Cluster:
         # load the GCS reports to the monitor process,
         # python/ray/autoscaler/_private/monitor.py): spec id -> resource dict.
         self._infeasible_demands: Dict[int, Dict[str, float]] = {}
+        self._resource_requests: List[Dict[str, float]] = []
         self._demand_lock = threading.Lock()
         # ONE demand queue + ONE drainer thread for all currently-infeasible
         # work (tasks and actor creations).  The reference keeps these in
@@ -675,6 +676,65 @@ class Cluster:
         if node is None or node.dead:
             return
         node.cancel_task(spec, force=force)
+
+    def request_resources(self, bundles: List[Dict[str, float]]) -> None:
+        """Set the explicit capacity floor (parity:
+        ``ray.autoscaler.sdk.request_resources``, commands.py). Replace
+        semantics: each call overwrites the previous request; an empty list
+        clears it. Floor semantics match the reference: bundles are
+        satisfied by TOTAL cluster capacity (busy or free) — the autoscaler
+        launches only the unmet residual and refuses idle scale-down that
+        would drop the cluster below the floor."""
+        with self._demand_lock:
+            self._resource_requests = [dict(b) for b in bundles]
+
+    def resource_requests(self) -> List[Dict[str, float]]:
+        with self._demand_lock:
+            return [dict(b) for b in self._resource_requests]
+
+    @staticmethod
+    def _pack_residual(
+        bundles: List[Dict[str, float]], capacities: List[Dict[str, float]]
+    ) -> List[Dict[str, float]]:
+        """First-fit-decreasing of bundles into capacities; -> what didn't fit."""
+        caps = [dict(c) for c in capacities]
+        residual: List[Dict[str, float]] = []
+        for b in sorted(bundles, key=lambda d: -sum(d.values())):
+            for cap in caps:
+                if all(cap.get(k, 0.0) >= v for k, v in b.items() if v > 0):
+                    for k, v in b.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    break
+            else:
+                residual.append(dict(b))
+        return residual
+
+    def _alive_capacities(self) -> List[Dict[str, float]]:
+        return [
+            node.pool.total.to_dict()
+            for node in list(self.nodes.values())
+            if not node.dead
+        ]
+
+    def unmet_resource_requests(
+        self, extra_capacities: Optional[List[Dict[str, float]]] = None
+    ) -> List[Dict[str, float]]:
+        """The part of the request_resources floor the cluster's TOTAL
+        capacity cannot hold — the shapes the autoscaler must launch for.
+        ``extra_capacities`` credits nodes already launched but not yet
+        registered (booting), so the caller doesn't re-launch for the same
+        residual every tick."""
+        reqs = self.resource_requests()
+        if not reqs:
+            return []
+        return self._pack_residual(
+            reqs, self._alive_capacities() + list(extra_capacities or [])
+        )
+
+    def requests_fit(self, capacities: List[Dict[str, float]]) -> bool:
+        """Would the floor still fit into these node capacities? (The
+        autoscaler's pre-termination check.)"""
+        return not self._pack_residual(self.resource_requests(), capacities)
 
     def pending_resource_demands(self) -> List[Dict[str, float]]:
         """Resource shapes of currently-unschedulable work, for the

@@ -378,3 +378,115 @@ def test_v2_idle_scale_down(ray_start_cluster):
             break
         time.sleep(0.05)
     assert asv2.im.instances({TERMINATED})
+
+
+def test_request_resources_floor_and_clear(ray_start_cluster):
+    """sdk.request_resources scales the cluster up with NO pending tasks,
+    holds idle nodes at the floor, and releases them when cleared (parity:
+    ray.autoscaler.sdk.request_resources replace semantics)."""
+    from ray_tpu.autoscaler import sdk
+
+    rt, cluster = ray_start_cluster  # head has 2 CPU
+    provider = InProcessNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig("w", {"CPU": 4})},
+        idle_timeout_s=0.3,
+        update_interval_s=0.05,
+    )
+    monitor = Monitor(cluster, config, provider=provider).start()
+    try:
+        # floor: 6 one-CPU bundles; head covers 2, so >=1 worker must launch
+        sdk.request_resources(num_cpus=6)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(provider.non_terminated_nodes()) >= 1:
+                break
+            time.sleep(0.05)
+        assert len(provider.non_terminated_nodes()) >= 1
+        # the floor pins the idle worker well past idle_timeout_s
+        time.sleep(1.0)
+        assert len(provider.non_terminated_nodes()) >= 1
+        # exact-shape bundles work too and REPLACE the old request
+        sdk.request_resources(bundles=[{"CPU": 2.0}])
+        assert cluster.resource_requests() == [{"CPU": 2.0}]
+        # the floor compares against TOTAL capacity, so an already-large
+        # cluster has no unmet residual (no over-provisioning)
+        assert cluster.unmet_resource_requests() == []
+        # clearing the floor lets idle scale-down reclaim the node
+        sdk.request_resources()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.1)
+        assert not provider.non_terminated_nodes()
+    finally:
+        monitor.stop()
+
+
+def test_request_resources_satisfied_by_busy_capacity(ray_start_cluster):
+    """A floor the cluster's TOTAL capacity already holds launches nothing,
+    even when that capacity is fully occupied by running tasks (reference
+    semantics: request_resources is a floor, not extra demand)."""
+    from ray_tpu.autoscaler import sdk
+
+    rt, cluster = ray_start_cluster  # head has 2 CPU
+
+    @rt.remote(num_cpus=1)
+    def hog(sec):
+        time.sleep(sec)
+        return 1
+
+    refs = [hog.remote(2.0) for _ in range(2)]  # occupy both CPUs
+    sdk.request_resources(num_cpus=2)
+    try:
+        assert cluster.unmet_resource_requests() == []
+        provider = InProcessNodeProvider(cluster)
+        config = AutoscalerConfig(
+            node_types={"w": NodeTypeConfig("w", {"CPU": 4})},
+            idle_timeout_s=3600,
+            update_interval_s=3600,  # drive updates by hand
+        )
+        from ray_tpu.autoscaler import StandardAutoscaler
+
+        scaler = StandardAutoscaler(cluster, provider, config)
+        scaler.update()
+        assert scaler.num_launches == 0 and not provider.non_terminated_nodes()
+        assert rt.get(refs, timeout=30) == [1, 1]
+    finally:
+        sdk.request_resources()
+
+
+def test_request_resources_floor_releases_extra_idle_nodes(ray_start_cluster):
+    """A small floor pins only the capacity it needs: extra idle workers
+    still scale down (the floor is bin-packed, not every-node-retained)."""
+    from ray_tpu.autoscaler import sdk
+
+    rt, cluster = ray_start_cluster  # head: 2 CPU
+    provider = InProcessNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig("w", {"CPU": 4})},
+        idle_timeout_s=0.2,
+        update_interval_s=3600,
+    )
+    from ray_tpu.autoscaler import StandardAutoscaler
+
+    scaler = StandardAutoscaler(cluster, provider, config)
+    # hand-provision two idle workers
+    provider.create_nodes(config.node_types["w"], 2)
+    # floor: one 4-CPU bundle -> exactly one worker must survive
+    sdk.request_resources(bundles=[{"CPU": 4.0}])
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            scaler.update()
+            if len(provider.non_terminated_nodes()) == 1:
+                break
+            time.sleep(0.1)
+        assert len(provider.non_terminated_nodes()) == 1
+        # ... and it stays: the floor blocks the last one
+        time.sleep(0.5)
+        scaler.update()
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        sdk.request_resources()

@@ -372,3 +372,29 @@ def test_read_text_crlf_newlines(tmp_path):
     p.write_bytes(b"alpha\r\nbeta\rgamma\n")
     rows = [r["text"] for r in rd.read_text(str(p)).take_all()]
     assert rows == ["alpha", "beta", "gamma"]
+
+
+def test_preserve_order_reorders_skewed_completions():
+    """DataContext.preserve_order (parity: ExecutionOptions.preserve_order):
+    a slow first block must not be overtaken in the output stream; with the
+    flag off, completion order is allowed (and expected here)."""
+    import time
+
+    def slow_first(b):
+        if int(np.asarray(b["id"])[0]) == 0:
+            time.sleep(0.4)
+        return b
+
+    ctx = rd.DataContext.get_current()
+    ds = rd.range(4, parallelism=4)
+    ctx.preserve_order = True
+    try:
+        rows = [r["id"] for r in ds.map_batches(slow_first, batch_format="numpy").take_all()]
+        assert rows == [0, 1, 2, 3]
+    finally:
+        ctx.preserve_order = False
+    # default: completion order is allowed — all rows arrive, any order
+    # (asserting the slow block lands last would flake when a contended
+    # box serializes the tasks)
+    rows = [r["id"] for r in ds.map_batches(slow_first, batch_format="numpy").take_all()]
+    assert sorted(rows) == [0, 1, 2, 3]

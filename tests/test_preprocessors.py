@@ -1,0 +1,172 @@
+"""Dataset preprocessors (parity: python/ray/data/preprocessors/ — fit on a
+Dataset, transform Datasets AND in-memory batches identically)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+import ray_tpu.data as data
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    CountVectorizer,
+    FeatureHasher,
+    KBinsDiscretizer,
+    LabelEncoder,
+    MinMaxScaler,
+    Normalizer,
+    OneHotEncoder,
+    OrdinalEncoder,
+    Preprocessor,
+    PreprocessorNotFittedError,
+    SimpleImputer,
+    StandardScaler,
+)
+
+
+@pytest.fixture
+def runtime():
+    rt.init(num_cpus=2)
+    # row-order assertions below need deterministic block order
+    ctx = data.DataContext.get_current()
+    ctx.preserve_order = True
+    try:
+        yield rt
+    finally:
+        ctx.preserve_order = False
+        rt.shutdown()
+
+
+def _rows(ds):
+    return ds.take_all()
+
+
+def test_standard_scaler_fit_and_batch_parity(runtime):
+    ds = data.from_items([{"x": float(i), "y": float(2 * i)} for i in range(10)])
+    scaler = StandardScaler(["x"])
+    out = _rows(scaler.fit_transform(ds))
+    xs = np.array([r["x"] for r in out])
+    assert abs(xs.mean()) < 1e-9 and abs(xs.std() - 1.0) < 1e-9
+    # y untouched
+    assert [r["y"] for r in out] == [float(2 * i) for i in range(10)]
+    # the SAME fitted object transforms serving-time batches identically
+    b = scaler.transform_batch({"x": np.array([4.5]), "y": np.array([0.0])})
+    assert abs(b["x"][0]) < 1e-9  # 4.5 is the fitted mean
+    assert scaler.stats_["mean(x)"] == 4.5
+
+
+def test_unfitted_raises(runtime):
+    with pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(["x"]).transform_batch({"x": np.array([1.0])})
+
+
+def test_minmax_and_discretizer(runtime):
+    ds = data.from_items([{"x": float(i)} for i in range(11)])
+    mm = MinMaxScaler(["x"]).fit(ds)
+    out = _rows(mm.transform(ds))
+    assert out[0]["x"] == 0.0 and out[-1]["x"] == 1.0
+
+    kb = KBinsDiscretizer(["x"], bins=5, strategy="uniform").fit(ds)
+    bins = [r["x"] for r in _rows(kb.transform(ds))]
+    assert min(bins) == 0 and max(bins) == 4 and bins == sorted(bins)
+
+    kq = KBinsDiscretizer(["x"], bins=2, strategy="quantile").fit(ds)
+    bins = [r["x"] for r in _rows(kq.transform(ds))]
+    assert bins.count(0) in (5, 6) and bins.count(1) in (5, 6)
+
+
+def test_encoders(runtime):
+    ds = data.from_items([{"c": v} for v in ["b", "a", "c", "a"]])
+    enc = OrdinalEncoder(["c"]).fit(ds)
+    assert [r["c"] for r in _rows(enc.transform(ds))] == [1, 0, 2, 0]
+    # unseen at serving time -> -1
+    assert enc.transform_batch({"c": np.array(["zz"])})["c"][0] == -1
+
+    oh = OneHotEncoder(["c"]).fit(ds)
+    mats = np.stack([r["c"] for r in _rows(oh.transform(ds))])
+    assert mats.shape == (4, 3)
+    assert mats.sum() == 4 and (mats[1] == [1, 0, 0]).all()
+    assert oh.transform_batch({"c": np.array(["zz"])})["c"].sum() == 0
+
+    le = LabelEncoder("c")
+    assert [r["c"] for r in _rows(le.fit_transform(ds))] == [1, 0, 2, 0]
+
+
+def test_imputer_strategies(runtime):
+    ds = data.from_items([{"x": 1.0}, {"x": float("nan")}, {"x": 3.0}])
+    mean_imp = SimpleImputer(["x"], strategy="mean").fit(ds)
+    assert [r["x"] for r in _rows(mean_imp.transform(ds))] == [1.0, 2.0, 3.0]
+
+    const = SimpleImputer(["x"], strategy="constant", fill_value=9.0)
+    # constant needs no fit
+    assert const.transform_batch({"x": np.array([np.nan])})["x"][0] == 9.0
+
+    dsm = data.from_items([{"c": "a"}, {"c": "b"}, {"c": "a"}, {"c": None}])
+    mf = SimpleImputer(["c"], strategy="most_frequent").fit(dsm)
+    assert [r["c"] for r in _rows(mf.transform(dsm))] == ["a", "b", "a", "a"]
+
+    # an all-missing column fails with a clear error, not an IndexError
+    ds_empty = data.from_items([{"c": None}, {"c": None}])
+    with pytest.raises(ValueError, match="no non-missing values"):
+        SimpleImputer(["c"], strategy="most_frequent").fit(ds_empty)
+
+
+def test_normalizer_concatenator_chain(runtime):
+    ds = data.from_items([{"a": 3.0, "b": 4.0, "keep": 7}])
+    norm = Normalizer(["a", "b"], norm="l2")
+    row = _rows(norm.transform(ds))[0]
+    assert abs(row["a"] - 0.6) < 1e-9 and abs(row["b"] - 0.8) < 1e-9
+
+    cat = Concatenator(["a", "b"], output_column_name="vec")
+    row = _rows(cat.transform(ds))[0]
+    assert list(row["vec"]) == [3.0, 4.0] and row["keep"] == 7 and "a" not in row
+
+    # chain: scale then concatenate; fit flows through stage outputs
+    ds2 = data.from_items([{"a": float(i), "b": float(i)} for i in range(4)])
+    chain = Chain(MinMaxScaler(["a", "b"]), Concatenator(["a", "b"], "vec"))
+    rows = _rows(chain.fit_transform(ds2))
+    assert list(rows[-1]["vec"]) == [1.0, 1.0]
+    b = chain.transform_batch({"a": np.array([0.0]), "b": np.array([3.0])})
+    assert list(b["vec"][0]) == [0.0, 1.0]
+
+
+def test_batch_mapper(runtime):
+    ds = data.from_items([{"x": 2}])
+    bm = BatchMapper(lambda b: {"x": np.asarray(b["x"]) * 10})
+    assert _rows(bm.transform(ds))[0]["x"] == 20
+
+
+def test_text_pipeline(runtime):
+    ds = data.from_items(
+        [{"t": "the cat sat"}, {"t": "the dog sat down"}]
+    )
+    cv = CountVectorizer(["t"]).fit(ds)
+    rows = _rows(cv.transform(ds))
+    vocab = cv.stats_["token_counts(t)"]
+    assert set(vocab) == {"the", "cat", "sat", "dog", "down"}
+    assert rows[0]["t"][vocab["cat"]] == 1.0 and rows[0]["t"][vocab["dog"]] == 0.0
+
+    # max_features keeps the most frequent tokens only
+    cv2 = CountVectorizer(["t"], max_features=2).fit(ds)
+    assert set(cv2.stats_["token_counts(t)"]) == {"the", "sat"}
+
+    fh = FeatureHasher(["t"], num_features=32)
+    vec = fh.transform_batch({"t": np.array(["cat cat dog"])})["t"]
+    assert vec.shape == (1, 32) and vec.sum() == 3.0
+    # deterministic across calls (md5, not PYTHONHASHSEED)
+    assert (vec == fh.transform_batch({"t": np.array(["cat cat dog"])})["t"]).all()
+
+
+def test_tokenizer_cells_stay_lists_even_when_uniform(runtime):
+    # all rows tokenize to the same length: the column must remain a 1-D
+    # object array of LISTS, not silently become a 2-D token matrix
+    ds = data.from_items([{"t": "a b"}, {"t": "c d"}])
+    from ray_tpu.data.preprocessors import Tokenizer
+
+    tk = Tokenizer(["t"])
+    b = tk.transform_batch({"t": np.array(["a b", "c d"])})
+    assert b["t"].ndim == 1 and b["t"].dtype == object
+    assert b["t"][0] == ["a", "b"] and b["t"][1] == ["c", "d"]
+    rows = tk.transform(ds).take_all()
+    assert rows[0]["t"] == ["a", "b"]
