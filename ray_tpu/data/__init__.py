@@ -56,11 +56,35 @@ from ray_tpu.data.read_api import (
 )
 from ray_tpu.data.llm_inference import LLMPredictor, clear_engine_cache
 from ray_tpu.data import preprocessors
+from ray_tpu.data.compute import ActorPoolStrategy, NodeIdStr, Schema, set_progress_bars
+from ray_tpu.data.context import ExecutionOptions, ExecutionResources
+from ray_tpu.data.datasource import (
+    BlockBasedFileDatasink,
+    Datasink,
+    RowBasedFileDatasink,
+)
+from ray_tpu.data.iterator import DataIterator as DatasetIterator
+from ray_tpu.data.preprocessors import Preprocessor
+
+# legacy alias (the reference kept DatasetContext as a deprecated name)
+DatasetContext = DataContext
 
 __all__ = [
     "AggregateFn",
     "LLMPredictor",
     "preprocessors",
+    "ActorPoolStrategy",
+    "NodeIdStr",
+    "Schema",
+    "set_progress_bars",
+    "ExecutionOptions",
+    "ExecutionResources",
+    "Datasink",
+    "BlockBasedFileDatasink",
+    "RowBasedFileDatasink",
+    "DatasetIterator",
+    "DatasetContext",
+    "Preprocessor",
     "clear_engine_cache",
     "Block",
     "BlockAccessor",

@@ -181,13 +181,16 @@ class Dataset:
         for row in self.take(limit):
             print(row)
 
-    def schema(self) -> Optional[Dict[str, Any]]:
+    def schema(self) -> Optional["Schema"]:
+        from ray_tpu.data.compute import Schema
+
         for bundle in self.limit(1)._execute():
             for ref, meta in zip(bundle.refs, bundle.metadata):
                 if meta.schema:
-                    return meta.schema
+                    return Schema(meta.schema)
                 block = ray_tpu.get(ref)
-                return BlockAccessor(block).schema()
+                s = BlockAccessor(block).schema()
+                return Schema(s) if s is not None else None
         return None
 
     def columns(self) -> Optional[List[str]]:

@@ -59,6 +59,68 @@ class Datasource:
 
 
 # --------------------------------------------------------------------------
+# Write-side bases (parity: the 2.9+ Datasink split — datasink.py,
+# _internal/datasource/*_datasink.py). Dataset.write_datasink accepts any of
+# these; the file sinks write one part file per block through an open
+# binary stream, so subclasses only format rows/blocks.
+# --------------------------------------------------------------------------
+class Datasink:
+    """Write-connector base (parity: ray.data.Datasink)."""
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasink", "")
+
+    def on_write_start(self) -> None:
+        pass
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        raise NotImplementedError
+
+    def on_write_complete(self) -> None:
+        pass
+
+
+class _FileDatasink(Datasink):
+    def __init__(self, file_extension: str = "out"):
+        self.file_extension = file_extension
+
+    def write(self, blocks: List[Block], path: str, **kwargs) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.on_write_start()
+        for i, block in enumerate(blocks):
+            fname = os.path.join(path, f"part-{i:05d}.{self.file_extension}")
+            with open(fname, "wb") as f:
+                self._write_one(block, f)
+        self.on_write_complete()
+
+    def _write_one(self, block: Block, file) -> None:
+        raise NotImplementedError
+
+
+class BlockBasedFileDatasink(_FileDatasink):
+    """Subclass and implement ``write_block_to_file(block, file)``
+    (parity: ray.data.BlockBasedFileDatasink)."""
+
+    def _write_one(self, block: Block, file) -> None:
+        self.write_block_to_file(block, file)
+
+    def write_block_to_file(self, block: Block, file) -> None:
+        raise NotImplementedError
+
+
+class RowBasedFileDatasink(_FileDatasink):
+    """Subclass and implement ``write_row_to_file(row, file)`` — called once
+    per row of each block (parity: ray.data.RowBasedFileDatasink)."""
+
+    def _write_one(self, block: Block, file) -> None:
+        for row in BlockAccessor(block).iter_rows():
+            self.write_row_to_file(row, file)
+
+    def write_row_to_file(self, row: dict, file) -> None:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
 # In-memory sources
 # --------------------------------------------------------------------------
 class RangeDatasource(Datasource):

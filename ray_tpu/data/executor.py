@@ -158,6 +158,11 @@ class PhysicalOperator:
         preserve_order hold-back (both are materialized memory)."""
         return len(self.outqueue) + len(self._pending_ordered)
 
+    def queued_output_bytes(self) -> int:
+        return sum(b.size_bytes() for b in self.outqueue) + sum(
+            b.size_bytes() for b in self._pending_ordered.values()
+        )
+
     def _emit(self, seq: int, bundle: RefBundle) -> None:
         """Release one task's output, reordering to dispatch order when
         preserve_order is set (a missing seq can only be a still-active
@@ -243,7 +248,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         super().__init__(name, [input_op])
         self.stages = stages
         self.max_concurrency = max_concurrency
-        self._active: Dict[Any, None] = {}
+        self._active: Dict[Any, Tuple[Any, int]] = {}  # meta_ref -> (block_ref, seq)
         stages_ser = list(stages)
         udfs = [s.fn for s in stages]
         resources = {"CPU": max(s.num_cpus for s in stages)}
@@ -304,7 +309,7 @@ class ActorPoolMapOperator(PhysicalOperator):
 
         self._actors = [_MapWorker.remote() for _ in range(pool_size)]
         self._load = {i: 0 for i in range(pool_size)}
-        self._active: Dict[Any, Tuple[Any, int]] = {}
+        self._active: Dict[Any, Tuple[Any, int, int]] = {}  # (block_ref, actor, seq)
         self.max_tasks_per_actor = 2
 
     def num_active_tasks(self) -> int:
@@ -479,7 +484,7 @@ class ReadOperator(PhysicalOperator):
         super().__init__("Read", [])
         self.inputs_done = [True]
         self._pending = deque(read_tasks)
-        self._active: Dict[Any, None] = {}
+        self._active: Dict[Any, Tuple[Any, int]] = {}  # meta_ref -> (block_ref, seq)
         self.max_concurrency = max_concurrency
 
         @ray_tpu.remote
@@ -559,10 +564,21 @@ def plan(op: L.LogicalOp, ctx) -> PhysicalOperator:
     if isinstance(op, (L.FusedMap, L.AbstractMap)):
         upstream = plan(op.inputs[0], ctx)
         stages = op.stages if isinstance(op, L.FusedMap) else [op]
-        if any(isinstance(s.fn, type) for s in stages):
+        # compute=ActorPoolStrategy forces the actor pool even for plain
+        # function UDFs (parity: ActorPoolStrategy on map_batches); class
+        # UDFs always need it (stateful constructors)
+        strategy = next(
+            (s.compute for s in stages if getattr(s, "compute", None) is not None), None
+        )
+        if any(isinstance(s.fn, type) for s in stages) or strategy is not None:
+            strategy_size = getattr(strategy, "size", None) or getattr(
+                strategy, "min_size", None
+            )
             conc = op.concurrency
-            pool = conc if isinstance(conc, int) else (conc[0] if conc else 2)
-            return ActorPoolMapOperator(stages, upstream, pool_size=pool or 2)
+            pool = conc if isinstance(conc, int) else (conc[0] if conc else None)
+            return ActorPoolMapOperator(
+                stages, upstream, pool_size=pool or strategy_size or 2
+            )
         return TaskPoolMapOperator(stages, upstream, max_concurrency=ctx.max_tasks_in_flight)
     if isinstance(op, L.Limit):
         return LimitOperator(op.limit, plan(op.inputs[0], ctx))
@@ -623,6 +639,18 @@ class StreamingExecutor:
                             consumer.inputs_done[idx] = True
 
     def _select_and_dispatch(self) -> bool:
+        # ExecutionOptions.resource_limits: cap in-flight task count (cpu)
+        # and finished-but-unconsumed bytes (object_store_memory) across the
+        # whole topology before considering any further dispatch
+        limits = self.ctx.execution_options.resource_limits
+        if limits.cpu is not None and sum(
+            o.num_active_tasks() for o in self.topology
+        ) >= limits.cpu:
+            return False
+        if limits.object_store_memory is not None and sum(
+            o.queued_output_bytes() for o in self.topology
+        ) >= limits.object_store_memory:
+            return False
         runnable = [op for op in self.topology if op.can_dispatch()]
         if not runnable:
             return False

@@ -335,10 +335,12 @@ class Chain(Preprocessor):
     def _fit(self, dataset) -> None:
         for i, p in enumerate(self.preprocessors):
             p.fit(dataset)
-            if i < len(self.preprocessors) - 1:
-                # materialize between stages: otherwise stage i's fit lazily
-                # re-executes the base read plus stages 0..i-1 from scratch
-                # (O(k^2) passes over the data for k fittable stages)
+            if any(q._is_fittable for q in self.preprocessors[i + 1 :]):
+                # materialize between stages: otherwise the next FIT lazily
+                # re-executes the base read plus stages 0..i from scratch
+                # (O(k^2) passes for k fittable stages). Skipped when no
+                # later stage fits — transform-only tails don't need the
+                # intermediate, and materializing it could dwarf the fit.
                 dataset = p.transform(dataset).materialize()
 
     def transform(self, dataset):

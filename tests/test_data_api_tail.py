@@ -191,3 +191,65 @@ def test_gated_interop_raises_actionably():
         rd.from_dask(None)
     with pytest.raises(ImportError):
         rd.read_avro(["f.avro"])
+
+
+def test_compat_surface_actor_pool_sinks_schema(tmp_path):
+    """2.9-era surface: ActorPoolStrategy on function UDFs, Datasink file
+    bases, Schema accessors, ExecutionOptions view, DatasetContext alias."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+
+    # ActorPoolStrategy routes a FUNCTION udf through the actor pool
+    ds = rd.range(8, parallelism=4).map_batches(
+        lambda b: {"id": np.asarray(b["id"]) * 2},
+        batch_format="numpy",
+        compute=rd.ActorPoolStrategy(size=2),
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+    # row-based sink writes one part per block through the user hook
+    class JsonlSink(rd.RowBasedFileDatasink):
+        def __init__(self):
+            super().__init__(file_extension="jsonl")
+
+        def write_row_to_file(self, row, file):
+            import json
+
+            file.write((json.dumps({k: int(v) for k, v in row.items()}) + "\n").encode())
+
+    out = tmp_path / "sink"
+    rd.range(4, parallelism=2).write_datasink(JsonlSink(), path=str(out))
+    import json
+
+    rows = []
+    for p in sorted(out.glob("part-*.jsonl")):
+        rows += [json.loads(line) for line in p.read_text().splitlines()]
+    assert sorted(r["id"] for r in rows) == [0, 1, 2, 3]
+
+    # Schema: dict-compatible with names/types accessors
+    schema = rd.range(3).schema()
+    assert isinstance(schema, rd.Schema)
+    assert schema.names == ["id"] and list(schema) == ["id"]
+
+    # ExecutionOptions is a live view over DataContext.preserve_order
+    ctx = rd.DataContext.get_current()
+    assert rd.DatasetContext is rd.DataContext
+    ctx.execution_options.preserve_order = True
+    try:
+        assert ctx.preserve_order is True
+    finally:
+        ctx.preserve_order = False
+    assert ctx.execution_options.preserve_order is False
+
+    # resource limits throttle dispatch but execution still completes
+    ctx.execution_options.resource_limits = rd.ExecutionResources(
+        cpu=1, object_store_memory=64 * 1024 * 1024
+    )
+    try:
+        rows = rd.range(8, parallelism=4).map_batches(
+            lambda b: b, batch_format="numpy"
+        ).take_all()
+        assert sorted(r["id"] for r in rows) == list(range(8))
+    finally:
+        ctx.execution_options.resource_limits = rd.ExecutionResources()
