@@ -14,12 +14,22 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.trainer import Result
+from ray_tpu.air.types import (
+    AcquiredResources,
+    DataBatchType,
+    DatasetConfig,
+    ResourceRequest,
+)
 
 __all__ = [
+    "AcquiredResources",
     "Checkpoint",
     "CheckpointConfig",
+    "DataBatchType",
+    "DatasetConfig",
     "FailureConfig",
     "Result",
+    "ResourceRequest",
     "RunConfig",
     "ScalingConfig",
 ]

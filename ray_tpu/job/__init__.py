@@ -7,6 +7,15 @@ end is ``rt job submit/status/logs/stop/list``.
 """
 
 from ray_tpu.job.manager import JobManager, JobStatus
+from ray_tpu.job.models import DriverInfo, JobDetails, JobInfo, JobType
 from ray_tpu.job.sdk import JobSubmissionClient
 
-__all__ = ["JobManager", "JobStatus", "JobSubmissionClient"]
+__all__ = [
+    "JobManager",
+    "JobStatus",
+    "JobSubmissionClient",
+    "JobInfo",
+    "JobDetails",
+    "JobType",
+    "DriverInfo",
+]

@@ -1,0 +1,15 @@
+"""``ray_tpu.job_submission`` — the reference's import path for the job SDK
+(``python/ray/job_submission/__init__.py``). Canonical home: ``ray_tpu.job``."""
+
+from ray_tpu.job.manager import JobStatus
+from ray_tpu.job.models import DriverInfo, JobDetails, JobInfo, JobType
+from ray_tpu.job.sdk import JobSubmissionClient
+
+__all__ = [
+    "JobSubmissionClient",
+    "JobStatus",
+    "JobInfo",
+    "JobDetails",
+    "JobType",
+    "DriverInfo",
+]

@@ -14,12 +14,16 @@ from ray_tpu.runtime_env.plugin import (
     register_plugin,
     validate_runtime_env,
 )
+from ray_tpu.runtime_env.runtime_env import RuntimeEnv, RuntimeEnvConfig, mpi_init
 from ray_tpu.runtime_env.uri_cache import URICache
 
 __all__ = [
+    "RuntimeEnv",
+    "RuntimeEnvConfig",
     "RuntimeEnvPlugin",
     "apply_to_process_env",
     "get_plugin",
+    "mpi_init",
     "register_plugin",
     "validate_runtime_env",
     "URICache",

@@ -440,8 +440,15 @@ for _p in (
     register_plugin(_p)
 
 
+# meta keys that configure env setup itself rather than naming a plugin
+# (parity: runtime_env["config"] = RuntimeEnvConfig — runtime_env.py)
+_META_KEYS = frozenset({"config"})
+
+
 def validate_runtime_env(runtime_env: dict) -> None:
     for key, value in runtime_env.items():
+        if key in _META_KEYS:
+            continue
         plugin = _plugins.get(key)
         if plugin is None:
             raise ValueError(f"unknown runtime_env field {key!r}; known: {sorted(_plugins)}")
@@ -463,7 +470,8 @@ def apply_to_process_env(
     """
     validate_runtime_env(runtime_env)
     for plugin in sorted(
-        (_plugins[k] for k in runtime_env), key=lambda p: p.priority
+        (_plugins[k] for k in runtime_env if k not in _META_KEYS),
+        key=lambda p: p.priority,
     ):
         env, cwd = plugin.modify_context(runtime_env[plugin.name], env, cwd, uris_out)
     return env, cwd

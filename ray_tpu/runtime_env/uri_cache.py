@@ -88,6 +88,19 @@ class URICache:
         with self._lock:
             return self._entries.get(uri)
 
+    def describe(self):
+        """Cache rows for the state API: uri, local path, refs, bytes."""
+        with self._lock:
+            return [
+                {
+                    "uri": uri,
+                    "path": path,
+                    "ref_count": self._refs.get(uri, 0),
+                    "size_bytes": self._sizes.get(uri, 0),
+                }
+                for uri, path in self._entries.items()
+            ]
+
     def total_size(self) -> int:
         with self._lock:
             return sum(self._sizes.values())

@@ -206,3 +206,150 @@ def summarize_objects() -> Dict[str, Any]:
         t["count"] += 1
         t["bytes"] += r["size_bytes"] or 0
     return {"summary": {k: dict(v) for k, v in by_tier.items()}, "total_objects": len(rows)}
+
+
+# ---------------------------------------------------------------------------
+# Singular accessors + the listing tail (parity: ray.util.state get_*/list_*
+# in python/ray/util/state/api.py and its StateApiClient)
+# ---------------------------------------------------------------------------
+def _first(rows: List[dict], key: str, value: str) -> Optional[dict]:
+    for r in rows:
+        if r.get(key) == value or str(r.get(key, "")).startswith(value):
+            return r
+    return None
+
+
+def get_node(node_id: str) -> Optional[dict]:
+    return _first(list_nodes(limit=100_000), "node_id", node_id)
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    return _first(list_actors(limit=100_000), "actor_id", actor_id)
+
+
+def get_task(task_id: str) -> Optional[dict]:
+    return _first(list_tasks(limit=100_000), "task_id", task_id)
+
+
+def get_objects(object_id: str) -> List[dict]:
+    """All state rows for one object id (an object can live on several
+    nodes; parity: get_objects returns a list)."""
+    return [
+        r
+        for r in list_objects(limit=1_000_000)
+        if r.get("object_id", "").startswith(object_id)
+    ]
+
+
+def get_placement_group(placement_group_id: str) -> Optional[dict]:
+    return _first(
+        list_placement_groups(limit=100_000), "placement_group_id", placement_group_id
+    )
+
+
+def get_job(job_id: str) -> Optional[dict]:
+    rows = list_jobs(limit=100_000)
+    return _first(rows, "job_id", job_id) or _first(rows, "submission_id", job_id)
+
+
+def list_workers(filters: Optional[List[tuple]] = None, limit: int = 1000) -> List[dict]:
+    """Pool workers across in-process nodes. Keys: worker_id (pid-derived),
+    node_id, pid, is_alive, dedicated."""
+    cluster = _cluster()
+    rows: List[dict] = []
+    for node_id, node in list(cluster.nodes.items()):
+        pool = getattr(node, "worker_pool", None)
+        if pool is None:
+            continue
+        with pool._lock:
+            handles = list(pool._all.values())
+        for h in handles:
+            rows.append(
+                {
+                    "worker_id": f"worker-{h.pid}",
+                    "node_id": node_id.hex(),
+                    "pid": h.pid,
+                    "is_alive": h.alive,
+                    "dedicated": h.dedicated,
+                }
+            )
+    return _limited(rows, limit, filters)
+
+
+def get_worker(worker_id: str) -> Optional[dict]:
+    return _first(list_workers(limit=100_000), "worker_id", worker_id)
+
+
+def list_runtime_envs(limit: int = 1000) -> List[dict]:
+    """Cached runtime-env URIs with reference counts (parity:
+    list_runtime_envs over the agent's cached envs)."""
+    from ray_tpu.runtime_env.plugin import _cache
+
+    return _cache.describe()[:limit]
+
+
+def list_logs(node_id: Optional[str] = None) -> Dict[str, List[str]]:
+    """Log sources per node (parity: list_logs — here one worker-log
+    stream per remote node, captured by the head's NodeLogStore)."""
+    cluster = _cluster()
+    store = cluster.node_logs
+    known = list(store.nodes())
+    nodes = [n for n in known if n.startswith(node_id)] if node_id else known
+    return {n: ["worker_out"] for n in nodes}
+
+
+def get_log(node_id: str, *, lines: int = 100) -> List[str]:
+    """Tail one node's captured worker logs (parity: get_log)."""
+    return _cluster().node_logs.tail(node_id, lines)
+
+
+def list_cluster_events(limit: int = 1000) -> List[dict]:
+    """Structured cluster events (parity: list_cluster_events)."""
+    from ray_tpu.observability.events import global_event_manager
+
+    return [
+        {
+            "timestamp": e.timestamp,
+            "severity": getattr(e.severity, "name", str(e.severity)),
+            "source_type": e.source_type,
+            "label": e.label,
+            "message": e.message,
+            "custom_fields": dict(e.custom_fields or {}),
+        }
+        for e in global_event_manager().list_events(limit=limit)
+    ]
+
+
+class StateApiClient:
+    """Programmatic client over the state API (parity:
+    ray.util.state.StateApiClient). In-process: methods call the module
+    functions against the current cluster; the REST dashboard serves the
+    same data cross-process."""
+
+    def list(self, resource: str, *, filters=None, limit: int = 1000):
+        fn = {
+            "nodes": list_nodes,
+            "actors": list_actors,
+            "tasks": list_tasks,
+            "objects": list_objects,
+            "placement_groups": list_placement_groups,
+            "jobs": list_jobs,
+            "workers": list_workers,
+        }.get(resource)
+        if fn is None:
+            raise ValueError(f"unknown resource {resource!r}")
+        return fn(filters=filters, limit=limit)
+
+    def get(self, resource: str, id: str):  # noqa: A002
+        fn = {
+            "nodes": get_node,
+            "actors": get_actor,
+            "tasks": get_task,
+            "objects": get_objects,
+            "placement_groups": get_placement_group,
+            "jobs": get_job,
+            "workers": get_worker,
+        }.get(resource)
+        if fn is None:
+            raise ValueError(f"unknown resource {resource!r}")
+        return fn(id)
