@@ -129,3 +129,37 @@ def test_air_type_shims():
     got = AcquiredResources(request=req)
     assert got.request.strategy == "PACK"
     assert DatasetConfig().split is True
+
+
+def test_util_metrics_default_tags(runtime):
+    from ray_tpu.observability.metrics import global_registry
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("app_requests_total", "requests").set_default_tags(
+        {"deployment": "d1"}
+    )
+    c.inc()
+    c.inc(2.0, tags={"deployment": "d2"})  # per-call override wins
+    series = dict(global_registry().counter("app_requests_total").series())
+    by_tag = {frozenset(k): v for k, v in series.items()}
+    assert by_tag[frozenset({("deployment", "d1")})] == 1.0
+    assert by_tag[frozenset({("deployment", "d2")})] == 2.0
+
+    # gauge default-tag merge verified on the recorded series
+    g = metrics.Gauge("app_inflight").set_default_tags({"app": "x"})
+    g.set(3.0)
+    gseries = {frozenset(k): v for k, v in global_registry().gauge("app_inflight").series()}
+    assert gseries[frozenset({("app", "x")})] == 3.0
+
+    h = metrics.Histogram(
+        "app_latency_s", boundaries=[0.1, 1.0], tag_keys=("route",)
+    ).set_default_tags({"route": "/a"})
+    h.observe(0.05)
+    # declared tag_keys reject typo'd tags instead of exporting stray series
+    with pytest.raises(ValueError, match="unknown tag"):
+        h.observe(0.05, tags={"rouet": "/a"})
+    # reference parity: counters refuse non-positive increments
+    with pytest.raises(ValueError, match="value > 0"):
+        c.inc(0)
+    assert c.info["default_tags"] == {"deployment": "d1"}
+    assert h.info["tag_keys"] == ("route",)
