@@ -138,6 +138,56 @@ class Algorithm:
         self._episode_returns.extend(episode_returns)
         self._total_env_steps += env_steps
 
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Run the current policy GREEDILY on a fresh env set and report
+        episode returns (parity: Algorithm.evaluate / evaluation_config
+        with explore=False). Does not touch training state."""
+        import jax
+
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        module = getattr(self, "module", None)
+        # CQL keeps its learner as `self.learner` (singular); accept both
+        learners = getattr(self, "learners", None) or getattr(self, "learner", None)
+        if (
+            module is None
+            or not hasattr(module, "inference_action")
+            or not hasattr(learners, "params")
+        ):
+            raise NotImplementedError(
+                f"{type(self).__name__} has no inference module to evaluate"
+            )
+        cfg = self.config
+        runner = getattr(self, "_eval_runner", None)
+        if runner is None:
+            # built once and cached — the jitted rollout scan is the
+            # expensive part, not the episodes
+            runner = self._eval_runner = EnvRunner(
+                cfg.env,
+                module,
+                policy="inference",
+                num_envs=min(8, max(1, num_episodes)),
+                rollout_length=cfg.env.max_episode_steps,
+                seed=cfg.seed + 10_000,
+            )
+        # reset per call: same seed -> same episodes (deterministic evals)
+        runner._key = jax.random.key(cfg.seed + 10_000)
+        runner._env_state = None
+        params = learners.params
+        returns: list = []
+        while len(returns) < num_episodes:
+            _, _, ep_returns = runner.sample(params)
+            returns.extend(ep_returns)
+        returns = returns[:num_episodes]
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "num_episodes": len(returns),
+            }
+        }
+
     def stop(self) -> None:
         runners = getattr(self, "runners", None)
         if runners is not None:

@@ -192,6 +192,24 @@ class ES(Algorithm):
             "fitness_max": float(jnp.max(returns)),
         }
 
+    def evaluate(self, num_episodes: int = 10) -> dict:
+        """Evaluate theta deterministically via the same vmapped eval scan
+        the trainer uses (population of identical members = N episodes).
+        Uses a FIXED eval key: evaluation never advances the training RNG."""
+        k = jax.random.key(self.config.seed + 10_000)
+        pop = jax.tree.map(
+            lambda leaf: jnp.broadcast_to(leaf, (num_episodes,) + leaf.shape), self.theta
+        )
+        returns, _, _, _ = self._eval(pop, jax.random.split(k, num_episodes))
+        return {
+            "evaluation": {
+                "episode_return_mean": float(jnp.mean(returns)),
+                "episode_return_min": float(jnp.min(returns)),
+                "episode_return_max": float(jnp.max(returns)),
+                "num_episodes": num_episodes,
+            }
+        }
+
     def get_state(self):
         return {
             "theta": self.theta,
@@ -300,6 +318,32 @@ class ARS(Algorithm):
             "fitness_mean": float(jnp.mean(returns)),
             "fitness_max": float(jnp.max(returns)),
             "obs_count": float(self.normalizer.count),
+        }
+
+    def evaluate(self, num_episodes: int = 10) -> dict:
+        """Evaluate theta (with the current obs normalizer) via the shared
+        vmapped eval scan. Fixed eval key: never advances the training RNG."""
+        k = jax.random.key(self.config.seed + 10_000)
+        pop = {
+            "w": jax.tree.map(
+                lambda leaf: jnp.broadcast_to(leaf, (num_episodes,) + leaf.shape),
+                self.theta,
+            ),
+            "_norm_mean": jnp.broadcast_to(
+                self.normalizer.mean, (num_episodes,) + self.normalizer.mean.shape
+            ),
+            "_norm_std": jnp.broadcast_to(
+                self.normalizer.std, (num_episodes,) + self.normalizer.std.shape
+            ),
+        }
+        returns, _, _, _ = self._eval(pop, jax.random.split(k, num_episodes))
+        return {
+            "evaluation": {
+                "episode_return_mean": float(jnp.mean(returns)),
+                "episode_return_min": float(jnp.min(returns)),
+                "episode_return_max": float(jnp.max(returns)),
+                "num_episodes": num_episodes,
+            }
         }
 
     def get_state(self):

@@ -41,6 +41,7 @@ class EnvRunner:
       - "sac": module.sample_action; logp recorded.
       - "ddpg": deterministic module.explore + gaussian noise; `extra`
         carries noise_scale.
+      - "inference": module.inference_action — greedy/mean, for evaluate().
       - "random": uniform actions (warmup for off-policy algos).
     """
 
@@ -88,6 +89,9 @@ class EnvRunner:
             return action, {SampleBatch.LOGP: logp}
         if self.policy == "ddpg":
             return m.explore(params, obs, key, extra["noise_scale"]), {}
+        if self.policy == "inference":
+            # greedy/mean actions via the module's forward_inference analog
+            return m.inference_action(params, obs), {}
         if self.policy == "random":
             if self.env.discrete:
                 return jax.random.randint(key, obs.shape[:1], 0, self.env.num_actions), {}

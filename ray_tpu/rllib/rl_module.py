@@ -75,6 +75,10 @@ class ActorCriticModule:
         entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
         return logp, entropy
 
+    def inference_action(self, params, obs: jax.Array) -> jax.Array:
+        """Greedy action for evaluation (forward_inference parity)."""
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
 
 @dataclasses.dataclass(frozen=True)
 class ContinuousActorCriticModule:
@@ -117,6 +121,10 @@ class ContinuousActorCriticModule:
         entropy = jnp.sum(params["log_std"] + 0.5 * math.log(2 * math.pi * math.e))
         return logp, jnp.broadcast_to(entropy, logp.shape)
 
+    def inference_action(self, params, obs) -> jax.Array:
+        """Mean action for evaluation (forward_inference parity)."""
+        return _mlp_apply(params["pi"], obs)
+
 
 @dataclasses.dataclass(frozen=True)
 class QModule:
@@ -140,6 +148,9 @@ class QModule:
         random_a = jax.random.randint(kr, greedy.shape, 0, self.num_actions)
         explore = jax.random.uniform(ku, greedy.shape) < epsilon
         return jnp.where(explore, random_a, greedy)
+
+    def inference_action(self, params, obs: jax.Array) -> jax.Array:
+        return jnp.argmax(self.q_values(params, obs), axis=-1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -185,6 +196,9 @@ class DDPGModule:
             _mlp_apply(params["q2"], x)[..., 0],
         )
 
+    def inference_action(self, params, obs: jax.Array) -> jax.Array:
+        return self.action(params, obs)
+
 
 @dataclasses.dataclass(frozen=True)
 class SACModule:
@@ -227,6 +241,12 @@ class SACModule:
             axis=-1,
         )
         return self._scale(tanh_a), logp
+
+    def inference_action(self, params, obs) -> jax.Array:
+        """Deterministic tanh(mean) action for evaluation."""
+        out = _mlp_apply(params["pi"], obs)
+        mean, _ = jnp.split(out, 2, axis=-1)
+        return self._scale(jnp.tanh(mean))
 
     def q_values(self, params, obs, action):
         x = jnp.concatenate([obs, action], axis=-1)

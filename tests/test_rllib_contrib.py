@@ -286,3 +286,54 @@ def test_lints_runs_and_checkpoints():
     algo2.set_state(algo.get_state())
     np.testing.assert_array_equal(np.asarray(algo.A), np.asarray(algo2.A))
     np.testing.assert_array_equal(np.asarray(algo.b), np.asarray(algo2.b))
+
+
+def test_algorithm_evaluate_greedy():
+    """Algorithm.evaluate (parity: evaluation with explore=False): greedy
+    rollouts on a fresh env set, training state untouched."""
+    config = (
+        PGConfig()
+        .environment(CartPole())
+        .env_runners(num_envs_per_runner=8, rollout_length=64)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    algo.train()
+    before = jax.tree.leaves(algo.learners.params)[0].copy()
+    out = algo.evaluate(num_episodes=5)
+    ev = out["evaluation"]
+    assert ev["num_episodes"] == 5
+    assert ev["episode_return_min"] <= ev["episode_return_mean"] <= ev["episode_return_max"]
+    # evaluation must not have trained
+    after = jax.tree.leaves(algo.learners.params)[0]
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    algo.stop()
+
+    # continuous-control modules evaluate deterministically: same seed,
+    # same returns
+    cfg2 = (
+        DDPGConfig()
+        .environment(Pendulum())
+        .env_runners(num_envs_per_runner=2, rollout_length=32)
+        .debugging(seed=1)
+    )
+    algo2 = cfg2.build()
+    e1 = algo2.evaluate(num_episodes=3)["evaluation"]["episode_return_mean"]
+    e2 = algo2.evaluate(num_episodes=3)["evaluation"]["episode_return_mean"]
+    assert e1 == e2
+    algo2.stop()
+
+
+def test_es_evaluate_deterministic():
+    config = ESConfig().environment(CartPole()).training(
+        population_size=8, eval_length=100
+    ).debugging(seed=0)
+    algo = config.build()
+    algo.train()
+    ev = algo.evaluate(num_episodes=4)["evaluation"]
+    assert ev["num_episodes"] == 4
+    assert ev["episode_return_min"] <= ev["episode_return_max"] <= 100
+    # evaluation is repeatable AND does not advance the training RNG
+    key_before = algo._key
+    assert algo.evaluate(num_episodes=4)["evaluation"] == ev
+    assert (jax.random.key_data(algo._key) == jax.random.key_data(key_before)).all()
