@@ -53,6 +53,7 @@ from ray_tpu.rllib.algorithms.simple_q import (
     SimpleQConfig,
 )
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.bandit import (
     LinearBanditEnv,
     LinTS,
@@ -118,6 +119,9 @@ __all__ = [
     "ESConfig",
     "ARS",
     "ARSConfig",
+    "R2D2",
+    "R2D2Config",
+    "GRUQModule",
     "LinUCB",
     "LinUCBConfig",
     "LinTS",

@@ -1,0 +1,333 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Parity: `rllib_contrib/r2d2` (Kapturowski et al. — an LSTM/GRU Q-network
+trained on stored SEQUENCES with burn-in: the first ``burn_in`` steps of
+each replayed sequence only rebuild the hidden state, TD loss applies to
+the remainder; double-DQN targets; zero-state sequence starts, the paper's
+simpler storage option).
+
+TPU design: the recurrent rollout is the SAME jitted `lax.scan` as every
+other runner — the GRU hidden state rides in the scan carry and resets
+in-graph on episode ends, so sampling stays a single XLA program. The
+learner unrolls stored sequences with one `lax.scan` over time for online
+and target networks together; burn-in is a static mask, not a Python loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import _soft_update
+from ray_tpu.rllib.env_runner import EnvRunner, _tree_where
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class GRUQModule:
+    """GRU core + dueling-free Q head. The recurrent analog of QModule:
+    ``step(params, h, obs) -> (h', q)`` is the single-timestep cell both
+    the rollout scan and the learner's unroll call."""
+
+    obs_size: int
+    num_actions: int
+    hidden_size: int = 64
+
+    def init(self, key: jax.Array):
+        kx, kh, kq = jax.random.split(key, 3)
+        H, O = self.hidden_size, self.obs_size
+        scale_x = 1.0 / np.sqrt(O)
+        scale_h = 1.0 / np.sqrt(H)
+        return {
+            # fused GRU weights: [O, 3H] and [H, 3H] for (reset, update, cand)
+            "wx": jax.random.normal(kx, (O, 3 * H)) * scale_x,
+            "wh": jax.random.normal(kh, (H, 3 * H)) * scale_h,
+            "b": jnp.zeros((3 * H,)),
+            "head": _mlp_init(kq, (H, H, self.num_actions)),
+        }
+
+    def initial_state(self, batch_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jnp.zeros(batch_shape + (self.hidden_size,))
+
+    def step(self, params, h: jax.Array, obs: jax.Array):
+        """One GRU step. h [..., H], obs [..., O] -> (h', q [..., A])."""
+        H = self.hidden_size
+        gates_x = obs @ params["wx"] + params["b"]
+        gates_h = h @ params["wh"]
+        r = jax.nn.sigmoid(gates_x[..., :H] + gates_h[..., :H])
+        z = jax.nn.sigmoid(gates_x[..., H : 2 * H] + gates_h[..., H : 2 * H])
+        cand = jnp.tanh(gates_x[..., 2 * H :] + r * gates_h[..., 2 * H :])
+        h_new = (1.0 - z) * h + z * cand
+        return h_new, _mlp_apply(params["head"], h_new)
+
+    def unroll(self, params, h0: jax.Array, obs_seq: jax.Array, reset_before=None):
+        """Scan over time: obs_seq [T, B, O], h0 [B, H] -> q_seq [T, B, A].
+        ``reset_before`` [T, B] zeroes the hidden state BEFORE consuming
+        step t — the learner's mirror of the rollout's reset-at-done."""
+        if reset_before is None:
+            reset_before = jnp.zeros(obs_seq.shape[:2])
+
+        def cell(h, inp):
+            obs, r = inp
+            h = h * (1.0 - r)[..., None]
+            h, q = self.step(params, h, obs)
+            return h, q
+
+        _, q_seq = jax.lax.scan(cell, h0, (obs_seq, reset_before))
+        return q_seq
+
+    def explore(self, params, h, obs, key, epsilon):
+        """Recurrent epsilon-greedy: -> (h', action)."""
+        h, q = self.step(params, h, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        kr, ku = jax.random.split(key)
+        random_a = jax.random.randint(kr, greedy.shape, 0, self.num_actions)
+        pick = jax.random.uniform(ku, greedy.shape) < epsilon
+        return h, jnp.where(pick, random_a, greedy)
+
+
+class _RecurrentEnvRunner(EnvRunner):
+    """EnvRunner whose scan carry includes the GRU hidden state, reset
+    in-graph when an episode ends (the recorded sequences therefore always
+    start from a zero state at episode boundaries — R2D2's zero-state
+    storage)."""
+
+    def _build_rollout(self):
+        def rollout(params, key, env_state, obs, ep_ret, extra):
+            def step(carry, _):
+                env_state, obs, h, ep_ret, key = carry
+                key, ak, rk = jax.random.split(key, 3)
+                h2, action = self.module.explore(params, h, obs, ak, extra["epsilon"])
+                env_state2, next_obs, reward, terminated, truncated = self._step_v(
+                    env_state, action
+                )
+                done = terminated | truncated
+                ep_ret2 = ep_ret + reward
+                completed = jnp.where(done, ep_ret2, jnp.nan)
+                reset_state, reset_obs = self._reset_v(
+                    jax.random.split(rk, self.num_envs)
+                )
+                env_state3 = _tree_where(done, reset_state, env_state2)
+                obs_after = _tree_where(done, reset_obs, next_obs)
+                # hidden state zeroes at episode end, like the env
+                h3 = jnp.where(done[..., None], jnp.zeros_like(h2), h2)
+                record = {
+                    SampleBatch.OBS: obs,
+                    SampleBatch.ACTIONS: action,
+                    SampleBatch.REWARDS: reward,
+                    SampleBatch.DONES: terminated,
+                    SampleBatch.TRUNCATEDS: truncated,
+                    SampleBatch.NEXT_OBS: next_obs,
+                    "_completed_return": completed,
+                }
+                return (env_state3, obs_after, h3, jnp.where(done, 0.0, ep_ret2), key), record
+
+            h0 = extra["hidden"]
+            (env_state, obs, h, ep_ret, key), traj = jax.lax.scan(
+                step, (env_state, obs, h0, ep_ret, key), None, length=self.rollout_length
+            )
+            return env_state, obs, ep_ret, key, (traj, h)
+
+        return rollout
+
+    def sample(self, params, extra=None):
+        if self._env_state is None:
+            self._key, rk = jax.random.split(self._key)
+            self._env_state, self._obs = self._reset_v(
+                jax.random.split(rk, self.num_envs)
+            )
+            self._ep_ret = jnp.zeros((self.num_envs,))
+            self._hidden = self.module.initial_state((self.num_envs,))
+        extra = dict(extra or {})
+        extra["hidden"] = self._hidden
+        self._env_state, self._obs, self._ep_ret, self._key, (traj, h) = self._rollout(
+            params, self._key, self._env_state, self._obs, self._ep_ret, extra
+        )
+        self._hidden = h
+        traj = {k: np.asarray(v) for k, v in traj.items()}
+        completed = traj.pop("_completed_return")
+        episode_returns = [float(r) for r in completed[~np.isnan(completed)]]
+        # keep the base sample() contract (metrics + module-view final obs)
+        self.metrics = {
+            "episodes_this_iter": len(episode_returns),
+            "env_steps_this_iter": self.rollout_length * self.num_envs,
+        }
+        final_obs = self._obs
+        if self.env_to_module is not None:
+            final_obs = self.env_to_module(final_obs)
+        return SampleBatch(traj), np.asarray(final_obs), episode_returns
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.hidden_size = 64
+        # 16 divides the inherited rollout_length=128, so the OUT-OF-BOX
+        # config builds (the setup assert would otherwise reject defaults)
+        self.sequence_length = 16
+        self.burn_in = 4
+        self.buffer_capacity = 2_000  # sequences, not transitions
+        self.learning_starts = 100  # sequences
+        self.target_update_tau = 0.01
+        self.num_updates_per_iter = 4
+        self.train_batch_size = 16  # sequences per update
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000
+
+
+def _r2d2_loss(module: GRUQModule, gamma: float, burn_in: int):
+    def loss_fn(params, batch, target_params):
+        # batch arrays are [B, T, ...]; scan wants time-major
+        obs = jnp.swapaxes(batch[SampleBatch.OBS], 0, 1)  # [T, B, O]
+        next_obs = jnp.swapaxes(batch[SampleBatch.NEXT_OBS], 0, 1)
+        actions = jnp.swapaxes(batch[SampleBatch.ACTIONS], 0, 1)  # [T, B]
+        rewards = jnp.swapaxes(batch[SampleBatch.REWARDS], 0, 1)
+        dones = jnp.swapaxes(batch[SampleBatch.DONES], 0, 1).astype(jnp.float32)
+        T, B = actions.shape
+        h0 = module.initial_state((B,))
+        # ONE (T+1)-step unroll per network over [obs..., last next_obs],
+        # with the hidden reset before any step whose predecessor ended an
+        # episode — EXACT hiddens for both q_seq (rows :T) and next-state
+        # values (rows 1:), mirroring the rollout's in-graph reset. (Where
+        # a terminal makes the t+1 hidden "wrong", (1-done) masks the
+        # target anyway.)
+        ext = jnp.concatenate([obs, next_obs[-1:]], axis=0)  # [T+1, B, O]
+        resets = jnp.concatenate([jnp.zeros((1, B)), dones], axis=0)
+        q_ext = module.unroll(params, h0, ext, resets)
+        q_ext_target = module.unroll(target_params, h0, ext, resets)
+        q_seq = q_ext[:T]
+        next_a = jnp.argmax(q_ext[1:], axis=-1)
+        next_q = jnp.take_along_axis(q_ext_target[1:], next_a[..., None], axis=-1)[..., 0]
+        q_taken = jnp.take_along_axis(
+            q_seq, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        target = rewards + gamma * (1.0 - dones) * jax.lax.stop_gradient(next_q)
+        td = q_taken - target
+        # burn-in: the first steps only build hidden state, no gradient
+        mask = (jnp.arange(T) >= burn_in).astype(jnp.float32)[:, None]
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+        loss = jnp.sum(huber * mask) / jnp.maximum(1.0, jnp.sum(mask) * B)
+        return loss, {
+            "td_abs_mean": jnp.sum(jnp.abs(td) * mask) / jnp.maximum(1.0, mask.sum() * B),
+            "q_mean": jnp.mean(q_taken),
+        }
+
+    return loss_fn
+
+
+class R2D2(Algorithm):
+    def setup(self) -> None:
+        cfg: R2D2Config = self.config
+        env = cfg.env
+        assert env.discrete, "R2D2 requires a discrete-action env"
+        assert cfg.rollout_length % cfg.sequence_length == 0, (
+            "rollout_length must be a multiple of sequence_length"
+        )
+        self.module = GRUQModule(env.observation_size, env.num_actions, cfg.hidden_size)
+        self.runners = _RecurrentEnvRunner(
+            env,
+            self.module,
+            policy="q",  # selector unused; explore() is called directly
+            num_envs=cfg.num_envs_per_runner,
+            rollout_length=cfg.rollout_length,
+            seed=cfg.seed,
+        )
+        self.learners = LearnerGroup(
+            Learner(
+                self.module,
+                _r2d2_loss(self.module, cfg.gamma, cfg.burn_in),
+                lr=cfg.lr,
+                max_grad_norm=cfg.max_grad_norm,
+                seed=cfg.seed,
+            )
+        )
+        self.target_params = jax.tree.map(jnp.copy, self.learners.params)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+
+    def _epsilon(self) -> float:
+        cfg: R2D2Config = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: R2D2Config = self.config
+        eps = jnp.asarray(self._epsilon())
+        batch, _, ep_returns = self.runners.sample(
+            self.learners.params, {"epsilon": eps}
+        )
+        T_total, B = batch[SampleBatch.ACTIONS].shape
+        self._record_episodes(ep_returns, T_total * B)
+        # slice the [T_total, B] rollout into [n_seq, seq_len] rows: each
+        # buffer row is ONE sequence ([seq_len, ...] per column)
+        L = cfg.sequence_length
+        seqs = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            # [T_total, B, ...] -> [T/L, L, B, ...] -> [T/L * B, L, ...]
+            v = v.reshape((T_total // L, L) + v.shape[1:])
+            v = np.moveaxis(v, 2, 1).reshape((-1, L) + v.shape[3:])
+            seqs[k] = v
+        self.buffer.add(SampleBatch(seqs))
+        stats: Dict[str, float] = {"epsilon": float(eps)}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            sample = self.buffer.sample(cfg.train_batch_size)
+            stats.update(self.learners.update(sample, target_params=self.target_params))
+            self.target_params = _soft_update(
+                self.target_params, self.learners.params, cfg.target_update_tau
+            )
+        return stats
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy recurrent evaluation: the same scan rollout at epsilon=0
+        on a cached eval runner (hidden state carries like training)."""
+        cfg: R2D2Config = self.config
+        runner = getattr(self, "_eval_runner", None)
+        if runner is None:
+            runner = self._eval_runner = _RecurrentEnvRunner(
+                cfg.env,
+                self.module,
+                policy="q",
+                num_envs=min(8, max(1, num_episodes)),
+                rollout_length=cfg.env.max_episode_steps,
+                seed=cfg.seed + 10_000,
+            )
+        runner._key = jax.random.key(cfg.seed + 10_000)
+        runner._env_state = None
+        extra = {"epsilon": jnp.zeros(())}
+        returns: list = []
+        while len(returns) < num_episodes:
+            _, _, ep_returns = runner.sample(self.learners.params, extra)
+            returns.extend(ep_returns)
+        returns = returns[:num_episodes]
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "num_episodes": len(returns),
+            }
+        }
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self.target_params
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+
+
+R2D2Config.algo_class = R2D2

@@ -26,6 +26,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
     from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
     from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, A3C, A3CConfig, PG, PGConfig
     from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+    from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
     from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
     from ray_tpu.rllib.algorithms.simple_q import (
         ApexDQN,
@@ -53,6 +54,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
         "APEX": (ApexDQN, ApexDQNConfig),
         "ES": (ES, ESConfig),
         "ARS": (ARS, ARSConfig),
+        "R2D2": (R2D2, R2D2Config),
         "BanditLinUCB": (LinUCB, LinUCBConfig),
         "BanditLinTS": (LinTS, LinTSConfig),
     }
