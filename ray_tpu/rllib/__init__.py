@@ -54,6 +54,7 @@ from ray_tpu.rllib.algorithms.simple_q import (
 )
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig, SimpleSpread
 from ray_tpu.rllib.algorithms.bandit import (
     LinearBanditEnv,
     LinTS,
@@ -122,6 +123,9 @@ __all__ = [
     "R2D2",
     "R2D2Config",
     "GRUQModule",
+    "MADDPG",
+    "MADDPGConfig",
+    "SimpleSpread",
     "LinUCB",
     "LinUCBConfig",
     "LinTS",

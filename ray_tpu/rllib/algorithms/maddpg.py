@@ -1,0 +1,360 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Parity: `rllib_contrib/maddpg` (Lowe et al. — decentralized deterministic
+actors over each agent's own observation, centralized critics over the
+JOINT observation+action, trained from a shared replay buffer; the MPE
+"simple spread" cooperative navigation task is the canonical benchmark).
+
+TPU design: per-agent parameters are STACKED along a leading agent axis and
+every per-agent computation — actor forwards in the rollout, critic TD
+updates, actor ascent — is one `jax.vmap` over that axis, so N agents cost
+one batched program instead of N Python loops. The environment itself is
+pure JAX (`SimpleSpread` below), so rollouts are the same vmapped
+`lax.scan` as every other runner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import _soft_update
+from ray_tpu.rllib.env_runner import _tree_where
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleSpread:
+    """Cooperative navigation (MPE simple_spread), pure JAX: N agents move
+    with continuous 2-D velocity actions to cover N landmarks. Shared
+    reward = -sum over landmarks of distance to the nearest agent, minus a
+    collision penalty. Per-agent obs: own pos/vel + landmark offsets +
+    other-agent offsets."""
+
+    n_agents: int = 3
+    arena: float = 1.0
+    dt: float = 0.1
+    collision_radius: float = 0.1
+    collision_penalty: float = 1.0
+    max_episode_steps: int = 25
+
+    @property
+    def action_size(self) -> int:
+        return 2
+
+    action_low: float = -1.0
+    action_high: float = 1.0
+
+    @property
+    def observation_size(self) -> int:
+        # pos(2) + vel(2) + landmarks (2 each) + others (2 each)
+        return 4 + 2 * self.n_agents + 2 * (self.n_agents - 1)
+
+    def _obs(self, state):
+        pos, vel, lm = state["pos"], state["vel"], state["lm"]
+        N = self.n_agents
+        rel_lm = (lm[None, :, :] - pos[:, None, :]).reshape(N, -1)  # [N, 2N]
+        rel_all = pos[None, :, :] - pos[:, None, :]  # [self, other, 2]
+        # each row keeps the N-1 OTHER agents via a static index table
+        # (dynamic pos[:i] slicing is untraceable under vmap)
+        others_idx = np.array(
+            [[j for j in range(N) if j != i] for i in range(N)], np.int32
+        )
+        rel_others = rel_all[jnp.arange(N)[:, None], others_idx].reshape(N, -1)
+        return jnp.concatenate([pos, vel, rel_lm, rel_others], axis=-1)
+
+    def reset(self, key: jax.Array):
+        kp, kl = jax.random.split(key)
+        pos = jax.random.uniform(kp, (self.n_agents, 2), minval=-self.arena, maxval=self.arena)
+        lm = jax.random.uniform(kl, (self.n_agents, 2), minval=-self.arena, maxval=self.arena)
+        state = {
+            "pos": pos,
+            "vel": jnp.zeros((self.n_agents, 2)),
+            "lm": lm,
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return state, self._obs(state)
+
+    def step(self, state, actions: jax.Array):
+        """actions [N, 2] in [-1, 1] -> (state, obs [N, O], reward [N],
+        terminated, truncated). Reward is SHARED (cooperative task)."""
+        act = jnp.clip(actions, self.action_low, self.action_high)
+        vel = 0.5 * state["vel"] + act * self.dt
+        pos = jnp.clip(state["pos"] + vel, -1.5 * self.arena, 1.5 * self.arena)
+        # distance from each landmark to its nearest agent
+        d = jnp.linalg.norm(state["lm"][:, None, :] - pos[None, :, :], axis=-1)
+        cover_cost = jnp.sum(jnp.min(d, axis=1))
+        # pairwise collisions
+        pd = jnp.linalg.norm(pos[:, None, :] - pos[None, :, :], axis=-1)
+        pairs = jnp.sum(jnp.triu(pd < self.collision_radius, k=1))
+        reward = -cover_cost - self.collision_penalty * pairs
+        t = state["t"] + 1
+        truncated = t >= self.max_episode_steps
+        state = {"pos": pos, "vel": vel, "lm": state["lm"], "t": t}
+        rewards = jnp.full((self.n_agents,), reward)
+        return state, self._obs(state), rewards, jnp.zeros((), bool), truncated
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_update_tau = 0.01
+        self.num_updates_per_iter = 4
+        self.train_batch_size = 128
+        self.exploration_noise = 0.2
+        self.num_envs_per_runner = 8
+        self.rollout_length = 25
+
+
+class _MADDPGNets:
+    """Stacked per-agent actors + centralized critics. All leaves carry a
+    leading [N] agent axis; forwards vmap over it."""
+
+    def __init__(self, env: SimpleSpread, hidden, key: jax.Array):
+        self.env = env
+        N, O, A = env.n_agents, env.observation_size, env.action_size
+        joint = N * O + N * A
+        ka, kc = jax.random.split(key)
+
+        def init_one(k):
+            k1, k2 = jax.random.split(k)
+            return {
+                "pi": _mlp_init(k1, (O, *hidden, A)),
+                "q": _mlp_init(k2, (joint, *hidden, 1)),
+            }
+
+        self.params = jax.vmap(init_one)(jax.random.split(ka, N))
+
+    @staticmethod
+    def actor(params_i, obs_i):
+        """One agent's deterministic action from its OWN obs."""
+        return jnp.tanh(_mlp_apply(params_i["pi"], obs_i))
+
+    @staticmethod
+    def critic(params_i, joint_obs, joint_act):
+        """One agent's centralized Q over the JOINT obs+action."""
+        x = jnp.concatenate([joint_obs, joint_act], axis=-1)
+        return _mlp_apply(params_i["q"], x)[..., 0]
+
+    def actions(self, params, obs):
+        """obs [..., N, O] -> [..., N, A] via vmap over the agent axis."""
+        return jax.vmap(self.actor, in_axes=(0, -2), out_axes=-2)(params, obs)
+
+
+class MADDPG(Algorithm):
+    def setup(self) -> None:
+        cfg: MADDPGConfig = self.config
+        env = cfg.env
+        assert isinstance(env, SimpleSpread) or (
+            hasattr(env, "n_agents") and hasattr(env, "_obs")
+        ), "MADDPG needs a pure-JAX multi-agent env (SimpleSpread protocol)"
+        self.env = env
+        self.nets = _MADDPGNets(env, cfg.hidden, jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.nets.params)
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.critic_lr)
+        self.actor_opt = self.actor_tx.init(self.nets.params)
+        self.critic_opt = self.critic_tx.init(self.nets.params)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._reset_v = jax.vmap(env.reset)
+        self._step_v = jax.vmap(env.step)
+        self._env_state = None
+        self._rollout = jax.jit(self._make_rollout())
+        self._update = jax.jit(self._make_update())
+
+    # -- sampling -----------------------------------------------------------
+    def _make_rollout(self):
+        cfg: MADDPGConfig = self.config
+        B = cfg.num_envs_per_runner
+
+        def rollout(params, key, env_state, obs, ep_ret):
+            def step(carry, _):
+                env_state, obs, ep_ret, key = carry
+                key, ak, rk = jax.random.split(key, 3)
+                act = self.nets.actions(params, obs)  # [B, N, A]
+                noise = cfg.exploration_noise * jax.random.normal(ak, act.shape)
+                act = jnp.clip(act + noise, self.env.action_low, self.env.action_high)
+                env_state2, next_obs, rewards, term, trunc = self._step_v(env_state, act)
+                done = term | trunc
+                ep_ret2 = ep_ret + rewards.sum(axis=-1) / self.env.n_agents
+                completed = jnp.where(done, ep_ret2, jnp.nan)
+                reset_state, reset_obs = self._reset_v(jax.random.split(rk, B))
+                env_state3 = _tree_where(done, reset_state, env_state2)
+                obs_after = _tree_where(done, reset_obs, next_obs)
+                rec = {
+                    SampleBatch.OBS: obs,
+                    SampleBatch.ACTIONS: act,
+                    SampleBatch.REWARDS: rewards,
+                    SampleBatch.DONES: jnp.broadcast_to(term[..., None], rewards.shape),
+                    SampleBatch.NEXT_OBS: next_obs,
+                    "_completed_return": completed,
+                }
+                return (env_state3, obs_after, jnp.where(done, 0.0, ep_ret2), key), rec
+
+            (env_state, obs, ep_ret, key), traj = jax.lax.scan(
+                step, (env_state, obs, ep_ret, key), None, length=cfg.rollout_length
+            )
+            return env_state, obs, ep_ret, key, traj
+
+        return rollout
+
+    # -- learning -----------------------------------------------------------
+    def _make_update(self):
+        cfg: MADDPGConfig = self.config
+        env, nets = self.env, self.nets
+        N = env.n_agents
+
+        def update(params, target_params, actor_opt, critic_opt, batch):
+            obs = batch[SampleBatch.OBS]  # [B, N, O]
+            act = batch[SampleBatch.ACTIONS]  # [B, N, A]
+            rew = batch[SampleBatch.REWARDS]  # [B, N]
+            done = batch[SampleBatch.DONES].astype(jnp.float32)  # [B, N]
+            next_obs = batch[SampleBatch.NEXT_OBS]
+            B = obs.shape[0]
+            joint_obs = obs.reshape(B, -1)
+            joint_next_obs = next_obs.reshape(B, -1)
+            next_act = nets.actions(target_params, next_obs).reshape(B, -1)
+
+            def critic_loss(p):
+                # each agent's TARGET critic values the joint next state...
+                tq = jax.vmap(
+                    lambda tp_i: nets.critic(tp_i, joint_next_obs, next_act)
+                )(target_params)  # [N, B]
+                target = rew.T + cfg.gamma * (1.0 - done.T) * jax.lax.stop_gradient(tq)
+                # ...and each agent's ONLINE critic regresses onto it
+                q = jax.vmap(
+                    lambda p_i: nets.critic(p_i, joint_obs, act.reshape(B, -1))
+                )(p)  # [N, B]
+                return jnp.mean((q - jax.lax.stop_gradient(target)) ** 2)
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            cgrads = {**cgrads, "pi": jax.tree.map(jnp.zeros_like, cgrads["pi"])}
+            cupd, critic_opt = self.critic_tx.update(cgrads, critic_opt, params)
+            params = optax.apply_updates(params, cupd)
+
+            def actor_loss(p):
+                # each agent's actor acts on its own obs; the OTHER agents'
+                # replayed actions stay fixed in its critic input
+                my_act = nets.actions(p, obs)  # [B, N, A] (grads per agent)
+                agent_idx = jnp.arange(N)
+
+                def one(i, p_i):
+                    mixed = act.at[:, i, :].set(my_act[:, i, :])
+                    q = nets.critic(
+                        jax.lax.stop_gradient(p_i), joint_obs, mixed.reshape(B, -1)
+                    )
+                    return -jnp.mean(q)
+
+                losses = jax.vmap(one)(agent_idx, p)
+                return jnp.mean(losses)
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(params)
+            agrads = {**agrads, "q": jax.tree.map(jnp.zeros_like, agrads["q"])}
+            aupd, actor_opt = self.actor_tx.update(agrads, actor_opt, params)
+            params = optax.apply_updates(params, aupd)
+            target_params = _soft_update(target_params, params, cfg.target_update_tau)
+            return params, target_params, actor_opt, critic_opt, {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+            }
+
+        return update
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: MADDPGConfig = self.config
+        B = cfg.num_envs_per_runner
+        if self._env_state is None:
+            self._key, rk = jax.random.split(self._key)
+            self._env_state, self._obs = self._reset_v(jax.random.split(rk, B))
+            self._ep_ret = jnp.zeros((B,))
+        self._env_state, self._obs, self._ep_ret, self._key, traj = self._rollout(
+            self.nets.params, self._key, self._env_state, self._obs, self._ep_ret
+        )
+        traj = {k: np.asarray(v) for k, v in traj.items()}
+        completed = traj.pop("_completed_return")
+        ep_returns = [float(r) for r in completed[~np.isnan(completed)]]
+        self._record_episodes(ep_returns, cfg.rollout_length * B)
+        flat = SampleBatch(
+            {k: v.reshape((-1,) + v.shape[2:]) for k, v in traj.items()}
+        )
+        self.buffer.add(flat)
+        stats: Dict[str, float] = {}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            sample = self.buffer.sample(cfg.train_batch_size)
+            jbatch = {k: jnp.asarray(v) for k, v in sample.items()}
+            (
+                self.nets.params,
+                self.target_params,
+                self.actor_opt,
+                self.critic_opt,
+                raw,
+            ) = self._update(
+                self.nets.params, self.target_params, self.actor_opt, self.critic_opt, jbatch
+            )
+            stats = {k: float(v) for k, v in raw.items()}
+        return stats
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Deterministic (noise-free) joint policy over fresh episodes."""
+        cfg: MADDPGConfig = self.config
+        key = jax.random.key(cfg.seed + 10_000)
+        B = max(1, num_episodes)
+        state, obs = self._reset_v(jax.random.split(key, B))
+
+        def step(carry, _):
+            state, obs, ret = carry
+            act = self.nets.actions(self.nets.params, obs)
+            state, obs2, rewards, term, trunc = self._step_v(state, act)
+            return (state, obs2, ret + rewards.sum(axis=-1) / self.env.n_agents), None
+
+        (state, obs, rets), _ = jax.lax.scan(
+            step, (state, obs, jnp.zeros((B,))), None, length=self.env.max_episode_steps
+        )
+        rets = np.asarray(rets)[:num_episodes]
+        return {
+            "evaluation": {
+                "episode_return_mean": float(rets.mean()),
+                "episode_return_min": float(rets.min()),
+                "episode_return_max": float(rets.max()),
+                "num_episodes": int(len(rets)),
+            }
+        }
+
+    def get_state(self):
+        return {
+            "params": self.nets.params,
+            "target_params": self.target_params,
+            "actor_opt": self.actor_opt,
+            "critic_opt": self.critic_opt,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state) -> None:
+        self.nets.params = state["params"]
+        self.target_params = state["target_params"]
+        self.actor_opt = state["actor_opt"]
+        self.critic_opt = state["critic_opt"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+MADDPGConfig.algo_class = MADDPG

@@ -68,10 +68,18 @@ class GRUQModule:
         h_new = (1.0 - z) * h + z * cand
         return h_new, _mlp_apply(params["head"], h_new)
 
-    def unroll(self, params, h0: jax.Array, obs_seq: jax.Array, reset_before=None):
+    def unroll(
+        self,
+        params,
+        h0: jax.Array,
+        obs_seq: jax.Array,
+        reset_before=None,
+        return_hidden: bool = False,
+    ):
         """Scan over time: obs_seq [T, B, O], h0 [B, H] -> q_seq [T, B, A].
         ``reset_before`` [T, B] zeroes the hidden state BEFORE consuming
-        step t — the learner's mirror of the rollout's reset-at-done."""
+        step t — the learner's mirror of the rollout's reset-at-done.
+        ``return_hidden`` also yields the post-step hiddens [T, B, H]."""
         if reset_before is None:
             reset_before = jnp.zeros(obs_seq.shape[:2])
 
@@ -79,10 +87,10 @@ class GRUQModule:
             obs, r = inp
             h = h * (1.0 - r)[..., None]
             h, q = self.step(params, h, obs)
-            return h, q
+            return h, (q, h)
 
-        _, q_seq = jax.lax.scan(cell, h0, (obs_seq, reset_before))
-        return q_seq
+        _, (q_seq, h_seq) = jax.lax.scan(cell, h0, (obs_seq, reset_before))
+        return (q_seq, h_seq) if return_hidden else q_seq
 
     def explore(self, params, h, obs, key, epsilon):
         """Recurrent epsilon-greedy: -> (h', action)."""
@@ -138,32 +146,17 @@ class _RecurrentEnvRunner(EnvRunner):
 
         return rollout
 
-    def sample(self, params, extra=None):
-        if self._env_state is None:
-            self._key, rk = jax.random.split(self._key)
-            self._env_state, self._obs = self._reset_v(
-                jax.random.split(rk, self.num_envs)
-            )
-            self._ep_ret = jnp.zeros((self.num_envs,))
-            self._hidden = self.module.initial_state((self.num_envs,))
-        extra = dict(extra or {})
+    # base sample() drives everything; these hooks thread the hidden state
+    def _on_lazy_reset(self) -> None:
+        self._hidden = self.module.initial_state((self.num_envs,))
+
+    def _augment_extra(self, extra):
         extra["hidden"] = self._hidden
-        self._env_state, self._obs, self._ep_ret, self._key, (traj, h) = self._rollout(
-            params, self._key, self._env_state, self._obs, self._ep_ret, extra
-        )
-        self._hidden = h
-        traj = {k: np.asarray(v) for k, v in traj.items()}
-        completed = traj.pop("_completed_return")
-        episode_returns = [float(r) for r in completed[~np.isnan(completed)]]
-        # keep the base sample() contract (metrics + module-view final obs)
-        self.metrics = {
-            "episodes_this_iter": len(episode_returns),
-            "env_steps_this_iter": self.rollout_length * self.num_envs,
-        }
-        final_obs = self._obs
-        if self.env_to_module is not None:
-            final_obs = self.env_to_module(final_obs)
-        return SampleBatch(traj), np.asarray(final_obs), episode_returns
+        return extra
+
+    def _consume_rollout(self, out):
+        traj, self._hidden = out
+        return traj
 
 
 class R2D2Config(AlgorithmConfig):
@@ -193,21 +186,35 @@ def _r2d2_loss(module: GRUQModule, gamma: float, burn_in: int):
         actions = jnp.swapaxes(batch[SampleBatch.ACTIONS], 0, 1)  # [T, B]
         rewards = jnp.swapaxes(batch[SampleBatch.REWARDS], 0, 1)
         dones = jnp.swapaxes(batch[SampleBatch.DONES], 0, 1).astype(jnp.float32)
+        truncs = jnp.swapaxes(batch[SampleBatch.TRUNCATEDS], 0, 1).astype(jnp.float32)
+        # the rollout resets h at terminated OR truncated; the learner must
+        # mirror exactly that, not terminals alone
+        ended = jnp.clip(dones + truncs, 0.0, 1.0)
         T, B = actions.shape
         h0 = module.initial_state((B,))
         # ONE (T+1)-step unroll per network over [obs..., last next_obs],
-        # with the hidden reset before any step whose predecessor ended an
-        # episode — EXACT hiddens for both q_seq (rows :T) and next-state
-        # values (rows 1:), mirroring the rollout's in-graph reset. (Where
-        # a terminal makes the t+1 hidden "wrong", (1-done) masks the
-        # target anyway.)
+        # hidden reset before any step whose predecessor ENDED an episode
+        # (term or trunc) — exact hiddens for q_seq (rows :T) and for the
+        # within-episode next-state values (rows 1:).
         ext = jnp.concatenate([obs, next_obs[-1:]], axis=0)  # [T+1, B, O]
-        resets = jnp.concatenate([jnp.zeros((1, B)), dones], axis=0)
-        q_ext = module.unroll(params, h0, ext, resets)
-        q_ext_target = module.unroll(target_params, h0, ext, resets)
+        resets = jnp.concatenate([jnp.zeros((1, B)), ended], axis=0)
+        q_ext, h_ext = module.unroll(params, h0, ext, resets, return_hidden=True)
+        q_ext_target, h_ext_target = module.unroll(
+            target_params, h0, ext, resets, return_hidden=True
+        )
         q_seq = q_ext[:T]
-        next_a = jnp.argmax(q_ext[1:], axis=-1)
-        next_q = jnp.take_along_axis(q_ext_target[1:], next_a[..., None], axis=-1)[..., 0]
+        # At an episode end inside the sequence, row t+1 of the ext unroll
+        # values the NEXT episode's reset obs — wrong for a truncation,
+        # which must bootstrap from next_obs_t (sample_batch.py: truncation
+        # bootstraps, termination zeroes). Correct those steps with one
+        # extra cell evaluation from the exact post-step hidden h_ext[:T].
+        q_b = module.step(params, h_ext[:T], next_obs)[1]
+        q_b_target = module.step(target_params, h_ext_target[:T], next_obs)[1]
+        e = ended[..., None]
+        next_online = jnp.where(e > 0, q_b, q_ext[1:])
+        next_target = jnp.where(e > 0, q_b_target, q_ext_target[1:])
+        next_a = jnp.argmax(next_online, axis=-1)
+        next_q = jnp.take_along_axis(next_target, next_a[..., None], axis=-1)[..., 0]
         q_taken = jnp.take_along_axis(
             q_seq, actions[..., None].astype(jnp.int32), axis=-1
         )[..., 0]

@@ -23,6 +23,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
     from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
     from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
     from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
+    from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
     from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
     from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, A3C, A3CConfig, PG, PGConfig
     from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -55,6 +56,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
         "ES": (ES, ESConfig),
         "ARS": (ARS, ARSConfig),
         "R2D2": (R2D2, R2D2Config),
+        "MADDPG": (MADDPG, MADDPGConfig),
         "BanditLinUCB": (LinUCB, LinUCBConfig),
         "BanditLinTS": (LinTS, LinTSConfig),
     }

@@ -151,6 +151,18 @@ class EnvRunner:
 
         return rollout
 
+    # -- subclass hooks (recurrent runners thread extra scan state) --------
+    def _on_lazy_reset(self) -> None:
+        """Called once when the env set is first (re)initialized."""
+
+    def _augment_extra(self, extra: Dict[str, Any]) -> Dict[str, Any]:
+        """Inject per-rollout carry (e.g. a hidden state) into ``extra``."""
+        return extra
+
+    def _consume_rollout(self, out):
+        """Unpack the rollout's traj output (and stash any aux carry)."""
+        return out
+
     # -- public API ---------------------------------------------------------
     def sample(
         self, params, extra: Optional[Dict[str, Any]] = None
@@ -163,9 +175,12 @@ class EnvRunner:
                 jax.random.split(rk, self.num_envs)
             )
             self._ep_ret = jnp.zeros((self.num_envs,))
-        self._env_state, self._obs, self._ep_ret, self._key, traj = self._rollout(
-            params, self._key, self._env_state, self._obs, self._ep_ret, extra or {}
+            self._on_lazy_reset()
+        extra = self._augment_extra(dict(extra or {}))
+        self._env_state, self._obs, self._ep_ret, self._key, out = self._rollout(
+            params, self._key, self._env_state, self._obs, self._ep_ret, extra
         )
+        traj = self._consume_rollout(out)
         traj = {k: np.asarray(v) for k, v in traj.items()}
         completed = traj.pop("_completed_return")
         episode_returns = [float(r) for r in completed[~np.isnan(completed)]]

@@ -416,3 +416,92 @@ def test_gru_module_unroll_matches_stepwise():
     for t in range(5):
         h, q = m.step(params, h, obs_seq[t])
         np.testing.assert_allclose(np.asarray(q), np.asarray(q_scan[t]), rtol=1e-5)
+
+
+def test_maddpg_learns_simple_spread():
+    """MADDPG on the pure-JAX cooperative navigation env: stacked per-agent
+    params, centralized critics, shared reward improves."""
+    from ray_tpu.rllib import MADDPG, MADDPGConfig, SimpleSpread
+
+    env = SimpleSpread(n_agents=2)
+    config = (
+        MADDPGConfig()
+        .environment(env)
+        .training(
+            learning_starts=200,
+            num_updates_per_iter=8,
+            train_batch_size=128,
+            exploration_noise=0.3,
+            hidden=(64, 64),
+        )
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    first = None
+    result = None
+    for _ in range(30):
+        result = algo.train()
+        if first is None and not np.isnan(result["episode_return_mean"]):
+            first = result["episode_return_mean"]
+    # cooperative shared return rises (less negative coverage cost)
+    assert result["episode_return_mean"] > first
+    assert np.isfinite(result["learners"]["critic_loss"])
+
+    # deterministic evaluation, checkpoint roundtrip
+    ev = algo.evaluate(num_episodes=4)["evaluation"]
+    assert ev["num_episodes"] == 4
+    assert algo.evaluate(num_episodes=4)["evaluation"] == ev
+    algo2 = config.copy().build()
+    algo2.set_state(algo.get_state())
+    for a, b in zip(
+        jax.tree.leaves(algo.nets.params), jax.tree.leaves(algo2.nets.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_simple_spread_env_shapes_and_reward():
+    from ray_tpu.rllib import SimpleSpread
+
+    env = SimpleSpread(n_agents=3)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (3, env.observation_size)
+    actions = jnp.zeros((3, 2))
+    state, obs2, rewards, term, trunc = env.step(state, actions)
+    # cooperative: every agent sees the SAME shared reward, <= 0
+    assert rewards.shape == (3,)
+    assert float(rewards[0]) == float(rewards[1]) == float(rewards[2])
+    assert float(rewards[0]) <= 0.0
+    assert not bool(term)
+    # truncates at the horizon
+    for _ in range(env.max_episode_steps):
+        state, obs2, rewards, term, trunc = env.step(state, actions)
+    assert bool(trunc)
+
+
+def test_r2d2_loss_consumes_truncations():
+    """Truncations inside a sequence must change the loss (hidden resets +
+    bootstrap-from-next_obs correction) — DONES alone is not enough."""
+    from ray_tpu.rllib import GRUQModule
+    from ray_tpu.rllib.algorithms.r2d2 import _r2d2_loss
+
+    m = GRUQModule(obs_size=4, num_actions=2, hidden_size=8)
+    params = m.init(jax.random.key(0))
+    target = jax.tree.map(lambda x: x * 0.9, params)
+    B, T = 3, 6
+    rng = np.random.default_rng(0)
+    base = {
+        SampleBatch.OBS: rng.normal(size=(B, T, 4)).astype(np.float32),
+        SampleBatch.NEXT_OBS: rng.normal(size=(B, T, 4)).astype(np.float32),
+        SampleBatch.ACTIONS: rng.integers(0, 2, (B, T)).astype(np.int32),
+        SampleBatch.REWARDS: rng.normal(size=(B, T)).astype(np.float32),
+        SampleBatch.DONES: np.zeros((B, T), bool),
+        SampleBatch.TRUNCATEDS: np.zeros((B, T), bool),
+    }
+    loss_fn = _r2d2_loss(m, gamma=0.99, burn_in=0)
+    l_plain, _ = loss_fn(params, {k: jnp.asarray(v) for k, v in base.items()}, target_params=target)
+    trunc = dict(base)
+    tr = np.zeros((B, T), bool)
+    tr[:, 2] = True  # episode cut mid-sequence
+    trunc[SampleBatch.TRUNCATEDS] = tr
+    l_trunc, _ = loss_fn(params, {k: jnp.asarray(v) for k, v in trunc.items()}, target_params=target)
+    assert float(l_plain) != float(l_trunc)
