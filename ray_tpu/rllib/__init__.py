@@ -55,6 +55,7 @@ from ray_tpu.rllib.algorithms.simple_q import (
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig, SimpleSpread
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig, DTModule
 from ray_tpu.rllib.algorithms.bandit import (
     LinearBanditEnv,
     LinTS,
@@ -126,6 +127,9 @@ __all__ = [
     "MADDPG",
     "MADDPGConfig",
     "SimpleSpread",
+    "DT",
+    "DTConfig",
+    "DTModule",
     "LinUCB",
     "LinUCBConfig",
     "LinTS",

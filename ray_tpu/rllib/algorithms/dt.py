@@ -1,0 +1,356 @@
+"""Decision Transformer: offline RL as conditional sequence modeling.
+
+Parity: `rllib_contrib/dt` (Chen et al. — a causal transformer over
+interleaved (return-to-go, state, action) tokens, trained on offline
+trajectories to predict the action given the sequence so far; acting
+conditions on a TARGET return and decrements it by observed rewards).
+
+TPU design: the model is a compact causal transformer built from the same
+dense/attention primitives as `ray_tpu.models` (static [B, 3K] token
+grids, one jitted train step, one jitted act step over a fixed-size
+context window — no dynamic shapes anywhere). Training data comes from the
+offline SampleBatch format (`rllib/offline.py`), with return-to-go
+computed once on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.rl_module import _mlp_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class DTModule:
+    """Causal transformer over (R, s, a) token triples.
+
+    Sequence layout per timestep t: [R_t, s_t, a_t]; the action head reads
+    the S-token positions (which attend to R_t, s_t and all earlier
+    triples — never to a_t itself)."""
+
+    obs_size: int
+    num_actions: int
+    context_length: int = 20  # K timesteps -> 3K tokens
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 2
+
+    def init(self, key: jax.Array):
+        D = self.d_model
+        keys = jax.random.split(key, 6 + self.n_layers)
+        params = {
+            "embed_r": _mlp_init(keys[0], (1, D)),
+            "embed_s": _mlp_init(keys[1], (self.obs_size, D)),
+            "embed_a": jax.random.normal(keys[2], (self.num_actions + 1, D)) * 0.02,
+            "pos": jax.random.normal(keys[3], (self.context_length, D)) * 0.02,
+            "head": _mlp_init(keys[4], (D, D, self.num_actions)),
+            "blocks": [],
+        }
+        for i in range(self.n_layers):
+            k1, k2, k3, k4 = jax.random.split(keys[6 + i], 4)
+            scale = 1.0 / np.sqrt(D)
+            params["blocks"].append(
+                {
+                    "wq": jax.random.normal(k1, (D, D)) * scale,
+                    "wk": jax.random.normal(k2, (D, D)) * scale,
+                    "wv": jax.random.normal(k3, (D, D)) * scale,
+                    "wo": jax.random.normal(k4, (D, D)) * scale,
+                    "ln1": {"g": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                    "ln2": {"g": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                    "mlp": _mlp_init(jax.random.fold_in(k1, 7), (D, 4 * D, D)),
+                }
+            )
+        return params
+
+    @staticmethod
+    def _ln(p, x):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
+
+    def _mlp(self, layers, x):
+        # gelu MLP (the tanh-MLP helper is for policy nets)
+        x = x @ layers[0]["w"] + layers[0]["b"]
+        x = jax.nn.gelu(x)
+        return x @ layers[1]["w"] + layers[1]["b"]
+
+    def _block(self, p, x, mask):
+        B, L, D = x.shape
+        H = self.n_heads
+        h = self._ln(p["ln1"], x)
+        q = (h @ p["wq"]).reshape(B, L, H, D // H)
+        k = (h @ p["wk"]).reshape(B, L, H, D // H)
+        v = (h @ p["wv"]).reshape(B, L, H, D // H)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D // H)
+        att = jnp.where(mask, att, -1e9)
+        att = jax.nn.softmax(att, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, L, D)
+        x = x + out @ p["wo"]
+        x = x + self._mlp(p["mlp"], self._ln(p["ln2"], x))
+        return x
+
+    def action_logits(self, params, rtg, obs, actions):
+        """rtg [B, K], obs [B, K, O], actions [B, K] — the UNSHIFTED action
+        taken at each step (pad index num_actions where not yet taken).
+        The a-token of step t sits AFTER s_t in the stream, so the causal
+        mask hides a_t from its own predictor while exposing a_{t-1} and
+        earlier — no shifting needed. -> logits [B, K, A] at the S tokens."""
+        B, K = rtg.shape
+        D = self.d_model
+        r_tok = rtg[..., None] @ params["embed_r"][0]["w"] + params["embed_r"][0]["b"]
+        s_tok = obs @ params["embed_s"][0]["w"] + params["embed_s"][0]["b"]
+        a_tok = params["embed_a"][actions]
+        pos = params["pos"][:K]
+        # interleave -> [B, 3K, D]
+        toks = jnp.stack([r_tok + pos, s_tok + pos, a_tok + pos], axis=2).reshape(
+            B, 3 * K, D
+        )
+        L = 3 * K
+        causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+        x = toks
+        for p in params["blocks"]:
+            x = self._block(p, x, causal)
+        s_positions = x.reshape(B, K, 3, D)[:, :, 1]  # the S tokens
+        h = s_positions @ params["head"][0]["w"] + params["head"][0]["b"]
+        h = jnp.tanh(h)
+        return h @ params["head"][1]["w"] + params["head"][1]["b"]
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.context_length = 20
+        self.d_model = 64
+        self.n_layers = 2
+        self.n_heads = 2
+        self.train_batch_size = 64
+        self.updates_per_iter = 50
+        self.target_return: float = 200.0
+
+    def offline_data(self, batch: SampleBatch) -> "DTConfig":
+        """Attach the offline experience (time-major [T, B] columns, the
+        shape `offline.record_rollouts` produces)."""
+        self.offline_batch = batch
+        return self
+
+
+class DT(Algorithm):
+    """Trains on offline (R, s, a) sequences; acts by conditioning on
+    ``target_return`` and decrementing it with observed rewards."""
+
+    def setup(self) -> None:
+        cfg: DTConfig = self.config
+        env = cfg.env
+        assert env.discrete, "this DT implementation is discrete-action"
+        assert getattr(cfg, "offline_batch", None) is not None, (
+            "DTConfig.offline_data(batch) is required (offline algorithm)"
+        )
+        self.module = DTModule(
+            env.observation_size,
+            env.num_actions,
+            cfg.context_length,
+            cfg.d_model,
+            cfg.n_layers,
+            cfg.n_heads,
+        )
+        self.params = self.module.init(jax.random.key(cfg.seed))
+        self.tx = optax.adamw(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._build_windows(cfg.offline_batch)
+        self._update = jax.jit(self._make_update())
+        self._act = jax.jit(self._make_act())
+        self._rng = np.random.default_rng(cfg.seed)
+
+    # -- data ---------------------------------------------------------------
+    def _build_windows(self, batch: SampleBatch) -> None:
+        """Index the offline [T, B] columns: compute return-to-go and the
+        list of valid (b, start, n) windows. Window TENSORS are gathered
+        lazily per minibatch — materializing every sliding window up front
+        would copy the dataset ~K-fold."""
+        cfg: DTConfig = self.config
+        K = cfg.context_length
+        self._obs_col = np.asarray(batch[SampleBatch.OBS], np.float32)  # [T, B, O]
+        self._act_col = np.asarray(batch[SampleBatch.ACTIONS], np.int64)  # [T, B]
+        rews = np.asarray(batch[SampleBatch.REWARDS], np.float32)
+        dones = np.asarray(batch[SampleBatch.DONES], bool)
+        if SampleBatch.TRUNCATEDS in batch:
+            dones = dones | np.asarray(batch[SampleBatch.TRUNCATEDS], bool)
+        T, B = self._act_col.shape
+        # return-to-go within episodes (reverse cumulative, reset at dones)
+        rtg = np.zeros_like(rews)
+        acc = np.zeros(B, np.float32)
+        for t in range(T - 1, -1, -1):
+            acc = rews[t] + np.where(dones[t], 0.0, acc)
+            rtg[t] = acc
+        self._rtg_col = rtg
+        # per-column episode run lengths -> valid windows (never straddling
+        # an episode boundary)
+        windows = []
+        for b in range(B):
+            ep_start = 0
+            for t in range(T):
+                if dones[t, b] or t == T - 1:
+                    ep_end = t + 1
+                    for start in range(ep_start, ep_end - 1):
+                        n = min(K, ep_end - start)
+                        if n >= 2:
+                            windows.append((b, start, n))
+                    ep_start = ep_end
+        self._window_index = np.asarray(windows, np.int64)
+
+    def _gather_windows(self, idx: np.ndarray) -> Tuple[np.ndarray, ...]:
+        cfg: DTConfig = self.config
+        K = cfg.context_length
+        pad_a = self.module.num_actions
+        m = len(idx)
+        rtg = np.zeros((m, K), np.float32)
+        obs = np.zeros((m, K, self.module.obs_size), np.float32)
+        act = np.full((m, K), pad_a, np.int64)
+        mask = np.zeros((m, K), np.float32)
+        for row, (b, start, n) in enumerate(self._window_index[idx]):
+            sl = slice(start, start + n)
+            rtg[row, :n] = self._rtg_col[sl, b]
+            obs[row, :n] = self._obs_col[sl, b]
+            act[row, :n] = self._act_col[sl, b]
+            mask[row, :n] = 1.0
+        return rtg, obs, act, mask
+
+    # -- training -----------------------------------------------------------
+    def _make_update(self):
+        m = self.module
+
+        def update(params, opt_state, rtg, obs, act, mask):
+            def loss_fn(p):
+                # the causal layout hides each a_t from its own S-token, so
+                # the SAME array serves as both input tokens and labels
+                logits = m.action_logits(p, rtg, obs, act)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(
+                    logp, jnp.clip(act, 0, m.num_actions - 1)[..., None], axis=-1
+                )[..., 0]
+                return jnp.sum(nll * mask) / jnp.maximum(1.0, mask.sum())
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: DTConfig = self.config
+        n = len(self._window_index)
+        loss = 0.0
+        for _ in range(cfg.updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            rtg, obs, act, mask = self._gather_windows(idx)
+            self.params, self.opt_state, loss = self._update(
+                self.params,
+                self.opt_state,
+                jnp.asarray(rtg),
+                jnp.asarray(obs),
+                jnp.asarray(act),
+                jnp.asarray(mask),
+            )
+        # offline: no env steps are sampled during training
+        return {"bc_loss": float(loss), "num_windows": float(n)}
+
+    # -- acting -------------------------------------------------------------
+    def _make_act(self):
+        m = self.module
+
+        def act(params, rtg, obs, actions, t):
+            logits = m.action_logits(params, rtg[None], obs[None], actions[None])[0]
+            return jnp.argmax(logits[t])
+
+        return act
+
+    def evaluate(self, num_episodes: int = 5, target_return=None) -> Dict[str, float]:
+        """Roll real episodes conditioning on target_return (decremented by
+        observed rewards), greedy action selection. The context window is
+        rebuilt each step from the episode HISTORY, so the prev-action
+        alignment can't drift when the window slides."""
+        cfg: DTConfig = self.config
+        env = cfg.env
+        K = cfg.context_length
+        pad_a = self.module.num_actions
+        O = env.observation_size
+        returns = []
+        key = jax.random.key(cfg.seed + 10_000)
+        for _ in range(num_episodes):
+            key, rk = jax.random.split(key)
+            state, obs0 = env.reset(rk)
+            target = float(
+                target_return if target_return is not None else cfg.target_return
+            )
+            hist_obs: list = []
+            hist_act: list = []
+            hist_rtg: list = []
+            ret, done = 0.0, False
+            while not done and len(hist_obs) < env.max_episode_steps:
+                hist_obs.append(np.asarray(obs0, np.float32))
+                hist_rtg.append(target - ret)
+                start = max(0, len(hist_obs) - K)
+                n = len(hist_obs) - start
+                obs_buf = np.zeros((K, O), np.float32)
+                rtg = np.zeros(K, np.float32)
+                acts = np.full(K, pad_a, np.int64)
+                obs_buf[:n] = np.stack(hist_obs[start:])
+                rtg[:n] = np.asarray(hist_rtg[start:])
+                # unshifted layout: past steps carry their TAKEN action;
+                # the current step's a-slot stays pad (not yet taken, and
+                # causally invisible to its own prediction anyway)
+                if n > 1:
+                    acts[: n - 1] = np.asarray(hist_act[start : start + n - 1])
+                a = int(
+                    self._act(
+                        self.params,
+                        jnp.asarray(rtg),
+                        jnp.asarray(obs_buf),
+                        jnp.asarray(acts),
+                        n - 1,
+                    )
+                )
+                hist_act.append(a)
+                state, obs0, r, term, trunc = env.step(state, jnp.asarray(a))
+                ret += float(r)
+                done = bool(term) or bool(trunc)
+            returns.append(ret)
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "num_episodes": num_episodes,
+            }
+        }
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+DTConfig.algo_class = DT

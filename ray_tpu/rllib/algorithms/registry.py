@@ -21,6 +21,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
     from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
     from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+    from ray_tpu.rllib.algorithms.dt import DT, DTConfig
     from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
     from ray_tpu.rllib.algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig
     from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
@@ -57,6 +58,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
         "ARS": (ARS, ARSConfig),
         "R2D2": (R2D2, R2D2Config),
         "MADDPG": (MADDPG, MADDPGConfig),
+        "DT": (DT, DTConfig),
         "BanditLinUCB": (LinUCB, LinUCBConfig),
         "BanditLinTS": (LinTS, LinTSConfig),
     }

@@ -505,3 +505,79 @@ def test_r2d2_loss_consumes_truncations():
     trunc[SampleBatch.TRUNCATEDS] = tr
     l_trunc, _ = loss_fn(params, {k: jnp.asarray(v) for k, v in trunc.items()}, target_params=target)
     assert float(l_plain) != float(l_trunc)
+
+
+def _cartpole_offline_data(T=200, n_good=5, n_random=5, seed=0):
+    """Time-major [T, B] offline columns from a scripted heuristic policy
+    (push toward the pole's lean — solves CartPole) mixed with random."""
+    env = CartPole()
+    B = n_good + n_random
+    key = jax.random.key(seed)
+    rng = np.random.default_rng(seed)
+    cols = {k: [] for k in ["obs", "actions", "rewards", "dones", "truncateds"]}
+    states, obs = [], []
+    for b in range(B):
+        key, rk = jax.random.split(key)
+        s, o = env.reset(rk)
+        states.append(s)
+        obs.append(np.asarray(o))
+    for t in range(T):
+        step_obs, step_act, step_rew, step_done, step_trunc = [], [], [], [], []
+        for b in range(B):
+            o = obs[b]
+            if b < n_good:
+                a = int(o[2] + 0.5 * o[3] > 0)  # lean-following heuristic
+            else:
+                a = int(rng.integers(0, 2))
+            s2, o2, r, term, trunc = env.step(states[b], jnp.asarray(a))
+            step_obs.append(o)
+            step_act.append(a)
+            step_rew.append(float(r))
+            step_done.append(bool(term))
+            step_trunc.append(bool(trunc))
+            if bool(term) or bool(trunc):
+                key, rk = jax.random.split(key)
+                s2, o2 = env.reset(rk)
+            states[b], obs[b] = s2, np.asarray(o2)
+        cols["obs"].append(np.stack(step_obs))
+        cols["actions"].append(np.asarray(step_act))
+        cols["rewards"].append(np.asarray(step_rew, np.float32))
+        cols["dones"].append(np.asarray(step_done))
+        cols["truncateds"].append(np.asarray(step_trunc))
+    return SampleBatch({k: np.stack(v) for k, v in cols.items()})
+
+
+def test_decision_transformer_conditions_on_return():
+    """DT trains on mixed-quality offline data and, conditioned on a HIGH
+    target return, clearly beats the random half of its training data."""
+    from ray_tpu.rllib import DTConfig
+
+    data = _cartpole_offline_data()
+    config = (
+        DTConfig()
+        .environment(CartPole())
+        .training(
+            context_length=16,
+            d_model=64,
+            n_layers=2,
+            updates_per_iter=60,
+            train_batch_size=64,
+            target_return=200.0,
+        )
+        .debugging(seed=0)
+        .offline_data(data)
+    )
+    algo = config.build()
+    first = algo.train()["learners"]["bc_loss"]
+    last = None
+    for _ in range(4):
+        last = algo.train()["learners"]["bc_loss"]
+    assert last < first  # sequence model fits the data
+    ev = algo.evaluate(num_episodes=5)["evaluation"]
+    # random CartPole averages ~20; return-conditioned DT must do far better
+    assert ev["episode_return_mean"] > 60.0, ev
+    # checkpoint roundtrip
+    algo2 = config.copy().build()
+    algo2.set_state(algo.get_state())
+    for a, b in zip(jax.tree.leaves(algo.params), jax.tree.leaves(algo2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
