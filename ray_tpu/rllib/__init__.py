@@ -56,6 +56,7 @@ from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig, SimpleSpread
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig, DTModule
+from ray_tpu.rllib.algorithms.qmix import DiscreteSpread, QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.bandit import (
     LinearBanditEnv,
     LinTS,
@@ -130,6 +131,9 @@ __all__ = [
     "DT",
     "DTConfig",
     "DTModule",
+    "QMIX",
+    "QMIXConfig",
+    "DiscreteSpread",
     "LinUCB",
     "LinUCBConfig",
     "LinTS",

@@ -1,0 +1,304 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Parity: `rllib_contrib/qmix` (Rashid et al. — per-agent utility networks
+Q_i(o_i, a_i) combined by a mixing network whose weights are produced by
+hypernetworks over the GLOBAL state and constrained non-negative, so
+argmax_a Q_tot decomposes into per-agent argmaxes; trained end-to-end with
+TD on the joint reward).
+
+TPU design: per-agent utility params are stacked on a leading agent axis
+(one vmap evaluates all agents), the mixing hypernetwork is a plain jitted
+function of the global state, and rollouts ride a vmapped `lax.scan` over
+a pure-JAX discrete multi-agent env (`DiscreteSpread` below — the
+grid-action variant of `maddpg.SimpleSpread`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import _soft_update
+from ray_tpu.rllib.algorithms.maddpg import SimpleSpread
+from ray_tpu.rllib.env_runner import _tree_where
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+# the 5 grid moves: stay, +x, -x, +y, -y
+_MOVES = np.array([[0, 0], [1, 0], [-1, 0], [0, 1], [0, -1]], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteSpread(SimpleSpread):
+    """SimpleSpread with 5 discrete moves per agent (QMIX needs discrete
+    per-agent action spaces). Inherits dynamics/reward/obs; actions are
+    indices into the move table."""
+
+    num_actions: int = 5
+
+    def step(self, state, actions: jax.Array):
+        vel_cmd = jnp.asarray(_MOVES)[actions]  # [N, 2]
+        return super().step(state, vel_cmd)
+
+    def global_state(self, state) -> jax.Array:
+        """The mixing hypernetwork's input: all positions + landmarks."""
+        return jnp.concatenate(
+            [state["pos"].reshape(-1), state["vel"].reshape(-1), state["lm"].reshape(-1)]
+        )
+
+    @property
+    def global_state_size(self) -> int:
+        return 6 * self.n_agents
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.mixing_embed = 32
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_update_tau = 0.01
+        self.num_updates_per_iter = 4
+        self.train_batch_size = 128
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 20_000
+        self.num_envs_per_runner = 8
+        self.rollout_length = 25
+
+
+class _QMixNets:
+    """Stacked per-agent utility nets + the monotonic mixer."""
+
+    def __init__(self, env: DiscreteSpread, hidden, embed: int, key: jax.Array):
+        self.env = env
+        self.embed = embed
+        N, O, A, S = env.n_agents, env.observation_size, env.num_actions, env.global_state_size
+        ku, k1, k2, k3, k4 = jax.random.split(key, 5)
+
+        def init_agent(k):
+            return {"q": _mlp_init(k, (O, *hidden, A))}
+
+        self.params = {
+            "agents": jax.vmap(init_agent)(jax.random.split(ku, N)),
+            # hypernetworks: global state -> mixer weights (abs() at use
+            # enforces monotonicity) and biases
+            "hyper_w1": _mlp_init(k1, (S, N * embed)),
+            "hyper_b1": _mlp_init(k2, (S, embed)),
+            "hyper_w2": _mlp_init(k3, (S, embed)),
+            "hyper_b2": _mlp_init(k4, (S, embed, 1)),
+        }
+
+    @staticmethod
+    def agent_qs(params, obs):
+        """obs [..., N, O] -> per-agent Q values [..., N, A]."""
+        return jax.vmap(
+            lambda p_i, o_i: _mlp_apply(p_i["q"], o_i), in_axes=(0, -2), out_axes=-2
+        )(params["agents"], obs)
+
+    def mix(self, params, chosen_qs, global_state):
+        """chosen_qs [..., N], global_state [..., S] -> Q_tot [...].
+        Weights go through abs(): dQ_tot/dQ_i >= 0 (the QMIX constraint)."""
+        N, E = self.env.n_agents, self.embed
+        w1 = jnp.abs(_mlp_apply(params["hyper_w1"], global_state)).reshape(
+            global_state.shape[:-1] + (N, E)
+        )
+        b1 = _mlp_apply(params["hyper_b1"], global_state)
+        h = jax.nn.elu(jnp.einsum("...n,...ne->...e", chosen_qs, w1) + b1)
+        w2 = jnp.abs(_mlp_apply(params["hyper_w2"], global_state))
+        b2 = _mlp_apply(params["hyper_b2"], global_state)[..., 0]
+        return jnp.sum(h * w2, axis=-1) + b2
+
+
+class QMIX(Algorithm):
+    def setup(self) -> None:
+        cfg: QMIXConfig = self.config
+        env = cfg.env
+        assert isinstance(env, DiscreteSpread) or hasattr(env, "global_state"), (
+            "QMIX needs a discrete multi-agent env with a global_state view"
+        )
+        self.env = env
+        self.nets = _QMixNets(env, cfg.hidden, cfg.mixing_embed, jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.nets.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.nets.params)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._reset_v = jax.vmap(env.reset)
+        self._step_v = jax.vmap(env.step)
+        self._gs_v = jax.vmap(env.global_state)
+        self._env_state = None
+        self._rollout = jax.jit(self._make_rollout())
+        self._update = jax.jit(self._make_update())
+
+    def _epsilon(self) -> float:
+        cfg: QMIXConfig = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    # -- sampling -----------------------------------------------------------
+    def _make_rollout(self):
+        cfg: QMIXConfig = self.config
+        B = cfg.num_envs_per_runner
+        A = self.env.num_actions
+
+        def rollout(params, key, env_state, obs, ep_ret, eps):
+            def step(carry, _):
+                env_state, obs, ep_ret, key = carry
+                key, ak, rk, ek = jax.random.split(key, 4)
+                qs = _QMixNets.agent_qs(params, obs)  # [B, N, A]
+                greedy = jnp.argmax(qs, axis=-1)
+                rand = jax.random.randint(ak, greedy.shape, 0, A)
+                explore = jax.random.uniform(ek, greedy.shape) < eps
+                act = jnp.where(explore, rand, greedy)
+                gs = self._gs_v(env_state)
+                env_state2, next_obs, rewards, term, trunc = self._step_v(env_state, act)
+                done = term | trunc
+                ep_ret2 = ep_ret + rewards.sum(axis=-1) / self.env.n_agents
+                completed = jnp.where(done, ep_ret2, jnp.nan)
+                reset_state, reset_obs = self._reset_v(jax.random.split(rk, B))
+                env_state3 = _tree_where(done, reset_state, env_state2)
+                obs_after = _tree_where(done, reset_obs, next_obs)
+                rec = {
+                    SampleBatch.OBS: obs,
+                    SampleBatch.ACTIONS: act,
+                    SampleBatch.REWARDS: rewards[..., 0],  # shared scalar
+                    SampleBatch.NEXT_OBS: next_obs,
+                    "global_state": gs,
+                    "next_global_state": self._gs_v(env_state2),
+                    SampleBatch.DONES: term,
+                    SampleBatch.TRUNCATEDS: trunc,
+                    "_completed_return": completed,
+                }
+                return (env_state3, obs_after, jnp.where(done, 0.0, ep_ret2), key), rec
+
+            (env_state, obs, ep_ret, key), traj = jax.lax.scan(
+                step, (env_state, obs, ep_ret, key), None, length=cfg.rollout_length
+            )
+            return env_state, obs, ep_ret, key, traj
+
+        return rollout
+
+    # -- learning -----------------------------------------------------------
+    def _make_update(self):
+        cfg: QMIXConfig = self.config
+        nets = self.nets
+
+        def update(params, target_params, opt_state, batch):
+            obs = batch[SampleBatch.OBS]  # [B, N, O]
+            act = batch[SampleBatch.ACTIONS].astype(jnp.int32)  # [B, N]
+            rew = batch[SampleBatch.REWARDS]  # [B] shared
+            done = batch[SampleBatch.DONES].astype(jnp.float32)
+            gs = batch["global_state"]
+            next_gs = batch["next_global_state"]
+            next_obs = batch[SampleBatch.NEXT_OBS]
+
+            # double-Q at the team level: online nets pick per-agent argmax,
+            # target nets evaluate, the TARGET mixer combines
+            next_q_online = _QMixNets.agent_qs(params, next_obs)
+            next_a = jnp.argmax(next_q_online, axis=-1)
+            next_q_target = _QMixNets.agent_qs(target_params, next_obs)
+            next_chosen = jnp.take_along_axis(next_q_target, next_a[..., None], -1)[..., 0]
+            next_tot = nets.mix(target_params, next_chosen, next_gs)
+            target = rew + cfg.gamma * (1.0 - done) * jax.lax.stop_gradient(next_tot)
+
+            def loss_fn(p):
+                qs = _QMixNets.agent_qs(p, obs)
+                chosen = jnp.take_along_axis(qs, act[..., None], -1)[..., 0]
+                tot = nets.mix(p, chosen, gs)
+                return jnp.mean((tot - jax.lax.stop_gradient(target)) ** 2), jnp.mean(tot)
+
+            (loss, q_mean), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = _soft_update(target_params, params, cfg.target_update_tau)
+            return params, target_params, opt_state, {"loss": loss, "q_tot_mean": q_mean}
+
+        return update
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: QMIXConfig = self.config
+        B = cfg.num_envs_per_runner
+        eps = jnp.asarray(self._epsilon())
+        if self._env_state is None:
+            self._key, rk = jax.random.split(self._key)
+            self._env_state, self._obs = self._reset_v(jax.random.split(rk, B))
+            self._ep_ret = jnp.zeros((B,))
+        self._env_state, self._obs, self._ep_ret, self._key, traj = self._rollout(
+            self.nets.params, self._key, self._env_state, self._obs, self._ep_ret, eps
+        )
+        traj = {k: np.asarray(v) for k, v in traj.items()}
+        completed = traj.pop("_completed_return")
+        ep_returns = [float(r) for r in completed[~np.isnan(completed)]]
+        self._record_episodes(ep_returns, cfg.rollout_length * B)
+        self.buffer.add(
+            SampleBatch({k: v.reshape((-1,) + v.shape[2:]) for k, v in traj.items()})
+        )
+        stats: Dict[str, float] = {"epsilon": float(eps)}
+        if len(self.buffer) < cfg.learning_starts:
+            return stats
+        for _ in range(cfg.num_updates_per_iter):
+            sample = self.buffer.sample(cfg.train_batch_size)
+            jbatch = {k: jnp.asarray(v) for k, v in sample.items()}
+            self.nets.params, self.target_params, self.opt_state, raw = self._update(
+                self.nets.params, self.target_params, self.opt_state, jbatch
+            )
+            stats.update({k: float(v) for k, v in raw.items()})
+        return stats
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy joint policy (per-agent argmax — exactly the policy the
+        monotonic mixer certifies as the Q_tot argmax)."""
+        key = jax.random.key(self.config.seed + 10_000)
+        B = max(1, num_episodes)
+        state, obs = self._reset_v(jax.random.split(key, B))
+
+        def step(carry, _):
+            state, obs, ret = carry
+            act = jnp.argmax(_QMixNets.agent_qs(self.nets.params, obs), axis=-1)
+            state, obs2, rewards, term, trunc = self._step_v(state, act)
+            return (state, obs2, ret + rewards.sum(axis=-1) / self.env.n_agents), None
+
+        (state, obs, rets), _ = jax.lax.scan(
+            step, (state, obs, jnp.zeros((B,))), None, length=self.env.max_episode_steps
+        )
+        rets = np.asarray(rets)[:num_episodes]
+        return {
+            "evaluation": {
+                "episode_return_mean": float(rets.mean()),
+                "episode_return_min": float(rets.min()),
+                "episode_return_max": float(rets.max()),
+                "num_episodes": int(len(rets)),
+            }
+        }
+
+    def get_state(self):
+        return {
+            "params": self.nets.params,
+            "target_params": self.target_params,
+            "opt_state": self.opt_state,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state) -> None:
+        self.nets.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+QMIXConfig.algo_class = QMIX

@@ -28,6 +28,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
     from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
     from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, A3C, A3CConfig, PG, PGConfig
     from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+    from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
     from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
     from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
     from ray_tpu.rllib.algorithms.simple_q import (
@@ -59,6 +60,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
         "R2D2": (R2D2, R2D2Config),
         "MADDPG": (MADDPG, MADDPGConfig),
         "DT": (DT, DTConfig),
+        "QMIX": (QMIX, QMIXConfig),
         "BanditLinUCB": (LinUCB, LinUCBConfig),
         "BanditLinTS": (LinTS, LinTSConfig),
     }
