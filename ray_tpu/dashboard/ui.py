@@ -111,7 +111,7 @@ async function refresh() {
       const tot = (n.resources_total || {})["CPU"] ?? 0;
       const avail = (n.resources_available || {})["CPU"] ?? 0;
       const used = tot - avail;
-      return [esc(n.node_id.slice(0, 12)),
+      return [`<a style="color:#7fd1b9;cursor:pointer" onclick="showNodeDetail('${esc(n.node_id)}')">${esc(n.node_id.slice(0, 12))}</a>`,
         `<span class="${n.state === 'ALIVE' ? 'ok' : 'bad'}">${esc(n.state)}</span>`,
         esc(n.address || ""),
         `<span class="num">${used.toFixed(1)}/${tot.toFixed(1)}</span>`, bar(used, tot),
@@ -125,12 +125,7 @@ async function refresh() {
       `<span class="${a.state === 'ALIVE' ? 'ok' : a.state === 'DEAD' ? 'bad' : ''}">${esc(a.state)}</span>`,
       esc((a.node_id || "").slice(0, 12)), esc(a.restarts + "/" + a.max_restarts)]));
   if (taskList) rows($("tasks"), ["task", "name", "state", "node", "attempt", "duration"],
-    (taskList.tasks || []).slice(-12).reverse().map(t => [
-      `<a style="color:#7fd1b9;cursor:pointer" onclick="showDetail('tasks','${esc(t.task_id || "")}')">${esc((t.task_id || "").slice(0, 12))}</a>`,
-      esc(t.name || ""),
-      `<span class="${t.state === 'FINISHED' ? 'ok' : t.state === 'FAILED' ? 'bad' : ''}">${esc(t.state || "")}</span>`,
-      esc((t.node_id || "").slice(0, 12)), esc(t.attempt ?? 0),
-      t.duration_s == null ? "" : `<span class="num">${(+t.duration_s).toFixed(3)}s</span>`]));
+    (taskList.tasks || []).slice(-12).reverse().map(taskRow));
   const work = [];
   if (status) work.push(["pending tasks", `<span class="num">${status.pending_tasks}</span>`]);
   if (tasks) work.push(["tasks total", `<span class="num">${tasks.total_tasks ?? 0}</span>`]);
@@ -226,6 +221,45 @@ async function refreshTransfers() {
         `<span class="num">${last.rows_out ?? 0}</span>`,
         `<span class="num">${fmtBytes(last.bytes_out)}</span>`];
     }));
+}
+async function showNodeDetail(nodeId) {
+  // per-node drill-down: identity + its actors/tasks + utilization tail
+  const [nodes, actors, tasks, hist, logs] = await Promise.all([
+    get("/api/nodes"), get("/api/actors?limit=1000"), get("/api/tasks?limit=1000"),
+    get(`/api/nodes/${nodeId}/metrics?minutes=1`), get(`/api/nodes/${nodeId}/logs?lines=6`),
+  ]);
+  const n = ((nodes || {}).nodes || []).find(x => x.node_id === nodeId);
+  if (!n) return;
+  $("detailsec").style.display = "";
+  $("detailtitle").textContent = "Node " + nodeId.slice(0, 16) + (n.is_head ? " ★head" : "");
+  const myActors = ((actors || {}).actors || []).filter(a => a.node_id === nodeId);
+  const myTasks = ((tasks || {}).tasks || []).filter(t => t.node_id === nodeId);
+  const kv = [
+    ["state", esc(n.state)], ["address", esc(n.address || "(in-process)")],
+    ["resources", esc(JSON.stringify(n.resources_total))],
+    ["available", esc(JSON.stringify(n.resources_available))],
+    ["labels", esc(JSON.stringify(n.labels || {}))],
+    ["actors here", `<span class="num">${myActors.length}</span> ` +
+      esc(myActors.slice(0, 8).map(a => a.class_name).join(", "))],
+    ["recent tasks here", `<span class="num">${myTasks.length}</span>`],
+  ];
+  const pts = ((hist || {}).series) || [];
+  if (pts.length) kv.push(["cpu now", `<span class="num">${(pts[pts.length-1].cpu_percent ?? 0).toFixed(0)}%</span>`]);
+  if (logs && (logs.lines || []).length)
+    kv.push(["log tail", `<pre style="margin:0">${esc(logs.lines.join("\\n"))}</pre>`]);
+  rows($("detailkv"), ["field", "value"], kv);
+  rows($("detailevents"), ["task", "name", "state", "node", "attempt", "duration"],
+    myTasks.slice(-20).reverse().map(taskRow));
+  $("detailsec").scrollIntoView({behavior: "smooth"});
+}
+function taskRow(t) {
+  // the one task-row formatter: main table, task detail, node drill-down
+  return [
+    `<a style="color:#7fd1b9;cursor:pointer" onclick="showDetail('tasks','${esc(t.task_id || "")}')">${esc((t.task_id || "").slice(0, 12))}</a>`,
+    esc(t.name || ""),
+    `<span class="${t.state === 'FINISHED' ? 'ok' : t.state === 'FAILED' ? 'bad' : ''}">${esc(t.state || "")}</span>`,
+    esc((t.node_id || "").slice(0, 12)), esc(t.attempt ?? 0),
+    t.duration_s == null ? "" : `<span class="num">${(+t.duration_s).toFixed(3)}s</span>`];
 }
 async function showDetail(kind, id) {
   const d = await get(`/api/${kind}/${id}`);
