@@ -1,0 +1,53 @@
+"""LLM engine tour: continuous batching over a shared KV cache.
+
+The engine admits requests mid-flight into fixed decode slots — arriving
+prompts prefill into a bucketed shape while earlier requests keep
+decoding. Greedy outputs are IDENTICAL to the one-shot ``generate()``
+path (the engine is an execution strategy, not a different model)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import TransformerConfig, generate, init_params
+from ray_tpu.serve.llm import LLMEngine
+
+
+def main():
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        attention="dense", dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.key(11))
+    engine = LLMEngine(cfg, params, max_batch_size=4, max_seq_len=64)
+
+    prompts = [[3, 14, 15, 9], [2, 71, 8], [28, 18, 2, 8, 45]]
+    outs = [None] * len(prompts)
+
+    def run(i):
+        outs[i] = engine.generate(prompts[i], max_tokens=8, temperature=0)
+
+    # concurrent submitters: the engine batches them into shared decode steps
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # each continuation matches the one-shot reference exactly
+    for p, got in zip(prompts, outs):
+        ref, lens = generate(
+            cfg, params, jnp.asarray([p], jnp.int32), max_new_tokens=8, temperature=0
+        )
+        expect = np.asarray(ref[0, len(p): int(lens[0])]).tolist()
+        assert got == expect, (got, expect)
+
+    stats = engine.stats()
+    print("llm tour OK:", {k: stats[k] for k in sorted(stats)[:4]})
+    engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -56,3 +56,12 @@ def test_example_serve_deployment():
 @pytest.mark.full
 def test_example_rllib_ppo():
     assert "rllib tour OK" in _run("06_rllib_ppo.py")
+
+
+def test_example_workflows():
+    assert "workflow tour OK" in _run("08_workflows.py")
+
+
+@pytest.mark.full
+def test_example_llm_serving():
+    assert "llm tour OK" in _run("09_llm_serving.py")
