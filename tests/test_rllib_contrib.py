@@ -44,6 +44,7 @@ def test_registry_resolves_every_algorithm():
         get_algorithm_class("nope")
 
 
+@pytest.mark.full
 def test_a2c_learns_cartpole():
     config = (
         A2CConfig()
@@ -225,6 +226,7 @@ def test_apex_epsilon_ladder_and_priority_writeback():
     algo.stop()
 
 
+@pytest.mark.full
 def test_es_learns_cartpole():
     config = (
         ESConfig()
@@ -245,6 +247,7 @@ def test_es_learns_cartpole():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.full
 def test_ars_learns_cartpole_with_obs_normalization():
     config = (
         ARSConfig()
@@ -418,6 +421,7 @@ def test_gru_module_unroll_matches_stepwise():
         np.testing.assert_allclose(np.asarray(q), np.asarray(q_scan[t]), rtol=1e-5)
 
 
+@pytest.mark.full
 def test_maddpg_learns_simple_spread():
     """MADDPG on the pure-JAX cooperative navigation env: stacked per-agent
     params, centralized critics, shared reward improves."""
@@ -547,6 +551,7 @@ def _cartpole_offline_data(T=200, n_good=5, n_random=5, seed=0):
     return SampleBatch({k: np.stack(v) for k, v in cols.items()})
 
 
+@pytest.mark.full
 def test_decision_transformer_conditions_on_return():
     """DT trains on mixed-quality offline data and, conditioned on a HIGH
     target return, clearly beats the random half of its training data."""
@@ -583,6 +588,7 @@ def test_decision_transformer_conditions_on_return():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.full
 def test_qmix_learns_discrete_spread_with_monotone_mixer():
     """QMIX: per-agent argmax policy improves the SHARED return, and the
     mixer is monotone in every agent utility (the QMIX constraint)."""
