@@ -57,6 +57,7 @@ from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig, SimpleSpread
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig, DTModule
 from ray_tpu.rllib.algorithms.qmix import DiscreteSpread, QMIX, QMIXConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.bandit import (
     LinearBanditEnv,
     LinTS,
@@ -134,6 +135,8 @@ __all__ = [
     "QMIX",
     "QMIXConfig",
     "DiscreteSpread",
+    "CRR",
+    "CRRConfig",
     "LinUCB",
     "LinUCBConfig",
     "LinTS",

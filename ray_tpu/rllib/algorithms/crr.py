@@ -1,0 +1,271 @@
+"""CRR: critic-regularized regression for offline continuous control.
+
+Parity: `rllib_contrib/crr` (Wang et al. — advantage-weighted behavior
+cloning: maximize log pi(a|s) * f(A(s,a)) on DATASET actions, where
+A = Q(s,a) - E_{a'~pi} Q(s,a') and f is the binary indicator 1[A>0] or
+exp(A/beta); the critic trains by ordinary TD with policy-sampled next
+actions. Unlike plain BC, bad dataset actions get zero (or exponentially
+small) weight — the policy imitates only what the critic endorses).
+
+TPU design: one jitted update computes critic TD and the weighted-BC actor
+step together; the advantage baseline E_{a'~pi}Q uses m policy samples
+drawn inside the jit (vmapped over the sample axis). Offline only — no env
+sampling; data arrives as a SampleBatch like BC/MARWIL/CQL.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import _soft_update
+from ray_tpu.rllib.rl_module import SACModule, _mlp_apply
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _tanh_gauss_log_prob(module: SACModule, params, obs, action):
+    """log pi(action|obs) for the tanh-squashed gaussian policy — the
+    inverse of SACModule.sample_action's squash + affine scale."""
+    lo, hi = module.action_low, module.action_high
+    span = 0.5 * (hi - lo)
+    tanh_a = jnp.clip((action - lo) / (hi - lo) * 2.0 - 1.0, -0.999999, 0.999999)
+    # cap the inverse: a dataset action AT the bound has atanh -> inf, and a
+    # handful of such rows would otherwise dominate the weighted-BC mean and
+    # saturate the policy (raw |3| already maps to tanh 0.995)
+    raw = jnp.clip(jnp.arctanh(tanh_a), -3.0, 3.0)
+    out = _mlp_apply(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, -10.0, 2.0)
+    std = jnp.exp(log_std)
+    logp = jnp.sum(
+        -0.5 * ((raw - mean) ** 2 / std**2 + 2 * log_std + math.log(2 * math.pi)),
+        axis=-1,
+    )
+    logp -= jnp.sum(jnp.log((1 - tanh_a**2) * span + 1e-6), axis=-1)
+    return logp
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.critic_lr = 1e-3
+        self.target_update_tau = 0.005
+        self.train_batch_size = 256
+        self.updates_per_iter = 50
+        # critic-only updates before the actor starts: an underfit critic's
+        # slope would launch the policy toward a bound it can't return from
+        # (weights go ~0 there, so the BC gradient vanishes)
+        self.critic_warmup_updates = 400
+        self.advantage_samples = 4  # m policy samples for the baseline
+        self.weight_fn = "bin"  # "bin" (1[A>0]) | "exp" (exp(A/beta), capped)
+        self.beta = 1.0
+        self.weight_cap = 20.0
+
+    def offline_data(self, batch: SampleBatch) -> "CRRConfig":
+        self.offline_batch = batch
+        return self
+
+
+class CRR(Algorithm):
+    def setup(self) -> None:
+        cfg: CRRConfig = self.config
+        env = cfg.env
+        assert not env.discrete, "this CRR implementation is continuous-action"
+        assert getattr(cfg, "offline_batch", None) is not None, (
+            "CRRConfig.offline_data(batch) is required (offline algorithm)"
+        )
+        self.module = SACModule(
+            env.observation_size,
+            env.action_size,
+            env.action_low,
+            env.action_high,
+            cfg.hidden,
+        )
+        self.params = self.module.init(jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.critic_lr)
+        self.actor_opt = self.actor_tx.init(self.params)
+        self.critic_opt = self.critic_tx.init(self.params)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._data = {
+            k: np.asarray(v)
+            for k, v in cfg.offline_batch.as_numpy().items()
+        }
+        # offline columns may be [T, B, ...]: flatten to rows
+        if self._data[SampleBatch.ACTIONS].ndim == 3 or (
+            self._data[SampleBatch.REWARDS].ndim == 2
+        ):
+            self._data = {
+                k: v.reshape((-1,) + v.shape[2:]) for k, v in self._data.items()
+            }
+        self._rng = np.random.default_rng(cfg.seed)
+        self._updates = 0
+        self._update = jax.jit(self._make_update(), static_argnames=("do_actor",))
+        self._act = jax.jit(self.module.inference_action)
+
+    def _make_update(self):
+        cfg: CRRConfig = self.config
+        m = self.module
+
+        def update(params, target_params, actor_opt, critic_opt, batch, key, do_actor: bool):
+            obs = batch[SampleBatch.OBS]
+            act = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.DONES].astype(jnp.float32)
+            next_obs = batch[SampleBatch.NEXT_OBS]
+            knext, kadv = jax.random.split(key)
+
+            # -- critic: TD with policy-sampled next actions ---------------
+            next_a, _ = m.sample_action(params, next_obs, knext)
+            tq1, tq2 = m.q_values(target_params, next_obs, next_a)
+            target = jax.lax.stop_gradient(
+                rew + cfg.gamma * (1.0 - done) * jnp.minimum(tq1, tq2)
+            )
+
+            def critic_loss(p):
+                q1, q2 = m.q_values(p, obs, act)
+                return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            cgrads = {**cgrads, "pi": jax.tree.map(jnp.zeros_like, cgrads["pi"])}
+            cupd, critic_opt = self.critic_tx.update(cgrads, critic_opt, params)
+            params = optax.apply_updates(params, cupd)
+
+            if not do_actor:
+                return params, target_params, actor_opt, critic_opt, {
+                    "critic_loss": closs,
+                    "actor_loss": jnp.zeros(()),
+                    "weight_mean": jnp.zeros(()),
+                    "advantage_mean": jnp.zeros(()),
+                }
+
+            # -- advantage of the DATASET action vs the policy baseline ----
+            def baseline_q(p, k):
+                def one(ki):
+                    a_s, _ = m.sample_action(p, obs, ki)
+                    q1, q2 = m.q_values(p, obs, a_s)
+                    return jnp.minimum(q1, q2)
+
+                qs = jax.vmap(one)(jax.random.split(k, cfg.advantage_samples))
+                return qs.mean(axis=0)
+
+            q1d, q2d = m.q_values(params, obs, act)
+            adv = jnp.minimum(q1d, q2d) - baseline_q(params, kadv)
+            adv = jax.lax.stop_gradient(adv)
+            if cfg.weight_fn == "bin":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / cfg.beta), cfg.weight_cap)
+
+            # -- actor: advantage-weighted BC on dataset actions -----------
+            def actor_loss(p):
+                logp = _tanh_gauss_log_prob(m, p, obs, act)
+                return -jnp.mean(w * logp)
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(params)
+            agrads = {
+                "pi": agrads["pi"],
+                "q1": jax.tree.map(jnp.zeros_like, agrads["q1"]),
+                "q2": jax.tree.map(jnp.zeros_like, agrads["q2"]),
+            }
+            aupd, actor_opt = self.actor_tx.update(agrads, actor_opt, params)
+            params = optax.apply_updates(params, aupd)
+            target_params = _soft_update(target_params, params, cfg.target_update_tau)
+            stats = {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+                "weight_mean": jnp.mean(w),
+                "advantage_mean": jnp.mean(adv),
+            }
+            return params, target_params, actor_opt, critic_opt, stats
+
+        return update
+
+    def training_step(self) -> Dict[str, float]:
+        cfg: CRRConfig = self.config
+        n = len(self._data[SampleBatch.REWARDS])
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            jbatch = {k: jnp.asarray(v[idx]) for k, v in self._data.items()}
+            self._key, uk = jax.random.split(self._key)
+            (
+                self.params,
+                self.target_params,
+                self.actor_opt,
+                self.critic_opt,
+                raw,
+            ) = self._update(
+                self.params,
+                self.target_params,
+                self.actor_opt,
+                self.critic_opt,
+                jbatch,
+                uk,
+                do_actor=(self._updates >= cfg.critic_warmup_updates),
+            )
+            self._updates += 1
+            stats = raw
+        # one device->host sync for the LAST update's stats, not one per step
+        return {k: float(v) for k, v in stats.items()}
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Deterministic tanh(mean) policy over fresh env episodes."""
+        cfg: CRRConfig = self.config
+        env = cfg.env
+        key = jax.random.key(cfg.seed + 10_000)
+        returns = []
+        act_fn = self._act
+        for _ in range(num_episodes):
+            key, rk = jax.random.split(key)
+            state, obs = env.reset(rk)
+            ret, done, steps = 0.0, False, 0
+            while not done and steps < env.max_episode_steps:
+                a = act_fn(self.params, jnp.asarray(obs))
+                state, obs, r, term, trunc = env.step(state, a)
+                ret += float(r)
+                done = bool(term) or bool(trunc)
+                steps += 1
+            returns.append(ret)
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "num_episodes": num_episodes,
+            }
+        }
+
+    def get_state(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "actor_opt": self.actor_opt,
+            "critic_opt": self.critic_opt,
+            "updates": self._updates,
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+
+    def set_state(self, state) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.actor_opt = state["actor_opt"]
+        self.critic_opt = state["critic_opt"]
+        self._updates = state.get("updates", self.config.critic_warmup_updates)
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self) -> None:
+        pass
+
+
+CRRConfig.algo_class = CRR

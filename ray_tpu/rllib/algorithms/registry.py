@@ -18,6 +18,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
     )
     from ray_tpu.rllib.algorithms.bc import BC, BCConfig
     from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+    from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
     from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
     from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
@@ -61,6 +62,7 @@ def _load() -> Dict[str, Tuple[type, Callable]]:
         "MADDPG": (MADDPG, MADDPGConfig),
         "DT": (DT, DTConfig),
         "QMIX": (QMIX, QMIXConfig),
+        "CRR": (CRR, CRRConfig),
         "BanditLinUCB": (LinUCB, LinUCBConfig),
         "BanditLinTS": (LinTS, LinTSConfig),
     }
