@@ -140,6 +140,11 @@ class CRR(Algorithm):
             params = optax.apply_updates(params, cupd)
 
             if not do_actor:
+                # the target must track the critic during warmup too, or
+                # every warmup TD step bootstraps off the frozen random init
+                target_params = _soft_update(
+                    target_params, params, cfg.target_update_tau
+                )
                 return params, target_params, actor_opt, critic_opt, {
                     "critic_loss": closs,
                     "actor_loss": jnp.zeros(()),

@@ -27,6 +27,9 @@ class TrainContext:
     trial_dir: str = "/tmp"
     devices: List[Any] = field(default_factory=list)
     mesh: Any = None
+    # unique per worker-gang attempt; scopes cross-rank rendezvous keys so
+    # retries / concurrent same-name runs can never read each other's state
+    group_token: str = ""
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -56,6 +59,10 @@ class TrainContext:
     def get_mesh(self):
         """This worker's ``jax.sharding.Mesh`` over its assigned devices."""
         return self.mesh
+
+    def get_group_token(self) -> str:
+        """Opaque id shared by all ranks of one gang attempt."""
+        return self.group_token
 
 
 class _Session:

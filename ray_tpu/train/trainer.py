@@ -62,7 +62,13 @@ class DataConfig:
         for name, ds in (datasets or {}).items():
             split = self._datasets_to_split is None or name in self._datasets_to_split
             if split and num_workers > 1:
-                parts = ds.split(num_workers)
+                # Row-balanced, not block-greedy: reference Train shards via
+                # streaming_split(equal=True), which splits *blocks* when
+                # needed — a single-block dataset must not shard [all, 0].
+                mat = ds.materialize() if hasattr(ds, "materialize") else ds
+                total = mat.count()
+                cuts = [(i * total) // num_workers for i in range(1, num_workers)]
+                parts = mat.split_at_indices(cuts)
                 for i in range(num_workers):
                     shards[i][name] = parts[i]
             else:

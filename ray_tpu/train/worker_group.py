@@ -36,6 +36,7 @@ class TrainWorkerActor:
         experiment_name: str,
         trial_dir: str,
         pin_devices: bool = True,
+        group_token: str = "",
     ):
         self.rank = rank
         self.world_size = world_size
@@ -77,6 +78,7 @@ class TrainWorkerActor:
             trial_dir=trial_dir,
             devices=list(self.devices),
             mesh=mesh,
+            group_token=group_token,
         )
 
     # ------------------------------------------------------------ running
@@ -124,6 +126,10 @@ class WorkerGroup:
         self.trial_dir = trial_dir
         self.execution = execution  # "inproc" shares the jax grid; "process"
                                     # isolates ranks (torch process groups)
+        import uuid
+
+        # fresh per group (= per fit attempt): scopes rank rendezvous keys
+        self.group_token = uuid.uuid4().hex
         self.workers: List[Any] = []
 
     def start(self) -> None:
@@ -140,6 +146,7 @@ class WorkerGroup:
                 self.experiment_name,
                 self.trial_dir,
                 pin_devices=self.execution != "process",
+                group_token=self.group_token,
             )
             for rank in range(n)
         ]

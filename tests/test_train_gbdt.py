@@ -1,0 +1,341 @@
+"""GBDT trainers (XGBoost / LightGBM) — parity with the reference's
+``train/xgboost`` + ``train/lightgbm`` packages, driven against minimal
+framework lookalikes (same gating style as the fake-optuna Tune tests):
+per-round reports, end-of-train checkpoints, resume, the rabit-tracker
+rendezvous, and LightGBM's ``machines`` negotiation."""
+
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.train import RunConfig, ScalingConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _frame(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = (X @ np.array([1.0, -2.0, 0.5]) > 0).astype(np.float64)
+    return pd.DataFrame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+
+
+# ---------------------------------------------------------------------------
+# fake xgboost
+# ---------------------------------------------------------------------------
+def _fake_xgboost(monkeypatch, calls, with_collective=False):
+    mod = types.ModuleType("xgboost")
+
+    class DMatrix:
+        def __init__(self, X, label=None, **kw):
+            self.X, self.label, self.kw = X, label, kw
+
+    class Booster:
+        def __init__(self):
+            self.rounds = 0
+
+        def num_boosted_rounds(self):
+            return self.rounds
+
+        def save_model(self, path):
+            with open(path, "w") as f:
+                f.write(str(self.rounds))
+
+        def load_model(self, path):
+            with open(path) as f:
+                self.rounds = int(f.read())
+
+    class TrainingCallback:
+        pass
+
+    def train(params, dtrain, evals=(), evals_result=None, num_boost_round=10,
+              xgb_model=None, callbacks=(), **kw):
+        model = Booster()
+        if xgb_model is not None:
+            model.rounds = xgb_model.rounds
+        calls.append({
+            "params": dict(params),
+            "nrows": len(dtrain.X),
+            "rounds": num_boost_round,
+            "eval_names": [name for _, name in evals],
+            "resumed_at": model.rounds,
+        })
+        evals_log = {name: {"rmse": []} for _, name in evals}
+        for epoch in range(num_boost_round):
+            model.rounds += 1
+            for name in evals_log:
+                evals_log[name]["rmse"].append(1.0 / model.rounds)
+            for cb in callbacks:
+                if hasattr(cb, "after_iteration"):
+                    cb.after_iteration(model, epoch, evals_log)
+        for cb in callbacks:
+            if hasattr(cb, "after_training"):
+                cb.after_training(model)
+        if evals_result is not None:
+            evals_result.update(evals_log)
+        return model
+
+    mod.DMatrix = DMatrix
+    mod.Booster = Booster
+    mod.train = train
+    mod.callback = types.SimpleNamespace(TrainingCallback=TrainingCallback)
+    if with_collective:
+        entered = []
+
+        class CommunicatorContext:
+            def __init__(self, **args):
+                self.args = args
+
+            def __enter__(self):
+                entered.append(dict(self.args))
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        class RabitTracker:
+            def __init__(self, host_ip, n_workers):
+                self.host_ip, self.n_workers = host_ip, n_workers
+
+            def start(self):
+                pass
+
+            def worker_args(self):
+                return {"dmlc_tracker_uri": self.host_ip, "dmlc_tracker_port": 9091}
+
+            def free(self):
+                pass
+
+        mod.collective = types.SimpleNamespace(CommunicatorContext=CommunicatorContext)
+        mod.tracker = types.SimpleNamespace(RabitTracker=RabitTracker)
+        mod._entered = entered
+    monkeypatch.setitem(sys.modules, "xgboost", mod)
+    return mod
+
+
+def test_xgboost_trainer_reports_and_checkpoints(monkeypatch, tmp_path):
+    calls = []
+    _fake_xgboost(monkeypatch, calls)
+    from ray_tpu.train.xgboost import RayTrainReportCallback, XGBoostTrainer
+
+    df = _frame()
+    result = XGBoostTrainer(
+        params={"eta": 0.3},
+        label_column="label",
+        num_boost_round=5,
+        datasets={"train": rd.from_pandas(df), "valid": rd.from_pandas(_frame(seed=1))},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="xgb_single", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["training_iteration"] == 5
+    assert result.metrics["train-rmse"] == pytest.approx(1.0 / 5)
+    assert result.metrics["valid-rmse"] == pytest.approx(1.0 / 5)
+    assert calls[0]["eval_names"] == ["train", "valid"]
+    assert calls[0]["params"]["eta"] == 0.3
+    # end-of-train checkpoint holds the 5-round booster
+    assert result.checkpoint is not None
+    model = RayTrainReportCallback.get_model(result.checkpoint)
+    assert model.num_boosted_rounds() == 5
+
+
+def test_xgboost_resume_trains_remaining_rounds(monkeypatch, tmp_path):
+    calls = []
+    _fake_xgboost(monkeypatch, calls)
+    from ray_tpu.train.xgboost import XGBoostTrainer
+
+    ds = rd.from_pandas(_frame())
+    first = XGBoostTrainer(
+        label_column="label", num_boost_round=4, datasets={"train": ds},
+        run_config=RunConfig(name="xgb_r1", storage_path=str(tmp_path)),
+    ).fit()
+    assert first.error is None
+    second = XGBoostTrainer(
+        label_column="label", num_boost_round=10, datasets={"train": ds},
+        run_config=RunConfig(name="xgb_r2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=first.checkpoint,
+    ).fit()
+    assert second.error is None
+    assert calls[-1]["resumed_at"] == 4 and calls[-1]["rounds"] == 6
+    from ray_tpu.train.xgboost import RayTrainReportCallback
+
+    assert RayTrainReportCallback.get_model(second.checkpoint).num_boosted_rounds() == 10
+
+
+def test_xgboost_two_workers_shard_and_join_collective(monkeypatch, tmp_path):
+    calls = []
+    mod = _fake_xgboost(monkeypatch, calls, with_collective=True)
+    from ray_tpu.train.xgboost import XGBoostTrainer
+
+    result = XGBoostTrainer(
+        label_column="label", num_boost_round=3,
+        datasets={"train": rd.from_pandas(_frame(n=40))},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="xgb_gang", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    # both ranks trained on disjoint row shards of the 40-row frame
+    assert sorted(c["nrows"] for c in calls) == [20, 20]
+    # and joined the collective with the tracker args rank 0 published
+    assert len(mod._entered) == 2
+    assert all(a["dmlc_tracker_port"] == 9091 for a in mod._entered)
+
+
+def test_xgboost_missing_dependency_is_actionable(tmp_path):
+    sys.modules.pop("xgboost", None)
+    try:
+        import xgboost  # noqa: F401
+
+        pytest.skip("xgboost installed in this env")
+    except ImportError:
+        pass
+    from ray_tpu.train.xgboost import XGBoostTrainer
+
+    result = XGBoostTrainer(
+        label_column="label", num_boost_round=2,
+        datasets={"train": rd.from_pandas(_frame())},
+        run_config=RunConfig(name="xgb_missing", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is not None
+    assert "pip install xgboost" in str(result.error)
+
+
+# ---------------------------------------------------------------------------
+# fake lightgbm
+# ---------------------------------------------------------------------------
+def _fake_lightgbm(monkeypatch, calls):
+    import collections
+
+    mod = types.ModuleType("lightgbm")
+    CallbackEnv = collections.namedtuple(
+        "CallbackEnv",
+        ["model", "params", "iteration", "begin_iteration", "end_iteration",
+         "evaluation_result_list"],
+    )
+
+    class Dataset:
+        def __init__(self, X, label=None, reference=None):
+            self.X, self.label, self.reference = X, label, reference
+
+    class Booster:
+        def __init__(self, model_file=None):
+            self.iters = 0
+            if model_file is not None:
+                with open(model_file) as f:
+                    self.iters = int(f.read())
+
+        def current_iteration(self):
+            return self.iters
+
+        def save_model(self, path):
+            with open(path, "w") as f:
+                f.write(str(self.iters))
+
+    def train(params, train_set, num_boost_round=10, valid_sets=(), valid_names=(),
+              init_model=None, callbacks=(), **kw):
+        model = Booster()
+        if init_model is not None:
+            model.iters = init_model.iters
+        calls.append({
+            "params": dict(params),
+            "nrows": len(train_set.X),
+            "rounds": num_boost_round,
+            "valid_names": list(valid_names),
+        })
+        for it in range(num_boost_round):
+            model.iters += 1
+            results = [(n, "l2", 1.0 / model.iters, False) for n in valid_names]
+            env = CallbackEnv(model, params, it, 0, num_boost_round, results)
+            for cb in callbacks:
+                cb(env)
+        return model
+
+    mod.Dataset = Dataset
+    mod.Booster = Booster
+    mod.train = train
+    monkeypatch.setitem(sys.modules, "lightgbm", mod)
+    return mod
+
+
+def test_lightgbm_trainer_reports_and_checkpoints(monkeypatch, tmp_path):
+    calls = []
+    _fake_lightgbm(monkeypatch, calls)
+    from ray_tpu.train.lightgbm import LightGBMTrainer, RayTrainReportCallback
+
+    result = LightGBMTrainer(
+        params={"objective": "regression"},
+        label_column="label",
+        num_boost_round=4,
+        datasets={"train": rd.from_pandas(_frame()), "valid": rd.from_pandas(_frame(seed=2))},
+        run_config=RunConfig(name="lgbm_single", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["training_iteration"] == 4
+    assert result.metrics["train-l2"] == pytest.approx(0.25)
+    assert result.metrics["valid-l2"] == pytest.approx(0.25)
+    assert calls[0]["valid_names"] == ["train", "valid"]
+    assert RayTrainReportCallback.get_model(result.checkpoint).current_iteration() == 4
+
+
+def test_lightgbm_two_workers_negotiate_machines(monkeypatch, tmp_path):
+    calls = []
+    _fake_lightgbm(monkeypatch, calls)
+    from ray_tpu.train.lightgbm import LightGBMTrainer
+
+    result = LightGBMTrainer(
+        label_column="label", num_boost_round=2,
+        datasets={"train": rd.from_pandas(_frame(n=40))},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="lgbm_gang", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert sorted(c["nrows"] for c in calls) == [20, 20]
+    ports = set()
+    for c in calls:
+        p = c["params"]
+        assert p["num_machines"] == 2 and p["tree_learner"] == "data"
+        machines = p["machines"].split(",")
+        assert len(machines) == 2
+        # each rank listens on the port it advertised in the machines list
+        assert any(m.endswith(f":{p['local_listen_port']}") for m in machines)
+        ports.add(p["local_listen_port"])
+    assert len(ports) == 2  # distinct listen ports on the shared host
+    # both ranks agreed on the same machines list
+    assert calls[0]["params"]["machines"] == calls[1]["params"]["machines"]
+
+
+def test_lightgbm_resume_trains_remaining_rounds(monkeypatch, tmp_path):
+    calls = []
+    _fake_lightgbm(monkeypatch, calls)
+    from ray_tpu.train.lightgbm import LightGBMTrainer
+
+    ds = rd.from_pandas(_frame())
+    first = LightGBMTrainer(
+        label_column="label", num_boost_round=3, datasets={"train": ds},
+        run_config=RunConfig(name="lgbm_r1", storage_path=str(tmp_path)),
+    ).fit()
+    second = LightGBMTrainer(
+        label_column="label", num_boost_round=8, datasets={"train": ds},
+        run_config=RunConfig(name="lgbm_r2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=first.checkpoint,
+    ).fit()
+    assert second.error is None
+    assert calls[-1]["rounds"] == 5
+
+
+def test_group_token_unique_per_gang_attempt():
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    g1 = WorkerGroup(ScalingConfig(num_workers=1), "same_name", "/tmp/rt_tok")
+    g2 = WorkerGroup(ScalingConfig(num_workers=1), "same_name", "/tmp/rt_tok")
+    assert g1.group_token and g1.group_token != g2.group_token
