@@ -23,6 +23,11 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.predictor import (
+    JaxPredictor,
+    Predictor,
+    PredictorNotSerializableException,
+)
 from ray_tpu.train.trainer import (
     BaseTrainer,
     DataConfig,
@@ -44,7 +49,10 @@ __all__ = [
     "DataConfig",
     "DataParallelTrainer",
     "FailureConfig",
+    "JaxPredictor",
     "JaxTrainer",
+    "Predictor",
+    "PredictorNotSerializableException",
     "Result",
     "RunConfig",
     "ScalingConfig",

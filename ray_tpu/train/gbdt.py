@@ -19,9 +19,8 @@ otherwise (same gating style as the Tune external searchers).
 from __future__ import annotations
 
 import json
-import socket
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 def require_module(name: str):
@@ -46,15 +45,6 @@ def shard_to_xy(shard, label_column: str):
     return df.drop(columns=[label_column]), df[label_column]
 
 
-def free_port() -> int:
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    try:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-    finally:
-        s.close()
-
-
 def host_ip() -> str:
     """This host's address as reachable by gang peers on other nodes.
 
@@ -66,12 +56,20 @@ def host_ip() -> str:
     return get_node_ip_address()
 
 
+def default_rendezvous_timeout() -> float:
+    """Gang-rendezvous deadline (seconds).  Env-overridable because the
+    slowest rank may be separated from the fastest by data-load skew."""
+    import os
+
+    return float(os.environ.get("RAY_TPU_GBDT_RENDEZVOUS_TIMEOUT_S", "300"))
+
+
 def kv_rendezvous(
     key_prefix: str,
     rank: int,
     world_size: int,
     payload: Dict[str, Any],
-    timeout: float = 60.0,
+    timeout: Optional[float] = None,
 ) -> List[Dict[str, Any]]:
     """All-gather small JSON payloads across a training gang via internal KV.
 
@@ -81,6 +79,9 @@ def kv_rendezvous(
     reference passes through its backend configs.
     """
     from ray_tpu.experimental import internal_kv
+
+    if timeout is None:
+        timeout = default_rendezvous_timeout()
 
     def _gather(prefix: str, what: str) -> List[bytes]:
         deadline = time.monotonic() + timeout

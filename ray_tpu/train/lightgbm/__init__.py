@@ -20,18 +20,19 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.train import session as train_session
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.predictor import Predictor, wrap_predictions_column
 from ray_tpu.train.config import TRAIN_DATASET_KEY
 from ray_tpu.train.gbdt import (
     eval_shards,
-    free_port,
     host_ip,
     kv_rendezvous,
     require_module,
     shard_to_xy,
 )
+from ray_tpu.util.misc import reserve_port
 from ray_tpu.train.trainer import DataParallelTrainer
 
-__all__ = ["LightGBMTrainer", "LightGBMCheckpoint", "RayTrainReportCallback"]
+__all__ = ["LightGBMTrainer", "LightGBMCheckpoint", "RayTrainReportCallback", "LightGBMPredictor"]
 
 
 class LightGBMCheckpoint(Checkpoint):
@@ -113,9 +114,20 @@ def _network_params(world: int, rank: int, run_key: str) -> Dict[str, Any]:
     if world <= 1:
         return {}
     ip = host_ip()
-    port = free_port()
-    payloads = kv_rendezvous(run_key, rank, world, {"ip": ip, "port": port})
+    # hold the reservation socket OPEN through the rendezvous so the kernel
+    # cannot hand a sibling rank on this host the same ephemeral port
+    sock = reserve_port()
+    port = sock.getsockname()[1]
+    try:
+        payloads = kv_rendezvous(run_key, rank, world, {"ip": ip, "port": port})
+    finally:
+        sock.close()  # LightGBM binds it next
     machines = ",".join(f"{p['ip']}:{p['port']}" for p in payloads)
+    if len({(p["ip"], p["port"]) for p in payloads}) != world:
+        raise RuntimeError(
+            f"LightGBM machines negotiation collided: {machines!r} — "
+            "two ranks advertised the same endpoint"
+        )
     return {
         "machines": machines,
         "local_listen_port": port,
@@ -154,11 +166,6 @@ class LightGBMTrainer(DataParallelTrainer):
             merged.update(config or {})
             ctx = train_session.get_context()
             world, rank = ctx.get_world_size(), ctx.get_world_rank()
-            merged.update(
-                _network_params(
-                    world, rank, f"lgbm_machines/{run_name}/{ctx.get_group_token()}"
-                )
-            )
 
             ckpt = train_session.get_checkpoint()
             init_model = None
@@ -171,6 +178,19 @@ class LightGBMTrainer(DataParallelTrainer):
                     else 0
                 )
                 remaining = max(num_boost_round - done, 0)
+            if remaining == 0:
+                # Already at (or past) the target round count.  LightGBM
+                # would run zero iterations and the per-iteration callback
+                # would never fire, so re-report the restored model
+                # explicitly — otherwise fit() returns no metrics and no
+                # checkpoint and the trained model is lost to the caller.
+                out_ckpt = (
+                    LightGBMCheckpoint.from_model(init_model) if rank == 0 else None
+                )
+                train_session.report(
+                    {"training_iteration": num_boost_round}, checkpoint=out_ckpt
+                )
+                return
 
             train_X, train_y = shard_to_xy(
                 train_session.get_dataset_shard(TRAIN_DATASET_KEY), label_column
@@ -185,6 +205,14 @@ class LightGBMTrainer(DataParallelTrainer):
             callbacks = list(train_kwargs.get("callbacks", []))
             callbacks.append(cb)
             extra = {k: v for k, v in train_kwargs.items() if k != "callbacks"}
+            # negotiate the socket mesh LAST — data loading above can take
+            # minutes, and the advertised port is only reserved, not bound,
+            # until lightgbm.train below actually listens on it
+            merged.update(
+                _network_params(
+                    world, rank, f"lgbm_machines/{run_name}/{ctx.get_group_token()}"
+                )
+            )
             lightgbm.train(
                 merged,
                 dtrain,
@@ -197,3 +225,22 @@ class LightGBMTrainer(DataParallelTrainer):
             )
 
         super().__init__(_train_fn, train_loop_config={}, **kwargs)
+
+
+class LightGBMPredictor(Predictor):
+    """Batch inference with a trained booster (parity:
+    ``train/lightgbm/lightgbm_predictor.py``)."""
+
+    def __init__(self, model, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, preprocessor=None) -> "LightGBMPredictor":
+        return cls(LightGBMCheckpoint(checkpoint.path).get_model(), preprocessor)
+
+    def _predict_pandas(self, df, **kwargs):
+        import pandas as pd
+
+        preds = self.model.predict(df, **kwargs)
+        return pd.DataFrame({"predictions": wrap_predictions_column(preds)})

@@ -19,9 +19,17 @@ import socket
 from dataclasses import dataclass
 from typing import Optional
 
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.predictor import Predictor
 from ray_tpu.train.trainer import DataParallelTrainer
 
-__all__ = ["TensorflowTrainer", "TensorflowConfig", "prepare_dataset_shard"]
+__all__ = [
+    "TensorflowTrainer",
+    "TensorflowConfig",
+    "TensorflowCheckpoint",
+    "TensorflowPredictor",
+    "prepare_dataset_shard",
+]
 
 
 @dataclass
@@ -32,16 +40,13 @@ class TensorflowConfig:
 
 
 def _free_ports(n: int, host: str):
-    socks, ports = [], []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.bind((host, 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-    finally:
-        for s in socks:
-            s.close()  # freed just before workers bind; races are unlikely
+    from ray_tpu.util.misc import reserve_port
+
+    socks = [reserve_port(host) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()  # freed just before workers bind; held together above so
+        # the n reservations are guaranteed distinct
     return ports
 
 
@@ -102,3 +107,50 @@ def prepare_dataset_shard(dataset_shard):
     auto-sharding on an already-sharded dataset; our shards arrive
     pre-split from DataConfig)."""
     return dataset_shard
+
+
+class TensorflowCheckpoint(Checkpoint):
+    """A checkpoint holding one saved keras model (parity:
+    ``train/tensorflow/tensorflow_checkpoint.py``)."""
+
+    MODEL_FILENAME = "model.keras"
+
+    @classmethod
+    def from_model(cls, model, base_dir: Optional[str] = None) -> "TensorflowCheckpoint":
+        import os
+        import tempfile
+
+        d = base_dir or tempfile.mkdtemp(prefix="tf_ckpt_")
+        os.makedirs(d, exist_ok=True)
+        model.save(os.path.join(d, cls.MODEL_FILENAME))
+        return cls(d)
+
+    def get_model(self):
+        import os
+
+        import tensorflow as tf
+
+        return tf.keras.models.load_model(os.path.join(self.path, self.MODEL_FILENAME))
+
+
+class TensorflowPredictor(Predictor):
+    """Batch inference with a keras model (parity:
+    ``train/tensorflow/tensorflow_predictor.py``)."""
+
+    def __init__(self, model, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, preprocessor=None) -> "TensorflowPredictor":
+        return cls(TensorflowCheckpoint(checkpoint.path).get_model(), preprocessor)
+
+    def _predict_numpy(self, data, **kwargs):
+        import numpy as np
+
+        if isinstance(data, dict):
+            x = np.stack([np.asarray(v, dtype=np.float32) for v in data.values()], axis=-1)
+        else:
+            x = np.asarray(data, dtype=np.float32)
+        out = self.model(x, training=False)
+        return {"predictions": np.asarray(out)}

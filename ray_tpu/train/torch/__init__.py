@@ -13,15 +13,20 @@ joins a gloo group over it before the user loop starts.
 
 from __future__ import annotations
 
+import os
 import socket
 from dataclasses import dataclass
 from typing import Optional
 
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.predictor import Predictor
 from ray_tpu.train.trainer import DataParallelTrainer
 
 __all__ = [
     "TorchTrainer",
     "TorchConfig",
+    "TorchCheckpoint",
+    "TorchPredictor",
     "prepare_model",
     "prepare_data_loader",
     "get_device",
@@ -38,9 +43,9 @@ class TorchConfig:
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from ray_tpu.util.misc import free_port
+
+    return free_port()
 
 
 def _with_process_group(fn, backend: str, master_addr: str, master_port: int, timeout_s: int):
@@ -169,3 +174,70 @@ def prepare_data_loader(data_loader):
         worker_init_fn=data_loader.worker_init_fn,
         generator=data_loader.generator,
     )
+
+
+class TorchCheckpoint(Checkpoint):
+    """A checkpoint holding a torch module's ``state_dict`` (parity:
+    ``train/torch/torch_checkpoint.py``)."""
+
+    MODEL_FILENAME = "model.pt"
+
+    @classmethod
+    def from_model(cls, model, base_dir: Optional[str] = None) -> "TorchCheckpoint":
+        import tempfile
+
+        import torch
+
+        d = base_dir or tempfile.mkdtemp(prefix="torch_ckpt_")
+        os.makedirs(d, exist_ok=True)
+        torch.save(model.state_dict(), os.path.join(d, cls.MODEL_FILENAME))
+        return cls(d)
+
+    @classmethod
+    def from_state_dict(cls, state_dict, base_dir: Optional[str] = None) -> "TorchCheckpoint":
+        import tempfile
+
+        import torch
+
+        d = base_dir or tempfile.mkdtemp(prefix="torch_ckpt_")
+        os.makedirs(d, exist_ok=True)
+        torch.save(state_dict, os.path.join(d, cls.MODEL_FILENAME))
+        return cls(d)
+
+    def get_model(self, model):
+        """Load the stored state dict into ``model`` and return it."""
+        import torch
+
+        state = torch.load(
+            os.path.join(self.path, self.MODEL_FILENAME), weights_only=True
+        )
+        model.load_state_dict(state)
+        model.eval()
+        return model
+
+
+class TorchPredictor(Predictor):
+    """Batch inference with a torch module (parity:
+    ``train/torch/torch_predictor.py``).  Dict batches stack their feature
+    columns along the last axis; outputs come back as numpy."""
+
+    def __init__(self, model, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+        self.model.eval()
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, model, preprocessor=None) -> "TorchPredictor":
+        return cls(TorchCheckpoint(checkpoint.path).get_model(model), preprocessor)
+
+    def _predict_numpy(self, data, **kwargs):
+        import numpy as np
+        import torch
+
+        if isinstance(data, dict):
+            x = np.stack([np.asarray(v, dtype=np.float32) for v in data.values()], axis=-1)
+        else:
+            x = np.asarray(data, dtype=np.float32)
+        with torch.no_grad():
+            out = self.model(torch.from_numpy(x), **kwargs)
+        return {"predictions": out.detach().cpu().numpy()}

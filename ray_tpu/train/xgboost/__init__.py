@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.train import session as train_session
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.predictor import Predictor, wrap_predictions_column
 from ray_tpu.train.config import TRAIN_DATASET_KEY
 from ray_tpu.train.gbdt import (
     eval_shards,
@@ -32,7 +33,7 @@ from ray_tpu.train.gbdt import (
 )
 from ray_tpu.train.trainer import DataParallelTrainer
 
-__all__ = ["XGBoostTrainer", "XGBoostCheckpoint", "RayTrainReportCallback"]
+__all__ = ["XGBoostTrainer", "XGBoostCheckpoint", "RayTrainReportCallback", "XGBoostPredictor"]
 
 
 class XGBoostCheckpoint(Checkpoint):
@@ -149,6 +150,19 @@ def _communicator(xgboost, world_size: int, rank: int, run_key: str):
     ctx_cls = getattr(coll, "CommunicatorContext", None) if coll else None
     tracker_cls = getattr(tracker_mod, "RabitTracker", None) if tracker_mod else None
     if world_size <= 1 or ctx_cls is None or tracker_cls is None:
+        if world_size > 1:
+            import warnings
+
+            warnings.warn(
+                "xgboost has no collective API (xgboost.collective / "
+                "xgboost.tracker missing): each of the "
+                f"{world_size} workers is training INDEPENDENTLY on its "
+                "1/{0} row shard — the checkpointed model sees a fraction "
+                "of the data. Upgrade xgboost (>=1.7) for distributed "
+                "training.".format(world_size),
+                RuntimeWarning,
+                stacklevel=2,
+            )
         yield
         return
     tracker = None
@@ -213,25 +227,27 @@ class XGBoostTrainer(DataParallelTrainer):
                 ) else 0
                 remaining = max(num_boost_round - done, 0)
 
-            train_X, train_y = shard_to_xy(
-                train_session.get_dataset_shard(TRAIN_DATASET_KEY), label_column
-            )
-            dtrain = xgboost.DMatrix(
-                train_X, label=train_y, **dmatrix_params.get(TRAIN_DATASET_KEY, {})
-            )
-            evals = [(dtrain, TRAIN_DATASET_KEY)]
-            for name, X, y in eval_shards(dataset_keys, label_column, TRAIN_DATASET_KEY):
-                evals.append(
-                    (xgboost.DMatrix(X, label=y, **dmatrix_params.get(name, {})), name)
-                )
-
             cb = report_callback or RayTrainReportCallback()
             callbacks = list(train_kwargs.get("callbacks", []))
             callbacks.append(_adapt_callback(cb, xgboost))
             extra = {k: v for k, v in train_kwargs.items() if k != "callbacks"}
             evals_result: Dict[str, Any] = {}
             rdv_key = f"xgb_tracker/{run_name}/{ctx.get_group_token()}"
+            # the communicator spans shard loading too: ranks rendezvous on
+            # the tracker BEFORE the (possibly minutes-long, skewed) data
+            # materialization, so load skew can't eat the rendezvous timeout
             with _communicator(xgboost, world, rank, rdv_key):
+                train_X, train_y = shard_to_xy(
+                    train_session.get_dataset_shard(TRAIN_DATASET_KEY), label_column
+                )
+                dtrain = xgboost.DMatrix(
+                    train_X, label=train_y, **dmatrix_params.get(TRAIN_DATASET_KEY, {})
+                )
+                evals = [(dtrain, TRAIN_DATASET_KEY)]
+                for name, X, y in eval_shards(dataset_keys, label_column, TRAIN_DATASET_KEY):
+                    evals.append(
+                        (xgboost.DMatrix(X, label=y, **dmatrix_params.get(name, {})), name)
+                    )
                 xgboost.train(
                     merged,
                     dtrain=dtrain,
@@ -244,3 +260,23 @@ class XGBoostTrainer(DataParallelTrainer):
                 )
 
         super().__init__(_train_fn, train_loop_config={}, **kwargs)
+
+
+class XGBoostPredictor(Predictor):
+    """Batch inference with a trained booster (parity:
+    ``train/xgboost/xgboost_predictor.py:18``)."""
+
+    def __init__(self, model, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, preprocessor=None) -> "XGBoostPredictor":
+        return cls(XGBoostCheckpoint(checkpoint.path).get_model(), preprocessor)
+
+    def _predict_pandas(self, df, **kwargs):
+        import pandas as pd
+
+        xgboost = require_module("xgboost")
+        preds = self.model.predict(xgboost.DMatrix(df), **kwargs)
+        return pd.DataFrame({"predictions": wrap_predictions_column(preds)})

@@ -1,7 +1,7 @@
-"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, PBT.
+"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, PBT, PB2.
 
 Parity: ``python/ray/tune/schedulers/`` — ``async_hyperband.py`` (ASHA),
-``hb.py`` (HyperBand), ``median_stopping_rule.py``, ``pbt.py``.  Decisions
+``hb.py`` (HyperBand), ``median_stopping_rule.py``, ``pbt.py``, ``pb2.py``.  Decisions
 are made per reported result: CONTINUE or STOP; PBT may also mutate a
 trial's config and restart it from a peer's checkpoint (exploit/explore).
 """
@@ -158,6 +158,12 @@ class PopulationBasedTraining(TrialScheduler):
         self.resample_prob = resample_probability
         self.rng = random.Random(seed)
         self._latest: Dict[str, tuple] = {}  # trial_id -> (score, config, checkpoint)
+        self._last_t: Dict[str, float] = {}  # trial_id -> latest reported time
+        # trial_id -> time of its last exploit (parity: pbt.py
+        # last_perturbation_time): without this cooldown an exploited trial
+        # that restarts from scratch re-crosses the t%interval boundary and
+        # is exploited forever
+        self._last_perturb: Dict[str, float] = {}
 
     def on_trial_result(self, trial, result: dict) -> str:
         t = result.get(self.time_attr, 0)
@@ -166,6 +172,7 @@ class PopulationBasedTraining(TrialScheduler):
             return CONTINUE
         score = -value if self.mode == "min" else value
         self._latest[trial.trial_id] = (score, dict(trial.config), trial.latest_checkpoint)
+        self._last_t[trial.trial_id] = t
         # Exploit/explore itself is initiated by the controller, which calls
         # exploit_target() at perturbation boundaries and restarts the trial.
         return CONTINUE
@@ -179,6 +186,10 @@ class PopulationBasedTraining(TrialScheduler):
         """If trial is bottom-quantile, return (new_config, donor_checkpoint)."""
         if len(self._latest) < 2 or trial.trial_id not in self._latest:
             return None
+        t = self._last_t.get(trial.trial_id, 0)
+        last = self._last_perturb.get(trial.trial_id)
+        if last is not None and t - last < self.interval:
+            return None  # cooling down since the previous exploit
         ranked = sorted(self._latest.items(), key=lambda kv: kv[1][0], reverse=True)
         n = len(ranked)
         k = max(1, int(n * self.quantile))
@@ -195,4 +206,142 @@ class PopulationBasedTraining(TrialScheduler):
             elif key in new_cfg and isinstance(new_cfg[key], (int, float)):
                 factor = self.rng.choice([0.8, 1.2])
                 new_cfg[key] = type(new_cfg[key])(new_cfg[key] * factor)
+        self._last_perturb[trial.trial_id] = t
         return new_cfg, donor_ckpt
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (parity: ``pb2.py``).
+
+    PBT's exploit step with a model-based explore step: instead of randomly
+    perturbing hyperparameters, the exploited trial's new config maximizes a
+    UCB acquisition on a Gaussian-process model of reward *change* as a
+    function of (time, hyperparameters), fit to the whole population's
+    history (Parker-Holder et al. 2020, "Provably Efficient Online
+    Hyperparameter Optimization with Population-Based Bandits").
+
+    The reference implementation requires GPy; this one is a self-contained
+    numpy GP (RBF kernel, median-heuristic lengthscale, fixed noise), which
+    is the whole model PB2 needs — the paper's time-varying kernel adds a
+    forgetting term handled here by windowing the data to the most recent
+    ``max_obs`` observations.
+
+    Only continuous ``hyperparam_bounds`` are tuned by the GP (same
+    restriction as the reference); any ``hyperparam_mutations`` keys passed
+    through behave as in PBT.
+    """
+
+    def __init__(
+        self,
+        *,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_bounds: Optional[Dict[str, Any]] = None,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        ucb_kappa: float = 2.0,
+        max_obs: int = 256,
+        candidates: int = 256,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(
+            time_attr=time_attr,
+            metric=metric,
+            mode=mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations=hyperparam_mutations,
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires continuous hyperparam_bounds={key: [lo, hi]}")
+        self.bounds = {
+            k: (float(lo), float(hi)) for k, (lo, hi) in hyperparam_bounds.items()
+        }
+        self.kappa = ucb_kappa
+        self.max_obs = max_obs
+        self.n_candidates = candidates
+        # rows: (t, [bounded hyperparams in sorted-key order], reward-rate)
+        self._obs: List[tuple] = []
+        self._window_start: Dict[str, tuple] = {}  # trial_id -> (t, score)
+
+    # ------------------------------------------------------------- data
+    def on_trial_result(self, trial, result: dict) -> str:
+        decision = super().on_trial_result(trial, result)
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None or not self.at_perturbation_boundary(result):
+            return decision
+        score = -value if self.mode == "min" else value
+        start = self._window_start.get(trial.trial_id)
+        if start is not None and t > start[0]:
+            xs = [float(trial.config.get(k, lo)) for k, (lo, _) in sorted(self.bounds.items())]
+            # reward RATE over the window: invariant to window length
+            y = (score - start[1]) / (t - start[0])
+            self._obs.append((float(t), xs, y))
+            if len(self._obs) > self.max_obs:
+                self._obs = self._obs[-self.max_obs:]
+        self._window_start[trial.trial_id] = (t, score)
+        return decision
+
+    # ---------------------------------------------------------- explore
+    def exploit_target(self, trial) -> Optional[tuple]:
+        out = super().exploit_target(trial)
+        if out is None:
+            return None
+        new_cfg, donor_ckpt = out
+        for k, v in self._select_bounded(new_cfg).items():
+            new_cfg[k] = v
+        # the exploited trial jumps to the donor's checkpoint: its next
+        # score delta is dominated by the swap, not the new config — drop
+        # the open observation window so the GP never ingests that jump
+        self._window_start.pop(trial.trial_id, None)
+        return new_cfg, donor_ckpt
+
+    def _select_bounded(self, base_cfg: dict) -> Dict[str, float]:
+        import numpy as np
+
+        keys = sorted(self.bounds)
+        lows = np.array([self.bounds[k][0] for k in keys])
+        highs = np.array([self.bounds[k][1] for k in keys])
+        rng = np.random.default_rng(self.rng.randrange(2**31))
+        if len(self._obs) < 4:
+            sample = lows + rng.random(len(keys)) * (highs - lows)
+            return dict(zip(keys, sample.tolist()))
+
+        t_max = max(row[0] for row in self._obs) or 1.0
+        X = np.array(
+            [[row[0] / t_max] + [
+                (row[1][i] - lows[i]) / max(highs[i] - lows[i], 1e-12)
+                for i in range(len(keys))
+            ] for row in self._obs]
+        )
+        y = np.array([row[2] for row in self._obs], dtype=float)
+        y_std = y.std() or 1.0
+        y_n = (y - y.mean()) / y_std
+
+        # median-heuristic RBF lengthscale over the observed inputs
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        med = np.median(d2[d2 > 0]) if (d2 > 0).any() else 1.0
+        ls2 = max(med, 1e-6)
+        K = np.exp(-d2 / (2 * ls2)) + 1e-4 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            L = np.linalg.cholesky(K + 1e-2 * np.eye(len(X)))
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y_n))
+
+        # candidates at the NEXT window (t=1 in normalized time)
+        cand = rng.random((self.n_candidates, len(keys)))
+        Xc = np.concatenate([np.ones((self.n_candidates, 1)), cand], axis=1)
+        d2c = ((Xc[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-d2c / (2 * ls2))
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.maximum(1.0 - (v**2).sum(0), 1e-12)
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cand[int(np.argmax(ucb))]
+        chosen = lows + best * (highs - lows)
+        return dict(zip(keys, chosen.tolist()))

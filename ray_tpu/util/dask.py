@@ -125,6 +125,23 @@ def _unpack(keys: Any, values: Dict[Hashable, Any]) -> Any:
     return values[keys]
 
 
+_NODE_TASK = None
+
+
+def _node_task():
+    """The shared graph-node remote function, created once per process
+    (re-registering it per scheduler call wastes export overhead)."""
+    global _NODE_TASK
+    if _NODE_TASK is None:
+
+        @ray_tpu.remote
+        def _dask_node(spec, *dep_vals):
+            return _evaluate(spec, dep_vals)
+
+        _NODE_TASK = _dask_node
+    return _NODE_TASK
+
+
 def ray_dask_get(dsk: Dict[Hashable, Any], keys: Any, *, ray_persist: bool = False, **_: Any) -> Any:
     """Dask scheduler: one submitted task per graph node.
 
@@ -135,18 +152,14 @@ def ray_dask_get(dsk: Dict[Hashable, Any], keys: Any, *, ray_persist: bool = Fal
     refs instead of materialized values (parity: scheduler.py's persist
     path).
     """
-
-    @ray_tpu.remote
-    def _node(spec, *dep_vals):
-        return _evaluate(spec, dep_vals)
-
+    node = _node_task()
     refs: Dict[Hashable, Any] = {}
     order, deps = _toposort(dsk)
     for k in order:
         ordered = sorted(deps[k], key=repr)
         dep_index = {d: i for i, d in enumerate(ordered)}
         spec = _rewrite(dsk[k], dep_index)
-        refs[k] = _node.remote(spec, *[refs[d] for d in ordered])
+        refs[k] = node.remote(spec, *[refs[d] for d in ordered])
     if ray_persist:
         return _unpack(keys, refs)
     flat: List[Hashable] = []

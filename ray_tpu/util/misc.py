@@ -16,6 +16,30 @@ import time
 from typing import Any, Callable, Dict, List
 
 
+def reserve_port(host: str = "127.0.0.1") -> socket.socket:
+    """Bind an ephemeral port and return the OPEN socket.
+
+    The caller closes it when whatever service will actually own the port
+    is ready to bind — holding the socket open prevents the kernel handing
+    the same port to a concurrent caller (the flaw in probe-and-close
+    helpers: two gang ranks on one host can otherwise collide)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    return s
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """Probe-and-close ephemeral port lookup.  Only for single-caller uses
+    (e.g. one driver picking a master port); concurrent callers should hold
+    ``reserve_port`` sockets through their rendezvous instead."""
+    s = reserve_port(host)
+    try:
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 def get_node_ip_address() -> str:
     """This host's primary outbound IP (no traffic is sent: a UDP connect
     just selects the route)."""

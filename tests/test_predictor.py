@@ -1,0 +1,221 @@
+"""Predictor surface (parity: train/predictor.py + the framework
+predictors): format dispatch, preprocessor application, pandas-UDF wrapper,
+checkpoint loading, non-serializability, and batch inference through
+Dataset.map_batches with a callable class."""
+
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu.train import Checkpoint, JaxPredictor, Predictor
+from ray_tpu.train.predictor import PredictorNotSerializableException
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class _DoublePredictor(Predictor):
+    @classmethod
+    def from_checkpoint(cls, checkpoint, **kw):
+        return cls(**kw)
+
+    def _predict_pandas(self, df, **kw):
+        return pd.DataFrame({"predictions": df.sum(axis=1) * 2})
+
+
+def test_pandas_in_pandas_out():
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    out = _DoublePredictor().predict(df)
+    assert list(out["predictions"]) == [8.0, 12.0]
+
+
+def test_numpy_dict_cross_converts_through_pandas_impl():
+    out = _DoublePredictor().predict({"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])})
+    assert isinstance(out, dict)
+    assert list(out["predictions"]) == [8.0, 12.0]
+
+
+def test_preprocessor_applies_before_predict():
+    class AddOne:
+        def transform_batch(self, df):
+            return df + 1
+
+    df = pd.DataFrame({"a": [1.0], "b": [1.0]})
+    out = _DoublePredictor(preprocessor=AddOne()).predict(df)
+    assert list(out["predictions"]) == [8.0]
+
+
+def test_from_pandas_udf():
+    p = Predictor.from_pandas_udf(lambda df: pd.DataFrame({"predictions": df["x"] * 10}))
+    out = p.predict(pd.DataFrame({"x": [1.0, 2.0]}))
+    assert list(out["predictions"]) == [10.0, 20.0]
+
+
+def test_predictor_not_serializable():
+    import pickle
+
+    with pytest.raises(PredictorNotSerializableException, match="from_checkpoint"):
+        pickle.dumps(_DoublePredictor())
+
+
+def test_unsupported_batch_type():
+    with pytest.raises(TypeError, match="Unsupported batch type"):
+        _DoublePredictor().predict([1, 2, 3])
+
+
+def test_jax_predictor_from_pytree_checkpoint(tmp_path):
+    import jax.numpy as jnp
+
+    w = {"scale": np.array(3.0)}
+    ckpt = Checkpoint.from_pytree(w, base_dir=str(tmp_path))
+
+    def apply_fn(params, x):
+        return params["scale"] * jnp.sum(x, axis=-1)
+
+    p = JaxPredictor.from_checkpoint(ckpt, apply_fn)
+    out = p.predict({"a": np.array([1.0, 2.0]), "b": np.array([1.0, 0.0])})
+    assert np.allclose(out["predictions"], [6.0, 6.0])
+    out2 = p.predict(np.array([[1.0, 1.0], [2.0, 0.0]]))
+    assert np.allclose(out2["predictions"], [6.0, 6.0])
+
+
+def test_batch_inference_via_map_batches():
+    from ray_tpu import data as rd
+
+    ds = rd.from_pandas(pd.DataFrame({"a": np.arange(8.0), "b": np.ones(8)}))
+
+    class Scorer:
+        def __init__(self):
+            self.predictor = _DoublePredictor()
+
+        def __call__(self, batch):
+            return self.predictor.predict(batch)
+
+    rows = ds.map_batches(Scorer, batch_format="pandas").take_all()
+    got = sorted(r["predictions"] for r in rows)
+    want = sorted((a + 1) * 2 for a in np.arange(8.0))
+    assert got == pytest.approx(want)
+
+
+def test_xgboost_predictor_roundtrip(monkeypatch, tmp_path):
+    mod = types.ModuleType("xgboost")
+
+    class DMatrix:
+        def __init__(self, df, **kw):
+            self.df = df
+
+    class Booster:
+        def __init__(self):
+            self.rounds = 7
+
+        def load_model(self, path):
+            with open(path) as f:
+                self.rounds = int(f.read())
+
+        def save_model(self, path):
+            with open(path, "w") as f:
+                f.write(str(self.rounds))
+
+        def predict(self, dmat, **kw):
+            return np.asarray(dmat.df.sum(axis=1)) * self.rounds
+
+    mod.DMatrix = DMatrix
+    mod.Booster = Booster
+    monkeypatch.setitem(sys.modules, "xgboost", mod)
+
+    from ray_tpu.train.xgboost import XGBoostCheckpoint, XGBoostPredictor
+
+    ckpt = XGBoostCheckpoint.from_model(Booster(), base_dir=str(tmp_path))
+    p = XGBoostPredictor.from_checkpoint(ckpt)
+    out = p.predict(pd.DataFrame({"a": [1.0, 2.0], "b": [0.0, 1.0]}))
+    assert list(out["predictions"]) == [7.0, 21.0]
+
+
+def test_lightgbm_predictor_roundtrip(monkeypatch, tmp_path):
+    mod = types.ModuleType("lightgbm")
+
+    class Booster:
+        def __init__(self, model_file=None):
+            self.iters = 5
+            if model_file is not None:
+                with open(model_file) as f:
+                    self.iters = int(f.read())
+
+        def save_model(self, path):
+            with open(path, "w") as f:
+                f.write(str(self.iters))
+
+        def predict(self, df, **kw):
+            return np.asarray(df.sum(axis=1)) * self.iters
+
+    mod.Booster = Booster
+    monkeypatch.setitem(sys.modules, "lightgbm", mod)
+
+    from ray_tpu.train.lightgbm import LightGBMCheckpoint, LightGBMPredictor
+
+    ckpt = LightGBMCheckpoint.from_model(Booster(), base_dir=str(tmp_path))
+    p = LightGBMPredictor.from_checkpoint(ckpt)
+    out = p.predict(pd.DataFrame({"a": [2.0], "b": [1.0]}))
+    assert list(out["predictions"]) == [15.0]
+
+
+def test_torch_predictor_roundtrip(tmp_path):
+    import torch
+
+    from ray_tpu.train.torch import TorchCheckpoint, TorchPredictor
+
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.copy_(torch.tensor([[2.0, 3.0]]))
+    ckpt = TorchCheckpoint.from_model(model, base_dir=str(tmp_path))
+    p = TorchPredictor.from_checkpoint(ckpt, torch.nn.Linear(2, 1, bias=False))
+    out = p.predict({"a": np.array([1.0, 0.0]), "b": np.array([0.0, 1.0])})
+    assert np.allclose(out["predictions"].ravel(), [2.0, 3.0])
+
+
+def test_tensorflow_predictor_roundtrip(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    from ray_tpu.train.tensorflow import TensorflowCheckpoint, TensorflowPredictor
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input(shape=(2,)), tf.keras.layers.Dense(1, use_bias=False)]
+    )
+    model.layers[0].set_weights([np.array([[2.0], [3.0]], dtype=np.float32)])
+    ckpt = TensorflowCheckpoint.from_model(model, base_dir=str(tmp_path))
+    p = TensorflowPredictor.from_checkpoint(ckpt)
+    out = p.predict(np.array([[1.0, 0.0], [0.0, 1.0]], dtype=np.float32))
+    assert np.allclose(out["predictions"].ravel(), [2.0, 3.0])
+
+
+def test_torch_predictor_dataframe_path_with_2d_output(tmp_path):
+    # DataFrame in -> DataFrame out must survive (n, 1)-shaped model output
+    import torch
+
+    from ray_tpu.train.torch import TorchCheckpoint, TorchPredictor
+
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.copy_(torch.tensor([[1.0, 1.0]]))
+    p = TorchPredictor.from_checkpoint(
+        TorchCheckpoint.from_model(model, base_dir=str(tmp_path)),
+        torch.nn.Linear(2, 1, bias=False),
+    )
+    out = p.predict(pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]}))
+    assert [float(np.asarray(v).ravel()[0]) for v in out["predictions"]] == [4.0, 6.0]
+
+
+def test_base_predictor_requires_an_impl():
+    class Empty(Predictor):
+        pass
+
+    with pytest.raises(NotImplementedError, match="implements neither"):
+        Empty().predict(pd.DataFrame({"a": [1.0]}))

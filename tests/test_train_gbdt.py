@@ -339,3 +339,25 @@ def test_group_token_unique_per_gang_attempt():
     g1 = WorkerGroup(ScalingConfig(num_workers=1), "same_name", "/tmp/rt_tok")
     g2 = WorkerGroup(ScalingConfig(num_workers=1), "same_name", "/tmp/rt_tok")
     assert g1.group_token and g1.group_token != g2.group_token
+
+
+def test_lightgbm_resume_at_target_rounds_still_reports_checkpoint(monkeypatch, tmp_path):
+    calls = []
+    _fake_lightgbm(monkeypatch, calls)
+    from ray_tpu.train.lightgbm import LightGBMTrainer, RayTrainReportCallback
+
+    ds = rd.from_pandas(_frame())
+    first = LightGBMTrainer(
+        label_column="label", num_boost_round=3, datasets={"train": ds},
+        run_config=RunConfig(name="lgbm_done1", storage_path=str(tmp_path)),
+    ).fit()
+    n_calls = len(calls)
+    again = LightGBMTrainer(
+        label_column="label", num_boost_round=3, datasets={"train": ds},
+        run_config=RunConfig(name="lgbm_done2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=first.checkpoint,
+    ).fit()
+    assert again.error is None
+    assert len(calls) == n_calls  # zero boosting rounds -> lightgbm.train never ran
+    assert again.metrics["training_iteration"] == 3
+    assert RayTrainReportCallback.get_model(again.checkpoint).current_iteration() == 3

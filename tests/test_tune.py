@@ -260,3 +260,128 @@ def test_external_searchers_gate_with_importerror():
         tune.OptunaSearch()
     with pytest.raises(ImportError, match="hyperopt"):
         tune.HyperOptSearch()
+
+
+# --------------------------------------------------------------------------
+# PB2 (GP-bandit explore; parity: schedulers/pb2.py)
+# --------------------------------------------------------------------------
+class _FakeTrial:
+    def __init__(self, tid, config):
+        self.trial_id = tid
+        self.config = config
+        self.latest_checkpoint = None
+
+
+def test_pb2_collects_reward_rate_observations():
+    from ray_tpu.tune import PB2
+
+    sched = PB2(metric="score", mode="max", perturbation_interval=2,
+                hyperparam_bounds={"lr": [0.0, 1.0]}, seed=0)
+    t = _FakeTrial("t1", {"lr": 0.5})
+    for i, score in [(2, 4.0), (4, 10.0)]:
+        sched.on_trial_result(t, {"training_iteration": i, "score": score})
+    assert len(sched._obs) == 1
+    t_obs, xs, rate = sched._obs[0]
+    assert t_obs == 4 and xs == [0.5] and rate == pytest.approx(3.0)
+
+
+def test_pb2_cold_start_samples_within_bounds():
+    from ray_tpu.tune import PB2
+
+    sched = PB2(metric="score", mode="max",
+                hyperparam_bounds={"lr": [0.1, 0.9], "wd": [1e-5, 1e-3]}, seed=1)
+    cfg = sched._select_bounded({})
+    assert 0.1 <= cfg["lr"] <= 0.9
+    assert 1e-5 <= cfg["wd"] <= 1e-3
+
+
+def test_pb2_gp_moves_toward_better_region():
+    from ray_tpu.tune import PB2
+
+    sched = PB2(metric="score", mode="max", hyperparam_bounds={"lr": [0.0, 1.0]},
+                seed=0, ucb_kappa=1.0)
+    # population evidence: reward rate grows linearly with lr
+    for i in range(24):
+        lr = (i % 8) / 8.0
+        sched._obs.append((float(i + 1), [lr], lr * 10.0))
+    picks = [sched._select_bounded({})["lr"] for _ in range(5)]
+    assert sum(p > 0.5 for p in picks) >= 4, picks
+
+
+def test_pb2_requires_bounds():
+    from ray_tpu.tune import PB2
+
+    with pytest.raises(ValueError, match="hyperparam_bounds"):
+        PB2(metric="score", mode="max")
+
+
+def test_pb2_runs_end_to_end():
+    from ray_tpu.tune import PB2
+
+    def trainable(config):
+        score = 0.0
+        for i in range(1, 9):
+            score += config["lr"]
+            tune.report({"training_iteration": i, "score": score})
+
+    scheduler = PB2(
+        perturbation_interval=4,
+        hyperparam_bounds={"lr": [0.01, 1.0]},
+        seed=0,
+    )
+    results = tune.run(
+        trainable,
+        config={"lr": tune.uniform(0.01, 1.0)},
+        num_samples=4,
+        metric="score",
+        mode="max",
+        scheduler=scheduler,
+        max_concurrent_trials=4,
+    )
+    assert len(results) == 4
+    best = results.get_best_result().metrics["score"]
+    assert best > 0
+    # every exploited config stayed inside the declared bounds
+    for r in results:
+        assert 0.01 <= r.config["lr"] <= 1.0
+
+
+def test_pbt_exploit_cooldown_prevents_restart_loop():
+    """An exploited trial that restarts from scratch re-crosses the
+    t%interval boundary; without the last-perturbation cooldown (reference:
+    pbt.py last_perturbation_time) it is exploited forever."""
+    sched = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, seed=0,
+    )
+    weak = _FakeTrial("weak", {"lr": 0.1})
+    strong = _FakeTrial("strong", {"lr": 1.0})
+    sched.on_trial_result(strong, {"training_iteration": 8, "score": 8.0})
+    sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    assert sched.exploit_target(weak) is not None
+    # the trial restarted from zero and reached the same boundary again
+    sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    assert sched.exploit_target(weak) is None  # cooling down
+    # after a full fresh interval beyond the exploit point it is eligible
+    sched.on_trial_result(weak, {"training_iteration": 8, "score": 0.8})
+    assert sched.exploit_target(weak) is not None
+
+
+def test_pb2_exploit_drops_open_observation_window():
+    """The exploited trial jumps to the donor checkpoint; its next score
+    delta reflects the swap, not the new config, and must not enter the GP."""
+    from ray_tpu.tune import PB2
+
+    sched = PB2(metric="score", mode="max", perturbation_interval=4,
+                hyperparam_bounds={"lr": [0.0, 1.0]}, seed=0)
+    weak, strong = _FakeTrial("weak", {"lr": 0.1}), _FakeTrial("strong", {"lr": 0.9})
+    sched.on_trial_result(strong, {"training_iteration": 8, "score": 8.0})
+    sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    assert "weak" in sched._window_start
+    assert sched.exploit_target(weak) is not None
+    assert "weak" not in sched._window_start
+    # post-restart boundary: opens a fresh window instead of emitting a
+    # spurious (donor_score - old_score) observation
+    n_obs = len(sched._obs)
+    sched.on_trial_result(weak, {"training_iteration": 8, "score": 8.5})
+    assert len(sched._obs) == n_obs
