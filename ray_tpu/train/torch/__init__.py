@@ -184,14 +184,7 @@ class TorchCheckpoint(Checkpoint):
 
     @classmethod
     def from_model(cls, model, base_dir: Optional[str] = None) -> "TorchCheckpoint":
-        import tempfile
-
-        import torch
-
-        d = base_dir or tempfile.mkdtemp(prefix="torch_ckpt_")
-        os.makedirs(d, exist_ok=True)
-        torch.save(model.state_dict(), os.path.join(d, cls.MODEL_FILENAME))
-        return cls(d)
+        return cls.from_state_dict(model.state_dict(), base_dir)
 
     @classmethod
     def from_state_dict(cls, state_dict, base_dir: Optional[str] = None) -> "TorchCheckpoint":

@@ -23,7 +23,7 @@ import ray_tpu
 from ray_tpu.exceptions import RayActorError, RayTaskError, WorkerCrashedError
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune import schedulers as sched_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 from ray_tpu.tune.session import TrialInterrupt, _TuneSession, init_trial_session, shutdown_trial_session
 
@@ -117,6 +117,9 @@ class TuneController:
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_search_properties(metric, mode)
+        if hasattr(self.scheduler, "_controller"):
+            # ResourceChangingScheduler's allocation fn reads trial states
+            self.scheduler._controller = self
         if getattr(self.scheduler, "metric", None) is None:
             self.scheduler.metric = metric
         self.metric = metric
@@ -149,8 +152,12 @@ class TuneController:
 
     def _start_trial(self, trial: Trial, checkpoint: Optional[Checkpoint] = None) -> None:
         # with_resources() attaches per-trial requirements to the trainable
-        # (parity: tune.with_resources -> PlacementGroupFactory head bundle)
+        # (parity: tune.with_resources -> PlacementGroupFactory head bundle);
+        # a per-TRIAL override (ResourceChangingScheduler) wins over it
         res = dict(getattr(self.trainable, "_tune_resources", None) or {})
+        # merge, don't replace: a CPU-only reallocation must not drop the
+        # trainable's accelerator reservations
+        res.update(getattr(trial, "resources", None) or {})
         opts: dict = {"execution": "inproc", "max_concurrency": 4}
         if res:
             opts["num_cpus"] = res.pop("CPU", 1)
@@ -247,7 +254,7 @@ class TuneController:
             decision = self.scheduler.on_trial_result(trial, metrics)
             if decision == STOP:
                 self._stop_trial(trial)
-            elif isinstance(self.scheduler, PopulationBasedTraining) and self.scheduler.at_perturbation_boundary(metrics):
+            elif hasattr(self.scheduler, "at_perturbation_boundary") and self.scheduler.at_perturbation_boundary(metrics):
                 target = self.scheduler.exploit_target(trial)
                 if target is not None:
                     new_cfg, donor_ckpt = target

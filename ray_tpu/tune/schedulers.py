@@ -159,6 +159,9 @@ class PopulationBasedTraining(TrialScheduler):
         self.rng = random.Random(seed)
         self._latest: Dict[str, tuple] = {}  # trial_id -> (score, config, checkpoint)
         self._last_t: Dict[str, float] = {}  # trial_id -> latest reported time
+        # every exploit decision, for PopulationBasedTrainingReplay
+        # (parity: pbt.py policy logging to pbt_policy_*.txt)
+        self.policy_log: List[Dict[str, Any]] = []
         # trial_id -> time of its last exploit (parity: pbt.py
         # last_perturbation_time): without this cooldown an exploited trial
         # that restarts from scratch re-crosses the t%interval boundary and
@@ -207,7 +210,20 @@ class PopulationBasedTraining(TrialScheduler):
                 factor = self.rng.choice([0.8, 1.2])
                 new_cfg[key] = type(new_cfg[key])(new_cfg[key] * factor)
         self._last_perturb[trial.trial_id] = t
+        self.policy_log.append(
+            {"trial_id": trial.trial_id, "time": t, "config": dict(new_cfg)}
+        )
         return new_cfg, donor_ckpt
+
+    def save_policy(self, path: str, trial_id: Optional[str] = None) -> None:
+        """Write the recorded exploit schedule as jsonl, optionally filtered
+        to one trial — the input PopulationBasedTrainingReplay consumes."""
+        import json
+
+        with open(path, "w") as f:
+            for row in self.policy_log:
+                if trial_id is None or row["trial_id"] == trial_id:
+                    f.write(json.dumps(row) + "\n")
 
 
 class PB2(PopulationBasedTraining):
@@ -294,6 +310,10 @@ class PB2(PopulationBasedTraining):
         new_cfg, donor_ckpt = out
         for k, v in self._select_bounded(new_cfg).items():
             new_cfg[k] = v
+        # keep the policy log pointing at the config the trial will actually
+        # train with (super() appended the pre-GP donor config)
+        if self.policy_log and self.policy_log[-1]["trial_id"] == trial.trial_id:
+            self.policy_log[-1]["config"] = dict(new_cfg)
         # the exploited trial jumps to the donor's checkpoint: its next
         # score delta is dominated by the swap, not the new config — drop
         # the open observation window so the GP never ingests that jump
@@ -345,3 +365,135 @@ class PB2(PopulationBasedTraining):
         best = cand[int(np.argmax(ucb))]
         chosen = lows + best * (highs - lows)
         return dict(zip(keys, chosen.tolist()))
+
+
+# canonical alias (parity: async_hyperband.py ASHAScheduler = AsyncHyperBand)
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand bracket scheduler for BOHB (parity: ``hb_bohb.py``).
+
+    The reference variant differs from plain HyperBand in feeding paused
+    trials back to the TuneBOHB searcher; our searcher protocol reports
+    every result to the search algorithm already, so the bracket behavior
+    is inherited unchanged.  Pair with ``TuneBOHB`` (gated on ConfigSpace,
+    ``tune/search.py``)."""
+
+
+class PopulationBasedTrainingReplay(PopulationBasedTraining):
+    """Replay one trial's recorded PBT schedule (parity: ``pbt.py``
+    ``PopulationBasedTrainingReplay``).
+
+    Takes the jsonl policy written by ``PopulationBasedTraining
+    .save_policy`` (rows ``{"time": t, "config": {...}}``) — or an in-memory
+    list of ``(time, config)`` — and re-applies each config switch when the
+    single replayed trial crosses the recorded time, without any population
+    or metric logic."""
+
+    def __init__(self, policy, *, time_attr: str = "training_iteration"):
+        super().__init__(time_attr=time_attr, metric=None, mode="max")
+        if isinstance(policy, str):
+            import json
+
+            with open(policy) as f:
+                rows = [json.loads(line) for line in f if line.strip()]
+            self._policy = [(r["time"], dict(r["config"])) for r in rows]
+        else:
+            self._policy = [(t, dict(cfg)) for t, cfg in policy]
+        self._policy.sort(key=lambda tc: tc[0])
+        self._next = 0
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._last_t[trial.trial_id] = result.get(self.time_attr, 0)
+        return CONTINUE
+
+    def at_perturbation_boundary(self, result: dict) -> bool:
+        return (
+            self._next < len(self._policy)
+            and result.get(self.time_attr, 0) >= self._policy[self._next][0]
+        )
+
+    def exploit_target(self, trial):
+        if self._next >= len(self._policy):
+            return None
+        t = self._last_t.get(trial.trial_id, 0)
+        if t < self._policy[self._next][0]:
+            return None
+        _, cfg = self._policy[self._next]
+        self._next += 1
+        # continue from the trial's own latest checkpoint with the recorded
+        # config — replay has no donor population
+        return dict(cfg), trial.latest_checkpoint
+
+
+class DistributeResources:
+    """Even-split resource policy (parity:
+    ``resource_changing_scheduler.py`` ``DistributeResources``): every
+    running trial gets an equal share of the cluster's CPUs, never less
+    than its base request."""
+
+    def __init__(self, base_resources: Optional[Dict[str, float]] = None):
+        self.base = dict(base_resources or {"CPU": 1})
+
+    def __call__(self, tune_controller, trial, result, scheduler) -> Optional[Dict[str, float]]:
+        import ray_tpu
+
+        try:
+            total = ray_tpu.cluster_resources().get("CPU", 0)
+        except Exception:
+            return None
+        running = 1
+        if tune_controller is not None:
+            running = max(
+                1, sum(1 for t in tune_controller.trials if t.status == "RUNNING")
+            )
+        share = int(total // running) if total else 0
+        out = dict(self.base)
+        out["CPU"] = max(float(out.get("CPU", 1)), float(share or out.get("CPU", 1)))
+        return out
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate per-trial resources as the experiment evolves (parity:
+    ``resource_changing_scheduler.py``).
+
+    Wraps a base scheduler for trial decisions; after every report the
+    allocation function proposes a new resource bundle, stored on the trial
+    and applied at its next (re)start — the reference restarts trials from
+    checkpoint to apply mid-flight, which here happens naturally at PBT
+    exploits, failure retries, and fresh trial launches."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base_scheduler = base_scheduler or FIFOScheduler()
+        self.alloc = resources_allocation_function or DistributeResources()
+        self._controller = None  # injected by the controller when it starts
+
+    def set_search_properties(self, metric: str, mode: str) -> None:
+        super().set_search_properties(metric, mode)
+        self.base_scheduler.set_search_properties(metric, mode)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        decision = self.base_scheduler.on_trial_result(trial, result)
+        new = self.alloc(self._controller, trial, result, self)
+        if new:
+            trial.resources = dict(new)
+        return decision
+
+    def on_trial_complete(self, trial, result: Optional[dict]) -> None:
+        self.base_scheduler.on_trial_complete(trial, result)
+
+    def choose_trial_to_run(self, pending: list):
+        return self.base_scheduler.choose_trial_to_run(pending)
+
+    # PBT-family passthrough: the controller drives exploit/explore through
+    # these two hooks — without forwarding them, wrapping PBT in a
+    # ResourceChangingScheduler would silently disable exploitation
+    def at_perturbation_boundary(self, result: dict) -> bool:
+        hook = getattr(self.base_scheduler, "at_perturbation_boundary", None)
+        return bool(hook(result)) if hook else False
+
+    def exploit_target(self, trial) -> Optional[tuple]:
+        hook = getattr(self.base_scheduler, "exploit_target", None)
+        return hook(trial) if hook else None

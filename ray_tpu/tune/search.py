@@ -567,3 +567,4 @@ OptunaSearch = _make_optuna_search()
 HyperOptSearch = _external_searcher_stub("HyperOptSearch", "hyperopt")
 AxSearch = _external_searcher_stub("AxSearch", "ax-platform")
 BayesOptSearch = _external_searcher_stub("BayesOptSearch", "bayesian-optimization")
+TuneBOHB = _external_searcher_stub("TuneBOHB", "ConfigSpace + hpbandster")

@@ -65,3 +65,7 @@ def test_example_workflows():
 @pytest.mark.full
 def test_example_llm_serving():
     assert "llm tour OK" in _run("09_llm_serving.py")
+
+
+def test_example_dask_graphs():
+    assert "dask tour OK" in _run("10_dask_graphs.py")

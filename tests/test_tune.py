@@ -385,3 +385,113 @@ def test_pb2_exploit_drops_open_observation_window():
     n_obs = len(sched._obs)
     sched.on_trial_result(weak, {"training_iteration": 8, "score": 8.5})
     assert len(sched._obs) == n_obs
+
+
+# --------------------------------------------------------------------------
+# Scheduler tail: ASHA alias, PBT replay, resource-changing (parity:
+# schedulers/__init__.py, pbt.py Replay, resource_changing_scheduler.py)
+# --------------------------------------------------------------------------
+def test_asha_alias_and_bohb_names():
+    from ray_tpu.tune import ASHAScheduler, AsyncHyperBandScheduler, HyperBandForBOHB, TuneBOHB
+
+    assert ASHAScheduler is AsyncHyperBandScheduler
+    assert issubclass(HyperBandForBOHB, AsyncHyperBandScheduler)
+    with pytest.raises(ImportError, match="ConfigSpace"):
+        TuneBOHB()
+
+
+def test_pbt_replay_applies_recorded_schedule():
+    from ray_tpu.tune import PopulationBasedTrainingReplay
+
+    def trainable(config):
+        for i in range(1, 9):
+            tune.report({"training_iteration": i, "lr_seen": config["lr"], "score": i})
+
+    replay = PopulationBasedTrainingReplay([(4, {"lr": 0.5})])
+    results = tune.run(
+        trainable, config={"lr": 0.1}, num_samples=1,
+        metric="score", mode="max", scheduler=replay,
+    )
+    r = results[0]
+    assert r.config["lr"] == 0.5          # switched at the recorded time
+    assert r.metrics["lr_seen"] == 0.5    # and the restarted loop saw it
+    assert replay._next == 1              # schedule fully consumed
+
+
+def test_pbt_save_policy_roundtrips_into_replay(tmp_path):
+    sched = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.9]}, resample_probability=1.0, seed=0,
+    )
+    weak, strong = _FakeTrial("weak", {"lr": 0.1}), _FakeTrial("strong", {"lr": 1.0})
+    sched.on_trial_result(strong, {"training_iteration": 8, "score": 8.0})
+    sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    assert sched.exploit_target(weak) is not None
+    path = str(tmp_path / "policy.jsonl")
+    sched.save_policy(path, "weak")
+    from ray_tpu.tune import PopulationBasedTrainingReplay
+
+    replay = PopulationBasedTrainingReplay(path)
+    assert replay._policy[0][0] == 4
+    assert replay._policy[0][1]["lr"] == 0.9
+
+
+def test_resource_changing_scheduler_sets_trial_resources():
+    from ray_tpu.tune import ResourceChangingScheduler
+
+    seen = []
+
+    def alloc(controller, trial, result, scheduler):
+        seen.append(result["training_iteration"])
+        return {"CPU": 2.0}
+
+    sched = ResourceChangingScheduler(resources_allocation_function=alloc)
+    t = _FakeTrial("t1", {"x": 1})
+    t.status = "RUNNING"
+    assert sched.on_trial_result(t, {"training_iteration": 1, "score": 1.0}) == "CONTINUE"
+    assert t.resources == {"CPU": 2.0}
+    assert seen == [1]
+
+
+def test_resource_changing_scheduler_end_to_end():
+    from ray_tpu.tune import DistributeResources, ResourceChangingScheduler
+
+    def trainable(config):
+        for i in range(1, 4):
+            tune.report({"training_iteration": i, "score": i * config["lr"]})
+
+    sched = ResourceChangingScheduler(
+        resources_allocation_function=DistributeResources({"CPU": 1}),
+    )
+    results = tune.run(trainable, config={"lr": tune.choice([0.1, 1.0])},
+                       num_samples=2, metric="score", mode="max", scheduler=sched)
+    assert len(results) == 2
+    assert all(r.metrics["training_iteration"] == 3 for r in results)
+
+
+def test_resource_changing_wrapper_forwards_pbt_exploits():
+    from ray_tpu.tune import ResourceChangingScheduler
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.9]}, resample_probability=1.0, seed=0,
+    )
+    wrapper = ResourceChangingScheduler(base_scheduler=pbt)
+    weak, strong = _FakeTrial("weak", {"lr": 0.1}), _FakeTrial("strong", {"lr": 1.0})
+    wrapper.on_trial_result(strong, {"training_iteration": 8, "score": 8.0})
+    wrapper.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    assert wrapper.at_perturbation_boundary({"training_iteration": 4, "score": 0.4})
+    out = wrapper.exploit_target(weak)
+    assert out is not None and out[0]["lr"] == 0.9
+
+
+def test_pb2_policy_log_records_post_gp_config():
+    from ray_tpu.tune import PB2
+
+    sched = PB2(metric="score", mode="max", perturbation_interval=4,
+                hyperparam_bounds={"lr": [0.0, 1.0]}, seed=0)
+    weak, strong = _FakeTrial("weak", {"lr": 0.1}), _FakeTrial("strong", {"lr": 0.9})
+    sched.on_trial_result(strong, {"training_iteration": 8, "score": 8.0})
+    sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
+    new_cfg, _ = sched.exploit_target(weak)
+    assert sched.policy_log[-1]["config"]["lr"] == new_cfg["lr"]
