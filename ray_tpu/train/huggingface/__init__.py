@@ -13,6 +13,8 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.predictor import Predictor
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 
 
@@ -87,3 +89,98 @@ class TransformersTrainer(TorchTrainer):
             hf_trainer.train()
 
         super().__init__(loop, **kwargs)
+
+
+class TransformersCheckpoint(Checkpoint):
+    """A checkpoint holding a ``save_pretrained`` HF model directory
+    (parity: ``train/huggingface/transformers/transformers_checkpoint.py``)."""
+
+    @classmethod
+    def from_model(cls, model, tokenizer=None, base_dir: Optional[str] = None) -> "TransformersCheckpoint":
+        import tempfile
+
+        d = base_dir or tempfile.mkdtemp(prefix="hf_ckpt_")
+        os.makedirs(d, exist_ok=True)
+        model.save_pretrained(d)
+        if tokenizer is not None:
+            tokenizer.save_pretrained(d)
+        return cls(d)
+
+    def get_model(self, model_cls=None):
+        """Reload with ``model_cls.from_pretrained`` (AutoModel default)."""
+        if model_cls is None:
+            from transformers import AutoModel as model_cls  # noqa: N813
+        return model_cls.from_pretrained(self.path)
+
+
+class TransformersPredictor(Predictor):
+    """Batch inference with a HF model or pipeline (parity:
+    ``train/huggingface/transformers/transformers_predictor.py``).
+
+    Two modes: a ``transformers.pipeline`` (rows in, list-of-dicts out —
+    one DataFrame column per output key), or a bare model whose forward
+    consumes ``input_ids`` and yields ``.logits``.
+    """
+
+    def __init__(self, model=None, pipeline=None, preprocessor=None):
+        super().__init__(preprocessor)
+        if model is None and pipeline is None:
+            raise ValueError("TransformersPredictor needs a model or a pipeline")
+        self.model = model
+        self.pipeline = pipeline
+        if self.model is not None:
+            self.model.eval()
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint: Checkpoint,
+        *,
+        model_cls=None,
+        pipeline_task: Optional[str] = None,
+        preprocessor=None,
+        **pipeline_kwargs,
+    ) -> "TransformersPredictor":
+        ckpt = TransformersCheckpoint(checkpoint.path)
+        if pipeline_task is not None:
+            from transformers import pipeline as hf_pipeline
+
+            return cls(
+                pipeline=hf_pipeline(pipeline_task, model=ckpt.path, **pipeline_kwargs),
+                preprocessor=preprocessor,
+            )
+        if model_cls is None:
+            # AutoModel would load the HEADLESS base model and silently hand
+            # back hidden states as "predictions"; the logits contract needs
+            # a headed class by default
+            from transformers import AutoModelForCausalLM as model_cls  # noqa: N813
+        return cls(model=ckpt.get_model(model_cls), preprocessor=preprocessor)
+
+    def _predict_pandas(self, df, **kwargs):
+        import pandas as pd
+
+        if self.pipeline is not None:
+            rows = self.pipeline(list(df[df.columns[0]]), **kwargs)
+            return pd.DataFrame(rows)
+        arrays = {c: df[c].to_numpy() for c in df.columns}
+        out = self._predict_numpy(arrays, **kwargs)
+        from ray_tpu.train.predictor import wrap_predictions_column
+
+        return pd.DataFrame({k: wrap_predictions_column(v) for k, v in out.items()})
+
+    def _predict_numpy(self, data, **kwargs):
+        import numpy as np
+        import torch
+
+        if self.pipeline is not None:
+            # route dict/array batches through the pandas path's pipeline call
+            raise TypeError(
+                "pipeline-mode TransformersPredictor takes DataFrame batches "
+                "(one text column); pass a model for tensor batches"
+            )
+        x = data["input_ids"] if isinstance(data, dict) else data
+        ids = torch.from_numpy(np.asarray(x, dtype=np.int64))
+        with torch.no_grad():
+            out = self.model(input_ids=ids, **kwargs)
+        logits = out.logits if hasattr(out, "logits") else out[0]
+        return {"predictions": logits.detach().cpu().numpy()}

@@ -390,8 +390,12 @@ def create_scheduler(name: str, **kwargs):
         "async_hyperband": S.AsyncHyperBandScheduler,
         "asha": S.AsyncHyperBandScheduler,
         "hyperband": S.HyperBandScheduler,
+        "hb_bohb": S.HyperBandForBOHB,
         "median_stopping_rule": S.MedianStoppingRule,
         "pbt": S.PopulationBasedTraining,
+        "pbt_replay": S.PopulationBasedTrainingReplay,
+        "pb2": S.PB2,
+        "resource_changing": S.ResourceChangingScheduler,
     }
     if name not in table:
         raise TuneError(f"unknown scheduler {name!r}; choose from {sorted(table)}")

@@ -403,8 +403,26 @@ class PopulationBasedTrainingReplay(PopulationBasedTraining):
             self._policy = [(t, dict(cfg)) for t, cfg in policy]
         self._policy.sort(key=lambda tc: tc[0])
         self._next = 0
+        # replay is a SINGLE-trial scheduler: the first trial to report
+        # becomes the replay target; siblings run untouched (and warned
+        # about) instead of racing each other for policy steps
+        self._target_trial: Optional[str] = None
+        self._warned: set = set()
 
     def on_trial_result(self, trial, result: dict) -> str:
+        if self._target_trial is None:
+            self._target_trial = trial.trial_id
+        elif trial.trial_id != self._target_trial and trial.trial_id not in self._warned:
+            self._warned.add(trial.trial_id)
+            import warnings
+
+            warnings.warn(
+                "PopulationBasedTrainingReplay replays ONE trial's schedule; "
+                f"trial {trial.trial_id} runs with its original config "
+                f"(replay target: {self._target_trial}). Use num_samples=1.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         self._last_t[trial.trial_id] = result.get(self.time_attr, 0)
         return CONTINUE
 
@@ -416,6 +434,8 @@ class PopulationBasedTrainingReplay(PopulationBasedTraining):
 
     def exploit_target(self, trial):
         if self._next >= len(self._policy):
+            return None
+        if self._target_trial is not None and trial.trial_id != self._target_trial:
             return None
         t = self._last_t.get(trial.trial_id, 0)
         if t < self._policy[self._next][0]:
@@ -444,13 +464,21 @@ class DistributeResources:
         except Exception:
             return None
         running = 1
+        declared: Dict[str, float] = {}
         if tune_controller is not None:
             running = max(
                 1, sum(1 for t in tune_controller.trials if t.status == "RUNNING")
             )
+            declared = dict(
+                getattr(tune_controller.trainable, "_tune_resources", None) or {}
+            )
         share = int(total // running) if total else 0
-        out = dict(self.base)
-        out["CPU"] = max(float(out.get("CPU", 1)), float(share or out.get("CPU", 1)))
+        # the floor is the trial's DECLARED request (with_resources), raised
+        # to the policy base — a reallocation must never shrink a trial
+        # below what it asked for, and non-CPU reservations pass through
+        out = {**declared, **{k: v for k, v in self.base.items() if k not in declared}}
+        floor = max(float(self.base.get("CPU", 1)), float(declared.get("CPU", 0) or 0))
+        out["CPU"] = max(floor, float(share or floor))
         return out
 
 

@@ -8,6 +8,7 @@ from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 from ray_tpu.util.check_serialize import inspect_serializability
 from ray_tpu.util import collective, iter, pdb  # noqa: A004 — reference name
+from ray_tpu.util import pdb as ray_debugpy  # reference exports util.debugpy under this name
 from ray_tpu.util.client.worker import connect
 from ray_tpu.util.misc import (
     deregister_serializer,
@@ -70,5 +71,6 @@ __all__ = [
     "list_named_actors",
     "log_once",
     "pdb",
+    "ray_debugpy",
     "register_serializer",
 ]

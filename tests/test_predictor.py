@@ -219,3 +219,47 @@ def test_base_predictor_requires_an_impl():
 
     with pytest.raises(NotImplementedError, match="implements neither"):
         Empty().predict(pd.DataFrame({"a": [1.0]}))
+
+
+def test_transformers_predictor_roundtrip(tmp_path):
+    transformers = pytest.importorskip("transformers")
+
+    from ray_tpu.train.huggingface import TransformersCheckpoint, TransformersPredictor
+
+    model = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(vocab_size=32, n_positions=8, n_embd=8, n_layer=1, n_head=2)
+    )
+    ckpt = TransformersCheckpoint.from_model(model, base_dir=str(tmp_path))
+    p = TransformersPredictor.from_checkpoint(ckpt, model_cls=transformers.GPT2LMHeadModel)
+    ids = np.array([[1, 2, 3], [4, 5, 6]], dtype=np.int64)
+    out = p.predict(ids)
+    assert out["predictions"].shape == (2, 3, 32)
+    # reloaded weights match the saved model exactly (eval: dropout off)
+    import torch
+
+    model.eval()
+    with torch.no_grad():
+        want = model(input_ids=torch.from_numpy(ids)).logits.numpy()
+    assert np.allclose(out["predictions"], want, atol=1e-5)
+
+
+def test_transformers_predictor_requires_model_or_pipeline():
+    pytest.importorskip("transformers")
+    from ray_tpu.train.huggingface import TransformersPredictor
+
+    with pytest.raises(ValueError, match="model or a pipeline"):
+        TransformersPredictor()
+
+
+def test_transformers_predictor_default_class_keeps_logits_contract(tmp_path):
+    transformers = pytest.importorskip("transformers")
+
+    from ray_tpu.train.huggingface import TransformersCheckpoint, TransformersPredictor
+
+    model = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(vocab_size=32, n_positions=8, n_embd=8, n_layer=1, n_head=2)
+    )
+    ckpt = TransformersCheckpoint.from_model(model, base_dir=str(tmp_path))
+    p = TransformersPredictor.from_checkpoint(ckpt)  # no model_cls
+    out = p.predict(np.array([[1, 2, 3]], dtype=np.int64))
+    assert out["predictions"].shape == (1, 3, 32)  # vocab logits, not hidden states

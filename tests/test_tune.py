@@ -495,3 +495,34 @@ def test_pb2_policy_log_records_post_gp_config():
     sched.on_trial_result(weak, {"training_iteration": 4, "score": 0.4})
     new_cfg, _ = sched.exploit_target(weak)
     assert sched.policy_log[-1]["config"]["lr"] == new_cfg["lr"]
+
+
+def test_replay_binds_to_one_trial():
+    from ray_tpu.tune import PopulationBasedTrainingReplay
+
+    replay = PopulationBasedTrainingReplay([(4, {"lr": 0.5})])
+    a, b = _FakeTrial("a", {"lr": 0.1}), _FakeTrial("b", {"lr": 0.2})
+    replay.on_trial_result(a, {"training_iteration": 2, "score": 1.0})
+    with pytest.warns(RuntimeWarning, match="ONE trial"):
+        replay.on_trial_result(b, {"training_iteration": 4, "score": 1.0})
+    # the sibling never consumes the policy step...
+    assert replay.exploit_target(b) is None and replay._next == 0
+    # ...which stays available for the bound trial
+    replay.on_trial_result(a, {"training_iteration": 4, "score": 2.0})
+    out = replay.exploit_target(a)
+    assert out is not None and out[0]["lr"] == 0.5 and replay._next == 1
+
+
+def test_distribute_resources_floor_is_declared_request():
+    from ray_tpu.tune import DistributeResources
+
+    class _Ctl:
+        class trainable:
+            _tune_resources = {"CPU": 4, "TPU": 2}
+
+        trials = []
+
+    alloc = DistributeResources()
+    out = alloc(_Ctl(), _FakeTrial("t", {}), {"training_iteration": 1}, None)
+    assert out["CPU"] >= 4.0          # never below the declared request
+    assert out["TPU"] == 2            # accelerators pass through
