@@ -178,7 +178,18 @@ class TransformersPredictor(Predictor):
                 "pipeline-mode TransformersPredictor takes DataFrame batches "
                 "(one text column); pass a model for tensor batches"
             )
-        x = data["input_ids"] if isinstance(data, dict) else data
+        if isinstance(data, dict):
+            if "input_ids" in data:
+                x = data["input_ids"]
+            elif len(data) == 1:
+                x = next(iter(data.values()))  # sole column = the token ids
+            else:
+                raise KeyError(
+                    "model-mode TransformersPredictor expects an 'input_ids' "
+                    f"column (or a single-column batch); got {sorted(data)}"
+                )
+        else:
+            x = data
         ids = torch.from_numpy(np.asarray(x, dtype=np.int64))
         with torch.no_grad():
             out = self.model(input_ids=ids, **kwargs)

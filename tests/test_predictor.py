@@ -263,3 +263,22 @@ def test_transformers_predictor_default_class_keeps_logits_contract(tmp_path):
     p = TransformersPredictor.from_checkpoint(ckpt)  # no model_cls
     out = p.predict(np.array([[1, 2, 3]], dtype=np.int64))
     assert out["predictions"].shape == (1, 3, 32)  # vocab logits, not hidden states
+
+
+def test_transformers_predictor_sole_column_and_error(tmp_path):
+    transformers = pytest.importorskip("transformers")
+
+    from ray_tpu.train.huggingface import TransformersCheckpoint, TransformersPredictor
+
+    model = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(vocab_size=32, n_positions=8, n_embd=8, n_layer=1, n_head=2)
+    )
+    p = TransformersPredictor.from_checkpoint(
+        TransformersCheckpoint.from_model(model, base_dir=str(tmp_path))
+    )
+    ids = np.array([[1, 2, 3]], dtype=np.int64)
+    # a single dict column under any name is accepted as the token ids
+    out = p.predict({"tokens": ids})
+    assert out["predictions"].shape == (1, 3, 32)
+    with pytest.raises(KeyError, match="input_ids"):
+        p.predict({"a": ids, "b": ids})

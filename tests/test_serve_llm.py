@@ -470,3 +470,17 @@ def test_llm_predictor_cache_respects_kwargs(params):
         assert c.engine.S == 48
     finally:
         clear_engine_cache()  # the supported release API
+
+
+@pytest.mark.full
+def test_llm_bench_script_tiny(monkeypatch, tmp_path):
+    """The decode-throughput bench script measures real waves end-to-end
+    (tiny config; same warmup/accounting paths as the serving-scale run)."""
+    monkeypatch.setenv("RAY_TPU_LLM_BENCH_TINY", "1")
+    from ray_tpu.scripts.llm_bench import main
+
+    out = main(str(tmp_path / "llm.json"))
+    assert out["metric"] == "llm_decode_throughput"
+    assert out["value"] > 0
+    assert out["extra"]["total_tokens"] == 2 * 4 * 3  # slots x tokens x waves
+    assert (tmp_path / "llm.json").exists()
