@@ -46,7 +46,10 @@ def main(out_path: str | None = None) -> dict:
     params = init_params(cfg, jax.random.key(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
 
-    engine = LLMEngine(cfg, params, max_batch_size=B, max_seq_len=seq_cap)
+    chunk = int(os.environ.get("RAY_TPU_LLM_BENCH_CHUNK", "1"))
+    engine = LLMEngine(
+        cfg, params, max_batch_size=B, max_seq_len=seq_cap, decode_chunk=chunk
+    )
     try:
         vocab_span = cfg.vocab_size - 2
         prompts = [
@@ -91,6 +94,7 @@ def main(out_path: str | None = None) -> dict:
         "unit": "tokens/s",
         "extra": {
             "params_millions": round(n_params / 1e6, 1),
+            "decode_chunk": chunk,
             "batch_slots": B,
             "new_tokens_per_request": new_tokens,
             "prompt_len": prompt_len,

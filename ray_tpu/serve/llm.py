@@ -94,10 +94,16 @@ class LLMEngine:
         quantize_min_size: int = 4096,
         mesh: Optional[Any] = None,
         tp: str = "tp",
+        decode_chunk: int = 1,
     ):
         self.cfg = cfg
         self.B = max_batch_size
         self.S = max_seq_len
+        # tokens generated per host round trip (1 = per-token stepping).
+        # >1 amortizes dispatch/readback latency; admission and stream
+        # emission happen at chunk granularity, and a request finishing
+        # mid-chunk discards the tail tokens (identical outputs either way)
+        self.decode_chunk = max(1, int(decode_chunk))
         self.top_k = top_k
         self.top_p = top_p
         self.quantized = quantize
@@ -158,16 +164,6 @@ class LLMEngine:
         use_kernel = None if mesh is None else False
         prefill_kernel = mesh is None and jax.default_backend() == "tpu"
 
-        # the cache is donated through decode/insert: the engine holds the
-        # only reference and reassigns, so XLA updates the [L,B,Hkv,S,Dh]
-        # buffers in place instead of copying them every token
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _decode(params, cache, toks, pos):
-            return decode_step(
-                cfg_, params, cache, toks, pos,
-                layer_scales=layer_scales, use_decode_kernel=use_kernel,
-            )
-
         @jax.jit
         def _prefill_one(params, tokens, length):
             """tokens [1, Tb] (bucket-padded); length is traced so all
@@ -193,17 +189,45 @@ class LLMEngine:
                 )(cache[kk], row[kk])
             return out
 
-        @jax.jit
-        def _sample(key, logits, temps):
+        top_k_, top_p_ = self.top_k, self.top_p
+
+        def _sample_impl(key, logits, temps):
             """Per-slot temperature; temp <= 0 means greedy."""
             greedy = temps <= 0.0
             t = jnp.where(greedy, 1.0, temps)
-            scaled = filter_top_k_top_p(logits / t[:, None], self.top_k, self.top_p)
+            scaled = filter_top_k_top_p(logits / t[:, None], top_k_, top_p_)
             keys = jax.random.split(key, logits.shape[0])
             sampled = jax.vmap(jax.random.categorical)(keys, scaled)
             return jnp.where(greedy, jnp.argmax(logits, -1), sampled).astype(jnp.int32)
 
-        self._decode = _decode
+        _sample = jax.jit(_sample_impl)
+
+        # the decode program: K sequential decode+sample steps inside ONE
+        # jitted lax.scan (K = decode_chunk; 1 = classic per-token
+        # stepping), so the host pays one dispatch/readback round trip per
+        # K tokens. One key split per generated token.  The cache is
+        # donated: the engine holds the only reference and reassigns, so
+        # XLA updates the [L,B,Hkv,S,Dh] buffers in place.
+        K_chunk = self.decode_chunk
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode_k(params, cache, toks, pos, temps, key):
+            def body(carry, _):
+                cache, toks, pos, key = carry
+                logits, cache = decode_step(
+                    cfg_, params, cache, toks, pos,
+                    layer_scales=layer_scales, use_decode_kernel=use_kernel,
+                )
+                key, sub = jax.random.split(key)
+                nxt = _sample_impl(sub, logits, temps)
+                return (cache, nxt, pos + 1, key), nxt
+
+            (cache, _, _, key), toks_k = jax.lax.scan(
+                body, (cache, toks, pos, key), None, length=K_chunk
+            )
+            return jnp.swapaxes(toks_k, 0, 1), cache, key  # [B, K]
+
+        self._decode_k = _decode_k
         self._prefill_one = _prefill_one
         self._insert = _insert
         self._sample = _sample
@@ -356,19 +380,22 @@ class LLMEngine:
     def _step(self) -> None:
         toks = jnp.asarray(self._last_tok)
         pos = jnp.asarray(self._pos)
-        logits, self._cache = self._decode(self.params, self._cache, toks, pos)
-        self._key, sub = jax.random.split(self._key)
-        sampled = np.asarray(self._sample(sub, logits, jnp.asarray(self._temps)))
-        for i in range(self.B):
-            req = self._slots[i]
-            if req is None:
-                continue
-            tok = int(sampled[i])
-            req.generated.append(tok)
-            req.emit(tok)
-            self._pos[i] += 1
-            self._last_tok[i] = tok
-            self._maybe_finish(req, tok)
+        out, self._cache, self._key = self._decode_k(
+            self.params, self._cache, toks, pos,
+            jnp.asarray(self._temps), self._key,
+        )
+        sampled = np.asarray(out)  # [B, K]
+        for k in range(sampled.shape[1]):
+            for i in range(self.B):
+                req = self._slots[i]
+                if req is None:
+                    continue  # free, or finished earlier in this chunk
+                tok = int(sampled[i, k])
+                req.generated.append(tok)
+                req.emit(tok)
+                self._pos[i] += 1
+                self._last_tok[i] = tok
+                self._maybe_finish(req, tok)
 
     def _reset_cache(self) -> None:
         """(Re)allocate the decode cache — also the recovery path after a
@@ -434,6 +461,7 @@ class LLMServer:
         quantize: bool = False,
         mesh: Optional[Any] = None,
         tp: str = "tp",
+        decode_chunk: int = 1,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
@@ -448,6 +476,7 @@ class LLMServer:
             quantize=quantize,
             mesh=mesh,
             tp=tp,
+            decode_chunk=decode_chunk,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:

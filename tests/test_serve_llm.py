@@ -484,3 +484,52 @@ def test_llm_bench_script_tiny(monkeypatch, tmp_path):
     assert out["value"] > 0
     assert out["extra"]["total_tokens"] == 2 * 4 * 3  # slots x tokens x waves
     assert (tmp_path / "llm.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# chunked decode (decode_chunk > 1): K tokens per host round trip
+# ---------------------------------------------------------------------------
+def test_chunked_engine_matches_generate(params):
+    eng = LLMEngine(CFG, params, max_batch_size=4, max_seq_len=64, decode_chunk=3)
+    try:
+        prompt = [3, 14, 15, 9, 2]
+        # 8 tokens with K=3: 1 at admission + 3 + 3 + 1-of-3 — the request
+        # finishes mid-chunk and the 2 tail tokens are discarded
+        assert eng.generate(prompt, max_tokens=8) == _reference(params, prompt, 8)
+    finally:
+        eng.shutdown()
+
+
+def test_chunked_engine_concurrent_ragged(params):
+    eng = LLMEngine(CFG, params, max_batch_size=4, max_seq_len=64, decode_chunk=4)
+    try:
+        prompts = [[5, 6], [7, 8, 9, 10, 11], [1] * 17, [42], [13, 12, 11]]
+        ns = [9, 5, 7, 11, 6]  # ragged lengths, several mid-chunk finishes
+        futs = [eng.submit(p, max_tokens=n) for p, n in zip(prompts, ns)]
+        got = [f.result(timeout=120) for f in futs]
+        for p, n, g in zip(prompts, ns, got):
+            assert g == _reference(params, p, n)
+    finally:
+        eng.shutdown()
+
+
+def test_chunked_engine_eos_mid_chunk(params):
+    # eos = the SECOND greedy token: the first comes from prefill at
+    # admission, so this eos fires at k=0 INSIDE a 4-token decode chunk —
+    # the request must stop there and the chunk's 3 tail tokens discard
+    # find a prompt whose first two greedy tokens differ, so eos=t2 cannot
+    # fire at admission (t1 from prefill) and must fire INSIDE the chunk
+    for seed in range(1, 40):
+        prompt = [seed, (seed * 7) % 88 + 1, (seed * 3) % 88 + 1]
+        t1, t2 = _reference(params, prompt, 2)
+        if t1 != t2:
+            break
+    assert t1 != t2
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64, decode_chunk=4)
+    try:
+        got = eng.generate(prompt, max_tokens=10, eos_id=t2)
+        assert got == [t1, t2]
+        # the slot is reusable afterwards: a second request still works
+        assert eng.generate(prompt, max_tokens=3) == _reference(params, prompt, 3)
+    finally:
+        eng.shutdown()
