@@ -598,39 +598,13 @@ class Dataset:
 
         return [to_arrow.remote(r) for r in self.materialize()._refs]
 
-    def to_torch(
-        self,
-        *,
-        label_column: Optional[str] = None,
-        feature_columns: Optional[List[str]] = None,
-        batch_size: int = 1,
-        drop_last: bool = False,
-        local_shuffle_buffer_size: Optional[int] = None,
-    ):
+    def to_torch(self, **kwargs):
         """A ``torch.utils.data.IterableDataset`` yielding
         ``(features, label)`` tensor pairs (label None when no
-        ``label_column``) — parity: Dataset.to_torch."""
-        import torch
-
-        outer = self
-
-        class _TorchIterable(torch.utils.data.IterableDataset):
-            def __iter__(self):
-                it = outer.iter_torch_batches(
-                    batch_size=batch_size,
-                    drop_last=drop_last,
-                    local_shuffle_buffer_size=local_shuffle_buffer_size,
-                )
-                for batch in it:
-                    label = batch.pop(label_column) if label_column else None
-                    cols = feature_columns or list(batch)
-                    # consistent (B, num_cols) float contract regardless of
-                    # column count — a model must not change shape because
-                    # the feature list grew by one
-                    feats = torch.stack([batch[c].float() for c in cols], dim=1)
-                    yield feats, label
-
-        return _TorchIterable()
+        ``label_column``) — parity: Dataset.to_torch.  Delegates to
+        :meth:`DataIterator.to_torch` so both entry points share one
+        implementation (dtype handling, dict feature groups, prefetch)."""
+        return self.iterator().to_torch(**kwargs)
 
     def to_random_access_dataset(self, key: str, *, num_workers: int = 4):
         """Serve this dataset for random key lookups from a pool of actors

@@ -176,6 +176,133 @@ class DataIterator:
             return self._owner.materialize()
         raise NotImplementedError
 
+    # -------------------------------------------------- metadata + torch
+    def schema(self):
+        """Schema of the iterated dataset (parity: iterator.py:258) —
+        the owner's schema when attached.  Owner-less iterators
+        (streaming_split consumers) return None: their source is a
+        single-pass queue, and probing a batch to infer dtypes would
+        permanently drop those rows from the stream."""
+        if self._owner is not None and hasattr(self._owner, "schema"):
+            return self._owner.schema()
+        return None
+
+    def stats(self) -> str:
+        """Execution-timing report (parity: iterator.py:253)."""
+        if self._owner is not None and hasattr(self._owner, "stats"):
+            return self._owner.stats()
+        return ""
+
+    def to_torch(
+        self,
+        *,
+        label_column=None,
+        feature_columns=None,
+        label_column_dtype=None,
+        feature_column_dtypes=None,
+        batch_size: int = 1,
+        prefetch_batches: int = 1,
+        drop_last: bool = False,
+        local_shuffle_buffer_size=None,
+        local_shuffle_seed=None,
+        unsqueeze_label_tensor: bool = True,
+        unsqueeze_feature_tensors: bool = True,
+    ):
+        """Torch IterableDataset of ``(features, label)`` tuples (parity:
+        iterator.py:485).  ``feature_columns`` as a list of names packs one
+        ``[B, F]`` tensor; a dict of name-lists yields a dict of tensors;
+        None packs every non-label column."""
+        import torch
+
+        it = self
+
+        def _features(batch, cols):
+            ts = []
+            for j, c in enumerate(cols):
+                t = torch.as_tensor(batch[c])
+                if feature_column_dtypes is not None:
+                    if isinstance(feature_column_dtypes, dict):
+                        dt = feature_column_dtypes.get(c)
+                    elif isinstance(feature_column_dtypes, (list, tuple)):
+                        dt = feature_column_dtypes[j]  # positional, parity
+                    else:
+                        dt = feature_column_dtypes
+                    if dt is not None:
+                        t = t.to(dt)
+                if t.dim() == 1 and unsqueeze_feature_tensors:
+                    t = t.unsqueeze(1)
+                ts.append(t)
+            if len(ts) == 1:
+                return ts[0]
+            if any(t.dim() == 1 for t in ts):
+                raise ValueError(
+                    "to_torch: multiple 1-D feature columns cannot concatenate "
+                    "with unsqueeze_feature_tensors=False — keep it True (each "
+                    "column becomes [B, 1] before the [B, F] concat)"
+                )
+            return torch.cat(ts, dim=1)
+
+        class _IterableDS(torch.utils.data.IterableDataset):
+            def __iter__(self_ds):
+                source = it.iter_batches(
+                    batch_size=batch_size,
+                    batch_format="numpy",
+                    drop_last=drop_last,
+                    local_shuffle_buffer_size=local_shuffle_buffer_size,
+                    local_shuffle_seed=local_shuffle_seed,
+                )
+                if prefetch_batches and prefetch_batches > 0:
+                    source = _prefetch(source, prefetch_batches)
+                for batch in source:
+                    label = None
+                    if label_column is not None:
+                        label = torch.as_tensor(batch[label_column])
+                        if label_column_dtype is not None:
+                            label = label.to(label_column_dtype)
+                        if unsqueeze_label_tensor and label.dim() == 1:
+                            label = label.unsqueeze(1)
+                    if isinstance(feature_columns, dict):
+                        feats = {
+                            k: _features(batch, cols)
+                            for k, cols in feature_columns.items()
+                        }
+                    else:
+                        cols = feature_columns or [
+                            c for c in batch.keys() if c != label_column
+                        ]
+                        feats = _features(batch, cols)
+                    yield feats, label
+
+        return _IterableDS()
+
+
+def _prefetch(source: Iterator[Any], n: int) -> Iterator[Any]:
+    """Run the source iterator in a background thread, keeping up to ``n``
+    items buffered ahead of the consumer (the ``prefetch_batches`` contract:
+    batch formatting/IO overlaps the training step)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, n))
+    END = object()
+
+    def pump():
+        try:
+            for item in source:
+                q.put(item)
+            q.put(END)
+        except BaseException as exc:  # noqa: BLE001 — re-raised on the consumer
+            q.put(exc)
+
+    threading.Thread(target=pump, daemon=True, name="to-torch-prefetch").start()
+    while True:
+        item = q.get()
+        if item is END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
 
 def _shuffle_blocks(source: Iterator[Block], buffer_size: int, seed: Optional[int]) -> Iterator[Block]:
     """Local shuffle: accumulate rows into a buffer, emit shuffled slices
@@ -197,3 +324,4 @@ def _shuffle_blocks(source: Iterator[Block], buffer_size: int, seed: Optional[in
         acc = BlockAccessor(merged)
         perm = rng.permutation(acc.num_rows())
         yield acc.take(perm)
+

@@ -253,3 +253,66 @@ def test_compat_surface_actor_pool_sinks_schema(tmp_path):
         assert sorted(r["id"] for r in rows) == list(range(8))
     finally:
         ctx.execution_options.resource_limits = rd.ExecutionResources()
+
+
+# --------------------------------------------------------------------------
+# DataIterator tail: schema/stats/to_torch (parity: iterator.py:253/258/485)
+# --------------------------------------------------------------------------
+def test_iterator_schema_and_stats():
+    ds = rd.from_items([{"a": float(i), "label": i % 2} for i in range(8)])
+    it = ds.iterator()
+    sch = it.schema()
+    assert sch is not None and "a" in sch.names
+    assert isinstance(it.stats(), str)
+
+
+def test_iterator_to_torch_packs_features_and_label():
+    import torch
+
+    ds = rd.from_items(
+        [{"a": float(i), "b": float(2 * i), "label": float(i % 2)} for i in range(8)]
+    )
+    tds = ds.iterator().to_torch(label_column="label", batch_size=4)
+    batches = list(tds)
+    assert len(batches) == 2
+    feats, label = batches[0]
+    assert feats.shape == (4, 2) and label.shape == (4, 1)
+    # dict-of-column-lists -> dict of tensors; no label -> None
+    tds2 = ds.iterator().to_torch(
+        feature_columns={"x": ["a"], "y": ["b", "a"]}, batch_size=8
+    )
+    feats2, label2 = next(iter(tds2))
+    assert label2 is None
+    assert feats2["x"].shape == (8, 1) and feats2["y"].shape == (8, 2)
+    assert torch.equal(feats2["y"][:, 1:2], feats2["x"])
+
+
+def test_to_torch_dtype_list_prefetch_and_dataset_delegation():
+    import torch
+
+    ds = rd.from_items(
+        [{"a": float(i), "b": float(3 * i), "label": float(i)} for i in range(8)]
+    )
+    # positional dtype list + background prefetch
+    tds = ds.iterator().to_torch(
+        label_column="label", feature_columns=["a", "b"],
+        feature_column_dtypes=[torch.float64, torch.float32],
+        batch_size=4, prefetch_batches=2,
+    )
+    feats, label = next(iter(tds))
+    assert feats.shape == (4, 2) and feats.dtype == torch.float64  # cat upcasts
+    # Dataset.to_torch is the same implementation
+    feats2, label2 = next(iter(ds.to_torch(label_column="label", batch_size=4)))
+    assert feats2.shape == (4, 2) and label2.shape == (4, 1)
+    # multiple 1-D columns with unsqueeze off is a clear error, not a crash
+    with pytest.raises(ValueError, match="unsqueeze_feature_tensors"):
+        next(iter(ds.iterator().to_torch(
+            label_column="label", feature_columns=["a", "b"],
+            unsqueeze_feature_tensors=False, batch_size=4,
+        )))
+    # owner-less (streaming_split) schema is None, and no rows are lost
+    left, right = ds.streaming_split(2)
+    assert left.schema() is None
+    n = sum(len(b["a"]) for b in left.iter_batches(batch_size=4)) + sum(
+        len(b["a"]) for b in right.iter_batches(batch_size=4))
+    assert n == 8
