@@ -603,7 +603,10 @@ class Dataset:
         ``(features, label)`` tensor pairs (label None when no
         ``label_column``) — parity: Dataset.to_torch.  Delegates to
         :meth:`DataIterator.to_torch` so both entry points share one
-        implementation (dtype handling, dict feature groups, prefetch)."""
+        implementation (dtype handling, dict feature groups, prefetch).
+        Reference semantics: column dtypes are PRESERVED (cast explicitly
+        via ``feature_column_dtypes``/``label_column_dtype``) and the label
+        unsqueezes to ``[B, 1]`` unless ``unsqueeze_label_tensor=False``."""
         return self.iterator().to_torch(**kwargs)
 
     def to_random_access_dataset(self, key: str, *, num_workers: int = 4):

@@ -216,6 +216,15 @@ class DataIterator:
 
         it = self
 
+        if isinstance(feature_columns, dict) and isinstance(
+            feature_column_dtypes, (list, tuple)
+        ):
+            raise ValueError(
+                "to_torch: positional feature_column_dtypes cannot pair with "
+                "dict feature_columns (the index would reset per group) — "
+                "use a {column: dtype} dict"
+            )
+
         def _features(batch, cols):
             ts = []
             for j, c in enumerate(cols):
@@ -224,6 +233,12 @@ class DataIterator:
                     if isinstance(feature_column_dtypes, dict):
                         dt = feature_column_dtypes.get(c)
                     elif isinstance(feature_column_dtypes, (list, tuple)):
+                        if len(feature_column_dtypes) != len(cols):
+                            raise ValueError(
+                                "to_torch: feature_column_dtypes has "
+                                f"{len(feature_column_dtypes)} entries for "
+                                f"{len(cols)} feature columns"
+                            )
                         dt = feature_column_dtypes[j]  # positional, parity
                     else:
                         dt = feature_column_dtypes
@@ -267,8 +282,16 @@ class DataIterator:
                             for k, cols in feature_columns.items()
                         }
                     else:
+                        import numpy as _np
+
+                        # default selection skips non-numeric (id/text)
+                        # columns, matching iter_torch_batches above
                         cols = feature_columns or [
-                            c for c in batch.keys() if c != label_column
+                            c
+                            for c in batch.keys()
+                            if c != label_column
+                            # skip non-numeric (object/str/bytes) columns
+                            and _np.asarray(batch[c]).dtype.kind not in "OUS"
                         ]
                         feats = _features(batch, cols)
                     yield feats, label
@@ -279,29 +302,52 @@ class DataIterator:
 def _prefetch(source: Iterator[Any], n: int) -> Iterator[Any]:
     """Run the source iterator in a background thread, keeping up to ``n``
     items buffered ahead of the consumer (the ``prefetch_batches`` contract:
-    batch formatting/IO overlaps the training step)."""
+    batch formatting/IO overlaps the training step).
+
+    A consumer that stops early (break / next-once / GC) closes this
+    generator; the finally block signals the pump, whose timeout-put loop
+    notices and exits — no thread or source iterator outlives the consumer.
+    """
     import queue
     import threading
 
     q: "queue.Queue" = queue.Queue(maxsize=max(1, n))
     END = object()
+    stopped = threading.Event()
+
+    def _put(item) -> bool:
+        while not stopped.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def pump():
         try:
             for item in source:
-                q.put(item)
-            q.put(END)
+                if not _put(item):
+                    return
+            _put(END)
         except BaseException as exc:  # noqa: BLE001 — re-raised on the consumer
-            q.put(exc)
+            _put(exc)
+        finally:
+            close = getattr(source, "close", None)
+            if stopped.is_set() and close is not None:
+                close()
 
     threading.Thread(target=pump, daemon=True, name="to-torch-prefetch").start()
-    while True:
-        item = q.get()
-        if item is END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stopped.set()
 
 
 def _shuffle_blocks(source: Iterator[Block], buffer_size: int, seed: Optional[int]) -> Iterator[Block]:

@@ -193,7 +193,69 @@ class Algorithm:
         if runners is not None:
             runners.stop()
 
-    # -- checkpointing (parity: Algorithm.save/restore) ---------------------
+    # -- inference API (parity: Algorithm.compute_single_action /
+    # compute_actions / get_module / get_policy / weights) ------------------
+    def _learner_group(self):
+        lg = getattr(self, "learners", None) or getattr(self, "learner", None)
+        if lg is None:
+            raise NotImplementedError(f"{type(self).__name__} has no learner group")
+        return lg
+
+    def get_module(self, module_id: Optional[str] = None):
+        """The RLModule holding the trained policy (parity: get_module;
+        single-module algorithms ignore ``module_id``)."""
+        m = getattr(self, "module", None)
+        if m is None:
+            raise NotImplementedError(f"{type(self).__name__} exposes no RLModule")
+        return m
+
+    def get_policy(self, policy_id: Optional[str] = None):
+        """New-stack parity: the RLModule IS the policy object."""
+        return self.get_module(policy_id)
+
+    def get_weights(self, policies: Optional[list] = None):
+        """The current parameter pytree (parity: get_weights)."""
+        return self._learner_group().params
+
+    def set_weights(self, weights) -> None:
+        lg = self._learner_group()
+        target = getattr(lg, "learner", lg)  # LearnerGroup wraps one Learner
+        target.params = weights
+
+    def compute_single_action(self, observation, *, explore: bool = False):
+        """Action for ONE observation with the trained policy (parity:
+        compute_single_action).  ``explore=False`` is the greedy
+        forward_inference path; stochastic exploration belongs to the
+        algorithm's own rollout machinery."""
+        import numpy as np
+
+        if explore:
+            raise NotImplementedError(
+                "compute_single_action(explore=True): use the algorithm's "
+                "rollout path; inference here is greedy (reference "
+                "forward_inference semantics)"
+            )
+        obs = np.asarray(observation)[None, ...]
+        act = self.compute_actions(obs)
+        a = act[0]
+        return a.item() if getattr(a, "ndim", 1) == 0 else a
+
+    def compute_actions(self, observations, *, explore: bool = False):
+        """Greedy actions for a batch of observations (parity:
+        compute_actions)."""
+        import numpy as np
+
+        if explore:
+            raise NotImplementedError("see compute_single_action")
+        module = self.get_module()
+        if not hasattr(module, "inference_action"):
+            raise NotImplementedError(
+                f"{type(module).__name__} has no inference_action"
+            )
+        params = self._learner_group().params
+        return np.asarray(module.inference_action(params, np.asarray(observations)))
+
+    # -- checkpointing (parity: Algorithm.save/restore/from_checkpoint) -----
     def get_state(self) -> Dict[str, Any]:
         return {
             "learner": self.learners.get_state(),
@@ -206,14 +268,84 @@ class Algorithm:
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
 
+    # config attributes holding whole offline datasets — stripped from
+    # checkpoints (a periodic save must not serialize multi-GB replay data)
+    _HEAVY_CONFIG_ATTRS = ("offline_data",)
+
     def save(self, path: str) -> str:
+        """Self-describing checkpoint: state + the pickled config, so
+        :meth:`from_checkpoint` can rebuild without the caller re-supplying
+        the algorithm class or its configuration.  Offline datasets on the
+        config are NOT serialized; a revived offline algorithm carries its
+        trained weights but needs fresh data to continue training."""
+        cfg = self.config
+        stripped = {
+            a: getattr(cfg, a)
+            for a in self._HEAVY_CONFIG_ATTRS
+            if getattr(cfg, a, None) is not None
+        }
+        if stripped:
+            cfg = cfg.copy()
+            for a in stripped:
+                setattr(cfg, a, None)
         with open(path, "wb") as f:
-            pickle.dump(self.get_state(), f)
+            pickle.dump(
+                {
+                    "__algo_ckpt__": 1,
+                    "config": cfg,
+                    "stripped_config_attrs": sorted(stripped),
+                    "state": self.get_state(),
+                },
+                f,
+            )
         return path
 
     def restore(self, path: str) -> None:
         with open(path, "rb") as f:
-            self.set_state(pickle.load(f))
+            blob = pickle.load(f)
+        # accept both the self-describing format and a bare state dict
+        self.set_state(blob["state"] if "__algo_ckpt__" in blob else blob)
+
+    @classmethod
+    def from_checkpoint(cls, path: str, config: Optional["AlgorithmConfig"] = None) -> "Algorithm":
+        """Rebuild a trained algorithm from :meth:`save` output (parity:
+        Algorithm.from_checkpoint).  Offline algorithms must pass ``config``
+        carrying the dataset — checkpoints strip offline data."""
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if "__algo_ckpt__" not in blob:
+            raise ValueError(
+                f"{path!r} is a bare state dict (pre-config checkpoint "
+                "format); build the algorithm from its config and call "
+                "restore(path) instead"
+            )
+        stripped = blob.get("stripped_config_attrs") or []
+        if config is None and stripped:
+            raise ValueError(
+                f"checkpoint {path!r} stripped config attrs {stripped} "
+                "(offline datasets are not serialized); pass config= with "
+                "the data attached, or build manually and restore(path)"
+            )
+        algo = (config or blob["config"]).build()
+        algo.set_state(blob["state"])
+        return algo
+
+    # -- Trainable-protocol aliases (parity: Algorithm inherits Trainable) --
+    def step(self) -> Dict[str, Any]:
+        return self.train()
+
+    def cleanup(self) -> None:
+        self.stop()
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        import os
+
+        return self.save(os.path.join(checkpoint_dir, "algorithm_state.pkl"))
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+
+        self.restore(os.path.join(checkpoint_dir, "algorithm_state.pkl"))
 
     # -- Tune integration ---------------------------------------------------
     @classmethod

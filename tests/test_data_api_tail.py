@@ -316,3 +316,43 @@ def test_to_torch_dtype_list_prefetch_and_dataset_delegation():
     n = sum(len(b["a"]) for b in left.iter_batches(batch_size=4)) + sum(
         len(b["a"]) for b in right.iter_batches(batch_size=4))
     assert n == 8
+
+
+def test_to_torch_prefetch_shuts_down_on_early_stop():
+    import gc
+    import threading
+
+    ds = rd.from_items([{"a": float(i), "label": 0.0} for i in range(64)])
+    before = threading.active_count()
+    for _ in range(5):
+        it = iter(ds.to_torch(label_column="label", batch_size=4, prefetch_batches=1))
+        next(it)   # consume one batch, then abandon the iterator
+        del it
+    gc.collect()
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before + 1  # pumps exited, no leak
+
+
+def test_to_torch_skips_object_columns_and_rejects_bad_dtype_spec():
+    import torch
+
+    ds = rd.from_items(
+        [{"name": f"row{i}", "a": float(i), "label": 0.0} for i in range(4)]
+    )
+    feats, _ = next(iter(ds.to_torch(label_column="label", batch_size=4)))
+    assert feats.shape == (4, 1)  # 'name' (object dtype) skipped
+    with pytest.raises(ValueError, match="dict feature_columns"):
+        next(iter(ds.to_torch(
+            feature_columns={"x": ["a"]},
+            feature_column_dtypes=[torch.float32], batch_size=4,
+        )))
+    with pytest.raises(ValueError, match="entries for"):
+        next(iter(ds.to_torch(
+            feature_columns=["a"],
+            feature_column_dtypes=[torch.float32, torch.float64], batch_size=4,
+        )))
