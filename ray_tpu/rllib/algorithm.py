@@ -197,6 +197,8 @@ class Algorithm:
     # compute_actions / get_module / get_policy / weights) ------------------
     def _learner_group(self):
         lg = getattr(self, "learners", None) or getattr(self, "learner", None)
+        if lg is None and hasattr(self, "params"):
+            return self  # DT/CRR-style algorithms hold params directly
         if lg is None:
             raise NotImplementedError(f"{type(self).__name__} has no learner group")
         return lg
@@ -285,7 +287,9 @@ class Algorithm:
             if getattr(cfg, a, None) is not None
         }
         if stripped:
-            cfg = cfg.copy()
+            # shallow copy, NOT cfg.copy() (deepcopy) — deepcopying would
+            # duplicate the very multi-GB dataset the strip exists to avoid
+            cfg = copy.copy(cfg)
             for a in stripped:
                 setattr(cfg, a, None)
         with open(path, "wb") as f:

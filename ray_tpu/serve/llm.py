@@ -529,3 +529,147 @@ class LLMServer:
             self.engine.shutdown()
         except Exception:
             pass
+
+
+class OpenAICompatLLMServer(LLMServer):
+    """OpenAI-compatible request/response adapter over :class:`LLMServer`.
+
+    Accepts the body shapes of ``POST /v1/completions`` (``model`` +
+    ``prompt``) and ``POST /v1/chat/completions`` (``model`` +
+    ``messages``) and answers in the matching OpenAI response envelopes,
+    including streaming chunk events over the proxy's SSE path.  Dispatch
+    is by body shape — the HTTP proxy routes whole apps by path prefix, so
+    one deployment serves both the native protocol and the OpenAI one.
+    (Beyond reference parity: the reference delegates OpenAI-compatible
+    LLM serving to vLLM.)
+
+    Text prompts/messages need the model_factory to supply a tokenizer;
+    token-id prompts work without one.  ``stop`` supports a single token id
+    (honored in-engine as eos) or, with a tokenizer, a string trimmed from
+    the non-streaming response.
+    """
+
+    def __call__(self, request: Any):
+        if isinstance(request, dict) and ("messages" in request or "model" in request):
+            return self._openai(request)
+        return super().__call__(request)
+
+    # ------------------------------------------------------------- openai
+    def _openai(self, body: Dict[str, Any]):
+        import uuid
+
+        chat = "messages" in body
+        prompt_ids = self._openai_prompt(body, chat)
+        stop = body.get("stop")
+        eos_id = None
+        stop_text = None
+        if isinstance(stop, int):
+            eos_id = stop
+        elif isinstance(stop, str):
+            if self.tokenizer is not None:
+                enc = self.tokenizer.encode(stop)
+                if len(enc) == 1:
+                    eos_id = enc[0]
+                else:
+                    stop_text = stop
+            else:
+                raise ValueError("string stop requires a tokenizer")
+        elif isinstance(stop, list) and len(stop) == 1:
+            return self._openai({**body, "stop": stop[0]})
+        elif stop is not None:
+            raise ValueError("stop: a single token id or string is supported")
+
+        kw = dict(
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            eos_id=eos_id,
+        )
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        model = body.get("model", "ray_tpu")
+        created = int(time.time())
+        obj = "chat.completion" if chat else "text_completion"
+
+        if body.get("stream"):
+            if stop_text is not None:
+                raise ValueError(
+                    "streaming with a multi-token stop string is not "
+                    "supported — use a stop that encodes to one token"
+                )
+            stream = self.engine.submit_stream(prompt_ids, **kw)
+
+            def chunks():
+                reason = "length"
+                for tok in stream:
+                    if eos_id is not None and tok == eos_id:
+                        # OpenAI semantics: the stop sequence is excluded
+                        # from the streamed output
+                        reason = "stop"
+                        continue  # engine ends the stream after eos
+                    piece = (
+                        self.tokenizer.decode([tok])
+                        if self.tokenizer is not None
+                        else None
+                    )
+                    delta = (
+                        {"delta": {"content": piece}, "index": 0, "finish_reason": None}
+                        if chat
+                        else {"text": piece, "token_ids": [tok], "index": 0,
+                              "finish_reason": None}
+                    )
+                    yield {"id": rid, "object": obj + ".chunk", "created": created,
+                           "model": model, "choices": [delta]}
+                final = (
+                    {"delta": {}, "index": 0, "finish_reason": reason}
+                    if chat
+                    else {"text": "", "index": 0, "finish_reason": reason}
+                )
+                yield {"id": rid, "object": obj + ".chunk", "created": created,
+                       "model": model, "choices": [final]}
+
+            return chunks()
+
+        out = self.engine.generate(prompt_ids, **kw)
+        finish = "stop" if (eos_id is not None and out and out[-1] == eos_id) else "length"
+        if finish == "stop":
+            out = out[:-1]  # OpenAI semantics: stop sequence excluded
+        text = self.tokenizer.decode(out) if self.tokenizer is not None else None
+        if text is not None and stop_text and stop_text in text:
+            text = text.split(stop_text)[0]
+            finish = "stop"
+        choice: Dict[str, Any] = {"index": 0, "finish_reason": finish, "token_ids": out}
+        if chat:
+            choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text
+        return {
+            "id": rid,
+            "object": obj,
+            "created": created,
+            "model": model,
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": len(out),
+                "total_tokens": len(prompt_ids) + len(out),
+            },
+        }
+
+    def _openai_prompt(self, body: Dict[str, Any], chat: bool) -> List[int]:
+        if chat:
+            messages = body["messages"]
+            if self.tokenizer is None:
+                raise ValueError("chat completions require a tokenizer")
+            template = getattr(self.tokenizer, "apply_chat_template", None)
+            if template is not None:
+                ids = template(messages, add_generation_prompt=True)
+                return list(ids)
+            joined = "\n".join(f"{m['role']}: {m['content']}" for m in messages)
+            return list(self.tokenizer.encode(joined + "\nassistant:"))
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompts require a tokenizer")
+            return list(self.tokenizer.encode(prompt))
+        if isinstance(prompt, list) and all(isinstance(t, int) for t in prompt):
+            return prompt
+        raise ValueError("prompt must be a string or a list of token ids")

@@ -321,21 +321,22 @@ def test_to_torch_dtype_list_prefetch_and_dataset_delegation():
 def test_to_torch_prefetch_shuts_down_on_early_stop():
     import gc
     import threading
+    import time
+
+    def pumps():
+        return sum(1 for t in threading.enumerate() if t.name == "to-torch-prefetch")
 
     ds = rd.from_items([{"a": float(i), "label": 0.0} for i in range(64)])
-    before = threading.active_count()
     for _ in range(5):
         it = iter(ds.to_torch(label_column="label", batch_size=4, prefetch_batches=1))
         next(it)   # consume one batch, then abandon the iterator
         del it
     gc.collect()
     deadline = 50
-    while threading.active_count() > before and deadline:
-        import time
-
+    while pumps() and deadline:
         time.sleep(0.1)
         deadline -= 1
-    assert threading.active_count() <= before + 1  # pumps exited, no leak
+    assert pumps() == 0  # every abandoned pump exited; no leak
 
 
 def test_to_torch_skips_object_columns_and_rejects_bad_dtype_spec():

@@ -704,3 +704,45 @@ def test_crr_weights_good_actions_above_bc_mean():
     algo2.set_state(algo.get_state())
     for x, y in zip(jax.tree.leaves(algo.params), jax.tree.leaves(algo2.params)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_direct_param_algorithms_expose_inference_api():
+    """CRR holds params directly (no learner group); the Algorithm-level
+    compute_actions/get_weights fall back to self.params."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.crr import CRRConfig
+    from ray_tpu.rllib.sample_batch import SampleBatch
+
+    class _Env:
+        discrete = False
+        observation_size = 3
+        action_size = 1
+        action_low = -1.0
+        action_high = 1.0
+        max_episode_steps = 1
+
+    rng = np.random.default_rng(0)
+    n = 64
+    batch = SampleBatch({
+        SampleBatch.OBS: rng.normal(size=(n, 3)).astype(np.float32),
+        SampleBatch.ACTIONS: rng.uniform(-1, 1, size=(n, 1)).astype(np.float32),
+        SampleBatch.REWARDS: rng.normal(size=n).astype(np.float32),
+        SampleBatch.NEXT_OBS: rng.normal(size=(n, 3)).astype(np.float32),
+        SampleBatch.DONES: np.ones(n, bool),
+    })
+    algo = (
+        CRRConfig()
+        .environment(_Env())
+        .offline_data(batch)
+        .training(critic_warmup_updates=1, updates_per_iter=2)
+        .build()
+    )
+    try:
+        algo.train()
+        a = algo.compute_single_action(np.zeros(3, np.float32))
+        assert np.asarray(a).shape == (1,)
+        w = algo.get_weights()
+        algo.set_weights(w)
+    finally:
+        algo.stop()

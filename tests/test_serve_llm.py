@@ -533,3 +533,111 @@ def test_chunked_engine_eos_mid_chunk(params):
         assert eng.generate(prompt, max_tokens=3) == _reference(params, prompt, 3)
     finally:
         eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# OpenAI-compatible adapter (body-shape dispatch; beyond reference parity)
+# ---------------------------------------------------------------------------
+class _Tok:
+    """Toy tokenizer: 1 char = 1 id (offset so ids stay in-vocab)."""
+
+    def encode(self, s):
+        return [ord(c) % 80 + 1 for c in s]
+
+    def decode(self, ids):
+        return "".join(chr((i - 1) % 80 + 97) for i in ids)
+
+
+@pytest.fixture()
+def oai(params):
+    from ray_tpu.serve.llm import OpenAICompatLLMServer
+
+    srv = OpenAICompatLLMServer(
+        lambda: (CFG, params, _Tok()), max_batch_size=4, max_seq_len=64
+    )
+    yield srv
+    srv.engine.shutdown()
+
+
+def test_openai_completions_envelope(oai, params):
+    body = {"model": "m", "prompt": "hi", "max_tokens": 5}
+    resp = oai(body)
+    assert resp["object"] == "text_completion" and resp["id"].startswith("cmpl-")
+    ch = resp["choices"][0]
+    want = _reference(params, _Tok().encode("hi"), 5)
+    assert ch["token_ids"] == want and ch["finish_reason"] == "length"
+    assert resp["usage"] == {"prompt_tokens": 2, "completion_tokens": 5,
+                             "total_tokens": 7}
+    # token-id prompts skip the tokenizer entirely
+    resp2 = oai({"model": "m", "prompt": [3, 1, 4], "max_tokens": 3})
+    assert resp2["choices"][0]["token_ids"] == _reference(params, [3, 1, 4], 3)
+
+
+def test_openai_chat_and_streaming(oai, params):
+    body = {"model": "m", "messages": [{"role": "user", "content": "yo"}],
+            "max_tokens": 4}
+    resp = oai(body)
+    assert resp["object"] == "chat.completion"
+    msg = resp["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+    # streaming chunks end with a finish_reason frame
+    chunks = list(oai({**body, "stream": True}))
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    deltas = [c["choices"][0]["delta"].get("content") for c in chunks[:-1]]
+    assert all(isinstance(d, str) for d in deltas)
+    assert len(deltas) == 4
+
+
+def test_openai_stop_token_and_legacy_dispatch(oai, params):
+    prompt = [3, 14, 15, 9, 2]
+    t1, t2 = _reference(params, prompt, 2)
+    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": int(t2)})
+    ch = resp["choices"][0]
+    if t1 != t2:
+        # OpenAI semantics: the stop token is EXCLUDED from the output
+        assert ch["token_ids"] == [t1] and ch["finish_reason"] == "stop"
+    # streaming also excludes the stop token and reports finish "stop"
+    chunks = list(oai({"model": "m", "prompt": prompt, "max_tokens": 8,
+                       "stop": int(t2), "stream": True}))
+    if t1 != t2:
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        toks = [c["choices"][0]["token_ids"][0] for c in chunks[:-1]]
+        assert toks == [t1]
+    # multi-token stop strings can't stream: clear error, not silent drop
+    with pytest.raises(ValueError, match="stop"):
+        oai({"model": "m", "prompt": "ab", "max_tokens": 4,
+             "stop": "xyz", "stream": True})
+    # a body without model/messages takes the native protocol path
+    native = oai({"prompt": prompt, "max_tokens": 3})
+    assert native["tokens"] == _reference(params, prompt, 3)
+
+
+def test_openai_over_http(params):
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import OpenAICompatLLMServer
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(OpenAICompatLLMServer).bind(
+            lambda: (CFG, params, _Tok()), max_batch_size=2, max_seq_len=64
+        )
+        serve.run(app, route_prefix="/v1")
+        body = json.dumps({"model": "m", "prompt": "ab", "max_tokens": 4}).encode()
+        req = urllib.request.Request(
+            serve.proxy_url() + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            resp = json.loads(r.read())
+        assert resp["object"] == "text_completion"
+        assert resp["choices"][0]["token_ids"] == _reference(
+            params, _Tok().encode("ab"), 4)
+        assert resp["usage"]["completion_tokens"] == 4
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
