@@ -9,7 +9,6 @@ Dataset→Train hand-off.
 
 from __future__ import annotations
 
-import collections
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np

@@ -635,6 +635,9 @@ class OpenAICompatLLMServer(LLMServer):
         text = self.tokenizer.decode(out) if self.tokenizer is not None else None
         if text is not None and stop_text and stop_text in text:
             text = text.split(stop_text)[0]
+            # keep the envelope self-consistent: token_ids and usage must
+            # describe the TRIMMED text, not the raw generation
+            out = list(self.tokenizer.encode(text))
             finish = "stop"
         choice: Dict[str, Any] = {"index": 0, "finish_reason": finish, "token_ids": out}
         if chat:

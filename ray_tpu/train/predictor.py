@@ -15,7 +15,7 @@ Framework predictors for the GBDT trainers live next to their trainers
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 

@@ -8,7 +8,6 @@ trial's config and restart it from a peer's checkpoint (exploit/explore).
 
 from __future__ import annotations
 
-import math
 import random
 from collections import defaultdict
 from typing import Any, Dict, List, Optional

@@ -69,3 +69,8 @@ def test_example_llm_serving():
 
 def test_example_dask_graphs():
     assert "dask tour OK" in _run("10_dask_graphs.py")
+
+
+@pytest.mark.full
+def test_example_openai_serving():
+    assert "openai serving tour OK" in _run("11_openai_serving.py")

@@ -641,3 +641,19 @@ def test_openai_over_http(params):
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_openai_multi_token_stop_trims_token_ids_too(oai, params):
+    """token_ids/usage must describe the trimmed text when a multi-token
+    stop string fires, not the raw generation."""
+    prompt = [3, 14, 15, 9, 2]
+    raw = _reference(params, prompt, 8)
+    text = _Tok().decode(raw)
+    if len(text) >= 4 and text[1:3] not in text[:1]:
+        stop = text[1:3]  # 2-char -> 2-token stop appearing after 1 token
+        resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": stop})
+        ch = resp["choices"][0]
+        assert ch["finish_reason"] == "stop"
+        assert ch["text"] == text.split(stop)[0]
+        assert ch["token_ids"] == _Tok().encode(ch["text"])
+        assert resp["usage"]["completion_tokens"] == len(ch["token_ids"])
