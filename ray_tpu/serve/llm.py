@@ -634,10 +634,16 @@ class OpenAICompatLLMServer(LLMServer):
             out = out[:-1]  # OpenAI semantics: stop sequence excluded
         text = self.tokenizer.decode(out) if self.tokenizer is not None else None
         if text is not None and stop_text and stop_text in text:
-            text = text.split(stop_text)[0]
-            # keep the envelope self-consistent: token_ids and usage must
-            # describe the TRIMMED text, not the raw generation
-            out = list(self.tokenizer.encode(text))
+            # trim at TOKEN granularity so token_ids stay faithful to what
+            # the model generated (re-encoding trimmed text could produce
+            # ids the model never emitted): keep the longest generated
+            # prefix whose decode does not yet contain the stop text, and
+            # derive text from it so decode(token_ids) == text
+            kept = len(out)
+            while kept > 0 and stop_text in self.tokenizer.decode(out[:kept]):
+                kept -= 1
+            out = out[:kept]
+            text = self.tokenizer.decode(out)
             finish = "stop"
         choice: Dict[str, Any] = {"index": 0, "finish_reason": finish, "token_ids": out}
         if chat:

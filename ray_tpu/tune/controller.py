@@ -137,6 +137,70 @@ class TuneController:
 
         self.callbacks = CallbackList(callbacks)
 
+    # ---------------------------------------------------- resume support
+    STATE_FILE = "experiment_state.pkl"
+
+    def _save_experiment_state(self) -> None:
+        """Persist per-trial progress for Tuner.restore (parity role:
+        the reference's experiment-state snapshots in the experiment dir).
+        Atomic replace so an interrupt mid-write never corrupts the file."""
+        import pickle
+
+        rows = []
+        for t in self.trials:
+            rows.append(
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "last_result": t.last_result,
+                    "history": t.history,
+                    "checkpoint_path": (
+                        t.latest_checkpoint.path if t.latest_checkpoint else None
+                    ),
+                }
+            )
+        path = os.path.join(self.experiment_dir, self.STATE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump({"trials": rows}, f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — incl. unpicklable configs/results
+            # state saving must never kill the experiment
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def preseed(self, rows: List[dict]) -> None:
+        """Seed restored trials before run(): finished ones keep their
+        results (and feed the searcher's history); unfinished ones are
+        rescheduled PENDING, resuming from their latest checkpoint."""
+        for row in rows:
+            trial = Trial(
+                row["trial_id"], row["config"],
+                os.path.join(self.experiment_dir, row["trial_id"]),
+            )
+            os.makedirs(trial.trial_dir, exist_ok=True)
+            if row.get("checkpoint_path"):
+                trial.latest_checkpoint = Checkpoint(row["checkpoint_path"])
+            trial.last_result = row.get("last_result") or {}
+            trial.history = row.get("history") or []
+            trial.status = TERMINATED if row["status"] == TERMINATED else PENDING
+            # the restore hook advances deterministic cursors (grids resume
+            # at the next point) and feeds completed (config, result) pairs
+            # to model-based searchers — see Searcher.on_restore
+            restore = getattr(self.searcher, "on_restore", None)
+            if restore is not None:
+                restore(
+                    trial.trial_id,
+                    trial.config,
+                    trial.last_result,
+                    completed=trial.status == TERMINATED,
+                )
+            self.trials.append(trial)
+
     # ------------------------------------------------------------------
     def _make_trial(self) -> Optional[Trial]:
         if self.num_samples is not None and len(self.trials) >= self.num_samples:
@@ -209,6 +273,7 @@ class TuneController:
         else:
             self.callbacks.on_trial_complete(trial)
         self._write_trial_state(trial)
+        self._save_experiment_state()
 
     def _stop_criteria_met(self, trial: Trial, metrics: dict) -> bool:
         if self.stop is None:
@@ -276,7 +341,17 @@ class TuneController:
 
     # ------------------------------------------------------------------
     def run(self) -> List[Trial]:
-        """The experiment loop (parity: TuneController.step cycle)."""
+        """The experiment loop (parity: TuneController.step cycle).
+
+        State is snapshotted in a finally block so an interrupt — the very
+        scenario Tuner.restore exists for — still leaves a resumable
+        experiment_state.pkl behind."""
+        try:
+            return self._run()
+        finally:
+            self._save_experiment_state()
+
+    def _run(self) -> List[Trial]:
         self._stop_all = False
         while True:
             running = [t for t in self.trials if t.status == RUNNING]
@@ -286,9 +361,16 @@ class TuneController:
                 for t in running:
                     self._stop_trial(t)
                 break
-            # launch new trials up to the concurrency cap
+            # launch new trials up to the concurrency cap — restored
+            # PENDING trials (Tuner.restore preseeds) go first, resuming
+            # from their latest checkpoint
             while len(running) < self.max_concurrent:
-                trial = self._make_trial()
+                trial = next(
+                    (t for t in self.trials if t.status == PENDING and t.actor is None),
+                    None,
+                )
+                if trial is None:
+                    trial = self._make_trial()
                 if trial is None:
                     break
                 self._start_trial(trial)

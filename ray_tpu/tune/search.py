@@ -218,6 +218,14 @@ class Searcher:
     def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
         pass
 
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        """Rebuild state for ONE restored trial (Tuner.restore): advance
+        deterministic cursors past it and, when completed, absorb its real
+        (config, result) pair.  Default: no-op — a stateless searcher needs
+        nothing.  NOT suggest(): a model-based searcher must pair the
+        restored result with the trial's actual config, never a fresh
+        draw."""
+
 
 class BasicVariantGenerator(Searcher):
     """Grid × random expansion (parity: basic_variant.py).
@@ -266,6 +274,11 @@ class BasicVariantGenerator(Searcher):
         self._next += 1
         return cfg
 
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        # the variant list is deterministic (same space, same seed):
+        # advancing the cursor resumes the grid at the next point
+        self._next = min(self._next + 1, len(self._configs))
+
 
 # --------------------------------------------------------------------------
 # Model-based search: native TPE (what the reference delegates to
@@ -303,6 +316,16 @@ class TPESearcher(Searcher):
         if self.mode == "min":
             score = -score
         self._observed.append((cfg, score))
+
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        if not completed or not last_result or self.metric not in last_result:
+            return
+        score = float(last_result[self.metric])
+        if self.mode == "min":
+            score = -score
+        # the REAL config pairs with the restored metric (a discarded
+        # suggest() would pair it with a fresh random draw)
+        self._observed.append((dict(config), score))
 
     # -- sampling ----------------------------------------------------------
     def _random_config(self) -> dict:
@@ -390,6 +413,11 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False) -> None:
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        # restored trials don't occupy a concurrency slot; the cap applies
+        # to LIVE suggestions only — delegate straight to the inner searcher
+        self.searcher.on_restore(trial_id, config, last_result, completed)
 
 
 class Repeater(Searcher):

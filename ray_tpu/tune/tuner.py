@@ -87,6 +87,48 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_dir: Optional[str] = None
+
+    # ------------------------------------------------------ resume (parity:
+    # Tuner.restore / Tuner.can_restore — tuner.py in the reference)
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        """True when ``path`` holds a resumable experiment state."""
+        from ray_tpu.tune.controller import TuneController
+
+        return os.path.exists(os.path.join(path, TuneController.STATE_FILE))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Union[Callable, "BaseTrainer"],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory.
+
+        Finished trials return with their recorded results (and feed the
+        searcher's history); unfinished ones re-run from their latest
+        checkpoint.  The trainable is re-supplied by the caller — same as
+        the reference, which cannot always serialize it.  Searcher
+        internals beyond fed-back results are not restored.
+        """
+        if not cls.can_restore(path):
+            raise ValueError(
+                f"{path!r} has no experiment state to restore "
+                "(expected experiment_state.pkl written by a prior fit)"
+            )
+        tuner = cls(
+            trainable,
+            param_space=param_space,
+            tune_config=tune_config,
+            run_config=run_config,
+        )
+        tuner._restore_dir = path
+        return tuner
 
     def fit(self) -> ResultGrid:
         trainable = self.trainable
@@ -122,6 +164,8 @@ class Tuner:
         exp_dir = None
         if self.run_config.storage_path:
             exp_dir = os.path.join(self.run_config.storage_path, self.run_config.name or "tune_experiment")
+        if self._restore_dir:
+            exp_dir = self._restore_dir
         controller = TuneController(
             trainable,
             searcher=searcher,
@@ -135,6 +179,11 @@ class Tuner:
             num_samples=self.tune_config.num_samples if custom_searcher else None,
             stop=self.run_config.stop,
         )
+        if self._restore_dir:
+            import pickle
+
+            with open(os.path.join(self._restore_dir, TuneController.STATE_FILE), "rb") as f:
+                controller.preseed(pickle.load(f)["trials"])
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
 

@@ -646,14 +646,25 @@ def test_openai_over_http(params):
 def test_openai_multi_token_stop_trims_token_ids_too(oai, params):
     """token_ids/usage must describe the trimmed text when a multi-token
     stop string fires, not the raw generation."""
-    prompt = [3, 14, 15, 9, 2]
+    # this prompt's greedy continuation changes token mid-way, giving a
+    # 2-char window usable as a mid-text stop (probed: [5,6] -> ffff}}}})
+    prompt = [5, 6]
     raw = _reference(params, prompt, 8)
     text = _Tok().decode(raw)
-    if len(text) >= 4 and text[1:3] not in text[:1]:
-        stop = text[1:3]  # 2-char -> 2-token stop appearing after 1 token
-        resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": stop})
-        ch = resp["choices"][0]
-        assert ch["finish_reason"] == "stop"
-        assert ch["text"] == text.split(stop)[0]
-        assert ch["token_ids"] == _Tok().encode(ch["text"])
-        assert resp["usage"]["completion_tokens"] == len(ch["token_ids"])
+    # any 2-char window whose FIRST occurrence is mid-text works as a stop
+    stop = None
+    for i in range(1, len(text) - 1):
+        if text.find(text[i : i + 2]) == i:
+            stop = text[i : i + 2]
+            break
+    if stop is None:
+        pytest.skip("greedy continuation has no mid-text 2-char stop here")
+    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": stop})
+    ch = resp["choices"][0]
+    assert ch["finish_reason"] == "stop"
+    # token_ids are a faithful prefix of the actual generation, and the
+    # text is their decode — envelope self-consistent
+    assert ch["token_ids"] == raw[: len(ch["token_ids"])]
+    assert ch["text"] == _Tok().decode(ch["token_ids"])
+    assert stop not in ch["text"]
+    assert resp["usage"]["completion_tokens"] == len(ch["token_ids"])

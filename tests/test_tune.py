@@ -526,3 +526,84 @@ def test_distribute_resources_floor_is_declared_request():
     out = alloc(_Ctl(), _FakeTrial("t", {}), {"training_iteration": 1}, None)
     assert out["CPU"] >= 4.0          # never below the declared request
     assert out["TPU"] == 2            # accelerators pass through
+
+
+# --------------------------------------------------------------------------
+# Tuner.restore / can_restore (parity: reference Tuner resume)
+# --------------------------------------------------------------------------
+def _resumable_trainable(config):
+    """Counts iterations through its checkpoint, so a resumed trial
+    continues instead of restarting; every executed step is appended to
+    config["log"] so tests can see exactly what re-ran."""
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.tune.session import get_checkpoint
+
+    ckpt = get_checkpoint()
+    start = ckpt.to_dict()["i"] + 1 if ckpt is not None else 0
+    for i in range(start, 4):
+        with open(config["log"], "a") as f:
+            f.write(f"{config['x']},{i}\n")
+        tune.report(
+            {"training_iteration": i + 1, "i": i, "x": config["x"]},
+            checkpoint=Checkpoint.from_dict({"i": i}),
+        )
+
+
+def test_tuner_restore_reruns_only_unfinished_trials(tmp_path):
+    import pickle
+
+    from ray_tpu.tune import TuneConfig, Tuner
+    from ray_tpu.train import RunConfig
+
+    tune_cfg = dict(metric="i", mode="max", num_samples=1)
+    log = str(tmp_path / "steps.log")
+    tuner = Tuner(
+        _resumable_trainable,
+        param_space={"x": tune.grid_search([10, 20, 30]), "log": log},
+        tune_config=TuneConfig(**tune_cfg),
+        run_config=RunConfig(name="resume_exp", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 3 and all(r.metrics["i"] == 3 for r in results)
+    exp_dir = str(tmp_path / "resume_exp")
+    assert Tuner.can_restore(exp_dir)
+
+    # simulate an interruption: mark the last trial unfinished at i=1
+    state_path = exp_dir + "/experiment_state.pkl"
+    with open(state_path, "rb") as f:
+        state = pickle.load(f)
+    from ray_tpu.train import Checkpoint
+
+    doctored = state["trials"][-1]
+    doctored["status"] = "RUNNING"
+    doctored["last_result"] = {"training_iteration": 2, "i": 1, "x": doctored["config"]["x"]}
+    doctored["checkpoint_path"] = Checkpoint.from_dict(
+        {"i": 1}, base_dir=str(tmp_path / "interrupted_ckpt")).path
+    with open(state_path, "wb") as f:
+        pickle.dump(state, f)
+
+    open(log, "w").close()  # observe only post-restore executions
+    restored = Tuner.restore(
+        exp_dir, _resumable_trainable,
+        param_space={"x": tune.grid_search([10, 20, 30]), "log": log},
+        tune_config=TuneConfig(**tune_cfg),
+    ).fit()
+    assert len(restored) == 3
+    by_x = {r.config["x"]: r for r in restored}
+    # finished trials kept their recorded results without re-running
+    assert by_x[10].metrics["i"] == 3 and by_x[20].metrics["i"] == 3
+    # the interrupted one resumed from its checkpoint (i=1 -> 2, 3)
+    assert by_x[30].metrics["i"] == 3
+    # and the grid was NOT re-suggested from the start: exactly 3 trials
+    assert len({r.config["x"] for r in restored}) == 3
+    # only the interrupted trial executed, and only its REMAINING steps
+    steps = [tuple(map(int, l.split(","))) for l in open(log) if l.strip()]
+    assert steps == [(30, 2), (30, 3)], steps
+
+
+def test_tuner_restore_requires_state(tmp_path):
+    from ray_tpu.tune import Tuner
+
+    assert not Tuner.can_restore(str(tmp_path))
+    with pytest.raises(ValueError, match="no experiment state"):
+        Tuner.restore(str(tmp_path), lambda c: None)
