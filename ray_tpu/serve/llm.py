@@ -639,10 +639,17 @@ class OpenAICompatLLMServer(LLMServer):
             # ids the model never emitted): keep the longest generated
             # prefix whose decode does not yet contain the stop text, and
             # derive text from it so decode(token_ids) == text
-            kept = len(out)
-            while kept > 0 and stop_text in self.tokenizer.decode(out[:kept]):
-                kept -= 1
-            out = out[:kept]
+            # contains-stop is monotone in the prefix length, so binary
+            # search the cut (a linear scan would decode O(n) prefixes on
+            # the serving hot path when the stop lands early)
+            lo, hi = 0, len(out)  # invariant: decode(out[:lo]) lacks stop
+            while lo < hi:
+                mid = (lo + hi + 1) // 2
+                if stop_text in self.tokenizer.decode(out[:mid]):
+                    hi = mid - 1
+                else:
+                    lo = mid
+            out = out[:lo]
             text = self.tokenizer.decode(out)
             finish = "stop"
         choice: Dict[str, Any] = {"index": 0, "finish_reason": finish, "token_ids": out}

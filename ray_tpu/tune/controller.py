@@ -158,6 +158,7 @@ class TuneController:
                     "checkpoint_path": (
                         t.latest_checkpoint.path if t.latest_checkpoint else None
                     ),
+                    "error": repr(t.error) if t.error is not None else None,
                 }
             )
         path = os.path.join(self.experiment_dir, self.STATE_FILE)
@@ -187,7 +188,17 @@ class TuneController:
                 trial.latest_checkpoint = Checkpoint(row["checkpoint_path"])
             trial.last_result = row.get("last_result") or {}
             trial.history = row.get("history") or []
-            trial.status = TERMINATED if row["status"] == TERMINATED else PENDING
+            if row["status"] == TERMINATED:
+                trial.status = TERMINATED
+            elif row["status"] == ERROR:
+                # errored trials stay errored (reference semantics without
+                # resume_errored): re-running a deterministic failure on
+                # every restore would silently burn retries
+                trial.status = ERROR
+                if row.get("error"):
+                    trial.error = RuntimeError(row["error"])
+            else:
+                trial.status = PENDING
             # the restore hook advances deterministic cursors (grids resume
             # at the next point) and feeds completed (config, result) pairs
             # to model-based searchers — see Searcher.on_restore

@@ -318,7 +318,12 @@ class TPESearcher(Searcher):
         self._observed.append((cfg, score))
 
     def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
-        if not completed or not last_result or self.metric not in last_result:
+        if not completed:
+            # the resumed trial will complete later: register its REAL
+            # config so on_trial_complete can pair it with the result
+            self._live[trial_id] = dict(config)
+            return
+        if not last_result or self.metric not in last_result:
             return
         score = float(last_result[self.metric])
         if self.mode == "min":
@@ -425,6 +430,13 @@ class Repeater(Searcher):
     the wrapped searcher (parity: search/repeater.py — noise-robust
     evaluation)."""
 
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        # advance the inner searcher past restored trials (cursors move,
+        # completed pairs absorb); the repeat-group averaging bookkeeping
+        # itself is not reconstructed — a partially-restored group reports
+        # its post-restore repeats only
+        self.searcher.on_restore(trial_id, config, last_result, completed)
+
     def __init__(self, searcher: Searcher, repeat: int):
         super().__init__(metric=searcher.metric, mode=searcher.mode)
         self.searcher = searcher
@@ -518,6 +530,22 @@ class _OptunaSearch(Searcher):
                 sampler=self._sampler or self._optuna.samplers.TPESampler(seed=self._seed),
             )
         return self._study
+
+    def on_restore(self, trial_id: str, config: dict, last_result: Optional[dict] = None, completed: bool = False) -> None:
+        # optuna trials cannot be reconstructed from (config, result) pairs
+        # through the ask/tell surface alone — say so once instead of
+        # silently pairing restored results with fresh asks
+        import warnings
+
+        if not getattr(type(self), "_warned_restore", False):
+            type(self)._warned_restore = True
+            warnings.warn(
+                "OptunaSearch cannot rebuild study history from a restored "
+                "experiment; the resumed search starts with a fresh study "
+                "(completed trials keep their recorded results).",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def _suggest_param(self, ot, name: str, dom) -> Any:
         if isinstance(dom, GridSearch):

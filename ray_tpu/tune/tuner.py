@@ -121,6 +121,13 @@ class Tuner:
                 f"{path!r} has no experiment state to restore "
                 "(expected experiment_state.pkl written by a prior fit)"
             )
+        if param_space is None and (tune_config is None or tune_config.search_alg is None):
+            raise ValueError(
+                "Tuner.restore needs the original param_space (or a "
+                "tune_config with its search_alg): without it, grid points "
+                "not yet started before the interrupt would silently "
+                "disappear from the resumed experiment"
+            )
         tuner = cls(
             trainable,
             param_space=param_space,
@@ -185,7 +192,15 @@ class Tuner:
             with open(os.path.join(self._restore_dir, TuneController.STATE_FILE), "rb") as f:
                 controller.preseed(pickle.load(f)["trials"])
         trials = controller.run()
-        return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
+        self._results = ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
+        return self._results
+
+    def get_results(self) -> ResultGrid:
+        """The ResultGrid of the completed fit (parity: Tuner.get_results)."""
+        results = getattr(self, "_results", None)
+        if results is None:
+            raise RuntimeError("Tuner.get_results(): call fit() first")
+        return results
 
 
 def run(

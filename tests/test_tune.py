@@ -607,3 +607,69 @@ def test_tuner_restore_requires_state(tmp_path):
     assert not Tuner.can_restore(str(tmp_path))
     with pytest.raises(ValueError, match="no experiment state"):
         Tuner.restore(str(tmp_path), lambda c: None)
+
+
+def test_tuner_get_results():
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    t = Tuner(trainable, param_space={"x": tune.grid_search([1, 2])},
+              tune_config=TuneConfig(metric="score", mode="max"))
+    with pytest.raises(RuntimeError, match="call fit"):
+        t.get_results()
+    grid = t.fit()
+    assert t.get_results() is grid
+
+
+def test_tuner_restore_requires_param_space(tmp_path):
+    import pickle
+
+    from ray_tpu.tune import Tuner
+
+    (tmp_path / "experiment_state.pkl").write_bytes(pickle.dumps({"trials": []}))
+    with pytest.raises(ValueError, match="param_space"):
+        Tuner.restore(str(tmp_path), lambda c: None)
+
+
+def test_restore_keeps_errored_trials_errored(tmp_path):
+    import pickle
+
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def trainable(config):
+        tune.report({"i": 1})
+
+    exp = tmp_path / "err_exp"
+    exp.mkdir()
+    state = {"trials": [
+        {"trial_id": "trial_00000", "config": {"x": 1}, "status": "TERMINATED",
+         "last_result": {"i": 1}, "history": [], "checkpoint_path": None,
+         "error": None},
+        {"trial_id": "trial_00001", "config": {"x": 2}, "status": "ERROR",
+         "last_result": {}, "history": [], "checkpoint_path": None,
+         "error": "ValueError('bad config')"},
+    ]}
+    (exp / "experiment_state.pkl").write_bytes(pickle.dumps(state))
+    results = Tuner.restore(
+        str(exp), trainable, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="i", mode="max"),
+    ).fit()
+    assert len(results) == 2
+    errs = results.errors
+    assert len(errs) == 1 and "bad config" in str(errs[0])
+
+
+def test_tpe_on_restore_registers_live_and_completed():
+    from ray_tpu.tune.search import TPESearcher
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s = TPESearcher(space, metric="score", mode="max")
+    s.on_restore("done", {"x": 0.7}, {"score": 1.0}, completed=True)
+    assert s._observed[-1] == ({"x": 0.7}, 1.0)
+    s.on_restore("inflight", {"x": 0.2}, {}, completed=False)
+    assert s._live["inflight"] == {"x": 0.2}
+    # the resumed trial's eventual completion pairs with its REAL config
+    s.on_trial_complete("inflight", {"score": 2.0})
+    assert s._observed[-1] == ({"x": 0.2}, 2.0)
