@@ -29,6 +29,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -95,10 +96,18 @@ class LLMEngine:
         mesh: Optional[Any] = None,
         tp: str = "tp",
         decode_chunk: int = 1,
+        prefill_cache_size: int = 0,
     ):
         self.cfg = cfg
         self.B = max_batch_size
         self.S = max_seq_len
+        # opt-in memo of prefill results keyed by the EXACT prompt token
+        # tuple: repeated prompts (identical system prompts, retries) skip
+        # the prefill forward entirely.  Each entry pins one cache row
+        # ([L,1,Hkv,S,Dh]) in HBM, so keep the LRU small.
+        self._prefill_cache_size = max(0, int(prefill_cache_size))
+        self._prefill_cache: OrderedDict[tuple, Any] = OrderedDict()
+        self._prefill_count = 0  # actual prefill forwards (cache misses)
         # tokens generated per host round trip (1 = per-token stepping).
         # >1 amortizes dispatch/readback latency; admission and stream
         # emission happen at chunk granularity, and a request finishing
@@ -301,6 +310,8 @@ class LLMEngine:
                 "active_slots": int(self._active.sum()),
                 "max_batch_size": self.B,
                 "queued": len(self._queue),
+                "prefill_forwards": self._prefill_count,
+                "prefill_cache_entries": len(self._prefill_cache),
             }
 
     def shutdown(self) -> None:
@@ -327,10 +338,28 @@ class LLMEngine:
                 slot = free[0]
             try:
                 tp = len(req.prompt)
-                bucket = min(_bucket(tp), self.S)
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :tp] = req.prompt
-                logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+                prompt_key = tuple(req.prompt)
+                with self._lock:
+                    hit = (
+                        self._prefill_cache.get(prompt_key)
+                        if self._prefill_cache_size
+                        else None
+                    )
+                    if hit is not None:
+                        self._prefill_cache.move_to_end(prompt_key)
+                if hit is not None:
+                    logits, row = hit
+                else:
+                    bucket = min(_bucket(tp), self.S)
+                    toks = np.zeros((1, bucket), np.int32)
+                    toks[0, :tp] = req.prompt
+                    logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+                    with self._lock:  # stats() reads these under the lock
+                        self._prefill_count += 1
+                        if self._prefill_cache_size:
+                            self._prefill_cache[prompt_key] = (logits, row)
+                            while len(self._prefill_cache) > self._prefill_cache_size:
+                                self._prefill_cache.popitem(last=False)
                 self._cache = self._insert(self._cache, row, slot)
                 # first output token comes straight from the prefill logits
                 self._key, sub = jax.random.split(self._key)
@@ -462,6 +491,7 @@ class LLMServer:
         mesh: Optional[Any] = None,
         tp: str = "tp",
         decode_chunk: int = 1,
+        prefill_cache_size: int = 0,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
@@ -477,6 +507,7 @@ class LLMServer:
             mesh=mesh,
             tp=tp,
             decode_chunk=decode_chunk,
+            prefill_cache_size=prefill_cache_size,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:

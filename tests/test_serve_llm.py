@@ -668,3 +668,53 @@ def test_openai_multi_token_stop_trims_token_ids_too(oai, params):
     assert ch["text"] == _Tok().decode(ch["token_ids"])
     assert stop not in ch["text"]
     assert resp["usage"]["completion_tokens"] == len(ch["token_ids"])
+
+
+# ---------------------------------------------------------------------------
+# prompt prefill memo (prefill_cache_size; beyond reference parity)
+# ---------------------------------------------------------------------------
+def test_prefill_cache_skips_repeat_prompts(params):
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
+                    prefill_cache_size=2)
+    try:
+        prompt = [3, 14, 15, 9, 2]
+        want = _reference(params, prompt, 5)
+        assert eng.generate(prompt, max_tokens=5) == want
+        n1 = eng.stats()["prefill_forwards"]
+        # identical prompt again: NO new prefill forward, same output
+        assert eng.generate(prompt, max_tokens=5) == want
+        assert eng.stats()["prefill_forwards"] == n1
+        # a different prompt does prefill (and still decodes correctly)
+        other = [7, 8, 9]
+        assert eng.generate(other, max_tokens=4) == _reference(params, other, 4)
+        assert eng.stats()["prefill_forwards"] == n1 + 1
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_cache_lru_evicts(params):
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
+                    prefill_cache_size=1)
+    try:
+        a, b = [1, 2, 3], [4, 5, 6]
+        ra, rb = _reference(params, a, 3), _reference(params, b, 3)
+        assert eng.generate(a, max_tokens=3) == ra   # prefill a (cached)
+        assert eng.generate(b, max_tokens=3) == rb   # prefill b, evicts a
+        n = eng.stats()["prefill_forwards"]
+        assert eng.stats()["prefill_cache_entries"] == 1
+        assert eng.generate(a, max_tokens=3) == ra   # a evicted -> re-prefills
+        assert eng.stats()["prefill_forwards"] == n + 1
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_cache_off_by_default(params):
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64)
+    try:
+        p = [2, 3]
+        eng.generate(p, max_tokens=2)
+        eng.generate(p, max_tokens=2)
+        assert eng.stats()["prefill_forwards"] == 2
+        assert eng.stats()["prefill_cache_entries"] == 0
+    finally:
+        eng.shutdown()
