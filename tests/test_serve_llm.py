@@ -718,3 +718,25 @@ def test_prefill_cache_off_by_default(params):
         assert eng.stats()["prefill_cache_entries"] == 0
     finally:
         eng.shutdown()
+
+
+def test_tp_engine_with_chunked_decode_and_prefill_cache(params):
+    """decode_chunk and prefill_cache compose with tensor-parallel serving:
+    the sharded scan program produces the single-device engine's tokens and
+    repeated prompts skip prefill on the mesh path too."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual devices")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
+                    mesh=mesh, decode_chunk=3, prefill_cache_size=2)
+    try:
+        prompt = [3, 14, 15, 9, 2]
+        want = _reference(params, prompt, 7)
+        assert eng.generate(prompt, max_tokens=7) == want
+        n = eng.stats()["prefill_forwards"]
+        assert eng.generate(prompt, max_tokens=7) == want
+        assert eng.stats()["prefill_forwards"] == n  # memo hit on the mesh path
+    finally:
+        eng.shutdown()
