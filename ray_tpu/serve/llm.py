@@ -589,6 +589,7 @@ class OpenAICompatLLMServer(LLMServer):
     def _openai(self, body: Dict[str, Any]):
         import uuid
 
+        self._reject_unsupported(body)
         chat = "messages" in body
         prompt_ids = self._openai_prompt(body, chat)
         stop = body.get("stop")
@@ -700,6 +701,42 @@ class OpenAICompatLLMServer(LLMServer):
                 "total_tokens": len(prompt_ids) + len(out),
             },
         }
+
+    def _reject_unsupported(self, body: Dict[str, Any]) -> None:
+        """Unimplemented OpenAI sampling params fail loudly — silently
+        ignoring them would return samples the client didn't ask for.
+        Values matching OpenAI defaults (top_p=1, n=1, zero penalties)
+        pass, since SDKs send those unprompted."""
+        bad = []
+        top_p = body.get("top_p")
+        if top_p is not None and top_p < 1.0:
+            # sampling config is per-ENGINE: a request may restate the
+            # engine's own top_p, but asking for a different distribution
+            # must not be silently overridden.  top_p=1.0 always passes —
+            # SDKs send the OpenAI default unprompted.
+            eng_p = self.engine.top_p
+            if eng_p is None or abs(float(top_p) - float(eng_p)) > 1e-9:
+                bad.append(
+                    f"top_p={top_p} (engine is configured with "
+                    f"top_p={eng_p}; per-request nucleus sampling is not "
+                    "supported — configure it on the deployment)"
+                )
+        if body.get("n", 1) not in (None, 1):
+            bad.append("n > 1")
+        if body.get("best_of", 1) not in (None, 1):
+            bad.append("best_of > 1")
+        lp = body.get("logprobs")
+        if lp is not None and lp is not False:  # NOT `in (None, False)`: 0 == False
+            bad.append("logprobs")
+        for k in ("presence_penalty", "frequency_penalty"):
+            if body.get(k):
+                bad.append(k)
+        if body.get("echo"):
+            bad.append("echo")
+        if bad:
+            raise ValueError(
+                "unsupported OpenAI parameter(s): " + ", ".join(bad)
+            )
 
     def _openai_prompt(self, body: Dict[str, Any], chat: bool) -> List[int]:
         if chat:

@@ -740,3 +740,36 @@ def test_tp_engine_with_chunked_decode_and_prefill_cache(params):
         assert eng.stats()["prefill_forwards"] == n  # memo hit on the mesh path
     finally:
         eng.shutdown()
+
+
+def test_openai_rejects_unsupported_sampling_params(oai, params):
+    base = {"model": "m", "prompt": [1, 2], "max_tokens": 2}
+    # OpenAI-SDK defaults sail through
+    ok = oai({**base, "top_p": 1.0, "n": 1, "presence_penalty": 0,
+              "frequency_penalty": 0.0})
+    assert ok["object"] == "text_completion"
+    for extra, match in [({"top_p": 0.5}, "top_p"), ({"n": 3}, "n > 1"),
+                         ({"logprobs": 5}, "logprobs"),
+                         ({"logprobs": 0}, "logprobs"),  # 0 == False trap
+                         ({"presence_penalty": 0.7}, "presence_penalty"),
+                         ({"echo": True}, "echo")]:
+        with pytest.raises(ValueError, match=match.split()[0]):
+            oai({**base, **extra})
+
+
+def test_openai_top_p_allowed_when_engine_configured(params):
+    from ray_tpu.serve.llm import OpenAICompatLLMServer
+
+    srv = OpenAICompatLLMServer(
+        lambda: (CFG, params, _Tok()), max_batch_size=2, max_seq_len=64,
+        top_p=0.9,
+    )
+    try:
+        resp = srv({"model": "m", "prompt": [1, 2], "max_tokens": 2, "top_p": 0.9})
+        assert resp["object"] == "text_completion"
+        # the SDK default passes, but a DIFFERENT distribution is refused
+        srv({"model": "m", "prompt": [1, 2], "max_tokens": 2, "top_p": 1.0})
+        with pytest.raises(ValueError, match="top_p=0.2"):
+            srv({"model": "m", "prompt": [1, 2], "max_tokens": 2, "top_p": 0.2})
+    finally:
+        srv.engine.shutdown()
