@@ -38,6 +38,9 @@ class AlgorithmConfig:
         self.train_batch_size = 1024
         self.max_grad_norm: Optional[float] = 0.5
         self.hidden = (64, 64)
+        # evaluation (parity: AlgorithmConfig.evaluation)
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_duration = 10  # episodes per evaluation
 
     def environment(self, env) -> "AlgorithmConfig":
         # a string resolves through the shared tune registry
@@ -58,6 +61,25 @@ class AlgorithmConfig:
 
     def debugging(self, *, seed: int = 0) -> "AlgorithmConfig":
         self.seed = seed
+        return self
+
+    def evaluation(
+        self,
+        *,
+        evaluation_interval: Optional[int] = None,
+        evaluation_duration: Optional[int] = None,
+    ) -> "AlgorithmConfig":
+        """Periodic greedy evaluation during training (parity:
+        AlgorithmConfig.evaluation — ``evaluation_interval`` in
+        iterations; results nest under ``result["evaluation"]``)."""
+        if evaluation_interval is not None:
+            if evaluation_interval <= 0:
+                raise ValueError("evaluation_interval must be a positive iteration count")
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            if evaluation_duration <= 0:
+                raise ValueError("evaluation_duration must be a positive episode count")
+            self.evaluation_duration = evaluation_duration
         return self
 
     def env_runners(
@@ -132,6 +154,27 @@ class Algorithm:
         }
         # flat aliases (the reference keeps legacy top-level keys)
         result["episode_return_mean"] = result["env_runners"]["episode_return_mean"]
+        interval = getattr(self.config, "evaluation_interval", None)
+        if interval and interval > 0 and self.iteration % interval == 0:
+            try:
+                ev = self.evaluate(
+                    num_episodes=getattr(self.config, "evaluation_duration", 10)
+                )
+            except NotImplementedError as exc:
+                # an algorithm without an inference module must not lose a
+                # long run mid-training to a config oversight: warn once
+                # and disable instead of crashing the trial
+                import warnings
+
+                warnings.warn(
+                    f"evaluation_interval disabled: {exc}", RuntimeWarning,
+                    stacklevel=2,
+                )
+                self.config.evaluation_interval = None
+            else:
+                # evaluate() wraps under "evaluation" — unwrap so the
+                # result nests once (result["evaluation"]["episode_return_mean"])
+                result["evaluation"] = ev.get("evaluation", ev)
         return result
 
     def _record_episodes(self, episode_returns, env_steps: int) -> None:

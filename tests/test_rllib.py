@@ -586,3 +586,30 @@ def test_offline_checkpoint_strips_dataset(tmp_path):
         assert revived.iteration == 1
     finally:
         revived.stop()
+
+
+def test_periodic_evaluation_in_train():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment(CartPole())
+        .env_runners(num_envs_per_runner=4, rollout_length=32)
+        .evaluation(evaluation_interval=2, evaluation_duration=3)
+        .build()
+    )
+    try:
+        r1 = algo.train()
+        assert "evaluation" not in r1          # iteration 1: off-interval
+        r2 = algo.train()
+        ev = r2["evaluation"]                   # iteration 2: evaluated
+        assert ev["num_episodes"] == 3
+        assert "episode_return_mean" in ev
+    finally:
+        algo.stop()
+    from ray_tpu.rllib.algorithm import AlgorithmConfig
+
+    with pytest.raises(ValueError, match="positive"):
+        AlgorithmConfig().evaluation(evaluation_interval=0)
+    with pytest.raises(ValueError, match="positive"):
+        AlgorithmConfig().evaluation(evaluation_duration=-1)
