@@ -84,6 +84,12 @@ def init(
         from ray_tpu.runtime.control import JobInfo
 
         cluster.control.jobs.add(JobInfo(job_id, entrypoint="driver"))
+        # finished tracing spans (driver-side and those harvested from
+        # worker result payloads) land in the control service's span store,
+        # where timeline() merges them with task events
+        from ray_tpu.observability import tracing
+
+        tracing.set_span_sink(cluster.control.spans.add)
         if include_dashboard:
             from ray_tpu.dashboard import DashboardHead
 
@@ -130,6 +136,9 @@ def shutdown() -> None:
         try:
             _cluster.shutdown()
         finally:
+            from ray_tpu.observability import tracing
+
+            tracing.set_span_sink(None)
             if _cluster.core_worker is not None:
                 _cluster.core_worker.ref_counter.stop()
             _cluster = None
@@ -265,10 +274,13 @@ def nodes() -> List[dict]:
 
 
 def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Task events for tracing (``ray.timeline`` parity). With ``filename``,
-    writes chrome://tracing JSON there and returns the converted events;
-    without, returns the raw task-event records."""
-    events = get_cluster().control.task_events.list_events()
+    """Task events + tracing spans (``ray.timeline`` parity). With
+    ``filename``, writes chrome://tracing JSON there and returns the
+    converted events; without, returns the raw records — task-state dicts
+    plus span dicts (``type == "span"``) from the tracing layer."""
+    control = get_cluster().control
+    events = control.task_events.list_events()
+    events = events + control.spans.list_events(limit=100_000)
     if filename is not None:
         from ray_tpu.observability.timeline import chrome_trace
 

@@ -111,6 +111,11 @@ class Config:
     task_events_enabled: bool = True
     # Bounded task-event store size (reference GcsTaskManager eviction).
     task_events_max_entries: int = 100_000
+    # Distributed task tracing: trace-context propagation through task specs
+    # and per-phase spans (submit/schedule/execute/commit) merged into
+    # ray_tpu.timeline().  Cheap (a few dict builds per task); disable to
+    # shave the last microseconds off the submit hot path.
+    tracing_enabled: bool = True
 
     # ---- distributed -----------------------------------------------------
     # Port for the control service when serving multi-host.

@@ -25,6 +25,8 @@ import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import Future
+# py3.10: futures.TimeoutError is NOT the builtin (unified only in 3.11)
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from enum import Enum
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -33,6 +35,7 @@ import numpy as np
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.observability import metric_defs
 
 
 class Tier(Enum):
@@ -98,6 +101,20 @@ class ObjectStore:
         self.num_gets = 0
         self.num_spills = 0
         self.num_restores = 0
+        # per-node metric tag sets, prebuilt once (hot-path allocations);
+        # the hosting Node calls set_metrics_tags with its node id
+        self._tags: Optional[Dict[str, str]] = None
+        self._tags_hbm: Dict[str, str] = {"tier": "hbm"}
+        self._tags_host: Dict[str, str] = {"tier": "host"}
+        self._tags_hit: Dict[str, str] = {"result": "hit"}
+        self._tags_miss: Dict[str, str] = {"result": "miss"}
+
+    def set_metrics_tags(self, tags: Dict[str, str]) -> None:
+        self._tags = dict(tags)
+        self._tags_hbm = {**tags, "tier": "hbm"}
+        self._tags_host = {**tags, "tier": "host"}
+        self._tags_hit = {**tags, "result": "hit"}
+        self._tags_miss = {**tags, "result": "miss"}
 
     # ------------------------------------------------------------------ put
     def put(self, object_id: ObjectID, value: Any, is_error: bool = False) -> None:
@@ -118,6 +135,15 @@ class ObjectStore:
                 self._host_used += size
             self.num_puts += 1
             waiters = self._waiters.pop(object_id, [])
+            n_entries = len(self._entries)
+            tier_used = self._hbm_used if tier is Tier.DEVICE else self._host_used
+        metric_defs.OBJECT_STORE_PUTS.inc(tags=self._tags)
+        if size:
+            metric_defs.OBJECT_STORE_BYTES_PUT.inc(size, tags=self._tags)
+        metric_defs.OBJECT_STORE_OBJECTS.set(n_entries, self._tags)
+        metric_defs.OBJECT_STORE_USED_BYTES.set(
+            tier_used, self._tags_hbm if tier is Tier.DEVICE else self._tags_host
+        )
         for fut in waiters:
             if not fut.done():
                 fut.set_result(value)
@@ -135,16 +161,21 @@ class ObjectStore:
                 value = self._materialize_locked(object_id, entry)
                 self._entries.move_to_end(object_id)
                 self.num_gets += 1
+                size = entry.size
                 fut.set_result(value)
+                metric_defs.OBJECT_STORE_GETS.inc(tags=self._tags_hit)
+                if size:
+                    metric_defs.OBJECT_STORE_BYTES_GOT.inc(size, tags=self._tags)
                 return fut
             self._waiters.setdefault(object_id, []).append(fut)
+        metric_defs.OBJECT_STORE_GETS.inc(tags=self._tags_miss)
         return fut
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
         fut = self.get_async(object_id)
         try:
             return fut.result(timeout)
-        except TimeoutError:
+        except (TimeoutError, _FutureTimeoutError):
             raise GetTimeoutError(f"Get timed out for {object_id}")
 
     def get_batch(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
@@ -155,7 +186,7 @@ class ObjectStore:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
                 out.append(fut.result(remaining))
-            except TimeoutError:
+            except (TimeoutError, _FutureTimeoutError):
                 raise GetTimeoutError("Get timed out")
         return out
 
@@ -246,6 +277,7 @@ class ObjectStore:
                 self._host_used += entry.size
                 freed += entry.size
                 self.num_spills += 1
+                metric_defs.OBJECT_STORE_SPILLS.inc(tags=self._tags_host)
 
     def _spill_host_locked(self, need: int) -> None:
         freed = 0
@@ -270,6 +302,7 @@ class ObjectStore:
                 entry.tier = Tier.SHM
                 self._host_used -= entry.size
                 self.num_spills += 1
+                metric_defs.OBJECT_STORE_SPILLS.inc(tags=self._spill_tags("shm"))
                 return True
             except (MemoryError, FileExistsError):
                 pass
@@ -283,7 +316,13 @@ class ObjectStore:
         entry.disk_path = path
         self._host_used -= entry.size
         self.num_spills += 1
+        metric_defs.OBJECT_STORE_SPILLS.inc(tags=self._spill_tags("disk"))
         return True
+
+    def _spill_tags(self, tier: str) -> Dict[str, str]:
+        # spills are rare (memory-pressure only): building the tag dict
+        # here is fine, unlike the per-put/get fast paths
+        return {**(self._tags or {}), "tier": tier}
 
     def _materialize_locked(self, oid: ObjectID, entry: ObjectEntry) -> Any:
         if entry.tier in (Tier.DEVICE, Tier.HOST):
@@ -304,6 +343,7 @@ class ObjectStore:
             self._shm.unpin(oid.binary())  # drop the spill pin, then delete
             self._shm.delete(oid.binary())
             self.num_restores += 1
+            metric_defs.OBJECT_STORE_RESTORES.inc(tags=self._tags)
             return value
         if entry.tier is Tier.DISK:
             with open(entry.disk_path, "rb") as f:
@@ -317,6 +357,7 @@ class ObjectStore:
                 pass
             entry.disk_path = None
             self.num_restores += 1
+            metric_defs.OBJECT_STORE_RESTORES.inc(tags=self._tags)
             return value
         raise ObjectLostError(oid)
 

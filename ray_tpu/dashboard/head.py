@@ -86,7 +86,11 @@ class DashboardHead:
         # cluster-wide rate series (tasks/s, transfer B/s): sampled from the
         # counters the runtime already keeps, ~15 min of 2s points
         self.cluster_history: deque = deque(maxlen=450)
-        prev_tasks = prev_bytes = None
+        # Baseline at thread start, not at the first tick: work finishing
+        # inside the first 2 s window (fast tests, bursty startup jobs) must
+        # show up in the first delta instead of vanishing into the baseline.
+        prev_tasks = self._terminal_task_count()
+        prev_bytes = self.cluster.transfer_bytes + self._peer_bytes_received()
         prev_t = time.monotonic()
         while not self._stop_sampler.wait(2.0):
             if head_node is not None:
@@ -95,10 +99,11 @@ class DashboardHead:
             dt = max(1e-6, now - prev_t)
             tasks = self._terminal_task_count()
             xfer = self.cluster.transfer_bytes + self._peer_bytes_received()
-            point = {"ts": time.time()}
-            if prev_tasks is not None:
-                point["tasks_per_s"] = max(0.0, (tasks - prev_tasks) / dt)
-                point["transfer_bytes_per_s"] = max(0.0, (xfer - prev_bytes) / dt)
+            point = {
+                "ts": time.time(),
+                "tasks_per_s": max(0.0, (tasks - prev_tasks) / dt),
+                "transfer_bytes_per_s": max(0.0, (xfer - prev_bytes) / dt),
+            }
             prev_tasks, prev_bytes, prev_t = tasks, xfer, now
             self.cluster_history.append(point)
 
@@ -222,8 +227,17 @@ class DashboardHead:
 
             # ?limit= caps the event count (downloads default high); ?since_s=
             # keeps only spans ending in the trailing window — the inline
-            # Gantt polls with since_s=120&limit=400 so refreshes stay cheap
-            trace = chrome_trace(self.cluster.control.task_events.list_events(limit=100_000))
+            # Gantt polls with since_s=120&limit=400 so refreshes stay cheap;
+            # ?tracing=1 merges the tracing layer's spans into the trace
+            events = self.cluster.control.task_events.list_events(limit=100_000)
+            if query.get("tracing", ["0"])[0] in ("1", "true"):
+                events = events + self.cluster.control.spans.list_events(limit=100_000)
+            trace = chrome_trace(events)
+            # the merged stream interleaves two independently-ordered
+            # stores: sort by start time so the newest-N `limit` below
+            # keeps the newest slices rather than whichever store was
+            # concatenated last
+            trace.sort(key=lambda e: e["ts"])
             since_s = query.get("since_s")
             if since_s:
                 cutoff = (time.time() - float(since_s[0])) * 1e6

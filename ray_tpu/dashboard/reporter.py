@@ -127,6 +127,17 @@ class MetricsHistory:
                 return
             self._last_add[node_hex] = now
             self._series.setdefault(node_hex, deque(maxlen=self._maxlen)).append(metrics)
+        # mirror the freshest sample into the Prometheus gauges so /metrics
+        # scrapes carry node utilization without a second sampling path
+        from ray_tpu.observability import metric_defs
+
+        tags = {"node": node_hex[:8]}
+        if "cpu_percent" in metrics:
+            metric_defs.NODE_CPU_PERCENT.set(metrics["cpu_percent"], tags)
+        if "mem_used" in metrics:
+            metric_defs.NODE_MEM_USED_BYTES.set(metrics["mem_used"], tags)
+        if "tpu_mem_used" in metrics:
+            metric_defs.NODE_TPU_MEM_USED_BYTES.set(metrics["tpu_mem_used"], tags)
 
     def series(self, node_hex: str, minutes: float = 15.0):
         cutoff = time.time() - minutes * 60

@@ -15,6 +15,7 @@ from ray_tpu.observability.metrics import (
 )
 from ray_tpu.observability.events import Event, EventManager, EventSeverity, global_event_manager
 from ray_tpu.observability.timeline import chrome_trace, dump_timeline
+from ray_tpu.observability.tracing import Span, TraceContext, current_context, span
 
 __all__ = [
     "Counter",
@@ -28,4 +29,8 @@ __all__ = [
     "global_event_manager",
     "chrome_trace",
     "dump_timeline",
+    "Span",
+    "TraceContext",
+    "current_context",
+    "span",
 ]

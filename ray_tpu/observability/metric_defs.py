@@ -1,0 +1,185 @@
+"""Predefined core-runtime metrics (parity: ``src/ray/stats/metric_defs.cc``).
+
+The reference pre-declares ~100 runtime metrics in one translation unit so
+every component records into a shared, centrally-documented catalog.  Same
+idea here: every default metric family the runtime emits is defined in this
+module, registered on the global registry at import, and wired into the hot
+paths of ``runtime/scheduler.py``, ``core/object_store.py``,
+``runtime/worker_pool.py``, ``runtime/data_plane.py``, ``serve/router.py``
+and the cluster fabric's task-commit path.  ``MetricsRegistry.
+render_prometheus()`` (and thus the dashboard's ``/metrics`` scrape
+endpoint) exposes them with no extra plumbing.
+
+Naming follows Prometheus conventions: ``_total`` counters, ``_s`` /
+``_bytes`` units, and the registry adds the ``ray_tpu_`` prefix at render
+time.  ``ALL_METRICS`` lists every family for the exposition-validity test
+in ``tests/test_tracing.py``.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.observability.metrics import global_registry
+
+_reg = global_registry()
+
+# Latency boundaries: sub-millisecond placement decisions up to minute-scale
+# task bodies.  Placement gets its own finer grid — the in-process scheduler
+# decides in microseconds and the default buckets would collapse it into one.
+_PLACEMENT_BOUNDS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)
+_LATENCY_BOUNDS = (1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+# ---- tasks ---------------------------------------------------------------
+TASKS_SUBMITTED = _reg.counter(
+    "tasks_submitted_total", "Tasks submitted by this driver, by type (normal/actor)."
+)
+TASKS_TERMINAL = _reg.counter(
+    "tasks_terminal_total", "Terminal task states by outcome"
+)
+TASK_QUEUE_WAIT = _reg.histogram(
+    "task_submit_to_start_s",
+    "Latency from .remote() submission to execution start (scheduling + queueing).",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+TASK_EXEC_TIME = _reg.histogram(
+    "task_start_to_finish_s",
+    "Latency from execution start to the terminal commit.",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+
+# ---- scheduler -----------------------------------------------------------
+SCHEDULER_QUEUE_DEPTH = _reg.gauge(
+    "scheduler_queue_depth", "Tasks waiting on resources in a node's local scheduler.", "tasks"
+)
+SCHEDULER_PLACEMENT_LATENCY = _reg.histogram(
+    "scheduler_placement_latency_s",
+    "Wall time of the cluster-level node-selection decision per task.",
+    "s",
+    boundaries=_PLACEMENT_BOUNDS,
+)
+SCHEDULER_TASKS_DISPATCHED = _reg.counter(
+    "scheduler_tasks_dispatched_total", "Tasks handed to an executor by a local scheduler."
+)
+
+# ---- object store --------------------------------------------------------
+OBJECT_STORE_PUTS = _reg.counter(
+    "object_store_puts_total", "Objects committed into a node's object store."
+)
+OBJECT_STORE_GETS = _reg.counter(
+    "object_store_gets_total",
+    "Object store lookups, by result (hit = value already local, miss = waiter parked).",
+)
+OBJECT_STORE_BYTES_PUT = _reg.counter(
+    "object_store_bytes_put_total", "Accounted payload bytes committed into object stores.", "By"
+)
+OBJECT_STORE_BYTES_GOT = _reg.counter(
+    "object_store_bytes_got_total", "Accounted payload bytes served by object-store hits.", "By"
+)
+OBJECT_STORE_SPILLS = _reg.counter(
+    "object_store_spills_total",
+    "Objects demoted a tier under memory pressure (device->host, host->shm/disk), by target tier.",
+)
+OBJECT_STORE_RESTORES = _reg.counter(
+    "object_store_restores_total", "Objects promoted back to the host tier on access."
+)
+OBJECT_STORE_OBJECTS = _reg.gauge(
+    "object_store_objects", "Live entries in a node's object store.", "objects"
+)
+OBJECT_STORE_USED_BYTES = _reg.gauge(
+    "object_store_used_bytes", "Accounted bytes held per tier (hbm/host) in a node's store.", "By"
+)
+
+# ---- worker pool ---------------------------------------------------------
+WORKER_POOL_WORKERS = _reg.gauge(
+    "worker_pool_workers", "Process workers per pool, by state (idle/busy).", "workers"
+)
+WORKER_POOL_TASKS = _reg.counter(
+    "worker_pool_tasks_total", "Stateless tasks submitted to process worker pools."
+)
+WORKER_POOL_SPAWNED = _reg.counter(
+    "worker_pool_spawned_total", "Worker processes spawned."
+)
+WORKER_POOL_DEATHS = _reg.counter(
+    "worker_pool_worker_deaths_total", "Worker processes that died or were killed."
+)
+
+# ---- actors --------------------------------------------------------------
+ACTOR_CALLS_SUBMITTED = _reg.counter(
+    "actor_calls_submitted_total", "Actor method calls submitted by this driver."
+)
+
+# ---- data plane ----------------------------------------------------------
+DATA_PLANE_BYTES = _reg.counter(
+    "data_plane_transfer_bytes_total",
+    "Bulk object bytes moved on the peer-to-peer data plane, by direction.",
+    "By",
+)
+DATA_PLANE_TRANSFERS = _reg.counter(
+    "data_plane_transfers_total", "Data-plane operations, by kind (pull/push/shm handoff)."
+)
+DATA_PLANE_LATENCY = _reg.histogram(
+    "data_plane_transfer_latency_s",
+    "Wall time of one client-side data-plane transfer (pull or push).",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+
+# ---- serve router --------------------------------------------------------
+SERVE_ROUTER_REQUESTS = _reg.counter(
+    "serve_router_requests_total", "Requests routed to replicas, by deployment."
+)
+SERVE_ROUTER_QUEUE_WAIT = _reg.histogram(
+    "serve_router_queue_wait_s",
+    "Time a request spends in the router before reaching a replica "
+    "(replica choice + membership waits).",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+SERVE_ROUTER_INFLIGHT = _reg.gauge(
+    "serve_router_inflight", "Requests in flight to replicas, by deployment.", "requests"
+)
+
+# ---- node utilization (dashboard reporter samples) -----------------------
+NODE_CPU_PERCENT = _reg.gauge(
+    "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
+)
+NODE_MEM_USED_BYTES = _reg.gauge(
+    "node_mem_used_bytes", "Host memory in use sampled by the node reporter.", "By"
+)
+NODE_TPU_MEM_USED_BYTES = _reg.gauge(
+    "node_tpu_mem_used_bytes", "Device HBM in use sampled by the node reporter.", "By"
+)
+
+#: every predefined family, for catalog tests and docs
+ALL_METRICS = [
+    TASKS_SUBMITTED,
+    TASKS_TERMINAL,
+    TASK_QUEUE_WAIT,
+    TASK_EXEC_TIME,
+    SCHEDULER_QUEUE_DEPTH,
+    SCHEDULER_PLACEMENT_LATENCY,
+    SCHEDULER_TASKS_DISPATCHED,
+    OBJECT_STORE_PUTS,
+    OBJECT_STORE_GETS,
+    OBJECT_STORE_BYTES_PUT,
+    OBJECT_STORE_BYTES_GOT,
+    OBJECT_STORE_SPILLS,
+    OBJECT_STORE_RESTORES,
+    OBJECT_STORE_OBJECTS,
+    OBJECT_STORE_USED_BYTES,
+    WORKER_POOL_WORKERS,
+    WORKER_POOL_TASKS,
+    WORKER_POOL_SPAWNED,
+    WORKER_POOL_DEATHS,
+    ACTOR_CALLS_SUBMITTED,
+    DATA_PLANE_BYTES,
+    DATA_PLANE_TRANSFERS,
+    DATA_PLANE_LATENCY,
+    SERVE_ROUTER_REQUESTS,
+    SERVE_ROUTER_QUEUE_WAIT,
+    SERVE_ROUTER_INFLIGHT,
+    NODE_CPU_PERCENT,
+    NODE_MEM_USED_BYTES,
+    NODE_TPU_MEM_USED_BYTES,
+]

@@ -66,7 +66,11 @@ class Gauge(Metric):
 
 
 class Histogram(Metric):
-    """Fixed-bucket histogram (Prometheus cumulative-bucket semantics)."""
+    """Fixed-bucket histogram (Prometheus cumulative-bucket semantics).
+
+    ``counts`` carries ``len(boundaries) + 1`` entries: one per finite
+    boundary plus an explicit overflow bucket for values above the largest
+    boundary, so ``sum(counts) == total`` always holds."""
 
     kind = "histogram"
 
@@ -80,11 +84,13 @@ class Histogram(Metric):
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         key = _tagkey(tags)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.boundaries))
+            counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
             for i, b in enumerate(self.boundaries):
                 if value <= b:
                     counts[i] += 1
                     break
+            else:
+                counts[-1] += 1  # overflow: above the largest boundary
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 

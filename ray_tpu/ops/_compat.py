@@ -1,0 +1,15 @@
+"""Pallas-TPU version-compat shim shared by the ops kernels.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``; kernels
+import ``pltpu`` from here so they can use the new spelling on any jax.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 ships the pre-rename name; alias so kernels use one spelling
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+__all__ = ["pltpu"]

@@ -30,7 +30,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from ray_tpu.ops._compat import pltpu
 
 NEG_INF = -1e30
 _LANES = 128  # m/l scratch is lane-replicated to keep stores 2-D tileable

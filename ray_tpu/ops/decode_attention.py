@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from ray_tpu.ops._compat import pltpu
 
 from ray_tpu.ops.attention import NEG_INF, _LANES, _use_interpret
 

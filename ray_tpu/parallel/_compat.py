@@ -1,0 +1,35 @@
+"""jax version-compat shims shared by the parallel package.
+
+One module owns every rename this package straddles, so the next jax API
+move is a one-file fix:
+
+  * ``shard_map`` — promoted from ``jax.experimental.shard_map`` to
+    ``jax.shard_map``.
+  * ``lax.axis_size`` — absent before jax 0.5; ``lax.psum(1, axis)`` is the
+    classic spelling and constant-folds to the mesh axis size.
+  * the shard_map replication-checking kwarg — renamed
+    ``check_rep`` -> ``check_vma``.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax: pre-promotion location
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def axis_size(axis_name: str):
+    fn = getattr(lax, "axis_size", None)
+    return fn(axis_name) if fn is not None else lax.psum(1, axis_name)
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with replication/vma checking off — the kwarg was renamed
+    check_rep -> check_vma across jax versions."""
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)

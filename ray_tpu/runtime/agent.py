@@ -132,12 +132,23 @@ class AgentFabric:
         callback()
 
     # -- completion callbacks (forwarded to the owner on the head) ----------
+    def _drained_spans(self) -> list:
+        """Finished tracing spans buffered on this agent (it has no sink):
+        piggyback them on the next task_finished so they reach the head's
+        span store.  Spans carry their trace ids, so draining everything
+        accumulated — including spans of OTHER tasks on this agent — is
+        attribution-safe."""
+        from ray_tpu.observability import tracing
+
+        return tracing.drain_span_events()
+
     def on_task_finished(self, node, spec, result, error) -> None:
         self._forget(spec)
         if error is not None:
             self.conn.send(
                 "task_finished",
-                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None},
+                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None,
+                 "spans": self._drained_spans()},
             )
             return
         # Store returns locally first: this node IS a valid object location
@@ -169,6 +180,7 @@ class AgentFabric:
                     "task_id": spec.task_id.binary(), "value": None, "error": None,
                     "lazy": True,
                     "device_returns": [is_device_array(v) for v in values],
+                    "spans": self._drained_spans(),
                 },
             )
 
@@ -186,7 +198,8 @@ class AgentFabric:
             return
         self.conn.send(
             "task_finished",
-            {"task_id": spec.task_id.binary(), "value": enc, "error": None},
+            {"task_id": spec.task_id.binary(), "value": enc, "error": None,
+             "spans": self._drained_spans()},
         )
 
     def on_stream_item(self, node, spec, index: int, value, is_error: bool = False) -> None:

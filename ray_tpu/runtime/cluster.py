@@ -39,6 +39,7 @@ from ray_tpu.exceptions import (
     RayTaskError,
     WorkerCrashedError,
 )
+from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime.control import ActorState, ControlService, NodeInfo
 from ray_tpu.runtime.node import Node
 from ray_tpu.runtime.scheduler import ClusterScheduler, TaskSpec
@@ -171,7 +172,6 @@ class Cluster:
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
         self.core_worker = None       # set by worker.init
-        self._terminal_counter = None  # cached tasks_terminal_total metric
         self.shm_store = None
         if shm_capacity >= 0:
             try:
@@ -495,7 +495,9 @@ class Cluster:
     # task submission (cluster-level)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> None:
+        t0 = time.perf_counter()
         node_id = self.cluster_scheduler.pick_node(spec)
+        metric_defs.SCHEDULER_PLACEMENT_LATENCY.observe(time.perf_counter() - t0)
         if node_id is None:
             # infeasible now: park until resources free up / nodes join.
             self._park_infeasible(spec)
@@ -857,19 +859,24 @@ class Cluster:
                     )
                 if error is None:
                     # the call actually completed: salvage the result onto
-                    # the head node's store
+                    # the head node's store.  Event recorded BEFORE the puts:
+                    # getters wake the instant the value commits, and the
+                    # terminal record must already be visible to them (and
+                    # to a racing shutdown snapshot).
+                    self._record_task_event(spec, node, "FINISHED")
                     values = [result] if spec.num_returns == 1 else list(result or [None] * spec.num_returns)
                     for oid, value in zip(spec.return_ids, values):
                         self.head_node.store.put(oid, value)
                         self.directory.add_location(oid, self.head_node.node_id)
                     self.task_manager.mark_completed(spec)
-                    self._record_task_event(spec, node, "FINISHED")
+                    self._emit_task_spans(spec, "FINISHED")
                 elif self._maybe_retry_actor_task(spec):
                     return
                 else:
+                    self._record_task_event(spec, node, "FAILED")
                     self.task_manager.mark_failed(spec)
                     self._commit_error_everywhere(spec, error)
-                    self._record_task_event(spec, node, "FAILED")
+                    self._emit_task_spans(spec, "FAILED")
                 self._after_commit(spec)
             return
         if error is not None:
@@ -896,36 +903,57 @@ class Cluster:
                 # an actor call that died with its worker surfaces as an
                 # actor error, not a bare worker crash (RayActorError parity)
                 error = ActorDiedError(spec.actor_id, str(error))
+            # record BEFORE committing the error objects: committing wakes
+            # blocked getters, and the terminal record must already be
+            # visible to them (and to a racing shutdown snapshot)
+            self._record_task_event(spec, node, "FAILED")
             self.task_manager.mark_failed(spec)
             self._commit_error_everywhere(spec, error)
+            self._emit_task_spans(spec, "FAILED")
             self._after_commit(spec)
-            self._record_task_event(spec, node, "FAILED")
             return
 
-        # split returns
+        # split returns.  The terminal event is recorded BEFORE the value
+        # commits: store.put wakes blocked getters, and a caller returning
+        # from rt.get (or a shutdown snapshot racing this thread) must
+        # already see the task's terminal record.
+        self._record_task_event(spec, node, "FINISHED")
         if lazy:
             # values live in the remote node's store; record locations only
             for oid in spec.return_ids:
                 self.directory.add_location(oid, node.node_id)
             self.task_manager.mark_completed(spec)
+            self._emit_task_spans(spec, "FINISHED")
             self._after_commit(spec)
-            self._record_task_event(spec, node, "FINISHED")
             return
         if spec.num_returns == 1:
             values = [result]
         else:
             values = list(result) if result is not None else [None] * spec.num_returns
+        t_put = time.time()
         for oid, value in zip(spec.return_ids, values):
             node.store.put(oid, value)
             self.directory.add_location(oid, node.node_id)
+        if spec.trace_ctx is not None and spec.return_ids:
+            tracing.emit_span(
+                f"put::{spec.name}", spec.trace_ctx[0], spec.trace_ctx[1],
+                t_put, time.time(),
+            )
         self.task_manager.mark_completed(spec)
+        # root span emitted after the puts so its interval contains them
+        self._emit_task_spans(spec, "FINISHED")
         self._after_commit(spec)
-        self._record_task_event(spec, node, "FINISHED")
 
     def _record_task_event(self, spec: TaskSpec, node: Node, state: str) -> None:
         """TaskEventBuffer→GcsTaskManager parity (task_event_buffer.h:206):
         one record per terminal state with submit/start/end timestamps, from
         which ``rt timeline`` builds chrome-trace spans."""
+        metric_defs.TASKS_TERMINAL.inc(tags={"state": state})
+        now = time.time()
+        if spec.submit_time and spec.start_time:
+            metric_defs.TASK_QUEUE_WAIT.observe(spec.start_time - spec.submit_time)
+        if spec.start_time:
+            metric_defs.TASK_EXEC_TIME.observe(now - spec.start_time)
         if not get_config().task_events_enabled:
             return
         self.control.task_events.add(
@@ -937,17 +965,33 @@ class Cluster:
                 "attempt": spec.attempt,
                 "submit_ts": spec.submit_time or None,
                 "start_ts": spec.start_time or None,
-                "ts": time.time(),
+                "ts": now,
             }
         )
-        counter = self._terminal_counter
-        if counter is None:
-            from ray_tpu.observability.metrics import global_registry
 
-            counter = self._terminal_counter = global_registry().counter(
-                "tasks_terminal_total", "Terminal task states by outcome"
+    def _emit_task_spans(self, spec: TaskSpec, state: str) -> None:
+        """Synthesize the task's ROOT span (submit→now; its id was reserved
+        at submit so both sides of the process boundary parent to it) plus
+        the owner-side schedule phase — worker-side execute spans arrive
+        through result payloads and nest under the same root.  Called AFTER
+        the return commits so the root covers the put phase (children must
+        nest by time containment in the rendered trace)."""
+        ctx = spec.trace_ctx
+        if ctx is None:
+            return
+        trace_id, task_span_id, parent_id = ctx
+        now = time.time()
+        root_start = spec.submit_time or spec.start_time or now
+        tracing.emit_span(
+            f"task::{spec.name}", trace_id, parent_id, root_start, now,
+            span_id=task_span_id,
+            attrs={"task_id": spec.task_id.hex(), "state": state},
+        )
+        if spec.submit_time and spec.start_time:
+            tracing.emit_span(
+                f"schedule::{spec.name}", trace_id, task_span_id,
+                spec.submit_time, spec.start_time,
             )
-        counter.inc(tags={"state": state})
 
     # ------------------------------------------------------------------
     # streaming generators (reference: TryReadObjectRefStream,
@@ -1000,9 +1044,11 @@ class Cluster:
             self.on_stream_item(node, spec, index, error, is_error=True)
             self.task_manager.mark_failed(spec)
             self._record_task_event(spec, node, "FAILED")
+            self._emit_task_spans(spec, "FAILED")
         else:
             self.task_manager.mark_completed(spec)
             self._record_task_event(spec, node, "FINISHED")
+            self._emit_task_spans(spec, "FINISHED")
         gen = self._streams.pop(spec.task_id.binary(), None)
         if gen is not None:
             gen._finish()

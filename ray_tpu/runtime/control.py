@@ -352,6 +352,10 @@ class ControlService:
         self.actors = ActorDirectory(self.pubsub)
         self.jobs = JobTable()
         self.task_events = TaskEventStore()
+        # finished tracing spans (observability/tracing.py), kept separate
+        # from task-state records so state-API task listings/summaries stay
+        # span-free; ray_tpu.timeline() merges the two streams
+        self.spans = TaskEventStore()
         from ray_tpu.runtime.placement import PlacementGroupManager
 
         self.placement_groups = PlacementGroupManager(self.nodes, self.pubsub)

@@ -41,6 +41,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 _LEN = struct.Struct("<Q")
 
 
+def _observe_latency(op: str, t_start: float) -> None:
+    from ray_tpu.observability import metric_defs
+
+    metric_defs.DATA_PLANE_LATENCY.observe(time.perf_counter() - t_start, tags={"op": op})
+
+
 class DataPlaneError(ConnectionError):
     pass
 
@@ -265,7 +271,21 @@ def _recv_header(sock: socket.socket) -> dict:
 
 
 class TransferStats:
-    """Byte/count accounting, surfaced in tests and the dashboard."""
+    """Byte/count accounting, surfaced in tests and the dashboard.  Every
+    ``add`` also feeds the matching global metric family, so per-instance
+    snapshots and the Prometheus scrape can't drift."""
+
+    #: field -> (metric attr on metric_defs, tag dict); resolved lazily so
+    #: importing this module in bare worker processes stays cheap
+    _FIELD_METRICS = {
+        "bytes_sent": ("DATA_PLANE_BYTES", {"direction": "sent"}),
+        "bytes_received": ("DATA_PLANE_BYTES", {"direction": "received"}),
+        "pulls_served": ("DATA_PLANE_TRANSFERS", {"op": "pull_served"}),
+        "pulls_issued": ("DATA_PLANE_TRANSFERS", {"op": "pull"}),
+        "pushes_sent": ("DATA_PLANE_TRANSFERS", {"op": "push"}),
+        "pushes_received": ("DATA_PLANE_TRANSFERS", {"op": "push_received"}),
+        "shm_handoffs": ("DATA_PLANE_TRANSFERS", {"op": "shm_handoff"}),
+    }
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -292,6 +312,11 @@ class TransferStats:
     def add(self, field: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+        metric = self._FIELD_METRICS.get(field)
+        if metric is not None:
+            from ray_tpu.observability import metric_defs
+
+            getattr(metric_defs, metric[0]).inc(n, tags=metric[1])
 
 
 class DataServer:
@@ -615,6 +640,13 @@ class DataClient:
         """Fetch an object from a peer; returns ``(value, is_error)``.
         Raises :class:`ObjectNotFound` if the peer doesn't materialize it
         within ``timeout``."""
+        t_start = time.perf_counter()
+        try:
+            return self._pull(addr, oid, timeout)
+        finally:
+            _observe_latency("pull", t_start)
+
+    def _pull(self, addr: str, oid: bytes, timeout: float = 30.0) -> Tuple[Any, bool]:
         from ray_tpu.core.config import get_config
         from ray_tpu.runtime import device_plane
 
@@ -699,6 +731,13 @@ class DataClient:
         return from_frames(meta, buffers), header.get("is_error", False)
 
     def push(self, addr: str, oid: bytes, value: Any, is_error: bool = False) -> None:
+        t_start = time.perf_counter()
+        try:
+            self._push(addr, oid, value, is_error)
+        finally:
+            _observe_latency("push", t_start)
+
+    def _push(self, addr: str, oid: bytes, value: Any, is_error: bool = False) -> None:
         meta, buffers = to_frames(value)
         sizes = [memoryview(b).cast("B").nbytes for b in buffers]
         with self._admission:

@@ -36,6 +36,7 @@ from ray_tpu.exceptions import (
     RayTaskError,
     WorkerCrashedError,
 )
+from ray_tpu.observability import tracing
 from ray_tpu.runtime import protocol
 from ray_tpu.runtime.scheduler import LocalScheduler, TaskSpec
 from ray_tpu.runtime.worker_pool import ProcessWorkerPool, WorkerHandle
@@ -186,7 +187,11 @@ class Node:
         self.labels = labels or {}
         self.pool = ResourcePool(resources)
         self.store = ObjectStore(shm_store=shm_store)
-        self.scheduler = LocalScheduler(self.pool, self.store, self._dispatch)
+        self.store.set_metrics_tags({"node": node_id.hex()[:8]})
+        self.scheduler = LocalScheduler(
+            self.pool, self.store, self._dispatch,
+            metrics_tags={"node": node_id.hex()[:8]},
+        )
         # One pool serves both "thread" CPU-light tasks and device tasks; XLA
         # dispatch is async so device tasks occupy a thread only briefly.
         # Demand-grown (not fixed-size): nested inproc tasks blocking on
@@ -392,7 +397,8 @@ class Node:
             token = task_context.push(spec.task_id, self.node_id)
             t0 = time.perf_counter()
             try:
-                result = spec.func(*args, **kwargs)
+                with tracing.task_span(f"execute::{spec.name}", spec.trace_ctx):
+                    result = spec.func(*args, **kwargs)
             finally:
                 task_context.pop(token)
                 if spec.execution == "auto":
@@ -469,6 +475,7 @@ class Node:
         self.worker_pool.submit(
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result,
             runtime_env=spec.runtime_env,
+            trace=spec.trace_ctx[:2] if spec.trace_ctx is not None else None,
         )
 
     def _handle_worker_api(self, task_bin, blob: bytes, op: str = "", worker_key=None) -> bytes:
@@ -637,11 +644,14 @@ class Node:
                     value = protocol.decode_value(value, shm)
                     self.cluster.on_task_finished(self, spec, value, None)
 
+            payload = {"method": spec.actor_method, "args_blob": enc, "name": spec.name}
+            if spec.trace_ctx is not None:
+                payload["trace"] = spec.trace_ctx[:2]
             self.worker_pool.submit_to_worker(
                 inst.worker,
                 "actor_call",
                 spec.task_id.binary(),
-                {"method": spec.actor_method, "args_blob": enc, "name": spec.name},
+                payload,
                 on_result,
             )
 
@@ -682,14 +692,15 @@ class Node:
 
                 return on_result
 
-            calls.append(
-                {
-                    "task_id": spec.task_id.binary(),
-                    "method": spec.actor_method,
-                    "args_blob": enc,
-                    "name": spec.name,
-                }
-            )
+            call = {
+                "task_id": spec.task_id.binary(),
+                "method": spec.actor_method,
+                "args_blob": enc,
+                "name": spec.name,
+            }
+            if spec.trace_ctx is not None:
+                call["trace"] = spec.trace_ctx[:2]
+            calls.append(call)
             cbs.append((spec.task_id.binary(), make_on_result()))
         if calls:
             self.worker_pool.submit_batch_to_worker(inst.worker, calls, cbs)
@@ -725,7 +736,8 @@ class Node:
                         inst.created.set()
                         self.cluster.on_actor_created(self, spec)
                         continue
-                    result = getattr(inst.instance, spec.actor_method)(*args, **kwargs)
+                    with tracing.task_span(f"execute::{spec.name}", spec.trace_ctx):
+                        result = getattr(inst.instance, spec.actor_method)(*args, **kwargs)
                 finally:
                     task_context.pop(token)
                 self.cluster.on_task_finished(self, spec, result, None)

@@ -403,6 +403,13 @@ class RemoteNodeHandle:
     # agent -> head message handling (called by HeadService)
     # ------------------------------------------------------------------
     def on_task_finished_msg(self, payload: dict) -> None:
+        spans = payload.get("spans")
+        if spans:
+            # agent-side execute/user spans ride the completion notice; the
+            # head's sink lands them in the control service's span store
+            from ray_tpu.observability import tracing
+
+            tracing.record_span_events(spans)
         spec = self._untrack(payload["task_id"])
         if spec is None:
             return  # already resolved (e.g. node-death resubmission raced)

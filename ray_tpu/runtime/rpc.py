@@ -391,6 +391,9 @@ def encode_spec(spec, fn_blob_fn, sent_fns: set) -> dict:
         "actor_method": spec.actor_method,
         "is_actor_creation": spec.is_actor_creation,
         "runtime_env": spec.runtime_env,
+        # propagated trace context (tracing.py) — the agent's execute span
+        # must parent to the task span minted on the submitting host
+        "trace_ctx": spec.trace_ctx,
     }
     if spec.func is not None:
         fn_id, blob = fn_blob_fn(spec.func)
@@ -435,6 +438,7 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
     )
     spec.retries_left = d["retries_left"]
     spec.attempt = d["attempt"]
+    spec.trace_ctx = d.get("trace_ctx")
     return spec
 
 

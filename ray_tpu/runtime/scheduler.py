@@ -30,6 +30,7 @@ from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu.core.resources import ResourcePool, ResourceSet
 from ray_tpu.core.sync import when_all
+from ray_tpu.observability import metric_defs
 
 
 # --------------------------------------------------------------------------
@@ -64,7 +65,7 @@ class TaskSpec:
         "retries_left", "execution", "actor_id", "scheduling_strategy",
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
-        "_oom_killed", "_stream_closed", "_actor_seq",
+        "_oom_killed", "_stream_closed", "_actor_seq", "trace_ctx",
     )
 
     def __init__(
@@ -115,6 +116,9 @@ class TaskSpec:
         # per-actor submission-order stamp, assigned on first enqueue;
         # retries reinsert by it (see Cluster.submit_actor_task)
         self._actor_seq = None
+        # propagated trace context (trace_id, task_span_id, parent_span_id)
+        # stamped at submit time when tracing is enabled (tracing.py)
+        self.trace_ctx = None
 
 
 # --------------------------------------------------------------------------
@@ -266,13 +270,15 @@ class LocalScheduler:
     call :meth:`on_task_done`.
     """
 
-    def __init__(self, pool: ResourcePool, object_store, dispatch_fn: Callable[[TaskSpec], None]):
+    def __init__(self, pool: ResourcePool, object_store, dispatch_fn: Callable[[TaskSpec], None],
+                 metrics_tags: Optional[Dict[str, str]] = None):
         self._pool = pool
         self._store = object_store
         self._dispatch_fn = dispatch_fn
         self._lock = threading.Lock()
         self._ready: deque = deque()          # deps satisfied, waiting resources
         self._infeasible: List[TaskSpec] = []
+        self._metrics_tags = metrics_tags
         self.num_submitted = 0
         self.num_dispatched = 0
 
@@ -298,24 +304,33 @@ class LocalScheduler:
                 dispatch_now = True
             else:
                 self._ready.append(spec)
+                depth = len(self._ready)
         if dispatch_now:
             self._run(spec)
         else:
+            metric_defs.SCHEDULER_QUEUE_DEPTH.set(depth, self._metrics_tags)
             self._drain()
 
     def _drain(self) -> None:
         cfg = get_config()
+        drained = False
         while True:
             to_run = None
             with self._lock:
                 if self._ready and self._pool.acquire(self._ready[0].resources):
                     to_run = self._ready.popleft()
+                    drained = True
+                elif drained:
+                    depth = len(self._ready)
             if to_run is None:
+                if drained:
+                    metric_defs.SCHEDULER_QUEUE_DEPTH.set(depth, self._metrics_tags)
                 return
             self._run(to_run)
 
     def _run(self, spec: TaskSpec) -> None:
         self.num_dispatched += 1
+        metric_defs.SCHEDULER_TASKS_DISPATCHED.inc(tags=self._metrics_tags)
         try:
             self._dispatch_fn(spec)
         except Exception:

@@ -18,6 +18,10 @@ import itertools
 import threading
 import time
 from concurrent.futures import Future
+# py3.10: futures.TimeoutError is NOT the builtin (unified only in 3.11) —
+# catching bare TimeoutError lets Future.result timeouts leak past the
+# GetTimeoutError translation
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core.config import get_config
@@ -27,6 +31,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.resources import ResourceSet
 from ray_tpu.core.serialization import get_context
 from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime.context import task_context
 from ray_tpu.runtime.control import ActorInfo
 from ray_tpu.runtime.scheduler import TaskSpec
@@ -125,6 +130,8 @@ class CoreWorker:
             runtime_env=runtime_env,
         )
         spec._retry_exceptions = retry_exceptions
+        spec.trace_ctx = tracing.task_trace_context()
+        metric_defs.TASKS_SUBMITTED.inc(tags=_NORMAL_TASK_TAGS)
         for oid in return_ids:
             self.ref_counter.add_owned_object(oid)
         self.ref_counter.add_submitted_task_references([r.id() for r in deps])
@@ -215,6 +222,9 @@ class CoreWorker:
             actor_id=actor_id,
             actor_method=method_name,
         )
+        spec.trace_ctx = tracing.task_trace_context()
+        metric_defs.TASKS_SUBMITTED.inc(tags=_ACTOR_TASK_TAGS)
+        metric_defs.ACTOR_CALLS_SUBMITTED.inc()
         for oid in return_ids:
             self.ref_counter.add_owned_object(oid)
         self.ref_counter.add_submitted_task_references([r.id() for r in deps])
@@ -297,7 +307,7 @@ class CoreWorker:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
                 values.append(fut.result(remaining))
-            except TimeoutError:
+            except (TimeoutError, _FutureTimeoutError):
                 raise GetTimeoutError("ray_tpu.get timed out")
         return values[0] if single else values
 
@@ -354,6 +364,10 @@ class CoreWorker:
                 node.store.delete(oid)
         self.cluster.directory.forget(oid)
 
+
+# prebuilt tag dicts: the submit hot path must not allocate them per call
+_NORMAL_TASK_TAGS = {"type": "normal"}
+_ACTOR_TASK_TAGS = {"type": "actor"}
 
 _RESOURCE_SET_CACHE: dict = {}
 

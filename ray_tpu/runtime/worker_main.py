@@ -354,30 +354,39 @@ class Worker:
     def _handle_exec(self, payload: dict) -> None:
         import time
 
+        from ray_tpu.observability import tracing
+
         task_id = payload["task_id"]
+        name = payload.get("name", "task")
         self._current.task = task_id
         ctx, token = self._push_task_context(task_id)
         try:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
             t0 = time.perf_counter()
-            result = _maybe_profile(
-                payload.get("name", "task"), task_id, fn, args, kwargs,
-                runtime_env=payload.get("runtime_env"),
-            )
+            # adopt the driver's propagated trace context: the execute span
+            # (and any spans the task body opens) parent to the task span
+            # minted at .remote() time in the submitting process
+            with tracing.task_span(f"execute::{name}", payload.get("trace")):
+                result = _maybe_profile(
+                    name, task_id, fn, args, kwargs,
+                    runtime_env=payload.get("runtime_env"),
+                )
             exec_s = time.perf_counter() - t0
-            self._reply(
-                "result",
-                {"task_id": task_id, "value_blob": self._encode_result(result), "exec_s": exec_s},
-            )
+            reply = {"task_id": task_id, "value_blob": self._encode_result(result), "exec_s": exec_s}
+            spans = tracing.drain_span_events()
+            if spans:
+                reply["spans"] = spans
+            self._reply("result", reply)
         except BaseException as exc:  # noqa: BLE001 — task errors become objects
-            self._reply(
-                "result",
-                {
-                    "task_id": task_id,
-                    "error_blob": pickle.dumps(_make_task_error(payload.get("name", "task"), exc)),
-                },
-            )
+            reply = {
+                "task_id": task_id,
+                "error_blob": pickle.dumps(_make_task_error(name, exc)),
+            }
+            spans = tracing.drain_span_events()
+            if spans:
+                reply["spans"] = spans
+            self._reply("result", reply)
         finally:
             self._current.task = None
             if token is not None:
@@ -426,10 +435,16 @@ class Worker:
                 self._reply("result_batch", {"results": batch})
 
     def _handle_actor_call(self, payload: dict, collect=None) -> None:
+        from ray_tpu.observability import tracing
+
         task_id = payload["task_id"]
         method_name = payload["method"]
+        trace = payload.get("trace")
 
         def emit(result_payload: dict) -> None:
+            spans = tracing.drain_span_events()
+            if spans:
+                result_payload["spans"] = spans
             if collect is not None:
                 collect(result_payload)
             else:
@@ -447,7 +462,8 @@ class Worker:
                 async def _run_with_context():
                     ctx, token = self._push_task_context(task_id)
                     try:
-                        return await method(*args, **kwargs)
+                        with tracing.task_span(f"execute::{method_name}", trace):
+                            return await method(*args, **kwargs)
                     finally:
                         if token is not None:
                             ctx.pop(token)
@@ -465,7 +481,8 @@ class Worker:
             self._current.task = task_id
             ctx, token = self._push_task_context(task_id)
             try:
-                result = _maybe_profile(method_name, task_id, method, args, kwargs)
+                with tracing.task_span(f"execute::{method_name}", trace):
+                    result = _maybe_profile(method_name, task_id, method, args, kwargs)
             finally:
                 self._current.task = None
                 if token is not None:

@@ -27,7 +27,12 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime import protocol
+
+# prebuilt gauge tag dicts (hot-path allocations)
+_IDLE_TAGS = {"state": "idle"}
+_BUSY_TAGS = {"state": "busy"}
 
 
 class WorkerHandle:
@@ -203,8 +208,18 @@ class ProcessWorkerPool:
             self._all[handle.pid] = handle
             if to_idle:
                 self._idle.append(handle)
+        metric_defs.WORKER_POOL_SPAWNED.inc()
+        self._update_worker_gauges()
         self._watch_worker(handle)
         return handle
+
+    def _update_worker_gauges(self) -> None:
+        # racy reads on purpose: gauges are approximate and the counts are
+        # plain len()s — no lock needed on this path
+        idle = len(self._idle)
+        total = len(self._all)
+        metric_defs.WORKER_POOL_WORKERS.set(idle, _IDLE_TAGS)
+        metric_defs.WORKER_POOL_WORKERS.set(max(0, total - idle), _BUSY_TAGS)
 
     #: optional redirect for worker log lines (fn(line_with_prefix)); node
     #: agents point this at the head connection so task prints land on the
@@ -257,7 +272,7 @@ class ProcessWorkerPool:
                     while self._backlog:
                         failed.append(self._backlog.popleft())
             for item in failed:
-                callback = item[5]  # (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
+                callback = item[5]  # (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env, trace)
                 try:
                     callback(None, WorkerCrashedError(f"worker spawn failed: {exc}"), None)
                 except BaseException:
@@ -303,6 +318,7 @@ class ProcessWorkerPool:
                     worker.last_idle_time = time.monotonic()
                     self._idle.append(worker)
                     self._maybe_reap_locked()
+        self._update_worker_gauges()
         if backlog_item is not None:
             self._send_exec(worker, *backlog_item)
 
@@ -321,23 +337,27 @@ class ProcessWorkerPool:
         args_blob: bytes,
         callback: Callable[[Any, Optional[BaseException]], None],
         runtime_env: Optional[dict] = None,
+        trace: Optional[tuple] = None,
     ) -> bool:
         """Run a stateless task on an idle worker; queues when saturated.
         Never blocks: pool growth happens on a spawner thread."""
+        metric_defs.WORKER_POOL_TASKS.inc()
         worker = self._acquire_idle()
         if worker is None:
             with self._lock:
                 self._backlog.append(
-                    (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
+                    (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env, trace)
                 )
             self._maybe_grow_async()
             return True
-        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env)
+        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env, trace)
         return True
 
     def _send_exec(self, worker, task_id, name, fn_id, fn_blob, args_blob, callback,
-                   runtime_env: Optional[dict] = None) -> None:
+                   runtime_env: Optional[dict] = None, trace: Optional[tuple] = None) -> None:
         payload = {"task_id": task_id, "name": name, "fn_id": fn_id, "args_blob": args_blob}
+        if trace is not None:
+            payload["trace"] = trace
         if runtime_env:
             # per-TASK runtime env: only the body-scoped keys travel —
             # process-level plugins (pip, conda, container, working_dir)
@@ -636,6 +656,12 @@ class ProcessWorkerPool:
         return out
 
     def _deliver_result(self, worker: WorkerHandle, payload: dict) -> None:
+        spans = payload.get("spans")
+        if spans:
+            # worker-side finished spans (execute phase + any user spans)
+            # ride the result payload home; on the head host the tracing
+            # sink lands them in the control service's span store
+            tracing.record_span_events(spans)
         task_id = payload["task_id"]
         with self._lock:
             callback = self._inflight.pop(task_id, None)
@@ -695,6 +721,8 @@ class ProcessWorkerPool:
         # unblock orphaned-callback paths (check-register races) that
         # sequence behind the notification above
         worker.death_done.set()
+        metric_defs.WORKER_POOL_DEATHS.inc()
+        self._update_worker_gauges()
         for task_id, callback, slot in dead_tasks:
             if callback is not None:
                 callback(None, WorkerCrashedError(f"worker {worker.pid} died"), None)
@@ -735,6 +763,8 @@ class ProcessWorkerPool:
         worker.death_done.set()
         with self._lock:
             self._all.pop(worker.pid, None)
+        metric_defs.WORKER_POOL_DEATHS.inc()
+        self._update_worker_gauges()
         try:
             worker.send("shutdown", {})
         except OSError:

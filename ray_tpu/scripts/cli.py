@@ -219,7 +219,8 @@ def cmd_summary(args) -> int:
 
 def cmd_timeline(args) -> int:
     address = _read_address(args.address)
-    trace = _get(address, "/api/timeline")
+    route = "/api/timeline?tracing=1" if getattr(args, "tracing", False) else "/api/timeline"
+    trace = _get(address, route)
     with open(args.output, "w") as f:
         json.dump(trace, f)
     print(f"wrote {len(trace)} events to {args.output} (open in chrome://tracing or Perfetto)")
@@ -414,6 +415,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("timeline", help="dump chrome-tracing timeline")
     sp.add_argument("--address", default=None)
     sp.add_argument("-o", "--output", default="timeline.json")
+    sp.add_argument(
+        "--tracing", action="store_true",
+        help="include distributed-tracing spans (submit/schedule/execute/put phases)",
+    )
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("metrics", help="print Prometheus metrics")

@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.observability import metric_defs
 
 
 def _is_system_failure(exc: BaseException) -> bool:
@@ -100,6 +102,7 @@ class Router:
         self._rng = random.Random()
         self._reqs_since_push = 0
         self._watching = False
+        self._metric_tags = {"deployment": deployment_name}
 
     # ------------------------------------------------------------ updates
     def _apply_snapshot(self, version: int, replicas: List[Any]) -> None:
@@ -169,6 +172,7 @@ class Router:
                 self._refresh(force=True)
 
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        t_start = time.perf_counter()
         if not self._replicas:
             self._refresh()
         if not self._replicas:
@@ -183,11 +187,17 @@ class Router:
                 a, b = self._rng.sample(range(n), 2)
                 idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            total_inflight = sum(self._inflight.values())
             replica = self._replicas[idx]
             self._reqs_since_push += 1
             push = self._reqs_since_push >= 10
             if push:
                 self._reqs_since_push = 0
+        metric_defs.SERVE_ROUTER_REQUESTS.inc(tags=self._metric_tags)
+        metric_defs.SERVE_ROUTER_INFLIGHT.set(total_inflight, self._metric_tags)
+        metric_defs.SERVE_ROUTER_QUEUE_WAIT.observe(
+            time.perf_counter() - t_start, tags=self._metric_tags
+        )
         # Resolve nested DeploymentResponses: pass their refs so the fabric
         # chains the calls without blocking here (model composition).
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
@@ -217,7 +227,9 @@ class Router:
         with self._lock:
             if idx in self._inflight and self._inflight[idx] > 0:
                 self._inflight[idx] -= 1
-            drained = not any(self._inflight.values())
+            total_inflight = sum(self._inflight.values())
+            drained = not total_inflight
+        metric_defs.SERVE_ROUTER_INFLIGHT.set(total_inflight, self._metric_tags)
         if drained:
             # without this push the controller's last snapshot would show
             # ongoing requests forever and it would never scale down

@@ -35,8 +35,8 @@ def test_counter_gauge_histogram():
     h.observe(0.5)
     h.observe(100)
     counts, total_sum, total = h.snapshot()
-    assert counts == [1, 1, 0]       # 100 exceeds the largest bound
-    assert total == 3
+    assert counts == [1, 1, 0, 1]    # 100 lands in the explicit overflow bucket
+    assert sum(counts) == total == 3
     assert total_sum == pytest.approx(100.55)
 
 

@@ -11,7 +11,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax: pre-promotion location
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.parallel import (

@@ -1,0 +1,378 @@
+"""Distributed tracing + default-metric catalog.
+
+Covers the observability tentpole: span context propagation across the
+process boundary (driver → pool worker → driver), chrome-trace nesting,
+the predefined metric families of ``observability/metric_defs.py`` firing
+from the instrumented hot paths, exposition-format validity for every
+defined family, and the CLI surfaces (``ray_tpu metrics``, ``ray_tpu
+timeline --tracing``).
+"""
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.observability import metric_defs, tracing
+from ray_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    global_registry,
+)
+from ray_tpu.observability.timeline import chrome_trace
+
+
+# ----------------------------------------------------------------------
+# span primitives (no runtime needed)
+# ----------------------------------------------------------------------
+def test_nested_spans_share_trace_and_chain_parents():
+    drained = tracing.drain_span_events()  # isolate from other tests
+    with tracing.span("outer") as outer:
+        assert tracing.current_context().span_id == outer.span_id
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tracing.current_context() is None
+    events = tracing.drain_span_events()
+    assert [e["name"] for e in events] == ["inner", "outer"]
+    assert all(e["type"] == "span" and e["ts"] >= e["start_ts"] for e in events)
+    del drained
+
+
+def test_task_trace_context_inherits_enclosing_span():
+    with tracing.span("root") as root:
+        ctx = tracing.task_trace_context()
+        assert ctx[0] == root.trace_id
+        assert ctx[2] == root.span_id
+    tracing.drain_span_events()
+    # no enclosing span: a fresh trace is minted
+    ctx = tracing.task_trace_context()
+    assert ctx[0] and ctx[1] and ctx[2] is None
+
+
+def test_histogram_overflow_bucket_regression():
+    """Values above the largest boundary must be counted (previously only
+    ``+Inf`` via the total), so bucket counts always sum to the total."""
+    h = Histogram("overflow", boundaries=[1.0, 2.0])
+    for v in (0.5, 1.5, 3.0, 1000.0):
+        h.observe(v)
+    counts, total_sum, total = h.snapshot()
+    assert counts == [1, 1, 2]
+    assert sum(counts) == total == 4
+    assert total_sum == pytest.approx(1005.0)
+
+
+def test_prometheus_escape_roundtrip():
+    """Label values containing quotes and newlines must survive rendering
+    (exercises ``_escape``) and be recoverable by unescaping."""
+    reg = MetricsRegistry()
+    raw = 'he said "hi"\nback\\slash'
+    reg.counter("esc").inc(1, tags={"msg": raw})
+    text = reg.render_prometheus()
+    m = re.search(r'ray_tpu_esc\{msg="((?:[^"\\]|\\.)*)"\} 1', text)
+    assert m, text
+    unescaped = m.group(1).replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    assert unescaped == raw
+    # the sample must stay on one physical line
+    assert all(line.count('"') % 2 == 0 for line in text.splitlines() if "esc" in line)
+
+
+# ----------------------------------------------------------------------
+# metric_defs catalog: every family renders spec-valid exposition text
+# ----------------------------------------------------------------------
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                      # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"  # more labels
+    r" -?[0-9.eE+\-]+(\s[0-9]+)?$"                    # value [timestamp]
+)
+
+
+def test_every_metric_def_renders_valid_exposition():
+    """Tier-1 catalog guard: a new metric added to metric_defs.py cannot
+    silently break the /metrics scrape endpoint."""
+    names = [m.name for m in metric_defs.ALL_METRICS]
+    assert len(names) == len(set(names)), "duplicate metric names"
+    reg = MetricsRegistry()
+    for m in metric_defs.ALL_METRICS:
+        assert _NAME_RE.match(m.name), m.name
+        assert m.description, f"metric {m.name} has no HELP text"
+        # clone into a scratch registry (global state untouched) and drive
+        # one sample with a representative tag set
+        if isinstance(m, Histogram):
+            reg.histogram(m.name, m.description, m.unit, m.boundaries).observe(
+                0.123, tags={"node": "abc"}
+            )
+        elif isinstance(m, Counter):
+            reg.counter(m.name, m.description, m.unit).inc(2, tags={"state": "x"})
+        else:
+            assert isinstance(m, Gauge), type(m)
+            reg.gauge(m.name, m.description, m.unit).set(7, tags={"state": "x"})
+    text = reg.render_prometheus()
+    seen_types = {}
+    samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert re.match(r"^# HELP ray_tpu_[a-zA-Z0-9_:]+ \S", line), line
+        elif line.startswith("# TYPE "):
+            m2 = re.match(r"^# TYPE (ray_tpu_[a-zA-Z0-9_:]+) (counter|gauge|histogram)$", line)
+            assert m2, line
+            seen_types[m2.group(1)] = m2.group(2)
+        else:
+            assert _SAMPLE_RE.match(line), f"invalid sample line: {line!r}"
+            samples += 1
+    for m in metric_defs.ALL_METRICS:
+        full = f"ray_tpu_{m.name}"
+        assert seen_types.get(full) == m.kind, f"{full} missing or wrong # TYPE"
+    assert samples >= len(metric_defs.ALL_METRICS)
+    # histogram buckets are cumulative and consistent with _count
+    for m in metric_defs.ALL_METRICS:
+        if isinstance(m, Histogram):
+            full = f"ray_tpu_{m.name}"
+            bucket_lines = [l for l in text.splitlines() if l.startswith(full + "_bucket")]
+            assert any('le="+Inf"' in l for l in bucket_lines), full
+
+
+# ----------------------------------------------------------------------
+# against a live runtime
+# ----------------------------------------------------------------------
+@pytest.fixture
+def rt_cluster():
+    rt.init(num_cpus=2)
+    yield
+    rt.shutdown()
+
+
+def _span_events():
+    return [e for e in rt.timeline() if e.get("type") == "span"]
+
+
+def test_cross_process_trace_propagation(rt_cluster):
+    """A task submitted from the driver produces driver-side (task root,
+    schedule, put) and worker-side (execute) spans sharing one trace_id,
+    and the chrome trace nests them."""
+
+    @rt.remote(execution="process")
+    def traced(x):
+        return x + 1
+
+    assert rt.get(traced.remote(41)) == 42
+    deadline = time.monotonic() + 10
+    trace = None
+    while time.monotonic() < deadline and trace is None:
+        by_trace = {}
+        for s in _span_events():
+            if s["name"].endswith("::traced"):
+                by_trace.setdefault(s["trace_id"], []).append(s)
+        for tid, spans in by_trace.items():
+            if len({s["pid"] for s in spans}) >= 2:
+                trace = spans
+                break
+        if trace is None:
+            time.sleep(0.1)
+    assert trace is not None, "no multi-process trace appeared"
+
+    names = {s["name"].split("::")[0]: s for s in trace}
+    root = names["task"]
+    execute = names["execute"]
+    assert root["pid"] == os.getpid()
+    assert execute["pid"] != os.getpid(), "execute span must come from the worker"
+    assert execute["parent_id"] == root["span_id"]
+    assert "schedule" in names and names["schedule"]["parent_id"] == root["span_id"]
+    # nesting: the root covers the worker-side execution
+    assert root["start_ts"] <= execute["start_ts"] + 1e-6
+    assert root["ts"] >= execute["ts"] - 1e-6
+
+    slices = chrome_trace(trace)
+    group = {s["pid"] for s in slices}
+    assert group == {f"trace:{root['trace_id'][:8]}"}
+    root_slice = next(s for s in slices if s["name"].startswith("task::"))
+    exec_slice = next(s for s in slices if s["name"].startswith("execute::"))
+    assert root_slice["ts"] <= exec_slice["ts"] + 1
+    assert root_slice["ts"] + root_slice["dur"] >= exec_slice["ts"] + exec_slice["dur"] - 1
+
+
+def test_inproc_and_actor_spans_share_trace(rt_cluster):
+    @rt.remote
+    class Tracer:
+        def poke(self):
+            return 1
+
+    t = Tracer.options(execution="inproc").remote()
+    assert rt.get(t.poke.remote()) == 1
+    # the task root span is emitted just AFTER the value commits (so its
+    # interval covers the put phase) — poll briefly for it
+    deadline = time.monotonic() + 10
+    kinds = set()
+    while time.monotonic() < deadline and not {"task", "execute"} <= kinds:
+        # actor-call specs are named Class.method
+        spans = [s for s in _span_events() if s["name"].endswith("Tracer.poke")]
+        by_trace = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        if by_trace:
+            _tid, group = max(by_trace.items(), key=lambda kv: len(kv[1]))
+            kinds = {s["name"].split("::")[0] for s in group}
+        if not {"task", "execute"} <= kinds:
+            time.sleep(0.05)
+    assert {"task", "execute"} <= kinds, kinds
+
+
+def test_workload_acceptance_metrics_and_spans(rt_cluster):
+    """ISSUE acceptance: tasks + actor calls + puts drive ≥ 10 distinct
+    non-zero ray_tpu_* families, and the timeline carries spans from ≥ 2
+    processes sharing one trace_id with correct parent/child nesting."""
+    import numpy as np
+
+    @rt.remote(execution="process")
+    def proc_task(x):
+        return x * 2
+
+    @rt.remote
+    def quick(x):
+        return x + 1
+
+    @rt.remote
+    class Acc:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+    a = Acc.remote()
+    rt.get([proc_task.remote(i) for i in range(4)])
+    rt.get([quick.remote(i) for i in range(4)])
+    rt.get([a.add.remote(1) for _ in range(4)])
+    rt.get(rt.put(np.arange(1024, dtype=np.float32)))
+    time.sleep(0.3)
+
+    text = global_registry().render_prometheus()
+    nonzero = set()
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith("ray_tpu_"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if v:
+            base = name.split("{")[0]
+            base = re.sub(r"_(bucket|sum|count)$", "", base)
+            nonzero.add(base)
+    defined = {f"ray_tpu_{m.name}" for m in metric_defs.ALL_METRICS}
+    hot = nonzero & defined
+    assert len(hot) >= 10, f"only {sorted(hot)}"
+
+    spans = _span_events()
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    multi = [ss for ss in by_trace.values() if len({s["pid"] for s in ss}) >= 2]
+    assert multi, "no trace crossed a process boundary"
+    ss = multi[0]
+    root = next(s for s in ss if s["name"].startswith("task::"))
+    children = [s for s in ss if s["parent_id"] == root["span_id"]]
+    assert children, "root span has no children"
+
+
+def test_cross_host_trace_propagation():
+    """trace_ctx rides encode_spec to a node agent and the agent's execute
+    spans ride task_finished back: a task executed on a remote agent still
+    lands a worker-side execute span under the head-side task root."""
+    from test_multihost import _spawn_agent, _wait_for_nodes
+
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        address = cluster.start_head_service()
+        proc = _spawn_agent(address)
+        try:
+            _wait_for_nodes(cluster, 2)
+
+            @rt.remote(resources={"remote": 1}, execution="process")
+            def afar(i):
+                return i * 3
+
+            assert rt.get([afar.remote(i) for i in range(3)], timeout=60) == [0, 3, 6]
+            deadline = time.monotonic() + 15
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                spans = [s for s in _span_events() if "afar" in s["name"]]
+                roots = {s["span_id"]: s for s in spans if s["name"].startswith("task::")}
+                ok = any(
+                    s["name"].startswith("execute::")
+                    and s["pid"] != os.getpid()
+                    and roots.get(s["parent_id"], {}).get("pid") == os.getpid()
+                    for s in spans
+                )
+                if not ok:
+                    time.sleep(0.2)
+            assert ok, "no agent-side execute span reached the head's span store"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+    finally:
+        rt.shutdown()
+
+
+def test_tracing_disabled_stamps_nothing():
+    rt.init(num_cpus=1, _system_config={"tracing_enabled": False})
+    try:
+        @rt.remote
+        def f():
+            return 1
+
+        assert rt.get(f.remote()) == 1
+        assert _span_events() == []
+    finally:
+        rt.shutdown()
+
+
+# ----------------------------------------------------------------------
+# CLI smoke: metrics + timeline --tracing against a live dashboard
+# ----------------------------------------------------------------------
+def test_cli_metrics_and_tracing_timeline(tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    rt.init(num_cpus=2, include_dashboard=True)
+    try:
+        url = rt.get_cluster().dashboard.url
+
+        @rt.remote(execution="process")
+        def job(x):
+            return x
+
+        rt.get([job.remote(i) for i in range(3)])
+        time.sleep(0.3)
+
+        assert main(["metrics", "--address", url]) == 0
+        out = capsys.readouterr().out
+        assert "# TYPE ray_tpu_tasks_terminal_total counter" in out
+        assert "ray_tpu_scheduler_tasks_dispatched_total" in out
+
+        out_file = tmp_path / "trace.json"
+        assert main(["timeline", "--tracing", "--address", url, "-o", str(out_file)]) == 0
+        trace = json.loads(out_file.read_text())
+        span_slices = [e for e in trace if e.get("cat") == "span"]
+        assert span_slices, "timeline --tracing carried no spans"
+        assert any(e["pid"].startswith("trace:") for e in span_slices)
+
+        # without the flag, spans stay out of the dump
+        plain_file = tmp_path / "plain.json"
+        assert main(["timeline", "--address", url, "-o", str(plain_file)]) == 0
+        plain = json.loads(plain_file.read_text())
+        assert not [e for e in plain if e.get("cat") == "span"]
+    finally:
+        rt.shutdown()
