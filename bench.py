@@ -166,6 +166,8 @@ def main() -> None:
         finally:
             rt.shutdown()
 
+    capture_policy = {}
+
     # The shared CI box swings +/-40% run to run on the fastest
     # single-submitter rows; one unlucky window must not ship as the
     # artifact (VERDICT r3 weak #2's prescription: re-run the worst row N
@@ -185,6 +187,31 @@ def main() -> None:
         med = sorted(samples)[len(samples) // 2]
         progress(f"{noisy} (median of {len(samples)})", med, results[noisy][1])
         results[noisy] = (med, results[noisy][1])
+        capture_policy[noisy] = "median-of-3"
+
+    # Multi-process rows are a scheduling LOTTERY on the 1-core box (PERF.md:
+    # +/-2x between same-code runs — every submitter, server and the runtime
+    # share one core). Capture policy (VERDICT r5 next-round #9): BEST of 3
+    # fresh-runtime runs — with variance that is pure contention noise, the
+    # max is the closest observable to what the code can do, and it is the
+    # number the QUOTA_SCALING.json linearity curve is judged against.
+    # Documented in PERF.md ("Capture policy").
+    for lottery in (
+        "1_n_actor_calls_async",
+        "n_n_actor_calls_async",
+        "multi_client_tasks_async",
+    ):
+        samples = [results[lottery][0]]
+        for _ in range(2):
+            rt.init(num_cpus=4)
+            try:
+                samples.append(run_suite(rt, select=[lottery])[lottery][0])
+            finally:
+                rt.shutdown()
+        best = max(samples)
+        progress(f"{lottery} (best of {len(samples)})", best, results[lottery][1])
+        results[lottery] = (best, results[lottery][1])
+        capture_policy[lottery] = "best-of-3"
     print("# model_train_step (MFU)...", file=sys.stderr, flush=True)
 
     extra = {}
@@ -195,6 +222,8 @@ def main() -> None:
         base = BASELINES.get(name)
         if base is not None:
             row["vs_baseline"] = round(value / base[0], 2)
+        if name in capture_policy:
+            row["capture"] = capture_policy[name]
         if name == "hbm_get_gigabytes" and value < 0.5:
             row["note"] = (
                 "tunnel-limited: every device->host read crosses the CI "

@@ -64,6 +64,15 @@ def init(
             cfg = Config().apply_env_overrides()
             cfg.apply_dict(_system_config)
             set_config(cfg)
+        if get_config().failpoints:
+            # deterministic chaos: arm the configured failpoints for this
+            # session (disarmed again at shutdown); agents adopt the same
+            # spec+seed at registration, workers via the inherited env var
+            from ray_tpu.runtime import failpoints
+
+            failpoints.arm(
+                get_config().failpoints, seed=get_config().failpoint_seed
+            )
         node_resources = dict(resources or {})
         node_resources["CPU"] = num_cpus if num_cpus is not None else (os.cpu_count() or 4)
         if "TPU" not in node_resources:
@@ -137,7 +146,11 @@ def shutdown() -> None:
             _cluster.shutdown()
         finally:
             from ray_tpu.observability import tracing
+            from ray_tpu.runtime import failpoints
 
+            # chaos is per-session: a spec armed for this runtime must not
+            # leak faults into the next init in this process
+            failpoints.disarm()
             tracing.set_span_sink(None)
             if _cluster.core_worker is not None:
                 _cluster.core_worker.ref_counter.stop()

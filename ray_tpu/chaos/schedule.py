@@ -1,0 +1,114 @@
+"""Declarative chaos schedules: a seeded timeline of fault events.
+
+A schedule is the reproducible half of a chaos run — ``(seed, schedule)``
+fully determines which faults fire (see the determinism contract in
+``runtime/failpoints.py``).  Schedules are plain data, JSON round-trippable,
+so a failing run is shipped and replayed as a file::
+
+    {
+      "seed": 42,
+      "events": [
+        {"t": 0.0, "kind": "arm",       "spec": "data_plane.send_frame=drop(0.2)"},
+        {"t": 1.0, "kind": "partition", "fp": "agent.heartbeat", "duration": 3.0},
+        {"t": 2.0, "kind": "kill_node", "index": 1},
+        {"t": 2.5, "kind": "lose_objects", "fraction": 0.5},
+        {"t": 3.0, "kind": "disarm"}
+      ]
+    }
+
+Event kinds
+-----------
+``arm``           arm failpoints from ``spec`` (merges; see failpoints.arm).
+``disarm``        disarm ``name`` (one failpoint) or everything.
+``partition``     arm ``fp`` at probability 1.0 for ``duration`` seconds,
+                  then restore whatever was armed before — a timed network
+                  partition of that site.
+``kill_node``     kill the ``index``-th live non-head node through the
+                  existing ``cluster.kill_node`` chaos hook
+                  (NodeKillerActor parity).
+``lose_objects``  delete a seeded ``fraction`` of committed objects from
+                  every store and kick lineage reconstruction — the
+                  "silent storage loss" failure mode.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+_KINDS = ("arm", "disarm", "partition", "kill_node", "lose_objects")
+
+
+class ChaosEvent:
+    __slots__ = ("t", "kind", "params")
+
+    def __init__(self, t: float, kind: str, **params: Any):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown chaos event kind {kind!r} (expected one of {_KINDS})")
+        self.t = float(t)
+        self.kind = kind
+        self.params = params
+
+    def to_dict(self) -> dict:
+        return {"t": self.t, "kind": self.kind, **self.params}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosEvent":
+        d = dict(d)
+        t = d.pop("t", 0.0)
+        kind = d.pop("kind")
+        return cls(t, kind, **d)
+
+    def __repr__(self):
+        return f"ChaosEvent(t={self.t}, kind={self.kind!r}, {self.params})"
+
+
+class ChaosSchedule:
+    """An ordered fault timeline plus the decision-stream seed."""
+
+    def __init__(self, events: List[ChaosEvent], seed: int = 0, name: str = ""):
+        self.events = sorted(events, key=lambda e: e.t)
+        self.seed = int(seed)
+        self.name = name
+
+    # ------------------------------------------------------------- codec
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {"seed": self.seed, "events": [e.to_dict() for e in self.events]}
+        if self.name:
+            out["name"] = self.name
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosSchedule":
+        return cls(
+            [ChaosEvent.from_dict(e) for e in d.get("events", [])],
+            seed=d.get("seed", 0),
+            name=d.get("name", ""),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ChaosSchedule":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def load(cls, path: str, seed: Optional[int] = None) -> "ChaosSchedule":
+        with open(path) as f:
+            sched = cls.from_json(f.read())
+        if seed is not None:
+            sched.seed = int(seed)
+        return sched
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    def duration(self) -> float:
+        """Timeline span including partition windows (the runner keeps
+        walking until every timed window has closed)."""
+        end = 0.0
+        for e in self.events:
+            end = max(end, e.t + float(e.params.get("duration", 0.0)))
+        return end

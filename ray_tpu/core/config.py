@@ -107,6 +107,18 @@ class Config:
     # Automatically lower array-typed remote fns to jax.jit.
     auto_jit_array_tasks: bool = True
 
+    # ---- failpoints / chaos ----------------------------------------------
+    # Deterministic fault-injection spec (runtime/failpoints.py), e.g.
+    # "data_plane.send_frame=drop(0.05);rpc.call=delay(0.2,0.5)".  Empty =
+    # everything disarmed (the near-zero-cost default).  The env form
+    # (RAY_TPU_FAILPOINTS) is inherited by worker processes and the config
+    # form propagates to node agents at registration, so one spec covers
+    # the whole fabric.
+    failpoints: str = ""
+    # Seed of the failpoint decision stream: same (seed, spec, workload) ->
+    # byte-for-byte identical fault log (failpoints.fault_log()).
+    failpoint_seed: int = 0
+
     # ---- events / tracing ------------------------------------------------
     task_events_enabled: bool = True
     # Bounded task-event store size (reference GcsTaskManager eviction).

@@ -209,8 +209,10 @@ class DataIterator:
     ):
         """Torch IterableDataset of ``(features, label)`` tuples (parity:
         iterator.py:485).  ``feature_columns`` as a list of names packs one
-        ``[B, F]`` tensor; a dict of name-lists yields a dict of tensors;
-        None packs every non-label column."""
+        ``[B, F]`` tensor; a list of name-lists yields a LIST of per-group
+        tensors (with ``feature_column_dtypes`` then one dtype per group);
+        a dict of name-lists yields a dict of tensors; None packs every
+        non-label column (non-numeric columns are dropped with a warning)."""
         import torch
 
         it = self
@@ -223,24 +225,45 @@ class DataIterator:
                 "dict feature_columns (the index would reset per group) — "
                 "use a {column: dtype} dict"
             )
+        grouped = False
+        if isinstance(feature_columns, (list, tuple)) and feature_columns:
+            nested = [isinstance(c, (list, tuple)) for c in feature_columns]
+            if all(nested):
+                grouped = True  # List[List[str]]: one tensor per group
+            elif any(nested):
+                raise ValueError(
+                    "to_torch: feature_columns mixes column names and "
+                    "nested lists — use all strings (one [B, F] tensor), "
+                    "all lists (a list of per-group tensors), or a dict of "
+                    "lists (a dict of tensors)"
+                )
+        if grouped and isinstance(feature_column_dtypes, (list, tuple)) and len(
+            feature_column_dtypes
+        ) != len(feature_columns):
+            raise ValueError(
+                "to_torch: with List[List[str]] feature_columns, "
+                "feature_column_dtypes needs one dtype per group "
+                f"({len(feature_column_dtypes)} entries for "
+                f"{len(feature_columns)} groups)"
+            )
 
-        def _features(batch, cols):
+        def _features(batch, cols, dtypes):
             ts = []
             for j, c in enumerate(cols):
                 t = torch.as_tensor(batch[c])
-                if feature_column_dtypes is not None:
-                    if isinstance(feature_column_dtypes, dict):
-                        dt = feature_column_dtypes.get(c)
-                    elif isinstance(feature_column_dtypes, (list, tuple)):
-                        if len(feature_column_dtypes) != len(cols):
+                if dtypes is not None:
+                    if isinstance(dtypes, dict):
+                        dt = dtypes.get(c)
+                    elif isinstance(dtypes, (list, tuple)):
+                        if len(dtypes) != len(cols):
                             raise ValueError(
                                 "to_torch: feature_column_dtypes has "
-                                f"{len(feature_column_dtypes)} entries for "
+                                f"{len(dtypes)} entries for "
                                 f"{len(cols)} feature columns"
                             )
-                        dt = feature_column_dtypes[j]  # positional, parity
+                        dt = dtypes[j]  # positional, parity
                     else:
-                        dt = feature_column_dtypes
+                        dt = dtypes
                     if dt is not None:
                         t = t.to(dt)
                 if t.dim() == 1 and unsqueeze_feature_tensors:
@@ -267,6 +290,7 @@ class DataIterator:
                 )
                 if prefetch_batches and prefetch_batches > 0:
                     source = _prefetch(source, prefetch_batches)
+                warned_dropped = False  # default-selection drop warns once
                 for batch in source:
                     label = None
                     if label_column is not None:
@@ -277,22 +301,47 @@ class DataIterator:
                             label = label.unsqueeze(1)
                     if isinstance(feature_columns, dict):
                         feats = {
-                            k: _features(batch, cols)
+                            k: _features(batch, cols, feature_column_dtypes)
                             for k, cols in feature_columns.items()
                         }
+                    elif grouped:
+                        feats = [
+                            _features(
+                                batch, list(cols),
+                                feature_column_dtypes[gi]
+                                if isinstance(feature_column_dtypes, (list, tuple))
+                                else feature_column_dtypes,
+                            )
+                            for gi, cols in enumerate(feature_columns)
+                        ]
                     else:
                         import numpy as _np
 
-                        # default selection skips non-numeric (id/text)
-                        # columns, matching iter_torch_batches above
-                        cols = feature_columns or [
-                            c
-                            for c in batch.keys()
-                            if c != label_column
-                            # skip non-numeric (object/str/bytes) columns
-                            and _np.asarray(batch[c]).dtype.kind not in "OUS"
-                        ]
-                        feats = _features(batch, cols)
+                        cols = feature_columns
+                        if not cols:
+                            # default selection skips non-numeric (id/text)
+                            # columns — loudly: silently thinner feature
+                            # tensors are a training bug nobody can see
+                            cols, dropped = [], []
+                            for c in batch.keys():
+                                if c == label_column:
+                                    continue
+                                if _np.asarray(batch[c]).dtype.kind in "OUS":
+                                    dropped.append(c)
+                                else:
+                                    cols.append(c)
+                            if dropped and not warned_dropped:
+                                import warnings
+
+                                warned_dropped = True
+                                warnings.warn(
+                                    "to_torch: default feature selection "
+                                    f"dropped non-numeric column(s) {dropped}; "
+                                    "pass feature_columns explicitly to choose "
+                                    "(or encode) them",
+                                    stacklevel=2,
+                                )
+                        feats = _features(batch, cols, feature_column_dtypes)
                     yield feats, label
 
         return _IterableDS()

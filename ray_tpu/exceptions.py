@@ -125,3 +125,33 @@ class CollectiveGroupDeadError(RayTpuError):
         super().__init__(
             f"collective group {group_name!r} lost a participant: {reason or 'rank died'}"
         )
+
+
+def raised_copy(exc: BaseException) -> BaseException:
+    """A fresh copy of a STORED exception, for raising at a caller.
+
+    Error objects live in the object store (error tombstones, failed-task
+    returns) and are served to every getter.  Raising the stored object
+    itself attaches each caller's traceback to it — the store entry then
+    pins those frames (and every local they reference: ref lists, values)
+    for as long as the object lives.  Found by the chaos invariant sweep as
+    a refcount "leak" after fault runs; the reference avoids it by
+    reconstructing exceptions from their serialized form on every get.
+    Falls back to the original object if the copy fails (uncopyable custom
+    exception) — correctness over hygiene.
+    """
+    import copy
+
+    try:
+        fresh = copy.copy(exc)
+        # copy re-invokes __init__ with args=(message,), which re-formats
+        # classes that build their message from a non-message first arg —
+        # restore the original args so str(copy) == str(original)
+        fresh.args = exc.args
+        fresh.__traceback__ = None
+        # keep the cause chain visible without sharing OUR traceback back
+        # into the stored object
+        fresh.__cause__ = exc.__cause__
+        return fresh
+    except Exception:  # noqa: BLE001
+        return exc

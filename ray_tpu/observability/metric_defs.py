@@ -140,6 +140,12 @@ SERVE_ROUTER_INFLIGHT = _reg.gauge(
     "serve_router_inflight", "Requests in flight to replicas, by deployment.", "requests"
 )
 
+# ---- chaos / fault injection ---------------------------------------------
+CHAOS_FAULTS_INJECTED = _reg.counter(
+    "chaos_faults_injected_total",
+    "Faults injected by armed failpoints, by failpoint name and action.",
+)
+
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
     "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
@@ -179,6 +185,7 @@ ALL_METRICS = [
     SERVE_ROUTER_REQUESTS,
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,
+    CHAOS_FAULTS_INJECTED,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

@@ -722,6 +722,12 @@ class NodeAgent:
         cfg = Config()
         cfg.apply_dict({k: v for k, v in reply.get("config", {}).items() if hasattr(cfg, k)})
         set_config(cfg)
+        if cfg.failpoints:
+            # the head's chaos spec covers the whole fabric: arm the same
+            # failpoints (and decision seed) in this agent process
+            from ray_tpu.runtime import failpoints
+
+            failpoints.arm(cfg.failpoints, seed=cfg.failpoint_seed)
 
     def _flush_logs(self) -> None:
         with self._log_lock:
@@ -881,7 +887,22 @@ class NodeAgent:
         sampler = SystemSampler()
         period = max(0.02, get_config().resource_sync_period_s)
         last_sample = 0.0
+        chaos_sent = 0  # fault-log shipping cursor (append-only log)
+        from ray_tpu.runtime import failpoints
+
         while not self._stop.is_set() and not conn.closed:
+            if failpoints.ARMED:
+                # chaos: a dropped/partitioned heartbeat skips this tick's
+                # report entirely — enough consecutive drops and the head's
+                # health checker declares this node dead (the exact flaky-
+                # agent failure mode the recovery path must survive)
+                try:
+                    action = failpoints.fp("agent.heartbeat")
+                except failpoints.FailpointInjected:
+                    action = "drop"
+                if action is not None:
+                    self._stop.wait(period)
+                    continue
             try:
                 pool = self.node.pool
                 report = {
@@ -910,6 +931,18 @@ class NodeAgent:
                         }
                     except Exception:  # noqa: BLE001 — stats must not kill reports
                         pass
+                    # armed chaos: ship this agent's fault-log TAIL so the
+                    # head can audit a multihost chaos run. Cursor-based —
+                    # the log only appends, and re-serializing the whole
+                    # run every tick would grow heartbeat frames O(n)
+                    if failpoints.ARMED:
+                        try:
+                            tail = failpoints.raw_log(chaos_sent)
+                            if tail:
+                                report["chaos_faults"] = tail
+                                chaos_sent += len(tail)
+                        except Exception:  # noqa: BLE001
+                            pass
                     # shm-arena occupancy: the arena lives in THIS process,
                     # so the head's /api/memory can only see it by piggyback
                     if self.shm_store is not None:
@@ -1053,6 +1086,13 @@ def main(argv=None) -> int:
     # (reference: accelerators/tpu.py worker-id detection).
     if "TPU_WORKER_ID" in os.environ and "ray_tpu.io/worker-index" not in labels:
         labels["ray_tpu.io/worker-index"] = os.environ["TPU_WORKER_ID"]
+
+    # chaos: a RAY_TPU_FAILPOINTS spec on the agent's environment arms this
+    # process even before registration (the head's config-borne spec, if
+    # any, merges in at _adopt_config)
+    from ray_tpu.runtime import failpoints
+
+    failpoints.arm_from_env()
 
     agent = NodeAgent(args.address, resources, labels=labels)
     # graceful SIGTERM: unlink the shm arena and leave the cluster cleanly

@@ -40,6 +40,7 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.observability import metric_defs, tracing
+from ray_tpu.runtime import failpoints
 from ray_tpu.runtime.control import ActorState, ControlService, NodeInfo
 from ray_tpu.runtime.node import Node
 from ray_tpu.runtime.scheduler import ClusterScheduler, TaskSpec
@@ -373,6 +374,7 @@ class Cluster:
                 if spec.num_returns != "streaming" and self.task_manager.should_retry(
                     spec, is_system_error=True
                 ):
+                    self._emit_retry_span(spec)
                     self.submit(spec)
                 else:
                     self.task_manager.mark_failed(spec)
@@ -786,6 +788,20 @@ class Cluster:
                 if not self.directory.locations(oid) and not self._is_pending(oid):
                     self._try_recover(oid)
                 return
+            if failpoints.ARMED:
+                # chaos: the in-process fabric's store-to-store copy IS its
+                # data plane — a dropped "frame" here retries off-thread (a
+                # Timer, not recursion: wait_for fires callbacks inline and
+                # a p=1 partition must stall the pull, not blow the stack)
+                try:
+                    action = failpoints.fp("data_plane.send_frame")
+                except failpoints.FailpointInjected:
+                    action = "drop"
+                if action is not None:
+                    threading.Timer(
+                        0.02, self.directory.wait_for, args=(oid, on_located)
+                    ).start()
+                    return
             try:
                 value = src.store.get(oid, timeout=30)
             except Exception:
@@ -796,7 +812,18 @@ class Cluster:
             size = getattr(value, "nbytes", 0) or 0
             self.transfer_bytes += size
             self.transfer_count += 1
-            dest_node.store.put(oid, value, is_error=bool(src_info and src_info["is_error"]))
+            try:
+                if failpoints.ARMED:
+                    failpoints.fp("object_store.put")  # raise/delay
+                dest_node.store.put(oid, value, is_error=bool(src_info and src_info["is_error"]))
+            except failpoints.FailpointInjected:
+                # chaos: the destination commit failed — retry the pull
+                # off-thread; repeated failures keep consuming hit indices
+                # until the deterministic decision stream lets one through
+                threading.Timer(
+                    0.02, self.directory.wait_for, args=(oid, on_located)
+                ).start()
+                return
             self.directory.add_location(oid, dest_node.node_id)
             callback()
 
@@ -824,6 +851,7 @@ class Cluster:
             return False
         spec.retries_left = max(spec.retries_left, 1)
         spec.attempt += 1
+        self._emit_retry_span(spec)
         self.task_manager.add_pending(spec)
         self.submit(spec)
         return True
@@ -892,6 +920,7 @@ class Cluster:
             if spec._cancelled:
                 pass  # cancelled tasks never retry
             elif spec.actor_id is None and self.task_manager.should_retry(spec, is_system, retry_exceptions):
+                self._emit_retry_span(spec)
                 self.submit(spec)
                 return
             elif spec.actor_id is not None and is_system and self._maybe_retry_actor_task(spec):
@@ -943,6 +972,20 @@ class Cluster:
         # root span emitted after the puts so its interval contains them
         self._emit_task_spans(spec, "FINISHED")
         self._after_commit(spec)
+
+    def _emit_retry_span(self, spec: TaskSpec) -> None:
+        """Every retried attempt becomes a distinct ``retry::`` span in the
+        trace (chaos invariant: the span store must show each retry
+        per-attempt, so a reproduced fault schedule can be audited from the
+        timeline alone).  Instant span, parented to the task span."""
+        ctx = spec.trace_ctx
+        if ctx is None:
+            return
+        now = time.time()
+        tracing.emit_span(
+            f"retry::{spec.name}", ctx[0], ctx[1], now, now,
+            attrs={"task_id": spec.task_id.hex(), "attempt": str(spec.attempt)},
+        )
 
     def _record_task_event(self, spec: TaskSpec, node: Node, state: str) -> None:
         """TaskEventBuffer→GcsTaskManager parity (task_event_buffer.h:206):
@@ -1173,6 +1216,10 @@ class Cluster:
         state = self.control.actors.on_failure(actor_id, cause)
         if state is ActorState.RESTARTING and spec is not None:
             spec.attempt += 1
+            # restarts are retries of the creation task: each must be a
+            # distinct retry:: span or the chaos invariant sweep flags a
+            # healthy recovery as an unaccounted attempt
+            self._emit_retry_span(spec)
             self._schedule_actor_creation(spec)
         else:
             self._fail_actor_queue(actor_id, ActorDiedError(actor_id, f"The actor died: {cause}"))
@@ -1209,6 +1256,7 @@ class Cluster:
             return False
         if not self.task_manager.should_retry(spec, is_system_error=True):
             return False
+        self._emit_retry_span(spec)
         self.submit_actor_task(spec, _is_retry=True)
         return True
 

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.runtime import failpoints
+
 _LEN = struct.Struct("<Q")
 
 
@@ -87,6 +89,16 @@ def from_frames(meta: bytes, buffers: List[Any]) -> Any:
 
 
 def _send_frame(sock: socket.socket, data: bytes) -> None:
+    if failpoints.ARMED:
+        # chaos: every fault shape surfaces as ConnectionError — the exact
+        # failure the transfer paths already recover from (client: discard
+        # socket + DataPlaneError -> relay/retry; server: connection reaped)
+        try:
+            action = failpoints.fp("data_plane.send_frame")
+        except failpoints.FailpointInjected as exc:
+            raise ConnectionError(str(exc)) from None
+        if action is not None:
+            raise ConnectionError(f"failpoint data_plane.send_frame: {action}")
     sock.sendall(_LEN.pack(len(data)) + data)
 
 

@@ -37,7 +37,7 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.observability import tracing
-from ray_tpu.runtime import protocol
+from ray_tpu.runtime import failpoints, protocol
 from ray_tpu.runtime.scheduler import LocalScheduler, TaskSpec
 from ray_tpu.runtime.worker_pool import ProcessWorkerPool, WorkerHandle
 
@@ -258,6 +258,20 @@ class Node:
     # ------------------------------------------------------------------
     def _dispatch(self, spec: TaskSpec) -> None:
         spec.start_time = time.time()
+        if failpoints.ARMED:
+            # chaos: a dispatch fault surfaces as a system error so the
+            # normal retry machinery (should_retry, is_system_error=True)
+            # owns recovery — exactly what a raylet crash mid-dispatch does
+            try:
+                action = failpoints.fp("scheduler.dispatch")
+            except failpoints.FailpointInjected as exc:
+                action = str(exc)
+            if action is not None:
+                self._commit(
+                    spec, None,
+                    WorkerCrashedError(f"failpoint scheduler.dispatch: {action}"),
+                )
+                return
         if spec._cancelled:
             from ray_tpu.exceptions import TaskCancelledError
 
@@ -380,8 +394,13 @@ class Node:
             info = self.store.entry_info(v.id())
             if info is not None and info["is_error"] and isinstance(value, BaseException):
                 # Upstream failure propagates to this task's returns
-                # (reference: dependent tasks inherit RayTaskError).
-                raise value
+                # (reference: dependent tasks inherit RayTaskError).  A
+                # COPY is raised — raising the stored object would graft
+                # this frame onto it, pinning the frame for the entry's
+                # lifetime (see exceptions.raised_copy).
+                from ray_tpu.exceptions import raised_copy
+
+                raise raised_copy(value)
             return value
 
         args = tuple(resolve(a) for a in spec.args)

@@ -506,6 +506,14 @@ class RemoteNodeHandle:
             self.transfer_stats = payload["transfers"]
         if "arena" in payload:
             self.arena_stats = payload["arena"]
+        if "chaos_faults" in payload:
+            # incremental tail of the agent's deterministic fault log
+            # (failpoints.raw_log cursor), accumulated here so multihost
+            # chaos runs are auditable head-side; sort by (fp, hit) to
+            # recover the canonical fault_log order
+            if not hasattr(self, "chaos_faults"):
+                self.chaos_faults = []
+            self.chaos_faults.extend(payload["chaos_faults"])
         self.last_report = time.monotonic()
         self.cluster.control.nodes.heartbeat(
             self.node_id,

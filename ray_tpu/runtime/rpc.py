@@ -37,6 +37,7 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu.runtime import failpoints
 from ray_tpu.runtime.protocol import FrameReader, send_msg as _send_msg
 
 #: Wire-protocol version: bumped on any incompatible change to message
@@ -100,6 +101,17 @@ class RpcConnection:
     # sending
     # ------------------------------------------------------------------
     def _send_frame(self, msg_type: str, payload: dict) -> None:
+        if failpoints.ARMED:
+            # chaos: drop/partition make the frame vanish on the "wire"
+            # (one-ways are simply lost; requests hit their timeouts — a
+            # network partition as the caller experiences it); raise tears
+            # the connection down like a peer death (reconnect machinery)
+            try:
+                action = failpoints.fp("rpc.call")
+            except failpoints.FailpointInjected as exc:
+                raise OSError(str(exc)) from None
+            if action is not None:
+                return
         with self._send_lock:
             _send_msg(self._sock, msg_type, payload)
 

@@ -30,7 +30,7 @@ from ray_tpu.core.object_ref import ObjectRef, hooks
 from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.resources import ResourceSet
 from ray_tpu.core.serialization import get_context
-from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.exceptions import GetTimeoutError, raised_copy
 from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime.context import task_context
 from ray_tpu.runtime.control import ActorInfo
@@ -75,6 +75,12 @@ class CoreWorker:
         return oid
 
     def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.runtime import failpoints
+
+        if failpoints.ARMED:
+            # chaos: a put fault surfaces HERE, before any state is minted —
+            # the caller sees FailpointInjected loudly, nothing half-commits
+            failpoints.fp("object_store.put")
         oid = self.mint_put_oid()
         node = self.head_node
         node.store.put(oid, value)
@@ -248,7 +254,10 @@ class CoreWorker:
             info = node.store.entry_info(ref.id())
             if info and info["is_error"] and isinstance(value, BaseException):
                 if not fut.done():
-                    fut.set_exception(value)
+                    # never raise the STORED object: the traceback it would
+                    # accumulate pins this frame (and the caller's refs) for
+                    # the lifetime of the store entry
+                    fut.set_exception(raised_copy(value))
             else:
                 if not fut.done():
                     fut.set_result(value)
@@ -299,7 +308,7 @@ class CoreWorker:
                 value = node.store.get(oid)
                 info = node.store.entry_info(oid)
                 if info and info["is_error"] and isinstance(value, BaseException):
-                    raise value
+                    raise raised_copy(value)
                 return value
         futures = [self.get_async(r) for r in ref_list]
         values = []

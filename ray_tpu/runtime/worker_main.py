@@ -35,6 +35,12 @@ def main() -> None:
     # Workers never touch the TPU — keep jax off the device if imported.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    # chaos: a RAY_TPU_FAILPOINTS spec exported on the driver (spawn passes
+    # the environment through) arms the same failpoints in this worker
+    from ray_tpu.runtime import failpoints
+
+    failpoints.arm_from_env()
+
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     try:
         sock.connect(args.addr)

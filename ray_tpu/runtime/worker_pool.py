@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import WorkerCrashedError
 from ray_tpu.observability import metric_defs, tracing
-from ray_tpu.runtime import protocol
+from ray_tpu.runtime import failpoints, protocol
 
 # prebuilt gauge tag dicts (hot-path allocations)
 _IDLE_TAGS = {"state": "idle"}
@@ -133,12 +133,27 @@ class ProcessWorkerPool:
         for _ in range(count):
             try:
                 self._spawn()
+            except failpoints.FailpointInjected:
+                continue  # chaos: prestart is best-effort warm-up — demand
+                # growth recovers; a thread-crash traceback here reads as a
+                # real failure
             except (RuntimeError, OSError):
                 if self._shutdown:
                     return  # pool torn down mid-prestart: stand down quietly
                 raise
 
     def _spawn(self, to_idle: bool = True) -> WorkerHandle:
+        chaos_kill = False
+        if failpoints.ARMED:
+            # chaos: "raise" fails the spawn outright (the growth/backlog
+            # machinery owns recovery); "kill" lets the worker register and
+            # then kills it — an early worker crash, surfaced through the
+            # normal death handling on first contact
+            action = failpoints.fp("worker_pool.spawn")
+            if action == "kill":
+                chaos_kill = True
+            elif action is not None:
+                raise RuntimeError(f"failpoint worker_pool.spawn: {action}")
         # Hand the child the driver's full sys.path and start it with -S:
         # site processing re-runs any sitecustomize, which on TPU hosts can
         # initialize a jax/PJRT client — seconds of CPU burned per worker
@@ -211,6 +226,11 @@ class ProcessWorkerPool:
         metric_defs.WORKER_POOL_SPAWNED.inc()
         self._update_worker_gauges()
         self._watch_worker(handle)
+        if chaos_kill:
+            try:
+                proc.kill()
+            except OSError:
+                pass
         return handle
 
     def _update_worker_gauges(self) -> None:

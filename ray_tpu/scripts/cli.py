@@ -327,6 +327,12 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    from ray_tpu.chaos.runner import run_cli
+
+    return run_cli(args)
+
+
 def cmd_microbenchmark(args) -> int:
     """Microbenchmark suite (``ray microbenchmark`` parity: the ray_perf.py
     metric set, plus the TPU-native shm / host<->HBM bandwidth axes)."""
@@ -464,6 +470,23 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("status", "shutdown"):
         s = ssub.add_parser(name)
         s.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="deterministic fault injection (failpoints + seeded schedules)",
+    )
+    csub = sp.add_subparsers(dest="chaos_cmd", required=True)
+    c = csub.add_parser(
+        "run",
+        help="run a workload under a chaos schedule and check recovery "
+        "invariants; same --seed + schedule reproduces the same faults",
+    )
+    c.add_argument("--schedule", required=True, help="path to a schedule JSON (ray_tpu/chaos/schedule.py)")
+    c.add_argument("--seed", type=int, default=None, help="override the schedule's decision-stream seed")
+    c.add_argument("--workload", default="fanout", help="builtin workload: fanout|actor")
+    c.add_argument("--num-cpus", type=int, default=4)
+    c.add_argument("--timeout", type=float, default=60.0, help="quiescence/join budget seconds")
+    c.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
     sp.add_argument("--num-cpus", type=int, default=4)

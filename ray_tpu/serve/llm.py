@@ -613,7 +613,10 @@ class OpenAICompatLLMServer(LLMServer):
 
         kw = dict(
             max_tokens=int(body.get("max_tokens", 16)),
-            temperature=float(body.get("temperature", 0.0)),
+            # OpenAI semantics: absent temperature means 1.0 (sampling) —
+            # defaulting to greedy here would silently answer a different
+            # distribution than every OpenAI SDK client expects
+            temperature=float(body.get("temperature", 1.0)),
             eos_id=eos_id,
         )
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]

@@ -339,6 +339,45 @@ def test_to_torch_prefetch_shuts_down_on_early_stop():
     assert pumps() == 0  # every abandoned pump exited; no leak
 
 
+def test_to_torch_grouped_feature_columns():
+    """List[List[str]] feature_columns -> a list of per-group tensors, with
+    feature_column_dtypes as one dtype per group (ADVICE low)."""
+    import torch
+
+    ds = rd.from_items(
+        [{"a": float(i), "b": float(2 * i), "c": float(3 * i), "label": 1.0} for i in range(4)]
+    )
+    feats, label = next(iter(ds.to_torch(
+        label_column="label", feature_columns=[["a", "b"], ["c"]], batch_size=4,
+    )))
+    assert isinstance(feats, list) and len(feats) == 2
+    assert feats[0].shape == (4, 2) and feats[1].shape == (4, 1)
+    assert label.shape == (4, 1)
+    f2, _ = next(iter(ds.to_torch(
+        label_column="label", feature_columns=[["a", "b"], ["c"]],
+        feature_column_dtypes=[torch.float64, torch.float32], batch_size=4,
+    )))
+    assert f2[0].dtype == torch.float64 and f2[1].dtype == torch.float32
+    with pytest.raises(ValueError, match="one dtype per group"):
+        ds.to_torch(
+            feature_columns=[["a"], ["b"]],
+            feature_column_dtypes=[torch.float32], batch_size=4,
+        )
+    with pytest.raises(ValueError, match="mixes"):
+        ds.to_torch(feature_columns=["a", ["b"]], batch_size=4)
+
+
+def test_to_torch_warns_on_dropped_non_numeric_columns():
+    """Default feature selection must NAME the non-numeric columns it drops
+    (ADVICE low: silent drops make thinner feature tensors undiagnosable)."""
+    ds = rd.from_items(
+        [{"name": f"r{i}", "a": float(i), "label": 0.0} for i in range(4)]
+    )
+    with pytest.warns(UserWarning, match="name"):
+        feats, _ = next(iter(ds.to_torch(label_column="label", batch_size=4)))
+    assert feats.shape == (4, 1)
+
+
 def test_to_torch_skips_object_columns_and_rejects_bad_dtype_spec():
     import torch
 

@@ -1,0 +1,223 @@
+"""Failpoint fabric unit + runtime-integration tests.
+
+Covers the determinism contract (decisions are a pure function of
+``(seed, name, hit index)``), spec parsing, the disarmed fast path, the
+observability wiring (``chaos_faults_injected_total`` metric + ``fault::``
+trace events in the timeline), and each instrumented site's recovery path.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.runtime import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+# --------------------------------------------------------------------------
+# spec parsing
+# --------------------------------------------------------------------------
+def test_parse_spec_grammar():
+    spec = failpoints.parse_spec(
+        "data_plane.send_frame=drop(0.05); rpc.call=delay(0.2, 0.5),"
+        "worker_pool.spawn=kill;scheduler.dispatch=raise"
+    )
+    assert spec["data_plane.send_frame"] == {"action": "drop", "prob": 0.05, "delay_s": 0.0}
+    assert spec["rpc.call"] == {"action": "delay", "prob": 0.5, "delay_s": 0.2}
+    assert spec["worker_pool.spawn"] == {"action": "kill", "prob": 1.0, "delay_s": 0.0}
+    assert spec["scheduler.dispatch"] == {"action": "raise", "prob": 1.0, "delay_s": 0.0}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_equals_sign",
+        "a=explode",              # unknown action
+        "a=raise(2.0)",           # p out of range
+        "a=delay",                # delay needs seconds
+        "a=drop(0.5",             # unclosed paren
+        "a=raise(nan_is_not_p_)", # unparsable arg
+    ],
+)
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        failpoints.parse_spec(bad)
+
+
+# --------------------------------------------------------------------------
+# determinism
+# --------------------------------------------------------------------------
+def test_decision_is_pure_and_seed_sensitive():
+    a = [failpoints._decision(42, "fp.x", i) for i in range(200)]
+    b = [failpoints._decision(42, "fp.x", i) for i in range(200)]
+    assert a == b
+    assert a != [failpoints._decision(43, "fp.x", i) for i in range(200)]
+    assert a != [failpoints._decision(42, "fp.y", i) for i in range(200)]
+    assert all(0.0 <= v < 1.0 for v in a)
+
+
+def test_fault_log_reproducible_across_rearm():
+    def one_run():
+        failpoints.reset()
+        failpoints.arm("t.fp=drop(0.3)", seed=9)
+        hits = []
+        for _ in range(100):
+            hits.append(failpoints.fp("t.fp"))
+        log = failpoints.fault_log()
+        return hits, log
+
+    hits1, log1 = one_run()
+    hits2, log2 = one_run()
+    assert hits1 == hits2
+    assert log1 == log2
+    assert 0 < len(log1) < 100  # p=0.3 injected some, not all
+    # the log is sorted by (fp, hit) and entries carry the action
+    assert log1 == sorted(log1, key=lambda e: (e["fp"], e["hit"]))
+    assert all(e["action"] == "drop" for e in log1)
+
+
+def test_disarmed_is_noop_and_cheap():
+    assert failpoints.ARMED is False
+    assert failpoints.fp("anything.at.all") is None
+    # an armed registry only fires for registered names
+    failpoints.arm("some.fp=raise", seed=0)
+    assert failpoints.fp("other.fp") is None
+    failpoints.disarm()
+    assert failpoints.ARMED is False
+    assert failpoints.fault_log() == []  # full disarm clears the log
+
+
+def test_actions_raise_delay_and_passthrough():
+    failpoints.arm("r=raise;d=delay(0.05);x=drop;k=kill;p=partition", seed=1)
+    with pytest.raises(failpoints.FailpointInjected):
+        failpoints.fp("r")
+    t0 = time.perf_counter()
+    assert failpoints.fp("d") is None  # delay handled internally
+    assert time.perf_counter() - t0 >= 0.045
+    assert failpoints.fp("x") == "drop"
+    assert failpoints.fp("k") == "kill"
+    assert failpoints.fp("p") == "partition"
+
+
+def test_single_name_disarm_preserves_log_and_counters():
+    """Closing a partition window disarms ONE name — the run's fault log
+    must survive, and a re-arm of the same name must resume its hit index
+    stream (indices never restart mid-run)."""
+    failpoints.arm("a=drop;b=drop", seed=0)
+    failpoints.fp("a")
+    failpoints.fp("a")
+    failpoints.disarm("a")
+    assert failpoints.fault_log(), "single-name disarm must not clear the log"
+    failpoints.disarm("b")  # registry now empty — log still survives
+    assert failpoints.fault_log()
+    failpoints.arm("a=drop")
+    failpoints.fp("a")
+    hits = [e["hit"] for e in failpoints.fault_log() if e["fp"] == "a"]
+    assert hits == [0, 1, 2], hits  # resumed, not restarted
+    failpoints.disarm()  # full disarm resets everything
+    assert failpoints.fault_log() == []
+
+
+def test_metric_family_counts_injections():
+    from ray_tpu.observability import metrics
+
+    failpoints.arm("m.fp=drop", seed=0)
+    for _ in range(3):
+        failpoints.fp("m.fp")
+    text = metrics.global_registry().render_prometheus()
+    line = [
+        ln for ln in text.splitlines()
+        if ln.startswith("ray_tpu_chaos_faults_injected_total") and 'failpoint="m.fp"' in ln
+    ]
+    assert line and float(line[0].rsplit(" ", 1)[1]) >= 3
+
+
+# --------------------------------------------------------------------------
+# runtime integration per instrumented site
+# --------------------------------------------------------------------------
+def test_dispatch_fault_retries_to_success(ray_start_regular):
+    @rt.remote(max_retries=10)
+    def bump(x):
+        return x + 1
+
+    failpoints.arm("scheduler.dispatch=raise(0.5)", seed=3)
+    assert rt.get([bump.remote(i) for i in range(20)], timeout=60) == [
+        i + 1 for i in range(20)
+    ]
+    assert len(failpoints.fault_log()) > 0
+
+
+def test_dispatch_fault_exhausts_retries_loudly(ray_start_regular):
+    from ray_tpu.exceptions import WorkerCrashedError
+
+    @rt.remote(max_retries=1)
+    def bump(x):
+        return x + 1
+
+    failpoints.arm("scheduler.dispatch=raise(1.0)", seed=3)
+    with pytest.raises(WorkerCrashedError, match="scheduler.dispatch"):
+        rt.get(bump.remote(1), timeout=30)
+
+
+def test_put_fault_raises_loudly(ray_start_regular):
+    ok_ref = rt.put("before")
+    failpoints.arm("object_store.put=raise", seed=0)
+    with pytest.raises(failpoints.FailpointInjected):
+        rt.put("during")
+    failpoints.disarm()
+    assert rt.get(ok_ref) == "before"
+    assert rt.get(rt.put("after")) == "after"
+
+
+def test_worker_spawn_fault_fanout_still_completes(ray_start_regular):
+    @rt.remote(execution="process")
+    def pid_task(x):
+        import os
+
+        return (os.getpid(), x)
+
+    # warm one worker so recovery always has a drain path, then fault spawns
+    rt.get(pid_task.remote(-1))
+    failpoints.arm("worker_pool.spawn=raise(0.6)", seed=5)
+    out = rt.get([pid_task.remote(i) for i in range(12)], timeout=120)
+    assert [x for _pid, x in out] == list(range(12))
+
+
+def test_fault_events_visible_in_timeline(ray_start_regular):
+    @rt.remote(max_retries=8)
+    def bump(x):
+        return x + 1
+
+    failpoints.arm("scheduler.dispatch=raise(0.5)", seed=11)
+    rt.get([bump.remote(i) for i in range(10)], timeout=60)
+    failpoints.disarm()
+    events = rt.timeline()
+    fault_events = [e for e in events if str(e.get("name", "")).startswith("fault::")]
+    assert fault_events, "injected faults must surface as fault:: trace events"
+    ev = fault_events[0]
+    assert ev["attrs"]["failpoint"] == "scheduler.dispatch"
+    assert ev["attrs"]["action"] == "raise"
+    # and the chrome-trace rendering keeps them (rt timeline --tracing path)
+    from ray_tpu.observability.timeline import chrome_trace
+
+    slices = [s for s in chrome_trace(events) if s["name"].startswith("fault::")]
+    assert slices
+
+
+def test_shutdown_disarms_session_failpoints():
+    rt.init(num_cpus=2, _system_config={"failpoints": "t.cfg=drop", "failpoint_seed": 4})
+    try:
+        assert failpoints.configured("t.cfg") == {
+            "action": "drop", "prob": 1.0, "delay_s": 0.0,
+        }
+        assert failpoints.fp("t.cfg") == "drop"
+    finally:
+        rt.shutdown()
+    assert failpoints.ARMED is False

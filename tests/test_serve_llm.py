@@ -560,7 +560,7 @@ def oai(params):
 
 
 def test_openai_completions_envelope(oai, params):
-    body = {"model": "m", "prompt": "hi", "max_tokens": 5}
+    body = {"model": "m", "prompt": "hi", "max_tokens": 5, "temperature": 0}
     resp = oai(body)
     assert resp["object"] == "text_completion" and resp["id"].startswith("cmpl-")
     ch = resp["choices"][0]
@@ -569,7 +569,7 @@ def test_openai_completions_envelope(oai, params):
     assert resp["usage"] == {"prompt_tokens": 2, "completion_tokens": 5,
                              "total_tokens": 7}
     # token-id prompts skip the tokenizer entirely
-    resp2 = oai({"model": "m", "prompt": [3, 1, 4], "max_tokens": 3})
+    resp2 = oai({"model": "m", "prompt": [3, 1, 4], "max_tokens": 3, "temperature": 0})
     assert resp2["choices"][0]["token_ids"] == _reference(params, [3, 1, 4], 3)
 
 
@@ -592,14 +592,15 @@ def test_openai_chat_and_streaming(oai, params):
 def test_openai_stop_token_and_legacy_dispatch(oai, params):
     prompt = [3, 14, 15, 9, 2]
     t1, t2 = _reference(params, prompt, 2)
-    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": int(t2)})
+    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": int(t2),
+                "temperature": 0})
     ch = resp["choices"][0]
     if t1 != t2:
         # OpenAI semantics: the stop token is EXCLUDED from the output
         assert ch["token_ids"] == [t1] and ch["finish_reason"] == "stop"
     # streaming also excludes the stop token and reports finish "stop"
     chunks = list(oai({"model": "m", "prompt": prompt, "max_tokens": 8,
-                       "stop": int(t2), "stream": True}))
+                       "stop": int(t2), "stream": True, "temperature": 0}))
     if t1 != t2:
         assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
         toks = [c["choices"][0]["token_ids"][0] for c in chunks[:-1]]
@@ -627,7 +628,8 @@ def test_openai_over_http(params):
             lambda: (CFG, params, _Tok()), max_batch_size=2, max_seq_len=64
         )
         serve.run(app, route_prefix="/v1")
-        body = json.dumps({"model": "m", "prompt": "ab", "max_tokens": 4}).encode()
+        body = json.dumps({"model": "m", "prompt": "ab", "max_tokens": 4,
+                           "temperature": 0}).encode()
         req = urllib.request.Request(
             serve.proxy_url() + "/v1/completions", data=body,
             headers={"Content-Type": "application/json"},
@@ -659,7 +661,8 @@ def test_openai_multi_token_stop_trims_token_ids_too(oai, params):
             break
     if stop is None:
         pytest.skip("greedy continuation has no mid-text 2-char stop here")
-    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": stop})
+    resp = oai({"model": "m", "prompt": prompt, "max_tokens": 8, "stop": stop,
+                "temperature": 0})
     ch = resp["choices"][0]
     assert ch["finish_reason"] == "stop"
     # token_ids are a faithful prefix of the actual generation, and the
@@ -740,6 +743,27 @@ def test_tp_engine_with_chunked_decode_and_prefill_cache(params):
         assert eng.stats()["prefill_forwards"] == n  # memo hit on the mesh path
     finally:
         eng.shutdown()
+
+
+def test_openai_absent_temperature_defaults_to_sampling(oai):
+    """OpenAI semantics: a body without temperature means 1.0 (sampling),
+    NOT greedy — the engine must receive 1.0, and an explicit 0 must still
+    reach it untouched."""
+    captured = {}
+    orig = oai.engine.generate
+
+    def spy(prompt, **kw):
+        captured["temperature"] = kw.get("temperature")
+        return orig(prompt, **kw)
+
+    oai.engine.generate = spy
+    try:
+        oai({"model": "m", "prompt": [1, 2], "max_tokens": 2})
+        assert captured["temperature"] == 1.0
+        oai({"model": "m", "prompt": [1, 2], "max_tokens": 2, "temperature": 0})
+        assert captured["temperature"] == 0.0
+    finally:
+        oai.engine.generate = orig
 
 
 def test_openai_rejects_unsupported_sampling_params(oai, params):
