@@ -146,6 +146,9 @@ ROW_GROUPS = [
     ["single_client_put_gigabytes", "multi_client_put_gigabytes", "shm_put_gigabytes",
      "hbm_put_gigabytes", "hbm_get_gigabytes"],
     ["placement_group_create_removal"],
+    # arg-heavy cross-node tasks/s: the locality-scheduling + PullManager
+    # row (ISSUE 3). Own group — it adds a second node to the runtime.
+    ["locality_arg_tasks"],
 ]
 
 
@@ -176,6 +179,7 @@ def main() -> None:
         "1_1_actor_calls_async",
         "single_client_tasks_async",
         "single_client_tasks_and_get_batch",
+        "locality_arg_tasks",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

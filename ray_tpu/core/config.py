@@ -44,6 +44,12 @@ class Config:
     scheduler_top_k_fraction: float = 0.2
     # Max tasks dispatched per scheduling iteration.
     max_tasks_per_dispatch: int = 1000
+    # Locality-aware placement (reference: locality_with_output /
+    # LocalityAwareLeasePolicy, lease_policy.cc): for the default and SPREAD
+    # strategies, a task is steered onto the node already holding the most
+    # of its dependency bytes when that node leads the runner-up by at
+    # least this margin.  0 disables the locality stage.
+    scheduler_locality_threshold_bytes: int = 1024 * 1024
 
     # ---- workers ---------------------------------------------------------
     # CPU-task worker processes prestarted (off-thread) at node start; the
@@ -141,6 +147,14 @@ class Config:
     # Admission control: concurrent bulk transfers served/issued per process
     # (reference: PullManager admission, pull_manager.h:52).
     max_concurrent_object_transfers: int = 4
+    # PullManager admission: total bytes of in-flight dependency pulls the
+    # fabric allows before further pulls queue (reference:
+    # pull_manager.h:52 num_bytes_available_).  Pulls of unknown-size
+    # objects are admitted without charging the budget.
+    pull_manager_max_inflight_bytes: int = 1 << 30
+    # First retry delay after a failed pull source (doubles per attempt,
+    # capped at ~2s); the failed location is purged before re-resolving.
+    pull_manager_retry_backoff_s: float = 0.05
     # Worker results/args decoded from the shm arena stay as READ-ONLY
     # zero-copy views pinned until garbage-collected (plasma Get semantics,
     # plasma/client.h:62) instead of being copied out. Disable for owned,

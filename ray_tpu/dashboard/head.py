@@ -181,6 +181,8 @@ class DashboardHead:
             req._send(200, self.cluster.dump_cluster_stacks(timeout=timeout))
         elif path == "/api/transfers":
             req._send(200, self._transfer_stats())
+        elif path == "/api/pulls":
+            req._send(200, self._pull_stats())
         elif path == "/api/memory":
             req._send(200, self._memory_summary())
         elif path == "/api/data/datasets":
@@ -364,6 +366,20 @@ class DashboardHead:
                     "device": device_plane.stats.snapshot(),
                 }
         return {"nodes": nodes}
+
+    def _pull_stats(self) -> dict:
+        """`rt pulls`: the PullManager's live admission/dedup counters plus
+        the scheduler's locality hit/miss byte totals — together they answer
+        "is the cluster moving bytes it didn't have to?"."""
+        from ray_tpu.observability import metric_defs
+
+        return {
+            "pull_manager": self.cluster.pull_manager.snapshot(),
+            "locality": {
+                "hit_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "hit"}),
+                "miss_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "miss"}),
+            },
+        }
 
     def _memory_summary(self) -> dict:
         """`ray memory` role for the browser: per-node object totals broken

@@ -61,6 +61,12 @@ SCHEDULER_PLACEMENT_LATENCY = _reg.histogram(
 SCHEDULER_TASKS_DISPATCHED = _reg.counter(
     "scheduler_tasks_dispatched_total", "Tasks handed to an executor by a local scheduler."
 )
+SCHEDULER_LOCALITY_BYTES = _reg.counter(
+    "scheduler_locality_bytes_total",
+    "Dependency bytes of placed tasks, by result (hit = already local on the "
+    "chosen node, miss = must transfer). Multi-node default/SPREAD decisions only.",
+    "By",
+)
 
 # ---- object store --------------------------------------------------------
 OBJECT_STORE_PUTS = _reg.counter(
@@ -125,6 +131,28 @@ DATA_PLANE_LATENCY = _reg.histogram(
     boundaries=_LATENCY_BOUNDS,
 )
 
+# ---- pull manager --------------------------------------------------------
+PULL_MANAGER_QUEUE_DEPTH = _reg.gauge(
+    "pull_manager_queue_depth",
+    "Dependency pulls waiting for in-flight-byte admission.",
+    "pulls",
+)
+PULL_MANAGER_INFLIGHT_BYTES = _reg.gauge(
+    "pull_manager_inflight_bytes",
+    "Known bytes of admitted, not-yet-completed dependency pulls.",
+    "By",
+)
+PULL_MANAGER_DEDUP_HITS = _reg.counter(
+    "pull_manager_dedup_hits_total",
+    "Pull requests coalesced onto an already-in-flight transfer of the same "
+    "(object, destination).",
+)
+PULL_MANAGER_RETRIES = _reg.counter(
+    "pull_manager_retries_total",
+    "Pull attempts retried after a failed/stale source (the location is "
+    "purged before re-resolving).",
+)
+
 # ---- serve router --------------------------------------------------------
 SERVE_ROUTER_REQUESTS = _reg.counter(
     "serve_router_requests_total", "Requests routed to replicas, by deployment."
@@ -166,6 +194,7 @@ ALL_METRICS = [
     SCHEDULER_QUEUE_DEPTH,
     SCHEDULER_PLACEMENT_LATENCY,
     SCHEDULER_TASKS_DISPATCHED,
+    SCHEDULER_LOCALITY_BYTES,
     OBJECT_STORE_PUTS,
     OBJECT_STORE_GETS,
     OBJECT_STORE_BYTES_PUT,
@@ -182,6 +211,10 @@ ALL_METRICS = [
     DATA_PLANE_BYTES,
     DATA_PLANE_TRANSFERS,
     DATA_PLANE_LATENCY,
+    PULL_MANAGER_QUEUE_DEPTH,
+    PULL_MANAGER_INFLIGHT_BYTES,
+    PULL_MANAGER_DEDUP_HITS,
+    PULL_MANAGER_RETRIES,
     SERVE_ROUTER_REQUESTS,
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,

@@ -122,10 +122,13 @@ class AgentFabric:
         # this copy exists (device flag keeps HBM-residency tracking honest)
         from ray_tpu.runtime.device_plane import is_device_array
 
+        from ray_tpu.runtime.remote_node import _probe_nbytes
+
         try:
             self.conn.send(
                 "object_location",
-                {"oid": oid.binary(), "device": is_device_array(value)},
+                {"oid": oid.binary(), "device": is_device_array(value),
+                 "size": _probe_nbytes(value)[0]},
             )
         except rpc.RpcError:
             pass
@@ -173,6 +176,7 @@ class AgentFabric:
             # Device placement of each return rides along so the head's
             # directory records HBM residency (SURVEY §5.8).
             from ray_tpu.runtime.device_plane import is_device_array
+            from ray_tpu.runtime.remote_node import _probe_nbytes
 
             self.conn.send(
                 "task_finished",
@@ -180,6 +184,9 @@ class AgentFabric:
                     "task_id": spec.task_id.binary(), "value": None, "error": None,
                     "lazy": True,
                     "device_returns": [is_device_array(v) for v in values],
+                    # per-return sizes: the head's directory needs them for
+                    # locality scoring + pull admission without the bytes
+                    "return_sizes": [_probe_nbytes(v)[0] for v in values],
                     "spans": self._drained_spans(),
                 },
             )
@@ -231,6 +238,7 @@ class AgentFabric:
                     {
                         "task_id": spec.task_id.binary(), "index": index,
                         "lazy": True, "device": is_device_array(value),
+                        "size": approx,
                     },
                 )
                 return
@@ -373,9 +381,12 @@ class AgentFabric:
         oid = _OID(kw["oid"])
         self.node.store.put(oid, value)
         from ray_tpu.runtime.device_plane import is_device_array
+        from ray_tpu.runtime.remote_node import _probe_nbytes
 
         self.conn.send(
-            "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
+            "object_location",
+            {"oid": oid.binary(), "device": is_device_array(value),
+             "size": _probe_nbytes(value)[0]},
         )
         self.conn.send(
             "worker_api_async",
@@ -408,9 +419,12 @@ class AgentFabric:
         try:
             self.node.store.put(oid, value)
             from ray_tpu.runtime.device_plane import is_device_array
+            from ray_tpu.runtime.remote_node import _probe_nbytes
 
             self.conn.send(
-                "object_location", {"oid": oid.binary(), "device": is_device_array(value)}
+                "object_location",
+                {"oid": oid.binary(), "device": is_device_array(value),
+                 "size": _probe_nbytes(value)[0]},
             )
         except BaseException:
             # minted but not committed: unpin on the head and drop the local

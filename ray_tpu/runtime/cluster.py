@@ -26,7 +26,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID
@@ -40,22 +40,32 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.observability import metric_defs, tracing
-from ray_tpu.runtime import failpoints
 from ray_tpu.runtime.control import ActorState, ControlService, NodeInfo
 from ray_tpu.runtime.node import Node
 from ray_tpu.runtime.scheduler import ClusterScheduler, TaskSpec
 
 
 class ObjectDirectory:
-    """object id -> node locations, with waiters for not-yet-created objects."""
+    """object id -> node locations, with waiters for not-yet-created objects.
+
+    Beside locations it records per-object SIZE and TIER (host / device /
+    shm / disk) captured at commit time — the inputs the locality stage of
+    :meth:`ClusterScheduler.pick_node` sums per node (reference: the object
+    directory feeds LocalityAwareLeasePolicy, ``lease_policy.cc``) and the
+    PullManager charges against its in-flight-byte budget."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
         self._waiters: Dict[ObjectID, List[Callable[[NodeID], None]]] = {}
         # oids whose primary copy is DEVICE-resident (HBM) at its location —
-        # SURVEY §5.8: device placement recorded in the object directory
+        # SURVEY §5.8: device placement recorded in the object directory.
+        # This set IS the tier record: device vs host; finer tiering (shm /
+        # disk) is a per-store detail copies don't share, so storing one
+        # tier per oid would lie as soon as a second copy lands elsewhere.
         self._device: Set[ObjectID] = set()
+        # oid -> payload size in bytes, captured when a copy commits
+        self._meta: Dict[ObjectID, int] = {}
 
     def mark_device(self, oid: ObjectID) -> None:
         with self._lock:
@@ -65,9 +75,54 @@ class ObjectDirectory:
         with self._lock:
             return oid in self._device
 
-    def add_location(self, oid: ObjectID, node_id: NodeID) -> None:
+    def record_meta(self, oid: ObjectID, size: int, tier: str = "host") -> None:
+        """Record payload size without touching locations (used when
+        metadata arrives separately from the location notice, e.g. the wire
+        protocol's lazy commits).  ``tier == "device"`` also sets the
+        HBM-residency flag."""
+        if not size:
+            return
+        with self._lock:
+            self._meta[oid] = int(size)
+            if tier == "device":
+                self._device.add(oid)
+
+    def object_size(self, oid: ObjectID) -> int:
+        with self._lock:
+            return self._meta.get(oid, 0)
+
+    def local_bytes(self, oids) -> Dict[NodeID, int]:
+        """Per-node sum of known sizes of the given objects."""
+        return self.locality_view(oids)[0]
+
+    def locality_view(self, oids) -> Tuple[Dict[NodeID, int], int]:
+        """One lock pass over ``oids``: (per-node local bytes, total known
+        bytes) — the inputs of the scheduler's locality stage."""
+        out: Dict[NodeID, int] = {}
+        total = 0
+        with self._lock:
+            for oid in oids:
+                size = self._meta.get(oid)
+                if size is None:
+                    continue
+                total += size
+                for node_id in self._locations.get(oid, ()):
+                    out[node_id] = out.get(node_id, 0) + size
+        return out, total
+
+    def add_location(
+        self,
+        oid: ObjectID,
+        node_id: NodeID,
+        size: Optional[int] = None,
+        tier: Optional[str] = None,
+    ) -> None:
         with self._lock:
             self._locations.setdefault(oid, set()).add(node_id)
+            if size:
+                self._meta[oid] = int(size)
+                if tier == "device":
+                    self._device.add(oid)
             waiters = self._waiters.pop(oid, [])
         for cb in waiters:
             cb(node_id)
@@ -102,12 +157,14 @@ class ObjectDirectory:
                     lost.append(oid)
             for oid in lost:
                 del self._locations[oid]
+                self._meta.pop(oid, None)
         return lost
 
     def forget(self, oid: ObjectID) -> None:
         with self._lock:
             self._locations.pop(oid, None)
             self._device.discard(oid)
+            self._meta.pop(oid, None)
             waiters = self._waiters.pop(oid, None)
         # Fire waiters with None (object out of scope) instead of dropping
         # them: a silently-dropped waiter is a leak for ready-hooks (serve
@@ -122,13 +179,14 @@ class ObjectDirectory:
 class _ActorQueue:
     """Per-actor ordered send queue (head-of-line blocking on dep pulls)."""
 
-    __slots__ = ("pending", "lock", "alive", "next_seq")
+    __slots__ = ("pending", "lock", "alive", "next_seq", "prefetched_seq")
 
     def __init__(self):
         self.pending: deque = deque()   # [spec, ready: bool]
         self.lock = threading.Lock()
         self.alive = False
         self.next_seq = 0               # per-actor submission order stamp
+        self.prefetched_seq = -1        # dep-prefetch cursor (pump backlog)
 
 
 class Cluster:
@@ -163,7 +221,16 @@ class Cluster:
             self._snapshot_thread.start()
         self.cluster_scheduler = ClusterScheduler()
         self.directory = ObjectDirectory()
+        # locality stage: pick_node scores candidate nodes by the dependency
+        # bytes the directory says they already hold
+        self.cluster_scheduler.bind_directory(self.directory)
         self.task_manager = TaskManager()
+        # all inbound object traffic funnels through one admission-controlled
+        # PullManager (pull_manager.h:52 parity); created lazily-free here —
+        # its worker threads spawn on first use
+        from ray_tpu.runtime.pull_manager import PullManager
+
+        self.pull_manager = PullManager(self)
         self.nodes: Dict[NodeID, Node] = {}
         self.head_node: Optional[Node] = None
         self._actor_queues: Dict[ActorID, _ActorQueue] = {}
@@ -756,81 +823,24 @@ class Cluster:
     # object pulls / transfer
     # ------------------------------------------------------------------
     def pull_object(self, oid: ObjectID, dest_node: Node, callback: Callable[[], None]) -> None:
-        if dest_node.store.contains(oid):
-            callback()
-            return
+        """All inbound object traffic funnels through the PullManager:
+        dedup of concurrent pulls, in-flight-byte admission, transfers on
+        pull workers (never directory callback threads), retry-with-purge
+        on failed sources (see runtime/pull_manager.py)."""
+        self.pull_manager.pull(oid, dest_node, callback)
 
-        def on_located(src_node_id: Optional[NodeID]) -> None:
-            if src_node_id is None:
-                # The object went out of scope while we waited. Reconstruct
-                # from lineage if possible; otherwise surface ObjectLostError
-                # to the dependent task instead of hanging it.
-                if self._try_recover(oid):
-                    self.directory.wait_for(oid, on_located)
-                    return
-                from ray_tpu.exceptions import ObjectLostError
-
-                # Local error tombstone so the dependent task fails fast; NOT
-                # registered in the directory — the object is forgotten and
-                # no other node must discover this node as a "location".
-                dest_node.store.put(oid, ObjectLostError(oid), is_error=True)
-                callback()
-                return
-            if src_node_id == dest_node.node_id:
-                callback()
-                return
-            src = self.nodes.get(src_node_id)
-            if src is None or src.dead:
-                # Stale location: purge it so the re-registered wait blocks
-                # for a fresh copy instead of looping on the dead node.
-                self.directory.remove_location(oid, src_node_id)
-                self.directory.wait_for(oid, on_located)
-                if not self.directory.locations(oid) and not self._is_pending(oid):
-                    self._try_recover(oid)
-                return
-            if failpoints.ARMED:
-                # chaos: the in-process fabric's store-to-store copy IS its
-                # data plane — a dropped "frame" here retries off-thread (a
-                # Timer, not recursion: wait_for fires callbacks inline and
-                # a p=1 partition must stall the pull, not blow the stack)
-                try:
-                    action = failpoints.fp("data_plane.send_frame")
-                except failpoints.FailpointInjected:
-                    action = "drop"
-                if action is not None:
-                    threading.Timer(
-                        0.02, self.directory.wait_for, args=(oid, on_located)
-                    ).start()
-                    return
-            try:
-                value = src.store.get(oid, timeout=30)
-            except Exception:
-                self.directory.wait_for(oid, on_located)
-                return
-            src_info = src.store.entry_info(oid)
-            # chunked-transfer accounting (object_manager 5MiB chunks parity)
-            size = getattr(value, "nbytes", 0) or 0
-            self.transfer_bytes += size
-            self.transfer_count += 1
-            try:
-                if failpoints.ARMED:
-                    failpoints.fp("object_store.put")  # raise/delay
-                dest_node.store.put(oid, value, is_error=bool(src_info and src_info["is_error"]))
-            except failpoints.FailpointInjected:
-                # chaos: the destination commit failed — retry the pull
-                # off-thread; repeated failures keep consuming hit indices
-                # until the deterministic decision stream lets one through
-                threading.Timer(
-                    0.02, self.directory.wait_for, args=(oid, on_located)
-                ).start()
-                return
-            self.directory.add_location(oid, dest_node.node_id)
-            callback()
-
-        self.directory.wait_for(oid, on_located)
-        # if nothing will ever produce it, try lineage reconstruction
-        if not self.directory.locations(oid) and not self._is_pending(oid):
-            self._try_recover(oid)
+    def commit_location(self, node, oid: ObjectID) -> None:
+        """Record a location WITH the committed entry's size/tier metadata
+        — the inputs the scheduler's locality stage and the PullManager's
+        admission control read from the directory."""
+        store = getattr(node, "store", None)
+        info = store.entry_info(oid) if store is not None else None
+        if info:
+            self.directory.add_location(
+                oid, node.node_id, size=info["size"], tier=info["tier"]
+            )
+        else:
+            self.directory.add_location(oid, node.node_id)
 
     def _is_pending(self, oid: ObjectID) -> bool:
         for spec in self.task_manager.pending_specs():
@@ -895,7 +905,7 @@ class Cluster:
                     values = [result] if spec.num_returns == 1 else list(result or [None] * spec.num_returns)
                     for oid, value in zip(spec.return_ids, values):
                         self.head_node.store.put(oid, value)
-                        self.directory.add_location(oid, self.head_node.node_id)
+                        self.commit_location(self.head_node, oid)
                     self.task_manager.mark_completed(spec)
                     self._emit_task_spans(spec, "FINISHED")
                 elif self._maybe_retry_actor_task(spec):
@@ -962,7 +972,7 @@ class Cluster:
         t_put = time.time()
         for oid, value in zip(spec.return_ids, values):
             node.store.put(oid, value)
-            self.directory.add_location(oid, node.node_id)
+            self.commit_location(node, oid)
         if spec.trace_ctx is not None and spec.return_ids:
             tracing.emit_span(
                 f"put::{spec.name}", spec.trace_ctx[0], spec.trace_ctx[1],
@@ -1072,7 +1082,7 @@ class Cluster:
             else:
                 store_node = self.head_node if node.dead else node
                 store_node.store.put(oid, value, is_error=is_error)
-                self.directory.add_location(oid, store_node.node_id)
+                self.commit_location(store_node, oid)
             spec.return_ids.append(oid)
             gen = self._streams.get(spec.task_id.binary())
             if gen is not None:
@@ -1406,6 +1416,25 @@ class Cluster:
                     break
         if needs_prep is not None:
             self._prepare_actor_entry(needs_prep)
+            # pipeline the backlog: calls QUEUED BEHIND the head start their
+            # dependency pulls now, in dispatch order, instead of one
+            # head-of-line transfer at a time (PullManager prefetch role).
+            # The cursor makes this incremental — each pump only touches
+            # calls queued since the last one, not the whole backlog again.
+            with q.lock:
+                upcoming = [
+                    e[0] for e in q.pending
+                    if not e[1]
+                    and e[0] is not needs_prep[0]
+                    and e[0].dependencies
+                    and (e[0]._actor_seq or 0) > q.prefetched_seq
+                ]
+                if upcoming:
+                    q.prefetched_seq = max(
+                        (s._actor_seq or 0) for s in upcoming
+                    )
+            for queued_spec in upcoming:
+                self.pull_manager.prefetch(queued_spec.dependencies, node)
 
     def _fail_actor_queue(self, actor_id: ActorID, error: BaseException) -> None:
         q = self._actor_queues.get(actor_id)
@@ -1442,6 +1471,7 @@ class Cluster:
         with self._demand_cv:
             self._demand_stop = True
             self._demand_cv.notify_all()
+        self.pull_manager.shutdown()
         if self._snapshot_thread is not None:
             self._snapshot_thread.join(timeout=10)
         cfg = get_config()

@@ -106,18 +106,24 @@ def _send_header(sock: socket.socket, header: dict) -> None:
     _send_frame(sock, pickle.dumps(header, protocol=5))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int):
+    """Read exactly ``n`` bytes.  Large reads (>= 1 MiB: bulk meta frames,
+    big pickle headers) go straight into one preallocated buffer via
+    recv_into — no per-chunk bytes objects and no final join() copy; every
+    consumer (pickle.loads, len, from_frames) takes the bytearray as-is."""
     if n == 0:
         return b""
+    if n >= (1 << 20):
+        return _recv_into_buffer(sock, n)
     chunks = []
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
+        chunk = sock.recv(n - got)
         if not chunk:
             raise ConnectionError("data socket closed")
         chunks.append(chunk)
         got += len(chunk)
-    return b"".join(chunks)
+    return chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
 
 def _recv_into_buffer(sock: socket.socket, size: int) -> bytearray:

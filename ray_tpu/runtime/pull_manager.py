@@ -1,0 +1,375 @@
+"""Admission-controlled dependency pulls (parity: ``pull_manager.h:52``).
+
+Every inbound object transfer in the in-process fabric funnels through one
+:class:`PullManager` owned by the cluster.  It replaces the old ad-hoc
+per-dependency copy in ``Cluster.pull_object`` with the reference
+PullManager's load-bearing properties:
+
+  * **dedup** — concurrent pulls of the same ``(object, destination)``
+    coalesce into ONE in-flight transfer with a waiter list (N consumers of
+    a shuffle block cost one copy, not N),
+  * **admission** — bytes of ACTIVE transfers are capped by
+    ``pull_manager_max_inflight_bytes``; located-but-over-budget transfers
+    queue FIFO, so a burst of bulk args cannot buffer unbounded memory on
+    the destination.  A pull idling for a not-yet-produced object holds no
+    budget — lineage recovery's own dependency pulls can never deadlock
+    behind the pull that triggered the recovery,
+  * **dedicated transfer threads** — the blocking source read
+    (``src.store.get``, which for remote sources is a chunked data-plane
+    pull) runs on a small pull-worker pool, never on directory callback
+    threads (the old path parked object-commit threads behind 30 s gets),
+  * **retry with backoff + source purge** — a failed source's location is
+    removed from the directory BEFORE re-resolving, so a wedged-but-alive
+    node is not retried in a hot loop (the old path re-waited without
+    purging), and repeated failures back off exponentially,
+  * **prefetch** — queued tasks' dependencies can be warmed in dispatch
+    order (``prefetch``), pipelining transfers behind head-of-line waits.
+
+Chaos: the ``data_plane.send_frame`` and ``object_store.put`` failpoints
+fire at the same logical points as the old path (a dropped "frame" retries
+off-thread; a failed destination commit retries off-thread), so seeded
+schedules keep reproducing.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.observability import metric_defs
+from ray_tpu.runtime import failpoints
+
+
+class _Pull:
+    """One registered transfer of an object to a destination."""
+
+    __slots__ = ("oid", "dest", "waiters", "charged", "admitted", "attempts")
+
+    def __init__(self, oid: ObjectID, dest, callback: Callable[[], None]):
+        self.oid = oid
+        self.dest = dest
+        self.waiters: List[Callable[[], None]] = [callback]
+        self.charged = 0        # bytes currently held against the budget
+        self.admitted = False   # True while a transfer attempt is budgeted
+        self.attempts = 0       # failed-source retries so far
+
+
+class PullManager:
+    def __init__(self, cluster):
+        cfg = get_config()
+        self.cluster = cluster
+        self._lock = threading.Lock()
+        self._pulls: Dict[Tuple[ObjectID, NodeID], _Pull] = {}
+        # located transfers awaiting byte budget, FIFO: (pull, src_node_id, size)
+        self._pending: "deque[Tuple[_Pull, NodeID, int]]" = deque()
+        self._inflight_bytes = 0
+        self._admitted = 0
+        self._max_inflight = max(1, cfg.pull_manager_max_inflight_bytes)
+        self._backoff_s = max(0.0, cfg.pull_manager_retry_backoff_s)
+        self._closed = False
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(1, cfg.max_concurrent_object_transfers),
+            thread_name_prefix="pull-worker",
+        )
+        # lifetime counters (snapshot() / `rt pulls`)
+        self.dedup_hits = 0
+        self.retries = 0
+        self.completed = 0
+        self.bytes_pulled = 0
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+    def pull(self, oid: ObjectID, dest_node, callback: Callable[[], None]) -> None:
+        """Ensure ``oid`` is (or becomes) readable in ``dest_node``'s store,
+        then invoke ``callback``.  Concurrent pulls of the same
+        ``(oid, dest)`` share one transfer."""
+        if dest_node.store.contains(oid):
+            callback()
+            return
+        key = (oid, dest_node.node_id)
+        with self._lock:
+            if self._closed:
+                return
+            existing = self._pulls.get(key)
+            if existing is not None:
+                existing.waiters.append(callback)
+                self.dedup_hits += 1
+                metric_defs.PULL_MANAGER_DEDUP_HITS.inc()
+                return
+            p = _Pull(oid, dest_node, callback)
+            self._pulls[key] = p
+        self._resolve(p)
+
+    def prefetch(self, oids, dest_node) -> None:
+        """Warm transfers for a queued task's dependencies (dispatch order):
+        each missing object starts a pull, so by the time the task reaches
+        the head of its queue the bytes are already moving (reference:
+        PullManager pulls for queued lease requests, not just the active
+        one).  Objects whose pull is already in flight are skipped WITHOUT
+        joining the waiter list — a prefetch needs no completion signal,
+        and repeat prefetches of a slow transfer must not grow its waiter
+        list or inflate the dedup-hit metric."""
+        for oid in oids:
+            if dest_node.store.contains(oid):
+                continue
+            with self._lock:
+                if (oid, dest_node.node_id) in self._pulls:
+                    continue
+            self.pull(oid, dest_node, _noop)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": self._admitted,
+                "queued": len(self._pending),
+                "inflight_bytes": self._inflight_bytes,
+                "max_inflight_bytes": self._max_inflight,
+                "dedup_hits": self.dedup_hits,
+                "retries": self.retries,
+                "completed": self.completed,
+                "bytes_pulled": self.bytes_pulled,
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._pulls.clear()
+            self._pending.clear()
+        # cancel_futures: queued transfers must not run against a cluster
+        # mid-teardown, and the futures atexit hook must not join workers
+        # parked in a 30 s store.get
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------
+    # admission: budget is held only while a transfer attempt is active —
+    # a pull waiting for its object to exist (or to be reconstructed)
+    # charges nothing
+    # ------------------------------------------------------------------
+    def _admit_or_queue(self, p: _Pull, src_node_id: NodeID) -> None:
+        """A source is known: start the transfer if the byte budget allows,
+        else queue it FIFO (later arrivals never jump a waiting pull)."""
+        with self._lock:
+            if self._closed:
+                return
+            size = self.cluster.directory.object_size(p.oid)
+            if not self._pending and (
+                self._admitted == 0
+                or self._inflight_bytes + size <= self._max_inflight
+            ):
+                self._charge_locked(p, size)
+            else:
+                self._pending.append((p, src_node_id, size))
+                metric_defs.PULL_MANAGER_QUEUE_DEPTH.set(len(self._pending))
+                return
+        self._submit_transfer(p, src_node_id)
+
+    def _charge_locked(self, p: _Pull, size: int) -> None:
+        p.charged = size
+        p.admitted = True
+        self._admitted += 1
+        self._inflight_bytes += size
+        metric_defs.PULL_MANAGER_INFLIGHT_BYTES.set(self._inflight_bytes)
+
+    def _uncharge(self, p: _Pull) -> None:
+        """Return p's budget and start whatever it unblocks."""
+        ready: List[Tuple[_Pull, NodeID]] = []
+        with self._lock:
+            if not p.admitted:
+                return
+            p.admitted = False
+            self._admitted = max(0, self._admitted - 1)
+            self._inflight_bytes = max(0, self._inflight_bytes - p.charged)
+            p.charged = 0
+            while self._pending and (
+                self._admitted == 0
+                or self._inflight_bytes + self._pending[0][2] <= self._max_inflight
+            ):
+                nxt, nxt_src, nxt_size = self._pending.popleft()
+                self._charge_locked(nxt, nxt_size)
+                ready.append((nxt, nxt_src))
+            metric_defs.PULL_MANAGER_INFLIGHT_BYTES.set(self._inflight_bytes)
+            metric_defs.PULL_MANAGER_QUEUE_DEPTH.set(len(self._pending))
+        for nxt, nxt_src in ready:
+            self._submit_transfer(nxt, nxt_src)
+
+    def _submit_transfer(self, p: _Pull, src_node_id: NodeID) -> None:
+        src = self.cluster.nodes.get(src_node_id)
+        if src is None or src.dead:
+            # went away while queued: purge the stale location, return the
+            # budget, and re-resolve for a fresh copy
+            self.cluster.directory.remove_location(p.oid, src_node_id)
+            self._uncharge(p)
+            self._resolve(p)
+            return
+        # the blocking read runs on a pull worker, NEVER the caller thread —
+        # callers include store-commit threads waking directory waiters
+        try:
+            self._executor.submit(self._transfer, p, src)
+        except RuntimeError:  # executor shut down mid-teardown
+            pass
+
+    def _complete(self, p: _Pull) -> None:
+        self._uncharge(p)
+        with self._lock:
+            self._pulls.pop((p.oid, p.dest.node_id), None)
+            self.completed += 1
+            waiters = list(p.waiters)
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — one waiter must not strand the rest
+                import sys
+                import traceback
+
+                print(
+                    f"ray_tpu: pull waiter for object {p.oid.hex()[:12]} -> "
+                    f"node {p.dest.node_id.hex()[:8]} raised:\n"
+                    f"{traceback.format_exc()}",
+                    file=sys.stderr,
+                )
+
+    # ------------------------------------------------------------------
+    # location resolution (event-driven; cheap — safe on commit threads)
+    # ------------------------------------------------------------------
+    def _resolve(self, p: _Pull) -> None:
+        if self._closed:
+            return
+        directory = self.cluster.directory
+        directory.wait_for(p.oid, lambda src: self._on_located(p, src))
+        # if nothing will ever produce it, try lineage reconstruction
+        if not directory.locations(p.oid) and not self.cluster._is_pending(p.oid):
+            self.cluster._try_recover(p.oid)
+
+    def _resolve_later(self, p: _Pull, delay: float) -> None:
+        timer = threading.Timer(delay, self._resolve, args=(p,))
+        timer.daemon = True
+        timer.start()
+
+    def _on_located(self, p: _Pull, src_node_id: Optional[NodeID]) -> None:
+        if self._closed:
+            return
+        cluster = self.cluster
+        if src_node_id is None:
+            # The object went out of scope while we waited.  Reconstruct
+            # from lineage if possible; otherwise surface ObjectLostError
+            # to the dependents instead of hanging them.
+            if cluster._try_recover(p.oid):
+                self._resolve(p)
+                return
+            from ray_tpu.exceptions import ObjectLostError
+
+            # Local error tombstone so dependent tasks fail fast; NOT
+            # registered in the directory — the object is forgotten and no
+            # other node must discover this node as a "location".
+            p.dest.store.put(p.oid, ObjectLostError(p.oid), is_error=True)
+            self._complete(p)
+            return
+        if src_node_id == p.dest.node_id:
+            self._complete(p)
+            return
+        self._admit_or_queue(p, src_node_id)
+
+    # ------------------------------------------------------------------
+    # the transfer itself (pull-worker threads only)
+    # ------------------------------------------------------------------
+    def _transfer(self, p: _Pull, src) -> None:
+        try:
+            self._transfer_inner(p, src)
+        except Exception:  # noqa: BLE001 — NOTHING may leak budget/waiters
+            # an unexpected failure (dest store MemoryError/arena-full,
+            # entry_info race, directory error) must not strand the pull:
+            # return the budget, report loudly, and retry with backoff —
+            # a transient condition (memory pressure spilling) clears, a
+            # permanent one shows up in the log instead of as silence
+            import sys
+            import traceback
+
+            print(
+                f"ray_tpu: pull of object {p.oid.hex()[:12]} -> node "
+                f"{p.dest.node_id.hex()[:8]} failed unexpectedly:\n"
+                f"{traceback.format_exc()}",
+                file=sys.stderr,
+            )
+            with self._lock:
+                self.retries += 1
+            metric_defs.PULL_MANAGER_RETRIES.inc()
+            p.attempts += 1
+            self._uncharge(p)
+            delay = min(self._backoff_s * (2 ** (p.attempts - 1)), 2.0)
+            self._resolve_later(p, max(delay, 0.001))
+
+    def _transfer_inner(self, p: _Pull, src) -> None:
+        if self._closed:
+            return  # teardown: cluster state is going away under us
+        cluster = self.cluster
+        if p.dest.store.contains(p.oid):
+            self._complete(p)
+            return
+        if failpoints.ARMED:
+            # chaos: the in-process fabric's store-to-store copy IS its
+            # data plane — a dropped "frame" here retries off-thread (a
+            # Timer, not recursion: a p=1 partition must stall the pull,
+            # not blow the stack or spin a worker)
+            try:
+                action = failpoints.fp("data_plane.send_frame")
+            except failpoints.FailpointInjected:
+                action = "drop"
+            if action is not None:
+                self._uncharge(p)
+                self._resolve_later(p, 0.02)
+                return
+        try:
+            value = src.store.get(p.oid, timeout=30)
+        except Exception:  # noqa: BLE001 — wedged/emptied source
+            # purge the failed location FIRST: without it a wedged-but-alive
+            # source is retried in a hot loop forever (the pre-PullManager
+            # bug); backoff doubles per attempt so a flapping source costs
+            # bounded churn.  The budget returns while we back off.
+            cluster.directory.remove_location(p.oid, src.node_id)
+            with self._lock:
+                self.retries += 1
+            metric_defs.PULL_MANAGER_RETRIES.inc()
+            p.attempts += 1
+            self._uncharge(p)
+            delay = min(self._backoff_s * (2 ** (p.attempts - 1)), 2.0)
+            self._resolve_later(p, max(delay, 0.001))
+            if not cluster.directory.locations(p.oid) and not cluster._is_pending(p.oid):
+                cluster._try_recover(p.oid)
+            return
+        src_info = src.store.entry_info(p.oid)
+        size = getattr(value, "nbytes", 0) or 0
+        try:
+            if failpoints.ARMED:
+                failpoints.fp("object_store.put")  # raise/delay
+            p.dest.store.put(
+                p.oid, value, is_error=bool(src_info and src_info["is_error"])
+            )
+        except failpoints.FailpointInjected:
+            # chaos: the destination commit failed — retry off-thread;
+            # repeated failures keep consuming hit indices until the
+            # deterministic decision stream lets one through
+            self._uncharge(p)
+            self._resolve_later(p, 0.02)
+            return
+        # chunked-transfer accounting (object_manager 5MiB chunks parity);
+        # under the manager lock — multiple pull workers commit concurrently
+        with self._lock:
+            cluster.transfer_bytes += size
+            cluster.transfer_count += 1
+            self.bytes_pulled += size
+        dest_info = p.dest.store.entry_info(p.oid)
+        cluster.directory.add_location(
+            p.oid, p.dest.node_id,
+            size=dest_info["size"] if dest_info else None,
+            tier=dest_info["tier"] if dest_info else None,
+        )
+        self._complete(p)
+
+
+def _noop() -> None:
+    pass

@@ -420,10 +420,19 @@ class RemoteNodeHandle:
         elif payload.get("lazy"):
             # bulk result: bytes stayed on the agent; commit location-only
             # and let consumers pull peer-to-peer on demand.  HBM-resident
-            # returns are flagged in the directory (SURVEY §5.8).
-            for oid, on_device in zip(spec.return_ids, payload.get("device_returns", ())):
+            # returns are flagged in the directory (SURVEY §5.8); sizes
+            # ride the notice so locality scoring and pull admission know
+            # the payload weight without the payload.
+            device_returns = list(payload.get("device_returns", ()))
+            sizes = list(payload.get("return_sizes", ()))
+            for i, oid in enumerate(spec.return_ids):
+                on_device = bool(device_returns[i]) if i < len(device_returns) else False
                 if on_device:
                     self.cluster.directory.mark_device(oid)
+                if i < len(sizes) and sizes[i]:
+                    self.cluster.directory.record_meta(
+                        oid, sizes[i], "device" if on_device else "host"
+                    )
             self.cluster.on_task_finished(self, spec, None, None, lazy=True)
             return
         else:
@@ -453,9 +462,15 @@ class RemoteNodeHandle:
             return
         if payload.get("lazy"):
             # bulk item stayed on the agent: location-only commit
+            item_oid = ObjectID.for_task_return(
+                TaskID(payload["task_id"]), payload["index"] + 1
+            )
             if payload.get("device"):
-                self.cluster.directory.mark_device(
-                    ObjectID.for_task_return(TaskID(payload["task_id"]), payload["index"] + 1)
+                self.cluster.directory.mark_device(item_oid)
+            if payload.get("size"):
+                self.cluster.directory.record_meta(
+                    item_oid, payload["size"],
+                    "device" if payload.get("device") else "host",
                 )
             committed = self.cluster.on_stream_item(
                 self, spec, payload["index"], None, lazy=True
@@ -615,7 +630,7 @@ class HeadService:
         self.cluster.head_node.store.put(
             oid, data_plane.from_frames(meta, buffers), is_error=is_error
         )
-        self.cluster.directory.add_location(oid, self.cluster.head_node.node_id)
+        self.cluster.commit_location(self.cluster.head_node, oid)
 
     def _health_loop(self) -> None:
         from ray_tpu.core.config import get_config
@@ -760,7 +775,11 @@ class HeadService:
         oid = ObjectID(payload["oid"])
         if payload.get("device"):
             self.cluster.directory.mark_device(oid)
-        self.cluster.directory.add_location(oid, handle.node_id)
+        self.cluster.directory.add_location(
+            oid, handle.node_id,
+            size=payload.get("size"),
+            tier="device" if payload.get("device") else "host",
+        )
 
     def _h_pull_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """An agent needs an object for a task dependency.  Resolve through

@@ -32,6 +32,10 @@ from ray_tpu.core.resources import ResourcePool, ResourceSet
 from ray_tpu.core.sync import when_all
 from ray_tpu.observability import metric_defs
 
+# prebuilt tag dicts: the locality stage runs per placement decision
+_LOCALITY_HIT = {"result": "hit"}
+_LOCALITY_MISS = {"result": "miss"}
+
 
 # --------------------------------------------------------------------------
 # Scheduling strategies (parity: python/ray/util/scheduling_strategies.py)
@@ -139,6 +143,14 @@ class ClusterScheduler:
         self._labels: Dict[NodeID, dict] = {}
         self._alive: Dict[NodeID, bool] = {}
         self._queue_lens: Dict[NodeID, Callable[[], int]] = {}
+        # object directory for the locality stage (bound by the cluster
+        # fabric; None = locality disabled, e.g. bare unit tests)
+        self._directory = None
+
+    def bind_directory(self, directory) -> None:
+        """Wire the object directory so pick_node can score candidate nodes
+        by local dependency bytes (locality_with_output parity)."""
+        self._directory = directory
 
     def register_node(
         self,
@@ -227,9 +239,59 @@ class ClusterScheduler:
                 feasible = [(nid, p) for nid, p in alive if spec.resources.fits(p.total)]
             if not feasible:
                 return None
-            return min(feasible, key=lambda kv: (self._queued(kv[0]), kv[1].utilization()))[0]
+            return self._pick_with_locality(
+                feasible, spec, cfg,
+                lambda: min(feasible, key=lambda kv: (self._queued(kv[0]), kv[1].utilization()))[0],
+            )
 
-        return self._hybrid(alive, spec, cfg)
+        if spec.dependencies and self._directory is not None:
+            feasible = [(nid, p) for nid, p in alive if spec.resources.fits(p.total)]
+            return self._pick_with_locality(
+                feasible, spec, cfg, lambda: self._hybrid(alive, spec, cfg)
+            )
+        return self._hybrid(alive, spec, cfg)  # no-dep hot path: zero overhead
+
+    def _pick_with_locality(
+        self,
+        feasible: List[Tuple[NodeID, ResourcePool]],
+        spec: TaskSpec,
+        cfg,
+        fallback: Callable[[], Optional[NodeID]],
+    ) -> Optional[NodeID]:
+        """Locality stage (reference: locality_with_output,
+        lease_policy.cc): rank feasible nodes by the dependency bytes the
+        directory says they already hold; prefer the leader when it beats
+        the runner-up by at least ``scheduler_locality_threshold_bytes``.
+        Ties and small-arg tasks fall back to the wrapped policy — locality
+        must never override load balance for cheap-to-move args."""
+        directory = self._directory
+        deps = spec.dependencies
+        threshold = cfg.scheduler_locality_threshold_bytes
+        # multi-node decisions only: with one candidate there is no
+        # placement choice to make (or to count in the hit/miss metric)
+        if not deps or directory is None or threshold <= 0 or len(feasible) < 2:
+            return fallback()
+        by_node, total_known = directory.locality_view(deps)
+        chosen = None
+        if by_node:
+            # stable sort on bytes only (NodeID has no ordering)
+            ranked = sorted(
+                ((by_node.get(nid, 0), nid) for nid, _pool in feasible),
+                key=lambda t: t[0], reverse=True,
+            )
+            best_bytes, best_nid = ranked[0]
+            if best_bytes >= ranked[1][0] + threshold:
+                chosen = best_nid
+        if chosen is None:
+            chosen = fallback()
+        if chosen is not None:
+            hit = by_node.get(chosen, 0)
+            miss = max(0, total_known - hit)
+            if hit:
+                metric_defs.SCHEDULER_LOCALITY_BYTES.inc(hit, tags=_LOCALITY_HIT)
+            if miss:
+                metric_defs.SCHEDULER_LOCALITY_BYTES.inc(miss, tags=_LOCALITY_MISS)
+        return chosen
 
     def _hybrid(self, nodes: List[Tuple[NodeID, ResourcePool]], spec: TaskSpec, cfg) -> Optional[NodeID]:
         """Hybrid policy (hybrid_scheduling_policy.cc:48): prefer packing

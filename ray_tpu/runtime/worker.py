@@ -84,7 +84,9 @@ class CoreWorker:
         oid = self.mint_put_oid()
         node = self.head_node
         node.store.put(oid, value)
-        self.cluster.directory.add_location(oid, node.node_id)
+        # size/tier ride into the directory so the locality stage can score
+        # nodes by local dependency bytes for tasks consuming this put
+        self.cluster.commit_location(node, oid)
         return ObjectRef(oid)
 
     # --------------------------------------------------------------- submit

@@ -223,7 +223,7 @@ def _execute_async_put(core_worker, op: str, kw: dict, worker_key) -> None:
         cluster = api.get_cluster()
         node = cluster.head_node
         node.store.put(oid, kw["value"])
-        cluster.directory.add_location(oid, node.node_id)
+        cluster.commit_location(node, oid)
     _pin_captured(core_worker, worker_key, [ref])
 
 

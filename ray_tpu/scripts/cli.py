@@ -327,6 +327,37 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_pulls(args) -> int:
+    """``rt pulls``: PullManager snapshot — queue depth, in-flight bytes,
+    dedup hits — plus the scheduler's locality hit/miss byte totals."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/pulls")
+    pm = data.get("pull_manager", {})
+    loc = data.get("locality", {})
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    print(
+        f"pulls: {pm.get('inflight', 0)} in flight "
+        f"({pm.get('inflight_bytes', 0) / 1e6:.1f} MB of "
+        f"{pm.get('max_inflight_bytes', 0) / 1e6:.0f} MB budget), "
+        f"{pm.get('queued', 0)} queued for admission"
+    )
+    print(
+        f"lifetime: {pm.get('completed', 0)} completed, "
+        f"{pm.get('bytes_pulled', 0) / 1e6:.1f} MB moved, "
+        f"{pm.get('dedup_hits', 0)} dedup hits, {pm.get('retries', 0)} retries"
+    )
+    hit, miss = loc.get("hit_bytes", 0), loc.get("miss_bytes", 0)
+    total = hit + miss
+    pct = f" ({100 * hit / total:.0f}% local)" if total else ""
+    print(
+        f"locality: {hit / 1e6:.1f} MB scheduled onto their bytes, "
+        f"{miss / 1e6:.1f} MB needed transfer{pct}"
+    )
+    return 0
+
+
 def cmd_chaos(args) -> int:
     from ray_tpu.chaos.runner import run_cli
 
@@ -453,6 +484,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--timeout", type=float, default=5.0)
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "pulls",
+        help="PullManager snapshot: queue depth, in-flight bytes, dedup hits, "
+        "locality hit/miss bytes",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_pulls)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

@@ -490,6 +490,43 @@ def run_suite(
 
         record("placement_group_create_removal", _rate(pg_cycle, N(500)), "ops/s")
 
+    # ---- locality-aware scheduling ---------------------------------------
+    if wanted("locality_arg_tasks"):
+        # Arg-heavy cross-node tasks/s: a 32 MiB argument lives on a second
+        # node; each round fans a batch of consumers over it.  The locality
+        # stage lands them ON the holder, so the rate measures scheduling +
+        # dispatch — not redundant 32 MiB copies (ISSUE 3 tentpole).  Runs
+        # LAST in the suite: it adds a node, which must not perturb the
+        # CPU-count-derived shapes of earlier rows.
+        cluster = rt.get_cluster()
+        cluster.add_node({"CPU": 2, "loc_bench": 1})
+
+        @rt.remote(execution="thread", resources={"loc_bench": 1}, num_cpus=0)
+        def produce_big():
+            return np.ones(32 * 1024 * 1024, np.uint8)
+
+        @rt.remote(execution="thread", num_cpus=0)
+        def consume_big(x):
+            return x.nbytes
+
+        big_ref = produce_big.remote()
+        deadline = time.monotonic() + 30
+        while not cluster.directory.locations(big_ref.id()):
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
+        batch = N(200)
+
+        def locality_round():
+            rt.get([consume_big.remote(big_ref) for _ in range(batch)], timeout=120)
+
+        record(
+            "locality_arg_tasks",
+            _rate(locality_round, 4, warmup=1) * batch,
+            "tasks/s",
+        )
+        del big_ref
+
     return results
 
 

@@ -136,6 +136,33 @@ def test_concurrent_pulls(server_store):
     client.close()
 
 
+def test_recv_exact_large_reads_use_single_buffer():
+    """Reads >= 1 MiB route through recv_into on one preallocated buffer —
+    no per-chunk bytes objects and no b"".join copy (ISSUE 3 satellite)."""
+    import socket as socket_mod
+
+    a, b = socket_mod.socketpair()
+    try:
+        payload = os.urandom(3 * (1 << 20) + 17)
+
+        def send():
+            a.sendall(payload)
+
+        t = threading.Thread(target=send, daemon=True)
+        t.start()
+        got = data_plane._recv_exact(b, len(payload))
+        t.join(timeout=10)
+        assert isinstance(got, bytearray)  # the recv_into path, not join()
+        assert bytes(got) == payload
+        # small reads still return bytes
+        a.sendall(b"tiny")
+        small = data_plane._recv_exact(b, 4)
+        assert isinstance(small, bytes) and small == b"tiny"
+    finally:
+        a.close()
+        b.close()
+
+
 # ==========================================================================
 # integration: two agents, peer-to-peer transfer (the round-3 bar)
 # ==========================================================================

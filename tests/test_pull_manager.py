@@ -1,0 +1,520 @@
+"""Locality-aware scheduling + PullManager (ISSUE 3).
+
+Two coupled subsystems:
+
+  * the ObjectDirectory records per-object size/tier at commit time and
+    ``ClusterScheduler.pick_node`` grows a locality stage — big-arg tasks
+    run where their bytes already live (reference: locality_with_output,
+    ``lease_policy.cc``),
+  * all inbound object traffic funnels through an admission-controlled
+    ``PullManager`` (``pull_manager.h:52`` parity): dedup of concurrent
+    pulls, in-flight-byte cap, transfers on pull workers, retry-with-purge
+    on failed sources.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import NodeID, ObjectID, TaskID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.core.resources import ResourcePool, ResourceSet
+from ray_tpu.runtime.cluster import ObjectDirectory
+from ray_tpu.runtime.scheduler import (
+    ClusterScheduler,
+    NodeAffinitySchedulingStrategy,
+    TaskSpec,
+)
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ==========================================================================
+# unit: PullManager over fake nodes (full control of sources/failures)
+# ==========================================================================
+class _FakeNode:
+    def __init__(self, store=None):
+        self.node_id = NodeID.from_random()
+        self.store = store if store is not None else ObjectStore(shm_store=None)
+        self.dead = False
+
+
+class _FakeCluster:
+    """The slice of the Cluster surface PullManager touches."""
+
+    def __init__(self):
+        self.directory = ObjectDirectory()
+        self.nodes = {}
+        self.transfer_bytes = 0
+        self.transfer_count = 0
+
+    def add(self, node):
+        self.nodes[node.node_id] = node
+        return node
+
+    def _is_pending(self, oid):
+        return False
+
+    def _try_recover(self, oid):
+        return False
+
+
+class _GatedStore(ObjectStore):
+    """get() blocks until the gate opens — makes admission observable."""
+
+    def __init__(self):
+        super().__init__(shm_store=None)
+        self.gate = threading.Event()
+
+    def get(self, object_id, timeout=None):
+        assert self.gate.wait(30)
+        return super().get(object_id, timeout=timeout)
+
+
+class _FailingStore(ObjectStore):
+    """get() raises — a wedged-but-alive source."""
+
+    def __init__(self):
+        super().__init__(shm_store=None)
+        self.get_calls = 0
+
+    def get(self, object_id, timeout=None):
+        self.get_calls += 1
+        raise RuntimeError("wedged source")
+
+
+def _make_pm(cluster):
+    from ray_tpu.runtime.pull_manager import PullManager
+
+    return PullManager(cluster)
+
+
+def test_concurrent_pulls_dedup_into_one_transfer():
+    fake = _FakeCluster()
+    gated = _GatedStore()  # hold the transfer in flight while pulls pile on
+    src = fake.add(_FakeNode(store=gated))
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    try:
+        oid = ObjectID.from_random()
+        value = np.ones(1 << 20, np.uint8)
+        gated.put(oid, value)
+        fake.directory.add_location(oid, src.node_id, size=value.nbytes, tier="host")
+
+        events = [threading.Event() for _ in range(6)]
+        for e in events:
+            pm.pull(oid, dest, e.set)
+        gated.gate.set()
+        for e in events:
+            assert e.wait(20)
+        assert fake.transfer_count == 1  # ONE transfer, six waiters
+        assert pm.snapshot()["dedup_hits"] >= 5
+        assert dest.store.contains(oid)
+        # the new copy is a recorded location with its size
+        assert dest.node_id in fake.directory.locations(oid)
+        assert fake.directory.object_size(oid) == value.nbytes
+    finally:
+        pm.shutdown()
+
+
+def test_admission_caps_inflight_bytes():
+    fake = _FakeCluster()
+    gated = _GatedStore()
+    src = fake.add(_FakeNode(store=gated))
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    pm._max_inflight = 100  # tiny budget: two 80-byte pulls cannot coexist
+    try:
+        oids = [ObjectID.from_random() for _ in range(2)]
+        for oid in oids:
+            gated.put(oid, np.ones(80, np.uint8))
+            fake.directory.add_location(oid, src.node_id, size=80, tier="host")
+        done = [threading.Event() for _ in oids]
+        pm.pull(oids[0], dest, done[0].set)
+        pm.pull(oids[1], dest, done[1].set)
+        snap = pm.snapshot()
+        assert snap["inflight"] == 1 and snap["queued"] == 1
+        assert snap["inflight_bytes"] == 80
+        gated.gate.set()  # release the transfer workers
+        for e in done:
+            assert e.wait(20)
+        snap = pm.snapshot()
+        assert snap["queued"] == 0 and snap["inflight_bytes"] == 0
+        assert fake.transfer_count == 2
+    finally:
+        pm.shutdown()
+
+
+def test_admission_is_fifo_small_pulls_queue_behind_large():
+    """A stream of small pulls must not jump a queued large pull — later
+    arrivals line up behind the queue head, or the large pull (and the task
+    blocked on it) starves while the budget churns under it."""
+    fake = _FakeCluster()
+    gated = _GatedStore()
+    src = fake.add(_FakeNode(store=gated))
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    pm._max_inflight = 100
+    try:
+        sizes = [80, 80, 10]  # in-flight, queued-large, late-small
+        oids = [ObjectID.from_random() for _ in sizes]
+        for oid, size in zip(oids, sizes):
+            gated.put(oid, np.ones(size, np.uint8))
+            fake.directory.add_location(oid, src.node_id, size=size, tier="host")
+        done = [threading.Event() for _ in oids]
+        for oid, e in zip(oids, done):
+            pm.pull(oid, dest, e.set)
+        snap = pm.snapshot()
+        # the 10-byte pull FITS the remaining budget but must queue behind
+        # the 80-byte pull that was already waiting
+        assert snap["inflight"] == 1 and snap["queued"] == 2
+        gated.gate.set()
+        for e in done:
+            assert e.wait(20)
+        assert fake.transfer_count == 3
+    finally:
+        pm.shutdown()
+
+
+def test_unlocated_pull_holds_no_budget():
+    """A pull waiting for an object that doesn't exist yet (or is being
+    reconstructed) must NOT hold admission budget — otherwise recovery's
+    own dependency pulls can deadlock behind the pull that triggered the
+    recovery.  Budget is charged only while a located transfer runs."""
+    fake = _FakeCluster()
+    src = fake.add(_FakeNode())
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    pm._max_inflight = 100
+    try:
+        ghost = ObjectID.from_random()   # never produced (yet)
+        fake.directory.record_meta(ghost, 90, "host")  # size known, no copy
+        waiting = threading.Event()
+        pm.pull(ghost, dest, waiting.set)
+        snap = pm.snapshot()
+        assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0
+        # another large pull admits freely — the ghost charges nothing
+        oid = ObjectID.from_random()
+        src.store.put(oid, np.ones(80, np.uint8))
+        fake.directory.add_location(oid, src.node_id, size=80, tier="host")
+        done = threading.Event()
+        pm.pull(oid, dest, done.set)
+        assert done.wait(20)
+        # the ghost materializes: its pull proceeds and completes
+        src.store.put(ghost, np.ones(90, np.uint8))
+        fake.directory.add_location(ghost, src.node_id, size=90, tier="host")
+        assert waiting.wait(20)
+        assert dest.store.contains(ghost)
+    finally:
+        pm.shutdown()
+
+
+def test_prefetch_joins_without_waiter_growth():
+    """Repeat prefetches of an in-flight transfer are no-ops: no waiter
+    accumulation, no dedup-hit inflation."""
+    fake = _FakeCluster()
+    gated = _GatedStore()
+    src = fake.add(_FakeNode(store=gated))
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    try:
+        oid = ObjectID.from_random()
+        gated.put(oid, np.ones(64, np.uint8))
+        fake.directory.add_location(oid, src.node_id, size=64, tier="host")
+        for _ in range(10):
+            pm.prefetch([oid], dest)
+        key = (oid, dest.node_id)
+        with pm._lock:
+            assert len(pm._pulls[key].waiters) == 1  # the first prefetch only
+        assert pm.snapshot()["dedup_hits"] == 0
+        done = threading.Event()
+        pm.pull(oid, dest, done.set)  # a REAL consumer still joins
+        gated.gate.set()
+        assert done.wait(20)
+        assert fake.transfer_count == 1
+    finally:
+        pm.shutdown()
+
+
+def test_failed_source_is_purged_then_retried():
+    """The pre-PullManager bug: a failing source was re-waited WITHOUT
+    remove_location, so the same wedged node was retried in a hot loop.
+    Now the stale location is purged first and the pull completes from a
+    fresh source once one appears."""
+    fake = _FakeCluster()
+    wedged = fake.add(_FakeNode(store=_FailingStore()))
+    healthy = fake.add(_FakeNode())
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    try:
+        oid = ObjectID.from_random()
+        fake.directory.add_location(oid, wedged.node_id, size=64, tier="host")
+        done = threading.Event()
+        pm.pull(oid, dest, done.set)
+        # the failing get purges the wedged location
+        assert _wait_for(lambda: wedged.node_id not in fake.directory.locations(oid))
+        assert pm.snapshot()["retries"] >= 1
+        assert wedged.store.get_calls == 1  # purged, NOT hot-looped
+        # a healthy copy appears: the parked pull completes from it
+        healthy.store.put(oid, np.ones(64, np.uint8))
+        fake.directory.add_location(oid, healthy.node_id, size=64, tier="host")
+        assert done.wait(20)
+        assert dest.store.contains(oid)
+    finally:
+        pm.shutdown()
+
+
+def test_dest_put_failure_returns_budget_and_retries(capsys):
+    """An unexpected failure AFTER the source get (e.g. the destination
+    store's put raising MemoryError) must not leak admitted budget or
+    strand waiters — the pull uncharges, logs, and retries."""
+
+    class _FlakyPutStore(ObjectStore):
+        def __init__(self):
+            super().__init__(shm_store=None)
+            self.fail_remaining = 2
+
+        def put(self, object_id, value, is_error=False):
+            if self.fail_remaining:
+                self.fail_remaining -= 1
+                raise MemoryError("arena full")
+            super().put(object_id, value, is_error=is_error)
+
+    fake = _FakeCluster()
+    src = fake.add(_FakeNode())
+    dest = fake.add(_FakeNode(store=_FlakyPutStore()))
+    pm = _make_pm(fake)
+    try:
+        oid = ObjectID.from_random()
+        src.store.put(oid, np.ones(64, np.uint8))
+        fake.directory.add_location(oid, src.node_id, size=64, tier="host")
+        done = threading.Event()
+        pm.pull(oid, dest, done.set)
+        assert done.wait(20)  # retried past the failures, waiter fired
+        assert dest.store.contains(oid)
+        snap = pm.snapshot()
+        assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0
+        assert snap["retries"] >= 2
+        assert "failed unexpectedly" in capsys.readouterr().err
+    finally:
+        pm.shutdown()
+
+
+def test_dead_source_location_purged():
+    fake = _FakeCluster()
+    src = fake.add(_FakeNode())
+    dest = fake.add(_FakeNode())
+    pm = _make_pm(fake)
+    try:
+        oid = ObjectID.from_random()
+        src.store.put(oid, b"x" * 64)
+        fake.directory.add_location(oid, src.node_id, size=64, tier="host")
+        src.dead = True
+        done = threading.Event()
+        pm.pull(oid, dest, done.set)
+        assert _wait_for(lambda: src.node_id not in fake.directory.locations(oid))
+        assert not done.is_set()  # parked for a fresh copy, not failed
+    finally:
+        pm.shutdown()
+
+
+# ==========================================================================
+# unit: the scheduler's locality stage
+# ==========================================================================
+def _spec(deps, resources=None, strategy=None):
+    return TaskSpec(
+        task_id=TaskID.from_random(),
+        name="t",
+        func=None,
+        args=(),
+        kwargs={},
+        dependencies=deps,
+        num_returns=1,
+        return_ids=[],
+        resources=ResourceSet(resources or {"CPU": 1}),
+        scheduling_strategy=strategy,
+    )
+
+
+def _two_node_sched():
+    sched = ClusterScheduler()
+    directory = ObjectDirectory()
+    sched.bind_directory(directory)
+    pool_a, pool_b = ResourcePool({"CPU": 4}), ResourcePool({"CPU": 4})
+    nid_a, nid_b = NodeID.from_random(), NodeID.from_random()
+    sched.register_node(nid_a, pool_a)
+    sched.register_node(nid_b, pool_b)
+    return sched, directory, (nid_a, pool_a), (nid_b, pool_b)
+
+
+def test_locality_overrides_utilization_for_big_args():
+    sched, directory, (nid_a, pool_a), (nid_b, _pool_b) = _two_node_sched()
+    # A busy, B idle: the hybrid policy would pick B
+    assert pool_a.acquire(ResourceSet({"CPU": 3}))
+    dep = ObjectID.from_random()
+    directory.add_location(dep, nid_a, size=8 << 20, tier="host")
+    for _ in range(5):
+        assert sched.pick_node(_spec([dep])) == nid_a
+
+
+def test_small_args_fall_back_to_hybrid():
+    sched, directory, (nid_a, pool_a), (nid_b, _pool_b) = _two_node_sched()
+    assert pool_a.acquire(ResourceSet({"CPU": 3}))
+    dep = ObjectID.from_random()
+    directory.add_location(dep, nid_a, size=1000, tier="host")  # << 1 MiB
+    # below the threshold the cheap-to-move arg must not pin placement
+    for _ in range(5):
+        assert sched.pick_node(_spec([dep])) == nid_b
+
+
+def test_locality_tie_falls_back():
+    sched, directory, (nid_a, pool_a), (nid_b, _pool_b) = _two_node_sched()
+    assert pool_a.acquire(ResourceSet({"CPU": 3}))
+    dep = ObjectID.from_random()
+    # both nodes hold the bytes: no lead over the runner-up -> hybrid
+    directory.add_location(dep, nid_a, size=8 << 20, tier="host")
+    directory.add_location(dep, nid_b, size=8 << 20, tier="host")
+    assert sched.pick_node(_spec([dep])) == nid_b
+
+
+def test_locality_applies_to_spread_strategy():
+    sched, directory, (nid_a, _pa), (nid_b, _pb) = _two_node_sched()
+    dep = ObjectID.from_random()
+    directory.add_location(dep, nid_b, size=16 << 20, tier="host")
+    for _ in range(5):
+        assert sched.pick_node(_spec([dep], strategy="SPREAD")) == nid_b
+
+
+def test_directory_drops_meta_with_last_location():
+    directory = ObjectDirectory()
+    nid = NodeID.from_random()
+    oid = ObjectID.from_random()
+    directory.add_location(oid, nid, size=4096, tier="host")
+    assert directory.object_size(oid) == 4096
+    assert directory.local_bytes([oid]) == {nid: 4096}
+    directory.forget(oid)
+    assert directory.object_size(oid) == 0
+    assert directory.local_bytes([oid]) == {}
+
+
+# ==========================================================================
+# integration: real cluster — the acceptance bars
+# ==========================================================================
+def test_big_arg_task_lands_on_producer_zero_transfer(ray_start_cluster):
+    """2+ nodes: a task whose arg exceeds the locality threshold schedules
+    onto the node holding the bytes (directory-verified) and the fabric
+    moves ZERO argument bytes; a no-arg workload still spreads."""
+    rt, cluster = ray_start_cluster
+    producer = cluster.add_node({"CPU": 2, "prod": 1})
+    cluster.add_node({"CPU": 2})
+
+    @rt.remote(execution="thread", resources={"prod": 1}, num_cpus=0)
+    def produce():
+        return np.ones(8 * 1024 * 1024, np.uint8)
+
+    @rt.remote(execution="thread")
+    def where(x):
+        return rt.get_runtime_context().get_node_id()
+
+    ref = produce.remote()
+    assert _wait_for(lambda: cluster.directory.locations(ref.id()))
+    assert cluster.directory.object_size(ref.id()) == 8 * 1024 * 1024
+    bytes_before = cluster.transfer_bytes
+    for _ in range(3):
+        assert rt.get(where.remote(ref), timeout=30) == producer.node_id.hex()
+    # the 8 MiB argument never moved (result pulls are byte-free ints)
+    assert cluster.transfer_bytes == bytes_before
+
+    @rt.remote(execution="thread")
+    def where_no_arg():
+        time.sleep(0.2)
+        return rt.get_runtime_context().get_node_id()
+
+    nodes_seen = set(rt.get([where_no_arg.remote() for _ in range(12)], timeout=60))
+    assert len(nodes_seen) >= 2  # locality stage leaves no-arg spread intact
+
+
+def test_n_consumers_one_remote_arg_single_copy(ray_start_cluster):
+    """N concurrent consumers of one remote 8 MiB object, pinned AWAY from
+    the bytes: the PullManager coalesces their dependency pulls into ONE
+    data transfer (transfer bytes grow by exactly one copy)."""
+    rt, cluster = ray_start_cluster
+    cluster.add_node({"CPU": 2, "pa": 4})
+    node_b = cluster.add_node({"CPU": 4})
+    nbytes = 8 * 1024 * 1024
+
+    @rt.remote(execution="thread", resources={"pa": 1}, num_cpus=0)
+    def produce():
+        return np.ones(nbytes, np.uint8)
+
+    @rt.remote(execution="thread", num_cpus=0)
+    def consume(x):
+        return int(x[0])
+
+    ref = produce.remote()
+    assert _wait_for(lambda: cluster.directory.locations(ref.id()))
+    bytes_before = cluster.transfer_bytes
+    pin_b = NodeAffinitySchedulingStrategy(node_b.node_id)
+    out = rt.get(
+        [consume.options(scheduling_strategy=pin_b).remote(ref) for _ in range(4)],
+        timeout=60,
+    )
+    assert out == [1, 1, 1, 1]
+    # exactly ONE copy of the argument crossed the fabric
+    assert cluster.transfer_bytes - bytes_before == nbytes
+    assert node_b.node_id in cluster.directory.locations(ref.id())
+
+
+def test_explicit_concurrent_pull_object_dedups(ray_start_cluster):
+    rt, cluster = ray_start_cluster
+    src_node = cluster.add_node({"CPU": 1, "src": 1})
+    dest = cluster.add_node({"CPU": 1})
+
+    @rt.remote(execution="thread", resources={"src": 1}, num_cpus=0)
+    def produce():
+        return np.full(2 << 20, 7, np.uint8)
+
+    ref = produce.remote()
+    assert _wait_for(lambda: cluster.directory.locations(ref.id()))
+    # slow the source read so all five pulls arrive while one is in flight
+    orig_get = src_node.store.get
+    gate = threading.Event()
+
+    def gated_get(oid, timeout=None):
+        assert gate.wait(30)
+        return orig_get(oid, timeout=timeout)
+
+    src_node.store.get = gated_get
+    try:
+        count_before = cluster.transfer_count
+        dedup_before = cluster.pull_manager.dedup_hits
+        events = [threading.Event() for _ in range(5)]
+        for e in events:
+            cluster.pull_object(ref.id(), dest, e.set)
+        gate.set()
+        for e in events:
+            assert e.wait(30)
+        assert cluster.transfer_count - count_before == 1
+        assert cluster.pull_manager.dedup_hits - dedup_before >= 4
+        assert dest.store.contains(ref.id())
+    finally:
+        src_node.store.get = orig_get
+
+
+def test_pull_manager_snapshot_shape(ray_start_regular):
+    rt = ray_start_regular
+    snap = rt.get_cluster().pull_manager.snapshot()
+    for key in (
+        "inflight", "queued", "inflight_bytes", "max_inflight_bytes",
+        "dedup_hits", "retries", "completed", "bytes_pulled",
+    ):
+        assert key in snap
