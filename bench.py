@@ -149,6 +149,12 @@ ROW_GROUPS = [
     # arg-heavy cross-node tasks/s: the locality-scheduling + PullManager
     # row (ISSUE 3). Own group — it adds a second node to the runtime.
     ["locality_arg_tasks"],
+    # one 64 MiB object relayed to 4 destinations through the fanout-2
+    # spanning tree (ISSUE 4): aggregate GB/s delivered + root egress as a
+    # multiple of the object size (socket-byte accounting; unicast = 4x).
+    # Own fresh-runtime group — 256 MiB of buffers must not churn the page
+    # cache under other rows.
+    ["broadcast_64mb_to_n", "broadcast_root_egress_x"],
 ]
 
 
@@ -180,6 +186,7 @@ def main() -> None:
         "single_client_tasks_async",
         "single_client_tasks_and_get_batch",
         "locality_arg_tasks",
+        "broadcast_64mb_to_n",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

@@ -155,6 +155,15 @@ class Config:
     # First retry delay after a failed pull source (doubles per attempt,
     # capped at ~2s); the failed location is purged before re-resolving.
     pull_manager_retry_backoff_s: float = 0.05
+    # Broadcast: concurrent pulls of ONE object to >= 2 destinations
+    # coalesce into a bounded-fanout spanning tree (Cornet/Orchestra-style
+    # cooperative broadcast) — the source serves at most this many direct
+    # children; every completed destination relays further copies.  0
+    # disables the planner (every pull goes straight to a replica).
+    broadcast_fanout: int = 2
+    # Serve-side frame cache on each data server: N consumers of one bulk
+    # object cost one serialization, not N.  Entry count, 0 disables.
+    data_server_frame_cache_entries: int = 4
     # Worker results/args decoded from the shm arena stay as READ-ONLY
     # zero-copy views pinned until garbage-collected (plasma Get semantics,
     # plasma/client.h:62) instead of being copied out. Disable for owned,

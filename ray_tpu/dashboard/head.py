@@ -368,13 +368,25 @@ class DashboardHead:
         return {"nodes": nodes}
 
     def _pull_stats(self) -> dict:
-        """`rt pulls`: the PullManager's live admission/dedup counters plus
-        the scheduler's locality hit/miss byte totals — together they answer
-        "is the cluster moving bytes it didn't have to?"."""
+        """`rt pulls`: the PullManager's live admission/dedup counters, the
+        broadcast planner's plan snapshots, the head data server's frame
+        cache hit rate, plus the scheduler's locality hit/miss byte totals
+        — together they answer "is the cluster moving bytes it didn't have
+        to?"."""
         from ray_tpu.observability import metric_defs
 
+        frame_cache = {"hits": 0, "misses": 0}
+        head_service = self.cluster.head_service
+        if head_service is not None:
+            stats = head_service.data_server.stats
+            frame_cache = {
+                "hits": stats.frame_cache_hits,
+                "misses": stats.frame_cache_misses,
+            }
         return {
             "pull_manager": self.cluster.pull_manager.snapshot(),
+            "broadcast": self.cluster.pull_manager.broadcast_snapshot(),
+            "frame_cache": frame_cache,
             "locality": {
                 "hit_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "hit"}),
                 "miss_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "miss"}),

@@ -153,6 +153,25 @@ PULL_MANAGER_RETRIES = _reg.counter(
     "purged before re-resolving).",
 )
 
+# ---- broadcast (spanning-tree object fan-out) ----------------------------
+BROADCAST_PLANS = _reg.counter(
+    "broadcast_plans_total",
+    "Broadcast plans built: concurrent pulls of one object to >= 2 "
+    "destinations coalesced into a bounded-fanout spanning tree.",
+)
+BROADCAST_RELAY_BYTES = _reg.counter(
+    "broadcast_relay_bytes_total",
+    "Object bytes moved over relay tree edges (served by an interior "
+    "destination, not the root source) — bytes the root did NOT have to send.",
+    "By",
+)
+PULL_SOURCE_SELECTED = _reg.counter(
+    "pull_source_selected_total",
+    "Pull source decisions, by kind (sole = one replica existed, balanced = "
+    "chosen round-robin among replicas, relay = an in-flight destination "
+    "assigned as a chained/tree parent).",
+)
+
 # ---- serve router --------------------------------------------------------
 SERVE_ROUTER_REQUESTS = _reg.counter(
     "serve_router_requests_total", "Requests routed to replicas, by deployment."
@@ -215,6 +234,9 @@ ALL_METRICS = [
     PULL_MANAGER_INFLIGHT_BYTES,
     PULL_MANAGER_DEDUP_HITS,
     PULL_MANAGER_RETRIES,
+    BROADCAST_PLANS,
+    BROADCAST_RELAY_BYTES,
+    PULL_SOURCE_SELECTED,
     SERVE_ROUTER_REQUESTS,
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,

@@ -81,7 +81,13 @@ class AgentFabric:
         if self.data_client is None:
             relay()
             return
+        # one re-locate after a failed peer: the failure notice purges the
+        # stale location at the head, so the retry lands on a SURVIVING
+        # replica (purge-then-retry parity with the head PullManager; this
+        # is how a dead relay's chained children re-parent mid-broadcast)
+        self._locate_and_pull(oid, node, callback, relay, retries=1)
 
+    def _locate_and_pull(self, oid: ObjectID, node, callback, relay, retries: int) -> None:
         def on_locate(reply, error):
             if isinstance(error, rpc.RemoteHandlerError):
                 # live head, failing handler (e.g. version skew): the relay
@@ -95,8 +101,13 @@ class AgentFabric:
                 # a push to this node is already in flight — wait for it
                 self._transfer_pool().submit(self._wait_local, oid, node, callback, relay)
             elif addr:
+                if retries > 0:
+                    def fallback():
+                        self._locate_and_pull(oid, node, callback, relay, retries - 1)
+                else:
+                    fallback = relay
                 self._transfer_pool().submit(
-                    self._direct_pull, addr, oid, node, callback, relay
+                    self._direct_pull, addr, oid, node, callback, fallback
                 )
             else:
                 relay()
@@ -114,6 +125,12 @@ class AgentFabric:
         try:
             value, is_error = self.data_client.pull(addr, oid.binary(), timeout=30.0)
         except Exception:  # noqa: BLE001 — peer died / stale location
+            # tell the head WHICH peer failed so it can purge the stale
+            # location before this (or any other) consumer re-resolves
+            try:
+                self.conn.send("pull_failed", {"oid": oid.binary(), "addr": addr})
+            except rpc.RpcError:
+                pass
             fallback()
             return
         node.store.put(oid, value, is_error=is_error)

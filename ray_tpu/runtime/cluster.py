@@ -58,6 +58,18 @@ class ObjectDirectory:
         self._lock = threading.Lock()
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
         self._waiters: Dict[ObjectID, List[Callable[[NodeID], None]]] = {}
+        # replica-aware source selection: per-object ring pointer (the last
+        # node served) over the sorted replica set, so N concurrent
+        # consumers spread across copies instead of all hammering whichever
+        # location hashed first.  Successor rotation (not an index cursor):
+        # it keeps rotating correctly while the replica set GROWS, and it
+        # is deterministic — same call sequence -> same picks, which keeps
+        # seeded chaos schedules byte-reproducible.
+        self._rr: Dict[ObjectID, NodeID] = {}
+        # called as observer(oid, node_id) after every add_location commit
+        # (outside the directory lock); the PullManager uses it to mark
+        # chained broadcast destinations as completed replicas.
+        self.location_observer: Optional[Callable[[ObjectID, NodeID], None]] = None
         # oids whose primary copy is DEVICE-resident (HBM) at its location —
         # SURVEY §5.8: device placement recorded in the object directory.
         # This set IS the tier record: device vs host; finer tiering (shm /
@@ -126,6 +138,12 @@ class ObjectDirectory:
             waiters = self._waiters.pop(oid, [])
         for cb in waiters:
             cb(node_id)
+        observer = self.location_observer
+        if observer is not None:
+            try:
+                observer(oid, node_id)
+            except Exception:  # noqa: BLE001 — observers must not block commits
+                pass
 
     def remove_location(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._lock:
@@ -137,15 +155,49 @@ class ObjectDirectory:
         with self._lock:
             return set(self._locations.get(oid, ()))
 
+    def _pick_locked(self, oid: ObjectID, exclude=()) -> Tuple[Optional[NodeID], int]:
+        """(chosen location, candidate count) under self._lock — successor
+        rotation over the sorted replica set so consumers spread across
+        copies (the pointer strictly advances, so consecutive picks can
+        never pin one replica even while the set grows)."""
+        locs = self._locations.get(oid)
+        if not locs:
+            return None, 0
+        cands = sorted((n for n in locs if n not in exclude), key=lambda n: n.binary())
+        if not cands:
+            cands = sorted(locs, key=lambda n: n.binary())
+        last = self._rr.get(oid)
+        chosen = cands[0]
+        if last is not None:
+            for nid in cands:
+                if nid.binary() > last.binary():
+                    chosen = nid
+                    break
+        self._rr[oid] = chosen
+        return chosen, len(cands)
+
+    def pick_location(self, oid: ObjectID, exclude=()) -> Optional[NodeID]:
+        """Replica-aware source selection: balance across live replicas
+        instead of handing every consumer the deterministic first location
+        (the pre-broadcast behavior that hammered one copy)."""
+        with self._lock:
+            chosen, n = self._pick_locked(oid, exclude)
+        if chosen is not None:
+            metric_defs.PULL_SOURCE_SELECTED.inc(
+                tags={"kind": "sole" if n == 1 else "balanced"}
+            )
+        return chosen
+
     def wait_for(self, oid: ObjectID, callback: Callable[[NodeID], None]) -> None:
         with self._lock:
-            locs = self._locations.get(oid)
-            if locs:
-                node_id = next(iter(locs))
-            else:
+            chosen, n = self._pick_locked(oid)
+            if chosen is None:
                 self._waiters.setdefault(oid, []).append(callback)
                 return
-        callback(node_id)
+        metric_defs.PULL_SOURCE_SELECTED.inc(
+            tags={"kind": "sole" if n == 1 else "balanced"}
+        )
+        callback(chosen)
 
     def drop_node(self, node_id: NodeID) -> List[ObjectID]:
         """Remove all locations on a dead node; return objects now lost."""
@@ -158,6 +210,7 @@ class ObjectDirectory:
             for oid in lost:
                 del self._locations[oid]
                 self._meta.pop(oid, None)
+                self._rr.pop(oid, None)
         return lost
 
     def forget(self, oid: ObjectID) -> None:
@@ -165,6 +218,7 @@ class ObjectDirectory:
             self._locations.pop(oid, None)
             self._device.discard(oid)
             self._meta.pop(oid, None)
+            self._rr.pop(oid, None)
             waiters = self._waiters.pop(oid, None)
         # Fire waiters with None (object out of scope) instead of dropping
         # them: a silently-dropped waiter is a leak for ready-hooks (serve
@@ -231,6 +285,9 @@ class Cluster:
         from ray_tpu.runtime.pull_manager import PullManager
 
         self.pull_manager = PullManager(self)
+        # broadcast bookkeeping: the planner marks chained destinations as
+        # completed replicas the moment their copy commits a location
+        self.directory.location_observer = self.pull_manager.on_location_committed
         self.nodes: Dict[NodeID, Node] = {}
         self.head_node: Optional[Node] = None
         self._actor_queues: Dict[ActorID, _ActorQueue] = {}
@@ -433,6 +490,9 @@ class Cluster:
         self.control.placement_groups.on_node_dead(node_id)
         # objects whose only copy was there are lost
         lost = self.directory.drop_node(node_id)
+        # broadcast plans: a relay node dying mid-broadcast re-parents its
+        # parked subtree onto surviving replicas (purge-then-retry path)
+        self.pull_manager.on_node_dead(node_id)
         # resubmit this node's pending tasks (system failure → consumes retry)
         for spec in self.task_manager.pending_specs():
             if spec.owner_node == node_id and spec.actor_id is None:

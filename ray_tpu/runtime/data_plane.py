@@ -22,6 +22,18 @@ pickles; chunk frames are length-prefixed raw bytes):
   push:  -> {"op": "push", "oid", "size", "chunks", "is_error"}
          -> chunk * chunks
          <- {"ok": True}
+  relay: -> {"op": "relay", "oid", "meta_size", "buffer_sizes", "is_error",
+             "children": [{"addr", "children": [...]}, ...]}
+         -> meta + chunk stream
+         <- {"ok": True, "failed": [addr, ...]}
+
+The relay op is the broadcast data path (Cornet/Orchestra-style
+cooperative tree broadcast): the receiver commits each inbound chunk to
+its local buffers WHILE forwarding it to its subtree children, so a
+fanout-f tree over N destinations finishes in ~size/BW + depth*chunk
+instead of N*size/BW serialized at the source, and the source's egress is
+bounded at fanout copies.  Failed subtrees are reported up the ack chain
+so the caller can re-pull just those destinations.
 
 Blocking is fine HERE (unlike on the control connection): each data
 connection has a dedicated server thread and carries nothing but bulk
@@ -149,6 +161,29 @@ def _send_buffers(sock: socket.socket, buffers, chunk_bytes: int) -> int:
         for start in range(0, view.nbytes, chunk_bytes):
             sock.sendall(view[start:start + chunk_bytes])
     return total
+
+
+def build_relay_tree(addrs: List[str], fanout: int) -> List[dict]:
+    """Heap-shaped bounded-fanout spanning tree over destination addresses.
+
+    Returns the source's first-level subtrees (at most ``fanout`` of them);
+    node i's children are nodes ``fanout + i*fanout .. fanout + i*fanout +
+    fanout - 1``, so every node has <= fanout children and the depth is
+    ~log_fanout(N) — the pipeline depth term of the broadcast completion
+    time."""
+    fanout = max(1, fanout)
+    nodes = [{"addr": a, "children": []} for a in addrs]
+    for i in range(fanout, len(nodes)):
+        nodes[(i - fanout) // fanout]["children"].append(nodes[i])
+    return nodes[:fanout]
+
+
+def _flatten_tree(subtree: dict) -> List[str]:
+    """Every destination address in a relay subtree (failure reporting)."""
+    out = [subtree["addr"]]
+    for child in subtree.get("children") or ():
+        out.extend(_flatten_tree(child))
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -303,6 +338,7 @@ class TransferStats:
         "pushes_sent": ("DATA_PLANE_TRANSFERS", {"op": "push"}),
         "pushes_received": ("DATA_PLANE_TRANSFERS", {"op": "push_received"}),
         "shm_handoffs": ("DATA_PLANE_TRANSFERS", {"op": "shm_handoff"}),
+        "relays": ("DATA_PLANE_TRANSFERS", {"op": "relay"}),
     }
 
     def __init__(self):
@@ -314,6 +350,9 @@ class TransferStats:
         self.pushes_sent = 0
         self.pushes_received = 0
         self.shm_handoffs = 0
+        self.relays = 0
+        self.frame_cache_hits = 0
+        self.frame_cache_misses = 0
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -325,6 +364,9 @@ class TransferStats:
                 "pushes_sent": self.pushes_sent,
                 "pushes_received": self.pushes_received,
                 "shm_handoffs": self.shm_handoffs,
+                "relays": self.relays,
+                "frame_cache_hits": self.frame_cache_hits,
+                "frame_cache_misses": self.frame_cache_misses,
             }
 
     def add(self, field: str, n: int = 1) -> None:
@@ -406,6 +448,8 @@ class DataServer:
                     self._serve_pull(sock, req)
                 elif op == "push":
                     self._serve_push(sock, req)
+                elif op == "relay":
+                    self._serve_relay(sock, req)
                 else:
                     _send_header(sock, {"error": f"unknown op {op!r}"})
         except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
@@ -528,6 +572,107 @@ class DataServer:
         _send_header(sock, {"ok": True})
         self.stats.add("pushes_received")
         self.stats.add("bytes_received", len(meta) + sum(req["buffer_sizes"]))
+
+    def _serve_relay(self, sock: socket.socket, req: dict) -> None:
+        """Broadcast relay hop: commit each inbound chunk locally WHILE
+        forwarding it to this node's subtree children — the chunk-pipelined
+        tree edge (recv chunk -> local write + forward).  The local copy is
+        stored before acking so a parent's ack means "this subtree's root
+        is a replica"; child failures are reported up, never retried here
+        (the broadcast planner re-pulls just the failed destinations)."""
+        children = req.get("children") or []
+        meta_size = req["meta_size"]
+        buffer_sizes = req["buffer_sizes"]
+        failed: List[str] = []
+        downstream: List[list] = []  # [socket, subtree, dead]
+        forwarded = 0
+
+        def forward(view) -> None:
+            nonlocal forwarded
+            for entry in downstream:
+                if entry[2]:
+                    continue
+                try:
+                    entry[0].sendall(view)
+                    forwarded += len(view)
+                except OSError:
+                    entry[2] = True
+                    failed.extend(_flatten_tree(entry[1]))
+                    try:  # close NOW: the ack loop skips dead entries
+                        entry[0].close()
+                    except OSError:
+                        pass
+
+        with self._admission:
+            for child in children:
+                try:
+                    host, _, port = child["addr"].rpartition(":")
+                    csock = socket.create_connection(
+                        (host or "127.0.0.1", int(port)), timeout=10.0
+                    )
+                    csock.settimeout(120.0)
+                    csock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    _send_header(
+                        csock,
+                        {"op": "relay", "oid": req["oid"],
+                         "is_error": req.get("is_error", False),
+                         "meta_size": meta_size, "buffer_sizes": buffer_sizes,
+                         "children": child.get("children") or []},
+                    )
+                    downstream.append([csock, child, False])
+                except (OSError, ConnectionError):
+                    failed.extend(_flatten_tree(child))
+            try:
+                meta = _recv_exact(sock, meta_size)
+                forward(memoryview(meta).cast("B") if meta else meta)
+                buffers = []
+                for size in buffer_sizes:
+                    buf = bytearray(size)
+                    view = memoryview(buf)
+                    got = 0
+                    while got < size:
+                        n = sock.recv_into(
+                            view[got:], min(size - got, self.chunk_bytes)
+                        )
+                        if n == 0:
+                            raise ConnectionError("data socket closed")
+                        forward(view[got:got + n])
+                        got += n
+                    buffers.append(buf)
+            except BaseException:
+                for entry in downstream:
+                    try:
+                        entry[0].close()
+                    except OSError:
+                        pass
+                raise
+        # local write commits BEFORE the ack: an acked hop IS a replica
+        self._put_frames(req["oid"], meta, buffers, req.get("is_error", False))
+        for entry in downstream:
+            if entry[2]:
+                continue
+            try:
+                reply = _recv_header(entry[0])
+                failed.extend(reply.get("failed") or [])
+                if not reply.get("ok"):
+                    failed.extend(_flatten_tree(entry[1]))
+            except (OSError, ConnectionError, EOFError, pickle.UnpicklingError):
+                failed.extend(_flatten_tree(entry[1]))
+            finally:
+                try:
+                    entry[0].close()
+                except OSError:
+                    pass
+        # account BEFORE acking: the upstream ack chain completes the
+        # broadcast, and callers read these counters the moment it does
+        self.stats.add("relays")
+        self.stats.add("bytes_received", meta_size + sum(buffer_sizes))
+        if forwarded:
+            self.stats.add("bytes_sent", forwarded)
+            from ray_tpu.observability import metric_defs
+
+            metric_defs.BROADCAST_RELAY_BYTES.inc(forwarded)
+        _send_header(sock, {"ok": True, "failed": sorted(set(failed))})
 
 
 class DataClient:
@@ -748,6 +893,66 @@ class DataClient:
         self.stats.add("bytes_received", len(meta) + sum(header["buffer_sizes"]))
         return from_frames(meta, buffers), header.get("is_error", False)
 
+    def relay(self, oid: bytes, value: Any, tree: List[dict],
+              is_error: bool = False, timeout: float = 120.0) -> List[str]:
+        """Broadcast ``value`` through a spanning tree of data servers (see
+        :func:`build_relay_tree`).  The source streams only to the
+        first-level subtrees (egress bounded at ``len(tree)`` copies); each
+        hop commits chunks locally while forwarding downstream.  Returns
+        the addresses that did NOT durably receive the object — the caller
+        re-pulls exactly those."""
+        t_start = time.perf_counter()
+        meta, buffers = to_frames(value)
+        sizes = [memoryview(b).cast("B").nbytes for b in buffers]
+        failed: List[str] = []
+        lock = threading.Lock()
+
+        def send_subtree(sub: dict) -> None:
+            addr = sub["addr"]
+            with self._admission:
+                sock = self._checkout(addr)
+                try:
+                    sock.settimeout(timeout)
+                    _send_header(
+                        sock,
+                        {"op": "relay", "oid": oid, "is_error": is_error,
+                         "meta_size": len(meta), "buffer_sizes": sizes,
+                         "children": sub.get("children") or []},
+                    )
+                    sock.sendall(meta)
+                    sent = _send_buffers(sock, buffers, self.chunk_bytes)
+                    reply = _recv_header(sock)
+                    sock.settimeout(None)
+                except (OSError, EOFError, pickle.UnpicklingError):
+                    self._discard(sock)
+                    with lock:
+                        failed.extend(_flatten_tree(sub))
+                    return
+                else:
+                    self._checkin(addr, sock)
+            self.stats.add("relays")
+            self.stats.add("bytes_sent", len(meta) + sent)
+            with lock:
+                failed.extend(reply.get("failed") or [])
+                if not reply.get("ok"):
+                    failed.extend(_flatten_tree(sub))
+
+        if len(tree) > 1:
+            threads = [
+                threading.Thread(target=send_subtree, args=(sub,),
+                                 name="relay-root", daemon=True)
+                for sub in tree
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            for sub in tree:
+                send_subtree(sub)
+        _observe_latency("relay", t_start)
+        return sorted(set(failed))
+
     def push(self, addr: str, oid: bytes, value: Any, is_error: bool = False) -> None:
         t_start = time.perf_counter()
         try:
@@ -796,25 +1001,37 @@ def store_server(store, host: str = "127.0.0.1", port: int = 0,
     # Small serve-side frame cache: N consumers of one bulk object (shuffle
     # fan-in, broadcast) cost one serialization, not N.  Objects are
     # immutable so entries can never go stale.  Frames are (meta, buffer
-    # views of the live value) — near-zero marginal memory.
+    # views of the live value) — near-zero marginal memory.  Entry count is
+    # a config knob (data_server_frame_cache_entries, 0 disables); hit/miss
+    # counters surface in the server's TransferStats and `rt pulls`.
+    cache_cap = max(0, cfg.data_server_frame_cache_entries)
     frame_cache: "OrderedDict[bytes, Tuple[bytes, List[Any], bool]]" = OrderedDict()
     cache_lock = threading.Lock()
+    server_box: List[DataServer] = []
+
+    def _cache_count(field: str) -> None:
+        if server_box:
+            server_box[0].stats.add(field)
 
     def get_frames(oid_bytes: bytes, timeout: float):
         with cache_lock:
             hit = frame_cache.get(oid_bytes)
             if hit is not None:
                 frame_cache.move_to_end(oid_bytes)
-                return hit
+        if hit is not None:
+            _cache_count("frame_cache_hits")
+            return hit
+        _cache_count("frame_cache_misses")
         oid = ObjectID(oid_bytes)
         value = store.get(oid, timeout=timeout)
         info = store.entry_info(oid)
         meta, buffers = to_frames(value)
         out = (meta, buffers, bool(info and info["is_error"]))
-        with cache_lock:
-            frame_cache[oid_bytes] = out
-            while len(frame_cache) > 4:
-                frame_cache.popitem(last=False)
+        if cache_cap > 0:
+            with cache_lock:
+                frame_cache[oid_bytes] = out
+                while len(frame_cache) > cache_cap:
+                    frame_cache.popitem(last=False)
         return out
 
     def put_frames(oid_bytes: bytes, meta: bytes, buffers, is_error: bool) -> None:
@@ -843,10 +1060,12 @@ def store_server(store, host: str = "127.0.0.1", port: int = 0,
         except Exception:  # noqa: BLE001 — eviction race etc.: no offer,
             return None    # the pull falls through to the host envelope
 
-    return DataServer(
+    server = DataServer(
         get_frames, put_frames, host=host, port=port,
         chunk_bytes=chunk_bytes or cfg.object_transfer_chunk_bytes,
         max_concurrent=max_concurrent or cfg.max_concurrent_object_transfers,
         get_device_offer=get_device_offer,
         shm_store=shm_store,
     )
+    server_box.append(server)
+    return server

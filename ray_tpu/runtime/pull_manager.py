@@ -23,20 +23,34 @@ PullManager's load-bearing properties:
     node is not retried in a hot loop (the old path re-waited without
     purging), and repeated failures back off exponentially,
   * **prefetch** — queued tasks' dependencies can be warmed in dispatch
-    order (``prefetch``), pipelining transfers behind head-of-line waits.
+    order (``prefetch``), pipelining transfers behind head-of-line waits,
+  * **broadcast** — concurrent pulls of ONE object to >= 2 different
+    destinations coalesce into a bounded-fanout spanning tree
+    (:class:`_BroadcastPlan`, Cornet/Orchestra-style): the source serves
+    at most ``broadcast_fanout`` direct children, every other destination
+    parks budget-free under an earlier destination and transfers from it
+    once that copy commits, late joiners attach under completed replicas,
+    and a dead relay re-parents its subtree onto survivors through the
+    purge-then-retry path.  Remote destination groups are served by ONE
+    chunk-pipelined data-plane relay (``data_plane.relay``); agents'
+    ``locate_object`` pulls get replica-balanced / chained sources via
+    :meth:`PullManager.assign_remote_source`.
 
 Chaos: the ``data_plane.send_frame`` and ``object_store.put`` failpoints
 fire at the same logical points as the old path (a dropped "frame" retries
 off-thread; a failed destination commit retries off-thread), so seeded
-schedules keep reproducing.
+schedules keep reproducing; replica rotation is deterministic (no
+randomness), so same (seed, schedule, workload) still yields identical
+fault logs.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import NodeID, ObjectID
@@ -47,7 +61,8 @@ from ray_tpu.runtime import failpoints
 class _Pull:
     """One registered transfer of an object to a destination."""
 
-    __slots__ = ("oid", "dest", "waiters", "charged", "admitted", "attempts")
+    __slots__ = ("oid", "dest", "waiters", "charged", "admitted", "attempts",
+                 "src", "via_relay")
 
     def __init__(self, oid: ObjectID, dest, callback: Callable[[], None]):
         self.oid = oid
@@ -56,6 +71,84 @@ class _Pull:
         self.charged = 0        # bytes currently held against the budget
         self.admitted = False   # True while a transfer attempt is budgeted
         self.attempts = 0       # failed-source retries so far
+        self.src: Optional[NodeID] = None  # source of the current attempt
+        self.via_relay = False  # current attempt reads from a tree parent,
+        #                         not the root — its bytes count as relayed
+
+
+class _BroadcastPlan:
+    """Bounded-fanout spanning tree for concurrent pulls of ONE object to
+    many destinations (Cornet/Orchestra-style cooperative broadcast).
+
+    The root is the source replica; each destination is attached under the
+    first parent with spare fanout — completed members first (they can
+    serve immediately, which is also where late joiners land), then the
+    root, then pending members in attach order (those children PARK, no
+    budget held, until their parent's copy commits).  Root egress is
+    bounded at ``fanout`` direct children; every further copy is relayed by
+    a destination.  All mutation happens under the PullManager's lock."""
+
+    __slots__ = ("oid", "fanout", "root", "members", "order", "parent",
+                 "children", "done", "failed", "parked")
+
+    def __init__(self, oid: ObjectID, fanout: int):
+        self.oid = oid
+        self.fanout = max(1, fanout)
+        self.root: Optional[NodeID] = None   # source replica, fixed on first locate
+        self.members: Dict[NodeID, _Pull] = {}
+        self.order: List[NodeID] = []        # attach order (parent scan order)
+        self.parent: Dict[NodeID, Optional[NodeID]] = {}  # None = root slot
+        self.children: Dict[Optional[NodeID], List[NodeID]] = {None: []}
+        self.done: Set[NodeID] = set()
+        self.failed: Set[NodeID] = set()
+        self.parked: Set[NodeID] = set()
+
+    def _capacity(self, nid: Optional[NodeID]) -> bool:
+        return len(self.children.get(nid, ())) < self.fanout
+
+    def _pick_parent(self) -> Optional[NodeID]:
+        for nid in self.order:              # completed members serve NOW
+            if nid in self.done and nid not in self.failed and self._capacity(nid):
+                return nid
+        if self._capacity(None):            # then the root's direct slots
+            return None
+        for nid in self.order:              # then pending members (child parks)
+            if nid not in self.failed and self._capacity(nid):
+                return nid
+        live = [n for n in self.order if n not in self.failed]
+        if live:                            # tree full: chain off the lightest
+            return min(live, key=lambda n: (len(self.children.get(n, ())), n.binary()))
+        return None
+
+    def attach(self, p: _Pull) -> None:
+        dest = p.dest.node_id
+        parent = self._pick_parent()
+        self.members[dest] = p
+        self.order.append(dest)
+        self.parent[dest] = parent
+        self.children.setdefault(parent, []).append(dest)
+        self.children.setdefault(dest, [])
+
+    def reparent(self, dest: NodeID) -> Optional[NodeID]:
+        """Failed parent: move ``dest`` under a completed member with spare
+        fanout, else back under the root (surviving-replica fallback; the
+        fanout bound yields to liveness here)."""
+        old = self.parent.get(dest)
+        siblings = self.children.get(old)
+        if siblings is not None and dest in siblings:
+            siblings.remove(dest)
+        new = None
+        for nid in self.order:
+            if nid is not dest and nid in self.done and nid not in self.failed \
+                    and self._capacity(nid):
+                new = nid
+                break
+        self.parent[dest] = new
+        self.children.setdefault(new, []).append(dest)
+        return new
+
+    def drained(self) -> bool:
+        return all(m in self.done or m in self.failed for m in self.members)
 
 
 class PullManager:
@@ -64,6 +157,17 @@ class PullManager:
         self.cluster = cluster
         self._lock = threading.Lock()
         self._pulls: Dict[Tuple[ObjectID, NodeID], _Pull] = {}
+        # same-object pulls to DIFFERENT destinations (broadcast coalescing)
+        self._by_oid: Dict[ObjectID, List[_Pull]] = {}
+        self._plans: Dict[ObjectID, _BroadcastPlan] = {}
+        self._fanout = cfg.broadcast_fanout
+        # remote chained-pull bookkeeping for agents' locate_object requests:
+        # oid -> {node_id: [children_assigned, in_flight, monotonic_ts,
+        # assigned_parent]}.  In-flight entries are requesters mid-pull —
+        # assignable as tree parents (their data server blocks until the
+        # copy materializes); the parent pointer lets a completed/failed
+        # child release its parent's slot and blocks assignment cycles.
+        self._remote_chain: Dict[ObjectID, Dict[NodeID, list]] = {}
         # located transfers awaiting byte budget, FIFO: (pull, src_node_id, size)
         self._pending: "deque[Tuple[_Pull, NodeID, int]]" = deque()
         self._inflight_bytes = 0
@@ -80,6 +184,8 @@ class PullManager:
         self.retries = 0
         self.completed = 0
         self.bytes_pulled = 0
+        self.plans_created = 0
+        self.relay_bytes = 0
 
     # ------------------------------------------------------------------
     # public surface
@@ -92,6 +198,7 @@ class PullManager:
             callback()
             return
         key = (oid, dest_node.node_id)
+        new_plan = None
         with self._lock:
             if self._closed:
                 return
@@ -103,6 +210,32 @@ class PullManager:
                 return
             p = _Pull(oid, dest_node, callback)
             self._pulls[key] = p
+            peers = self._by_oid.setdefault(oid, [])
+            peers.append(p)
+            # broadcast coalescing: >= 2 concurrent destinations for ONE
+            # object become a bounded-fanout spanning tree — the source
+            # serves at most `fanout` children, completed destinations
+            # relay the rest (~N/fanout less root egress than N unicasts)
+            plan = self._plans.get(oid)
+            wire_check = None
+            if plan is not None:
+                plan.attach(p)
+                wire_check = plan
+            elif self._fanout > 0 and len(peers) >= 2:
+                plan = _BroadcastPlan(oid, self._fanout)
+                for q in peers:
+                    plan.attach(q)
+                plan.root = peers[0].src  # may still be unlocated (None)
+                self._plans[oid] = plan
+                self.plans_created += 1
+                new_plan = plan
+        if new_plan is not None:
+            metric_defs.BROADCAST_PLANS.inc()
+            self._maybe_wire_relay(new_plan)
+        elif wire_check is not None and p_dest_addr(p) is not None:
+            # late remote joiner: batch it (with any other unserved remote
+            # members) into a follow-up relay pass
+            self._maybe_wire_relay(wire_check)
         self._resolve(p)
 
     def prefetch(self, oids, dest_node) -> None:
@@ -133,12 +266,37 @@ class PullManager:
                 "retries": self.retries,
                 "completed": self.completed,
                 "bytes_pulled": self.bytes_pulled,
+                "broadcast_plans": self.plans_created,
+                "relay_bytes": self.relay_bytes,
+            }
+
+    def broadcast_snapshot(self) -> dict:
+        """Live broadcast-plan view (`rt pulls` / GET /api/pulls)."""
+        with self._lock:
+            active = [
+                {
+                    "oid": oid.hex()[:12],
+                    "fanout": plan.fanout,
+                    "dests": len(plan.members),
+                    "done": len(plan.done),
+                    "parked": len(plan.parked),
+                    "root": plan.root.hex()[:8] if plan.root is not None else None,
+                }
+                for oid, plan in self._plans.items()
+            ]
+            return {
+                "plans_total": self.plans_created,
+                "relay_bytes": self.relay_bytes,
+                "active": active,
             }
 
     def shutdown(self) -> None:
         with self._lock:
             self._closed = True
             self._pulls.clear()
+            self._by_oid.clear()
+            self._plans.clear()
+            self._remote_chain.clear()
             self._pending.clear()
         # cancel_futures: queued transfers must not run against a cluster
         # mid-teardown, and the futures atexit hook must not join workers
@@ -154,8 +312,9 @@ class PullManager:
         """A source is known: start the transfer if the byte budget allows,
         else queue it FIFO (later arrivals never jump a waiting pull)."""
         with self._lock:
-            if self._closed:
-                return
+            if self._closed or p.admitted:
+                return  # a concurrent wire relay already charged this pull
+            p.src = src_node_id
             size = self.cluster.directory.object_size(p.oid)
             if not self._pending and (
                 self._admitted == 0
@@ -190,6 +349,8 @@ class PullManager:
                 or self._inflight_bytes + self._pending[0][2] <= self._max_inflight
             ):
                 nxt, nxt_src, nxt_size = self._pending.popleft()
+                if nxt.admitted:
+                    continue  # a wire relay claimed it while it queued
                 self._charge_locked(nxt, nxt_size)
                 ready.append((nxt, nxt_src))
             metric_defs.PULL_MANAGER_INFLIGHT_BYTES.set(self._inflight_bytes)
@@ -215,10 +376,36 @@ class PullManager:
 
     def _complete(self, p: _Pull) -> None:
         self._uncharge(p)
+        promote: List[Tuple[_Pull, NodeID]] = []
         with self._lock:
             self._pulls.pop((p.oid, p.dest.node_id), None)
+            peers = self._by_oid.get(p.oid)
+            if peers is not None:
+                try:
+                    peers.remove(p)
+                except ValueError:
+                    pass
+                if not peers:
+                    self._by_oid.pop(p.oid, None)
             self.completed += 1
             waiters = list(p.waiters)
+            plan = self._plans.get(p.oid)
+            if plan is not None and p.dest.node_id in plan.members:
+                dest = p.dest.node_id
+                plan.done.add(dest)
+                # this destination is now a replica: promote its parked
+                # children — their edge transfers read from it, not the root
+                for child in list(plan.children.get(dest, ())):
+                    if child in plan.parked:
+                        plan.parked.discard(child)
+                        cp = plan.members.get(child)
+                        if cp is not None:
+                            cp.via_relay = True
+                            promote.append((cp, dest))
+                if plan.drained():
+                    self._plans.pop(p.oid, None)
+        for cp, src in promote:
+            self._admit_or_queue(cp, src)
         for cb in waiters:
             try:
                 cb()
@@ -250,6 +437,40 @@ class PullManager:
         timer.daemon = True
         timer.start()
 
+    def _plan_route(self, p: _Pull, src_node_id: NodeID):
+        """Broadcast routing decision for a located pull (self._lock held):
+        returns ``("go", src)`` to start the edge transfer from ``src``, or
+        ``("park", None)`` to wait (budget-free) for the assigned tree
+        parent's copy to commit."""
+        plan = self._plans.get(p.oid)
+        if plan is None:
+            return "go", src_node_id
+        dest = p.dest.node_id
+        if dest not in plan.members:
+            return "go", src_node_id
+        parent = plan.parent.get(dest)
+        p.via_relay = False
+        if parent is not None and (parent in plan.failed or parent not in plan.members):
+            # the assigned parent died/left: re-parent onto a surviving
+            # replica (completed member first, else back to the root)
+            parent = plan.reparent(dest)
+        if parent is None:
+            # root child: pin the plan root on first locate so the tree has
+            # ONE source, then route every root edge through it
+            if plan.root is None:
+                plan.root = src_node_id
+            root = plan.root
+            node = self.cluster.nodes.get(root) if root is not None else None
+            if node is not None and not node.dead:
+                return "go", root
+            plan.root = None
+            return "go", src_node_id
+        if parent in plan.done:
+            p.via_relay = True
+            return "go", parent
+        plan.parked.add(dest)
+        return "park", None
+
     def _on_located(self, p: _Pull, src_node_id: Optional[NodeID]) -> None:
         if self._closed:
             return
@@ -272,7 +493,26 @@ class PullManager:
         if src_node_id == p.dest.node_id:
             self._complete(p)
             return
-        self._admit_or_queue(p, src_node_id)
+        # wire-relay attempt FIRST: when a broadcast plan's remote members
+        # all resolve at once (the checkpoint pattern — consumers pulled
+        # before the producer committed), one chunk-pipelined relay covers
+        # the whole group; members it charges skip the per-edge path below
+        with self._lock:
+            plan = self._plans.get(p.oid)
+            wire_worthy = (
+                plan is not None
+                and p.dest.node_id in plan.members
+                and p_dest_addr(p) is not None
+            )
+        if wire_worthy:
+            self._maybe_wire_relay(plan)
+        with self._lock:
+            if p.admitted:
+                return  # a wire relay already owns this pull's attempt
+            action, src = self._plan_route(p, src_node_id)
+        if action == "park":
+            return  # promoted (budget-free) when the parent's copy commits
+        self._admit_or_queue(p, src)
 
     # ------------------------------------------------------------------
     # the transfer itself (pull-worker threads only)
@@ -362,6 +602,12 @@ class PullManager:
             cluster.transfer_bytes += size
             cluster.transfer_count += 1
             self.bytes_pulled += size
+            if p.via_relay:
+                # this edge read from a tree parent, not the root — bytes
+                # the broadcast spared the source from sending
+                self.relay_bytes += size
+        if p.via_relay and size:
+            metric_defs.BROADCAST_RELAY_BYTES.inc(size)
         dest_info = p.dest.store.entry_info(p.oid)
         cluster.directory.add_location(
             p.oid, p.dest.node_id,
@@ -369,6 +615,283 @@ class PullManager:
             tier=dest_info["tier"] if dest_info else None,
         )
         self._complete(p)
+
+    # ------------------------------------------------------------------
+    # broadcast: node death / remote chained-pull bookkeeping
+    # ------------------------------------------------------------------
+    def on_node_dead(self, node_id: NodeID) -> None:
+        """A node died (cluster kill path).  A relay member's PARKED
+        children re-resolve through the directory — replica-aware
+        wait_for lands them on a surviving copy (the purge-then-retry
+        path); in-flight children self-heal when their transfer fails."""
+        resolves: List[_Pull] = []
+        with self._lock:
+            if self._closed:
+                return
+            for plan in self._plans.values():
+                if plan.root == node_id:
+                    plan.root = None
+                if node_id in plan.members:
+                    plan.failed.add(node_id)
+                    plan.done.discard(node_id)
+                    for child in list(plan.children.get(node_id, ())):
+                        if child in plan.parked:
+                            plan.parked.discard(child)
+                            cp = plan.members.get(child)
+                            if cp is not None:
+                                resolves.append(cp)
+            for table in self._remote_chain.values():
+                if node_id in table:
+                    self._chain_release_locked(table, node_id)
+                    del table[node_id]
+        for cp in resolves:
+            self._resolve(cp)
+
+    @staticmethod
+    def _chain_release_locked(table: dict, node_id: NodeID) -> None:
+        """The edge into ``node_id`` ended (commit/failure/staleness):
+        return the assigned-child slot to its parent."""
+        entry = table.get(node_id)
+        if entry is None or entry[3] is None:
+            return
+        parent = table.get(entry[3])
+        if parent is not None and parent[0] > 0:
+            parent[0] -= 1
+        entry[3] = None
+
+    @staticmethod
+    def _chain_ancestors(table: dict, node_id: NodeID, limit: int = 16):
+        """Walk assigned-parent pointers upward (bounded)."""
+        out = []
+        entry = table.get(node_id)
+        while entry is not None and entry[3] is not None and len(out) < limit:
+            out.append(entry[3])
+            entry = table.get(entry[3])
+        return out
+
+    def on_location_committed(self, oid: ObjectID, node_id: NodeID) -> None:
+        """Directory observer: a copy committed somewhere.  A chained
+        remote destination that was mid-pull is now a full replica, and
+        its parent gets its assignment slot back."""
+        with self._lock:
+            table = self._remote_chain.get(oid)
+            if table is not None:
+                entry = table.get(node_id)
+                if entry is not None:
+                    entry[1] = False  # in-flight -> committed replica
+                    self._chain_release_locked(table, node_id)
+
+    def note_source_failed(self, oid: ObjectID, node_id: NodeID) -> None:
+        """An agent reported a failed direct pull from this peer: drop it
+        from chain assignment (the directory location is purged by the
+        caller) so new pulls re-parent onto surviving replicas."""
+        with self._lock:
+            table = self._remote_chain.get(oid)
+            if table is not None:
+                self._chain_release_locked(table, node_id)
+                table.pop(node_id, None)
+
+    def assign_remote_source(self, oid: ObjectID, requester: NodeID) -> Optional[NodeID]:
+        """Broadcast-aware source selection for an agent's ``locate_object``
+        request.  Committed replicas are load-balanced with at most
+        ``broadcast_fanout`` concurrently-assigned children each; once every
+        replica is saturated, an IN-FLIGHT requester is assigned as a
+        chained parent — its data server blocks until its copy
+        materializes, so N simultaneous pulls form a tree instead of N
+        point-to-point streams out of one producer.  Returns None when the
+        caller's directory pick should stand."""
+        fanout = self._fanout
+        if fanout <= 0:
+            return None
+        kind = None
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return None
+            if len(self._remote_chain) > 512:
+                # prune whole tables whose every entry went stale
+                for key in [
+                    k for k, t in self._remote_chain.items()
+                    if all(now - e[2] > 90.0 for e in t.values())
+                ]:
+                    self._remote_chain.pop(key, None)
+            table = self._remote_chain.setdefault(oid, {})
+            for nid in [n for n, e in table.items() if e[1] and now - e[2] > 90.0]:
+                # in-flight entry that never committed: stale — free its slot
+                self._chain_release_locked(table, nid)
+                del table[nid]
+            committed = self.cluster.directory.locations(oid)
+            for nid in committed:
+                entry = table.get(nid)
+                if entry is None:
+                    table[nid] = [0, False, now, None]
+                elif entry[1]:
+                    entry[1] = False
+                    self._chain_release_locked(table, nid)
+            nodes = self.cluster.nodes
+            cands = []
+            n_committed = 0
+            for nid, entry in table.items():
+                if nid == requester:
+                    continue
+                node = nodes.get(nid)
+                if node is None or getattr(node, "dead", False):
+                    continue
+                if entry[1] and requester in self._chain_ancestors(table, nid):
+                    # chaining the requester behind a node that (transitively)
+                    # pulls FROM the requester would deadlock both until the
+                    # pull timeout — never close the loop
+                    continue
+                cands.append((entry[0], 1 if entry[1] else 0, nid.binary(), nid, entry))
+                if not entry[1]:
+                    n_committed += 1
+            chosen = None
+            if cands:
+                under = [c for c in cands if c[0] < fanout and c[1] == 0]
+                if not under:
+                    under = [c for c in cands if c[0] < fanout]
+                pick = min(under or cands)
+                pick[4][0] += 1
+                chosen = pick[3]
+                kind = "relay" if pick[1] else ("balanced" if n_committed > 1 else "sole")
+            # register the requester as an in-flight (assignable) copy and
+            # record the edge so completion releases the parent's slot
+            mine = table.get(requester)
+            if mine is None:
+                mine = table[requester] = [0, requester not in committed, now, None]
+            else:
+                mine[2] = now
+            if chosen is not None:
+                self._chain_release_locked(table, requester)  # drop any old edge
+                mine[3] = chosen
+        if kind is not None:
+            metric_defs.PULL_SOURCE_SELECTED.inc(tags={"kind": kind})
+        return chosen
+
+    # ------------------------------------------------------------------
+    # wire relay: one chunk-pipelined data-plane broadcast covers every
+    # remote destination of a plan in a single pass
+    # ------------------------------------------------------------------
+    def _relay_client(self):
+        head_service = getattr(self.cluster, "head_service", None)
+        return getattr(head_service, "data_client", None)
+
+    def _maybe_wire_relay(self, plan: _BroadcastPlan) -> None:
+        """>= 2 plan members living behind data-plane addresses (remote
+        agents) are served by ONE relay: the head streams the object to
+        ``fanout`` first-level destinations, whose data servers commit each
+        chunk locally while forwarding it downstream.  Budget is charged
+        once per tree edge up front; if the budget is contended the plan
+        falls back to ordinary per-edge transfers (still tree-shaped)."""
+        client = self._relay_client()
+        if client is None:
+            return
+        group: List[_Pull] = []
+        with self._lock:
+            if self._closed or self._plans.get(plan.oid) is not plan:
+                return
+            if plan.root is None and not self.cluster.directory.locations(plan.oid):
+                return  # nothing to read from yet: per-edge path handles it
+            candidates = [
+                q for q in plan.members.values()
+                if not q.admitted
+                and p_dest_addr(q) is not None
+                and q.dest.node_id not in plan.done
+                and q.dest.node_id not in plan.failed
+            ]
+            if len(candidates) < 2:
+                return
+            size = self.cluster.directory.object_size(plan.oid)
+            # never charge more than the whole budget in one group — a huge
+            # fan-out must not head-of-line-block every unrelated pull for
+            # the relay's duration; trimmed members keep the per-edge path
+            if size > 0:
+                max_group = self._max_inflight // size
+                if max_group < 2:
+                    return  # objects this big pace one edge at a time
+                candidates = candidates[:max_group]
+            total = size * len(candidates)
+            if self._pending or (
+                self._admitted and self._inflight_bytes + total > self._max_inflight
+            ):
+                return  # budget contended: per-edge admission owns pacing
+            for q in candidates:
+                plan.parked.discard(q.dest.node_id)
+                self._charge_locked(q, size)
+                q.src = plan.root
+            group = candidates
+        try:
+            self._executor.submit(self._wire_relay, plan, group, client)
+        except RuntimeError:  # executor shut down mid-teardown
+            for q in group:
+                self._uncharge(q)
+
+    def _wire_relay(self, plan: _BroadcastPlan, group: List[_Pull], client) -> None:
+        from ray_tpu.runtime import data_plane
+
+        oid = plan.oid
+        cluster = self.cluster
+
+        def retry_all(pulls) -> None:
+            with self._lock:
+                self.retries += len(pulls)
+            for q in pulls:
+                metric_defs.PULL_MANAGER_RETRIES.inc()
+                q.attempts += 1
+                self._uncharge(q)
+                delay = min(self._backoff_s * (2 ** (q.attempts - 1)), 2.0)
+                self._resolve_later(q, max(delay, 0.001))
+
+        try:
+            src_id = plan.root or cluster.directory.pick_location(oid)
+            src = cluster.nodes.get(src_id) if src_id is not None else None
+            if src is None or src.dead:
+                raise RuntimeError("no live broadcast source")
+            value = src.store.get(oid, timeout=30)
+            info = src.store.entry_info(oid)
+            is_error = bool(info and info["is_error"])
+            addrs = [p_dest_addr(q) for q in group]
+            tree = data_plane.build_relay_tree(addrs, plan.fanout)
+            failed = set(client.relay(oid.binary(), value, tree, is_error=is_error))
+        except Exception:  # noqa: BLE001 — source gone / relay transport died
+            retry_all(group)
+            return
+        size = getattr(value, "nbytes", 0) or 0
+        first_level = set(addrs[: plan.fanout])
+        for q in group:
+            addr = p_dest_addr(q)
+            if addr in failed:
+                retry_all([q])
+                continue
+            try:
+                # head-side cache copy WITHOUT echoing the bytes (the relay
+                # already delivered them): callers that read the handle's
+                # store (pull relays, dispatch staging) see the value
+                skip = getattr(q.dest.store, "skip_push_once", None)
+                if skip is not None:
+                    skip(oid)
+                q.dest.store.put(oid, value, is_error=is_error)
+            except Exception:  # noqa: BLE001 — dest cache refused: retry path
+                retry_all([q])
+                continue
+            with self._lock:
+                cluster.transfer_bytes += size
+                cluster.transfer_count += 1
+                self.bytes_pulled += size
+                if addr not in first_level:
+                    self.relay_bytes += size
+            dest_info = q.dest.store.entry_info(oid)
+            cluster.directory.add_location(
+                oid, q.dest.node_id,
+                size=dest_info["size"] if dest_info else None,
+                tier=dest_info["tier"] if dest_info else None,
+            )
+            self._complete(q)
+
+
+def p_dest_addr(p: _Pull) -> Optional[str]:
+    """Data-plane address of a pull's destination (remote agents only)."""
+    return getattr(p.dest, "data_address", None) or None
 
 
 def _noop() -> None:

@@ -677,6 +677,7 @@ class HeadService:
             "pull_object": self._h_pull_object,
             "locate_object": self._h_locate_object,
             "object_location": self._h_object_location,
+            "pull_failed": self._h_pull_failed,
             "mint_put_oid": self._h_mint_put_oid,
             "release_put_oid": self._h_release_put_oid,
             "worker_api": self._h_worker_api,
@@ -742,6 +743,18 @@ class HeadService:
                     # forgotten/lost: the relay fallback owns error surfacing
                     conn.send_reply(rid, {"addr": None})
                     return
+                if requester is not None:
+                    # broadcast-aware source selection: balance committed
+                    # replicas (bounded children each) and, when all are
+                    # saturated, chain this requester behind an IN-FLIGHT
+                    # one — its data server blocks until the copy lands, so
+                    # N simultaneous pulls form a tree instead of N streams
+                    # out of one producer (pull_manager.assign_remote_source)
+                    alt = cluster.pull_manager.assign_remote_source(
+                        oid, requester.node_id
+                    )
+                    if alt is not None:
+                        src_node_id = alt
                 if requester is not None and src_node_id == requester.node_id:
                     conn.send_reply(rid, {"addr": "self"})
                     return
@@ -780,6 +793,22 @@ class HeadService:
             size=payload.get("size"),
             tier="device" if payload.get("device") else "host",
         )
+
+    def _h_pull_failed(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """An agent's direct peer pull failed: purge the stale location
+        BEFORE it re-resolves (the same purge-then-retry contract the head
+        PullManager applies) and drop the peer from broadcast chain
+        assignment, so a wedged-but-alive replica is not re-handed to every
+        subsequent consumer."""
+        oid = ObjectID(payload["oid"])
+        addr = payload.get("addr")
+        if not addr:
+            return
+        for node in list(self.cluster.nodes.values()):
+            if getattr(node, "data_address", None) == addr:
+                self.cluster.directory.remove_location(oid, node.node_id)
+                self.cluster.pull_manager.note_source_failed(oid, node.node_id)
+                return
 
     def _h_pull_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """An agent needs an object for a task dependency.  Resolve through

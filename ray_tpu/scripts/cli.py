@@ -348,6 +348,24 @@ def cmd_pulls(args) -> int:
         f"{pm.get('bytes_pulled', 0) / 1e6:.1f} MB moved, "
         f"{pm.get('dedup_hits', 0)} dedup hits, {pm.get('retries', 0)} retries"
     )
+    bc = data.get("broadcast", {})
+    active = bc.get("active", [])
+    print(
+        f"broadcast: {len(active)} active plans, "
+        f"{bc.get('plans_total', 0)} lifetime, "
+        f"{bc.get('relay_bytes', 0) / 1e6:.1f} MB relayed off-root"
+    )
+    for plan in active:
+        print(
+            f"  plan {plan['oid']}: {plan['done']}/{plan['dests']} dests done "
+            f"(fanout {plan['fanout']}, {plan['parked']} parked, "
+            f"root {plan['root'] or '?'})"
+        )
+    fc = data.get("frame_cache")
+    if fc is not None:
+        total = fc.get("hits", 0) + fc.get("misses", 0)
+        pct = f" ({100 * fc['hits'] / total:.0f}% hit)" if total else ""
+        print(f"frame cache: {fc.get('hits', 0)} hits, {fc.get('misses', 0)} misses{pct}")
     hit, miss = loc.get("hit_bytes", 0), loc.get("miss_bytes", 0)
     total = hit + miss
     pct = f" ({100 * hit / total:.0f}% local)" if total else ""

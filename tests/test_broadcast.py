@@ -1,0 +1,622 @@
+"""Spanning-tree object broadcast (ISSUE 4).
+
+Three coupled layers:
+
+  * ``data_plane.py`` grows a ``relay`` op — a chunk-pipelined tree edge
+    (recv chunk -> local write + forward) so one object reaches N
+    destinations with the SOURCE sending only ``fanout`` copies
+    (Cornet/Orchestra-style cooperative broadcast),
+  * the head-side ``PullManager`` coalesces concurrent pulls of one object
+    to different destinations into a bounded-fanout **broadcast plan** —
+    parked children hold no budget and are promoted when their tree
+    parent's copy commits; a dead relay re-parents its subtree onto
+    surviving replicas via the purge-then-retry path,
+  * the ``ObjectDirectory`` grows replica-aware ``pick_location`` so new
+    and late-joining pulls spread across copies instead of hammering the
+    first location.
+
+Root-egress bounds are asserted with BYTE accounting (socket bytes for the
+relay op, per-store read counts for the in-process plan), never timing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.object_store import ObjectStore
+from ray_tpu.observability import metric_defs
+from ray_tpu.runtime import data_plane
+from ray_tpu.runtime.cluster import ObjectDirectory
+from ray_tpu.runtime.pull_manager import PullManager
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ==========================================================================
+# unit: relay tree construction
+# ==========================================================================
+def test_build_relay_tree_fanout_bounded():
+    def check(tree, fanout):
+        seen = []
+        def walk(node):
+            seen.append(node["addr"])
+            assert len(node["children"]) <= fanout
+            for child in node["children"]:
+                walk(child)
+        for sub in tree:
+            walk(sub)
+        return seen
+
+    for n in range(1, 10):
+        for fanout in (1, 2, 3):
+            addrs = [f"a{i}" for i in range(n)]
+            tree = data_plane.build_relay_tree(addrs, fanout)
+            assert len(tree) <= fanout                   # source egress bound
+            seen = check(tree, fanout)
+            assert sorted(seen) == sorted(addrs)         # every dest exactly once
+
+    # fanout 1 is a chain: depth == N
+    tree = data_plane.build_relay_tree(["a", "b", "c"], 1)
+    assert tree[0]["addr"] == "a"
+    assert tree[0]["children"][0]["addr"] == "b"
+    assert tree[0]["children"][0]["children"][0]["addr"] == "c"
+
+
+# ==========================================================================
+# unit: the data-plane relay op over real sockets
+# ==========================================================================
+@pytest.fixture
+def dest_farm():
+    """N (store, server) pairs + a client; closed afterwards."""
+    created = []
+
+    def make(n, chunk_bytes=1 << 20):
+        stores = [ObjectStore(shm_store=None) for _ in range(n)]
+        servers = [data_plane.store_server(s, chunk_bytes=chunk_bytes) for s in stores]
+        client = data_plane.DataClient(chunk_bytes=chunk_bytes)
+        created.append((servers, client))
+        return stores, servers, client
+
+    yield make
+    for servers, client in created:
+        client.close()
+        for server in servers:
+            server.close()
+
+
+def test_relay_root_socket_egress_bounded_64mb(dest_farm):
+    """THE acceptance bar: one 64 MiB object to N >= 4 destinations moves
+    <= fanout x object bytes out of the root — socket-byte accounting on
+    the root's DataClient, not timing.  (Repeated unicast would be N x.)"""
+    n_dest, fanout = 4, 2
+    stores, servers, client = dest_farm(n_dest, chunk_bytes=8 << 20)
+    size = 64 << 20
+    value = np.full(size, 7, np.uint8)
+    oid = ObjectID.from_random()
+    tree = data_plane.build_relay_tree([s.address for s in servers], fanout)
+    failed = client.relay(oid.binary(), value, tree)
+    assert failed == []
+    for store in stores:
+        assert store.contains(oid)
+    got = stores[-1].get(oid, timeout=5)
+    assert got.nbytes == size and got[0] == 7 and got[-1] == 7
+    # root egress: fanout copies plus per-frame header slack — NOT n_dest
+    assert client.stats.bytes_sent <= fanout * size + 64 * 1024
+    assert client.stats.bytes_sent >= fanout * size  # both subtrees streamed
+
+
+def test_relay_chain_pipelines_through_interior_nodes(dest_farm):
+    """fanout=1 chain of 4: the root sends ONE copy; every interior server
+    forwards what it receives (server-side socket-byte stats), and the
+    broadcast_relay_bytes_total metric records the forwarded bytes."""
+    stores, servers, client = dest_farm(4)
+    size = 4 << 20
+    value = np.arange(size, dtype=np.uint8)
+    oid = ObjectID.from_random()
+    relayed_before = metric_defs.BROADCAST_RELAY_BYTES.get()
+    failed = client.relay(oid.binary(), value, data_plane.build_relay_tree(
+        [s.address for s in servers], 1))
+    assert failed == []
+    for store in stores:
+        np.testing.assert_array_equal(store.get(oid, timeout=5), value)
+    assert client.stats.bytes_sent <= size + 64 * 1024  # ONE copy out of the root
+    for server in servers[:3]:  # interior hops forwarded the whole object
+        assert server.stats.bytes_sent >= size
+    assert servers[3].stats.bytes_sent == 0  # the leaf forwards nothing
+    assert metric_defs.BROADCAST_RELAY_BYTES.get() - relayed_before >= 3 * size
+
+
+def test_relay_reports_failed_subtree_and_serves_the_rest(dest_farm):
+    """A dead child mid-tree: its whole subtree is reported failed (the
+    planner re-pulls exactly those); live destinations still commit."""
+    stores, servers, client = dest_farm(2)
+    # a listener that is closed before the relay: connection refused
+    import socket as _socket
+
+    dead = _socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_addr = f"127.0.0.1:{dead.getsockname()[1]}"
+    dead.close()
+    size = 1 << 20
+    value = np.ones(size, np.uint8)
+    oid = ObjectID.from_random()
+    tree = [
+        {"addr": servers[0].address, "children": [
+            {"addr": dead_addr, "children": [
+                {"addr": servers[1].address, "children": []},
+            ]},
+        ]},
+    ]
+    failed = client.relay(oid.binary(), value, tree)
+    # the dead hop AND its descendant are reported; the live parent served
+    assert dead_addr in failed
+    assert servers[1].address in failed
+    assert stores[0].contains(oid)
+    assert not stores[1].contains(oid)
+
+
+# ==========================================================================
+# unit: broadcast plans in the PullManager (in-process fabric)
+# ==========================================================================
+class _CountingStore(ObjectStore):
+    """Counts get() calls; optional gate (block-until-open) or tripwire
+    (block, then raise once released) to control transfer order."""
+
+    def __init__(self, gate=None, raise_on_release=False):
+        super().__init__(shm_store=None)
+        self.get_calls = 0
+        self.gate = gate
+        self.raise_on_release = raise_on_release
+
+    def get(self, object_id, timeout=None):
+        self.get_calls += 1
+        if self.gate is not None:
+            assert self.gate.wait(30)
+            if self.raise_on_release:
+                raise RuntimeError("relay source died")
+        return super().get(object_id, timeout=timeout)
+
+
+class _FakeNode:
+    def __init__(self, store=None):
+        self.node_id = NodeID.from_random()
+        self.store = store if store is not None else ObjectStore(shm_store=None)
+        self.dead = False
+
+
+class _FakeCluster:
+    def __init__(self):
+        self.directory = ObjectDirectory()
+        self.nodes = {}
+        self.transfer_bytes = 0
+        self.transfer_count = 0
+
+    def add(self, node):
+        self.nodes[node.node_id] = node
+        return node
+
+    def _is_pending(self, oid):
+        return False
+
+    def _try_recover(self, oid):
+        return False
+
+
+def _make_pm(fake, fanout=None):
+    pm = PullManager(fake)
+    if fanout is not None:
+        pm._fanout = fanout
+    fake.directory.location_observer = pm.on_location_committed
+    return pm
+
+
+def test_plan_bounds_root_reads_and_drains_budget():
+    """5 concurrent pulls of one object, fanout 2: the source store is read
+    exactly TWICE (the root's direct children); the other 3 copies are
+    relayed by destinations.  Budget returns to zero after the plan
+    drains and the plan itself is torn down."""
+    fake = _FakeCluster()
+    gate = threading.Event()
+    root = fake.add(_FakeNode(store=_CountingStore(gate=gate)))
+    dests = [fake.add(_FakeNode(store=_CountingStore())) for _ in range(5)]
+    pm = _make_pm(fake, fanout=2)
+    try:
+        oid = ObjectID.from_random()
+        value = np.ones(1 << 20, np.uint8)
+        root.store.put(oid, value)
+        root.store.get_calls = 0  # the put-side bookkeeping doesn't count
+        fake.directory.add_location(oid, root.node_id, size=value.nbytes, tier="host")
+        plans_before = pm.plans_created
+        events = [threading.Event() for _ in dests]
+        for dest, event in zip(dests, events):
+            pm.pull(oid, dest, event.set)
+        assert pm.plans_created - plans_before == 1
+        snap = pm.broadcast_snapshot()
+        assert snap["active"] and snap["active"][0]["dests"] == 5
+        gate.set()
+        for event in events:
+            assert event.wait(20)
+        for dest in dests:
+            assert dest.store.contains(oid)
+        # root egress bound: fanout reads, was N reads before the planner
+        assert root.store.get_calls == 2
+        # the other three edges were relayed by destinations
+        assert sum(d.store.get_calls for d in dests) == 3
+        assert pm.relay_bytes == 3 * value.nbytes
+        snap = pm.snapshot()
+        assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0
+        assert _wait_for(lambda: not pm.broadcast_snapshot()["active"])
+    finally:
+        pm.shutdown()
+
+
+def test_parked_children_hold_no_budget():
+    """Children waiting on a pending tree parent charge nothing against
+    the in-flight-byte budget — only active edges are budgeted."""
+    fake = _FakeCluster()
+    gate = threading.Event()
+    root = fake.add(_FakeNode(store=_CountingStore(gate=gate)))
+    dests = [fake.add(_FakeNode()) for _ in range(5)]
+    pm = _make_pm(fake, fanout=2)
+    try:
+        oid = ObjectID.from_random()
+        root.store.put(oid, np.ones(1 << 20, np.uint8))
+        fake.directory.add_location(oid, root.node_id, size=1 << 20, tier="host")
+        events = [threading.Event() for _ in dests]
+        for dest, event in zip(dests, events):
+            pm.pull(oid, dest, event.set)
+        snap = pm.snapshot()
+        # two root edges admitted (blocked on the gate); three children parked
+        assert snap["inflight"] == 2
+        assert snap["inflight_bytes"] == 2 << 20
+        assert pm.broadcast_snapshot()["active"][0]["parked"] == 3
+        gate.set()
+        for event in events:
+            assert event.wait(20)
+        assert pm.snapshot()["inflight_bytes"] == 0
+    finally:
+        pm.shutdown()
+
+
+def test_late_joiner_pulls_from_replica_not_root():
+    """fanout=1 chain root->A1->A2: a pull that joins while the plan is
+    active attaches under a destination, and after the plan drains the
+    round-robin directory pick keeps spreading load off the root."""
+    fake = _FakeCluster()
+    root_gate, a1_gate = threading.Event(), threading.Event()
+    root = fake.add(_FakeNode(store=_CountingStore(gate=root_gate)))
+    a1 = fake.add(_FakeNode(store=_CountingStore(gate=a1_gate)))
+    a2 = fake.add(_FakeNode(store=_CountingStore()))
+    late = fake.add(_FakeNode(store=_CountingStore()))
+    pm = _make_pm(fake, fanout=1)
+    try:
+        oid = ObjectID.from_random()
+        root.store.put(oid, np.ones(1 << 18, np.uint8))
+        root.store.get_calls = 0
+        fake.directory.add_location(oid, root.node_id, size=1 << 18, tier="host")
+        ev1, ev2, ev_late = threading.Event(), threading.Event(), threading.Event()
+        pm.pull(oid, a1, ev1.set)   # root child, blocked on root_gate
+        pm.pull(oid, a2, ev2.set)   # child of a1: parks
+        root_gate.set()
+        assert ev1.wait(20)         # a1 is now a replica; a2 promoted,
+        #                             blocked on a1_gate mid-edge
+        pm.pull(oid, late, ev_late.set)  # late joiner: root (fanout 1) and
+        #                                  a1 are full -> attaches under a2
+        a1_gate.set()
+        assert ev2.wait(20) and ev_late.wait(20)
+        assert late.store.contains(oid)
+        assert root.store.get_calls == 1      # the root served exactly ONE edge
+        assert a1.store.get_calls == 1        # a1 relayed to a2
+        assert a2.store.get_calls == 1        # the late joiner read from a2
+        # post-drain pulls keep spreading: round-robin over all four replicas
+        more = [fake.add(_FakeNode()) for _ in range(4)]
+        for node in more:
+            done = threading.Event()
+            pm.pull(oid, node, done.set)
+            assert done.wait(20)
+        assert root.store.get_calls < 1 + 4   # not every new pull hit the root
+    finally:
+        pm.shutdown()
+
+
+def test_dead_relay_reparents_subtree_onto_survivors():
+    """fanout=1 chain root->d1->d2->d3.  d1 dies after completing, while
+    serving d2: d2's failed edge purges + retries onto the root (surviving
+    replica), and d3 — parked under d2 — still completes through the
+    repaired chain.  The purge-then-retry path, end to end."""
+    fake = _FakeCluster()
+    root_gate = threading.Event()
+    trip = threading.Event()
+    root = fake.add(_FakeNode(store=_CountingStore(gate=root_gate)))
+    d1 = fake.add(_FakeNode(store=_CountingStore(gate=trip, raise_on_release=True)))
+    d2 = fake.add(_FakeNode(store=_CountingStore()))
+    d3 = fake.add(_FakeNode(store=_CountingStore()))
+    pm = _make_pm(fake, fanout=1)
+    try:
+        oid = ObjectID.from_random()
+        root.store.put(oid, np.ones(1 << 18, np.uint8))
+        root.store.get_calls = 0
+        fake.directory.add_location(oid, root.node_id, size=1 << 18, tier="host")
+        events = {n.node_id: threading.Event() for n in (d1, d2, d3)}
+        for node in (d1, d2, d3):
+            pm.pull(oid, node, events[node.node_id].set)
+        root_gate.set()
+        assert events[d1.node_id].wait(20)   # d1 committed its copy
+        # d2's edge is now blocked inside d1's store; kill d1 mid-broadcast
+        d1.dead = True
+        fake.directory.drop_node(d1.node_id)
+        pm.on_node_dead(d1.node_id)
+        trip.set()                            # d1's serve raises -> purge+retry
+        assert events[d2.node_id].wait(20)
+        assert events[d3.node_id].wait(20)
+        assert d2.store.contains(oid) and d3.store.contains(oid)
+        assert pm.snapshot()["retries"] >= 1
+        # d2 re-parented onto the root (the only surviving replica then)
+        assert root.store.get_calls == 2
+        snap = pm.snapshot()
+        assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0
+    finally:
+        pm.shutdown()
+
+
+# ==========================================================================
+# unit: wire relay — the PullManager drives ONE data-plane relay for a
+# group of remote destinations (socket-byte root egress bound)
+# ==========================================================================
+class _HeadCacheStore(ObjectStore):
+    """Head-side cache surface of a RemoteNodeHandle's store."""
+
+    def __init__(self):
+        super().__init__(shm_store=None)
+
+    def skip_push_once(self, oid):
+        pass
+
+
+class _FakeRemoteDest:
+    """RemoteNodeHandle shape: a head-side cache store + the agent's real
+    store served by a DataServer at data_address."""
+
+    def __init__(self, server_address):
+        self.node_id = NodeID.from_random()
+        self.store = _HeadCacheStore()
+        self.data_address = server_address
+        self.dead = False
+
+
+def test_wire_relay_serves_remote_group_with_bounded_root_egress(dest_farm):
+    """4 remote destinations pull one 8 MiB object BEFORE it is produced
+    (the checkpoint-broadcast pattern).  When the location commits, the
+    planner runs ONE chunk-pipelined relay: every agent store receives the
+    bytes, head caches fill without echo pushes, and the head's socket
+    egress stays <= fanout x size (was N x)."""
+    from types import SimpleNamespace
+
+    agent_stores, servers, client = dest_farm(4, chunk_bytes=1 << 20)
+    fake = _FakeCluster()
+    fake.head_service = SimpleNamespace(data_client=client)
+    src = fake.add(_FakeNode())
+    dests = [fake.add(_FakeRemoteDest(server.address)) for server in servers]
+    pm = _make_pm(fake, fanout=2)
+    try:
+        oid = ObjectID.from_random()
+        size = 8 << 20
+        value = np.full(size, 3, np.uint8)
+        events = [threading.Event() for _ in dests]
+        for dest, event in zip(dests, events):
+            pm.pull(oid, dest, event.set)   # object not produced yet: all wait
+        assert pm.snapshot()["inflight"] == 0   # unlocated pulls hold no budget
+        # the producer commits: one wire relay covers the whole group
+        src.store.put(oid, value)
+        fake.directory.add_location(oid, src.node_id, size=size, tier="host")
+        for event in events:
+            assert event.wait(30)
+        for store in agent_stores:               # the AGENT stores got the bytes
+            assert store.contains(oid)
+        for dest in dests:                       # and the head caches filled
+            assert dest.store.contains(oid)
+            assert dest.node_id in fake.directory.locations(oid)
+        # socket-byte accounting: the head streamed only fanout copies
+        assert client.stats.bytes_sent <= 2 * size + 64 * 1024
+        assert servers[0].stats.bytes_sent + servers[1].stats.bytes_sent >= 2 * size
+        snap = pm.snapshot()
+        assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0
+        assert _wait_for(lambda: not pm.broadcast_snapshot()["active"])
+    finally:
+        pm.shutdown()
+
+
+# ==========================================================================
+# unit: replica-aware directory selection (satellite)
+# ==========================================================================
+def test_pick_location_spreads_across_replicas():
+    directory = ObjectDirectory()
+    oid = ObjectID.from_random()
+    nodes = [NodeID.from_random() for _ in range(3)]
+    for nid in nodes:
+        directory.add_location(oid, nid, size=1024, tier="host")
+    picks = [directory.pick_location(oid) for _ in range(9)]
+    counts = {nid: picks.count(nid) for nid in nodes}
+    assert all(count == 3 for count in counts.values()), counts  # round-robin
+    # exclude filters; a sole replica is always returned
+    only = directory.pick_location(oid, exclude=set(nodes[1:]))
+    assert only == nodes[0]
+    sole = ObjectID.from_random()
+    directory.add_location(sole, nodes[0])
+    assert all(directory.pick_location(sole) == nodes[0] for _ in range(3))
+    assert directory.pick_location(ObjectID.from_random()) is None
+
+
+def test_pick_location_feeds_source_metric():
+    directory = ObjectDirectory()
+    oid = ObjectID.from_random()
+    for _ in range(2):
+        directory.add_location(oid, NodeID.from_random(), size=64, tier="host")
+    balanced_before = metric_defs.PULL_SOURCE_SELECTED.get({"kind": "balanced"})
+    directory.pick_location(oid)
+    assert metric_defs.PULL_SOURCE_SELECTED.get({"kind": "balanced"}) == balanced_before + 1
+
+
+def test_assign_remote_source_chains_behind_inflight_requesters():
+    """locate_object-side broadcasting: with the sole replica saturated at
+    ``fanout`` children, the next requesters are chained behind IN-FLIGHT
+    requesters — forming a tree instead of N streams out of the producer.
+    Completed requesters (location committed) become balanced sources."""
+    fake = _FakeCluster()
+    producer = fake.add(_FakeNode())
+    requesters = [fake.add(_FakeNode()) for _ in range(5)]
+    pm = _make_pm(fake, fanout=1)
+    try:
+        oid = ObjectID.from_random()
+        producer.store.put(oid, b"x" * 64)
+        fake.directory.add_location(oid, producer.node_id, size=64, tier="host")
+        relay_before = metric_defs.PULL_SOURCE_SELECTED.get({"kind": "relay"})
+        first = pm.assign_remote_source(oid, requesters[0].node_id)
+        assert first == producer.node_id            # replica has capacity
+        second = pm.assign_remote_source(oid, requesters[1].node_id)
+        assert second == requesters[0].node_id      # producer saturated: chain
+        third = pm.assign_remote_source(oid, requesters[2].node_id)
+        assert third == requesters[1].node_id       # chain extends, fanout 1
+        assert metric_defs.PULL_SOURCE_SELECTED.get({"kind": "relay"}) >= relay_before + 2
+        # requester 0 commits its copy: it now serves as a REPLICA and its
+        # parent's (the producer's) assignment slot is RELEASED; a failed
+        # peer is dropped from assignment entirely, freeing its slot too
+        fake.directory.add_location(oid, requesters[0].node_id, size=64, tier="host")
+        pm.note_source_failed(oid, requesters[1].node_id)
+        fake.directory.remove_location(oid, requesters[1].node_id)
+        fourth = pm.assign_remote_source(oid, requesters[3].node_id)
+        # freed committed replicas win over chaining behind in-flight pulls
+        assert fourth in (producer.node_id, requesters[0].node_id)
+    finally:
+        pm.shutdown()
+
+
+def test_assign_remote_source_never_closes_a_cycle():
+    """Both chained requesters lose their source: re-assignment must not
+    chain A behind B while B (transitively) pulls from A — that would
+    deadlock both until the pull timeout."""
+    fake = _FakeCluster()
+    producer = fake.add(_FakeNode())
+    req_a = fake.add(_FakeNode())
+    req_b = fake.add(_FakeNode())
+    req_c = fake.add(_FakeNode())
+    pm = _make_pm(fake, fanout=1)
+    try:
+        oid = ObjectID.from_random()
+        producer.store.put(oid, b"x" * 64)
+        fake.directory.add_location(oid, producer.node_id, size=64, tier="host")
+        assert pm.assign_remote_source(oid, req_a.node_id) == producer.node_id
+        assert pm.assign_remote_source(oid, req_b.node_id) == req_a.node_id
+        # the producer dies before either copy lands
+        producer.dead = True
+        fake.directory.remove_location(oid, producer.node_id)
+        pm.note_source_failed(oid, producer.node_id)
+        # A re-locates: B is the only other entry, but B pulls FROM A —
+        # assignment must refuse the loop (fall back to the directory pick)
+        assert pm.assign_remote_source(oid, req_a.node_id) is None
+        # an unrelated requester may still chain behind B
+        assert pm.assign_remote_source(oid, req_c.node_id) in (
+            req_a.node_id, req_b.node_id
+        )
+    finally:
+        pm.shutdown()
+
+
+def test_broadcast_metric_families_in_catalog():
+    """The new families ride the default catalog, so the tier-1
+    exposition-validity test (test_tracing) covers them automatically."""
+    names = {m.name for m in metric_defs.ALL_METRICS}
+    assert {
+        "broadcast_plans_total",
+        "broadcast_relay_bytes_total",
+        "pull_source_selected_total",
+    } <= names
+
+
+# ==========================================================================
+# satellite: data-server frame cache knob + hit/miss counters
+# ==========================================================================
+def test_frame_cache_knob_and_counters(monkeypatch):
+    from ray_tpu.core.config import get_config
+
+    monkeypatch.setattr(get_config(), "data_server_frame_cache_entries", 2)
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store, chunk_bytes=1 << 20)
+    client = data_plane.DataClient(chunk_bytes=1 << 20)
+    try:
+        oids = [ObjectID.from_random() for _ in range(3)]
+        for oid in oids:
+            store.put(oid, np.ones(2048, np.uint8))
+        client.pull(server.address, oids[0].binary())
+        client.pull(server.address, oids[0].binary())
+        assert server.stats.frame_cache_hits == 1
+        assert server.stats.frame_cache_misses == 1
+        # capacity 2: pulling two more objects evicts the first (LRU)
+        client.pull(server.address, oids[1].binary())
+        client.pull(server.address, oids[2].binary())
+        client.pull(server.address, oids[0].binary())
+        assert server.stats.frame_cache_misses == 4
+        snap = server.stats.snapshot()
+        assert snap["frame_cache_hits"] == 1 and snap["frame_cache_misses"] == 4
+    finally:
+        client.close()
+        server.close()
+
+
+# ==========================================================================
+# integration: real cluster — plans form for real concurrent consumers
+# ==========================================================================
+def test_broadcast_plan_forms_for_concurrent_consumers(ray_start_cluster):
+    """N consumers of one 8 MiB object pinned to DIFFERENT nodes: the
+    fabric builds one broadcast plan and the object lands everywhere with
+    the producing store read at most fanout times."""
+    rt, cluster = ray_start_cluster
+    producer_node = cluster.add_node({"CPU": 1, "prod": 1})
+    consumer_nodes = [cluster.add_node({"CPU": 1}) for _ in range(3)]
+    nbytes = 8 * 1024 * 1024
+
+    @rt.remote(execution="thread", resources={"prod": 1}, num_cpus=0)
+    def produce():
+        return np.ones(nbytes, np.uint8)
+
+    ref = produce.remote()
+    assert _wait_for(lambda: cluster.directory.locations(ref.id()))
+    # gate the producing store so all three pulls register while the first
+    # edges are in flight (the broadcast window is microseconds otherwise)
+    gate = threading.Event()
+    orig_get = producer_node.store.get
+
+    def gated_get(oid, timeout=None):
+        assert gate.wait(30)
+        return orig_get(oid, timeout=timeout)
+
+    producer_node.store.get = gated_get
+    try:
+        plans_before = cluster.pull_manager.plans_created
+        relay_before = cluster.pull_manager.relay_bytes
+        events = [threading.Event() for _ in consumer_nodes]
+        for node, event in zip(consumer_nodes, events):
+            cluster.pull_object(ref.id(), node, event.set)
+        gate.set()
+        for event in events:
+            assert event.wait(30)
+    finally:
+        producer_node.store.get = orig_get
+    assert cluster.pull_manager.plans_created - plans_before == 1
+    for node in consumer_nodes:
+        assert node.store.contains(ref.id())
+        assert node.node_id in cluster.directory.locations(ref.id())
+    # tree accounting: with fanout 2 and 3 dests, the third edge relayed
+    assert cluster.pull_manager.relay_bytes - relay_before >= nbytes
+    snap = cluster.pull_manager.snapshot()
+    assert snap["inflight"] == 0 and snap["inflight_bytes"] == 0

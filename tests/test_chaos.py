@@ -342,6 +342,99 @@ def test_schedule_node_kill_through_runner(ray_start_cluster):
 
 
 # --------------------------------------------------------------------------
+# 6. relay-node kill mid-broadcast (ISSUE 4): a fanout-1 broadcast chain
+#    head -> B -> C -> D with B killed by the schedule while it is serving
+#    C.  C's failed edge takes the purge-then-retry path and re-parents
+#    onto the surviving replica (the head); D — parked under C — completes
+#    through the repaired chain.  The armed put failpoint makes every
+#    commit attempt a workload-driven decision-stream hit: the broadcast
+#    is fully sequential (gated), so same-seed runs produce byte-identical
+#    fault logs even THROUGH the kill.
+# --------------------------------------------------------------------------
+def _relay_kill_run(seed):
+    import threading
+
+    import numpy as np
+
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        node_b = cluster.add_node({"CPU": 1})  # schedule victim (index 0)
+        node_c = cluster.add_node({"CPU": 1})
+        node_d = cluster.add_node({"CPU": 1})
+
+        schedule = ChaosSchedule(
+            [
+                ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)"),
+                ChaosEvent(0.8, "kill_node", index=0),
+            ],
+            seed=seed, name="relay-kill-broadcast",
+        )
+
+        def workload():
+            pm = cluster.pull_manager
+            old_fanout = pm._fanout
+            pm._fanout = 1  # chain topology: B is everyone's relay
+            # the broadcast payload; the armed put failpoint may fire —
+            # application-level retry consumes hits deterministically
+            while True:
+                try:
+                    ref = rt.put(np.ones(4 << 20, np.uint8))
+                    break
+                except failpoints.FailpointInjected:
+                    continue
+            oid = ref.id()
+            # hold B's outbound serve: C stays blocked mid-edge until the
+            # schedule's kill lands, then the edge fails loudly
+            trip = threading.Event()
+            orig_get = node_b.store.get
+
+            def tripping_get(o, timeout=None):
+                assert trip.wait(60)
+                raise RuntimeError("relay node died mid-serve")
+
+            node_b.store.get = tripping_get
+            try:
+                done = {
+                    n.node_id: threading.Event() for n in (node_b, node_c, node_d)
+                }
+                for n in (node_b, node_c, node_d):
+                    cluster.pull_object(oid, n, done[n.node_id].set)
+                assert done[node_b.node_id].wait(30)  # B holds a copy; C is
+                #                                       blocked inside B's store
+                deadline = time.monotonic() + 30
+                while not node_b.dead and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert node_b.dead, "schedule kill never landed"
+                trip.set()  # C's edge fails -> purge-then-retry -> the head
+                assert done[node_c.node_id].wait(60)
+                assert done[node_d.node_id].wait(60)
+                assert node_c.store.contains(oid)
+                assert node_d.store.contains(oid)
+            finally:
+                node_b.store.get = orig_get
+                pm._fanout = old_fanout
+            return [ref]
+
+        result = ChaosRunner(schedule, quiesce_timeout=60).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        killed = [e for e in result.events_applied if e["kind"] == "kill_node"]
+        assert killed and killed[0]["node"] == node_b.node_id.hex()[:8]
+        assert cluster.pull_manager.retries >= 1  # the re-parenting retry
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_relay_node_kill_mid_broadcast():
+    r1 = _relay_kill_run(seed=11)
+    r2 = _relay_kill_run(seed=11)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert all(f["fp"] == "object_store.put" for f in r1.faults)
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
 # schedule JSON round trip + CLI-facing loader
 # --------------------------------------------------------------------------
 def test_schedule_json_round_trip(tmp_path):
