@@ -155,6 +155,11 @@ ROW_GROUPS = [
     # Own fresh-runtime group — 256 MiB of buffers must not churn the page
     # cache under other rows.
     ["broadcast_64mb_to_n", "broadcast_root_egress_x"],
+    # 4-stage cross-node actor pipeline through an INSTALLED execution plan
+    # (ISSUE 5): per-iteration latency with zero TaskSpecs/ObjectRefs, and
+    # the dispatch-overhead ratio vs the equivalent .remote() chain.  Own
+    # fresh-runtime group — it adds a node.
+    ["compiled_pipeline_iter", "compiled_pipeline_vs_remote_x"],
 ]
 
 
@@ -187,6 +192,7 @@ def main() -> None:
         "single_client_tasks_and_get_batch",
         "locality_arg_tasks",
         "broadcast_64mb_to_n",
+        "compiled_pipeline_iter",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

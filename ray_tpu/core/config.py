@@ -174,6 +174,11 @@ class Config:
     # zero-copy local sharing role (reference: plasma/store.h:55, fd
     # passing fling.cc). Disable to force every transfer onto sockets.
     same_host_shm_transfer: bool = True
+    # Compiled execution plans (dag/plan.py): per-frame timeout of the
+    # persistent chan_push channel streams AND the inbound-slot delivery
+    # wait.  A full consumer slot stalls the producer's ack this long
+    # before the stream (and the plan) is declared wedged.
+    compiled_plan_channel_timeout_s: float = 300.0
     # Default timeout for one actor-collective round (rendezvous + reduce).
     # Callers waiting on a collective result (rt.get) should budget MORE
     # than this so the collective's own timeout fires first with the

@@ -20,6 +20,18 @@ from ray_tpu.dag.dag_node import (
 from ray_tpu.dag.compiled import CompiledDAG
 from ray_tpu.dag.channel import Channel, ChannelClosed, DeviceChannel
 
+
+def __getattr__(name):
+    # Lazy: plan.py pulls in runtime.channel_manager, which imports
+    # dag.channel (and thus this package __init__) — an eager import here
+    # would be circular when channel_manager loads first (agent processes).
+    if name == "ExecutionPlan":
+        from ray_tpu.dag.plan import ExecutionPlan
+
+        return ExecutionPlan
+    raise AttributeError(name)
+
+
 __all__ = [
     "DAGNode",
     "FunctionNode",
@@ -28,6 +40,7 @@ __all__ = [
     "InputAttributeNode",
     "MultiOutputNode",
     "CompiledDAG",
+    "ExecutionPlan",
     "Channel",
     "ChannelClosed",
     "DeviceChannel",

@@ -35,9 +35,17 @@ class Channel:
                 raise TimeoutError("channel write timed out")
             if self._closed:
                 raise ChannelClosed()
-            self._value = value
+            # placement (the _place hook) runs AFTER the slot is acquired:
+            # under backpressure the pre-placement value must not already be
+            # pinned to the target device — that holds TWO copies in HBM for
+            # the whole wait (DeviceChannel's device_put happens here)
+            self._value = self._place(value)
             self._full = True
             self._cond.notify_all()
+
+    @staticmethod
+    def _place(value: Any) -> Any:
+        return value
 
     def read(self, timeout: Optional[float] = None) -> Any:
         with self._cond:
@@ -69,9 +77,12 @@ class DeviceChannel(Channel):
         super().__init__()
         self._device = device
 
-    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+    def _place(self, value: Any) -> Any:
+        # runs inside write() AFTER the slot is free: a writer blocked on a
+        # full channel holds only the source copy, never a second
+        # device-resident one (ICI copy deferred until it can be consumed)
         if self._device is not None:
             import jax
 
             value = jax.device_put(value, self._device)
-        super().write(value, timeout)
+        return value

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
-from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, Optional
 
 from ray_tpu.dag.dag_node import (
@@ -127,17 +126,24 @@ class CompiledDAG:
         if inst.dead:
             raise ActorDiedError(node.actor_handle._actor_id)
         # ride the actor's own call queue: executes on the actor thread in
-        # program order with queued .remote() calls, minus TaskSpec/ObjectRef
+        # program order with queued .remote() calls, minus TaskSpec/ObjectRef.
+        # The future registers with the actor's death notification, so a
+        # kill with the call still queued surfaces ActorDiedError the
+        # instant the death sweep runs — not at the next poll tick.
         fut: Future = Future()
-        inst.call_queue.put(("__direct__", (node.method_name, args, kwargs, fut)))
-        while True:
+
+        def on_death() -> None:
             try:
-                return fut.result(timeout=1.0)
-            except FuturesTimeoutError:
-                # actor killed with the call still queued: its thread exited
-                # without draining, so the future would never resolve
-                if inst.dead:
-                    raise ActorDiedError(node.actor_handle._actor_id) from None
+                fut.set_exception(ActorDiedError(node.actor_handle._actor_id))
+            except BaseException:  # noqa: BLE001 — call already resolved
+                pass
+
+        inst.on_death(on_death)
+        try:
+            inst.call_queue.put(("__direct__", (node.method_name, args, kwargs, fut)))
+            return fut.result()
+        finally:
+            inst.remove_death_callback(on_death)
 
     # ------------------------------------------------------------------
     # public API

@@ -66,6 +66,15 @@ class DAGNode:
 
         return CompiledDAG(self, fuse=fuse)
 
+    def compile_plan(self, name: str = "") -> "ExecutionPlan":
+        """Compile an actor-method DAG into a multi-host execution plan:
+        stage programs installed ONCE on every participating node, edges as
+        persistent channels, zero TaskSpecs/ObjectRefs per execute()
+        (docs/compiled_dags.md)."""
+        from ray_tpu.dag.plan import ExecutionPlan
+
+        return ExecutionPlan(self, name=name)
+
     def _resolve(self, value, cache):
         return cache[id(value)] if isinstance(value, DAGNode) else value
 

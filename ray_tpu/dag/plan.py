@@ -1,0 +1,573 @@
+"""Multi-host compiled execution plans: install-once DAG schedules.
+
+Reference parity: the accelerated-DAG runtime
+(``python/ray/dag/compiled_dag_node.py:278`` + the mutable plasma/NCCL
+channels under ``python/ray/experimental/channel/``) — and the Pathways
+insight behind it (Barham et al., MLSys 2022): amortize single-controller
+dispatch by tracing the graph ONCE and executing many times over
+pre-established channels.
+
+:class:`CompiledDAG` (``dag/compiled.py``) covers the single-process cases
+(XLA fusion, in-proc direct schedule); this module covers the case it
+silently fell back on — a DAG of actor-method stages whose actors live on
+REMOTE nodes.  Compiling builds per-process **stage programs** installed
+once on each participating node agent via the ``install_plan`` control RPC;
+every DAG edge becomes a named channel (``runtime/channel_manager.py``):
+an in-proc single-slot channel when producer and consumer are co-located, a
+persistent seq-numbered data-plane stream (``chan_push``) when they cross
+processes.  ``plan.execute(args)`` then pushes the input to the entry
+channels and awaits the output channel — zero TaskSpecs, zero scheduler
+hops, zero ObjectRefs per iteration; ``execute_async`` pipelines successive
+iterations through the stages (each single-slot edge buffers one iteration,
+so a k-stage pipeline runs ~k iterations concurrently).
+
+Failure story: a stage actor raising a USER exception fails that iteration
+(the typed error travels the channels like any value) and the plan stays
+READY; actor or node DEATH surfaces a typed error (ActorDiedError /
+WorkerCrashedError) on the output channel and flips the plan to BROKEN —
+subsequent executes raise immediately, and ``teardown()`` releases the
+channels on every agent.  Channel traffic rides the existing
+``data_plane.send_frame`` failpoint, so seeded chaos schedules perturb
+plans through the same deterministic decision stream as every other
+transfer.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.channel import ChannelClosed
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    _DagInput,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    WorkerCrashedError,
+    raised_copy,
+)
+from ray_tpu.runtime.channel_manager import (
+    NodeActorInvoker,
+    StageExecutor,
+    StageSpec,
+    _set_future,
+    global_manager,
+)
+
+_SYSTEM_ERRORS = (ActorDiedError, WorkerCrashedError)
+
+
+class _DriverInvoker:
+    """Invoker over DRIVER-PROCESS nodes: resolves each stage actor against
+    the in-process Node hosting it (the driver process may host several
+    nodes of an in-process cluster)."""
+
+    def __init__(self, cluster, actor_node_ids: Dict[Any, Any]):
+        self._subs = {
+            actor_id: NodeActorInvoker(cluster.nodes[node_id])
+            for actor_id, node_id in actor_node_ids.items()
+        }
+
+    def resolve(self, actor_id):
+        return self._subs[actor_id].resolve(actor_id)
+
+    def invoke(self, inst, actor_id, method, args, kwargs):
+        return self._subs[actor_id].invoke(inst, actor_id, method, args, kwargs)
+
+
+class _StageDraft:
+    __slots__ = ("stage_id", "node", "actor_id", "node_id", "proc",
+                 "arg_slots", "kw_slots", "inchan", "outs", "name")
+
+    def __init__(self, stage_id: int, node: ClassMethodNode):
+        self.stage_id = stage_id
+        self.node = node
+        self.actor_id = node.actor_handle._actor_id
+        self.node_id = None
+        self.proc = None
+        self.arg_slots: List[tuple] = []
+        self.kw_slots: Dict[str, tuple] = {}
+        self.inchan: Optional[str] = None
+        self.outs: List[str] = []
+        self.name = node.method_name
+
+
+class ExecutionPlan:
+    """Compile a DAG of actor-method stages into an installed multi-host
+    schedule; see the module docstring.  Build via
+    ``dag_node.compile_plan()``."""
+
+    def __init__(self, root: DAGNode, name: str = ""):
+        from ray_tpu.api import _auto_init, get_cluster
+
+        _auto_init()
+        self._cluster = get_cluster()
+        self.plan_id = os.urandom(8).hex()
+        self.name = name or f"plan-{self.plan_id[:8]}"
+        self._state = "READY"
+        self._error: Optional[BaseException] = None
+        self._state_lock = threading.Lock()
+        self._submit_lock = threading.Lock()
+        self._seq = 0
+        self._completed = 0
+        self._failed = 0
+        self._manager = global_manager()
+        self._executor: Optional[StageExecutor] = None
+        self._remote_handles: Dict[str, Any] = {}   # proc key -> RemoteNodeHandle
+        self._entry_writes: List[Any] = []          # callables write(seq, payload)
+        self._out_channels: List[Any] = []
+        self._streams: List[Any] = []               # driver-owned ChannelStreams
+        self._trace_id = f"plan-{self.plan_id[:12]}"
+        self._pending: "queue.Queue" = queue.Queue()
+
+        self._compile(root)
+        try:
+            self._install()
+        except BaseException:
+            # partial install (an agent may already hold stages): release
+            # everything so nothing leaks from a failed compile
+            self._state = "TORN_DOWN"
+            for handle in self._remote_handles.values():
+                try:
+                    handle.conn.request(
+                        "uninstall_plan", {"plan": self.plan_id}, timeout=5.0
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._executor is not None:
+                self._executor.stop()
+            self._manager.release_plan(self.plan_id)
+            raise
+        self._cluster.compiled_plans[self.plan_id] = self
+        self._drainer = threading.Thread(
+            target=self._drain_loop, name=f"plan-{self.plan_id[:8]}-out", daemon=True
+        )
+        self._drainer.start()
+
+    # ------------------------------------------------------------------
+    # compilation: DAG -> stages + channels
+    # ------------------------------------------------------------------
+    def _compile(self, root: DAGNode) -> None:
+        order = root.topological()
+        for node in order:
+            if isinstance(node, FunctionNode):
+                raise ValueError(
+                    "ExecutionPlan compiles actor-method DAGs; function nodes "
+                    "belong to CompiledDAG (experimental_compile)"
+                )
+        drafts: Dict[int, _StageDraft] = {}
+        consts: List[Any] = []
+        for node in order:
+            if not isinstance(node, ClassMethodNode):
+                continue
+            draft = _StageDraft(len(drafts), node)
+            drafts[id(node)] = draft
+
+            def slot_for(value, draft=draft):
+                if isinstance(value, InputNode):
+                    return ("input", None)
+                if isinstance(value, InputAttributeNode):
+                    return ("input", value._key)
+                if isinstance(value, ClassMethodNode):
+                    producer = drafts[id(value)]
+                    chan = f"s{producer.stage_id}_s{draft.stage_id}"
+                    if chan not in producer.outs:
+                        producer.outs.append(chan)
+                    return ("chan", chan)
+                if isinstance(value, DAGNode):
+                    raise ValueError(f"unsupported DAG node {type(value).__name__} in plan")
+                consts.append(value)
+                return ("const", len(consts) - 1)
+
+            draft.arg_slots = [slot_for(a) for a in node._bound_args]
+            draft.kw_slots = {k: slot_for(v) for k, v in node._bound_kwargs.items()}
+            slots = list(draft.arg_slots) + list(draft.kw_slots.values())
+            if any(kind == "input" for kind, _ in slots):
+                draft.inchan = f"in_s{draft.stage_id}"
+            if not any(kind in ("input", "chan") for kind, _ in slots):
+                raise ValueError(
+                    f"stage {draft.name!r} has no per-iteration inputs "
+                    "(all-constant stages have nothing to trigger them)"
+                )
+        if not drafts:
+            raise ValueError("ExecutionPlan needs at least one actor-method stage")
+
+        # terminal node(s) -> output channels, in leaf order
+        if isinstance(root, MultiOutputNode):
+            leaves = list(root._bound_args)
+            if not all(isinstance(leaf, ClassMethodNode) for leaf in leaves):
+                raise ValueError("MultiOutputNode leaves must be actor-method stages")
+            self._multi_output = True
+        elif isinstance(root, ClassMethodNode):
+            leaves = [root]
+            self._multi_output = False
+        else:
+            raise ValueError(
+                f"plan root must be an actor-method stage, got {type(root).__name__}"
+            )
+        self._output_names: List[str] = []
+        for j, leaf in enumerate(leaves):
+            draft = drafts[id(leaf)]
+            chan = f"s{draft.stage_id}_out{j}"
+            draft.outs.append(chan)
+            self._output_names.append(chan)
+
+        # placement: every stage actor must be ALIVE somewhere
+        self._stages = list(drafts.values())
+        self._consts = consts
+        self._actor_ids = set()
+        self._node_ids = set()
+        for draft in self._stages:
+            draft.node_id = self._wait_actor_alive(draft.actor_id)
+            draft.proc = self._proc_key(draft.node_id)
+            self._actor_ids.add(draft.actor_id)
+            self._node_ids.add(draft.node_id)
+
+    def _wait_actor_alive(self, actor_id, timeout: float = 30.0):
+        from ray_tpu.runtime.control import ActorState
+
+        deadline = time.monotonic() + timeout
+        while True:
+            info = self._cluster.control.actors.get(actor_id)
+            if info is None:
+                raise ValueError(f"unknown actor {actor_id.hex()[:8]} in plan")
+            if info.state is ActorState.DEAD:
+                raise ActorDiedError(actor_id, "stage actor died before plan install")
+            if info.state is ActorState.ALIVE and info.node_id is not None:
+                return info.node_id
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"stage actor {actor_id.hex()[:8]} never became ALIVE"
+                )
+            time.sleep(0.01)
+
+    def _proc_key(self, node_id) -> str:
+        node = self._cluster.nodes.get(node_id)
+        if node is None:
+            raise ValueError(f"stage actor's node {node_id.hex()[:8]} is unknown")
+        return node_id.hex() if hasattr(node, "conn") else "driver"
+
+    # ------------------------------------------------------------------
+    # install: per-process stage programs + channels (ONCE)
+    # ------------------------------------------------------------------
+    def _driver_addr_for(self, handle) -> str:
+        """The driver's data endpoint as dialable from ``handle``'s host."""
+        head_service = self._cluster.head_service
+        if head_service is None:
+            raise RuntimeError("remote plan stages require the head service")
+        return f"{handle.conn.local_ip}:{head_service.data_server.port}"
+
+    def _install(self) -> None:
+        from ray_tpu.core.config import get_config
+        from ray_tpu.runtime import data_plane, rpc
+
+        cfg = get_config()
+        procs = sorted({d.proc for d in self._stages})
+        by_proc: Dict[str, List[_StageDraft]] = {p: [] for p in procs}
+        for draft in self._stages:
+            by_proc[draft.proc].append(draft)
+        proc_of_chan: Dict[str, str] = {}    # channel -> hosting proc
+        writer_addr: Dict[str, tuple] = {}   # channel -> (producer proc, consumer proc)
+        stage_proc = {d.stage_id: d.proc for d in self._stages}
+        for draft in self._stages:
+            for chan in draft.outs:
+                if chan in self._output_names:
+                    consumer = "driver"
+                else:
+                    consumer = stage_proc[int(chan.rsplit("_s", 1)[1])]
+                proc_of_chan[chan] = consumer
+                if draft.proc != consumer:
+                    writer_addr[chan] = (draft.proc, consumer)
+            if draft.inchan is not None:
+                proc_of_chan[draft.inchan] = draft.proc
+                if draft.proc != "driver":
+                    writer_addr[draft.inchan] = ("driver", draft.proc)
+
+        for proc in procs:
+            if proc == "driver":
+                continue
+            from ray_tpu.core.ids import NodeID
+
+            handle = self._cluster.nodes.get(NodeID(bytes.fromhex(proc)))
+            if handle is None or handle.dead:
+                raise WorkerCrashedError(f"plan node {proc[:8]} died during install")
+            self._remote_handles[proc] = handle
+
+        def addr_of(proc: str, from_proc: str) -> str:
+            if proc == "driver":
+                return self._driver_addr_for(self._remote_handles[from_proc])
+            return self._remote_handles[proc].data_address
+
+        # driver-hosted channels (locals + inbound from agents)
+        driver_chans = [c for c, p in proc_of_chan.items() if p == "driver"]
+        chans = self._manager.register(self.plan_id, driver_chans)
+        self._out_channels = [chans[c] for c in self._output_names]
+
+        # driver-side outbound writers (driver -> agent edges)
+        driver_writers: Dict[str, Any] = {}
+        for chan, (pproc, cproc) in writer_addr.items():
+            if pproc != "driver":
+                continue
+            stream = data_plane.ChannelStream(
+                addr_of(cproc, pproc), self.plan_id, chan,
+                chunk_bytes=cfg.object_transfer_chunk_bytes,
+                timeout=cfg.compiled_plan_channel_timeout_s,
+            )
+            driver_writers[chan] = stream
+            self._streams.append(stream)
+
+        # entry writes, one per stage consuming the DAG input, in stage order
+        for draft in sorted(self._stages, key=lambda d: d.stage_id):
+            if draft.inchan is None:
+                continue
+            if draft.proc == "driver":
+                ch = chans[draft.inchan]
+                self._entry_writes.append(
+                    lambda seq, payload, ch=ch: ch.write(seq, payload)
+                )
+            else:
+                stream = driver_writers[draft.inchan]
+                self._entry_writes.append(
+                    lambda seq, payload, stream=stream: stream.push(seq, payload)
+                )
+
+        # remote installs: ONE control RPC per participating agent
+        for proc in procs:
+            if proc == "driver":
+                continue
+            handle = self._remote_handles[proc]
+            payload = {
+                "plan": self.plan_id,
+                "channels": [c for c, p in proc_of_chan.items() if p == proc],
+                "writers": {
+                    chan: addr_of(cproc, proc)
+                    for chan, (pproc, cproc) in writer_addr.items()
+                    if pproc == proc
+                },
+                "consts": rpc.dumps_value(self._consts),
+                "stages": [
+                    {
+                        "stage": d.stage_id,
+                        "actor_id": d.actor_id.binary(),
+                        "method": d.node.method_name,
+                        "name": d.name,
+                        "args": [list(s) for s in d.arg_slots],
+                        "kwargs": {k: list(s) for k, s in d.kw_slots.items()},
+                        "inchan": d.inchan,
+                        "outs": d.outs,
+                    }
+                    for d in by_proc[proc]
+                ],
+            }
+            handle.conn.request("install_plan", payload, timeout=60.0)
+
+        # driver-hosted stage executor
+        driver_stages = [
+            StageSpec(d.stage_id, d.actor_id, d.node.method_name, d.name,
+                      d.arg_slots, d.kw_slots, d.inchan, d.outs)
+            for d in by_proc.get("driver", ())
+        ]
+        if driver_stages:
+            invoker = _DriverInvoker(
+                self._cluster,
+                {d.actor_id: d.node_id for d in by_proc["driver"]},
+            )
+            self._executor = StageExecutor(
+                self.plan_id, driver_stages, self._consts, self._manager,
+                invoker, driver_writers, on_broken=self._mark_broken,
+                trace_id=self._trace_id,
+            )
+            self._executor.start()
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _check_alive(self) -> None:
+        if self._state == "TORN_DOWN":
+            raise RuntimeError("ExecutionPlan was torn down")
+        if self._state == "BROKEN":
+            raise raised_copy(self._error) if self._error is not None else RuntimeError(
+                "ExecutionPlan is broken"
+            )
+
+    def execute(self, *args, **kwargs):
+        """Run one iteration through the installed pipeline; returns the raw
+        output value(s) — no ObjectRefs."""
+        return self.execute_async(*args, **kwargs).result()
+
+    def execute_async(self, *args, **kwargs) -> Future:
+        """Push one iteration's input and return a Future for its output.
+        Successive calls pipeline: each single-slot edge buffers one
+        iteration, so a k-stage plan keeps ~k iterations in flight."""
+        self._check_alive()
+        payload = (
+            _DagInput(args, kwargs) if (kwargs or len(args) != 1) else args[0]
+        )
+        fut: Future = Future()
+        with self._submit_lock:
+            self._check_alive()
+            seq = self._seq
+            self._seq += 1
+            fut._plan_seq = seq
+            fut._plan_t0 = time.time()
+            try:
+                for write in self._entry_writes:
+                    write(seq, payload)
+            except BaseException as exc:  # noqa: BLE001 — broke/tore down under us
+                from ray_tpu.runtime.data_plane import DataPlaneError
+
+                err = self._error
+                if err is None and isinstance(
+                    exc, (ChannelClosed, DataPlaneError, TimeoutError)
+                ):
+                    # the persistent stream itself died (agent gone before
+                    # the death sweep ran): the plan cannot execute again —
+                    # break it NOW with the typed error instead of leaking
+                    # a transport exception
+                    self._mark_broken(
+                        WorkerCrashedError(f"plan entry channel failed: {exc}")
+                    )
+                    err = self._error
+                if err is not None:
+                    raise raised_copy(err) from None
+                raise
+            self._pending.put(fut)
+        return fut
+
+    def _drain_loop(self) -> None:
+        from ray_tpu.observability import metric_defs, tracing
+
+        while True:
+            fut = self._pending.get()
+            if fut is None:
+                return
+            try:
+                # drain EVERY output channel before deciding ok/error: one
+                # errored leaf must not leave sibling channels holding this
+                # iteration's values, or every later iteration reads stale
+                # slots (outputs permanently desynced from futures)
+                outs = []
+                err: Optional[BaseException] = None
+                for ch in self._out_channels:
+                    _seq, value, is_err = ch.read()
+                    if is_err and err is None:
+                        err = value if isinstance(value, BaseException) else RuntimeError(
+                            str(value)
+                        )
+                    outs.append(value)
+                if err is not None:
+                    # raised_copy: the error object may be shared (one
+                    # instance forwarded down several channels) — raising
+                    # it raw would graft a traceback per raise (PR 2 bug)
+                    raise raised_copy(err)
+            except BaseException as exc:  # noqa: BLE001
+                if isinstance(exc, _SYSTEM_ERRORS):
+                    # actor/node death: the plan is permanently broken
+                    self._mark_broken(exc)
+                self._failed += 1
+                metric_defs.COMPILED_PLAN_EXECUTIONS.inc(tags={"state": "error"})
+                _set_future(fut, exc=exc)
+                continue
+            self._completed += 1
+            metric_defs.COMPILED_PLAN_EXECUTIONS.inc(tags={"state": "ok"})
+            if tracing.enabled():
+                tracing.emit_span(
+                    f"plan::{self.name}", self._trace_id, None,
+                    getattr(fut, "_plan_t0", time.time()), time.time(),
+                    attrs={"seq": str(getattr(fut, "_plan_seq", -1))},
+                )
+            _set_future(fut, outs if self._multi_output else outs[0])
+
+    # ------------------------------------------------------------------
+    # failure + lifecycle
+    # ------------------------------------------------------------------
+    def _mark_broken(self, error: BaseException) -> None:
+        with self._state_lock:
+            if self._state != "READY":
+                return
+            self._state = "BROKEN"
+            self._error = error
+        # closing the driver-side channels wakes the drainer (pending
+        # futures fail with the typed error) and nacks agent pushes
+        self._manager.break_plan(self.plan_id, error)
+
+    def on_actor_dead(self, actor_id, cause: str = "") -> None:
+        """Cluster hook: a stage actor died — flip BROKEN even with no
+        iteration in flight."""
+        if actor_id in self._actor_ids and self._state == "READY":
+            self._mark_broken(
+                ActorDiedError(actor_id, f"plan stage actor died: {cause or 'killed'}")
+            )
+
+    def on_node_dead(self, node_id) -> None:
+        """Cluster hook: a node hosting plan stages died."""
+        if node_id in self._node_ids and self._state == "READY":
+            self._mark_broken(
+                WorkerCrashedError(f"node {node_id.hex()[:8]} died mid-plan")
+            )
+
+    def teardown(self) -> None:
+        """Release channels on every participating agent. Idempotent."""
+        with self._state_lock:
+            if self._state == "TORN_DOWN":
+                return
+            self._state = "TORN_DOWN"
+        self._cluster.compiled_plans.pop(self.plan_id, None)
+        for handle in self._remote_handles.values():
+            if handle.dead:
+                continue
+            try:
+                handle.conn.request("uninstall_plan", {"plan": self.plan_id}, timeout=10.0)
+            except Exception:  # noqa: BLE001 — agent gone: nothing to release
+                pass
+        if self._executor is not None:
+            self._executor.stop()
+        for stream in self._streams:
+            try:
+                stream.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._manager.release_plan(self.plan_id)
+        self._pending.put(None)
+
+    # ------------------------------------------------------------------
+    # observability (GET /api/plans, `rt plans`)
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "plan": self.plan_id[:12],
+            "name": self.name,
+            "state": self._state,
+            "executions": self._completed,
+            "failed": self._failed,
+            "inflight": max(0, self._seq - self._completed - self._failed),
+            "stages": [
+                {
+                    "stage": d.stage_id,
+                    "method": d.name,
+                    "actor": d.actor_id.hex()[:8],
+                    "node": d.node_id.hex()[:8],
+                    "proc": "driver" if d.proc == "driver" else "agent",
+                }
+                for d in sorted(self._stages, key=lambda d: d.stage_id)
+            ],
+            "channels": sorted(
+                {c for d in self._stages for c in d.outs}
+                | {d.inchan for d in self._stages if d.inchan}
+            ),
+            "error": repr(self._error) if self._error is not None else None,
+        }

@@ -183,6 +183,8 @@ class DashboardHead:
             req._send(200, self._transfer_stats())
         elif path == "/api/pulls":
             req._send(200, self._pull_stats())
+        elif path == "/api/plans":
+            req._send(200, self._plan_stats())
         elif path == "/api/memory":
             req._send(200, self._memory_summary())
         elif path == "/api/data/datasets":
@@ -390,6 +392,26 @@ class DashboardHead:
             "locality": {
                 "hit_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "hit"}),
                 "miss_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "miss"}),
+            },
+        }
+
+    def _plan_stats(self) -> dict:
+        """`rt plans`: installed compiled-execution-plan snapshots (stages,
+        channel layout, state, iteration counts) plus the process-wide
+        channel traffic/occupancy counters — 'is the compiled hot path
+        actually carrying the iterations?'."""
+        from ray_tpu.observability import metric_defs
+
+        return {
+            "plans": [
+                p.snapshot() for p in list(self.cluster.compiled_plans.values())
+            ],
+            "totals": {
+                "executions_ok": metric_defs.COMPILED_PLAN_EXECUTIONS.get({"state": "ok"}),
+                "executions_error": metric_defs.COMPILED_PLAN_EXECUTIONS.get({"state": "error"}),
+                "channel_bytes_sent": metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "sent"}),
+                "channel_bytes_received": metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "received"}),
+                "channel_occupancy": metric_defs.COMPILED_CHANNEL_OCCUPANCY.get(),
             },
         }
 

@@ -172,6 +172,26 @@ PULL_SOURCE_SELECTED = _reg.counter(
     "assigned as a chained/tree parent).",
 )
 
+# ---- compiled execution plans (dag/plan.py + runtime/channel_manager.py) -
+COMPILED_PLAN_EXECUTIONS = _reg.counter(
+    "compiled_plan_executions_total",
+    "Iterations executed through installed compiled plans, by outcome "
+    "(ok / error) — each one a full pipeline pass with zero TaskSpecs, "
+    "scheduler hops, or ObjectRefs.",
+)
+COMPILED_CHANNEL_BYTES = _reg.counter(
+    "compiled_channel_bytes_total",
+    "Bytes moved over cross-process compiled-plan channel streams "
+    "(chan_push frames), by direction.",
+    "By",
+)
+COMPILED_CHANNEL_OCCUPANCY = _reg.gauge(
+    "compiled_channel_occupancy",
+    "Compiled-plan channel slots currently holding a value in this process "
+    "(single-slot channels: occupancy == iterations buffered between stages).",
+    "slots",
+)
+
 # ---- serve router --------------------------------------------------------
 SERVE_ROUTER_REQUESTS = _reg.counter(
     "serve_router_requests_total", "Requests routed to replicas, by deployment."
@@ -237,6 +257,9 @@ ALL_METRICS = [
     BROADCAST_PLANS,
     BROADCAST_RELAY_BYTES,
     PULL_SOURCE_SELECTED,
+    COMPILED_PLAN_EXECUTIONS,
+    COMPILED_CHANNEL_BYTES,
+    COMPILED_CHANNEL_OCCUPANCY,
     SERVE_ROUTER_REQUESTS,
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,

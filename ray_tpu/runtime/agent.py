@@ -789,8 +789,26 @@ class NodeAgent:
             "shutdown": self._h_shutdown,
             "coll_fail": self._h_coll_fail,
             "dump_stacks": self._h_dump_stacks,
+            "install_plan": self._h_install_plan,
+            "uninstall_plan": self._h_uninstall_plan,
             "ping": lambda c, p, rid=None: {},
         }
+
+    def _h_install_plan(self, conn, payload, rid=None) -> dict:
+        """Install a compiled execution plan's stage program ONCE: register
+        this process's channels, open the persistent outbound streams, and
+        start the stage loops.  Every subsequent plan.execute is pure
+        data-plane traffic — this control connection never sees it."""
+        from ray_tpu.runtime import channel_manager
+
+        channel_manager.install_remote_plan(payload, self.node, conn)
+        return {}
+
+    def _h_uninstall_plan(self, conn, payload, rid=None) -> dict:
+        from ray_tpu.runtime import channel_manager
+
+        channel_manager.uninstall_remote_plan(payload["plan"])
+        return {}
 
     def _h_dump_stacks(self, conn, payload: dict, rid: int):
         """`rt stack`: this agent's threads + its pool workers'.  Collected
@@ -1014,6 +1032,12 @@ class NodeAgent:
         t = getattr(self, "_report_thread", None)
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0)
+        try:
+            from ray_tpu.runtime import channel_manager
+
+            channel_manager.uninstall_all_remote_plans()
+        except Exception:  # noqa: BLE001 — plan channels die with the process
+            pass
         if self.node is not None:
             self.node.shutdown()
         from ray_tpu.parallel.collective import reset_module_state

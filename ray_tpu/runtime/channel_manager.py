@@ -1,0 +1,548 @@
+"""Per-process channel registry + stage executors for compiled execution plans.
+
+Reference parity: ``python/ray/experimental/channel/`` — the aDAG runtime's
+mutable plasma/NCCL channels and the per-actor compiled-DAG loops
+(``compiled_dag_node.py:278``).  A compiled :class:`~ray_tpu.dag.plan.
+ExecutionPlan` partitions a DAG of actor-method stages across the processes
+hosting the actors; every DAG edge becomes a **named channel**:
+
+  * producer and consumer in the SAME process  -> a local :class:`SeqChannel`
+    (single-slot rendezvous, a reference move),
+  * producer and consumer in DIFFERENT processes -> a persistent data-plane
+    channel stream (``chan_push`` op in ``runtime/data_plane.py``):
+    seq-numbered single-slot frames whose ack is withheld until the consumer
+    side slot accepted the value — end-to-end backpressure with at most one
+    frame in flight plus one in the slot per edge.
+
+This module is the per-process half: the global :class:`ChannelManager`
+(which the data plane's ``chan_push`` server delivers into), the
+:class:`StageExecutor` that runs one thread per locally-hosted stage
+(read inputs -> invoke the actor method -> write outputs), and the
+:class:`NodeActorInvoker` that calls a hosted actor WITHOUT a TaskSpec, a
+scheduler hop, or an ObjectRef — the whole point of the compiled hot path.
+
+Error semantics: a stage whose actor call fails writes the typed error AS the
+iteration's value (``is_error=True``) downstream, so downstream stages
+forward it without invoking their actors and the driver's output read raises
+it — exactly how errored ObjectRefs propagate through the interpreted DAG,
+minus the objects.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.channel import ChannelClosed
+
+
+def _set_future(fut: Future, value: Any = None, exc: Optional[BaseException] = None) -> None:
+    """Resolve a future that a death notification may already have resolved."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except InvalidStateError:
+        pass
+
+
+class _Occupancy:
+    """Occupied-slot counter feeding the ``compiled_channel_occupancy``
+    gauge — one per process, shared by every channel."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def delta(self, d: int) -> None:
+        with self._lock:
+            self._count += d
+            count = self._count
+        try:
+            from ray_tpu.observability import metric_defs
+
+            metric_defs.COMPILED_CHANNEL_OCCUPANCY.set(count)
+        except Exception:  # noqa: BLE001 — metrics must not break the data path
+            pass
+
+
+_occupancy = _Occupancy()
+
+
+class SeqChannel:
+    """Single-slot seq-numbered channel: ``write`` blocks while full, ``read``
+    blocks while empty; ``close(error)`` wakes both sides with the typed
+    error (or :class:`ChannelClosed`).  The mutable-plasma-channel protocol
+    of ``dag/channel.Channel``, plus the iteration sequence number the
+    cross-process stream carries on the wire."""
+
+    __slots__ = ("name", "_cond", "_slot", "_closed", "_error")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._cond = threading.Condition()
+        self._slot: Optional[Tuple[int, Any, bool]] = None
+        self._closed = False
+        self._error: Optional[BaseException] = None
+
+    def _raise_closed(self) -> None:
+        if self._error is not None:
+            from ray_tpu.exceptions import raised_copy
+
+            raise raised_copy(self._error)
+        raise ChannelClosed(f"channel {self.name!r} closed")
+
+    def write(self, seq: int, value: Any, is_error: bool = False,
+              timeout: Optional[float] = None) -> None:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._slot is None or self._closed, timeout):
+                raise TimeoutError(f"channel {self.name!r} write timed out")
+            if self._closed:
+                self._raise_closed()
+            self._slot = (seq, value, is_error)
+            self._cond.notify_all()
+        _occupancy.delta(1)
+
+    def read(self, timeout: Optional[float] = None) -> Tuple[int, Any, bool]:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._slot is not None or self._closed, timeout):
+                raise TimeoutError(f"channel {self.name!r} read timed out")
+            if self._slot is None:  # closed and empty
+                self._raise_closed()
+            item = self._slot
+            self._slot = None
+            self._cond.notify_all()
+        _occupancy.delta(-1)
+        return item
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._error = error
+            if self._slot is not None:
+                self._slot = None
+                drained = True
+            else:
+                drained = False
+            self._cond.notify_all()
+        if drained:
+            _occupancy.delta(-1)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class ChannelManager:
+    """Process-global (plan id, channel name) -> :class:`SeqChannel` registry.
+
+    The data plane's ``chan_push`` server resolves inbound frames here;
+    installed plans register their locally-hosted channels at install time
+    and release them at teardown (closing each channel wakes every blocked
+    stage thread)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._channels: Dict[Tuple[str, str], SeqChannel] = {}
+
+    def register(self, plan_id: str, names) -> Dict[str, SeqChannel]:
+        out = {}
+        with self._lock:
+            for name in names:
+                ch = self._channels.get((plan_id, name))
+                if ch is None:
+                    ch = self._channels[(plan_id, name)] = SeqChannel(name)
+                out[name] = ch
+        return out
+
+    def channel(self, plan_id: str, name: str) -> Optional[SeqChannel]:
+        with self._lock:
+            return self._channels.get((plan_id, name))
+
+    def deliver(self, plan_id: str, name: str, seq: int, value: Any,
+                is_error: bool, timeout: float = 300.0) -> Tuple[bool, str]:
+        """Land one inbound frame; BLOCKS while the slot is full — the
+        caller (the data server's chan_push handler) withholds its ack until
+        this returns, which is the stream's backpressure."""
+        ch = self.channel(plan_id, name)
+        if ch is None:
+            return False, "unknown channel"
+        try:
+            ch.write(seq, value, is_error=is_error, timeout=timeout)
+        except ChannelClosed:
+            return False, "channel closed"
+        except BaseException as exc:  # noqa: BLE001 — close(error) raised it
+            return False, f"channel closed: {type(exc).__name__}"
+        return True, ""
+
+    def release_plan(self, plan_id: str, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            doomed = [(k, ch) for k, ch in self._channels.items() if k[0] == plan_id]
+            for k, _ in doomed:
+                del self._channels[k]
+        for _, ch in doomed:
+            ch.close(error)
+
+    def break_plan(self, plan_id: str, error: BaseException) -> None:
+        """Close this plan's local channels WITH the typed error, leaving the
+        registrations (so straggler chan_push frames get a clean 'closed'
+        nack rather than 'unknown channel')."""
+        with self._lock:
+            doomed = [ch for k, ch in self._channels.items() if k[0] == plan_id]
+        for ch in doomed:
+            ch.close(error)
+
+
+_global_manager = ChannelManager()
+
+
+def global_manager() -> ChannelManager:
+    return _global_manager
+
+
+def deliver(plan_id: str, name: str, seq: int, value: Any, is_error: bool) -> Tuple[bool, str]:
+    """Entry point for ``data_plane._serve_chan_push`` (lazy import there)."""
+    from ray_tpu.core.config import get_config
+
+    return _global_manager.deliver(
+        plan_id, name, seq, value, is_error,
+        timeout=get_config().compiled_plan_channel_timeout_s,
+    )
+
+
+# --------------------------------------------------------------------------
+# actor invocation without a TaskSpec
+# --------------------------------------------------------------------------
+class NodeActorInvoker:
+    """Call a method on an actor hosted by ``node`` directly — no TaskSpec,
+    no scheduler hop, no ObjectRef.
+
+    inproc actors: the call rides the actor's own call queue (the
+    ``__direct__`` fast path, serialized with queued ``.remote()`` calls so
+    the single-threaded actor guarantee holds), with the waiting future
+    registered on the instance's death notification so a kill surfaces
+    :class:`ActorDiedError` immediately.  Process actors: one worker-IPC
+    frame per call via the pool's dedicated actor worker (worker death fails
+    the future through the pool's inflight sweep)."""
+
+    def __init__(self, node):
+        self._node = node
+
+    def resolve(self, actor_id):
+        inst = self._node.actors.get(actor_id)
+        if inst is None or inst.dead:
+            from ray_tpu.exceptions import ActorDiedError
+
+            raise ActorDiedError(actor_id, "actor is not alive on this node")
+        return inst
+
+    def invoke(self, inst, actor_id, method: str, args: tuple, kwargs: dict):
+        from ray_tpu.exceptions import ActorDiedError
+
+        if inst.dead:
+            raise ActorDiedError(actor_id)
+        fut: Future = Future()
+        if inst.mode == "inproc":
+            def on_death():
+                _set_future(fut, exc=ActorDiedError(actor_id, "actor killed mid-plan"))
+
+            inst.on_death(on_death)
+            try:
+                inst.call_queue.put(("__direct__", (method, args, kwargs, fut)))
+                return fut.result()
+            finally:
+                inst.remove_death_callback(on_death)
+        # process actor: encode args once, one IPC frame, decode the reply
+        import os
+
+        from ray_tpu.runtime import protocol
+
+        shm = self._node.store._shm
+
+        def on_result(value, err, exec_s=None):
+            if err is not None:
+                _set_future(fut, exc=err if isinstance(err, BaseException)
+                            else RuntimeError(str(err)))
+            else:
+                try:
+                    _set_future(fut, protocol.decode_value(value, shm))
+                except BaseException as exc:  # noqa: BLE001
+                    _set_future(fut, exc=exc)
+
+        enc = self._node._encode_args(args, kwargs, shm)
+        self._node.worker_pool.submit_to_worker(
+            inst.worker, "actor_call", os.urandom(16),
+            {"method": method, "args_blob": enc, "name": f"plan::{method}"},
+            on_result,
+        )
+        return fut.result()
+
+
+# --------------------------------------------------------------------------
+# stage programs
+# --------------------------------------------------------------------------
+class StageSpec:
+    """One locally-hosted stage of an installed plan (plain data)."""
+
+    __slots__ = ("stage_id", "actor_id", "method", "name", "arg_slots",
+                 "kw_slots", "inchan", "outs")
+
+    def __init__(self, stage_id: int, actor_id, method: str, name: str,
+                 arg_slots: List[tuple], kw_slots: Dict[str, tuple],
+                 inchan: Optional[str], outs: List[str]):
+        self.stage_id = stage_id
+        self.actor_id = actor_id
+        self.method = method
+        self.name = name
+        #: slots: ("chan", name) | ("input", key|None) | ("const", index)
+        self.arg_slots = arg_slots
+        self.kw_slots = kw_slots
+        self.inchan = inchan          # entry channel carrying the DAG input
+        self.outs = outs              # output channel names (local or remote)
+
+
+def select_input(payload: Any, key) -> Any:
+    """Resolve an ("input", key) slot against the per-iteration DAG input
+    (mirrors the interpreted walker's InputNode/_DagInput semantics)."""
+    from ray_tpu.dag.dag_node import _DagInput
+
+    if key is None:
+        return payload
+    if isinstance(payload, _DagInput):
+        return payload.select(key)
+    raise ValueError(
+        f"DAG input selector {key!r} used but execute() got a single argument"
+    )
+
+
+class StageExecutor:
+    """Run the locally-hosted stages of one plan: a thread per stage loops
+    read-inputs -> invoke -> write-outputs until its channels close.
+
+    ``writers`` maps the names of CROSS-PROCESS output channels to their
+    persistent :class:`~ray_tpu.runtime.data_plane.ChannelStream`; every
+    other out name resolves against the local manager.  ``on_broken(error)``
+    fires when a stage can no longer even FORWARD its error downstream
+    (transport death) — the plan must be broken out-of-band."""
+
+    def __init__(self, plan_id: str, stages: List[StageSpec], consts: List[Any],
+                 manager: ChannelManager, invoker: NodeActorInvoker,
+                 writers: Dict[str, Any],
+                 on_broken: Optional[Callable[[BaseException], None]] = None,
+                 trace_id: Optional[str] = None):
+        self.plan_id = plan_id
+        self._stages = stages
+        self._consts = consts
+        self._mgr = manager
+        self._invoker = invoker
+        self._writers = writers
+        self._on_broken = on_broken
+        self._trace_id = trace_id or f"plan-{plan_id[:12]}"
+        self._stop = False
+        self._insts = {s.stage_id: invoker.resolve(s.actor_id) for s in stages}
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for stage in self._stages:
+            t = threading.Thread(
+                target=self._stage_loop, args=(stage,),
+                name=f"plan-{self.plan_id[:8]}-s{stage.stage_id}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        for writer in self._writers.values():
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    def _emit(self, stage: StageSpec, seq: int, value: Any, is_error: bool) -> None:
+        for name in stage.outs:
+            writer = self._writers.get(name)
+            if writer is not None:
+                writer.push(seq, value, is_error=is_error)
+            else:
+                ch = self._mgr.channel(self.plan_id, name)
+                if ch is None:
+                    raise ChannelClosed(f"channel {name!r} released")
+                ch.write(seq, value, is_error=is_error)
+
+    def _resolve_slot(self, slot: tuple, payload: Any, chan_vals: Dict[str, Any]) -> Any:
+        kind, ref = slot
+        if kind == "chan":
+            return chan_vals[ref]
+        if kind == "input":
+            return select_input(payload, ref)
+        return self._consts[ref]
+
+    def _stage_loop(self, stage: StageSpec) -> None:
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            RayTaskError,
+            WorkerCrashedError,
+        )
+        from ray_tpu.observability import tracing
+        from ray_tpu.runtime.data_plane import DataPlaneError
+
+        inst = self._insts[stage.stage_id]
+        chan_inputs = sorted(
+            {ref for kind, ref in list(stage.arg_slots) + list(stage.kw_slots.values())
+             if kind == "chan"}
+        )
+        while not self._stop:
+            # -- 1. gather this iteration's inputs -------------------------
+            payload = None
+            seq = 0
+            error: Optional[BaseException] = None
+            try:
+                if stage.inchan is not None:
+                    ch = self._mgr.channel(self.plan_id, stage.inchan)
+                    if ch is None:
+                        return
+                    seq, payload, is_err = ch.read()
+                    if is_err:
+                        error = payload
+                chan_vals: Dict[str, Any] = {}
+                for name in chan_inputs:
+                    ch = self._mgr.channel(self.plan_id, name)
+                    if ch is None:
+                        return
+                    seq, v, is_err = ch.read()
+                    if is_err and error is None:
+                        error = v
+                    chan_vals[name] = v
+            except (ChannelClosed, ActorDiedError, WorkerCrashedError):
+                return  # plan torn down / broken
+            except Exception:  # noqa: BLE001 — close(error) re-raised typed errors
+                return
+            # -- 2. forward upstream errors without invoking ----------------
+            if error is None:
+                try:
+                    args = tuple(
+                        self._resolve_slot(s, payload, chan_vals) for s in stage.arg_slots
+                    )
+                    kwargs = {
+                        k: self._resolve_slot(s, payload, chan_vals)
+                        for k, s in stage.kw_slots.items()
+                    }
+                    t0 = time.time()
+                    result = self._invoker.invoke(
+                        inst, stage.actor_id, stage.method, args, kwargs
+                    )
+                    if tracing.enabled():
+                        tracing.emit_span(
+                            f"stage::{stage.name}", self._trace_id, None,
+                            t0, time.time(),
+                            attrs={"seq": str(seq), "stage": str(stage.stage_id)},
+                        )
+                except BaseException as exc:  # noqa: BLE001
+                    error = exc if isinstance(
+                        exc, (ActorDiedError, WorkerCrashedError, RayTaskError)
+                    ) else RayTaskError.from_exception(stage.name, exc)
+            # -- 3. write the value (or the typed error) downstream ---------
+            try:
+                if error is not None:
+                    self._emit(stage, seq, error, True)
+                else:
+                    self._emit(stage, seq, result, False)
+            except ChannelClosed:
+                return
+            except (DataPlaneError, OSError, TimeoutError) as exc:
+                # the error itself could not travel: break the plan out of
+                # band, else the driver's output read blocks forever
+                if self._on_broken is not None:
+                    try:
+                        self._on_broken(exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
+
+
+# --------------------------------------------------------------------------
+# remote (agent-side) plan hosting
+# --------------------------------------------------------------------------
+_installed_lock = threading.Lock()
+_installed: Dict[str, StageExecutor] = {}
+
+
+def install_remote_plan(payload: dict, node, conn) -> None:
+    """``install_plan`` control-RPC body on a node agent: register this
+    process's channels, open the persistent outbound streams, resolve the
+    hosted actor instances, and start the stage loops.  Installed ONCE;
+    every subsequent ``plan.execute`` is pure data-plane traffic."""
+    import pickle
+
+    from ray_tpu.core.ids import ActorID
+    from ray_tpu.runtime import data_plane, rpc
+
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    plan_id = payload["plan"]
+    mgr = global_manager()
+    mgr.register(plan_id, payload.get("channels", ()))
+    writers = {
+        name: data_plane.ChannelStream(
+            addr, plan_id, name,
+            chunk_bytes=cfg.object_transfer_chunk_bytes,
+            timeout=cfg.compiled_plan_channel_timeout_s,
+        )
+        for name, addr in (payload.get("writers") or {}).items()
+    }
+    consts = pickle.loads(payload["consts"]) if payload.get("consts") else []
+    stages = [
+        StageSpec(
+            d["stage"], ActorID(d["actor_id"]), d["method"], d["name"],
+            [tuple(s) for s in d["args"]],
+            {k: tuple(s) for k, s in d.get("kwargs", {}).items()},
+            d.get("inchan"), list(d.get("outs", ())),
+        )
+        for d in payload.get("stages", ())
+    ]
+
+    def on_broken(error: BaseException) -> None:
+        mgr.break_plan(plan_id, error)
+        try:
+            conn.send(
+                "plan_broken",
+                {"plan": plan_id, "error": rpc.encode_value(error)},
+            )
+        except Exception:  # noqa: BLE001 — head gone: its death sweep owns it
+            pass
+
+    executor = StageExecutor(
+        plan_id, stages, consts, mgr, NodeActorInvoker(node), writers,
+        on_broken=on_broken,
+    )
+    with _installed_lock:
+        old = _installed.pop(plan_id, None)
+        _installed[plan_id] = executor
+    if old is not None:
+        old.stop()
+    executor.start()
+
+
+def uninstall_remote_plan(plan_id: str) -> None:
+    with _installed_lock:
+        executor = _installed.pop(plan_id, None)
+    if executor is not None:
+        executor.stop()
+    global_manager().release_plan(plan_id)
+
+
+def uninstall_all_remote_plans() -> None:
+    with _installed_lock:
+        doomed = list(_installed.items())
+        _installed.clear()
+    for plan_id, executor in doomed:
+        executor.stop()
+        global_manager().release_plan(plan_id)

@@ -296,6 +296,10 @@ class Cluster:
         self._stream_lock = threading.Lock()  # serializes item commits vs force-close
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
+        # installed compiled execution plans (dag/plan.py): plan_id -> plan.
+        # The node/actor death sweeps flip affected plans to BROKEN through
+        # this registry; /api/plans and `rt plans` snapshot it.
+        self.compiled_plans: Dict[str, Any] = {}
         self.core_worker = None       # set by worker.init
         self.shm_store = None
         if shm_capacity >= 0:
@@ -493,6 +497,13 @@ class Cluster:
         # broadcast plans: a relay node dying mid-broadcast re-parents its
         # parked subtree onto surviving replicas (purge-then-retry path)
         self.pull_manager.on_node_dead(node_id)
+        # compiled execution plans with stages on this node flip to BROKEN
+        # (typed error on their output channels, blocked executes unblock)
+        for plan in list(self.compiled_plans.values()):
+            try:
+                plan.on_node_dead(node_id)
+            except Exception:  # noqa: BLE001 — one plan must not block the sweep
+                pass
         # resubmit this node's pending tasks (system failure → consumes retry)
         for spec in self.task_manager.pending_specs():
             if spec.owner_node == node_id and spec.actor_id is None:
@@ -1283,6 +1294,13 @@ class Cluster:
         # declaratively-bound collective groups the actor belongs to fail
         # open waits immediately (direct_actor_task_submitter.h:120 parity)
         self._fail_collective_groups_for_actor(actor_id, cause)
+        # compiled execution plans using this actor as a stage are BROKEN —
+        # even between iterations, so the next execute fails fast
+        for plan in list(self.compiled_plans.values()):
+            try:
+                plan.on_actor_dead(actor_id, cause)
+            except Exception:  # noqa: BLE001
+                pass
         state = self.control.actors.on_failure(actor_id, cause)
         if state is ActorState.RESTARTING and spec is not None:
             spec.attempt += 1
@@ -1315,6 +1333,11 @@ class Cluster:
                 node.pool.release(spec.resources)
         self.control.actors.mark_dead(actor_id, "killed via kill_actor")
         self._fail_actor_queue(actor_id, ActorDiedError(actor_id, "The actor was killed"))
+        for plan in list(self.compiled_plans.values()):
+            try:
+                plan.on_actor_dead(actor_id, "killed via kill_actor")
+            except Exception:  # noqa: BLE001
+                pass
 
     def _maybe_retry_actor_task(self, spec: TaskSpec) -> bool:
         """max_task_retries: resubmit an in-flight actor call whose actor
@@ -1531,6 +1554,13 @@ class Cluster:
         with self._demand_cv:
             self._demand_stop = True
             self._demand_cv.notify_all()
+        # release installed compiled plans: their channels and stage loops
+        # are process-global and must not outlive this runtime incarnation
+        for plan in list(self.compiled_plans.values()):
+            try:
+                plan.teardown()
+            except Exception:  # noqa: BLE001 — teardown is best-effort here
+                pass
         self.pull_manager.shutdown()
         if self._snapshot_thread is not None:
             self._snapshot_thread.join(timeout=10)

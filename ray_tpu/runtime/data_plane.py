@@ -26,6 +26,13 @@ pickles; chunk frames are length-prefixed raw bytes):
              "children": [{"addr", "children": [...]}, ...]}
          -> meta + chunk stream
          <- {"ok": True, "failed": [addr, ...]}
+  chan_push (compiled-plan channel stream; runtime/channel_manager.py):
+         -> {"op": "chan_push", "plan", "chan", "seq", "is_error",
+             "meta_size", "buffer_sizes"}
+         -> meta + chunk stream
+         <- {"ok": bool, "error": str}      # ack withheld until the
+                                            # consumer slot accepted the
+                                            # frame: end-to-end backpressure
 
 The relay op is the broadcast data path (Cornet/Orchestra-style
 cooperative tree broadcast): the receiver commits each inbound chunk to
@@ -450,6 +457,8 @@ class DataServer:
                     self._serve_push(sock, req)
                 elif op == "relay":
                     self._serve_relay(sock, req)
+                elif op == "chan_push":
+                    self._serve_chan_push(sock, req)
                 else:
                     _send_header(sock, {"error": f"unknown op {op!r}"})
         except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
@@ -562,6 +571,30 @@ class DataServer:
             shm.release(hit[0])  # lookup only — the store value holds the pin
             out.append((hit[0], hit[1], view.nbytes))
         return out
+
+    def _serve_chan_push(self, sock: socket.socket, req: dict) -> None:
+        """Compiled-plan channel frame: land it in this process's channel
+        manager and ack only once the single consumer slot ACCEPTED it —
+        the blocking deliver IS the stream's backpressure, so this op
+        deliberately skips the admission semaphore (a full slot must not
+        pin a transfer slot other ops need; the per-edge one-frame-in-
+        flight bound is its own admission control)."""
+        meta = _recv_exact(sock, req["meta_size"])
+        buffers = [_recv_into_buffer(sock, size) for size in req["buffer_sizes"]]
+        nbytes = req["meta_size"] + sum(req["buffer_sizes"])
+        try:
+            value = from_frames(meta, buffers)
+        except Exception as exc:  # noqa: BLE001 — poisoned frame: nack, keep the stream
+            _send_header(sock, {"ok": False, "error": f"decode failed: {exc!r}"})
+            return
+        from ray_tpu.observability import metric_defs
+        from ray_tpu.runtime import channel_manager
+
+        metric_defs.COMPILED_CHANNEL_BYTES.inc(nbytes, tags={"direction": "received"})
+        ok, err = channel_manager.deliver(
+            req["plan"], req["chan"], req["seq"], value, req.get("is_error", False)
+        )
+        _send_header(sock, {"ok": ok, "error": err})
 
     def _serve_push(self, sock: socket.socket, req: dict) -> None:
         # same admission gate as pulls: inbound bulk buffering is bounded too
@@ -985,6 +1018,94 @@ class DataClient:
                 raise DataPlaneError(f"push to {addr} rejected: {reply}")
         self.stats.add("pushes_sent")
         self.stats.add("bytes_sent", len(meta) + sum(sizes))
+
+
+class ChannelStream:
+    """Persistent data-plane connection carrying ONE compiled-plan channel.
+
+    Opened once at plan install, reused for every iteration (the 'install
+    once, execute many' contract): each :meth:`push` streams one
+    seq-numbered frame through the chunk pipeline and blocks on the
+    receiver's ack — which the peer withholds until its consumer slot
+    accepted the value, so the stream self-limits to one frame in flight
+    plus one in the slot.  A nack means the peer released/closed the
+    channel (teardown or a broken plan): surfaced as
+    :class:`~ray_tpu.dag.channel.ChannelClosed`."""
+
+    def __init__(self, addr: str, plan_id: str, chan: str,
+                 chunk_bytes: int = 8 * 1024 * 1024, timeout: float = 300.0):
+        self.addr = addr
+        self.plan_id = plan_id
+        self.chan = chan
+        self.chunk_bytes = chunk_bytes
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _connect(self) -> socket.socket:
+        host, _, port = self.addr.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=10.0)
+        sock.settimeout(self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def push(self, seq: int, value: Any, is_error: bool = False) -> None:
+        from ray_tpu.dag.channel import ChannelClosed
+        from ray_tpu.observability import metric_defs
+
+        t_start = time.perf_counter()
+        meta, buffers = to_frames(value)
+        sizes = [memoryview(b).cast("B").nbytes for b in buffers]
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed(f"channel stream {self.chan!r} closed")
+            if self._sock is None:
+                self._sock = self._connect()
+            sock = self._sock
+            try:
+                _send_header(
+                    sock,
+                    {"op": "chan_push", "plan": self.plan_id, "chan": self.chan,
+                     "seq": seq, "is_error": is_error,
+                     "meta_size": len(meta), "buffer_sizes": sizes},
+                )
+                sock.sendall(meta)
+                _send_buffers(sock, buffers, self.chunk_bytes)
+                reply = _recv_header(sock)
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                self._drop_sock()
+                raise DataPlaneError(
+                    f"channel push to {self.addr} failed: {exc}"
+                ) from exc
+        if not reply.get("ok"):
+            raise ChannelClosed(
+                f"channel {self.chan!r} rejected by {self.addr}: {reply.get('error')}"
+            )
+        nbytes = len(meta) + sum(sizes)
+        metric_defs.COMPILED_CHANNEL_BYTES.inc(nbytes, tags={"direction": "sent"})
+        from ray_tpu.observability import tracing
+
+        if tracing.enabled():
+            now = time.time()
+            tracing.emit_span(
+                f"chan::{self.chan}", f"plan-{self.plan_id[:12]}", None,
+                now - (time.perf_counter() - t_start), now,
+                attrs={"seq": str(seq), "bytes": str(nbytes)},
+            )
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._drop_sock()
 
 
 def store_server(store, host: str = "127.0.0.1", port: int = 0,

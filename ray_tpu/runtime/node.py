@@ -167,6 +167,37 @@ class ActorInstance:
         self.created = threading.Event()      # gates methods behind __init__
         self.creation_spec = None
         self.dead = False
+        # death notification: futures waiting on queued direct calls
+        # (compiled DAG / plan fast path) register here so a kill surfaces
+        # ActorDiedError IMMEDIATELY instead of at the next poll tick
+        self._death_lock = threading.Lock()
+        self._death_cbs: list = []
+
+    def on_death(self, cb) -> None:
+        with self._death_lock:
+            if not self.dead:
+                self._death_cbs.append(cb)
+                return
+        cb()  # already dead: fire inline
+
+    def remove_death_callback(self, cb) -> None:
+        with self._death_lock:
+            try:
+                self._death_cbs.remove(cb)
+            except ValueError:
+                pass
+
+    def mark_dead(self) -> None:
+        with self._death_lock:
+            if self.dead:
+                return
+            self.dead = True
+            cbs, self._death_cbs = self._death_cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — one waiter must not mask the rest
+                pass
 
 
 class Node:
@@ -737,14 +768,24 @@ class Node:
                 # methods must not outrun __init__ on a sibling thread
                 inst.created.wait()
             if kind == "__direct__":
-                # compiled-DAG fast path: (method, args, kwargs, future) with
-                # no TaskSpec — still serialized through this thread so the
-                # single-threaded actor guarantee holds (dag/compiled.py)
+                # compiled-DAG/plan fast path: (method, args, kwargs, future)
+                # with no TaskSpec — still serialized through this thread so
+                # the single-threaded actor guarantee holds (dag/compiled.py).
+                # set_* guarded: a death notification may have resolved the
+                # future already (kill raced a queued call).
                 method, args, kwargs, fut = spec
                 try:
-                    fut.set_result(getattr(inst.instance, method)(*args, **kwargs))
+                    result = getattr(inst.instance, method)(*args, **kwargs)
                 except BaseException as exc:  # noqa: BLE001
-                    fut.set_exception(exc)
+                    try:
+                        fut.set_exception(exc)
+                    except BaseException:  # noqa: BLE001 — already resolved
+                        pass
+                    continue
+                try:
+                    fut.set_result(result)
+                except BaseException:  # noqa: BLE001 — already resolved
+                    pass
                 continue
             try:
                 args, kwargs = self._resolve_args(spec)
@@ -771,7 +812,7 @@ class Node:
         inst = self.actors.pop(actor_id, None)
         if inst is None:
             return
-        inst.dead = True
+        inst.mark_dead()  # fires death-notified direct-call futures NOW
         if inst.mode == "inproc":
             inst.call_queue.put(("__stop__", None))
         elif inst.worker is not None:
@@ -786,7 +827,7 @@ class Node:
         if actor_id is not None:
             inst = self.actors.pop(actor_id, None)
             if inst is not None:
-                inst.dead = True
+                inst.mark_dead()
             self.cluster.on_actor_process_died(self, actor_id)
         # a dead worker's borrower ledger can never report again — drop its
         # per-worker ref pins (head pools release directly; agent fabrics

@@ -674,6 +674,7 @@ class HeadService:
             "actor_creation_failed": lambda c, p: c.peer.on_actor_creation_failed_msg(p),
             "actor_died": lambda c, p: c.peer.on_actor_died_msg(p),
             "resource_report": lambda c, p: c.peer.on_resource_report(p),
+            "plan_broken": self._h_plan_broken,
             "pull_object": self._h_pull_object,
             "locate_object": self._h_locate_object,
             "object_location": self._h_object_location,
@@ -793,6 +794,23 @@ class HeadService:
             size=payload.get("size"),
             tier="device" if payload.get("device") else "host",
         )
+
+    def _h_plan_broken(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """An agent's stage loop could not even forward its error downstream
+        (transport death mid-plan): break the plan head-side so blocked
+        executes surface the typed error instead of hanging."""
+        plan = self.cluster.compiled_plans.get(payload.get("plan"))
+        if plan is None:
+            return
+        error, _ = rpc.decode_value(payload["error"])
+        if not isinstance(error, BaseException):
+            from ray_tpu.exceptions import WorkerCrashedError
+
+            error = WorkerCrashedError(f"plan broke on an agent: {error!r}")
+        try:
+            plan._mark_broken(error)
+        except Exception:  # noqa: BLE001 — notice is best-effort
+            pass
 
     def _h_pull_failed(self, conn: rpc.RpcConnection, payload: dict) -> None:
         """An agent's direct peer pull failed: purge the stale location

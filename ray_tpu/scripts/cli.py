@@ -376,6 +376,40 @@ def cmd_pulls(args) -> int:
     return 0
 
 
+def cmd_plans(args) -> int:
+    """``rt plans``: installed compiled execution plans — per-plan state,
+    stage placement, iteration counts, plus the process-wide channel
+    traffic/occupancy totals."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/plans")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    totals = data.get("totals", {})
+    plans = data.get("plans", [])
+    print(
+        f"plans: {len(plans)} installed, "
+        f"{totals.get('executions_ok', 0):.0f} iterations ok / "
+        f"{totals.get('executions_error', 0):.0f} failed, "
+        f"{totals.get('channel_bytes_sent', 0) / 1e6:.1f} MB pushed on channel "
+        f"streams ({totals.get('channel_occupancy', 0):.0f} slots occupied)"
+    )
+    for plan in plans:
+        print(
+            f"  plan {plan['plan']} [{plan['name']}] {plan['state']}: "
+            f"{plan['executions']} executed, {plan['failed']} failed, "
+            f"{plan['inflight']} in flight"
+        )
+        for stage in plan.get("stages", ()):
+            print(
+                f"    s{stage['stage']} {stage['method']}() "
+                f"actor {stage['actor']} on node {stage['node']} ({stage['proc']})"
+            )
+        if plan.get("error"):
+            print(f"    error: {plan['error']}")
+    return 0
+
+
 def cmd_chaos(args) -> int:
     from ray_tpu.chaos.runner import run_cli
 
@@ -511,6 +545,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_pulls)
+
+    sp = sub.add_parser(
+        "plans",
+        help="installed compiled execution plans: state, stage placement, "
+        "iteration counts, channel traffic",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_plans)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

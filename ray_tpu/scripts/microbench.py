@@ -524,6 +524,70 @@ def run_suite(
                 server.close()
         del value
 
+    # ---- compiled execution plans ----------------------------------------
+    if wanted("compiled_pipeline_iter") or wanted("compiled_pipeline_vs_remote_x"):
+        # Per-iteration latency of a 4-stage cross-node actor pipeline run
+        # through an INSTALLED execution plan (ISSUE 5 acceptance bar):
+        # zero TaskSpecs / scheduler hops / ObjectRefs per iteration, edges
+        # as pre-established channels.  The _x row is the dispatch-overhead
+        # ratio vs the equivalent per-call `.remote()` chain (bar: >= 3x).
+        # Runs in its own fresh-runtime group: it adds a node.
+        from ray_tpu.dag import InputNode
+
+        cluster = rt.get_cluster()
+        cluster.add_node({"CPU": 2, "pipe_bench": 4})
+
+        @rt.remote
+        class PipeStage:
+            def step(self, x):
+                return x + 1
+
+        head = dict(execution="inproc")
+        other = dict(execution="inproc", resources={"pipe_bench": 1}, num_cpus=0)
+        stages = [
+            PipeStage.options(**head).remote(),
+            PipeStage.options(**other).remote(),
+            PipeStage.options(**other).remote(),
+            PipeStage.options(**head).remote(),
+        ]
+        with InputNode() as inp:
+            d = inp
+            for s in stages:
+                d = s.step.bind(d)
+        plan = d.compile_plan(name="bench")
+        try:
+            # steady-state per-iteration cost, BOTH paths pipelined with the
+            # same batch in flight: the plan streams iterations through its
+            # installed channels; the chain pays 4 TaskSpecs + ObjectRefs +
+            # scheduler hops per iteration.  Median of 3 rounds.
+            batch = N(300)
+            for _ in range(30):
+                plan.execute(0)  # warm
+
+            def plan_batch():
+                futs = [plan.execute_async(0) for _ in range(batch)]
+                for f in futs:
+                    f.result(timeout=120)
+
+            plan_rate = _rate(plan_batch, 1, warmup=1, rounds=3) * batch
+
+            def submit_chain():
+                ref = stages[0].step.remote(0)
+                for s in stages[1:]:
+                    ref = s.step.remote(ref)
+                return ref
+
+            rt.get([submit_chain() for _ in range(20)])
+
+            def remote_batch():
+                rt.get([submit_chain() for _ in range(batch)], timeout=120)
+
+            remote_rate = _rate(remote_batch, 1, warmup=1, rounds=3) * batch
+            record("compiled_pipeline_iter", 1e6 / plan_rate, "us")
+            record("compiled_pipeline_vs_remote_x", plan_rate / remote_rate, "x")
+        finally:
+            plan.teardown()
+
     # ---- placement groups ------------------------------------------------
     if wanted("placement_group_create_removal"):
         from ray_tpu.util.placement import placement_group, remove_placement_group

@@ -435,6 +435,87 @@ def test_schedule_relay_node_kill_mid_broadcast():
 
 
 # --------------------------------------------------------------------------
+# 7. stage-actor kill mid-compiled-plan (ISSUE 5): a 3-stage execution plan
+#    spanning two nodes runs iterations through its installed channels while
+#    an armed put failpoint generates a workload-driven decision stream;
+#    killing the middle stage actor mid-plan must surface a TYPED error
+#    (ActorDiedError) and flip the plan to BROKEN — and the same-seed runs'
+#    fault logs stay byte-identical THROUGH the kill, because plan traffic
+#    rides channels (zero store puts) and never perturbs the hit stream.
+# --------------------------------------------------------------------------
+def _plan_actor_kill_run(seed):
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        cluster.add_node({"CPU": 2, "stage": 4})
+
+        schedule = ChaosSchedule(
+            [ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)")],
+            seed=seed, name="plan-stage-kill",
+        )
+
+        def workload():
+            from ray_tpu.dag import InputNode
+            from ray_tpu.exceptions import ActorDiedError, RayActorError
+
+            @rt.remote
+            class Stage:
+                def __init__(self, k):
+                    self.k = k
+
+                def step(self, x):
+                    return x + self.k
+
+            head = dict(execution="inproc")
+            other = dict(execution="inproc", resources={"stage": 1}, num_cpus=0)
+            s0 = Stage.options(**head).remote(1)
+            s1 = Stage.options(**other).remote(10)
+            s2 = Stage.options(**head).remote(100)
+            with InputNode() as inp:
+                d = s2.step.bind(s1.step.bind(s0.step.bind(inp)))
+            plan = d.compile_plan(name="chaos")
+            # deterministic failpoint hits: app-retried puts — each attempt
+            # consumes exactly one decision-stream index
+            refs = []
+            for i in range(6):
+                while True:
+                    try:
+                        refs.append(rt.put(("blob", i)))
+                        break
+                    except failpoints.FailpointInjected:
+                        continue
+            for i in range(10):
+                assert plan.execute(i) == i + 111
+            rt.kill(s1)  # mid-plan: installed, channels live, between iters
+            deadline = time.monotonic() + 30
+            raised = None
+            while time.monotonic() < deadline:
+                try:
+                    plan.execute(0)
+                except (ActorDiedError, RayActorError) as exc:
+                    raised = exc
+                    break
+            assert isinstance(raised, (ActorDiedError, RayActorError)), raised
+            assert plan.state == "BROKEN"
+            plan.teardown()
+            return refs
+
+        r1 = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert r1.ok, (r1.workload_error, r1.invariants.violations)
+        return r1
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_stage_actor_kill_mid_plan():
+    r1 = _plan_actor_kill_run(seed=29)
+    r2 = _plan_actor_kill_run(seed=29)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert all(f["fp"] == "object_store.put" for f in r1.faults)
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
 # schedule JSON round trip + CLI-facing loader
 # --------------------------------------------------------------------------
 def test_schedule_json_round_trip(tmp_path):

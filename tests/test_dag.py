@@ -1,6 +1,7 @@
 """Compiled-DAG tests: interpreted execution, XLA fusion, direct schedule
 with actors, auto fallback, channels."""
 
+import threading
 import time
 
 import jax
@@ -186,6 +187,194 @@ def test_execute_async(ray_start_regular):
     futs = [compiled.execute_async(i) for i in range(10)]
     assert [f.result() for f in futs] == list(range(1, 11))
     compiled.teardown()
+
+
+def test_compiled_jit_fallback_only_on_first_trace(ray_start_regular):
+    """fuse='auto' may fall back to the direct schedule only on the FIRST
+    trace; once a jit trace has succeeded, later errors are user errors and
+    re-raise instead of silently degrading the compiled program."""
+    rt = ray_start_regular
+
+    @rt.remote
+    def double(x):
+        return x * 2
+
+    with InputNode() as inp:
+        d = double.bind(inp)
+    compiled = d.experimental_compile()
+    assert compiled.mode == "jit"
+    out = compiled.execute(jnp.arange(3))
+    assert list(np.asarray(out)) == [0, 2, 4]
+
+    class Poison:
+        def __mul__(self, other):
+            raise RuntimeError("poisoned operand")
+
+        __rmul__ = __mul__
+
+    with pytest.raises(Exception):
+        compiled.execute(Poison())
+    # still jit — the error did NOT demote the program to direct mode
+    assert compiled.mode == "jit"
+    assert list(np.asarray(compiled.execute(jnp.arange(3)))) == [0, 2, 4]
+
+
+def test_compiled_teardown_idempotent_and_execute_after(ray_start_regular):
+    rt = ray_start_regular
+
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    @rt.remote
+    class A:
+        def m(self, x):
+            return x
+
+    # jit mode
+    with InputNode() as inp:
+        d = inc.bind(inp)
+    compiled = d.experimental_compile()
+    assert compiled.mode == "jit"
+    compiled.teardown()
+    compiled.teardown()  # idempotent
+    with pytest.raises(RuntimeError, match="torn down"):
+        compiled.execute(1)
+
+    # direct mode
+    a = A.options(execution="inproc").remote()
+    rt.get(a.m.remote(0))
+    with InputNode() as inp:
+        d = a.m.bind(inp)
+    direct = d.experimental_compile(fuse="none")
+    assert direct.mode == "direct"
+    assert direct.execute(7) == 7
+    direct.teardown()
+    direct.teardown()
+    with pytest.raises(RuntimeError, match="torn down"):
+        direct.execute(1)
+
+
+def test_compiled_actor_kill_surfaces_immediately(ray_start_regular):
+    """Satellite fix: a direct DAG call queued on a killed actor raises
+    ActorDiedError the instant the death sweep runs — via the actor's death
+    notification, not an up-to-1s poll tick."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    rt = ray_start_regular
+
+    @rt.remote
+    class Slow:
+        def snooze(self, s):
+            time.sleep(s)
+            return s
+
+        def quick(self, x):
+            return x
+
+    a = Slow.options(execution="inproc").remote()
+    rt.get(a.quick.remote(0))
+    with InputNode() as inp:
+        d = a.quick.bind(inp)
+    compiled = d.experimental_compile(fuse="none")
+
+    # occupy the actor thread so the direct call stays QUEUED
+    a.snooze.remote(5.0)
+    time.sleep(0.1)
+    out = {}
+
+    def run():
+        t0 = time.perf_counter()
+        try:
+            compiled.execute(1)
+        except ActorDiedError:
+            out["latency"] = time.perf_counter() - t0
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    t_kill = time.perf_counter()
+    rt.kill(a)
+    t.join(3)
+    assert "latency" in out, "queued direct call never surfaced the death"
+    # immediate, not the old poll tick (which fired up to 1s after submit)
+    assert time.perf_counter() - t_kill < 0.5
+    compiled.teardown()
+
+
+def test_channel_close_while_blocked_stress():
+    """N readers and N writers all blocked on single-slot channels; close()
+    must wake every one of them promptly with ChannelClosed."""
+    channels = [Channel() for _ in range(8)]
+    for ch in channels[4:]:
+        ch.write("occupied")  # writers on these will block on the full slot
+    results = []
+    lock = threading.Lock()
+
+    def blocked_reader(ch):
+        try:
+            ch.read(timeout=10)
+            outcome = "value"
+        except ChannelClosed:
+            outcome = "closed"
+        with lock:
+            results.append(outcome)
+
+    def blocked_writer(ch):
+        try:
+            ch.write("late", timeout=10)
+            outcome = "wrote"
+        except ChannelClosed:
+            outcome = "closed"
+        with lock:
+            results.append(outcome)
+
+    threads = [threading.Thread(target=blocked_reader, args=(ch,), daemon=True)
+               for ch in channels[:4]]
+    threads += [threading.Thread(target=blocked_writer, args=(ch,), daemon=True)
+                for ch in channels[4:]]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    t0 = time.perf_counter()
+    for ch in channels:
+        ch.close()
+    for t in threads:
+        t.join(5)
+    assert time.perf_counter() - t0 < 2.0
+    assert results.count("closed") == 8, results
+
+
+def test_device_channel_places_after_slot_acquired():
+    """Satellite fix: under backpressure the blocked writer must NOT hold a
+    device-placed second copy — jax.device_put runs only once the slot is
+    free (observable: placement count trails the write call)."""
+    placed = []
+
+    class CountingChannel(DeviceChannel):
+        def _place(self, value):
+            placed.append(True)
+            return super()._place(value)
+
+    ch = CountingChannel(jax.devices()[0])
+    ch.write(jnp.arange(4))
+    assert len(placed) == 1
+
+    done = threading.Event()
+
+    def second_write():
+        ch.write(jnp.arange(4))
+        done.set()
+
+    t = threading.Thread(target=second_write, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    # writer is blocked on the full slot: placement must NOT have happened
+    assert len(placed) == 1 and not done.is_set()
+    ch.read()
+    t.join(2)
+    assert done.is_set() and len(placed) == 2
+    assert list(np.asarray(ch.read())) == [0, 1, 2, 3]
 
 
 def test_channel_roundtrip():

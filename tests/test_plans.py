@@ -1,0 +1,495 @@
+"""Compiled execution plans (ISSUE 5): install-once DAG schedules with
+persistent data-plane channels.
+
+Covers the channel layer (SeqChannel backpressure, chan_push streams), the
+plan lifecycle (compile -> install -> execute -> teardown), the acceptance
+bar (a 4-stage cross-node pipeline runs N=100 iterations with ZERO
+per-iteration TaskSpec/ObjectRef creation, asserted via the scheduler/task
+counters), pipelined execute_async, the failure story (actor kill and agent
+kill -9 -> typed error + BROKEN), and the observability surfaces
+(/api/plans + `rt plans`).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dag import ChannelClosed, InputNode, MultiOutputNode
+from ray_tpu.exceptions import ActorDiedError, RayTaskError, WorkerCrashedError
+from ray_tpu.observability import metric_defs
+from ray_tpu.runtime.channel_manager import ChannelManager, SeqChannel
+
+
+# --------------------------------------------------------------------------
+# channel layer
+# --------------------------------------------------------------------------
+def test_seq_channel_backpressure_and_order():
+    ch = SeqChannel("t")
+    ch.write(0, "a")
+    # single slot: the second write must block until the slot drains
+    blocked = threading.Event()
+    done = threading.Event()
+
+    def second():
+        blocked.set()
+        ch.write(1, "b")
+        done.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    blocked.wait(2)
+    time.sleep(0.05)
+    assert not done.is_set()
+    assert ch.read() == (0, "a", False)
+    t.join(2)
+    assert done.is_set()
+    assert ch.read() == (1, "b", False)
+
+
+def test_seq_channel_close_with_typed_error_wakes_reader():
+    ch = SeqChannel("t")
+    out = {}
+
+    def reader():
+        try:
+            ch.read()
+        except BaseException as exc:  # noqa: BLE001
+            out["exc"] = exc
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    ch.close(ActorDiedError("stage actor killed"))
+    t.join(2)
+    assert isinstance(out["exc"], ActorDiedError)
+    # closed channel rejects writes with the same typed error
+    with pytest.raises(ActorDiedError):
+        ch.write(2, "x")
+
+
+def test_chan_push_stream_delivers_and_nacks_unknown():
+    """A persistent ChannelStream lands seq-numbered frames in the peer's
+    channel manager; unknown channels nack (ChannelClosed at the writer)."""
+    import numpy as np
+
+    from ray_tpu.core.object_store import ObjectStore
+    from ray_tpu.runtime import channel_manager, data_plane
+
+    mgr = channel_manager.global_manager()
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store)
+    try:
+        chans = mgr.register("testplan", ["c1"])
+        stream = data_plane.ChannelStream(server.address, "testplan", "c1")
+        payload = np.arange(1000, dtype=np.float32)
+        stream.push(0, payload)
+        seq, value, is_err = chans["c1"].read()
+        assert seq == 0 and not is_err
+        np.testing.assert_array_equal(np.asarray(value), payload)
+        # error frames carry the exception with is_error=True
+        stream.push(1, ValueError("boom"), is_error=True)
+        seq, value, is_err = chans["c1"].read()
+        assert seq == 1 and is_err and isinstance(value, ValueError)
+        # unknown channel: clean nack, not a wedged stream
+        bad = data_plane.ChannelStream(server.address, "testplan", "nope")
+        with pytest.raises(ChannelClosed):
+            bad.push(0, 1)
+        bad.close()
+        stream.close()
+    finally:
+        mgr.release_plan("testplan")
+        server.close()
+
+
+def test_channel_manager_release_closes_blocked():
+    mgr = ChannelManager()
+    chans = mgr.register("p", ["a"])
+    errs = []
+
+    def reader():
+        try:
+            chans["a"].read()
+        except ChannelClosed as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    mgr.release_plan("p")
+    t.join(2)
+    assert len(errs) == 1
+    assert mgr.channel("p", "a") is None
+
+
+# --------------------------------------------------------------------------
+# plan lifecycle on an in-process multi-node cluster
+# --------------------------------------------------------------------------
+@pytest.fixture
+def two_node_pipeline(ray_start_cluster):
+    rt_mod, cluster = ray_start_cluster
+    cluster.add_node({"CPU": 2, "stage": 4})
+
+    @rt.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def step(self, x):
+            return x + self.k
+
+        def fail(self, x):
+            raise ValueError(f"stage error at {x}")
+
+        def flaky(self, x):
+            if x < 0:
+                raise ValueError(f"stage error at {x}")
+            return x + self.k
+
+    head = dict(execution="inproc")
+    other = dict(execution="inproc", resources={"stage": 1}, num_cpus=0)
+    actors = [
+        Stage.options(**head).remote(1),
+        Stage.options(**other).remote(10),
+        Stage.options(**other).remote(100),
+        Stage.options(**head).remote(1000),
+    ]
+    yield cluster, Stage, actors
+
+
+def _chain(actors, inp):
+    d = inp
+    for a in actors:
+        d = a.step.bind(d)
+    return d
+
+
+def test_plan_100_iterations_zero_taskspecs(two_node_pipeline):
+    """Acceptance bar: a 4-stage pipeline of actors spanning 2 nodes runs
+    N=100 iterations through the installed plan with ZERO per-iteration
+    TaskSpec / scheduler-dispatch / ObjectRef creation."""
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = _chain(actors, inp)
+    plan = d.compile_plan(name="accept")
+    assert plan.state == "READY"
+    assert {s["node"] for s in plan.snapshot()["stages"]} == {
+        n.hex()[:8] for n in cluster.nodes
+    }
+
+    before = (
+        metric_defs.TASKS_SUBMITTED.series(),
+        metric_defs.ACTOR_CALLS_SUBMITTED.series(),
+        metric_defs.SCHEDULER_TASKS_DISPATCHED.series(),
+    )
+    refs_before = cluster.core_worker.ref_counter.num_tracked()
+    for i in range(100):
+        assert plan.execute(i) == i + 1111
+    after = (
+        metric_defs.TASKS_SUBMITTED.series(),
+        metric_defs.ACTOR_CALLS_SUBMITTED.series(),
+        metric_defs.SCHEDULER_TASKS_DISPATCHED.series(),
+    )
+    assert before == after, "plan.execute must create zero TaskSpecs"
+    assert cluster.core_worker.ref_counter.num_tracked() == refs_before, (
+        "plan.execute must create zero ObjectRefs"
+    )
+    snap = plan.snapshot()
+    assert snap["executions"] == 100 and snap["state"] == "READY"
+    plan.teardown()
+
+
+def test_plan_execute_async_pipelines_iterations(two_node_pipeline):
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = _chain(actors, inp)
+    plan = d.compile_plan()
+    futs = [plan.execute_async(i) for i in range(50)]
+    assert [f.result(timeout=60) for f in futs] == [i + 1111 for i in range(50)]
+    plan.teardown()
+
+
+def test_plan_multi_output_and_kwargs(two_node_pipeline):
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        first = actors[0].step.bind(inp)
+        d = MultiOutputNode([actors[1].step.bind(first), actors[2].step.bind(first)])
+    plan = d.compile_plan()
+    assert plan.execute(5) == [5 + 1 + 10, 5 + 1 + 100]
+    assert plan.execute(0) == [11, 101]
+    plan.teardown()
+
+
+def test_plan_user_exception_fails_iteration_not_plan(two_node_pipeline):
+    """A stage raising a USER error fails that iteration (typed error out of
+    the output channel) but the plan stays READY — only actor/node death
+    breaks it (reference aDAG semantics)."""
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = actors[1].fail.bind(actors[0].step.bind(inp))
+    plan = d.compile_plan()
+    with pytest.raises(RayTaskError, match="stage error"):
+        plan.execute(3)
+    assert plan.state == "READY"
+    # and the pipeline keeps serving afterwards
+    with pytest.raises(RayTaskError):
+        plan.execute(4)
+    plan.teardown()
+
+
+def test_plan_multi_output_error_does_not_desync_siblings(two_node_pipeline):
+    """One leaf erroring must drain the sibling leaf's output slot too —
+    otherwise every later iteration reads the previous iteration's stale
+    value (outputs permanently desynced from futures)."""
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        first = actors[0].step.bind(inp)
+        d = MultiOutputNode([actors[1].flaky.bind(first), actors[2].step.bind(first)])
+    plan = d.compile_plan()
+    with pytest.raises(RayTaskError, match="stage error"):
+        plan.execute(-5)  # leaf 0 errors; leaf 1 still produced a value
+    assert plan.state == "READY"
+    # the SAME plan's next iteration must return ITS values, not the stale
+    # sibling slot from the errored iteration
+    assert plan.execute(5) == [5 + 1 + 10, 5 + 1 + 100]
+    assert plan.execute(0) == [11, 101]
+    plan.teardown()
+
+
+def test_plan_actor_kill_breaks_plan_with_typed_error(two_node_pipeline):
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = _chain(actors, inp)
+    plan = d.compile_plan()
+    assert plan.execute(1) == 1112
+    rt.kill(actors[2])
+    deadline = time.monotonic() + 10
+    while plan.state != "BROKEN" and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert plan.state == "BROKEN"
+    with pytest.raises(ActorDiedError):
+        plan.execute(2)
+    plan.teardown()
+
+
+def test_plan_node_death_breaks_plan(two_node_pipeline):
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = _chain(actors, inp)
+    plan = d.compile_plan()
+    assert plan.execute(0) == 1111
+    victim = next(
+        nid for nid, n in cluster.nodes.items() if n is not cluster.head_node
+    )
+    cluster.kill_node(victim, reason="test")
+    assert plan.state == "BROKEN"
+    with pytest.raises((ActorDiedError, WorkerCrashedError)):
+        plan.execute(1)
+    plan.teardown()
+
+
+def test_plan_teardown_idempotent_and_execute_after(two_node_pipeline):
+    cluster, Stage, actors = two_node_pipeline
+    with InputNode() as inp:
+        d = _chain(actors[:2], inp)
+    plan = d.compile_plan()
+    assert plan.execute(0) == 11
+    assert plan.plan_id in cluster.compiled_plans
+    plan.teardown()
+    plan.teardown()  # idempotent
+    assert plan.plan_id not in cluster.compiled_plans
+    with pytest.raises(RuntimeError, match="torn down"):
+        plan.execute(0)
+
+
+def test_plan_rejects_function_nodes_and_const_only_stages(ray_start_regular):
+    @rt.remote
+    def f(x):
+        return x
+
+    @rt.remote
+    class A:
+        def m(self, x):
+            return x
+
+    a = A.options(execution="inproc").remote()
+    with InputNode() as inp:
+        d = f.bind(inp)
+    with pytest.raises(ValueError, match="CompiledDAG"):
+        d.compile_plan()
+    with InputNode() as inp:
+        d = a.m.bind(7)  # no per-iteration input
+    with pytest.raises(ValueError, match="per-iteration"):
+        d.compile_plan()
+
+
+def test_plan_const_args_and_input_selectors(ray_start_regular):
+    @rt.remote
+    class Mixer:
+        def mix(self, x, y, scale=1):
+            return (x + y) * scale
+
+    m = Mixer.options(execution="inproc").remote()
+    with InputNode() as inp:
+        d = m.mix.bind(inp.a, inp.b, scale=10)
+    plan = d.compile_plan()
+    assert plan.execute(a=3, b=4) == 70
+    assert plan.execute(a=1, b=1) == 20
+    plan.teardown()
+
+
+# --------------------------------------------------------------------------
+# multihost: a real agent process hosts half the pipeline
+# --------------------------------------------------------------------------
+def test_plan_install_and_execute_across_processes():
+    from test_multihost import _spawn_agent, _wait_for_nodes
+
+    rt.init(num_cpus=2)
+    proc = None
+    try:
+        cluster = rt.get_cluster()
+        address = cluster.start_head_service()
+        proc = _spawn_agent(address)
+        _wait_for_nodes(cluster, 2)
+
+        @rt.remote
+        class Stage:
+            def __init__(self, k):
+                self.k = k
+
+            def step(self, x):
+                return x + self.k
+
+        head = dict(execution="inproc")
+        remote = dict(execution="inproc", resources={"remote": 1}, num_cpus=0)
+        actors = [
+            Stage.options(**head).remote(1),
+            Stage.options(**remote).remote(10),
+            Stage.options(**remote).remote(100),
+            Stage.options(**head).remote(1000),
+        ]
+        with InputNode() as inp:
+            d = _chain(actors, inp)
+        plan = d.compile_plan(name="xproc")
+        sent_before = metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "sent"})
+        before = (
+            metric_defs.TASKS_SUBMITTED.series(),
+            metric_defs.ACTOR_CALLS_SUBMITTED.series(),
+        )
+        for i in range(100):
+            assert plan.execute(i) == i + 1111
+        assert (
+            metric_defs.TASKS_SUBMITTED.series(),
+            metric_defs.ACTOR_CALLS_SUBMITTED.series(),
+        ) == before
+        # the iterations crossed processes on the persistent channel streams
+        assert metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "sent"}) > sent_before
+        # pipelined async across the process boundary
+        futs = [plan.execute_async(i) for i in range(30)]
+        assert [f.result(timeout=60) for f in futs] == [i + 1111 for i in range(30)]
+        plan.teardown()
+        # teardown released the agent-side channels: a fresh plan reinstalls
+        plan2 = d.compile_plan()
+        assert plan2.execute(0) == 1111
+        plan2.teardown()
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        rt.shutdown()
+
+
+def test_plan_agent_kill9_yields_typed_error_and_broken():
+    import signal
+
+    from test_multihost import _spawn_agent, _wait_for_nodes
+
+    rt.init(num_cpus=2)
+    proc = None
+    try:
+        cluster = rt.get_cluster()
+        address = cluster.start_head_service()
+        proc = _spawn_agent(address)
+        _wait_for_nodes(cluster, 2)
+
+        @rt.remote
+        class Stage:
+            def step(self, x):
+                return x + 1
+
+        a = Stage.options(execution="inproc").remote()
+        b = Stage.options(
+            execution="inproc", resources={"remote": 1}, num_cpus=0
+        ).remote()
+        with InputNode() as inp:
+            d = b.step.bind(a.step.bind(inp))
+        plan = d.compile_plan()
+        assert plan.execute(0) == 2
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        # keep executing until the death sweep breaks the plan; every
+        # surfaced failure must be the typed error, never a hang
+        deadline = time.monotonic() + 60
+        with pytest.raises((ActorDiedError, WorkerCrashedError)):
+            while time.monotonic() < deadline:
+                plan.execute(1)
+        assert plan.state == "BROKEN"
+        with pytest.raises((ActorDiedError, WorkerCrashedError)):
+            plan.execute(2)
+        plan.teardown()
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# observability: /api/plans + `rt plans` CLI smoke
+# --------------------------------------------------------------------------
+def test_api_plans_and_cli_smoke(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rt.init(num_cpus=2, include_dashboard=True)
+    try:
+        url = rt.get_cluster().dashboard.url
+
+        @rt.remote
+        class Stage:
+            def step(self, x):
+                return x * 2
+
+        a = Stage.options(execution="inproc").remote()
+        b = Stage.options(execution="inproc").remote()
+        with InputNode() as inp:
+            d = b.step.bind(a.step.bind(inp))
+        plan = d.compile_plan(name="cli-smoke")
+        assert plan.execute(3) == 12
+        assert main(["plans", "--address", url]) == 0
+        out = capsys.readouterr().out
+        assert "cli-smoke" in out and "READY" in out
+        assert "step()" in out
+        # json form round-trips
+        assert main(["plans", "--address", url, "--format", "json"]) == 0
+        import json as _json
+
+        data = _json.loads(capsys.readouterr().out)
+        assert data["plans"][0]["executions"] >= 1
+        assert data["totals"]["executions_ok"] >= 1
+        plan.teardown()
+        assert main(["plans", "--address", url]) == 0
+        assert "0 installed" in capsys.readouterr().out
+    finally:
+        rt.shutdown()
+
+
+def test_plan_metric_families_in_catalog():
+    """The three new families ride ALL_METRICS, so the tier-1
+    exposition-validity test (test_tracing) covers them automatically."""
+    names = {m.name for m in metric_defs.ALL_METRICS}
+    assert {
+        "compiled_plan_executions_total",
+        "compiled_channel_bytes_total",
+        "compiled_channel_occupancy",
+    } <= names
