@@ -53,8 +53,15 @@ class StandardAutoscaler:
         available: List[Dict[str, float]] = []
         busy: Dict[str, bool] = {}
         totals: Dict[str, Dict[str, float]] = {}
+        draining = getattr(self._cluster.cluster_scheduler, "is_draining", None)
         for node_id, node in list(self._cluster.nodes.items()):
             if node.dead:
+                continue
+            if draining is not None and draining(node_id):
+                # mid-drain: its capacity must not satisfy pending demand
+                # (nothing new places there) and it must not be re-picked
+                # for idle termination — mark busy, skip its availability
+                busy[node_id.hex()] = True
                 continue
             avail = node.pool.available.to_dict()
             total = node.pool.total.to_dict()

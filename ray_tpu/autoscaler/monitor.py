@@ -25,6 +25,9 @@ class Monitor:
         self.autoscaler = StandardAutoscaler(cluster, self.provider, config)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # expose the live autoscaler on the fabric (GET /api/autoscaler,
+        # `rt nodes` read its summary through the dashboard)
+        cluster.autoscaler_monitor = self
 
     def start(self) -> "Monitor":
         if self._thread is not None:

@@ -75,10 +75,12 @@ class InProcessNodeProvider(NodeProvider):
             self._managed.pop(provider_node_id, None)
         for node_id, node in list(self._cluster.nodes.items()):
             if node_id.hex() == provider_node_id and not node.dead:
-                # graceful: drain, then remove (reference DrainRaylet,
-                # node_manager.proto:391)
-                self._cluster.control.nodes.drain(node_id)
-                self._cluster.kill_node(node_id, reason="autoscaler terminated node")
+                # graceful removal (reference DrainRaylet,
+                # node_manager.proto:391): stop placements, evacuate
+                # sole-replica objects, restart actors elsewhere, THEN
+                # terminate — idle scale-down must never strand the only
+                # copy of an object someone still holds a ref to
+                self._cluster.drain_node(node_id)
                 return
 
     def non_terminated_nodes(self) -> Dict[str, str]:

@@ -22,7 +22,7 @@ CLI: ``rt chaos run --seed N --schedule f.json``.
 
 from ray_tpu.chaos.invariants import InvariantReport, check_invariants, snapshot_baseline
 from ray_tpu.chaos.runner import ChaosResult, ChaosRunner
-from ray_tpu.chaos.schedule import ChaosEvent, ChaosSchedule
+from ray_tpu.chaos.schedule import ChaosEvent, ChaosSchedule, validate_schedule
 
 __all__ = [
     "ChaosEvent",
@@ -32,4 +32,5 @@ __all__ = [
     "InvariantReport",
     "check_invariants",
     "snapshot_baseline",
+    "validate_schedule",
 ]

@@ -20,6 +20,19 @@ These are the contracts (ISSUE 2 tentpole):
   5. **Retries are visible**: every terminal record with ``attempt = n > 0``
      has matching distinct ``retry::`` spans in the span store (PR 1
      tracing), so a reproduced schedule can be audited from the timeline.
+
+Elasticity invariants (ISSUE 6 tentpole) — membership changes must not
+weaken any of the above, and add contracts of their own:
+
+  6. **Drains lose nothing**: every graceful drain evacuated ALL its
+     sole-replica objects before terminating — an object that had a
+     surviving replica (or time to gain one) is never lost to a drain.
+  7. **Restart budgets hold**: no actor's ``num_restarts`` ever exceeds its
+     ``max_restarts`` — drains, head restarts, and chaos kills all consume
+     the same FSM budget.
+  8. **Plan state machines are legal**: compiled plans only ever move
+     READY→BROKEN (death), BROKEN→READY (repair), or →TORN_DOWN — audited
+     from the cluster's transition log so released plans stay checkable.
 """
 
 from __future__ import annotations
@@ -60,9 +73,13 @@ def snapshot_baseline() -> dict:
 
     worker = global_worker()
     worker.ref_counter.drain_deferred()
+    cluster = worker.cluster
     return {
         "tracked_refs": worker.ref_counter.num_tracked(),
-        "num_task_events": len(worker.cluster.control.task_events),
+        "num_task_events": len(cluster.control.task_events),
+        # elasticity scoping: only drains / plan transitions from THIS run
+        "num_drain_reports": len(getattr(cluster, "drain_reports", ())),
+        "num_plan_transitions": len(getattr(cluster, "plan_transitions", ())),
     }
 
 
@@ -244,4 +261,48 @@ def check_invariants(
                     f"{len(seen)} retry spans are in the span store"
                 )
     report.checked["tasks_with_retries"] = sum(1 for a in attempts_by_task.values() if max(a) > 0)
+
+    # 6. drains lose nothing that had somewhere to go -----------------------
+    drain_reports = list(getattr(cluster, "drain_reports", ()))
+    if baseline is not None:
+        drain_reports = drain_reports[baseline.get("num_drain_reports", 0):]
+    for rep in drain_reports:
+        if rep.get("failed_evacuations"):
+            report.add(
+                f"drain of node {rep['node']} terminated with "
+                f"{rep['failed_evacuations']} sole-replica object(s) "
+                "unevacuated (survivors existed)"
+            )
+    report.checked["drains"] = len(drain_reports)
+    report.checked["drain_evacuated"] = sum(r.get("evacuated", 0) for r in drain_reports)
+
+    # 7. actor restart budgets hold -----------------------------------------
+    over_budget = [
+        info for info in cluster.control.actors.list_actors()
+        if info.max_restarts >= 0 and info.num_restarts > info.max_restarts
+    ]
+    for info in over_budget:
+        report.add(
+            f"actor {info.actor_id.hex()[:8]} restarted {info.num_restarts} "
+            f"times with max_restarts={info.max_restarts}"
+        )
+
+    # 8. compiled-plan state machines are legal -----------------------------
+    legal = {
+        ("READY", "BROKEN"), ("BROKEN", "READY"),
+        ("READY", "TORN_DOWN"), ("BROKEN", "TORN_DOWN"),
+    }
+    transitions = list(getattr(cluster, "plan_transitions", ()))
+    if baseline is not None:
+        transitions = transitions[baseline.get("num_plan_transitions", 0):]
+    last_state: Dict[str, str] = {}
+    for plan_id, src, dst in transitions:
+        prev = last_state.get(plan_id, src)
+        if (prev, dst) not in legal or prev != src:
+            report.add(
+                f"plan {plan_id[:8]} made an illegal state transition "
+                f"{src}->{dst} (after {prev})"
+            )
+        last_state[plan_id] = dst
+    report.checked["plan_transitions"] = len(transitions)
     return report

@@ -189,7 +189,7 @@ class ChaosRunner:
             failpoints.arm({name: {"action": "partition", "prob": 1.0, "delay_s": 0.0}})
             restores.append((t_start + event.t + float(p.get("duration", 1.0)), name, prev))
             return {"fp": name, "duration": p.get("duration", 1.0)}
-        if event.kind == "kill_node":
+        if event.kind in ("kill_node", "drain_node"):
             victims = [
                 (nid, node) for nid, node in cluster.nodes.items()
                 if not node.dead and node is not cluster.head_node
@@ -198,8 +198,25 @@ class ChaosRunner:
             if idx >= len(victims):
                 return {"skipped": f"no live non-head node at index {idx}"}
             nid, node = victims[idx]
-            cluster.kill_node(nid, reason="chaos schedule kill_node")
-            return {"node": nid.hex()[:8]}
+            if event.kind == "kill_node":
+                cluster.kill_node(nid, reason="chaos schedule kill_node")
+                return {"node": nid.hex()[:8]}
+            report = cluster.drain_node(nid, timeout_s=p.get("timeout"))
+            return {
+                "node": nid.hex()[:8],
+                "outcome": report["outcome"],
+                "evacuated": report["evacuated"],
+                "actors_restarted": report["actors_restarted"],
+            }
+        if event.kind == "add_node":
+            node = cluster.add_node(
+                dict(p.get("resources") or {"CPU": 1}), labels=p.get("labels")
+            )
+            return {"node": node.node_id.hex()[:8]}
+        if event.kind == "kill_head":
+            return {"snapshot": cluster.kill_head()}
+        if event.kind == "restart_head":
+            return cluster.restart_head()
         if event.kind == "lose_objects":
             return self._lose_objects(cluster, float(p.get("fraction", 0.5)))
         return {}
@@ -265,6 +282,20 @@ def run_cli(args) -> int:
     import json
 
     import ray_tpu as rt
+
+    # schema-check before burning minutes: a typo'd kind or malformed spec
+    # fails in milliseconds with a friendly message, not mid-run
+    from ray_tpu.chaos.schedule import validate_schedule
+
+    with open(args.schedule) as f:
+        errors = validate_schedule(json.load(f))
+    if errors:
+        import sys
+
+        print(f"{args.schedule}: invalid schedule", file=sys.stderr)
+        for err in errors:
+            print(f"  - {err}", file=sys.stderr)
+        return 1
 
     schedule = ChaosSchedule.load(args.schedule, seed=args.seed)
     own_runtime = not rt.is_initialized()

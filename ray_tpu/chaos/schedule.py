@@ -29,6 +29,18 @@ Event kinds
 ``lose_objects``  delete a seeded ``fraction`` of committed objects from
                   every store and kick lineage reconstruction — the
                   "silent storage loss" failure mode.
+``add_node``      grow the cluster mid-run: add a node with ``resources``
+                  (default ``{"CPU": 1}``) and optional ``labels`` — the
+                  elastic half of a scale event.
+``drain_node``    gracefully remove the ``index``-th live non-head node via
+                  ``cluster.drain_node`` (DrainRaylet parity): placements
+                  stop, sole-replica objects evacuate, actors restart
+                  elsewhere, then the node terminates.
+``kill_head``     simulate head control-service death: durable state (incl.
+                  failpoint hit counters) snapshots, then mutations go to
+                  the doomed incarnation until ``restart_head``.
+``restart_head``  restore the head from the kill-time snapshot; live nodes
+                  re-adopt and live actor instances reconcile.
 """
 
 from __future__ import annotations
@@ -36,7 +48,10 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-_KINDS = ("arm", "disarm", "partition", "kill_node", "lose_objects")
+_KINDS = (
+    "arm", "disarm", "partition", "kill_node", "lose_objects",
+    "add_node", "drain_node", "kill_head", "restart_head",
+)
 
 
 class ChaosEvent:
@@ -112,3 +127,157 @@ class ChaosSchedule:
         for e in self.events:
             end = max(end, e.t + float(e.params.get("duration", 0.0)))
         return end
+
+
+# --------------------------------------------------------------------------
+# schema validation (`rt chaos validate`) — catch a malformed schedule in
+# milliseconds instead of finding out minutes into a chaos run
+# --------------------------------------------------------------------------
+
+#: per-kind parameter schema: name -> (required, {param: allowed types})
+_EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
+    "arm": {"spec": (True, (str, dict))},
+    "disarm": {"name": (False, (str,))},
+    "partition": {"fp": (True, (str,)), "duration": (False, (int, float))},
+    "kill_node": {"index": (False, (int,))},
+    "drain_node": {"index": (False, (int,)), "timeout": (False, (int, float))},
+    "add_node": {"resources": (False, (dict,)), "labels": (False, (dict,))},
+    "kill_head": {},
+    "restart_head": {},
+    "lose_objects": {"fraction": (False, (int, float))},
+}
+
+
+def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
+    """Schema-check a schedule dict (as loaded from JSON) WITHOUT running
+    anything.  Returns a list of friendly error strings — empty means valid.
+
+    ``num_nodes`` (optional) is the number of live non-head worker nodes the
+    run will start with; when given, ``kill_node``/``drain_node`` indices
+    are bounds-checked against a simulated node count that tracks
+    ``add_node``/``kill_node``/``drain_node`` events in timeline order."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return [f"schedule must be a JSON object, got {type(data).__name__}"]
+    if "seed" in data and not isinstance(data["seed"], int):
+        errors.append(f"'seed' must be an integer, got {data['seed']!r}")
+    events = data.get("events")
+    if events is None:
+        return errors + ["schedule has no 'events' list"]
+    if not isinstance(events, list):
+        return errors + [f"'events' must be a list, got {type(events).__name__}"]
+
+    from ray_tpu.runtime.failpoints import parse_spec
+
+    indexed = []
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: must be an object, got {type(ev).__name__}")
+            continue
+        kind = ev.get("kind")
+        if kind is None:
+            errors.append(f"{where}: missing 'kind'")
+            continue
+        if kind not in _KINDS:
+            errors.append(
+                f"{where}: unknown kind {kind!r} (expected one of {', '.join(_KINDS)})"
+            )
+            continue
+        t = ev.get("t", 0.0)
+        if not isinstance(t, (int, float)) or isinstance(t, bool):
+            errors.append(f"{where} ({kind}): 't' must be a number, got {t!r}")
+            t = 0.0
+        elif t < 0:
+            errors.append(f"{where} ({kind}): 't' must be >= 0, got {t}")
+        schema = _EVENT_SCHEMA[kind]
+        for pname, (required, _types) in schema.items():
+            if required and pname not in ev:
+                errors.append(f"{where} ({kind}): missing required parameter {pname!r}")
+        for pname, pval in ev.items():
+            if pname in ("t", "kind"):
+                continue
+            if pname not in schema:
+                errors.append(
+                    f"{where} ({kind}): unknown parameter {pname!r} "
+                    f"(accepts: {', '.join(schema) or 'none'})"
+                )
+                continue
+            types = schema[pname][1]
+            if not isinstance(pval, types) or isinstance(pval, bool):
+                names = "/".join(tp.__name__ for tp in types)
+                errors.append(
+                    f"{where} ({kind}): {pname!r} must be {names}, got {pval!r}"
+                )
+        if kind == "arm" and isinstance(ev.get("spec"), str):
+            try:
+                parse_spec(ev["spec"])
+            except ValueError as exc:
+                errors.append(f"{where} (arm): bad failpoint spec: {exc}")
+        if kind == "partition" and isinstance(ev.get("duration"), (int, float)) \
+                and ev["duration"] <= 0:
+            errors.append(f"{where} (partition): 'duration' must be > 0")
+        if kind == "lose_objects" and isinstance(ev.get("fraction"), (int, float)) \
+                and not 0.0 <= ev["fraction"] <= 1.0:
+            errors.append(
+                f"{where} (lose_objects): 'fraction' must be in [0, 1], "
+                f"got {ev['fraction']}"
+            )
+        if kind in ("kill_node", "drain_node") and isinstance(ev.get("index"), int) \
+                and ev["index"] < 0:
+            errors.append(f"{where} ({kind}): 'index' must be >= 0")
+        indexed.append((t, i, kind, ev))
+
+    # timeline-order simulation: head liveness pairing + node-index bounds
+    indexed.sort(key=lambda e: (e[0], e[1]))
+    head_down = False
+    live = num_nodes
+    for t, i, kind, ev in indexed:
+        where = f"event[{i}]"
+        if kind == "kill_head":
+            if head_down:
+                errors.append(f"{where}: kill_head while the head is already down")
+            head_down = True
+        elif kind == "restart_head":
+            if not head_down:
+                errors.append(f"{where}: restart_head without a preceding kill_head")
+            head_down = False
+        elif live is not None:
+            if kind == "add_node":
+                live += 1
+            elif kind in ("kill_node", "drain_node"):
+                idx = ev.get("index", 0)
+                if isinstance(idx, int) and idx >= live:
+                    errors.append(
+                        f"{where} ({kind}): index {idx} out of range — only "
+                        f"{live} live non-head node(s) at t={t}"
+                    )
+                live = max(0, live - 1)
+    if head_down:
+        errors.append("schedule ends with the head still down (missing restart_head)")
+    return errors
+
+
+def validate_cli(args) -> int:
+    """``rt chaos validate <schedule.json> [--nodes N]``: schema-check a
+    schedule before a run burns minutes on it."""
+    import sys
+
+    try:
+        with open(args.schedule) as f:
+            data = json.load(f)
+    except OSError as exc:
+        print(f"cannot read {args.schedule}: {exc}", file=sys.stderr)
+        return 1
+    except json.JSONDecodeError as exc:
+        print(f"{args.schedule} is not valid JSON: {exc}", file=sys.stderr)
+        return 1
+    errors = validate_schedule(data, num_nodes=args.nodes)
+    if errors:
+        print(f"{args.schedule}: {len(errors)} problem(s)", file=sys.stderr)
+        for err in errors:
+            print(f"  - {err}", file=sys.stderr)
+        return 1
+    n = len(data.get("events", []))
+    print(f"{args.schedule}: ok ({n} events, seed {data.get('seed', 0)})")
+    return 0

@@ -189,6 +189,14 @@ class Config:
     # reconnect to a restarted GCS — core_worker.proto:443
     # RayletNotifyGCSRestart). 0 restores the round-2 exit-on-disconnect.
     agent_reconnect_timeout_s: float = 60.0
+    # Graceful node drain (Cluster.drain_node, DrainRaylet parity): budget
+    # for evacuating sole-replica objects AND for the node's in-flight
+    # tasks to finish before the terminate lands anyway.
+    drain_node_timeout_s: float = 30.0
+    # Compiled-plan self-healing: how long repair() (and the auto-repair
+    # thread of plans compiled with auto_repair=True) waits for each dead
+    # stage actor to come back ALIVE through the restart FSM.
+    compiled_plan_repair_timeout_s: float = 30.0
 
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):

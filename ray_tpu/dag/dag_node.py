@@ -66,14 +66,18 @@ class DAGNode:
 
         return CompiledDAG(self, fuse=fuse)
 
-    def compile_plan(self, name: str = "") -> "ExecutionPlan":
+    def compile_plan(self, name: str = "", auto_repair: bool = False) -> "ExecutionPlan":
         """Compile an actor-method DAG into a multi-host execution plan:
         stage programs installed ONCE on every participating node, edges as
         persistent channels, zero TaskSpecs/ObjectRefs per execute()
-        (docs/compiled_dags.md)."""
+        (docs/compiled_dags.md).  ``auto_repair=True`` opts the plan into
+        self-healing: when a stage actor/node death flips it BROKEN, a
+        background repair waits for the restart FSM to bring the dead
+        actors back and reinstalls onto the replacements instead of
+        staying broken forever."""
         from ray_tpu.dag.plan import ExecutionPlan
 
-        return ExecutionPlan(self, name=name)
+        return ExecutionPlan(self, name=name, auto_repair=auto_repair)
 
     def _resolve(self, value, cache):
         return cache[id(value)] if isinstance(value, DAGNode) else value

@@ -107,7 +107,7 @@ class ExecutionPlan:
     schedule; see the module docstring.  Build via
     ``dag_node.compile_plan()``."""
 
-    def __init__(self, root: DAGNode, name: str = ""):
+    def __init__(self, root: DAGNode, name: str = "", auto_repair: bool = False):
         from ray_tpu.api import _auto_init, get_cluster
 
         _auto_init()
@@ -116,6 +116,9 @@ class ExecutionPlan:
         self.name = name or f"plan-{self.plan_id[:8]}"
         self._state = "READY"
         self._error: Optional[BaseException] = None
+        self._auto_repair = auto_repair
+        self._repair_lock = threading.Lock()   # serializes repair attempts
+        self.state_history: List[str] = ["READY"]
         self._state_lock = threading.Lock()
         self._submit_lock = threading.Lock()
         self._seq = 0
@@ -248,6 +251,38 @@ class ExecutionPlan:
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"stage actor {actor_id.hex()[:8]} never became ALIVE"
+                )
+            time.sleep(0.01)
+
+    def _wait_stage_actor_live(self, actor_id, deadline: float):
+        """Repair's stricter liveness wait: the control record may still
+        say ALIVE-on-the-dead-node for a beat (the death sweep breaks the
+        plan BEFORE it runs the actor FSM), so besides the FSM state the
+        hosting node must be alive and — for in-process nodes — the actor
+        instance must actually exist there.  ``deadline`` is a monotonic
+        instant shared by the whole repair, not a per-actor budget."""
+        from ray_tpu.runtime.control import ActorState
+
+        while True:
+            info = self._cluster.control.actors.get(actor_id)
+            if info is None:
+                raise ValueError(f"unknown actor {actor_id.hex()[:8]} in plan")
+            if info.state is ActorState.DEAD:
+                raise ActorDiedError(
+                    actor_id, "stage actor is permanently dead; plan unrepairable"
+                )
+            if info.state is ActorState.ALIVE and info.node_id is not None:
+                node = self._cluster.nodes.get(info.node_id)
+                if node is not None and not node.dead:
+                    insts = getattr(node, "actors", None)
+                    if insts is None:  # remote agent hosts it
+                        return info.node_id
+                    inst = insts.get(actor_id)
+                    if inst is not None and not inst.dead:
+                        return info.node_id
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"stage actor {actor_id.hex()[:8]} never came back ALIVE"
                 )
             time.sleep(0.01)
 
@@ -495,15 +530,131 @@ class ExecutionPlan:
     # ------------------------------------------------------------------
     # failure + lifecycle
     # ------------------------------------------------------------------
+    def _record_transition(self, src: str, dst: str) -> None:
+        """History for the chaos sweep's READY→BROKEN→READY audit — the
+        cluster-level log outlives torn-down plans."""
+        self.state_history.append(dst)
+        try:
+            self._cluster.plan_transitions.append((self.plan_id, src, dst))
+        except Exception:  # noqa: BLE001 — bookkeeping must not block failure paths
+            pass
+
     def _mark_broken(self, error: BaseException) -> None:
         with self._state_lock:
             if self._state != "READY":
                 return
             self._state = "BROKEN"
             self._error = error
+            self._record_transition("READY", "BROKEN")
         # closing the driver-side channels wakes the drainer (pending
         # futures fail with the typed error) and nacks agent pushes
         self._manager.break_plan(self.plan_id, error)
+        if self._auto_repair:
+            threading.Thread(
+                target=self._auto_repair_loop,
+                name=f"plan-{self.plan_id[:8]}-repair", daemon=True,
+            ).start()
+
+    def _auto_repair_loop(self) -> None:
+        from ray_tpu.core.config import get_config
+
+        try:
+            self.repair(timeout=get_config().compiled_plan_repair_timeout_s)
+        except BaseException:  # noqa: BLE001 — the plan stays BROKEN with
+            pass               # the original typed error for introspection
+
+    def repair(self, timeout: float = 30.0) -> None:
+        """Rebuild a BROKEN plan onto its restarted stage actors.
+
+        The actor restart FSM owns bringing dead stage actors back (they
+        must be restartable — ``max_restarts`` budget left); repair waits
+        for every stage actor to be ALIVE again, releases the broken
+        channel fabric everywhere (streams, driver channels, remote stage
+        programs), re-runs placement against the actors' NEW nodes, and
+        reinstalls — then flips the plan back to READY.  Raises (and leaves
+        the plan BROKEN) if any stage actor is permanently DEAD or never
+        comes back within ``timeout``."""
+        from ray_tpu.observability import metric_defs
+
+        with self._repair_lock:
+            with self._state_lock:
+                if self._state == "READY":
+                    return  # nothing to repair (or a racing repair won)
+                if self._state != "BROKEN":
+                    raise RuntimeError(f"cannot repair a {self._state} plan")
+            try:
+                # 1. every stage actor back ALIVE, on its (possibly new)
+                # node — ONE deadline for the whole pass, so `timeout`
+                # bounds the repair wait, not timeout-per-stage
+                deadline = time.monotonic() + timeout
+                for draft in self._stages:
+                    draft.node_id = self._wait_stage_actor_live(
+                        draft.actor_id, deadline
+                    )
+                    draft.proc = self._proc_key(draft.node_id)
+                self._node_ids = {d.node_id for d in self._stages}
+                # 2. release the broken fabric: driver executor + streams,
+                # remote stage programs, local channel registrations.  The
+                # drainer has already failed every pending future (the
+                # break closed its channels); it survives and will read the
+                # NEW output channels after reinstall.
+                if self._executor is not None:
+                    self._executor.stop()
+                    self._executor = None
+                # let the drainer finish failing the broken epoch's pending
+                # futures (its reads raise instantly off the closed
+                # channels) BEFORE the swap — a stale future must never
+                # block on a fresh channel's first iteration
+                deadline = time.monotonic() + 5.0
+                while not self._pending.empty() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                time.sleep(0.02)  # settle: a just-popped future finishes its read
+                for stream in self._streams:
+                    try:
+                        stream.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._streams = []
+                self._entry_writes = []
+                self._out_channels = []
+                for handle in self._remote_handles.values():
+                    if handle.dead:
+                        continue
+                    try:
+                        handle.conn.request(
+                            "uninstall_plan", {"plan": self.plan_id}, timeout=10.0
+                        )
+                    except Exception:  # noqa: BLE001 — agent gone with its node
+                        pass
+                self._remote_handles = {}
+                self._manager.release_plan(self.plan_id)
+                # 3. reinstall on the replacements (fresh channels/streams)
+                self._install()
+            except BaseException:
+                metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
+                raise
+            with self._state_lock:
+                if self._state != "BROKEN":
+                    # torn down while we rebuilt: stay torn down — a repair
+                    # must never resurrect a released plan
+                    metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
+                    return
+                self._error = None
+                self._state = "READY"
+                self._record_transition("BROKEN", "READY")
+        metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "ok"})
+        # deaths that landed while state was BROKEN were ignored by the
+        # hooks — re-check so a mid-repair casualty re-breaks immediately
+        # instead of surfacing as a hang on the next execute
+        from ray_tpu.runtime.control import ActorState
+
+        for draft in self._stages:
+            info = self._cluster.control.actors.get(draft.actor_id)
+            if info is None or info.state is ActorState.DEAD:
+                self._mark_broken(
+                    ActorDiedError(draft.actor_id, "stage actor died during repair")
+                )
+                return
 
     def on_actor_dead(self, actor_id, cause: str = "") -> None:
         """Cluster hook: a stage actor died — flip BROKEN even with no
@@ -525,7 +676,9 @@ class ExecutionPlan:
         with self._state_lock:
             if self._state == "TORN_DOWN":
                 return
+            prev = self._state
             self._state = "TORN_DOWN"
+            self._record_transition(prev, "TORN_DOWN")
         self._cluster.compiled_plans.pop(self.plan_id, None)
         for handle in self._remote_handles.values():
             if handle.dead:
@@ -552,6 +705,8 @@ class ExecutionPlan:
             "plan": self.plan_id[:12],
             "name": self.name,
             "state": self._state,
+            "auto_repair": self._auto_repair,
+            "history": list(self.state_history),
             "executions": self._completed,
             "failed": self._failed,
             "inflight": max(0, self._seq - self._completed - self._failed),

@@ -183,6 +183,8 @@ class DashboardHead:
             req._send(200, self._transfer_stats())
         elif path == "/api/pulls":
             req._send(200, self._pull_stats())
+        elif path == "/api/autoscaler":
+            req._send(200, self._autoscaler_status())
         elif path == "/api/plans":
             req._send(200, self._plan_stats())
         elif path == "/api/memory":
@@ -368,6 +370,38 @@ class DashboardHead:
                     "device": device_plane.stats.snapshot(),
                 }
         return {"nodes": nodes}
+
+    def _autoscaler_status(self) -> dict:
+        """`rt nodes` / GET /api/autoscaler: per-node lifecycle state
+        (ALIVE / DRAINING / DEAD), drain reports with evacuation counts,
+        head-restart count, and the live autoscaler summary when a monitor
+        is attached."""
+        cluster = self.cluster
+        scheduler = cluster.cluster_scheduler
+        nodes = []
+        for info in cluster.control.nodes.all_nodes():
+            state = info.state.value
+            if state == "ALIVE" and scheduler.is_draining(info.node_id):
+                state = "DRAINING"
+            nodes.append(
+                {
+                    "node_id": info.node_id.hex(),
+                    "state": state,
+                    "address": info.address,
+                    "resources": info.resources_total,
+                    "is_head": (
+                        cluster.head_node is not None
+                        and info.node_id == cluster.head_node.node_id
+                    ),
+                }
+            )
+        monitor = getattr(cluster, "autoscaler_monitor", None)
+        return {
+            "nodes": nodes,
+            "drains": list(cluster.drain_reports),
+            "head_restarts": cluster.head_restarts,
+            "autoscaler": monitor.autoscaler.summary() if monitor is not None else None,
+        }
 
     def _pull_stats(self) -> dict:
         """`rt pulls`: the PullManager's live admission/dedup counters, the

@@ -213,6 +213,31 @@ CHAOS_FAULTS_INJECTED = _reg.counter(
     "Faults injected by armed failpoints, by failpoint name and action.",
 )
 
+# ---- elasticity: drains, head failover, plan self-healing ----------------
+NODE_DRAINS = _reg.counter(
+    "node_drains_total",
+    "Graceful node drains (Cluster.drain_node), by outcome (ok = evacuated "
+    "and quiesced in budget, timeout = terminated with work/objects still "
+    "in flight, noop = node already gone).",
+)
+DRAIN_EVACUATED_BYTES = _reg.counter(
+    "drain_evacuated_bytes_total",
+    "Bytes of sole-replica objects copied off draining nodes to survivors "
+    "before termination.",
+    "By",
+)
+HEAD_RESTARTS = _reg.counter(
+    "head_restarts_total",
+    "Head control-service restarts that restored durable state from the "
+    "snapshot and re-adopted live nodes/actors.",
+)
+PLAN_REPAIRS = _reg.counter(
+    "plan_repairs_total",
+    "Compiled-plan repair attempts (ExecutionPlan.repair / auto-repair), "
+    "by outcome (ok = plan returned to READY on restarted stage actors, "
+    "failed = a stage actor never came back).",
+)
+
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
     "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
@@ -264,6 +289,10 @@ ALL_METRICS = [
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,
     CHAOS_FAULTS_INJECTED,
+    NODE_DRAINS,
+    DRAIN_EVACUATED_BYTES,
+    HEAD_RESTARTS,
+    PLAN_REPAIRS,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

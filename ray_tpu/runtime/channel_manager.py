@@ -454,7 +454,10 @@ class StageExecutor:
                     self._emit(stage, seq, error, True)
                 else:
                     self._emit(stage, seq, result, False)
-            except ChannelClosed:
+            except (ChannelClosed, ActorDiedError, WorkerCrashedError):
+                # the channel was closed/broken under us (plan death sweep
+                # re-raises its typed error from close(error)): the plan is
+                # already broken out-of-band — just stand down
                 return
             except (DataPlaneError, OSError, TimeoutError) as exc:
                 # the error itself could not travel: break the plan out of

@@ -199,6 +199,15 @@ class ObjectDirectory:
         )
         callback(chosen)
 
+    def sole_replica_objects(self, node_id: NodeID) -> List[ObjectID]:
+        """Objects whose ONLY known location is ``node_id`` — what a
+        graceful drain must evacuate before terminating it."""
+        with self._lock:
+            return [
+                oid for oid, locs in self._locations.items()
+                if locs == {node_id}
+            ]
+
     def drop_node(self, node_id: NodeID) -> List[ObjectID]:
         """Remove all locations on a dead node; return objects now lost."""
         lost = []
@@ -300,6 +309,21 @@ class Cluster:
         # The node/actor death sweeps flip affected plans to BROKEN through
         # this registry; /api/plans and `rt plans` snapshot it.
         self.compiled_plans: Dict[str, Any] = {}
+        # plan state transitions (plan_id, from, to) appended by every plan
+        # lifecycle change — the chaos sweep audits the READY→BROKEN→READY
+        # machine from this log even after a plan is torn down/released
+        self.plan_transitions: List[tuple] = []
+        # drain reports (drain_node): evacuation counts + outcome, audited
+        # by the chaos elasticity invariants (nothing with a surviving
+        # replica may be lost by a drain)
+        self.drain_reports: List[dict] = []
+        # head failover simulation state (kill_head/restart_head chaos
+        # hooks); the lock makes the _head_down check and a snapshot write
+        # atomic — the periodic writer must never clobber the kill-time
+        # snapshot with doomed-incarnation state
+        self._head_down = False
+        self._head_lock = threading.Lock()
+        self.head_restarts = 0
         self.core_worker = None       # set by worker.init
         self.shm_store = None
         if shm_capacity >= 0:
@@ -460,6 +484,135 @@ class Cluster:
                 q.alive = True
             self._pump_actor_queue(actor_id)
 
+    # ------------------------------------------------------------------
+    # head failover (GCS restart parity, gcs_redis_failure_detector.h:28)
+    # ------------------------------------------------------------------
+    def _head_snapshot_path(self) -> str:
+        cfg = get_config()
+        return cfg.control_snapshot_path or os.path.join(
+            self.session_dir, "control.snap"
+        )
+
+    def kill_head(self) -> str:
+        """Chaos hook: simulate the head's control-service process dying.
+
+        Durable control state — KV, jobs, actor records, task events, spans,
+        and the failpoint hit counters (so same-seed fault logs stay
+        byte-identical through the restart) — snapshots to disk exactly as
+        the periodic writer would have.  The control service is then marked
+        down: mutations landing between kill and restart go to the doomed
+        incarnation and are DISCARDED at restart, which is precisely what
+        writes to a dying GCS lose.  Data-plane state (object stores,
+        in-flight tasks, live actor instances) is owned by workers/nodes
+        and survives, per the ownership invariant (SURVEY §1)."""
+        path = self._head_snapshot_path()
+        with self._head_lock:
+            if self._head_down:
+                raise RuntimeError("kill_head while the head is already down")
+            self.control.save_snapshot(path)
+            self._head_down = True
+        try:
+            from ray_tpu.observability.events import global_event_manager
+
+            global_event_manager().warning("CLUSTER", "head_killed", "head control service down")
+        except Exception:  # noqa: BLE001
+            pass
+        return path
+
+    def restart_head(self) -> dict:
+        """Chaos hook: bring a fresh control service up from the last
+        snapshot.  Durable state reloads; live nodes re-adopt (raylet
+        re-registration against a restarted GCS); live actor instances
+        reconcile back to ALIVE; actors whose host died during the outage
+        follow the restart FSM (restart elsewhere or DEAD)."""
+        if not self._head_down:
+            raise RuntimeError("restart_head called without a preceding kill_head")
+        path = self._head_snapshot_path()
+        old = self.control
+        fresh = ControlService()
+        fresh.restore_snapshot(path)
+        with self._node_lifecycle_lock:
+            # live nodes re-register with the fresh service (liveness is
+            # process state, rebuilt from the living — never snapshotted)
+            for nid, node in self.nodes.items():
+                if node.dead:
+                    continue
+                address = (
+                    f"tcp://{node.address}" if hasattr(node, "conn")
+                    else f"inproc://{nid.hex()[:8]}"
+                )
+                info = NodeInfo(
+                    nid, address, node.pool.total.to_dict(),
+                    getattr(node, "labels", None),
+                )
+                fresh.nodes.register(info)
+                if self.cluster_scheduler.is_draining(nid):
+                    fresh.nodes.drain(nid)
+            # live placement groups re-adopt like live actors do: their
+            # bundles still hold resources in surviving node pools (data
+            # plane), and the old in-process registry is the durable record
+            # a restarted GCS would reload them from — dropping them would
+            # leak the acquired bundle capacity forever
+            with old.placement_groups._lock:
+                live_groups = dict(old.placement_groups._groups)
+            with fresh.placement_groups._lock:
+                fresh.placement_groups._groups.update(live_groups)
+            fresh.placement_groups.bind_node_pools(
+                {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
+            )
+            self.control = fresh
+            with self._head_lock:
+                self._head_down = False
+        old.shutdown()
+        # the driver demonstrably survived the head restart (in-process
+        # fabric): its job is still RUNNING, not the FAILED a restore
+        # infers for jobs whose driver died with the old head
+        if self.core_worker is not None:
+            job = fresh.jobs.get(self.core_worker.job_id)
+            if job is not None:
+                job.status = "RUNNING"
+        # reconcile live actor instances (RayletNotifyGCSRestart parity):
+        # restored records come back RESTARTING; instances still alive on
+        # live nodes flip ALIVE and their queues pump, the rest follow the
+        # restart FSM (restart elsewhere if the budget allows, else DEAD)
+        reconciled = refailed = 0
+        for actor_id, spec in list(self._actor_specs.items()):
+            info = fresh.actors.get(actor_id)
+            if info is None or info.state is ActorState.DEAD:
+                continue
+            node = self.nodes.get(spec.owner_node)
+            live = False
+            if node is not None and not node.dead:
+                insts = getattr(node, "actors", None)
+                if insts is None:
+                    # remote agent: its instances survived with it (deaths
+                    # during the outage re-report through the live channel)
+                    live = True
+                else:
+                    inst = insts.get(actor_id)
+                    live = inst is not None and not inst.dead
+            if live:
+                self.reconcile_rejoined_actors(node, [actor_id])
+                reconciled += 1
+            else:
+                refailed += 1
+                self._handle_actor_failure(
+                    actor_id, "hosting node died during head outage"
+                )
+        fresh.restored_restarting.clear()
+        self.head_restarts += 1
+        metric_defs.HEAD_RESTARTS.inc()
+        try:
+            from ray_tpu.observability.events import global_event_manager
+
+            global_event_manager().warning(
+                "CLUSTER", "head_restarted",
+                f"head restored from {path}: {reconciled} actors reconciled",
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        return {"snapshot": path, "reconciled": reconciled, "refailed": refailed}
+
     def kill_node(self, node_id: NodeID, expected=None, reason: str = "") -> None:
         """Chaos hook: simulate node failure (NodeKillerActor parity,
         python/ray/_private/test_utils.py:1497).  ``expected`` guards the
@@ -476,6 +629,137 @@ class Cluster:
             if expected is not None and node is not expected:
                 return
             self._kill_node_locked(node_id, node, reason=reason)
+
+    # ------------------------------------------------------------------
+    # graceful drain (DrainRaylet parity, node_manager.proto)
+    # ------------------------------------------------------------------
+    def drain_node(self, node_id: NodeID, timeout_s: Optional[float] = None) -> dict:
+        """Gracefully remove a node instead of hard-killing it:
+
+        1. flip it to DRAINING — the scheduler stops placing tasks/actors
+           there (including parked demand-queue entries re-resolving),
+        2. evacuate sole-replica objects to survivors through the
+           PullManager (directory commits make them replicas BEFORE the
+           node goes away),
+        3. push hosted actors through the restart FSM so restartable ones
+           come back on survivors (buffered/in-flight calls follow the
+           normal ``max_task_retries`` semantics),
+        4. wait (bounded by ``drain_node_timeout_s``) for the node's
+           in-flight tasks to finish, then terminate through the normal
+           death sweep — which now finds a surviving replica for every
+           evacuated object, so nothing with somewhere to go is lost.
+
+        Returns the drain report (also appended to ``self.drain_reports``
+        for the chaos elasticity invariants and ``/api/autoscaler``)."""
+        cfg = get_config()
+        if timeout_s is None:
+            timeout_s = cfg.drain_node_timeout_s
+        report = {
+            "node": node_id.hex()[:8], "outcome": "ok",
+            "evacuated": 0, "evacuated_bytes": 0,
+            "failed_evacuations": 0, "actors_restarted": 0,
+        }
+        with self._node_lifecycle_lock:
+            node = self.nodes.get(node_id)
+            if node is None or node.dead:
+                report["outcome"] = "noop"
+                metric_defs.NODE_DRAINS.inc(tags={"outcome": "noop"})
+                self.drain_reports.append(report)
+                return report
+            if node is self.head_node:
+                raise ValueError("cannot drain the head node")
+            # DRAINING before anything moves: evacuation pulls, actor
+            # restarts, and task resubmits must never land back here
+            self.cluster_scheduler.set_draining(node_id)
+            self.control.nodes.drain(node_id)
+        try:
+            from ray_tpu.observability.events import global_event_manager
+
+            global_event_manager().info(
+                "NODE", "node_draining", f"node {node_id.hex()[:8]} draining"
+            )
+        except Exception:  # noqa: BLE001 — diagnostics must not block the drain
+            pass
+        deadline = time.monotonic() + timeout_s
+
+        # -- 2. evacuate sole-replica objects --------------------------
+        sole = self.directory.sole_replica_objects(node_id)
+        evacuated_bytes = 0
+        if sole:
+            pending = threading.Semaphore(0)
+
+            def one_done():
+                pending.release()
+
+            started = 0
+            for oid in sole:
+                dest = self._pick_evacuation_dest(node_id, started)
+                if dest is None:
+                    break  # no survivor can take copies: nothing to do
+                self.pull_manager.pull(oid, dest, one_done)
+                started += 1
+            done = 0
+            for _ in range(started):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not pending.acquire(timeout=max(0.01, remaining)):
+                    break
+                done += 1
+            report["evacuated"] = done
+            report["failed_evacuations"] = len(sole) - done
+            if report["failed_evacuations"]:
+                report["outcome"] = "timeout"
+            for oid in sole:
+                if len(self.directory.locations(oid)) > 1:
+                    evacuated_bytes += self.directory.object_size(oid)
+            report["evacuated_bytes"] = evacuated_bytes
+            if evacuated_bytes:
+                metric_defs.DRAIN_EVACUATED_BYTES.inc(evacuated_bytes)
+
+        # -- 3. restart hosted actors elsewhere ------------------------
+        for info in self.control.actors.list_actors():
+            if info.node_id == node_id and info.state in (
+                ActorState.ALIVE, ActorState.PENDING_CREATION
+            ):
+                report["actors_restarted"] += 1
+                self._handle_actor_failure(
+                    info.actor_id, f"node {node_id.hex()[:8]} draining"
+                )
+
+        # -- 4. wait for in-flight work, then terminate ----------------
+        def _quiesced() -> bool:
+            return not any(
+                s.owner_node == node_id for s in self.task_manager.pending_specs()
+            )
+
+        while time.monotonic() < deadline and not _quiesced():
+            time.sleep(0.01)
+        if not _quiesced():
+            # only a genuinely un-quiesced node is a timeout — a deadline
+            # fully spent on (successful) evacuation is not
+            report["outcome"] = "timeout"
+        # the death sweep resubmits stragglers and drops the store —
+        # every evacuated object now has a surviving replica to serve it
+        self.kill_node(node_id, reason="drained")
+        metric_defs.NODE_DRAINS.inc(tags={"outcome": report["outcome"]})
+        self.drain_reports.append(report)
+        return report
+
+    def _pick_evacuation_dest(self, draining: NodeID, seq: int):
+        """Round-robin over alive, non-draining nodes (deterministic order:
+        sorted node ids) so a drain spreads its bytes instead of dumping
+        them all on one survivor."""
+        survivors = sorted(
+            (
+                node for nid, node in list(self.nodes.items())
+                if not node.dead
+                and nid != draining
+                and not self.cluster_scheduler.is_draining(nid)
+            ),
+            key=lambda n: n.node_id.binary(),
+        )
+        if not survivors:
+            return None
+        return survivors[seq % len(survivors)]
 
     def _kill_node_locked(self, node_id: NodeID, node, reason: str = "") -> None:
         node.dead = True
@@ -1535,7 +1819,13 @@ class Cluster:
     def _snapshot_loop(self, path: str, interval_s: float) -> None:
         while not self._snapshot_stop.wait(interval_s):
             try:
-                self.control.save_snapshot(path)
+                # flag check + write under one lock: a kill_head racing in
+                # between would otherwise have its kill-time snapshot
+                # rotated away by a write of doomed-incarnation state
+                with self._head_lock:
+                    if self._head_down:
+                        continue
+                    self.control.save_snapshot(path)
             except Exception:  # noqa: BLE001 — persistence must not kill the fabric
                 pass
 

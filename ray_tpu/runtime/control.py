@@ -407,44 +407,108 @@ class ControlService:
                         "death_cause": info.death_cause,
                     }
                 )
+        from ray_tpu.runtime import failpoints
+
         return {
             "version": 1,
             "kv": kv_data,
             "jobs": jobs,
             "actors": actors,
             "task_events": self.task_events.list_events(limit=len(self.task_events)),
+            # finished spans ride along so the chaos sweep's retry-span
+            # audit (invariant 5) survives a head restart
+            "spans": self.spans.list_events(limit=len(self.spans)),
+            # failpoint hit counters + fault log: same-seed chaos fault logs
+            # must stay byte-identical THROUGH a head restart
+            "failpoints": failpoints.snapshot_state(),
         }
 
     _snapshot_write_lock = threading.Lock()
 
+    #: snapshot framing: magic + blake2b-16(payload) + payload.  The digest
+    #: rejects a torn/truncated file outright; the ``.prev`` generation kept
+    #: by save_snapshot is the fallback a rejected file restores from.
+    _SNAP_MAGIC = b"RTSNAP1\n"
+
     def save_snapshot(self, path: str) -> None:
+        """Crash-atomic snapshot write: temp file + fsync + rename, with the
+        previous generation rotated to ``<path>.prev`` first.  A head killed
+        at ANY instant (``kill_head`` chaos, kill -9) leaves either the new
+        complete snapshot, or the previous complete one — never a torn file
+        a restart would restore."""
+        import hashlib
         import os
         import pickle
 
         # serialized: the periodic writer and the shutdown save share the
         # tmp path; concurrent writes would publish a torn snapshot
         with self._snapshot_write_lock:
+            payload = pickle.dumps(self.snapshot_state())
+            digest = hashlib.blake2b(payload, digest_size=16).digest()
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
-                pickle.dump(self.snapshot_state(), f)
+                f.write(self._SNAP_MAGIC + digest + payload)
+                f.flush()
+                os.fsync(f.fileno())  # bytes durable BEFORE the rename publishes them
+            if os.path.exists(path):
+                # keep the last good generation: if the crash lands between
+                # the two renames, restore falls back to .prev
+                os.replace(path, path + ".prev")
             os.replace(tmp, path)   # atomic: readers never see a torn file
+            try:
+                dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)  # the renames themselves survive power loss
+                finally:
+                    os.close(dir_fd)
+            except OSError:
+                pass
 
-    def restore_snapshot(self, path: str) -> bool:
+    @classmethod
+    def _load_snapshot_file(cls, path: str):
+        """One snapshot file -> state dict, or None if missing/torn.  The
+        digest check rejects truncated and bit-flipped files before pickle
+        ever sees them; headerless files fall back to plain pickle (legacy
+        snapshots from before the framing)."""
+        import hashlib
         import logging
         import os
         import pickle
 
         if not os.path.exists(path):
-            return False
+            return None
         try:
             with open(path, "rb") as f:
-                state = pickle.load(f)
-        except Exception:  # noqa: BLE001 — same rule as save: persistence
-            # must not brick init(); a torn snapshot starts empty
+                raw = f.read()
+            if raw.startswith(cls._SNAP_MAGIC):
+                off = len(cls._SNAP_MAGIC)
+                digest, payload = raw[off:off + 16], raw[off + 16:]
+                if hashlib.blake2b(payload, digest_size=16).digest() != digest:
+                    raise ValueError("snapshot digest mismatch (torn/partial write)")
+                return pickle.loads(payload)
+            return pickle.loads(raw)
+        except Exception:  # noqa: BLE001 — persistence must not brick init()
             logging.getLogger(__name__).exception(
-                "control snapshot %s unreadable; starting with empty state", path
+                "control snapshot %s unreadable/torn; trying fallback", path
             )
-            return False
+            return None
+
+    def restore_snapshot(self, path: str) -> bool:
+        import logging
+
+        from ray_tpu.runtime import failpoints
+
+        state = self._load_snapshot_file(path)
+        if state is None:
+            # torn/missing current generation: the previous complete one
+            # (rotated by save_snapshot) is strictly better than empty
+            state = self._load_snapshot_file(path + ".prev")
+            if state is None:
+                return False
+            logging.getLogger(__name__).warning(
+                "control snapshot %s rejected; restored previous generation %s",
+                path, path + ".prev",
+            )
         self.kv.restore(state.get("kv", {}))
         max_job = 0
         for row in state.get("jobs", []):
@@ -484,6 +548,11 @@ class ControlService:
                 pass  # name collision with a live record wins
         for event in state.get("task_events", []):
             self.task_events.add(event)
+        for event in state.get("spans", []):
+            self.spans.add(event)
+        # resume the failpoint decision streams exactly where the dead head
+        # left them (counters merge forward; a no-op when nothing was armed)
+        failpoints.restore_state(state.get("failpoints") or {})
         return True
 
     # health-check loop (GcsHealthCheckManager parity)

@@ -344,3 +344,66 @@ def reset() -> None:
     disarm()
     with _lock:
         _seed = 0
+
+
+# --------------------------------------------------------------------------
+# state persistence — the determinism contract THROUGH a head restart
+# --------------------------------------------------------------------------
+def snapshot_state() -> dict:
+    """Everything a restarted head must not lose for same-seed fault logs to
+    stay byte-identical through the restart: the seed, the armed spec, every
+    hit counter (armed and retired), and the fault log accumulated so far.
+    The control snapshot (``control.save_snapshot``) embeds this, so a head
+    killed and restored mid-run resumes every decision stream at the exact
+    hit index where the snapshot left it."""
+    with _lock:
+        spec = {
+            n: {"action": f.action, "prob": f.prob, "delay_s": f.delay_s}
+            for n, f in _fps.items()
+        }
+        counters = {n: f.count for n, f in _fps.items()}
+        retired = dict(_retired_counts)
+        seed = _seed
+    with _log_lock:
+        log = list(_log)
+    return {
+        "seed": seed,
+        "spec": spec,
+        "counters": counters,
+        "retired": retired,
+        "log": log,
+    }
+
+
+def restore_state(state: dict) -> None:
+    """Restore a :func:`snapshot_state` capture.  Merge semantics — counters
+    only advance (max) and log entries union — so restoring into a process
+    that never actually died is a no-op, while restoring into a fresh head
+    process resumes the per-failpoint index streams where they stopped."""
+    global _seed
+    if not state:
+        return
+    spec = state.get("spec") or {}
+    if spec:
+        arm(spec, seed=state.get("seed"))
+    elif state.get("seed") is not None:
+        with _lock:
+            _seed = int(state["seed"])
+    with _lock:
+        for name, count in (state.get("counters") or {}).items():
+            f = _fps.get(name)
+            if f is not None:
+                with f.lock:
+                    f.count = max(f.count, int(count))
+            else:
+                _retired_counts[name] = max(_retired_counts.get(name, 0), int(count))
+        for name, count in (state.get("retired") or {}).items():
+            if name not in _fps:
+                _retired_counts[name] = max(_retired_counts.get(name, 0), int(count))
+    with _log_lock:
+        seen = set(_log)
+        for entry in state.get("log") or ():
+            entry = tuple(entry)
+            if entry not in seen:
+                _log.append(entry)
+                seen.add(entry)

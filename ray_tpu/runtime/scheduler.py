@@ -142,6 +142,11 @@ class ClusterScheduler:
         self._pools: Dict[NodeID, ResourcePool] = {}
         self._labels: Dict[NodeID, dict] = {}
         self._alive: Dict[NodeID, bool] = {}
+        # DRAINING nodes (graceful removal in progress): still alive — their
+        # running work finishes and their objects evacuate — but pick_node
+        # never places NEW tasks/actors there, including parked demand-queue
+        # entries re-resolving (DrainRaylet lease rejection parity).
+        self._draining: set = set()
         self._queue_lens: Dict[NodeID, Callable[[], int]] = {}
         # object directory for the locality stage (bound by the cluster
         # fabric; None = locality disabled, e.g. bare unit tests)
@@ -176,6 +181,21 @@ class ClusterScheduler:
     def remove_node(self, node_id: NodeID) -> None:
         with self._lock:
             self._alive[node_id] = False
+            self._draining.discard(node_id)
+
+    def set_draining(self, node_id: NodeID, draining: bool = True) -> None:
+        """Flip a node's DRAINING bit: a draining node is excluded from
+        every placement decision until it either terminates (remove_node)
+        or the drain is cancelled."""
+        with self._lock:
+            if draining:
+                self._draining.add(node_id)
+            else:
+                self._draining.discard(node_id)
+
+    def is_draining(self, node_id: NodeID) -> bool:
+        with self._lock:
+            return node_id in self._draining
 
     def node_pools(self) -> Dict[NodeID, ResourcePool]:
         with self._lock:
@@ -186,7 +206,15 @@ class ClusterScheduler:
         cfg = get_config()
         strategy = spec.scheduling_strategy
         with self._lock:
-            alive = [(nid, self._pools[nid]) for nid, ok in self._alive.items() if ok]
+            # draining nodes are filtered out of EVERY policy below — the
+            # single-node fast path, affinity fallbacks, SPREAD, locality,
+            # hybrid — and of demand-queue re-resolution (which re-enters
+            # here); a drain must stop new placements atomically
+            alive = [
+                (nid, self._pools[nid])
+                for nid, ok in self._alive.items()
+                if ok and nid not in self._draining
+            ]
         if not alive:
             return None
         if len(alive) == 1 and strategy is None:

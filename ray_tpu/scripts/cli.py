@@ -410,7 +410,47 @@ def cmd_plans(args) -> int:
     return 0
 
 
+def cmd_nodes(args) -> int:
+    """``rt nodes``: per-node lifecycle state (ALIVE / DRAINING / DEAD),
+    drain history with evacuation totals, head restarts, and the autoscaler
+    summary when one is running."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/autoscaler")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    for n in data.get("nodes", ()):
+        head = " (head)" if n.get("is_head") else ""
+        res = " ".join(f"{k}={v:g}" for k, v in sorted(n.get("resources", {}).items()))
+        print(f"  node {n['node_id'][:12]} {n['state']:9s}{head}  {res}")
+    drains = data.get("drains", ())
+    if drains:
+        evac = sum(d.get("evacuated", 0) for d in drains)
+        mb = sum(d.get("evacuated_bytes", 0) for d in drains) / 1e6
+        outcomes = {}
+        for d in drains:
+            outcomes[d.get("outcome", "?")] = outcomes.get(d.get("outcome", "?"), 0) + 1
+        summary = ", ".join(f"{n} {o}" for o, n in sorted(outcomes.items()))
+        print(f"drains: {len(drains)} ({summary}); {evac} objects / {mb:.1f} MB evacuated")
+    print(f"head restarts: {data.get('head_restarts', 0)}")
+    autoscaler = data.get("autoscaler")
+    if autoscaler:
+        active = ", ".join(
+            f"{n} x {t}" for t, n in sorted(autoscaler.get("active_nodes", {}).items())
+        ) or "none"
+        print(
+            f"autoscaler: {active} managed; {autoscaler.get('num_launches', 0)} "
+            f"launches, {autoscaler.get('num_terminations', 0)} terminations, "
+            f"{len(autoscaler.get('pending_demands', []))} pending demands"
+        )
+    return 0
+
+
 def cmd_chaos(args) -> int:
+    if args.chaos_cmd == "validate":
+        from ray_tpu.chaos.schedule import validate_cli
+
+        return validate_cli(args)
     from ray_tpu.chaos.runner import run_cli
 
     return run_cli(args)
@@ -555,6 +595,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_plans)
 
+    sp = sub.add_parser(
+        "nodes",
+        help="node lifecycle states (ALIVE/DRAINING/DEAD), drain/evacuation "
+        "history, head restarts, autoscaler summary",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_nodes)
+
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)
     sp.add_argument("--limit", type=int, default=1000)
@@ -587,6 +636,18 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--workload", default="fanout", help="builtin workload: fanout|actor")
     c.add_argument("--num-cpus", type=int, default=4)
     c.add_argument("--timeout", type=float, default=60.0, help="quiescence/join budget seconds")
+    c.set_defaults(fn=cmd_chaos)
+    c = csub.add_parser(
+        "validate",
+        help="schema-check a schedule JSON (unknown kinds, bad params, "
+        "out-of-range node indices) before a run burns minutes on it",
+    )
+    c.add_argument("schedule", help="path to a schedule JSON")
+    c.add_argument(
+        "--nodes", type=int, default=None,
+        help="live non-head worker count the run will start with "
+        "(enables node-index bounds checking)",
+    )
     c.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")

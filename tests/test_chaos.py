@@ -516,6 +516,408 @@ def test_schedule_stage_actor_kill_mid_plan():
 
 
 # --------------------------------------------------------------------------
+# 8. drain a relay node mid-broadcast (ISSUE 6): the fanout-1 chain of
+#    test 6 (head -> B -> C -> D) with the relay GRACEFULLY drained instead
+#    of killed.  B holds no sole-replica objects (its broadcast copy also
+#    lives at the head), so the drain's evacuation is a no-op and its
+#    terminate lands while C is still blocked mid-edge — C re-parents onto
+#    the surviving replica through purge-then-retry, parked D completes
+#    through the repaired chain, and the elasticity invariants (drain lost
+#    nothing, every ref resolves) hold.  The armed put failpoint makes the
+#    decision stream workload-driven: same-seed fault logs are
+#    byte-identical THROUGH the drain.
+# --------------------------------------------------------------------------
+def _relay_drain_run(seed):
+    import threading
+
+    import numpy as np
+
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        node_b = cluster.add_node({"CPU": 1})  # schedule victim (index 0)
+        node_c = cluster.add_node({"CPU": 1})
+        node_d = cluster.add_node({"CPU": 1})
+
+        schedule = ChaosSchedule(
+            [
+                ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)"),
+                ChaosEvent(0.8, "drain_node", index=0, timeout=5.0),
+            ],
+            seed=seed, name="relay-drain-broadcast",
+        )
+
+        def workload():
+            pm = cluster.pull_manager
+            old_fanout = pm._fanout
+            pm._fanout = 1  # chain topology: B is everyone's relay
+            while True:
+                try:
+                    ref = rt.put(np.ones(4 << 20, np.uint8))
+                    break
+                except failpoints.FailpointInjected:
+                    continue
+            oid = ref.id()
+            # hold B's outbound serve: C stays blocked mid-edge until the
+            # schedule's drain terminates B, then the edge fails loudly
+            trip = threading.Event()
+            orig_get = node_b.store.get
+
+            def tripping_get(o, timeout=None):
+                assert trip.wait(60)
+                raise RuntimeError("relay node drained mid-serve")
+
+            node_b.store.get = tripping_get
+            try:
+                done = {
+                    n.node_id: threading.Event() for n in (node_b, node_c, node_d)
+                }
+                for n in (node_b, node_c, node_d):
+                    cluster.pull_object(oid, n, done[n.node_id].set)
+                assert done[node_b.node_id].wait(30)  # B holds a copy; C is
+                #                                       blocked inside B's store
+                deadline = time.monotonic() + 30
+                while not node_b.dead and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert node_b.dead, "schedule drain never landed"
+                trip.set()  # C's edge fails -> purge-then-retry -> the head
+                assert done[node_c.node_id].wait(60)
+                assert done[node_d.node_id].wait(60)
+                assert node_c.store.contains(oid)
+                assert node_d.store.contains(oid)
+            finally:
+                node_b.store.get = orig_get
+                pm._fanout = old_fanout
+            return [ref]
+
+        result = ChaosRunner(schedule, quiesce_timeout=60).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        drained = [e for e in result.events_applied if e["kind"] == "drain_node"]
+        assert drained and drained[0]["node"] == node_b.node_id.hex()[:8]
+        # nothing was sole-replica on B: the drain had nothing to evacuate
+        # and nothing to lose (elasticity invariant 6 audited this)
+        assert drained[0]["evacuated"] == 0
+        assert cluster.drain_reports[-1]["failed_evacuations"] == 0
+        assert cluster.pull_manager.retries >= 1  # the re-parenting retry
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_relay_node_drain_mid_broadcast():
+    r1 = _relay_drain_run(seed=13)
+    r2 = _relay_drain_run(seed=13)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert all(f["fp"] == "object_store.put" for f in r1.faults)
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
+# 9. kill_head + restart_head mid-workload (ISSUE 6): a live workload (an
+#    actor with in-process state, app-retried puts driving the decision
+#    stream) runs across a full head outage.  The kill-time snapshot carries
+#    the failpoint hit counters, the restart re-adopts the live node and
+#    reconciles the actor instance back to ALIVE, work resumes — and the
+#    same-seed fault logs are byte-identical ACROSS the restart boundary.
+#    A doomed-incarnation KV write between kill and restart is discarded,
+#    exactly what a write to a dying GCS loses.
+# --------------------------------------------------------------------------
+def _head_outage_run(seed):
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        cluster.add_node({"CPU": 2})
+
+        schedule = ChaosSchedule(
+            [
+                ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)"),
+                ChaosEvent(2.0, "kill_head"),
+                ChaosEvent(3.5, "restart_head"),
+            ],
+            seed=seed, name="head-outage",
+        )
+
+        def retried_puts(tag, n):
+            out = []
+            for i in range(n):
+                while True:
+                    try:
+                        out.append(rt.put((tag, i)))
+                        break
+                    except failpoints.FailpointInjected:
+                        continue
+            return out
+
+        def workload():
+            t0 = time.monotonic()
+
+            @rt.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def add(self, k):
+                    self.n += k
+                    return self.n
+
+            c = Counter.options(name="outage-counter", max_restarts=1).remote()
+            # ---- phase 1: everything resolves BEFORE the kill lands ----
+            refs = retried_puts("pre", 6)
+            assert rt.get([c.add.remote(1) for _ in range(5)], timeout=30) == [
+                1, 2, 3, 4, 5
+            ]
+            cluster.control.kv.put(b"outage_marker", b"pre-kill")
+            # ---- the outage window: quiesce through kill + restart ----
+            while time.monotonic() - t0 < 4.2:
+                time.sleep(0.05)
+                if cluster._head_down:
+                    # doomed-incarnation write: must vanish at restart
+                    cluster.control.kv.put(b"doomed_marker", b"lost")
+            assert cluster.head_restarts >= 1, "restart_head never landed"
+            # ---- phase 2: the fabric works after the restart ----
+            assert cluster.control.kv.get(b"outage_marker") == b"pre-kill"
+            assert cluster.control.kv.get(b"doomed_marker") is None
+            refs += retried_puts("post", 6)
+            # the named record survived the outage AND the live instance
+            # reconciled: in-process state (n == 5) carried through
+            c2 = rt.get_actor("outage-counter")
+            assert rt.get(c2.add.remote(1), timeout=30) == 6
+            return refs
+
+        result = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        kinds = [e["kind"] for e in result.events_applied]
+        assert kinds.count("kill_head") == 1 and kinds.count("restart_head") == 1
+        restart = next(e for e in result.events_applied if e["kind"] == "restart_head")
+        assert restart["reconciled"] >= 1
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_head_outage_mid_workload():
+    r1 = _head_outage_run(seed=61)
+    r2 = _head_outage_run(seed=61)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
+# 10. kill a plan stage node, then auto-repair (ISSUE 6): a compiled plan
+#     with a restartable stage actor on a doomed node keeps executing while
+#     the schedule kills that node.  The plan flips BROKEN (typed error),
+#     the restart FSM revives the actor on the surviving "stage" node, the
+#     auto-repair thread reinstalls onto it, and subsequent iterations
+#     produce correct outputs — READY -> BROKEN -> READY, audited by the
+#     invariant sweep from the cluster's transition log.
+# --------------------------------------------------------------------------
+def _plan_auto_repair_run(seed):
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        node_b = cluster.add_node({"CPU": 1, "stage": 1})  # victim (index 0)
+        cluster.add_node({"CPU": 1, "stage": 1})           # restart target
+
+        schedule = ChaosSchedule(
+            [
+                ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)"),
+                ChaosEvent(1.0, "kill_node", index=0),
+            ],
+            seed=seed, name="plan-node-kill-auto-repair",
+        )
+
+        def workload():
+            from ray_tpu.dag import InputNode
+            from ray_tpu.exceptions import (
+                ActorDiedError,
+                RayActorError,
+                WorkerCrashedError,
+            )
+
+            @rt.remote
+            class Stage:
+                def __init__(self, k):
+                    self.k = k
+
+                def step(self, x):
+                    return x + self.k
+
+            # s0/s2 pinned to the head: default placement could land them
+            # on the doomed node, where max_restarts=0 would (correctly)
+            # make the plan unrepairable — not what this test is about
+            head = dict(
+                execution="inproc",
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    cluster.head_node.node_id
+                ),
+            )
+            s0 = Stage.options(**head).remote(1)
+            s1 = Stage.options(
+                execution="inproc", num_cpus=0, resources={"stage": 1},
+                max_restarts=1,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_b.node_id, soft=True
+                ),
+            ).remote(10)
+            s2 = Stage.options(**head).remote(100)
+            with InputNode() as inp:
+                d = s2.step.bind(s1.step.bind(s0.step.bind(inp)))
+            plan = d.compile_plan(name="self-healing", auto_repair=True)
+            refs = []
+            for i in range(4):
+                while True:
+                    try:
+                        refs.append(rt.put(("blob", i)))
+                        break
+                    except failpoints.FailpointInjected:
+                        continue
+            # iterate THROUGH the node kill: broken iterations surface
+            # typed errors, auto-repair reinstalls, iterations resume
+            saw_break = False
+            deadline = time.monotonic() + 45
+            completed_after_break = 0
+            while time.monotonic() < deadline and completed_after_break < 5:
+                try:
+                    assert plan.execute(7) == 118
+                    if saw_break:
+                        completed_after_break += 1
+                    elif node_b.dead:
+                        # raced: repair finished before an execute failed
+                        saw_break = True
+                except (ActorDiedError, RayActorError, WorkerCrashedError):
+                    saw_break = True
+                    time.sleep(0.05)
+            assert saw_break, "the stage-node kill never surfaced"
+            assert completed_after_break >= 5, "plan never healed"
+            assert plan.state == "READY"
+            assert "BROKEN" in plan.state_history
+            assert plan.state_history[-1] == "READY"
+            plan.teardown()
+            return refs
+
+        result = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        killed = [e for e in result.events_applied if e["kind"] == "kill_node"]
+        assert killed and killed[0]["node"] == node_b.node_id.hex()[:8]
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_plan_stage_node_kill_auto_repair():
+    r1 = _plan_auto_repair_run(seed=37)
+    r2 = _plan_auto_repair_run(seed=37)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
+# 11. the full elasticity schedule (ISSUE 6 acceptance): ONE seeded timeline
+#     containing add_node, drain_node, kill_head, AND restart_head runs a
+#     live workload to completion — the drained node's sole-replica objects
+#     evacuate (zero loss with survivors present), the head outage discards
+#     doomed writes and reconciles on restart, and the fault log is
+#     byte-identical across two same-seed runs INCLUDING across the head
+#     restart boundary (every put/transfer hit is workload-driven).
+# --------------------------------------------------------------------------
+def _elasticity_run(seed):
+    import numpy as np
+
+    rt.init(num_cpus=2)
+    try:
+        cluster = rt.get_cluster()
+        node_b = cluster.add_node({"CPU": 1})  # drain victim (index 0)
+
+        schedule = ChaosSchedule(
+            [
+                ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)"),
+                ChaosEvent(0.6, "add_node", resources={"CPU": 1}),
+                ChaosEvent(1.2, "drain_node", index=0, timeout=10.0),
+                ChaosEvent(2.4, "kill_head"),
+                ChaosEvent(3.9, "restart_head"),
+            ],
+            seed=seed, name="full-elasticity",
+        )
+
+        def retried_puts(tag, n):
+            out = []
+            for i in range(n):
+                while True:
+                    try:
+                        out.append(rt.put((tag, i)))
+                        break
+                    except failpoints.FailpointInjected:
+                        continue
+            return out
+
+        def workload():
+            t0 = time.monotonic()
+
+            @rt.remote(execution="thread", max_retries=4)
+            def produce(i):
+                return np.full(150_000, i, np.uint8)
+
+            # sole replicas on the doomed node: the drain MUST evacuate them
+            refs = [
+                produce.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(node_b.node_id)
+                ).remote(i)
+                for i in range(4)
+            ]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and any(
+                not cluster.directory.locations(r.id()) for r in refs
+            ):
+                time.sleep(0.02)
+            put_refs = retried_puts("pre", 4)
+            # ---- wait out the drain (t=1.2), then prove zero loss ----
+            while time.monotonic() - t0 < 2.2:
+                time.sleep(0.05)
+            assert node_b.dead, "schedule drain never landed"
+            values = rt.get(refs, timeout=30)
+            assert all(
+                v[0] == i and v.nbytes == 150_000 for i, v in enumerate(values)
+            ), "evacuated objects must survive the drain byte-for-byte"
+            # ---- wait out the head outage (kill 2.4 -> restart 3.9) ----
+            while time.monotonic() - t0 < 4.6:
+                time.sleep(0.05)
+            assert cluster.head_restarts >= 1, "restart_head never landed"
+            # ---- the elastic fabric still works end to end ----
+            put_refs += retried_puts("post", 4)
+            added = [
+                n for n in cluster.nodes.values()
+                if not n.dead and n is not cluster.head_node
+            ]
+            assert added, "the add_node event's node must be live"
+            out = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    added[0].node_id
+                )
+            ).remote(9)
+            assert rt.get(out, timeout=30)[0] == 9
+            return refs + put_refs + [out]
+
+        result = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        kinds = [e["kind"] for e in result.events_applied]
+        for kind in ("add_node", "drain_node", "kill_head", "restart_head"):
+            assert kind in kinds, f"{kind} never applied: {result.events_applied}"
+        drained = next(e for e in result.events_applied if e["kind"] == "drain_node")
+        assert drained["evacuated"] == 4 and drained["outcome"] == "ok"
+        assert cluster.drain_reports[-1]["failed_evacuations"] == 0
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_full_elasticity_byte_identical_through_restart():
+    r1 = _elasticity_run(seed=101)
+    r2 = _elasticity_run(seed=101)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+# --------------------------------------------------------------------------
 # schedule JSON round trip + CLI-facing loader
 # --------------------------------------------------------------------------
 def test_schedule_json_round_trip(tmp_path):

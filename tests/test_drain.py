@@ -1,0 +1,596 @@
+"""Graceful elasticity: node drain, head failover, crash-atomic snapshots,
+and the failpoint-state round trip that keeps chaos deterministic through a
+head restart (ISSUE 6).
+
+The chaos-schedule integration lives in ``test_chaos.py`` (schedules 8-10);
+this file covers the mechanisms one at a time:
+
+  * ``Cluster.drain_node``: sole-replica evacuation, actor restarts off the
+    draining node, scheduler exclusion (including parked demand-queue
+    entries), autoscaler termination routing,
+  * ``control.save_snapshot``: fsync + rename + ``.prev`` rotation — a torn
+    current generation restores the previous one, never garbage,
+  * ``failpoints.snapshot_state``/``restore_state``: hit counters and the
+    fault log resume across a simulated process death, byte-identically,
+  * ``rt chaos validate``: friendly schema errors before a run burns time.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.chaos.schedule import validate_schedule
+from ray_tpu.runtime import failpoints
+from ray_tpu.runtime.scheduler import NodeAffinitySchedulingStrategy
+
+
+def _wait_for(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# --------------------------------------------------------------------------
+# drain_node
+# --------------------------------------------------------------------------
+def test_drain_evacuates_sole_replica_objects(ray_start_cluster):
+    rt_mod, cluster = ray_start_cluster
+    node_b = cluster.add_node({"CPU": 1})
+
+    @rt.remote(execution="thread")
+    def produce(i):
+        return np.full(300_000, i, np.uint8)
+
+    refs = [
+        produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_b.node_id)
+        ).remote(i)
+        for i in range(4)
+    ]
+    # wait for commits WITHOUT get() — a get would replicate onto the head
+    # and there would be nothing sole-replica left to evacuate
+    assert _wait_for(
+        lambda: all(cluster.directory.locations(r.id()) for r in refs)
+    )
+    assert all(
+        cluster.directory.locations(r.id()) == {node_b.node_id} for r in refs
+    )
+
+    report = cluster.drain_node(node_b.node_id)
+    assert report["outcome"] == "ok", report
+    assert report["evacuated"] == 4
+    assert report["evacuated_bytes"] >= 4 * 300_000
+    assert node_b.dead
+    # every value survived the node via its evacuated replica — no lineage
+    # reconstruction ran (the tasks would otherwise re-execute)
+    values = rt.get(refs, timeout=30)
+    assert all(v[0] == i and v.nbytes == 300_000 for i, v in enumerate(values))
+    for r in refs:
+        assert node_b.node_id not in cluster.directory.locations(r.id())
+    assert cluster.drain_reports[-1] is report
+
+    from ray_tpu.runtime.control import NodeState
+
+    assert cluster.control.nodes.get(node_b.node_id).state is NodeState.DEAD
+
+
+def test_drain_restarts_actor_elsewhere(ray_start_cluster):
+    rt_mod, cluster = ray_start_cluster
+    node_b = cluster.add_node({"CPU": 1, "R": 1})
+    node_c = cluster.add_node({"CPU": 1, "R": 1})
+
+    @rt.remote
+    class Holder:
+        def __init__(self):
+            self.pid_tag = "alive"
+
+        def ping(self):
+            return self.pid_tag
+
+    h = (
+        Holder.options(
+            max_restarts=2,
+            resources={"R": 1},
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_b.node_id, soft=True),
+        ).remote()
+    )
+    assert rt.get(h.ping.remote(), timeout=30) == "alive"
+    info = cluster.control.actors.get(h._actor_id)
+    assert info.node_id == node_b.node_id
+
+    report = cluster.drain_node(node_b.node_id)
+    assert report["actors_restarted"] == 1
+    # the restart FSM brought it back on the survivor, not the drained node
+    assert rt.get(h.ping.remote(), timeout=30) == "alive"
+    info = cluster.control.actors.get(h._actor_id)
+    assert info.node_id == node_c.node_id
+    assert info.num_restarts == 1
+
+
+def test_draining_node_excluded_from_placement(ray_start_cluster):
+    rt_mod, cluster = ray_start_cluster
+    node_b = cluster.add_node({"CPU": 2})
+    node_c = cluster.add_node({"CPU": 2})
+    cluster.cluster_scheduler.set_draining(node_b.node_id)
+    try:
+        before = node_b.scheduler.num_submitted
+
+        @rt.remote(execution="thread")
+        def f(i):
+            return i
+
+        refs = [
+            f.options(scheduling_strategy="SPREAD").remote(i) for i in range(12)
+        ]
+        assert rt.get(refs, timeout=30) == list(range(12))
+        assert node_b.scheduler.num_submitted == before
+        assert node_c.scheduler.num_submitted > 0
+    finally:
+        cluster.cluster_scheduler.set_draining(node_b.node_id, False)
+
+
+def test_parked_demand_does_not_dispatch_to_draining_node(ray_start_cluster):
+    """A demand-queue entry parked while its only feasible node is draining
+    must wait for a NEW node, never dispatch onto the draining one."""
+    rt_mod, cluster = ray_start_cluster
+    node_b = cluster.add_node({"CPU": 1, "special": 1})
+    cluster.cluster_scheduler.set_draining(node_b.node_id)
+
+    @rt.remote(resources={"special": 1}, execution="thread")
+    def f():
+        return "ran"
+
+    ref = f.remote()  # parks: the only "special" node is draining
+    time.sleep(0.3)
+    assert node_b.scheduler.num_submitted == 0
+    node_c = cluster.add_node({"CPU": 1, "special": 1})
+    assert rt.get(ref, timeout=30) == "ran"
+    assert node_b.scheduler.num_submitted == 0
+    assert node_c.scheduler.num_submitted == 1
+    cluster.cluster_scheduler.set_draining(node_b.node_id, False)
+
+
+def test_autoscaler_terminate_routes_through_drain(ray_start_cluster):
+    """Idle scale-down must not strand the only copy of a live object: the
+    provider's terminate_node drains (evacuates) instead of hard-killing."""
+    from ray_tpu.autoscaler.demand import NodeTypeConfig
+    from ray_tpu.autoscaler.node_provider import InProcessNodeProvider
+
+    rt_mod, cluster = ray_start_cluster
+    provider = InProcessNodeProvider(cluster)
+    (pid,) = provider.create_nodes(
+        NodeTypeConfig(name="worker", resources={"CPU": 1}), 1
+    )
+    node = next(n for nid, n in cluster.nodes.items() if nid.hex() == pid)
+
+    @rt.remote(execution="thread")
+    def produce():
+        return np.arange(200_000, dtype=np.uint8)
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node.node_id)
+    ).remote()
+    assert _wait_for(lambda: bool(cluster.directory.locations(ref.id())))
+    assert cluster.directory.locations(ref.id()) == {node.node_id}
+
+    provider.terminate_node(pid)
+    assert node.dead
+    assert cluster.drain_reports[-1]["evacuated"] == 1
+    assert rt.get(ref, timeout=30).nbytes == 200_000
+
+
+def test_drain_head_node_rejected(ray_start_regular):
+    cluster = rt.get_cluster()
+    with pytest.raises(ValueError, match="head"):
+        cluster.drain_node(cluster.head_node.node_id)
+
+
+# --------------------------------------------------------------------------
+# crash-atomic snapshots
+# --------------------------------------------------------------------------
+def test_snapshot_truncated_file_falls_back_to_prev(tmp_path):
+    from ray_tpu.runtime.control import ControlService
+
+    path = str(tmp_path / "control.snap")
+    svc = ControlService()
+    svc.kv.put(b"gen", b"one")
+    svc.save_snapshot(path)
+    svc.kv.put(b"gen", b"two")
+    svc.save_snapshot(path)  # rotates gen-one to .prev
+
+    # tear the current generation mid-write (what a kill -9 leaves behind
+    # when the filesystem loses the tail)
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+
+    restored = ControlService()
+    assert restored.restore_snapshot(path) is True
+    assert restored.kv.get(b"gen") == b"one"  # previous complete generation
+    restored.shutdown()
+    svc.shutdown()
+
+
+def test_snapshot_both_generations_torn_starts_empty(tmp_path):
+    from ray_tpu.runtime.control import ControlService
+
+    path = str(tmp_path / "control.snap")
+    with open(path, "wb") as f:
+        f.write(b"RTSNAP1\n" + b"\x00" * 10)  # torn beyond recovery
+    with open(path + ".prev", "wb") as f:
+        f.write(b"garbage")
+    restored = ControlService()
+    assert restored.restore_snapshot(path) is False
+    assert restored.kv.get(b"gen") is None
+    restored.shutdown()
+
+
+def test_snapshot_round_trip_preserves_state(tmp_path):
+    from ray_tpu.runtime.control import ControlService
+
+    path = str(tmp_path / "control.snap")
+    svc = ControlService()
+    svc.kv.put(b"k", b"v")
+    svc.task_events.add({"task_id": "t", "state": "FINISHED", "attempt": 0})
+    svc.spans.add({"type": "span", "name": "retry::f"})
+    svc.save_snapshot(path)
+    restored = ControlService()
+    assert restored.restore_snapshot(path) is True
+    assert restored.kv.get(b"k") == b"v"
+    assert restored.task_events.list_events()[-1]["task_id"] == "t"
+    assert restored.spans.list_events()[-1]["name"] == "retry::f"
+    restored.shutdown()
+    svc.shutdown()
+
+
+# --------------------------------------------------------------------------
+# failpoint state through a (simulated) head death
+# --------------------------------------------------------------------------
+def test_failpoint_state_round_trip_is_byte_identical():
+    """The determinism contract THROUGH a restart: a run whose failpoint
+    state is snapshotted, wiped (process death), and restored produces the
+    same fault log as an uninterrupted run of the same seed."""
+    def drive(n):
+        hits = []
+        for _ in range(n):
+            try:
+                failpoints.fp("demo.site")
+            except failpoints.FailpointInjected:
+                hits.append(1)
+        return hits
+
+    try:
+        # uninterrupted reference run: 30 hits
+        failpoints.reset()
+        failpoints.arm("demo.site=raise(0.5)", seed=1234)
+        drive(30)
+        reference = failpoints.fault_log()
+        assert reference, "the failpoint must fire at p=0.5"
+
+        # interrupted run: 12 hits, snapshot, full wipe, restore, 18 more
+        failpoints.reset()
+        failpoints.arm("demo.site=raise(0.5)", seed=1234)
+        drive(12)
+        snap = failpoints.snapshot_state()
+        failpoints.reset()  # the head process died
+        assert failpoints.fault_log() == []
+        failpoints.restore_state(snap)
+        assert failpoints.ARMED  # armed spec came back with the state
+        drive(18)
+        assert failpoints.fault_log() == reference
+    finally:
+        failpoints.reset()
+
+
+def test_control_snapshot_carries_failpoint_state(tmp_path):
+    from ray_tpu.runtime.control import ControlService
+
+    path = str(tmp_path / "control.snap")
+    try:
+        failpoints.reset()
+        failpoints.arm("demo.snap=raise(0.5)", seed=9)
+        for _ in range(10):
+            try:
+                failpoints.fp("demo.snap")
+            except failpoints.FailpointInjected:
+                pass
+        log_before = failpoints.fault_log()
+        svc = ControlService()
+        svc.save_snapshot(path)
+        failpoints.reset()
+        restored = ControlService()
+        assert restored.restore_snapshot(path) is True
+        assert failpoints.fault_log() == log_before
+        assert failpoints.configured("demo.snap")["prob"] == 0.5
+        restored.shutdown()
+        svc.shutdown()
+    finally:
+        failpoints.reset()
+
+
+# --------------------------------------------------------------------------
+# head kill/restart mechanism (schedule-driven variant in test_chaos.py)
+# --------------------------------------------------------------------------
+def test_kill_restart_head_preserves_named_actor_and_kv(ray_start_regular):
+    cluster = rt.get_cluster()
+
+    @rt.remote
+    class Keeper:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    k = Keeper.options(name="drain-keeper").remote()
+    assert rt.get(k.bump.remote(), timeout=30) == 1
+    cluster.control.kv.put(b"marker", b"pre-kill")
+
+    path = cluster.kill_head()
+    # a doomed-incarnation write: discarded at restart, like any write to a
+    # dying GCS that never committed
+    cluster.control.kv.put(b"doomed", b"lost")
+    info = cluster.restart_head()
+    assert info["reconciled"] >= 1
+    assert cluster.head_restarts == 1
+    assert cluster.control.kv.get(b"marker") == b"pre-kill"
+    assert cluster.control.kv.get(b"doomed") is None
+
+    # the named record survived AND the live instance reconciled: in-process
+    # state (n == 1) carried through the outage
+    k2 = rt.get_actor("drain-keeper")
+    assert rt.get(k2.bump.remote(), timeout=30) == 2
+    import os
+
+    assert path.startswith("/") and os.path.exists(path)
+
+
+def test_restart_head_without_kill_rejected(ray_start_regular):
+    cluster = rt.get_cluster()
+    with pytest.raises(RuntimeError, match="kill_head"):
+        cluster.restart_head()
+
+
+def test_double_kill_head_rejected(ray_start_regular):
+    """A second kill_head before restart would snapshot the doomed
+    incarnation — persisting exactly the writes the first kill promised
+    to discard."""
+    cluster = rt.get_cluster()
+    cluster.kill_head()
+    with pytest.raises(RuntimeError, match="already down"):
+        cluster.kill_head()
+    cluster.restart_head()
+
+
+def test_restart_head_readopts_live_placement_groups(ray_start_regular):
+    """Live placement groups (bundle resources held in surviving node
+    pools) must survive a head restart like live actors do — dropping the
+    registry would leak the acquired capacity forever."""
+    from ray_tpu.util.placement import placement_group, remove_placement_group
+
+    cluster = rt.get_cluster()
+    pg = placement_group([{"CPU": 1}])
+    assert rt.get(pg.ready(), timeout=30)
+    head_pool = cluster.head_node.pool
+    held = head_pool.available.to_dict().get("CPU")
+
+    cluster.kill_head()
+    cluster.restart_head()
+
+    infos = cluster.control.placement_groups.list_groups()
+    assert any(i.pg_id == pg.id for i in infos)
+    # removal through the FRESH control releases the bundle's resources
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if head_pool.available.to_dict().get("CPU") == held + 1:
+            break
+        time.sleep(0.05)
+    assert head_pool.available.to_dict().get("CPU") == held + 1
+
+
+# --------------------------------------------------------------------------
+# plan repair (chaos-driven variant in test_chaos.py)
+# --------------------------------------------------------------------------
+def test_plan_repair_after_restartable_stage_death(ray_start_cluster):
+    from ray_tpu.dag import InputNode
+    from ray_tpu.exceptions import ActorDiedError, RayActorError
+
+    rt_mod, cluster = ray_start_cluster
+    cluster.add_node({"CPU": 1, "stage": 1})
+    cluster.add_node({"CPU": 1, "stage": 1})
+
+    @rt.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def step(self, x):
+            return x + self.k
+
+    s0 = Stage.options(execution="inproc").remote(1)
+    s1 = Stage.options(
+        execution="inproc", num_cpus=0, resources={"stage": 1}, max_restarts=1
+    ).remote(10)
+    with InputNode() as inp:
+        d = s0.step.bind(s1.step.bind(inp))
+    plan = d.compile_plan(name="repairable")
+    try:
+        assert plan.execute(5) == 16
+
+        rt.kill(s1, no_restart=False)  # restartable: the FSM revives it
+        deadline = time.monotonic() + 30
+        while plan.state != "BROKEN" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert plan.state == "BROKEN"
+        with pytest.raises((ActorDiedError, RayActorError)):
+            plan.execute(5)
+
+        plan.repair(timeout=30)
+        assert plan.state == "READY"
+        for i in range(5):
+            assert plan.execute(i) == i + 11
+        assert plan.state_history == ["READY", "BROKEN", "READY"]
+        # the cluster-level transition log feeds the chaos invariant sweep
+        ours = [t for t in cluster.plan_transitions if t[0] == plan.plan_id]
+        assert ours == [
+            (plan.plan_id, "READY", "BROKEN"),
+            (plan.plan_id, "BROKEN", "READY"),
+        ]
+    finally:
+        plan.teardown()
+
+
+def test_plan_repair_fails_for_dead_stage(ray_start_regular):
+    from ray_tpu.dag import InputNode
+    from ray_tpu.exceptions import ActorDiedError, RayActorError
+
+    @rt.remote
+    class Stage:
+        def step(self, x):
+            return x * 2
+
+    s0 = Stage.options(execution="inproc").remote()  # max_restarts=0
+    with InputNode() as inp:
+        d = s0.step.bind(inp)
+    plan = d.compile_plan(name="unrepairable")
+    try:
+        assert plan.execute(4) == 8
+        rt.kill(s0)
+        deadline = time.monotonic() + 30
+        while plan.state != "BROKEN" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert plan.state == "BROKEN"
+        with pytest.raises((ActorDiedError, RayActorError, TimeoutError)):
+            plan.repair(timeout=3)
+        assert plan.state == "BROKEN"
+    finally:
+        plan.teardown()
+
+
+# --------------------------------------------------------------------------
+# observability surfaces: /api/autoscaler + `rt nodes`
+# --------------------------------------------------------------------------
+def test_api_autoscaler_and_rt_nodes_surface_drains(capsys):
+    import json
+    import urllib.request
+
+    rt.init(num_cpus=2, include_dashboard=True)
+    try:
+        cluster = rt.get_cluster()
+        # head restart FIRST: liveness is rebuilt from the living, so a
+        # node drained before the restart would (correctly) drop out of
+        # the fresh node table entirely
+        cluster.kill_head()
+        cluster.restart_head()
+        node_b = cluster.add_node({"CPU": 1})
+        cluster.drain_node(node_b.node_id)
+
+        url = cluster.dashboard.url
+        with urllib.request.urlopen(url + "/api/autoscaler", timeout=30) as resp:
+            data = json.loads(resp.read())
+        states = {n["node_id"]: n["state"] for n in data["nodes"]}
+        assert states[node_b.node_id.hex()] == "DEAD"
+        assert any(n["is_head"] and n["state"] == "ALIVE" for n in data["nodes"])
+        assert data["head_restarts"] == 1
+        assert data["drains"] and data["drains"][0]["node"] == node_b.node_id.hex()[:8]
+
+        from ray_tpu.scripts.cli import main
+
+        assert main(["nodes", "--address", url]) == 0
+        out = capsys.readouterr().out
+        assert "DEAD" in out and "head restarts: 1" in out and "drains: 1" in out
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# schedule validation (`rt chaos validate`)
+# --------------------------------------------------------------------------
+def test_validate_schedule_catches_schema_errors():
+    errors = validate_schedule(
+        {
+            "seed": "not-an-int",
+            "events": [
+                {"t": -1.0, "kind": "kill_node", "index": 0},
+                {"t": 0.5, "kind": "explode"},
+                {"t": 1.0, "kind": "arm"},                      # missing spec
+                {"t": 1.5, "kind": "arm", "spec": "x=frobnicate"},
+                {"t": 2.0, "kind": "lose_objects", "fraction": 1.5},
+                {"t": 2.5, "kind": "kill_node", "index": -2},
+                {"t": 3.0, "kind": "partition", "fp": "rpc.call", "duration": 0},
+                {"t": 3.5, "kind": "restart_head"},             # no kill_head
+                {"t": 4.0, "kind": "kill_node", "whom": 1},     # unknown param
+            ],
+        }
+    )
+    text = "\n".join(errors)
+    assert "'seed' must be an integer" in text
+    assert "'t' must be >= 0" in text
+    assert "unknown kind 'explode'" in text
+    assert "missing required parameter 'spec'" in text
+    assert "bad failpoint spec" in text
+    assert "'fraction' must be in [0, 1]" in text
+    assert "'index' must be >= 0" in text
+    assert "'duration' must be > 0" in text
+    assert "restart_head without a preceding kill_head" in text
+    assert "unknown parameter 'whom'" in text
+
+
+def test_validate_schedule_bounds_node_indices():
+    events = [
+        {"t": 0.0, "kind": "kill_node", "index": 1},
+        {"t": 1.0, "kind": "add_node", "resources": {"CPU": 1}},
+        {"t": 2.0, "kind": "drain_node", "index": 1},
+        {"t": 3.0, "kind": "kill_node", "index": 1},  # only 1 node left
+    ]
+    errors = validate_schedule({"seed": 0, "events": events}, num_nodes=2)
+    assert len(errors) == 1 and "index 1 out of range" in errors[0]
+    assert not validate_schedule({"seed": 0, "events": events[:3]}, num_nodes=2)
+
+
+def test_validate_schedule_accepts_elasticity_schedule():
+    sched = {
+        "seed": 7,
+        "events": [
+            {"t": 0.0, "kind": "arm", "spec": "object_store.put=raise(0.3)"},
+            {"t": 0.5, "kind": "add_node", "resources": {"CPU": 2}},
+            {"t": 1.0, "kind": "drain_node", "index": 0, "timeout": 10},
+            {"t": 1.5, "kind": "kill_head"},
+            {"t": 2.5, "kind": "restart_head"},
+            {"t": 3.0, "kind": "disarm"},
+        ],
+    }
+    assert validate_schedule(sched, num_nodes=1) == []
+
+
+def test_chaos_validate_cli_smoke(tmp_path, capsys):
+    import json
+
+    from ray_tpu.scripts.cli import main
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "seed": 3,
+        "events": [{"t": 0.0, "kind": "arm", "spec": "rpc.call=delay(0.1,0.5)"}],
+    }))
+    assert main(["chaos", "validate", str(good)]) == 0
+    assert "ok (1 events" in capsys.readouterr().out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"seed": 3, "events": [{"t": 0, "kind": "nope"}]}))
+    assert main(["chaos", "validate", str(bad)]) == 1
+    assert "unknown kind" in capsys.readouterr().err
+
+    notjson = tmp_path / "notjson.json"
+    notjson.write_text("{")
+    assert main(["chaos", "validate", str(notjson)]) == 1
+    assert "not valid JSON" in capsys.readouterr().err
