@@ -160,6 +160,11 @@ ROW_GROUPS = [
     # the dispatch-overhead ratio vs the equivalent .remote() chain.  Own
     # fresh-runtime group — it adds a node.
     ["compiled_pipeline_iter", "compiled_pipeline_vs_remote_x"],
+    # lease-based direct dispatch (ISSUE 7): the multi_client_tasks_async /
+    # n_n_actor_calls_async SHAPES riding cached worker leases and actor
+    # direct routes — the regression rows tracked head-to-head against the
+    # lease path.  Own fresh-runtime group, median-of-3 capture below.
+    ["direct_dispatch_tasks_async", "direct_dispatch_actor_calls_async"],
 ]
 
 
@@ -193,6 +198,8 @@ def main() -> None:
         "locality_arg_tasks",
         "broadcast_64mb_to_n",
         "compiled_pipeline_iter",
+        "direct_dispatch_tasks_async",
+        "direct_dispatch_actor_calls_async",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

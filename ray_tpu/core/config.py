@@ -198,6 +198,29 @@ class Config:
     # stage actor to come back ALIVE through the restart FSM.
     compiled_plan_repair_timeout_s: float = 30.0
 
+    # ---- worker leases / direct dispatch (runtime/scheduler.LeaseManager,
+    # reference: cached RequestWorkerLease reuse per SchedulingKey,
+    # direct_task_transport.cc:409) -----------------------------------------
+    # How long an unused lease survives before it is returned (its pinned
+    # worker goes back to the pool and the next submit re-grants). 0
+    # disables lease caching entirely — every task takes the scheduled path.
+    lease_idle_timeout_s: float = 10.0
+    # Max cached leases (distinct nodes) per scheduling key; spillback
+    # grants beyond this replace the most-saturated lease instead.
+    max_leases_per_key: int = 2
+    # Local-scheduler queue depth on a leased node that triggers a
+    # spillback re-grant (raylet spillback parity) when another node could
+    # take the work.  1 = any resource queueing spills (evaluated at most
+    # every 50ms per lease, so a throughput burst pays ~20 scheduling
+    # decisions/s, not one per task). 0 disables spillback — leases only
+    # rotate on expiry.
+    lease_spillback_queue_depth: int = 1
+    # Agent-side ObjectDirectory location commits coalesce into one
+    # ``object_locations`` control RPC per batch: flush at this many
+    # entries, or after the delay below — whichever comes first.
+    location_commit_flush_count: int = 64
+    location_commit_flush_delay_s: float = 0.003
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             env_key = _ENV_PREFIX + f.name.upper()

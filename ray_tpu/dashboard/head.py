@@ -183,6 +183,8 @@ class DashboardHead:
             req._send(200, self._transfer_stats())
         elif path == "/api/pulls":
             req._send(200, self._pull_stats())
+        elif path == "/api/leases":
+            req._send(200, self._lease_stats())
         elif path == "/api/autoscaler":
             req._send(200, self._autoscaler_status())
         elif path == "/api/plans":
@@ -426,6 +428,28 @@ class DashboardHead:
             "locality": {
                 "hit_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "hit"}),
                 "miss_bytes": metric_defs.SCHEDULER_LOCALITY_BYTES.get({"result": "miss"}),
+            },
+        }
+
+    def _lease_stats(self) -> dict:
+        """`rt leases` / GET /api/leases: active worker leases (per-shape
+        cached dispatch routes), lifetime grant/reuse/spillback churn,
+        direct-push transport split, and the actor direct-route totals —
+        together they answer "is the head off the steady-state hot path?"."""
+        from ray_tpu.observability import metric_defs
+
+        leases = self.cluster.lease_manager.snapshot()
+        return {
+            "leases": leases,
+            "actor_routes": self.cluster.actor_route_stats(),
+            "head": {
+                "scheduling_decisions": self.cluster.cluster_scheduler.num_picks,
+                "rpcs_avoided": metric_defs.HEAD_RPCS_AVOIDED.get(),
+            },
+            "pushes": {
+                "inproc": metric_defs.DIRECT_PUSHES.get({"transport": "inproc"}),
+                "data_plane": metric_defs.DIRECT_PUSHES.get({"transport": "data_plane"}),
+                "actor_direct": metric_defs.DIRECT_PUSHES.get({"transport": "actor_direct"}),
             },
         }
 

@@ -115,6 +115,31 @@ ACTOR_CALLS_SUBMITTED = _reg.counter(
     "actor_calls_submitted_total", "Actor method calls submitted by this driver."
 )
 
+# ---- worker leases / direct dispatch -------------------------------------
+LEASE_GRANTS = _reg.counter(
+    "lease_grants_total",
+    "Worker leases granted by the head scheduler, by reason (miss = first "
+    "task of a scheduling key, spillback = leased node saturated). Each "
+    "grant is ONE head scheduling decision amortized over every reuse.",
+)
+LEASE_REUSE_HITS = _reg.counter(
+    "lease_reuse_hits_total",
+    "Tasks routed through an already-granted worker lease — repeat-shape "
+    "submissions that skipped the head's per-task scheduling decision.",
+)
+DIRECT_PUSHES = _reg.counter(
+    "direct_pushes_total",
+    "Tasks pushed straight to their leased executor, by transport (inproc "
+    "= same-process local scheduler, data_plane = peer-to-peer push_task "
+    "frame to an agent, actor_direct = cached actor route).",
+)
+HEAD_RPCS_AVOIDED = _reg.counter(
+    "head_rpcs_avoided_total",
+    "Head-side scheduling/dispatch hops avoided by lease reuse and direct "
+    "actor routes — the head's steady-state work is O(lease churn), not "
+    "O(tasks).",
+)
+
 # ---- data plane ----------------------------------------------------------
 DATA_PLANE_BYTES = _reg.counter(
     "data_plane_transfer_bytes_total",
@@ -272,6 +297,10 @@ ALL_METRICS = [
     WORKER_POOL_SPAWNED,
     WORKER_POOL_DEATHS,
     ACTOR_CALLS_SUBMITTED,
+    LEASE_GRANTS,
+    LEASE_REUSE_HITS,
+    DIRECT_PUSHES,
+    HEAD_RPCS_AVOIDED,
     DATA_PLANE_BYTES,
     DATA_PLANE_TRANSFERS,
     DATA_PLANE_LATENCY,

@@ -20,7 +20,8 @@ import os
 import sys
 import threading
 import time
-from typing import Any, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
 from ray_tpu.core.resources import ResourceSet
@@ -38,6 +39,21 @@ class AgentFabric:
         self._pull_pool = None    # lazily-built transfer thread pool
         self._specs: Dict[bytes, Any] = {}   # task_id -> agent-side spec
         self._specs_lock = threading.Lock()
+        # recently-completed pushed tasks: dedup window for the owner's
+        # control-plane fallback resubmit racing a push whose delivery ack
+        # was lost in flight (the task ran here; running it again would
+        # break exactly-once side effects)
+        self._pushed_done: "OrderedDict[Tuple[bytes, int], None]" = OrderedDict()
+        # batched ObjectDirectory commits: per-put object_location notices
+        # coalesce into one object_locations control RPC (flush on count or
+        # a short deadline) — the head sees O(batches), not O(puts).  One
+        # long-lived flusher thread parks on the condition: a Timer per
+        # window would create+destroy an OS thread every few ms on the very
+        # put path this batching exists to speed up
+        self._loc_buf: list = []
+        self._loc_cond = threading.Condition()
+        self._loc_deadline: Optional[float] = None
+        self._loc_thread: Optional[threading.Thread] = None
 
     def _transfer_pool(self):
         if self._pull_pool is None:
@@ -136,19 +152,13 @@ class AgentFabric:
         node.store.put(oid, value, is_error=is_error)
         # metadata-only notice: the head's directory records this node as a
         # location so future consumers can pull from here and recovery knows
-        # this copy exists (device flag keeps HBM-residency tracking honest)
+        # this copy exists (device flag keeps HBM-residency tracking honest);
+        # batched — it rides the next coalesced object_locations frame
         from ray_tpu.runtime.device_plane import is_device_array
 
         from ray_tpu.runtime.remote_node import _probe_nbytes
 
-        try:
-            self.conn.send(
-                "object_location",
-                {"oid": oid.binary(), "device": is_device_array(value),
-                 "size": _probe_nbytes(value)[0]},
-            )
-        except rpc.RpcError:
-            pass
+        self.notify_location(oid, _probe_nbytes(value)[0], is_device_array(value))
         callback()
 
     # -- completion callbacks (forwarded to the owner on the head) ----------
@@ -162,14 +172,92 @@ class AgentFabric:
 
         return tracing.drain_span_events()
 
+    # -- batched directory commits --------------------------------------
+    def notify_location(self, oid: ObjectID, size: int, device: bool) -> None:
+        """Queue a location notice for the next coalesced object_locations
+        RPC.  Flush on count, else on a short timer — one control frame per
+        BATCH of puts instead of one per put (the multi_client_put row's
+        head round-trips)."""
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        entry = (oid.binary(), int(size or 0), bool(device))
+        flush = None
+        with self._loc_cond:
+            self._loc_buf.append(entry)
+            if len(self._loc_buf) >= max(1, cfg.location_commit_flush_count):
+                flush, self._loc_buf = self._loc_buf, []
+                self._loc_deadline = None
+            else:
+                if self._loc_deadline is None:
+                    self._loc_deadline = time.monotonic() + max(
+                        0.0, cfg.location_commit_flush_delay_s
+                    )
+                    self._loc_cond.notify()
+                if self._loc_thread is None:
+                    self._loc_thread = threading.Thread(
+                        target=self._loc_flush_loop, name="loc-flush", daemon=True
+                    )
+                    self._loc_thread.start()
+        if flush is not None:
+            self._send_locations(flush)
+
+    def _loc_flush_loop(self) -> None:
+        while True:
+            with self._loc_cond:
+                while self._loc_deadline is None:
+                    self._loc_cond.wait()
+                delay = self._loc_deadline - time.monotonic()
+                if delay > 0:
+                    self._loc_cond.wait(delay)
+                    continue  # re-check: a count-flush may have drained us
+                flush, self._loc_buf = self._loc_buf, []
+                self._loc_deadline = None
+            if flush:
+                self._send_locations(flush)
+
+    def flush_locations(self) -> None:
+        with self._loc_cond:
+            flush, self._loc_buf = self._loc_buf, []
+            self._loc_deadline = None
+        if flush:
+            self._send_locations(flush)
+
+    def _send_locations(self, locs: list) -> None:
+        try:
+            self.conn.send("object_locations", {"locs": locs})
+        except rpc.RpcError:
+            pass  # head gone: the rejoin/death path owns recovery
+
     def on_task_finished(self, node, spec, result, error) -> None:
+        push = spec._push_reply
+        if push is not None:
+            # Leased direct dispatch: the OWNER is blocked on the data-plane
+            # connection this task arrived on — route the completion back
+            # there (owner-to-owner results; the head control channel never
+            # sees this task again).  Returns still store locally first:
+            # this node stays a valid object location either way.
+            with self._specs_lock:
+                self._pushed_done[(spec.task_id.binary(), spec.attempt)] = None
+                while len(self._pushed_done) > 4096:
+                    self._pushed_done.popitem(last=False)
+            self._forget(spec)
+            box, done = push
+            if error is None and spec.num_returns != 0:
+                if spec.num_returns == 1:
+                    values = [result]
+                else:
+                    values = list(result) if result is not None else [None] * spec.num_returns
+                for oid, value in zip(spec.return_ids, values):
+                    node.store.put(oid, value)
+                box["values"] = values
+            box["result"] = result
+            box["error"] = error
+            done.set()
+            return
         self._forget(spec)
         if error is not None:
-            self.conn.send(
-                "task_finished",
-                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None,
-                 "spans": self._drained_spans()},
-            )
+            self._send_task_finished(spec, [], None, error)
             return
         # Store returns locally first: this node IS a valid object location
         # (the head's directory will record it), so same-node consumers read
@@ -182,6 +270,34 @@ class AgentFabric:
             values = list(result) if result is not None else [None] * spec.num_returns
         for oid, value in zip(spec.return_ids, values):
             node.store.put(oid, value)
+        self._send_task_finished(spec, values, result, None)
+
+    def pushed_duplicate(self, task_bin: bytes, attempt: int) -> bool:
+        """True when a control-plane submit duplicates a pushed task that is
+        in flight or recently completed here — the owner's fallback resubmit
+        raced a push whose delivery ack it never read.  That copy's
+        completion is guaranteed to reach the owner (data-plane reply or the
+        control re-route), so the duplicate must not run."""
+        with self._specs_lock:
+            if (task_bin, attempt) in self._pushed_done:
+                return True
+            prior = self._specs.get(task_bin)
+        return (
+            prior is not None
+            and getattr(prior, "_push_reply", None) is not None
+            and prior.attempt == attempt
+        )
+
+    def _send_task_finished(self, spec, values, result, error) -> None:
+        """Control-plane completion notice (error / lazy / inline value).
+        Returns must already be stored locally."""
+        if error is not None:
+            self.conn.send(
+                "task_finished",
+                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None,
+                 "spans": self._drained_spans()},
+            )
+            return
         from ray_tpu.core.config import get_config
 
         threshold = get_config().data_plane_inline_bytes
@@ -301,7 +417,9 @@ class AgentFabric:
         except Exception:  # noqa: BLE001 — head gone: its death sweep cleans up
             pass
 
-    def handle_worker_api(self, blob: bytes, op: str = "", worker_key=None) -> bytes:
+    def handle_worker_api(
+        self, blob: bytes, op: str = "", worker_key=None, pushed: bool = False
+    ) -> bytes:
         """A worker on this agent made a nested API call: the owner (the
         driver's CoreWorker) lives across the transport — relay and wait.
         Long timeout: a nested get legitimately waits on real work.
@@ -312,16 +430,18 @@ class AgentFabric:
         control connection twice (worker→agent→head→agent→worker).
         ``op`` rides beside the blob so only the ops with a local fast path
         (get/put) are ever deserialized here; everything else relays as an
-        opaque blob."""
+        opaque blob.  ``pushed``: the calling worker is executing a task
+        that arrived over the data-plane push channel — its result will NOT
+        ride this control connection, so any ref the call mints must be
+        registered synchronously (nothing orders the two channels)."""
         from ray_tpu.runtime.worker_api import ASYNC_OPS
 
         if op in ASYNC_OPS:
             if op == "put_async":
                 # keep the BYTES in this node's store; the head records
-                # only ownership + the worker pin (register_put_async) and
-                # learns placement from object_location
+                # ownership + placement from the register notice
                 try:
-                    if self._local_put_async(blob, worker_key):
+                    if self._local_put_async(blob, worker_key, sync=pushed):
                         return b""
                 except Exception:  # noqa: BLE001 — fall through to full relay
                     pass
@@ -377,10 +497,14 @@ class AgentFabric:
         )
         return reply["blob"]
 
-    def _local_put_async(self, blob: bytes, worker_key) -> bool:
+    def _local_put_async(self, blob: bytes, worker_key, sync: bool = False) -> bool:
         """Worker-minted fire-and-forget put: bytes stay in this node's
         store; the head gets a tiny ownership+pin notice.  Returns False
-        when the value must rebuild in the driver (nested refs)."""
+        when the value must rebuild in the driver (nested refs).  ``sync``
+        (puts from PUSHED tasks): register with a blocking round trip — the
+        minted ref travels back on the data-plane reply, which nothing
+        orders against this control channel, so registration must complete
+        before the put returns to the worker."""
         import pickle
 
         from ray_tpu.core.ids import ObjectID as _OID
@@ -400,19 +524,27 @@ class AgentFabric:
         from ray_tpu.runtime.device_plane import is_device_array
         from ray_tpu.runtime.remote_node import _probe_nbytes
 
-        self.conn.send(
-            "object_location",
-            {"oid": oid.binary(), "device": is_device_array(value),
-             "size": _probe_nbytes(value)[0]},
-        )
-        self.conn.send(
-            "worker_api_async",
-            {
-                "blob": worker_api._dumps(("register_put_async", {"oid": kw["oid"]})),
-                "op": "register_put_async",
-                "worker_key": worker_key,
-            },
-        )
+        # placement rides INSIDE the ownership notice (one frame per put,
+        # not two) — a separate batched object_locations frame could trail
+        # the ownership record, and a node dying in that window left an
+        # owned object the death/drain sweeps couldn't see (get hangs
+        # instead of raising lost-object)
+        reg_blob = worker_api._dumps((
+            "register_put_async",
+            {"oid": kw["oid"], "size": _probe_nbytes(value)[0],
+             "device": is_device_array(value)},
+        ))
+        if sync:
+            self.conn.request(
+                "worker_api", {"blob": reg_blob, "worker_key": worker_key},
+                timeout=30.0,
+            )
+        else:
+            self.conn.send(
+                "worker_api_async",
+                {"blob": reg_blob, "op": "register_put_async",
+                 "worker_key": worker_key},
+            )
         return True
 
     def _local_put(self, blob: bytes, decoded=None) -> Optional[bytes]:
@@ -438,11 +570,13 @@ class AgentFabric:
             from ray_tpu.runtime.device_plane import is_device_array
             from ray_tpu.runtime.remote_node import _probe_nbytes
 
-            self.conn.send(
-                "object_location",
-                {"oid": oid.binary(), "device": is_device_array(value),
-                 "size": _probe_nbytes(value)[0]},
-            )
+            self.notify_location(oid, _probe_nbytes(value)[0], is_device_array(value))
+            # sync flush: the worker's put must not return before the head
+            # can see the location (this path already pays a mint_put_oid
+            # round trip, so the one-way frame is noise); replica notices
+            # from _direct_pull stay batched — losing one loses a replica
+            # RECORD, never the object
+            self.flush_locations()
         except BaseException:
             # minted but not committed: unpin on the head and drop the local
             # copy, else the oid stays owned forever with a stranded value
@@ -525,6 +659,53 @@ class NodeAgent:
         self.conn: Optional[rpc.RpcConnection] = None
 
     # ------------------------------------------------------------------
+    def _install_inproc_api(self) -> None:
+        """In-proc tasks execute in THIS process.  Without a global worker,
+        ``rt.put``/``get``/``submit`` inside one would auto-init a phantom
+        PRIVATE cluster whose refs mean nothing to the real head — puts
+        silently landed in a runtime nobody else can see.  Install a
+        WorkerApiClient whose transport is a direct call into the node's
+        API handler: the exact frames process workers send over the pool
+        socket, minus the socket.  Async ops run inline (put-before-result
+        ordering, mirroring the pool's reader thread); sync ops compute
+        their reply before ``send_request`` returns, so the caller's future
+        resolves immediately."""
+        import pickle as _pickle
+
+        from ray_tpu.core.object_ref import hooks
+        from ray_tpu.runtime.context import task_context
+        from ray_tpu.runtime.worker import set_global_worker
+        from ray_tpu.runtime.worker_api import ASYNC_OPS, WorkerApiClient
+        from ray_tpu.runtime.worker_main import _WorkerRefCounter
+
+        wkey = os.getpid()
+
+        def send_request(rid: int, blob: bytes, task_bin, op: str) -> None:
+            node = self.node
+            if op in ASYNC_OPS:
+                try:
+                    node._handle_worker_api(task_bin, blob, op=op, worker_key=wkey)
+                except Exception:  # noqa: BLE001 — notification: no reply due
+                    pass
+                return
+            try:
+                reply = node._handle_worker_api(task_bin, blob, op=op, worker_key=wkey)
+            except BaseException as exc:  # noqa: BLE001
+                reply = _pickle.dumps(
+                    ("err", RuntimeError(f"worker api failed: {exc}"))
+                )
+            client.on_reply(rid, reply)
+
+        def current_task():
+            cur = task_context.current()
+            return cur[0].binary() if cur is not None else None
+
+        client = WorkerApiClient(send_request, current_task)
+        set_global_worker(client)
+        # release protocol parity with process workers: refs minted by
+        # in-proc tasks drop their owner-side pins when they go out of scope
+        hooks.ref_counter = _WorkerRefCounter(client)
+
     def start(self) -> None:
         from ray_tpu.runtime.node import Node
 
@@ -572,6 +753,9 @@ class NodeAgent:
             data_ip=self.conn.local_ip, head_ip=self.conn.peer_ip,
         )
         self.fabric.node = self.node
+        # rt.* must work inside in-proc tasks executing in THIS process
+        # (auto-tier profiling routes hot small tasks here)
+        self._install_inproc_api()
         # Bulk data plane: this node serves its local store to peers and
         # pulls dependencies directly from whichever peer holds them (the
         # head is only the address book — see data_plane.py docstring).
@@ -583,6 +767,11 @@ class NodeAgent:
         self.data_server = data_plane.store_server(
             self.node.store, host="0.0.0.0", shm_store=self.shm_store
         )
+        # leased direct dispatch: submitters holding a worker lease push
+        # repeat-shape tasks straight here (push_task frames); results flow
+        # back owner-to-owner on the same connection — the head control
+        # channel carries lease churn, not per-task traffic
+        self.data_server.task_handler = self._handle_pushed_task
         self.data_address = f"{self.conn.local_ip}:{self.data_server.port}"
         self.fabric.data_client = data_plane.DataClient(
             chunk_bytes=cfg.object_transfer_chunk_bytes,
@@ -846,7 +1035,116 @@ class NodeAgent:
         return spec
 
     def _h_submit_task(self, conn, payload) -> None:
+        enc = payload["spec"]
+        if self.fabric.pushed_duplicate(enc["task_id"], enc["attempt"]):
+            # the owner's control fallback raced a push that WAS delivered
+            # here: that copy ran (or is running) and its completion reaches
+            # the owner on its own — running this duplicate would break
+            # exactly-once side effects
+            return
         self.node.submit(self._decode(payload))
+
+    # -- leased direct dispatch (data-plane push_task) -------------------
+    def _handle_pushed_task(self, spec_blob: bytes, accept):
+        """Run one peer-pushed TaskSpec and return its owner-routed result
+        frames: ``(header, meta, buffers, reply_failed)`` — meta None means
+        the header alone carries the outcome (error / lazy commit), and
+        ``reply_failed`` (None until the task is accepted) re-routes the
+        completion over the control channel when the data-plane reply can't
+        reach the owner.  ``accept()`` sends the delivery ack and must
+        succeed BEFORE dispatch: once the owner reads it, it never falls
+        back to a control resubmit.  Runs on the data connection's
+        dedicated serve thread; blocking until the task commits IS the
+        owner's wait."""
+        import pickle as _pickle
+
+        payload = _pickle.loads(spec_blob)
+        try:
+            spec = rpc.decode_spec(payload, self._fn_cache)
+        except rpc.FunctionNotCached:
+            # the function blob rode an earlier control-plane submit whose
+            # frame hasn't landed (cross-channel race): ask the owner to
+            # resend with the blob inline
+            return {"ok": False, "need_fn": True}, None, None, None
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        spec._push_reply = (box, done)
+        spec._leased = True  # pin a warm process worker to the shape
+        # accept BEFORE _remember: a remembered-but-never-accepted spec
+        # would make pushed_duplicate drop the owner's control fallback for
+        # a task that never ran — losing it forever
+        accept()  # ConnectionError/OSError -> the owner's fallback owns it
+        self.fabric._remember(spec)
+
+        def reply_failed() -> None:
+            # the owner never confirmed the data-plane reply — and it never
+            # resubmits a delivered push — so the completion must travel the
+            # control channel (on_task_finished_msg resolves the still-
+            # tracked spec; a duplicate arrival no-ops on the untrack guard)
+            try:
+                self.fabric._send_task_finished(
+                    spec, box.get("values") or [], box.get("result"), box.get("error")
+                )
+            except rpc.RpcError:
+                pass  # head gone too: the node-death sweep owns the spec
+
+        try:
+            self.node.submit(spec)
+        except Exception as exc:  # noqa: BLE001 — post-accept dispatch
+            # failure: the owner will never resubmit, so this must surface
+            # as a task outcome, not a dropped frame
+            self.fabric._forget(spec)
+            box["error"] = RuntimeError(f"pushed task dispatch failed: {exc!r}")
+            done.set()
+        # long wait by design (a pushed task may legitimately block on
+        # nested work); a dead owner connection surfaces through the reply
+        # send/receipt-ack, which re-routes via reply_failed
+        if not done.wait(24 * 3600.0):
+            # wedged worker: an ok-reply with an empty box would commit
+            # None as the result — surface a typed failure instead
+            err = RuntimeError("pushed task did not commit within 24h")
+            box.setdefault("error", err)
+            return {
+                "ok": True, "error": rpc.encode_value(err),
+                "spans": self.fabric._drained_spans(),
+            }, None, None, reply_failed
+        error = box.get("error")
+        spans = self.fabric._drained_spans()
+        if error is not None:
+            return (
+                {"ok": True, "error": rpc.encode_value(error), "spans": spans},
+                None, None, reply_failed,
+            )
+        result = box.get("result")
+        from ray_tpu.core.config import get_config
+
+        threshold = get_config().data_plane_inline_bytes
+        from ray_tpu.runtime.remote_node import _bulk_size
+
+        values = box.get("values", ())
+
+        def lazy_header():
+            from ray_tpu.runtime.device_plane import is_device_array
+            from ray_tpu.runtime.remote_node import _probe_nbytes
+
+            return {
+                "ok": True, "lazy": True, "spans": spans,
+                "device_returns": [is_device_array(v) for v in values],
+                "return_sizes": [_probe_nbytes(v)[0] for v in values],
+            }, None, None, reply_failed
+
+        if _bulk_size(result) > threshold:
+            # bulk result: bytes stay in this node's store (the lazy-commit
+            # contract) — the owner records the location, consumers pull
+            # peer-to-peer on demand
+            return lazy_header()
+        from ray_tpu.runtime import data_plane
+
+        meta, buffers = data_plane.to_frames(result)
+        total = len(meta) + sum(memoryview(b).cast("B").nbytes for b in buffers)
+        if total > threshold:
+            return lazy_header()
+        return {"ok": True, "spans": spans}, meta, buffers, reply_failed
 
     def _h_submit_actor_task(self, conn, payload) -> None:
         self.node.submit_actor_task(self._decode(payload))
@@ -1006,6 +1304,12 @@ class NodeAgent:
                 conn.send("resource_report", report)
             except rpc.RpcError:
                 return
+            try:
+                # lease-pin hygiene rides the report cadence: expire pins
+                # whose shape went quiet (head expiry can't reach this pool)
+                self.node.worker_pool.sweep_stale_pins()
+            except Exception:  # noqa: BLE001 — hygiene must not kill reports
+                pass
             self._flush_logs()
             self._stop.wait(period)
 

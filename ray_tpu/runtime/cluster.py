@@ -42,7 +42,16 @@ from ray_tpu.exceptions import (
 from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime.control import ActorState, ControlService, NodeInfo
 from ray_tpu.runtime.node import Node
-from ray_tpu.runtime.scheduler import ClusterScheduler, TaskSpec
+from ray_tpu.runtime.scheduler import ClusterScheduler, LeaseManager, TaskSpec
+
+# prebuilt tag dict: the actor direct-route hot path must not allocate it
+_ACTOR_DIRECT_TAGS = {"transport": "actor_direct"}
+
+# How long a no-location, no-lineage object gets for an in-flight metadata
+# notice to land before it is tombstoned as lost.  Covers the control-vs-
+# data-plane ordering gap for worker-minted put refs that return through
+# owner-routed push replies; genuine losses just raise this much later.
+_LOST_NOTICE_GRACE_S = 0.25
 
 
 class ObjectDirectory:
@@ -145,6 +154,18 @@ class ObjectDirectory:
             except Exception:  # noqa: BLE001 — observers must not block commits
                 pass
 
+    def commit_placement(
+        self, oid: ObjectID, node_id: NodeID, size: Optional[int], device: bool
+    ) -> None:
+        """The one placement-commit idiom for agent-relayed put/pull notices
+        (device flag + size/tier + location, waking waiters) — every wire
+        path lands here so the commit semantics can't drift."""
+        if device:
+            self.mark_device(oid)
+        self.add_location(
+            oid, node_id, size=size or None, tier="device" if device else "host"
+        )
+
     def remove_location(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._lock:
             locs = self._locations.get(oid)
@@ -242,7 +263,10 @@ class ObjectDirectory:
 class _ActorQueue:
     """Per-actor ordered send queue (head-of-line blocking on dep pulls)."""
 
-    __slots__ = ("pending", "lock", "alive", "next_seq", "prefetched_seq")
+    __slots__ = (
+        "pending", "lock", "alive", "next_seq", "prefetched_seq",
+        "direct_node", "direct_submits",
+    )
 
     def __init__(self):
         self.pending: deque = deque()   # [spec, ready: bool]
@@ -250,6 +274,14 @@ class _ActorQueue:
         self.alive = False
         self.next_seq = 0               # per-actor submission order stamp
         self.prefetched_seq = -1        # dep-prefetch cursor (pump backlog)
+        # cached dispatch route (the actor's hosting node) while the actor
+        # is ALIVE — the actor-shaped worker lease: dep-free calls with an
+        # empty queue stamp their seq and go straight to the instance,
+        # skipping the control-registry lookups and the queue pump
+        # (direct_actor_task_submitter cached-address parity).  Cleared
+        # (under ``lock``) BEFORE the instance dies on every failure path.
+        self.direct_node = None
+        self.direct_submits = 0         # calls that took the direct route
 
 
 class Cluster:
@@ -283,10 +315,17 @@ class Cluster:
             )
             self._snapshot_thread.start()
         self.cluster_scheduler = ClusterScheduler()
+        # cached worker leases: repeat-shape tasks skip per-task pick_node
+        # (grant once, push direct; see scheduler.LeaseManager)
+        self.lease_manager = LeaseManager(self)
         self.directory = ObjectDirectory()
         # locality stage: pick_node scores candidate nodes by the dependency
         # bytes the directory says they already hold
         self.cluster_scheduler.bind_directory(self.directory)
+        # oids whose lost-marking is deferred by the metadata grace window
+        # (see _try_recover) — one timer per oid, not one per caller
+        self._recover_grace: Set[bytes] = set()
+        self._recover_grace_lock = threading.Lock()
         self.task_manager = TaskManager()
         # all inbound object traffic funnels through one admission-controlled
         # PullManager (pull_manager.h:52 parity); created lazily-free here —
@@ -482,6 +521,7 @@ class Cluster:
             self.control.actors.mark_alive(actor_id, handle.node_id)
             with q.lock:
                 q.alive = True
+                q.direct_node = handle
             self._pump_actor_queue(actor_id)
 
     # ------------------------------------------------------------------
@@ -671,6 +711,11 @@ class Cluster:
             # DRAINING before anything moves: evacuation pulls, actor
             # restarts, and task resubmits must never land back here
             self.cluster_scheduler.set_draining(node_id)
+            # return this node's worker leases NOW: new grants already
+            # exclude a draining node (pick_node), and revocation frees its
+            # pinned workers so the drain never waits on an idle-but-leased
+            # worker (ISSUE 7 satellite)
+            self.lease_manager.revoke_node(node_id)
             self.control.nodes.drain(node_id)
         try:
             from ray_tpu.observability.events import global_event_manager
@@ -774,6 +819,9 @@ class Cluster:
         except Exception:  # noqa: BLE001 — diagnostics must not block teardown
             pass
         self.cluster_scheduler.remove_node(node_id)
+        # worker leases routed here are void: revoke BEFORE resubmitting
+        # pending tasks so their retries re-grant on survivors
+        self.lease_manager.revoke_node(node_id)
         self.control.nodes.mark_dead(node_id)
         self.control.placement_groups.on_node_dead(node_id)
         # objects whose only copy was there are lost
@@ -919,6 +967,32 @@ class Cluster:
     # task submission (cluster-level)
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> None:
+        # Lease fast path (direct dispatch): dependency-free, strategy-free
+        # repeat-shape tasks ride a cached worker lease straight to their
+        # node — the head's per-task scheduling hop (pick_node + placement
+        # metric) runs only at lease churn, not per task.  Dep-bearing
+        # tasks keep the locality stage; strategies keep their policies;
+        # streaming keeps its registration ordering; retries re-enter here
+        # and reuse (or re-grant) the lease like any other submission.
+        if (
+            spec.actor_id is None
+            and not spec.dependencies
+            and spec.scheduling_strategy is None
+            and not spec.runtime_env
+            and spec.num_returns != "streaming"
+        ):
+            node = self.lease_manager.route(spec)
+            if node is not None:
+                try:
+                    node.submit_leased(spec)
+                    return
+                except ConnectionError:
+                    # the leased node died under us: revoke and fall back
+                    # to the scheduled path (which re-routes or parks)
+                    self.lease_manager.revoke_node(node.node_id)
+        self._submit_scheduled(spec)
+
+    def _submit_scheduled(self, spec: TaskSpec) -> None:
         t0 = time.perf_counter()
         node_id = self.cluster_scheduler.pick_node(spec)
         metric_defs.SCHEDULER_PLACEMENT_LATENCY.observe(time.perf_counter() - t0)
@@ -1074,9 +1148,13 @@ class Cluster:
 
             release_worker_pins(self.core_worker, pid)
 
-    def handle_worker_api(self, blob: bytes, op: str = "", worker_key=None) -> bytes:
+    def handle_worker_api(
+        self, blob: bytes, op: str = "", worker_key=None, pushed: bool = False
+    ) -> bytes:
         """Nested runtime API call from a worker process on this host: runs
-        against the driver's CoreWorker (the single owner)."""
+        against the driver's CoreWorker (the single owner).  ``pushed`` is
+        accepted for agent-fabric signature parity — head-local workers
+        have no cross-channel registration race."""
         from ray_tpu.runtime import protocol, worker_api
 
         if self.core_worker is None:
@@ -1203,11 +1281,35 @@ class Cluster:
                 return True
         return False
 
-    def _try_recover(self, oid: ObjectID) -> bool:
+    def _try_recover(self, oid: ObjectID, _graced: bool = False) -> bool:
         if self.directory.locations(oid) or self._is_pending(oid):
             return True  # already available or being (re)produced
         spec = self.task_manager.lineage_spec(oid)
         if spec is None:
+            if not _graced:
+                # Cross-channel race, not loss: a worker-minted put's
+                # ownership/location notice rides the CONTROL channel while
+                # the task result that carried its ref can arrive
+                # owner-routed on the DATA plane — nothing orders the two.
+                # Re-check after a short grace before tombstoning; blocked
+                # getters are parked on directory.wait_for either way (they
+                # resolve the moment the notice lands, or raise when the
+                # tombstone commits below).
+                key = oid.binary()
+                with self._recover_grace_lock:
+                    if key in self._recover_grace:
+                        return True  # a grace timer already owns this oid
+                    self._recover_grace.add(key)
+
+                def _expire():
+                    with self._recover_grace_lock:
+                        self._recover_grace.discard(key)
+                    self._try_recover(oid, _graced=True)
+
+                timer = threading.Timer(_LOST_NOTICE_GRACE_S, _expire)
+                timer.daemon = True
+                timer.start()
+                return True
             # Unrecoverable: commit ObjectLostError so blocked getters raise
             # instead of hanging (reference: OwnerDiedError/ObjectLostError
             # surfaced at get).
@@ -1500,7 +1602,7 @@ class Cluster:
         namespace: str = "default", max_task_retries: int = 0,
     ) -> None:
         with self._actor_lock:
-            self._actor_queues[spec.actor_id] = _ActorQueue()
+            q = self._actor_queues[spec.actor_id] = _ActorQueue()
             self._actor_specs[spec.actor_id] = spec
             self._actor_options[spec.actor_id] = {
                 "mode": mode,
@@ -1549,6 +1651,9 @@ class Cluster:
         if q is not None:
             with q.lock:
                 q.alive = True
+                # grant the direct route: dep-free calls now skip the
+                # registry and the pump while the queue stays drained
+                q.direct_node = node
             self._pump_actor_queue(spec.actor_id)
 
     def on_actor_creation_failed(self, spec: TaskSpec, error: BaseException) -> None:
@@ -1565,16 +1670,21 @@ class Cluster:
         self._handle_actor_failure(actor_id, "actor process died")
 
     def _handle_actor_failure(self, actor_id: ActorID, cause: str) -> None:
+        # Revoke the direct route FIRST, before the instance dies: a call
+        # racing this sweep must fall onto the buffering slow path (where
+        # the restart FSM preserves it) rather than land on a dead
+        # instance it could have avoided.
+        q = self._actor_queues.get(actor_id)
+        if q is not None:
+            with q.lock:
+                q.alive = False
+                q.direct_node = None
         spec = self._actor_specs.get(actor_id)
         if spec is not None:
             node = self.nodes.get(spec.owner_node)
             if node is not None and not node.dead:
                 node.kill_actor(actor_id)
                 node.pool.release(spec.resources)
-        q = self._actor_queues.get(actor_id)
-        if q is not None:
-            with q.lock:
-                q.alive = False
         # declaratively-bound collective groups the actor belongs to fail
         # open waits immediately (direct_actor_task_submitter.h:120 parity)
         self._fail_collective_groups_for_actor(actor_id, cause)
@@ -1606,6 +1716,14 @@ class Cluster:
             self._handle_actor_failure(actor_id, "killed via kill_actor (restartable)")
             return
         info.max_restarts = info.num_restarts  # exhaust restarts
+        q = self._actor_queues.get(actor_id)
+        if q is not None:
+            # route revoked BEFORE the kill so a racing direct call buffers
+            # (and is then failed by _fail_actor_queue) instead of racing
+            # the dying instance
+            with q.lock:
+                q.alive = False
+                q.direct_node = None
         if info.node_id is not None:
             node = self.nodes.get(info.node_id)
             if node is not None:
@@ -1637,16 +1755,55 @@ class Cluster:
         self.submit_actor_task(spec, _is_retry=True)
         return True
 
+    def _stamp_actor_retries(self, spec: TaskSpec) -> None:
+        """First submission of an actor call: stamp the actor's
+        max_task_retries onto the spec (-1 = retry until the actor is
+        permanently dead).  ONE reader of _actor_options so the direct
+        route and the queued path can't drift."""
+        opts = self._actor_options.get(spec.actor_id)
+        retries = opts.get("max_task_retries", 0) if opts else 0
+        if retries:
+            spec.max_retries = (1 << 30) if retries < 0 else retries
+            spec.retries_left = spec.max_retries
+
     # -- ordered per-actor call queue -----------------------------------
     def submit_actor_task(self, spec: TaskSpec, _is_retry: bool = False) -> None:
+        # Direct route (the actor-shaped worker lease): while the actor is
+        # ALIVE with an empty call queue, a dependency-free call stamps its
+        # seq and goes straight to the hosting node — no control-registry
+        # lookups, no queue churn, no pump.  Submission happens UNDER
+        # q.lock (exactly like the pump) so the per-actor order guarantee
+        # holds against concurrent submitters; a dead instance surfaces
+        # through the normal in-flight failure path (node-level
+        # ActorDiedError -> retry FSM), the same window in-flight pumped
+        # calls already have.
+        q = self._actor_queues.get(spec.actor_id)
+        if (
+            q is not None
+            and not _is_retry
+            and q.direct_node is not None
+            and not spec.dependencies
+        ):
+            submitted = False
+            with q.lock:
+                node = q.direct_node
+                if q.alive and node is not None and not q.pending:
+                    self._stamp_actor_retries(spec)
+                    spec._actor_seq = q.next_seq
+                    q.next_seq += 1
+                    try:
+                        node.submit_actor_task(spec)
+                        submitted = True
+                        q.direct_submits += 1
+                    except ConnectionError:
+                        pass  # node died: the slow path below reinserts
+                        # by the stamped seq and the death sweep owns it
+            if submitted:
+                metric_defs.DIRECT_PUSHES.inc(tags=_ACTOR_DIRECT_TAGS)
+                metric_defs.HEAD_RPCS_AVOIDED.inc()
+                return
         if not _is_retry:
-            opts = self._actor_options.get(spec.actor_id)
-            if opts:
-                retries = opts.get("max_task_retries", 0)
-                if retries:
-                    # -1 = retry until the actor is permanently dead
-                    spec.max_retries = (1 << 30) if retries < 0 else retries
-                    spec.retries_left = spec.max_retries
+            self._stamp_actor_retries(spec)
         q = self._actor_queues.get(spec.actor_id)
         info = self.control.actors.get(spec.actor_id)
         if q is None and info is not None and info.state is not ActorState.DEAD:
@@ -1803,6 +1960,17 @@ class Cluster:
             for queued_spec in upcoming:
                 self.pull_manager.prefetch(queued_spec.dependencies, node)
 
+    def actor_route_stats(self) -> dict:
+        """Direct actor-route snapshot for /api/leases: how many live
+        actors currently carry a cached route and how many calls rode it."""
+        with self._actor_lock:
+            queues = list(self._actor_queues.values())
+        active = sum(1 for q in queues if q.direct_node is not None)
+        return {
+            "active_routes": active,
+            "direct_submits": sum(q.direct_submits for q in queues),
+        }
+
     def _fail_actor_queue(self, actor_id: ActorID, error: BaseException) -> None:
         q = self._actor_queues.get(actor_id)
         if q is None:
@@ -1837,6 +2005,7 @@ class Cluster:
         # disconnects racing the teardown) stop writing into process-global
         # p2p state the moment we start clearing it
         self._snapshot_stop.set()
+        self.lease_manager.stop()
         p2p.clear_endpoint()
         # collective groups/counters index this runtime incarnation; a
         # survivor would desync the next init against fresh-born peers

@@ -33,6 +33,24 @@ pickles; chunk frames are length-prefixed raw bytes):
          <- {"ok": bool, "error": str}      # ack withheld until the
                                             # consumer slot accepted the
                                             # frame: end-to-end backpressure
+  push_task (leased direct dispatch; ISSUE 7 — a submitter holding a
+  worker lease pushes repeat-shape tasks peer-to-peer, and the RESULT
+  frames flow back to the owner on this same connection instead of a
+  head-routed task_finished control RPC):
+         -> {"op": "push_task", "spec_size"}
+         -> spec blob (pickled encoded TaskSpec, inline args included)
+         <- {"accepted": True}              # delivery ack BEFORE dispatch:
+                                            # once read, the owner never
+                                            # control-resubmits (exactly-
+                                            # once guard); absent on
+                                            # need_fn/decode failures
+         <- {"ok": bool, "error"?, "lazy"?, "device_returns"?,
+             "return_sizes"?, "spans"?, "meta_size"?, "buffer_sizes"?}
+         <- meta + chunk stream             # only when meta_size present
+         -> {"ok": True}                    # owner receipt ack (accepted
+                                            # pushes only): an unconfirmed
+                                            # reply re-routes over the
+                                            # control channel
 
 The relay op is the broadcast data path (Cornet/Orchestra-style
 cooperative tree broadcast): the receiver commits each inbound chunk to
@@ -76,6 +94,12 @@ class ObjectNotFound(DataPlaneError):
     pass
 
 
+class PushDeliveredError(DataPlaneError):
+    """push_task transport died AFTER the peer acked delivery of the spec:
+    the task may be executing there, so the caller must NOT resubmit (the
+    agent re-routes the completion over the control channel instead)."""
+
+
 def to_blob(value: Any) -> bytes:
     """Serialize a value for bulk transfer — ONE serialization policy shared
     with the control plane (rpc.dumps_value), so the two paths can't drift."""
@@ -107,7 +131,7 @@ def from_frames(meta: bytes, buffers: List[Any]) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
-def _send_frame(sock: socket.socket, data: bytes) -> None:
+def _check_send_failpoint() -> None:
     if failpoints.ARMED:
         # chaos: every fault shape surfaces as ConnectionError — the exact
         # failure the transfer paths already recover from (client: discard
@@ -118,7 +142,18 @@ def _send_frame(sock: socket.socket, data: bytes) -> None:
             raise ConnectionError(str(exc)) from None
         if action is not None:
             raise ConnectionError(f"failpoint data_plane.send_frame: {action}")
+
+
+def _send_frame(sock: socket.socket, data: bytes) -> None:
+    _check_send_failpoint()
     sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _send_frame_raw(sock: socket.socket, data: bytes) -> None:
+    """Unprefixed payload whose size already rode the header (push_task
+    spec blobs) — same failpoint as every other data-plane send."""
+    _check_send_failpoint()
+    sock.sendall(data)
 
 
 def _send_header(sock: socket.socket, header: dict) -> None:
@@ -410,6 +445,11 @@ class DataServer:
         self._get_frames = get_frames
         self._put_frames = put_frames
         self._get_device_offer = get_device_offer
+        # leased direct dispatch: the hosting process (a node agent) sets
+        # this to run a pushed TaskSpec and return its result frames —
+        # fn(spec_blob) -> (header_dict, meta_bytes, buffers).  None (the
+        # default) rejects push_task ops.
+        self.task_handler: Optional[Callable[[bytes], Tuple[dict, bytes, List[Any]]]] = None
         self._shm_store = shm_store
         self._stage_lock = threading.Lock()
         self.chunk_bytes = chunk_bytes
@@ -459,6 +499,8 @@ class DataServer:
                     self._serve_relay(sock, req)
                 elif op == "chan_push":
                     self._serve_chan_push(sock, req)
+                elif op == "push_task":
+                    self._serve_push_task(sock, req)
                 else:
                     _send_header(sock, {"error": f"unknown op {op!r}"})
         except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
@@ -595,6 +637,62 @@ class DataServer:
             req["plan"], req["chan"], req["seq"], value, req.get("is_error", False)
         )
         _send_header(sock, {"ok": ok, "error": err})
+
+    def _serve_push_task(self, sock: socket.socket, req: dict) -> None:
+        """Leased direct dispatch: decode + run a pushed TaskSpec and send
+        the result frames straight back to the owner.  Blocking here is by
+        design (each data connection has a dedicated serve thread): the
+        blocked read IS the owner's wait, with zero head involvement.
+        Deliberately outside the admission semaphore — a long task must not
+        pin a transfer slot that bulk pulls need."""
+        spec_blob = _recv_exact(sock, req["spec_size"])
+        handler = self.task_handler
+        if handler is None:
+            _send_header(sock, {"ok": False, "error": "push_task not served here"})
+            return
+
+        def accept() -> None:
+            # delivery ack BEFORE dispatch: once the owner reads this it
+            # never falls back to a control-plane resubmit (double-execution
+            # guard); if this send fails the handler aborts without running
+            _send_header(sock, {"accepted": True})
+
+        try:
+            header, meta, buffers, reply_failed = handler(bytes(spec_blob), accept)
+        except (ConnectionError, OSError):
+            raise  # accept() failed: the task never ran; the owner falls back
+        except Exception as exc:  # noqa: BLE001 — decode/dispatch failure:
+            # task_error marks this as a TASK outcome (e.g. unpicklable user
+            # args) — a control resubmit would fail identically, so the
+            # owner fails the task instead of falling back
+            _send_header(
+                sock, {"ok": False, "task_error": True, "error": f"push_task failed: {exc!r}"}
+            )
+            return
+        try:
+            if meta is None:
+                _send_header(sock, header)
+            else:
+                sizes = [memoryview(b).cast("B").nbytes for b in buffers]
+                header = dict(header, meta_size=len(meta), buffer_sizes=sizes)
+                _send_header(sock, header)
+                sock.sendall(meta)
+                sent = _send_buffers(sock, buffers, self.chunk_bytes)
+                self.stats.add("bytes_sent", len(meta) + sent)
+            if reply_failed is not None:
+                # the completion is held until the owner CONFIRMS receipt: a
+                # reply sendall into a dead-but-unreset socket "succeeds"
+                # locally, and the owner (which never resubmits a delivered
+                # push) would wait forever on a result that evaporated
+                sock.settimeout(300.0)
+                ack = _recv_header(sock)
+                sock.settimeout(None)
+                if not ack.get("ok"):
+                    raise OSError("owner rejected push_task reply")
+        except (OSError, EOFError, pickle.UnpicklingError):
+            if reply_failed is not None:
+                reply_failed()  # re-route the completion over the control plane
+            raise
 
     def _serve_push(self, sock: socket.socket, req: dict) -> None:
         # same admission gate as pulls: inbound bulk buffering is bounded too
@@ -985,6 +1083,70 @@ class DataClient:
                 send_subtree(sub)
         _observe_latency("relay", t_start)
         return sorted(set(failed))
+
+    def push_task(self, addr: str, spec_blob: bytes, timeout: float = 300.0,
+                  result_timeout: float = 24 * 3600.0 + 300.0):
+        """Leased direct dispatch: push one encoded TaskSpec to a peer's
+        data server and block for its owner-routed result.  Returns the
+        reply header plus decoded result frames: ``(header, value_or_None)``.
+        Raises :class:`DataPlaneError` on transport death BEFORE the peer
+        acks delivery (the caller may fall back to the control-plane submit
+        path) and :class:`PushDeliveredError` after (the task may be
+        executing — the caller must NOT resubmit).
+
+        Deliberately OUTSIDE the admission semaphore, mirroring the server
+        side: the result wait spans the task's full runtime and must not
+        pin a transfer slot that bulk pulls/pushes need (inline result
+        frames are bounded by ``data_plane_inline_bytes``, so the ungated
+        receive can't buffer unbounded bulk).  The wait itself is capped by
+        ``result_timeout`` — the agent-side commit bound plus slack, NOT
+        the transfer timeout: a task merely longer than ``timeout`` must
+        not trip the control-plane fallback and execute twice."""
+        t_start = time.perf_counter()
+        sock = self._checkout(addr)
+        delivered = False
+        try:
+            sock.settimeout(timeout)
+            _send_header(sock, {"op": "push_task", "spec_size": len(spec_blob)})
+            _send_frame_raw(sock, spec_blob)
+            header = _recv_header(sock)  # delivery ack (or need_fn/dispatch failure)
+            if header.get("accepted"):
+                # the agent ACKed the spec before dispatching: from here on a
+                # transport death means the task may be running — the caller
+                # must never resubmit (PushDeliveredError)
+                delivered = True
+                sock.settimeout(result_timeout)
+                header = _recv_header(sock)
+            value = None
+            if header.get("meta_size") is not None:
+                sock.settimeout(timeout)
+                meta = _recv_exact(sock, header["meta_size"])
+                buffers = [
+                    _recv_into_buffer(sock, size)
+                    for size in header["buffer_sizes"]
+                ]
+                self.stats.add(
+                    "bytes_received", header["meta_size"] + sum(header["buffer_sizes"])
+                )
+                value = from_frames(meta, buffers)
+            if delivered:
+                # receipt ack: the agent holds the completion until the owner
+                # confirms — an unconfirmed reply re-routes over the control
+                # channel, so a silently dead socket can't strand the result
+                _send_header(sock, {"ok": True})
+            sock.settimeout(None)
+        except (OSError, EOFError, pickle.UnpicklingError) as exc:
+            self._discard(sock)
+            if delivered:
+                raise PushDeliveredError(
+                    f"push_task to {addr} died after delivery: {exc}"
+                ) from exc
+            raise DataPlaneError(f"push_task to {addr} failed: {exc}") from exc
+        else:
+            self._checkin(addr, sock)
+        self.stats.add("pushes_sent")
+        _observe_latency("push_task", t_start)
+        return header, value
 
     def push(self, addr: str, oid: bytes, value: Any, is_error: bool = False) -> None:
         t_start = time.perf_counter()

@@ -36,10 +36,13 @@ from ray_tpu.exceptions import (
     RayTaskError,
     WorkerCrashedError,
 )
-from ray_tpu.observability import tracing
+from ray_tpu.observability import metric_defs, tracing
 from ray_tpu.runtime import failpoints, protocol
 from ray_tpu.runtime.scheduler import LocalScheduler, TaskSpec
 from ray_tpu.runtime.worker_pool import ProcessWorkerPool, WorkerHandle
+
+# prebuilt tag dict for the leased-dispatch hot path
+_INPROC_PUSH_TAGS = {"transport": "inproc"}
 
 
 class CachedThreadPool:
@@ -284,6 +287,19 @@ class Node:
             lambda: self.scheduler.submit_ready(spec),
         )
 
+    def submit_leased(self, spec: TaskSpec) -> None:
+        """Lease fast path: a repeat-shape, dependency-free task dispatched
+        straight into this node's local scheduler — the cached lease IS the
+        placement decision, so there is no cluster-level hop and no
+        dependency stage.  Raises ConnectionError on a dead node so the
+        caller revokes the lease and falls back to the scheduled path."""
+        if self.dead:
+            raise ConnectionError("leased node is dead")
+        spec.owner_node = self.node_id
+        spec._leased = True
+        metric_defs.DIRECT_PUSHES.inc(tags=_INPROC_PUSH_TAGS)
+        self.scheduler.submit_ready(spec)
+
     # ------------------------------------------------------------------
     # dispatch (deps local, resources held)
     # ------------------------------------------------------------------
@@ -526,6 +542,9 @@ class Node:
             spec.task_id.binary(), spec.name, fn_id, fn_blob, args_blob, on_result,
             runtime_env=spec.runtime_env,
             trace=spec.trace_ctx[:2] if spec.trace_ctx is not None else None,
+            # leased shapes pin a warm worker (keyed by function identity)
+            # so repeat dispatches hit a hot process without pool churn
+            lease_key=fn_id if spec._leased else None,
         )
 
     def _handle_worker_api(self, task_bin, blob: bytes, op: str = "", worker_key=None) -> bytes:
@@ -543,8 +562,20 @@ class Node:
         blocking = spec is not None and op in worker_api.BLOCKING_OPS
         if blocking:
             self.scheduler.release_blocked(spec)
+        # a put inside a PUSHED task mints a ref that travels back on the
+        # owner-routed DATA-plane reply — nothing orders that against this
+        # node's control frames, so its registration must be synchronous.
+        # In-proc specs aren't in _proc_specs; the agent fabric remembers
+        # them (head-side cluster has no lookup_spec — pushed stays False
+        # there, correctly: head-local results never leave the process).
+        if spec is None and task_bin:
+            lookup = getattr(self.cluster, "lookup_spec", None)
+            spec = lookup(task_bin) if lookup is not None else None
+        pushed = spec is not None and getattr(spec, "_push_reply", None) is not None
         try:
-            return self.cluster.handle_worker_api(blob, op=op, worker_key=worker_key)
+            return self.cluster.handle_worker_api(
+                blob, op=op, worker_key=worker_key, pushed=pushed
+            )
         finally:
             if blocking and task_bin in self._proc_specs:
                 # reacquire ONLY if the task is still in flight: its worker

@@ -31,8 +31,17 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID
 from ray_tpu.core.object_store import ObjectStore
 from ray_tpu.core.resources import ResourcePool, ResourceSet
+from ray_tpu.observability import metric_defs
 from ray_tpu.runtime import rpc
 from ray_tpu.runtime.scheduler import TaskSpec
+
+# prebuilt tag dict for the leased remote-push hot path
+_DATA_PLANE_PUSH_TAGS = {"transport": "data_plane"}
+
+#: concurrent leased pushes per remote node before new leased submissions
+#: overflow onto the control-plane path (each push holds a thread for the
+#: task's whole round trip — long tasks must not wedge the push pool)
+_MAX_PUSH_INFLIGHT = 16
 
 
 class MirrorPool(ResourcePool):
@@ -243,6 +252,9 @@ class _NullWorkerPool:
     def inflight_tasks(self):
         return []
 
+    def unpin_lease(self, lease_key: bytes) -> None:
+        pass  # the agent's own pool sweeps its lease pins (stale-pin path)
+
 
 class RemoteNodeHandle:
     """Node-surface proxy for an agent process (see module docstring)."""
@@ -267,6 +279,13 @@ class RemoteNodeHandle:
         self._inflight: Dict[bytes, TaskSpec] = {}   # task_id -> head-side spec
         self._inflight_lock = threading.Lock()
         self._sent_fns: set = set()
+        # function blobs shipped over the PUSH channel — separate from
+        # _sent_fns: control and data frames have no cross-channel ordering,
+        # so a blob "already sent" on one channel may not have landed when
+        # the other channel's frame arrives
+        self._pushed_fns: set = set()
+        self.push_pool = None  # dedicated leased-push executor (HeadService)
+        self.push_gate = None  # shared in-flight cap (one per HeadService)
         self.last_report = time.monotonic()
 
     def push_value_async(self, oid: ObjectID, value, is_error: bool) -> None:
@@ -331,6 +350,129 @@ class RemoteNodeHandle:
         except rpc.RpcError:
             self._untrack(spec.task_id.binary())
             raise
+
+    def submit_leased(self, spec: TaskSpec) -> None:
+        """Leased direct dispatch to this agent: the encoded spec (inline
+        args included) rides a peer-to-peer ``push_task`` frame on the data
+        plane, and the result frames come back on the same connection to
+        the OWNER — the head control channel sees neither the dispatch nor
+        the completion.  Falls back to the control-plane submit when the
+        data plane is absent or the push pool is saturated (long tasks)."""
+        if self.dead:
+            raise ConnectionError("leased node is dead")
+        pool = self.push_pool
+        gate = self.push_gate
+        if (
+            pool is None or gate is None
+            or self.data_address is None or self.data_client is None
+        ):
+            self.submit(spec)
+            return
+        # The gate is SHARED across all handles (it mirrors the push pool's
+        # thread count): counting per-handle would accept N_handles x cap
+        # pushes that then queue unsent inside the executor behind long
+        # tasks instead of overflowing to the control path.
+        if not gate.acquire(blocking=False):
+            self.submit(spec)
+            return
+        spec.owner_node = self.node_id
+        self._track(spec)
+        metric_defs.DIRECT_PUSHES.inc(tags=_DATA_PLANE_PUSH_TAGS)
+        pool.submit(self._push_task_run, spec)
+
+    def _push_task_run(self, spec: TaskSpec) -> None:
+        import pickle
+
+        from ray_tpu.runtime import data_plane
+
+        try:
+            try:
+                blob = pickle.dumps(
+                    rpc.encode_spec(spec, self._function_blob, self._pushed_fns),
+                    protocol=5,
+                )
+                header, value = self.data_client.push_task(self.data_address, blob)
+                if header.get("need_fn"):
+                    # cross-channel race: the agent's fn cache is cold —
+                    # resend with the blob inline
+                    blob = pickle.dumps(
+                        rpc.encode_spec(spec, self._function_blob, set()),
+                        protocol=5,
+                    )
+                    header, value = self.data_client.push_task(self.data_address, blob)
+                if not header.get("ok"):
+                    if header.get("task_error"):
+                        # the agent could not decode/dispatch the spec (e.g.
+                        # unpicklable user args): a control resubmit would
+                        # fail identically — fail the task instead
+                        if self._untrack(spec.task_id.binary()) is not None:
+                            self.cluster.on_task_finished(
+                                self, spec, None,
+                                RuntimeError(header.get("error") or "push_task failed"),
+                            )
+                        return
+                    raise data_plane.DataPlaneError(
+                        header.get("error") or "push_task rejected"
+                    )
+            except data_plane.PushDeliveredError:
+                # the agent ACKed delivery before the socket died: the task
+                # may be executing there — a control resubmit would double-
+                # execute it.  The spec stays tracked: the agent re-routes
+                # its completion over the control channel when the data
+                # reply goes unconfirmed, and node death hands the spec to
+                # the kill sweep.
+                return
+            except data_plane.DataPlaneError:
+                # data plane can't serve (agent mid-restart, transient
+                # socket death BEFORE the spec was accepted): the control-
+                # plane submit path still can.  The spec stays tracked — the
+                # completion comes back as a normal task_finished message.
+                # (If the delivery ack was sent but lost, the agent's
+                # pushed_duplicate guard drops this resubmit.)
+                if self.dead:
+                    return  # node death sweep owns the pending spec
+                try:
+                    self._send("submit_task", {"spec": self._encode(spec)})
+                except rpc.RpcError:
+                    pass  # connection gone: kill_node's sweep resubmits
+                return
+            self._on_push_reply(spec, header, value)
+        finally:
+            self.push_gate.release()
+
+    def _on_push_reply(self, spec: TaskSpec, header: dict, value) -> None:
+        """Owner-side completion of a pushed task — the mirror of
+        on_task_finished_msg, fed by data-plane frames instead of a head
+        control RPC."""
+        spans = header.get("spans")
+        if spans:
+            from ray_tpu.observability import tracing
+
+            tracing.record_span_events(spans)
+        if self._untrack(spec.task_id.binary()) is None:
+            return  # already resolved (node-death resubmission raced)
+        if header.get("error") is not None:
+            error, _ = rpc.decode_value(header["error"])
+            self.cluster.on_task_finished(self, spec, None, error)
+            return
+        if header.get("lazy"):
+            device_returns = list(header.get("device_returns", ()))
+            sizes = list(header.get("return_sizes", ()))
+            for i, oid in enumerate(spec.return_ids):
+                on_device = bool(device_returns[i]) if i < len(device_returns) else False
+                if on_device:
+                    self.cluster.directory.mark_device(oid)
+                if i < len(sizes) and sizes[i]:
+                    self.cluster.directory.record_meta(
+                        oid, sizes[i], "device" if on_device else "host"
+                    )
+            self.cluster.on_task_finished(self, spec, None, None, lazy=True)
+            return
+        # the agent stored the returns locally before replying: mark them
+        # so the owner-side cache put doesn't echo the bytes back
+        for oid in spec.return_ids:
+            self.store.skip_push_once(oid)
+        self.cluster.on_task_finished(self, spec, value, None)
 
     def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int = 1) -> None:
         self._track(spec)
@@ -574,6 +716,14 @@ class HeadService:
             max_workers=max(1, cfg.max_concurrent_object_transfers),
             thread_name_prefix="head-transfer",
         )
+        # Leased direct dispatch gets its OWN executor: a push holds its
+        # thread for the task's full round trip, and a slow leased task
+        # must never starve object pushes/pulls out of the transfer pool.
+        self._push_pool = ThreadPoolExecutor(
+            max_workers=_MAX_PUSH_INFLIGHT, thread_name_prefix="head-push-task"
+        )
+        # one in-flight cap for the whole pool, shared by every handle
+        self._push_gate = threading.BoundedSemaphore(_MAX_PUSH_INFLIGHT)
         self._stop = threading.Event()
         # Active failure detector (GcsHealthCheckManager parity,
         # gcs_health_check_manager.h:39,97): socket death catches clean
@@ -596,6 +746,7 @@ class HeadService:
         self.data_server.close()
         self.data_client.close()
         self._transfer_pool.shutdown(wait=False)
+        self._push_pool.shutdown(wait=False)
 
     # -- data-plane store resolvers ------------------------------------
     def _head_get_frames(self, oid_bytes: bytes, timeout: float):
@@ -678,6 +829,7 @@ class HeadService:
             "pull_object": self._h_pull_object,
             "locate_object": self._h_locate_object,
             "object_location": self._h_object_location,
+            "object_locations": self._h_object_locations,
             "pull_failed": self._h_pull_failed,
             "mint_put_oid": self._h_mint_put_oid,
             "release_put_oid": self._h_release_put_oid,
@@ -714,6 +866,8 @@ class HeadService:
             data_client=self.data_client,
             transfer_pool=self._transfer_pool,
         )
+        handle.push_pool = self._push_pool
+        handle.push_gate = self._push_gate
         conn.peer = handle
         self.cluster.register_remote_node(handle)
         if payload.get("rejoin"):
@@ -786,14 +940,22 @@ class HeadService:
         handle: RemoteNodeHandle = conn.peer
         if handle is None or handle.dead:
             return
-        oid = ObjectID(payload["oid"])
-        if payload.get("device"):
-            self.cluster.directory.mark_device(oid)
-        self.cluster.directory.add_location(
-            oid, handle.node_id,
-            size=payload.get("size"),
-            tier="device" if payload.get("device") else "host",
+        self.cluster.directory.commit_placement(
+            ObjectID(payload["oid"]), handle.node_id,
+            payload.get("size"), bool(payload.get("device")),
         )
+
+    def _h_object_locations(self, conn: rpc.RpcConnection, payload: dict) -> None:
+        """Coalesced location commits: one control frame carrying a BATCH
+        of per-put notices — the head pays O(batches), not O(puts), for a
+        client's put stream (ISSUE 7 satellite)."""
+        handle: RemoteNodeHandle = conn.peer
+        if handle is None or handle.dead:
+            return
+        for oid_bin, size, device in payload["locs"]:
+            self.cluster.directory.commit_placement(
+                ObjectID(oid_bin), handle.node_id, size, bool(device)
+            )
 
     def _h_plan_broken(self, conn: rpc.RpcConnection, payload: dict) -> None:
         """An agent's stage loop could not even forward its error downstream

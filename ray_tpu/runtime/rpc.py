@@ -44,7 +44,7 @@ from ray_tpu.runtime.protocol import FrameReader, send_msg as _send_msg
 #: shapes (the reference versions its protobuf schemas; pickle frames
 #: assume same-version-everywhere, so the version is checked EXPLICITLY at
 #: node registration instead of silently corrupting).
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 
 #: Sentinel a handler returns to take ownership of replying later.
 DEFER = object()
@@ -56,6 +56,11 @@ class RpcError(ConnectionError):
 
 class RemoteHandlerError(RpcError):
     """The peer's handler raised; carries the remote traceback."""
+
+
+class FunctionNotCached(KeyError):
+    """decode_spec: the spec's fn_id is absent from this agent's fn cache
+    (the blob rode another channel whose frame hasn't landed yet)."""
 
 
 class ProtocolMismatchError(RpcError):
@@ -429,7 +434,12 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
         blob = d.get("fn_blob")
         if blob is not None and fn_id not in fn_cache:
             fn_cache[fn_id] = pickle.loads(blob)
-        func = fn_cache[fn_id]
+        try:
+            func = fn_cache[fn_id]
+        except KeyError:
+            # distinct from a KeyError raised by user args unpickling below:
+            # only THIS miss means "resend with the blob inline"
+            raise FunctionNotCached(fn_id) from None
     args, kwargs = pickle.loads(d["args_blob"])
     spec = TaskSpec(
         task_id=TaskID(d["task_id"]),

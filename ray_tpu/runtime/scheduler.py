@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -70,6 +71,7 @@ class TaskSpec:
         "runtime_env", "owner_node", "is_actor_creation", "actor_method",
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
         "_oom_killed", "_stream_closed", "_actor_seq", "trace_ctx",
+        "_leased", "_push_reply",
     )
 
     def __init__(
@@ -123,6 +125,13 @@ class TaskSpec:
         # propagated trace context (trace_id, task_span_id, parent_span_id)
         # stamped at submit time when tracing is enabled (tracing.py)
         self.trace_ctx = None
+        # dispatched through a cached worker lease (direct dispatch): the
+        # hosting node may pin a process worker to the task's shape
+        self._leased = False
+        # agent-side: (box, event) of a peer-pushed task — the completion
+        # frames go back on the data-plane connection to the OWNER instead
+        # of the head control channel (owner-routed results)
+        self._push_reply = None
 
 
 # --------------------------------------------------------------------------
@@ -151,6 +160,12 @@ class ClusterScheduler:
         # object directory for the locality stage (bound by the cluster
         # fabric; None = locality disabled, e.g. bare unit tests)
         self._directory = None
+        # head scheduling decisions made (every pick_node call).  THE
+        # O(tasks)-vs-O(lease churn) witness: a steady-state repeat-shape
+        # workload must grow this by the number of lease grants, not the
+        # number of tasks.  Racy += under the GIL only ever UNDER-counts,
+        # which keeps upper-bound assertions sound.
+        self.num_picks = 0
 
     def bind_directory(self, directory) -> None:
         """Wire the object directory so pick_node can score candidate nodes
@@ -203,6 +218,7 @@ class ClusterScheduler:
 
     def pick_node(self, spec: TaskSpec) -> Optional[NodeID]:
         """Returns the chosen node, or None if currently infeasible."""
+        self.num_picks += 1
         cfg = get_config()
         strategy = spec.scheduling_strategy
         with self._lock:
@@ -348,6 +364,310 @@ class ClusterScheduler:
         if not eventually:
             return None
         return min(eventually, key=lambda kv: (self._queued(kv[0]), kv[1].utilization(), random.random()))[0]
+
+
+# --------------------------------------------------------------------------
+# Worker leases (cached dispatch routes; reference parity:
+# CoreWorkerDirectTaskSubmitter's lease cache — RequestWorkerLease reuse per
+# SchedulingKey, direct_task_transport.cc:409 — with raylet spillback)
+# --------------------------------------------------------------------------
+class WorkerLease:
+    """One cached dispatch route: scheduling key -> node.
+
+    Holding the lease means repeat-shape tasks go STRAIGHT to this node's
+    local scheduler (peer-to-peer for remote nodes) — the head's per-task
+    work collapses to lease churn.  ``func``/``resources`` pin the key's
+    referents so the id()-based key cannot be recycled while the lease
+    lives."""
+
+    __slots__ = (
+        "key", "node_id", "func", "resources",
+        "granted_at", "last_used", "uses", "last_spill_check",
+    )
+
+    def __init__(self, key, node_id, func, resources):
+        now = time.monotonic()
+        self.key = key
+        self.node_id = node_id
+        self.func = func
+        self.resources = resources
+        self.granted_at = now
+        self.last_used = now
+        self.uses = 0
+        self.last_spill_check = 0.0
+
+
+# prebuilt tag dicts for the per-task hot path
+_GRANT_MISS = {"reason": "miss"}
+_GRANT_SPILLBACK = {"reason": "spillback"}
+
+
+class LeaseManager:
+    """Grant/reuse/return of worker leases, keyed by task shape.
+
+    A scheduling key is ``(function identity, resource-demand identity,
+    execution tier)`` — the same shape the reference's SchedulingKey
+    captures.  The FIRST task of a shape pays one head scheduling decision
+    (``ClusterScheduler.pick_node``) and caches the chosen node as a lease;
+    every repeat-shape task reuses it with zero head-side work.  Leases
+    return on idle expiry, revoke on node death/DRAINING, and spill back to
+    a fresh grant when the leased node's local queue saturates while an
+    alternative exists (raylet spillback parity).
+
+    Only dependency-free, strategy-free, non-streaming normal tasks are
+    lease-eligible (the caller checks) — dep-bearing tasks keep the
+    locality stage, strategies keep their policies."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._by_key: Dict[tuple, List[WorkerLease]] = {}
+        self._rr: Dict[tuple, int] = {}
+        self._next_sweep = 0.0
+        # periodic expiry driver (lazily started on first grant): route()
+        # also sweeps, but once lease-eligible submissions stop, nothing
+        # else would ever expire the last leases or return their pinned
+        # workers to the idle pool
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: Optional[threading.Thread] = None
+        # lifetime stats (snapshot + /api/leases; racy ints are fine)
+        self.grants = 0
+        self.reuse_hits = 0
+        self.spillbacks = 0
+        self.expired = 0
+        self.revoked = 0
+
+    def stop(self) -> None:
+        self._sweep_stop.set()
+
+    def _ensure_sweeper(self) -> None:
+        # called under self._lock
+        if self._sweep_thread is None and not self._sweep_stop.is_set():
+            self._sweep_thread = threading.Thread(
+                target=self._sweep_loop, name="lease-sweep", daemon=True
+            )
+            self._sweep_thread.start()
+
+    def _sweep_loop(self) -> None:
+        while True:
+            try:
+                interval = max(0.5, get_config().lease_idle_timeout_s / 2.0)
+            except Exception:  # noqa: BLE001 — config torn down at exit
+                return
+            if self._sweep_stop.wait(interval):
+                return
+            try:
+                self._sweep(time.monotonic(), get_config())
+                for node in list(self._cluster.nodes.values()):
+                    # head-local pools never see the remote agents' report-
+                    # cadence pin sweep; stubs without the hook are skipped
+                    sweep = getattr(getattr(node, "worker_pool", None),
+                                    "sweep_stale_pins", None)
+                    if sweep is not None and not node.dead:
+                        sweep()
+            except Exception:  # noqa: BLE001 — sweeping must not die mid-teardown
+                pass
+
+    @staticmethod
+    def key_for(spec: TaskSpec) -> tuple:
+        # id()-keyed on purpose: O(1) on the submit hot path. The lease
+        # entry pins func/resources so neither id can be recycled while
+        # cached (same pinning discipline as Node._fn_profile).
+        return (id(spec.func), id(spec.resources), spec.execution)
+
+    # ------------------------------------------------------------------
+    def route(self, spec: TaskSpec):
+        """The node to dispatch ``spec`` on — a cached lease (no scheduling
+        decision) or a fresh grant (exactly one ``pick_node``).  None means
+        currently infeasible: the caller takes the scheduled path, which
+        parks the task on the demand queue."""
+        cfg = get_config()
+        if cfg.lease_idle_timeout_s <= 0:
+            return None
+        key = self.key_for(spec)
+        now = time.monotonic()
+        if now >= self._next_sweep:
+            self._sweep(now, cfg)
+        leases = self._by_key.get(key)
+        if leases:
+            i = self._rr.get(key, 0)
+            self._rr[key] = i + 1
+            try:
+                lease = leases[i % len(leases)]
+            except (IndexError, ZeroDivisionError):
+                lease = None  # raced a revoke; re-grant below
+            if lease is not None:
+                node = self._cluster.nodes.get(lease.node_id)
+                if node is None or node.dead:
+                    self._drop(key, lease, count_revoked=True)
+                elif now - lease.last_used > cfg.lease_idle_timeout_s:
+                    self._drop(key, lease, count_expired=True)
+                elif self._saturated(node, lease, now, cfg):
+                    self.spillbacks += 1
+                    granted = self._grant(spec, key, _GRANT_SPILLBACK, cfg)
+                    # nothing strictly better: keep the lease, queue here
+                    return granted if granted is not None else node
+                else:
+                    lease.last_used = now
+                    lease.uses += 1
+                    self.reuse_hits += 1
+                    metric_defs.LEASE_REUSE_HITS.inc()
+                    metric_defs.HEAD_RPCS_AVOIDED.inc()
+                    return node
+        return self._grant(spec, key, _GRANT_MISS, cfg)
+
+    # ------------------------------------------------------------------
+    def _saturated(self, node, lease: WorkerLease, now: float, cfg) -> bool:
+        depth = cfg.lease_spillback_queue_depth
+        if depth <= 0:
+            return False
+        try:
+            if node.scheduler.queue_len() < depth:
+                return False
+        except Exception:  # noqa: BLE001 — remote view mid-teardown
+            return False
+        # bounded re-evaluation: while saturated, re-run the (O(nodes))
+        # alternative check at most every 50ms, not per pushed task
+        if now - lease.last_spill_check < 0.05:
+            return False
+        lease.last_spill_check = now
+        # snapshot: a node registering concurrently must not blow up the
+        # submit path with "dict changed size during iteration"
+        alive = sum(1 for n in list(self._cluster.nodes.values()) if not n.dead)
+        return alive > 1
+
+    def _grant(self, spec: TaskSpec, key: tuple, reason_tags: dict, cfg):
+        node_id = self._cluster.cluster_scheduler.pick_node(spec)
+        if node_id is None:
+            return None
+        node = self._cluster.nodes.get(node_id)
+        if node is None or node.dead:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            leases = self._by_key.setdefault(key, [])
+            for lease in leases:
+                if lease.node_id == node_id:
+                    # the decision landed on an already-leased node (single
+                    # node, or spillback found nothing better): refresh it
+                    lease.last_used = now
+                    return node
+            lease = WorkerLease(key, node_id, spec.func, spec.resources)
+            while len(leases) >= max(1, cfg.max_leases_per_key):
+                stale = min(leases, key=lambda l: l.last_used)
+                leases.remove(stale)
+                self._return_worker(stale)
+            leases.append(lease)
+            self.grants += 1
+            self._ensure_sweeper()
+        metric_defs.LEASE_GRANTS.inc(tags=reason_tags)
+        return node
+
+    # ------------------------------------------------------------------
+    def _drop(self, key: tuple, lease: WorkerLease,
+              count_expired: bool = False, count_revoked: bool = False) -> None:
+        with self._lock:
+            leases = self._by_key.get(key)
+            if leases is None:
+                return
+            try:
+                leases.remove(lease)
+            except ValueError:
+                return  # a concurrent drop won
+            if not leases:
+                self._by_key.pop(key, None)
+                self._rr.pop(key, None)
+        if count_expired:
+            self.expired += 1
+        if count_revoked:
+            self.revoked += 1
+        self._return_worker(lease)
+
+    def _return_worker(self, lease: WorkerLease) -> None:
+        """Return the lease's pinned worker (if the shape ever dispatched
+        to a process worker) to the pool's idle set so normal reaping
+        applies — a returned lease must never strand a warm process."""
+        node = self._cluster.nodes.get(lease.node_id)
+        if node is None:
+            return
+        blob = getattr(lease.func, "_rt_fn_blob", None)
+        if blob is None:
+            return
+        pool = getattr(node, "worker_pool", None)
+        if pool is None:
+            return
+        try:
+            pool.unpin_lease(blob[0])
+        except Exception:  # noqa: BLE001 — pool torn down with the node
+            pass
+
+    def _sweep(self, now: float, cfg) -> None:
+        """Expire every idle lease, not just the ones a route touches."""
+        self._next_sweep = now + max(0.5, cfg.lease_idle_timeout_s / 2.0)
+        with self._lock:
+            stale = [
+                (key, lease)
+                for key, leases in self._by_key.items()
+                for lease in leases
+                if now - lease.last_used > cfg.lease_idle_timeout_s
+            ]
+        for key, lease in stale:
+            self._drop(key, lease, count_expired=True)
+
+    # ------------------------------------------------------------------
+    def revoke_node(self, node_id) -> int:
+        """Drop every lease routed at ``node_id`` (node death, DRAINING):
+        the next repeat-shape task re-grants on a survivor.  Returns the
+        number revoked."""
+        dropped = []
+        with self._lock:
+            for key, leases in list(self._by_key.items()):
+                for lease in list(leases):
+                    if lease.node_id == node_id:
+                        leases.remove(lease)
+                        dropped.append(lease)
+                if not leases:
+                    self._by_key.pop(key, None)
+                    self._rr.pop(key, None)
+            self.revoked += len(dropped)
+        for lease in dropped:
+            self._return_worker(lease)
+        return len(dropped)
+
+    def leases_on(self, node_id) -> int:
+        with self._lock:
+            return sum(
+                1
+                for leases in self._by_key.values()
+                for lease in leases
+                if lease.node_id == node_id
+            )
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            entries = [
+                {
+                    "function": getattr(lease.func, "__name__", None)
+                    or getattr(lease.func, "_rt_name", "?"),
+                    "execution": lease.key[2],
+                    "resources": lease.resources.to_dict(),
+                    "node": lease.node_id.hex()[:8],
+                    "uses": lease.uses,
+                    "age_s": round(now - lease.granted_at, 3),
+                    "idle_s": round(now - lease.last_used, 3),
+                }
+                for leases in self._by_key.values()
+                for lease in leases
+            ]
+        return {
+            "active": entries,
+            "grants": self.grants,
+            "reuse_hits": self.reuse_hits,
+            "spillbacks": self.spillbacks,
+            "expired": self.expired,
+            "revoked": self.revoked,
+        }
 
 
 # --------------------------------------------------------------------------

@@ -208,8 +208,8 @@ def _execute_async_put(core_worker, op: str, kw: dict, worker_key) -> None:
 
     ``put_async`` carries the value (the bytes land in the owner's store);
     ``register_put_async`` is the agent-relayed variant where the bytes
-    stayed in the agent's store and only ownership + the worker pin are
-    recorded here (the agent's object_location notice carries placement).
+    stayed in the agent's store; ownership, the worker pin, AND the
+    placement (size/device piggybacked on the notice) are recorded here.
     Identical oids from a retried attempt overwrite idempotently — the
     reference's put-id convention."""
     from ray_tpu import api
@@ -219,11 +219,21 @@ def _execute_async_put(core_worker, op: str, kw: dict, worker_key) -> None:
     oid = ObjectID(kw["oid"])
     core_worker.ref_counter.add_owned_object(oid)
     ref = ObjectRef(oid)
+    cluster = api.get_cluster()
     if op == "put_async":
-        cluster = api.get_cluster()
         node = cluster.head_node
         node.store.put(oid, kw["value"])
         cluster.commit_location(node, oid)
+    else:
+        # register_put_async: the bytes stayed in the agent's store and
+        # placement rode inside this notice — commit it here so the
+        # location can never trail the ownership record (the worker_key's
+        # first element is the relaying agent's node id)
+        node_id = worker_key[0] if isinstance(worker_key, tuple) else None
+        if node_id is not None:
+            cluster.directory.commit_placement(
+                oid, node_id, kw.get("size"), bool(kw.get("device"))
+            )
     _pin_captured(core_worker, worker_key, [ref])
 
 

@@ -42,6 +42,8 @@ class WorkerHandle:
         self.pid = pid
         self.known_fns: set = set()
         self.dedicated = False      # owned by an actor
+        self.lease_key = None       # pinned to a worker lease's task shape
+        self.lease_busy = False     # leased worker currently executing
         self.alive = True
         # set once the death handler has finished notifying (actor FSM
         # updated); orphaned-callback paths sequence behind it
@@ -103,6 +105,12 @@ class ProcessWorkerPool:
         self._inflight: Dict[bytes, Callable[[Any, Optional[BaseException]], None]] = {}
         self._inflight_worker: Dict[bytes, WorkerHandle] = {}
         self._inflight_start: Dict[bytes, float] = {}
+        # worker-lease pins: lease key (fn id) -> warm worker reserved for
+        # that task shape.  Pinned workers skip the idle-deque churn on the
+        # leased dispatch path, never reap while the lease is live, and
+        # return to the pool on lease expiry/revocation (unpin_lease) or
+        # after sitting idle past the lease timeout (stale-pin sweep).
+        self._lease_pins: Dict[bytes, WorkerHandle] = {}
         self._direct: Dict[bytes, _DirectSlot] = {}   # sync waiters by task id
         self._stack_waiters: Dict[str, dict] = {}     # dump_stacks tokens
         self._on_worker_death: Optional[Callable[[WorkerHandle], None]] = None
@@ -333,7 +341,15 @@ class ProcessWorkerPool:
         with self._lock:
             if worker.alive and not worker.dedicated:
                 if self._backlog:
+                    # pinned or not, an idle process serves waiting work —
+                    # a lease reserves warmth, never capacity
                     backlog_item = self._backlog.popleft()
+                elif worker.lease_key is not None:
+                    # stays pinned to its lease: not reapable, instantly
+                    # reusable by the next leased dispatch of the shape
+                    worker.lease_busy = False
+                    worker.last_idle_time = time.monotonic()
+                    self._unpin_stale_locked()
                 else:
                     worker.last_idle_time = time.monotonic()
                     self._idle.append(worker)
@@ -347,6 +363,81 @@ class ProcessWorkerPool:
             w = self._idle.popleft()
             self._kill_worker(w)
 
+    # -- worker-lease pins ----------------------------------------------
+    def _take_lease_worker(self, lease_key: bytes) -> Optional[WorkerHandle]:
+        """The pinned worker for this shape if it is free — pinning one
+        from the idle set on first use.  None falls back to the normal
+        acquire/backlog path (pinned-but-busy, or nothing idle to pin)."""
+        with self._lock:
+            worker = self._lease_pins.get(lease_key)
+            if worker is not None:
+                if not worker.alive:
+                    del self._lease_pins[lease_key]
+                elif not worker.lease_busy:
+                    worker.lease_busy = True
+                    return worker
+                return None  # busy: overflow onto the shared pool
+            while self._idle:
+                cand = self._idle.pop()
+                if cand.alive:
+                    cand.lease_key = lease_key
+                    cand.lease_busy = True
+                    self._lease_pins[lease_key] = cand
+                    return cand
+        return None
+
+    def _steal_free_pin_locked(self) -> Optional[WorkerHandle]:
+        """Unpin and return any free lease-pinned worker.  A pin reserves
+        WARMTH, never capacity: when the shared pool is exhausted and work
+        would otherwise backlog behind idle-but-pinned processes (the
+        many-shapes deadlock — every worker pinned, none ever completing
+        anything again), the pin loses."""
+        for key, worker in list(self._lease_pins.items()):
+            if worker.alive and not worker.lease_busy:
+                del self._lease_pins[key]
+                worker.lease_key = None
+                return worker
+        return None
+
+    def unpin_lease(self, lease_key: bytes) -> None:
+        """Lease returned/revoked: the pinned worker rejoins the idle set
+        (normal idle reaping applies again)."""
+        with self._lock:
+            worker = self._lease_pins.pop(lease_key, None)
+            if worker is None or not worker.alive:
+                return
+            worker.lease_key = None
+            if not worker.lease_busy:
+                worker.last_idle_time = time.monotonic()
+                self._idle.append(worker)
+                self._maybe_reap_locked()
+            # busy: _release_worker routes it to the idle set on completion
+        self._update_worker_gauges()
+
+    def sweep_stale_pins(self) -> None:
+        """Periodic entry point (agent report loop): on remote agents the
+        head's lease expiry only reaches a no-op pool stub, and the
+        release-time sweep can't see its OWN pin as stale — without this a
+        pinned worker whose shape went quiet stays out of the idle set
+        (and out of reaping) forever."""
+        with self._lock:
+            self._unpin_stale_locked()
+        self._update_worker_gauges()
+
+    def _unpin_stale_locked(self) -> None:
+        """Agent-side safety net (no head LeaseManager runs here): pins
+        whose worker sat idle past the lease timeout return to the pool."""
+        if not self._lease_pins:
+            return
+        cutoff = time.monotonic() - get_config().lease_idle_timeout_s
+        for key, worker in list(self._lease_pins.items()):
+            if not worker.lease_busy and worker.last_idle_time < cutoff:
+                del self._lease_pins[key]
+                worker.lease_key = None
+                if worker.alive:
+                    self._idle.append(worker)
+        self._maybe_reap_locked()
+
     # ------------------------------------------------------------------
     def submit(
         self,
@@ -358,11 +449,21 @@ class ProcessWorkerPool:
         callback: Callable[[Any, Optional[BaseException]], None],
         runtime_env: Optional[dict] = None,
         trace: Optional[tuple] = None,
+        lease_key: Optional[bytes] = None,
     ) -> bool:
         """Run a stateless task on an idle worker; queues when saturated.
         Never blocks: pool growth happens on a spawner thread."""
         metric_defs.WORKER_POOL_TASKS.inc()
-        worker = self._acquire_idle()
+        worker = None
+        if lease_key is not None:
+            worker = self._take_lease_worker(lease_key)
+        if worker is None:
+            worker = self._acquire_idle()
+        if worker is None:
+            # nothing idle: a FREE pinned worker serves rather than letting
+            # this task backlog behind processes that may never run again
+            with self._lock:
+                worker = self._steal_free_pin_locked()
         if worker is None:
             with self._lock:
                 self._backlog.append(
@@ -724,6 +825,10 @@ class ProcessWorkerPool:
                 self._idle.remove(worker)
             except ValueError:
                 pass
+            if worker.lease_key is not None:
+                if self._lease_pins.get(worker.lease_key) is worker:
+                    del self._lease_pins[worker.lease_key]
+                worker.lease_key = None
             for task_id, w in list(self._inflight_worker.items()):
                 if w is worker:
                     dead_tasks.append(

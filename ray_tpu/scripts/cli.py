@@ -376,6 +376,48 @@ def cmd_pulls(args) -> int:
     return 0
 
 
+def cmd_leases(args) -> int:
+    """``rt leases``: worker-lease snapshot — per-shape cached dispatch
+    routes, grant/reuse/spillback lifetime churn, the direct-push transport
+    split, and actor direct-route totals."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/leases")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    leases = data.get("leases", {})
+    head = data.get("head", {})
+    pushes = data.get("pushes", {})
+    actors = data.get("actor_routes", {})
+    active = leases.get("active", [])
+    print(
+        f"leases: {len(active)} active; lifetime {leases.get('grants', 0)} grants, "
+        f"{leases.get('reuse_hits', 0)} reuse hits, "
+        f"{leases.get('spillbacks', 0)} spillbacks, "
+        f"{leases.get('expired', 0)} expired, {leases.get('revoked', 0)} revoked"
+    )
+    for lease in active:
+        res = " ".join(f"{k}={v:g}" for k, v in sorted(lease.get("resources", {}).items()))
+        print(
+            f"  {lease['function']}() [{lease['execution']}] -> node {lease['node']}  "
+            f"{lease['uses']} uses, idle {lease['idle_s']:.1f}s  ({res})"
+        )
+    print(
+        f"direct pushes: {pushes.get('inproc', 0):.0f} inproc, "
+        f"{pushes.get('data_plane', 0):.0f} data-plane, "
+        f"{pushes.get('actor_direct', 0):.0f} actor-direct"
+    )
+    print(
+        f"actor routes: {actors.get('active_routes', 0)} active, "
+        f"{actors.get('direct_submits', 0)} calls routed direct"
+    )
+    print(
+        f"head: {head.get('scheduling_decisions', 0)} scheduling decisions made, "
+        f"{head.get('rpcs_avoided', 0):.0f} per-task hops avoided"
+    )
+    return 0
+
+
 def cmd_plans(args) -> int:
     """``rt plans``: installed compiled execution plans — per-plan state,
     stage placement, iteration counts, plus the process-wide channel
@@ -585,6 +627,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_pulls)
+
+    sp = sub.add_parser(
+        "leases",
+        help="worker leases / direct dispatch: active per-shape leases, "
+        "grant/reuse/spillback churn, actor direct routes, head RPCs avoided",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_leases)
 
     sp = sub.add_parser(
         "plans",

@@ -304,6 +304,58 @@ def run_suite(
         for actor in actors:
             rt.kill(actor)
 
+    # ---- lease-based direct dispatch (ISSUE 7) ---------------------------
+    # The two regression rows' SHAPES re-measured in a fresh runtime with
+    # the lease path warm: N submitter threads flooding repeat-shape work
+    # that rides cached worker leases / actor direct routes after the
+    # single warmup grant — tracked head-to-head against the historical
+    # multi_client_tasks_async / n_n_actor_calls_async numbers.
+    if wanted("direct_dispatch_tasks_async"):
+        n_clients = 4
+        per_client = N(2000)
+
+        @rt.remote
+        def leased_noop():
+            return None
+
+        rt.get([leased_noop.remote() for _ in range(100)])  # grant + tier warm
+
+        def leased_client():
+            rt.get([leased_noop.remote() for _ in range(per_client)])
+
+        rates = []
+        for _ in range(3):
+            threads = [threading.Thread(target=leased_client) for _ in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rates.append(n_clients * per_client / (time.perf_counter() - t0))
+        record("direct_dispatch_tasks_async", sorted(rates)[1], "tasks/s")
+
+    if wanted("direct_dispatch_actor_calls_async"):
+        n = max(2, min(4, int(rt.cluster_resources().get("CPU", 2))))
+        actors = [A.remote() for _ in range(n)]
+        rt.get([a.m.remote() for a in actors])  # alive: routes granted
+        per = N(1000)
+
+        def route_caller(actor):
+            rt.get([actor.m.remote() for _ in range(per)])
+
+        rates = []
+        for _ in range(3):
+            threads = [threading.Thread(target=route_caller, args=(a,)) for a in actors]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rates.append(n * per / (time.perf_counter() - t0))
+        record("direct_dispatch_actor_calls_async", sorted(rates)[1], "calls/s")
+        for actor in actors:
+            rt.kill(actor)
+
     # ---- put/get call rates ---------------------------------------------
     if wanted("single_client_put_calls"):
         small = np.zeros(1024, dtype=np.uint8)

@@ -918,6 +918,76 @@ def test_schedule_full_elasticity_byte_identical_through_restart():
 
 
 # --------------------------------------------------------------------------
+# 11. leased-worker kill mid-workload (ISSUE 7): dispatch faults hit the
+#     LEASED direct-dispatch path (repeat-shape tasks riding one cached
+#     lease) and the lease-pinned process worker is SIGKILLed between
+#     bursts — retries flow through the normal FSM, the lease machinery
+#     re-pins, and the same-seed fault logs stay byte-identical.
+# --------------------------------------------------------------------------
+def test_schedule_leased_worker_kill_mid_push():
+    rt.init(
+        num_cpus=2,
+        _system_config={
+            "num_prestart_workers": 0,
+            # keep every "auto" task in process workers so the leased path
+            # exercises worker pinning (the kill target)
+            "inproc_task_threshold_s": 0.0,
+        },
+    )
+    try:
+        schedule = ChaosSchedule(
+            [ChaosEvent(0.0, "arm", spec="scheduler.dispatch=raise(0.12)")],
+            seed=61, name="leased-worker-kill",
+        )
+
+        def workload():
+            @rt.remote(max_retries=25)
+            def bump():
+                return 1
+
+            cluster = rt.get_cluster()
+            pool = cluster.head_node.worker_pool
+            # burst 1 rides the freshly-granted lease (dispatch faults
+            # land on leased submissions; the FSM retries them)
+            assert rt.get([bump.remote() for _ in range(15)], timeout=90) == [1] * 15
+            assert cluster.lease_manager.reuse_hits >= 10
+            # sequential calls land on an IDLE worker, forming the pin
+            # (the async burst above arrived before any worker existed)
+            for _ in range(3):
+                assert rt.get(bump.remote(), timeout=90) == 1
+            # kill the lease-pinned worker at a QUIESCENT point (nothing
+            # in flight -> the kill adds no nondeterministic retries, so
+            # both runs see the identical dispatch-hit sequence)
+            with pool._lock:
+                pinned = list(pool._lease_pins.values())
+            assert pinned, "leased shape never pinned a process worker"
+            for w in pinned:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            for w in pinned:
+                try:
+                    w.proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            # burst 2: the dead pin is detected, the pool re-pins/regrows,
+            # every task still completes through the lease path
+            refs = [bump.remote() for _ in range(15)]
+            assert rt.get(refs, timeout=90) == [1] * 15
+            return refs
+
+        r1 = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        r2 = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert r1.ok, (r1.workload_error, r1.invariants.violations)
+        assert r2.ok, (r2.workload_error, r2.invariants.violations)
+        assert any(f["fp"] == "scheduler.dispatch" for f in r1.faults)
+        assert r1.same_faults(r2), (r1.faults, r2.faults)
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
 # schedule JSON round trip + CLI-facing loader
 # --------------------------------------------------------------------------
 def test_schedule_json_round_trip(tmp_path):

@@ -135,6 +135,33 @@ def test_draining_node_excluded_from_placement(ray_start_cluster):
         cluster.cluster_scheduler.set_draining(node_b.node_id, False)
 
 
+def test_drain_revokes_worker_leases(ray_start_cluster):
+    """ISSUE 7 satellite: flipping a node DRAINING revokes its cached
+    worker leases — the drain never waits on an idle-but-leased worker,
+    and repeat-shape tasks re-grant on survivors."""
+    rt_mod, cluster = ray_start_cluster
+    node_b = cluster.add_node({"CPU": 2, "aux": 2})
+
+    @rt.remote(resources={"aux": 1}, num_cpus=0, execution="thread")
+    def on_aux():
+        return 1
+
+    assert rt.get([on_aux.remote() for _ in range(5)], timeout=30) == [1] * 5
+    lm = cluster.lease_manager
+    assert lm.leases_on(node_b.node_id) == 1
+    revoked_before = lm.revoked
+    report = cluster.drain_node(node_b.node_id)
+    assert report["outcome"] == "ok", report
+    assert lm.leases_on(node_b.node_id) == 0
+    assert lm.revoked > revoked_before
+    # a survivor with the resource picks the shape back up via a new grant
+    node_c = cluster.add_node({"CPU": 2, "aux": 2})
+    grants_before = lm.grants
+    assert rt.get(on_aux.remote(), timeout=30) == 1
+    assert lm.grants > grants_before
+    assert lm.leases_on(node_c.node_id) == 1
+
+
 def test_parked_demand_does_not_dispatch_to_draining_node(ray_start_cluster):
     """A demand-queue entry parked while its only feasible node is draining
     must wait for a NEW node, never dispatch onto the draining one."""

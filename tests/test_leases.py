@@ -1,0 +1,537 @@
+"""Worker leases + peer-to-peer direct dispatch (ISSUE 7).
+
+The lifecycle under test: the FIRST task of a scheduling key pays one head
+scheduling decision (grant); every repeat-shape task reuses the cached
+lease with ZERO head-side work (the O(tasks) -> O(lease churn) acceptance
+bar, asserted via ``ClusterScheduler.num_picks``); leases return on idle
+expiry, revoke on node death/DRAINING, spill back to a fresh grant when
+the leased node saturates while an alternative exists, and pin a warm
+process worker that rejoins the pool when the lease goes away.  Actor
+calls get the same treatment through cached direct routes.  Cross-process
+leases push tasks peer-to-peer on the data plane with owner-routed result
+frames (no per-task head control RPCs).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.observability import metric_defs
+
+
+def _wait_for(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# --------------------------------------------------------------------------
+# grant-once / reuse-many: the O(K) -> O(1) head-RPC collapse
+# --------------------------------------------------------------------------
+def test_repeat_shape_tasks_one_grant_zero_picks():
+    rt.init(num_cpus=2)
+    try:
+
+        @rt.remote
+        def noop():
+            return 1
+
+        # warm: the first submission grants the lease (and trials the fn
+        # in a process worker for the adaptive tier)
+        assert rt.get([noop.remote() for _ in range(20)], timeout=60) == [1] * 20
+        cluster = rt.get_cluster()
+        picks0 = cluster.cluster_scheduler.num_picks
+        grants0 = cluster.lease_manager.grants
+        hits0 = cluster.lease_manager.reuse_hits
+        assert rt.get([noop.remote() for _ in range(300)], timeout=120) == [1] * 300
+        # steady state: ZERO head scheduling decisions for 300 repeat tasks
+        assert cluster.cluster_scheduler.num_picks - picks0 == 0
+        assert cluster.lease_manager.grants == grants0
+        assert cluster.lease_manager.reuse_hits - hits0 >= 300
+        snap = cluster.lease_manager.snapshot()
+        assert snap["active"], snap
+        assert snap["active"][0]["function"] == "noop"
+    finally:
+        rt.shutdown()
+
+
+def test_multi_client_workload_o_n_head_rpcs():
+    """K repeat-shape tasks from N concurrent clients: the head's
+    scheduling work is bounded by lease churn (~O(N) at worst), never
+    O(K) — the ISSUE 7 acceptance assertion."""
+    rt.init(num_cpus=4)
+    try:
+
+        @rt.remote
+        def noop():
+            return None
+
+        rt.get([noop.remote() for _ in range(20)], timeout=60)  # grant + warm
+        cluster = rt.get_cluster()
+        picks0 = cluster.cluster_scheduler.num_picks
+        n_clients, per_client = 4, 250
+        errors = []
+
+        def client():
+            try:
+                rt.get([noop.remote() for _ in range(per_client)], timeout=120)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client) for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        picks = cluster.cluster_scheduler.num_picks - picks0
+        # single node: reuse hits cover everything — no spillback possible,
+        # so the bound is lease churn, not K=1000.  Allow slack for an idle
+        # expiry racing the run.
+        assert picks <= n_clients, f"{picks} head picks for {n_clients * per_client} tasks"
+        assert metric_defs.HEAD_RPCS_AVOIDED.get() > 0
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# idle expiry -> return -> re-grant
+# --------------------------------------------------------------------------
+def test_lease_idle_expiry_returns_and_regrants():
+    rt.init(num_cpus=2, _system_config={"lease_idle_timeout_s": 0.3})
+    try:
+
+        @rt.remote
+        def noop():
+            return None
+
+        rt.get([noop.remote() for _ in range(5)], timeout=60)
+        cluster = rt.get_cluster()
+        lm = cluster.lease_manager
+        assert lm.grants == 1
+        time.sleep(0.8)  # past lease_idle_timeout_s
+        rt.get(noop.remote(), timeout=60)
+        assert lm.expired >= 1
+        assert lm.grants == 2  # the post-expiry task re-granted
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# revocation on node death
+# --------------------------------------------------------------------------
+def test_lease_revoked_on_node_kill():
+    cluster = rt.init(num_cpus=2)
+    try:
+        aux = cluster.add_node({"CPU": 1, "aux": 1})
+
+        @rt.remote(resources={"aux": 1}, num_cpus=0, execution="thread")
+        def on_aux():
+            return 1
+
+        assert rt.get([on_aux.remote() for _ in range(5)], timeout=60) == [1] * 5
+        lm = cluster.lease_manager
+        assert lm.leases_on(aux.node_id) == 1
+        cluster.kill_node(aux.node_id)
+        assert lm.leases_on(aux.node_id) == 0
+        assert lm.snapshot()["revoked"] >= 1
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# spillback when the leased node saturates
+# --------------------------------------------------------------------------
+def test_lease_spillback_spreads_under_saturation():
+    cluster = rt.init(num_cpus=1)
+    try:
+        cluster.add_node({"CPU": 1})
+
+        @rt.remote(execution="thread")
+        def where():
+            time.sleep(0.15)  # hold the CPU so the local queue builds
+            return rt.get_runtime_context().get_node_id()
+
+        nodes_seen = set(rt.get([where.remote() for _ in range(10)], timeout=60))
+        assert len(nodes_seen) >= 2, nodes_seen  # spillback found the second node
+        assert cluster.lease_manager.spillbacks >= 1
+        assert metric_defs.LEASE_GRANTS.get(tags={"reason": "spillback"}) >= 1
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# worker pinning: a leased shape holds a warm process worker; revocation
+# returns it to the pool
+# --------------------------------------------------------------------------
+def test_leased_process_worker_pinned_then_returned():
+    # inproc_task_threshold_s=0 keeps every "auto" task in process workers,
+    # so the leased dispatches exercise the pin path
+    cluster = rt.init(num_cpus=2, _system_config={"inproc_task_threshold_s": 0.0})
+    try:
+
+        @rt.remote
+        def proc_task():
+            return os.getpid()
+
+        pids = rt.get([proc_task.remote() for _ in range(10)], timeout=60)
+        assert all(p != os.getpid() for p in pids)  # really ran out of process
+        pool = cluster.head_node.worker_pool
+        assert _wait_for(lambda: bool(pool._lease_pins), timeout=10)
+        cluster.lease_manager.revoke_node(cluster.head_node.node_id)
+        assert _wait_for(lambda: not pool._lease_pins, timeout=10)
+        # the returned worker is reusable — next submit re-grants and runs
+        assert rt.get(proc_task.remote(), timeout=60)
+    finally:
+        rt.shutdown()
+
+
+def test_many_shapes_never_deadlock_on_pinned_workers():
+    """Regression: with more leased shapes than pool workers, every worker
+    ends up pinned to SOME shape — a fresh shape's task must steal a free
+    pin instead of backlogging behind idle-but-pinned processes forever
+    (a pin reserves warmth, never capacity)."""
+    rt.init(num_cpus=2, _system_config={"inproc_task_threshold_s": 0.0})
+    try:
+        # 6 distinct shapes sequentially on a 2-worker pool: each grant
+        # pins, later shapes must still run
+        for i in range(6):
+
+            @rt.remote
+            def shape(i=i):
+                return i
+
+            shape._rt_name = f"shape_{i}"
+            assert rt.get([shape.remote() for _ in range(3)], timeout=60) == [i] * 3
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# lease-ineligible shapes keep their policies
+# --------------------------------------------------------------------------
+def test_strategy_and_dep_tasks_bypass_leases():
+    cluster = rt.init(num_cpus=2)
+    try:
+        n2 = cluster.add_node({"CPU": 2})
+        from ray_tpu.runtime.scheduler import NodeAffinitySchedulingStrategy
+
+        @rt.remote(execution="thread")
+        def where():
+            return rt.get_runtime_context().get_node_id()
+
+        strategy = NodeAffinitySchedulingStrategy(n2.node_id)
+        for _ in range(5):
+            assert (
+                rt.get(where.options(scheduling_strategy=strategy).remote(), timeout=60)
+                == n2.node_id.hex()
+            )
+        # dep-bearing tasks take the scheduled path (locality stage intact)
+        picks0 = cluster.cluster_scheduler.num_picks
+
+        @rt.remote(execution="thread")
+        def consume(x):
+            return x
+
+        ref = rt.put(7)
+        assert rt.get([consume.remote(ref) for _ in range(5)], timeout=60) == [7] * 5
+        assert cluster.cluster_scheduler.num_picks - picks0 >= 5
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# actor direct routes (the actor-shaped lease)
+# --------------------------------------------------------------------------
+def test_actor_direct_route_ordering_and_counts():
+    rt.init(num_cpus=2)
+    try:
+
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.x = 0
+
+            def inc(self):
+                self.x += 1
+                return self.x
+
+        a = Counter.remote()
+        assert rt.get(a.inc.remote(), timeout=60) == 1
+        cluster = rt.get_cluster()
+        before = cluster.actor_route_stats()["direct_submits"]
+        vals = rt.get([a.inc.remote() for _ in range(50)], timeout=60)
+        assert vals == list(range(2, 52))  # per-actor order preserved
+        stats = cluster.actor_route_stats()
+        assert stats["active_routes"] >= 1
+        assert stats["direct_submits"] - before >= 45
+        rt.kill(a)
+        assert _wait_for(
+            lambda: cluster.actor_route_stats()["active_routes"] == 0, timeout=10
+        )
+    finally:
+        rt.shutdown()
+
+
+def test_actor_direct_route_survives_restart():
+    rt.init(num_cpus=2)
+    try:
+
+        @rt.remote(max_restarts=2)
+        class Echo:
+            def ping(self):
+                return "pong"
+
+        a = Echo.remote()
+        assert rt.get(a.ping.remote(), timeout=60) == "pong"
+        cluster = rt.get_cluster()
+        assert cluster.actor_route_stats()["active_routes"] == 1
+        rt.kill(a, no_restart=False)  # restart FSM brings it back
+        # the route revokes with the death and re-grants on the restart
+        assert rt.get(a.ping.remote(), timeout=60) == "pong"
+        assert _wait_for(
+            lambda: cluster.actor_route_stats()["active_routes"] == 1, timeout=10
+        )
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# cross-process: leased tasks push peer-to-peer, results owner-routed
+# --------------------------------------------------------------------------
+def _spawn_agent(address, resources='{"remote": 4}'):
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.runtime.agent", "--address", address,
+         "--num-cpus", "2", "--resources", resources],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def test_remote_lease_pushes_tasks_on_data_plane():
+    import numpy as np
+
+    cluster = rt.init(num_cpus=1)
+    proc = None
+    try:
+        address = cluster.start_head_service()
+        proc = _spawn_agent(address)
+        assert _wait_for(
+            lambda: sum(1 for n in cluster.nodes.values() if not n.dead) >= 2,
+            timeout=60,
+        )
+
+        @rt.remote(resources={"remote": 1}, num_cpus=0)
+        def remote_nine():
+            return 9
+
+        assert rt.get(remote_nine.remote(), timeout=120) == 9  # grant + warm
+        pushes0 = metric_defs.DIRECT_PUSHES.get(tags={"transport": "data_plane"})
+        picks0 = cluster.cluster_scheduler.num_picks
+        assert rt.get([remote_nine.remote() for _ in range(40)], timeout=120) == [9] * 40
+        assert cluster.cluster_scheduler.num_picks - picks0 == 0
+        # a meaningful share of the burst rode push_task frames (the
+        # 16-in-flight cap bounds how many can be outstanding at once —
+        # on a slow box the whole burst lands before any push completes,
+        # so the floor is below the cap; overflow legitimately takes the
+        # control path)
+        assert (
+            metric_defs.DIRECT_PUSHES.get(tags={"transport": "data_plane"}) - pushes0
+            >= 10
+        )
+
+        # bulk results commit lazily: bytes stay on the agent, the owner
+        # records the location, the consumer pulls peer-to-peer
+        @rt.remote(resources={"remote": 1}, num_cpus=0)
+        def remote_big():
+            return np.ones(1 << 20, np.uint8)
+
+        rt.get(remote_big.remote(), timeout=120)  # grant
+        out = rt.get(remote_big.remote(), timeout=120)  # leased push, lazy reply
+        assert out.nbytes == 1 << 20 and int(out[0]) == 1
+
+        # a worker-minted put whose ref rides the owner-routed push reply
+        # races its own control-channel registration (nothing orders the
+        # two channels): the metadata grace window in _try_recover must let
+        # the notice land instead of tombstoning the object as lost
+        @rt.remote(resources={"remote": 1}, num_cpus=0)
+        def remote_putter():
+            return rt.put(np.full(50_000, 3, np.int64))
+
+        rt.get(remote_putter.remote(), timeout=120)  # grant
+        for _ in range(5):  # leased pushes: get the inner ref immediately
+            inner = rt.get(rt.get(remote_putter.remote(), timeout=120), timeout=120)
+            assert int(inner[0]) == 3 and inner.shape == (50_000,)
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# observability: /api/leases + `rt leases` CLI smoke
+# --------------------------------------------------------------------------
+def test_api_leases_and_cli_smoke(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rt.init(num_cpus=2, include_dashboard=True)
+    try:
+        url = rt.get_cluster().dashboard.url
+
+        @rt.remote
+        def leased_fn():
+            return None
+
+        rt.get([leased_fn.remote() for _ in range(20)], timeout=60)
+        assert main(["leases", "--address", url]) == 0
+        out = capsys.readouterr().out
+        assert "leased_fn" in out and "reuse hits" in out
+        assert main(["leases", "--address", url, "--format", "json"]) == 0
+        import json as _json
+
+        data = _json.loads(capsys.readouterr().out)
+        assert data["leases"]["grants"] >= 1
+        assert data["leases"]["reuse_hits"] >= 10
+        assert data["head"]["scheduling_decisions"] >= 1
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# put placement rides inside the ownership notice (no trailing-commit window)
+# --------------------------------------------------------------------------
+def test_register_put_async_commits_location_inline():
+    """A relayed worker put's placement is part of the register notice
+    itself: the directory must know the location the instant ownership is
+    recorded — a separate (batched) location frame could trail it, and a
+    node dying in that window left an owned object the death/drain sweeps
+    couldn't see (rt.get would hang instead of raising lost-object)."""
+    rt.init(num_cpus=1)
+    try:
+        from ray_tpu import api
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.runtime import worker_api
+
+        cluster = api.get_cluster()
+        oid = ObjectID.from_random()
+        blob = worker_api._dumps(
+            ("register_put_async",
+             {"oid": oid.binary(), "size": 123, "device": False})
+        )
+        worker_api.execute(
+            cluster.core_worker, blob,
+            worker_key=(cluster.head_node.node_id, 4242),
+        )
+        # ownership AND placement landed from the one frame
+        assert oid in cluster.core_worker.ref_counter._refs
+        assert cluster.head_node.node_id in cluster.directory.locations(oid)
+    finally:
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# push_task exactly-once protocol: delivery ack, receipt ack, control re-route
+# --------------------------------------------------------------------------
+def test_push_task_ack_protocol_and_control_reroute():
+    """The push_task exchange brackets execution with two acks: the agent
+    acks DELIVERY before dispatch (so the owner never control-resubmits a
+    task that may be running), and the owner acks RECEIPT of the result (so
+    a reply sent into a silently dead socket re-routes over the control
+    channel instead of stranding the owner's get forever).  Decode/dispatch
+    failures come back as a typed ``task_error`` — a task outcome, not a
+    transport error to fall back from."""
+    import pickle
+    import socket as socklib
+
+    from ray_tpu.runtime import data_plane
+
+    rerouted = []
+
+    def handler(spec_blob, accept):
+        mode = pickle.loads(spec_blob)
+        if mode == "boom":
+            raise ValueError("undecodable spec")
+        if mode == "need_fn":
+            return {"ok": False, "need_fn": True}, None, None, None
+        accept()
+        meta, buffers = data_plane.to_frames({"v": 7})
+        return {"ok": True}, meta, buffers, lambda: rerouted.append(mode)
+
+    server = data_plane.DataServer(
+        get_frames=lambda oid, timeout: (_ for _ in ()).throw(KeyError(oid)),
+        put_frames=lambda *a: None,
+    )
+    server.task_handler = handler
+    client = data_plane.DataClient()
+    try:
+        # happy path: accept -> result -> receipt ack; no re-route
+        header, value = client.push_task(server.address, pickle.dumps("ok"))
+        assert header["ok"] and value == {"v": 7} and not rerouted
+
+        # cold fn cache: need_fn rides back without a delivery ack
+        header, value = client.push_task(server.address, pickle.dumps("need_fn"))
+        assert header.get("need_fn") and not header.get("ok")
+
+        # handler failure surfaces as a TASK outcome, not a transport error
+        header, value = client.push_task(server.address, pickle.dumps("boom"))
+        assert header.get("task_error") and not header.get("ok")
+        assert not rerouted
+
+        # owner vanishes after reading the delivery ack: the reply goes
+        # unconfirmed and the completion must re-route (control channel)
+        host, _, port = server.address.rpartition(":")
+        sock = socklib.create_connection((host or "127.0.0.1", int(port)))
+        blob = pickle.dumps("ok")
+        data_plane._send_header(sock, {"op": "push_task", "spec_size": len(blob)})
+        data_plane._send_frame_raw(sock, blob)
+        assert data_plane._recv_header(sock).get("accepted")
+        sock.close()  # owner gone before the result / receipt ack
+        assert _wait_for(lambda: rerouted == ["ok"], timeout=15), rerouted
+    finally:
+        server.close()
+
+
+def test_pushed_duplicate_guard():
+    """A control-plane submit that duplicates a pushed task — in flight OR
+    recently completed at this agent — must be dropped: the pushed copy's
+    completion is guaranteed to reach the owner, and running the duplicate
+    would break exactly-once side effects.  A genuine retry (bumped
+    attempt) must pass."""
+    import threading as _threading
+
+    from ray_tpu.core.ids import ObjectID, TaskID
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu.runtime.agent import AgentFabric
+    from ray_tpu.runtime.scheduler import TaskSpec
+
+    fabric = AgentFabric("/tmp/rt_test_session")
+    tid = TaskID.from_random()
+    spec = TaskSpec(
+        task_id=tid, name="t", func=None, args=(), kwargs={},
+        dependencies=[], num_returns=1, return_ids=[ObjectID.from_random()],
+        resources=ResourceSet.from_fixed_dict({}),
+    )
+    spec._push_reply = ({}, _threading.Event())
+    fabric._remember(spec)
+    assert fabric.pushed_duplicate(tid.binary(), spec.attempt)
+    # a retry carries a bumped attempt: never deduped
+    assert not fabric.pushed_duplicate(tid.binary(), spec.attempt + 1)
+    # unknown tasks pass through
+    assert not fabric.pushed_duplicate(TaskID.from_random().binary(), 0)
+    # completion moves the guard to the recent-done window
+    with fabric._specs_lock:
+        fabric._pushed_done[(tid.binary(), spec.attempt)] = None
+    fabric._forget(spec)
+    assert fabric.pushed_duplicate(tid.binary(), spec.attempt)
