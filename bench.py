@@ -165,6 +165,11 @@ ROW_GROUPS = [
     # direct routes — the regression rows tracked head-to-head against the
     # lease path.  Own fresh-runtime group, median-of-3 capture below.
     ["direct_dispatch_tasks_async", "direct_dispatch_actor_calls_async"],
+    # tail latency under one delay-armed slow node, hedging off vs on
+    # (ISSUE 8): p99 ratio — the hedged second attempt on the other node
+    # rescues the stragglers.  Own fresh-runtime group — it adds a node
+    # and arms a chaos delay.
+    ["hedged_tail_latency_p99"],
 ]
 
 
@@ -200,6 +205,7 @@ def main() -> None:
         "compiled_pipeline_iter",
         "direct_dispatch_tasks_async",
         "direct_dispatch_actor_calls_async",
+        "hedged_tail_latency_p99",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

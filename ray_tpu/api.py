@@ -313,6 +313,10 @@ _TASK_OPTION_KEYS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
     "max_retries", "retry_exceptions", "name", "scheduling_strategy",
     "runtime_env", "execution", "max_calls", "_metadata",
+    # gray-failure knobs (ISSUE 8): end-to-end deadline budget (seconds,
+    # enforced at every lifecycle stage, never retried) and the hedged
+    # straggler-retry threshold (second attempt on a different node)
+    "deadline_s", "hedge_after_s",
 }
 _ACTOR_OPTION_KEYS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "name", "namespace",
@@ -354,6 +358,8 @@ class RemoteFunction:
         self._execution = opts.get("execution", "auto")
         self._scheduling_strategy = opts.get("scheduling_strategy")
         self._runtime_env = opts.get("runtime_env")
+        self._deadline_s = opts.get("deadline_s")
+        self._hedge_after_s = opts.get("hedge_after_s")
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         _auto_init()
@@ -369,6 +375,8 @@ class RemoteFunction:
             execution=self._execution,
             scheduling_strategy=self._scheduling_strategy,
             runtime_env=self._runtime_env,
+            deadline_s=self._deadline_s,
+            hedge_after_s=self._hedge_after_s,
         )
         if self._num_returns == "streaming":
             return refs  # a single ObjectRefGenerator

@@ -33,6 +33,18 @@ weaken any of the above, and add contracts of their own:
   8. **Plan state machines are legal**: compiled plans only ever move
      READY→BROKEN (death), BROKEN→READY (repair), or →TORN_DOWN — audited
      from the cluster's transition log so released plans stay checkable.
+
+Gray-failure invariants (ISSUE 8 tentpole) — partitions that heal must not
+reintroduce the dead:
+
+  9. **No commit lands from a fenced incarnation**: a node the fabric
+     declared dead never re-enters the object directory (its locations
+     stay purged), and every fence event on record names a node that is
+     genuinely DEAD — fencing never false-positives a live node.
+ 10. **At most one terminal side-effect per task across a heal**: no
+     terminal task event was recorded from a node a fence event rejected
+     for that same task — the resubmitted attempt's result is the ONLY one
+     visible.
 """
 
 from __future__ import annotations
@@ -80,6 +92,7 @@ def snapshot_baseline() -> dict:
         # elasticity scoping: only drains / plan transitions from THIS run
         "num_drain_reports": len(getattr(cluster, "drain_reports", ())),
         "num_plan_transitions": len(getattr(cluster, "plan_transitions", ())),
+        "num_fence_events": getattr(cluster, "fence_events_total", 0),
     }
 
 
@@ -305,4 +318,65 @@ def check_invariants(
             )
         last_state[plan_id] = dst
     report.checked["plan_transitions"] = len(transitions)
+
+    # 9. no commit lands from a fenced incarnation --------------------------
+    from ray_tpu.runtime.control import NodeState
+
+    dead_nodes = {
+        info.node_id
+        for info in cluster.control.nodes.all_nodes()
+        if info.state is NodeState.DEAD
+    }
+    dead_short = {nid.hex()[:8] for nid in dead_nodes}
+    with cluster.directory._lock:
+        for oid, locs in cluster.directory._locations.items():
+            bad = locs & dead_nodes
+            if bad:
+                report.add(
+                    f"fenced incarnation re-entered the directory: object "
+                    f"{oid.hex()[:8]} located on dead node(s) "
+                    f"{[n.hex()[:8] for n in bad]}"
+                )
+                break
+    fence_events = list(getattr(cluster, "fence_events", ()))
+    if baseline is not None:
+        # the log is a bounded deque: slice THIS run's tail by the
+        # monotonic total, not a list index
+        delta = getattr(cluster, "fence_events_total", 0) - baseline.get(
+            "num_fence_events", 0
+        )
+        fence_events = fence_events[-delta:] if delta > 0 else []
+    for fe in fence_events:
+        if fe.get("node") and fe["node"] not in dead_short:
+            if (
+                fe.get("incarnation") is not None
+                and fe.get("current") is not None
+                and fe["incarnation"] != fe["current"]
+            ):
+                # a stale EPOCH of a still-alive node id (transient rejoin
+                # superseded the old connection): fencing working as
+                # designed, not a false positive
+                continue
+            report.add(
+                f"fence false-positive: frame from LIVE node {fe['node']} "
+                f"rejected ({fe.get('kind')})"
+            )
+    report.checked["fence_events"] = len(fence_events)
+
+    # 10. at most one terminal side-effect per task across a heal -----------
+    fenced_tasks = {
+        (fe.get("task"), fe.get("node"))
+        for fe in fence_events
+        if fe.get("task")
+    }
+    if fenced_tasks:
+        for ev in events:
+            if ev.get("state") in ("FINISHED", "FAILED") and (
+                ev.get("task_id"), ev.get("node")
+            ) in fenced_tasks:
+                report.add(
+                    f"fenced commit LANDED: task {ev['task_id'][:8]} has a "
+                    f"terminal record from fenced node {ev['node']}"
+                )
+    report.checked["fenced_tasks"] = len(fenced_tasks)
     return report

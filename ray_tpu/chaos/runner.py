@@ -208,6 +208,27 @@ class ChaosRunner:
                 "evacuated": report["evacuated"],
                 "actors_restarted": report["actors_restarted"],
             }
+        if event.kind in ("slow_node", "partition_node"):
+            victims = [
+                (nid, node) for nid, node in cluster.nodes.items()
+                if not node.dead and node is not cluster.head_node
+            ]
+            idx = int(p.get("index", 0))
+            if idx >= len(victims):
+                return {"skipped": f"no live non-head node at index {idx}"}
+            nid, node = victims[idx]
+            if event.kind == "slow_node":
+                # deterministic straggler: a fixed per-dispatch delay — no
+                # failpoint decisions consumed, fault logs unaffected
+                node._chaos_delay_s = float(p.get("delay", 1.0))
+                return {"node": nid.hex()[:8], "delay": node._chaos_delay_s}
+            cluster.partition_node(nid)
+            return {"node": nid.hex()[:8]}
+        if event.kind == "heal_partition":
+            fresh = cluster.heal_partition()
+            if fresh is None:
+                return {"skipped": "nothing partitioned"}
+            return {"node": fresh.node_id.hex()[:8]}
         if event.kind == "add_node":
             node = cluster.add_node(
                 dict(p.get("resources") or {"CPU": 1}), labels=p.get("labels")

@@ -41,6 +41,16 @@ Event kinds
                   the doomed incarnation until ``restart_head``.
 ``restart_head``  restore the head from the kill-time snapshot; live nodes
                   re-adopt and live actor instances reconcile.
+``slow_node``     arm a fixed per-dispatch delay on the ``index``-th live
+                  non-head node (``delay`` seconds; 0 clears) — the
+                  deterministic straggler the hedging machinery exists for.
+``partition_node``  gray failure: declare the ``index``-th live non-head
+                  node dead (full death sweep) WITHOUT shutting it down —
+                  its runtime keeps executing and its commits must all be
+                  rejected as fenced (stale incarnation).
+``heal_partition``  the partition heals: the fenced node self-fences
+                  (workers killed, store dropped, pins cleared) and a
+                  FRESH node joins through the add_node elasticity path.
 """
 
 from __future__ import annotations
@@ -51,6 +61,7 @@ from typing import Any, Dict, List, Optional
 _KINDS = (
     "arm", "disarm", "partition", "kill_node", "lose_objects",
     "add_node", "drain_node", "kill_head", "restart_head",
+    "slow_node", "partition_node", "heal_partition",
 )
 
 
@@ -145,6 +156,9 @@ _EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
     "kill_head": {},
     "restart_head": {},
     "lose_objects": {"fraction": (False, (int, float))},
+    "slow_node": {"index": (False, (int,)), "delay": (False, (int, float))},
+    "partition_node": {"index": (False, (int,))},
+    "heal_partition": {},
 }
 
 
@@ -223,15 +237,19 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
                 f"{where} (lose_objects): 'fraction' must be in [0, 1], "
                 f"got {ev['fraction']}"
             )
-        if kind in ("kill_node", "drain_node") and isinstance(ev.get("index"), int) \
-                and ev["index"] < 0:
+        if kind in ("kill_node", "drain_node", "slow_node", "partition_node") \
+                and isinstance(ev.get("index"), int) and ev["index"] < 0:
             errors.append(f"{where} ({kind}): 'index' must be >= 0")
+        if kind == "slow_node" and isinstance(ev.get("delay"), (int, float)) \
+                and ev["delay"] < 0:
+            errors.append(f"{where} (slow_node): 'delay' must be >= 0")
         indexed.append((t, i, kind, ev))
 
     # timeline-order simulation: head liveness pairing + node-index bounds
     indexed.sort(key=lambda e: (e[0], e[1]))
     head_down = False
     live = num_nodes
+    partitioned = 0
     for t, i, kind, ev in indexed:
         where = f"event[{i}]"
         if kind == "kill_head":
@@ -242,17 +260,31 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
             if not head_down:
                 errors.append(f"{where}: restart_head without a preceding kill_head")
             head_down = False
+        elif kind == "heal_partition":
+            if partitioned <= 0:
+                errors.append(
+                    f"{where}: heal_partition without a preceding partition_node"
+                )
+            else:
+                partitioned -= 1
+                if live is not None:
+                    live += 1  # the fenced node rejoins as a FRESH node
         elif live is not None:
             if kind == "add_node":
                 live += 1
-            elif kind in ("kill_node", "drain_node"):
+            elif kind in ("kill_node", "drain_node", "partition_node", "slow_node"):
                 idx = ev.get("index", 0)
                 if isinstance(idx, int) and idx >= live:
                     errors.append(
                         f"{where} ({kind}): index {idx} out of range — only "
                         f"{live} live non-head node(s) at t={t}"
                     )
-                live = max(0, live - 1)
+                if kind != "slow_node":
+                    live = max(0, live - 1)
+                if kind == "partition_node":
+                    partitioned += 1
+        elif kind == "partition_node":
+            partitioned += 1
     if head_down:
         errors.append("schedule ends with the head still down (missing restart_head)")
     return errors

@@ -221,6 +221,28 @@ class Config:
     location_commit_flush_count: int = 64
     location_commit_flush_delay_s: float = 0.003
 
+    # ---- gray failures: deadlines, hedging, control-plane retries --------
+    # End-to-end task deadlines (.options(deadline_s=...)): after the
+    # deadline fires the task is cancelled cooperatively; if it has not
+    # committed a terminal state within this grace window the hosting
+    # worker is force-killed (CancelTask force_kill parity).
+    task_deadline_grace_s: float = 2.0
+    # Poll period of the owner-side watchdog that enforces deadlines and
+    # fires hedged retries.  Deadline/hedge latency is bounded by one tick.
+    watchdog_poll_period_s: float = 0.02
+    # Opt-in automatic hedging: when enabled, dep-free retryable tasks of a
+    # SchedulingKey with a settled latency EWMA hedge once their attempt
+    # outlives ewma * hedge_auto_multiplier (never below hedge_auto_min_s).
+    hedge_auto_enabled: bool = False
+    hedge_auto_multiplier: float = 3.0
+    hedge_auto_min_samples: int = 10
+    hedge_auto_min_s: float = 0.05
+    # Control-plane retry helper (rpc.retry_with_backoff): base delay,
+    # multiplier cap, and default attempt count for retriable control RPCs.
+    rpc_retry_base_backoff_s: float = 0.05
+    rpc_retry_max_backoff_s: float = 2.0
+    rpc_retry_max_attempts: int = 3
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             env_key = _ENV_PREFIX + f.name.upper()

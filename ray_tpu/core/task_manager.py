@@ -53,6 +53,16 @@ class TaskManager:
             self._pending.pop(spec.task_id, None)
             self.num_failed += 1
 
+    def claim(self, spec) -> bool:
+        """Atomically claim the right to commit ONE terminal state for the
+        task: pops the pending entry, True only for the first claimant.
+        Used by the racing deadline paths (watchdog direct-fail vs a
+        straggler completion) — (task_id, attempt) terminal-exactly-once
+        depends on exactly one of them winning.  Only valid for tasks that
+        can no longer retry (a claimed task cannot re-enter pending)."""
+        with self._lock:
+            return self._pending.pop(spec.task_id, None) is not None
+
     def should_retry(self, spec, is_system_error: bool, retry_exceptions: bool = False) -> bool:
         if spec.retries_left <= 0:
             return False

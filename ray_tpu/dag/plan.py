@@ -53,6 +53,7 @@ from ray_tpu.dag.dag_node import (
 )
 from ray_tpu.exceptions import (
     ActorDiedError,
+    RayTpuError,
     WorkerCrashedError,
     raised_copy,
 )
@@ -539,9 +540,21 @@ class ExecutionPlan:
         except Exception:  # noqa: BLE001 — bookkeeping must not block failure paths
             pass
 
-    def _mark_broken(self, error: BaseException) -> None:
+    def _mark_broken(self, error: BaseException, upgrade: bool = False) -> None:
         with self._state_lock:
             if self._state != "READY":
+                if (
+                    upgrade and self._state == "BROKEN"
+                    and not isinstance(self._error, RayTpuError)
+                ):
+                    # a stage loop's RAW transport error (DataPlaneError on
+                    # a channel into the dying node) won the race against
+                    # this death notice: upgrade the stored error to the
+                    # typed cause callers are promised (ActorDiedError /
+                    # WorkerCrashedError), keeping the transport detail
+                    # chained for the curious
+                    error.__cause__ = self._error
+                    self._error = error
                 return
             self._state = "BROKEN"
             self._error = error
@@ -659,16 +672,18 @@ class ExecutionPlan:
     def on_actor_dead(self, actor_id, cause: str = "") -> None:
         """Cluster hook: a stage actor died — flip BROKEN even with no
         iteration in flight."""
-        if actor_id in self._actor_ids and self._state == "READY":
+        if actor_id in self._actor_ids:
             self._mark_broken(
-                ActorDiedError(actor_id, f"plan stage actor died: {cause or 'killed'}")
+                ActorDiedError(actor_id, f"plan stage actor died: {cause or 'killed'}"),
+                upgrade=True,
             )
 
     def on_node_dead(self, node_id) -> None:
         """Cluster hook: a node hosting plan stages died."""
-        if node_id in self._node_ids and self._state == "READY":
+        if node_id in self._node_ids:
             self._mark_broken(
-                WorkerCrashedError(f"node {node_id.hex()[:8]} died mid-plan")
+                WorkerCrashedError(f"node {node_id.hex()[:8]} died mid-plan"),
+                upgrade=True,
             )
 
     def teardown(self) -> None:

@@ -391,6 +391,7 @@ class DashboardHead:
                     "state": state,
                     "address": info.address,
                     "resources": info.resources_total,
+                    "incarnation": cluster.control.nodes.incarnation_of(info.node_id),
                     "is_head": (
                         cluster.head_node is not None
                         and info.node_id == cluster.head_node.node_id
@@ -398,11 +399,20 @@ class DashboardHead:
                 }
             )
         monitor = getattr(cluster, "autoscaler_monitor", None)
+        fence_events = list(getattr(cluster, "fence_events", ()))
+        fence_by_kind: dict = {}
+        for fe in fence_events:
+            fence_by_kind[fe.get("kind", "?")] = fence_by_kind.get(fe.get("kind", "?"), 0) + 1
         return {
             "nodes": nodes,
             "drains": list(cluster.drain_reports),
             "head_restarts": cluster.head_restarts,
             "autoscaler": monitor.autoscaler.summary() if monitor is not None else None,
+            # gray-failure counters (ISSUE 8): fenced frames by kind + the
+            # owner-side watchdog's deadline/hedge totals
+            "fenced_frames": getattr(cluster, "fence_events_total", len(fence_events)),
+            "fenced_by_kind": fence_by_kind,
+            "watchdog": cluster.watchdog.snapshot(),
         }
 
     def _pull_stats(self) -> dict:

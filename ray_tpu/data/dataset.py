@@ -32,11 +32,27 @@ class Dataset:
     def _with(self, op: L.LogicalOp) -> "Dataset":
         return Dataset(op)
 
-    def _execute(self) -> Iterator[RefBundle]:
+    def _execute(self, preserve_order: Optional[bool] = None) -> Iterator[RefBundle]:
+        """``preserve_order=True`` forces dispatch-order output release for
+        THIS execution regardless of the context default — row-positional
+        consumers (``take``/``take_all``) need it: without it parallel map
+        tasks finishing out of order make ``take(1)`` return an arbitrary
+        block's rows (a long-standing flake; blocks completed in order only
+        by timing luck)."""
         ctx = DataContext.get_current()
-        optimized = L.optimize(_clone_plan(self._logical_op))
-        root = plan(optimized, ctx)
-        executor = StreamingExecutor(root, ctx)
+        restore = None
+        if preserve_order is not None and ctx.preserve_order != preserve_order:
+            restore = ctx.preserve_order
+            ctx.preserve_order = preserve_order
+        try:
+            optimized = L.optimize(_clone_plan(self._logical_op))
+            root = plan(optimized, ctx)
+            executor = StreamingExecutor(root, ctx)
+        finally:
+            # operators capture the flag at construction: the context can
+            # restore as soon as the physical plan exists
+            if restore is not None:
+                ctx.preserve_order = restore
         try:
             yield from executor.run()
         finally:
@@ -149,7 +165,9 @@ class Dataset:
     # --------------------------------------------------------- consumption
     def take(self, limit: int = 20) -> List[Dict[str, Any]]:
         rows: List[Dict[str, Any]] = []
-        for bundle in self.limit(limit)._execute():
+        # row-positional by definition: "the first N rows" only means
+        # something in dispatch order (see _execute docstring)
+        for bundle in self.limit(limit)._execute(preserve_order=True):
             for ref in bundle.refs:
                 block = ray_tpu.get(ref)
                 rows.extend(BlockAccessor(block).iter_rows())
@@ -159,7 +177,7 @@ class Dataset:
 
     def take_all(self) -> List[Dict[str, Any]]:
         rows: List[Dict[str, Any]] = []
-        for bundle in self._execute():
+        for bundle in self._execute(preserve_order=True):
             for ref in bundle.refs:
                 rows.extend(BlockAccessor(ray_tpu.get(ref)).iter_rows())
         return rows

@@ -98,6 +98,44 @@ class TaskCancelledError(RayTpuError):
         super().__init__(f"Task {task_id} was cancelled.")
 
 
+class DeadlineExceededError(RayTpuError):
+    """An end-to-end task deadline (``.options(deadline_s=...)``) expired.
+
+    ``stage`` names the lifecycle stage the task was in when the deadline
+    fired — ``parked`` (demand queue), ``queued`` (node-local queue),
+    ``pulling`` (dependency transfer), or ``executing``.  Deadline failures
+    are terminal by design: a task that is already too late must never
+    retry (retrying cannot un-miss the deadline), so this error bypasses
+    every retry path including ``retry_exceptions``."""
+
+    def __init__(self, task_name: str = "?", stage: str = "?", deadline_s: float | None = None):
+        self.task_name = task_name
+        self.stage = stage
+        self.deadline_s = deadline_s
+        budget = f"{deadline_s:.3f}s" if deadline_s is not None else "?"
+        super().__init__(
+            f"Task {task_name} exceeded its {budget} deadline while {stage}."
+        )
+
+    def __reduce__(self):
+        return (DeadlineExceededError, (self.task_name, self.stage, self.deadline_s))
+
+
+class FencedError(RayTpuError):
+    """This agent's incarnation was fenced by the head: a newer incarnation
+    of its node id registered (or the head declared this node dead while it
+    was partitioned).  The agent must self-fence — kill workers, drop its
+    store, clear lease pins — and rejoin as a fresh node; none of its
+    in-flight commits will be accepted."""
+
+    def __init__(self, node_id=None, incarnation: int | None = None):
+        self.node_id = node_id
+        self.incarnation = incarnation
+        super().__init__(
+            f"node incarnation {incarnation} is fenced; re-register as a fresh node"
+        )
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died."""
 

@@ -263,6 +263,32 @@ PLAN_REPAIRS = _reg.counter(
     "failed = a stage actor never came back).",
 )
 
+# ---- gray failures: fencing, deadlines, hedging --------------------------
+FENCED_FRAMES = _reg.counter(
+    "fenced_frames_total",
+    "Control/data-plane frames rejected because they carried a stale node "
+    "incarnation (a partitioned-but-alive agent outliving its death "
+    "declaration), by frame kind (task_finished / object_location / "
+    "resource_report / push_result / chan_push / register / ...).",
+)
+NODE_REJOINS = _reg.counter(
+    "node_rejoins_total",
+    "Fenced agents that self-fenced (killed workers, dropped their store, "
+    "cleared lease pins) and re-registered as a FRESH node after a "
+    "partition healed.",
+)
+TASK_DEADLINE_EXCEEDED = _reg.counter(
+    "task_deadline_exceeded_total",
+    "Tasks failed with DeadlineExceededError, by the lifecycle stage the "
+    "deadline fired in (parked / queued / pulling / executing).",
+)
+TASK_HEDGES = _reg.counter(
+    "task_hedges_total",
+    "Hedged straggler retries, by outcome: won = the hedge attempt "
+    "committed first, lost = the primary beat its hedge (the hedge was "
+    "cancelled and its commits discarded by attempt fencing).",
+)
+
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
     "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
@@ -322,6 +348,10 @@ ALL_METRICS = [
     DRAIN_EVACUATED_BYTES,
     HEAD_RESTARTS,
     PLAN_REPAIRS,
+    FENCED_FRAMES,
+    NODE_REJOINS,
+    TASK_DEADLINE_EXCEEDED,
+    TASK_HEDGES,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

@@ -36,6 +36,10 @@ class AgentFabric:
         self.conn: Optional[rpc.RpcConnection] = None
         self.node = None          # set after registration
         self.data_client = None   # peer-to-peer bulk transfer (data_plane)
+        # incarnation granted by the head at registration: stamped on every
+        # state-bearing frame this agent sends so the head can fence frames
+        # from a superseded epoch (gray-failure split-brain guard)
+        self.incarnation = 0
         self._pull_pool = None    # lazily-built transfer thread pool
         self._specs: Dict[bytes, Any] = {}   # task_id -> agent-side spec
         self._specs_lock = threading.Lock()
@@ -223,9 +227,27 @@ class AgentFabric:
         if flush:
             self._send_locations(flush)
 
+    def _stamp(self, payload: dict) -> dict:
+        """Stamp the current incarnation onto a state-bearing frame."""
+        payload["inc"] = self.incarnation
+        return payload
+
+    def reset_epoch(self) -> None:
+        """Self-fence support: drop every remnant of the fenced epoch —
+        remembered task specs (their tasks were resubmitted elsewhere; a
+        stale entry would make the producing-here wait in _local_get stall
+        30s on a result that will never commit), the pushed-task dedup
+        window, and buffered location notices for the dropped store."""
+        with self._specs_lock:
+            self._specs.clear()
+            self._pushed_done.clear()
+        with self._loc_cond:
+            self._loc_buf.clear()
+            self._loc_deadline = None
+
     def _send_locations(self, locs: list) -> None:
         try:
-            self.conn.send("object_locations", {"locs": locs})
+            self.conn.send("object_locations", self._stamp({"locs": locs}))
         except rpc.RpcError:
             pass  # head gone: the rejoin/death path owns recovery
 
@@ -294,8 +316,8 @@ class AgentFabric:
         if error is not None:
             self.conn.send(
                 "task_finished",
-                {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None,
-                 "spans": self._drained_spans()},
+                self._stamp({"task_id": spec.task_id.binary(), "error": rpc.encode_value(error), "value": None,
+                 "spans": self._drained_spans()}),
             )
             return
         from ray_tpu.core.config import get_config
@@ -313,7 +335,7 @@ class AgentFabric:
 
             self.conn.send(
                 "task_finished",
-                {
+                self._stamp({
                     "task_id": spec.task_id.binary(), "value": None, "error": None,
                     "lazy": True,
                     "device_returns": [is_device_array(v) for v in values],
@@ -321,7 +343,7 @@ class AgentFabric:
                     # locality scoring + pull admission without the bytes
                     "return_sizes": [_probe_nbytes(v)[0] for v in values],
                     "spans": self._drained_spans(),
-                },
+                }),
             )
 
         if self.data_client is not None:
@@ -338,8 +360,8 @@ class AgentFabric:
             return
         self.conn.send(
             "task_finished",
-            {"task_id": spec.task_id.binary(), "value": enc, "error": None,
-             "spans": self._drained_spans()},
+            self._stamp({"task_id": spec.task_id.binary(), "value": enc, "error": None,
+             "spans": self._drained_spans()}),
         )
 
     def on_stream_item(self, node, spec, index: int, value, is_error: bool = False) -> None:
@@ -368,46 +390,46 @@ class AgentFabric:
                 node.store.put(oid, value)
                 self.conn.send(
                     "stream_item",
-                    {
+                    self._stamp({
                         "task_id": spec.task_id.binary(), "index": index,
                         "lazy": True, "device": is_device_array(value),
                         "size": approx,
-                    },
+                    }),
                 )
                 return
         self.conn.send(
             "stream_item",
-            {
+            self._stamp({
                 "task_id": spec.task_id.binary(), "index": index,
                 "value": enc if enc is not None else rpc.encode_value(value, is_error),
-            },
+            }),
         )
 
     def on_stream_done(self, node, spec, index: int, error) -> None:
         self._forget(spec)
         self.conn.send(
             "stream_done",
-            {
+            self._stamp({
                 "task_id": spec.task_id.binary(),
                 "index": index,
                 "error": rpc.encode_value(error) if error is not None else None,
-            },
+            }),
         )
 
     # -- actor lifecycle ----------------------------------------------------
     def on_actor_created(self, node, spec) -> None:
         self._forget(spec)
-        self.conn.send("actor_created", {"task_id": spec.task_id.binary()})
+        self.conn.send("actor_created", self._stamp({"task_id": spec.task_id.binary()}))
 
     def on_actor_creation_failed(self, spec, error) -> None:
         self._forget(spec)
         self.conn.send(
             "actor_creation_failed",
-            {"task_id": spec.task_id.binary(), "error": rpc.encode_value(error)},
+            self._stamp({"task_id": spec.task_id.binary(), "error": rpc.encode_value(error)}),
         )
 
     def on_actor_process_died(self, node, actor_id: ActorID) -> None:
-        self.conn.send("actor_died", {"actor_id": actor_id.binary()})
+        self.conn.send("actor_died", self._stamp({"actor_id": actor_id.binary()}))
 
     def on_worker_process_died(self, pid) -> None:
         """Relay to the head, which keys this agent's worker pins by
@@ -459,7 +481,8 @@ class AgentFabric:
             # fire-and-forget: relay as a notification — the control
             # connection preserves order, the head processes inline
             self.conn.send(
-                "worker_api_async", {"blob": blob, "op": op, "worker_key": worker_key}
+                "worker_api_async",
+                self._stamp({"blob": blob, "op": op, "worker_key": worker_key}),
             )
             return b""
         if op == "get":
@@ -492,8 +515,14 @@ class AgentFabric:
                 import pickle as _pickle
 
                 blob = _pickle.dumps(decoded, protocol=5)
-        reply = self.conn.request(
-            "worker_api", {"blob": blob, "worker_key": worker_key}, timeout=24 * 3600.0
+        # deadline-bearing in-proc tasks relay on THEIR OWN thread, so the
+        # deadline context is visible here: pass the remaining budget
+        # instead of the flat 24h bound (process-worker relays run on
+        # worker-api threads with no context and keep the long default)
+        reply = rpc.request_with_budget(
+            self.conn, "worker_api",
+            self._stamp({"blob": blob, "worker_key": worker_key}),
+            default_timeout=24 * 3600.0,
         )
         return reply["blob"]
 
@@ -536,14 +565,15 @@ class AgentFabric:
         ))
         if sync:
             self.conn.request(
-                "worker_api", {"blob": reg_blob, "worker_key": worker_key},
+                "worker_api",
+                self._stamp({"blob": reg_blob, "worker_key": worker_key}),
                 timeout=30.0,
             )
         else:
             self.conn.send(
                 "worker_api_async",
-                {"blob": reg_blob, "op": "register_put_async",
-                 "worker_key": worker_key},
+                self._stamp({"blob": reg_blob, "op": "register_put_async",
+                 "worker_key": worker_key}),
             )
         return True
 
@@ -563,7 +593,7 @@ class AgentFabric:
         value = kw["value"]
         if not _ref_free(value):
             return None
-        reply = self.conn.request("mint_put_oid", {}, timeout=30.0)
+        reply = self.conn.request("mint_put_oid", self._stamp({}), timeout=30.0)
         oid = _OID(reply["oid"])
         try:
             self.node.store.put(oid, value)
@@ -608,7 +638,35 @@ class AgentFabric:
         for r in ref_list:
             oid = r.id()
             if not store.contains(oid):
-                return None
+                # the producing task may be IN FLIGHT on this very node (a
+                # nested get racing its producer — common when both ride
+                # concurrent leased pushes): its returns commit locally
+                # first, so wait for that commit instead of falling back to
+                # the head relay, which would round-trip the bulk value
+                # through the control plane for nothing
+                task_bin = oid.task_id().binary()
+                with self._specs_lock:
+                    producing_here = task_bin in self._specs
+                if not producing_here:
+                    return None
+                # bounded incremental wait, re-checking the producer is
+                # STILL here each step: a producer that fails (its error
+                # object commits at the owner, never locally) or migrates
+                # must fall back to the head relay promptly, not after a
+                # flat 30s.  Blocking is safe: sync gets are served on a
+                # dedicated worker-api thread, never the pool reader.
+                deadline = time.monotonic() + 30.0
+                while True:
+                    try:
+                        store.get(oid, timeout=0.2)
+                        break
+                    except Exception:  # noqa: BLE001 — not committed yet
+                        with self._specs_lock:
+                            still_here = task_bin in self._specs
+                        if not still_here and not store.contains(oid):
+                            return None  # producer finished/failed elsewhere
+                        if time.monotonic() >= deadline:
+                            return None  # head relay is the authoritative path
             # short timeout: a concurrent free between contains() and get()
             # leaves an unwoken waiter — time out and take the head path
             value = store.get(oid, timeout=1.0)
@@ -654,9 +712,11 @@ class NodeAgent:
         self._stop = threading.Event()
         self._reconnect_lock = threading.Lock()
         self._reconnecting = False
+        self._refencing = False
         self.node = None
         self.node_id: Optional[NodeID] = None
         self.conn: Optional[rpc.RpcConnection] = None
+        self.incarnation = 0
 
     # ------------------------------------------------------------------
     def _install_inproc_api(self) -> None:
@@ -707,8 +767,6 @@ class NodeAgent:
         hooks.ref_counter = _WorkerRefCounter(client)
 
     def start(self) -> None:
-        from ray_tpu.runtime.node import Node
-
         self.conn = rpc.connect(
             self.head_address,
             handlers=self._handlers(),
@@ -743,6 +801,43 @@ class NodeAgent:
             )
         except Exception:  # noqa: BLE001 — no /dev/shm: plain pipes still work
             self.shm_store = None
+        self.fabric.data_client = None  # built in _build_node_runtime
+        # worker prints on this node surface on the DRIVER's stderr
+        # (log_monitor parity; head side: HeadService._h_log_batch).
+        # Batched: chatty workers must not serialize one RPC frame per line
+        # against task traffic on the shared connection.
+        self._log_buf: list = []
+        self._log_lock = threading.Lock()
+        self._log_last_flush = time.monotonic()
+        self._build_node_runtime(self.conn)
+        # rt.* must work inside in-proc tasks executing in THIS process
+        # (auto-tier profiling routes hot small tasks here)
+        self._install_inproc_api()
+        # collectives / gang rendezvous in this process reach the cluster KV
+        # over the head connection
+        from ray_tpu.runtime.kv_client import register_agent_kv
+
+        register_agent_kv(self.conn)
+        # stragglers below the batch threshold drain on the report tick
+        # (_report_loop calls _flush_logs)
+        reply = self._register(rejoin=False)
+        self._adopt_incarnation(reply)
+        self._report_thread = threading.Thread(
+            target=self._report_loop, args=(self.conn,), name="agent-report", daemon=True
+        )
+        self._report_thread.start()
+
+    def _build_node_runtime(self, conn: rpc.RpcConnection) -> None:
+        """Construct the node-level runtime for the CURRENT ``self.node_id``:
+        the Node (scheduler, worker pool, store, actors), the bulk data
+        server over its store, and the p2p endpoint.  Called at start and
+        again by the self-fence path, which rebuilds everything under a
+        fresh node id."""
+        from ray_tpu.core.config import get_config
+        from ray_tpu.runtime import data_plane, p2p
+        from ray_tpu.runtime.node import Node
+
+        cfg = get_config()
         self.node = Node(
             self.node_id, self.resources, self.fabric,
             shm_store=self.shm_store, labels=self.labels,
@@ -750,17 +845,12 @@ class NodeAgent:
             # their lazy p2p endpoints (worker_pool spawn env;
             # p2p.ensure_endpoint) — passed through the constructor so even
             # the prestarted worker gets them
-            data_ip=self.conn.local_ip, head_ip=self.conn.peer_ip,
+            data_ip=conn.local_ip, head_ip=conn.peer_ip,
         )
         self.fabric.node = self.node
-        # rt.* must work inside in-proc tasks executing in THIS process
-        # (auto-tier profiling routes hot small tasks here)
-        self._install_inproc_api()
         # Bulk data plane: this node serves its local store to peers and
         # pulls dependencies directly from whichever peer holds them (the
         # head is only the address book — see data_plane.py docstring).
-        from ray_tpu.runtime import data_plane
-
         # Bind all interfaces; advertise the IP this host is reachable at
         # from the head's side of the control connection (loopback would be
         # undialable for peers on other machines).
@@ -772,51 +862,36 @@ class NodeAgent:
         # back owner-to-owner on the same connection — the head control
         # channel carries lease churn, not per-task traffic
         self.data_server.task_handler = self._handle_pushed_task
-        self.data_address = f"{self.conn.local_ip}:{self.data_server.port}"
-        self.fabric.data_client = data_plane.DataClient(
-            chunk_bytes=cfg.object_transfer_chunk_bytes,
-            max_concurrent=cfg.max_concurrent_object_transfers,
-        )
+        self.data_address = f"{conn.local_ip}:{self.data_server.port}"
+        if self.fabric.data_client is None:
+            self.fabric.data_client = data_plane.DataClient(
+                chunk_bytes=cfg.object_transfer_chunk_bytes,
+                max_concurrent=cfg.max_concurrent_object_transfers,
+            )
         # collectives in this process send/recv store-to-store on the data
         # plane (runtime/p2p.py) instead of polling values through the KV
-        from ray_tpu.runtime import p2p
-
         p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
         p2p.set_local_node(self.node_id.hex())
-        # collectives / gang rendezvous in this process reach the cluster KV
-        # over the head connection
-        from ray_tpu.runtime.kv_client import register_agent_kv
+        self.node.worker_pool.log_sink = self._log_sink
 
-        register_agent_kv(self.conn)
-        # worker prints on this node surface on the DRIVER's stderr
-        # (log_monitor parity; head side: HeadService._h_log_batch).
-        # Batched: chatty workers must not serialize one RPC frame per line
-        # against task traffic on the shared connection.
-        self._log_buf: list = []
-        self._log_lock = threading.Lock()
-        self._log_last_flush = time.monotonic()
-
-        def log_sink(line: str) -> None:
-            flush = None
-            with self._log_lock:
-                self._log_buf.append(line)
-                now = time.monotonic()
-                if len(self._log_buf) >= 50 or now - self._log_last_flush > 0.2:
-                    flush, self._log_buf = self._log_buf, []
-                    self._log_last_flush = now
-            if flush:
+    def _log_sink(self, line: str) -> None:
+        flush = None
+        with self._log_lock:
+            self._log_buf.append(line)
+            now = time.monotonic()
+            if len(self._log_buf) >= 50 or now - self._log_last_flush > 0.2:
+                flush, self._log_buf = self._log_buf, []
+                self._log_last_flush = now
+        if flush:
+            try:
                 self.conn.send("log_batch", {"lines": flush})
+            except rpc.RpcError:
+                pass
 
-        self.node.worker_pool.log_sink = log_sink
-        # stragglers below the batch threshold drain on the report tick
-        # (_report_loop calls _flush_logs)
-        self._register(rejoin=False)
-        self._report_thread = threading.Thread(
-            target=self._report_loop, args=(self.conn,), name="agent-report", daemon=True
-        )
-        self._report_thread.start()
-
-    def _register(self, rejoin: bool, conn: Optional[rpc.RpcConnection] = None) -> None:
+    def _register(
+        self, rejoin: bool, conn: Optional[rpc.RpcConnection] = None,
+        refenced: bool = False,
+    ) -> dict:
         payload = {
             "node_id": self.node_id.binary(),
             "resources": self.resources,
@@ -824,13 +899,119 @@ class NodeAgent:
             "address": _self_address(),
             "data_address": self.data_address,
         }
+        if refenced:
+            # the previous incarnation of this agent was fenced; this is
+            # the fresh-node rejoin after the self-fence (node_rejoins_total)
+            payload["refenced"] = True
         if rejoin:
             payload["rejoin"] = True
             # reconciliation: the actor instances still alive in THIS
             # process, so the (possibly restarted) head can rebuild its
             # routing state for them
             payload["actors"] = [aid.binary() for aid in list(self.node.actors.keys())]
-        (conn or self.conn).request("register_node", payload)
+        return (conn or self.conn).request("register_node", payload)
+
+    def _adopt_incarnation(self, reply: dict) -> None:
+        self.incarnation = int(reply.get("incarnation") or 0)
+        self.fabric.incarnation = self.incarnation
+        # channel frames (chan_push) carry (node, incarnation) too so peer
+        # data servers can fence a stale epoch's compiled-plan streams
+        from ray_tpu.runtime import data_plane
+
+        data_plane.set_local_source(self.node_id.hex(), self.incarnation)
+
+    # -- incarnation fencing (gray failures) ----------------------------
+    def _h_fenced(self, conn, payload) -> None:
+        """The head rejected one of our frames as a stale incarnation: this
+        epoch's commits will never be accepted again.  Self-fence off the
+        dispatch thread (teardown joins worker processes).  Notices naming
+        an incarnation we already shed (straggler frames sent before a
+        completed self-fence) are ignored — they must not re-fence the
+        fresh, healthy epoch."""
+        fenced_inc = payload.get("incarnation")
+        if fenced_inc is not None and fenced_inc != self.incarnation:
+            return
+        self._start_refence(conn)
+
+    def _h_peer_fenced(self, conn, payload) -> None:
+        """A peer node's incarnation was fenced cluster-wide: reject its
+        chan_push frames at this agent's data server too."""
+        from ray_tpu.runtime import data_plane
+
+        node_hex = payload.get("node")
+        if node_hex:
+            data_plane.fence_source(node_hex)
+
+    def _refence_single_flight(self, conn) -> bool:
+        """Run the self-fence unless another thread already owns it (or the
+        agent is stopping) — the ONE single-flight protocol both trigger
+        paths (fenced notice, fenced rejoin reply) share.  Returns False
+        when skipped; exceptions propagate to the caller."""
+        with self._reconnect_lock:
+            if self._refencing or self._stop.is_set():
+                return False
+            self._refencing = True
+        try:
+            self._refence(conn)
+        finally:
+            with self._reconnect_lock:
+                self._refencing = False
+        return True
+
+    def _start_refence(self, conn) -> None:
+        threading.Thread(
+            target=self._refence_thread, args=(conn,), name="agent-refence", daemon=True
+        ).start()
+
+    def _refence_thread(self, conn) -> None:
+        try:
+            self._refence_single_flight(conn)
+        except BaseException as exc:  # noqa: BLE001 — cannot recover: exit
+            print(f"ray_tpu agent: self-fence failed: {exc!r}", file=sys.stderr)
+            self._stop.set()
+
+    def _refence(self, conn: rpc.RpcConnection) -> None:
+        """Self-fence and rejoin FRESH (ISSUE 8 tentpole): kill this node's
+        workers and actors, drop its store and lease pins (they die with
+        the worker pool), release compiled-plan channels, then build a new
+        Node under a NEW node id and register it through the normal
+        elasticity path.  Everything the old incarnation still had in
+        flight is garbage by definition — the head's death sweep already
+        resubmitted/recovered around it."""
+        print(
+            "ray_tpu agent: incarnation fenced — self-fencing and rejoining "
+            "as a fresh node",
+            file=sys.stderr,
+        )
+        try:
+            from ray_tpu.runtime import channel_manager
+
+            channel_manager.uninstall_all_remote_plans()
+        except Exception:  # noqa: BLE001 — plan channels die with the node
+            pass
+        old_node = self.node
+        if old_node is not None:
+            old_node.shutdown()  # kills actors + pool workers; pins clear
+        if getattr(self, "data_server", None) is not None:
+            self.data_server.close()  # old store must not serve stale bytes
+        # drop the fenced epoch's fabric state (remembered specs, dedup
+        # window, buffered location notices for the dropped store)
+        self.fabric.reset_epoch()
+        from ray_tpu.parallel.collective import reset_module_state
+
+        reset_module_state()
+        self.node_id = NodeID.from_random()
+        self._build_node_runtime(conn)
+        reply = self._register(rejoin=False, conn=conn, refenced=True)
+        if reply.get("fenced"):
+            from ray_tpu.exceptions import FencedError
+
+            raise FencedError(self.node_id, self.incarnation)
+        self._adopt_incarnation(reply)
+        print(
+            f"ray_tpu agent: rejoined as fresh node {self.node_id.hex()[:8]}",
+            file=sys.stderr,
+        )
 
     # -- head fault tolerance -------------------------------------------
     def _reconnect_loop(self) -> None:
@@ -890,19 +1071,34 @@ class NodeAgent:
             from ray_tpu.runtime import p2p
             from ray_tpu.runtime.kv_client import register_agent_kv
 
-            self._register(rejoin=True, conn=conn)
+            reg = self._register(rejoin=True, conn=conn)
+            if not reg.get("fenced"):
+                # adopt the NEW incarnation BEFORE publishing the connection
+                # to the fabric: a completion sent in between would carry
+                # the stale stamp and be fenced — stranding its spec and
+                # spuriously re-fencing a healthy, just-rejoined node
+                self._adopt_incarnation(reg)
             # registration done: publish the new epoch to the rest of the
             # process, then arm the disconnect hook
             self.conn = conn
             self.fabric.conn = conn
             register_agent_kv(conn)
-            p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
-            # collective groups/counters index the PREVIOUS head incarnation:
-            # a rank here holding generation N would desync against restarted
-            # driver-side ranks that are born at generation 0
-            from ray_tpu.parallel.collective import reset_module_state
+            if reg.get("fenced"):
+                # the head declared this node dead during the partition: the
+                # old incarnation can never rejoin.  Self-fence (kill
+                # workers, drop the store + pins) and join as a FRESH node
+                # on this connection — the partition-heal rejoin path.
+                # Single-flight against a notice-triggered refence racing in
+                # on the new connection's dispatch thread.
+                self._refence_single_flight(conn)
+            else:
+                p2p.register_endpoint(self.node.store, self.fabric.data_client, self.data_address)
+                # collective groups/counters index the PREVIOUS head
+                # incarnation: a rank here holding generation N would desync
+                # against restarted driver-side ranks born at generation 0
+                from ray_tpu.parallel.collective import reset_module_state
 
-            reset_module_state()
+                reset_module_state()
         except BaseException:
             conn.close()
             raise
@@ -980,6 +1176,8 @@ class NodeAgent:
             "dump_stacks": self._h_dump_stacks,
             "install_plan": self._h_install_plan,
             "uninstall_plan": self._h_uninstall_plan,
+            "fenced": self._h_fenced,
+            "peer_fenced": self._h_peer_fenced,
             "ping": lambda c, p, rid=None: {},
         }
 
@@ -1107,12 +1305,17 @@ class NodeAgent:
             return {
                 "ok": True, "error": rpc.encode_value(err),
                 "spans": self.fabric._drained_spans(),
+                "src": (self.node_id.hex(), self.incarnation),
             }, None, None, reply_failed
         error = box.get("error")
         spans = self.fabric._drained_spans()
+        # (node, incarnation) stamp: the owner fences results from a
+        # superseded epoch (the death sweep already resubmitted the task)
+        src = (self.node_id.hex(), self.incarnation)
         if error is not None:
             return (
-                {"ok": True, "error": rpc.encode_value(error), "spans": spans},
+                {"ok": True, "error": rpc.encode_value(error), "spans": spans,
+                 "src": src},
                 None, None, reply_failed,
             )
         result = box.get("result")
@@ -1128,7 +1331,7 @@ class NodeAgent:
             from ray_tpu.runtime.remote_node import _probe_nbytes
 
             return {
-                "ok": True, "lazy": True, "spans": spans,
+                "ok": True, "lazy": True, "spans": spans, "src": src,
                 "device_returns": [is_device_array(v) for v in values],
                 "return_sizes": [_probe_nbytes(v)[0] for v in values],
             }, None, None, reply_failed
@@ -1144,7 +1347,7 @@ class NodeAgent:
         total = len(meta) + sum(memoryview(b).cast("B").nbytes for b in buffers)
         if total > threshold:
             return lazy_header()
-        return {"ok": True, "spans": spans}, meta, buffers, reply_failed
+        return {"ok": True, "spans": spans, "src": src}, meta, buffers, reply_failed
 
     def _h_submit_actor_task(self, conn, payload) -> None:
         self.node.submit_actor_task(self._decode(payload))
@@ -1257,6 +1460,9 @@ class NodeAgent:
                     "available": pool.available.fixed(),
                     "queue_len": self.node.scheduler.queue_len(),
                     "stats": self.node.scheduler.stats(),
+                    # incarnation stamp: a superseded epoch's heartbeat must
+                    # not refresh the liveness of the CURRENT one
+                    "inc": self.incarnation,
                 }
                 # reporter piggyback: CPU/mem/TPU utilization, sampled at
                 # the HISTORY's cadence (2s), not the hot report tick — the

@@ -35,6 +35,7 @@ from ray_tpu.core.sync import when_all
 from ray_tpu.core.task_manager import TaskManager
 from ray_tpu.exceptions import (
     ActorDiedError,
+    DeadlineExceededError,
     ObjectLostError,
     RayTaskError,
     WorkerCrashedError,
@@ -46,6 +47,9 @@ from ray_tpu.runtime.scheduler import ClusterScheduler, LeaseManager, TaskSpec
 
 # prebuilt tag dict: the actor direct-route hot path must not allocate it
 _ACTOR_DIRECT_TAGS = {"transport": "actor_direct"}
+
+# prebuilt fence tags (completion paths run per task)
+_FENCE_TASK_TAGS = {"kind": "task_finished"}
 
 # How long a no-location, no-lineage object gets for an in-flight metadata
 # notice to land before it is tombstoned as lost.  Covers the control-vs-
@@ -318,6 +322,21 @@ class Cluster:
         # cached worker leases: repeat-shape tasks skip per-task pick_node
         # (grant once, push direct; see scheduler.LeaseManager)
         self.lease_manager = LeaseManager(self)
+        # gray-failure defenses: owner-side deadline enforcement + hedged
+        # straggler retries (runtime/watchdog.py)
+        from ray_tpu.runtime.watchdog import TaskWatchdog
+
+        self.watchdog = TaskWatchdog(self)
+        # fence audit log: every frame/commit rejected for carrying a stale
+        # node incarnation (split-brain attempts), read by the chaos
+        # invariant sweep and /api/autoscaler.  BOUNDED — the dead-node
+        # completion path feeds it, and a long-lived churning cluster must
+        # not grow it forever; fence_events_total keeps the true count
+        self.fence_events: deque = deque(maxlen=4096)
+        self.fence_events_total = 0
+        # gray-partitioned nodes (declared dead, still running) awaiting a
+        # heal_partition — see partition_node/heal_partition chaos hooks
+        self._partitioned: List[tuple] = []
         self.directory = ObjectDirectory()
         # locality stage: pick_node scores candidate nodes by the dependency
         # bytes the directory says they already hold
@@ -571,6 +590,9 @@ class Cluster:
         old = self.control
         fresh = ControlService()
         fresh.restore_snapshot(path)
+        # incarnations minted after the kill-time snapshot must not be
+        # re-minted by the fresh table (merge keeps the max per node id)
+        fresh.nodes.restore_incarnations(old.nodes.incarnation_snapshot())
         with self._node_lifecycle_lock:
             # live nodes re-register with the fresh service (liveness is
             # process state, rebuilt from the living — never snapshotted)
@@ -669,6 +691,43 @@ class Cluster:
             if expected is not None and node is not expected:
                 return
             self._kill_node_locked(node_id, node, reason=reason)
+
+    # ------------------------------------------------------------------
+    # gray partitions (chaos hooks: a node declared dead while its runtime
+    # is still ALIVE — the split-brain scenario incarnation fencing exists
+    # for; see docs/fault_tolerance.md "Fault model")
+    # ------------------------------------------------------------------
+    def partition_node(self, node_id: NodeID) -> None:
+        """Declare the node dead — full death sweep: leases revoked,
+        pending tasks resubmitted, objects recovered — WITHOUT shutting its
+        runtime down.  Its workers keep executing and keep trying to commit
+        results; every such commit must now be rejected as fenced."""
+        with self._node_lifecycle_lock:
+            node = self.nodes.get(node_id)
+            if node is None or node.dead:
+                return
+            self._kill_node_locked(
+                node_id, node, reason="gray partition (declared dead, still running)",
+                shutdown=False,
+            )
+            self._partitioned.append((node_id, node))
+
+    def heal_partition(self):
+        """The partition healed: the stale incarnation learns it is fenced,
+        self-fences (workers killed, store dropped, lease pins cleared with
+        the pool), and a FRESH node joins through the add_node elasticity
+        path — it can never double-commit what the death sweep already
+        resubmitted.  Returns the fresh node, or None if nothing was
+        partitioned."""
+        with self._node_lifecycle_lock:
+            if not self._partitioned:
+                return None
+            node_id, node = self._partitioned.pop(0)
+        resources = node.pool.total.to_dict()
+        labels = dict(getattr(node, "labels", None) or {}) or None
+        node.shutdown()  # the self-fence: actors + workers die, pins clear
+        metric_defs.NODE_REJOINS.inc()
+        return self.add_node(resources, labels=labels)
 
     # ------------------------------------------------------------------
     # graceful drain (DrainRaylet parity, node_manager.proto)
@@ -806,7 +865,12 @@ class Cluster:
             return None
         return survivors[seq % len(survivors)]
 
-    def _kill_node_locked(self, node_id: NodeID, node, reason: str = "") -> None:
+    def _kill_node_locked(
+        self, node_id: NodeID, node, reason: str = "", shutdown: bool = True
+    ) -> None:
+        """``shutdown=False`` (gray partition): run the FULL death sweep but
+        leave the node's runtime alive — exactly what a real partition looks
+        like from the head's side."""
         node.dead = True
         node.death_reason = reason or "killed"
         try:
@@ -882,7 +946,23 @@ class Cluster:
                     spec, ActorDiedError(spec.actor_id, f"node {node_id.hex()[:8]} died")
                 )
                 self._after_commit(spec)
-        node.shutdown()
+        # fence the dead incarnation's DATA-plane frames too: the head's own
+        # data server and every live agent reject chan_push frames stamped
+        # with this node id (a partitioned agent's channel streams may
+        # still be connected peer-to-peer)
+        if hasattr(node, "conn"):
+            from ray_tpu.runtime import data_plane
+
+            data_plane.fence_source(node_id.hex())
+            for peer in list(self.nodes.values()):
+                if peer is node or peer.dead or not hasattr(peer, "conn"):
+                    continue
+                try:
+                    peer.conn.send("peer_fenced", {"node": node_id.hex()})
+                except Exception:  # noqa: BLE001 — that peer is dying too
+                    pass
+        if shutdown:
+            node.shutdown()
 
     # ------------------------------------------------------------------
     # collective death notices (VERDICT r4 item 5)
@@ -1013,6 +1093,7 @@ class Cluster:
         Zero threads per entry: one drainer (started lazily, parked while
         the queue is empty) retries placement on resource events / a short
         tick and fails entries past their deadline."""
+        spec._stage = "parked"
         with self._demand_lock:
             self._infeasible_demands[id(spec)] = spec.resources.to_dict()
         timeout = (
@@ -1181,6 +1262,76 @@ class Cluster:
             return
         node.cancel_task(spec, force=force)
 
+    # ------------------------------------------------------------------
+    # gray-failure hooks: deadlines + hedges (runtime/watchdog.py callers)
+    # ------------------------------------------------------------------
+    def record_fence_event(self, event: dict) -> None:
+        """One audited fence rejection (bounded log + monotonic total)."""
+        self.fence_events.append(event)
+        self.fence_events_total += 1
+
+    def unpark_and_fail(self, spec: TaskSpec, error: BaseException) -> bool:
+        """Remove a PARKED task from the demand queue and commit ``error``
+        as its terminal state.  Returns False when the drainer placed it
+        concurrently (the caller falls back to the cancel path)."""
+        removed = False
+        with self._demand_cv:
+            for entry in list(self._demand_entries):
+                if entry[0] is spec:
+                    self._demand_entries.remove(entry)
+                    removed = True
+                    break
+            if removed:
+                self._park_deadlines.pop(id(spec), None)
+        if not removed:
+            return False
+        with self._demand_lock:
+            self._infeasible_demands.pop(id(spec), None)
+        if not self.task_manager.claim(spec):
+            return True  # something else already terminated it
+        self._record_task_event(spec, self.head_node, "FAILED")
+        self.task_manager.mark_failed(spec)
+        self._commit_error_everywhere(spec, error)
+        self._emit_task_spans(spec, "FAILED")
+        self._after_commit(spec)
+        return True
+
+    def deadline_fail_now(self, spec: TaskSpec) -> bool:
+        """Owner-side terminal commit of a deadline failure (pulling-stage
+        fire, or the escalation safety net).  Claim-based: a straggler
+        completion racing this loses atomically — terminal-exactly-once
+        per (task_id, attempt) holds."""
+        if not self.task_manager.claim(spec):
+            return False
+        error = self.watchdog.deadline_error(spec)
+        node = self.nodes.get(spec.owner_node)
+        if node is None or node.dead:
+            node = self.head_node
+        self._record_task_event(spec, node, "FAILED")
+        self.task_manager.mark_failed(spec)
+        self._commit_error_everywhere(spec, error)
+        self._emit_task_spans(spec, "FAILED")
+        self._after_commit(spec)
+        return True
+
+    def submit_hedge(self, spec: TaskSpec, exclude=()) -> bool:
+        """Launch a hedged second attempt on a node OTHER than the
+        (possibly straggling) primary's.  Deliberately bypasses the lease
+        fast path — the cached lease points at the very node being hedged
+        against.  False = no alternative node exists right now."""
+        exclude = frozenset(n for n in exclude if n is not None)
+        node_id = self.cluster_scheduler.pick_node(spec, exclude=exclude)
+        if node_id is None or node_id in exclude:
+            return False
+        node = self.nodes.get(node_id)
+        if node is None or node.dead:
+            return False
+        try:
+            node.submit(spec)
+        except ConnectionError:
+            return False
+        return True
+
     def request_resources(self, bundles: List[Dict[str, float]]) -> None:
         """Set the explicit capacity floor (parity:
         ``ray.autoscaler.sdk.request_resources``, commands.py). Replace
@@ -1345,39 +1496,68 @@ class Cluster:
             # retry owns the returns), so straggler completions are dropped.
             # In-flight ACTOR tasks are not resubmitted — their callers must
             # see an error, not hang.
-            if spec.actor_id is not None:
-                if lazy and error is None:
-                    # the result's only copy died with the node: surface as a
-                    # worker crash so retry/ActorDiedError policy applies
-                    error = WorkerCrashedError(
-                        f"node {node.node_id.hex()[:8]} died before the result transferred"
-                    )
-                if error is None:
-                    # the call actually completed: salvage the result onto
-                    # the head node's store.  Event recorded BEFORE the puts:
-                    # getters wake the instant the value commits, and the
-                    # terminal record must already be visible to them (and
-                    # to a racing shutdown snapshot).
-                    self._record_task_event(spec, node, "FINISHED")
-                    values = [result] if spec.num_returns == 1 else list(result or [None] * spec.num_returns)
-                    for oid, value in zip(spec.return_ids, values):
-                        self.head_node.store.put(oid, value)
-                        self.commit_location(self.head_node, oid)
-                    self.task_manager.mark_completed(spec)
-                    self._emit_task_spans(spec, "FINISHED")
-                elif self._maybe_retry_actor_task(spec):
-                    return
-                else:
-                    self._record_task_event(spec, node, "FAILED")
-                    self.task_manager.mark_failed(spec)
-                    self._commit_error_everywhere(spec, error)
-                    self._emit_task_spans(spec, "FAILED")
-                self._after_commit(spec)
+            if spec.actor_id is None:
+                # fenced commit: a dead — possibly partitioned-but-ALIVE —
+                # incarnation tried to land a task result.  Rejecting it is
+                # what keeps a healed partition from double-committing what
+                # the death sweep already resubmitted; audited by chaos
+                # invariant 9 and surfaced as fenced_frames_total.
+                metric_defs.FENCED_FRAMES.inc(tags=_FENCE_TASK_TAGS)
+                self.record_fence_event(
+                    {
+                        "kind": "task_finished",
+                        "node": node.node_id.hex()[:8],
+                        "task": spec.task_id.hex(),
+                        "attempt": spec.attempt,
+                    }
+                )
+                return
+            if lazy and error is None:
+                # the result's only copy died with the node: surface as a
+                # worker crash so retry/ActorDiedError policy applies
+                error = WorkerCrashedError(
+                    f"node {node.node_id.hex()[:8]} died before the result transferred"
+                )
+            if error is None:
+                # the call actually completed: salvage the result onto
+                # the head node's store.  Event recorded BEFORE the puts:
+                # getters wake the instant the value commits, and the
+                # terminal record must already be visible to them (and
+                # to a racing shutdown snapshot).
+                self._record_task_event(spec, node, "FINISHED")
+                values = [result] if spec.num_returns == 1 else list(result or [None] * spec.num_returns)
+                for oid, value in zip(spec.return_ids, values):
+                    self.head_node.store.put(oid, value)
+                    self.commit_location(self.head_node, oid)
+                self.task_manager.mark_completed(spec)
+                self._emit_task_spans(spec, "FINISHED")
+            elif self._maybe_retry_actor_task(spec):
+                return
+            else:
+                self._record_task_event(spec, node, "FAILED")
+                self.task_manager.mark_failed(spec)
+                self._commit_error_everywhere(spec, error)
+                self._emit_task_spans(spec, "FAILED")
+            self._after_commit(spec)
             return
+        if spec._hedge is not None and not self.watchdog.arbitrate(spec, error):
+            # hedge loser (or an error suppressed in favor of its live
+            # sibling): this completion is discarded ENTIRELY — the winning
+            # attempt owns the returns, the terminal event, the retries
+            return
+        if spec._deadline_fired and spec.num_returns != "streaming":
+            # once the deadline fired, the outcome IS DeadlineExceededError
+            # regardless of how the attempt ended; claim the terminal right
+            # (the watchdog's direct-fail paths race this completion)
+            if not self.task_manager.claim(spec):
+                return
+            error = self.watchdog.deadline_error(spec)
         if error is not None:
             from ray_tpu.exceptions import OutOfMemoryError, TaskCancelledError
 
-            if spec._cancelled and not isinstance(error, TaskCancelledError):
+            if spec._cancelled and not isinstance(
+                error, (TaskCancelledError, DeadlineExceededError)
+            ):
                 # a force-cancel kills the hosting worker: the death must
                 # surface as cancellation, not WorkerCrashedError, and must
                 # never retry
@@ -1414,6 +1594,9 @@ class Cluster:
         # from rt.get (or a shutdown snapshot racing this thread) must
         # already see the task's terminal record.
         self._record_task_event(spec, node, "FINISHED")
+        if self.watchdog.auto_on and spec.actor_id is None and spec.submit_time:
+            # per-SchedulingKey latency EWMA feed for the auto-hedge mode
+            self.watchdog.observe_latency(spec, time.time() - spec.submit_time)
         if lazy:
             # values live in the remote node's store; record locations only
             for oid in spec.return_ids:
@@ -1591,6 +1774,7 @@ class Cluster:
             self.directory.add_location(oid, node.node_id)
 
     def _after_commit(self, spec: TaskSpec) -> None:
+        self.watchdog.on_terminal(spec)
         if self.core_worker is not None:
             self.core_worker.on_task_committed(spec)
 
@@ -2006,6 +2190,7 @@ class Cluster:
         # p2p state the moment we start clearing it
         self._snapshot_stop.set()
         self.lease_manager.stop()
+        self.watchdog.stop()
         p2p.clear_endpoint()
         # collective groups/counters index this runtime incarnation; a
         # survivor would desync the next init against fresh-born peers

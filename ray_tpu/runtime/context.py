@@ -39,6 +39,48 @@ class _TaskContext:
 task_context = _TaskContext()
 
 
+# --------------------------------------------------------------------------
+# end-to-end deadline propagation (.options(deadline_s=...)): the executing
+# task's absolute deadline rides a contextvar so nested submissions inherit
+# the REMAINING budget (min'd with their own) and deadline-bearing blocking
+# calls can pass it instead of flat defaults.  Crosses process boundaries by
+# riding the worker payload (worker_main re-installs it around execution).
+# --------------------------------------------------------------------------
+_deadline_ts: "contextvars.ContextVar[Optional[float]]" = contextvars.ContextVar(
+    "rt_deadline_ts", default=None
+)
+
+
+def push_deadline(deadline_ts: Optional[float]):
+    """Install the executing task's absolute deadline (wall-clock seconds);
+    returns a token for :func:`pop_deadline`.  None is a no-op install so
+    callers need no branching."""
+    return _deadline_ts.set(deadline_ts)
+
+
+def pop_deadline(token) -> None:
+    try:
+        _deadline_ts.reset(token)
+    except ValueError:
+        pass  # token from another Context copy (async hand-off)
+
+
+def current_deadline_ts() -> Optional[float]:
+    return _deadline_ts.get()
+
+
+def remaining_budget(default: Optional[float] = None) -> Optional[float]:
+    """Seconds left on the executing task's deadline, or ``default`` when
+    no deadline is in scope.  Never negative (an expired budget returns 0
+    so blocking calls fail fast instead of hanging a full default)."""
+    import time as _time
+
+    ts = _deadline_ts.get()
+    if ts is None:
+        return default
+    return max(0.0, ts - _time.time())
+
+
 class RuntimeContext:
     """User-facing runtime context (ray.get_runtime_context() parity)."""
 

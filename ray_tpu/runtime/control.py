@@ -119,6 +119,9 @@ class NodeInfo:
         self.state = NodeState.ALIVE
         self.last_heartbeat = time.monotonic()
         self.missed_heartbeats = 0
+        # incarnation granted at registration (gray-failure fencing): frames
+        # stamped with an OLDER incarnation of this node id are rejected
+        self.incarnation = 0
 
 
 class NodeTable:
@@ -126,9 +129,38 @@ class NodeTable:
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._pubsub = pubsub
+        # node_id bytes -> last granted incarnation.  Monotonic per node id
+        # for the life of the cluster (persisted across head restarts): a
+        # re-registration ALWAYS gets a higher incarnation, so frames from
+        # the previous epoch of the same node id are detectably stale.
+        self._incarnations: Dict[bytes, int] = {}
+
+    def next_incarnation(self, node_id: NodeID) -> int:
+        """Mint the next incarnation for this node id (registration time)."""
+        with self._lock:
+            key = node_id.binary()
+            inc = self._incarnations.get(key, 0) + 1
+            self._incarnations[key] = inc
+            return inc
+
+    def incarnation_of(self, node_id: NodeID) -> int:
+        """The CURRENT (authoritative) incarnation of a node id; frames
+        carrying anything else are fenced."""
+        with self._lock:
+            return self._incarnations.get(node_id.binary(), 0)
+
+    def incarnation_snapshot(self) -> Dict[bytes, int]:
+        with self._lock:
+            return dict(self._incarnations)
+
+    def restore_incarnations(self, data: Dict[bytes, int]) -> None:
+        with self._lock:
+            for key, inc in (data or {}).items():
+                self._incarnations[key] = max(self._incarnations.get(key, 0), int(inc))
 
     def register(self, info: NodeInfo) -> None:
         with self._lock:
+            info.incarnation = self._incarnations.get(info.node_id.binary(), 0)
             self._nodes[info.node_id] = info
         self._pubsub.publish("node", ("ALIVE", info.node_id))
 
@@ -421,6 +453,9 @@ class ControlService:
             # failpoint hit counters + fault log: same-seed chaos fault logs
             # must stay byte-identical THROUGH a head restart
             "failpoints": failpoints.snapshot_state(),
+            # incarnation counters: a restarted head must never re-mint an
+            # incarnation a fenced epoch already held, or fencing breaks
+            "node_incarnations": self.nodes.incarnation_snapshot(),
         }
 
     _snapshot_write_lock = threading.Lock()
@@ -553,6 +588,7 @@ class ControlService:
         # resume the failpoint decision streams exactly where the dead head
         # left them (counters merge forward; a no-op when nothing was armed)
         failpoints.restore_state(state.get("failpoints") or {})
+        self.nodes.restore_incarnations(state.get("node_incarnations") or {})
         return True
 
     # health-check loop (GcsHealthCheckManager parity)

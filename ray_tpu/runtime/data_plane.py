@@ -90,6 +90,49 @@ class DataPlaneError(ConnectionError):
     pass
 
 
+# --------------------------------------------------------------------------
+# incarnation fencing on the data plane (gray failures, ISSUE 8): channel
+# frames stamp their source (node hex, incarnation); once the head fences a
+# node it broadcasts ``peer_fenced`` and every data server rejects frames
+# from that node id.  Fenced node ids never serve again (a healed agent
+# rejoins under a FRESH id), so a plain set suffices.
+# --------------------------------------------------------------------------
+_fence_lock = threading.Lock()
+_fenced_sources: set = set()            # node hex strings
+_local_source: Optional[tuple] = None   # (node_hex, incarnation) of THIS process
+
+
+def set_local_source(node_hex: str, incarnation: int) -> None:
+    global _local_source
+    with _fence_lock:
+        _local_source = (node_hex, int(incarnation))
+
+
+def local_source() -> Optional[tuple]:
+    with _fence_lock:
+        return _local_source
+
+
+def fence_source(node_hex: str) -> None:
+    with _fence_lock:
+        _fenced_sources.add(node_hex)
+
+
+def source_fenced(src) -> bool:
+    if not src:
+        return False
+    with _fence_lock:
+        return src[0] in _fenced_sources
+
+
+def reset_fencing() -> None:
+    """Test/shutdown hook: forget fenced sources and the local stamp."""
+    global _local_source
+    with _fence_lock:
+        _fenced_sources.clear()
+        _local_source = None
+
+
 class ObjectNotFound(DataPlaneError):
     pass
 
@@ -624,6 +667,18 @@ class DataServer:
         meta = _recv_exact(sock, req["meta_size"])
         buffers = [_recv_into_buffer(sock, size) for size in req["buffer_sizes"]]
         nbytes = req["meta_size"] + sum(req["buffer_sizes"])
+        if source_fenced(req.get("src")):
+            # stale incarnation pushing channel frames (a partitioned agent
+            # whose plan streams stayed connected peer-to-peer): the frame
+            # bytes were drained above to keep the stream parseable, but
+            # the value must never reach a consumer slot
+            from ray_tpu.observability import metric_defs
+
+            metric_defs.FENCED_FRAMES.inc(tags={"kind": "chan_push"})
+            _send_header(
+                sock, {"ok": False, "fenced": True, "error": "fenced: stale incarnation"}
+            )
+            return
         try:
             value = from_frames(meta, buffers)
         except Exception as exc:  # noqa: BLE001 — poisoned frame: nack, keep the stream
@@ -1229,7 +1284,7 @@ class ChannelStream:
                 _send_header(
                     sock,
                     {"op": "chan_push", "plan": self.plan_id, "chan": self.chan,
-                     "seq": seq, "is_error": is_error,
+                     "seq": seq, "is_error": is_error, "src": local_source(),
                      "meta_size": len(meta), "buffer_sizes": sizes},
                 )
                 sock.sendall(meta)

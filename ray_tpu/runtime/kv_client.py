@@ -48,19 +48,29 @@ class _ControlKV(KVClient):
 
 
 class _RpcKV(KVClient):
-    """Agent-side: KV ops over the head connection."""
+    """Agent-side: KV ops over the head connection.  Timeouts retry with
+    deterministic jittered backoff (rpc.retry_with_backoff): the KV carries
+    gang/collective rendezvous metadata, where one slow control round under
+    load must not abort a whole rendezvous that would succeed on retry."""
 
     def __init__(self, conn):
         self._conn = conn
 
+    def _request(self, msg: str, payload: dict) -> dict:
+        from ray_tpu.runtime import rpc
+
+        return rpc.retry_with_backoff(
+            lambda: self._conn.request(msg, payload), salt=f"kv:{msg}"
+        )
+
     def put(self, key: bytes, value: bytes) -> None:
-        self._conn.request("kv_put", {"key": key, "value": value})
+        self._request("kv_put", {"key": key, "value": value})
 
     def get(self, key: bytes) -> Optional[bytes]:
-        return self._conn.request("kv_get", {"key": key}).get("value")
+        return self._request("kv_get", {"key": key}).get("value")
 
     def delete(self, key: bytes) -> None:
-        self._conn.request("kv_del", {"key": key})
+        self._request("kv_del", {"key": key})
 
 
 class _WorkerKV(KVClient):

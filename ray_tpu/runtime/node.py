@@ -271,6 +271,11 @@ class Node:
         # inline — zero thread/process switches on the sync path).
         self._inproc_pending: Dict[bytes, TaskSpec] = {}
         self._inproc_lock = threading.Lock()
+        # chaos straggler injection (`slow_node` schedule kind / the hedging
+        # bench): every dispatch on this node sleeps this long ON AN
+        # EXECUTOR THREAD first.  A fixed deterministic delay — no failpoint
+        # decisions consumed, so seeded fault logs are unaffected.
+        self._chaos_delay_s = 0.0
         self.dead = False
 
     # ------------------------------------------------------------------
@@ -281,6 +286,8 @@ class Node:
         # Dependencies may live on other nodes: route waits through the
         # fabric's pull path instead of the raw local store.
         deps = [d for d in spec.dependencies if not self.store.contains(d)]
+        if deps:
+            spec._stage = "pulling"  # deadline attribution while deps move
         when_all(
             deps,
             lambda dep, done: self.cluster.pull_object(dep, self, done),
@@ -305,6 +312,7 @@ class Node:
     # ------------------------------------------------------------------
     def _dispatch(self, spec: TaskSpec) -> None:
         spec.start_time = time.time()
+        spec._stage = "executing"
         if failpoints.ARMED:
             # chaos: a dispatch fault surfaces as a system error so the
             # normal retry machinery (should_retry, is_system_error=True)
@@ -324,6 +332,23 @@ class Node:
 
             self._commit(spec, None, TaskCancelledError(spec.task_id))
             return
+        if self._chaos_delay_s > 0.0:
+            # slow-node chaos: park on an executor thread (the submitting
+            # thread must never sleep), then resume the normal dispatch
+            self.executor.submit(self._delayed_dispatch, spec, self._chaos_delay_s)
+            return
+        self._dispatch_modes(spec)
+
+    def _delayed_dispatch(self, spec: TaskSpec, delay: float) -> None:
+        time.sleep(delay)
+        if spec._cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            self._commit(spec, None, TaskCancelledError(spec.task_id))
+            return
+        self._dispatch_modes(spec)
+
+    def _dispatch_modes(self, spec: TaskSpec) -> None:
         if spec.num_returns == "streaming":
             # streaming generators run on the in-process executor: items
             # commit through direct calls into the owner's stream, which a
@@ -357,15 +382,26 @@ class Node:
 
     def cancel_task(self, spec: TaskSpec, force: bool = False) -> None:
         """Running-task cancellation.  A queued inproc task is claimed and
-        committed cancelled immediately; with ``force`` a task running in a
-        process worker has its worker killed (the commit path maps the death
-        to TaskCancelledError via spec._cancelled)."""
+        committed cancelled immediately; a resource-queued task is pulled
+        straight out of the local scheduler (its resources were never
+        acquired, so no release); with ``force`` a task running in a
+        process worker has its worker killed (the commit path maps the
+        death to TaskCancelledError / DeadlineExceededError via the spec
+        flags)."""
         task_bin = spec.task_id.binary()
         claimed = self._claim_inproc(task_bin)
         if claimed is not None:
             from ray_tpu.exceptions import TaskCancelledError
 
             self._commit(claimed, None, TaskCancelledError(claimed.task_id))
+            return
+        if self.scheduler.cancel_queued(spec):
+            from ray_tpu.exceptions import TaskCancelledError
+
+            # never dispatched: no resources to release — commit directly
+            self.cluster.on_task_finished(
+                self, spec, None, TaskCancelledError(spec.task_id)
+            )
             return
         if force and task_bin in self._proc_specs:
             self.worker_pool.kill_task_worker(task_bin)
@@ -455,17 +491,20 @@ class Node:
         return args, kwargs
 
     def _run_inproc(self, spec: TaskSpec) -> None:
-        from ray_tpu.runtime.context import task_context
+        from ray_tpu.runtime.context import pop_deadline, push_deadline, task_context
 
         try:
             args, kwargs = self._resolve_args(spec)
-            # propagate the executing task id for nested submissions/puts
+            # propagate the executing task id for nested submissions/puts,
+            # and the deadline so nested calls inherit the remaining budget
             token = task_context.push(spec.task_id, self.node_id)
+            dtoken = push_deadline(spec.deadline_ts)
             t0 = time.perf_counter()
             try:
                 with tracing.task_span(f"execute::{spec.name}", spec.trace_ctx):
                     result = spec.func(*args, **kwargs)
             finally:
+                pop_deadline(dtoken)
                 task_context.pop(token)
                 if spec.execution == "auto":
                     self._profile_task(spec.func, time.perf_counter() - t0)
@@ -545,6 +584,9 @@ class Node:
             # leased shapes pin a warm worker (keyed by function identity)
             # so repeat dispatches hit a hot process without pool churn
             lease_key=fn_id if spec._leased else None,
+            # the worker installs the deadline around execution so nested
+            # submissions from inside the task inherit the remaining budget
+            deadline_ts=spec.deadline_ts,
         )
 
     def _handle_worker_api(self, task_bin, blob: bytes, op: str = "", worker_key=None) -> bytes:

@@ -262,10 +262,14 @@ class RemoteNodeHandle:
     def __init__(self, cluster, conn: rpc.RpcConnection, node_id: NodeID,
                  resources: Dict[str, float], labels: Optional[dict], address: str,
                  data_address: Optional[str] = None,
-                 data_client=None, transfer_pool=None):
+                 data_client=None, transfer_pool=None, incarnation: int = 0):
         self.cluster = cluster
         self.conn = conn
         self.node_id = node_id
+        # the incarnation granted to THIS registration: frames arriving on
+        # this connection with a different stamp — or after a newer
+        # incarnation of the same node id registered — are fenced
+        self.incarnation = incarnation
         self.labels = labels or {}
         self.address = address
         self.data_address = data_address  # agent's bulk-transfer endpoint
@@ -440,20 +444,55 @@ class RemoteNodeHandle:
         finally:
             self.push_gate.release()
 
+    def _record_push_fence(self, spec: TaskSpec, inc, current) -> None:
+        metric_defs.FENCED_FRAMES.inc(tags={"kind": "push_result"})
+        self.cluster.record_fence_event(
+            {
+                "kind": "push_result",
+                "node": self.node_id.hex()[:8],
+                "incarnation": inc,
+                "current": current,
+                "task": spec.task_id.hex(),
+                "attempt": spec.attempt,
+            }
+        )
+
     def _on_push_reply(self, spec: TaskSpec, header: dict, value) -> None:
         """Owner-side completion of a pushed task — the mirror of
         on_task_finished_msg, fed by data-plane frames instead of a head
         control RPC."""
+        src = header.get("src")
+        current = self.cluster.control.nodes.incarnation_of(self.node_id)
+        if src is not None and src[1] != current:
+            # push result stamped by a FENCED incarnation: the death sweep
+            # already owns this task (resubmission) — committing the stale
+            # result would be the exact split-brain fencing exists to stop.
+            self._record_push_fence(spec, src[1], current)
+            return
+        owner: "RemoteNodeHandle" = self
+        if self.dead:
+            live = self.cluster.nodes.get(self.node_id)
+            if src is None or live is None or live.dead:
+                # node genuinely dead: the sweep resolved / will resolve
+                # the pending spec; this straggler result is fenced
+                self._record_push_fence(spec, src[1] if src else None, current)
+                return
+            # rejoin-race migration: THIS handle was superseded mid-push,
+            # but the reply carries the CURRENT epoch's stamp — the result
+            # is live and the spec was migrated to the adopting handle.
+            # Commit through it; dropping here would strand the rt.get
+            # (no death sweep ever runs for a still-alive node id).
+            owner = live
         spans = header.get("spans")
         if spans:
             from ray_tpu.observability import tracing
 
             tracing.record_span_events(spans)
-        if self._untrack(spec.task_id.binary()) is None:
+        if owner._untrack(spec.task_id.binary()) is None:
             return  # already resolved (node-death resubmission raced)
         if header.get("error") is not None:
             error, _ = rpc.decode_value(header["error"])
-            self.cluster.on_task_finished(self, spec, None, error)
+            self.cluster.on_task_finished(owner, spec, None, error)
             return
         if header.get("lazy"):
             device_returns = list(header.get("device_returns", ()))
@@ -466,13 +505,13 @@ class RemoteNodeHandle:
                     self.cluster.directory.record_meta(
                         oid, sizes[i], "device" if on_device else "host"
                     )
-            self.cluster.on_task_finished(self, spec, None, None, lazy=True)
+            self.cluster.on_task_finished(owner, spec, None, None, lazy=True)
             return
         # the agent stored the returns locally before replying: mark them
         # so the owner-side cache put doesn't echo the bytes back
         for oid in spec.return_ids:
-            self.store.skip_push_once(oid)
-        self.cluster.on_task_finished(self, spec, value, None)
+            owner.store.skip_push_once(oid)
+        self.cluster.on_task_finished(owner, spec, value, None)
 
     def create_actor(self, spec: TaskSpec, mode: str, max_concurrency: int = 1) -> None:
         self._track(spec)
@@ -814,17 +853,59 @@ class HeadService:
                     conn.close()
 
     # ------------------------------------------------------------------
+    # incarnation fencing (gray failures, ISSUE 8): every state-bearing
+    # frame from an agent is checked against the AUTHORITATIVE incarnation
+    # of its node id before it can touch cluster state.  A stale frame —
+    # from a dead handle, or stamped with an older incarnation after the
+    # node re-registered — is dropped, counted, audited, and answered with
+    # a one-way typed ``fenced`` notice so the sender can self-fence.
+    # ------------------------------------------------------------------
+    def _fence_guard(self, conn: rpc.RpcConnection, payload: dict, kind: str):
+        handle: Optional[RemoteNodeHandle] = conn.peer
+        if handle is None:
+            return None
+        frame_inc = payload.pop("inc", handle.incarnation)
+        current = self.cluster.control.nodes.incarnation_of(handle.node_id)
+        if not handle.dead and frame_inc == current:
+            return handle
+        metric_defs.FENCED_FRAMES.inc(tags={"kind": kind})
+        task = payload.get("task_id")
+        self.cluster.record_fence_event(
+            {
+                "kind": kind,
+                "node": handle.node_id.hex()[:8],
+                "incarnation": frame_inc,
+                "current": current,
+                "task": task.hex() if isinstance(task, bytes) else None,
+            }
+        )
+        try:
+            conn.send("fenced", {"kind": kind, "incarnation": frame_inc})
+        except rpc.RpcError:
+            pass  # sender already gone; nothing to notify
+        return None
+
+    def _guarded(self, kind: str, method: str):
+        def handler(conn, payload):
+            handle = self._fence_guard(conn, payload, kind)
+            if handle is not None:
+                getattr(handle, method)(payload)
+
+        return handler
+
     def _handlers_for(self, conn: rpc.RpcConnection) -> dict:
         return {
             "register_node_config": self._h_register_config,
             "register_node": self._h_register,
-            "task_finished": lambda c, p: c.peer.on_task_finished_msg(p),
-            "stream_item": lambda c, p: c.peer.on_stream_item_msg(p),
-            "stream_done": lambda c, p: c.peer.on_stream_done_msg(p),
-            "actor_created": lambda c, p: c.peer.on_actor_created_msg(p),
-            "actor_creation_failed": lambda c, p: c.peer.on_actor_creation_failed_msg(p),
-            "actor_died": lambda c, p: c.peer.on_actor_died_msg(p),
-            "resource_report": lambda c, p: c.peer.on_resource_report(p),
+            "task_finished": self._guarded("task_finished", "on_task_finished_msg"),
+            "stream_item": self._guarded("stream_item", "on_stream_item_msg"),
+            "stream_done": self._guarded("stream_done", "on_stream_done_msg"),
+            "actor_created": self._guarded("actor_lifecycle", "on_actor_created_msg"),
+            "actor_creation_failed": self._guarded(
+                "actor_lifecycle", "on_actor_creation_failed_msg"
+            ),
+            "actor_died": self._guarded("actor_lifecycle", "on_actor_died_msg"),
+            "resource_report": self._guarded("resource_report", "on_resource_report"),
             "plan_broken": self._h_plan_broken,
             "pull_object": self._h_pull_object,
             "locate_object": self._h_locate_object,
@@ -857,19 +938,61 @@ class HeadService:
         }
 
     def _h_register(self, conn: rpc.RpcConnection, payload: dict, rid: int) -> dict:
-        handle = RemoteNodeHandle(
-            self.cluster, conn, NodeID(payload["node_id"]),
-            resources=payload["resources"],
-            labels=payload.get("labels"),
-            address=payload.get("address", "?"),
-            data_address=payload.get("data_address"),
-            data_client=self.data_client,
-            transfer_pool=self._transfer_pool,
-        )
-        handle.push_pool = self._push_pool
-        handle.push_gate = self._push_gate
-        conn.peer = handle
-        self.cluster.register_remote_node(handle)
+        node_id = NodeID(payload["node_id"])
+        cluster = self.cluster
+        from ray_tpu.runtime.control import NodeState
+
+        with cluster._node_lifecycle_lock:
+            old = cluster.nodes.get(node_id)
+            info = cluster.control.nodes.get(node_id)
+            # fenced only when the node id is KNOWN dead: a rejoin against a
+            # RESTARTED head legitimately finds no record at all (node
+            # liveness is process state, rebuilt from the living — PR 6),
+            # and must be re-adopted, not fenced
+            known_dead = (old is not None and old.dead) or (
+                info is not None and info.state is NodeState.DEAD
+            )
+            if payload.get("rejoin") and known_dead:
+                # The death sweep already ran for this node id (health-check
+                # kill during a partition): its pending work was resubmitted
+                # and its objects recovered around.  Re-adopting the stale
+                # incarnation would let it double-commit — refuse with a
+                # typed fenced reply; the agent self-fences and joins FRESH.
+                metric_defs.FENCED_FRAMES.inc(tags={"kind": "register"})
+                cluster.record_fence_event(
+                    {"kind": "register", "node": node_id.hex()[:8]}
+                )
+                return {"fenced": True}
+            incarnation = cluster.control.nodes.next_incarnation(node_id)
+            handle = RemoteNodeHandle(
+                cluster, conn, node_id,
+                resources=payload["resources"],
+                labels=payload.get("labels"),
+                address=payload.get("address", "?"),
+                data_address=payload.get("data_address"),
+                data_client=self.data_client,
+                transfer_pool=self._transfer_pool,
+                incarnation=incarnation,
+            )
+            handle.push_pool = self._push_pool
+            handle.push_gate = self._push_gate
+            conn.peer = handle
+            cluster._register_remote_node_locked(handle)
+            if old is not None and old is not handle and not old.dead:
+                # Transient-disconnect rejoin that BEAT the old connection's
+                # death sweep: adopt the in-flight specs the agent kept
+                # running (their completions will arrive on THIS connection)
+                # and fence the superseded epoch so any straggler frames on
+                # the old socket are rejected.
+                with old._inflight_lock:
+                    migrated, old._inflight = dict(old._inflight), {}
+                with handle._inflight_lock:
+                    handle._inflight.update(migrated)
+                old.dead = True
+        if payload.get("refenced"):
+            # a previously-fenced agent completed its self-fence and joined
+            # as a fresh node — the partition-heal rejoin, healthy again
+            metric_defs.NODE_REJOINS.inc()
         if payload.get("rejoin"):
             # Head-restart reconciliation: the agent kept its actors alive
             # across our outage — rebuild routing state for the ones the
@@ -879,7 +1002,7 @@ class HeadService:
             self.cluster.reconcile_rejoined_actors(
                 handle, [ActorID(b) for b in payload.get("actors", ())]
             )
-        return {}
+        return {"incarnation": incarnation}
 
     def _h_locate_object(self, conn: rpc.RpcConnection, payload: dict, rid: int):
         """Address-book lookup: resolve an ObjectID to a peer's data-plane
@@ -936,9 +1059,12 @@ class HeadService:
 
     def _h_object_location(self, conn: rpc.RpcConnection, payload: dict) -> None:
         """Metadata notice after a direct peer pull: the agent now holds a
-        copy — record it so future consumers/recovery see this location."""
-        handle: RemoteNodeHandle = conn.peer
-        if handle is None or handle.dead:
+        copy — record it so future consumers/recovery see this location.
+        Fence-guarded: a stale incarnation committing object locations is
+        the canonical split-brain write (a consumer routed to it would read
+        from a store the death sweep already recovered around)."""
+        handle = self._fence_guard(conn, payload, "object_location")
+        if handle is None:
             return
         self.cluster.directory.commit_placement(
             ObjectID(payload["oid"]), handle.node_id,
@@ -948,9 +1074,10 @@ class HeadService:
     def _h_object_locations(self, conn: rpc.RpcConnection, payload: dict) -> None:
         """Coalesced location commits: one control frame carrying a BATCH
         of per-put notices — the head pays O(batches), not O(puts), for a
-        client's put stream (ISSUE 7 satellite)."""
-        handle: RemoteNodeHandle = conn.peer
-        if handle is None or handle.dead:
+        client's put stream (ISSUE 7 satellite).  Fence-guarded like the
+        single-notice path."""
+        handle = self._fence_guard(conn, payload, "object_location")
+        if handle is None:
             return
         for oid_bin, size, device in payload["locs"]:
             self.cluster.directory.commit_placement(
@@ -1020,7 +1147,10 @@ class HeadService:
         register ownership and pin it for the job's lifetime (the worker
         holds the ref but has no reference counter — same contract as
         worker_api._pin_refs on the relay path).  The BYTES stay on the
-        agent; its object_location notice records where."""
+        agent; its object_location notice records where.  Fence-guarded:
+        a fenced epoch must not mint owned oids."""
+        if self._fence_guard(conn, payload, "worker_api") is None:
+            return {"_exc": "fenced: stale incarnation"}
         from ray_tpu.core.object_ref import ObjectRef
         from ray_tpu.runtime.worker_api import _pin_refs
 
@@ -1057,9 +1187,13 @@ class HeadService:
     def _h_worker_api_async(self, conn: rpc.RpcConnection, payload: dict) -> None:
         """Fire-and-forget worker API op relayed from an agent (async
         submits, ref releases): processed inline — cheap, never blocking —
-        so the control connection's frame order carries through."""
+        so the control connection's frame order carries through.
+        Fence-guarded: these carry state mutations (nested submits, put
+        registrations) a stale incarnation must not land."""
         from ray_tpu.runtime import worker_api
 
+        if self._fence_guard(conn, payload, "worker_api") is None:
+            return
         peer = getattr(conn, "peer", None)
         worker_api.execute(
             self.cluster.core_worker, payload["blob"],
@@ -1070,7 +1204,12 @@ class HeadService:
         """Nested API call relayed from an agent's worker.  Served OFF the
         connection's dispatch thread: a blocking nested get must not stall
         the agent's task_finished messages — the very messages that resolve
-        it (deadlock otherwise)."""
+        it (deadlock otherwise).  Fence-guarded like the async twin: the
+        sync path carries the same mutation class (puts, submits) a stale
+        incarnation must not land — the typed error reply fails the fenced
+        worker's call instead of silently hanging it."""
+        if self._fence_guard(conn, payload, "worker_api") is None:
+            return {"_exc": "fenced: stale incarnation"}
         from ray_tpu.runtime import worker_api
 
         # pin accounting key: (agent node, worker pid) — unique per worker
@@ -1096,6 +1235,9 @@ class HeadService:
         return rpc.DEFER
 
     def _h_kv_put(self, conn, payload, rid=None):
+        # fenced epochs must not mutate rendezvous/collective metadata
+        if self._fence_guard(conn, payload, "kv") is None:
+            return {"_exc": "fenced: stale incarnation"}
         self.cluster.control.kv.put(
             payload["key"], payload["value"], overwrite=payload.get("overwrite", True)
         )
@@ -1105,6 +1247,8 @@ class HeadService:
         return {"value": self.cluster.control.kv.get(payload["key"])}
 
     def _h_kv_del(self, conn, payload, rid=None):
+        if self._fence_guard(conn, payload, "kv") is None:
+            return {"_exc": "fenced: stale incarnation"}
         self.cluster.control.kv.delete(payload["key"])
         return {}
 

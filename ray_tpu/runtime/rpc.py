@@ -44,7 +44,9 @@ from ray_tpu.runtime.protocol import FrameReader, send_msg as _send_msg
 #: shapes (the reference versions its protobuf schemas; pickle frames
 #: assume same-version-everywhere, so the version is checked EXPLICITLY at
 #: node registration instead of silently corrupting).
-PROTOCOL_VERSION = 4
+#: v5: node incarnations — registration replies carry ``incarnation`` and
+#: agent frames stamp ``inc``; heads fence stale incarnations.
+PROTOCOL_VERSION = 5
 
 #: Sentinel a handler returns to take ownership of replying later.
 DEFER = object()
@@ -56,6 +58,23 @@ class RpcError(ConnectionError):
 
 class RemoteHandlerError(RpcError):
     """The peer's handler raised; carries the remote traceback."""
+
+
+class ControlPlaneTimeout(RpcError, TimeoutError):
+    """A control-plane request ran out its time budget without a reply.
+
+    Typed (ISSUE 8 satellite) so callers can distinguish "the peer is slow
+    or partitioned" from "the connection died" (:class:`RpcError` base) and
+    apply backoff-retry (:func:`retry_with_backoff`) or surface the
+    remaining deadline budget — a generic RpcError forced every caller to
+    string-match."""
+
+    def __init__(self, msg_type: str, timeout: Optional[float]):
+        self.msg_type = msg_type
+        self.timeout = timeout
+        super().__init__(
+            f"control-plane rpc {msg_type!r} timed out after {timeout}s"
+        )
 
 
 class FunctionNotCached(KeyError):
@@ -147,7 +166,7 @@ class RpcConnection:
             # late reply can't fire a stale callback.
             with self._pending_lock:
                 self._pending.pop(rid_box[0], None)
-            raise RpcError(f"rpc {msg_type} timed out after {timeout}s")
+            raise ControlPlaneTimeout(msg_type, timeout)
         if result[1] is not None:
             raise result[1]
         return result[0]
@@ -359,6 +378,69 @@ class RpcServer:
             conn.close()
 
 
+def _jitter_factor(salt: str, attempt: int) -> float:
+    """Deterministic jitter in [0.5, 1.0): a pure hash of (salt, attempt),
+    NOT a shared PRNG — retry timing stays reproducible under seeded chaos
+    (the same contract failpoint decisions follow)."""
+    import hashlib
+
+    h = hashlib.blake2b(f"{salt}:{attempt}".encode(), digest_size=8).digest()
+    return 0.5 + (int.from_bytes(h, "little") / 2.0**64) * 0.5
+
+
+def retry_with_backoff(
+    fn: Callable[[], Any],
+    attempts: Optional[int] = None,
+    base_backoff_s: Optional[float] = None,
+    max_backoff_s: Optional[float] = None,
+    retry_on: tuple = (ControlPlaneTimeout,),
+    deadline_ts: Optional[float] = None,
+    salt: str = "rpc",
+) -> Any:
+    """The ONE control-plane retry idiom: call ``fn`` up to ``attempts``
+    times, sleeping an exponentially-growing, deterministically-jittered
+    delay between tries.  Only exception types in ``retry_on`` retry —
+    the default retries timeouts but NOT connection death (a dead
+    connection needs the reconnect machinery, not a hot loop).
+    ``deadline_ts`` (absolute wall clock) bounds the whole dance: once the
+    budget cannot fit another attempt the last failure re-raises."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    attempts = attempts if attempts is not None else max(1, cfg.rpc_retry_max_attempts)
+    base = base_backoff_s if base_backoff_s is not None else cfg.rpc_retry_base_backoff_s
+    cap = max_backoff_s if max_backoff_s is not None else cfg.rpc_retry_max_backoff_s
+    import time as _time
+
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as exc:  # noqa: PERF203 — retries are the point
+            last = exc
+            if i == attempts - 1:
+                raise
+            delay = min(cap, base * (2 ** i)) * _jitter_factor(salt, i)
+            if deadline_ts is not None and _time.time() + delay >= deadline_ts:
+                raise
+            _time.sleep(delay)
+    raise last  # unreachable; keeps type checkers honest
+
+
+def request_with_budget(
+    conn: "RpcConnection", msg_type: str, payload: dict, default_timeout: float = 30.0
+) -> dict:
+    """Deadline-aware blocking request: a call made on behalf of a
+    deadline-bearing task passes the task's REMAINING budget as the rpc
+    timeout instead of the flat default, so a doomed call fails within the
+    caller's deadline rather than 30 s later (ISSUE 8 satellite)."""
+    from ray_tpu.runtime.context import remaining_budget
+
+    budget = remaining_budget(default=None)
+    timeout = default_timeout if budget is None else max(0.05, min(default_timeout, budget))
+    return conn.request(msg_type, payload, timeout=timeout)
+
+
 def connect(
     address: str,
     handlers: Dict[str, Callable],
@@ -411,6 +493,10 @@ def encode_spec(spec, fn_blob_fn, sent_fns: set) -> dict:
         # propagated trace context (tracing.py) — the agent's execute span
         # must parent to the task span minted on the submitting host
         "trace_ctx": spec.trace_ctx,
+        # end-to-end deadline rides the spec so the agent installs it
+        # around execution (nested submissions inherit remaining budget)
+        "deadline_ts": spec.deadline_ts,
+        "deadline_s": spec.deadline_s,
     }
     if spec.func is not None:
         fn_id, blob = fn_blob_fn(spec.func)
@@ -461,6 +547,8 @@ def decode_spec(d: dict, fn_cache: Dict[bytes, Any]):
     spec.retries_left = d["retries_left"]
     spec.attempt = d["attempt"]
     spec.trace_ctx = d.get("trace_ctx")
+    spec.deadline_ts = d.get("deadline_ts")
+    spec.deadline_s = d.get("deadline_s")
     return spec
 
 

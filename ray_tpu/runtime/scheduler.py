@@ -72,6 +72,8 @@ class TaskSpec:
         "attempt", "submit_time", "start_time", "_retry_exceptions", "_cancelled",
         "_oom_killed", "_stream_closed", "_actor_seq", "trace_ctx",
         "_leased", "_push_reply",
+        "deadline_ts", "deadline_s", "hedge_after_s",
+        "_stage", "_deadline_fired", "_deadline_stage", "_hedge",
     )
 
     def __init__(
@@ -132,6 +134,23 @@ class TaskSpec:
         # frames go back on the data-plane connection to the OWNER instead
         # of the head control channel (owner-routed results)
         self._push_reply = None
+        # end-to-end deadline (wall-clock absolute + the original budget for
+        # error messages); None = no deadline.  Stamped by CoreWorker.submit
+        # from .options(deadline_s=) min'd with any inherited parent budget.
+        self.deadline_ts = None
+        self.deadline_s = None
+        # hedged straggler retry threshold (.options(hedge_after_s=)); the
+        # watchdog launches a second attempt on a different node past it
+        self.hedge_after_s = None
+        # owner-side lifecycle stage for deadline attribution: parked /
+        # queued / pulling / executing (best-effort; remote nodes report
+        # coarser — the owner sees "queued" until completion)
+        self._stage = "queued"
+        self._deadline_fired = False
+        self._deadline_stage = None
+        # hedge-group handle while this spec participates in a hedged pair
+        # (watchdog._HedgeGroup); completions arbitrate first-commit-wins
+        self._hedge = None
 
 
 # --------------------------------------------------------------------------
@@ -216,8 +235,11 @@ class ClusterScheduler:
         with self._lock:
             return {nid: p for nid, p in self._pools.items() if self._alive.get(nid)}
 
-    def pick_node(self, spec: TaskSpec) -> Optional[NodeID]:
-        """Returns the chosen node, or None if currently infeasible."""
+    def pick_node(self, spec: TaskSpec, exclude=()) -> Optional[NodeID]:
+        """Returns the chosen node, or None if currently infeasible.
+        ``exclude`` removes specific nodes from every policy — hedged
+        retries use it to force the second attempt onto a DIFFERENT node
+        than the (possibly straggling) primary."""
         self.num_picks += 1
         cfg = get_config()
         strategy = spec.scheduling_strategy
@@ -229,7 +251,7 @@ class ClusterScheduler:
             alive = [
                 (nid, self._pools[nid])
                 for nid, ok in self._alive.items()
-                if ok and nid not in self._draining
+                if ok and nid not in self._draining and nid not in exclude
             ]
         if not alive:
             return None
@@ -708,6 +730,7 @@ class LocalScheduler:
         )
 
     def _enqueue_ready(self, spec: TaskSpec) -> None:
+        spec._stage = "queued"  # deps local; waiting on node resources
         dispatch_now = False
         with self._lock:
             if not self._ready and self._pool.acquire(spec.resources):
@@ -764,6 +787,17 @@ class LocalScheduler:
         refusing would deadlock the parent; the oversubscription lasts only
         until currently-running tasks finish."""
         self._pool.force_acquire(spec.resources)
+
+    def cancel_queued(self, spec: TaskSpec) -> bool:
+        """Remove a ready-queued (resources-waiting) task.  True iff it was
+        removed HERE — its resources were never acquired, so the caller
+        commits the cancellation without an on_task_done release."""
+        with self._lock:
+            try:
+                self._ready.remove(spec)
+                return True
+            except ValueError:
+                return False
 
     def queue_len(self) -> int:
         return len(self._ready)

@@ -104,6 +104,9 @@ class CoreWorker:
         execution: str = "auto",
         scheduling_strategy: Any = None,
         runtime_env: Optional[dict] = None,
+        deadline_s: Optional[float] = None,
+        hedge_after_s: Optional[float] = None,
+        _inherited_deadline_ts: Optional[float] = None,
         _task_id: Optional[bytes] = None,
     ) -> List[ObjectRef]:
         cfg = get_config()
@@ -139,6 +142,45 @@ class CoreWorker:
         )
         spec._retry_exceptions = retry_exceptions
         spec.trace_ctx = tracing.task_trace_context()
+        # end-to-end deadline: own budget min'd with the inherited parent
+        # budget (nested calls never outlive their parent's deadline).  The
+        # inherited value arrives explicitly from worker relays, or from
+        # the in-process deadline context for same-process nesting.
+        watchdog = self.cluster.watchdog
+        if (
+            deadline_s is not None or hedge_after_s is not None
+            or _inherited_deadline_ts is not None or watchdog.auto_on
+        ):
+            if deadline_s is not None and deadline_s <= 0:
+                raise ValueError("deadline_s must be > 0")
+            if hedge_after_s is not None and hedge_after_s <= 0:
+                raise ValueError("hedge_after_s must be > 0")
+            if streaming and (deadline_s is not None or hedge_after_s is not None):
+                # EXPLICIT options only: an inherited parent deadline must
+                # not make a nested streaming submission crash — it is
+                # silently unenforced for streams (already-yielded items
+                # cannot be un-delivered)
+                raise ValueError(
+                    "deadline_s / hedge_after_s are not supported for "
+                    "num_returns='streaming' tasks (already-yielded items "
+                    "cannot be un-delivered)"
+                )
+            if not streaming:
+                deadline_ts = None if deadline_s is None else time.time() + deadline_s
+                inherited = _inherited_deadline_ts
+                if inherited is None:
+                    from ray_tpu.runtime.context import current_deadline_ts
+
+                    inherited = current_deadline_ts()
+                if inherited is not None:
+                    deadline_ts = inherited if deadline_ts is None else min(deadline_ts, inherited)
+                spec.deadline_ts = deadline_ts
+                if deadline_ts is not None:
+                    spec.deadline_s = (
+                        deadline_s if deadline_s is not None
+                        else max(0.0, deadline_ts - time.time())
+                    )
+                spec.hedge_after_s = hedge_after_s
         metric_defs.TASKS_SUBMITTED.inc(tags=_NORMAL_TASK_TAGS)
         for oid in return_ids:
             self.ref_counter.add_owned_object(oid)
@@ -153,6 +195,10 @@ class CoreWorker:
             self.cluster.submit(spec)
             return gen
         self.cluster.task_manager.add_pending(spec)
+        if spec.deadline_ts is not None or spec.hedge_after_s is not None or watchdog.auto_on:
+            # tracked BEFORE submission so a deadline firing while the task
+            # parks on the demand queue is already enforced
+            watchdog.maybe_track(spec)
         self.cluster.submit(spec)
         return [ObjectRef(oid) for oid in return_ids]
 

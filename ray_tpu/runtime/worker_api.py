@@ -26,6 +26,9 @@ import itertools
 import pickle
 import threading
 from concurrent.futures import Future
+# py3.10: futures.TimeoutError is NOT the builtin TimeoutError (unified in
+# 3.11) — catching the wrong one lets Future.result timeouts escape
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, Optional
 
 #: ops whose handler may block awaiting other tasks -> release resources
@@ -95,6 +98,9 @@ def execute(core_worker, blob: bytes, decoded=None, worker_key=None) -> bytes:
                 execution=kw.get("execution", "auto"),
                 scheduling_strategy=kw.get("scheduling_strategy"),
                 runtime_env=kw.get("runtime_env"),
+                deadline_s=kw.get("deadline_s"),
+                hedge_after_s=kw.get("hedge_after_s"),
+                _inherited_deadline_ts=kw.get("_inherited_deadline_ts"),
             )
         elif op == "create_actor":
             result = core_worker.create_actor(
@@ -181,6 +187,9 @@ def _execute_async_submit(core_worker, op: str, kw: dict, worker_key) -> None:
                 execution=kw.get("execution", "auto"),
                 scheduling_strategy=kw.get("scheduling_strategy"),
                 runtime_env=kw.get("runtime_env"),
+                deadline_s=kw.get("deadline_s"),
+                hedge_after_s=kw.get("hedge_after_s"),
+                _inherited_deadline_ts=kw.get("_inherited_deadline_ts"),
                 _task_id=kw["task_id"],
             )
         else:
@@ -335,7 +344,24 @@ class WorkerApiClient:
         # op rides beside the blob so the node's blocking-op check never
         # needs to deserialize the (possibly huge) payload
         self._send(rid, _dumps((op, kw)), self._current_task(), op)
-        blob = fut.result()
+        # deadline-bearing tasks bound their blocking control calls by the
+        # REMAINING budget (plus slack so the owner-side enforcement — the
+        # typed DeadlineExceededError — normally wins the race) instead of
+        # waiting forever on a reply the deadline already doomed
+        from ray_tpu.runtime.context import remaining_budget
+
+        budget = remaining_budget(None)
+        if budget is None:
+            blob = fut.result()
+        else:
+            try:
+                blob = fut.result(budget + 2.0)
+            except FuturesTimeoutError:
+                with self._lock:
+                    self._pending.pop(rid, None)
+                from ray_tpu.runtime.rpc import ControlPlaneTimeout
+
+                raise ControlPlaneTimeout(op, budget + 2.0) from None
         # unpickle under reply capture: ObjectRef constructions here are
         # owner-pinned deliveries the release protocol must account for
         from ray_tpu.core.object_ref import hooks as _hooks
@@ -426,6 +452,15 @@ class WorkerApiClient:
     def submit_task(self, func, args, kwargs, **opts):
         num_returns = opts.get("num_returns", 1)
         task_bin = self._current_task()
+        if "_inherited_deadline_ts" not in opts:
+            # nested submission from a deadline-bearing task: ship the
+            # REMAINING budget to the owner (the deadline context was
+            # installed by worker_main around this task's execution)
+            from ray_tpu.runtime.context import current_deadline_ts
+
+            inherited = current_deadline_ts()
+            if inherited is not None:
+                opts["_inherited_deadline_ts"] = inherited
         if num_returns != "streaming" and task_bin is not None:
             # Fire-and-forget fast path: mint the task id HERE (ids are
             # random-unique — ownership stays with the driver), send the

@@ -366,6 +366,11 @@ class Worker:
         name = payload.get("name", "task")
         self._current.task = task_id
         ctx, token = self._push_task_context(task_id)
+        # end-to-end deadline: installed around execution so nested
+        # submissions from inside the task inherit the remaining budget
+        from ray_tpu.runtime.context import pop_deadline, push_deadline
+
+        dtoken = push_deadline(payload.get("deadline_ts"))
         try:
             fn = self._get_function(payload)
             args, kwargs = self._decode_args(payload)
@@ -394,6 +399,7 @@ class Worker:
                 reply["spans"] = spans
             self._reply("result", reply)
         finally:
+            pop_deadline(dtoken)
             self._current.task = None
             if token is not None:
                 ctx.pop(token)

@@ -450,6 +450,7 @@ class ProcessWorkerPool:
         runtime_env: Optional[dict] = None,
         trace: Optional[tuple] = None,
         lease_key: Optional[bytes] = None,
+        deadline_ts: Optional[float] = None,
     ) -> bool:
         """Run a stateless task on an idle worker; queues when saturated.
         Never blocks: pool growth happens on a spawner thread."""
@@ -467,18 +468,27 @@ class ProcessWorkerPool:
         if worker is None:
             with self._lock:
                 self._backlog.append(
-                    (task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env, trace)
+                    (task_id, name, fn_id, fn_blob, args_blob, callback,
+                     runtime_env, trace, deadline_ts)
                 )
             self._maybe_grow_async()
             return True
-        self._send_exec(worker, task_id, name, fn_id, fn_blob, args_blob, callback, runtime_env, trace)
+        self._send_exec(
+            worker, task_id, name, fn_id, fn_blob, args_blob, callback,
+            runtime_env, trace, deadline_ts,
+        )
         return True
 
     def _send_exec(self, worker, task_id, name, fn_id, fn_blob, args_blob, callback,
-                   runtime_env: Optional[dict] = None, trace: Optional[tuple] = None) -> None:
+                   runtime_env: Optional[dict] = None, trace: Optional[tuple] = None,
+                   deadline_ts: Optional[float] = None) -> None:
         payload = {"task_id": task_id, "name": name, "fn_id": fn_id, "args_blob": args_blob}
         if trace is not None:
             payload["trace"] = trace
+        if deadline_ts is not None:
+            # the worker re-installs the deadline around execution so
+            # nested submissions inherit the remaining budget
+            payload["deadline_ts"] = deadline_ts
         if runtime_env:
             # per-TASK runtime env: only the body-scoped keys travel —
             # process-level plugins (pip, conda, container, working_dir)
@@ -888,6 +898,20 @@ class ProcessWorkerPool:
         worker.death_done.set()
         with self._lock:
             self._all.pop(worker.pid, None)
+            try:
+                self._idle.remove(worker)
+            except ValueError:
+                pass
+            # unpin HERE: the reader thread's death handler early-returns on
+            # alive=False, so this path (memory-monitor OOM kill, force
+            # cancel) is the only one that can release the lease pin — a
+            # leaked pin kept a dead worker as the shape's "warm" worker
+            # until the next leased dispatch stumbled over it (ISSUE 8
+            # satellite: memory-kill / lease interaction)
+            if worker.lease_key is not None:
+                if self._lease_pins.get(worker.lease_key) is worker:
+                    del self._lease_pins[worker.lease_key]
+                worker.lease_key = None
         metric_defs.WORKER_POOL_DEATHS.inc()
         self._update_worker_gauges()
         try:

@@ -464,7 +464,22 @@ def cmd_nodes(args) -> int:
     for n in data.get("nodes", ()):
         head = " (head)" if n.get("is_head") else ""
         res = " ".join(f"{k}={v:g}" for k, v in sorted(n.get("resources", {}).items()))
-        print(f"  node {n['node_id'][:12]} {n['state']:9s}{head}  {res}")
+        inc = n.get("incarnation") or 0
+        inc_s = f"  inc={inc}" if inc else ""
+        print(f"  node {n['node_id'][:12]} {n['state']:9s}{head}  {res}{inc_s}")
+    if data.get("fenced_frames"):
+        kinds = ", ".join(
+            f"{k}={v}" for k, v in sorted(data.get("fenced_by_kind", {}).items())
+        )
+        print(f"fenced frames: {data['fenced_frames']} ({kinds})")
+    wd = data.get("watchdog") or {}
+    if wd.get("deadlines_fired") or wd.get("hedges_launched"):
+        print(
+            f"watchdog: {wd.get('deadlines_fired', 0)} deadlines fired, "
+            f"{wd.get('hedges_launched', 0)} hedges "
+            f"({wd.get('hedges_won', 0)} won / {wd.get('hedges_lost', 0)} lost, "
+            f"{wd.get('hedge_discards', 0)} stale commits discarded)"
+        )
     drains = data.get("drains", ())
     if drains:
         evac = sum(d.get("evacuated", 0) for d in drains)

@@ -1008,3 +1008,105 @@ def test_schedule_json_round_trip(tmp_path):
     assert loaded.duration() == 3.0
     with pytest.raises(ValueError, match="unknown chaos event kind"):
         ChaosEvent(0.0, "explode")
+
+
+# --------------------------------------------------------------------------
+# 12. partition-heal smoke matrix (ISSUE 8): a gray-partitioned node keeps
+#     executing after its death declaration — every commit from the fenced
+#     incarnation is rejected, the resubmitted attempts own the results,
+#     the healed (fresh) node serves new work, and the same-seed fault logs
+#     are byte-identical run to run, at THREE seeds.
+# --------------------------------------------------------------------------
+_PARTITION_HEAL_SCHEDULE = {
+    "name": "partition-heal",
+    "events": [
+        {"t": 0.0, "kind": "arm", "spec": "scheduler.dispatch=raise(0.08)"},
+        {"t": 0.2, "kind": "slow_node", "index": 0, "delay": 0.05},
+        {"t": 0.45, "kind": "partition_node", "index": 0},
+        {"t": 0.9, "kind": "heal_partition"},
+        {"t": 1.1, "kind": "disarm"},
+    ],
+}
+
+
+def _partition_heal_run(seed):
+    from ray_tpu import api
+    from ray_tpu.chaos.schedule import validate_schedule
+    from ray_tpu.observability import metric_defs
+    from ray_tpu.runtime.scheduler import NodeAffinitySchedulingStrategy
+
+    sched_dict = dict(_PARTITION_HEAL_SCHEDULE, seed=seed)
+    assert validate_schedule(sched_dict, num_nodes=1) == []
+    rt.init(num_cpus=1)
+    try:
+        cluster = api.get_cluster()
+        victim = cluster.add_node({"CPU": 2})
+        fences0 = len(cluster.fence_events)
+        schedule = ChaosSchedule.from_dict(sched_dict)
+
+        def workload():
+            @rt.remote(max_retries=6)
+            def bump(i):
+                time.sleep(0.12)
+                return i + 1
+
+            # soft affinity onto the victim: tasks are IN FLIGHT there when
+            # the partition lands, so the stale incarnation tries to commit
+            strat = NodeAffinitySchedulingStrategy(victim.node_id, soft=True)
+            return [bump.options(scheduling_strategy=strat).remote(i) for i in range(20)]
+
+        result = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        # the split-brain regression: the fenced incarnation DID try to
+        # commit, and every attempt was rejected (invariants 9/10 audited
+        # the directory + terminal records inside result.invariants)
+        assert len(cluster.fence_events) > fences0, "no fenced commit observed"
+        assert metric_defs.FENCED_FRAMES.get(tags={"kind": "task_finished"}) > 0
+        # the healed (fresh) node serves new work
+        fresh = [
+            n for n in cluster.nodes.values()
+            if not n.dead and n is not cluster.head_node
+        ]
+        assert fresh, "heal_partition never produced a fresh node"
+
+        @rt.remote
+        def after_heal(x):
+            return x * 10
+
+        strat = NodeAffinitySchedulingStrategy(fresh[0].node_id)
+        assert rt.get(after_heal.options(scheduling_strategy=strat).remote(4), timeout=30) == 40
+        return result
+    finally:
+        rt.shutdown()
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_schedule_partition_heal_matrix(seed):
+    """Seeded smoke matrix: each seed runs TWICE and must produce
+    byte-identical fault logs through the partition, the fencing, and the
+    heal (the chaos determinism contract survives gray failures)."""
+    r1 = _partition_heal_run(seed)
+    r2 = _partition_heal_run(seed)
+    assert r1.same_faults(r2), (r1.faults, r2.faults)
+
+
+def test_chaos_validate_cli_partition_heal(tmp_path, capsys):
+    """`rt chaos validate` schema-checks the new kinds end to end."""
+    import json as _json
+
+    from ray_tpu.chaos.schedule import validate_cli
+
+    path = str(tmp_path / "partition_heal.json")
+    with open(path, "w") as f:
+        _json.dump(dict(_PARTITION_HEAL_SCHEDULE, seed=1), f)
+
+    class Args:
+        schedule = path
+        nodes = 1
+
+    assert validate_cli(Args()) == 0
+    # a heal without a partition fails validation loudly
+    bad = {"seed": 1, "events": [{"t": 0.0, "kind": "heal_partition"}]}
+    with open(path, "w") as f:
+        _json.dump(bad, f)
+    assert validate_cli(Args()) == 1
