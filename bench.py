@@ -170,6 +170,11 @@ ROW_GROUPS = [
     # rescues the stragglers.  Own fresh-runtime group — it adds a node
     # and arms a chaos delay.
     ["hedged_tail_latency_p99"],
+    # goodput under 5x-capacity offered load through the serve admission
+    # spine (ISSUE 9): bounded queues shed with typed 429s instead of
+    # growing — value is goodput/capacity (~1.0 = graceful degradation).
+    # Own fresh-runtime group — it deploys a serve app.
+    ["overload_goodput"],
 ]
 
 
@@ -206,6 +211,7 @@ def main() -> None:
         "direct_dispatch_tasks_async",
         "direct_dispatch_actor_calls_async",
         "hedged_tail_latency_p99",
+        "overload_goodput",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

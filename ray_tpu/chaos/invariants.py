@@ -45,6 +45,17 @@ reintroduce the dead:
      terminal task event was recorded from a node a fence event rejected
      for that same task — the resubmitted attempt's result is the ONLY one
      visible.
+
+Overload invariant (ISSUE 9 tentpole) — bounded admission queues must shed
+correctly, never lose or duplicate work:
+
+ 11. **Sheds are typed and final**: every admitted request terminates
+     exactly once — value or typed error (checks 2 and 3 applied to the
+     merged workload + injector refs) — every shed request got the typed
+     ``OverloadedError`` signal (audited from ``cluster.overload_events``,
+     which only the typed-shed paths feed), and no shed task ever
+     executed (a shed task id with a FINISHED terminal record is a
+     shed-then-run double execution).
 """
 
 from __future__ import annotations
@@ -93,6 +104,7 @@ def snapshot_baseline() -> dict:
         "num_drain_reports": len(getattr(cluster, "drain_reports", ())),
         "num_plan_transitions": len(getattr(cluster, "plan_transitions", ())),
         "num_fence_events": getattr(cluster, "fence_events_total", 0),
+        "num_overload_events": getattr(cluster, "overload_events_total", 0),
     }
 
 
@@ -128,6 +140,9 @@ def _expected_errors() -> tuple:
             exc.ObjectLostError,
             exc.WorkerCrashedError,
             exc.TaskCancelledError,
+            exc.OverloadedError,
+            exc.StoreFullError,
+            exc.DeadlineExceededError,
             FailpointInjected,
         )
     return _EXPECTED_ERRORS_CACHE
@@ -379,4 +394,28 @@ def check_invariants(
                     f"terminal record from fenced node {ev['node']}"
                 )
     report.checked["fenced_tasks"] = len(fenced_tasks)
+
+    # 11. overload sheds are typed, attributed, and shed work never ran ------
+    overload_events = list(getattr(cluster, "overload_events", ()))
+    if baseline is not None:
+        # bounded deque: slice THIS run's tail by the monotonic total
+        delta = getattr(cluster, "overload_events_total", 0) - baseline.get(
+            "num_overload_events", 0
+        )
+        overload_events = overload_events[-delta:] if delta > 0 else []
+    finished_tasks = {
+        ev.get("task_id") for ev in events if ev.get("state") == "FINISHED"
+    }
+    for oe in overload_events:
+        if not oe.get("typed"):
+            report.add(f"shed WITHOUT the typed signal: {oe}")
+        if not oe.get("layer") or not oe.get("reason"):
+            report.add(f"unattributed overload shed: {oe}")
+        task = oe.get("task")
+        if task and task in finished_tasks:
+            report.add(
+                f"shed task {task[:8]} has a FINISHED terminal record — "
+                "shed-then-run double execution"
+            )
+    report.checked["overload_sheds"] = len(overload_events)
     return report

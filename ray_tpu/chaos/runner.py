@@ -66,6 +66,10 @@ class ChaosRunner:
     def __init__(self, schedule: ChaosSchedule, quiesce_timeout: float = 60.0):
         self.schedule = schedule
         self.quiesce_timeout = quiesce_timeout
+        # refs minted by `overload` injector events: resolved by the
+        # invariant sweep alongside the workload's refs, so every injected
+        # request provably terminates exactly once (value or typed error)
+        self._injected_refs: List[Any] = []
 
     # ------------------------------------------------------------------
     def run(self, workload: Callable[[], Any]) -> ChaosResult:
@@ -149,6 +153,16 @@ class ChaosRunner:
         if isinstance(value, list) and value and all(isinstance(r, ObjectRef) for r in value):
             refs = value
             result.workload_result = f"<{len(refs)} refs (resolved by invariant sweep)>"
+        if self._injected_refs:
+            if refs is None:
+                refs = self._injected_refs
+            else:
+                # extend IN PLACE: the sweep clears this list to drop the
+                # workload's pins, and the workload's own reference to it
+                # must drain too (a fresh merged list would leave the
+                # original pinning every ref past the refcount check)
+                refs.extend(self._injected_refs)
+            self._injected_refs = []
         result.invariants = _inv.check_invariants(
             refs=refs, baseline=baseline, timeout=self.quiesce_timeout
         )
@@ -240,7 +254,42 @@ class ChaosRunner:
             return cluster.restart_head()
         if event.kind == "lose_objects":
             return self._lose_objects(cluster, float(p.get("fraction", 0.5)))
+        if event.kind == "overload":
+            return self._inject_overload(
+                int(p.get("tasks", 32)),
+                float(p.get("cpus", 1.0)),
+                float(p.get("hold_s", 0.0)),
+            )
         return {}
+
+    def _inject_overload(self, tasks: int, cpus: float, hold_s: float) -> dict:
+        """Deterministic synthetic load burst: ``tasks`` submissions each
+        demanding ``cpus`` CPUs and holding them ``hold_s`` seconds.  No
+        failpoint decisions are consumed, so same-seed fault logs stay
+        byte-identical; what varies under overload is WHICH admission layer
+        sheds, and invariant 11 audits that every shed was typed and no
+        shed task executed.  Refs (including ones whose terminal state is
+        the committed OverloadedError) join the invariant sweep."""
+        import ray_tpu as rt
+        from ray_tpu.exceptions import OverloadedError
+
+        @rt.remote(num_cpus=cpus, max_retries=0)
+        def _overload_probe(i, hold):
+            if hold:
+                time.sleep(hold)
+            return i
+
+        admitted = shed_at_submit = 0
+        for i in range(tasks):
+            try:
+                self._injected_refs.append(_overload_probe.remote(i, hold_s))
+                admitted += 1
+            except OverloadedError:
+                # submission-layer shed: typed, raised before a ref was
+                # minted (queue-layer sheds commit the error to the ref
+                # instead, and resolve in the sweep)
+                shed_at_submit += 1
+        return {"tasks": tasks, "submitted": admitted, "shed_at_submit": shed_at_submit}
 
     def _lose_objects(self, cluster, fraction: float) -> dict:
         """Delete a seeded fraction of committed objects from every store,

@@ -51,6 +51,13 @@ Event kinds
 ``heal_partition``  the partition heals: the fenced node self-fences
                   (workers killed, store dropped, pins cleared) and a
                   FRESH node joins through the add_node elasticity path.
+``overload``      deterministic synthetic load injector: submit ``tasks``
+                  no-op tasks demanding ``cpus`` CPUs each and holding
+                  their slot ``hold_s`` seconds — offered load beyond the
+                  bounded admission queues must SHED with typed
+                  OverloadedError, never grow a queue or double-execute
+                  (invariant 11).  The injector's refs join the invariant
+                  sweep's resolution set.
 """
 
 from __future__ import annotations
@@ -61,7 +68,7 @@ from typing import Any, Dict, List, Optional
 _KINDS = (
     "arm", "disarm", "partition", "kill_node", "lose_objects",
     "add_node", "drain_node", "kill_head", "restart_head",
-    "slow_node", "partition_node", "heal_partition",
+    "slow_node", "partition_node", "heal_partition", "overload",
 )
 
 
@@ -159,6 +166,11 @@ _EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
     "slow_node": {"index": (False, (int,)), "delay": (False, (int, float))},
     "partition_node": {"index": (False, (int,))},
     "heal_partition": {},
+    "overload": {
+        "tasks": (False, (int,)),
+        "cpus": (False, (int, float)),
+        "hold_s": (False, (int, float)),
+    },
 }
 
 
@@ -243,6 +255,13 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
         if kind == "slow_node" and isinstance(ev.get("delay"), (int, float)) \
                 and ev["delay"] < 0:
             errors.append(f"{where} (slow_node): 'delay' must be >= 0")
+        if kind == "overload":
+            if isinstance(ev.get("tasks"), int) and ev["tasks"] < 1:
+                errors.append(f"{where} (overload): 'tasks' must be >= 1")
+            if isinstance(ev.get("cpus"), (int, float)) and ev["cpus"] <= 0:
+                errors.append(f"{where} (overload): 'cpus' must be > 0")
+            if isinstance(ev.get("hold_s"), (int, float)) and ev["hold_s"] < 0:
+                errors.append(f"{where} (overload): 'hold_s' must be >= 0")
         indexed.append((t, i, kind, ev))
 
     # timeline-order simulation: head liveness pairing + node-index bounds

@@ -243,6 +243,40 @@ class Config:
     rpc_retry_max_backoff_s: float = 2.0
     rpc_retry_max_attempts: int = 3
 
+    # ---- overload survival: admission control + load shedding (ISSUE 9) --
+    # Every waiting list between the ingress and the object store is
+    # bounded; offered load beyond a bound SHEDS with a typed
+    # OverloadedError carrying retry_after_s instead of growing a queue
+    # until something OOMs.  See docs/fault_tolerance.md "Overload &
+    # backpressure".
+    #
+    # Bound on the scheduler's parked demand queue (currently-infeasible
+    # tasks/actor creations waiting for capacity).  Parks beyond it shed.
+    demand_queue_max_entries: int = 4096
+    # Per-caller cap on in-flight (submitted, not yet terminal) normal
+    # tasks.  0 disables.  At the cap, submission follows
+    # task_submit_overload_policy: "block" waits (bounded by
+    # task_submit_block_timeout_s and the caller's remaining deadline
+    # budget) then sheds; "shed" rejects immediately.
+    max_inflight_tasks_per_caller: int = 0
+    task_submit_overload_policy: str = "block"
+    task_submit_block_timeout_s: float = 30.0
+    # Bounded spill tier: max bytes of disk the object store's spill tier
+    # may hold.  0 = unbounded (the pre-ISSUE-9 behavior).  When bounded, a
+    # put that cannot fit in host + disk budgets backpressures up to
+    # store_put_backpressure_timeout_s for deletions to free room, then
+    # raises a typed StoreFullError (it never half-commits).
+    object_store_max_disk_bytes: int = 0
+    store_put_backpressure_timeout_s: float = 5.0
+    # Default retry-after hint stamped on OverloadedError when a layer has
+    # no better estimate of when capacity frees up.
+    overload_retry_after_s: float = 1.0
+    # Max seconds a request may WAIT in the serve router's bounded queue
+    # (max_queued_requests >= 0) for a replica slot before shedding — a
+    # wedged replica must cost a typed 429, not a handle call that never
+    # returns.
+    router_queue_wait_timeout_s: float = 30.0
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             env_key = _ENV_PREFIX + f.name.upper()

@@ -34,7 +34,7 @@ import numpy as np
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError, StoreFullError
 from ray_tpu.observability import metric_defs
 
 
@@ -97,10 +97,24 @@ class ObjectStore:
         self._hbm_budget = hbm_budget if hbm_budget is not None else cfg.object_store_hbm_bytes or _auto_hbm_budget()
         self._host_budget = host_budget if host_budget is not None else cfg.object_store_host_bytes
         self._spill_dir = cfg.spill_dir
+        # bounded spill tier (overload survival, ISSUE 9): bytes currently
+        # spilled to disk, charged against object_store_max_disk_bytes when
+        # that knob is set.  A put that cannot fit host + disk budgets
+        # BACKPRESSURES on this condition (deletions notify it) up to
+        # store_put_backpressure_timeout_s, then raises StoreFullError —
+        # the spill tier never grows unbounded and never half-commits.
+        self._disk_used = 0
+        # bytes of gate-admitted puts not yet inserted: the admission check
+        # must count them or N concurrent puts each seeing the last free
+        # bytes would ALL pass and overshoot the budget N-fold
+        self._pending_put_bytes = 0
+        self._space = threading.Condition(self._lock)
         self.num_puts = 0
         self.num_gets = 0
         self.num_spills = 0
         self.num_restores = 0
+        self.num_backpressure_waits = 0
+        self.num_puts_shed = 0
         # per-node metric tag sets, prebuilt once (hot-path allocations);
         # the hosting Node calls set_metrics_tags with its node id
         self._tags: Optional[Dict[str, str]] = None
@@ -122,11 +136,24 @@ class ObjectStore:
             tier, size = Tier.DEVICE, _nbytes(value)
         else:
             tier, size = Tier.HOST, _nbytes(value)
+        reserved = False
+        if tier is Tier.HOST and size and not is_error:
+            # error tombstones always commit (a failed task's error must
+            # reach its getters even under memory pressure); data puts pay
+            # the admission gate when the spill tier is bounded
+            reserved = self._admit_put(object_id, size)
         entry = ObjectEntry(value, tier, size, is_error)
         with self._lock:
+            if reserved:
+                self._pending_put_bytes -= size  # reservation becomes the entry
             old = self._entries.get(object_id)
             if old is not None:
+                # overwriting frees the old entry's footprint INCLUDING its
+                # spill copy (the _admit_put gate already credited this
+                # room) and wakes backpressured puts, exactly like delete()
                 self._account_remove(old)
+                self._drop_spill_locked(object_id, old)
+                self._space.notify_all()
             self._entries[object_id] = entry
             self._entries.move_to_end(object_id)
             if tier is Tier.DEVICE:
@@ -151,6 +178,56 @@ class ObjectStore:
 
     def put_error(self, object_id: ObjectID, error: BaseException) -> None:
         self.put(object_id, error, is_error=True)
+
+    def _admit_put(self, object_id: ObjectID, size: int) -> bool:
+        """Backpressure gate for host-tier puts under a BOUNDED spill tier
+        (``object_store_max_disk_bytes > 0``; 0 keeps the historical
+        unbounded-spill behavior).  Blocks — waking on deletions — until
+        the put fits within host + disk budgets, for at most
+        ``store_put_backpressure_timeout_s``; then raises a typed
+        :class:`StoreFullError` having committed nothing.  On success the
+        size is RESERVED (``_pending_put_bytes``) until the entry inserts,
+        so concurrent admits cannot all claim the same free bytes; returns
+        True iff a reservation was taken."""
+        cfg = get_config()
+        disk_budget = cfg.object_store_max_disk_bytes
+        if disk_budget <= 0:
+            return False
+        waited = 0.0
+        deadline = None
+        with self._lock:
+            while True:
+                # an overwrite frees the old entry's footprint in the same
+                # commit; count that room as available
+                old = self._entries.get(object_id)
+                credit = (
+                    old.size
+                    if old is not None and old.tier in (Tier.HOST, Tier.DISK)
+                    else 0
+                )
+                footprint = self._host_used + self._disk_used + self._pending_put_bytes - credit
+                if footprint + size <= self._host_budget + disk_budget:
+                    self._pending_put_bytes += size
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + cfg.store_put_backpressure_timeout_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.num_puts_shed += 1
+                    if waited:
+                        metric_defs.STORE_PUT_BACKPRESSURE.observe(waited, tags=self._tags)
+                    from ray_tpu.runtime.admission import record_shed
+
+                    record_shed("store", "spill_full", task_id=object_id.hex())
+                    raise StoreFullError(waited_s=waited, needed=size)
+                if waited == 0.0:
+                    self.num_backpressure_waits += 1  # one per blocked put
+                t0 = time.monotonic()
+                self._space.wait(min(remaining, 0.1))
+                waited += time.monotonic() - t0
+        if waited:
+            metric_defs.STORE_PUT_BACKPRESSURE.observe(waited, tags=self._tags)
+        return True
 
     # ------------------------------------------------------------------ get
     def get_async(self, object_id: ObjectID) -> Future:
@@ -213,6 +290,20 @@ class ObjectStore:
                 for oid, e in self._entries.items()
             ]
 
+    def _drop_spill_locked(self, object_id: ObjectID, entry: ObjectEntry) -> None:
+        """Free an entry's spill copy (the ONE cleanup idiom for delete and
+        overwrite): pinned SHM segments unpin+delete, DISK files come off
+        the bounded-tier ledger and unlink."""
+        if entry.tier is Tier.SHM and self._shm is not None:
+            self._shm.unpin(object_id.binary())
+            self._shm.delete(object_id.binary())
+        elif entry.tier is Tier.DISK and entry.disk_path:
+            self._disk_used -= entry.size
+            try:
+                os.unlink(entry.disk_path)
+            except OSError:
+                pass
+
     # --------------------------------------------------------------- delete
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -220,14 +311,9 @@ class ObjectStore:
             if entry is None:
                 return
             self._account_remove(entry)
-            if entry.tier is Tier.SHM and self._shm is not None:
-                self._shm.unpin(object_id.binary())
-                self._shm.delete(object_id.binary())
-            elif entry.tier is Tier.DISK and entry.disk_path:
-                try:
-                    os.unlink(entry.disk_path)
-                except OSError:
-                    pass
+            self._drop_spill_locked(object_id, entry)
+            # room freed: wake puts blocked on the backpressure gate
+            self._space.notify_all()
 
     def fail_pending(self, object_id: ObjectID, error: BaseException) -> None:
         """Wake waiters with an error without storing a value."""
@@ -306,7 +392,12 @@ class ObjectStore:
                 return True
             except (MemoryError, FileExistsError):
                 pass
-        # disk fallback
+        # disk fallback — refused when the bounded spill tier has no room
+        # (the put-side backpressure gate owns the full-store story; an
+        # over-budget host just stays over until deletions land)
+        disk_budget = get_config().object_store_max_disk_bytes
+        if disk_budget > 0 and self._disk_used + entry.size > disk_budget:
+            return False
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, oid.hex())
         with open(path, "wb") as f:
@@ -315,6 +406,7 @@ class ObjectStore:
         entry.tier = Tier.DISK
         entry.disk_path = path
         self._host_used -= entry.size
+        self._disk_used += entry.size
         self.num_spills += 1
         metric_defs.OBJECT_STORE_SPILLS.inc(tags=self._spill_tags("disk"))
         return True
@@ -351,6 +443,7 @@ class ObjectStore:
             entry.value = value
             entry.tier = Tier.HOST
             self._host_used += entry.size
+            self._disk_used -= entry.size
             try:
                 os.unlink(entry.disk_path)
             except OSError:
@@ -376,10 +469,14 @@ class ObjectStore:
                 "hbm_budget": self._hbm_budget,
                 "host_used": self._host_used,
                 "host_budget": self._host_budget,
+                "disk_used": self._disk_used,
+                "disk_budget": get_config().object_store_max_disk_bytes,
                 "puts": self.num_puts,
                 "gets": self.num_gets,
                 "spills": self.num_spills,
                 "restores": self.num_restores,
+                "put_backpressure_waits": self.num_backpressure_waits,
+                "puts_shed": self.num_puts_shed,
             }
 
 

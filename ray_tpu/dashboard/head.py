@@ -8,6 +8,7 @@ event,healthz}`` REST surfaces + ``python/ray/util/state`` aggregation):
   GET  /api/nodes | /api/actors | /api/tasks | /api/objects
        /api/placement_groups        — state-API listings
   GET  /api/cluster_status          — resource totals/availability
+  GET  /api/overload                — admission bounds, queue depths, sheds
   GET  /api/events                  — structured event log
   GET  /api/summary/tasks|actors|objects
   GET  /metrics                     — Prometheus text exposition
@@ -187,6 +188,8 @@ class DashboardHead:
             req._send(200, self._lease_stats())
         elif path == "/api/autoscaler":
             req._send(200, self._autoscaler_status())
+        elif path == "/api/overload":
+            req._send(200, self.cluster.overload_snapshot())
         elif path == "/api/plans":
             req._send(200, self._plan_stats())
         elif path == "/api/memory":

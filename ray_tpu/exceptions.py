@@ -136,6 +136,60 @@ class FencedError(RayTpuError):
         )
 
 
+class OverloadedError(RayTpuError):
+    """Admission control shed this request: a bounded queue at ``layer`` was
+    full (or a per-caller cap was hit) and the request was rejected instead
+    of growing the queue.  Machine-readable ``retry_after_s`` tells the
+    caller when capacity is likely to exist again; the serve proxies map
+    this to HTTP 429 with a ``Retry-After`` header (gRPC:
+    RESOURCE_EXHAUSTED).  Shedding happens BEFORE any side effect — a shed
+    request never executed and is always safe to retry after the hint."""
+
+    def __init__(
+        self,
+        layer: str = "?",
+        reason: str = "queue_full",
+        retry_after_s: float = 1.0,
+        message: str | None = None,
+    ):
+        self.layer = layer
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            message
+            or f"overloaded at {layer} ({reason}); retry after {retry_after_s:.3g}s"
+        )
+
+    def __reduce__(self):
+        # str(self) rides along so layer detail (which replica/queue) is
+        # not lost crossing process/actor boundaries
+        return (
+            OverloadedError,
+            (self.layer, self.reason, self.retry_after_s, str(self)),
+        )
+
+
+class StoreFullError(RayTpuError):
+    """Every tier of the object store — host budget plus the bounded
+    disk/spill tier — is full, and the put's backpressure deadline expired
+    before deletions freed room.  The put committed NOTHING; the caller can
+    free references and retry, or treat it as an overload signal."""
+
+    def __init__(self, waited_s: float = 0.0, needed: int = 0, message: str | None = None):
+        self.waited_s = float(waited_s)
+        self.needed = int(needed)
+        super().__init__(
+            message
+            or (
+                f"object store full (spill tier at capacity); waited "
+                f"{waited_s:.2f}s for {needed} bytes of room"
+            )
+        )
+
+    def __reduce__(self):
+        return (StoreFullError, (self.waited_s, self.needed, str(self)))
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died."""
 

@@ -289,6 +289,42 @@ TASK_HEDGES = _reg.counter(
     "cancelled and its commits discarded by attempt fencing).",
 )
 
+# ---- overload survival: admission control + load shedding (ISSUE 9) ------
+REQUESTS_SHED = _reg.counter(
+    "requests_shed_total",
+    "Requests rejected by a bounded admission queue, by layer (router / "
+    "replica / engine / submission / demand_queue / store) and reason "
+    "(queue_full / token_budget / inflight_cap / block_timeout / "
+    "deadline_expired / disconnect) — every one carried a typed "
+    "OverloadedError (or the deadline/store equivalent) with retry_after_s.",
+)
+ADMISSION_QUEUE_DEPTH = _reg.gauge(
+    "admission_queue_depth",
+    "Current depth of a bounded admission queue, by layer — under overload "
+    "these saturate at their configured bounds instead of growing.",
+    "requests",
+)
+TENANT_ADMISSIONS = _reg.counter(
+    "tenant_admissions_total",
+    "Requests admitted past an admission boundary, by tenant — the "
+    "weighted-fairness witness (two competing tenants' admission rates "
+    "track their configured weights).",
+)
+STORE_PUT_BACKPRESSURE = _reg.histogram(
+    "store_put_backpressure_seconds",
+    "Time object-store puts spent blocked on a full host+spill tier "
+    "waiting for deletions to free room (bounded by "
+    "store_put_backpressure_timeout_s, then StoreFullError).",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+LLM_SLOTS_EVICTED = _reg.counter(
+    "llm_slots_evicted_total",
+    "LLM engine decode slots freed before stop/length, by reason "
+    "(disconnect = the streaming consumer went away; its slot returns to "
+    "the batch instead of decoding for nobody).",
+)
+
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
     "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
@@ -352,6 +388,11 @@ ALL_METRICS = [
     NODE_REJOINS,
     TASK_DEADLINE_EXCEEDED,
     TASK_HEDGES,
+    REQUESTS_SHED,
+    ADMISSION_QUEUE_DEPTH,
+    TENANT_ADMISSIONS,
+    STORE_PUT_BACKPRESSURE,
+    LLM_SLOTS_EVICTED,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

@@ -51,6 +51,9 @@ _ACTOR_DIRECT_TAGS = {"transport": "actor_direct"}
 # prebuilt fence tags (completion paths run per task)
 _FENCE_TASK_TAGS = {"kind": "task_finished"}
 
+# prebuilt admission tags (the park path can run per task under overload)
+_DEMAND_QUEUE_TAGS = {"layer": "demand_queue"}
+
 # How long a no-location, no-lineage object gets for an in-flight metadata
 # notice to land before it is tombstoned as lost.  Covers the control-vs-
 # data-plane ordering gap for worker-minted put refs that return through
@@ -334,6 +337,13 @@ class Cluster:
         # not grow it forever; fence_events_total keeps the true count
         self.fence_events: deque = deque(maxlen=4096)
         self.fence_events_total = 0
+        # overload audit log: every admission-control shed (layer, reason,
+        # task id) recorded by runtime/admission.py — chaos invariant 11
+        # verifies each one carried the typed signal and that no shed task
+        # ever executed.  BOUNDED like fence_events; the monotonic total
+        # keeps the true count for baseline-scoped slicing.
+        self.overload_events: deque = deque(maxlen=4096)
+        self.overload_events_total = 0
         # gray-partitioned nodes (declared dead, still running) awaiting a
         # heal_partition — see partition_node/heal_partition chaos hooks
         self._partitioned: List[tuple] = []
@@ -363,6 +373,15 @@ class Cluster:
         self._stream_lock = threading.Lock()  # serializes item commits vs force-close
         self._actor_specs: Dict[ActorID, TaskSpec] = {}      # creation specs
         self._actor_options: Dict[ActorID, dict] = {}
+        # actors whose CREATION was shed by admission control: calls to
+        # them surface this typed OverloadedError (with retry_after_s), not
+        # a generic ActorDiedError — the caller can actually retry later.
+        # BOUNDED like the other overload structures: sustained overload
+        # must not grow head memory O(total sheds); evicted entries fall
+        # back to the generic dead-actor error.
+        from collections import OrderedDict as _OrderedDict
+
+        self._actor_shed_errors: "_OrderedDict[ActorID, BaseException]" = _OrderedDict()
         # installed compiled execution plans (dag/plan.py): plan_id -> plan.
         # The node/actor death sweeps flip affected plans to BROKEN through
         # this registry; /api/plans and `rt plans` snapshot it.
@@ -1092,25 +1111,82 @@ class Cluster:
 
         Zero threads per entry: one drainer (started lazily, parked while
         the queue is empty) retries placement on resource events / a short
-        tick and fails entries past their deadline."""
+        tick and fails entries past their deadline.
+
+        The queue is BOUNDED (``demand_queue_max_entries``): offered load
+        past the bound sheds with a typed OverloadedError instead of
+        growing the parked set until the head OOMs.  A RE-park of an
+        already-parked entry (placement race) is exempt — it held a slot
+        moments ago; shedding it would turn a transient race into a loss."""
+        cfg = get_config()
+        bound = cfg.demand_queue_max_entries
+        timeout = cfg.infeasible_task_timeout_s if kind == "task" else 30.0
         spec._stage = "parked"
+        # demand registered BEFORE the entry appends (original ordering):
+        # the drainer pops it on placement, so adding it after could leak a
+        # phantom record the autoscaler keeps seeing; the shed path pops it
+        # right back
         with self._demand_lock:
             self._infeasible_demands[id(spec)] = spec.resources.to_dict()
-        timeout = (
-            get_config().infeasible_task_timeout_s if kind == "task" else 30.0
-        )
         with self._demand_cv:
-            deadline = self._park_deadlines.get(id(spec))
-            if deadline is None:
-                deadline = time.monotonic() + timeout
-                self._park_deadlines[id(spec)] = deadline
-            self._demand_entries.append([spec, kind, deadline])
-            if self._demand_thread is None or not self._demand_thread.is_alive():
-                self._demand_thread = threading.Thread(
-                    target=self._demand_drain_loop, name="demand-drain", daemon=True
-                )
-                self._demand_thread.start()
-            self._demand_cv.notify_all()
+            # bound check and append share ONE critical section — a
+            # check-then-act split would let concurrent parks overshoot the
+            # bound by the number of racing submitters
+            depth = len(self._demand_entries)
+            repark = id(spec) in self._park_deadlines
+            if bound > 0 and depth >= bound and not repark:
+                shed_depth = depth
+            else:
+                shed_depth = None
+                deadline = self._park_deadlines.get(id(spec))
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                    self._park_deadlines[id(spec)] = deadline
+                self._demand_entries.append([spec, kind, deadline])
+                depth = len(self._demand_entries)
+                if self._demand_thread is None or not self._demand_thread.is_alive():
+                    self._demand_thread = threading.Thread(
+                        target=self._demand_drain_loop, name="demand-drain", daemon=True
+                    )
+                    self._demand_thread.start()
+                self._demand_cv.notify_all()
+        if shed_depth is not None:
+            with self._demand_lock:
+                self._infeasible_demands.pop(id(spec), None)
+            self._shed_parked(spec, kind, shed_depth)
+            return
+        metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, _DEMAND_QUEUE_TAGS)
+
+    def _shed_parked(self, spec: TaskSpec, kind: str, depth: int) -> None:
+        """Terminal-commit a typed OverloadedError for work the bounded
+        demand queue refused.  Claim-based for tasks so a racing completion
+        or deadline fire loses atomically (terminal exactly once)."""
+        from ray_tpu.runtime import admission
+
+        error = admission.shed(
+            "demand_queue",
+            "queue_full",
+            task_id=spec.task_id.hex(),
+            message=(
+                f"demand queue at its {depth}-entry bound "
+                f"(demand_queue_max_entries); task {spec.name!r} shed"
+            ),
+        )
+        if kind == "task":
+            if not self.task_manager.claim(spec):
+                return  # something else already terminated it
+            self.task_manager.mark_failed(spec)
+            self._commit_error_everywhere(spec, error)
+            self._after_commit(spec)
+        else:
+            # the TYPED error travels to the waiting callers (a shed is an
+            # overload signal with retry_after_s, not an actor death), and
+            # is remembered so LATER calls to the never-created actor get
+            # the same typed signal instead of a generic ActorDiedError
+            self._actor_shed_errors[spec.actor_id] = error
+            while len(self._actor_shed_errors) > 4096:
+                self._actor_shed_errors.popitem(last=False)
+            self.on_actor_creation_failed(spec, error)
 
     def notify_resources_changed(self) -> None:
         """Wake the demand drainer (node join, capacity growth)."""
@@ -1181,6 +1257,8 @@ class Cluster:
                         self._demand_entries.remove(entry)
                     except ValueError:
                         pass
+                depth = len(self._demand_entries)
+                metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, _DEMAND_QUEUE_TAGS)
                 if self._demand_entries:
                     self._demand_cv.wait(timeout=0.05)  # tick while backlogged
 
@@ -1269,6 +1347,49 @@ class Cluster:
         """One audited fence rejection (bounded log + monotonic total)."""
         self.fence_events.append(event)
         self.fence_events_total += 1
+
+    def record_overload_event(self, event: dict) -> None:
+        """One audited admission-control shed (bounded log + monotonic
+        total) — appended by runtime/admission.py for every rejection."""
+        self.overload_events.append(event)
+        self.overload_events_total += 1
+
+    def overload_snapshot(self) -> dict:
+        """The /api/overload payload: per-layer bounds, current depths, and
+        lifetime shed totals across the whole admission spine."""
+        from ray_tpu.runtime import admission
+
+        cfg = get_config()
+        with self._demand_cv:
+            parked = len(self._demand_entries)
+        head_store = (
+            self.head_node.store.stats()
+            if self.head_node is not None and not self.head_node.dead
+            else {}
+        )
+        return {
+            "shed_totals": admission.shed_totals(),
+            "events_total": self.overload_events_total,
+            "recent_events": list(self.overload_events)[-32:],
+            "demand_queue": {
+                "depth": parked,
+                "bound": cfg.demand_queue_max_entries,
+            },
+            "submission": (
+                self.core_worker.admission_gate.snapshot()
+                if self.core_worker is not None
+                else None
+            ),
+            "store": {
+                "host_used": head_store.get("host_used", 0),
+                "host_budget": head_store.get("host_budget", 0),
+                "disk_used": head_store.get("disk_used", 0),
+                "disk_budget": head_store.get("disk_budget", 0),
+                "put_backpressure_waits": head_store.get("put_backpressure_waits", 0),
+                "puts_shed": head_store.get("puts_shed", 0),
+            },
+            "sources": admission.sources_snapshot(),
+        }
 
     def unpark_and_fail(self, spec: TaskSpec, error: BaseException) -> bool:
         """Remove a PARKED task from the demand queue and commit ``error``
@@ -1951,6 +2072,17 @@ class Cluster:
             spec.retries_left = spec.max_retries
 
     # -- ordered per-actor call queue -----------------------------------
+    def _dead_actor_error(self, actor_id: ActorID) -> BaseException:
+        """The error a call to a dead actor commits: the remembered typed
+        shed error when the creation was refused by admission control (the
+        caller can retry after the hint), the generic death otherwise."""
+        from ray_tpu.exceptions import raised_copy
+
+        shed = self._actor_shed_errors.get(actor_id)
+        if shed is not None:
+            return raised_copy(shed)
+        return ActorDiedError(actor_id)
+
     def submit_actor_task(self, spec: TaskSpec, _is_retry: bool = False) -> None:
         # Direct route (the actor-shaped worker lease): while the actor is
         # ALIVE with an empty call queue, a dependency-free call stamps its
@@ -1998,7 +2130,7 @@ class Cluster:
                 q = self._actor_queues.setdefault(spec.actor_id, _ActorQueue())
         if q is None or info is None or info.state is ActorState.DEAD:
             self.task_manager.mark_failed(spec)
-            self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))
+            self._commit_error_everywhere(spec, self._dead_actor_error(spec.actor_id))
             self._after_commit(spec)
             return
         entry = [spec, False]
@@ -2040,7 +2172,7 @@ class Cluster:
                     pass
             if removed:
                 self.task_manager.mark_failed(spec)
-                self._commit_error_everywhere(spec, ActorDiedError(spec.actor_id))
+                self._commit_error_everywhere(spec, self._dead_actor_error(spec.actor_id))
                 self._after_commit(spec)
             return
         # start dep pulls targeting the actor's node (known once alive)

@@ -81,6 +81,37 @@ def remaining_budget(default: Optional[float] = None) -> Optional[float]:
     return max(0.0, ts - _time.time())
 
 
+# --------------------------------------------------------------------------
+# tenant propagation (overload survival, ISSUE 9): the serving ingress tags
+# each request with a tenant id (HTTP header / gRPC metadata); it rides this
+# contextvar through the serve handle and replica into every admission
+# decision (weighted fair queuing at the LLM engine, per-tenant admission
+# counters) so one hot tenant cannot starve the rest.
+# --------------------------------------------------------------------------
+_tenant_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "rt_tenant_id", default=None
+)
+
+
+def push_tenant(tenant: Optional[str]):
+    """Install the requesting tenant id; returns a token for
+    :func:`pop_tenant`.  None is a no-op install so callers need no
+    branching."""
+    return _tenant_id.set(tenant)
+
+
+def pop_tenant(token) -> None:
+    try:
+        _tenant_id.reset(token)
+    except ValueError:
+        pass  # token from another Context copy (async hand-off)
+
+
+def current_tenant(default: Optional[str] = None) -> Optional[str]:
+    tenant = _tenant_id.get()
+    return tenant if tenant is not None else default
+
+
 class RuntimeContext:
     """User-facing runtime context (ray.get_runtime_context() parity)."""
 

@@ -49,6 +49,12 @@ class CoreWorker:
         hooks.ref_counter = self.ref_counter
         hooks.serialization_ctx = get_context()
         cluster.core_worker = self
+        # per-caller in-flight task cap (overload survival, ISSUE 9):
+        # submissions past max_inflight_tasks_per_caller block or shed with
+        # a typed OverloadedError; released on every terminal commit
+        from ray_tpu.runtime.admission import AdmissionGate
+
+        self.admission_gate = AdmissionGate()
         # memory pressure frees dead objects before anything spills (a tight
         # put loop outruns the deferred-decref drainer thread); every
         # in-process store gets the hook, and add_node wires later joiners
@@ -181,6 +187,19 @@ class CoreWorker:
                         else max(0.0, deadline_ts - time.time())
                     )
                 spec.hedge_after_s = hedge_after_s
+        if not streaming and cfg.max_inflight_tasks_per_caller > 0:
+            # per-caller in-flight cap: block-or-shed BEFORE any ownership
+            # state is minted, so a shed submission leaves nothing behind.
+            # (Streaming tasks are exempt — their terminal path does not
+            # release through on_task_committed; actor calls are bounded by
+            # the per-actor queue instead.)
+            budget = (
+                None if spec.deadline_ts is None
+                else max(0.0, spec.deadline_ts - time.time())
+            )
+            self.admission_gate.admit(
+                self._current_task_id().binary(), task_id.binary(), budget
+            )
         metric_defs.TASKS_SUBMITTED.inc(tags=_NORMAL_TASK_TAGS)
         for oid in return_ids:
             self.ref_counter.add_owned_object(oid)
@@ -412,6 +431,9 @@ class CoreWorker:
 
     # ------------------------------------------------------------- internal
     def on_task_committed(self, spec: TaskSpec) -> None:
+        # idempotent (keyed by task id): a hedge twin committing for its
+        # primary releases the one admission slot exactly once
+        self.admission_gate.release(spec.task_id.binary())
         self.ref_counter.remove_submitted_task_references(spec.dependencies)
 
     def _on_object_out_of_scope(self, oid: ObjectID) -> None:

@@ -503,6 +503,53 @@ def cmd_nodes(args) -> int:
     return 0
 
 
+def cmd_overload(args) -> int:
+    """``rt overload``: the admission-control spine at a glance — per-layer
+    bounds vs current depths, lifetime shed totals by (layer, reason), the
+    per-caller submission gate, and store put-backpressure counters."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/overload")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    totals = data.get("shed_totals", {})
+    total_shed = sum(n for reasons in totals.values() for n in reasons.values())
+    print(f"sheds: {total_shed} lifetime ({data.get('events_total', 0)} audited)")
+    for layer in sorted(totals):
+        reasons = ", ".join(f"{r}={n}" for r, n in sorted(totals[layer].items()))
+        print(f"  {layer}: {reasons}")
+    dq = data.get("demand_queue", {})
+    print(f"demand queue: {dq.get('depth', 0)} parked (bound {dq.get('bound', 0)})")
+    gate = data.get("submission")
+    if gate and gate.get("cap", 0) > 0:
+        print(
+            f"submission gate: {gate['inflight']} in flight over "
+            f"{gate['callers']} caller(s), cap {gate['cap']}/caller "
+            f"[{gate['policy']}], {gate['blocks']} blocks, {gate['sheds']} sheds"
+        )
+    store = data.get("store", {})
+    if store.get("disk_budget"):
+        print(
+            f"store: host {store.get('host_used', 0) / 1e6:.1f}/"
+            f"{store.get('host_budget', 0) / 1e6:.0f} MB, disk "
+            f"{store.get('disk_used', 0) / 1e6:.1f}/"
+            f"{store.get('disk_budget', 0) / 1e6:.0f} MB, "
+            f"{store.get('put_backpressure_waits', 0)} backpressured puts, "
+            f"{store.get('puts_shed', 0)} shed"
+        )
+    for src in data.get("sources", ()):
+        if src.get("layer") == "engine":
+            print(
+                f"llm engine: {src.get('queued', 0)} queued "
+                f"(bound {src.get('queue_bound', 0)}), "
+                f"{src.get('queued_prefill_tokens', 0)} prefill tokens "
+                f"(budget {src.get('token_budget', 0) or 'unbounded'}), "
+                f"{src.get('active_slots', 0)}/{src.get('slots', 0)} slots, "
+                f"{src.get('slots_evicted', 0)} evicted, {src.get('shed', 0)} shed"
+            )
+    return 0
+
+
 def cmd_chaos(args) -> int:
     if args.chaos_cmd == "validate":
         from ray_tpu.chaos.schedule import validate_cli
@@ -669,6 +716,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_nodes)
+
+    sp = sub.add_parser(
+        "overload",
+        help="admission-control snapshot: per-layer bounds vs depths, shed "
+        "totals, submission gate, store put backpressure",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_overload)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

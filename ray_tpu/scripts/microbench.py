@@ -689,6 +689,95 @@ def run_suite(
         del big_ref
 
     # ---- hedged straggler retries (ISSUE 8) ------------------------------
+    if wanted("overload_goodput"):
+        # Overload survival (ISSUE 9): goodput under 5x-capacity offered
+        # load through the serve admission spine.  Capacity = throughput
+        # with offered concurrency == the replicas' aggregate concurrency
+        # (nothing sheds); overload = 5x the client threads.  Row value =
+        # goodput under overload / capacity (x; ~1.0 = graceful
+        # degradation — shed requests cost a typed 429, not a queue).
+        # In-row guards: every rejection is a typed OverloadedError with a
+        # retry_after_s hint, the router's admission gauge never exceeds
+        # its configured bound, and overload actually shed something.
+        import threading as _th
+
+        from ray_tpu import serve
+        from ray_tpu.exceptions import OverloadedError
+
+        MAX_ONGOING, REPLICAS, MAX_QUEUED = 4, 2, 8
+        # dispatched in-flight never exceeds the replicas' aggregate
+        # concurrency (the bounded router queue holds the rest)
+        capacity_bound = REPLICAS * MAX_ONGOING
+
+        @serve.deployment(
+            num_replicas=REPLICAS,
+            max_ongoing_requests=MAX_ONGOING,
+            max_queued_requests=MAX_QUEUED,
+        )
+        class _Work:
+            def __call__(self, x):
+                # 10ms: large enough that 5x client-thread GIL churn is
+                # noise next to the work item, so the ratio measures the
+                # ADMISSION machinery, not Python thread scheduling
+                time.sleep(0.010)
+                return x
+
+        handle = serve.run(_Work.bind(), route_prefix=None)
+        assert handle.remote(0).result(timeout=30) == 0  # warm replicas
+
+        router = handle._router
+
+        def drive(n_threads: int, seconds: float):
+            stop_at = time.monotonic() + seconds
+            ok = [0] * n_threads
+            shed = [0] * n_threads
+            bad: list = []
+            peak = [0]
+
+            def client(k):
+                while time.monotonic() < stop_at:
+                    try:
+                        handle.remote(k).result(timeout=30)
+                        ok[k] += 1
+                    except OverloadedError as exc:
+                        if not exc.retry_after_s > 0:
+                            bad.append("OverloadedError without retry_after_s")
+                        shed[k] += 1
+                        time.sleep(min(0.005, exc.retry_after_s))
+                    except Exception as exc:  # noqa: BLE001
+                        bad.append(f"untyped rejection: {exc!r}")
+
+            threads = [
+                _th.Thread(target=client, args=(k,), daemon=True)
+                for k in range(n_threads)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                with router._lock:
+                    depth = sum(router._inflight.values())
+                peak[0] = max(peak[0], depth)
+                time.sleep(0.005)
+            for t in threads:
+                t.join()
+            dt = time.monotonic() - t0
+            return sum(ok) / dt, sum(shed), bad, peak[0]
+
+        cap_rate, _, bad1, _ = drive(REPLICAS * MAX_ONGOING, 1.2)
+        good_rate, n_shed, bad2, peak = drive(5 * REPLICAS * MAX_ONGOING, 1.5)
+        serve.shutdown()
+        problems = bad1 + bad2
+        if problems:
+            raise AssertionError(f"overload row broke typing: {problems[:5]}")
+        if peak > capacity_bound + 2:  # +2: racing admits before the gauge
+            raise AssertionError(
+                f"router admission exceeded its bound: {peak} > {capacity_bound}"
+            )
+        if n_shed == 0:
+            raise AssertionError("5x offered load shed nothing — bound not engaged")
+        record("overload_goodput", good_rate / max(cap_rate, 1e-9), "x")
+
     if wanted("hedged_tail_latency_p99"):
         # Tail latency under ONE delay-armed slow node, hedging off vs on:
         # bursts spread across both nodes, so ~half the tasks land on the

@@ -113,6 +113,20 @@ class ServeControllerActor:
                 for name, s in self._deployments.items()
             }
 
+    def get_deployment_meta(self, name: str) -> Dict[str, Any]:
+        """Admission/retry knobs the router enforces per deployment
+        (fetched on membership changes, not per request)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return {}
+            d = state.deployment
+            return {
+                "max_ongoing_requests": d.max_ongoing_requests,
+                "max_queued_requests": d.max_queued_requests,
+                "idempotent": d.idempotent,
+            }
+
     def record_request_metrics(self, name: str, inflight: Dict[int, int]) -> None:
         with self._lock:
             state = self._deployments.get(name)
@@ -133,14 +147,21 @@ class ServeControllerActor:
         d = state.deployment
         while len(state.replicas) < state.target_replicas:
             is_function = not isinstance(d.func_or_class, type)
+            # the replica-level backstop (handle_request shedding past
+            # max_ongoing_requests, +2 concurrency headroom so it is
+            # reachable) arms only for deployments that OPTED INTO bounding
+            # (max_queued_requests >= 0) — the unbounded default keeps the
+            # historical queue-at-the-actor behavior, never a surprise 429
+            bounded = d.max_queued_requests >= 0
             replica = ReplicaActor.options(
                 execution="inproc",
-                max_concurrency=max(2, d.max_ongoing_requests),
+                max_concurrency=max(2, d.max_ongoing_requests + (2 if bounded else 0)),
                 **{k: v for k, v in d.ray_actor_options.items() if k in ("num_cpus", "num_tpus", "resources")},
             ).remote(
                 d.func_or_class, state.init_args, state.init_kwargs, d.user_config, is_function,
                 deployment=d.name,
                 replica_tag=f"{d.name}#{state.version}",
+                max_ongoing_requests=d.max_ongoing_requests if bounded else 0,
             )
             state.replicas.append(replica)
             state.version += 1

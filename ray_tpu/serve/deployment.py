@@ -33,6 +33,8 @@ class Deployment:
         autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
         ray_actor_options: Optional[dict] = None,
         max_ongoing_requests: int = 100,
+        max_queued_requests: int = -1,
+        idempotent: bool = False,
         user_config: Optional[dict] = None,
         version: str = "1",
     ):
@@ -47,6 +49,16 @@ class Deployment:
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = ray_actor_options or {}
         self.max_ongoing_requests = max_ongoing_requests
+        # Admission bound on requests WAITING for this deployment beyond
+        # its replicas' concurrency (parity: serve's max_queued_requests
+        # rejection path).  -1 = unbounded (the historical behavior); past
+        # the bound the router sheds with OverloadedError -> HTTP 429.
+        self.max_queued_requests = max_queued_requests
+        # Replica-death replay gate: only idempotent deployments may have a
+        # request REPLAYED after its replica died mid-flight (the original
+        # may have executed its side effects before dying).  Default False:
+        # at-most-once — the caller sees the typed actor error and decides.
+        self.idempotent = idempotent
         self.user_config = user_config
         self.version = version
 
@@ -56,6 +68,8 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
+            max_queued_requests=self.max_queued_requests,
+            idempotent=self.idempotent,
             user_config=self.user_config,
             version=self.version,
         )
@@ -101,6 +115,8 @@ def deployment(
     autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
     ray_actor_options: Optional[dict] = None,
     max_ongoing_requests: int = 100,
+    max_queued_requests: int = -1,
+    idempotent: bool = False,
     user_config: Optional[dict] = None,
     version: str = "1",
 ):
@@ -114,6 +130,8 @@ def deployment(
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            idempotent=idempotent,
             user_config=user_config,
             version=version,
         )

@@ -79,6 +79,9 @@ class GRPCProxy:
                 self._grpc.StatusCode.NOT_FOUND,
                 f"no application {app!r} (have: {sorted(self.apps)})",
             )
+        from ray_tpu.runtime.context import pop_tenant, push_tenant
+
+        tenant_token = push_tenant(md.get("x-tenant-id") or md.get("x-tenant"))
         try:
             if codec == "pickle":
                 payload = pickle.loads(request) if request else None
@@ -88,7 +91,19 @@ class GRPCProxy:
             if codec == "pickle" and not hasattr(result, "__next__"):
                 return pickle.dumps(result)
         except Exception as exc:  # noqa: BLE001
-            context.abort(self._grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+            # HTTP-coherent status mapping (RESOURCE_EXHAUSTED is the 429
+            # equivalent; retry_after_s rides the detail string since
+            # unary abort has no trailing-metadata helper here)
+            from ray_tpu.runtime.admission import grpc_code_for, unwrap
+
+            code_name, retry_after = grpc_code_for(exc)
+            cause = unwrap(exc)
+            detail = f"{type(cause).__name__}: {cause}"
+            if retry_after is not None:
+                detail += f" (retry_after_s={retry_after:g})"
+            context.abort(getattr(self._grpc.StatusCode, code_name), detail)
+        finally:
+            pop_tenant(tenant_token)
         if hasattr(result, "__next__"):
             # streaming deployments (stream=True generators) have no
             # unary-gRPC representation; the HTTP proxy serves them as SSE —

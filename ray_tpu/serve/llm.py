@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.exceptions import DeadlineExceededError
 from ray_tpu.models.generation import (
     decode_step,
     filter_top_k_top_p,
@@ -45,9 +46,14 @@ from ray_tpu.models.generation import (
     init_cache,
 )
 from ray_tpu.models.transformer import TransformerConfig
-
+from ray_tpu.observability import metric_defs
+from ray_tpu.runtime import admission
+from ray_tpu.runtime.context import current_deadline_ts, current_tenant
 
 _STREAM_END = object()
+
+# prebuilt tag dict for the per-request admission hot path
+_EVICT_DISCONNECT_TAGS = {"reason": "disconnect"}
 
 
 @dataclass
@@ -58,6 +64,15 @@ class GenRequest:
     eos_id: Optional[int] = None
     future: Future = field(default_factory=Future)
     stream_queue: Optional[Any] = None  # queue.Queue when streaming
+    # admission metadata: the requesting tenant (weighted fairness key) and
+    # the PR-8 deadline riding the request context — an expired deadline
+    # sheds on arrival so doomed work never occupies a decode slot
+    tenant: Optional[str] = None
+    deadline_ts: Optional[float] = None
+    # consumer-gone flag (streaming): the stream pump marks an abandoned
+    # iterator and the engine evicts the decode slot instead of generating
+    # for nobody
+    cancelled: bool = False
     # filled by the engine
     slot: int = -1
     generated: List[int] = field(default_factory=list)
@@ -65,6 +80,40 @@ class GenRequest:
     def emit(self, tok: int) -> None:
         if self.stream_queue is not None:
             self.stream_queue.put(tok)
+
+
+class _TokenStream:
+    """Iterator over a streaming request's tokens whose ``close()`` (called
+    explicitly, via GC of an abandoned iterator, or by GeneratorExit
+    propagation from a disconnected SSE client) marks the request
+    ABANDONED — the engine frees its decode slot (or its waiting-queue
+    budget, if never admitted) instead of generating for nobody.  A plain
+    generator's finally-block cannot do this: closing a generator that
+    never started skips its body entirely."""
+
+    __slots__ = ("_gen", "_req", "_engine")
+
+    def __init__(self, gen, req: GenRequest, engine: "LLMEngine"):
+        self._gen = gen
+        self._req = req
+        self._engine = engine
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        self._gen.close()
+        if not self._req.future.done():
+            self._engine._abandon_stream(self._req)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — GC teardown must never raise
+            pass
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -97,10 +146,22 @@ class LLMEngine:
         tp: str = "tp",
         decode_chunk: int = 1,
         prefill_cache_size: int = 0,
+        max_queued_requests: int = 256,
+        max_queued_prefill_tokens: int = 0,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         self.cfg = cfg
         self.B = max_batch_size
         self.S = max_seq_len
+        # bounded waiting queue (overload survival, ISSUE 9): past the
+        # request-count bound, or the prefill-token budget (0 = unbounded),
+        # submit() sheds with a typed OverloadedError instead of growing
+        # the waiting list while decode falls behind
+        self._max_queued = max(0, int(max_queued_requests))
+        self._max_queued_tokens = max(0, int(max_queued_prefill_tokens))
+        self._queued_tokens = 0
+        self.num_slots_evicted = 0
+        self.num_shed = 0
         # opt-in memo of prefill results keyed by the EXACT prompt token
         # tuple: repeated prompts (identical system prompts, retries) skip
         # the prefill forward entirely.  Each entry pins one cache row
@@ -150,10 +211,19 @@ class LLMEngine:
             self._layer_scales = None
             self.params = params
 
-        self._queue: List[GenRequest] = []
+        # tenant-keyed weighted fair queue: pops interleave proportionally
+        # to tenant_weights (default weight 1), so one hot tenant saturating
+        # the queue cannot starve the others' admissions
+        self._queue = admission.WeightedFairQueue(tenant_weights)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._admission_token = admission.register_admission_source(
+            "llm_engine", self.admission_snapshot
+        )
+        # per-engine series (keyed by the registry token): two engines
+        # must not clobber each other's admission-depth gauge
+        self._depth_tags = {"layer": "engine", "engine": str(self._admission_token)}
 
         # slot state (host-side mirrors of the device arrays)
         self._slots: List[Optional[GenRequest]] = [None] * self.B
@@ -252,9 +322,38 @@ class LLMEngine:
         max_tokens: int = 32,
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
         _stream_queue=None,
     ) -> Future:
-        """Enqueue one request; resolves to the generated token-id list."""
+        """Enqueue one request; resolves to the generated token-id list.
+
+        ``tenant`` (default: the request-context tenant id set by the
+        ingress) keys weighted fair queuing; ``deadline_ts`` (default: the
+        PR-8 deadline riding the request context) sheds on arrival when
+        already expired.  Raises OverloadedError when the bounded waiting
+        queue (count or prefill-token budget) is full."""
+        return self._submit_req(
+            prompt,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            eos_id=eos_id,
+            tenant=tenant,
+            deadline_ts=deadline_ts,
+            _stream_queue=_stream_queue,
+        ).future
+
+    def _submit_req(
+        self,
+        prompt: List[int],
+        *,
+        max_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
+        _stream_queue=None,
+    ) -> GenRequest:
         if self._stop:
             raise RuntimeError("LLMEngine is shut down")
         if not prompt:
@@ -266,11 +365,61 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) exceeds "
                 f"engine max_seq_len {self.S}"
             )
-        req = GenRequest(list(prompt), max_tokens, temperature, eos_id, stream_queue=_stream_queue)
+        if self._max_queued_tokens and len(prompt) > self._max_queued_tokens:
+            # a prompt that ALONE exceeds the budget can never be admitted:
+            # that is a config/input error, not a retry-after-able overload
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens) exceeds the engine's "
+                f"max_queued_prefill_tokens budget ({self._max_queued_tokens}) "
+                "and would never be admitted"
+            )
+        if tenant is None:
+            tenant = current_tenant()
+        if deadline_ts is None:
+            deadline_ts = current_deadline_ts()
+        if deadline_ts is not None and time.time() >= deadline_ts:
+            # shed-on-arrival: the deadline already expired — admitting
+            # would burn prefill + a decode slot on an answer nobody can
+            # use.  The typed signal is the deadline error, not 429.
+            self.num_shed += 1
+            admission.record_shed("engine", "deadline_expired")
+            raise DeadlineExceededError("llm_request", "engine_admission", 0.0)
         with self._lock:
-            self._queue.append(req)
+            depth = len(self._queue)
+            if self._max_queued and depth >= self._max_queued:
+                self.num_shed += 1
+                raise admission.shed(
+                    "engine", "queue_full",
+                    message=(
+                        f"engine waiting queue at its {self._max_queued}-"
+                        f"request bound"
+                    ),
+                )
+            if (
+                self._max_queued_tokens
+                and self._queued_tokens + len(prompt) > self._max_queued_tokens
+            ):
+                self.num_shed += 1
+                raise admission.shed(
+                    "engine", "token_budget",
+                    message=(
+                        f"queued prefill tokens {self._queued_tokens} + "
+                        f"{len(prompt)} exceed the "
+                        f"{self._max_queued_tokens}-token budget"
+                    ),
+                )
+            req = GenRequest(
+                list(prompt), max_tokens, temperature, eos_id,
+                stream_queue=_stream_queue, tenant=tenant,
+                deadline_ts=deadline_ts,
+            )
+            self._queue.push(req, tenant)
+            self._queued_tokens += len(prompt)
+            depth += 1
+        metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
+        metric_defs.TENANT_ADMISSIONS.inc(tags=admission.tenant_tags(tenant))
         self._wake.set()
-        return req.future
+        return req
 
     def generate(self, prompt: List[int], **kw) -> List[int]:
         return self.submit(prompt, **kw).result()
@@ -285,7 +434,8 @@ class LLMEngine:
         import queue as _queue
 
         q: "_queue.Queue" = _queue.Queue()
-        fut = self.submit(prompt, _stream_queue=q, **kw)
+        req = self._submit_req(prompt, _stream_queue=q, **kw)
+        fut = req.future
 
         def _iter():
             while True:
@@ -302,7 +452,29 @@ class LLMEngine:
                     return
                 yield tok
 
-        return _iter()
+        return _TokenStream(_iter(), req, self)
+
+    def _abandon_stream(self, req: GenRequest) -> None:
+        """Consumer gone: if the request is still WAITING, drop it from the
+        queue NOW (its count + prefill tokens stop holding the bounded
+        budget against live traffic); if it holds a decode slot, flag it
+        for eviction at the next engine-loop tick."""
+        req.cancelled = True
+        with self._lock:
+            removed = self._queue.remove(req)
+            if removed:
+                self._queued_tokens -= len(req.prompt)
+            depth = len(self._queue)
+        if removed:
+            metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
+            self.num_shed += 1
+            admission.record_shed("engine", "disconnect")
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("stream consumer disconnected before admission")
+                )
+        else:
+            self._wake.set()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -310,18 +482,42 @@ class LLMEngine:
                 "active_slots": int(self._active.sum()),
                 "max_batch_size": self.B,
                 "queued": len(self._queue),
+                "queued_prefill_tokens": self._queued_tokens,
                 "prefill_forwards": self._prefill_count,
                 "prefill_cache_entries": len(self._prefill_cache),
+                "slots_evicted": self.num_slots_evicted,
+                "shed": self.num_shed,
+            }
+
+    def admission_snapshot(self) -> Dict[str, Any]:
+        """Bounds + depths for GET /api/overload (admission source)."""
+        with self._lock:
+            return {
+                "layer": "engine",
+                "queued": len(self._queue),
+                "queue_bound": self._max_queued,
+                "queued_prefill_tokens": self._queued_tokens,
+                "token_budget": self._max_queued_tokens,
+                "active_slots": int(self._active.sum()),
+                "slots": self.B,
+                "by_tenant": self._queue.depth_by_tenant(),
+                "slots_evicted": self.num_slots_evicted,
+                "shed": self.num_shed,
             }
 
     def shutdown(self) -> None:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5)
+        admission.unregister_admission_source(self._admission_token)
+        # zero this engine's gauge series; the freed token (and thus the
+        # series label) is reused by the next engine
+        metric_defs.ADMISSION_QUEUE_DEPTH.set(0, self._depth_tags)
         with self._lock:
-            pending = [r for r in self._queue if not r.future.done()]
+            pending = [r for r in self._queue.items() if not r.future.done()]
             pending += [r for r in self._slots if r is not None and not r.future.done()]
-            self._queue.clear()
+            self._queue.drain()
+            self._queued_tokens = 0
         for r in pending:
             r.future.set_exception(RuntimeError("LLMEngine shut down"))
             if r.stream_queue is not None:
@@ -332,10 +528,33 @@ class LLMEngine:
         while True:
             with self._lock:
                 free = [i for i in range(self.B) if not self._active[i]]
-                if not free or not self._queue:
+                if not free or not len(self._queue):
                     return
-                req = self._queue.pop(0)
+                req = self._queue.pop()  # weighted fair order across tenants
+                self._queued_tokens -= len(req.prompt)
+                depth = len(self._queue)
                 slot = free[0]
+            metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
+            if req.cancelled:
+                # abandoned while waiting: never prefill it
+                self.num_shed += 1
+                admission.record_shed("engine", "disconnect")
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("stream consumer disconnected before admission")
+                    )
+                continue
+            if req.deadline_ts is not None and time.time() >= req.deadline_ts:
+                # expired while queued: shed instead of occupying a slot
+                self.num_shed += 1
+                admission.record_shed("engine", "deadline_expired")
+                if not req.future.done():
+                    req.future.set_exception(
+                        DeadlineExceededError("llm_request", "engine_queue", 0.0)
+                    )
+                if req.stream_queue is not None:
+                    req.stream_queue.put(_STREAM_END)
+                continue
             try:
                 tp = len(req.prompt)
                 prompt_key = tuple(req.prompt)
@@ -438,19 +657,41 @@ class LLMEngine:
         """Fail every queued and in-slot request (loop-crash recovery):
         futures resolve with the error and stream iterators terminate."""
         with self._lock:
-            victims = [r for r in self._queue] + [r for r in self._slots if r is not None]
-            self._queue.clear()
+            victims = self._queue.drain() + [r for r in self._slots if r is not None]
+            self._queued_tokens = 0
             self._slots = [None] * self.B
             self._active[:] = False
+        metric_defs.ADMISSION_QUEUE_DEPTH.set(0, self._depth_tags)
         for r in victims:
             if not r.future.done():
                 r.future.set_exception(error)
             if r.stream_queue is not None:
                 r.stream_queue.put(_STREAM_END)
 
+    def _evict_cancelled(self) -> None:
+        """Free decode slots whose streaming consumer went away: the slot
+        returns to the batch NOW instead of decoding to an abandoned queue
+        until stop/length (llm_slots_evicted_total{reason=disconnect})."""
+        with self._lock:
+            victims = [
+                (i, r) for i, r in enumerate(self._slots)
+                if r is not None and r.cancelled
+            ]
+            for i, _ in victims:
+                self._slots[i] = None
+                self._active[i] = False
+        for _, r in victims:
+            self.num_slots_evicted += 1
+            metric_defs.LLM_SLOTS_EVICTED.inc(tags=_EVICT_DISCONNECT_TAGS)
+            if not r.future.done():
+                r.future.set_exception(
+                    RuntimeError("stream consumer disconnected; decode slot evicted")
+                )
+
     def _loop(self) -> None:
         while not self._stop:
             try:
+                self._evict_cancelled()
                 self._admit()
                 if self._active.any():
                     self._step()
@@ -492,6 +733,9 @@ class LLMServer:
         tp: str = "tp",
         decode_chunk: int = 1,
         prefill_cache_size: int = 0,
+        max_queued_requests: int = 256,
+        max_queued_prefill_tokens: int = 0,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
@@ -508,6 +752,9 @@ class LLMServer:
             tp=tp,
             decode_chunk=decode_chunk,
             prefill_cache_size=prefill_cache_size,
+            max_queued_requests=max_queued_requests,
+            max_queued_prefill_tokens=max_queued_prefill_tokens,
+            tenant_weights=tenant_weights,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:

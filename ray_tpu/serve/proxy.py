@@ -37,6 +37,12 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b'{"error": "no app at this route"}')
             return
+        from ray_tpu.runtime.context import pop_tenant, push_tenant
+
+        # tenant id rides the ingress header into the request context, then
+        # handle -> replica -> engine admission (weighted fairness keys)
+        tenant = self.headers.get("X-Tenant-Id") or self.headers.get("X-Tenant")
+        tenant_token = push_tenant(tenant)
         try:
             payload: Any = None
             if body:
@@ -49,7 +55,7 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
                 # generator result (in-proc replica) -> server-sent events,
                 # one `data:` frame per item, flushed as produced. Once the
                 # 200 + headers are out, a mid-stream failure must NOT fall
-                # through to send_response(500) (that writes a second status
+                # through to an error status (that writes a second status
                 # line into the open body) — emit an error event and close.
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
@@ -67,6 +73,17 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
                         self.wfile.flush()
                     except OSError:
                         pass  # client already gone
+                finally:
+                    # a disconnected client must FREE its decode slot: close
+                    # the generator chain NOW (GeneratorExit propagates into
+                    # the engine's stream pump and marks the request
+                    # abandoned) instead of waiting for GC to find it
+                    close = getattr(result, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
                 return
             data = json.dumps(result, default=_jsonify).encode()
             self.send_response(200)
@@ -74,9 +91,23 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
         except Exception as exc:  # noqa: BLE001
-            self.send_response(500)
+            # coherent error -> status contract (regression-tested):
+            # OverloadedError -> 429 + Retry-After, deadline/timeout -> 504,
+            # actor/worker death past the retry budget -> 503, else 500.
+            from ray_tpu.runtime.admission import http_status_for, unwrap
+
+            status, retry_after = http_status_for(exc)
+            cause = unwrap(exc)
+            self.send_response(status)
+            payload = {"error": str(cause), "type": type(cause).__name__}
+            if retry_after is not None:
+                self.send_header("Retry-After", str(max(1, int(round(retry_after)))))
+                payload["retry_after_s"] = retry_after
+            self.send_header("Content-Type", "application/json")
             self.end_headers()
-            self.wfile.write(json.dumps({"error": str(exc)}).encode())
+            self.wfile.write(json.dumps(payload).encode())
+        finally:
+            pop_tenant(tenant_token)
 
     def do_GET(self):
         self._handle(None)

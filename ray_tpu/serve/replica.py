@@ -48,8 +48,14 @@ def get_replica_context() -> ReplicaContext:
 @ray_tpu.remote
 class ReplicaActor:
     def __init__(self, func_or_class, init_args, init_kwargs, user_config, is_function: bool,
-                 deployment: str = "", replica_tag: str = ""):
+                 deployment: str = "", replica_tag: str = "",
+                 max_ongoing_requests: int = 0):
         self.is_function = is_function
+        # replica-level admission backstop (0 = unlimited): the router's
+        # queue bound is the primary gate, but a replica must defend itself
+        # against stale routers too (parity: Serve replicas re-reject past
+        # max_ongoing_requests)
+        self._max_ongoing = int(max_ongoing_requests)
         self._context = ReplicaContext(deployment=deployment, replica_tag=replica_tag)
         token = _replica_context.set(self._context)
         try:
@@ -67,11 +73,27 @@ class ReplicaActor:
         self._lock = threading.Lock()
         self._total = 0
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       tenant: str = None) -> Any:
+        from ray_tpu.runtime.context import pop_tenant, push_tenant
+
         with self._lock:
+            if self._max_ongoing > 0 and self._ongoing >= self._max_ongoing:
+                from ray_tpu.runtime import admission
+
+                raise admission.shed(
+                    "replica", "queue_full",
+                    message=(
+                        f"replica {self._context.replica_tag!r} at its "
+                        f"max_ongoing_requests bound ({self._max_ongoing})"
+                    ),
+                )
             self._ongoing += 1
             self._total += 1
         token = _replica_context.set(self._context)
+        # the requesting tenant rides proxy header -> handle -> HERE so
+        # anything the deployment submits (e.g. LLMEngine admission) sees it
+        tenant_token = push_tenant(tenant)
         try:
             if self.is_function:
                 return self.callable(*args, **kwargs)
@@ -80,6 +102,7 @@ class ReplicaActor:
                 raise TypeError(f"deployment class {type(self.callable)} is not callable")
             return target(*args, **kwargs) if method != "__call__" else self.callable(*args, **kwargs)
         finally:
+            pop_tenant(tenant_token)
             _replica_context.reset(token)
             with self._lock:
                 self._ongoing -= 1

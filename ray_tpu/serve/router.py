@@ -20,6 +20,7 @@ import ray_tpu
 from ray_tpu.observability import metric_defs
 
 
+
 def _is_system_failure(exc: BaseException) -> bool:
     """System-level failures the router may fail over; application
     exceptions propagate untouched (parity: the reference router only
@@ -45,7 +46,11 @@ class DeploymentResponse:
     snapshot may still list it for ~a health-check period), waits for
     usable membership within the caller's deadline, and re-routes.  The
     retry replays the ORIGINAL request (nested DeploymentResponses
-    included, so a lost upstream result can itself fail over)."""
+    included, so a lost upstream result can itself fail over) — but ONLY
+    for deployments declared ``idempotent=True``: the dead replica may
+    have executed its side effects before dying, so replaying a
+    side-effecting deployment could execute it twice.  Non-idempotent
+    deployments (the default) surface the typed actor error instead."""
 
     def __init__(self, ref, router=None, request=None, replica=None):
         self._ref = ref
@@ -68,10 +73,28 @@ class DeploymentResponse:
                 self._router = self._request = self._replica = None
                 return value
             except Exception as exc:  # noqa: BLE001 — filtered below
+                from ray_tpu.exceptions import (
+                    DeadlineExceededError,
+                    OverloadedError,
+                    StoreFullError,
+                    raised_copy,
+                )
+                from ray_tpu.runtime.admission import unwrap
+
+                cause = unwrap(exc)
+                if cause is not exc and isinstance(
+                    cause, (OverloadedError, DeadlineExceededError, StoreFullError)
+                ):
+                    # typed admission/deadline signals raised INSIDE a
+                    # replica cross the actor boundary wrapped in
+                    # RayTaskError; the handle contract is the typed error
+                    # itself (the proxy maps it to 429/503/504)
+                    raise raised_copy(cause) from None
                 if (
                     self._router is None
                     or self._request is None
                     or not _is_system_failure(exc)
+                    or not self._router._idempotent
                     or (deadline is not None and _time.monotonic() >= deadline)
                 ):
                     raise
@@ -98,11 +121,26 @@ class Router:
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
         self._lock = threading.Lock()
+        # bounded router queue (max_queued_requests >= 0): requests beyond
+        # the replicas' aggregate concurrency WAIT here for a replica to
+        # free (notified by completions/membership), bounded by the queue
+        # cap — replicas are never overcommitted, overflow sheds typed
+        self._cv = threading.Condition(self._lock)
+        self._queue_waiters = 0
         self._version = -1
         self._rng = random.Random()
         self._reqs_since_push = 0
         self._watching = False
         self._metric_tags = {"deployment": deployment_name}
+        # per-deployment series: two bounded deployments must not
+        # clobber each other's admission-depth gauge
+        self._depth_tags = {"layer": "router", "deployment": deployment_name}
+        # per-deployment admission/retry knobs (controller.get_deployment
+        # _meta), refreshed on membership changes — never per request
+        self._max_ongoing = 100
+        self._max_queued = -1
+        self._idempotent = False
+        self._meta_version = None
 
     # ------------------------------------------------------------ updates
     def _apply_snapshot(self, version: int, replicas: List[Any]) -> None:
@@ -110,7 +148,14 @@ class Router:
             if version != self._version:
                 self._version = version
                 self._replicas = replicas
-                self._inflight = {i: self._inflight.get(i, 0) for i in range(len(replicas))}
+                # identity-keyed: surviving replicas KEEP their in-flight
+                # counts across membership changes — zeroing (or index
+                # shifts) would let the bounded-admission path over-dispatch
+                # onto still-saturated survivors after a replica death
+                self._inflight = {
+                    id(r): self._inflight.get(id(r), 0) for r in replicas
+                }
+                self._cv.notify_all()  # queued requests re-evaluate membership
 
     def _refresh(self, force: bool = False) -> None:
         # Membership updates arrive via a long-poll watcher (parity:
@@ -127,6 +172,28 @@ class Router:
         if force or not self._replicas:
             version, replicas = ray_tpu.get(self.controller.get_replicas.remote(self.deployment_name))
             self._apply_snapshot(version, replicas)
+            self._refresh_meta()
+
+    def _refresh_meta(self) -> None:
+        """Pull the deployment's admission/retry knobs once per membership
+        version (a redeploy may change them; requests must not)."""
+        with self._lock:
+            if self._meta_version == self._version:
+                return
+            version = self._version
+        try:
+            meta = ray_tpu.get(
+                self.controller.get_deployment_meta.remote(self.deployment_name),
+                timeout=10,
+            )
+        except Exception:  # noqa: BLE001 — keep the last-known knobs
+            return
+        with self._lock:
+            if meta:
+                self._max_ongoing = int(meta.get("max_ongoing_requests", 100))
+                self._max_queued = int(meta.get("max_queued_requests", -1))
+                self._idempotent = bool(meta.get("idempotent", False))
+            self._meta_version = version
 
     def _watch_loop(self) -> None:
         import time
@@ -140,6 +207,7 @@ class Router:
                 )
                 failures = 0
                 self._apply_snapshot(version, replicas)
+                self._refresh_meta()
             except Exception:
                 failures += 1
                 time.sleep(0.5)
@@ -155,7 +223,10 @@ class Router:
         with self._lock:
             if replica in self._replicas:
                 self._replicas = [r for r in self._replicas if r is not replica]
-                self._inflight = {i: 0 for i in range(len(self._replicas))}
+                self._inflight = {
+                    id(r): self._inflight.get(id(r), 0) for r in self._replicas
+                }
+                self._cv.notify_all()
 
     def route_within(self, method: str, args: tuple, kwargs: dict, *, deadline: float):
         """route(), but wait for usable membership (a live replica) up to
@@ -171,30 +242,129 @@ class Router:
                 _time.sleep(0.1)
                 self._refresh(force=True)
 
+    def _load_locked(self, idx: int) -> int:
+        return self._inflight.get(id(self._replicas[idx]), 0)
+
+    def _pick_free_locked(self) -> Optional[int]:
+        """Pow-2 choice restricted to replicas below ``max_ongoing``; falls
+        back to the global minimum when the sample is saturated.  None =
+        every replica is at capacity (the caller queues or sheds)."""
+        n = len(self._replicas)
+        if n == 0:
+            return None
+        cap = max(1, self._max_ongoing)
+        if n == 1:
+            idx = 0
+        else:
+            a, b = self._rng.sample(range(n), 2)
+            idx = a if self._load_locked(a) <= self._load_locked(b) else b
+        if self._load_locked(idx) >= cap:
+            idx = min(range(n), key=self._load_locked)
+            if self._load_locked(idx) >= cap:
+                return None
+        return idx
+
+    def _admit_bounded_locked(self) -> int:
+        """Bounded-queue admission (max_queued_requests >= 0, reference
+        ``max_queued_requests`` parity): replicas are never dispatched past
+        ``max_ongoing`` — a request arriving with every replica saturated
+        WAITS here (counted as the router queue, gauge-visible) until a
+        completion frees a slot; arrivals beyond the queue bound shed with
+        the typed 429 signal.  Called under ``self._lock``."""
+        # newcomers defer to already-queued requests: a fresh arrival must
+        # not barge past waiters onto a just-freed slot (CPython Condition
+        # wakes waiters in arrival order, so with this gate admission is
+        # near-FIFO and a long-waiting request cannot be starved into its
+        # queue_timeout by a stream of later arrivals)
+        if self._queue_waiters == 0:
+            idx = self._pick_free_locked()
+            if idx is not None:
+                return idx
+        if self._queue_waiters >= self._max_queued:
+            from ray_tpu.runtime import admission
+
+            raise admission.shed(
+                "router", "queue_full",
+                message=(
+                    f"deployment {self.deployment_name!r}: every replica at "
+                    f"max_ongoing_requests ({self._max_ongoing}) and "
+                    f"{self._queue_waiters} requests already queued "
+                    f"(max_queued_requests {self._max_queued})"
+                ),
+            )
+        self._queue_waiters += 1
+        metric_defs.ADMISSION_QUEUE_DEPTH.set(self._queue_waiters, self._depth_tags)
+        from ray_tpu.core.config import get_config
+
+        deadline = time.monotonic() + get_config().router_queue_wait_timeout_s
+        try:
+            while True:
+                # short timed waits so membership flaps can't strand us.
+                # Transiently-EMPTY membership (replica died, controller
+                # replacing it) keeps waiting within the budget — the rest
+                # of the failover machinery (route_within) does the same;
+                # failing every queued request the instant a replica dies
+                # would turn a ~1s replacement into a burst of 500s.
+                self._cv.wait(0.05)
+                if self._replicas:
+                    idx = self._pick_free_locked()
+                    if idx is not None:
+                        return idx
+                if time.monotonic() >= deadline:
+                    if not self._replicas:
+                        raise RuntimeError(
+                            f"deployment {self.deployment_name!r} has no replicas"
+                        )
+                    # a wedged replica must cost a typed 429, not a handle
+                    # call that never returns
+                    from ray_tpu.runtime import admission
+
+                    raise admission.shed(
+                        "router", "queue_timeout",
+                        message=(
+                            f"deployment {self.deployment_name!r}: no "
+                            "replica slot freed within "
+                            "router_queue_wait_timeout_s"
+                        ),
+                    )
+        finally:
+            self._queue_waiters -= 1
+            metric_defs.ADMISSION_QUEUE_DEPTH.set(
+                self._queue_waiters, self._depth_tags
+            )
+
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        from ray_tpu.runtime.context import current_tenant
+
         t_start = time.perf_counter()
         if not self._replicas:
             self._refresh()
         if not self._replicas:
             raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
         original_request = (method, args, kwargs)  # PRE-resolution, for replay
+        tenant = current_tenant()
         with self._lock:
-            n = len(self._replicas)
-            if n == 1:
+            if self._max_queued >= 0:
+                idx = self._admit_bounded_locked()
+            elif len(self._replicas) == 1:
                 idx = 0
             else:
                 # power of two choices over locally-tracked in-flight counts
-                a, b = self._rng.sample(range(n), 2)
-                idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            total_inflight = sum(self._inflight.values())
+                a, b = self._rng.sample(range(len(self._replicas)), 2)
+                idx = a if self._load_locked(a) <= self._load_locked(b) else b
             replica = self._replicas[idx]
+            rkey = id(replica)
+            self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
+            total_inflight = sum(self._inflight.values())
             self._reqs_since_push += 1
             push = self._reqs_since_push >= 10
             if push:
                 self._reqs_since_push = 0
         metric_defs.SERVE_ROUTER_REQUESTS.inc(tags=self._metric_tags)
         metric_defs.SERVE_ROUTER_INFLIGHT.set(total_inflight, self._metric_tags)
+        from ray_tpu.runtime.admission import tenant_tags
+
+        metric_defs.TENANT_ADMISSIONS.inc(tags=tenant_tags(tenant))
         metric_defs.SERVE_ROUTER_QUEUE_WAIT.observe(
             time.perf_counter() - t_start, tags=self._metric_tags
         )
@@ -202,14 +372,14 @@ class Router:
         # chains the calls without blocking here (model composition).
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v) for k, v in kwargs.items()}
-        ref = replica.handle_request.remote(method, args, kwargs)
+        ref = replica.handle_request.remote(method, args, kwargs, tenant)
         # Ready-hook, not ref.future(): a future would pull every response
         # onto the router's node; the directory callback fires when the
         # result is committed anywhere, without materializing it here.
         from ray_tpu.api import get_cluster
 
         get_cluster().directory.wait_for(
-            ref.id(), lambda _node, i=idx: self._request_finished(i)
+            ref.id(), lambda _node, k=rkey: self._request_finished(k)
         )
         if push:
             self._push_metrics()
@@ -223,12 +393,14 @@ class Router:
         except Exception:
             pass
 
-    def _request_finished(self, idx: int) -> None:
+    def _request_finished(self, rkey: int) -> None:
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if rkey in self._inflight and self._inflight[rkey] > 0:
+                self._inflight[rkey] -= 1
             total_inflight = sum(self._inflight.values())
             drained = not total_inflight
+            if self._queue_waiters:
+                self._cv.notify()  # a queued request can dispatch now
         metric_defs.SERVE_ROUTER_INFLIGHT.set(total_inflight, self._metric_tags)
         if drained:
             # without this push the controller's last snapshot would show

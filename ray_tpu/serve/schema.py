@@ -33,6 +33,8 @@ _DEPLOYMENT_OVERRIDE_KEYS = {
     "autoscaling_config",
     "ray_actor_options",
     "max_ongoing_requests",
+    "max_queued_requests",
+    "idempotent",
     "user_config",
     "version",
 }

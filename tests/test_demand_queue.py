@@ -13,7 +13,7 @@ import time
 import pytest
 
 import ray_tpu as rt
-from ray_tpu.exceptions import RayTaskError
+from ray_tpu.exceptions import OverloadedError, RayTaskError
 
 
 @pytest.fixture
@@ -39,10 +39,18 @@ def test_infeasible_burst_flat_thread_count(runtime):
     # the shared drainer (plus at most a lazily-started runtime thread) —
     # growth must be O(1), never O(tasks)
     assert after - before <= 3, f"thread count grew {before} -> {after}"
-    # demand is visible to the autoscaler while parked
+    # the queue is BOUNDED (ISSUE 9): exactly demand_queue_max_entries park
+    # (visible to the autoscaler as demand), the overflow sheds typed —
+    # offered load can never grow the parked set without limit
+    from ray_tpu.core.config import get_config
+
+    bound = get_config().demand_queue_max_entries
     cluster = rt.get_cluster()
-    assert len(cluster.pending_resource_demands()) >= 10_000
-    # entries fail with the infeasibility error after the deadline
+    parked = len(cluster.pending_resource_demands())
+    assert parked == bound, f"{parked} parked demands vs bound {bound}"
+    with pytest.raises(OverloadedError):
+        rt.get(refs[-1], timeout=60)  # past the bound: shed on arrival
+    # parked entries fail with the infeasibility error after the deadline
     with pytest.raises(RayTaskError):
         rt.get(refs[0], timeout=60)
 

@@ -371,7 +371,9 @@ def test_requests_failover_while_replica_dies():
     the dead replica is pruned locally and the retry waits for usable
     membership — never surfacing ActorDiedError to the caller."""
 
-    @serve.deployment(num_replicas=2)
+    # idempotent=True: replica-death replay is gated on the deployment
+    # declaring re-execution safe (ISSUE 9 satellite) — pure functions are
+    @serve.deployment(num_replicas=2, idempotent=True)
     class Svc:
         def __call__(self, x):
             return x * 10
@@ -392,7 +394,7 @@ def test_single_replica_failover_waits_for_replacement():
     the (only) dead replica, so failover must WAIT for the controller's
     replacement, not burn retries against the stale snapshot."""
 
-    @serve.deployment(num_replicas=1)
+    @serve.deployment(num_replicas=1, idempotent=True)
     class Solo:
         def __call__(self, x):
             return x + 100
