@@ -160,6 +160,13 @@ ROW_GROUPS = [
     # the dispatch-overhead ratio vs the equivalent .remote() chain.  Own
     # fresh-runtime group — it adds a node.
     ["compiled_pipeline_iter", "compiled_pipeline_vs_remote_x"],
+    # device-native plan channels + SPMD stage groups (ISSUE 11): an
+    # MB-scale array edge driven through the real chan_push wire with the
+    # device kind (control-only headers, staged device pull, zero pickling)
+    # vs the pickle kind, plus end-to-end us/iter of a gang-stage plan.
+    # Own fresh-runtime group — it binds a data server and installs a
+    # transfer stand-in.
+    ["device_channel_edge_bw", "device_channel_vs_pickle_x", "spmd_pipeline_iter"],
     # lease-based direct dispatch (ISSUE 7): the multi_client_tasks_async /
     # n_n_actor_calls_async SHAPES riding cached worker leases and actor
     # direct routes — the regression rows tracked head-to-head against the
@@ -208,6 +215,8 @@ def main() -> None:
         "locality_arg_tasks",
         "broadcast_64mb_to_n",
         "compiled_pipeline_iter",
+        "device_channel_edge_bw",
+        "spmd_pipeline_iter",
         "direct_dispatch_tasks_async",
         "direct_dispatch_actor_calls_async",
         "hedged_tail_latency_p99",

@@ -179,6 +179,25 @@ class Config:
     # wait.  A full consumer slot stalls the producer's ack this long
     # before the stream (and the plan) is declared wedged.
     compiled_plan_channel_timeout_s: float = 300.0
+    # Channel kind for compiled-plan edges.  "auto" (and its alias
+    # "device"): an edge whose payload is a jax array stays HBM-resident —
+    # co-located handoffs are reference moves, cross-host frames carry a
+    # control-only header with the payload bypassing pickle entirely
+    # (device-to-device pull when a transfer server is up, raw host-staged
+    # bytes otherwise); non-array payloads fall back to the pickle path
+    # per-edge, per-seq.  "pickle" forces every edge onto the original
+    # pickle-5 frame path.
+    plan_channel_kind: str = "auto"
+    # Producer-side staging depth for cross-host device edges: True keeps
+    # the last TWO seqs' arrays staged for pull (seq-parity slots), so a
+    # late or retried consumer pull can still fetch seq N-1 while seq N
+    # stages — the double-buffering of the mutable-channel design.  False
+    # stages one seq at a time.
+    device_channel_double_buffer: bool = True
+    # Upper bound on SPMD stage-group fan-out (members per gang stage).
+    # Each iteration dispatches one member step per gang slot from the
+    # stage executor's pool; compile rejects larger groups.
+    plan_stage_group_max_members: int = 64
     # Default timeout for one actor-collective round (rendezvous + reduce).
     # Callers waiting on a collective result (rt.get) should budget MORE
     # than this so the collective's own timeout fires first with the

@@ -25,10 +25,10 @@ def __getattr__(name):
     # Lazy: plan.py pulls in runtime.channel_manager, which imports
     # dag.channel (and thus this package __init__) — an eager import here
     # would be circular when channel_manager loads first (agent processes).
-    if name == "ExecutionPlan":
-        from ray_tpu.dag.plan import ExecutionPlan
+    if name in ("ExecutionPlan", "StageGroup", "StageGroupNode"):
+        from ray_tpu.dag import plan
 
-        return ExecutionPlan
+        return getattr(plan, name)
     raise AttributeError(name)
 
 
@@ -41,6 +41,8 @@ __all__ = [
     "MultiOutputNode",
     "CompiledDAG",
     "ExecutionPlan",
+    "StageGroup",
+    "StageGroupNode",
     "Channel",
     "ChannelClosed",
     "DeviceChannel",

@@ -19,6 +19,19 @@ class ChannelClosed(Exception):
     pass
 
 
+def device_place(value: Any, device=None) -> Any:
+    """Pin ``value`` to ``device`` (the default device when None).
+
+    The single placement primitive shared by :class:`DeviceChannel` and the
+    device-kind ``SeqChannel`` in ``runtime/channel_manager.py`` — an ICI
+    copy when source and target devices differ, a no-op reference move when
+    the value is already resident.
+    """
+    import jax
+
+    return jax.device_put(value, device) if device is not None else jax.device_put(value)
+
+
 class Channel:
     """Single-slot rendezvous buffer: write blocks while full, read blocks
     while empty (the mutable-plasma-channel protocol)."""
@@ -82,7 +95,5 @@ class DeviceChannel(Channel):
         # full channel holds only the source copy, never a second
         # device-resident one (ICI copy deferred until it can be consumed)
         if self._device is not None:
-            import jax
-
-            value = jax.device_put(value, self._device)
+            value = device_place(value, self._device)
         return value

@@ -86,9 +86,84 @@ class _DriverInvoker:
         return self._subs[actor_id].invoke(inst, actor_id, method, args, kwargs)
 
 
+class StageGroup:
+    """A mesh-sharded SPMD gang executing the SAME jit'd step as ONE plan
+    stage.
+
+    ``StageGroup([a0, a1, ...], "step").bind(inp)`` places a gang stage in a
+    compiled plan: every iteration the stage executor splits device-array
+    inputs across the members along ``split_axis`` (replicating everything
+    else), runs each member's ``method`` concurrently, and reassembles the
+    outputs into one ``jax.Array`` (mesh-sharded via
+    ``jax.make_array_from_single_device_arrays`` when ``mesh`` — a name
+    registered in ``parallel.mesh.mesh_manager()`` — matches the member
+    count, a device concat otherwise).  ``warmup=(shape, dtype)`` primes
+    every member's jit trace ONCE at install on a zeros example of the
+    per-member split, so iterations never retrace (trace-once,
+    execute-many).  All members must be co-hosted in one process; a member
+    death flips the plan BROKEN with :class:`ActorDiedError` and
+    ``repair()`` waits for every member before reinstalling."""
+
+    def __init__(self, actors, method: str, *, mesh: Optional[str] = None,
+                 split_axis: int = 0, warmup=None):
+        if not actors:
+            raise ValueError("StageGroup needs at least one member actor")
+        self.actors = list(actors)
+        self.method = method
+        self.mesh = mesh
+        self.split_axis = split_axis
+        self.warmup = warmup
+
+    def bind(self, *args, **kwargs) -> "StageGroupNode":
+        return StageGroupNode(self, args, kwargs)
+
+
+class StageGroupNode(ClassMethodNode):
+    """DAG node for a gang stage — shaped like a ClassMethodNode (the plan
+    compiler treats member 0 as the stage's nominal actor) but carrying the
+    whole :class:`StageGroup`."""
+
+    def __init__(self, group: StageGroup, args: tuple, kwargs: dict):
+        DAGNode.__init__(self, args, kwargs)
+        self.group = group
+
+    @property
+    def actor_handle(self):
+        return self.group.actors[0]
+
+    @property
+    def method_name(self) -> str:
+        return self.group.method
+
+    def _submit(self, cache, input_args, input_kwargs):
+        raise ValueError(
+            "stage groups execute through compile_plan(), not interpreted .execute()"
+        )
+
+
+def _group_payload(group: Optional[StageGroup], wire: bool) -> Optional[dict]:
+    """StageSpec / install-RPC encoding of a StageGroup: ``wire=True`` uses
+    actor-id bytes (what ``install_remote_plan`` decodes); ``wire=False``
+    keeps ActorID objects for the driver-local StageSpec."""
+    if group is None:
+        return None
+    warm = None
+    if group.warmup is not None:
+        shape, dtype = group.warmup
+        warm = [list(shape), str(dtype)]
+    return {
+        "members": [
+            (a._actor_id.binary() if wire else a._actor_id) for a in group.actors
+        ],
+        "split_axis": group.split_axis,
+        "mesh": group.mesh,
+        "warmup": warm,
+    }
+
+
 class _StageDraft:
     __slots__ = ("stage_id", "node", "actor_id", "node_id", "proc",
-                 "arg_slots", "kw_slots", "inchan", "outs", "name")
+                 "arg_slots", "kw_slots", "inchan", "outs", "name", "group")
 
     def __init__(self, stage_id: int, node: ClassMethodNode):
         self.stage_id = stage_id
@@ -101,6 +176,8 @@ class _StageDraft:
         self.inchan: Optional[str] = None
         self.outs: List[str] = []
         self.name = node.method_name
+        #: the StageGroup when this stage is an SPMD gang, else None
+        self.group: Optional[StageGroup] = getattr(node, "group", None)
 
 
 class ExecutionPlan:
@@ -226,16 +303,54 @@ class ExecutionPlan:
             draft.outs.append(chan)
             self._output_names.append(chan)
 
-        # placement: every stage actor must be ALIVE somewhere
+        # placement: every stage actor must be ALIVE somewhere; gang stages
+        # additionally require every member co-hosted in ONE process (the
+        # split/assemble handoff is an in-process HBM move, never a wire hop)
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
         self._stages = list(drafts.values())
         self._consts = consts
         self._actor_ids = set()
         self._node_ids = set()
         for draft in self._stages:
-            draft.node_id = self._wait_actor_alive(draft.actor_id)
-            draft.proc = self._proc_key(draft.node_id)
-            self._actor_ids.add(draft.actor_id)
-            self._node_ids.add(draft.node_id)
+            if draft.group is not None:
+                members = draft.group.actors
+                if len(members) > cfg.plan_stage_group_max_members:
+                    raise ValueError(
+                        f"stage group {draft.name!r} has {len(members)} members "
+                        f"(plan_stage_group_max_members={cfg.plan_stage_group_max_members})"
+                    )
+                member_nodes = [
+                    self._wait_actor_alive(a._actor_id) for a in members
+                ]
+                procs = {self._proc_key(nid) for nid in member_nodes}
+                if len(procs) != 1:
+                    raise ValueError(
+                        f"stage group {draft.name!r} members span processes "
+                        f"{sorted(procs)}; a gang must be co-hosted in one process"
+                    )
+                draft.node_id = member_nodes[0]
+                draft.proc = procs.pop()
+                for a, nid in zip(members, member_nodes):
+                    self._actor_ids.add(a._actor_id)
+                    self._node_ids.add(nid)
+            else:
+                draft.node_id = self._wait_actor_alive(draft.actor_id)
+                draft.proc = self._proc_key(draft.node_id)
+                self._actor_ids.add(draft.actor_id)
+                self._node_ids.add(draft.node_id)
+
+        # channel kinds: with plan_channel_kind "auto"/"device" every edge is
+        # device-capable (array payloads stay HBM-resident; non-arrays fall
+        # back to pickle per-seq on the same edge); "pickle" forces the
+        # original frame path everywhere
+        kind = "pickle" if cfg.plan_channel_kind == "pickle" else "device"
+        all_chans = (
+            {c for d in self._stages for c in d.outs}
+            | {d.inchan for d in self._stages if d.inchan}
+        )
+        self._chan_kinds: Dict[str, str] = {c: kind for c in all_chans}
 
     def _wait_actor_alive(self, actor_id, timeout: float = 30.0):
         from ray_tpu.runtime.control import ActorState
@@ -346,7 +461,9 @@ class ExecutionPlan:
 
         # driver-hosted channels (locals + inbound from agents)
         driver_chans = [c for c, p in proc_of_chan.items() if p == "driver"]
-        chans = self._manager.register(self.plan_id, driver_chans)
+        chans = self._manager.register(
+            self.plan_id, driver_chans, kinds=self._chan_kinds
+        )
         self._out_channels = [chans[c] for c in self._output_names]
 
         # driver-side outbound writers (driver -> agent edges)
@@ -358,6 +475,7 @@ class ExecutionPlan:
                 addr_of(cproc, pproc), self.plan_id, chan,
                 chunk_bytes=cfg.object_transfer_chunk_bytes,
                 timeout=cfg.compiled_plan_channel_timeout_s,
+                kind=self._chan_kinds.get(chan, "pickle"),
             )
             driver_writers[chan] = stream
             self._streams.append(stream)
@@ -382,13 +500,19 @@ class ExecutionPlan:
             if proc == "driver":
                 continue
             handle = self._remote_handles[proc]
+            proc_chans = [c for c, p in proc_of_chan.items() if p == proc]
+            proc_writers = {
+                chan: addr_of(cproc, proc)
+                for chan, (pproc, cproc) in writer_addr.items()
+                if pproc == proc
+            }
             payload = {
                 "plan": self.plan_id,
-                "channels": [c for c, p in proc_of_chan.items() if p == proc],
-                "writers": {
-                    chan: addr_of(cproc, proc)
-                    for chan, (pproc, cproc) in writer_addr.items()
-                    if pproc == proc
+                "channels": proc_chans,
+                "kinds": {c: self._chan_kinds.get(c, "pickle") for c in proc_chans},
+                "writers": proc_writers,
+                "writer_kinds": {
+                    c: self._chan_kinds.get(c, "pickle") for c in proc_writers
                 },
                 "consts": rpc.dumps_value(self._consts),
                 "stages": [
@@ -401,6 +525,7 @@ class ExecutionPlan:
                         "kwargs": {k: list(s) for k, s in d.kw_slots.items()},
                         "inchan": d.inchan,
                         "outs": d.outs,
+                        "group": _group_payload(d.group, wire=True),
                     }
                     for d in by_proc[proc]
                 ],
@@ -410,14 +535,24 @@ class ExecutionPlan:
         # driver-hosted stage executor
         driver_stages = [
             StageSpec(d.stage_id, d.actor_id, d.node.method_name, d.name,
-                      d.arg_slots, d.kw_slots, d.inchan, d.outs)
+                      d.arg_slots, d.kw_slots, d.inchan, d.outs,
+                      group=_group_payload(d.group, wire=False))
             for d in by_proc.get("driver", ())
         ]
         if driver_stages:
-            invoker = _DriverInvoker(
-                self._cluster,
-                {d.actor_id: d.node_id for d in by_proc["driver"]},
-            )
+            invoker_map: Dict[Any, Any] = {}
+            for d in by_proc["driver"]:
+                if d.group is not None:
+                    # gang members may sit on different in-process nodes of
+                    # the driver cluster — resolve each against control
+                    for a in d.group.actors:
+                        info = self._cluster.control.actors.get(a._actor_id)
+                        invoker_map[a._actor_id] = (
+                            info.node_id if info is not None else d.node_id
+                        )
+                else:
+                    invoker_map[d.actor_id] = d.node_id
+            invoker = _DriverInvoker(self._cluster, invoker_map)
             self._executor = StageExecutor(
                 self.plan_id, driver_stages, self._consts, self._manager,
                 invoker, driver_writers, on_broken=self._mark_broken,
@@ -600,12 +735,29 @@ class ExecutionPlan:
                 # node — ONE deadline for the whole pass, so `timeout`
                 # bounds the repair wait, not timeout-per-stage
                 deadline = time.monotonic() + timeout
+                self._node_ids = set()
                 for draft in self._stages:
-                    draft.node_id = self._wait_stage_actor_live(
-                        draft.actor_id, deadline
-                    )
-                    draft.proc = self._proc_key(draft.node_id)
-                self._node_ids = {d.node_id for d in self._stages}
+                    if draft.group is not None:
+                        # every gang member must come back, still co-hosted
+                        member_nodes = [
+                            self._wait_stage_actor_live(a._actor_id, deadline)
+                            for a in draft.group.actors
+                        ]
+                        procs = {self._proc_key(nid) for nid in member_nodes}
+                        if len(procs) != 1:
+                            raise WorkerCrashedError(
+                                f"stage group {draft.name!r} members restarted "
+                                f"across processes {sorted(procs)}"
+                            )
+                        draft.node_id = member_nodes[0]
+                        draft.proc = procs.pop()
+                        self._node_ids.update(member_nodes)
+                    else:
+                        draft.node_id = self._wait_stage_actor_live(
+                            draft.actor_id, deadline
+                        )
+                        draft.proc = self._proc_key(draft.node_id)
+                        self._node_ids.add(draft.node_id)
                 # 2. release the broken fabric: driver executor + streams,
                 # remote stage programs, local channel registrations.  The
                 # drainer has already failed every pending future (the
@@ -662,12 +814,17 @@ class ExecutionPlan:
         from ray_tpu.runtime.control import ActorState
 
         for draft in self._stages:
-            info = self._cluster.control.actors.get(draft.actor_id)
-            if info is None or info.state is ActorState.DEAD:
-                self._mark_broken(
-                    ActorDiedError(draft.actor_id, "stage actor died during repair")
-                )
-                return
+            members = (
+                [a._actor_id for a in draft.group.actors]
+                if draft.group is not None else [draft.actor_id]
+            )
+            for actor_id in members:
+                info = self._cluster.control.actors.get(actor_id)
+                if info is None or info.state is ActorState.DEAD:
+                    self._mark_broken(
+                        ActorDiedError(actor_id, "stage actor died during repair")
+                    )
+                    return
 
     def on_actor_dead(self, actor_id, cause: str = "") -> None:
         """Cluster hook: a stage actor died — flip BROKEN even with no
@@ -732,6 +889,7 @@ class ExecutionPlan:
                     "actor": d.actor_id.hex()[:8],
                     "node": d.node_id.hex()[:8],
                     "proc": "driver" if d.proc == "driver" else "agent",
+                    "group": len(d.group.actors) if d.group is not None else 0,
                 }
                 for d in sorted(self._stages, key=lambda d: d.stage_id)
             ],
@@ -739,5 +897,6 @@ class ExecutionPlan:
                 {c for d in self._stages for c in d.outs}
                 | {d.inchan for d in self._stages if d.inchan}
             ),
+            "channel_kinds": dict(getattr(self, "_chan_kinds", {})),
             "error": repr(self._error) if self._error is not None else None,
         }

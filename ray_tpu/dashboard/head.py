@@ -472,7 +472,9 @@ class DashboardHead:
         channel traffic/occupancy counters — 'is the compiled hot path
         actually carrying the iterations?'."""
         from ray_tpu.observability import metric_defs
+        from ray_tpu.runtime import channel_manager
 
+        dev = channel_manager.device_channel_stats()
         return {
             "plans": [
                 p.snapshot() for p in list(self.cluster.compiled_plans.values())
@@ -483,6 +485,15 @@ class DashboardHead:
                 "channel_bytes_sent": metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "sent"}),
                 "channel_bytes_received": metric_defs.COMPILED_CHANNEL_BYTES.get({"direction": "received"}),
                 "channel_occupancy": metric_defs.COMPILED_CHANNEL_OCCUPANCY.get(),
+                "device_channel_bytes_sent": metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get(
+                    {"direction": "sent"}
+                ),
+                "device_channel_bytes_received": metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get(
+                    {"direction": "received"}
+                ),
+                "device_channel_occupancy": dev["occupied_slots"],
+                "hbm_resident_bytes": dev["hbm_resident_bytes"],
+                "stage_group_executions": metric_defs.PLAN_STAGE_GROUP_EXECUTIONS.get(),
             },
         }
 

@@ -458,9 +458,11 @@ def loss_fn(cfg: TransformerConfig, params, tokens, *, act_spec=None, mesh=None,
     activations force XLA to pad/slice every (8,128)-tiled tensor in the
     step (measured ~2% of a 602M train step), while full-T stays
     tile-aligned."""
+    from ray_tpu.parallel._compat import spmd_roll
+
     B, T = tokens.shape
     logits = forward(cfg, params, tokens, act_spec=act_spec, mesh=mesh, sp_axis=sp_axis)
-    targets = jnp.roll(tokens, -1, axis=1)  # [:, T-1] rolls around: masked
+    targets = spmd_roll(tokens, -1, axis=1)  # [:, T-1] rolls around: masked
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = (jnp.arange(T) < T - 1).astype(nll.dtype)[None, :]
@@ -494,9 +496,13 @@ def make_train_step(
     ring_mesh = None
     sp_ax = None
     if mesh is not None:
+        from ray_tpu.parallel._compat import constraint_sharding
+
         axis_names = set(mesh.axis_names)
         sp_ax = sp if (sp and sp in axis_names) else None
-        act_spec = P(dp if dp in axis_names else None, sp_ax, None)
+        # bound to a NamedSharding so the jitted step works without an
+        # ambient mesh context at the call site (see parallel/_compat.py)
+        act_spec = constraint_sharding(mesh, P(dp if dp in axis_names else None, sp_ax, None))
         if cfg.attention == "ring":
             if sp_ax is None:
                 raise ValueError(

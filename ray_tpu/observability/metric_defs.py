@@ -216,6 +216,19 @@ COMPILED_CHANNEL_OCCUPANCY = _reg.gauge(
     "(single-slot channels: occupancy == iterations buffered between stages).",
     "slots",
 )
+COMPILED_DEVICE_CHANNEL_BYTES = _reg.counter(
+    "compiled_device_channel_bytes_total",
+    "Array payload bytes moved over DEVICE-kind compiled-plan edges, by "
+    "direction (sent / received).  These bytes bypassed pickle entirely: "
+    "the chan_push frame was control-only (dtype/shape header) and the "
+    "payload rode a device-to-device pull or raw host-staged buffers.",
+    "By",
+)
+PLAN_STAGE_GROUP_EXECUTIONS = _reg.counter(
+    "plan_stage_group_executions_total",
+    "SPMD stage-group iterations executed through installed plans — one per "
+    "gang dispatch (split args -> member jit step x N -> reassemble output).",
+)
 
 # ---- serve router --------------------------------------------------------
 SERVE_ROUTER_REQUESTS = _reg.counter(
@@ -376,6 +389,8 @@ ALL_METRICS = [
     COMPILED_PLAN_EXECUTIONS,
     COMPILED_CHANNEL_BYTES,
     COMPILED_CHANNEL_OCCUPANCY,
+    COMPILED_DEVICE_CHANNEL_BYTES,
+    PLAN_STAGE_GROUP_EXECUTIONS,
     SERVE_ROUTER_REQUESTS,
     SERVE_ROUTER_QUEUE_WAIT,
     SERVE_ROUTER_INFLIGHT,

@@ -125,6 +125,52 @@ def replicate(x, mesh: Mesh):
     return jax.device_put(x, named_sharding(mesh))
 
 
+# --------------------------------------------------------------------------
+# SPMD stage-group split/assemble (compiled-plan gang stages, dag/plan.py)
+# --------------------------------------------------------------------------
+def split_for_group(value, n: int, axis: int = 0) -> List:
+    """Split a device array into ``n`` member shards along ``axis``.
+
+    ``jnp.split`` slices stay on device — no host round trip — so a gang
+    stage's input fan-out is pure HBM work.  The split dimension must divide
+    evenly (callers replicate non-divisible args instead).
+    """
+    import jax.numpy as jnp
+
+    if n <= 1:
+        return [value]
+    return list(jnp.split(value, n, axis=axis))
+
+
+def assemble_from_group(parts: Sequence, mesh: Optional[Mesh] = None, axis: int = 0):
+    """Assemble gang-member outputs into ONE ``jax.Array``.
+
+    With a mesh whose device count matches the member count, the parts
+    become the per-device shards of a mesh-sharded array via
+    ``jax.make_array_from_single_device_arrays`` (zero host copies on TPU);
+    otherwise — notably the single-device CPU test backend — the parts are
+    concatenated on device along ``axis``.
+    """
+    import jax.numpy as jnp
+
+    parts = list(parts)
+    if not parts:
+        raise ValueError("no member outputs to assemble")
+    if len(parts) == 1 and mesh is None:
+        return parts[0]
+    if mesh is not None:
+        devs = list(np.asarray(mesh.devices).flat)
+        if len(devs) == len(parts):
+            shape = list(parts[0].shape)
+            shape[axis] = sum(int(p.shape[axis]) for p in parts)
+            spec: List = [None] * len(shape)
+            spec[axis] = tuple(mesh.axis_names) if len(mesh.axis_names) > 1 else mesh.axis_names[0]
+            sharding = NamedSharding(mesh, PartitionSpec(*spec))
+            shards = [jax.device_put(p, d) for p, d in zip(parts, devs)]
+            return jax.make_array_from_single_device_arrays(tuple(shape), sharding, shards)
+    return jnp.concatenate(parts, axis=axis)
+
+
 _global_manager: Optional[MeshManager] = None
 _global_lock = threading.Lock()
 

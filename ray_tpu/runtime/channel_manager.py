@@ -72,21 +72,58 @@ class _Occupancy:
 _occupancy = _Occupancy()
 
 
+class _DeviceChannelStats:
+    """HBM-resident accounting for DEVICE-kind channel slots in this process
+    (feeds ``/api/plans`` and ``rt plans``): how many device slots currently
+    hold an array, and how many array bytes they pin in HBM."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.occupied = 0
+        self.hbm_bytes = 0
+
+    def delta(self, slots: int, nbytes: int) -> None:
+        with self._lock:
+            self.occupied += slots
+            self.hbm_bytes += nbytes
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"occupied_slots": self.occupied, "hbm_resident_bytes": self.hbm_bytes}
+
+
+_device_stats = _DeviceChannelStats()
+
+
+def device_channel_stats() -> Dict[str, int]:
+    """Process-wide device-channel occupancy (dashboard/CLI surface)."""
+    return _device_stats.snapshot()
+
+
 class SeqChannel:
     """Single-slot seq-numbered channel: ``write`` blocks while full, ``read``
     blocks while empty; ``close(error)`` wakes both sides with the typed
     error (or :class:`ChannelClosed`).  The mutable-plasma-channel protocol
     of ``dag/channel.Channel``, plus the iteration sequence number the
-    cross-process stream carries on the wire."""
+    cross-process stream carries on the wire.
 
-    __slots__ = ("name", "_cond", "_slot", "_closed", "_error")
+    ``kind="device"`` extends ``dag/channel.DeviceChannel``'s slot semantics:
+    an array payload stays HBM-resident in the slot — handing it between
+    co-located stages is a reference move, never a host copy — and the slot
+    contributes to the process's HBM-resident accounting while occupied."""
 
-    def __init__(self, name: str = ""):
+    __slots__ = ("name", "kind", "_device", "_cond", "_slot", "_closed",
+                 "_error", "_slot_nbytes")
+
+    def __init__(self, name: str = "", kind: str = "pickle", device=None):
         self.name = name
+        self.kind = kind
+        self._device = device
         self._cond = threading.Condition()
         self._slot: Optional[Tuple[int, Any, bool]] = None
         self._closed = False
         self._error: Optional[BaseException] = None
+        self._slot_nbytes = 0
 
     def _raise_closed(self) -> None:
         if self._error is not None:
@@ -95,6 +132,29 @@ class SeqChannel:
             raise raised_copy(self._error)
         raise ChannelClosed(f"channel {self.name!r} closed")
 
+    def _place(self, value: Any, is_error: bool) -> Tuple[Any, int]:
+        """Device-kind slot placement — runs AFTER slot acquisition (the
+        ``dag/channel.Channel._place`` contract: a writer blocked on a full
+        slot must not pin a second HBM copy for the whole wait), and ONLY on
+        kind transitions: an already device-resident array is a pure
+        reference move; a host ndarray arriving on a device channel is
+        device_put once; non-array payloads (the per-seq pickle fallback)
+        pass through untouched."""
+        if self.kind != "device" or is_error:
+            return value, 0
+        from ray_tpu.runtime import device_plane
+
+        if device_plane.is_device_array(value):
+            return value, int(value.nbytes)
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            from ray_tpu.dag.channel import device_place
+
+            value = device_place(value, self._device)
+            return value, int(value.nbytes)
+        return value, 0
+
     def write(self, seq: int, value: Any, is_error: bool = False,
               timeout: Optional[float] = None) -> None:
         with self._cond:
@@ -102,9 +162,13 @@ class SeqChannel:
                 raise TimeoutError(f"channel {self.name!r} write timed out")
             if self._closed:
                 self._raise_closed()
+            value, nbytes = self._place(value, is_error)
             self._slot = (seq, value, is_error)
+            self._slot_nbytes = nbytes
             self._cond.notify_all()
         _occupancy.delta(1)
+        if nbytes:
+            _device_stats.delta(1, nbytes)
 
     def read(self, timeout: Optional[float] = None) -> Tuple[int, Any, bool]:
         with self._cond:
@@ -114,8 +178,11 @@ class SeqChannel:
                 self._raise_closed()
             item = self._slot
             self._slot = None
+            nbytes, self._slot_nbytes = self._slot_nbytes, 0
             self._cond.notify_all()
         _occupancy.delta(-1)
+        if nbytes:
+            _device_stats.delta(-1, -nbytes)
         return item
 
     def close(self, error: Optional[BaseException] = None) -> None:
@@ -129,9 +196,12 @@ class SeqChannel:
                 drained = True
             else:
                 drained = False
+            nbytes, self._slot_nbytes = self._slot_nbytes, 0
             self._cond.notify_all()
         if drained:
             _occupancy.delta(-1)
+            if nbytes:
+                _device_stats.delta(-1, -nbytes)
 
     @property
     def closed(self) -> bool:
@@ -150,13 +220,15 @@ class ChannelManager:
         self._lock = threading.Lock()
         self._channels: Dict[Tuple[str, str], SeqChannel] = {}
 
-    def register(self, plan_id: str, names) -> Dict[str, SeqChannel]:
+    def register(self, plan_id: str, names,
+                 kinds: Optional[Dict[str, str]] = None) -> Dict[str, SeqChannel]:
         out = {}
         with self._lock:
             for name in names:
                 ch = self._channels.get((plan_id, name))
                 if ch is None:
-                    ch = self._channels[(plan_id, name)] = SeqChannel(name)
+                    kind = (kinds or {}).get(name, "pickle")
+                    ch = self._channels[(plan_id, name)] = SeqChannel(name, kind=kind)
                 out[name] = ch
         return out
 
@@ -290,11 +362,12 @@ class StageSpec:
     """One locally-hosted stage of an installed plan (plain data)."""
 
     __slots__ = ("stage_id", "actor_id", "method", "name", "arg_slots",
-                 "kw_slots", "inchan", "outs")
+                 "kw_slots", "inchan", "outs", "group")
 
     def __init__(self, stage_id: int, actor_id, method: str, name: str,
                  arg_slots: List[tuple], kw_slots: Dict[str, tuple],
-                 inchan: Optional[str], outs: List[str]):
+                 inchan: Optional[str], outs: List[str],
+                 group: Optional[dict] = None):
         self.stage_id = stage_id
         self.actor_id = actor_id
         self.method = method
@@ -304,6 +377,10 @@ class StageSpec:
         self.kw_slots = kw_slots
         self.inchan = inchan          # entry channel carrying the DAG input
         self.outs = outs              # output channel names (local or remote)
+        #: SPMD stage group: {"members": [ActorID, ...], "split_axis": int,
+        #: "mesh": name|None, "warmup": [shape, dtype]|None} — the stage is a
+        #: gang executing the same jit'd step on per-member array shards
+        self.group = group
 
 
 def select_input(payload: Any, key) -> Any:
@@ -344,10 +421,30 @@ class StageExecutor:
         self._on_broken = on_broken
         self._trace_id = trace_id or f"plan-{plan_id[:12]}"
         self._stop = False
-        self._insts = {s.stage_id: invoker.resolve(s.actor_id) for s in stages}
+        self._insts = {}
+        self._group_insts: Dict[int, List[Any]] = {}
+        self._group_pools: Dict[int, Any] = {}
+        for s in stages:
+            if s.group:
+                members = [invoker.resolve(a) for a in s.group["members"]]
+                self._group_insts[s.stage_id] = members
+                self._insts[s.stage_id] = members[0]
+            else:
+                self._insts[s.stage_id] = invoker.resolve(s.actor_id)
         self._threads: List[threading.Thread] = []
 
     def start(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        for stage in self._stages:
+            if stage.group:
+                n = len(stage.group["members"])
+                if n > 1:
+                    self._group_pools[stage.stage_id] = ThreadPoolExecutor(
+                        max_workers=n - 1,
+                        thread_name_prefix=f"plan-{self.plan_id[:8]}-g{stage.stage_id}",
+                    )
+                self._warmup_group(stage)
         for stage in self._stages:
             t = threading.Thread(
                 target=self._stage_loop, args=(stage,),
@@ -363,8 +460,102 @@ class StageExecutor:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+        for pool in self._group_pools.values():
+            pool.shutdown(wait=False)
 
     # ------------------------------------------------------------------
+    def _warmup_group(self, stage: StageSpec) -> None:
+        """Install-time trace priming: invoke every gang member ONCE on a
+        zeros example shaped like its per-member split, so the jit'd step
+        traces at install and every ``execute`` is a pure cached call
+        (trace-once, execute-many)."""
+        g = stage.group
+        warm = g.get("warmup")
+        if not warm:
+            return
+        import numpy as np
+
+        from ray_tpu.dag.channel import device_place
+
+        shape, dtype = list(warm[0]), warm[1]
+        n = len(g["members"])
+        axis = g.get("split_axis", 0)
+        if n > 1 and len(shape) > axis and shape[axis] % n == 0:
+            shape[axis] //= n
+        x = device_place(np.zeros(tuple(shape), dtype=np.dtype(dtype)))
+        for inst, actor_id in zip(self._group_insts[stage.stage_id], g["members"]):
+            self._invoker.invoke(inst, actor_id, stage.method, (x,), {})
+
+    def _group_mesh(self, g: dict):
+        name = g.get("mesh")
+        if not name:
+            return None
+        try:
+            from ray_tpu.parallel.mesh import mesh_manager
+
+            return mesh_manager().get_mesh(name)
+        except KeyError:
+            return None
+
+    def _invoke_group(self, stage: StageSpec, args: tuple, kwargs: dict) -> Any:
+        """One gang dispatch: split device-array args across the members
+        along the group axis (replicating everything else), run every
+        member's jit'd step concurrently, reassemble the outputs into one
+        array (mesh-sharded when the mesh matches, device concat otherwise)."""
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+        from ray_tpu.observability import metric_defs
+        from ray_tpu.parallel import mesh as mesh_mod
+        from ray_tpu.runtime import device_plane
+
+        g = stage.group
+        members = g["members"]
+        insts = self._group_insts[stage.stage_id]
+        n = len(members)
+        axis = g.get("split_axis", 0)
+
+        def parts_of(v):
+            if (n > 1 and device_plane.is_device_array(v)
+                    and getattr(v, "ndim", 0) > axis and v.shape[axis] % n == 0):
+                return mesh_mod.split_for_group(v, n, axis=axis)
+            return [v] * n
+
+        arg_parts = [parts_of(a) for a in args]
+        kw_parts = {k: parts_of(v) for k, v in kwargs.items()}
+
+        def member_call(i: int):
+            m_args = tuple(p[i] for p in arg_parts)
+            m_kwargs = {k: p[i] for k, p in kw_parts.items()}
+            return self._invoker.invoke(insts[i], members[i], stage.method,
+                                        m_args, m_kwargs)
+
+        pool = self._group_pools.get(stage.stage_id)
+        futs = {i: pool.submit(member_call, i) for i in range(1, n)} if pool else {}
+        outs: List[Any] = [None] * n
+        first_err: Optional[BaseException] = None
+        try:
+            outs[0] = member_call(0)
+        except BaseException as exc:  # noqa: BLE001
+            first_err = exc
+        for i, fut in futs.items():
+            try:
+                outs[i] = fut.result()
+            except BaseException as exc:  # noqa: BLE001
+                # prefer the typed death over a secondary failure
+                if first_err is None or (
+                    isinstance(exc, (ActorDiedError, WorkerCrashedError))
+                    and not isinstance(first_err, (ActorDiedError, WorkerCrashedError))
+                ):
+                    first_err = exc
+        if first_err is not None:
+            raise first_err
+        metric_defs.PLAN_STAGE_GROUP_EXECUTIONS.inc()
+        if n == 1:
+            return outs[0]
+        if all(device_plane.is_device_array(o) and getattr(o, "ndim", 0) > axis
+               for o in outs):
+            return mesh_mod.assemble_from_group(outs, mesh=self._group_mesh(g), axis=axis)
+        return outs  # non-array member outputs pass through as the raw list
+
     def _emit(self, stage: StageSpec, seq: int, value: Any, is_error: bool) -> None:
         for name in stage.outs:
             writer = self._writers.get(name)
@@ -435,9 +626,12 @@ class StageExecutor:
                         for k, s in stage.kw_slots.items()
                     }
                     t0 = time.time()
-                    result = self._invoker.invoke(
-                        inst, stage.actor_id, stage.method, args, kwargs
-                    )
+                    if stage.group:
+                        result = self._invoke_group(stage, args, kwargs)
+                    else:
+                        result = self._invoker.invoke(
+                            inst, stage.actor_id, stage.method, args, kwargs
+                        )
                     if tracing.enabled():
                         tracing.emit_span(
                             f"stage::{stage.name}", self._trace_id, None,
@@ -491,23 +685,38 @@ def install_remote_plan(payload: dict, node, conn) -> None:
 
     cfg = get_config()
     plan_id = payload["plan"]
+    kinds = payload.get("kinds") or {}
     mgr = global_manager()
-    mgr.register(plan_id, payload.get("channels", ()))
+    mgr.register(plan_id, payload.get("channels", ()), kinds=kinds)
+    writer_kinds = payload.get("writer_kinds") or {}
     writers = {
         name: data_plane.ChannelStream(
             addr, plan_id, name,
             chunk_bytes=cfg.object_transfer_chunk_bytes,
             timeout=cfg.compiled_plan_channel_timeout_s,
+            kind=writer_kinds.get(name, "pickle"),
         )
         for name, addr in (payload.get("writers") or {}).items()
     }
     consts = pickle.loads(payload["consts"]) if payload.get("consts") else []
+
+    def _decode_group(d: Optional[dict]) -> Optional[dict]:
+        if not d:
+            return None
+        return {
+            "members": [ActorID(m) for m in d["members"]],
+            "split_axis": d.get("split_axis", 0),
+            "mesh": d.get("mesh"),
+            "warmup": d.get("warmup"),
+        }
+
     stages = [
         StageSpec(
             d["stage"], ActorID(d["actor_id"]), d["method"], d["name"],
             [tuple(s) for s in d["args"]],
             {k: tuple(s) for k, s in d.get("kwargs", {}).items()},
             d.get("inchan"), list(d.get("outs", ())),
+            group=_decode_group(d.get("group")),
         )
         for d in payload.get("stages", ())
     ]

@@ -679,6 +679,27 @@ class DataServer:
                 sock, {"ok": False, "fenced": True, "error": "fenced: stale incarnation"}
             )
             return
+        dev = req.get("device")
+        if dev is not None:
+            # DEVICE-kind frame: the header IS the metadata (dtype/shape/
+            # transfer ticket); the payload never saw pickle.  Materialize
+            # straight to a device array — a failed device-to-device pull
+            # nacks with a fallback flag so the producer resends host-staged.
+            from ray_tpu.observability import metric_defs
+            from ray_tpu.runtime import channel_manager
+
+            value, err = _materialize_device_frame(dev, buffers)
+            if value is None:
+                _send_header(sock, {"ok": False, "fallback": True, "error": err})
+                return
+            metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.inc(
+                int(value.nbytes), tags={"direction": "received"}
+            )
+            ok, err = channel_manager.deliver(
+                req["plan"], req["chan"], req["seq"], value, False
+            )
+            _send_header(sock, {"ok": ok, "error": err})
+            return
         try:
             value = from_frames(meta, buffers)
         except Exception as exc:  # noqa: BLE001 — poisoned frame: nack, keep the stream
@@ -1237,6 +1258,31 @@ class DataClient:
         self.stats.add("bytes_sent", len(meta) + sum(sizes))
 
 
+def _materialize_device_frame(dev: dict, buffers: List[Any]):
+    """Rebuild a device-channel frame's payload WITHOUT pickle: either a
+    device-to-device pull of the producer-staged array (``dev["xfer"]``
+    ticket) or — the CPU/no-transfer-server fallback — the host-staged raw
+    bytes assembled by ``collective._rendezvous_device_frame``.  Returns
+    ``(array, "")`` or ``(None, reason)``."""
+    from ray_tpu.parallel import collective
+
+    try:
+        xfer = dev.get("xfer")
+        if xfer is not None:
+            arr = collective.pull_device_value(xfer, dev["shape"], dev["dtype"])
+            if arr is None:
+                return None, "device pull unavailable"
+            return arr, ""
+        if not buffers:
+            return None, "device frame carried no payload"
+        return (
+            collective._rendezvous_device_frame(dev["shape"], dev["dtype"], buffers[0]),
+            "",
+        )
+    except Exception as exc:  # noqa: BLE001 — backend mismatch, expired entry
+        return None, f"device frame materialize failed: {exc!r}"
+
+
 class ChannelStream:
     """Persistent data-plane connection carrying ONE compiled-plan channel.
 
@@ -1250,12 +1296,17 @@ class ChannelStream:
     :class:`~ray_tpu.dag.channel.ChannelClosed`."""
 
     def __init__(self, addr: str, plan_id: str, chan: str,
-                 chunk_bytes: int = 8 * 1024 * 1024, timeout: float = 300.0):
+                 chunk_bytes: int = 8 * 1024 * 1024, timeout: float = 300.0,
+                 kind: str = "pickle"):
         self.addr = addr
         self.plan_id = plan_id
         self.chan = chan
         self.chunk_bytes = chunk_bytes
         self.timeout = timeout
+        #: "device": array payloads ride control-only headers (see
+        #: _push_device) — everything else falls back to the pickle frames
+        self.kind = kind
+        self._stager = None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._closed = False
@@ -1270,6 +1321,12 @@ class ChannelStream:
     def push(self, seq: int, value: Any, is_error: bool = False) -> None:
         from ray_tpu.dag.channel import ChannelClosed
         from ray_tpu.observability import metric_defs
+
+        if self.kind == "device" and not is_error:
+            from ray_tpu.runtime import device_plane
+
+            if device_plane.is_device_array(value):
+                return self._push_device(seq, value)
 
         t_start = time.perf_counter()
         meta, buffers = to_frames(value)
@@ -1309,6 +1366,89 @@ class ChannelStream:
                 f"chan::{self.chan}", f"plan-{self.plan_id[:12]}", None,
                 now - (time.perf_counter() - t_start), now,
                 attrs={"seq": str(seq), "bytes": str(nbytes)},
+            )
+
+    def _device_stager(self):
+        if self._stager is None:
+            from ray_tpu.core.config import get_config
+            from ray_tpu.parallel import collective
+
+            self._stager = collective.DeviceChannelStager(
+                f"{self.plan_id}:{self.chan}",
+                double_buffer=get_config().device_channel_double_buffer,
+            )
+        return self._stager
+
+    def _push_device(self, seq: int, arr, force_host: bool = False) -> None:
+        """Device-kind frame: the chan_push header is demoted to control
+        only (dtype/shape/sharding + optional pull descriptor) and the array
+        payload bypasses pickle entirely — either ZERO payload bytes on this
+        stream (the consumer pulls the producer-staged HBM buffer
+        device-to-device) or the raw host-view bytes when no transfer server
+        is running.  Exactly one ``_send_header`` per push, same as the
+        pickle path, so the failpoint decision stream (and same-seed chaos
+        fault logs) stays byte-identical."""
+        import numpy as np
+
+        from ray_tpu.dag.channel import ChannelClosed
+        from ray_tpu.observability import metric_defs
+
+        t_start = time.perf_counter()
+        shape = tuple(int(d) for d in arr.shape)
+        dtype = str(arr.dtype)
+        logical = int(arr.nbytes)
+        desc = None if force_host else self._device_stager().offer(seq, arr)
+        if desc is not None:
+            buffers: List[Any] = []
+            sizes: List[int] = []
+        else:
+            host = np.asarray(arr)
+            if not host.flags.c_contiguous:
+                host = np.ascontiguousarray(host)
+            buffers = [host.reshape(-1).view(np.uint8)]
+            sizes = [logical]
+        with self._lock:
+            if self._closed:
+                raise ChannelClosed(f"channel stream {self.chan!r} closed")
+            if self._sock is None:
+                self._sock = self._connect()
+            sock = self._sock
+            try:
+                _send_header(
+                    sock,
+                    {"op": "chan_push", "plan": self.plan_id, "chan": self.chan,
+                     "seq": seq, "is_error": False, "src": local_source(),
+                     "meta_size": 0, "buffer_sizes": sizes,
+                     "device": {"shape": shape, "dtype": dtype,
+                                "shards": len(getattr(arr, "addressable_shards", ()))
+                                or 1,
+                                "xfer": desc}},
+                )
+                if buffers:
+                    _send_buffers(sock, buffers, self.chunk_bytes)
+                reply = _recv_header(sock)
+            except (OSError, EOFError, pickle.UnpicklingError) as exc:
+                self._drop_sock()
+                raise DataPlaneError(
+                    f"channel push to {self.addr} failed: {exc}"
+                ) from exc
+        if not reply.get("ok"):
+            if desc is not None and reply.get("fallback"):
+                # the peer could not serve the device-to-device pull (no
+                # backend, staged entry expired): resend this seq host-staged
+                return self._push_device(seq, arr, force_host=True)
+            raise ChannelClosed(
+                f"channel {self.chan!r} rejected by {self.addr}: {reply.get('error')}"
+            )
+        metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.inc(logical, tags={"direction": "sent"})
+        from ray_tpu.observability import tracing
+
+        if tracing.enabled():
+            now = time.time()
+            tracing.emit_span(
+                f"chan::{self.chan}", f"plan-{self.plan_id[:12]}", None,
+                now - (time.perf_counter() - t_start), now,
+                attrs={"seq": str(seq), "bytes": str(logical), "kind": "device"},
             )
 
     def _drop_sock(self) -> None:

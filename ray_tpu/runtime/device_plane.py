@@ -160,10 +160,14 @@ def install_transfer_server(server: Optional[Any]) -> None:
     """Inject a transfer server (tests / the fake): subsequent
     ``transfer_server()`` calls return it without probing the platform.
     Pass None to reset to the unprobed state."""
-    global _xfer_server, _xfer_probed
+    global _xfer_server, _xfer_probed, _staged_outstanding
     with _xfer_lock:
         _xfer_server = server
         _xfer_probed = server is not None
+    # staged entries belong to the outgoing server; its replacement (or
+    # removal) invalidates them, so the admission counter resets with it
+    with _staged_lock:
+        _staged_outstanding = 0
 
 
 def transfer_server() -> Optional[Any]:

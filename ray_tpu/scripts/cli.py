@@ -436,6 +436,14 @@ def cmd_plans(args) -> int:
         f"{totals.get('channel_bytes_sent', 0) / 1e6:.1f} MB pushed on channel "
         f"streams ({totals.get('channel_occupancy', 0):.0f} slots occupied)"
     )
+    print(
+        f"device channels: "
+        f"{totals.get('device_channel_bytes_sent', 0) / 1e6:.1f} MB sent / "
+        f"{totals.get('device_channel_bytes_received', 0) / 1e6:.1f} MB received "
+        f"pickle-free, {totals.get('hbm_resident_bytes', 0) / 1e6:.1f} MB "
+        f"HBM-resident in {totals.get('device_channel_occupancy', 0):.0f} device "
+        f"slots, {totals.get('stage_group_executions', 0):.0f} gang iterations"
+    )
     for plan in plans:
         print(
             f"  plan {plan['plan']} [{plan['name']}] {plan['state']}: "
@@ -443,10 +451,15 @@ def cmd_plans(args) -> int:
             f"{plan['inflight']} in flight"
         )
         for stage in plan.get("stages", ()):
+            gang = f" gang={stage['group']}" if stage.get("group") else ""
             print(
                 f"    s{stage['stage']} {stage['method']}() "
                 f"actor {stage['actor']} on node {stage['node']} ({stage['proc']})"
+                f"{gang}"
             )
+        kinds = plan.get("channel_kinds") or {}
+        for name in plan.get("channels", ()):
+            print(f"    edge {name}: {kinds.get(name, 'pickle')}")
         if plan.get("error"):
             print(f"    error: {plan['error']}")
     return 0

@@ -640,6 +640,152 @@ def run_suite(
         finally:
             plan.teardown()
 
+    # ---- device-native plan channels (ISSUE 11) --------------------------
+    if wanted("device_channel_edge_bw") or wanted("device_channel_vs_pickle_x"):
+        # One MB-scale jax array pushed through a REAL chan_push wire
+        # (store_server + ChannelStream + SeqChannel consumer), device kind
+        # vs pickle kind.  Device kind: the push is a control-only header
+        # and the payload moves through the staged device-to-device pull —
+        # zero array bytes on the stream, zero pickling.  The transport
+        # stand-in hands the staged array over as a reference (on real TPU
+        # the pull rides jax.experimental.transfer over ICI), so the row
+        # measures the channel fabric's per-kind cost with the interconnect
+        # externalized; the _x row is the acceptance bar (device > pickle
+        # on >= 1 MiB arrays).
+        import jax
+
+        from ray_tpu.core.object_store import ObjectStore
+        from ray_tpu.runtime import channel_manager, data_plane as dp, device_plane
+
+        size = (1 << 20) if quick else (8 << 20)
+        value = jax.device_put(np.ones(size, np.uint8))
+        jax.block_until_ready(value)
+
+        class _RefTicket:
+            def __init__(self):
+                self._cbs = []
+
+            def add_done_callback(self, fn):
+                self._cbs.append(fn)
+
+            def fire(self):
+                cbs, self._cbs = self._cbs, []
+                for fn in cbs:
+                    fn(self)
+
+        class _RefTransfer:
+            def __init__(self):
+                self._staged = {}
+                self._lock = threading.Lock()
+
+            def address(self):
+                return "inproc:0"
+
+            def await_pull(self, uuid, array):
+                t = _RefTicket()
+                with self._lock:
+                    self._staged[uuid] = (array, t)
+                return t
+
+            def connect(self, addr):
+                return self
+
+            def pull(self, uuid, template):
+                with self._lock:
+                    array, t = self._staged.pop(uuid)
+                t.fire()
+                return array
+
+        mgr = channel_manager.global_manager()
+        store = ObjectStore(shm_store=None)
+        server = dp.store_server(store, chunk_bytes=8 << 20)
+        pushes = max(4, N(16))
+
+        def edge_bytes_per_s(kind: str) -> float:
+            plan_id = f"bench-devchan-{kind}"
+            ch = mgr.register(plan_id, ["edge"], kinds={"edge": kind})["edge"]
+            stream = dp.ChannelStream(server.address, plan_id, "edge", kind=kind)
+            stop = threading.Event()
+
+            def consume():
+                while not stop.is_set():
+                    try:
+                        ch.read(timeout=30)
+                    except Exception:  # noqa: BLE001 — closed: drain done
+                        return
+
+            reader = threading.Thread(target=consume, daemon=True)
+            reader.start()
+            seq = [0]
+
+            def burst():
+                for _ in range(pushes):
+                    stream.push(seq[0], value)
+                    seq[0] += 1
+
+            try:
+                rate = _rate(burst, 1, warmup=1, rounds=3)
+                return rate * pushes * size
+            finally:
+                stop.set()
+                stream.close()
+                mgr.release_plan(plan_id)
+                reader.join(timeout=5)
+
+        try:
+            try:
+                device_plane.install_transfer_server(_RefTransfer())
+                dev_bw = edge_bytes_per_s("device")
+            finally:
+                device_plane.install_transfer_server(None)
+            pickle_bw = edge_bytes_per_s("pickle")
+        finally:
+            server.close()
+        record("device_channel_edge_bw", dev_bw / 1e9, "GB/s")
+        record("device_channel_vs_pickle_x", dev_bw / max(pickle_bw, 1e-9), "x")
+        del value
+
+    if wanted("spmd_pipeline_iter"):
+        # End-to-end us/iter of a plan whose single stage is an SPMD gang:
+        # inputs split across the members, jit'd steps run concurrently,
+        # outputs reassembled into one array — trace once at install
+        # (warmup), execute many.  Steady state via execute_async pipelining,
+        # same shape as compiled_pipeline_iter.
+        import jax.numpy as jnp
+
+        from ray_tpu.dag import InputNode, StageGroup
+
+        @rt.remote
+        class GangWorker:
+            def __init__(self):
+                import jax as _jax
+
+                self._step = _jax.jit(lambda x: x * 2.0 + 1.0)
+
+            def step(self, x):
+                return self._step(x)
+
+        members = [GangWorker.options(execution="inproc").remote() for _ in range(2)]
+        gang = StageGroup(members, "step", split_axis=0, warmup=((8, 128), "float32"))
+        with InputNode() as inp:
+            out = gang.bind(inp)
+        plan = out.compile_plan(name="gang-bench")
+        x = jnp.ones((8, 128), jnp.float32)
+        try:
+            for _ in range(10):
+                plan.execute(x)
+            batch = N(200)
+
+            def gang_batch():
+                futs = [plan.execute_async(x) for _ in range(batch)]
+                for f in futs:
+                    f.result(timeout=120)
+
+            iters_per_s = _rate(gang_batch, 1, warmup=1, rounds=3) * batch
+            record("spmd_pipeline_iter", 1e6 / iters_per_s, "us")
+        finally:
+            plan.teardown()
+
     # ---- placement groups ------------------------------------------------
     if wanted("placement_group_create_removal"):
         from ray_tpu.util.placement import placement_group, remove_placement_group

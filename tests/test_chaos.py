@@ -1110,3 +1110,97 @@ def test_chaos_validate_cli_partition_heal(tmp_path, capsys):
     with open(path, "w") as f:
         _json.dump(bad, f)
     assert validate_cli(Args()) == 1
+
+
+# --------------------------------------------------------------------------
+# 14. kill one SPMD gang member mid-execute_async (ISSUE 11): the plan
+#     flips BROKEN with a typed ActorDiedError, repair() waits for the
+#     restart FSM and reinstalls the whole group (warmup re-primed), and
+#     iterations resume — with same-seed fault logs byte-identical (every
+#     failpoint hit is a workload-driven retried put; gang traffic rides
+#     the same send_frame failpoint as every other frame, unarmed here).
+# --------------------------------------------------------------------------
+def _gang_member_kill_run(seed):
+    rt.init(num_cpus=2)
+    try:
+        schedule = ChaosSchedule(
+            [ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)")],
+            seed=seed, name="gang-member-kill",
+        )
+
+        def workload():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.dag import InputNode, StageGroup
+            from ray_tpu.exceptions import ActorDiedError, RayActorError
+
+            step_fn = jax.jit(lambda x: x + 1.0)
+
+            @rt.remote
+            class Member:
+                def step(self, x):
+                    return step_fn(x)
+
+            members = [
+                Member.options(execution="inproc", max_restarts=1).remote()
+                for _ in range(2)
+            ]
+            gang = StageGroup(members, "step", split_axis=0, warmup=((4, 8), "float32"))
+            with InputNode() as inp:
+                d = gang.bind(inp)
+            plan = d.compile_plan(name="gang-chaos")
+            # deterministic failpoint hits: app-retried puts — each attempt
+            # consumes exactly one decision-stream index
+            refs = []
+            for i in range(10):
+                while True:
+                    try:
+                        refs.append(rt.put(("blob", i)))
+                        break
+                    except failpoints.FailpointInjected:
+                        continue
+            x = jnp.ones((4, 8), jnp.float32)
+            for _ in range(10):
+                out = plan.execute(x)
+                assert float(np.asarray(out).sum()) == 4 * 8 * 2
+            # kill one member with an iteration in flight
+            fut = plan.execute_async(x)
+            rt.kill(members[1], no_restart=False)
+            raised = None
+            try:
+                fut.result(timeout=30)
+            except (ActorDiedError, RayActorError) as exc:
+                raised = exc
+            deadline = time.monotonic() + 30
+            while raised is None and time.monotonic() < deadline:
+                try:
+                    plan.execute(x)
+                except (ActorDiedError, RayActorError) as exc:
+                    raised = exc
+                    break
+            assert isinstance(raised, (ActorDiedError, RayActorError)), raised
+            assert plan.state == "BROKEN"
+            # the restart FSM revives the member; repair reinstalls the gang
+            plan.repair(timeout=30)
+            assert plan.state == "READY"
+            for _ in range(5):
+                out = plan.execute(x)
+                assert float(np.asarray(out).sum()) == 4 * 8 * 2
+            plan.teardown()
+            return refs
+
+        result = ChaosRunner(schedule, quiesce_timeout=90).run(workload)
+        assert result.ok, (result.workload_error, result.invariants.violations)
+        return result
+    finally:
+        rt.shutdown()
+
+
+def test_schedule_gang_member_kill_repair_byte_identical():
+    r1 = _gang_member_kill_run(seed=53)
+    r2 = _gang_member_kill_run(seed=53)
+    assert r1.faults, "the put failpoint must actually fire"
+    assert all(f["fp"] == "object_store.put" for f in r1.faults)
+    assert r1.same_faults(r2), (r1.faults, r2.faults)

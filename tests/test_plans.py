@@ -485,11 +485,301 @@ def test_api_plans_and_cli_smoke(capsys):
 
 
 def test_plan_metric_families_in_catalog():
-    """The three new families ride ALL_METRICS, so the tier-1
+    """The plan families ride ALL_METRICS, so the tier-1
     exposition-validity test (test_tracing) covers them automatically."""
     names = {m.name for m in metric_defs.ALL_METRICS}
     assert {
         "compiled_plan_executions_total",
         "compiled_channel_bytes_total",
         "compiled_channel_occupancy",
+        "compiled_device_channel_bytes_total",
+        "plan_stage_group_executions_total",
     } <= names
+
+
+# --------------------------------------------------------------------------
+# device channels (ISSUE 11): HBM-resident slots + control-only streams
+# --------------------------------------------------------------------------
+def test_device_seq_channel_slot_semantics_and_stats():
+    """A device-kind slot hands a jax array over as a REFERENCE move (the
+    DeviceChannel contract), device_puts a host ndarray exactly once (kind
+    transition), passes non-array payloads through untouched, and keeps the
+    process HBM-resident accounting symmetric across write/read."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.runtime.channel_manager import device_channel_stats
+
+    base = device_channel_stats()
+    ch = SeqChannel("d", kind="device")
+    arr = jnp.arange(1024, dtype=jnp.float32)
+    ch.write(0, arr)
+    s = device_channel_stats()
+    assert s["occupied_slots"] == base["occupied_slots"] + 1
+    assert s["hbm_resident_bytes"] == base["hbm_resident_bytes"] + arr.nbytes
+    seq, out, is_err = ch.read()
+    assert (seq, is_err) == (0, False)
+    assert out is arr  # co-located hand-off: the same buffer, no copy
+    assert device_channel_stats() == base
+    # host ndarray arriving on a device slot: placed (device_put) once
+    ch.write(1, np.ones(16, np.float32))
+    _, out2, _ = ch.read()
+    assert isinstance(out2, jax.Array)
+    # non-array payload (the per-seq pickle fallback) rides the slot as-is
+    ch.write(2, {"k": 1})
+    assert ch.read()[1] == {"k": 1}
+    assert device_channel_stats() == base
+
+
+def test_device_seq_channel_close_while_blocked_restores_stats():
+    """close() on a device slot holding an array wakes the blocked writer
+    with ChannelClosed AND returns the HBM accounting to baseline — a torn
+    -down plan must not leak phantom HBM-resident bytes."""
+    import jax.numpy as jnp
+
+    from ray_tpu.runtime.channel_manager import device_channel_stats
+
+    base = device_channel_stats()
+    ch = SeqChannel("d", kind="device")
+    ch.write(0, jnp.ones(256, jnp.float32))
+    errs = []
+    blocked = threading.Event()
+
+    def second_write():
+        blocked.set()
+        try:
+            ch.write(1, jnp.zeros(256, jnp.float32))
+        except ChannelClosed as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=second_write, daemon=True)
+    t.start()
+    blocked.wait(2)
+    time.sleep(0.05)
+    assert device_channel_stats()["occupied_slots"] == base["occupied_slots"] + 1
+    ch.close()
+    t.join(2)
+    assert len(errs) == 1
+    assert device_channel_stats() == base
+
+
+def test_device_chan_push_host_staged_roundtrip_no_pickle():
+    """CPU fallback transport: with no transfer server, a device-kind edge
+    streams the raw host view and the receiver reassembles a real device
+    array — array payloads NEVER touch the pickler (the zero-pickle
+    acceptance bar), while non-array payloads on the SAME edge fall back to
+    the pickle frames per seq."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.core.object_store import ObjectStore
+    from ray_tpu.runtime import channel_manager, data_plane, device_plane
+
+    mgr = channel_manager.global_manager()
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store)
+    try:
+        chans = mgr.register("devplan", ["e"], kinds={"e": "device"})
+        stream = data_plane.ChannelStream(server.address, "devplan", "e", kind="device")
+        arr = jnp.asarray(np.random.default_rng(0).standard_normal((64, 32)), jnp.float32)
+        packed_before = device_plane.stats.arrays_packed
+        sent_before = metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get({"direction": "sent"})
+        recv_before = metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get({"direction": "received"})
+
+        stream.push(0, arr)
+        seq, value, is_err = chans["e"].read()
+        assert (seq, is_err) == (0, False)
+        assert isinstance(value, jax.Array)
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(arr))
+
+        # mixed payloads on the same edge: dict (pickle), error, array again
+        stream.push(1, {"meta": [1, 2, 3]})
+        assert chans["e"].read()[1] == {"meta": [1, 2, 3]}
+        stream.push(2, ValueError("boom"), is_error=True)
+        seq, value, is_err = chans["e"].read()
+        assert is_err and isinstance(value, ValueError)
+        stream.push(3, arr * 2)
+        np.testing.assert_array_equal(np.asarray(chans["e"].read()[1]), np.asarray(arr) * 2)
+
+        # the acceptance bar: zero array payloads went through the pickler,
+        # and the device-byte counter saw them on both directions
+        assert device_plane.stats.arrays_packed == packed_before
+        moved = 2 * arr.nbytes
+        assert metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get({"direction": "sent"}) == sent_before + moved
+        assert metric_defs.COMPILED_DEVICE_CHANNEL_BYTES.get({"direction": "received"}) == recv_before + moved
+        stream.close()
+    finally:
+        mgr.release_plan("devplan")
+        server.close()
+
+
+def test_device_chan_push_ticket_path_and_refused_pull_fallback():
+    """With a transfer server installed the push is control-only: the
+    payload moves through the staged device-to-device pull (ticket in the
+    header, zero array bytes on the stream).  A consumer whose pull fails
+    nacks with the fallback flag and the producer resends that seq
+    host-staged — delivery never depends on the fast path."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.core.object_store import ObjectStore
+    from ray_tpu.runtime import channel_manager, data_plane, device_plane
+    from ray_tpu.runtime.fake_transfer import FakeTransferServer
+
+    mgr = channel_manager.global_manager()
+    store = ObjectStore(shm_store=None)
+    server = data_plane.store_server(store)
+    fake = FakeTransferServer()
+    try:
+        device_plane.install_transfer_server(fake)
+        chans = mgr.register("tickplan", ["e"], kinds={"e": "device"})
+        stream = data_plane.ChannelStream(server.address, "tickplan", "e", kind="device")
+        arr = jnp.arange(4096, dtype=jnp.float32)
+        stream.push(0, arr)
+        np.testing.assert_array_equal(np.asarray(chans["e"].read()[1]), np.asarray(arr))
+        assert fake.pulls_served >= 1, "payload must ride the device pull, not the stream"
+
+        # refused pulls: the nack/fallback resend still delivers the seq
+        fake.refuse_pulls = True
+        stream.push(1, arr + 1)
+        np.testing.assert_array_equal(np.asarray(chans["e"].read()[1]), np.asarray(arr) + 1)
+        stream.close()
+    finally:
+        device_plane.install_transfer_server(None)
+        fake.close()
+        mgr.release_plan("tickplan")
+        server.close()
+
+
+# --------------------------------------------------------------------------
+# SPMD stage groups (ISSUE 11)
+# --------------------------------------------------------------------------
+def test_stage_group_plan_trace_once_and_zero_pickle(ray_start_regular):
+    """A gang stage splits device-array inputs across its members, runs the
+    SAME jit'd step on each, and reassembles one array — with the jit trace
+    primed ONCE at install (warmup): the retrace counter stays flat across
+    every iteration, array edges stay pickle-free, and the group executions
+    counter advances."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.dag import StageGroup
+    from ray_tpu.runtime import device_plane
+
+    step_fn = jax.jit(lambda x: x * 2.0 + 1.0)
+
+    @rt.remote
+    class Member:
+        def step(self, x):
+            return step_fn(x)
+
+    members = [Member.options(execution="inproc").remote() for _ in range(2)]
+    gang = StageGroup(members, "step", split_axis=0, warmup=((8, 16), "float32"))
+    with InputNode() as inp:
+        out = gang.bind(inp)
+    plan = out.compile_plan(name="gang")
+    try:
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((8, 16)), jnp.float32)
+        expected = np.asarray(x) * 2.0 + 1.0
+        traces_after_install = step_fn._cache_size()
+        assert traces_after_install >= 1, "warmup must prime the trace at install"
+        packed_before = device_plane.stats.arrays_packed
+        execs_before = metric_defs.PLAN_STAGE_GROUP_EXECUTIONS.get()
+        for _ in range(20):
+            result = plan.execute(x)
+            assert isinstance(result, jax.Array)
+            np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-6)
+        # trace once, execute many: not one retrace over 20 iterations
+        assert step_fn._cache_size() == traces_after_install
+        # steady-state device-edge execution: zero array pickling
+        assert device_plane.stats.arrays_packed == packed_before
+        assert metric_defs.PLAN_STAGE_GROUP_EXECUTIONS.get() >= execs_before + 20
+        # the observability snapshot carries the gang size + edge kinds
+        snap = plan.snapshot()
+        assert any(s.get("group") == 2 for s in snap["stages"])
+        assert snap["channel_kinds"] and all(
+            k == "device" for k in snap["channel_kinds"].values()
+        )
+    finally:
+        plan.teardown()
+
+
+def test_stage_group_validation_and_interpreted_execute_rejected(ray_start_regular):
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dag import StageGroup
+
+    with pytest.raises(ValueError):
+        StageGroup([], "step")
+
+    @rt.remote
+    class Member:
+        def step(self, x):
+            return x
+
+    members = [Member.options(execution="inproc").remote() for _ in range(2)]
+    gang = StageGroup(members, "step")
+    with InputNode() as inp:
+        node = gang.bind(inp)
+    # stage groups only exist compiled: interpreted .execute() is an error
+    with pytest.raises(ValueError):
+        node.execute(1)
+    # compile-time gang-size bound
+    cfg = get_config()
+    old = cfg.plan_stage_group_max_members
+    cfg.plan_stage_group_max_members = 1
+    try:
+        with pytest.raises(ValueError):
+            node.compile_plan()
+    finally:
+        cfg.plan_stage_group_max_members = old
+
+
+def test_api_plans_device_fields_and_cli(capsys):
+    """/api/plans + `rt plans` surface the device-channel story: per-edge
+    kind, HBM-resident bytes, device-channel occupancy, gang sizes."""
+    import jax.numpy as jnp
+
+    from ray_tpu.scripts.cli import main
+
+    rt.init(num_cpus=2, include_dashboard=True)
+    try:
+        url = rt.get_cluster().dashboard.url
+
+        @rt.remote
+        class Stage:
+            def step(self, x):
+                return x * 2
+
+        a = Stage.options(execution="inproc").remote()
+        with InputNode() as inp:
+            d = a.step.bind(inp)
+        plan = d.compile_plan(name="dev-smoke")
+        out = plan.execute(jnp.ones(512, jnp.float32))
+        assert float(out.sum()) == 1024.0
+
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(f"{url}/api/plans", timeout=10) as resp:
+            data = _json.loads(resp.read())
+        totals = data["totals"]
+        for key in (
+            "device_channel_bytes_sent",
+            "device_channel_bytes_received",
+            "device_channel_occupancy",
+            "hbm_resident_bytes",
+            "stage_group_executions",
+        ):
+            assert key in totals, f"missing {key} in /api/plans totals"
+        assert data["plans"][0]["channel_kinds"]
+
+        assert main(["plans", "--address", url]) == 0
+        text = capsys.readouterr().out
+        assert "device channels:" in text
+        assert "edge " in text and "device" in text
+        plan.teardown()
+    finally:
+        rt.shutdown()
