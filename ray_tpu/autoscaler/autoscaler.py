@@ -200,6 +200,8 @@ class StandardAutoscaler:
         return self._cluster.requests_fit(remaining)
 
     # ------------------------------------------------------------------
+    # rt-lint: disable=lock-discipline -- observability counters: a torn
+    # read skews one summary poll, never a launch/terminate decision
     def summary(self) -> dict:
         managed = self._provider.non_terminated_nodes()
         by_type: Dict[str, int] = {}

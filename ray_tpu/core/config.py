@@ -28,9 +28,6 @@ class Config:
     object_store_hbm_fraction: float = 0.35
     # Host-RAM tier capacity before spilling to the native shm store / disk.
     object_store_host_bytes: int = 8 * 1024**3
-    # Inline objects at or below this size directly into task replies
-    # (reference: RayConfig max_direct_call_object_size = 100KB).
-    max_inline_object_size: int = 100 * 1024
     # Chunk size for inter-host object transfer (reference: 5MiB chunks,
     # ray_config_def.h:352).
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
@@ -42,8 +39,6 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     # Top-k random choice among best nodes.
     scheduler_top_k_fraction: float = 0.2
-    # Max tasks dispatched per scheduling iteration.
-    max_tasks_per_dispatch: int = 1000
     # Locality-aware placement (reference: locality_with_output /
     # LocalityAwareLeasePolicy, lease_policy.cc): for the default and SPREAD
     # strategies, a task is steered onto the node already holding the most
@@ -107,12 +102,6 @@ class Config:
     # still collected — just amortized over bursts.
     gc_tune_on_init: bool = True
 
-    # ---- compile cache ---------------------------------------------------
-    # Cache compiled executables keyed by (fn, shapes, shardings).
-    executable_cache_size: int = 4096
-    # Automatically lower array-typed remote fns to jax.jit.
-    auto_jit_array_tasks: bool = True
-
     # ---- failpoints / chaos ----------------------------------------------
     # Deterministic fault-injection spec (runtime/failpoints.py), e.g.
     # "data_plane.send_frame=drop(0.05);rpc.call=delay(0.2,0.5)".  Empty =
@@ -136,8 +125,10 @@ class Config:
     tracing_enabled: bool = True
 
     # ---- distributed -----------------------------------------------------
-    # Port for the control service when serving multi-host.
-    control_port: int = 6380
+    # Port for the TCP control service when serving multi-host
+    # (start_head_service).  0 = OS-assigned ephemeral port; set it for a
+    # stable `rt start --address` target across head restarts.
+    control_port: int = 0
     # ray_syncer-equivalent resource broadcast period.
     resource_sync_period_s: float = 0.1
     # Values at or below this size ride the (ordered, low-latency) control

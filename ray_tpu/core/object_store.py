@@ -151,7 +151,7 @@ class ObjectStore:
                 # overwriting frees the old entry's footprint INCLUDING its
                 # spill copy (the _admit_put gate already credited this
                 # room) and wakes backpressured puts, exactly like delete()
-                self._account_remove(old)
+                self._account_remove_locked(old)
                 self._drop_spill_locked(object_id, old)
                 self._space.notify_all()
             self._entries[object_id] = entry
@@ -310,7 +310,7 @@ class ObjectStore:
             entry = self._entries.pop(object_id, None)
             if entry is None:
                 return
-            self._account_remove(entry)
+            self._account_remove_locked(entry)
             self._drop_spill_locked(object_id, entry)
             # room freed: wake puts blocked on the backpressure gate
             self._space.notify_all()
@@ -454,7 +454,7 @@ class ObjectStore:
             return value
         raise ObjectLostError(oid)
 
-    def _account_remove(self, entry: ObjectEntry) -> None:
+    def _account_remove_locked(self, entry: ObjectEntry) -> None:
         if entry.tier is Tier.DEVICE:
             self._hbm_used -= entry.size
         elif entry.tier is Tier.HOST:

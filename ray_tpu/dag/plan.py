@@ -238,6 +238,9 @@ class ExecutionPlan:
     # ------------------------------------------------------------------
     # compilation: DAG -> stages + channels
     # ------------------------------------------------------------------
+    # rt-lint: disable=lock-discipline -- construction phase: _compile runs
+    # only from __init__, before the plan is published to
+    # cluster.compiled_plans; no other thread can see these fields yet
     def _compile(self, root: DAGNode) -> None:
         order = root.topological()
         for node in order:
@@ -418,6 +421,8 @@ class ExecutionPlan:
             raise RuntimeError("remote plan stages require the head service")
         return f"{handle.conn.local_ip}:{head_service.data_server.port}"
 
+    # rt-lint: guarded-by(_repair_lock) -- callers: repair() holds it;
+    # __init__ runs pre-publication with exclusive access (stronger)
     def _install(self) -> None:
         from ray_tpu.core.config import get_config
         from ray_tpu.runtime import data_plane, rpc
@@ -564,9 +569,14 @@ class ExecutionPlan:
     # execution
     # ------------------------------------------------------------------
     @property
+    # rt-lint: disable=lock-discipline -- lock-free state snapshot for
+    # callers that tolerate staleness; transitions happen under _state_lock
     def state(self) -> str:
         return self._state
 
+    # rt-lint: disable=lock-discipline -- optimistic gate: a torn read here
+    # only lets one extra iteration into the entry-write path, where the
+    # failure is caught and converted to the plan's typed error
     def _check_alive(self) -> None:
         if self._state == "TORN_DOWN":
             raise RuntimeError("ExecutionPlan was torn down")
@@ -589,6 +599,11 @@ class ExecutionPlan:
             _DagInput(args, kwargs) if (kwargs or len(args) != 1) else args[0]
         )
         fut: Future = Future()
+        # rt-lint: disable=lock-discipline -- optimistic fabric reads:
+        # repair only swaps _entry_writes/_error under _repair_lock while
+        # state is BROKEN, and _check_alive (re-run under _submit_lock)
+        # gates entry; a break landing mid-write is caught below and
+        # surfaced as the plan's typed error, never silent corruption
         with self._submit_lock:
             self._check_alive()
             seq = self._seq
@@ -633,6 +648,10 @@ class ExecutionPlan:
                 # slots (outputs permanently desynced from futures)
                 outs = []
                 err: Optional[BaseException] = None
+                # rt-lint: disable=lock-discipline -- the drainer is the
+                # sole fabric reader between repair epochs: repair waits
+                # for _pending to drain (our reads fail fast off closed
+                # channels) before swapping _out_channels under _repair_lock
                 for ch in self._out_channels:
                     _seq, value, is_err = ch.read()
                     if is_err and err is None:
@@ -711,6 +730,30 @@ class ExecutionPlan:
         except BaseException:  # noqa: BLE001 — the plan stays BROKEN with
             pass               # the original typed error for introspection
 
+    def _release_fabric_locked(self) -> None:
+        """Close driver streams, drop the channel fabric, and release the
+        plan program on every reachable agent.  Caller holds
+        ``_repair_lock``; every release op tolerates already-released."""
+        for stream in self._streams:
+            try:
+                stream.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._streams = []
+        self._entry_writes = []
+        self._out_channels = []
+        for handle in self._remote_handles.values():
+            if handle.dead:
+                continue
+            try:
+                handle.conn.request(
+                    "uninstall_plan", {"plan": self.plan_id}, timeout=10.0
+                )
+            except Exception:  # noqa: BLE001 — agent gone with its node
+                pass
+        self._remote_handles = {}
+        self._manager.release_plan(self.plan_id)
+
     def repair(self, timeout: float = 30.0) -> None:
         """Rebuild a BROKEN plan onto its restarted stage actors.
 
@@ -774,39 +817,30 @@ class ExecutionPlan:
                 while not self._pending.empty() and time.monotonic() < deadline:
                     time.sleep(0.005)
                 time.sleep(0.02)  # settle: a just-popped future finishes its read
-                for stream in self._streams:
-                    try:
-                        stream.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                self._streams = []
-                self._entry_writes = []
-                self._out_channels = []
-                for handle in self._remote_handles.values():
-                    if handle.dead:
-                        continue
-                    try:
-                        handle.conn.request(
-                            "uninstall_plan", {"plan": self.plan_id}, timeout=10.0
-                        )
-                    except Exception:  # noqa: BLE001 — agent gone with its node
-                        pass
-                self._remote_handles = {}
-                self._manager.release_plan(self.plan_id)
+                self._release_fabric_locked()
                 # 3. reinstall on the replacements (fresh channels/streams)
                 self._install()
             except BaseException:
                 metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
                 raise
             with self._state_lock:
-                if self._state != "BROKEN":
-                    # torn down while we rebuilt: stay torn down — a repair
-                    # must never resurrect a released plan
-                    metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
-                    return
-                self._error = None
-                self._state = "READY"
-                self._record_transition("BROKEN", "READY")
+                resurrected = self._state == "BROKEN"
+                if resurrected:
+                    self._error = None
+                    self._state = "READY"
+                    self._record_transition("BROKEN", "READY")
+            if not resurrected:
+                # torn down while we rebuilt: stay torn down — a repair must
+                # never resurrect a released plan.  The racing teardown ran
+                # against the fabric step 2 had already released, so the
+                # FRESH executor, streams, and remote stage programs just
+                # installed are released here or they leak on every agent
+                if self._executor is not None:
+                    self._executor.stop()
+                    self._executor = None
+                self._release_fabric_locked()
+                metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
+                return
         metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "ok"})
         # deaths that landed while state was BROKEN were ignored by the
         # hooks — re-check so a mid-repair casualty re-breaks immediately
@@ -837,12 +871,19 @@ class ExecutionPlan:
 
     def on_node_dead(self, node_id) -> None:
         """Cluster hook: a node hosting plan stages died."""
+        # rt-lint: disable=lock-discipline -- atomic whole-set rebind:
+        # repair replaces _node_ids in one store; a death that races the
+        # swap is re-checked by repair's own post-install death sweep
         if node_id in self._node_ids:
             self._mark_broken(
                 WorkerCrashedError(f"node {node_id.hex()[:8]} died mid-plan"),
                 upgrade=True,
             )
 
+    # rt-lint: disable=lock-discipline -- runs after the TORN_DOWN flip
+    # (under _state_lock): new entries fail _check_alive, and a concurrent
+    # repair observes TORN_DOWN and releases its own fresh fabric, so the
+    # objects read here are the last epoch's; every release is idempotent
     def teardown(self) -> None:
         """Release channels on every participating agent. Idempotent."""
         with self._state_lock:
@@ -872,6 +913,8 @@ class ExecutionPlan:
     # ------------------------------------------------------------------
     # observability (GET /api/plans, `rt plans`)
     # ------------------------------------------------------------------
+    # rt-lint: disable=lock-discipline -- observability snapshot: torn
+    # reads only skew the dashboard for one poll, never plan execution
     def snapshot(self) -> dict:
         return {
             "plan": self.plan_id[:12],

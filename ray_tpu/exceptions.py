@@ -62,6 +62,11 @@ class RayActorError(RayTpuError):
         self.actor_id = actor_id
         super().__init__(message)
 
+    def __reduce__(self):
+        # default Exception pickling replays __init__ with args=(message,),
+        # which would land the message in actor_id; rebuild with both
+        return (type(self), (self.actor_id, str(self)))
+
 
 class ActorDiedError(RayActorError):
     pass
@@ -78,6 +83,9 @@ class ObjectLostError(RayTpuError):
         self.object_id = object_id
         super().__init__(message or f"Object {object_id} was lost and could not be reconstructed.")
 
+    def __reduce__(self):
+        return (type(self), (self.object_id, str(self)))
+
 
 class ObjectReconstructionFailedError(ObjectLostError):
     pass
@@ -86,6 +94,11 @@ class ObjectReconstructionFailedError(ObjectLostError):
 class OwnerDiedError(ObjectLostError):
     def __init__(self, object_id):
         super().__init__(object_id, f"The owner of object {object_id} has died.")
+
+    def __reduce__(self):
+        # narrower __init__ than the base: the message is derived, so only
+        # object_id crosses the wire (the base reduce would TypeError)
+        return (OwnerDiedError, (self.object_id,))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
@@ -96,6 +109,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled.")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
 
 
 class DeadlineExceededError(RayTpuError):
@@ -134,6 +150,9 @@ class FencedError(RayTpuError):
         super().__init__(
             f"node incarnation {incarnation} is fenced; re-register as a fresh node"
         )
+
+    def __reduce__(self):
+        return (FencedError, (self.node_id, self.incarnation))
 
 
 class OverloadedError(RayTpuError):
@@ -214,9 +233,13 @@ class CollectiveGroupDeadError(RayTpuError):
 
     def __init__(self, group_name: str, reason: str = ""):
         self.group_name = group_name
+        self.reason = reason
         super().__init__(
             f"collective group {group_name!r} lost a participant: {reason or 'rank died'}"
         )
+
+    def __reduce__(self):
+        return (CollectiveGroupDeadError, (self.group_name, self.reason))
 
 
 def raised_copy(exc: BaseException) -> BaseException:

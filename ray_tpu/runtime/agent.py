@@ -806,9 +806,11 @@ class NodeAgent:
         # (log_monitor parity; head side: HeadService._h_log_batch).
         # Batched: chatty workers must not serialize one RPC frame per line
         # against task traffic on the shared connection.
+        # rt-lint: disable=lock-discipline -- start() setup: initialized
+        # before _build_node_runtime spawns any thread that can log
         self._log_buf: list = []
         self._log_lock = threading.Lock()
-        self._log_last_flush = time.monotonic()
+        self._log_last_flush = time.monotonic()  # rt-lint: disable=lock-discipline -- start() setup
         self._build_node_runtime(self.conn)
         # rt.* must work inside in-proc tasks executing in THIS process
         # (auto-tier profiling routes hot small tasks here)

@@ -125,7 +125,7 @@ class SeqChannel:
         self._error: Optional[BaseException] = None
         self._slot_nbytes = 0
 
-    def _raise_closed(self) -> None:
+    def _raise_closed_locked(self) -> None:
         if self._error is not None:
             from ray_tpu.exceptions import raised_copy
 
@@ -161,7 +161,7 @@ class SeqChannel:
             if not self._cond.wait_for(lambda: self._slot is None or self._closed, timeout):
                 raise TimeoutError(f"channel {self.name!r} write timed out")
             if self._closed:
-                self._raise_closed()
+                self._raise_closed_locked()
             value, nbytes = self._place(value, is_error)
             self._slot = (seq, value, is_error)
             self._slot_nbytes = nbytes
@@ -175,7 +175,7 @@ class SeqChannel:
             if not self._cond.wait_for(lambda: self._slot is not None or self._closed, timeout):
                 raise TimeoutError(f"channel {self.name!r} read timed out")
             if self._slot is None:  # closed and empty
-                self._raise_closed()
+                self._raise_closed_locked()
             item = self._slot
             self._slot = None
             nbytes, self._slot_nbytes = self._slot_nbytes, 0
@@ -204,6 +204,8 @@ class SeqChannel:
                 _device_stats.delta(-1, -nbytes)
 
     @property
+    # rt-lint: disable=lock-discipline -- lock-free snapshot: close() is
+    # one-way, and every read/write path re-checks under _cond anyway
     def closed(self) -> bool:
         return self._closed
 

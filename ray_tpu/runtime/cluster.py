@@ -355,7 +355,12 @@ class Cluster:
         # (see _try_recover) — one timer per oid, not one per caller
         self._recover_grace: Set[bytes] = set()
         self._recover_grace_lock = threading.Lock()
-        self.task_manager = TaskManager()
+        # entry cap derived from the byte budget at ~10 KiB per retained
+        # spec (args are ref-compressed; the estimate only needs the right
+        # order of magnitude for eviction to track max_lineage_bytes)
+        self.task_manager = TaskManager(
+            max_lineage_entries=max(1024, get_config().max_lineage_bytes // (10 * 1024))
+        )
         # all inbound object traffic funnels through one admission-controlled
         # PullManager (pull_manager.h:52 parity); created lazily-free here —
         # its worker threads spawn on first use
@@ -494,6 +499,8 @@ class Cluster:
             from ray_tpu.runtime import p2p
             from ray_tpu.runtime.remote_node import HeadService
 
+            if port == 0:
+                port = get_config().control_port
             self.head_service = HeadService(self, host, port)
             # driver-resident collective ranks ride the data plane too;
             # on_consume drops the directory entry the head data server
@@ -603,6 +610,9 @@ class Cluster:
         re-registration against a restarted GCS); live actor instances
         reconcile back to ALIVE; actors whose host died during the outage
         follow the restart FSM (restart elsewhere or DEAD)."""
+        # rt-lint: disable=lock-discipline -- usage-error gate only: chaos
+        # hooks are driver-driven, and a racing kill_head still serializes
+        # on _node_lifecycle_lock below before any state is touched
         if not self._head_down:
             raise RuntimeError("restart_head called without a preceding kill_head")
         path = self._head_snapshot_path()
@@ -641,6 +651,10 @@ class Cluster:
             fresh.placement_groups.bind_node_pools(
                 {nid: n.pool for nid, n in self.nodes.items() if not n.dead}
             )
+            # rt-lint: disable=lock-discipline -- atomic-rebind publication:
+            # `control` is swapped exactly here (under the lifecycle lock so
+            # restarts serialize); the many unlocked readers see either the
+            # old or the new epoch, and both are valid service objects
             self.control = fresh
             with self._head_lock:
                 self._head_down = False
@@ -1194,6 +1208,9 @@ class Cluster:
             self._demand_cv.notify_all()
 
     def _demand_drain_loop(self) -> None:
+        # rt-lint: disable=lock-discipline -- double-checked loop gate: the
+        # unlocked read only decides to try again; the authoritative stop
+        # check re-runs under _demand_cv two lines down
         while not self._demand_stop:
             with self._demand_cv:
                 while not self._demand_entries and not self._demand_stop:

@@ -301,6 +301,9 @@ def host_token() -> Optional[bytes]:
                 fd = -1
             if fd >= 0:
                 try:
+                    # rt-lint: disable=chaos-determinism -- one-time host
+                    # identity token (same-host transport detection); not a
+                    # frame payload and never part of a chaos decision
                     os.write(fd, os.urandom(16).hex().encode())
                 finally:
                     os.close(fd)
@@ -1348,7 +1351,7 @@ class ChannelStream:
                 _send_buffers(sock, buffers, self.chunk_bytes)
                 reply = _recv_header(sock)
             except (OSError, EOFError, pickle.UnpicklingError) as exc:
-                self._drop_sock()
+                self._drop_sock_locked()
                 raise DataPlaneError(
                     f"channel push to {self.addr} failed: {exc}"
                 ) from exc
@@ -1428,7 +1431,7 @@ class ChannelStream:
                     _send_buffers(sock, buffers, self.chunk_bytes)
                 reply = _recv_header(sock)
             except (OSError, EOFError, pickle.UnpicklingError) as exc:
-                self._drop_sock()
+                self._drop_sock_locked()
                 raise DataPlaneError(
                     f"channel push to {self.addr} failed: {exc}"
                 ) from exc
@@ -1451,7 +1454,7 @@ class ChannelStream:
                 attrs={"seq": str(seq), "bytes": str(logical), "kind": "device"},
             )
 
-    def _drop_sock(self) -> None:
+    def _drop_sock_locked(self) -> None:
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -1462,7 +1465,7 @@ class ChannelStream:
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            self._drop_sock()
+            self._drop_sock_locked()
 
 
 def store_server(store, host: str = "127.0.0.1", port: int = 0,

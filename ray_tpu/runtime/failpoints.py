@@ -90,6 +90,11 @@ class FailpointInjected(RuntimeError):
         self.failpoint = name
         self.hit = hit
 
+    def __reduce__(self):
+        # args holds the formatted message; replaying __init__ with it
+        # would TypeError (two required params) — rebuild from the fields
+        return (FailpointInjected, (self.failpoint, self.hit))
+
 
 class _Failpoint:
     __slots__ = ("name", "action", "prob", "delay_s", "count", "lock")
@@ -154,6 +159,8 @@ def _record(name: str, idx: int, action: str) -> None:
         )
         if tracing.enabled():
             cur = tracing.current_context()
+            # rt-lint: disable=chaos-determinism -- span timestamps only;
+            # the fault log records (name, hit, action), never wall-clock
             now = time.time()
             tracing.emit_span(
                 f"fault::{name}",
@@ -254,6 +261,8 @@ def arm(spec, seed: Optional[int] = None) -> None:
         if _trace_id is None:
             import os
 
+            # rt-lint: disable=chaos-determinism -- trace-correlation id for
+            # emitted spans only; never feeds fp decisions or the fault log
             _trace_id = "chaos-" + os.urandom(4).hex()
         ARMED = bool(_fps)
 

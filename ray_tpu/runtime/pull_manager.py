@@ -424,6 +424,8 @@ class PullManager:
     # location resolution (event-driven; cheap — safe on commit threads)
     # ------------------------------------------------------------------
     def _resolve(self, p: _Pull) -> None:
+        # rt-lint: disable=lock-discipline -- one-way close gate: a
+        # stale read just does doomed-but-harmless work one more time
         if self._closed:
             return
         directory = self.cluster.directory
@@ -472,6 +474,8 @@ class PullManager:
         return "park", None
 
     def _on_located(self, p: _Pull, src_node_id: Optional[NodeID]) -> None:
+        # rt-lint: disable=lock-discipline -- one-way close gate: a
+        # stale read just does doomed-but-harmless work one more time
         if self._closed:
             return
         cluster = self.cluster
@@ -544,6 +548,8 @@ class PullManager:
             self._resolve_later(p, max(delay, 0.001))
 
     def _transfer_inner(self, p: _Pull, src) -> None:
+        # rt-lint: disable=lock-discipline -- one-way close gate: a
+        # stale read just does doomed-but-harmless work one more time
         if self._closed:
             return  # teardown: cluster state is going away under us
         cluster = self.cluster

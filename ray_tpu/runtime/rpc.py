@@ -76,6 +76,11 @@ class ControlPlaneTimeout(RpcError, TimeoutError):
             f"control-plane rpc {msg_type!r} timed out after {timeout}s"
         )
 
+    def __reduce__(self):
+        # args holds the formatted message; replaying __init__ with it
+        # would TypeError (two required params) — rebuild from the fields
+        return (ControlPlaneTimeout, (self.msg_type, self.timeout))
+
 
 class FunctionNotCached(KeyError):
     """decode_spec: the spec's fn_id is absent from this agent's fn cache

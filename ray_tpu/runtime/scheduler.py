@@ -599,10 +599,12 @@ class LeaseManager:
             if not leases:
                 self._by_key.pop(key, None)
                 self._rr.pop(key, None)
-        if count_expired:
-            self.expired += 1
-        if count_revoked:
-            self.revoked += 1
+            # inside the lock: += is a read-modify-write, and concurrent
+            # drops (sweeper vs. revoke vs. spillback) would lose counts
+            if count_expired:
+                self.expired += 1
+            if count_revoked:
+                self.revoked += 1
         self._return_worker(lease)
 
     def _return_worker(self, lease: WorkerLease) -> None:
@@ -682,6 +684,8 @@ class LeaseManager:
                 for leases in self._by_key.values()
                 for lease in leases
             ]
+        # rt-lint: disable=lock-discipline -- observability counters: a
+        # torn read skews one stats poll, never a grant/revoke decision
         return {
             "active": entries,
             "grants": self.grants,

@@ -31,6 +31,8 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, Optional
 
+from ray_tpu.core.config import get_config
+
 #: ops whose handler may block awaiting other tasks -> release resources
 BLOCKING_OPS = ("get", "wait")
 
@@ -108,7 +110,7 @@ def execute(core_worker, blob: bytes, decoded=None, worker_key=None) -> bytes:
                 name=kw.get("name"), namespace=kw.get("namespace", "default"),
                 class_name=kw.get("class_name", ""),
                 resources=kw.get("resources"),
-                max_restarts=kw.get("max_restarts", 0),
+                max_restarts=kw.get("max_restarts", get_config().actor_max_restarts),
                 max_task_retries=kw.get("max_task_retries", 0),
                 max_concurrency=kw.get("max_concurrency", 1),
                 mode=kw.get("mode", "process"),

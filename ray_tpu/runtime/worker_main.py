@@ -429,6 +429,9 @@ class Worker:
             import threading as _t
 
             self._flush_cv = _t.Condition()
+            # rt-lint: disable=lock-discipline -- lazy init, single-threaded:
+            # only the worker's task loop calls _emit_result, and the buffer
+            # exists before the flusher thread it hands off to starts
             self._flush_buf = []
             _t.Thread(target=self._flush_loop, name="result-flush", daemon=True).start()
         with self._flush_cv:

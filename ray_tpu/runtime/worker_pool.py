@@ -98,6 +98,9 @@ class ProcessWorkerPool:
         self._shm_name = shm_name
         self._max_workers = max_workers or (os.cpu_count() or 4)
         self._idle_cap = cfg.idle_worker_cap
+        # timeout reaping never shrinks the pool below the prestarted warm
+        # set the operator asked for (prestart() raises the floor)
+        self._prestart_floor = 0
         self._lock = threading.RLock()
         self._idle: deque[WorkerHandle] = deque()
         self._backlog: deque = deque()
@@ -138,6 +141,7 @@ class ProcessWorkerPool:
         self._on_worker_death = cb
 
     def prestart(self, count: int) -> None:
+        self._prestart_floor = max(self._prestart_floor, count)
         for _ in range(count):
             try:
                 self._spawn()
@@ -362,6 +366,19 @@ class ProcessWorkerPool:
         while len(self._idle) > self._idle_cap:
             w = self._idle.popleft()
             self._kill_worker(w)
+        # idle-timeout reaping (idle_worker_timeout_s; 0 disables): the
+        # deque is ordered by idle-entry time (appends stamp last_idle_time,
+        # reuse pops from the right), so the coldest worker is leftmost
+        timeout = get_config().idle_worker_timeout_s
+        if timeout <= 0:
+            return
+        cutoff = time.monotonic() - timeout
+        while (
+            len(self._idle) > self._prestart_floor
+            and self._idle[0].last_idle_time < cutoff
+        ):
+            w = self._idle.popleft()
+            self._kill_worker(w)
 
     # -- worker-lease pins ----------------------------------------------
     def _take_lease_worker(self, lease_key: bytes) -> Optional[WorkerHandle]:
@@ -422,6 +439,9 @@ class ProcessWorkerPool:
         (and out of reaping) forever."""
         with self._lock:
             self._unpin_stale_locked()
+            # also the periodic trigger for idle-timeout reaping: without
+            # it a pool that goes fully quiet never revisits the deque
+            self._maybe_reap_locked()
         self._update_worker_gauges()
 
     def _unpin_stale_locked(self) -> None:

@@ -573,6 +573,36 @@ def cmd_chaos(args) -> int:
     return run_cli(args)
 
 
+def cmd_lint(args) -> int:
+    """AST invariant linter (``rt lint``): enforce the runtime's
+    concurrency, wire-protocol, determinism, and observability contracts.
+    See docs/static_analysis.md for the checker catalog."""
+    from ray_tpu.analysis import all_checkers, run_lint
+    from ray_tpu.analysis.framework import render_json, repo_root_dir
+
+    known = {c.check_id for c in all_checkers()}
+    checks = set(args.check) if args.check else None
+    if checks and not checks <= known:
+        print(f"unknown check(s): {', '.join(sorted(checks - known))}; "
+              f"known: {', '.join(sorted(known))}", file=sys.stderr)
+        return 2
+    if args.update_protocol_manifest:
+        from ray_tpu.analysis.protocol_parity import update_manifest
+
+        ok, msg = update_manifest(repo_root_dir())
+        print(msg, file=sys.stdout if ok else sys.stderr)
+        return 0 if ok else 1
+    violations = run_lint(paths=args.paths or None, checks=checks)
+    if args.json:
+        print(render_json(violations))
+    else:
+        for v in violations:
+            print(v.render())
+        if violations:
+            print(f"\n{len(violations)} violation(s)", file=sys.stderr)
+    return 1 if violations else 0
+
+
 def cmd_microbenchmark(args) -> int:
     """Microbenchmark suite (``ray microbenchmark`` parity: the ray_perf.py
     metric set, plus the TPU-native shm / host<->HBM bandwidth axes)."""
@@ -784,6 +814,27 @@ def build_parser() -> argparse.ArgumentParser:
         "(enables node-index bounds checking)",
     )
     c.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "lint",
+        help="run the AST invariant linter over the tree (docs/static_analysis.md)",
+    )
+    sp.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the whole ray_tpu tree; "
+        "whole-tree parity checks only run on full-tree runs)",
+    )
+    sp.add_argument("--json", action="store_true", help="machine-readable output")
+    sp.add_argument(
+        "--check", action="append", metavar="ID",
+        help="run only this checker (repeatable)",
+    )
+    sp.add_argument(
+        "--update-protocol-manifest", action="store_true",
+        help="regenerate the wire-protocol kind manifest (requires a "
+        "PROTOCOL_VERSION bump when the kind set changed)",
+    )
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("microbenchmark", help="run the local microbenchmark suite")
     sp.add_argument("--num-cpus", type=int, default=4)

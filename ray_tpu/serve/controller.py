@@ -183,6 +183,8 @@ class ServeControllerActor:
 
     def _loop(self) -> None:
         ticks = 0
+        # rt-lint: disable=lock-discipline -- one-way stop flag: a stale
+        # read costs at most one extra 0.2s control-loop tick
         while self._running:
             time.sleep(0.2)
             ticks += 1

@@ -381,7 +381,8 @@ class LLMEngine:
             # shed-on-arrival: the deadline already expired — admitting
             # would burn prefill + a decode slot on an answer nobody can
             # use.  The typed signal is the deadline error, not 429.
-            self.num_shed += 1
+            with self._lock:  # += races the other shed paths' increments
+                self.num_shed += 1
             admission.record_shed("engine", "deadline_expired")
             raise DeadlineExceededError("llm_request", "engine_admission", 0.0)
         with self._lock:
@@ -464,10 +465,10 @@ class LLMEngine:
             removed = self._queue.remove(req)
             if removed:
                 self._queued_tokens -= len(req.prompt)
+                self.num_shed += 1  # under the lock: += races other shed paths
             depth = len(self._queue)
         if removed:
             metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
-            self.num_shed += 1
             admission.record_shed("engine", "disconnect")
             if not req.future.done():
                 req.future.set_exception(
@@ -537,7 +538,8 @@ class LLMEngine:
             metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
             if req.cancelled:
                 # abandoned while waiting: never prefill it
-                self.num_shed += 1
+                with self._lock:  # += races the request-thread shed paths
+                    self.num_shed += 1
                 admission.record_shed("engine", "disconnect")
                 if not req.future.done():
                     req.future.set_exception(
@@ -546,7 +548,8 @@ class LLMEngine:
                 continue
             if req.deadline_ts is not None and time.time() >= req.deadline_ts:
                 # expired while queued: shed instead of occupying a slot
-                self.num_shed += 1
+                with self._lock:  # += races the request-thread shed paths
+                    self.num_shed += 1
                 admission.record_shed("engine", "deadline_expired")
                 if not req.future.done():
                     req.future.set_exception(
@@ -635,6 +638,10 @@ class LLMEngine:
         sampled = np.asarray(out)  # [B, K]
         for k in range(sampled.shape[1]):
             for i in range(self.B):
+                # rt-lint: disable=lock-discipline -- engine-thread-owned:
+                # every _slots mutation (admit/finish/evict/fail_inflight)
+                # runs on this same engine loop thread; _lock exists for
+                # cross-thread READERS (stats, abandon flags), not for us
                 req = self._slots[i]
                 if req is None:
                     continue  # free, or finished earlier in this chunk

@@ -111,9 +111,12 @@ class ReplicaActor:
         if not self.is_function and hasattr(self.callable, "reconfigure"):
             self.callable.reconfigure(user_config)
 
+    # rt-lint: disable=lock-discipline -- autoscaler metric snapshot: a
+    # torn counter read skews one poll, never request accounting
     def get_num_ongoing_requests(self) -> int:
         return self._ongoing
 
+    # rt-lint: disable=lock-discipline -- same: observability snapshot
     def get_metrics(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total}
 

@@ -161,6 +161,9 @@ class Router:
         # Membership updates arrive via a long-poll watcher (parity:
         # LongPollHost, serve/_private/long_poll.py); the synchronous pull
         # only runs before the first snapshot lands.
+        # rt-lint: disable=lock-discipline -- double-checked lazy start:
+        # the unlocked read is a fast path; the decision re-runs under
+        # _lock before the watcher thread is spawned
         if not self._watching:
             with self._lock:
                 if self._watching:
@@ -169,6 +172,9 @@ class Router:
             threading.Thread(
                 target=self._watch_loop, daemon=True, name=f"serve-watch-{self.deployment_name}"
             ).start()
+        # rt-lint: disable=lock-discipline -- bootstrap emptiness probe: a
+        # stale read costs one redundant pull; _apply_snapshot is
+        # version-gated so a racing watcher update always wins
         if force or not self._replicas:
             version, replicas = ray_tpu.get(self.controller.get_replicas.remote(self.deployment_name))
             self._apply_snapshot(version, replicas)
@@ -201,6 +207,9 @@ class Router:
         failures = 0
         while failures < 3:
             try:
+                # rt-lint: disable=lock-discipline -- a stale _version just
+                # long-polls with an old cursor: the reply is re-applied
+                # through the version-gated _apply_snapshot, a no-op repeat
                 version, replicas = ray_tpu.get(
                     self.controller.poll_replicas.remote(self.deployment_name, self._version, 5.0),
                     timeout=30,
@@ -337,9 +346,11 @@ class Router:
         from ray_tpu.runtime.context import current_tenant
 
         t_start = time.perf_counter()
+        # rt-lint: disable=lock-discipline -- emptiness fast-path only: it
+        # decides refresh-or-fail; replica SELECTION below holds _lock
         if not self._replicas:
             self._refresh()
-        if not self._replicas:
+        if not self._replicas:  # rt-lint: disable=lock-discipline -- same
             raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
         original_request = (method, args, kwargs)  # PRE-resolution, for replay
         tenant = current_tenant()
@@ -387,6 +398,9 @@ class Router:
 
     def _push_metrics(self) -> None:
         try:
+            # rt-lint: disable=lock-discipline -- metrics snapshot: the
+            # copy races membership swaps by design; a rare mid-resize
+            # RuntimeError lands in the except and drops one push
             self.controller.record_request_metrics.remote(
                 self.deployment_name, dict(self._inflight)
             )

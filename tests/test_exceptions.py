@@ -1,0 +1,95 @@
+"""Pickle round-trips for every exception class in ray_tpu.exceptions.
+
+Errors cross process boundaries constantly (task results, error
+tombstones, actor death notices), so every class must survive
+pickle/unpickle with its type, message, and typed fields intact.  The
+default ``Exception`` pickling replays ``__init__`` with
+``args=(message,)`` — for any class whose first parameter is not the
+message that corrupts state (the message lands in ``actor_id``),
+double-formats, or raises ``TypeError`` outright; such classes need a
+``__reduce__``.
+
+Parametrized over the module by introspection: a newly added exception
+class is tested automatically, and breaks here if it pickles lossily.
+"""
+
+import inspect
+import pickle
+
+import pytest
+
+import ray_tpu.exceptions as exc_mod
+from ray_tpu.runtime.failpoints import FailpointInjected
+from ray_tpu.runtime.rpc import ControlPlaneTimeout
+
+# one representative instance per constructor shape; classes absent here
+# are constructed with a plain message (or no args)
+_SAMPLES = {
+    "RayTaskError": lambda c: c("f", "Traceback: boom\n", ValueError("boom")),
+    "RayActorError": lambda c: c("actor-1f2e", "actor actor-1f2e crashed"),
+    "ActorDiedError": lambda c: c("actor-1f2e", "actor actor-1f2e died"),
+    "ActorUnavailableError": lambda c: c("actor-1f2e", "actor restarting"),
+    "ObjectLostError": lambda c: c("obj-77aa"),
+    "ObjectReconstructionFailedError": lambda c: c("obj-77aa", "3 retries failed"),
+    "OwnerDiedError": lambda c: c("obj-77aa"),
+    "TaskCancelledError": lambda c: c("task-0042"),
+    "DeadlineExceededError": lambda c: c("train_step", "pulling", 1.5),
+    "FencedError": lambda c: c("node-9c", 7),
+    "OverloadedError": lambda c: c("router", "queue_full", 2.5),
+    "StoreFullError": lambda c: c(4.25, 1 << 20),
+    "CollectiveGroupDeadError": lambda c: c("allreduce-g0", "rank 3 died"),
+}
+
+
+def _exception_classes():
+    for name, obj in sorted(vars(exc_mod).items()):
+        if (
+            inspect.isclass(obj)
+            and issubclass(obj, BaseException)
+            and obj.__module__ == exc_mod.__name__
+        ):
+            yield name, obj
+
+
+def _state(e):
+    """Picklable typed state: everything __init__ stored on the instance
+    (the `cause` of RayTaskError compares by repr — exceptions don't
+    define __eq__)."""
+    return {
+        k: repr(v) if isinstance(v, BaseException) else v
+        for k, v in vars(e).items()
+    }
+
+
+@pytest.mark.parametrize(
+    "name,cls", list(_exception_classes()), ids=[n for n, _ in _exception_classes()]
+)
+def test_exception_pickle_round_trip(name, cls):
+    build = _SAMPLES.get(name, lambda c: c(f"{c.__name__}: synthetic message"))
+    original = build(cls)
+    clone = pickle.loads(pickle.dumps(original))
+    assert type(clone) is cls
+    assert str(clone) == str(original)
+    assert _state(clone) == _state(original)
+
+
+@pytest.mark.parametrize(
+    "original",
+    [FailpointInjected("data_plane.send_frame", 3), ControlPlaneTimeout("submit_task", 2.0)],
+    ids=["FailpointInjected", "ControlPlaneTimeout"],
+)
+def test_runtime_exception_pickle_round_trip(original):
+    # two-required-arg classes outside exceptions.py that ride the same
+    # wire paths (chaos faults and rpc timeouts propagate to callers)
+    clone = pickle.loads(pickle.dumps(original))
+    assert type(clone) is type(original)
+    assert str(clone) == str(original)
+    assert vars(clone) == vars(original)
+
+
+def test_every_sampled_class_exists():
+    # _SAMPLES rot guard: renaming an exception must fail loudly here,
+    # not silently fall back to the generic message constructor
+    names = {n for n, _ in _exception_classes()}
+    missing = set(_SAMPLES) - names
+    assert not missing, f"_SAMPLES references unknown classes: {missing}"

@@ -1,0 +1,444 @@
+"""rt lint — checker framework tests.
+
+Each checker gets a good/bad fixture twin: the bad fixture violates the
+invariant and must produce exactly the expected finding; the good twin is
+the minimal fix and must be clean.  Fixtures are injected in-memory via
+``run_lint(files=...)`` (``full_tree=True`` arms the whole-tree parity
+checks), so the tests never touch the real tree — except the tier-1 gate
+at the bottom, which pins the repo itself at ZERO violations and holds
+the analyzer to its speed bound.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.analysis import run_lint
+from ray_tpu.analysis.protocol_parity import check_manifest, kind_digest
+
+# ----------------------------------------------------------------------
+# lock-discipline
+# ----------------------------------------------------------------------
+
+_LOCKED_CLASS_BAD = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def peek(self):
+        return self.value
+'''
+
+_LOCKED_CLASS_GOOD = _LOCKED_CLASS_BAD.replace(
+    "    def peek(self):\n        return self.value",
+    "    def peek(self):\n        with self._lock:\n            return self.value",
+)
+
+
+def _lint(src, check, relpath="ray_tpu/mod.py", **kw):
+    return run_lint(files=[(relpath, src)], checks={check}, full_tree=True, **kw)
+
+
+def test_lock_discipline_bad():
+    vs = _lint(_LOCKED_CLASS_BAD, "lock-discipline")
+    assert len(vs) == 1
+    assert "Counter.value" in vs[0].message and "_lock" in vs[0].message
+    assert vs[0].check_id == "lock-discipline"
+
+
+def test_lock_discipline_good():
+    assert _lint(_LOCKED_CLASS_GOOD, "lock-discipline") == []
+
+
+def test_lock_discipline_condition_aliases_lock():
+    src = '''
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.items = []
+
+    def put(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def pop(self):
+        with self._cv:
+            return self.items.pop()
+'''
+    assert _lint(src, "lock-discipline") == []
+
+
+def test_lock_discipline_locked_suffix_convention():
+    # a *_locked helper's caller holds the lock: the suffix IS the contract
+    src = _LOCKED_CLASS_BAD.replace("def peek(self):", "def peek_locked(self):")
+    assert _lint(src, "lock-discipline") == []
+
+
+def test_lock_discipline_guarded_by_annotation():
+    src = _LOCKED_CLASS_BAD.replace(
+        "    def peek(self):",
+        "    # rt-lint: guarded-by(_lock)\n    def peek(self):",
+    )
+    assert _lint(src, "lock-discipline") == []
+
+
+def test_lock_discipline_disable_annotation():
+    src = _LOCKED_CLASS_BAD.replace(
+        "        return self.value",
+        "        # rt-lint: disable=lock-discipline -- stat snapshot\n"
+        "        return self.value",
+    )
+    assert _lint(src, "lock-discipline") == []
+
+
+def test_lock_discipline_publication_store():
+    # disable on the locked WRITE declares a benign publication: the store
+    # makes no guard claim, so the unlocked readers are clean too
+    src = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self._swap_lock = threading.Lock()
+        self.backend = object()
+
+    def swap(self, fresh):
+        with self._swap_lock:
+            # rt-lint: disable=lock-discipline -- atomic rebind
+            self.backend = fresh
+
+    def call(self):
+        return self.backend
+'''
+    assert _lint(src, "lock-discipline") == []
+
+
+# ----------------------------------------------------------------------
+# protocol-parity
+# ----------------------------------------------------------------------
+
+_RPC_FIXTURE = "PROTOCOL_VERSION = 1\n"
+
+
+def _proto_files(handler_line):
+    sender = (
+        "def go(conn):\n"
+        "    conn.send(\"ping\", {})\n"
+    )
+    dispatcher = (
+        "class H:\n"
+        "    def _h_ping(self, msg):\n"
+        "        return {}\n"
+        "    def table(self):\n"
+        f"        return {handler_line}\n"
+    )
+    return [
+        ("ray_tpu/runtime/rpc.py", _RPC_FIXTURE),
+        ("ray_tpu/runtime/sender.py", sender),
+        ("ray_tpu/runtime/dispatch.py", dispatcher),
+    ]
+
+
+_MANIFEST_OK = {
+    "digest": kind_digest(["ping"]),
+    "kinds": ["ping"],
+    "protocol_version": 1,
+}
+
+
+def test_protocol_parity_good():
+    vs = run_lint(
+        files=_proto_files('{"ping": self._h_ping}'),
+        checks={"protocol-parity"},
+        full_tree=True,
+        manifest_override=_MANIFEST_OK,
+    )
+    assert vs == []
+
+
+def test_protocol_parity_unhandled_send():
+    # registry handles a DIFFERENT kind: "ping" is sent into the void
+    vs = run_lint(
+        files=_proto_files('{"pong": self._h_ping}'),
+        checks={"protocol-parity"},
+        full_tree=True,
+        manifest_override=_MANIFEST_OK,
+    )
+    assert len(vs) == 1
+    assert "ping" in vs[0].message
+    assert vs[0].file == "ray_tpu/runtime/sender.py"
+
+
+def test_protocol_parity_manifest_detects_new_kind():
+    # a new frame kind without a PROTOCOL_VERSION bump fails the manifest
+    files = _proto_files('{"ping": self._h_ping, "probe": self._h_ping}')
+    files[1] = (
+        "ray_tpu/runtime/sender.py",
+        "def go(conn):\n"
+        "    conn.send(\"ping\", {})\n"
+        "    conn.send(\"probe\", {})\n",
+    )
+    vs = run_lint(
+        files=files,
+        checks={"protocol-parity"},
+        full_tree=True,
+        manifest_override=_MANIFEST_OK,
+    )
+    assert len(vs) == 1
+    assert "PROTOCOL_VERSION" in vs[0].message
+    assert vs[0].file == "ray_tpu/runtime/rpc.py"  # anchored at the version
+
+
+def test_check_manifest_pure():
+    manifest = {"digest": kind_digest(["a", "b"]), "kinds": ["a", "b"], "protocol_version": 3}
+    assert check_manifest(manifest, ["a", "b"], 3) == []
+    # kind added, version unchanged -> must fail and name the addition
+    errs = check_manifest(manifest, ["a", "b", "c"], 3)
+    assert errs and "c" in errs[0] and "PROTOCOL_VERSION" in errs[0]
+    # kind added WITH a bump: regenerated manifest is clean
+    bumped = {"digest": kind_digest(["a", "b", "c"]), "kinds": ["a", "b", "c"], "protocol_version": 4}
+    assert check_manifest(bumped, ["a", "b", "c"], 4) == []
+    # version drift without kind change is still an error
+    assert check_manifest(manifest, ["a", "b"], 4) != []
+    assert check_manifest(None, ["a"], 1) != []
+
+
+# ----------------------------------------------------------------------
+# metric-parity
+# ----------------------------------------------------------------------
+
+_METRIC_DEFS_GOOD = '''
+REQS = _reg.counter("requests_total")
+LAT = _reg.histogram("latency_seconds")
+ALL_METRICS = [REQS, LAT]
+'''
+
+_METRIC_USER = '''
+from ray_tpu.observability.metric_defs import REQS
+
+def handle():
+    REQS.inc(tags={"route": "a"})
+
+def handle2():
+    REQS.inc(tags={"route": "b"})
+'''
+
+
+def _metric_files(defs=_METRIC_DEFS_GOOD, user=_METRIC_USER):
+    return [
+        ("ray_tpu/observability/metric_defs.py", defs),
+        ("ray_tpu/serve/user.py", user),
+    ]
+
+
+def test_metric_parity_good():
+    vs = run_lint(files=_metric_files(), checks={"metric-parity"}, full_tree=True)
+    assert vs == []
+
+
+def test_metric_parity_missing_from_all_metrics():
+    defs = _METRIC_DEFS_GOOD.replace("ALL_METRICS = [REQS, LAT]", "ALL_METRICS = [REQS]")
+    vs = run_lint(files=_metric_files(defs=defs), checks={"metric-parity"}, full_tree=True)
+    assert len(vs) == 1
+    assert "LAT" in vs[0].message and "ALL_METRICS" in vs[0].message
+
+
+def test_metric_parity_unknown_foreign_family():
+    user = _METRIC_USER + '\nROGUE = _reg.counter("rogue_total")\n'
+    vs = run_lint(files=_metric_files(user=user), checks={"metric-parity"}, full_tree=True)
+    assert len(vs) == 1
+    assert "rogue_total" in vs[0].message
+
+
+def test_metric_parity_inconsistent_tags():
+    user = _METRIC_USER + '''
+def handle3():
+    REQS.inc(tags={"rout": "c"})
+'''
+    vs = run_lint(files=_metric_files(user=user), checks={"metric-parity"}, full_tree=True)
+    assert len(vs) == 1
+    assert "rout" in vs[0].message
+
+
+# ----------------------------------------------------------------------
+# chaos-determinism
+# ----------------------------------------------------------------------
+
+def test_chaos_determinism_bad():
+    src = '''
+import random
+
+def decide(spec):
+    return random.random() < spec.prob
+'''
+    vs = _lint(src, "chaos-determinism", relpath="ray_tpu/chaos/decider.py")
+    assert len(vs) == 1
+    assert "random.random" in vs[0].message
+
+
+def test_chaos_determinism_good():
+    src = '''
+def decide(spec, stream):
+    return stream.next_float() < spec.prob
+'''
+    assert _lint(src, "chaos-determinism", relpath="ray_tpu/chaos/decider.py") == []
+
+
+def test_chaos_determinism_unsorted_set_iteration():
+    src = '''
+def emit(nodes):
+    return [n for n in set(nodes)]
+'''
+    vs = _lint(src, "chaos-determinism", relpath="ray_tpu/chaos/emit.py")
+    assert len(vs) == 1
+    # sorted() fixes it
+    good = src.replace("set(nodes)", "sorted(set(nodes))")
+    assert _lint(good, "chaos-determinism", relpath="ray_tpu/chaos/emit.py") == []
+
+
+def test_chaos_determinism_frame_path_allows_time():
+    # frame modules (data_plane) ban randomness but allow wall-clock
+    src = '''
+import time
+import random
+
+def stamp():
+    return time.time(), random.random()
+'''
+    vs = _lint(src, "chaos-determinism", relpath="ray_tpu/runtime/data_plane.py")
+    assert len(vs) == 1
+    assert "random" in vs[0].message and "time.time" not in vs[0].message
+
+
+def test_chaos_determinism_disable_annotation():
+    src = '''
+import os
+
+def token():
+    # rt-lint: disable=chaos-determinism -- identity token, not a decision
+    return os.urandom(4).hex()
+'''
+    assert _lint(src, "chaos-determinism", relpath="ray_tpu/chaos/ident.py") == []
+
+
+# ----------------------------------------------------------------------
+# knob-hygiene
+# ----------------------------------------------------------------------
+
+_CONFIG_SRC = '''
+class Config:
+    pull_retries: int = 3
+'''
+
+_READER_SRC = '''
+def f(cfg):
+    return cfg.pull_retries
+'''
+
+
+def test_knob_hygiene_good():
+    vs = run_lint(
+        files=[("ray_tpu/core/config.py", _CONFIG_SRC), ("ray_tpu/runtime/r.py", _READER_SRC)],
+        checks={"knob-hygiene"},
+        full_tree=True,
+        docs_override={"config.md": "| `pull_retries` | `3` | retry count |"},
+    )
+    assert vs == []
+
+
+def test_knob_hygiene_dead_knob():
+    vs = run_lint(
+        files=[("ray_tpu/core/config.py", _CONFIG_SRC), ("ray_tpu/runtime/r.py", "def f():\n    pass\n")],
+        checks={"knob-hygiene"},
+        full_tree=True,
+        docs_override={"config.md": "| `pull_retries` | `3` | retry count |"},
+    )
+    assert len(vs) == 1
+    assert "pull_retries" in vs[0].message
+    assert vs[0].file == "ray_tpu/core/config.py"
+
+
+def test_knob_hygiene_undocumented_knob():
+    vs = run_lint(
+        files=[("ray_tpu/core/config.py", _CONFIG_SRC), ("ray_tpu/runtime/r.py", _READER_SRC)],
+        checks={"knob-hygiene"},
+        full_tree=True,
+        docs_override={"config.md": "nothing here"},
+    )
+    assert len(vs) == 1
+    assert "pull_retries" in vs[0].message and "doc" in vs[0].message.lower()
+
+
+# ----------------------------------------------------------------------
+# annotation scoping
+# ----------------------------------------------------------------------
+
+def test_standalone_annotation_binds_next_statement_only():
+    # the comment covers the first statement after it, not the whole file
+    src = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def w(self):
+        with self._lock:
+            self.a = 1
+            self.b = 1
+
+    def r(self):
+        # rt-lint: disable=lock-discipline -- covered
+        x = self.a
+        y = self.b
+        return x + y
+'''
+    vs = _lint(src, "lock-discipline")
+    assert len(vs) == 1
+    assert "C.b" in vs[0].message
+
+
+def test_def_line_annotation_covers_whole_block():
+    src = _LOCKED_CLASS_BAD.replace(
+        "    def peek(self):",
+        "    def peek(self):  # rt-lint: disable=lock-discipline -- snapshot",
+    )
+    assert _lint(src, "lock-discipline") == []
+
+
+def test_disable_all():
+    src = _LOCKED_CLASS_BAD.replace(
+        "        return self.value",
+        "        return self.value  # rt-lint: disable=all -- fixture",
+    )
+    assert _lint(src, "lock-discipline") == []
+
+
+# ----------------------------------------------------------------------
+# tier-1 gate: the repo itself lints clean, fast
+# ----------------------------------------------------------------------
+
+def test_repo_tree_is_clean_and_fast():
+    t0 = time.perf_counter()
+    violations = run_lint()
+    elapsed = time.perf_counter() - t0
+    rendered = "\n".join(v.render() for v in violations)
+    assert violations == [], f"rt lint must stay at zero violations:\n{rendered}"
+    assert elapsed < 5.0, f"full-tree lint took {elapsed:.2f}s (budget 5s)"
+
+
+def test_unknown_check_id_raises():
+    with pytest.raises(ValueError):
+        run_lint(checks={"no-such-check"})
