@@ -182,6 +182,14 @@ ROW_GROUPS = [
     # growing — value is goodput/capacity (~1.0 = graceful degradation).
     # Own fresh-runtime group — it deploys a serve app.
     ["overload_goodput"],
+    # paged KV cache + chunked prefill (ISSUE 14): concurrent streams at a
+    # fixed KV HBM budget paged vs dense (block-granular sharing packs
+    # short requests 4x deeper than whole-sequence slots), and the p99
+    # inter-token stall a running decode stream sees while long prompts
+    # prefill behind it (chunked prefill interleaves decode steps between
+    # fixed-width chunks).  Own fresh-runtime group — the rows spin up
+    # several engines with background decode threads.
+    ["llm_paged_capacity_x", "llm_chunked_prefill_stall_p99"],
 ]
 
 
@@ -221,6 +229,8 @@ def main() -> None:
         "direct_dispatch_actor_calls_async",
         "hedged_tail_latency_p99",
         "overload_goodput",
+        "llm_paged_capacity_x",
+        "llm_chunked_prefill_stall_p99",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

@@ -34,6 +34,8 @@ from ray_tpu.models.transformer import (
     _moe_ffn,
     _rms_norm,
     _rope,
+    gather_paged_kv,
+    scatter_paged_kv,
 )
 
 KVCache = Dict[str, jax.Array]
@@ -43,6 +45,21 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> 
     """Preallocated KV cache: {"k","v"}: [L, B, Hkv, max_len, Dh]."""
     dt = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def init_paged_cache(
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=None
+) -> KVCache:
+    """Paged KV pool: {"k","v"}: [L, num_blocks, block_size, Hkv, Dh].
+
+    Unlike :func:`init_cache` there is no batch axis — sequences own sets
+    of pages named by an ``int32[B, max_blocks]`` block table, so HBM is
+    proportional to tokens actually cached, not ``B * max_len``. Page 0 is
+    reserved by convention as the garbage page (all-zero table entries and
+    masked writes land there)."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
@@ -164,6 +181,120 @@ def forward_with_cache(
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
     return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+
+def paged_forward_with_cache(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    cache: KVCache,            # paged pool from init_paged_cache
+    block_tables: jax.Array,   # [B, M] int32 physical page per logical block
+    tokens: jax.Array,         # [B, T] int32 (T = chunk len for prefill, 1 for decode)
+    positions: jax.Array,      # [B, T] int32 absolute positions (contiguous per row)
+    *,
+    valid: Optional[jax.Array] = None,  # [B, T] bool: False = pad, don't cache
+    use_decode_kernel: Optional[bool] = None,
+    layer_scales: Optional[Dict[str, jax.Array]] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """:func:`forward_with_cache` over a paged pool instead of dense rows.
+
+    Writes this call's K/V into the pool through the block tables and
+    attends over every cached position up to ``positions``. Single-token
+    calls route through the Pallas paged decode kernel on TPU (the block
+    table rides scalar prefetch — pages stream from HBM with no gather
+    copy); everywhere else the pool is gathered to a dense view and the
+    attention lines are IDENTICAL to the dense path's, which is what makes
+    paged serving byte-equal to the dense cache under ``JAX_PLATFORMS=cpu``.
+
+    ``valid`` masks bucket-padded tail tokens out of the cache write (their
+    K/V routes to the garbage page 0); their logits still compute and are
+    simply never read. Chunked prefill is just this function called with
+    ``positions`` starting mid-sequence — visibility is positional, so a
+    chunk sees all previously cached chunks plus its own causal prefix.
+    """
+    B, T = tokens.shape
+    M = block_tables.shape[1]
+    bs = cache["k"].shape[2]
+    cap = M * bs
+    h_heads, hkv = cfg.n_heads, cfg.kv_heads
+    n_rep = h_heads // hkv
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    from ray_tpu.models.transformer import embed_tokens
+
+    x = embed_tokens(cfg, params, tokens)
+    starts = positions[:, 0]
+    kv_pos = jnp.arange(cap)
+    vis = kv_pos[None, None, None, :] <= positions[:, None, :, None]  # [B,1,T,cap]
+    if use_decode_kernel is None:
+        use_decode_kernel = jax.default_backend() == "tpu"
+    decode_kernel = use_decode_kernel and T == 1
+
+    def layer_fn(x, layer_kc_vc):
+        if layer_scales is not None:
+            layer_q, lsc, kc, vc = layer_kc_vc
+            layer = {
+                k: (layer_q[k].astype(jnp.float32) * lsc[k]).astype(cfg.param_dtype)
+                for k in layer_q
+            }
+        else:
+            layer, kc, vc = layer_kc_vc
+        h = _rms_norm(x, layer["attn_norm"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(h.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(h.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(h.dtype))
+        q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+        kc = scatter_paged_kv(kc, k, block_tables, positions, valid)
+        vc = scatter_paged_kv(vc, v, block_tables, positions, valid)
+        if decode_kernel:
+            from ray_tpu.ops.decode_attention import paged_decode_attention
+
+            o = paged_decode_attention(
+                q[:, 0], kc, vc, block_tables, starts + 1, sm_scale=scale
+            )[:, None]
+            o = o.astype(x.dtype)
+        else:
+            # gather the pool to a dense [B, Hkv, cap, Dh] view, then the
+            # grouped-query attention lines below are verbatim the dense
+            # path's — masked positions contribute exactly-0.0 weight, so
+            # page-0 garbage never reaches the output
+            kd = gather_paged_kv(kc, block_tables)
+            vd = gather_paged_kv(vc, block_tables)
+            qg = q.reshape(B, T, hkv, n_rep, cfg.head_dim)
+            s_ = jnp.einsum(
+                "btgrk,bgsk->bgrts", qg.astype(jnp.float32), kd.astype(jnp.float32)
+            ) * scale  # [B, Hkv, n_rep, T, cap]
+            s_ = jnp.where(vis[:, :, None], s_, -1e30)
+            p = jax.nn.softmax(s_, axis=-1)
+            o = jnp.einsum("bgrts,bgsk->btgrk", p, vd.astype(jnp.float32))
+            o = o.reshape(B, T, h_heads, cfg.head_dim).astype(x.dtype)
+        x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(o.dtype))
+        h = _rms_norm(x, layer["ffn_norm"])
+        ffn = _moe_ffn(cfg, layer, h) if cfg.num_experts > 0 else _dense_ffn(layer, h)
+        return x + ffn, (kc, vc)
+
+    if layer_scales is not None:
+        xs = (params["layers"], layer_scales, cache["k"], cache["v"])
+    else:
+        xs = (params["layers"], cache["k"], cache["v"])
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, xs)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(x.dtype))
+    return logits.astype(jnp.float32), {"k": ks, "v": vs}
+
+
+def paged_decode_step(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    cache: KVCache,
+    tokens: jax.Array,        # [B] the previously sampled token per row
+    positions: jax.Array,     # [B] the absolute position to write it at
+    block_tables: jax.Array,  # [B, M]
+    **fw_kwargs,
+) -> Tuple[jax.Array, KVCache]:
+    """One paged decode step: (logits [B, V], cache)."""
+    logits, cache = paged_forward_with_cache(
+        cfg, params, cache, block_tables, tokens[:, None], positions[:, None], **fw_kwargs
+    )
+    return logits[:, 0], cache
 
 
 def _single_device_params(params) -> bool:

@@ -337,6 +337,33 @@ LLM_SLOTS_EVICTED = _reg.counter(
     "(disconnect = the streaming consumer went away; its slot returns to "
     "the batch instead of decoding for nobody).",
 )
+LLM_KV_BLOCK_POOL_SIZE = _reg.gauge(
+    "llm_kv_block_pool_size",
+    "Usable pages in the LLM engine's paged KV block pool (excludes the "
+    "reserved garbage page; 0 = dense cache).",
+    "blocks",
+)
+LLM_KV_BLOCKS_IN_USE = _reg.gauge(
+    "llm_kv_blocks_in_use",
+    "KV pool pages currently held by admitted requests. in_use/pool_size "
+    "is the real HBM occupancy of serving — the paged analog of "
+    "active_slots/max_batch_size.",
+    "blocks",
+)
+LLM_PREFILL_CHUNKS = _reg.counter(
+    "llm_prefill_chunks_total",
+    "Prefill chunks executed by the LLM engine (Sarathi-style chunked "
+    "prefill: one prompt = ceil(len/prefill_chunk_tokens) chunks "
+    "interleaved between decode steps).",
+)
+LLM_DECODE_STALL = _reg.histogram(
+    "llm_decode_stall_seconds",
+    "Time running decodes stalled waiting on prefill work admitted between "
+    "decode steps. Chunked prefill bounds each observation to one chunk's "
+    "forward instead of a whole prompt's.",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
 
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
@@ -408,6 +435,10 @@ ALL_METRICS = [
     TENANT_ADMISSIONS,
     STORE_PUT_BACKPRESSURE,
     LLM_SLOTS_EVICTED,
+    LLM_KV_BLOCK_POOL_SIZE,
+    LLM_KV_BLOCKS_IN_USE,
+    LLM_PREFILL_CHUNKS,
+    LLM_DECODE_STALL,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

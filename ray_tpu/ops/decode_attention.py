@@ -16,6 +16,15 @@ bandwidth. The kernel:
   (``0 / -inf``), so ragged slot positions in the serving engine's shared
   cache need no recompilation.
 
+:func:`paged_decode_attention` is the block-pool variant (PagedAttention,
+Kwon et al. 2023): K/V live in a shared pool of fixed-size pages
+``[num_blocks, block_size, Hkv, D]`` and each sequence names its pages in
+an ``int32[B, max_blocks]`` block table. On TPU the table rides Pallas
+scalar prefetch (``PrefetchScalarGridSpec``) so the BlockSpec index maps
+gather pages straight out of HBM — no materialized per-sequence cache copy.
+Off TPU a ``jnp.take`` gather reduces to the dense math, which is what
+tier-1 exercises under ``JAX_PLATFORMS=cpu``.
+
 No backward pass: decode is inference-only. Non-TPU backends run in
 interpret mode (tests exercise the same code path on CPU).
 """
@@ -143,4 +152,148 @@ def decode_attention(
         ),
         interpret=_use_interpret(),
     )(qg, k_cache, v_cache, bias[:, None, :])
+    return out[:, :, :n_rep, :].reshape(B, H, D)
+
+
+def _paged_decode_kernel(
+    tables_ref, lengths_ref,  # scalar-prefetch: [B, M] int32 page ids, [B] int32
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale: float, block_size: int,
+):
+    """Grid (B, Hkv, M): M innermost walks the sequence's logical blocks.
+
+    The same online-softmax state machine as :func:`_decode_kernel`; the
+    difference is purely WHERE K/V come from — the BlockSpec index maps
+    read ``tables_ref`` (scalar prefetch) to stream physical pages, so
+    q_ref/k_ref/v_ref arrive here exactly as in the dense kernel. Validity
+    is derived in-kernel from ``lengths_ref`` instead of a bias input, and
+    logical blocks wholly past the valid prefix skip their FLOPs.
+    """
+    bi = pl.program_id(0)
+    si = pl.program_id(2)
+    num_s = pl.num_programs(2)
+    length = lengths_ref[bi]
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(si * block_size < length)
+    def _accum():
+        q = q_ref[...].astype(jnp.float32) * sm_scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        pos = si * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = s + jnp.where(pos < length, 0.0, NEG_INF)  # [rep_p, block_size]
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == num_s - 1)
+    def _final():
+        l = l_scr[:, :1]
+        empty = m_scr[:, :1] <= NEG_INF * 0.5  # lengths[b] == 0: emit zeros
+        out = jnp.where(empty, 0.0, acc_scr[...] / jnp.where(l == 0, 1.0, l))
+        o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _paged_decode_xla(qg, k_pages, v_pages, block_tables, lengths, scale):
+    """``jnp.take`` fallback: gather each sequence's pages into a dense
+    [B, Hkv, M*bs, D] view and run the masked grouped einsum — the exact
+    math of the dense path, so tier-1 (``JAX_PLATFORMS=cpu``) checks paged
+    serving byte-for-byte against the dense cache."""
+    g = jnp.take(k_pages, block_tables, axis=0)  # [B, M, bs, Hkv, D]
+    B, M, bs, Hkv, D = g.shape
+    k = jnp.transpose(g, (0, 3, 1, 2, 4)).reshape(B, Hkv, M * bs, D)
+    v = jnp.transpose(
+        jnp.take(v_pages, block_tables, axis=0), (0, 3, 1, 2, 4)
+    ).reshape(B, Hkv, M * bs, D)
+    s = jnp.einsum(
+        "bgrk,bgsk->bgrs", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [B, Hkv, n_rep, S]
+    vis = jnp.arange(M * bs)[None, :] < lengths[:, None]
+    s = jnp.where(vis[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # a fully-masked row softmaxes to uniform garbage; zero it like the kernel
+    p = jnp.where((lengths > 0)[:, None, None, None], p, 0.0)
+    return jnp.einsum("bgrs,bgsk->bgrk", p, v.astype(jnp.float32))
+
+
+def paged_decode_attention(
+    q: jax.Array,             # [B, H, D] one query row per sequence
+    k_pages: jax.Array,       # [num_blocks, block_size, Hkv, D] shared pool
+    v_pages: jax.Array,       # [num_blocks, block_size, Hkv, D]
+    block_tables: jax.Array,  # [B, M] int32 physical page per logical block
+    lengths: jax.Array,       # [B] int32: valid cache entries per sequence
+    *,
+    sm_scale: Optional[float] = None,
+    use_kernel: Optional[bool] = None,
+) -> jax.Array:
+    """Decode attention over a paged KV pool; returns [B, H, D].
+
+    Table entries past ``ceil(lengths[b] / block_size)`` may point anywhere
+    valid (the engine points them at the reserved garbage page 0) — they are
+    masked out, never normalized in. ``use_kernel`` default: Pallas on TPU,
+    gather fallback elsewhere (forcing it on runs the kernel in interpret
+    mode, which is how the kernel itself is tested on CPU).
+    """
+    import math
+
+    B, H, D = q.shape
+    _, bs, Hkv, _ = k_pages.shape
+    M = block_tables.shape[1]
+    n_rep = H // Hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+
+    qg = q.reshape(B, Hkv, n_rep, D)
+    if not use_kernel:
+        out = _paged_decode_xla(qg, k_pages, v_pages, block_tables, lengths, scale)
+        return out.astype(q.dtype).reshape(B, H, D)
+
+    rep_p = -(-n_rep // _MIN_REP) * _MIN_REP
+    if rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - n_rep), (0, 0)))
+    grid = (B, Hkv, M)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, lengths — usable in index maps
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, rep_p, D), lambda b, g, s, bt, ln: (b, g, 0, 0)),
+            # the paged gather: logical block s of sequence b streams from
+            # physical page bt[b, s] — one DMA per (group, block), no copy
+            pl.BlockSpec((None, bs, None, D), lambda b, g, s, bt, ln: (bt[b, s], 0, g, 0)),
+            pl.BlockSpec((None, bs, None, D), lambda b, g, s, bt, ln: (bt[b, s], 0, g, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, rep_p, D), lambda b, g, s, bt, ln: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep_p, _LANES), jnp.float32),
+            pltpu.VMEM((rep_p, _LANES), jnp.float32),
+            pltpu.VMEM((rep_p, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=scale, block_size=bs),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep_p, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_use_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pages, v_pages)
     return out[:, :, :n_rep, :].reshape(B, H, D)

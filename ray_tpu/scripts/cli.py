@@ -560,6 +560,52 @@ def cmd_overload(args) -> int:
                 f"{src.get('active_slots', 0)}/{src.get('slots', 0)} slots, "
                 f"{src.get('slots_evicted', 0)} evicted, {src.get('shed', 0)} shed"
             )
+            if src.get("kv_block_pool_size"):
+                print(
+                    f"  kv blocks: {src.get('kv_blocks_in_use', 0)}/"
+                    f"{src.get('kv_block_pool_size', 0)} in use "
+                    f"({100.0 * src.get('kv_block_occupancy', 0.0):.0f}%), "
+                    f"block size {src.get('kv_block_size', 0)}, "
+                    f"{src.get('prefilling', 0)} prefilling, "
+                    f"{src.get('prefill_chunks', 0)} chunks, "
+                    f"{src.get('waiting_for_blocks', 0)} waiting for blocks"
+                )
+    return 0
+
+
+def cmd_llm(args) -> int:
+    """``rt llm``: LLM serving engines at a glance — cache kind, KV block
+    pool occupancy, chunked-prefill progress, queue/slot pressure. One line
+    block per registered engine (admission source layer == "engine")."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/overload")
+    engines = [s for s in data.get("sources", ()) if s.get("layer") == "engine"]
+    if args.format == "json":
+        print(json.dumps(engines, indent=2))
+        return 0
+    if not engines:
+        print("no llm engines registered")
+        return 0
+    for i, src in enumerate(engines):
+        kind = src.get("cache_kind", "dense")
+        print(
+            f"engine {i}: cache={kind}, "
+            f"{src.get('active_slots', 0)}/{src.get('slots', 0)} slots, "
+            f"{src.get('queued', 0)} queued (bound {src.get('queue_bound', 0)}), "
+            f"{src.get('shed', 0)} shed, {src.get('slots_evicted', 0)} evicted"
+        )
+        if kind == "paged":
+            print(
+                f"  kv pool: {src.get('kv_blocks_in_use', 0)}/"
+                f"{src.get('kv_block_pool_size', 0)} blocks in use "
+                f"({100.0 * src.get('kv_block_occupancy', 0.0):.0f}%), "
+                f"block size {src.get('kv_block_size', 0)} tokens"
+            )
+            print(
+                f"  prefill: {src.get('prefilling', 0)} in flight, "
+                f"{src.get('prefill_chunks', 0)} chunks total, "
+                f"{src.get('waiting_for_blocks', 0)} head-of-line waiting for blocks"
+            )
     return 0
 
 
@@ -768,6 +814,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_overload)
+
+    sp = sub.add_parser(
+        "llm",
+        help="LLM serving engines: KV block pool occupancy, chunked-prefill "
+        "progress, slot/queue pressure",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_llm)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

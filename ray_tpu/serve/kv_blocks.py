@@ -1,0 +1,82 @@
+"""Free-list allocator for the paged KV cache's HBM block pool.
+
+The serving engine's paged cache (``models/generation.init_paged_cache``)
+is one shared pool of fixed-size pages per layer; sequences own disjoint
+sets of pages named by their block tables. This module is the host-side
+bookkeeping: which pages are free, which are held, and a typed
+``OverloadedError`` (the PR-9 admission contract, with ``retry_after_s``)
+when a request asks for more pages than are currently free.
+
+Page 0 is never handed out: it is the **garbage page**. Inactive decode
+rows and bucket-padded prefill tails scatter their K/V through all-zero
+block-table entries, and pointing those at a sacrificial page is what lets
+one static-shape decode program serve every allocation pattern without
+masking writes per row. Attention masks page 0 out by length, so its
+contents are never read.
+
+Not thread-safe on its own: the engine serializes every alloc/free under
+its admission lock, same as the WeightedFairQueue.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ray_tpu.runtime import admission
+
+
+class BlockAllocator:
+    """LIFO free list over pages ``1..num_blocks-1`` (page 0 reserved).
+
+    Alloc/free are O(n) in the request's own block count and allocation
+    order cannot fragment: pages are interchangeable (the block table
+    provides the indirection), so ANY ``n <= free_blocks`` pages satisfy a
+    request — there is no adjacency requirement to fragment against.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"pool needs >= 2 blocks (1 usable + the garbage page), got {num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        #: pages a single request may ever hold (pool minus the garbage page)
+        self.capacity = self.num_blocks - 1
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._held = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages off the free list; raises the typed admission
+        shed (``OverloadedError`` with ``retry_after_s``) when fewer than
+        ``n`` are free — the caller leaves the request queued and retries
+        as release paths return pages."""
+        if n < 1:
+            raise ValueError(f"alloc wants >= 1 block, got {n}")
+        if n > len(self._free):
+            raise admission.shed(
+                "engine", "kv_blocks",
+                message=(
+                    f"KV block pool exhausted: {n} blocks wanted, "
+                    f"{len(self._free)} of {self.capacity} free"
+                ),
+            )
+        blocks = [self._free.pop() for _ in range(n)]
+        self._held.update(blocks)
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        """Return pages to the pool. Double-frees and foreign pages raise —
+        a leak check must see corruption, not absorb it."""
+        for b in blocks:
+            if b not in self._held:
+                raise ValueError(f"freeing block {b} that is not held")
+            self._held.discard(b)
+            self._free.append(b)

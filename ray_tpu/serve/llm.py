@@ -4,20 +4,27 @@ The reference's Serve ships no inference engine (its LLM guides delegate to
 vLLM on GPU). On TPU the engine IS the framework's job, and the design is
 dictated by XLA's static-shape compilation model:
 
-- **Fixed decode slots.** One preallocated cache of ``[L, B, Hkv, S, Dh]``
-  where B = ``max_batch_size`` slots. A request occupies a slot from
-  admission to completion; every decode step is ONE jitted program over all
-  B slots (inactive slots compute masked garbage — the static-shape price,
-  paid in exchange for zero recompiles at any admission pattern).
-- **Bucketed prefill.** Prompts pad to power-of-2 buckets so prefill
-  compiles once per bucket, not once per length. Prefill runs batch-1 and
-  the resulting cache row is scattered into the slot (`dynamic_update_slice`
-  on the batch axis) — admission never stalls running decodes for longer
-  than one prefill.
+- **Fixed decode slots.** B = ``max_batch_size`` decode slots; a request
+  occupies a slot from admission to completion and every decode step is ONE
+  jitted program over all B slots (inactive slots compute masked garbage —
+  the static-shape price, paid in exchange for zero recompiles at any
+  admission pattern).
+- **Paged KV cache (default).** K/V live in a shared HBM pool of
+  fixed-size pages ``[L, num_blocks, block_size, Hkv, Dh]``; each slot
+  names its pages in a static-shape ``int32[B, max_blocks_per_slot]`` block
+  table (PagedAttention, Kwon et al. 2023). Admission is block-aware — a
+  request is admitted when enough PAGES are free, so HBM capacity is
+  proportional to tokens actually reserved, not ``B * max_len``. The
+  ``"dense"`` cache kind keeps the classic one-row-per-slot
+  ``[L, B, Hkv, S, Dh]`` buffer.
+- **Chunked prefill.** Prompts prefill in fixed-size chunks interleaved
+  between decode steps (Sarathi-style bounded per-iteration budget,
+  ``prefill_chunk_tokens``; 0 = one-shot with power-of-2 bucketing), so a
+  long prompt stalls running decodes by at most one chunk's forward.
 - **Continuous batching.** New requests join between decode steps
   (vLLM-style iteration-level scheduling); finished ones free their slot
-  immediately. Per-request ``max_tokens`` and ``temperature`` ride as
-  device arrays, so mixed sampling configs share one compiled step.
+  and pages immediately. Per-request ``max_tokens`` and ``temperature``
+  ride as device arrays, so mixed sampling configs share one compiled step.
 
 ``LLMServer`` is the Serve-facing wrapper: a deployment class whose
 replicas each own an engine; requests arrive via handle/HTTP and block on a
@@ -38,17 +45,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError
 from ray_tpu.models.generation import (
     decode_step,
     filter_top_k_top_p,
     forward_with_cache,
     init_cache,
+    init_paged_cache,
+    paged_decode_step,
+    paged_forward_with_cache,
 )
 from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.observability import metric_defs
 from ray_tpu.runtime import admission
 from ray_tpu.runtime.context import current_deadline_ts, current_tenant
+from ray_tpu.serve.kv_blocks import BlockAllocator
 
 _STREAM_END = object()
 
@@ -76,6 +88,8 @@ class GenRequest:
     # filled by the engine
     slot: int = -1
     generated: List[int] = field(default_factory=list)
+    # chunked prefill progress: prompt tokens already cached (paged engine)
+    prefill_pos: int = 0
 
     def emit(self, tok: int) -> None:
         if self.stream_queue is not None:
@@ -116,11 +130,17 @@ class _TokenStream:
             pass
 
 
-def _bucket(n: int, lo: int = 16) -> int:
+def _bucket(n: int, lo: int = 16, cap: Optional[int] = None) -> int:
+    """Smallest power-of-2 bucket >= n (floored at ``lo``), clamped to
+    ``cap``. A length past the cap raises — the caller surfaces it as the
+    typed never-fits ``ValueError`` at submit instead of letting the bucket
+    grow past the cache and failing deep inside prefill."""
+    if cap is not None and n > cap:
+        raise ValueError(f"length {n} exceeds the cache capacity {cap}")
     b = lo
     while b < n:
         b *= 2
-    return b
+    return b if cap is None else min(b, cap)
 
 
 class LLMEngine:
@@ -149,10 +169,45 @@ class LLMEngine:
         max_queued_requests: int = 256,
         max_queued_prefill_tokens: int = 0,
         tenant_weights: Optional[Dict[str, float]] = None,
+        cache_kind: Optional[str] = None,
+        kv_block_size: Optional[int] = None,
+        kv_num_blocks: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.cfg = cfg
         self.B = max_batch_size
         self.S = max_seq_len
+        # KV layout: "paged" (block pool + per-slot block tables) is the
+        # default via Config.llm_cache_kind; explicit args override the
+        # config knobs. Engines under a mesh auto-fall back to dense — the
+        # GSPMD sharding of the paged scatter/gather is not wired yet.
+        rc = get_config()
+        kind = cache_kind if cache_kind is not None else rc.llm_cache_kind
+        if kind == "paged" and mesh is not None:
+            if cache_kind is not None:
+                raise ValueError("cache_kind='paged' with a mesh is not supported yet")
+            kind = "dense"
+        if kind not in ("dense", "paged"):
+            raise ValueError(f"cache_kind must be 'dense' or 'paged', got {kind!r}")
+        self.cache_kind = kind
+        self.kv_block_size = int(
+            kv_block_size if kv_block_size is not None else rc.kv_block_size
+        )
+        if self.kv_block_size < 1:
+            raise ValueError(f"kv_block_size must be >= 1, got {self.kv_block_size}")
+        # static block-table width: enough logical blocks for a max-length
+        # sequence — the table shape never depends on the allocation pattern
+        self.max_blocks_per_slot = -(-self.S // self.kv_block_size)
+        nb = int(kv_num_blocks if kv_num_blocks is not None else rc.kv_num_blocks)
+        if nb <= 0:
+            # auto: dense-equivalent capacity (+1 for the garbage page)
+            nb = self.B * self.max_blocks_per_slot + 1
+        self.kv_num_blocks = nb
+        self.prefill_chunk_tokens = int(
+            prefill_chunk_tokens if prefill_chunk_tokens is not None
+            else rc.prefill_chunk_tokens
+        )
+        self._allocator = BlockAllocator(nb) if kind == "paged" else None
         # bounded waiting queue (overload survival, ISSUE 9): past the
         # request-count bound, or the prefill-token budget (0 = unbounded),
         # submit() sheds with a typed OverloadedError instead of growing
@@ -231,6 +286,24 @@ class LLMEngine:
         self._pos = np.zeros(self.B, np.int32)
         self._temps = np.zeros(self.B, np.float32)
         self._active = np.zeros(self.B, bool)
+        # paged state: per-slot block tables (host mirror of the device
+        # int32[B, M] array), pages held per slot, and slots reserved by a
+        # request whose chunked prefill is still in flight (the slot is
+        # taken but must not receive decode tokens yet)
+        self._block_tables = np.zeros((self.B, self.max_blocks_per_slot), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(self.B)]
+        self._reserved = np.zeros(self.B, bool)
+        self._prefilling: List[GenRequest] = []
+        # head-of-line request popped from the fair queue but waiting for
+        # pages: held (not re-pushed — that would break fair ordering)
+        # until release paths free enough blocks
+        self._held_req: Optional[GenRequest] = None
+        self._prefill_chunk_count = 0
+        metric_defs.LLM_KV_BLOCK_POOL_SIZE.set(
+            self._allocator.capacity if self._allocator is not None else 0,
+            self._depth_tags,
+        )
+        metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
 
         self._reset_cache()
         self._key = jax.random.key(np.random.randint(0, 2**31 - 1))
@@ -311,6 +384,73 @@ class LLMEngine:
         self._insert = _insert
         self._sample = _sample
 
+        if self.cache_kind == "paged":
+            bs_ = self.kv_block_size
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _prefill_chunk(params, cache, toks, bt, start, length):
+                """toks [1, C] chunk-padded; bt [1, M]; start/length traced,
+                so every chunk of every prompt at width C shares ONE
+                compile. Writes K/V for the chunk's ``length`` real tokens
+                through the block table and returns the last real token's
+                logits [V] (only the final chunk's are consumed)."""
+                C = toks.shape[1]
+                positions = start + jnp.arange(C)[None, :]
+                valid = (jnp.arange(C) < length)[None, :]
+                logits, cache = paged_forward_with_cache(
+                    cfg_, params, cache, bt, toks, positions,
+                    valid=valid, layer_scales=layer_scales, use_decode_kernel=False,
+                )
+                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0, keepdims=False)
+                return last, cache
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _decode_k_paged(params, cache, toks, pos, temps, key, bt):
+                def body(carry, _):
+                    cache, toks, pos, key = carry
+                    logits, cache = paged_decode_step(
+                        cfg_, params, cache, toks, pos, bt,
+                        layer_scales=layer_scales, use_decode_kernel=use_kernel,
+                    )
+                    key, sub = jax.random.split(key)
+                    nxt = _sample_impl(sub, logits, temps)
+                    return (cache, nxt, pos + 1, key), nxt
+
+                (cache, _, _, key), toks_k = jax.lax.scan(
+                    body, (cache, toks, pos, key), None, length=K_chunk
+                )
+                return jnp.swapaxes(toks_k, 0, 1), cache, key  # [B, K]
+
+            from ray_tpu.models.transformer import gather_paged_kv, scatter_paged_kv
+
+            @jax.jit
+            def _extract_row_paged(cache, bt):
+                """Gather one request's pages into a dense
+                [L, 1, Hkv, M*bs, Dh] row (prefill-memo store)."""
+                return {
+                    kk: jax.vmap(lambda p: gather_paged_kv(p, bt))(cache[kk])
+                    for kk in ("k", "v")
+                }
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _insert_row_paged(cache, row, bt):
+                """Scatter a memoized dense row into freshly allocated pages
+                (prefill-memo hit: the whole prefill forward is skipped)."""
+                cap = bt.shape[1] * bs_
+                positions = jnp.arange(cap)[None, :]
+                out = {}
+                for kk in ("k", "v"):
+                    new = jnp.transpose(row[kk], (0, 1, 3, 2, 4))  # [L,1,cap,Hkv,Dh]
+                    out[kk] = jax.vmap(
+                        lambda p, n: scatter_paged_kv(p, n, bt, positions)
+                    )(cache[kk], new)
+                return out
+
+            self._prefill_chunk = _prefill_chunk
+            self._decode_k_paged = _decode_k_paged
+            self._extract_row_paged = _extract_row_paged
+            self._insert_row_paged = _insert_row_paged
+
         self._thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
         self._thread.start()
 
@@ -365,6 +505,18 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) exceeds "
                 f"engine max_seq_len {self.S}"
             )
+        if self._allocator is not None:
+            # never-fits contract (same as max_queued_prefill_tokens below):
+            # a request needing more pages than the POOL holds can never be
+            # admitted — that is a config/input error at submit, not a
+            # retry-after-able overload and not a failure deep in prefill
+            needed = -(-(len(prompt) + max_tokens - 1) // self.kv_block_size)
+            if needed > self._allocator.capacity:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) needs "
+                    f"{needed} KV blocks but the pool only holds "
+                    f"{self._allocator.capacity} and would never be admitted"
+                )
         if self._max_queued_tokens and len(prompt) > self._max_queued_tokens:
             # a prompt that ALONE exceeds the budget can never be admitted:
             # that is a config/input error, not a retry-after-able overload
@@ -479,6 +631,7 @@ class LLMEngine:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            alloc = self._allocator
             return {
                 "active_slots": int(self._active.sum()),
                 "max_batch_size": self.B,
@@ -488,11 +641,20 @@ class LLMEngine:
                 "prefill_cache_entries": len(self._prefill_cache),
                 "slots_evicted": self.num_slots_evicted,
                 "shed": self.num_shed,
+                "cache_kind": self.cache_kind,
+                "kv_block_size": self.kv_block_size if alloc is not None else 0,
+                "kv_block_pool_size": alloc.capacity if alloc is not None else 0,
+                "kv_blocks_in_use": alloc.used_blocks if alloc is not None else 0,
+                "prefilling": len(self._prefilling),
+                "prefill_chunks": self._prefill_chunk_count,
             }
 
     def admission_snapshot(self) -> Dict[str, Any]:
         """Bounds + depths for GET /api/overload (admission source)."""
         with self._lock:
+            alloc = self._allocator
+            pool = alloc.capacity if alloc is not None else 0
+            in_use = alloc.used_blocks if alloc is not None else 0
             return {
                 "layer": "engine",
                 "queued": len(self._queue),
@@ -504,6 +666,14 @@ class LLMEngine:
                 "by_tenant": self._queue.depth_by_tenant(),
                 "slots_evicted": self.num_slots_evicted,
                 "shed": self.num_shed,
+                "cache_kind": self.cache_kind,
+                "kv_block_size": self.kv_block_size if alloc is not None else 0,
+                "kv_block_pool_size": pool,
+                "kv_blocks_in_use": in_use,
+                "kv_block_occupancy": (in_use / pool) if pool else 0.0,
+                "prefilling": len(self._prefilling),
+                "prefill_chunks": self._prefill_chunk_count,
+                "waiting_for_blocks": 1 if self._held_req is not None else 0,
             }
 
     def shutdown(self) -> None:
@@ -514,9 +684,18 @@ class LLMEngine:
         # zero this engine's gauge series; the freed token (and thus the
         # series label) is reused by the next engine
         metric_defs.ADMISSION_QUEUE_DEPTH.set(0, self._depth_tags)
+        if self._allocator is not None:
+            metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
+            metric_defs.LLM_KV_BLOCK_POOL_SIZE.set(0, self._depth_tags)
         with self._lock:
             pending = [r for r in self._queue.items() if not r.future.done()]
             pending += [r for r in self._slots if r is not None and not r.future.done()]
+            pending += [r for r in self._prefilling if not r.future.done()]
+            self._prefilling.clear()
+            if self._held_req is not None:
+                if not self._held_req.future.done():
+                    pending.append(self._held_req)
+                self._held_req = None
             self._queue.drain()
             self._queued_tokens = 0
         for r in pending:
@@ -526,15 +705,37 @@ class LLMEngine:
 
     # -- engine loop --------------------------------------------------------
     def _admit(self) -> None:
+        if self.cache_kind == "paged":
+            self._admit_paged()
+        else:
+            self._admit_dense()
+
+    def _pop_admissible(self, *, need_free_slot: bool = True):
+        """Shared admit-loop head: pop (or resume) the next runnable request.
+
+        Returns ``(req, free_slots)`` with shed-on-pop filtering applied, or
+        ``None`` when there is nothing admissible right now. A paged engine's
+        head-of-line request waiting for blocks lives in ``self._held_req``
+        and is resumed here (never re-pushed: re-pushing would re-bill its
+        stride and let later arrivals overtake the weighted-fair order).
+        """
         while True:
             with self._lock:
-                free = [i for i in range(self.B) if not self._active[i]]
-                if not free or not len(self._queue):
-                    return
-                req = self._queue.pop()  # weighted fair order across tenants
-                self._queued_tokens -= len(req.prompt)
+                free = [
+                    i for i in range(self.B)
+                    if not self._active[i] and not self._reserved[i]
+                ]
+                if need_free_slot and not free:
+                    return None
+                if self._held_req is not None:
+                    req = self._held_req
+                    self._held_req = None
+                elif len(self._queue):
+                    req = self._queue.pop()  # weighted fair order across tenants
+                    self._queued_tokens -= len(req.prompt)
+                else:
+                    return None
                 depth = len(self._queue)
-                slot = free[0]
             metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
             if req.cancelled:
                 # abandoned while waiting: never prefill it
@@ -558,6 +759,15 @@ class LLMEngine:
                 if req.stream_queue is not None:
                     req.stream_queue.put(_STREAM_END)
                 continue
+            return req, free
+
+    def _admit_dense(self) -> None:
+        while True:
+            popped = self._pop_admissible()
+            if popped is None:
+                return
+            req, free = popped
+            slot = free[0]
             try:
                 tp = len(req.prompt)
                 prompt_key = tuple(req.prompt)
@@ -572,10 +782,16 @@ class LLMEngine:
                 if hit is not None:
                     logits, row = hit
                 else:
-                    bucket = min(_bucket(tp), self.S)
+                    bucket = _bucket(tp, cap=self.S)
                     toks = np.zeros((1, bucket), np.int32)
                     toks[0, :tp] = req.prompt
+                    stalled = bool(self._active.any())
+                    t0 = time.perf_counter()
                     logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+                    jax.block_until_ready(logits)
+                    if stalled:
+                        # decode slots sat idle for this whole one-shot prefill
+                        metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
                     with self._lock:  # stats() reads these under the lock
                         self._prefill_count += 1
                         if self._prefill_cache_size:
@@ -593,15 +809,7 @@ class LLMEngine:
             except BaseException as exc:  # noqa: BLE001
                 # the popped request is in neither queue nor slots — fail it
                 # HERE or its caller hangs forever
-                if not req.future.done():
-                    req.future.set_exception(RuntimeError(f"prefill failed: {exc!r}"))
-                if req.stream_queue is not None:
-                    req.stream_queue.put(_STREAM_END)
-                if self._cache["k"].is_deleted():
-                    # _insert consumed its donation then failed: the shared
-                    # cache is gone, taking every in-flight slot with it
-                    self._fail_inflight(RuntimeError(f"cache lost in failed insert: {exc!r}"))
-                    self._reset_cache()
+                self._fail_admit(req, exc)
                 continue
             req.slot = slot
             req.generated = [tok0]
@@ -615,6 +823,178 @@ class LLMEngine:
             if self._maybe_finish(req, tok0):
                 continue
 
+    def _admit_paged(self) -> None:
+        """Block-aware admission: reserve the request's whole page budget up
+        front (``ceil((prompt + max_tokens - 1) / block_size)`` — the last
+        written position is ``prompt + max_tokens - 2``), so an admitted
+        request can never hit a mid-decode pool OOM and nothing is ever
+        preempted. Prefill itself runs later, chunk by chunk, from
+        ``_prefill_tick`` so decode steps interleave with long prompts."""
+        while True:
+            popped = self._pop_admissible()
+            if popped is None:
+                return
+            req, free = popped
+            tp = len(req.prompt)
+            needed = -(-(tp + req.max_tokens - 1) // self.kv_block_size)
+            with self._lock:
+                if needed > self._allocator.free_blocks:
+                    # head-of-line waits for release paths to return pages;
+                    # skipping it would starve big requests behind small ones
+                    self._held_req = req
+                    return
+                blocks = self._allocator.alloc(needed)
+                slot = free[0]
+                self._reserved[slot] = True
+                self._slot_blocks[slot] = blocks
+                self._block_tables[slot, :] = 0
+                self._block_tables[slot, : len(blocks)] = blocks
+                in_use = self._allocator.used_blocks
+            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+            req.slot = slot
+            req.prefill_pos = 0
+            prompt_key = tuple(req.prompt)
+            with self._lock:
+                hit = (
+                    self._prefill_cache.get(prompt_key)
+                    if self._prefill_cache_size
+                    else None
+                )
+                if hit is not None:
+                    self._prefill_cache.move_to_end(prompt_key)
+            if hit is None:
+                with self._lock:
+                    self._prefilling.append(req)
+                continue
+            logits, row = hit
+            try:
+                bt = jnp.asarray(self._block_tables[slot : slot + 1])
+                self._cache = self._insert_row_paged(self._cache, row, bt)
+                self._finish_prefill(req, logits)
+            except BaseException as exc:  # noqa: BLE001
+                self._fail_admit(req, exc)
+                continue
+
+    def _finish_prefill(self, req: GenRequest, logits) -> None:
+        """Prompt is fully in the paged cache: sample the first token and
+        hand the slot to the decode batch."""
+        tp = len(req.prompt)
+        self._key, sub = jax.random.split(self._key)
+        tok0 = int(
+            self._sample(
+                sub, logits[None, :], jnp.asarray([req.temperature], jnp.float32)
+            )[0]
+        )
+        req.generated = [tok0]
+        req.emit(tok0)
+        with self._lock:
+            slot = req.slot
+            self._slots[slot] = req
+            self._active[slot] = True
+            self._reserved[slot] = False
+            self._last_tok[slot] = tok0
+            self._pos[slot] = tp
+            self._temps[slot] = req.temperature
+        self._maybe_finish(req, tok0)
+
+    def _fail_admit(self, req: GenRequest, exc: BaseException) -> None:
+        """A popped request is in neither queue nor slots — fail it HERE or
+        its caller hangs forever; return any reserved pages to the pool."""
+        if not req.future.done():
+            req.future.set_exception(RuntimeError(f"prefill failed: {exc!r}"))
+        if req.stream_queue is not None:
+            req.stream_queue.put(_STREAM_END)
+        if self._allocator is not None and req.slot >= 0:
+            with self._lock:
+                self._release_blocks_locked(req.slot)
+                in_use = self._allocator.used_blocks
+            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+        if self._cache["k"].is_deleted():
+            # a donated insert/chunk consumed the cache then failed: the
+            # shared cache is gone, taking every in-flight slot with it
+            self._fail_inflight(RuntimeError(f"cache lost in failed prefill: {exc!r}"))
+            self._reset_cache()
+
+    def _release_blocks_locked(self, slot: int) -> None:
+        """Return a slot's pages to the pool. Caller holds ``self._lock``."""
+        blocks = self._slot_blocks[slot]
+        self._slot_blocks[slot] = []
+        self._block_tables[slot, :] = 0
+        self._reserved[slot] = False
+        if blocks:
+            self._allocator.free(blocks)
+
+    def _prefill_tick(self) -> bool:
+        """Advance the head prefilling request by one chunk. Returns True if
+        any device work ran (the loop then skips its idle wait).
+
+        With ``prefill_chunk_tokens > 0`` every chunk is the same fixed
+        width, so a single compiled program serves all prompts and a decode
+        step runs between chunks (Sarathi-style stall bounding). With 0 the
+        whole prompt goes in one bucketed call."""
+        with self._lock:
+            while self._prefilling and self._prefilling[0].cancelled:
+                req = self._prefilling.pop(0)
+                self._release_blocks_locked(req.slot)
+                self.num_shed += 1
+                admission.record_shed("engine", "disconnect")
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("stream consumer disconnected during prefill")
+                    )
+                if req.stream_queue is not None:
+                    req.stream_queue.put(_STREAM_END)
+            if not self._prefilling:
+                return False
+            req = self._prefilling[0]
+            in_use = self._allocator.used_blocks
+        metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+        tp = len(req.prompt)
+        start = req.prefill_pos
+        chunk = self.prefill_chunk_tokens
+        width = min(chunk, self.S) if chunk > 0 else _bucket(tp, cap=self.S)
+        n = min(width, tp - start)
+        toks = np.zeros((1, width), np.int32)
+        toks[0, :n] = req.prompt[start : start + n]
+        bt = jnp.asarray(self._block_tables[req.slot : req.slot + 1])
+        stalled = bool(self._active.any())
+        t0 = time.perf_counter()
+        try:
+            logits, self._cache = self._prefill_chunk(
+                self.params, self._cache, jnp.asarray(toks), bt,
+                jnp.int32(start), jnp.int32(n),
+            )
+            jax.block_until_ready(logits)
+        except BaseException as exc:  # noqa: BLE001
+            with self._lock:
+                self._prefilling.pop(0)
+            self._fail_admit(req, exc)
+            return True
+        if stalled:
+            # decode slots sat idle while this chunk ran; chunking bounds it
+            metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
+        metric_defs.LLM_PREFILL_CHUNKS.inc()
+        with self._lock:
+            self._prefill_chunk_count += 1
+        req.prefill_pos = start + n
+        if req.prefill_pos < tp:
+            return True
+        with self._lock:
+            self._prefilling.pop(0)
+            self._prefill_count += 1
+        try:
+            if self._prefill_cache_size:
+                bt_row = jnp.asarray(self._block_tables[req.slot : req.slot + 1])
+                row = self._extract_row_paged(self._cache, bt_row)
+                with self._lock:
+                    self._prefill_cache[tuple(req.prompt)] = (logits, row)
+                    while len(self._prefill_cache) > self._prefill_cache_size:
+                        self._prefill_cache.popitem(last=False)
+            self._finish_prefill(req, logits)
+        except BaseException as exc:  # noqa: BLE001
+            self._fail_admit(req, exc)
+        return True
+
     def _maybe_finish(self, req: GenRequest, tok: int) -> bool:
         done = len(req.generated) >= req.max_tokens or (
             req.eos_id is not None and tok == req.eos_id
@@ -623,6 +1003,11 @@ class LLMEngine:
             with self._lock:
                 self._active[req.slot] = False
                 self._slots[req.slot] = None
+                if self._allocator is not None:
+                    self._release_blocks_locked(req.slot)
+                    in_use = self._allocator.used_blocks
+            if self._allocator is not None:
+                metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
             req.future.set_result(req.generated)
             if req.stream_queue is not None:
                 req.stream_queue.put(_STREAM_END)
@@ -631,10 +1016,19 @@ class LLMEngine:
     def _step(self) -> None:
         toks = jnp.asarray(self._last_tok)
         pos = jnp.asarray(self._pos)
-        out, self._cache, self._key = self._decode_k(
-            self.params, self._cache, toks, pos,
-            jnp.asarray(self._temps), self._key,
-        )
+        if self.cache_kind == "paged":
+            # inactive rows decode through all-zero tables -> garbage page 0,
+            # so freed pages are never written after release
+            bt = jnp.asarray(self._block_tables * self._active[:, None].astype(np.int32))
+            out, self._cache, self._key = self._decode_k_paged(
+                self.params, self._cache, toks, pos,
+                jnp.asarray(self._temps), self._key, bt,
+            )
+        else:
+            out, self._cache, self._key = self._decode_k(
+                self.params, self._cache, toks, pos,
+                jnp.asarray(self._temps), self._key,
+            )
         sampled = np.asarray(out)  # [B, K]
         for k in range(sampled.shape[1]):
             for i in range(self.B):
@@ -655,20 +1049,34 @@ class LLMEngine:
     def _reset_cache(self) -> None:
         """(Re)allocate the decode cache — also the recovery path after a
         failed donated step leaves the old buffers deleted."""
+        if self.cache_kind == "paged":
+            self._cache = init_paged_cache(self.cfg, self.kv_num_blocks, self.kv_block_size)
+            return
         cache = init_cache(self.cfg, self.B, self.S)
         if self._kv_spec is not None:
             cache = {k: jax.device_put(v, self._kv_spec) for k, v in cache.items()}
         self._cache = cache
 
     def _fail_inflight(self, error: BaseException) -> None:
-        """Fail every queued and in-slot request (loop-crash recovery):
-        futures resolve with the error and stream iterators terminate."""
+        """Fail every queued, prefilling, and in-slot request (loop-crash
+        recovery): futures resolve with the error, stream iterators
+        terminate, and every reserved KV page returns to the pool."""
         with self._lock:
             victims = self._queue.drain() + [r for r in self._slots if r is not None]
+            victims += self._prefilling
+            self._prefilling.clear()
+            if self._held_req is not None:
+                victims.append(self._held_req)
+                self._held_req = None
             self._queued_tokens = 0
             self._slots = [None] * self.B
             self._active[:] = False
+            if self._allocator is not None:
+                for i in range(self.B):
+                    self._release_blocks_locked(i)
         metric_defs.ADMISSION_QUEUE_DEPTH.set(0, self._depth_tags)
+        if self._allocator is not None:
+            metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
         for r in victims:
             if not r.future.done():
                 r.future.set_exception(error)
@@ -677,8 +1085,9 @@ class LLMEngine:
 
     def _evict_cancelled(self) -> None:
         """Free decode slots whose streaming consumer went away: the slot
-        returns to the batch NOW instead of decoding to an abandoned queue
-        until stop/length (llm_slots_evicted_total{reason=disconnect})."""
+        (and its KV pages) returns to the batch NOW instead of decoding to an
+        abandoned queue until stop/length
+        (llm_slots_evicted_total{reason=disconnect})."""
         with self._lock:
             victims = [
                 (i, r) for i, r in enumerate(self._slots)
@@ -687,6 +1096,11 @@ class LLMEngine:
             for i, _ in victims:
                 self._slots[i] = None
                 self._active[i] = False
+                if self._allocator is not None:
+                    self._release_blocks_locked(i)
+            in_use = self._allocator.used_blocks if self._allocator is not None else 0
+        if victims and self._allocator is not None:
+            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
         for _, r in victims:
             self.num_slots_evicted += 1
             metric_defs.LLM_SLOTS_EVICTED.inc(tags=_EVICT_DISCONNECT_TAGS)
@@ -700,9 +1114,12 @@ class LLMEngine:
             try:
                 self._evict_cancelled()
                 self._admit()
+                progressed = False
+                if self.cache_kind == "paged":
+                    progressed = self._prefill_tick()
                 if self._active.any():
                     self._step()
-                else:
+                elif not progressed:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except BaseException as exc:  # noqa: BLE001 — a dead loop hangs every caller
@@ -743,6 +1160,10 @@ class LLMServer:
         max_queued_requests: int = 256,
         max_queued_prefill_tokens: int = 0,
         tenant_weights: Optional[Dict[str, float]] = None,
+        cache_kind: Optional[str] = None,
+        kv_block_size: Optional[int] = None,
+        kv_num_blocks: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
@@ -762,6 +1183,10 @@ class LLMServer:
             max_queued_requests=max_queued_requests,
             max_queued_prefill_tokens=max_queued_prefill_tokens,
             tenant_weights=tenant_weights,
+            cache_kind=cache_kind,
+            kv_block_size=kv_block_size,
+            kv_num_blocks=kv_num_blocks,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:

@@ -213,6 +213,9 @@ def test_engine_deadline_expired_while_queued_never_takes_slot():
             doomed.result(timeout=120)
         blocker.result(timeout=120)
         assert eng.stats()["shed"] >= 1
+        # the shed request never reserved KV pages; the finished blocker
+        # returned its own — the pool drains back to empty
+        assert eng.stats()["kv_blocks_in_use"] == 0
     finally:
         eng.shutdown()
 
@@ -256,6 +259,7 @@ def test_engine_disconnected_stream_frees_slot():
             time.sleep(0.01)
         assert eng.stats()["active_slots"] == 0, "slot never evicted"
         assert eng.stats()["slots_evicted"] == 1
+        assert eng.stats()["kv_blocks_in_use"] == 0  # evict freed its pages
         # the freed slot still serves new work
         assert len(eng.generate([4, 2], max_tokens=3)) == 3
     finally:
@@ -278,6 +282,7 @@ def test_engine_abandoned_queued_stream_never_admits():
         assert stats["shed"] >= 1
         blocker.result(timeout=120)
         assert eng.stats()["active_slots"] == 0
+        assert eng.stats()["kv_blocks_in_use"] == 0
     finally:
         eng.shutdown()
 

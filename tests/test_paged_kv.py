@@ -1,0 +1,344 @@
+"""Paged KV cache + chunked prefill tests.
+
+Four contracts:
+- allocator: typed exhaustion shed, no fragmentation across churn, double
+  frees raise (leak checks must see corruption, not absorb it)
+- ops: paged_decode_attention == dense decode_attention through a shuffled
+  block table (XLA fallback and interpret-mode Pallas kernel)
+- engine identity: the paged engine is token-identical to the dense engine
+  under greedy decoding, and chunked prefill is token-identical to one-shot
+  for every chunk width
+- leak checks: every release path (finish, eos, deadline shed, disconnect
+  evict, prefill crash, loop crash) returns ALL blocks to the pool
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.exceptions import DeadlineExceededError, OverloadedError
+from ray_tpu.models import TransformerConfig, init_params
+from ray_tpu.serve.kv_blocks import BlockAllocator
+from ray_tpu.serve.llm import LLMEngine
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    attention="dense", dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(11))
+
+
+def _paged(params, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(CFG, params, cache_kind="paged", **kw)
+
+
+def _wait(pred, timeout=60):
+    deadline = time.time() + timeout
+    while not pred() and time.time() < deadline:
+        time.sleep(0.005)
+    assert pred()
+
+
+# --------------------------------------------------------------------------
+# BlockAllocator
+# --------------------------------------------------------------------------
+def test_allocator_page_zero_reserved():
+    a = BlockAllocator(8)
+    assert a.capacity == 7
+    got = a.alloc(7)
+    assert 0 not in got and sorted(got) == list(range(1, 8))
+    assert a.free_blocks == 0 and a.used_blocks == 7
+    a.free(got)
+    assert a.free_blocks == 7 and a.used_blocks == 0
+
+
+def test_allocator_too_small_raises():
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+
+
+def test_allocator_exhaustion_is_typed_shed():
+    a = BlockAllocator(4)
+    held = a.alloc(2)
+    with pytest.raises(OverloadedError) as exc:
+        a.alloc(2)
+    assert exc.value.layer == "engine" and exc.value.reason == "kv_blocks"
+    assert exc.value.retry_after_s > 0
+    # the failed alloc took nothing
+    assert a.free_blocks == 1 and a.used_blocks == 2
+    a.free(held)
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(4)
+    got = a.alloc(1)
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got)
+    with pytest.raises(ValueError):
+        a.free([0])  # the garbage page is never held
+
+
+def test_allocator_no_fragmentation_across_churn():
+    """1k admit/release cycles of varying sizes: the pool always refills to
+    capacity and a full-capacity alloc still succeeds afterwards (pages are
+    interchangeable, so there is nothing to fragment)."""
+    a = BlockAllocator(17)
+    rng = np.random.default_rng(7)
+    for i in range(1000):
+        sizes = []
+        holds = []
+        while a.free_blocks > 0:
+            n = min(int(rng.integers(1, 5)), a.free_blocks)
+            holds.append(a.alloc(n))
+            sizes.append(n)
+        for h in rng.permutation(len(holds)):
+            a.free(holds[h])
+        assert a.free_blocks == a.capacity, f"leak after cycle {i}"
+    full = a.alloc(a.capacity)
+    assert len(full) == a.capacity
+    a.free(full)
+
+
+# --------------------------------------------------------------------------
+# paged_decode_attention op
+# --------------------------------------------------------------------------
+def _paged_op_case(seed=0, B=3, H=8, Hkv=2, D=16, S=64, bs=16):
+    from ray_tpu.ops.decode_attention import decode_attention
+
+    rng = np.random.default_rng(seed)
+    M = S // bs
+    N = B * M + 1
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(N, bs, Hkv, D)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(N, bs, Hkv, D)), jnp.float32)
+    # shuffled table: physical placement must not matter
+    perm = rng.permutation(np.arange(1, N))
+    bt = jnp.asarray(perm[: B * M].reshape(B, M).astype(np.int32))
+    lengths = jnp.asarray([5, S, 17], jnp.int32)
+    kd = jnp.transpose(jnp.take(k_pages, bt, axis=0), (0, 3, 1, 2, 4)).reshape(B, Hkv, S, D)
+    vd = jnp.transpose(jnp.take(v_pages, bt, axis=0), (0, 3, 1, 2, 4)).reshape(B, Hkv, S, D)
+    ref = decode_attention(q, kd, vd, lengths)
+    return q, k_pages, v_pages, bt, lengths, ref
+
+
+def test_paged_decode_attention_matches_dense_xla():
+    from ray_tpu.ops.decode_attention import paged_decode_attention
+
+    q, kp, vp, bt, lengths, ref = _paged_op_case()
+    out = paged_decode_attention(q, kp, vp, bt, lengths, use_kernel=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_paged_decode_attention_kernel_interpret():
+    from ray_tpu.ops.decode_attention import paged_decode_attention
+
+    q, kp, vp, bt, lengths, ref = _paged_op_case(seed=3)
+    out = paged_decode_attention(q, kp, vp, bt, lengths, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# engine identity: paged == dense, chunked == one-shot
+# --------------------------------------------------------------------------
+PROMPTS = [[3, 5, 7, 11, 13], [2] * 17, list(range(1, 31)), [8, 9]]
+
+
+def test_paged_engine_token_identical_to_dense(params):
+    dense = LLMEngine(CFG, params, max_batch_size=4, max_seq_len=64, cache_kind="dense")
+    paged = _paged(params)
+    try:
+        ref = [f.result(timeout=120) for f in
+               [dense.submit(p, max_tokens=8) for p in PROMPTS]]
+        got = [f.result(timeout=120) for f in
+               [paged.submit(p, max_tokens=8) for p in PROMPTS]]
+        assert got == ref
+        assert paged.stats()["kv_blocks_in_use"] == 0
+    finally:
+        dense.shutdown()
+        paged.shutdown()
+
+
+@pytest.mark.parametrize("chunk", [16, 7, 64])  # 1 block, odd, full prompt
+def test_chunked_prefill_token_identical_to_one_shot(params, chunk):
+    oneshot = _paged(params, prefill_chunk_tokens=0)
+    chunked = _paged(params, prefill_chunk_tokens=chunk)
+    try:
+        ref = [f.result(timeout=120) for f in
+               [oneshot.submit(p, max_tokens=8) for p in PROMPTS]]
+        got = [f.result(timeout=120) for f in
+               [chunked.submit(p, max_tokens=8) for p in PROMPTS]]
+        assert got == ref
+        st = chunked.stats()
+        assert st["kv_blocks_in_use"] == 0
+        assert st["prefill_chunks"] >= len(PROMPTS)
+    finally:
+        oneshot.shutdown()
+        chunked.shutdown()
+
+
+def test_paged_prefill_memo_skips_forward(params):
+    eng = _paged(params, prefill_cache_size=2)
+    try:
+        a = eng.generate([5, 4, 3, 2, 1], max_tokens=6)
+        assert eng.stats()["prefill_forwards"] == 1
+        b = eng.generate([5, 4, 3, 2, 1], max_tokens=6)
+        assert eng.stats()["prefill_forwards"] == 1  # memo hit, no forward
+        assert a == b
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_paged_never_fitting_prompt_is_value_error(params):
+    # pool of 2 usable blocks (32 positions) but max_seq_len still 64: the
+    # block check fires where the seq-len check cannot
+    eng = _paged(params, kv_num_blocks=3)
+    try:
+        with pytest.raises(ValueError, match="never be admitted"):
+            eng.submit([1] * 30, max_tokens=10)
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_bucket_cap_contract():
+    from ray_tpu.serve.llm import _bucket
+
+    assert _bucket(100, cap=128) == 128
+    assert _bucket(100, cap=100) == 100  # clamped, not grown past the cache
+    assert _bucket(64, cap=64) == 64
+    with pytest.raises(ValueError):
+        _bucket(65, cap=64)
+
+
+# --------------------------------------------------------------------------
+# leak checks: every release path returns ALL blocks
+# --------------------------------------------------------------------------
+def test_blocks_released_on_finish_and_eos(params):
+    eng = _paged(params)
+    try:
+        out = eng.generate([4, 5, 6], max_tokens=8)
+        eos = out[2]
+        eng.generate([4, 5, 6], max_tokens=8, eos_id=eos)  # early eos stop
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_disconnect_evict(params):
+    eng = _paged(params, max_batch_size=1)
+    try:
+        stream = eng.submit_stream([4, 2], max_tokens=50)
+        next(stream)
+        _wait(lambda: eng.stats()["active_slots"] == 1)
+        assert eng.stats()["kv_blocks_in_use"] > 0
+        stream.close()
+        _wait(lambda: eng.stats()["active_slots"] == 0)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+        # the freed pages still serve new work
+        assert len(eng.generate([4, 2], max_tokens=3)) == 3
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_deadline_shed(params):
+    eng = _paged(params, max_batch_size=1)
+    try:
+        blocker = eng.submit([2, 7, 1], max_tokens=40)
+        doomed = eng.submit([2, 7, 1], max_tokens=2,
+                            deadline_ts=time.time() + 0.05)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=120)
+        blocker.result(timeout=120)
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_prefill_crash(params):
+    eng = _paged(params, prefill_chunk_tokens=8)
+    try:
+        real = eng._prefill_chunk
+        eng._prefill_chunk = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected prefill fault")
+        )
+        fut = eng.submit([1, 2, 3, 4, 5], max_tokens=4)
+        with pytest.raises(RuntimeError, match="prefill failed"):
+            fut.result(timeout=120)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+        eng._prefill_chunk = real
+        # pool intact: the engine keeps serving
+        assert len(eng.generate([1, 2, 3], max_tokens=3)) == 3
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_loop_crash(params):
+    eng = _paged(params)
+    try:
+        real = eng._decode_k_paged
+        eng._decode_k_paged = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected decode fault")
+        )
+        fut = eng.submit([1, 2, 3], max_tokens=8)
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=120)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+        eng._decode_k_paged = real
+        # _fail_inflight + _reset_cache recovered the engine
+        assert len(eng.generate([1, 2, 3], max_tokens=3)) == 3
+        assert eng.stats()["kv_blocks_in_use"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_head_of_line_waits_for_blocks_no_leak(params):
+    """Pool fits one max-size request: the second is HELD (not shed, not
+    reordered) until the first releases, then admits and completes."""
+    eng = _paged(params, max_batch_size=2, kv_num_blocks=5)  # 4 usable blocks
+    try:
+        a = eng.submit([1] * 40, max_tokens=20)  # needs all 4 blocks
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 4)
+        b = eng.submit([2] * 40, max_tokens=20)  # must wait for the pool
+        _wait(lambda: eng.admission_snapshot()["waiting_for_blocks"] == 1)
+        assert len(a.result(timeout=120)) == 20
+        assert len(b.result(timeout=120)) == 20
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+    finally:
+        eng.shutdown()
+
+
+def test_paged_snapshot_and_metrics_registered(params):
+    from ray_tpu.observability import metric_defs
+    from ray_tpu.runtime import admission
+
+    names = {m.name for m in metric_defs.ALL_METRICS}
+    for family in (
+        "llm_kv_block_pool_size",
+        "llm_kv_blocks_in_use",
+        "llm_prefill_chunks_total",
+        "llm_decode_stall_seconds",
+    ):
+        assert family in names
+    eng = _paged(params)
+    try:
+        snap = [s for s in admission.sources_snapshot()
+                if s.get("layer") == "engine"][-1]
+        assert snap["cache_kind"] == "paged"
+        assert snap["kv_block_pool_size"] == eng._allocator.capacity
+        assert snap["kv_blocks_in_use"] == 0
+        assert snap["kv_block_occupancy"] == 0.0
+    finally:
+        eng.shutdown()

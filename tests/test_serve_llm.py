@@ -98,6 +98,13 @@ def test_bucket():
     assert _bucket(16) == 16
     assert _bucket(17) == 32
     assert _bucket(100) == 128
+    # capped: the bucket clamps to the cache capacity instead of growing
+    # past it, and a length that cannot fit raises (never-fits contract)
+    assert _bucket(100, cap=128) == 128
+    assert _bucket(100, cap=100) == 100
+    assert _bucket(64, cap=64) == 64
+    with pytest.raises(ValueError):
+        _bucket(65, cap=64)
 
 
 def test_llm_server_deployment(params):
