@@ -190,6 +190,13 @@ ROW_GROUPS = [
     # fixed-width chunks).  Own fresh-runtime group — the rows spin up
     # several engines with background decode threads.
     ["llm_paged_capacity_x", "llm_chunked_prefill_stall_p99"],
+    # prefix-aware KV reuse (ISSUE 15): wall-clock tok/s of 8 concurrent
+    # streams vs the same requests served one at a time (continuous
+    # batching utilization), and cold-vs-warm TTFT of a 192-token prompt
+    # whose full blocks come back out of the radix prefix cache (the warm
+    # run recomputes ONE token through a copy-on-write tail block).  Own
+    # fresh-runtime group — engines with background decode threads.
+    ["llm_concurrent_streams_x", "llm_prefix_cache_ttft_x"],
 ]
 
 
@@ -231,6 +238,8 @@ def main() -> None:
         "overload_goodput",
         "llm_paged_capacity_x",
         "llm_chunked_prefill_stall_p99",
+        "llm_concurrent_streams_x",
+        "llm_prefix_cache_ttft_x",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

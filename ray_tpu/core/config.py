@@ -310,6 +310,16 @@ class Config:
     # between decode steps, so running decodes never stall more than one
     # chunk's forward.  0 = one-shot (whole prompt, power-of-2 bucketed).
     prefill_chunk_tokens: int = 0
+    # Prefix-aware KV reuse (paged engines only): finished requests publish
+    # the full blocks of prompt+completion into a radix prefix cache
+    # (serve/prefix_cache.py) and new requests share() the longest cached
+    # prefix straight into their block table — zero prefill compute for the
+    # hit region, refcounted pages, copy-on-write on divergence.
+    llm_prefix_cache: bool = True
+    # Max full blocks the prefix cache may pin (0 = bounded only by the
+    # pool).  When the pool runs short, unreferenced cached leaves are
+    # LRU-evicted before admission holds or sheds either way.
+    prefix_cache_max_blocks: int = 0
 
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):

@@ -17,6 +17,7 @@ toggled by ``DataContext.use_push_based_shuffle`` (reference toggle:
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -39,12 +40,42 @@ def _partition_for_sort(block: Block, key, descending: bool, boundaries: List[An
     return [acc.take(np.nonzero(idx == p)[0]) for p in range(n_parts)]
 
 
+def _stable_key_hash(v: Any) -> int:
+    """Deterministic 64-bit hash of one partition-key value.
+
+    Python's ``hash()`` is salted per process (PYTHONHASHSEED), so two map
+    tasks in different worker processes could send the SAME string key to
+    DIFFERENT reduce partitions — a groupby/repartition correctness bug,
+    not just a repro nit. blake2b over a type-tagged encoding is identical
+    everywhere. Numeric values hash by VALUE like Python dict keys
+    (``2 == 2.0 == True`` land in one partition)."""
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, bool):
+        v = int(v)
+    elif isinstance(v, float) and v.is_integer():
+        v = int(v)
+    if isinstance(v, str):
+        tag, payload = b"s", v.encode("utf-8", "surrogatepass")
+    elif isinstance(v, bytes):
+        tag, payload = b"b", v
+    elif isinstance(v, int):
+        tag, payload = b"i", str(v).encode()
+    elif isinstance(v, float):
+        tag, payload = b"f", repr(v).encode()
+    elif v is None:
+        tag, payload = b"n", b""
+    else:
+        tag, payload = b"o", repr(v).encode()
+    return int.from_bytes(hashlib.blake2b(tag + payload, digest_size=8).digest(), "big")
+
+
 def _partition_by_hash(block: Block, key: str, n_parts: int) -> List[Block]:
     acc = BlockAccessor(block)
     if acc.num_rows() == 0:
         return [{} for _ in range(n_parts)]
     col = block[key]
-    hashes = np.asarray([hash(v.item() if isinstance(v, np.generic) else v) % n_parts for v in col])
+    hashes = np.asarray([_stable_key_hash(v) % n_parts for v in col])
     return [acc.take(np.nonzero(hashes == p)[0]) for p in range(n_parts)]
 
 

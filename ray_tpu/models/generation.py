@@ -63,6 +63,22 @@ def init_paged_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def copy_paged_page(cache: KVCache, src, dst) -> KVCache:
+    """Copy one physical page — every layer's K and V rows — from ``src`` to
+    ``dst`` in a paged pool (:func:`init_paged_cache` layout).
+
+    This is the engine's copy-on-write primitive: a sequence about to write
+    into a page it shares with the prefix cache (or another sequence) gets
+    its own copy first, then swaps its block-table entry, so shared pages
+    are only ever read. ``src``/``dst`` may be traced scalars — under
+    ``jit`` every copy shares one compile. Page 0 must never be a
+    destination (the garbage page's contents are sacrificial, but a COW
+    into it would alias every masked write)."""
+    return {
+        kk: cache[kk].at[:, dst].set(cache[kk][:, src]) for kk in ("k", "v")
+    }
+
+
 def _write_kv(cache_layer: jax.Array, new: jax.Array, starts: jax.Array) -> jax.Array:
     """cache_layer [B,Hkv,S,Dh] <- new [B,T,Hkv,Dh] at per-row offset starts[B]."""
     upd = jnp.transpose(new, (0, 2, 1, 3))  # [B, Hkv, T, Dh]

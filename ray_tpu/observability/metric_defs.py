@@ -364,6 +364,34 @@ LLM_DECODE_STALL = _reg.histogram(
     "s",
     boundaries=_LATENCY_BOUNDS,
 )
+LLM_PREFIX_CACHE_HITS = _reg.counter(
+    "llm_prefix_cache_hits_total",
+    "Admitted LLM requests by prefix-cache outcome: result=hit (every full "
+    "prompt block was cached), partial (some leading blocks), miss. Hit "
+    "regions skip prefill compute entirely — the hit rate is the fraction "
+    "of traffic whose TTFT is decoupled from prompt length.",
+)
+LLM_PREFIX_CACHE_BLOCKS = _reg.gauge(
+    "llm_prefix_cache_blocks",
+    "KV pool pages currently pinned by the prefix cache (one reference per "
+    "cached full block). These pages are reclaimable: an LRU sweep evicts "
+    "unreferenced leaves whenever admission runs short of pages.",
+    "blocks",
+)
+LLM_KV_BLOCKS_SHARED = _reg.gauge(
+    "llm_kv_blocks_shared",
+    "KV pool pages with more than one reference (cache + live requests, or "
+    "several requests on one shared prefix). Each extra reference is a "
+    "page of HBM the pool did NOT have to spend — the capacity "
+    "multiplication of prefix sharing.",
+    "blocks",
+)
+LLM_PREFIX_EVICTIONS = _reg.counter(
+    "llm_prefix_evictions_total",
+    "Prefix-cache entries LRU-evicted (deterministic insertion-ordered "
+    "tie-break) to return pages to a short pool or to respect "
+    "prefix_cache_max_blocks.",
+)
 
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
@@ -439,6 +467,10 @@ ALL_METRICS = [
     LLM_KV_BLOCKS_IN_USE,
     LLM_PREFILL_CHUNKS,
     LLM_DECODE_STALL,
+    LLM_PREFIX_CACHE_HITS,
+    LLM_PREFIX_CACHE_BLOCKS,
+    LLM_KV_BLOCKS_SHARED,
+    LLM_PREFIX_EVICTIONS,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

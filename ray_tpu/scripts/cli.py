@@ -570,6 +570,14 @@ def cmd_overload(args) -> int:
                     f"{src.get('prefill_chunks', 0)} chunks, "
                     f"{src.get('waiting_for_blocks', 0)} waiting for blocks"
                 )
+            if src.get("prefix_cache_enabled"):
+                print(
+                    f"  prefix cache: {src.get('prefix_cache_blocks', 0)} blocks, "
+                    f"{100.0 * src.get('prefix_hit_rate', 0.0):.0f}% hit rate, "
+                    f"{src.get('kv_blocks_shared', 0)} shared, "
+                    f"{src.get('prefix_tokens_reused', 0)} tokens reused, "
+                    f"{src.get('prefix_evictions', 0)} evictions"
+                )
     return 0
 
 
@@ -606,6 +614,16 @@ def cmd_llm(args) -> int:
                 f"{src.get('prefill_chunks', 0)} chunks total, "
                 f"{src.get('waiting_for_blocks', 0)} head-of-line waiting for blocks"
             )
+            if src.get("prefix_cache_enabled"):
+                print(
+                    f"  prefix cache: {src.get('prefix_cache_blocks', 0)} blocks "
+                    f"cached, {100.0 * src.get('prefix_hit_rate', 0.0):.0f}% hit "
+                    f"rate, {src.get('kv_blocks_shared', 0)} pages shared, "
+                    f"{src.get('prefix_tokens_reused', 0)} prompt tokens reused, "
+                    f"{src.get('prefix_evictions', 0)} evictions"
+                )
+            else:
+                print("  prefix cache: off")
     return 0
 
 

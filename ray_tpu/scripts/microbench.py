@@ -974,7 +974,12 @@ def run_suite(
         slow._chaos_delay_s = 0.0
 
     # ---- paged KV + chunked prefill (ISSUE 14) ---------------------------
-    if wanted("llm_paged_capacity_x") or wanted("llm_chunked_prefill_stall_p99"):
+    if (
+        wanted("llm_paged_capacity_x")
+        or wanted("llm_chunked_prefill_stall_p99")
+        or wanted("llm_concurrent_streams_x")
+        or wanted("llm_prefix_cache_ttft_x")
+    ):
         import jax
         import jax.numpy as jnp
 
@@ -1004,9 +1009,13 @@ def run_suite(
         STREAMS = 16
 
         def _peak_streams(kind, batch, num_blocks=None):
+            # prefix_cache off: this row measures block-granular packing at
+            # a fixed HBM budget; cached prefixes would hold pool pages and
+            # trip the all-blocks-return guard
             eng = LLMEngine(
                 llm_cfg, llm_params, max_batch_size=batch, max_seq_len=S_CAP,
                 cache_kind=kind, kv_block_size=BS, kv_num_blocks=num_blocks,
+                prefix_cache=False,
             )
             try:
                 eng.generate([1] * PROMPT_N, max_tokens=2)  # warm compiles
@@ -1093,6 +1102,93 @@ def run_suite(
                 f"one-shot {oneshot_p99:.4f}s"
             )
         record("llm_chunked_prefill_stall_p99", chunked_p99, "s")
+
+    if wanted("llm_concurrent_streams_x"):
+        # Decode-batch utilization (ISSUE 15): wall-clock tokens/s of 8
+        # concurrent streams vs the SAME 8 requests one at a time on one
+        # engine.  Sequential serving decodes a batch of 1 per step; the
+        # continuous batcher packs all 8 into one decode forward.  Row value
+        # = concurrent tok/s / sequential tok/s (x).  In-row guards: outputs
+        # are request-for-request identical (greedy), ratio >= 1.5x floor.
+        # prefix_cache off so the sequential pass cannot seed reuse for the
+        # concurrent pass — both do full prefills.
+        N_STREAMS, GEN_T, PROMPT_L = 8, 32, 24
+        eng = LLMEngine(
+            llm_cfg, llm_params, max_batch_size=N_STREAMS, max_seq_len=256,
+            cache_kind="paged", prefix_cache=False,
+        )
+        try:
+            prompts = [
+                [(i * 7 + j) % 96 + 1 for j in range(PROMPT_L)]
+                for i in range(N_STREAMS)
+            ]
+            eng.generate(prompts[0], max_tokens=2)  # warm the compiles
+            t0 = time.perf_counter()
+            seq_out = [eng.generate(p, max_tokens=GEN_T) for p in prompts]
+            seq_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_tokens=GEN_T) for p in prompts]
+            conc_out = [f.result(timeout=300) for f in futs]
+            conc_s = time.perf_counter() - t0
+            if conc_out != seq_out:
+                raise AssertionError(
+                    "concurrent streams row: batched tokens diverged from "
+                    "sequential"
+                )
+            ratio = seq_s / max(1e-9, conc_s)
+            if ratio < 1.5:
+                raise AssertionError(
+                    f"8 concurrent streams only {ratio:.2f}x sequential "
+                    f"tok/s, below the 1.5x floor"
+                )
+        finally:
+            eng.shutdown()
+        record("llm_concurrent_streams_x", ratio, "x")
+
+    if wanted("llm_prefix_cache_ttft_x"):
+        # Prefix-cache TTFT (ISSUE 15): time-to-first-token of a 192-token
+        # prompt cold (full prefill) vs warm (every full block shared out of
+        # the radix cache; the engine recomputes ONE token through a
+        # copy-on-write tail block).  Row value = cold TTFT / warm TTFT (x).
+        # In-row guards: warm tokens identical to cold (greedy), >= 2x
+        # acceptance floor.
+        PREFIX_L, GEN_T = 192, 8
+        eng = LLMEngine(
+            llm_cfg, llm_params, max_batch_size=2, max_seq_len=256,
+            cache_kind="paged", kv_block_size=16,
+        )
+        try:
+            # warm BOTH code paths (full prefill and hit + COW) on an
+            # unrelated prompt so the row times KV reuse, not XLA compiles
+            warmup = [7] * PREFIX_L
+            eng.generate(warmup, max_tokens=2)
+            eng.generate(warmup, max_tokens=2)
+            eng.flush_prefix_cache()
+
+            def ttft(p):
+                t0 = time.perf_counter()
+                stream = eng.submit_stream(p, max_tokens=GEN_T)
+                first = next(stream)
+                dt = time.perf_counter() - t0
+                return dt, [first] + list(stream)
+
+            prompt = [(j * 5) % 96 + 1 for j in range(PREFIX_L)]
+            cold_s, cold_toks = ttft(prompt)
+            warm_s, warm_toks = ttft(prompt)
+            if warm_toks != cold_toks:
+                raise AssertionError("ttft row: warm tokens diverged from cold")
+            if eng.stats()["prefix_cache_hits"] < 1:
+                raise AssertionError("ttft row: warm run missed the cache")
+            ratio = cold_s / max(1e-9, warm_s)
+            if ratio < 2.0:
+                raise AssertionError(
+                    f"warm TTFT {warm_s * 1e3:.2f}ms vs cold "
+                    f"{cold_s * 1e3:.2f}ms = {ratio:.2f}x, below the 2x "
+                    f"acceptance floor"
+                )
+        finally:
+            eng.shutdown()
+        record("llm_prefix_cache_ttft_x", ratio, "x")
 
     return results
 

@@ -12,15 +12,26 @@ rows and bucket-padded prefill tails scatter their K/V through all-zero
 block-table entries, and pointing those at a sacrificial page is what lets
 one static-shape decode program serve every allocation pattern without
 masking writes per row. Attention masks page 0 out by length, so its
-contents are never read.
+contents are never read. It is also never SHARED: sharing it would give it
+a refcount, and a refcount on the sentinel would let a release path return
+it to the free list.
 
-Not thread-safe on its own: the engine serializes every alloc/free under
-its admission lock, same as the WeightedFairQueue.
+Pages are **reference counted** so the prefix cache can share one physical
+page into many block tables (vLLM-style): ``alloc`` hands pages out at
+refcount 1, ``share`` takes another reference on already-held pages, and
+``free`` drops one reference — the page re-enters the free list only when
+the last holder lets go. A holder is either a live request (one reference
+per block-table entry) or the prefix cache (one reference per cached
+node), so every existing release path stays a plain ``free`` of the slot's
+pages.
+
+Not thread-safe on its own: the engine serializes every alloc/share/free
+under its admission lock, same as the WeightedFairQueue.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
 from ray_tpu.runtime import admission
 
@@ -43,7 +54,9 @@ class BlockAllocator:
         #: pages a single request may ever hold (pool minus the garbage page)
         self.capacity = self.num_blocks - 1
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
-        self._held = set()
+        # page -> reference count; a page is either on the free list or in
+        # here with count >= 1, never both
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -53,11 +66,23 @@ class BlockAllocator:
     def used_blocks(self) -> int:
         return self.capacity - len(self._free)
 
+    @property
+    def shared_blocks(self) -> int:
+        """Pages currently held by more than one reference (the
+        ``llm_kv_blocks_shared`` gauge)."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, block: int) -> int:
+        """References on ``block`` (0 = free or the garbage page). The
+        copy-on-write rule reads this: a write may only land on a page with
+        refcount 1."""
+        return self._refs.get(block, 0)
+
     def alloc(self, n: int) -> List[int]:
-        """Take ``n`` pages off the free list; raises the typed admission
-        shed (``OverloadedError`` with ``retry_after_s``) when fewer than
-        ``n`` are free — the caller leaves the request queued and retries
-        as release paths return pages."""
+        """Take ``n`` pages off the free list at refcount 1; raises the
+        typed admission shed (``OverloadedError`` with ``retry_after_s``)
+        when fewer than ``n`` are free — the caller leaves the request
+        queued and retries as release paths return pages."""
         if n < 1:
             raise ValueError(f"alloc wants >= 1 block, got {n}")
         if n > len(self._free):
@@ -69,14 +94,29 @@ class BlockAllocator:
                 ),
             )
         blocks = [self._free.pop() for _ in range(n)]
-        self._held.update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         return blocks
 
-    def free(self, blocks: List[int]) -> None:
-        """Return pages to the pool. Double-frees and foreign pages raise —
-        a leak check must see corruption, not absorb it."""
+    def share(self, blocks: List[int]) -> None:
+        """Take one more reference on each page (prefix-cache hit: the same
+        physical page enters another block table). Only held pages can be
+        shared — sharing a free page or the garbage page 0 is corruption
+        and raises, same contract as double-free."""
         for b in blocks:
-            if b not in self._held:
+            if b not in self._refs:
+                raise ValueError(f"sharing block {b} that is not held")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def free(self, blocks: List[int]) -> None:
+        """Drop one reference per page; a page returns to the pool only at
+        refcount 0. Double-frees and foreign pages raise — a leak check
+        must see corruption, not absorb it."""
+        for b in blocks:
+            if b not in self._refs:
                 raise ValueError(f"freeing block {b} that is not held")
-            self._held.discard(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)

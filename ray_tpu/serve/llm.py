@@ -21,6 +21,15 @@ dictated by XLA's static-shape compilation model:
   between decode steps (Sarathi-style bounded per-iteration budget,
   ``prefill_chunk_tokens``; 0 = one-shot with power-of-2 bucketing), so a
   long prompt stalls running decodes by at most one chunk's forward.
+- **Prefix-aware KV reuse (paged engines, on by default).** Finished
+  requests publish the full blocks of prompt+completion into a radix
+  prefix cache (``serve/prefix_cache.py``); admission matches the longest
+  cached prefix and ``share()``s those pages straight into the new block
+  table, so prefill starts at the first UNCACHED token and reserves pool
+  budget only for the suffix. Pages are refcounted; a write that would
+  land in a shared page goes through copy-on-write; when the pool runs
+  short, unreferenced cached leaves are LRU-evicted before admission holds
+  or sheds (vLLM PagedAttention / SGLang RadixAttention idiom).
 - **Continuous batching.** New requests join between decode steps
   (vLLM-style iteration-level scheduling); finished ones free their slot
   and pages immediately. Per-request ``max_tokens`` and ``temperature``
@@ -36,7 +45,6 @@ from __future__ import annotations
 import functools
 import threading
 import time
-from collections import OrderedDict
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -48,6 +56,7 @@ import numpy as np
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError
 from ray_tpu.models.generation import (
+    copy_paged_page,
     decode_step,
     filter_top_k_top_p,
     forward_with_cache,
@@ -61,11 +70,17 @@ from ray_tpu.observability import metric_defs
 from ray_tpu.runtime import admission
 from ray_tpu.runtime.context import current_deadline_ts, current_tenant
 from ray_tpu.serve.kv_blocks import BlockAllocator
+from ray_tpu.serve.prefix_cache import PrefixCache
 
 _STREAM_END = object()
 
-# prebuilt tag dict for the per-request admission hot path
+# prebuilt tag dicts for the per-request admission hot path
 _EVICT_DISCONNECT_TAGS = {"reason": "disconnect"}
+_PREFIX_RESULT_TAGS = {
+    "hit": {"result": "hit"},
+    "partial": {"result": "partial"},
+    "miss": {"result": "miss"},
+}
 
 
 @dataclass
@@ -165,7 +180,6 @@ class LLMEngine:
         mesh: Optional[Any] = None,
         tp: str = "tp",
         decode_chunk: int = 1,
-        prefill_cache_size: int = 0,
         max_queued_requests: int = 256,
         max_queued_prefill_tokens: int = 0,
         tenant_weights: Optional[Dict[str, float]] = None,
@@ -173,6 +187,8 @@ class LLMEngine:
         kv_block_size: Optional[int] = None,
         kv_num_blocks: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
+        prefix_cache_max_blocks: Optional[int] = None,
     ):
         self.cfg = cfg
         self.B = max_batch_size
@@ -208,6 +224,23 @@ class LLMEngine:
             else rc.prefill_chunk_tokens
         )
         self._allocator = BlockAllocator(nb) if kind == "paged" else None
+        # prefix-aware KV reuse is a paged-pool feature (dense engines have
+        # no pages to share); on by default via Config.llm_prefix_cache
+        use_prefix = prefix_cache if prefix_cache is not None else rc.llm_prefix_cache
+        pcb = int(
+            prefix_cache_max_blocks if prefix_cache_max_blocks is not None
+            else rc.prefix_cache_max_blocks
+        )
+        self._prefix = (
+            PrefixCache(self.kv_block_size, pcb)
+            if (kind == "paged" and use_prefix)
+            else None
+        )
+        # prefix-cache outcome counts per admitted request, tokens whose
+        # prefill compute was skipped, and copy-on-write page copies
+        self._prefix_results = {"hit": 0, "partial": 0, "miss": 0}
+        self._prefix_tokens_reused = 0
+        self._cow_count = 0
         # bounded waiting queue (overload survival, ISSUE 9): past the
         # request-count bound, or the prefill-token budget (0 = unbounded),
         # submit() sheds with a typed OverloadedError instead of growing
@@ -217,13 +250,7 @@ class LLMEngine:
         self._queued_tokens = 0
         self.num_slots_evicted = 0
         self.num_shed = 0
-        # opt-in memo of prefill results keyed by the EXACT prompt token
-        # tuple: repeated prompts (identical system prompts, retries) skip
-        # the prefill forward entirely.  Each entry pins one cache row
-        # ([L,1,Hkv,S,Dh]) in HBM, so keep the LRU small.
-        self._prefill_cache_size = max(0, int(prefill_cache_size))
-        self._prefill_cache: OrderedDict[tuple, Any] = OrderedDict()
-        self._prefill_count = 0  # actual prefill forwards (cache misses)
+        self._prefill_count = 0  # prompts fully prefilled
         # tokens generated per host round trip (1 = per-token stepping).
         # >1 amortizes dispatch/readback latency; admission and stream
         # emission happen at chunk granularity, and a request finishing
@@ -304,6 +331,8 @@ class LLMEngine:
             self._depth_tags,
         )
         metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
+        metric_defs.LLM_KV_BLOCKS_SHARED.set(0, self._depth_tags)
+        metric_defs.LLM_PREFIX_CACHE_BLOCKS.set(0, self._depth_tags)
 
         self._reset_cache()
         self._key = jax.random.key(np.random.randint(0, 2**31 - 1))
@@ -385,7 +414,6 @@ class LLMEngine:
         self._sample = _sample
 
         if self.cache_kind == "paged":
-            bs_ = self.kv_block_size
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def _prefill_chunk(params, cache, toks, bt, start, length):
@@ -421,35 +449,12 @@ class LLMEngine:
                 )
                 return jnp.swapaxes(toks_k, 0, 1), cache, key  # [B, K]
 
-            from ray_tpu.models.transformer import gather_paged_kv, scatter_paged_kv
-
-            @jax.jit
-            def _extract_row_paged(cache, bt):
-                """Gather one request's pages into a dense
-                [L, 1, Hkv, M*bs, Dh] row (prefill-memo store)."""
-                return {
-                    kk: jax.vmap(lambda p: gather_paged_kv(p, bt))(cache[kk])
-                    for kk in ("k", "v")
-                }
-
-            @functools.partial(jax.jit, donate_argnums=(0,))
-            def _insert_row_paged(cache, row, bt):
-                """Scatter a memoized dense row into freshly allocated pages
-                (prefill-memo hit: the whole prefill forward is skipped)."""
-                cap = bt.shape[1] * bs_
-                positions = jnp.arange(cap)[None, :]
-                out = {}
-                for kk in ("k", "v"):
-                    new = jnp.transpose(row[kk], (0, 1, 3, 2, 4))  # [L,1,cap,Hkv,Dh]
-                    out[kk] = jax.vmap(
-                        lambda p, n: scatter_paged_kv(p, n, bt, positions)
-                    )(cache[kk], new)
-                return out
+            # copy-on-write primitive (models/generation.copy_paged_page):
+            # donated so XLA copies the page in place in the pool buffers
+            self._copy_page = jax.jit(copy_paged_page, donate_argnums=(0,))
 
             self._prefill_chunk = _prefill_chunk
             self._decode_k_paged = _decode_k_paged
-            self._extract_row_paged = _extract_row_paged
-            self._insert_row_paged = _insert_row_paged
 
         self._thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
         self._thread.start()
@@ -638,15 +643,23 @@ class LLMEngine:
                 "queued": len(self._queue),
                 "queued_prefill_tokens": self._queued_tokens,
                 "prefill_forwards": self._prefill_count,
-                "prefill_cache_entries": len(self._prefill_cache),
                 "slots_evicted": self.num_slots_evicted,
                 "shed": self.num_shed,
                 "cache_kind": self.cache_kind,
                 "kv_block_size": self.kv_block_size if alloc is not None else 0,
                 "kv_block_pool_size": alloc.capacity if alloc is not None else 0,
                 "kv_blocks_in_use": alloc.used_blocks if alloc is not None else 0,
+                "kv_blocks_shared": alloc.shared_blocks if alloc is not None else 0,
                 "prefilling": len(self._prefilling),
                 "prefill_chunks": self._prefill_chunk_count,
+                "prefix_cache_enabled": self._prefix is not None,
+                "prefix_cache_blocks": len(self._prefix) if self._prefix is not None else 0,
+                "prefix_cache_hits": self._prefix_results["hit"],
+                "prefix_cache_partial": self._prefix_results["partial"],
+                "prefix_cache_misses": self._prefix_results["miss"],
+                "prefix_tokens_reused": self._prefix_tokens_reused,
+                "prefix_evictions": self._prefix.evictions if self._prefix is not None else 0,
+                "cow_copies": self._cow_count,
             }
 
     def admission_snapshot(self) -> Dict[str, Any]:
@@ -655,6 +668,8 @@ class LLMEngine:
             alloc = self._allocator
             pool = alloc.capacity if alloc is not None else 0
             in_use = alloc.used_blocks if alloc is not None else 0
+            probes = sum(self._prefix_results.values())
+            useful = self._prefix_results["hit"] + self._prefix_results["partial"]
             return {
                 "layer": "engine",
                 "queued": len(self._queue),
@@ -670,10 +685,16 @@ class LLMEngine:
                 "kv_block_size": self.kv_block_size if alloc is not None else 0,
                 "kv_block_pool_size": pool,
                 "kv_blocks_in_use": in_use,
+                "kv_blocks_shared": alloc.shared_blocks if alloc is not None else 0,
                 "kv_block_occupancy": (in_use / pool) if pool else 0.0,
                 "prefilling": len(self._prefilling),
                 "prefill_chunks": self._prefill_chunk_count,
                 "waiting_for_blocks": 1 if self._held_req is not None else 0,
+                "prefix_cache_enabled": self._prefix is not None,
+                "prefix_cache_blocks": len(self._prefix) if self._prefix is not None else 0,
+                "prefix_hit_rate": (useful / probes) if probes else 0.0,
+                "prefix_tokens_reused": self._prefix_tokens_reused,
+                "prefix_evictions": self._prefix.evictions if self._prefix is not None else 0,
             }
 
     def shutdown(self) -> None:
@@ -687,6 +708,8 @@ class LLMEngine:
         if self._allocator is not None:
             metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
             metric_defs.LLM_KV_BLOCK_POOL_SIZE.set(0, self._depth_tags)
+            metric_defs.LLM_KV_BLOCKS_SHARED.set(0, self._depth_tags)
+            metric_defs.LLM_PREFIX_CACHE_BLOCKS.set(0, self._depth_tags)
         with self._lock:
             pending = [r for r in self._queue.items() if not r.future.done()]
             pending += [r for r in self._slots if r is not None and not r.future.done()]
@@ -702,6 +725,43 @@ class LLMEngine:
             r.future.set_exception(RuntimeError("LLMEngine shut down"))
             if r.stream_queue is not None:
                 r.stream_queue.put(_STREAM_END)
+
+    def flush_prefix_cache(self) -> int:
+        """Evict every prefix-cache entry not currently shared into a live
+        request and return the number of pages freed.  Ops hook — also the
+        leak-check primitive: on a quiesced engine, ``kv_blocks_in_use``
+        equals ``prefix_cache_blocks`` and a flush takes both to zero."""
+        if self._prefix is None:
+            return 0
+        with self._lock:
+            pages = self._prefix.evict(len(self._prefix), self._evictable)
+            if pages:
+                self._allocator.free(pages)
+            gauges = self._pool_gauges_locked()
+        if pages:
+            metric_defs.LLM_PREFIX_EVICTIONS.inc(len(pages))
+        self._publish_pool_gauges(*gauges)
+        return len(pages)
+
+    def _evictable(self, page: int) -> bool:
+        """An eviction may only take pages whose sole reference is the
+        cache's own — refcount 1 means no live block table names the page.
+        Caller holds ``self._lock``."""
+        return self._allocator.refcount(page) == 1
+
+    def _pool_gauges_locked(self):
+        """(in_use, shared, cache_blocks) snapshot; caller holds the lock."""
+        alloc = self._allocator
+        return (
+            alloc.used_blocks if alloc is not None else 0,
+            alloc.shared_blocks if alloc is not None else 0,
+            len(self._prefix) if self._prefix is not None else 0,
+        )
+
+    def _publish_pool_gauges(self, in_use: int, shared: int, cache_blocks: int) -> None:
+        metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+        metric_defs.LLM_KV_BLOCKS_SHARED.set(shared, self._depth_tags)
+        metric_defs.LLM_PREFIX_CACHE_BLOCKS.set(cache_blocks, self._depth_tags)
 
     # -- engine loop --------------------------------------------------------
     def _admit(self) -> None:
@@ -770,34 +830,18 @@ class LLMEngine:
             slot = free[0]
             try:
                 tp = len(req.prompt)
-                prompt_key = tuple(req.prompt)
-                with self._lock:
-                    hit = (
-                        self._prefill_cache.get(prompt_key)
-                        if self._prefill_cache_size
-                        else None
-                    )
-                    if hit is not None:
-                        self._prefill_cache.move_to_end(prompt_key)
-                if hit is not None:
-                    logits, row = hit
-                else:
-                    bucket = _bucket(tp, cap=self.S)
-                    toks = np.zeros((1, bucket), np.int32)
-                    toks[0, :tp] = req.prompt
-                    stalled = bool(self._active.any())
-                    t0 = time.perf_counter()
-                    logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
-                    jax.block_until_ready(logits)
-                    if stalled:
-                        # decode slots sat idle for this whole one-shot prefill
-                        metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
-                    with self._lock:  # stats() reads these under the lock
-                        self._prefill_count += 1
-                        if self._prefill_cache_size:
-                            self._prefill_cache[prompt_key] = (logits, row)
-                            while len(self._prefill_cache) > self._prefill_cache_size:
-                                self._prefill_cache.popitem(last=False)
+                bucket = _bucket(tp, cap=self.S)
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :tp] = req.prompt
+                stalled = bool(self._active.any())
+                t0 = time.perf_counter()
+                logits, row = self._prefill_one(self.params, jnp.asarray(toks), jnp.int32(tp))
+                jax.block_until_ready(logits)
+                if stalled:
+                    # decode slots sat idle for this whole one-shot prefill
+                    metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
+                with self._lock:  # stats() reads this under the lock
+                    self._prefill_count += 1
                 self._cache = self._insert(self._cache, row, slot)
                 # first output token comes straight from the prefill logits
                 self._key, sub = jax.random.split(self._key)
@@ -829,51 +873,104 @@ class LLMEngine:
         written position is ``prompt + max_tokens - 2``), so an admitted
         request can never hit a mid-decode pool OOM and nothing is ever
         preempted. Prefill itself runs later, chunk by chunk, from
-        ``_prefill_tick`` so decode steps interleave with long prompts."""
+        ``_prefill_tick`` so decode steps interleave with long prompts.
+
+        With the prefix cache, the longest cached prefix of the prompt is
+        ``share()``d straight into the block table (zero prefill compute for
+        the hit region — chunked prefill starts at the first uncached token)
+        and only the uncached suffix reserves fresh pages. A full-prompt hit
+        still recomputes the LAST prompt token (its logits seed sampling),
+        and that write would land in the final matched block — a shared
+        page — so that block is copy-on-write: the request gets a fresh
+        page populated by a device page copy instead of a share."""
+        bs = self.kv_block_size
         while True:
             popped = self._pop_admissible()
             if popped is None:
                 return
             req, free = popped
             tp = len(req.prompt)
-            needed = -(-(tp + req.max_tokens - 1) // self.kv_block_size)
+            total = -(-(tp + req.max_tokens - 1) // bs)
             with self._lock:
+                pages: List[int] = []
+                matched = 0
+                if self._prefix is not None:
+                    pages, matched = self._prefix.match(req.prompt)
+                cow_src = -1
+                if matched == tp:
+                    # full-prompt hit: the tail block must be writable
+                    cow_src = pages.pop()
+                    matched -= bs
+                # pin the hit region (and the COW source) FIRST: the
+                # eviction sweep below must never free a page we matched
+                pins = pages + ([cow_src] if cow_src >= 0 else [])
+                if pins:
+                    self._allocator.share(pins)
+                needed = total - len(pages)
+                short = needed - self._allocator.free_blocks
+                evicted_n = 0
+                if short > 0 and self._prefix is not None:
+                    # pool short: LRU-sweep unreferenced cached leaves
+                    # before holding (and long before admission sheds)
+                    evicted = self._prefix.evict(short, self._evictable)
+                    if evicted:
+                        self._allocator.free(evicted)
+                        evicted_n = len(evicted)
                 if needed > self._allocator.free_blocks:
                     # head-of-line waits for release paths to return pages;
-                    # skipping it would starve big requests behind small ones
+                    # skipping it would starve big requests behind small
+                    # ones. Drop the pins — it re-probes the cache on wake.
+                    if pins:
+                        self._allocator.free(pins)
                     self._held_req = req
+                    if evicted_n:
+                        metric_defs.LLM_PREFIX_EVICTIONS.inc(evicted_n)
                     return
-                blocks = self._allocator.alloc(needed)
+                blocks = pages + self._allocator.alloc(needed)
                 slot = free[0]
                 self._reserved[slot] = True
                 self._slot_blocks[slot] = blocks
                 self._block_tables[slot, :] = 0
                 self._block_tables[slot, : len(blocks)] = blocks
-                in_use = self._allocator.used_blocks
-            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+                hit_tokens = matched + (bs if cow_src >= 0 else 0)
+                if self._prefix is not None:
+                    fb = (tp // bs) * bs  # the matchable (full-block) region
+                    result = (
+                        ("hit" if hit_tokens == fb else "partial")
+                        if hit_tokens > 0
+                        else "miss"
+                    )
+                    self._prefix_results[result] += 1
+                    self._prefix_tokens_reused += (
+                        tp - 1 if cow_src >= 0 else matched
+                    )
+                gauges = self._pool_gauges_locked()
+            if evicted_n:
+                metric_defs.LLM_PREFIX_EVICTIONS.inc(evicted_n)
+            self._publish_pool_gauges(*gauges)
+            if self._prefix is not None:
+                metric_defs.LLM_PREFIX_CACHE_HITS.inc(tags=_PREFIX_RESULT_TAGS[result])
             req.slot = slot
-            req.prefill_pos = 0
-            prompt_key = tuple(req.prompt)
+            # chunked prefill resumes at the first token whose KV is not
+            # already in the table (tp - 1 for a full hit: one recompute)
+            req.prefill_pos = matched
+            if cow_src >= 0:
+                try:
+                    dst = blocks[len(pages)]  # the fresh page for the tail block
+                    self._cache = self._copy_page(
+                        self._cache, jnp.int32(cow_src), jnp.int32(dst)
+                    )
+                    with self._lock:
+                        self._allocator.free([cow_src])  # drop the copy pin
+                        self._cow_count += 1
+                except BaseException as exc:  # noqa: BLE001
+                    with self._lock:
+                        self._allocator.free([cow_src])
+                    self._fail_admit(req, exc)
+                    continue
+                req.prefill_pos = tp - 1
             with self._lock:
-                hit = (
-                    self._prefill_cache.get(prompt_key)
-                    if self._prefill_cache_size
-                    else None
-                )
-                if hit is not None:
-                    self._prefill_cache.move_to_end(prompt_key)
-            if hit is None:
-                with self._lock:
-                    self._prefilling.append(req)
-                continue
-            logits, row = hit
-            try:
-                bt = jnp.asarray(self._block_tables[slot : slot + 1])
-                self._cache = self._insert_row_paged(self._cache, row, bt)
-                self._finish_prefill(req, logits)
-            except BaseException as exc:  # noqa: BLE001
-                self._fail_admit(req, exc)
-                continue
+                self._prefilling.append(req)
 
     def _finish_prefill(self, req: GenRequest, logits) -> None:
         """Prompt is fully in the paged cache: sample the first token and
@@ -907,8 +1004,8 @@ class LLMEngine:
         if self._allocator is not None and req.slot >= 0:
             with self._lock:
                 self._release_blocks_locked(req.slot)
-                in_use = self._allocator.used_blocks
-            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+                gauges = self._pool_gauges_locked()
+            self._publish_pool_gauges(*gauges)
         if self._cache["k"].is_deleted():
             # a donated insert/chunk consumed the cache then failed: the
             # shared cache is gone, taking every in-flight slot with it
@@ -916,13 +1013,75 @@ class LLMEngine:
             self._reset_cache()
 
     def _release_blocks_locked(self, slot: int) -> None:
-        """Return a slot's pages to the pool. Caller holds ``self._lock``."""
+        """Drop a slot's page references (a request holds exactly ONE per
+        block-table entry, shared or not, so every release path — finish,
+        shed, evict, crash — is this same free). Caller holds ``self._lock``."""
         blocks = self._slot_blocks[slot]
         self._slot_blocks[slot] = []
         self._block_tables[slot, :] = 0
         self._reserved[slot] = False
         if blocks:
             self._allocator.free(blocks)
+
+    def _retire_blocks_locked(self, req: GenRequest) -> int:
+        """Finish path: publish the request's full KV blocks into the prefix
+        cache (the request's reference TRANSFERS to the cache for newly
+        adopted nodes) and free everything else. Returns the number of
+        pages LRU-evicted to respect ``prefix_cache_max_blocks``. Caller
+        holds ``self._lock``."""
+        slot = req.slot
+        blocks = self._slot_blocks[slot]
+        self._slot_blocks[slot] = []
+        self._block_tables[slot, :] = 0
+        self._reserved[slot] = False
+        if not blocks:
+            return 0
+        if self._prefix is None:
+            self._allocator.free(blocks)
+            return 0
+        # the last sampled token was never written back to the KV cache;
+        # every token before it was — cache exactly those full blocks
+        cached = req.prompt + req.generated[:-1]
+        adopted, evicted = self._prefix.insert(cached, blocks, self._evictable)
+        if evicted:
+            self._allocator.free(evicted)
+        rest = [b for b in blocks if b not in adopted]
+        if rest:
+            self._allocator.free(rest)
+        return len(evicted)
+
+    def _cow_shared_writes(self, slot: int, start: int, n: int) -> None:
+        """Copy-on-write guard for the position range ``[start, start+n)``
+        of ``slot``: any page the write would touch that is still shared
+        (refcount > 1) is replaced by a freshly allocated copy and the
+        block-table entry swapped, so shared pages are only ever READ.
+        By construction the admission path never maps a to-be-written block
+        to a shared page, so this is an invariant net, not a hot path."""
+        if n < 1 or self._allocator is None:
+            return
+        bs = self.kv_block_size
+        lo = max(0, start // bs)
+        # decode overshoot past the table scatters into page 0 — no COW
+        hi = min((start + n - 1) // bs, self.max_blocks_per_slot - 1)
+        for bidx in range(lo, hi + 1):
+            with self._lock:
+                old = int(self._block_tables[slot, bidx])
+                if old == 0 or self._allocator.refcount(old) <= 1:
+                    continue
+                if self._allocator.free_blocks < 1 and self._prefix is not None:
+                    evicted = self._prefix.evict(1, self._evictable)
+                    if evicted:
+                        self._allocator.free(evicted)
+                new = self._allocator.alloc(1)[0]  # typed shed if truly none
+            # the old page holds >= 2 refs (ours included) so it cannot be
+            # reallocated while the device copy reads it
+            self._cache = self._copy_page(self._cache, jnp.int32(old), jnp.int32(new))
+            with self._lock:
+                bl = self._slot_blocks[slot]
+                bl[bl.index(old)] = new
+                self._block_tables[slot, bidx] = new
+                self._allocator.free([old])
+                self._cow_count += 1
 
     def _prefill_tick(self) -> bool:
         """Advance the head prefilling request by one chunk. Returns True if
@@ -947,19 +1106,25 @@ class LLMEngine:
             if not self._prefilling:
                 return False
             req = self._prefilling[0]
-            in_use = self._allocator.used_blocks
-        metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+            gauges = self._pool_gauges_locked()
+        self._publish_pool_gauges(*gauges)
         tp = len(req.prompt)
         start = req.prefill_pos
         chunk = self.prefill_chunk_tokens
-        width = min(chunk, self.S) if chunk > 0 else _bucket(tp, cap=self.S)
+        # one-shot width buckets the UNCACHED suffix, not the whole prompt:
+        # a warm request's TTFT is proportional to what it actually computes
+        width = min(chunk, self.S) if chunk > 0 else _bucket(tp - start, cap=self.S)
         n = min(width, tp - start)
         toks = np.zeros((1, width), np.int32)
         toks[0, :n] = req.prompt[start : start + n]
-        bt = jnp.asarray(self._block_tables[req.slot : req.slot + 1])
         stalled = bool(self._active.any())
         t0 = time.perf_counter()
         try:
+            # invariant net: admission never maps a to-be-written block to a
+            # shared page (the full-hit tail is COW'd eagerly), but writes
+            # must still never land on refcount > 1 pages
+            self._cow_shared_writes(req.slot, start, n)
+            bt = jnp.asarray(self._block_tables[req.slot : req.slot + 1])
             logits, self._cache = self._prefill_chunk(
                 self.params, self._cache, jnp.asarray(toks), bt,
                 jnp.int32(start), jnp.int32(n),
@@ -983,13 +1148,6 @@ class LLMEngine:
             self._prefilling.pop(0)
             self._prefill_count += 1
         try:
-            if self._prefill_cache_size:
-                bt_row = jnp.asarray(self._block_tables[req.slot : req.slot + 1])
-                row = self._extract_row_paged(self._cache, bt_row)
-                with self._lock:
-                    self._prefill_cache[tuple(req.prompt)] = (logits, row)
-                    while len(self._prefill_cache) > self._prefill_cache_size:
-                        self._prefill_cache.popitem(last=False)
             self._finish_prefill(req, logits)
         except BaseException as exc:  # noqa: BLE001
             self._fail_admit(req, exc)
@@ -1000,14 +1158,17 @@ class LLMEngine:
             req.eos_id is not None and tok == req.eos_id
         )
         if done:
+            evicted_n = 0
             with self._lock:
                 self._active[req.slot] = False
                 self._slots[req.slot] = None
                 if self._allocator is not None:
-                    self._release_blocks_locked(req.slot)
-                    in_use = self._allocator.used_blocks
+                    evicted_n = self._retire_blocks_locked(req)
+                    gauges = self._pool_gauges_locked()
             if self._allocator is not None:
-                metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+                if evicted_n:
+                    metric_defs.LLM_PREFIX_EVICTIONS.inc(evicted_n)
+                self._publish_pool_gauges(*gauges)
             req.future.set_result(req.generated)
             if req.stream_queue is not None:
                 req.stream_queue.put(_STREAM_END)
@@ -1017,6 +1178,12 @@ class LLMEngine:
         toks = jnp.asarray(self._last_tok)
         pos = jnp.asarray(self._pos)
         if self.cache_kind == "paged":
+            # copy-on-write net: a decode chunk writes positions
+            # [pos, pos + K) — if any of those blocks still maps to a
+            # shared page, give the slot its own copy before stepping
+            for i in range(self.B):
+                if self._active[i]:
+                    self._cow_shared_writes(i, int(self._pos[i]), self.decode_chunk)
             # inactive rows decode through all-zero tables -> garbage page 0,
             # so freed pages are never written after release
             bt = jnp.asarray(self._block_tables * self._active[:, None].astype(np.int32))
@@ -1074,9 +1241,16 @@ class LLMEngine:
             if self._allocator is not None:
                 for i in range(self.B):
                     self._release_blocks_locked(i)
+                if self._prefix is not None:
+                    # the device pool is about to be re-initialized; cached
+                    # page CONTENTS die with it, so the index must too —
+                    # drop every node and its reference unconditionally
+                    stale = self._prefix.drain()
+                    if stale:
+                        self._allocator.free(stale)
         metric_defs.ADMISSION_QUEUE_DEPTH.set(0, self._depth_tags)
         if self._allocator is not None:
-            metric_defs.LLM_KV_BLOCKS_IN_USE.set(0, self._depth_tags)
+            self._publish_pool_gauges(0, 0, 0)
         for r in victims:
             if not r.future.done():
                 r.future.set_exception(error)
@@ -1098,9 +1272,9 @@ class LLMEngine:
                 self._active[i] = False
                 if self._allocator is not None:
                     self._release_blocks_locked(i)
-            in_use = self._allocator.used_blocks if self._allocator is not None else 0
+            gauges = self._pool_gauges_locked()
         if victims and self._allocator is not None:
-            metric_defs.LLM_KV_BLOCKS_IN_USE.set(in_use, self._depth_tags)
+            self._publish_pool_gauges(*gauges)
         for _, r in victims:
             self.num_slots_evicted += 1
             metric_defs.LLM_SLOTS_EVICTED.inc(tags=_EVICT_DISCONNECT_TAGS)
@@ -1156,7 +1330,6 @@ class LLMServer:
         mesh: Optional[Any] = None,
         tp: str = "tp",
         decode_chunk: int = 1,
-        prefill_cache_size: int = 0,
         max_queued_requests: int = 256,
         max_queued_prefill_tokens: int = 0,
         tenant_weights: Optional[Dict[str, float]] = None,
@@ -1164,6 +1337,8 @@ class LLMServer:
         kv_block_size: Optional[int] = None,
         kv_num_blocks: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
+        prefix_cache_max_blocks: Optional[int] = None,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
@@ -1179,7 +1354,6 @@ class LLMServer:
             mesh=mesh,
             tp=tp,
             decode_chunk=decode_chunk,
-            prefill_cache_size=prefill_cache_size,
             max_queued_requests=max_queued_requests,
             max_queued_prefill_tokens=max_queued_prefill_tokens,
             tenant_weights=tenant_weights,
@@ -1187,6 +1361,8 @@ class LLMServer:
             kv_block_size=kv_block_size,
             kv_num_blocks=kv_num_blocks,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            prefix_cache=prefix_cache,
+            prefix_cache_max_blocks=prefix_cache_max_blocks,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:

@@ -398,3 +398,49 @@ def test_preserve_order_reorders_skewed_completions():
     # box serializes the tasks)
     rows = [r["id"] for r in ds.map_batches(slow_first, batch_format="numpy").take_all()]
     assert sorted(rows) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# hash-partition determinism (shuffle.py used salted hash() before: the SAME
+# key could land in DIFFERENT reduce partitions across worker processes)
+# ---------------------------------------------------------------------------
+def test_partition_by_hash_stable_across_processes():
+    """Partition assignment must be identical in processes with different
+    hash salts — no PYTHONHASHSEED pinning anywhere."""
+    import json
+    import subprocess
+    import sys
+
+    code = (
+        "import json, sys\n"
+        "from ray_tpu.data.shuffle import _stable_key_hash\n"
+        "keys = ['alpha', 'beta', '\\u03b4elta', b'raw', 2, 2.0, True, 2.5,"
+        " -7, None, ('t', 1)]\n"
+        "print(json.dumps([_stable_key_hash(k) % 8 for k in keys]))\n"
+    )
+
+    def run(seed):
+        env = dict(os.environ, PYTHONHASHSEED=str(seed), JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        return json.loads(out.stdout)
+
+    a, b = run(1), run(4242)
+    assert a == b
+    # numeric keys hash by VALUE, like dict keys: 2 == 2.0 == True
+    assert a[4] == a[5] == a[6]
+    assert a[7] != a[4] or a[8] != a[4]  # non-integral/other values may differ
+
+
+def test_groupby_string_keys_one_group_per_key():
+    """Multi-process hash groupby over string keys: every key reduces in
+    exactly ONE partition (the salted-hash bug split a key's rows across
+    partitions, yielding duplicate groups with partial sums)."""
+    items = [{"k": f"key-{i % 5}", "v": 1} for i in range(200)]
+    ds = rd.from_items(items, parallelism=8)
+    rows = ds.groupby("k").sum("v").take_all()
+    assert len(rows) == 5, rows  # one group per key, never split
+    assert {r["k"]: r["sum(v)"] for r in rows} == {
+        f"key-{i}": 40 for i in range(5)
+    }

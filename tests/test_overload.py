@@ -213,8 +213,12 @@ def test_engine_deadline_expired_while_queued_never_takes_slot():
             doomed.result(timeout=120)
         blocker.result(timeout=120)
         assert eng.stats()["shed"] >= 1
-        # the shed request never reserved KV pages; the finished blocker
-        # returned its own — the pool drains back to empty
+        # the shed request never reserved KV pages; the finished blocker's
+        # pages are all accounted for by the prefix cache and a flush
+        # drains the pool back to empty
+        st = eng.stats()
+        assert st["kv_blocks_in_use"] == st["prefix_cache_blocks"]
+        eng.flush_prefix_cache()
         assert eng.stats()["kv_blocks_in_use"] == 0
     finally:
         eng.shutdown()
@@ -282,6 +286,9 @@ def test_engine_abandoned_queued_stream_never_admits():
         assert stats["shed"] >= 1
         blocker.result(timeout=120)
         assert eng.stats()["active_slots"] == 0
+        st = eng.stats()
+        assert st["kv_blocks_in_use"] == st["prefix_cache_blocks"]
+        eng.flush_prefix_cache()
         assert eng.stats()["kv_blocks_in_use"] == 0
     finally:
         eng.shutdown()

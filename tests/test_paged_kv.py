@@ -48,6 +48,16 @@ def _wait(pred, timeout=60):
     assert pred()
 
 
+def _assert_no_leak(eng):
+    """Quiesced-engine leak check under prefix caching: every held page is
+    accounted for by the prefix cache, and flushing it empties the pool."""
+    st = eng.stats()
+    assert st["kv_blocks_in_use"] == st["prefix_cache_blocks"]
+    eng.flush_prefix_cache()
+    st = eng.stats()
+    assert st["kv_blocks_in_use"] == 0 and st["prefix_cache_blocks"] == 0
+
+
 # --------------------------------------------------------------------------
 # BlockAllocator
 # --------------------------------------------------------------------------
@@ -162,7 +172,7 @@ def test_paged_engine_token_identical_to_dense(params):
         got = [f.result(timeout=120) for f in
                [paged.submit(p, max_tokens=8) for p in PROMPTS]]
         assert got == ref
-        assert paged.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(paged)
     finally:
         dense.shutdown()
         paged.shutdown()
@@ -178,23 +188,28 @@ def test_chunked_prefill_token_identical_to_one_shot(params, chunk):
         got = [f.result(timeout=120) for f in
                [chunked.submit(p, max_tokens=8) for p in PROMPTS]]
         assert got == ref
-        st = chunked.stats()
-        assert st["kv_blocks_in_use"] == 0
-        assert st["prefill_chunks"] >= len(PROMPTS)
+        assert chunked.stats()["prefill_chunks"] >= len(PROMPTS)
+        _assert_no_leak(chunked)
     finally:
         oneshot.shutdown()
         chunked.shutdown()
 
 
-def test_paged_prefill_memo_skips_forward(params):
-    eng = _paged(params, prefill_cache_size=2)
+def test_paged_prefix_reuse_token_identical(params):
+    """A repeated prompt admits through the prefix cache: the warm run reuses
+    every full prompt block (plus COW on the tail) and the tokens match the
+    cold run bit-for-bit."""
+    eng = _paged(params, kv_block_size=8)
     try:
-        a = eng.generate([5, 4, 3, 2, 1], max_tokens=6)
-        assert eng.stats()["prefill_forwards"] == 1
-        b = eng.generate([5, 4, 3, 2, 1], max_tokens=6)
-        assert eng.stats()["prefill_forwards"] == 1  # memo hit, no forward
+        prompt = list(range(40, 7, -1))  # 33 tokens -> 4 full blocks of 8
+        a = eng.generate(prompt, max_tokens=6)
+        assert eng.stats()["prefix_cache_misses"] == 1
+        b = eng.generate(prompt, max_tokens=6)
         assert a == b
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        st = eng.stats()
+        assert st["prefix_cache_hits"] == 1
+        assert st["prefix_tokens_reused"] >= 32
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -230,7 +245,7 @@ def test_blocks_released_on_finish_and_eos(params):
         out = eng.generate([4, 5, 6], max_tokens=8)
         eos = out[2]
         eng.generate([4, 5, 6], max_tokens=8, eos_id=eos)  # early eos stop
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -247,7 +262,7 @@ def test_blocks_released_on_disconnect_evict(params):
         _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
         # the freed pages still serve new work
         assert len(eng.generate([4, 2], max_tokens=3)) == 3
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -261,7 +276,7 @@ def test_blocks_released_on_deadline_shed(params):
         with pytest.raises(DeadlineExceededError):
             doomed.result(timeout=120)
         blocker.result(timeout=120)
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -280,7 +295,7 @@ def test_blocks_released_on_prefill_crash(params):
         eng._prefill_chunk = real
         # pool intact: the engine keeps serving
         assert len(eng.generate([1, 2, 3], max_tokens=3)) == 3
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -299,7 +314,7 @@ def test_blocks_released_on_loop_crash(params):
         eng._decode_k_paged = real
         # _fail_inflight + _reset_cache recovered the engine
         assert len(eng.generate([1, 2, 3], max_tokens=3)) == 3
-        assert eng.stats()["kv_blocks_in_use"] == 0
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 
@@ -315,7 +330,9 @@ def test_head_of_line_waits_for_blocks_no_leak(params):
         _wait(lambda: eng.admission_snapshot()["waiting_for_blocks"] == 1)
         assert len(a.result(timeout=120)) == 20
         assert len(b.result(timeout=120)) == 20
-        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"]
+              == eng.stats()["prefix_cache_blocks"])
+        _assert_no_leak(eng)
     finally:
         eng.shutdown()
 

@@ -1,0 +1,476 @@
+"""Prefix-aware KV reuse tests.
+
+Four contracts:
+- PrefixCache unit: hash-chain keys are process-stable and unambiguous,
+  match/insert/evict round-trip pages, eviction is LRU over unreferenced
+  leaves with a deterministic (last_used, seq) order, max_blocks is honored
+  without ever evicting the chain being inserted
+- allocator refcounts: share() pins pages, free() releases one reference,
+  pages return to the pool only at zero, and misuse (double free, sharing a
+  free page or the garbage page) raises instead of corrupting the pool
+- engine identity: warm runs (full hit + COW, partial hit, chunked prefill
+  resuming mid-prompt, divergent suffixes off a shared prefix) are
+  token-identical to the dense reference under greedy decoding
+- leak + determinism: every release path under ACTIVE sharing returns the
+  request's references (pool == cache after quiesce, flush drains both),
+  loop crash invalidates the whole cache, and the same workload on a
+  bounded cache evicts the same pages in the same order
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import TransformerConfig, init_params
+from ray_tpu.serve.kv_blocks import BlockAllocator
+from ray_tpu.serve.llm import LLMEngine
+from ray_tpu.serve.prefix_cache import PrefixCache, chain_key, _ROOT
+
+CFG = TransformerConfig(
+    vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+    attention="dense", dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(11))
+
+
+def _paged(params, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(CFG, params, cache_kind="paged", **kw)
+
+
+def _dense(params, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(CFG, params, cache_kind="dense", **kw)
+
+
+def _wait(pred, timeout=60):
+    deadline = time.time() + timeout
+    while not pred() and time.time() < deadline:
+        time.sleep(0.005)
+    assert pred()
+
+
+def _assert_no_leak(eng):
+    st = eng.stats()
+    assert st["kv_blocks_in_use"] == st["prefix_cache_blocks"]
+    eng.flush_prefix_cache()
+    st = eng.stats()
+    assert st["kv_blocks_in_use"] == 0 and st["prefix_cache_blocks"] == 0
+
+
+# --------------------------------------------------------------------------
+# chain keys
+# --------------------------------------------------------------------------
+def test_chain_key_stable_and_unambiguous():
+    # fixed-width encoding: [1, 23] and [12, 3] must not collide
+    assert chain_key(_ROOT, [1, 23]) != chain_key(_ROOT, [12, 3])
+    # same inputs, same digest (no per-process salt)
+    assert chain_key(_ROOT, [7, 8, 9]) == chain_key(_ROOT, [7, 8, 9])
+    # chained: depends on the parent
+    k1 = chain_key(_ROOT, [1, 2])
+    assert chain_key(k1, [3, 4]) != chain_key(_ROOT, [3, 4])
+    # negative token ids encode without error
+    assert chain_key(_ROOT, [-1]) != chain_key(_ROOT, [1])
+
+
+# --------------------------------------------------------------------------
+# PrefixCache unit
+# --------------------------------------------------------------------------
+def test_match_insert_roundtrip():
+    pc = PrefixCache(block_size=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8, 9]  # 2 full blocks + 1 partial token
+    adopted, evicted = pc.insert(toks, [10, 11], lambda p: True)
+    assert adopted == {10, 11} and evicted == []
+    assert len(pc) == 2
+    pages, n = pc.match(toks)
+    assert pages == [10, 11] and n == 8
+    # longer prompt with the same prefix matches the shared chain
+    pages, n = pc.match(toks[:8] + [40, 41, 42, 43])
+    assert pages == [10, 11] and n == 8
+    # diverging second block matches only the first
+    pages, n = pc.match([1, 2, 3, 4, 9, 9, 9, 9])
+    assert pages == [10] and n == 4
+    # no full block -> no match
+    assert pc.match([1, 2, 3]) == ([], 0)
+
+
+def test_insert_adopts_only_new_blocks():
+    pc = PrefixCache(block_size=2)
+    a1, _ = pc.insert([1, 2, 3, 4], [5, 6], lambda p: True)
+    assert a1 == {5, 6}
+    # re-inserting the same chain with different pages adopts nothing:
+    # the caller keeps (and frees) its duplicates
+    a2, _ = pc.insert([1, 2, 3, 4, 9, 9], [7, 8, 9], lambda p: True)
+    assert a2 == {9}
+    pages, n = pc.match([1, 2, 3, 4])
+    assert pages == [5, 6] and n == 4
+
+
+def test_evict_is_lru_over_unreferenced_leaves():
+    pc = PrefixCache(block_size=1)
+    pc.insert([1], [11], lambda p: True)
+    pc.insert([2], [12], lambda p: True)
+    pc.insert([3], [13], lambda p: True)
+    pc.match([1])  # chain [1] is now the most recently used
+    # LRU order: [2] then [3] then [1]
+    assert pc.evict(2, lambda p: True) == [12, 13]
+    assert pc.evict(5, lambda p: True) == [11]
+    assert len(pc) == 0 and pc.evictions == 3
+
+
+def test_evict_skips_shared_pages_and_interior_nodes():
+    pc = PrefixCache(block_size=1)
+    pc.insert([1, 2], [11, 12], lambda p: True)  # chain: 11 -> 12
+    # interior node 11 is not a leaf; leaf 12 is "shared" (not evictable)
+    assert pc.evict(2, lambda p: p != 12) == []
+    assert len(pc) == 2
+    # once the leaf is droppable, the sweep cascades up the cold chain
+    assert pc.evict(2, lambda p: True) == [12, 11]
+
+
+def test_insert_at_bound_never_evicts_own_chain():
+    pc = PrefixCache(block_size=1, max_blocks=2)
+    pc.insert([1], [11], lambda p: True)
+    # a 3-deep chain at bound 2: the chain being built is protected, so the
+    # sweep takes the cold [1] entry, then stops adopting when nothing else
+    # is evictable — never stranding a mid-chain node
+    adopted, evicted = pc.insert([5, 6, 7], [21, 22, 23], lambda p: True)
+    assert evicted == [11]
+    assert adopted == {21, 22}  # third block did not fit; chain intact
+    pages, n = pc.match([5, 6, 7])
+    assert pages == [21, 22] and n == 2
+
+
+def test_drain_returns_every_page_regardless_of_sharing():
+    pc = PrefixCache(block_size=1)
+    pc.insert([1, 2, 3], [11, 12, 13], lambda p: True)
+    assert sorted(pc.drain()) == [11, 12, 13]
+    assert len(pc) == 0
+    assert pc.match([1]) == ([], 0)
+
+
+def test_cache_eviction_deterministic_across_instances():
+    """Same workload, two fresh caches: identical surviving keys and
+    identical eviction order (acceptance: same workload -> same evicted
+    pages)."""
+    def run():
+        pc = PrefixCache(block_size=2, max_blocks=3)
+        order = []
+        for toks in ([1, 2, 3, 4], [5, 6], [7, 8, 9, 10], [1, 2, 11, 12]):
+            _, ev = pc.insert(toks, list(range(20, 20 + len(toks) // 2)),
+                              lambda p: True)
+            order += ev
+        pc.match([5, 6])
+        order += pc.evict(2, lambda p: True)
+        return order, sorted(pc.keys())
+
+    assert run() == run()
+
+
+# --------------------------------------------------------------------------
+# allocator refcounts
+# --------------------------------------------------------------------------
+def test_allocator_share_and_refcounts():
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    assert all(a.refcount(b) == 1 for b in got) and a.shared_blocks == 0
+    a.share(got)
+    assert all(a.refcount(b) == 2 for b in got) and a.shared_blocks == 2
+    a.free(got)  # one reference down: pages still held
+    assert a.used_blocks == 2 and all(a.refcount(b) == 1 for b in got)
+    assert a.shared_blocks == 0
+    a.free(got)  # last reference: pages return to the pool
+    assert a.used_blocks == 0 and a.free_blocks == 5
+    assert a.refcount(got[0]) == 0
+
+
+def test_allocator_share_misuse_raises_and_is_atomic():
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    with pytest.raises(ValueError):
+        a.share([0])  # the garbage page is never shared
+    with pytest.raises(ValueError):
+        a.share([got[0], 99])  # 99 is not held
+    # atomic: the failed share must not have bumped got[0]
+    assert a.refcount(got[0]) == 1
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.share(got)  # sharing a freed page
+    with pytest.raises(ValueError):
+        a.free(got)  # double free
+    assert a.free_blocks == 5
+
+
+# --------------------------------------------------------------------------
+# engine: warm-path token identity
+# --------------------------------------------------------------------------
+def test_full_hit_cow_token_identical_to_dense(params):
+    eng = _paged(params, kv_block_size=8)
+    ref = _dense(params)
+    try:
+        p = list(range(1, 25))  # 24 tokens = 3 full blocks
+        want6 = ref.generate(p, max_tokens=6)
+        want10 = ref.generate(p, max_tokens=10)
+        assert eng.generate(p, max_tokens=6) == want6  # cold
+        # warm, different generation length: full hit + COW on the tail block
+        assert eng.generate(p, max_tokens=10) == want10
+        st = eng.stats()
+        assert st["prefix_cache_hits"] >= 1 and st["cow_copies"] >= 1
+        assert st["prefix_tokens_reused"] >= 23
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+        ref.shutdown()
+
+
+def test_divergent_suffixes_share_prefix_blocks(params):
+    eng = _paged(params, kv_block_size=8)
+    ref = _dense(params)
+    try:
+        base = list(range(30, 46))  # 16 tokens = 2 full blocks
+        p1, p2 = base + [5, 6, 7], base + [8, 9]
+        assert eng.generate(p1, max_tokens=5) == ref.generate(p1, max_tokens=5)
+        assert eng.generate(p2, max_tokens=5) == ref.generate(p2, max_tokens=5)
+        st = eng.stats()
+        # p2 reused base's two blocks without COW (its suffix diverges)
+        assert st["prefix_cache_hits"] + st["prefix_cache_partial"] >= 1
+        assert st["prefix_tokens_reused"] >= 16
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+        ref.shutdown()
+
+
+@pytest.mark.parametrize("chunk", [7, 8, 16])
+def test_chunked_prefill_resumes_at_first_uncached_token(params, chunk):
+    """Chunked prefill x cache hit: the warm run starts prefill mid-prompt
+    (at the first uncached token) and still produces the dense tokens."""
+    eng = _paged(params, kv_block_size=8, prefill_chunk_tokens=chunk)
+    ref = _dense(params)
+    try:
+        p = list(range(1, 31))  # 30 tokens
+        want = ref.generate(p, max_tokens=5)
+        assert eng.generate(p, max_tokens=5) == want
+        chunks_cold = eng.stats()["prefill_chunks"]
+        assert eng.generate(p, max_tokens=5) == want
+        st = eng.stats()
+        # warm prefill only covered the uncached tail: fewer chunks than cold
+        assert st["prefill_chunks"] - chunks_cold < chunks_cold
+        assert st["prefix_cache_hits"] >= 1
+        # an EXTENDED prompt diverges inside the cached completion's block:
+        # a PARTIAL hit that resumes after the shared full blocks
+        p2 = p + [60, 61, 62]
+        assert eng.generate(p2, max_tokens=5) == ref.generate(p2, max_tokens=5)
+        assert eng.stats()["prefix_cache_partial"] >= 1
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+        ref.shutdown()
+
+
+def test_shared_pages_visible_while_request_live(params):
+    """While a warm request decodes, the matched pages carry two references
+    (cache + block table) and show up in kv_blocks_shared; disconnect-evict
+    mid-decode drops only the request's reference."""
+    eng = _paged(params, kv_block_size=8)
+    try:
+        p = list(range(1, 18))  # 2 full blocks
+        eng.generate(p, max_tokens=3)  # populate the cache
+        cached = eng.stats()["prefix_cache_blocks"]
+        assert cached >= 2
+        stream = eng.submit_stream(p, max_tokens=40)
+        next(stream)
+        assert eng.stats()["kv_blocks_shared"] >= 2
+        stream.close()  # evict mid-decode while sharing is active
+        _wait(lambda: eng.stats()["active_slots"] == 0)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"]
+              == eng.stats()["prefix_cache_blocks"])
+        assert eng.stats()["kv_blocks_shared"] == 0
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# engine: release paths under active sharing
+# --------------------------------------------------------------------------
+def test_blocks_released_on_deadline_shed_with_warm_cache(params):
+    eng = _paged(params, max_batch_size=1, kv_block_size=8)
+    try:
+        p = list(range(1, 18))
+        eng.generate(p, max_tokens=3)  # warm
+        blocker = eng.submit(p, max_tokens=40)  # warm admit, shares pages
+        doomed = eng.submit(p, max_tokens=2, deadline_ts=time.time() + 0.05)
+        from ray_tpu.exceptions import DeadlineExceededError
+
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=120)
+        blocker.result(timeout=120)
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_disconnect_mid_prefill_under_sharing(params):
+    eng = _paged(params, kv_block_size=8, prefill_chunk_tokens=8)
+    try:
+        p = list(range(1, 25))
+        eng.generate(p, max_tokens=3)  # warm: 3+ blocks cached
+        entered = threading.Event()
+        real = eng._prefill_chunk
+
+        def slow(*a, **k):
+            entered.set()
+            time.sleep(0.1)
+            return real(*a, **k)
+
+        eng._prefill_chunk = slow
+        # partial hit + a 12-token uncached suffix -> at least 2 chunks
+        stream = eng.submit_stream(p + list(range(50, 62)), max_tokens=20)
+        assert entered.wait(timeout=60)
+        stream.close()  # abandon while its prefill is still running
+        _wait(lambda: eng.stats()["active_slots"] == 0
+              and eng.stats()["prefilling"] == 0
+              and eng.stats()["queued"] == 0)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"]
+              == eng.stats()["prefix_cache_blocks"])
+        eng._prefill_chunk = real
+        # the pool still serves warm traffic afterwards
+        assert len(eng.generate(p, max_tokens=3)) == 3
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+def test_loop_crash_invalidates_whole_cache(params):
+    """After _fail_inflight resets the device pool, every cached page's
+    contents are gone — the index must drain with them, and the next warm
+    prompt is a MISS that still decodes correctly."""
+    eng = _paged(params, kv_block_size=8)
+    try:
+        p = list(range(1, 18))
+        want = eng.generate(p, max_tokens=4)
+        assert eng.stats()["prefix_cache_blocks"] > 0
+        real = eng._decode_k_paged
+        eng._decode_k_paged = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected decode fault")
+        )
+        with pytest.raises(RuntimeError):
+            eng.submit(p, max_tokens=8).result(timeout=120)
+        _wait(lambda: eng.stats()["kv_blocks_in_use"] == 0)
+        assert eng.stats()["prefix_cache_blocks"] == 0  # drained, not leaked
+        eng._decode_k_paged = real
+        misses = eng.stats()["prefix_cache_misses"]
+        assert eng.generate(p, max_tokens=4) == want  # recomputed, identical
+        assert eng.stats()["prefix_cache_misses"] == misses + 1
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+def test_shutdown_with_populated_cache(params):
+    eng = _paged(params, kv_block_size=8)
+    eng.generate(list(range(1, 18)), max_tokens=3)
+    assert eng.stats()["prefix_cache_blocks"] > 0
+    eng.shutdown()  # must not raise; gauges zeroed with pages still cached
+
+
+# --------------------------------------------------------------------------
+# engine: pool pressure + determinism
+# --------------------------------------------------------------------------
+def test_pool_short_admission_evicts_cache_before_holding(params):
+    eng = _paged(params, max_batch_size=1, kv_num_blocks=5)  # 4 usable
+    try:
+        assert len(eng.generate([1] * 40, max_tokens=20)) == 20
+        assert eng.stats()["prefix_cache_blocks"] == 3  # 59 tokens, bs=16
+        # a different prompt needs all 4 pages: admission LRU-sweeps the
+        # cache instead of holding (no other request will ever free pages)
+        assert len(eng.generate([2] * 40, max_tokens=20)) == 20
+        assert eng.stats()["prefix_evictions"] >= 3
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+def test_never_fitting_prompt_rejected_with_cache_populated(params):
+    eng = _paged(params, kv_num_blocks=4)  # 3 usable blocks
+    try:
+        eng.generate(list(range(1, 18)), max_tokens=2)  # caches 1 block
+        with pytest.raises(ValueError, match="never be admitted"):
+            eng.submit([1] * 40, max_tokens=20)  # needs 4 > 3 total
+        _assert_no_leak(eng)
+    finally:
+        eng.shutdown()
+
+
+def test_pool_exhaustion_shed_is_typed_with_retry_hint():
+    from ray_tpu.exceptions import OverloadedError
+
+    a = BlockAllocator(4)
+    held = a.alloc(2)
+    a.share(held)  # sharing must not change the exhaustion contract
+    with pytest.raises(OverloadedError) as exc:
+        a.alloc(2)
+    assert exc.value.layer == "engine" and exc.value.reason == "kv_blocks"
+    assert exc.value.retry_after_s > 0
+    a.free(held)
+    a.free(held)
+    assert a.free_blocks == 3
+
+
+def test_engine_eviction_deterministic_across_runs(params):
+    """Same workload on a bounded cache, twice: identical surviving chain
+    keys, identical eviction and hit counters."""
+    prompts = [list(range(1, 18)), list(range(40, 57)),
+               list(range(1, 22)), list(range(60, 77))]
+
+    def run():
+        eng = _paged(params, kv_block_size=8, prefix_cache_max_blocks=4)
+        try:
+            for p in prompts:
+                eng.generate(p, max_tokens=3)
+            st = eng.stats()
+            return (sorted(eng._prefix.keys()), st["prefix_evictions"],
+                    st["prefix_cache_hits"], st["prefix_cache_partial"],
+                    st["prefix_cache_misses"])
+        finally:
+            eng.shutdown()
+
+    assert run() == run()
+
+
+def test_prefix_metric_families_registered(params):
+    from ray_tpu.observability import metric_defs
+    from ray_tpu.runtime import admission
+
+    names = {m.name for m in metric_defs.ALL_METRICS}
+    for family in (
+        "llm_prefix_cache_hits_total",
+        "llm_prefix_cache_blocks",
+        "llm_kv_blocks_shared",
+        "llm_prefix_evictions_total",
+    ):
+        assert family in names
+    eng = _paged(params, kv_block_size=8)
+    try:
+        p = list(range(1, 18))
+        eng.generate(p, max_tokens=3)
+        eng.generate(p, max_tokens=3)
+        snap = [s for s in admission.sources_snapshot()
+                if s.get("layer") == "engine"][-1]
+        assert snap["prefix_cache_enabled"] is True
+        assert snap["prefix_cache_blocks"] >= 2
+        assert 0.0 < snap["prefix_hit_rate"] <= 1.0
+        assert snap["prefix_tokens_reused"] >= 16
+    finally:
+        eng.shutdown()

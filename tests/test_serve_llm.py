@@ -681,73 +681,70 @@ def test_openai_multi_token_stop_trims_token_ids_too(oai, params):
 
 
 # ---------------------------------------------------------------------------
-# prompt prefill memo (prefill_cache_size; beyond reference parity)
+# prefix-aware KV reuse (serve/prefix_cache.py; beyond reference parity)
 # ---------------------------------------------------------------------------
-def test_prefill_cache_skips_repeat_prompts(params):
+def test_prefix_cache_reuses_repeat_prompt_blocks(params):
+    """A repeated prompt's full blocks come out of the prefix cache: the
+    warm run reuses KV (prefix_tokens_reused grows) and is token-identical
+    to the cold run under greedy decoding."""
     eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
-                    prefill_cache_size=2)
+                    kv_block_size=8)
     try:
-        prompt = [3, 14, 15, 9, 2]
+        prompt = list(range(1, 18))  # 17 tokens -> 2 full blocks of 8
         want = _reference(params, prompt, 5)
         assert eng.generate(prompt, max_tokens=5) == want
-        n1 = eng.stats()["prefill_forwards"]
-        # identical prompt again: NO new prefill forward, same output
+        st = eng.stats()
+        assert st["prefix_cache_misses"] == 1 and st["prefix_cache_blocks"] > 0
         assert eng.generate(prompt, max_tokens=5) == want
-        assert eng.stats()["prefill_forwards"] == n1
-        # a different prompt does prefill (and still decodes correctly)
+        st = eng.stats()
+        assert st["prefix_cache_hits"] == 1
+        assert st["prefix_tokens_reused"] >= 16  # both full blocks skipped
+        # a different prompt is a miss and still decodes correctly
         other = [7, 8, 9]
         assert eng.generate(other, max_tokens=4) == _reference(params, other, 4)
-        assert eng.stats()["prefill_forwards"] == n1 + 1
+        assert eng.stats()["prefix_cache_misses"] == 2
     finally:
         eng.shutdown()
 
 
-def test_prefill_cache_lru_evicts(params):
-    eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
-                    prefill_cache_size=1)
-    try:
-        a, b = [1, 2, 3], [4, 5, 6]
-        ra, rb = _reference(params, a, 3), _reference(params, b, 3)
-        assert eng.generate(a, max_tokens=3) == ra   # prefill a (cached)
-        assert eng.generate(b, max_tokens=3) == rb   # prefill b, evicts a
-        n = eng.stats()["prefill_forwards"]
-        assert eng.stats()["prefill_cache_entries"] == 1
-        assert eng.generate(a, max_tokens=3) == ra   # a evicted -> re-prefills
-        assert eng.stats()["prefill_forwards"] == n + 1
-    finally:
-        eng.shutdown()
-
-
-def test_prefill_cache_off_by_default(params):
+def test_prefix_cache_on_by_default_and_disable_knob(params):
     eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64)
+    off = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
+                    prefix_cache=False)
     try:
-        p = [2, 3]
-        eng.generate(p, max_tokens=2)
-        eng.generate(p, max_tokens=2)
-        assert eng.stats()["prefill_forwards"] == 2
-        assert eng.stats()["prefill_cache_entries"] == 0
+        assert eng.stats()["prefix_cache_enabled"] is True
+        assert off.stats()["prefix_cache_enabled"] is False
+        p = list(range(1, 20))
+        want = _reference(params, p, 3)
+        for e in (eng, off):
+            assert e.generate(p, max_tokens=3) == want
+            assert e.generate(p, max_tokens=3) == want
+        # disabled: nothing retained, every page back in the pool
+        st = off.stats()
+        assert st["prefix_cache_blocks"] == 0 and st["kv_blocks_in_use"] == 0
+        assert st["prefix_cache_hits"] == 0
     finally:
         eng.shutdown()
+        off.shutdown()
 
 
-def test_tp_engine_with_chunked_decode_and_prefill_cache(params):
-    """decode_chunk and prefill_cache compose with tensor-parallel serving:
-    the sharded scan program produces the single-device engine's tokens and
-    repeated prompts skip prefill on the mesh path too."""
+def test_tp_engine_with_chunked_decode(params):
+    """decode_chunk composes with tensor-parallel serving: the sharded scan
+    program produces the single-device engine's tokens (mesh engines run
+    the dense cache, so prefix reuse does not apply there)."""
     if len(jax.devices()) < 2:
         pytest.skip("needs virtual devices")
     from jax.sharding import Mesh
 
     mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
     eng = LLMEngine(CFG, params, max_batch_size=2, max_seq_len=64,
-                    mesh=mesh, decode_chunk=3, prefill_cache_size=2)
+                    mesh=mesh, decode_chunk=3)
     try:
+        assert eng.stats()["prefix_cache_enabled"] is False  # dense fallback
         prompt = [3, 14, 15, 9, 2]
         want = _reference(params, prompt, 7)
         assert eng.generate(prompt, max_tokens=7) == want
-        n = eng.stats()["prefill_forwards"]
         assert eng.generate(prompt, max_tokens=7) == want
-        assert eng.stats()["prefill_forwards"] == n  # memo hit on the mesh path
     finally:
         eng.shutdown()
 
