@@ -300,6 +300,22 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — MFU must not sink the suite
         extra["model_train_step"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # the LLM rows' engine-side SLO sketches (TTFT / inter-token /
+    # queue-wait / e2e percentiles over the concurrent-streams run) ride
+    # along so serving-latency regressions show in the report, not just
+    # throughput ratios
+    from ray_tpu.scripts.microbench import LLM_SKETCH_CAPTURE
+
+    if LLM_SKETCH_CAPTURE:
+        extra["llm_latency_sketches"] = {
+            name: {
+                "p50_ms": round(pct.get("p50", 0.0) * 1000, 3),
+                "p99_ms": round(pct.get("p99", 0.0) * 1000, 3),
+                "count": pct.get("count", 0),
+            }
+            for name, pct in LLM_SKETCH_CAPTURE.items()
+        }
+
     headline_value = results[HEADLINE][0]
     print(
         json.dumps(

@@ -8,7 +8,7 @@ codebase leans on clang-tidy/TSan for exactly this; a pure-Python runtime
 needs its own pass — each convention is encoded as a checker ONCE and every
 future PR gets it enforced in tier-1 instead of in a fifth review round.
 
-Five checkers (see :mod:`ray_tpu.analysis.framework` for the plugin model
+Six checkers (see :mod:`ray_tpu.analysis.framework` for the plugin model
 and ``docs/static_analysis.md`` for the catalog):
 
 ``lock-discipline``     attributes written under a class's lock must never
@@ -25,6 +25,9 @@ and ``docs/static_analysis.md`` for the catalog):
                         sets into output.
 ``knob-hygiene``        every ``core/config.py`` knob is read somewhere and
                         documented in a docs knob table.
+``span-manifest``       every ``prefix::``-shaped span name uses a pinned
+                        tracing namespace (``task::``/``serve::``/``llm::``
+                        …); a new namespace is a deliberate manifest edit.
 
 Suppressions (inline, narrowest-scope-wins):
 

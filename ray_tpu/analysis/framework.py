@@ -286,6 +286,7 @@ def all_checkers() -> List[CheckPlugin]:
     from ray_tpu.analysis.lock_discipline import LockDisciplineChecker
     from ray_tpu.analysis.metric_parity import MetricParityChecker
     from ray_tpu.analysis.protocol_parity import ProtocolParityChecker
+    from ray_tpu.analysis.span_manifest import SpanManifestChecker
 
     return [
         LockDisciplineChecker(),
@@ -293,6 +294,7 @@ def all_checkers() -> List[CheckPlugin]:
         MetricParityChecker(),
         DeterminismChecker(),
         KnobHygieneChecker(),
+        SpanManifestChecker(),
     ]
 
 

@@ -321,6 +321,21 @@ class Config:
     # LRU-evicted before admission holds or sheds either way.
     prefix_cache_max_blocks: int = 0
 
+    # ---- request-scope serving observability -----------------------------
+    # Lifecycle traces for serve requests (observability/reqtrace.py): a
+    # RequestTrace born at the HTTP proxy rides the request through
+    # router -> replica -> engine collecting phase-attributed timestamps,
+    # kept in bounded rings and served by GET /api/requests + `rt requests`.
+    # Pure wall-clock bookkeeping: consumes zero failpoint decisions, so
+    # same-seed chaos fault logs stay byte-identical on or off.
+    serve_request_trace: bool = True
+    # Trace 1-in-N proxy requests (1 = every request).  Sampling bounds the
+    # per-request overhead at high QPS; engine-side SLO sketches (TTFT,
+    # inter-token) are unaffected — they observe every request regardless.
+    serve_request_trace_sample_n: int = 1
+    # Completed-trace ring capacity (recent + the slowest-N derive from it).
+    serve_request_trace_ring: int = 512
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             env_key = _ENV_PREFIX + f.name.upper()

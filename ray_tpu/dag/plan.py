@@ -713,6 +713,19 @@ class ExecutionPlan:
             self._state = "BROKEN"
             self._error = error
             self._record_transition("READY", "BROKEN")
+        # flight-record the break: the postmortem needs the error and the
+        # last served requests, captured before repair rewrites the fabric
+        try:
+            from ray_tpu.observability import reqtrace
+
+            reqtrace.flight_record(
+                "plan_broken",
+                f"compiled plan {self.plan_id[:8]} BROKEN: {error!r}",
+                severity="ERROR",
+                state={"plan_id": self.plan_id, "auto_repair": self._auto_repair},
+            )
+        except Exception:  # noqa: BLE001 — recording must not block the break
+            pass
         # closing the driver-side channels wakes the drainer (pending
         # futures fail with the typed error) and nacks agent pushes
         self._manager.break_plan(self.plan_id, error)
@@ -842,6 +855,17 @@ class ExecutionPlan:
                 metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "failed"})
                 return
         metric_defs.PLAN_REPAIRS.inc(tags={"outcome": "ok"})
+        try:
+            from ray_tpu.observability import reqtrace
+
+            reqtrace.flight_record(
+                "plan_repaired",
+                f"compiled plan {self.plan_id[:8]} repaired: BROKEN -> READY",
+                severity="INFO",
+                state={"plan_id": self.plan_id},
+            )
+        except Exception:  # noqa: BLE001
+            pass
         # deaths that landed while state was BROKEN were ignored by the
         # hooks — re-check so a mid-repair casualty re-breaks immediately
         # instead of surfacing as a hang on the next execute

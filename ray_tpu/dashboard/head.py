@@ -190,6 +190,13 @@ class DashboardHead:
             req._send(200, self._autoscaler_status())
         elif path == "/api/overload":
             req._send(200, self.cluster.overload_snapshot())
+        elif path == "/api/requests":
+            from ray_tpu.observability import reqtrace
+
+            req._send(
+                200,
+                reqtrace.global_trace_store().snapshot(limit=min(limit, 200)),
+            )
         elif path == "/api/plans":
             req._send(200, self._plan_stats())
         elif path == "/api/memory":

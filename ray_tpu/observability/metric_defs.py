@@ -393,6 +393,40 @@ LLM_PREFIX_EVICTIONS = _reg.counter(
     "prefix_cache_max_blocks.",
 )
 
+# Serving SLO families (request-scope observability): ms-scale boundaries
+# matching observability/sketch.py SERVING_LATENCY_BOUNDS — the coarse
+# _LATENCY_BOUNDS grid would collapse a 20 ms vs 80 ms TTFT regression
+# into one bucket.  Keep the two grids in sync.
+_SERVING_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+LLM_TTFT = _reg.histogram(
+    "llm_ttft_seconds",
+    "Time to first token: engine submission to the first sampled token "
+    "(prefill queue wait + KV-block wait + prefill compute). The SLO the "
+    "prefix cache and chunked prefill exist to move.",
+    "s",
+    boundaries=_SERVING_BOUNDS,
+)
+LLM_INTER_TOKEN = _reg.histogram(
+    "llm_inter_token_seconds",
+    "Gap between consecutive streamed tokens of one request. The p99 is "
+    "the running-stream stall a user feels when prefills or pool pressure "
+    "preempt decode.",
+    "s",
+    boundaries=_SERVING_BOUNDS,
+)
+SERVE_REQUEST_PHASE = _reg.histogram(
+    "serve_request_phase_seconds",
+    "Per-phase time of traced serve requests, tagged phase= (proxy, "
+    "router_queue, dispatch, replica, engine_queue, kv_block_wait, "
+    "prefill, decode, handler). Phases partition the request timeline: "
+    "summed across phases they reproduce end-to-end latency.",
+    "s",
+    boundaries=_SERVING_BOUNDS,
+)
+
 # ---- node utilization (dashboard reporter samples) -----------------------
 NODE_CPU_PERCENT = _reg.gauge(
     "node_cpu_percent", "Host CPU utilization sampled by the node reporter.", "percent"
@@ -471,6 +505,9 @@ ALL_METRICS = [
     LLM_PREFIX_CACHE_BLOCKS,
     LLM_KV_BLOCKS_SHARED,
     LLM_PREFIX_EVICTIONS,
+    LLM_TTFT,
+    LLM_INTER_TOKEN,
+    SERVE_REQUEST_PHASE,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

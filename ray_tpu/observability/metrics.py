@@ -10,6 +10,7 @@ in-process registry instead of a gRPC exporter hop — the dashboard serves
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -83,14 +84,14 @@ class Histogram(Metric):
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         key = _tagkey(tags)
+        # binary search, not a linear scan: this sits on the per-token
+        # serving hot path (inter-token/TTFT families observe every token).
+        # bisect_left is bucket-for-bucket identical to the old `value <= b`
+        # scan: first boundary >= value, len(boundaries) = overflow.
+        idx = bisect.bisect_left(self.boundaries, value)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    counts[i] += 1
-                    break
-            else:
-                counts[-1] += 1  # overflow: above the largest boundary
+            counts[idx if idx < len(self.boundaries) else -1] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 

@@ -86,6 +86,23 @@ def record_shed(layer: str, reason: str, task_id: Optional[str] = None) -> None:
         key = (layer, reason)
         _shed_totals[key] = _shed_totals.get(key, 0) + 1
     _audit({"layer": layer, "reason": reason, "task": task_id, "typed": True})
+    # flight-record the shed into the structured event ring, throttled per
+    # (layer, reason) so a shed storm costs one snapshot a second, not one
+    # per rejected request
+    try:
+        from ray_tpu.observability import reqtrace
+
+        if reqtrace.snapshot_due(f"shed:{layer}:{reason}"):
+            reqtrace.flight_record(
+                "request_shed",
+                f"admission shed at {layer}: {reason}",
+                severity="WARNING",
+                state={"shed_totals": shed_totals()},
+                layer=layer,
+                reason=reason,
+            )
+    except Exception:  # noqa: BLE001 — observability must never fail a shed
+        pass
 
 
 def _audit(event: dict) -> None:

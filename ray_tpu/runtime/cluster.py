@@ -291,6 +291,17 @@ class _ActorQueue:
         self.direct_submits = 0         # calls that took the direct route
 
 
+def _request_latency_snapshot() -> dict:
+    """Per-deployment SLO percentiles for /api/overload — empty (never an
+    error) when request tracing is off or nothing has been served."""
+    try:
+        from ray_tpu.observability import reqtrace
+
+        return reqtrace.global_trace_store().deployment_percentiles()
+    except Exception:  # noqa: BLE001 — observability must not fail the API
+        return {}
+
+
 class Cluster:
     def __init__(self, session_dir: Optional[str] = None, shm_capacity: int = 0):
         cfg = get_config()
@@ -879,6 +890,19 @@ class Cluster:
         self.kill_node(node_id, reason="drained")
         metric_defs.NODE_DRAINS.inc(tags={"outcome": report["outcome"]})
         self.drain_reports.append(report)
+        # the drain report lands in the structured event ring too: a
+        # timeout outcome is a WARNING (work may have been resubmitted)
+        try:
+            from ray_tpu.observability import reqtrace
+
+            reqtrace.flight_record(
+                "node_drain_report",
+                f"drain of node {report['node']} finished: {report['outcome']}",
+                severity="WARNING" if report["outcome"] == "timeout" else "INFO",
+                state=report,
+            )
+        except Exception:  # noqa: BLE001 — reporting must never fail a drain
+            pass
         return report
 
     def _pick_evacuation_dest(self, draining: NodeID, seq: int):
@@ -1364,6 +1388,22 @@ class Cluster:
         """One audited fence rejection (bounded log + monotonic total)."""
         self.fence_events.append(event)
         self.fence_events_total += 1
+        # flight-record into the structured event ring (throttled: a fence
+        # storm after an epoch bump is one snapshot a second, not one per
+        # stale submission)
+        try:
+            from ray_tpu.observability import reqtrace
+
+            if reqtrace.snapshot_due("fence"):
+                reqtrace.flight_record(
+                    "fence_rejection",
+                    "stale-epoch submission fenced",
+                    severity="WARNING",
+                    state={"fence_events_total": self.fence_events_total,
+                           "last_event": event},
+                )
+        except Exception:  # noqa: BLE001 — auditing must never fail a fence
+            pass
 
     def record_overload_event(self, event: dict) -> None:
         """One audited admission-control shed (bounded log + monotonic
@@ -1406,6 +1446,10 @@ class Cluster:
                 "puts_shed": head_store.get("puts_shed", 0),
             },
             "sources": admission.sources_snapshot(),
+            # per-deployment SLO percentiles from the request-trace store
+            # (ms-scale e2e / queue-wait; engine sources above carry
+            # ttft / inter_token under their own "latency" key)
+            "request_latency": _request_latency_snapshot(),
         }
 
     def unpark_and_fail(self, spec: TaskSpec, error: BaseException) -> bool:

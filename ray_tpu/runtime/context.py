@@ -112,6 +112,38 @@ def current_tenant(default: Optional[str] = None) -> Optional[str]:
     return tenant if tenant is not None else default
 
 
+# --------------------------------------------------------------------------
+# request-trace propagation (request-scope observability, ISSUE 16): the
+# proxy births a RequestTrace (observability/reqtrace.py) and it rides this
+# contextvar alongside the tenant id so each layer can stamp its phase
+# timestamps without new plumbing.  Like the tenant, it does NOT survive
+# the router -> replica actor-call boundary (replicas run requests on pool
+# threads) — the router passes it as an explicit argument and the replica
+# re-installs it here around the callable invocation.
+# --------------------------------------------------------------------------
+_request_trace: "contextvars.ContextVar[Optional[object]]" = contextvars.ContextVar(
+    "rt_request_trace", default=None
+)
+
+
+def push_request_trace(trace):
+    """Install the in-flight request's trace record; returns a token for
+    :func:`pop_request_trace`.  None is a no-op install so callers need no
+    branching."""
+    return _request_trace.set(trace)
+
+
+def pop_request_trace(token) -> None:
+    try:
+        _request_trace.reset(token)
+    except ValueError:
+        pass  # token from another Context copy (async hand-off)
+
+
+def current_request_trace():
+    return _request_trace.get()
+
+
 class RuntimeContext:
     """User-facing runtime context (ray.get_runtime_context() parity)."""
 

@@ -578,6 +578,21 @@ def cmd_overload(args) -> int:
                     f"{src.get('prefix_tokens_reused', 0)} tokens reused, "
                     f"{src.get('prefix_evictions', 0)} evictions"
                 )
+            lat = src.get("latency", {})
+            ttft, itl = lat.get("ttft", {}), lat.get("inter_token", {})
+            if ttft.get("count"):
+                print(
+                    f"  latency: ttft p99={ttft['p99'] * 1000:.1f}ms, "
+                    f"inter-token p99={itl.get('p99', 0.0) * 1000:.1f}ms"
+                )
+    for dep, sketches in sorted(data.get("request_latency", {}).items()):
+        e2e = sketches.get("e2e", {})
+        if e2e.get("count"):
+            print(
+                f"deployment {dep or '-'}: e2e p50={e2e['p50'] * 1000:.1f}ms "
+                f"p95={e2e['p95'] * 1000:.1f}ms p99={e2e['p99'] * 1000:.1f}ms "
+                f"over {e2e['count']} request(s)"
+            )
     return 0
 
 
@@ -624,6 +639,77 @@ def cmd_llm(args) -> int:
                 )
             else:
                 print("  prefix cache: off")
+        lat = src.get("latency", {})
+        parts = []
+        for name in ("ttft", "inter_token", "queue_wait", "e2e"):
+            pct = lat.get(name, {})
+            if pct.get("count"):
+                parts.append(
+                    f"{name} p50={pct['p50'] * 1000:.1f}ms "
+                    f"p99={pct['p99'] * 1000:.1f}ms"
+                )
+        if parts:
+            print("  latency: " + "; ".join(parts))
+    return 0
+
+
+def _print_waterfall(tr: dict, width: int = 36) -> None:
+    """One trace as an aligned phase waterfall. Phases are deltas between
+    consecutive lifecycle marks, so the bars sum exactly to e2e."""
+    e2e = tr.get("e2e_s") or 0.0
+    ttft = tr.get("ttft_s")
+    ttft_txt = f" ttft={ttft * 1000:.1f}ms" if ttft is not None else ""
+    print(
+        f"  {tr.get('id', '?')} [{tr.get('deployment') or tr.get('route') or '-'}] "
+        f"{tr.get('outcome', '?')} e2e={e2e * 1000:.1f}ms{ttft_txt} "
+        f"tokens={tr.get('tokens', 0)}"
+    )
+    if not e2e:
+        return
+    for ph in tr.get("phases", ()):
+        start, dur = ph.get("start_s", 0.0), ph.get("dur_s", 0.0)
+        lead = min(int(round(start / e2e * width)), width - 1)
+        bar = min(max(1, int(round(dur / e2e * width))), width - lead)
+        print(
+            f"    {ph.get('phase', '?'):<14}|{' ' * lead}{'#' * bar}"
+            f"{' ' * (width - lead - bar)}| {dur * 1000:9.2f}ms"
+        )
+
+
+def cmd_requests(args) -> int:
+    """``rt requests``: request-scope lifecycle traces as phase waterfalls
+    (proxy -> router queue -> dispatch -> engine queue -> kv-block wait ->
+    prefill -> decode), the slowest-N / in-flight views, and per-deployment
+    SLO percentiles from the trace store's latency sketches."""
+    address = _read_address(args.address)
+    data = _get(address, f"/api/requests?limit={args.limit}")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    label = "slowest" if args.slowest else "recent"
+    traces = data.get(label, [])
+    inflight = data.get("in_flight", [])
+    if not traces and not inflight:
+        print(
+            "no request traces recorded "
+            "(serve_request_trace off, or no traffic yet)"
+        )
+        return 0
+    print(f"{len(traces)} {label} trace(s), {len(inflight)} in flight")
+    for tr in traces[: args.limit]:
+        _print_waterfall(tr)
+    for tr in inflight[: args.limit]:
+        _print_waterfall(tr)
+    deps = data.get("deployments", {})
+    for dep in sorted(deps):
+        for name, pct in sorted(deps[dep].items()):
+            if pct.get("count"):
+                print(
+                    f"{dep or '-'}/{name}: n={pct['count']} "
+                    f"p50={pct['p50'] * 1000:.1f}ms "
+                    f"p95={pct['p95'] * 1000:.1f}ms "
+                    f"p99={pct['p99'] * 1000:.1f}ms"
+                )
     return 0
 
 
@@ -841,6 +927,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_llm)
+
+    sp = sub.add_parser(
+        "requests",
+        help="request lifecycle traces: per-phase waterfalls (proxy/router/"
+        "engine queue/kv wait/prefill/decode), slowest-N, in-flight, "
+        "per-deployment SLO percentiles",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=8)
+    sp.add_argument(
+        "--slowest", action="store_true",
+        help="show the slowest-N traces instead of the most recent",
+    )
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_requests)
 
     sp = sub.add_parser("memory", help="object store contents + refcounts (ray memory parity)")
     sp.add_argument("--address", default=None)

@@ -44,6 +44,12 @@ BASELINES: Dict[str, Tuple[float, str]] = {
     # vs_baseline is intentionally absent from bench output.
 }
 
+# Side-channel for bench.py: the LLM rows' engine-side SLO sketch
+# percentiles ({ttft, inter_token, queue_wait, e2e} -> percentiles dict),
+# captured from the concurrent-streams engine before shutdown.  Cleared
+# at the top of every run_suite call.
+LLM_SKETCH_CAPTURE: Dict[str, dict] = {}
+
 
 def _rate(fn: Callable[[], None], n: int, warmup: Optional[int] = None, rounds: int = 3) -> float:
     """Median-of-rounds rate (ops/s) — robust to shared-box noise."""
@@ -72,6 +78,7 @@ def run_suite(
     import numpy as np
 
     results: Dict[str, Tuple[float, str]] = {}
+    LLM_SKETCH_CAPTURE.clear()
 
     def record(name: str, value: float, unit: str) -> None:
         results[name] = (value, unit)
@@ -1141,6 +1148,10 @@ def run_suite(
                     f"8 concurrent streams only {ratio:.2f}x sequential "
                     f"tok/s, below the 1.5x floor"
                 )
+            # capture the engine's SLO sketch percentiles (TTFT /
+            # inter-token over all 16 runs) for the bench report's
+            # llm_latency_sketches row — read before shutdown zeroes it
+            LLM_SKETCH_CAPTURE.update(eng.admission_snapshot()["latency"])
         finally:
             eng.shutdown()
         record("llm_concurrent_streams_x", ratio, "x")

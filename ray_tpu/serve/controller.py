@@ -261,6 +261,26 @@ class ServeControllerActor:
                             ray_tpu.kill(r)
                         except Exception:
                             pass
+                        # flight-record the death with the last requests:
+                        # which traffic preceded the failed probes is the
+                        # first postmortem question
+                        try:
+                            from ray_tpu.observability import reqtrace
+
+                            reqtrace.flight_record(
+                                "replica_died",
+                                f"deployment {name!r} replica removed "
+                                f"(verdict: {verdict})",
+                                severity="WARNING",
+                                state={
+                                    "deployment": name,
+                                    "verdict": verdict,
+                                    "fails": rec["fails"],
+                                    "replicas_left": len(state.replicas),
+                                },
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
                 if changed:
                     self._changed.notify_all()  # routers drop dead replicas now
 

@@ -45,6 +45,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -67,8 +68,13 @@ from ray_tpu.models.generation import (
 )
 from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.observability import metric_defs
+from ray_tpu.observability.sketch import LatencySketch
 from ray_tpu.runtime import admission
-from ray_tpu.runtime.context import current_deadline_ts, current_tenant
+from ray_tpu.runtime.context import (
+    current_deadline_ts,
+    current_request_trace,
+    current_tenant,
+)
 from ray_tpu.serve.kv_blocks import BlockAllocator
 from ray_tpu.serve.prefix_cache import PrefixCache
 
@@ -105,6 +111,18 @@ class GenRequest:
     generated: List[int] = field(default_factory=list)
     # chunked prefill progress: prompt tokens already cached (paged engine)
     prefill_pos: int = 0
+    # request-scope observability: the lifecycle trace born at the proxy
+    # (None when tracing is off, the request skipped sampling, or the
+    # engine is driven directly without a serve ingress) plus engine-side
+    # perf_counter stamps that feed the per-engine latency sketches
+    # whether or not a trace is riding along
+    trace: Optional[Any] = None
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    t_last_tok: float = 0.0
+    # queue-wait observed exactly once (a held head-of-line request is
+    # resumed through _pop_admissible again and must not double-count)
+    wfq_popped: bool = False
 
     def emit(self, tok: int) -> None:
         if self.stream_queue is not None:
@@ -306,6 +324,22 @@ class LLMEngine:
         # per-engine series (keyed by the registry token): two engines
         # must not clobber each other's admission-depth gauge
         self._depth_tags = {"layer": "engine", "engine": str(self._admission_token)}
+        # per-engine SLO latency sketches (deterministic fixed-boundary
+        # quantiles, observability/sketch.py): fed from the engine's OWN
+        # request timestamps, so TTFT/inter-token/queue-wait/e2e
+        # percentiles exist even when the engine is driven directly
+        # without a serve ingress (no trace riding the request). Written
+        # only by the engine/request threads; snapshot readers tolerate a
+        # torn single-counter read.
+        self._sketches = {
+            "ttft": LatencySketch(),
+            "inter_token": LatencySketch(),
+            "queue_wait": LatencySketch(),
+            "e2e": LatencySketch(),
+        }
+        # bounded ring of recently terminated request summaries — the
+        # flight recorder's raw material when the loop crashes
+        self._finished_ring: deque = deque(maxlen=64)
 
         # slot state (host-side mirrors of the device arrays)
         self._slots: List[Optional[GenRequest]] = [None] * self.B
@@ -534,6 +568,12 @@ class LLMEngine:
             tenant = current_tenant()
         if deadline_ts is None:
             deadline_ts = current_deadline_ts()
+        # the lifecycle trace rode proxy -> router -> replica context to
+        # get here; stamp the engine-submit boundary before any shed so a
+        # shed request still shows where it died
+        trace = current_request_trace()
+        if trace is not None:
+            trace.mark("engine_submit")
         if deadline_ts is not None and time.time() >= deadline_ts:
             # shed-on-arrival: the deadline already expired — admitting
             # would burn prefill + a decode slot on an answer nobody can
@@ -569,8 +609,9 @@ class LLMEngine:
             req = GenRequest(
                 list(prompt), max_tokens, temperature, eos_id,
                 stream_queue=_stream_queue, tenant=tenant,
-                deadline_ts=deadline_ts,
+                deadline_ts=deadline_ts, trace=trace,
             )
+            req.t_submit = time.perf_counter()
             self._queue.push(req, tenant)
             self._queued_tokens += len(prompt)
             depth += 1
@@ -627,6 +668,7 @@ class LLMEngine:
         if removed:
             metric_defs.ADMISSION_QUEUE_DEPTH.set(depth, self._depth_tags)
             admission.record_shed("engine", "disconnect")
+            self._record_done(req, "disconnect", "stream abandoned while queued")
             if not req.future.done():
                 req.future.set_exception(
                     RuntimeError("stream consumer disconnected before admission")
@@ -695,6 +737,11 @@ class LLMEngine:
                 "prefix_hit_rate": (useful / probes) if probes else 0.0,
                 "prefix_tokens_reused": self._prefix_tokens_reused,
                 "prefix_evictions": self._prefix.evictions if self._prefix is not None else 0,
+                # SLO percentiles from the engine-side latency sketches
+                # (ttft / inter_token / queue_wait / e2e, seconds)
+                "latency": {
+                    name: sk.percentiles() for name, sk in self._sketches.items()
+                },
             }
 
     def shutdown(self) -> None:
@@ -763,6 +810,63 @@ class LLMEngine:
         metric_defs.LLM_KV_BLOCKS_SHARED.set(shared, self._depth_tags)
         metric_defs.LLM_PREFIX_CACHE_BLOCKS.set(cache_blocks, self._depth_tags)
 
+    # -- request-scope latency bookkeeping ----------------------------------
+    def _note_first_token(self, req: GenRequest) -> None:
+        """TTFT boundary: the first sampled token leaves the engine."""
+        now = time.perf_counter()
+        req.t_first = req.t_last_tok = now
+        if req.t_submit:
+            ttft = now - req.t_submit
+            self._sketches["ttft"].observe(ttft)
+            metric_defs.LLM_TTFT.observe(ttft, self._depth_tags)
+        if req.trace is not None:
+            req.trace.note_token(0.0)  # marks first_token on the trace
+
+    def _note_next_token(self, req: GenRequest) -> None:
+        """Inter-token gap: one decode token after the first."""
+        now = time.perf_counter()
+        gap = now - req.t_last_tok
+        req.t_last_tok = now
+        self._sketches["inter_token"].observe(gap)
+        metric_defs.LLM_INTER_TOKEN.observe(gap, self._depth_tags)
+        if req.trace is not None:
+            req.trace.note_token(gap)
+
+    def _note_stall(self) -> None:
+        """A prefill forward just stalled every running decode slot: count
+        the stall on each stalled request's trace (the decoding requests
+        experience the bubble, not the prefilling one)."""
+        # rt-lint: disable=lock-discipline -- engine-thread-owned: _slots
+        # mutations all run on this same engine loop thread (see _step)
+        for r in self._slots:
+            if r is not None and r.trace is not None:
+                r.trace.note_stall()
+
+    def _record_done(self, req: GenRequest, outcome: str, detail: str = "") -> None:
+        """Terminal bookkeeping shared by every exit path: feed the e2e
+        sketch (engine-side view: submit -> terminal, successful finishes
+        only) and push a summary onto the bounded ring the flight recorder
+        snapshots. Abnormal terminals claim the trace outcome HERE so the
+        proxy's generic mapping (first-wins) cannot mislabel them."""
+        now = time.perf_counter()
+        e2e = (now - req.t_submit) if req.t_submit else 0.0
+        if outcome == "finish":
+            self._sketches["e2e"].observe(e2e)
+        elif req.trace is not None:
+            req.trace.set_outcome(outcome, detail or f"engine:{outcome}")
+        self._finished_ring.append({
+            "outcome": outcome,
+            "detail": detail,
+            "tenant": req.tenant or "",
+            "prompt_tokens": len(req.prompt),
+            "generated": len(req.generated),
+            "e2e_ms": round(e2e * 1000.0, 3),
+            "ttft_ms": (
+                round((req.t_first - req.t_submit) * 1000.0, 3)
+                if req.t_first and req.t_submit else None
+            ),
+        })
+
     # -- engine loop --------------------------------------------------------
     def _admit(self) -> None:
         if self.cache_kind == "paged":
@@ -802,6 +906,9 @@ class LLMEngine:
                 with self._lock:  # += races the request-thread shed paths
                     self.num_shed += 1
                 admission.record_shed("engine", "disconnect")
+                self._record_done(
+                    req, "disconnect", "stream consumer gone before admission"
+                )
                 if not req.future.done():
                     req.future.set_exception(
                         RuntimeError("stream consumer disconnected before admission")
@@ -812,6 +919,7 @@ class LLMEngine:
                 with self._lock:  # += races the request-thread shed paths
                     self.num_shed += 1
                 admission.record_shed("engine", "deadline_expired")
+                self._record_done(req, "deadline", "deadline expired while queued")
                 if not req.future.done():
                     req.future.set_exception(
                         DeadlineExceededError("llm_request", "engine_queue", 0.0)
@@ -819,6 +927,17 @@ class LLMEngine:
                 if req.stream_queue is not None:
                     req.stream_queue.put(_STREAM_END)
                 continue
+            if not req.wfq_popped:
+                # queue-wait ends at the FIRST pop; a held head-of-line
+                # request resumed from _held_req is in kv_block_wait, not
+                # queue time, and must not re-observe
+                req.wfq_popped = True
+                if req.t_submit:
+                    self._sketches["queue_wait"].observe(
+                        time.perf_counter() - req.t_submit
+                    )
+                if req.trace is not None:
+                    req.trace.mark("wfq_pop")
             return req, free
 
     def _admit_dense(self) -> None:
@@ -828,6 +947,9 @@ class LLMEngine:
                 return
             req, free = popped
             slot = free[0]
+            if req.trace is not None:
+                # dense admission is immediate: no kv_block_wait phase
+                req.trace.mark("admitted")
             try:
                 tp = len(req.prompt)
                 bucket = _bucket(tp, cap=self.S)
@@ -840,6 +962,7 @@ class LLMEngine:
                 if stalled:
                     # decode slots sat idle for this whole one-shot prefill
                     metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
+                    self._note_stall()
                 with self._lock:  # stats() reads this under the lock
                     self._prefill_count += 1
                 self._cache = self._insert(self._cache, row, slot)
@@ -857,6 +980,7 @@ class LLMEngine:
                 continue
             req.slot = slot
             req.generated = [tok0]
+            self._note_first_token(req)
             req.emit(tok0)
             with self._lock:
                 self._slots[slot] = req
@@ -951,6 +1075,9 @@ class LLMEngine:
             if self._prefix is not None:
                 metric_defs.LLM_PREFIX_CACHE_HITS.inc(tags=_PREFIX_RESULT_TAGS[result])
             req.slot = slot
+            if req.trace is not None:
+                # pages reserved: kv_block_wait (wfq_pop -> here) is over
+                req.trace.mark("admitted")
             # chunked prefill resumes at the first token whose KV is not
             # already in the table (tp - 1 for a full hit: one recompute)
             req.prefill_pos = matched
@@ -983,6 +1110,7 @@ class LLMEngine:
             )[0]
         )
         req.generated = [tok0]
+        self._note_first_token(req)
         req.emit(tok0)
         with self._lock:
             slot = req.slot
@@ -997,6 +1125,7 @@ class LLMEngine:
     def _fail_admit(self, req: GenRequest, exc: BaseException) -> None:
         """A popped request is in neither queue nor slots — fail it HERE or
         its caller hangs forever; return any reserved pages to the pool."""
+        self._record_done(req, "crash", f"prefill failed: {exc!r}")
         if not req.future.done():
             req.future.set_exception(RuntimeError(f"prefill failed: {exc!r}"))
         if req.stream_queue is not None:
@@ -1097,6 +1226,9 @@ class LLMEngine:
                 self._release_blocks_locked(req.slot)
                 self.num_shed += 1
                 admission.record_shed("engine", "disconnect")
+                self._record_done(
+                    req, "disconnect", "stream consumer gone during prefill"
+                )
                 if not req.future.done():
                     req.future.set_exception(
                         RuntimeError("stream consumer disconnected during prefill")
@@ -1138,7 +1270,10 @@ class LLMEngine:
         if stalled:
             # decode slots sat idle while this chunk ran; chunking bounds it
             metric_defs.LLM_DECODE_STALL.observe(time.perf_counter() - t0)
+            self._note_stall()
         metric_defs.LLM_PREFILL_CHUNKS.inc()
+        if req.trace is not None:
+            req.trace.note_prefill_chunk()
         with self._lock:
             self._prefill_chunk_count += 1
         req.prefill_pos = start + n
@@ -1169,6 +1304,7 @@ class LLMEngine:
                 if evicted_n:
                     metric_defs.LLM_PREFIX_EVICTIONS.inc(evicted_n)
                 self._publish_pool_gauges(*gauges)
+            self._record_done(req, "finish")
             req.future.set_result(req.generated)
             if req.stream_queue is not None:
                 req.stream_queue.put(_STREAM_END)
@@ -1208,6 +1344,7 @@ class LLMEngine:
                     continue  # free, or finished earlier in this chunk
                 tok = int(sampled[i, k])
                 req.generated.append(tok)
+                self._note_next_token(req)
                 req.emit(tok)
                 self._pos[i] += 1
                 self._last_tok[i] = tok
@@ -1252,6 +1389,7 @@ class LLMEngine:
         if self._allocator is not None:
             self._publish_pool_gauges(0, 0, 0)
         for r in victims:
+            self._record_done(r, "crash", str(error))
             if not r.future.done():
                 r.future.set_exception(error)
             if r.stream_queue is not None:
@@ -1278,6 +1416,7 @@ class LLMEngine:
         for _, r in victims:
             self.num_slots_evicted += 1
             metric_defs.LLM_SLOTS_EVICTED.inc(tags=_EVICT_DISCONNECT_TAGS)
+            self._record_done(r, "disconnect", "decode slot evicted mid-stream")
             if not r.future.done():
                 r.future.set_exception(
                     RuntimeError("stream consumer disconnected; decode slot evicted")
@@ -1297,6 +1436,18 @@ class LLMEngine:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except BaseException as exc:  # noqa: BLE001 — a dead loop hangs every caller
+                # flight-record the crash BEFORE recovery clears the
+                # evidence: admission state + the last finished requests
+                from ray_tpu.observability import reqtrace
+
+                reqtrace.flight_record(
+                    "engine_crash",
+                    f"LLMEngine loop crashed: {exc!r}",
+                    severity="ERROR",
+                    state=self.admission_snapshot(),
+                    requests=list(self._finished_ring)[-8:],
+                    engine=str(self._admission_token),
+                )
                 self._fail_inflight(RuntimeError(f"LLMEngine step failed: {exc!r}"))
                 # a failed donated step leaves self._cache pointing at
                 # deleted buffers; reallocate so the engine keeps serving

@@ -37,12 +37,27 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b'{"error": "no app at this route"}')
             return
-        from ray_tpu.runtime.context import pop_tenant, push_tenant
+        from ray_tpu.observability import reqtrace
+        from ray_tpu.runtime.context import (
+            pop_request_trace,
+            pop_tenant,
+            push_request_trace,
+            push_tenant,
+        )
 
         # tenant id rides the ingress header into the request context, then
         # handle -> replica -> engine admission (weighted fairness keys)
         tenant = self.headers.get("X-Tenant-Id") or self.headers.get("X-Tenant")
         tenant_token = push_tenant(tenant)
+        # the request trace is BORN here (proxy admission) and rides the
+        # same context path; None when disabled or not sampled
+        trace = reqtrace.start_trace(
+            route=prefix,
+            deployment=getattr(handle, "deployment_name", ""),
+            tenant=tenant,
+        )
+        trace_token = push_request_trace(trace)
+        outcome, detail = "ok", ""
         try:
             payload: Any = None
             if body:
@@ -66,13 +81,17 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
                         frame = json.dumps(item, default=_jsonify)
                         self.wfile.write(f"data: {frame}\n\n".encode())
                         self.wfile.flush()
+                except OSError:
+                    # the socket died mid-stream: the client went away
+                    outcome, detail = "disconnect", "client disconnected mid-stream"
                 except Exception as exc:  # noqa: BLE001
+                    outcome, detail = _trace_outcome(exc)
                     try:
                         err = json.dumps({"error": str(exc)})
                         self.wfile.write(f"data: {err}\n\n".encode())
                         self.wfile.flush()
                     except OSError:
-                        pass  # client already gone
+                        outcome, detail = "disconnect", "client disconnected mid-stream"
                 finally:
                     # a disconnected client must FREE its decode slot: close
                     # the generator chain NOW (GeneratorExit propagates into
@@ -96,6 +115,7 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
             # actor/worker death past the retry budget -> 503, else 500.
             from ray_tpu.runtime.admission import http_status_for, unwrap
 
+            outcome, detail = _trace_outcome(exc)
             status, retry_after = http_status_for(exc)
             cause = unwrap(exc)
             self.send_response(status)
@@ -107,7 +127,11 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(json.dumps(payload).encode())
         finally:
+            pop_request_trace(trace_token)
             pop_tenant(tenant_token)
+            # an engine-side terminal (crash/shed/disconnect) claimed first
+            # wins: finish_trace's outcome only fills an unclaimed trace
+            reqtrace.finish_trace(trace, outcome, detail)
 
     def do_GET(self):
         self._handle(None)
@@ -120,6 +144,29 @@ class _ServeHTTPHandler(BaseHTTPRequestHandler):
 def _is_stream(result) -> bool:
     """Iterator/generator results stream as SSE; lists/dicts/strs do not."""
     return hasattr(result, "__next__")
+
+
+def _trace_outcome(exc: BaseException) -> tuple:
+    """Map a request-terminal exception to the trace outcome vocabulary
+    (finish/shed/deadline/disconnect/crash are the flight recorder's
+    buckets); mirrors admission.http_status_for's type unwrapping."""
+    from ray_tpu.exceptions import (
+        DeadlineExceededError,
+        GetTimeoutError,
+        OverloadedError,
+        RayActorError,
+        WorkerCrashedError,
+    )
+    from ray_tpu.runtime.admission import unwrap
+
+    cause = unwrap(exc)
+    if isinstance(cause, OverloadedError):
+        return "shed", f"{cause.layer}:{cause.reason}" if hasattr(cause, "layer") else str(cause)
+    if isinstance(cause, (DeadlineExceededError, GetTimeoutError)):
+        return "deadline", str(cause)
+    if isinstance(cause, (RayActorError, WorkerCrashedError)):
+        return "crash", f"{type(cause).__name__}: {cause}"
+    return "error", f"{type(cause).__name__}: {cause}"
 
 
 def _jsonify(obj):

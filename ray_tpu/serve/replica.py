@@ -74,8 +74,13 @@ class ReplicaActor:
         self._total = 0
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       tenant: str = None) -> Any:
-        from ray_tpu.runtime.context import pop_tenant, push_tenant
+                       tenant: str = None, trace=None) -> Any:
+        from ray_tpu.runtime.context import (
+            pop_request_trace,
+            pop_tenant,
+            push_request_trace,
+            push_tenant,
+        )
 
         with self._lock:
             if self._max_ongoing > 0 and self._ongoing >= self._max_ongoing:
@@ -94,6 +99,11 @@ class ReplicaActor:
         # the requesting tenant rides proxy header -> handle -> HERE so
         # anything the deployment submits (e.g. LLMEngine admission) sees it
         tenant_token = push_tenant(tenant)
+        # the request trace rode the router's explicit argument across the
+        # actor boundary; re-install it so the engine stamps its phases
+        if trace is not None:
+            trace.mark("replica_in")
+        trace_token = push_request_trace(trace)
         try:
             if self.is_function:
                 return self.callable(*args, **kwargs)
@@ -102,6 +112,7 @@ class ReplicaActor:
                 raise TypeError(f"deployment class {type(self.callable)} is not callable")
             return target(*args, **kwargs) if method != "__call__" else self.callable(*args, **kwargs)
         finally:
+            pop_request_trace(trace_token)
             pop_tenant(tenant_token)
             _replica_context.reset(token)
             with self._lock:

@@ -343,9 +343,14 @@ class Router:
             )
 
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
-        from ray_tpu.runtime.context import current_tenant
+        from ray_tpu.runtime.context import current_request_trace, current_tenant
 
         t_start = time.perf_counter()
+        trace = current_request_trace()
+        if trace is not None:
+            trace.mark("router_in")
+            if not trace.deployment:
+                trace.deployment = self.deployment_name
         # rt-lint: disable=lock-discipline -- emptiness fast-path only: it
         # decides refresh-or-fail; replica SELECTION below holds _lock
         if not self._replicas:
@@ -379,11 +384,16 @@ class Router:
         metric_defs.SERVE_ROUTER_QUEUE_WAIT.observe(
             time.perf_counter() - t_start, tags=self._metric_tags
         )
+        if trace is not None:
+            trace.mark("router_dequeue")
         # Resolve nested DeploymentResponses: pass their refs so the fabric
         # chains the calls without blocking here (model composition).
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v) for k, v in kwargs.items()}
-        ref = replica.handle_request.remote(method, args, kwargs, tenant)
+        # the trace rides as an explicit argument, like the tenant —
+        # contextvars do not survive the actor-call boundary (replicas run
+        # requests on pool threads)
+        ref = replica.handle_request.remote(method, args, kwargs, tenant, trace)
         # Ready-hook, not ref.future(): a future would pull every response
         # onto the router's node; the directory callback fires when the
         # result is committed anywhere, without materializing it here.

@@ -1,0 +1,471 @@
+"""Request-scope serving observability tests (ISSUE 16).
+
+Covers the three tentpole layers end to end:
+
+  * ``LatencySketch`` — deterministic quantiles (same multiset, any
+    insertion order, byte-identical answers), associative/commutative
+    merge, boundary-mismatch rejection, wire round-trip, and the
+    float-rank guard at p99.
+  * ``RequestTrace`` / ``TraceStore`` — the phase algebra (waterfall sums
+    exactly to e2e, handler fallback for non-LLM requests, idempotent
+    marks, first-terminal-claim-wins), bounded rings under 10k traces,
+    the sampling knob, and the off switch.
+  * the serving stack — phase monotonicity + completeness for real HTTP
+    requests (streaming and non-streaming) through proxy -> router ->
+    replica -> engine, engine-side sketches/finished-ring without any
+    HTTP ingress, the flight recorder's event-ring snapshots, and the
+    chaos contract: same-seed fault logs are byte-identical with
+    ``serve_request_trace`` on vs off (tracing consumes zero failpoint
+    decisions).
+"""
+
+import json
+import time
+import uuid
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.config import get_config
+from ray_tpu.observability import reqtrace
+from ray_tpu.observability.reqtrace import MARKS, RequestTrace, TraceStore
+from ray_tpu.observability.sketch import (
+    SERVING_LATENCY_BOUNDS,
+    LatencySketch,
+    merged,
+)
+
+CFG = None  # built lazily: the sketch/trace tests must not touch JAX
+
+
+def _model_cfg():
+    global CFG
+    if CFG is None:
+        from ray_tpu.models import TransformerConfig
+
+        CFG = TransformerConfig(
+            vocab_size=89, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=64, attention="dense", dtype=jnp.float32,
+        )
+    return CFG
+
+
+@pytest.fixture(scope="module")
+def params():
+    from ray_tpu.models import init_params
+
+    return init_params(_model_cfg(), jax.random.key(11))
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_store():
+    reqtrace.global_trace_store().reset()
+    yield
+    reqtrace.global_trace_store().reset()
+
+
+# --------------------------------------------------------------------------
+# LatencySketch: determinism, merge algebra, wire format
+# --------------------------------------------------------------------------
+_OBS = [0.0003, 0.0009, 0.004, 0.004, 0.03, 0.07, 0.2, 0.8, 3.0, 45.0]
+
+
+def test_sketch_deterministic_and_order_invariant():
+    a, b = LatencySketch(), LatencySketch()
+    for v in _OBS:
+        a.observe(v)
+    for v in reversed(_OBS):  # same multiset, different insertion order
+        b.observe(v)
+    assert a.to_dict() == b.to_dict()
+    assert a.percentiles() == b.percentiles()
+    # quantiles answer with bucket upper edges (or the exact max overflow)
+    assert a.quantile(0.5) in SERVING_LATENCY_BOUNDS
+    assert a.quantile(1.0) == 45.0  # overflow bucket answers the true max
+
+
+def test_sketch_quantile_edges():
+    sk = LatencySketch()
+    assert sk.quantile(0.5) == 0.0  # empty
+    assert sk.percentiles()["count"] == 0
+    sk.observe(0.003)
+    # single observation: every quantile is its bucket's upper edge
+    assert sk.quantile(0.01) == sk.quantile(0.99) == 0.005
+    assert sk.percentiles()["max"] == 0.003
+
+
+def test_sketch_p99_float_rank_guard():
+    """0.99 * 100 is 99.000...01 in IEEE; a bare ceil would bump the rank
+    to 100 and misreport p99 as the single outlier."""
+    sk = LatencySketch()
+    for _ in range(99):
+        sk.observe(0.0001)
+    sk.observe(99.0)
+    assert sk.quantile(0.99) == SERVING_LATENCY_BOUNDS[0]
+    assert sk.quantile(1.0) == 99.0
+
+
+def test_sketch_merge_associative_commutative():
+    def fresh(values):
+        sk = LatencySketch()
+        for v in values:
+            sk.observe(v)
+        return sk
+
+    a_obs, b_obs, c_obs = _OBS[:3], _OBS[3:7], _OBS[7:]
+    left = merged([fresh(a_obs), fresh(b_obs)]).merge(fresh(c_obs))
+    right = fresh(a_obs).merge(merged([fresh(b_obs), fresh(c_obs)]))
+    shuffled = merged([fresh(c_obs), fresh(a_obs), fresh(b_obs)])
+    for other in (right, shuffled, fresh(_OBS)):
+        # counts/total/max (and therefore every quantile) are exactly
+        # associative; `sum` is float addition, order-dependent in the ulps
+        assert left.counts == other.counts
+        assert left.total == other.total == len(_OBS)
+        assert left.max == other.max
+        for q in (0.5, 0.95, 0.99, 1.0):
+            assert left.quantile(q) == other.quantile(q)
+        assert left.sum == pytest.approx(other.sum, rel=1e-12)
+
+
+def test_sketch_boundary_contract():
+    with pytest.raises(ValueError):
+        LatencySketch((0.5, 0.1))  # unsorted
+    with pytest.raises(ValueError):
+        LatencySketch(())  # empty
+    with pytest.raises(ValueError):
+        LatencySketch((0.1, 1.0)).merge(LatencySketch())  # mismatched grids
+
+
+def test_sketch_wire_roundtrip():
+    sk = LatencySketch()
+    for v in _OBS:
+        sk.observe(v)
+    clone = LatencySketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+    assert clone.to_dict() == sk.to_dict()
+    assert clone.percentiles() == sk.percentiles()
+
+
+# --------------------------------------------------------------------------
+# RequestTrace: the phase algebra
+# --------------------------------------------------------------------------
+def _routed_trace(**kw):
+    tr = RequestTrace(route="/llm", deployment="LLMServer", **kw)
+    for name in ("router_in", "router_dequeue", "replica_in",
+                 "engine_submit", "wfq_pop", "admitted"):
+        tr.mark(name)
+    return tr
+
+
+def test_trace_phases_sum_exactly_to_e2e():
+    store = TraceStore(ring=16)
+    tr = _routed_trace()
+    tr.note_token(0.0)  # stamps first_token
+    for gap in (0.001, 0.003, 0.002):
+        tr.note_token(gap)
+    store.finish(tr, "ok")
+    phases = tr.phases()
+    assert [p for p, _, _ in phases] == [
+        "proxy", "router_queue", "dispatch", "replica",
+        "engine_queue", "kv_block_wait", "prefill", "decode",
+    ]
+    # monotone, gap-free, and the waterfall telescopes to e2e exactly
+    for (_, a, b), (_, a2, _) in zip(phases, phases[1:]):
+        assert b == a2
+    assert sum(b - a for _, a, b in phases) == pytest.approx(tr.e2e_s, rel=1e-9)
+    assert tr.tokens == 4
+    assert tr.to_dict()["inter_token"]["count"] == 3
+
+
+def test_trace_handler_phase_for_non_llm():
+    store = TraceStore(ring=16)
+    tr = RequestTrace(route="/echo", deployment="Echo")
+    tr.mark("router_in")
+    tr.mark("router_dequeue")
+    tr.mark("replica_in")
+    store.finish(tr, "ok")
+    assert tr.phases()[-1][0] == "handler"  # no first_token: not decode
+
+
+def test_trace_marks_idempotent_ordered_and_bounded():
+    tr = _routed_trace()
+    tr.mark("router_in")  # held-request re-entry must not re-stamp
+    names = [n for n, _ in tr.marks]
+    assert names.count("router_in") == 1
+    offsets = [t for _, t in tr.marks]
+    assert offsets == sorted(offsets)
+    for i in range(100):  # hard per-trace bound
+        tr.mark(f"extension_{i}")
+    assert len(tr.marks) <= 32
+
+
+def test_outcome_first_claim_wins():
+    store = TraceStore(ring=16)
+    tr = _routed_trace()
+    tr.set_outcome("crash", "engine loop died")  # engine claims first
+    store.finish(tr, "error", "proxy saw a 500")
+    assert tr.outcome == "crash"
+    assert tr.detail == "engine loop died"
+
+
+# --------------------------------------------------------------------------
+# TraceStore: bounded rings, sampling, off switch
+# --------------------------------------------------------------------------
+def test_ring_bounded_under_10k_traces(monkeypatch):
+    monkeypatch.setattr(get_config(), "serve_request_trace_ring", 64)
+    monkeypatch.setattr(get_config(), "tracing_enabled", False)
+    store = TraceStore(ring=64)
+    for i in range(10_000):
+        tr = store.start(route="/r", deployment="d")
+        assert tr is not None
+        store.finish(tr, "ok")
+    snap = store.snapshot(limit=100_000)
+    assert len(snap["recent"]) <= 64
+    assert len(snap["slowest"]) <= 32
+    assert snap["in_flight"] == []
+    assert snap["deployments"]["d"]["e2e"]["count"] == 10_000
+
+
+def test_ring_rebinds_when_knob_shrinks(monkeypatch):
+    store = TraceStore(ring=512)
+    monkeypatch.setattr(get_config(), "serve_request_trace_ring", 8)
+    monkeypatch.setattr(get_config(), "tracing_enabled", False)
+    for _ in range(50):
+        store.finish(store.start(route="/r", deployment="d"), "ok")
+    assert len(store.snapshot(limit=1000)["recent"]) <= 8
+
+
+def test_slowest_heap_keeps_worst():
+    store = TraceStore(ring=4)  # tiny ring: slowest must survive churn
+    for i in range(40):
+        tr = RequestTrace(route="/r", deployment="d")
+        tr.t0 -= i * 0.01  # synthetic e2e: trace i took ~10*i ms
+        store.finish(tr, "ok")
+    slowest = store.snapshot(limit=100)["slowest"]
+    assert len(slowest) == 32
+    assert slowest[0]["e2e_s"] == max(t["e2e_s"] for t in slowest)
+    assert slowest[0]["e2e_s"] > 0.38  # the 390 ms worst case survived
+
+
+def test_sampling_knob_thins_traces(monkeypatch):
+    monkeypatch.setattr(get_config(), "serve_request_trace_sample_n", 4)
+    store = TraceStore(ring=get_config().serve_request_trace_ring)
+    traced = [store.start(route="/r") for _ in range(20)]
+    assert sum(t is not None for t in traced) == 5  # every 4th, from the 1st
+    assert traced[0] is not None and traced[1] is None
+
+
+def test_disabled_knob_returns_none(monkeypatch):
+    monkeypatch.setattr(get_config(), "serve_request_trace", False)
+    assert reqtrace.start_trace(route="/r") is None
+    reqtrace.finish_trace(None)  # no-op, must not raise
+
+
+# --------------------------------------------------------------------------
+# Flight recorder
+# --------------------------------------------------------------------------
+def test_flight_record_snapshots_into_event_ring():
+    from ray_tpu.observability.events import EventSeverity, global_event_manager
+
+    store = reqtrace.global_trace_store()
+    for _ in range(3):
+        store.finish(store.start(route="/llm", deployment="d"), "ok")
+    label = f"test_crash_{uuid.uuid4().hex[:8]}"
+    reqtrace.flight_record(
+        label, "engine loop crashed in a test", severity="ERROR",
+        state={"slots": 2, "queue": 7}, layer="engine",
+    )
+    events = [e for e in global_event_manager().list_events(source_type="SERVE")
+              if e.label == label]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.severity == EventSeverity.ERROR
+    assert ev.custom_fields["layer"] == "engine"
+    assert json.loads(ev.custom_fields["state"]) == {"slots": 2, "queue": 7}
+    recs = json.loads(ev.custom_fields["requests"])
+    assert len(recs) == 3 and all(r["outcome"] == "ok" for r in recs)
+
+
+def test_snapshot_due_throttles_per_key():
+    key = f"shed:test:{uuid.uuid4().hex[:8]}"
+    assert reqtrace.snapshot_due(key, min_interval_s=60.0)
+    assert not reqtrace.snapshot_due(key, min_interval_s=60.0)
+    assert reqtrace.snapshot_due(f"{key}:other", min_interval_s=60.0)
+
+
+# --------------------------------------------------------------------------
+# LLM engine: sketches + finished ring work without any HTTP ingress
+# --------------------------------------------------------------------------
+@pytest.fixture()
+def engine(params):
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_model_cfg(), params, max_batch_size=2, max_seq_len=64)
+    yield eng
+    eng.shutdown()
+
+
+def test_engine_sketches_and_finished_ring(engine):
+    out = engine.generate([3, 1, 4], max_tokens=6)
+    assert len(out) == 6
+    lat = engine.admission_snapshot()["latency"]
+    assert lat["ttft"]["count"] == 1 and lat["ttft"]["p99"] > 0.0
+    assert lat["queue_wait"]["count"] == 1
+    assert lat["e2e"]["count"] == 1 and lat["e2e"]["p99"] > 0.0
+    assert lat["inter_token"]["count"] == 5  # 6 tokens -> 5 gaps
+    rec = list(engine._finished_ring)[-1]
+    assert rec["outcome"] == "finish"
+    assert rec["generated"] == 6
+    assert rec["ttft_ms"] is not None and rec["e2e_ms"] > 0.0
+
+
+@pytest.mark.full
+def test_engine_stream_disconnect_lands_in_ring(engine):
+    it = engine.submit_stream([2, 3], max_tokens=40)
+    next(it)
+    it.close()  # client went away mid-stream
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if any(r["outcome"] == "disconnect" for r in list(engine._finished_ring)):
+            break
+        time.sleep(0.05)
+    outcomes = [r["outcome"] for r in engine._finished_ring]
+    assert "disconnect" in outcomes, outcomes
+
+
+# --------------------------------------------------------------------------
+# proxy outcome vocabulary (shed/deadline/crash/error mapping)
+# --------------------------------------------------------------------------
+def test_trace_outcome_mapping():
+    from ray_tpu.exceptions import (
+        DeadlineExceededError,
+        OverloadedError,
+        WorkerCrashedError,
+    )
+    from ray_tpu.serve.proxy import _trace_outcome
+
+    assert _trace_outcome(OverloadedError("router full"))[0] == "shed"
+    assert _trace_outcome(DeadlineExceededError("too slow"))[0] == "deadline"
+    assert _trace_outcome(WorkerCrashedError("boom"))[0] == "crash"
+    outcome, detail = _trace_outcome(ValueError("bad prompt"))
+    assert outcome == "error" and "ValueError" in detail
+
+
+# --------------------------------------------------------------------------
+# the full serving stack over HTTP: phase monotonicity + completeness
+# --------------------------------------------------------------------------
+@pytest.mark.full
+def test_http_traces_streaming_and_blocking(params):
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMServer
+
+    rt.init(num_cpus=4)
+    serve.start(http_port=0)
+    try:
+        app = serve.deployment(LLMServer).bind(
+            lambda: (_model_cfg(), params), max_batch_size=2, max_seq_len=64
+        )
+        serve.run(app, route_prefix="/llm")
+        reqtrace.global_trace_store().reset()
+
+        def post(payload):
+            req = urllib.request.Request(
+                serve.proxy_url() + "/llm",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = urllib.request.urlopen(req, timeout=120)
+            return resp.read()
+
+        post({"prompt": [3, 1, 4], "max_tokens": 4})
+        body = post({"prompt": [2, 7, 9], "max_tokens": 4, "stream": True})
+        assert b"data: " in body  # SSE frames actually streamed
+
+        snap = reqtrace.global_trace_store().snapshot(limit=10)
+        traces = snap["recent"]
+        assert len(traces) == 2
+        mark_order = {name: i for i, name in enumerate(MARKS)}
+        for tr in traces:
+            assert tr["outcome"] == "ok"
+            assert tr["deployment"] == "LLMServer" and tr["route"] == "/llm"
+            # marks: known names, strictly ordered in both index and time
+            names = [n for n, _ in tr["marks"]]
+            offsets = [t for _, t in tr["marks"]]
+            assert all(n in mark_order for n in names)
+            idx = [mark_order[n] for n in names]
+            assert idx == sorted(idx) and len(set(idx)) == len(idx)
+            assert offsets == sorted(offsets)
+            # completeness: the request reached the engine and produced
+            # tokens, so every serving phase must be present
+            phases = [p["phase"] for p in tr["phases"]]
+            assert phases == [
+                "proxy", "router_queue", "dispatch", "replica",
+                "engine_queue", "kv_block_wait", "prefill", "decode",
+            ], phases
+            assert tr["tokens"] == 4
+            assert tr["ttft_s"] is not None and 0 < tr["ttft_s"] <= tr["e2e_s"]
+            # the waterfall sums to e2e (to_dict rounds at 1 us)
+            total = sum(p["dur_s"] for p in tr["phases"])
+            assert total == pytest.approx(tr["e2e_s"], abs=1e-4)
+        dep = snap["deployments"]["LLMServer"]
+        assert dep["e2e"]["count"] == 2 and dep["e2e"]["p99"] > 0.0
+        assert dep["queue_wait"]["count"] >= 2
+    finally:
+        serve.shutdown()
+        rt.shutdown()
+
+
+# --------------------------------------------------------------------------
+# chaos contract: tracing on vs off leaves the fault log byte-identical
+# --------------------------------------------------------------------------
+@pytest.mark.full
+def test_chaos_fault_log_identical_tracing_on_vs_off(ray_start_regular):
+    """Same (seed, spec, workload), run once with serve_request_trace on
+    and once with it off: the deterministic fault logs must be identical,
+    proving the tracer consumes zero failpoint decisions (ids come from
+    os.urandom, never the seeded stream)."""
+    from ray_tpu.chaos import ChaosEvent, ChaosRunner, ChaosSchedule
+    from ray_tpu.runtime import failpoints
+
+    failpoints.reset()
+    schedule = ChaosSchedule(
+        [ChaosEvent(0.0, "arm", spec="object_store.put=raise(0.4)")],
+        seed=77, name="put-fault-traced",
+    )
+
+    def workload():
+        refs = []
+        for i in range(10):
+            tr = reqtrace.start_trace(route="/llm", deployment="chaosd")
+            if tr is not None:
+                tr.mark("router_in")
+                tr.mark("replica_in")
+            while True:  # app-level retry: each miss consumes one hit
+                try:
+                    refs.append(rt.put(("blob", i)))
+                    break
+                except failpoints.FailpointInjected:
+                    continue
+            reqtrace.finish_trace(tr, "ok")
+        assert rt.get(refs, timeout=30) == [("blob", i) for i in range(10)]
+        return refs
+
+    cfg = get_config()
+    try:
+        cfg.serve_request_trace = True
+        r_on = ChaosRunner(schedule, quiesce_timeout=60).run(workload)
+        traced = reqtrace.global_trace_store().snapshot(limit=100)
+        cfg.serve_request_trace = False
+        r_off = ChaosRunner(schedule, quiesce_timeout=60).run(workload)
+    finally:
+        cfg.serve_request_trace = True
+        failpoints.reset()
+    assert r_on.ok, (r_on.workload_error, r_on.invariants.violations)
+    assert r_off.ok, (r_off.workload_error, r_off.invariants.violations)
+    assert r_on.faults, "the put failpoint must actually fire"
+    assert r_on.same_faults(r_off), (r_on.faults, r_off.faults)
+    # and the traced run really did trace: the fault log equality above is
+    # meaningful only if tracing was exercised alongside the failpoints
+    assert len(traced["recent"]) == 10
