@@ -190,6 +190,13 @@ ROW_GROUPS = [
     # fixed-width chunks).  Own fresh-runtime group — the rows spin up
     # several engines with background decode threads.
     ["llm_paged_capacity_x", "llm_chunked_prefill_stall_p99"],
+    # elastic gang-scheduled training (ISSUE 17): step time of the same
+    # global batch split across a 1- then 2- then 4-member StageGroup gang
+    # (value = gang-1/gang-4 step time), with the in-row train-while-serve
+    # guard — a serving deployment's p99 measured while the gang steps in
+    # the background must stay within noise of its idle p99.  Own
+    # fresh-runtime group — it runs a training gang and a serve app.
+    ["train_step_scaling"],
     # prefix-aware KV reuse (ISSUE 15): wall-clock tok/s of 8 concurrent
     # streams vs the same requests served one at a time (continuous
     # batching utilization), and cold-vs-warm TTFT of a 192-token prompt
@@ -236,6 +243,7 @@ def main() -> None:
         "direct_dispatch_actor_calls_async",
         "hedged_tail_latency_p99",
         "overload_goodput",
+        "train_step_scaling",
         "llm_paged_capacity_x",
         "llm_chunked_prefill_stall_p99",
         "llm_concurrent_streams_x",

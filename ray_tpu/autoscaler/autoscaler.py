@@ -131,6 +131,21 @@ class StandardAutoscaler:
             logger.info("autoscaler: launched %d x %s", len(ids), tname)
 
         self._terminate_idle(managed, busy, demands, totals)
+        self._elastic_train_tick()
+
+    def _elastic_train_tick(self) -> None:
+        """Elastic training hook: after capacity changes land, let every
+        registered gang reconcile its size against live CPU capacity —
+        scale-up re-traces at the new mesh size, scale-down drains the
+        departing member (zero lost step state)."""
+        for name in sorted(getattr(self._cluster, "train_controllers", {})):
+            ctl = self._cluster.train_controllers.get(name)
+            if ctl is None:
+                continue
+            try:
+                ctl.elastic_tick()
+            except Exception:  # noqa: BLE001 — a wedged gang must not stall scaling
+                logger.exception("autoscaler: elastic_tick failed for train job %s", name)
 
     def _terminate_idle(
         self,

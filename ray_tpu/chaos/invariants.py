@@ -56,6 +56,20 @@ correctly, never lose or duplicate work:
      which only the typed-shed paths feed), and no shed task ever
      executed (a shed task id with a FINISHED terminal record is a
      shed-then-run double execution).
+
+Training invariant (ISSUE 17 tentpole) — repair must be bit-exact, not
+merely "it kept going":
+
+ 12. **Post-repair loss trajectory equals an uninterrupted run's**: every
+     gang repair this run recorded (``cluster.train_repair_audits``)
+     carries the restored checkpoint state and the losses the gang
+     produced after resuming; replaying the same number of steps from the
+     same state WITHOUT the gang (single-process, same seeded batches,
+     same update arithmetic) must reproduce those losses byte-for-byte
+     (float32 buffers compared with ``tobytes()``).  A repair that resumed
+     from torn state, re-sharded batches non-deterministically, or summed
+     gradients in a different order fails here even though training
+     "continued" without error.
 """
 
 from __future__ import annotations
@@ -105,6 +119,7 @@ def snapshot_baseline() -> dict:
         "num_plan_transitions": len(getattr(cluster, "plan_transitions", ())),
         "num_fence_events": getattr(cluster, "fence_events_total", 0),
         "num_overload_events": getattr(cluster, "overload_events_total", 0),
+        "num_train_repairs": len(getattr(cluster, "train_repair_audits", ())),
     }
 
 
@@ -418,4 +433,30 @@ def check_invariants(
                 "shed-then-run double execution"
             )
     report.checked["overload_sheds"] = len(overload_events)
+
+    # 12. post-repair loss trajectory equals an uninterrupted run's ---------
+    audits = list(getattr(cluster, "train_repair_audits", ()))
+    if baseline is not None:
+        audits = audits[baseline.get("num_train_repairs", 0):]
+    replayed_steps = 0
+    for audit in audits:
+        losses = list(audit.get("losses", ()))
+        if not losses:
+            continue  # repair landed but no post-repair step ran this run
+        import numpy as np
+
+        expected = audit["replay"](
+            audit["state"], audit["world_size"], len(losses)
+        )
+        got = np.asarray(losses, np.float32).tobytes()
+        want = np.asarray(expected, np.float32).tobytes()
+        if got != want:
+            report.add(
+                f"train repair of {audit.get('controller')!r} at step "
+                f"{audit.get('start_step')} ({audit.get('outcome')}) diverged "
+                f"from the uninterrupted replay over {len(losses)} step(s)"
+            )
+        replayed_steps += len(losses)
+    report.checked["train_repairs"] = len(audits)
+    report.checked["train_replayed_steps"] = replayed_steps
     return report

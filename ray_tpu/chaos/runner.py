@@ -260,7 +260,39 @@ class ChaosRunner:
                 float(p.get("cpus", 1.0)),
                 float(p.get("hold_s", 0.0)),
             )
+        if event.kind == "preempt_gang_member":
+            return self._preempt_gang_member(
+                cluster,
+                p.get("job"),
+                p.get("index"),
+                bool(p.get("graceful", True)),
+            )
         return {}
+
+    @staticmethod
+    def _preempt_gang_member(cluster, job, index, graceful: bool) -> dict:
+        """Preempt one member of a registered training gang.  Like the
+        overload injector this consumes no failpoint decisions, so
+        same-seed fault logs stay byte-identical; what it perturbs is the
+        gang itself.  ``graceful=True`` exercises the serving-burst ladder
+        (checkpoint → shrink → continue); ``graceful=False`` hard-kills the
+        member, and the workload's repair must resume bit-exact from the
+        latest step checkpoint (invariant 12 audits the resumed loss
+        trajectory against an uninterrupted replay)."""
+        controllers = getattr(cluster, "train_controllers", {})
+        if job is None:
+            names = sorted(controllers)
+            if not names:
+                return {"skipped": "no registered training gangs"}
+            job = names[0]
+        ctl = controllers.get(job)
+        if ctl is None:
+            return {"skipped": f"no training gang named {job!r}"}
+        if graceful:
+            new_size = ctl.preempt_member(index, graceful=True)
+            return {"job": job, "graceful": True, "gang_size": new_size}
+        ctl.preempt_member(index, graceful=False)
+        return {"job": job, "graceful": False, "killed_index": index}
 
     def _inject_overload(self, tasks: int, cpus: float, hold_s: float) -> dict:
         """Deterministic synthetic load burst: ``tasks`` submissions each

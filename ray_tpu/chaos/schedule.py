@@ -58,6 +58,14 @@ Event kinds
                   OverloadedError, never grow a queue or double-execute
                   (invariant 11).  The injector's refs join the invariant
                   sweep's resolution set.
+``preempt_gang_member``  preempt one member of a registered training gang
+                  (``job`` names the TrainController; default: the first
+                  registered, sorted).  ``graceful=True`` (default) drives
+                  the checkpoint → shrink → continue ladder the serving
+                  admission path uses; ``graceful=False`` hard-kills the
+                  member (``kill -9`` equivalent), which must flip the plan
+                  BROKEN with a typed error and repair bit-exact from the
+                  latest step checkpoint (invariant 12).
 """
 
 from __future__ import annotations
@@ -69,6 +77,7 @@ _KINDS = (
     "arm", "disarm", "partition", "kill_node", "lose_objects",
     "add_node", "drain_node", "kill_head", "restart_head",
     "slow_node", "partition_node", "heal_partition", "overload",
+    "preempt_gang_member",
 )
 
 
@@ -171,6 +180,11 @@ _EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "cpus": (False, (int, float)),
         "hold_s": (False, (int, float)),
     },
+    "preempt_gang_member": {
+        "job": (False, (str,)),
+        "index": (False, (int,)),
+        "graceful": (False, (bool,)),
+    },
 }
 
 
@@ -230,7 +244,7 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
                 )
                 continue
             types = schema[pname][1]
-            if not isinstance(pval, types) or isinstance(pval, bool):
+            if not isinstance(pval, types) or (isinstance(pval, bool) and bool not in types):
                 names = "/".join(tp.__name__ for tp in types)
                 errors.append(
                     f"{where} ({kind}): {pname!r} must be {names}, got {pval!r}"
@@ -255,6 +269,9 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
         if kind == "slow_node" and isinstance(ev.get("delay"), (int, float)) \
                 and ev["delay"] < 0:
             errors.append(f"{where} (slow_node): 'delay' must be >= 0")
+        if kind == "preempt_gang_member" and isinstance(ev.get("index"), int) \
+                and ev["index"] < 0:
+            errors.append(f"{where} (preempt_gang_member): 'index' must be >= 0")
         if kind == "overload":
             if isinstance(ev.get("tasks"), int) and ev["tasks"] < 1:
                 errors.append(f"{where} (overload): 'tasks' must be >= 1")

@@ -321,6 +321,21 @@ class Config:
     # LRU-evicted before admission holds or sheds either way.
     prefix_cache_max_blocks: int = 0
 
+    # ---- elastic gang-scheduled training (train/controller.py) -----------
+    # Steps between TrainController step checkpoints (optimizer/step/RNG
+    # state, digest-framed).  Repair-and-resume restores from the latest
+    # one, so the period bounds recomputed work after a gang-member death.
+    train_checkpoint_period_steps: int = 10
+    # Floor on gang size: shrink recovery (a permanently-dead or preempted
+    # member) and elastic resize never drop the gang below this many
+    # members; below it the typed failure surfaces to the caller instead.
+    train_gang_min_members: int = 1
+    # Register the training gang with the admission machinery as a
+    # preemptible background tenant: a serving burst may preempt members
+    # (checkpoint -> shrink -> continue) and training absorbs spare
+    # capacity.  Off = the gang holds its members like any foreground job.
+    train_preemptible: bool = False
+
     # ---- request-scope serving observability -----------------------------
     # Lifecycle traces for serve requests (observability/reqtrace.py): a
     # RequestTrace born at the HTTP proxy rides the request through

@@ -97,8 +97,9 @@ class StageGroup:
     outputs into one ``jax.Array`` (mesh-sharded via
     ``jax.make_array_from_single_device_arrays`` when ``mesh`` — a name
     registered in ``parallel.mesh.mesh_manager()`` — matches the member
-    count, a device concat otherwise).  ``warmup=(shape, dtype)`` primes
-    every member's jit trace ONCE at install on a zeros example of the
+    count, a device concat otherwise).  ``warmup=(shape, dtype)`` — or a
+    sequence of such pairs for multi-argument steps — primes every
+    member's jit trace ONCE at install on zeros examples of the
     per-member split, so iterations never retrace (trace-once,
     execute-many).  All members must be co-hosted in one process; a member
     death flips the plan BROKEN with :class:`ActorDiedError` and
@@ -149,8 +150,12 @@ def _group_payload(group: Optional[StageGroup], wire: bool) -> Optional[dict]:
         return None
     warm = None
     if group.warmup is not None:
-        shape, dtype = group.warmup
-        warm = [list(shape), str(dtype)]
+        pairs = group.warmup
+        # legacy single (shape, dtype) vs a sequence of them (multi-arg
+        # steps): a shape's first element is an int, a pair's is a shape
+        if len(pairs) == 2 and not (pairs[0] and isinstance(pairs[0][0], (list, tuple))):
+            pairs = [pairs]
+        warm = [[list(shape), str(dtype)] for shape, dtype in pairs]
     return {
         "members": [
             (a._actor_id.binary() if wire else a._actor_id) for a in group.actors

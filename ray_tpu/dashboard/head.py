@@ -199,6 +199,8 @@ class DashboardHead:
             )
         elif path == "/api/plans":
             req._send(200, self._plan_stats())
+        elif path == "/api/train":
+            req._send(200, self._train_stats())
         elif path == "/api/memory":
             req._send(200, self._memory_summary())
         elif path == "/api/data/datasets":
@@ -501,6 +503,35 @@ class DashboardHead:
                 "device_channel_occupancy": dev["occupied_slots"],
                 "hbm_resident_bytes": dev["hbm_resident_bytes"],
                 "stage_group_executions": metric_defs.PLAN_STAGE_GROUP_EXECUTIONS.get(),
+            },
+        }
+
+    def _train_stats(self) -> dict:
+        """`rt train`: every registered training gang's live status (size,
+        step, last checkpoint, resize/repair history) plus the process-wide
+        training counters — 'is the gang making steps, and what did it
+        survive?'."""
+        from ray_tpu.observability import metric_defs
+
+        jobs = []
+        for name in sorted(getattr(self.cluster, "train_controllers", {})):
+            ctl = self.cluster.train_controllers.get(name)
+            if ctl is None:
+                continue
+            try:
+                jobs.append(ctl.status())
+            except Exception:  # noqa: BLE001 — one wedged gang must not 500 the API
+                jobs.append({"name": name, "error": "status unavailable"})
+        return {
+            "jobs": jobs,
+            "totals": {
+                "steps": metric_defs.TRAIN_STEPS.get(),
+                "resizes_scale_up": metric_defs.TRAIN_GANG_RESIZES.get({"reason": "scale_up"}),
+                "resizes_scale_down": metric_defs.TRAIN_GANG_RESIZES.get({"reason": "scale_down"}),
+                "resizes_preempt": metric_defs.TRAIN_GANG_RESIZES.get({"reason": "preempt"}),
+                "repairs_repaired": metric_defs.TRAIN_REPAIRS.get({"outcome": "repaired"}),
+                "repairs_shrunk": metric_defs.TRAIN_REPAIRS.get({"outcome": "shrunk"}),
+                "repairs_failed": metric_defs.TRAIN_REPAIRS.get({"outcome": "failed"}),
             },
         }
 

@@ -808,3 +808,38 @@ class ExecutorStats:
         if self.wall_s:
             lines.append(f"Dataset execution time: {_t(self.wall_s)}")
         return "\n".join(lines).rstrip()
+
+
+# --------------------------------------------------------------------------
+# Training feed: RefBundles -> one deterministic feature matrix
+# --------------------------------------------------------------------------
+def bundles_to_feature_rows(bundles: Iterator[RefBundle]) -> np.ndarray:
+    """Materialize an ORDERED RefBundle stream into one ``[N, F]`` float32
+    feature matrix — the global row order elastic training batches index
+    into (``train/controller.py global_batch``).
+
+    Columns are flattened in sorted-name order (scalars contribute one
+    feature, fixed-width vectors their width), so the matrix — and with it
+    every training batch — is a pure function of the dataset contents,
+    independent of block boundaries or gang size.  Pass the result of
+    ``dataset._execute(preserve_order=True)`` so block order matches the
+    logical row order."""
+    feature_blocks: List[np.ndarray] = []
+    for bundle in bundles:
+        for ref in bundle.refs:
+            block = normalize_block(ray_tpu.get(ref))
+            if not block:
+                continue
+            cols = []
+            for name in sorted(block):
+                col = np.asarray(block[name])
+                if col.dtype == object:
+                    raise TypeError(
+                        f"column {name!r} is not numeric; the training feed "
+                        "needs numeric features"
+                    )
+                cols.append(col.reshape(col.shape[0], -1).astype(np.float32))
+            feature_blocks.append(np.concatenate(cols, axis=1))
+    if not feature_blocks:
+        raise ValueError("dataset produced no rows to train on")
+    return np.ascontiguousarray(np.concatenate(feature_blocks, axis=0))

@@ -276,6 +276,36 @@ PLAN_REPAIRS = _reg.counter(
     "failed = a stage actor never came back).",
 )
 
+# ---- elastic gang-scheduled training (train/controller.py) ---------------
+TRAIN_STEPS = _reg.counter(
+    "train_steps_total",
+    "Optimizer steps completed by TrainController gang jobs (each step is "
+    "one StageGroup dispatch: per-member grad shards assembled and summed "
+    "in fixed member order, then one jit'd optimizer update).",
+)
+TRAIN_GANG_RESIZES = _reg.counter(
+    "train_gang_resizes_total",
+    "Elastic gang resizes, by reason (scale_up = capacity grew and the "
+    "step re-traced at the larger mesh, scale_down = graceful drain of "
+    "departing members, preempt = a serving burst or chaos event took "
+    "members and the gang shrank to continue).",
+)
+TRAIN_REPAIRS = _reg.counter(
+    "train_repairs_total",
+    "Gang repair-and-resume recoveries, by outcome (repaired = repair() "
+    "restored the same gang on restarted members, shrunk = a permanently "
+    "dead member forced a rebuild at a smaller size, failed = recovery "
+    "was impossible and the typed error surfaced to the caller).",
+)
+TRAIN_CHECKPOINT_SECONDS = _reg.histogram(
+    "train_checkpoint_seconds",
+    "Wall time of one digest-framed step-state checkpoint write "
+    "(tmp+fsync+rename with .prev rotation) — the synchronous pause the "
+    "train loop pays every train_checkpoint_period_steps.",
+    "s",
+    boundaries=_LATENCY_BOUNDS,
+)
+
 # ---- gray failures: fencing, deadlines, hedging --------------------------
 FENCED_FRAMES = _reg.counter(
     "fenced_frames_total",
@@ -488,6 +518,10 @@ ALL_METRICS = [
     DRAIN_EVACUATED_BYTES,
     HEAD_RESTARTS,
     PLAN_REPAIRS,
+    TRAIN_STEPS,
+    TRAIN_GANG_RESIZES,
+    TRAIN_REPAIRS,
+    TRAIN_CHECKPOINT_SECONDS,
     FENCED_FRAMES,
     NODE_REJOINS,
     TASK_DEADLINE_EXCEEDED,

@@ -467,10 +467,12 @@ class StageExecutor:
 
     # ------------------------------------------------------------------
     def _warmup_group(self, stage: StageSpec) -> None:
-        """Install-time trace priming: invoke every gang member ONCE on a
-        zeros example shaped like its per-member split, so the jit'd step
+        """Install-time trace priming: invoke every gang member ONCE on
+        zeros examples shaped like its per-member split, so the jit'd step
         traces at install and every ``execute`` is a pure cached call
-        (trace-once, execute-many)."""
+        (trace-once, execute-many).  One ``[shape, dtype]`` pair per step
+        argument; each pair follows the same split-or-replicate rule
+        ``_invoke_group`` applies to real inputs."""
         g = stage.group
         warm = g.get("warmup")
         if not warm:
@@ -479,14 +481,18 @@ class StageExecutor:
 
         from ray_tpu.dag.channel import device_place
 
-        shape, dtype = list(warm[0]), warm[1]
+        # legacy single [shape, dtype] vs a list of such pairs
+        pairs = [warm] if len(warm) == 2 and isinstance(warm[1], str) else warm
         n = len(g["members"])
         axis = g.get("split_axis", 0)
-        if n > 1 and len(shape) > axis and shape[axis] % n == 0:
-            shape[axis] //= n
-        x = device_place(np.zeros(tuple(shape), dtype=np.dtype(dtype)))
+        examples = []
+        for shape, dtype in pairs:
+            shape = list(shape)
+            if n > 1 and len(shape) > axis and shape[axis] % n == 0:
+                shape[axis] //= n
+            examples.append(device_place(np.zeros(tuple(shape), dtype=np.dtype(dtype))))
         for inst, actor_id in zip(self._group_insts[stage.stage_id], g["members"]):
-            self._invoker.invoke(inst, actor_id, stage.method, (x,), {})
+            self._invoker.invoke(inst, actor_id, stage.method, tuple(examples), {})
 
     def _group_mesh(self, g: dict):
         name = g.get("mesh")

@@ -410,6 +410,15 @@ class Cluster:
         # by the chaos elasticity invariants (nothing with a surviving
         # replica may be lost by a drain)
         self.drain_reports: List[dict] = []
+        # live TrainController gang jobs (train/controller.py): name ->
+        # controller.  The chaos `preempt_gang_member` kind and /api/train
+        # find their targets here.
+        self.train_controllers: Dict[str, Any] = {}
+        # one audit row per gang repair/shrink recovery: checkpoint path,
+        # resume step, world size, and the accumulating post-repair loss
+        # bytes — invariant 12 replays these from the checkpoint and
+        # byte-compares the trajectories
+        self.train_repair_audits: List[dict] = []
         # head failover simulation state (kill_head/restart_head chaos
         # hooks); the lock makes the _head_down check and a snapshot write
         # atomic — the periodic writer must never clobber the kill-time

@@ -465,6 +465,50 @@ def cmd_plans(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    """``rt train``: registered training gangs — size, step, last
+    checkpoint, resize/repair history, and the process-wide step /
+    resize / repair counters."""
+    address = _read_address(args.address)
+    data = _get(address, "/api/train")
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    totals = data.get("totals", {})
+    jobs = data.get("jobs", [])
+    print(
+        f"train: {len(jobs)} gang(s), {totals.get('steps', 0):.0f} steps, "
+        f"resizes {totals.get('resizes_scale_up', 0):.0f} up / "
+        f"{totals.get('resizes_scale_down', 0):.0f} down / "
+        f"{totals.get('resizes_preempt', 0):.0f} preempt, "
+        f"repairs {totals.get('repairs_repaired', 0):.0f} repaired / "
+        f"{totals.get('repairs_shrunk', 0):.0f} shrunk / "
+        f"{totals.get('repairs_failed', 0):.0f} failed"
+    )
+    for job in jobs:
+        if job.get("error"):
+            print(f"  job {job['name']}: {job['error']}")
+            continue
+        loss = job.get("last_loss")
+        loss_s = f"{loss:.4f}" if loss is not None else "-"
+        print(
+            f"  job {job['name']} [{job.get('plan_state')}]: "
+            f"gang {job['gang_size']}, step {job['step']}, loss {loss_s}, "
+            f"ckpt {job.get('last_checkpoint') or '-'}"
+        )
+        for r in job.get("resizes", ()):
+            print(
+                f"    resize @step {r['step']}: {r['from']} -> {r['to']} "
+                f"({r['reason']})"
+            )
+        for r in job.get("repairs", ()):
+            print(
+                f"    repair @step {r['step']}: {r['outcome']} "
+                f"(gang {r.get('world_size', '?')}, {r.get('error') or 'no error'})"
+            )
+    return 0
+
+
 def cmd_nodes(args) -> int:
     """``rt nodes``: per-node lifecycle state (ALIVE / DRAINING / DEAD),
     drain history with evacuation totals, head restarts, and the autoscaler
@@ -900,6 +944,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=["table", "json"], default="table")
     sp.set_defaults(fn=cmd_plans)
+
+    sp = sub.add_parser(
+        "train",
+        help="training gangs: size, step, last checkpoint, resize/repair "
+        "history, step/resize/repair counters",
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser(
         "nodes",

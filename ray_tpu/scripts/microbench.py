@@ -793,6 +793,87 @@ def run_suite(
         finally:
             plan.teardown()
 
+    # ---- elastic gang training (ISSUE 17) --------------------------------
+    if wanted("train_step_scaling"):
+        # Step time vs gang size through a TrainController StageGroup gang:
+        # the same global batch split across 1, then 2, then 4 members
+        # (elastic resize re-traces once per new mesh size).  Row value =
+        # median step time at gang 1 / at gang 4 (x) — what the split
+        # actually buys end to end, gang dispatch included.
+        # In-row guard (train-while-serve): a serving deployment's p99
+        # measured WHILE the gang steps in the background must stay within
+        # noise of its idle p99 — training registers as a preemptible
+        # background tenant, and a step must never stall a serving burst
+        # beyond the generous shared-box bound asserted below.
+        from ray_tpu import serve
+        from ray_tpu.train.controller import TrainController
+
+        @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+        class _Echo:
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(_Echo.bind(), route_prefix=None)
+        assert handle.remote(0).result(timeout=30) == 0  # warm the replica
+
+        def serve_p99(calls: int) -> float:
+            lat = []
+            for i in range(calls):
+                t0 = time.perf_counter()
+                handle.remote(i).result(timeout=30)
+                lat.append(time.perf_counter() - t0)
+            return float(np.percentile(np.asarray(lat), 99))
+
+        ctl = TrainController(
+            "bench_scaling",
+            world_size=1,
+            batch_size=32,
+            feature_dim=64,
+            seed=11,
+            checkpoint_period=10**9,  # no checkpoint I/O inside the timing
+            preemptible=True,
+            # zero-CPU members: the gang must coexist with the serving
+            # deployment on the 4-CPU bench runtime (inproc members burn
+            # no scheduler capacity anyway)
+            member_resources=[{}],
+        )
+        steps = N(20)
+        try:
+            step_us = {}
+            for size in (1, 2, 4):
+                if size != ctl.world_size:
+                    ctl.resize(size, reason="scale_up")
+                for _ in range(3):  # absorb the re-trace + warm the path
+                    ctl.step()
+                step_us[size] = 1e6 / _rate(ctl.step, steps, warmup=0, rounds=3)
+
+            idle_p99 = serve_p99(100)
+            stop = threading.Event()
+
+            def background_train():
+                while not stop.is_set():
+                    ctl.step()
+
+            trainer_thread = threading.Thread(target=background_train, daemon=True)
+            trainer_thread.start()
+            try:
+                busy_p99 = serve_p99(100)
+            finally:
+                stop.set()
+                trainer_thread.join(timeout=30)
+            # generous shared-box bound: the guard catches a gang that
+            # wedges serving (seconds-long head-of-line stalls), not
+            # scheduler jitter on a contended core
+            if busy_p99 > 5 * idle_p99 + 0.100:
+                raise AssertionError(
+                    f"serving p99 regressed under background training: "
+                    f"{busy_p99 * 1e3:.1f}ms busy vs {idle_p99 * 1e3:.1f}ms idle"
+                )
+            record("train_step_scaling", step_us[1] / max(step_us[4], 1e-9), "x")
+        finally:
+            ctl.shutdown()
+            serve.shutdown()
+
     # ---- placement groups ------------------------------------------------
     if wanted("placement_group_create_removal"):
         from ray_tpu.util.placement import placement_group, remove_placement_group

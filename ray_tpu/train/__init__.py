@@ -7,6 +7,7 @@ metrics/checkpoints to the driver, and checkpoints are pytree directories.
 """
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.controller import TrainController, global_batch
 from ray_tpu.train.config import (
     TRAIN_DATASET_KEY,
     BackendConfig,
@@ -58,6 +59,8 @@ __all__ = [
     "ScalingConfig",
     "TorchTrainer",
     "TrainContext",
+    "TrainController",
+    "global_batch",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",

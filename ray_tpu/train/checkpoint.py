@@ -7,17 +7,84 @@ metrics attached by the session).
 TPU-first delta: first-class helpers for jax pytrees — ``from_pytree`` /
 ``to_pytree`` serialize a params pytree via orbax when available, falling
 back to a pickled host copy (``jax.device_get``) otherwise.
+
+Durability: every pickled artifact is written with the crash-atomic framing
+the control-plane snapshots use (``runtime/control.py save_snapshot``):
+``magic + blake2b-16(payload) + payload`` into a temp file, fsync, atomic
+rename, with the previous generation rotated to ``<path>.prev`` first.  A
+writer killed at ANY instant (kill -9 chaos mid-checkpoint) leaves either
+the new complete file or the previous complete one; restore rejects torn
+files on the digest and falls back to ``.prev``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import os
 import pickle
 import shutil
 import tempfile
 import uuid
 from typing import Any, Dict, Iterator, Optional
+
+#: framing shared by every pickled checkpoint artifact.  Distinct magic from
+#: the control snapshot (RTSNAP1) so a mis-pointed restore fails loudly.
+_CKPT_MAGIC = b"RTCKPT1\n"
+
+
+def save_framed(path: str, obj: Any) -> None:
+    """Crash-atomic pickled write: digest framing + tmp + fsync + rename,
+    rotating the previous generation to ``<path>.prev``."""
+    payload = pickle.dumps(obj, protocol=5)
+    digest = hashlib.blake2b(payload, digest_size=16).digest()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(_CKPT_MAGIC + digest + payload)
+        f.flush()
+        os.fsync(f.fileno())  # bytes durable BEFORE the rename publishes them
+    if os.path.exists(path):
+        # keep the last good generation: a crash between the two renames
+        # still leaves .prev for load_framed's fallback
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)  # atomic: readers never see a torn file
+    try:
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)  # the renames themselves survive power loss
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        pass
+
+
+def _load_framed_file(path: str) -> Optional[Any]:
+    """One framed file -> object, or None if missing/torn.  The digest check
+    rejects truncated and bit-flipped files before pickle ever sees them;
+    headerless files fall back to plain pickle (pre-framing artifacts)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        if raw.startswith(_CKPT_MAGIC):
+            off = len(_CKPT_MAGIC)
+            digest, payload = raw[off:off + 16], raw[off + 16:]
+            if hashlib.blake2b(payload, digest_size=16).digest() != digest:
+                raise ValueError("checkpoint digest mismatch (torn/partial write)")
+            return pickle.loads(payload)
+        return pickle.loads(raw)
+    except Exception:  # noqa: BLE001 — a torn file must fall back, not raise
+        return None
+
+
+def load_framed(path: str) -> Optional[Any]:
+    """Framed file -> object; a rejected current generation restores the
+    ``.prev`` one rotated by :func:`save_framed`.  None when neither loads."""
+    obj = _load_framed_file(path)
+    if obj is None:
+        obj = _load_framed_file(path + ".prev")
+    return obj
 
 
 class Checkpoint:
@@ -45,13 +112,16 @@ class Checkpoint:
     def from_dict(cls, data: Dict[str, Any], base_dir: Optional[str] = None) -> "Checkpoint":
         path = os.path.join(base_dir or tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:12]}")
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "data.pkl"), "wb") as f:
-            pickle.dump(data, f, protocol=5)
+        save_framed(os.path.join(path, "data.pkl"), data)
         return cls(path)
 
     def to_dict(self) -> Dict[str, Any]:
-        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
-            return pickle.load(f)
+        data = load_framed(os.path.join(self.path, "data.pkl"))
+        if data is None:
+            raise FileNotFoundError(
+                f"no readable checkpoint data at {self.path} (missing or torn)"
+            )
+        return data
 
     # ------------------------------------------------------------- pytrees
     @classmethod
@@ -69,8 +139,7 @@ class Checkpoint:
         except Exception:
             import jax
 
-            with open(os.path.join(path, "pytree.pkl"), "wb") as f:
-                pickle.dump(jax.device_get(tree), f, protocol=5)
+            save_framed(os.path.join(path, "pytree.pkl"), jax.device_get(tree))
         return cls(path)
 
     def to_pytree(self) -> Any:
@@ -79,8 +148,12 @@ class Checkpoint:
             import orbax.checkpoint as ocp
 
             return ocp.PyTreeCheckpointer().restore(orbax_path)
-        with open(os.path.join(self.path, "pytree.pkl"), "rb") as f:
-            return pickle.load(f)
+        tree = load_framed(os.path.join(self.path, "pytree.pkl"))
+        if tree is None:
+            raise FileNotFoundError(
+                f"no readable pytree checkpoint at {self.path} (missing or torn)"
+            )
+        return tree
 
     def __repr__(self) -> str:
         return f"Checkpoint(path={self.path})"

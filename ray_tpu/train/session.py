@@ -30,6 +30,10 @@ class TrainContext:
     # unique per worker-gang attempt; scopes cross-rank rendezvous keys so
     # retries / concurrent same-name runs can never read each other's state
     group_token: str = ""
+    # how many times the gang has been rebuilt after a failure (0 on the
+    # first attempt): repair-and-resume loops use this to distinguish a
+    # fresh run from a restart resuming off train.get_checkpoint()
+    restart_count: int = 0
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -63,6 +67,10 @@ class TrainContext:
     def get_group_token(self) -> str:
         """Opaque id shared by all ranks of one gang attempt."""
         return self.group_token
+
+    def get_restart_count(self) -> int:
+        """0 on the first gang attempt, incremented per repair restart."""
+        return self.restart_count
 
 
 class _Session:

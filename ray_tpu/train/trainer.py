@@ -143,10 +143,11 @@ class DataParallelTrainer(BaseTrainer):
         self.train_loop_config = train_loop_config or {}
         self.dataset_config = dataset_config or DataConfig()
 
-    def training_iterator(self) -> "TrainingIterator":
-        """Stream rank-0 reports while the gang trains (one attempt,
-        caller-owned loop); ``fit()`` remains the retrying path."""
-        return TrainingIterator(self)
+    def training_iterator(self, *, auto_repair: bool = False) -> "TrainingIterator":
+        """Stream rank-0 reports while the gang trains (caller-owned loop);
+        ``fit()`` remains the batch path.  ``auto_repair=True`` restarts the
+        gang from the best checkpoint on a worker death instead of raising."""
+        return TrainingIterator(self, auto_repair=auto_repair)
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -165,7 +166,11 @@ class DataParallelTrainer(BaseTrainer):
 
         while True:
             group = WorkerGroup(
-                self.scaling_config, name, trial_dir, execution=self._worker_execution
+                self.scaling_config,
+                name,
+                trial_dir,
+                execution=self._worker_execution,
+                restart_count=attempt,
             )
             group.start()
             shards = self.dataset_config.configure(self.datasets, self.scaling_config.num_workers)
@@ -181,6 +186,10 @@ class DataParallelTrainer(BaseTrainer):
                     # Surface a rank's failure immediately — sibling ranks
                     # blocked in a collective on the dead rank never finish,
                     # so waiting for the full gang would hang fit() forever.
+                    if not finished:
+                        dead = group.dead_workers()
+                        if dead:
+                            raise dead[0][1]
                     ray_tpu.get(finished)
                     done_refs.extend(finished)
                     reports, _ = group.poll_all()
@@ -223,14 +232,24 @@ class DataParallelTrainer(BaseTrainer):
 
 
 class TrainingIterator:
-    """Streamed per-report iteration over ONE training-gang run
+    """Streamed per-report iteration over a training-gang run
     (reference: train/trainer.py TrainingIterator — the internal iterator
     fit() drains).  Yields rank-0 report rows as they arrive; ``result()``
-    afterwards returns the terminal :class:`Result`.  Unlike ``fit()`` it
-    does not retry on failure — the caller owns the loop."""
+    afterwards returns the terminal :class:`Result`.
 
-    def __init__(self, trainer: "DataParallelTrainer"):
+    Fault contract: a gang member that dies mid-step (``kill -9`` included)
+    surfaces as the **typed** error — ``ActorDiedError`` /
+    ``WorkerCrashedError`` — never a hang.  The rank-0 drain loop probes the
+    control plane's actor table between waits, so a rank whose run future
+    can no longer resolve is converted to its typed death immediately.
+    With ``auto_repair=True`` the death instead restarts the gang from the
+    best checkpoint seen so far (repair budget:
+    ``run_config.failure_config.max_failures``, 0 meaning a small default);
+    otherwise the typed error is raised to the caller."""
+
+    def __init__(self, trainer: "DataParallelTrainer", *, auto_repair: bool = False):
         self._trainer = trainer
+        self._auto_repair = auto_repair
         self._result: Optional[Result] = None
 
     def __iter__(self):
@@ -243,41 +262,75 @@ class TrainingIterator:
         last_metrics: Dict[str, Any] = {}
         best_checkpoint = t.resume_from_checkpoint
         error: Optional[BaseException] = None
-        group = WorkerGroup(t.scaling_config, name, trial_dir, execution=t._worker_execution)
-        group.start()
+        max_failures = t.run_config.failure_config.max_failures
+        repairs_left = (max_failures if max_failures > 0 else 3) if max_failures != -1 else -1
 
-        def drain_rank0():
-            # one drain of the group's buffered reports -> rank-0 rows
-            reports, _ = group.poll_all()
-            for rank, metrics, ckpt in reports:
-                if rank != 0:
-                    continue
-                row = dict(metrics)
-                history.append(row)
-                nonlocal last_metrics, best_checkpoint
-                last_metrics = row
-                if ckpt is not None:
-                    best_checkpoint = ckpt
-                yield row
-
+        attempt = 0
         try:
-            shards = t.dataset_config.configure(t.datasets, t.scaling_config.num_workers)
-            futures = group.run_async(
-                t.train_loop_per_worker, t.train_loop_config, shards, best_checkpoint
-            )
-            pending = list(futures)
-            done_refs: list = []
-            while pending:
-                finished, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.2)
-                ray_tpu.get(finished)
-                done_refs.extend(finished)
-                yield from drain_rank0()
-            ray_tpu.get(done_refs)
-            yield from drain_rank0()
-        except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
-            error = exc
+            while True:
+                group = WorkerGroup(
+                    t.scaling_config,
+                    name,
+                    trial_dir,
+                    execution=t._worker_execution,
+                    restart_count=attempt,
+                )
+                group.start()
+
+                def drain_rank0(group=group):
+                    # one drain of the group's buffered reports -> rank-0 rows
+                    reports, _ = group.poll_all()
+                    for rank, metrics, ckpt in reports:
+                        if rank != 0:
+                            continue
+                        row = dict(metrics)
+                        history.append(row)
+                        nonlocal last_metrics, best_checkpoint
+                        last_metrics = row
+                        if ckpt is not None:
+                            best_checkpoint = ckpt
+                        yield row
+
+                try:
+                    shards = t.dataset_config.configure(
+                        t.datasets, t.scaling_config.num_workers
+                    )
+                    futures = group.run_async(
+                        t.train_loop_per_worker, t.train_loop_config, shards, best_checkpoint
+                    )
+                    pending = list(futures)
+                    done_refs: list = []
+                    while pending:
+                        finished, pending = ray_tpu.wait(
+                            pending, num_returns=len(pending), timeout=0.2
+                        )
+                        if not finished:
+                            # Liveness guard: a DEAD rank whose future is
+                            # still pending (siblings blocked on it in a
+                            # collective) must raise typed, not hang.
+                            dead = group.dead_workers()
+                            if dead:
+                                raise dead[0][1]
+                        ray_tpu.get(finished)
+                        done_refs.extend(finished)
+                        yield from drain_rank0()
+                    ray_tpu.get(done_refs)
+                    yield from drain_rank0()
+                    error = None
+                    break
+                except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
+                    error = exc
+                    if not self._auto_repair:
+                        break
+                    if repairs_left == 0:
+                        break
+                    if repairs_left > 0:
+                        repairs_left -= 1
+                    attempt += 1
+                    # repair: restart the gang from the best checkpoint
+                finally:
+                    group.shutdown()
         finally:
-            group.shutdown()
             self._result = Result(
                 metrics=last_metrics,
                 checkpoint=best_checkpoint,
@@ -298,7 +351,85 @@ class JaxTrainer(DataParallelTrainer):
     """The flagship TPU trainer (replaces the reference's TorchTrainer +
     Torch-XLA backend, ``train/torch/xla/config.py:20``): the worker gang
     shares the chip grid, each rank owning a submesh; the user loop builds
-    pjit/shard_map programs over ``train.get_context().get_mesh()``."""
+    pjit/shard_map programs over ``train.get_context().get_mesh()``.
+
+    Two modes:
+
+    * **user-loop mode** (``train_loop_per_worker`` given): the classic
+      DataParallelTrainer path — the loop runs on every rank of a
+      :class:`WorkerGroup` gang.
+    * **gang mode** (``train_loop_per_worker=None`` and ``gang=dict(...)``):
+      the data-parallel step compiles to a plan whose training stage is a
+      ``StageGroup`` gang driven by a
+      :class:`~ray_tpu.train.controller.TrainController` — repairable
+      (member death → BROKEN → repair, bit-exact resume from the latest
+      step checkpoint), elastic (autoscaler grow/shrink), and preemptible
+      by serving bursts.  ``gang`` keys are TrainController kwargs
+      (``world_size``, ``batch_size``, ``feature_dim``, ``seed``, ...)
+      plus an optional ``num_steps``; a ``datasets={"train": ds}`` entry
+      feeds the gang from the streaming Dataset executor.  Whether a
+      mid-run member death auto-repairs follows
+      ``run_config.failure_config.max_failures`` (0 → the typed error
+      propagates into ``Result.error``).  The controller stays alive after
+      ``fit()`` as ``self.controller`` for status/resize/shutdown.
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Optional[Callable] = None,
+        *,
+        gang: Optional[dict] = None,
+        num_steps: Optional[int] = None,
+        **kwargs,
+    ):
+        if train_loop_per_worker is None and gang is None:
+            raise ValueError(
+                "JaxTrainer needs either train_loop_per_worker (user-loop "
+                "mode) or gang=dict(...) (compiled StageGroup gang mode)"
+            )
+        self.gang = dict(gang) if gang is not None else None
+        self.num_steps = num_steps
+        self.controller = None  # set by gang-mode fit()
+        super().__init__(train_loop_per_worker, **kwargs)
+
+    def fit(self) -> Result:
+        if self.train_loop_per_worker is not None:
+            return super().fit()
+        from ray_tpu.train.controller import TrainController
+
+        name = self.run_config.name or f"JaxTrainer_{int(time.time())}"
+        spec = dict(self.gang or {})
+        num_steps = int(
+            self.num_steps
+            if self.num_steps is not None
+            else spec.pop("num_steps", 10)
+        )
+        spec.pop("num_steps", None)
+        if self.datasets and "dataset" not in spec:
+            spec["dataset"] = self.datasets.get("train")
+        ctl = self.controller = TrainController(name, **spec)
+        auto_repair = self.run_config.failure_config.max_failures != 0
+        error: Optional[BaseException] = None
+        try:
+            ctl.run(num_steps, auto_repair=auto_repair)
+            ctl.save_checkpoint()
+        except (RayTaskError, RayActorError, WorkerCrashedError) as exc:
+            error = exc
+        losses = ctl.losses()
+        ckpt_dir = os.path.dirname(ctl.checkpoint_path)
+        return Result(
+            metrics={
+                "step": ctl.step_count,
+                "loss": losses[-1] if losses else None,
+                "world_size": ctl.world_size,
+            },
+            checkpoint=Checkpoint(ckpt_dir) if ctl.last_checkpoint else None,
+            path=ckpt_dir,
+            metrics_dataframe=[
+                {"step": i + 1, "loss": loss} for i, loss in enumerate(losses)
+            ],
+            error=error,
+        )
 
 
 # TorchTrainer lives in ray_tpu.train.torch (full gloo process-group

@@ -37,6 +37,7 @@ class TrainWorkerActor:
         trial_dir: str,
         pin_devices: bool = True,
         group_token: str = "",
+        restart_count: int = 0,
     ):
         self.rank = rank
         self.world_size = world_size
@@ -79,6 +80,7 @@ class TrainWorkerActor:
             devices=list(self.devices),
             mesh=mesh,
             group_token=group_token,
+            restart_count=restart_count,
         )
 
     # ------------------------------------------------------------ running
@@ -120,6 +122,7 @@ class WorkerGroup:
         experiment_name: str,
         trial_dir: str,
         execution: str = "inproc",
+        restart_count: int = 0,
     ):
         self.scaling = scaling
         self.experiment_name = experiment_name
@@ -130,6 +133,7 @@ class WorkerGroup:
 
         # fresh per group (= per fit attempt): scopes rank rendezvous keys
         self.group_token = uuid.uuid4().hex
+        self.restart_count = restart_count
         self.workers: List[Any] = []
 
     def start(self) -> None:
@@ -147,6 +151,7 @@ class WorkerGroup:
                 self.trial_dir,
                 pin_devices=self.execution != "process",
                 group_token=self.group_token,
+                restart_count=self.restart_count,
             )
             for rank in range(n)
         ]
@@ -157,6 +162,31 @@ class WorkerGroup:
             w.run.remote(fn, config, dataset_shards[i] if dataset_shards else {}, latest_checkpoint)
             for i, w in enumerate(self.workers)
         ]
+
+    def dead_workers(self) -> List[Tuple[int, BaseException]]:
+        """Ranks the control plane declares DEAD, with the typed error a
+        caller should surface.  The liveness guard of the rank-0 drain
+        path: a ``kill -9``'d rank whose run future has not resolved yet
+        must become a typed :class:`ActorDiedError`, never a hang."""
+        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.runtime.control import ActorState
+
+        cluster = ray_tpu.get_cluster()
+        out: List[Tuple[int, BaseException]] = []
+        for rank, w in enumerate(self.workers):
+            info = cluster.control.actors.get(w._actor_id)
+            if info is not None and info.state is ActorState.DEAD:
+                out.append(
+                    (
+                        rank,
+                        ActorDiedError(
+                            w._actor_id,
+                            info.death_cause
+                            or f"train worker rank {rank} died mid-run",
+                        ),
+                    )
+                )
+        return out
 
     def poll_all(self) -> Tuple[List[Tuple[int, dict, Any]], bool]:
         """Gather new reports from every rank; done only when all ranks done."""

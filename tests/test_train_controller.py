@@ -1,0 +1,276 @@
+"""TrainController: gang-compiled training with repair-and-resume.
+
+Covers the ISSUE 17 robustness ladder below the chaos harness:
+checkpoint durability (crash-atomic framing, torn-file fallback,
+cross-process round trips), bit-exact recovery from member death, claim
+after head restart, and the TrainingIterator's typed-error / never-hang
+contract when a gang member is killed mid-run.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.exceptions import RayActorError, WorkerCrashedError
+from ray_tpu.runtime.control import ActorState
+from ray_tpu.train import (
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainController,
+)
+from ray_tpu.train.checkpoint import load_framed, save_framed
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability (satellite: crash-atomic framing)
+# ---------------------------------------------------------------------------
+def test_framed_roundtrip_cross_process(tmp_path):
+    """Save a pytree in a PROCESS worker, restore on the head: every leaf
+    — RNG keys included — comes back bit-equal."""
+
+    @ray_tpu.remote(execution="process")
+    def save_in_worker(path):
+        import jax
+        import numpy as _np
+
+        from ray_tpu.train.checkpoint import save_framed as _save
+
+        tree = {
+            "params": _np.arange(16, dtype=_np.float32) / 3.0,
+            "momentum": _np.full(16, -0.25, dtype=_np.float32),
+            "rng_key": _np.asarray(jax.random.PRNGKey(1234)),
+            "step": 7,
+        }
+        _save(path, tree)
+        return {
+            k: v.tobytes() if hasattr(v, "tobytes") else v
+            for k, v in tree.items()
+        }
+
+    path = str(tmp_path / "state.ckpt")
+    expected = ray_tpu.get(save_in_worker.remote(path), timeout=120)
+    restored = load_framed(path)
+    assert restored is not None
+    assert restored["step"] == expected["step"]
+    for key in ("params", "momentum", "rng_key"):
+        assert np.asarray(restored[key]).tobytes() == expected[key], key
+
+
+def test_framed_rejects_torn_file_and_falls_back(tmp_path):
+    path = str(tmp_path / "state.ckpt")
+    save_framed(path, {"step": 1})
+    save_framed(path, {"step": 2})  # rotates step-1 into .prev
+    assert load_framed(path)["step"] == 2
+
+    # tear the current file mid-write: digest check must reject it and the
+    # loader must fall back to the previous generation
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)
+    assert load_framed(path)["step"] == 1
+
+    # both generations torn -> None, never a half-parsed object
+    with open(path + ".prev", "r+b") as f:
+        f.seek(12)
+        f.write(b"\xff\xff\xff")
+    assert load_framed(path) is None
+
+
+def test_checkpoint_survives_member_state(tmp_path):
+    """Controller save/restore round-trips ALL resume state bit-exact."""
+    ctl = TrainController(
+        "ckpt_rt", world_size=2, batch_size=8, feature_dim=4, seed=5,
+        checkpoint_dir=str(tmp_path), checkpoint_period=10**9,
+    )
+    try:
+        ctl.run(3)
+        ctl.save_checkpoint()
+        state = load_framed(ctl.checkpoint_path)
+        assert state["step"] == 3
+        assert state["params"].tobytes() == ctl._state()["params"].tobytes()
+        assert state["rng_key"].tobytes() == ctl._state()["rng_key"].tobytes()
+    finally:
+        ctl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# repair-and-resume: bit-exact vs an uninterrupted run
+# ---------------------------------------------------------------------------
+def test_recover_resumes_bit_exact():
+    """Kill a gang member mid-run; the repaired gang's full loss history
+    must be byte-identical to an uninterrupted same-seed run's."""
+    ctl = TrainController(
+        "bitexact_a", world_size=2, batch_size=8, feature_dim=4, seed=21,
+        checkpoint_period=4,
+    )
+    ref = TrainController(
+        "bitexact_b", world_size=2, batch_size=8, feature_dim=4, seed=21,
+        checkpoint_period=10**9,
+    )
+    try:
+        ctl.run(6)  # checkpoint lands at step 4
+        ray_tpu.kill(ctl._members[1], no_restart=False)
+        ctl.run(4, auto_repair=True)  # death surfaces, recover(), resume
+        assert ctl.step_count == 10
+        assert ctl.repair_history, "member death never triggered a repair"
+
+        uninterrupted = ref.run(10)
+        got = np.asarray(ctl.losses(), np.float32).tobytes()
+        want = np.asarray(uninterrupted, np.float32).tobytes()
+        assert got == want, "post-repair loss trajectory diverged"
+    finally:
+        ctl.shutdown()
+        ref.shutdown()
+
+
+def test_recover_without_auto_repair_raises_typed():
+    ctl = TrainController(
+        "typed_err", world_size=2, batch_size=8, feature_dim=4, seed=3,
+    )
+    try:
+        ctl.run(2)
+        ray_tpu.kill(ctl._members[0], no_restart=True)
+        with pytest.raises((RayActorError, WorkerCrashedError)):
+            ctl.run(3, auto_repair=False)
+    finally:
+        ctl.shutdown()
+
+
+def test_claim_after_head_restart():
+    """Step state rides head snapshots: save, kill_head/restart_head, then
+    claim() rebuilds the controller from the KV summary + checkpoint."""
+    cluster = ray_tpu.get_cluster()
+    ctl = TrainController(
+        "claimed", world_size=2, batch_size=8, feature_dim=4, seed=9,
+        checkpoint_period=10**9,
+    )
+    ckpt_dir = os.path.dirname(ctl.checkpoint_path)
+    try:
+        ctl.run(5)
+        ctl.save_checkpoint()
+        saved = ctl._state()
+    finally:
+        ctl.shutdown()
+
+    cluster.kill_head()
+    cluster.restart_head()
+
+    ctl2 = TrainController.claim("claimed")
+    try:
+        assert os.path.dirname(ctl2.checkpoint_path) == ckpt_dir
+        assert ctl2.step_count == 5
+        restored = ctl2._state()
+        assert restored["params"].tobytes() == saved["params"].tobytes()
+        assert restored["rng_key"].tobytes() == saved["rng_key"].tobytes()
+        # and it trains on from the claimed state
+        ctl2.run(1)
+        assert ctl2.step_count == 6
+    finally:
+        ctl2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# TrainingIterator: typed errors, never a hang (satellite 2)
+# ---------------------------------------------------------------------------
+def _kill_one_train_worker(cluster, done: threading.Event, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not done.is_set():
+        for info in cluster.control.actors.list_actors():
+            if (
+                info.class_name.endswith("TrainWorkerActor")
+                and info.state is ActorState.ALIVE
+            ):
+                cluster.kill_actor(info.actor_id, no_restart=True)
+                done.set()
+                return
+        time.sleep(0.02)
+
+
+def test_training_iterator_member_kill_raises_typed_never_hangs():
+    def loop(config):
+        for i in range(200):  # ~10s — far beyond the kill
+            train.report({"i": i})
+            time.sleep(0.05)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+    )
+    it = trainer.training_iterator()
+    cluster = ray_tpu.get_cluster()
+    killed = threading.Event()
+    killer = threading.Thread(
+        target=_kill_one_train_worker, args=(cluster, killed), daemon=True
+    )
+    killer.start()
+    t0 = time.monotonic()
+    with pytest.raises((RayActorError, WorkerCrashedError)):
+        for _ in it:
+            pass
+    killer.join(timeout=30)
+    assert killed.is_set()
+    assert time.monotonic() - t0 < 30, "iterator hung instead of raising"
+    assert it.result().error is not None
+
+
+def test_training_iterator_auto_repair_restarts_gang():
+    def loop(config):
+        for i in range(20):
+            train.report({"i": i})
+            time.sleep(0.02)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+    )
+    it = trainer.training_iterator(auto_repair=True)
+    cluster = ray_tpu.get_cluster()
+    killed = threading.Event()
+    killer = threading.Thread(
+        target=_kill_one_train_worker, args=(cluster, killed), daemon=True
+    )
+    killer.start()
+    rows = list(it)
+    killer.join(timeout=30)
+    result = it.result()
+    assert result.error is None, f"auto_repair leaked the error: {result.error}"
+    assert rows, "repaired run produced no reports"
+    # the restarted attempt announces itself through the session context
+    assert killed.is_set()
+
+
+def test_gang_mode_jaxtrainer_fit():
+    """JaxTrainer(gang=...) compiles the step into a StageGroup plan and
+    returns a Result backed by the controller's checkpoint."""
+    trainer = JaxTrainer(
+        gang=dict(world_size=2, batch_size=8, feature_dim=4, seed=2),
+        num_steps=4,
+        run_config=RunConfig(name="gangfit"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 4
+    assert result.metrics["world_size"] == 2
+    assert len(result.metrics_dataframe) == 4
+    assert result.checkpoint is not None
+    ctl = trainer.controller
+    try:
+        assert ctl.last_checkpoint and os.path.exists(ctl.last_checkpoint)
+        assert ctl.status()["plan_state"] == "READY"
+    finally:
+        ctl.shutdown()
