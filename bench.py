@@ -204,6 +204,14 @@ ROW_GROUPS = [
     # run recomputes ONE token through a copy-on-write tail block).  Own
     # fresh-runtime group — engines with background decode threads.
     ["llm_concurrent_streams_x", "llm_prefix_cache_ttft_x"],
+    # disaggregated prefill/decode (ISSUE 20): p99 inter-token gap of a
+    # running decode stream while a long-prompt burst lands as migrated
+    # KV blocks (header-only tickets, zero payload bytes on the control
+    # stream) instead of chunk-prefilling on the victim's own replica.
+    # In-row guards: beats the shared-replica chunked baseline, and the
+    # migration wall undercuts one prefill chunk.  Own fresh-runtime
+    # group — two engines with background decode threads.
+    ["llm_disagg_intertoken_p99"],
 ]
 
 
@@ -248,6 +256,7 @@ def main() -> None:
         "llm_chunked_prefill_stall_p99",
         "llm_concurrent_streams_x",
         "llm_prefix_cache_ttft_x",
+        "llm_disagg_intertoken_p99",
     ):
         samples = [results[noisy][0]]
         for _ in range(2):

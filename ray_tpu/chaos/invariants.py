@@ -70,6 +70,18 @@ merely "it kept going":
      from torn state, re-sharded batches non-deterministically, or summed
      gradients in a different order fails here even though training
      "continued" without error.
+
+Disaggregated-serving invariant (ISSUE 20 tentpole) — KV-block migrations
+must never leak or double-free staged state:
+
+ 13. **Every staged migration reaches exactly one terminal**: each
+     ``"staged"`` audit row in ``cluster.kv_migration_audits``
+     (serve/disagg.py) pairs with exactly one ``"released"`` row —
+     outcome ``adopted``, ``reprefill``, or ``failed``.  Zero terminals
+     means the prefill replica's staged block set leaked; more than one
+     means it was freed twice.  A decode-replica kill mid-migration must
+     still land here: the re-prefill ladder releases the orphaned attempt
+     before staging the next.
 """
 
 from __future__ import annotations
@@ -120,6 +132,7 @@ def snapshot_baseline() -> dict:
         "num_fence_events": getattr(cluster, "fence_events_total", 0),
         "num_overload_events": getattr(cluster, "overload_events_total", 0),
         "num_train_repairs": len(getattr(cluster, "train_repair_audits", ())),
+        "num_kv_migration_audits": len(getattr(cluster, "kv_migration_audits", ())),
     }
 
 
@@ -459,4 +472,38 @@ def check_invariants(
         replayed_steps += len(losses)
     report.checked["train_repairs"] = len(audits)
     report.checked["train_replayed_steps"] = replayed_steps
+
+    # 13. every staged KV-block migration reaches exactly one terminal ------
+    # (serve/disagg.py: "staged" must pair with exactly one "released" —
+    # adopted, reprefill, or failed; zero terminals leaks the staged set,
+    # two would double-free it)
+    mig_audits = list(getattr(cluster, "kv_migration_audits", ()))
+    if baseline is not None:
+        mig_audits = mig_audits[baseline.get("num_kv_migration_audits", 0):]
+    staged_ids: List[str] = []
+    released: Dict[str, int] = {}
+    for audit in mig_audits:
+        mid = audit.get("mig_id", "")
+        if audit.get("event") == "staged":
+            staged_ids.append(mid)
+        elif audit.get("event") == "released":
+            released[mid] = released.get(mid, 0) + 1
+    for mid in staged_ids:
+        n = released.get(mid, 0)
+        if n == 0:
+            report.add(
+                f"kv migration {mid!r} staged but never released — the "
+                "staged block set leaked"
+            )
+        elif n > 1:
+            report.add(
+                f"kv migration {mid!r} released {n} times — staged block "
+                "set freed more than once"
+            )
+    for mid, n in released.items():
+        if mid not in staged_ids:
+            report.add(
+                f"kv migration {mid!r} released without a staged record"
+            )
+    report.checked["kv_migrations"] = len(staged_ids)
     return report

@@ -267,7 +267,42 @@ class ChaosRunner:
                 p.get("index"),
                 bool(p.get("graceful", True)),
             )
+        if event.kind == "kill_decode_replica":
+            return self._kill_decode_replica(
+                cluster,
+                p.get("deployment"),
+                str(p.get("role", "decode")),
+                int(p.get("index", 0)),
+            )
         return {}
+
+    @staticmethod
+    def _kill_decode_replica(cluster, deployment, role: str, index: int) -> dict:
+        """Kill one replica of a disaggregated serving deployment through
+        the controller's chaos hook.  Like preempt_gang_member this
+        consumes NO failpoint decisions — same-seed fault logs stay
+        byte-identical; what it perturbs is the replica pool.  A migration
+        in flight must walk the re-prefill ladder (typed KVMigrationError
+        internally), and invariant 13 audits that every staged block set
+        still reached exactly one terminal outcome."""
+        controllers = getattr(cluster, "serve_controllers", {})
+        if not controllers:
+            return {"skipped": "no registered serve controllers"}
+        for key in sorted(controllers):
+            ctl = controllers[key]
+            try:
+                killed = ctl.chaos_kill_replica(
+                    deployment or "", role=role, index=index
+                )
+            except Exception as exc:  # noqa: BLE001 — report, don't crash
+                return {"skipped": f"controller hook failed: {exc!r}"}
+            if killed:
+                return {
+                    "deployment": deployment or "(sole roles deployment)",
+                    "role": role,
+                    "index": index,
+                }
+        return {"skipped": f"no {role!r} replica at index {index}"}
 
     @staticmethod
     def _preempt_gang_member(cluster, job, index, graceful: bool) -> dict:

@@ -66,6 +66,14 @@ Event kinds
                   member (``kill -9`` equivalent), which must flip the plan
                   BROKEN with a typed error and repair bit-exact from the
                   latest step checkpoint (invariant 12).
+``kill_decode_replica``  kill one replica of a disaggregated serving
+                  deployment (``deployment`` names it; default: the sole
+                  roles deployment), by ``role`` (default ``"decode"``) and
+                  ``index`` within the pool (default 0, list order — never
+                  random).  A migration in flight must surface as a typed
+                  KVMigrationError internally and re-prefill on a fresh
+                  replica pair; every staged block set still reaches
+                  exactly one terminal outcome (invariant 13).
 """
 
 from __future__ import annotations
@@ -77,7 +85,7 @@ _KINDS = (
     "arm", "disarm", "partition", "kill_node", "lose_objects",
     "add_node", "drain_node", "kill_head", "restart_head",
     "slow_node", "partition_node", "heal_partition", "overload",
-    "preempt_gang_member",
+    "preempt_gang_member", "kill_decode_replica",
 )
 
 
@@ -185,6 +193,11 @@ _EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "index": (False, (int,)),
         "graceful": (False, (bool,)),
     },
+    "kill_decode_replica": {
+        "deployment": (False, (str,)),
+        "role": (False, (str,)),
+        "index": (False, (int,)),
+    },
 }
 
 
@@ -272,6 +285,14 @@ def validate_schedule(data: Any, num_nodes: Optional[int] = None) -> List[str]:
         if kind == "preempt_gang_member" and isinstance(ev.get("index"), int) \
                 and ev["index"] < 0:
             errors.append(f"{where} (preempt_gang_member): 'index' must be >= 0")
+        if kind == "kill_decode_replica":
+            if isinstance(ev.get("index"), int) and ev["index"] < 0:
+                errors.append(f"{where} (kill_decode_replica): 'index' must be >= 0")
+            if isinstance(ev.get("role"), str) and ev["role"] not in ("prefill", "decode"):
+                errors.append(
+                    f"{where} (kill_decode_replica): 'role' must be "
+                    f"'prefill' or 'decode', got {ev['role']!r}"
+                )
         if kind == "overload":
             if isinstance(ev.get("tasks"), int) and ev["tasks"] < 1:
                 errors.append(f"{where} (overload): 'tasks' must be >= 1")

@@ -320,6 +320,17 @@ class Config:
     # pool).  When the pool runs short, unreferenced cached leaves are
     # LRU-evicted before admission holds or sheds either way.
     prefix_cache_max_blocks: int = 0
+    # Disaggregated prefill/decode serving (serve/disagg.py): attempts per
+    # request on the migration fallback ladder.  Attempt 1 migrates the
+    # prefill replica's KV blocks to a decode replica (device pull, then
+    # host-staged fallback); each later attempt re-prefills from scratch on
+    # a fresh prefill/decode pair.  Exhausting the ladder raises the typed
+    # KVMigrationError to the caller.
+    kv_migration_attempts: int = 2
+    # Seconds the decode side waits for one staged KV block to arrive over
+    # the device plane before treating the pull as refused and dropping to
+    # the host-staged rung.
+    kv_migration_pull_timeout_s: float = 30.0
 
     # ---- elastic gang-scheduled training (train/controller.py) -----------
     # Steps between TrainController step checkpoints (optimizer/step/RNG

@@ -451,10 +451,41 @@ SERVE_REQUEST_PHASE = _reg.histogram(
     "serve_request_phase_seconds",
     "Per-phase time of traced serve requests, tagged phase= (proxy, "
     "router_queue, dispatch, replica, engine_queue, kv_block_wait, "
-    "prefill, decode, handler). Phases partition the request timeline: "
-    "summed across phases they reproduce end-to-end latency.",
+    "prefill, kv_migrate, decode, handler). Phases partition the request "
+    "timeline: summed across phases they reproduce end-to-end latency.",
     "s",
     boundaries=_SERVING_BOUNDS,
+)
+LLM_KV_MIGRATIONS = _reg.counter(
+    "llm_kv_migrations_total",
+    "Disaggregated prefill->decode KV-block migrations by outcome= "
+    "(device = pulled device-to-device through the transfer server, host = "
+    "host-staged fallback after a refused pull, reprefill = decode-side "
+    "failure fell back to re-prefilling on another replica, failed = the "
+    "fallback ladder was exhausted).",
+)
+LLM_KV_MIGRATION_SECONDS = _reg.histogram(
+    "llm_kv_migration_seconds",
+    "Wall time of one KV-block migration: prefill-done to the decode "
+    "replica holding every block (staging + pulls + adoption). Must "
+    "amortize below one prefill chunk's latency or disaggregation is "
+    "paying more than the interference it removes.",
+    "s",
+    boundaries=_SERVING_BOUNDS,
+)
+SERVE_POOL_REPLICAS = _reg.gauge(
+    "serve_pool_replicas",
+    "Replicas per deployment role pool, tagged role= (prefill/decode for "
+    "disaggregated LLM deployments). Each role autoscales on its own "
+    "bottleneck signal: prefill by ongoing requests, decode by free KV "
+    "pages.",
+    "replicas",
+)
+SERVE_POOL_ONGOING = _reg.gauge(
+    "serve_pool_ongoing",
+    "In-flight requests per deployment role pool, tagged role=. The "
+    "per-role numerator of the queue-depth autoscaler.",
+    "requests",
 )
 
 # ---- node utilization (dashboard reporter samples) -----------------------
@@ -542,6 +573,10 @@ ALL_METRICS = [
     LLM_TTFT,
     LLM_INTER_TOKEN,
     SERVE_REQUEST_PHASE,
+    LLM_KV_MIGRATIONS,
+    LLM_KV_MIGRATION_SECONDS,
+    SERVE_POOL_REPLICAS,
+    SERVE_POOL_ONGOING,
     NODE_CPU_PERCENT,
     NODE_MEM_USED_BYTES,
     NODE_TPU_MEM_USED_BYTES,

@@ -14,8 +14,15 @@ completed trace's waterfall always sums exactly to its end-to-end latency:
     engine_submit -> wfq_pop    "engine_queue"   WFQ admission wait
     wfq_pop -> admitted         "kv_block_wait"  held head-of-line for pages
     admitted -> first_token     "prefill"        chunks counted on the side
-    first_token -> finished     "decode"         inter-token gaps aggregated
+    first_token -> kv_migrate   "kv_migrate"     disaggregated handoff only
+    kv_migrate -> finished      "decode"         inter-token gaps aggregated
 
+``kv_migrate`` only appears on disaggregated requests (prefill pool ->
+decode pool KV-block migration); co-located requests go straight from
+``first_token`` to ``finished`` and the waterfall still sums exactly to
+e2e either way.  For disaggregated requests the ``decode`` segment is
+attributed to the decode replica (the trace rides the explicit
+router -> replica argument into the decode pool), not the proxy.
 Non-LLM requests stop at ``replica_in``; their final segment reports as
 ``handler``.  Per-token data stays O(1) per trace: gaps, stalls, and
 prefill chunks fold into counters/max — rings and sketches are the only
@@ -54,7 +61,8 @@ from ray_tpu.observability.sketch import LatencySketch
 #: point) but the waterfall names below cover the serving path.
 MARKS = (
     "proxy_in", "router_in", "router_dequeue", "replica_in",
-    "engine_submit", "wfq_pop", "admitted", "first_token", "finished",
+    "engine_submit", "wfq_pop", "admitted", "first_token", "kv_migrate",
+    "finished",
 )
 
 #: segment name keyed by the LATER mark of the pair.
@@ -66,6 +74,7 @@ _SEGMENT_FOR_MARK = {
     "wfq_pop": "engine_queue",
     "admitted": "kv_block_wait",
     "first_token": "prefill",
+    "kv_migrate": "kv_migrate",
     "finished": "decode",
 }
 
@@ -80,6 +89,7 @@ PHASE_SPANS = {
     "engine_queue": "llm::engine_queue",
     "kv_block_wait": "llm::kv_block_wait",
     "prefill": "llm::prefill",
+    "kv_migrate": "llm::kv_migrate",
     "decode": "llm::decode",
 }
 
@@ -176,7 +186,7 @@ class RequestTrace:
         out: List[Tuple[str, float, float]] = []
         for (prev, t_prev), (name, t) in zip(self.marks, self.marks[1:]):
             phase = _SEGMENT_FOR_MARK.get(name, name)
-            if name == "finished" and prev != "first_token":
+            if name == "finished" and prev not in ("first_token", "kv_migrate"):
                 # non-LLM requests (or ones that died pre-token) end their
                 # last segment in the handler, not decode
                 phase = "handler"

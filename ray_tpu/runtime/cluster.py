@@ -419,6 +419,14 @@ class Cluster:
         # bytes — invariant 12 replays these from the checkpoint and
         # byte-compares the trajectories
         self.train_repair_audits: List[dict] = []
+        # live ServeControllerActor callables (serve/controller.py): id ->
+        # controller.  The chaos `kill_decode_replica` kind finds its
+        # targets here (mirrors train_controllers above).
+        self.serve_controllers: Dict[str, Any] = {}
+        # one audit row per KV-block migration lifecycle event ("staged" /
+        # "released", serve/disagg.py) — chaos invariant 13 asserts every
+        # staged block set reaches exactly one terminal outcome
+        self.kv_migration_audits: List[dict] = []
         # head failover simulation state (kill_head/restart_head chaos
         # hooks); the lock makes the _head_down check and a snapshot write
         # atomic — the periodic writer must never clobber the kill-time
@@ -1459,7 +1467,20 @@ class Cluster:
             # (ms-scale e2e / queue-wait; engine sources above carry
             # ttft / inter_token under their own "latency" key)
             "request_latency": _request_latency_snapshot(),
+            # disaggregated serving: per-role pool lines (replica count vs
+            # target, ongoing requests, decode free-KV fraction) from every
+            # registered serve controller (serve/disagg.py)
+            "serve_pools": self._serve_pools_snapshot(),
         }
+
+    def _serve_pools_snapshot(self) -> Dict[str, dict]:
+        pools: Dict[str, dict] = {}
+        for ctl in list(self.serve_controllers.values()):
+            try:
+                pools.update(ctl.pool_status())
+            except Exception:  # noqa: BLE001 — observability never raises
+                continue
+        return pools
 
     def unpark_and_fail(self, spec: TaskSpec, error: BaseException) -> bool:
         """Remove a PARKED task from the demand queue and commit ``error``

@@ -427,6 +427,7 @@ class TransferStats:
         "pushes_received": ("DATA_PLANE_TRANSFERS", {"op": "push_received"}),
         "shm_handoffs": ("DATA_PLANE_TRANSFERS", {"op": "shm_handoff"}),
         "relays": ("DATA_PLANE_TRANSFERS", {"op": "relay"}),
+        "kv_blocks_served": ("DATA_PLANE_TRANSFERS", {"op": "kv_pull_served"}),
     }
 
     def __init__(self):
@@ -439,6 +440,7 @@ class TransferStats:
         self.pushes_received = 0
         self.shm_handoffs = 0
         self.relays = 0
+        self.kv_blocks_served = 0
         self.frame_cache_hits = 0
         self.frame_cache_misses = 0
 
@@ -453,6 +455,7 @@ class TransferStats:
                 "pushes_received": self.pushes_received,
                 "shm_handoffs": self.shm_handoffs,
                 "relays": self.relays,
+                "kv_blocks_served": self.kv_blocks_served,
                 "frame_cache_hits": self.frame_cache_hits,
                 "frame_cache_misses": self.frame_cache_misses,
             }
@@ -465,6 +468,65 @@ class TransferStats:
             from ray_tpu.observability import metric_defs
 
             getattr(metric_defs, metric[0]).inc(n, tags=metric[1])
+
+
+# --------------------------------------------------------------------------
+# KV-block migration sources (disaggregated serving, serve/disagg.py).
+# A prefill engine registers its staged block set here under the derived
+# migration id; the decode side's host-fallback `kv_pull` op resolves
+# through this registry, so the runtime layer never imports serve code.
+# Process-global: in-proc replicas on one node share one data server.
+# --------------------------------------------------------------------------
+_kv_sources_lock = threading.Lock()
+_kv_sources: Dict[str, Callable[[int], Any]] = {}
+
+
+def register_kv_block_source(mig_id: str, fetch: Callable[[int], Any]) -> None:
+    """``fetch(block_idx) -> ndarray`` for one staged migration."""
+    with _kv_sources_lock:
+        _kv_sources[mig_id] = fetch
+
+
+def unregister_kv_block_source(mig_id: str) -> None:
+    with _kv_sources_lock:
+        _kv_sources.pop(mig_id, None)
+
+
+def kv_block_source(mig_id: str) -> Optional[Callable[[int], Any]]:
+    with _kv_sources_lock:
+        return _kv_sources.get(mig_id)
+
+
+def pull_kv_block(addr: str, mig_id: str, idx: int,
+                  timeout: float = 30.0) -> Optional[Any]:
+    """Pull one staged KV block over the ``kv_pull`` wire op (host-staged
+    fallback rung).  Returns the block as a numpy array, or ``None`` when
+    the peer has no such staging (released, unknown, or refused)."""
+    import numpy as np
+
+    host, port = addr.rsplit(":", 1)
+    try:
+        sock = socket.create_connection((host, int(port)), timeout=timeout)
+    except OSError:
+        return None
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(timeout)
+        _send_header(sock, {"op": "kv_pull", "mig": mig_id, "idx": int(idx)})
+        header = _recv_header(sock)
+        if not header.get("found"):
+            return None
+        raw = _recv_into_buffer(sock, int(header["size"]))
+        return np.frombuffer(raw, dtype=np.dtype(header["dtype"])).reshape(
+            header["shape"]
+        )
+    except (ConnectionError, OSError, EOFError, pickle.UnpicklingError, KeyError):
+        return None
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 class DataServer:
@@ -547,6 +609,8 @@ class DataServer:
                     self._serve_chan_push(sock, req)
                 elif op == "push_task":
                     self._serve_push_task(sock, req)
+                elif op == "kv_pull":
+                    self._serve_kv_pull(sock, req)
                 else:
                     _send_header(sock, {"error": f"unknown op {op!r}"})
         except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
@@ -602,6 +666,34 @@ class DataServer:
             sent = _send_buffers(sock, buffers, self.chunk_bytes)
         self.stats.add("pulls_served")
         self.stats.add("bytes_sent", len(meta) + sent)
+
+    def _serve_kv_pull(self, sock: socket.socket, req: dict) -> None:
+        """Host-staged rung of the KV-block migration ladder
+        (serve/disagg.py): serve one staged block of a registered
+        migration as raw bytes.  The device-to-device ticket path never
+        touches this op — it exists for refused/absent transfer servers,
+        mirroring the chan_push host fallback."""
+        fetch = kv_block_source(req.get("mig", ""))
+        if fetch is None:
+            _send_header(sock, {"found": False})
+            return
+        try:
+            import numpy as _np
+
+            arr = _np.ascontiguousarray(fetch(int(req.get("idx", 0))))
+        except Exception:  # noqa: BLE001 — released mid-pull / bad index
+            _send_header(sock, {"found": False})
+            return
+        payload = memoryview(arr).cast("B")
+        with self._admission:
+            _send_header(
+                sock,
+                {"found": True, "shape": tuple(arr.shape),
+                 "dtype": str(arr.dtype), "size": payload.nbytes},
+            )
+            sent = _send_buffers(sock, [payload], self.chunk_bytes)
+        self.stats.add("kv_blocks_served")
+        self.stats.add("bytes_sent", sent)
 
     def _stage_offer(self, oid: bytes, meta: bytes, buffers: List[Any]) -> Optional[dict]:
         """Build a same-host handoff offer.

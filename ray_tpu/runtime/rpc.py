@@ -46,7 +46,9 @@ from ray_tpu.runtime.protocol import FrameReader, send_msg as _send_msg
 #: node registration instead of silently corrupting).
 #: v5: node incarnations — registration replies carry ``incarnation`` and
 #: agent frames stamp ``inc``; heads fence stale incarnations.
-PROTOCOL_VERSION = 5
+#: v6: disaggregated serving — new data-plane ``kv_pull`` op (host-staged
+#: KV-block migration fallback) joins the wire surface.
+PROTOCOL_VERSION = 6
 
 #: Sentinel a handler returns to take ownership of replying later.
 DEFER = object()

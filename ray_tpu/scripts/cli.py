@@ -629,6 +629,17 @@ def cmd_overload(args) -> int:
                     f"  latency: ttft p99={ttft['p99'] * 1000:.1f}ms, "
                     f"inter-token p99={itl.get('p99', 0.0) * 1000:.1f}ms"
                 )
+    for dep, pools in sorted(data.get("serve_pools", {}).items()):
+        for role, row in sorted(pools.items()):
+            extra = (
+                f", {100.0 * row['kv_free_frac']:.0f}% kv free"
+                if "kv_free_frac" in row else ""
+            )
+            print(
+                f"pool {dep}/{role}: {row.get('replicas', 0)}/"
+                f"{row.get('target', 0)} replicas, "
+                f"{row.get('ongoing', 0)} ongoing{extra}"
+            )
     for dep, sketches in sorted(data.get("request_latency", {}).items()):
         e2e = sketches.get("e2e", {})
         if e2e.get("count"):
@@ -655,12 +666,20 @@ def cmd_llm(args) -> int:
         return 0
     for i, src in enumerate(engines):
         kind = src.get("cache_kind", "dense")
+        role = src.get("role") or ""
+        role_txt = f" role={role}," if role else ""
         print(
-            f"engine {i}: cache={kind}, "
+            f"engine {i}: cache={kind},{role_txt} "
             f"{src.get('active_slots', 0)}/{src.get('slots', 0)} slots, "
             f"{src.get('queued', 0)} queued (bound {src.get('queue_bound', 0)}), "
             f"{src.get('shed', 0)} shed, {src.get('slots_evicted', 0)} evicted"
         )
+        if role or src.get("migrations_out") or src.get("migrations_in"):
+            print(
+                f"  migrations: {src.get('migrations_out', 0)} out, "
+                f"{src.get('migrations_in', 0)} in, "
+                f"{src.get('staged_migrations', 0)} staged"
+            )
         if kind == "paged":
             print(
                 f"  kv pool: {src.get('kv_blocks_in_use', 0)}/"
@@ -694,6 +713,17 @@ def cmd_llm(args) -> int:
                 )
         if parts:
             print("  latency: " + "; ".join(parts))
+    for dep, pools in sorted(data.get("serve_pools", {}).items()):
+        for role, row in sorted(pools.items()):
+            extra = (
+                f", {100.0 * row['kv_free_frac']:.0f}% kv free"
+                if "kv_free_frac" in row else ""
+            )
+            print(
+                f"pool {dep}/{role}: {row.get('replicas', 0)}/"
+                f"{row.get('target', 0)} replicas, "
+                f"{row.get('ongoing', 0)} ongoing{extra}"
+            )
     return 0
 
 

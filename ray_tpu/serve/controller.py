@@ -25,8 +25,23 @@ class _DeploymentState:
         self.init_kwargs = init_kwargs
         self.replicas: List[Any] = []
         self.version = 0
+        # disaggregated prefill/decode (serve/disagg.py): per-role replica
+        # targets + the role list index-aligned with `replicas` (the router
+        # reads it through get_deployment_meta per membership version)
+        self.roles: Optional[Dict[str, int]] = (
+            dict(deployment.roles) if deployment.roles else None
+        )
+        self.replica_roles: List[str] = []
+        self.role_targets: Dict[str, int] = dict(self.roles or {})
+        # decode-pool KV pressure (id(replica) -> free fraction), refreshed
+        # by the health-check-cadence probe — the decode pool's autoscaling
+        # signal (free pages, not queue depth)
+        self.kv_free_frac: Dict[int, float] = {}
         if deployment.autoscaling_config is not None:
-            self.target_replicas = deployment.autoscaling_config.min_replicas
+            if self.roles is not None:
+                self.target_replicas = sum(self.role_targets.values())
+            else:
+                self.target_replicas = deployment.autoscaling_config.min_replicas
         else:
             self.target_replicas = int(deployment.num_replicas)
         self.last_inflight: Dict[int, int] = {}
@@ -44,11 +59,26 @@ class ServeControllerActor:
         self._lock = threading.RLock()
         self._changed = threading.Condition(self._lock)  # long-poll wakeups
         self._running = True
+        # register on the cluster so chaos hooks (kill_decode_replica) can
+        # find live controllers (mirrors cluster.train_controllers)
+        try:
+            from ray_tpu.runtime.worker import global_worker
+
+            global_worker().cluster.serve_controllers[id(self)] = self
+        except Exception:  # noqa: BLE001 — controller driven without rt.init
+            pass
         self._reconcile_thread = threading.Thread(target=self._loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
 
     # ----------------------------------------------------------- deploys
     def deploy(self, deployment: Deployment, init_args: tuple, init_kwargs: dict) -> None:
+        # deploy-time role validation: zero-replica pools or a dense KV
+        # cache fail HERE with a typed ValueError, not at the first
+        # migration (serve/disagg.py)
+        if deployment.roles is not None:
+            from ray_tpu.serve.disagg import validate_roles
+
+            validate_roles(deployment.roles, init_kwargs)
         with self._lock:
             old = self._deployments.get(deployment.name)
             state = _DeploymentState(deployment, init_args, init_kwargs)
@@ -125,6 +155,10 @@ class ServeControllerActor:
                 "max_ongoing_requests": d.max_ongoing_requests,
                 "max_queued_requests": d.max_queued_requests,
                 "idempotent": d.idempotent,
+                # disagg: declared role targets + the per-replica role list,
+                # index-aligned with this version's get_replicas snapshot
+                "roles": dict(state.roles) if state.roles else None,
+                "replica_roles": list(state.replica_roles),
             }
 
     def record_request_metrics(self, name: str, inflight: Dict[int, int]) -> None:
@@ -144,6 +178,9 @@ class ServeControllerActor:
             self._changed.notify_all()
 
     def _reconcile_inner_locked(self, state: _DeploymentState) -> None:
+        if state.roles is not None:
+            self._reconcile_roles_locked(state)
+            return
         d = state.deployment
         while len(state.replicas) < state.target_replicas:
             is_function = not isinstance(d.func_or_class, type)
@@ -168,14 +205,62 @@ class ServeControllerActor:
         if len(state.replicas) > state.target_replicas:
             self._scale_down_locked(state, state.target_replicas)
 
+    def _reconcile_roles_locked(self, state: _DeploymentState) -> None:
+        """Reconcile a disaggregated deployment's TWO pools independently:
+        each role's replica count converges on its target, and every new
+        replica gets ``init_kwargs["role"]`` so the LLM engine knows which
+        half of the migration it serves.  Role order is sorted — replica
+        creation order (and thus versions and tags) is deterministic."""
+        d = state.deployment
+        bounded = d.max_queued_requests >= 0
+        is_function = not isinstance(d.func_or_class, type)
+        for role in sorted(state.role_targets):
+            target = max(0, int(state.role_targets[role]))
+            count = state.replica_roles.count(role)
+            while count < target:
+                kwargs = dict(state.init_kwargs)
+                kwargs["role"] = role
+                replica = ReplicaActor.options(
+                    execution="inproc",
+                    max_concurrency=max(2, d.max_ongoing_requests + (2 if bounded else 0)),
+                    **{k: v for k, v in d.ray_actor_options.items() if k in ("num_cpus", "num_tpus", "resources")},
+                ).remote(
+                    d.func_or_class, state.init_args, kwargs, d.user_config, is_function,
+                    deployment=d.name,
+                    replica_tag=f"{d.name}:{role}#{state.version}",
+                    max_ongoing_requests=d.max_ongoing_requests if bounded else 0,
+                )
+                state.replicas.append(replica)
+                state.replica_roles.append(role)
+                state.version += 1
+                count += 1
+            while count > target:
+                idx = max(
+                    i for i, rr in enumerate(state.replica_roles) if rr == role
+                )
+                replica = state.replicas.pop(idx)
+                state.replica_roles.pop(idx)
+                state.health.pop(id(replica), None)
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:
+                    pass
+                state.version += 1
+                count -= 1
+        state.target_replicas = sum(state.role_targets.values())
+
     def _scale_down_locked(self, state: _DeploymentState, target: int) -> None:
         while len(state.replicas) > target:
             replica = state.replicas.pop()
+            if state.replica_roles:
+                state.replica_roles.pop()
             try:
                 ray_tpu.kill(replica)
             except Exception:
                 pass
             state.version += 1
+        if state.roles is not None and target == 0:
+            state.role_targets = {r: 0 for r in state.role_targets}
 
     HEALTH_CHECK_TIMEOUT_S = 5.0
     HEALTH_CHECK_FAILS = 3       # consecutive failures before replacement
@@ -190,12 +275,19 @@ class ServeControllerActor:
             ticks += 1
             if ticks % 5 == 0:  # ~1s health-check cadence, outside the lock
                 self._health_check()
+            if ticks % 5 == 0:
+                self._probe_kv_pressure()
             with self._lock:
                 for state in list(self._deployments.values()):
                     cfg = state.deployment.autoscaling_config
                     if cfg is not None:
-                        self._autoscale_locked(state, cfg)
+                        if state.roles is not None:
+                            self._autoscale_roles_locked(state, cfg)
+                        else:
+                            self._autoscale_locked(state, cfg)
                     self._reconcile_locked(state)
+                    if state.roles is not None and ticks % 5 == 0:
+                        self._publish_role_gauges_locked(state)
 
     def _health_check(self) -> None:
         """Replace replicas that fail HEALTH_CHECK_FAILS consecutive probes
@@ -253,8 +345,12 @@ class ServeControllerActor:
                         rec["fails"] >= self.HEALTH_CHECK_FAILS and not in_grace
                     )
                     if should_remove and r in state.replicas:
-                        state.replicas.remove(r)
+                        idx = state.replicas.index(r)
+                        state.replicas.pop(idx)
+                        if idx < len(state.replica_roles):
+                            state.replica_roles.pop(idx)
                         state.health.pop(id(r), None)
+                        state.kv_free_frac.pop(id(r), None)
                         state.version += 1
                         changed = True
                         try:
@@ -300,8 +396,185 @@ class ServeControllerActor:
             state.target_replicas = desired
             state.last_scale_time = now
 
+    # decode pool scales up below this free-page fraction and back down
+    # above the high-water (hysteresis gap absorbs admission churn)
+    KV_LOW_WATER = 0.2
+    KV_HIGH_WATER = 0.8
+
+    def _probe_kv_pressure(self) -> None:
+        """Refresh each decode replica's free-KV-page fraction (its pool's
+        autoscaling signal).  Probes run OUTSIDE the lock like health
+        checks — a busy engine must not stall the control loop."""
+        with self._lock:
+            targets = []
+            for name, state in self._deployments.items():
+                if state.roles is None:
+                    continue
+                for i, r in enumerate(state.replicas):
+                    if i < len(state.replica_roles) and state.replica_roles[i] == "decode":
+                        targets.append((name, r))
+        if not targets:
+            return
+        results: Dict[tuple, float] = {}
+        for name, r in targets:
+            try:
+                st = ray_tpu.get(
+                    r.handle_request.remote("stats", (), {}, None, None),
+                    timeout=5.0,
+                )
+                pool = int(st.get("kv_block_pool_size", 0))
+                if pool > 0:
+                    results[(name, id(r))] = 1.0 - int(st.get("kv_blocks_in_use", 0)) / pool
+            except Exception:  # noqa: BLE001 — probe failure = keep last
+                continue
+        with self._lock:
+            for (name, rid), frac in results.items():
+                state = self._deployments.get(name)
+                if state is not None:
+                    state.kv_free_frac[rid] = frac
+
+    def _autoscale_roles_locked(self, state: _DeploymentState, cfg: AutoscalingConfig) -> None:
+        """Per-role autoscaling for a disaggregated deployment: the
+        prefill pool scales on queue depth (ongoing requests — prefill is
+        compute-bound), the decode pool on free KV pages (decode is
+        HBM-bound: a full pool sheds migrations long before its queue
+        grows).  Each pool is clamped to [declared count, max_replicas]
+        and rate-limited like homogeneous autoscaling."""
+        now = time.monotonic()
+        declared = state.roles or {}
+        ongoing: Dict[str, int] = {}
+        for i, r in enumerate(state.replicas):
+            role = state.replica_roles[i] if i < len(state.replica_roles) else ""
+            ongoing[role] = ongoing.get(role, 0) + state.last_inflight.get(id(r), 0)
+        desired: Dict[str, int] = {}
+        # prefill: queue-depth signal
+        p_min = max(1, int(declared.get("prefill", 1)))
+        desired["prefill"] = max(p_min, min(
+            max(p_min, cfg.max_replicas),
+            math.ceil(ongoing.get("prefill", 0) / max(cfg.target_ongoing_requests, 1e-9)),
+        ))
+        # decode: free-KV-page signal with hysteresis
+        d_min = max(1, int(declared.get("decode", 1)))
+        d_max = max(d_min, cfg.max_replicas)
+        d_count = state.replica_roles.count("decode")
+        fracs = [
+            state.kv_free_frac[id(r)]
+            for i, r in enumerate(state.replicas)
+            if i < len(state.replica_roles)
+            and state.replica_roles[i] == "decode"
+            and id(r) in state.kv_free_frac
+        ]
+        d_desired = d_count
+        if fracs:
+            avg_free = sum(fracs) / len(fracs)
+            if avg_free < self.KV_LOW_WATER:
+                d_desired = d_count + 1
+            elif avg_free > self.KV_HIGH_WATER:
+                d_desired = d_count - 1
+        desired["decode"] = max(d_min, min(d_max, d_desired))
+        for role, want in desired.items():
+            cur = state.role_targets.get(role, want)
+            if want > cur and now - state.last_scale_time >= cfg.upscale_delay_s:
+                state.role_targets[role] = want
+                state.last_scale_time = now
+            elif want < cur and now - state.last_scale_time >= cfg.downscale_delay_s:
+                state.role_targets[role] = want
+                state.last_scale_time = now
+        state.target_replicas = sum(state.role_targets.values())
+
+    def _publish_role_gauges_locked(self, state: _DeploymentState) -> None:
+        from ray_tpu.observability import metric_defs
+
+        name = state.deployment.name
+        for role in sorted(state.role_targets):
+            count = state.replica_roles.count(role)
+            ongoing = sum(
+                state.last_inflight.get(id(r), 0)
+                for i, r in enumerate(state.replicas)
+                if i < len(state.replica_roles) and state.replica_roles[i] == role
+            )
+            tags = {"deployment": name, "role": role}
+            metric_defs.SERVE_POOL_REPLICAS.set(count, tags)
+            metric_defs.SERVE_POOL_ONGOING.set(ongoing, tags)
+
+    def pool_status(self) -> Dict[str, dict]:
+        """Per-role pool lines for rt llm / GET /api/overload: replica
+        count, target, ongoing requests, and (decode) free-KV fraction."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for name, state in self._deployments.items():
+                if state.roles is None:
+                    continue
+                pools: Dict[str, dict] = {}
+                for role in sorted(state.role_targets):
+                    idxs = [
+                        i for i, rr in enumerate(state.replica_roles)
+                        if rr == role and i < len(state.replicas)
+                    ]
+                    row = {
+                        "replicas": len(idxs),
+                        "target": int(state.role_targets.get(role, 0)),
+                        "ongoing": sum(
+                            state.last_inflight.get(id(state.replicas[i]), 0)
+                            for i in idxs
+                        ),
+                    }
+                    if role == "decode":
+                        fracs = [
+                            state.kv_free_frac[id(state.replicas[i])]
+                            for i in idxs
+                            if id(state.replicas[i]) in state.kv_free_frac
+                        ]
+                        if fracs:
+                            row["kv_free_frac"] = round(sum(fracs) / len(fracs), 3)
+                    pools[role] = row
+                out[name] = pools
+            return out
+
+    def chaos_kill_replica(self, deployment: str, role: str = "decode",
+                           index: int = 0) -> bool:
+        """Chaos hook (`kill_decode_replica` schedule kind): kill the
+        ``index``-th replica of ``role`` deterministically (list order, no
+        randomness — fault logs must be byte-identical across same-seed
+        replays).  The reconcile loop replaces it on the next tick."""
+        with self._lock:
+            state = self._deployments.get(deployment)
+            if state is None:
+                # default target: the first roles deployment, sorted by
+                # name — deterministic, never random
+                for name in sorted(self._deployments):
+                    if self._deployments[name].roles is not None:
+                        state = self._deployments[name]
+                        break
+            if state is None or state.roles is None:
+                return False
+            idxs = [
+                i for i, rr in enumerate(state.replica_roles)
+                if rr == role and i < len(state.replicas)
+            ]
+            if index >= len(idxs):
+                return False
+            idx = idxs[index]
+            replica = state.replicas.pop(idx)
+            state.replica_roles.pop(idx)
+            state.health.pop(id(replica), None)
+            state.kv_free_frac.pop(id(replica), None)
+            state.version += 1
+            self._changed.notify_all()
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+        return True
+
     # ------------------------------------------------------------- admin
     def shutdown(self) -> None:
+        try:
+            from ray_tpu.runtime.worker import global_worker
+
+            global_worker().cluster.serve_controllers.pop(id(self), None)
+        except Exception:  # noqa: BLE001
+            pass
         with self._lock:
             self._running = False
             for state in self._deployments.values():

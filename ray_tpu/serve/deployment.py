@@ -37,10 +37,20 @@ class Deployment:
         idempotent: bool = False,
         user_config: Optional[dict] = None,
         version: str = "1",
+        roles: Optional[Dict[str, int]] = None,
     ):
         self.func_or_class = func_or_class
         self.name = name
         self.num_replicas = num_replicas
+        # Disaggregated serving (serve/disagg.py): ``roles={"prefill": n,
+        # "decode": m}`` materializes two independently-scaled replica
+        # pools instead of one homogeneous set; the router migrates each
+        # request's KV blocks from its prefill replica to a decode replica
+        # over the device plane.  None = classic homogeneous deployment.
+        # Validated at deploy time (controller.deploy -> validate_roles).
+        self.roles = dict(roles) if roles else None
+        if self.roles is not None:
+            self.num_replicas = sum(int(v) for v in self.roles.values())
         if isinstance(autoscaling_config, dict):
             autoscaling_config = AutoscalingConfig(**autoscaling_config)
         if num_replicas == "auto" and autoscaling_config is None:
@@ -72,6 +82,7 @@ class Deployment:
             idempotent=self.idempotent,
             user_config=self.user_config,
             version=self.version,
+            roles=self.roles,
         )
         name = kwargs.pop("name", self.name)
         merged.update(kwargs)
@@ -119,6 +130,7 @@ def deployment(
     idempotent: bool = False,
     user_config: Optional[dict] = None,
     version: str = "1",
+    roles: Optional[Dict[str, int]] = None,
 ):
     """``@serve.deployment`` (parity: serve/api.py:deployment)."""
 
@@ -134,6 +146,7 @@ def deployment(
             idempotent=idempotent,
             user_config=user_config,
             version=version,
+            roles=roles,
         )
 
     if _func_or_class is not None:

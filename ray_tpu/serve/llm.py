@@ -123,6 +123,14 @@ class GenRequest:
     # queue-wait observed exactly once (a held head-of-line request is
     # resumed through _pop_admissible again and must not double-count)
     wfq_popped: bool = False
+    # disaggregated serving (serve/disagg.py): an EXPORT request runs
+    # chunked prefill, then stages its block set under this migration id
+    # and resolves its future with a ticket instead of decoding; an IMPORT
+    # request carries the producer's ticket + pulled block arrays and
+    # joins the decode batch without prefilling
+    export_mig_id: Optional[str] = None
+    import_ticket: Optional[dict] = None
+    import_arrays: Optional[Dict[int, Any]] = None
 
     def emit(self, tok: int) -> None:
         if self.stream_queue is not None:
@@ -207,10 +215,17 @@ class LLMEngine:
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
         prefix_cache_max_blocks: Optional[int] = None,
+        role: Optional[str] = None,
     ):
         self.cfg = cfg
         self.B = max_batch_size
         self.S = max_seq_len
+        # disaggregated pool role ("prefill"/"decode", "" = co-located).
+        # Informational except for validation: either role can run either
+        # path, the router just never sends a prefill replica decodes.
+        if role not in (None, "", "prefill", "decode"):
+            raise ValueError(f"role must be 'prefill' or 'decode', got {role!r}")
+        self.role = role or ""
         # KV layout: "paged" (block pool + per-slot block tables) is the
         # default via Config.llm_cache_kind; explicit args override the
         # config knobs. Engines under a mesh auto-fall back to dense — the
@@ -223,6 +238,11 @@ class LLMEngine:
             kind = "dense"
         if kind not in ("dense", "paged"):
             raise ValueError(f"cache_kind must be 'dense' or 'paged', got {kind!r}")
+        if self.role and kind != "paged":
+            raise ValueError(
+                f"role={self.role!r} requires the paged KV cache: a dense "
+                "cache has no block table to migrate between replicas"
+            )
         self.cache_kind = kind
         self.kv_block_size = int(
             kv_block_size if kv_block_size is not None else rc.kv_block_size
@@ -360,6 +380,13 @@ class LLMEngine:
         # until release paths free enough blocks
         self._held_req: Optional[GenRequest] = None
         self._prefill_chunk_count = 0
+        # disaggregated serving: staged exports parked by migration id
+        # (the extracted block arrays outlive the prefill request's pool
+        # pages — those retire into the prefix cache at export) and the
+        # in/out migration counters surfaced by stats()/rt llm
+        self._staged: Dict[str, dict] = {}
+        self.num_migrations_out = 0
+        self.num_migrations_in = 0
         metric_defs.LLM_KV_BLOCK_POOL_SIZE.set(
             self._allocator.capacity if self._allocator is not None else 0,
             self._depth_tags,
@@ -487,6 +514,25 @@ class LLMEngine:
             # donated so XLA copies the page in place in the pool buffers
             self._copy_page = jax.jit(copy_paged_page, donate_argnums=(0,))
 
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _write_blocks(cache, kvs, pages):
+                """Land a migrated block set into the pool in ONE donated
+                scatter: ``kvs`` is ``[N, 2, L, block_size, Hkv, Dh]`` (k
+                then v per block), ``pages`` the destination page of each.
+                Per-block writes cost a dispatch each — 24 blocks of a
+                long prompt stall the engine loop ~10ms on the bench box.
+                Callers bucket-pad N by repeating the last (block, page)
+                pair (identical bytes to the same page, so the duplicate
+                scatter indices stay idempotent), keeping the compile
+                count at O(log blocks), not one per block count."""
+                out = {}
+                for i, kk in enumerate(("k", "v")):
+                    out[kk] = cache[kk].at[:, pages].set(
+                        jnp.swapaxes(kvs[:, i], 0, 1)
+                    )
+                return out
+
+            self._write_blocks = _write_blocks
             self._prefill_chunk = _prefill_chunk
             self._decode_k_paged = _decode_k_paged
 
@@ -532,6 +578,9 @@ class LLMEngine:
         tenant: Optional[str] = None,
         deadline_ts: Optional[float] = None,
         _stream_queue=None,
+        _export_mig_id: Optional[str] = None,
+        _import_ticket: Optional[dict] = None,
+        _import_arrays: Optional[Dict[int, Any]] = None,
     ) -> GenRequest:
         if self._stop:
             raise RuntimeError("LLMEngine is shut down")
@@ -611,6 +660,9 @@ class LLMEngine:
                 stream_queue=_stream_queue, tenant=tenant,
                 deadline_ts=deadline_ts, trace=trace,
             )
+            req.export_mig_id = _export_mig_id
+            req.import_ticket = _import_ticket
+            req.import_arrays = _import_arrays
             req.t_submit = time.perf_counter()
             self._queue.push(req, tenant)
             self._queued_tokens += len(prompt)
@@ -634,24 +686,27 @@ class LLMEngine:
 
         q: "_queue.Queue" = _queue.Queue()
         req = self._submit_req(prompt, _stream_queue=q, **kw)
+        return _TokenStream(self._stream_iter(req, q, token_timeout_s), req, self)
+
+    def _stream_iter(self, req: GenRequest, q, token_timeout_s: float = 120.0):
+        """Generator draining ``req``'s stream queue until ``_STREAM_END``
+        (shared by submit_stream and the disagg adopt-stream path)."""
+        import queue as _queue
+
         fut = req.future
-
-        def _iter():
-            while True:
-                try:
-                    tok = q.get(timeout=token_timeout_s)
-                except _queue.Empty:
-                    raise RuntimeError(
-                        f"no token for {token_timeout_s}s — engine stalled or overloaded"
-                    ) from None
-                if tok is _STREAM_END:
-                    exc = fut.exception() if fut.done() else None
-                    if exc is not None:
-                        raise exc
-                    return
-                yield tok
-
-        return _TokenStream(_iter(), req, self)
+        while True:
+            try:
+                tok = q.get(timeout=token_timeout_s)
+            except _queue.Empty:
+                raise RuntimeError(
+                    f"no token for {token_timeout_s}s — engine stalled or overloaded"
+                ) from None
+            if tok is _STREAM_END:
+                exc = fut.exception() if fut.done() else None
+                if exc is not None:
+                    raise exc
+                return
+            yield tok
 
     def _abandon_stream(self, req: GenRequest) -> None:
         """Consumer gone: if the request is still WAITING, drop it from the
@@ -676,10 +731,114 @@ class LLMEngine:
         else:
             self._wake.set()
 
+    # -- disaggregated prefill/decode (serve/disagg.py) ---------------------
+    def prefill_export(
+        self,
+        prompt: List[int],
+        *,
+        mig_id: str,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
+    ) -> Future:
+        """Prefill-pool entry point: chunked-prefill ``prompt`` into local
+        paged KV, sample the first token, stage the block set under
+        ``mig_id`` and resolve the future with the migration ticket
+        (header-only — zero KV payload bytes).  The request reserves only
+        the prompt's pages (``max_tokens=1``): decode never runs here."""
+        if self.cache_kind != "paged":
+            raise ValueError("prefill_export requires the paged KV cache")
+        return self._submit_req(
+            prompt, max_tokens=1, temperature=temperature, eos_id=eos_id,
+            tenant=tenant, deadline_ts=deadline_ts, _export_mig_id=mig_id,
+        ).future
+
+    def adopt_migration(
+        self,
+        ticket: dict,
+        arrays: Dict[int, Any],
+        *,
+        max_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        tenant: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
+        _stream_queue=None,
+    ) -> GenRequest:
+        """Decode-pool entry point: join the continuous batch from a
+        migrated block set.  ``arrays`` maps prompt block index -> the
+        pulled ``[2, L, block_size, Hkv, Dh]`` stack (the caller pulls on
+        its own thread — only the engine loop may touch the cache); block
+        indices already covered by this replica's prefix cache may be
+        omitted.  Admission, block budget, COW and prefix-cache semantics
+        are the normal paged path; only prefill compute is skipped."""
+        if self.cache_kind != "paged":
+            raise ValueError("adopt_migration requires the paged KV cache")
+        return self._submit_req(
+            list(ticket["prompt"]), max_tokens=max_tokens,
+            temperature=temperature, eos_id=eos_id, tenant=tenant,
+            deadline_ts=deadline_ts, _stream_queue=_stream_queue,
+            _import_ticket=dict(ticket),
+            _import_arrays=dict(arrays),
+        )
+
+    def peek_prefix_match(self, prompt: List[int]) -> int:
+        """Longest cached prefix (tokens) of ``prompt`` in THIS replica's
+        prefix cache — the decode side probes before pulling so a warm
+        prefix short-circuits re-migration of shared-prefix blocks.
+        Advisory: admission re-matches, and a shrink in between surfaces
+        as a typed migration error (the ladder re-prefills)."""
+        if self._prefix is None:
+            return 0
+        with self._lock:
+            _, matched = self._prefix.match(prompt)
+        return matched
+
+    def kv_free_blocks(self) -> int:
+        """Free pages right now — the decode-pool routing signal."""
+        alloc = self._allocator
+        if alloc is None:
+            return 0
+        with self._lock:
+            return alloc.free_blocks
+
+    def release_migration(self, mig_id: str) -> bool:
+        """Drop a staged export: forget the arrays and unregister the
+        host-fallback source.  Device-plane offers have no cancel API —
+        unpulled ones expire via the transfer server's staging TTL (a
+        documented device_plane caveat).  Idempotent; True if the staging
+        existed.  The prefill-side POOL pages were already retired into
+        the prefix cache at export, so this never touches the pool —
+        exactly-once freeing is the export path's invariant."""
+        with self._lock:
+            entry = self._staged.pop(mig_id, None)
+        if entry is None:
+            return False
+        from ray_tpu.runtime import data_plane
+
+        data_plane.unregister_kv_block_source(mig_id)
+        return True
+
+    def fetch_staged_block(self, mig_id: str, block_idx: int):
+        """One staged block.  Returns the staged device array as-is: the
+        in-process rung adopts it without a host round-trip, and the
+        data-plane ``kv_pull`` op host-converts it only when actually
+        serving a remote pull."""
+        with self._lock:
+            entry = self._staged.get(mig_id)
+        if entry is None:
+            raise KeyError(f"no staged migration {mig_id!r}")
+        return entry["arrays"][block_idx]
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             alloc = self._allocator
             return {
+                "role": self.role,
+                "migrations_out": self.num_migrations_out,
+                "migrations_in": self.num_migrations_in,
+                "staged_migrations": len(self._staged),
                 "active_slots": int(self._active.sum()),
                 "max_batch_size": self.B,
                 "queued": len(self._queue),
@@ -714,6 +873,10 @@ class LLMEngine:
             useful = self._prefix_results["hit"] + self._prefix_results["partial"]
             return {
                 "layer": "engine",
+                "role": self.role,
+                "migrations_out": self.num_migrations_out,
+                "migrations_in": self.num_migrations_in,
+                "staged_migrations": len(self._staged),
                 "queued": len(self._queue),
                 "queue_bound": self._max_queued,
                 "queued_prefill_tokens": self._queued_tokens,
@@ -768,6 +931,13 @@ class LLMEngine:
                 self._held_req = None
             self._queue.drain()
             self._queued_tokens = 0
+            staged = list(self._staged)
+            self._staged.clear()
+        if staged:
+            from ray_tpu.runtime import data_plane
+
+            for mig_id in staged:
+                data_plane.unregister_kv_block_source(mig_id)
         for r in pending:
             r.future.set_exception(RuntimeError("LLMEngine shut down"))
             if r.stream_queue is not None:
@@ -1096,12 +1266,22 @@ class LLMEngine:
                     self._fail_admit(req, exc)
                     continue
                 req.prefill_pos = tp - 1
+            if req.import_arrays is not None:
+                # migrated request: blocks land from the producer's staged
+                # arrays (or this replica's own prefix cache) — no prefill.
+                # One adoption per admission pass: landing a block set is
+                # the heaviest admission step, and a migration burst
+                # draining in a single pass would stall the decode cadence
+                # for every running stream (the loop re-admits next tick)
+                self._adopt_admitted(req, had_cow=cow_src >= 0)
+                return
             with self._lock:
                 self._prefilling.append(req)
 
     def _finish_prefill(self, req: GenRequest, logits) -> None:
         """Prompt is fully in the paged cache: sample the first token and
-        hand the slot to the decode batch."""
+        hand the slot to the decode batch (or, for an export request,
+        stage the block set for migration instead)."""
         tp = len(req.prompt)
         self._key, sub = jax.random.split(self._key)
         tok0 = int(
@@ -1109,6 +1289,9 @@ class LLMEngine:
                 sub, logits[None, :], jnp.asarray([req.temperature], jnp.float32)
             )[0]
         )
+        if req.export_mig_id is not None:
+            self._export_staged(req, tok0)
+            return
         req.generated = [tok0]
         self._note_first_token(req)
         req.emit(tok0)
@@ -1121,6 +1304,153 @@ class LLMEngine:
             self._pos[slot] = tp
             self._temps[slot] = req.temperature
         self._maybe_finish(req, tok0)
+
+    def _adopt_admitted(self, req: GenRequest, *, had_cow: bool) -> None:
+        """Activate an admitted IMPORT request: write the pulled block
+        arrays into its freshly allocated pages (runs on the engine loop —
+        the only thread allowed to touch the donated cache), then join the
+        decode batch at position ``len(prompt)`` with the producer's first
+        token.  A warm local prefix covers its blocks without any write
+        (the re-migration short-circuit); a block neither cached nor
+        pulled — the prefix shrank between the caller's probe and now —
+        is the typed migration error, and the ladder re-prefills."""
+        from ray_tpu.serve.disagg import KVMigrationError
+
+        ticket = req.import_ticket or {}
+        mig_id = ticket.get("mig_id", "?")
+        tp = len(req.prompt)
+        bs = self.kv_block_size
+        n_blocks = -(-tp // bs)
+        if not had_cow:
+            # prefill_pos = matched tokens (a multiple of block_size);
+            # with a full-hit COW every prompt position is already paged
+            # in, so there is nothing to write at all
+            writes = []
+            for bidx in range(req.prefill_pos // bs, n_blocks):
+                arr = (req.import_arrays or {}).get(bidx)
+                if arr is None:
+                    self._fail_admit(req, KVMigrationError(
+                        mig_id, "pulled",
+                        f"block {bidx} neither locally cached nor pulled "
+                        f"(local prefix match shrank to {req.prefill_pos} "
+                        "tokens after the probe)",
+                    ))
+                    return
+                writes.append(
+                    (arr, int(self._block_tables[req.slot, bidx]))
+                )
+            if writes:
+                bucket = 1
+                while bucket < len(writes):
+                    bucket *= 2
+                while len(writes) < bucket:  # idempotent scatter pad
+                    writes.append(writes[-1])
+                try:
+                    # host-side stack: jnp.stack dispatches an expand_dims
+                    # per block (~1.5ms for a long prompt's 32); np views
+                    # of CPU-backend arrays memcpy in ~80µs, and the jit
+                    # boundary ships one contiguous buffer
+                    self._cache = self._write_blocks(
+                        self._cache,
+                        np.stack([np.asarray(a) for a, _ in writes]),
+                        np.asarray([p for _, p in writes], np.int32),
+                    )
+                except BaseException as exc:  # noqa: BLE001
+                    self._fail_admit(req, exc)
+                    return
+        tok0 = int(ticket.get("tok0", 0))
+        req.generated = [tok0]
+        now = time.perf_counter()
+        req.t_first = req.t_last_tok = now
+        if req.trace is not None:
+            # the migration phase ends here: first_token was marked on the
+            # prefill replica, decode gaps accrue on THIS one
+            req.trace.mark("kv_migrate")
+        req.emit(tok0)
+        with self._lock:
+            slot = req.slot
+            self._slots[slot] = req
+            self._active[slot] = True
+            self._reserved[slot] = False
+            self._last_tok[slot] = tok0
+            self._pos[slot] = tp
+            self._temps[slot] = req.temperature
+            self.num_migrations_in += 1
+        self._maybe_finish(req, tok0)
+
+    def _export_staged(self, req: GenRequest, tok0: int) -> None:
+        """Export terminal of a prefill-pool request: extract the prompt
+        blocks as device-array copies, stage them for device-to-device
+        pull under deterministic ``(request, block)`` uuids, register the
+        host fallback source, retire the POOL pages into this replica's
+        prefix cache (exactly-once: the staged copies, not the pages,
+        migrate), and resolve the future with the header-only ticket."""
+        from ray_tpu.runtime import data_plane, device_plane
+        from ray_tpu.serve import disagg
+
+        mig_id = req.export_mig_id
+        tp = len(req.prompt)
+        bs = self.kv_block_size
+        n_blocks = -(-tp // bs)
+        req.generated = [tok0]
+        self._note_first_token(req)
+        # engine-thread-only cache reads: jnp indexing materializes NEW
+        # buffers, so the copies survive later donated steps
+        arrays = []
+        for bidx in range(n_blocks):
+            page = int(self._block_tables[req.slot, bidx])
+            arrays.append(
+                jnp.stack([self._cache["k"][:, page], self._cache["v"][:, page]])
+            )
+        if arrays:
+            jax.block_until_ready(arrays[-1])
+        transfer_addr = device_plane.transfer_address()
+        if transfer_addr is not None:
+            for bidx, arr in enumerate(arrays):
+                if not device_plane.offer_device_pull(
+                    disagg.migration_uuid(mig_id, bidx), arr
+                ):
+                    # staging cap hit: advertise no device rung — offers
+                    # already made are consumed or TTL-reaped
+                    transfer_addr = None
+                    break
+
+        def _fetch(idx: int, _arrays=arrays):
+            # device array as-is: the in-process rung adopts it zero-copy;
+            # the data-plane kv_pull op host-converts only for remote pulls
+            return _arrays[idx]
+
+        data_plane.register_kv_block_source(mig_id, _fetch)
+        evicted_n = 0
+        with self._lock:
+            # pool pages retire into the prefix cache NOW (cached tokens =
+            # the prompt: tok0 was sampled, never written back) — the one
+            # free of the migrated block set on this replica
+            evicted_n = self._retire_blocks_locked(req)
+            self._staged[mig_id] = {
+                "arrays": arrays,
+                "prompt": list(req.prompt),
+                "n_blocks": n_blocks,
+            }
+            self.num_migrations_out += 1
+            gauges = self._pool_gauges_locked()
+        if evicted_n:
+            metric_defs.LLM_PREFIX_EVICTIONS.inc(evicted_n)
+        self._publish_pool_gauges(*gauges)
+        ticket = disagg.make_ticket(
+            mig_id,
+            prompt=req.prompt,
+            tok0=tok0,
+            n_blocks=n_blocks,
+            block_size=bs,
+            block_shape=tuple(arrays[0].shape) if arrays else (0,),
+            block_dtype=str(arrays[0].dtype) if arrays else "float32",
+            transfer_addr=transfer_addr,
+            data_addr=disagg.local_data_addr(),
+            source=str(self._admission_token),
+        )
+        self._record_done(req, "finish", f"export {mig_id}")
+        req.future.set_result(ticket)
 
     def _fail_admit(self, req: GenRequest, exc: BaseException) -> None:
         """A popped request is in neither queue nor slots — fail it HERE or
@@ -1490,10 +1820,12 @@ class LLMServer:
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
         prefix_cache_max_blocks: Optional[int] = None,
+        role: Optional[str] = None,
     ):
         made = model_factory()
         cfg, params = made[0], made[1]
         self.tokenizer = made[2] if len(made) > 2 else None
+        self.role = role or ""
         self.engine = LLMEngine(
             cfg,
             params,
@@ -1514,6 +1846,7 @@ class LLMServer:
             prefill_chunk_tokens=prefill_chunk_tokens,
             prefix_cache=prefix_cache,
             prefix_cache_max_blocks=prefix_cache_max_blocks,
+            role=role,
         )
 
     def _encode(self, request: Dict[str, Any]) -> List[int]:
@@ -1560,6 +1893,98 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    # -- disaggregated prefill/decode (called by the router's dispatcher) --
+    def disagg_prefill(self, request: Dict[str, Any], mig_id: str) -> dict:
+        """Prefill-pool half of a disaggregated request: chunked prefill +
+        stage, returning the header-only migration ticket."""
+        prompt = self._encode(request)
+        return self.engine.prefill_export(
+            prompt,
+            mig_id=mig_id,
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"),
+        ).result()
+
+    def disagg_decode(self, request: Dict[str, Any], ticket: dict):
+        """Decode-pool half: probe the local prefix cache, pull only the
+        uncached-suffix blocks (device rung first, host fallback after),
+        adopt into the continuous batch and run decode to completion.
+        Migration failures return the typed-error envelope the dispatcher
+        converts into KVMigrationError — the re-prefill ladder, not a
+        crashed request."""
+        from ray_tpu.serve import disagg
+
+        prompt = list(ticket["prompt"])
+        bs = self.engine.kv_block_size
+        n_blocks = int(ticket["n_blocks"])
+        matched = self.engine.peek_prefix_match(prompt)
+        arrays: Dict[int, Any] = {}
+        rung = "device"
+        try:
+            for bidx in range(matched // bs, n_blocks):
+                arr, r = disagg.pull_block(ticket, bidx)
+                if r != "device":
+                    rung = r
+                arrays[bidx] = arr
+        except disagg.KVMigrationError as exc:
+            return {"_kv_migration_error": True, "stage": exc.stage,
+                    "message": str(exc)}
+        kw = dict(
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"),
+        )
+        if request.get("stream"):
+            import queue as _queue
+
+            q: "_queue.Queue" = _queue.Queue()
+            req = self.engine.adopt_migration(
+                ticket, arrays, _stream_queue=q, **kw
+            )
+            stream = _TokenStream(
+                self.engine._stream_iter(req, q), req, self.engine
+            )
+
+            def events():
+                n = 0
+                for tok in stream:
+                    n += 1
+                    yield {"token": tok}
+                yield {"done": True, "num_generated": n}
+
+            return {"_stream": events(), "_migration_rung": rung}
+        t0 = time.perf_counter()
+        req = self.engine.adopt_migration(ticket, arrays, **kw)
+        try:
+            out = req.future.result()
+        except disagg.KVMigrationError as exc:
+            return {"_kv_migration_error": True, "stage": exc.stage,
+                    "message": str(exc)}
+        except RuntimeError as exc:
+            cause = exc.__cause__
+            if isinstance(cause, disagg.KVMigrationError):
+                return {"_kv_migration_error": True, "stage": cause.stage,
+                        "message": str(cause)}
+            raise
+        resp = {
+            "tokens": out,
+            "num_generated": len(out),
+            "latency_s": round(time.perf_counter() - t0, 4),
+            "_migration_rung": rung,
+        }
+        if self.tokenizer is not None:
+            resp["text"] = self.tokenizer.decode(out)
+        return resp
+
+    def disagg_release(self, mig_id: str) -> bool:
+        """Drop a staged export (dispatcher calls exactly once per
+        migration, whatever the outcome)."""
+        return self.engine.release_migration(mig_id)
+
+    def kv_free_blocks(self) -> int:
+        """Decode-pool routing signal for the role-aware router."""
+        return self.engine.kv_free_blocks()
 
     def __del__(self):
         try:

@@ -114,6 +114,41 @@ class DeploymentResponse:
         return self._ref
 
 
+class _DisaggResponse:
+    """Future-like response for the disaggregated path: the dispatcher
+    drives prefill → migration → decode on a router worker thread; this
+    wraps its future with the DeploymentResponse surface (``result()`` with
+    the reference's ``timeout_s`` spelling, typed admission errors
+    unwrapped, ``_to_object_ref`` for composition)."""
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None, *,
+               timeout_s: Optional[float] = None) -> Any:
+        budget = timeout_s if timeout_s is not None else timeout
+        try:
+            return self._fut.result(budget)
+        except Exception as exc:  # noqa: BLE001 — filtered below
+            from ray_tpu.exceptions import (
+                DeadlineExceededError,
+                OverloadedError,
+                StoreFullError,
+                raised_copy,
+            )
+            from ray_tpu.runtime.admission import unwrap
+
+            cause = unwrap(exc)
+            if cause is not exc and isinstance(
+                cause, (OverloadedError, DeadlineExceededError, StoreFullError)
+            ):
+                raise raised_copy(cause) from None
+            raise
+
+    def _to_object_ref(self):
+        return ray_tpu.put(self.result())
+
+
 class Router:
     def __init__(self, deployment_name: str, controller_handle):
         self.deployment_name = deployment_name
@@ -141,6 +176,15 @@ class Router:
         self._max_queued = -1
         self._idempotent = False
         self._meta_version = None
+        # disaggregated prefill/decode (serve/disagg.py): roles declared by
+        # the deployment, the per-replica role list (index-aligned with
+        # _replicas per membership version), and the lazily-built dispatcher
+        # + its dispatch pool (dispatcher calls block on prefill AND decode,
+        # so they run off the caller's thread to keep .remote() non-blocking)
+        self._roles: Optional[Dict[str, int]] = None
+        self._replica_roles: List[str] = []
+        self._disagg = None
+        self._disagg_pool = None
 
     # ------------------------------------------------------------ updates
     def _apply_snapshot(self, version: int, replicas: List[Any]) -> None:
@@ -199,6 +243,8 @@ class Router:
                 self._max_ongoing = int(meta.get("max_ongoing_requests", 100))
                 self._max_queued = int(meta.get("max_queued_requests", -1))
                 self._idempotent = bool(meta.get("idempotent", False))
+                self._roles = meta.get("roles") or None
+                self._replica_roles = list(meta.get("replica_roles") or ())
             self._meta_version = version
 
     def _watch_loop(self) -> None:
@@ -231,6 +277,15 @@ class Router:
         period, and re-routing onto it just burns the retry."""
         with self._lock:
             if replica in self._replicas:
+                # prune the role entry at the same index so _replica_roles
+                # stays aligned until the controller's replacement snapshot
+                idx = next(
+                    i for i, r in enumerate(self._replicas) if r is replica
+                )
+                if idx < len(self._replica_roles):
+                    self._replica_roles = (
+                        self._replica_roles[:idx] + self._replica_roles[idx + 1:]
+                    )
                 self._replicas = [r for r in self._replicas if r is not replica]
                 self._inflight = {
                     id(r): self._inflight.get(id(r), 0) for r in self._replicas
@@ -342,6 +397,121 @@ class Router:
                 self._queue_waiters, self._depth_tags
             )
 
+    # --------------------------------------------- disaggregated dispatch
+    def call_replica(self, deployment: str, index: int, method: str,
+                     args: tuple, tenant=None, trace=None, *,
+                     timeout: Optional[float] = None):
+        """Call ONE replica by index and block for its result (the disagg
+        dispatcher's primitive: migrations target a specific replica pair,
+        so pow-2 sampling happens in pick_role_replica, not here).  The
+        in-flight count still settles through the completion hook so the
+        queue-depth signal sees dispatcher traffic too."""
+        with self._lock:
+            if index < 0 or index >= len(self._replicas):
+                raise RuntimeError(
+                    f"deployment {deployment!r} replica #{index} left the "
+                    "membership (died or scaled away)"
+                )
+            replica = self._replicas[index]
+            rkey = id(replica)
+            self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
+        ref = replica.handle_request.remote(
+            method, tuple(args), {}, tenant, trace
+        )
+        from ray_tpu.api import get_cluster
+
+        get_cluster().directory.wait_for(
+            ref.id(), lambda _node, k=rkey: self._request_finished(k)
+        )
+        return ray_tpu.get(ref, timeout=timeout)
+
+    def pick_role_replica(self, deployment: str, role: str,
+                          signal: str = "queue") -> int:
+        """Pick a replica index from one role's pool.  ``signal="queue"``
+        (prefill): pow-2 over locally-tracked in-flight counts — prefill is
+        compute-bound, so queue depth is the contended resource.
+        ``signal="kv_free"`` (decode): probe free KV pages on a pow-2
+        sample and take the roomier replica — decode is HBM-bound, and a
+        migration landing on a page-starved replica just sheds."""
+        self._refresh()
+        with self._lock:
+            if len(self._replica_roles) != len(self._replicas):
+                aligned = False
+            else:
+                aligned = True
+            roles = list(self._replica_roles)
+            n = len(self._replicas)
+        if not aligned:
+            self._refresh(force=True)
+            with self._lock:
+                roles = list(self._replica_roles)
+                n = len(self._replicas)
+        idxs = [i for i, r in enumerate(roles[:n]) if r == role]
+        if not idxs:
+            raise RuntimeError(
+                f"deployment {deployment!r} has no live {role!r} replicas"
+            )
+        if len(idxs) == 1:
+            return idxs[0]
+        with self._lock:
+            a, b = self._rng.sample(idxs, 2)
+        if signal == "kv_free":
+            best, best_free = a, -1
+            for i in (a, b):
+                try:
+                    free = int(self.call_replica(
+                        deployment, i, "kv_free_blocks", (), timeout=5.0
+                    ))
+                except Exception:  # noqa: BLE001 — probe failure = skip
+                    continue
+                if free > best_free:
+                    best, best_free = i, free
+            return best
+        with self._lock:
+            return a if self._load_locked(a) <= self._load_locked(b) else b
+
+    def _route_disagg(self, args: tuple, kwargs: dict) -> "_DisaggResponse":
+        """Delegate a ``__call__`` on a roles deployment to the disagg
+        dispatcher (prefill pool → KV migration → decode pool) on a worker
+        thread, so ``.remote()`` stays non-blocking like ordinary dispatch."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.runtime.context import current_request_trace, current_tenant
+        from ray_tpu.serve.disagg import DisaggDispatcher
+
+        trace = current_request_trace()
+        if trace is not None:
+            trace.mark("router_in")
+            if not trace.deployment:
+                trace.deployment = self.deployment_name
+        request = args[0] if args else kwargs.get("request")
+        if not isinstance(request, dict):
+            raise TypeError(
+                f"disaggregated deployment {self.deployment_name!r} takes a "
+                "single request dict"
+            )
+        tenant = current_tenant()
+        with self._lock:
+            if self._disagg is None:
+                self._disagg = DisaggDispatcher(self, self.deployment_name)
+            if self._disagg_pool is None:
+                self._disagg_pool = ThreadPoolExecutor(
+                    max_workers=32,
+                    thread_name_prefix=f"disagg-{self.deployment_name}",
+                )
+            disp, pool = self._disagg, self._disagg_pool
+        metric_defs.SERVE_ROUTER_REQUESTS.inc(tags=self._metric_tags)
+        if trace is not None:
+            trace.mark("router_dequeue")
+        return _DisaggResponse(pool.submit(disp.route, request, tenant, trace))
+
+    def disagg_snapshot(self) -> Optional[dict]:
+        """Per-role dispatch/migration counters for rt llm / /api/overload
+        (None until the first disaggregated request)."""
+        with self._lock:
+            disp = self._disagg
+        return None if disp is None else disp.snapshot()
+
     def route(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
         from ray_tpu.runtime.context import current_request_trace, current_tenant
 
@@ -357,6 +527,14 @@ class Router:
             self._refresh()
         if not self._replicas:  # rt-lint: disable=lock-discipline -- same
             raise RuntimeError(f"deployment {self.deployment_name!r} has no replicas")
+        # rt-lint: disable=lock-discipline -- meta-gated delegation: _roles
+        # only transitions None->dict at meta refresh; a stale None routes
+        # one early request homogeneously, never corrupts state
+        if self._roles and method == "__call__":
+            # roles deployment: __call__ takes the disaggregated path
+            # (prefill pool -> KV migration -> decode pool); other methods
+            # (stats, reconfigure hooks) still dispatch normally below
+            return self._route_disagg(args, kwargs)
         original_request = (method, args, kwargs)  # PRE-resolution, for replay
         tenant = current_tenant()
         with self._lock:

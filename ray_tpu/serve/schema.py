@@ -37,6 +37,7 @@ _DEPLOYMENT_OVERRIDE_KEYS = {
     "idempotent",
     "user_config",
     "version",
+    "roles",
 }
 
 
